@@ -1,0 +1,73 @@
+"""paddle_tpu — a TPU-native deep-learning framework with PaddlePaddle's
+capabilities, built on JAX/XLA/Pallas (see SURVEY.md for the reference map).
+
+The top-level namespace mirrors `import paddle`: tensor creation/math live here,
+`nn`, `optimizer`, `amp`, `io`, `vision`, `distributed`… as submodules.
+"""
+from __future__ import annotations
+
+import jax as _jax
+
+# float64/int64 parity with the reference requires x64 mode; TPU code paths
+# should still use fp32/bf16 (float64 on TPU is software-emulated).
+_jax.config.update("jax_enable_x64", True)
+
+from .core import (  # noqa: F401,E402
+    Tensor, to_tensor,
+    no_grad, enable_grad, grad, is_grad_enabled, set_grad_enabled,
+    Place, CPUPlace, TPUPlace, CUDAPlace, CUDAPinnedPlace,
+    set_device, get_device,
+    is_compiled_with_cuda, is_compiled_with_rocm, is_compiled_with_xpu,
+    is_compiled_with_tpu, is_compiled_with_distribute,
+    bool_, uint8, int8, int16, int32, int64, float16, bfloat16, float32,
+    float64, complex64, complex128, set_default_dtype, get_default_dtype,
+    seed, get_rng_state, set_rng_state,
+)
+from .ops import *  # noqa: F401,F403,E402
+from .ops import creation as _creation  # noqa: E402
+
+# submodules (imported lazily below to keep `import paddle_tpu` light where
+# possible; nn/optimizer pull in the full layer corpus)
+from . import nn  # noqa: E402,F401
+from . import optimizer  # noqa: E402,F401
+from . import amp  # noqa: E402,F401
+from . import io  # noqa: E402,F401
+from . import metric  # noqa: E402,F401
+from . import framework  # noqa: E402,F401
+from .framework.io import save, load  # noqa: E402,F401
+from . import device  # noqa: E402,F401
+from . import autograd  # noqa: E402,F401
+
+__version__ = "0.1.0"
+
+# `paddle.disable_static()/enable_static()` parity: this framework is always
+# "dygraph" at the surface (compiled via jit underneath), so these are no-ops
+# kept for source compatibility.
+_static_mode = False
+
+
+def enable_static():
+    global _static_mode
+    _static_mode = True
+
+
+def disable_static():
+    global _static_mode
+    _static_mode = False
+
+
+def in_dynamic_mode() -> bool:
+    return not _static_mode
+
+
+def is_grad_enabled_():  # legacy alias
+    return is_grad_enabled()
+
+
+def summary(net, input_size=None, dtypes=None):
+    from .hapi.summary import summary as _summary
+    return _summary(net, input_size, dtypes)
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    return 0

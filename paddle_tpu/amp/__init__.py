@@ -1,0 +1,11 @@
+"""Automatic mixed precision (reference: python/paddle/amp/auto_cast.py:21,
+grad_scaler.py:26).
+
+On TPU the AMP dtype of choice is bfloat16: same exponent range as fp32, so
+loss scaling is numerically unnecessary — GradScaler stays API-compatible but
+becomes a cheap pass-through when scaling is disabled or dtype is bf16.
+O1 = white/black-list op casting at the Tensor-op boundary; O2 = cast the whole
+model to the low dtype with fp32 master weights held by the optimizer.
+"""
+from .auto_cast import auto_cast, decorate, amp_guard, white_list, black_list  # noqa: F401
+from .grad_scaler import GradScaler, AmpScaler  # noqa: F401

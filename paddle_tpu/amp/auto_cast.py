@@ -1,0 +1,86 @@
+"""auto_cast — O1/O2 mixed precision (reference: python/paddle/amp/auto_cast.py).
+
+Implemented as a thread-local autocast state consulted by the defop layer:
+inside an ``auto_cast(True)`` scope, ops on the white list compute in the low
+dtype (bf16 by default on TPU), black-list ops compute in fp32.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax.numpy as jnp
+
+from ..core.dtype import to_jax
+
+# reference white/black lists (amp/auto_cast.py WHITE_LIST/BLACK_LIST)
+white_list = {"matmul", "mm", "bmm", "mv", "conv1d", "conv2d", "conv3d",
+              "conv1d_transpose", "conv2d_transpose", "conv3d_transpose",
+              "linear", "einsum", "attention", "scaled_dot_product_attention"}
+black_list = {"exp", "square", "log", "mean", "sum", "cos_sim", "softmax",
+              "log_softmax", "softmax_with_cross_entropy", "sigmoid_cross_entropy_with_logits",
+              "cross_entropy", "layer_norm", "batch_norm", "reduce_sum", "pow"}
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = jnp.bfloat16
+        self.level = "O1"
+        self.custom_white = set()
+        self.custom_black = set()
+
+
+_state = _AmpState()
+
+
+def amp_state():
+    return _state
+
+
+def should_cast(op_name: str) -> str | None:
+    """Return 'low'/'high'/None for an op under the active autocast scope."""
+    if not _state.enabled:
+        return None
+    if op_name in _state.custom_black or op_name in black_list:
+        return "high"
+    if _state.level == "O2":
+        return "low"
+    if op_name in _state.custom_white or op_name in white_list:
+        return "low"
+    return None
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16"):
+    prev = (_state.enabled, _state.dtype, _state.level, _state.custom_white,
+            _state.custom_black)
+    _state.enabled = bool(enable)
+    _state.dtype = jnp.dtype(to_jax(dtype))
+    _state.level = level
+    _state.custom_white = set(custom_white_list or ())
+    _state.custom_black = set(custom_black_list or ())
+    try:
+        yield
+    finally:
+        (_state.enabled, _state.dtype, _state.level, _state.custom_white,
+         _state.custom_black) = prev
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2 decoration: cast model params to the AMP dtype (reference
+    amp/auto_cast.py:81 `decorate`).  Master fp32 weights live in the optimizer
+    functional state, so params can safely be low precision."""
+    single_model = not isinstance(models, (list, tuple))
+    model_list = [models] if single_model else list(models)
+    if level == "O2":
+        for m in model_list:
+            m.to(dtype=dtype)
+    if optimizers is None:
+        return models if single_model else model_list
+    return (models if single_model else model_list), optimizers

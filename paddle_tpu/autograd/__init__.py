@@ -1,0 +1,5 @@
+"""paddle.autograd parity surface."""
+from ..core.autograd import backward, no_grad, enable_grad, grad, set_grad_enabled, is_grad_enabled  # noqa: F401
+from ..core.pylayer import PyLayer, PyLayerContext  # noqa: F401
+
+PyLayerMeta = type(PyLayer)

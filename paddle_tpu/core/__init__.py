@@ -1,0 +1,13 @@
+from . import dtype, place, random, autograd  # noqa: F401
+from .tensor import Tensor, to_tensor  # noqa: F401
+from .autograd import no_grad, enable_grad, grad, is_grad_enabled, set_grad_enabled  # noqa: F401
+from .place import (  # noqa: F401
+    Place, CPUPlace, TPUPlace, CUDAPlace, CUDAPinnedPlace, set_device, get_device,
+    is_compiled_with_cuda, is_compiled_with_rocm, is_compiled_with_xpu,
+    is_compiled_with_tpu, is_compiled_with_distribute, device_count,
+)
+from .dtype import (  # noqa: F401
+    bool_, uint8, int8, int16, int32, int64, float16, bfloat16, float32, float64,
+    complex64, complex128, set_default_dtype, get_default_dtype,
+)
+from .random import seed, get_rng_state, set_rng_state  # noqa: F401

@@ -1,0 +1,239 @@
+"""Eager autograd engine.
+
+The reference has two C++ autograd engines (paddle/fluid/eager/backward.cc:105
+``RunBackward`` — a topological walk over ``GradNodeBase`` graphs; legacy
+paddle/fluid/imperative/basic_engine.cc).  Here the graph is built per-op from
+``jax.vjp``: every differentiable eager op stores its VJP closure (which holds the
+residuals, like the reference's TensorWrapper saved-tensors) in a :class:`GradNode`.
+``backward()`` walks nodes in reverse execution order — a valid topological order
+for an eagerly-recorded graph — mirroring RunBackward's dual-queue walk without
+needing an in-degree map.
+
+The jit/compiled training path does NOT use this engine: there gradients come from
+``jax.grad`` over a functional step (the analog of static-graph ``append_backward``).
+"""
+from __future__ import annotations
+
+import contextlib
+import itertools
+import threading
+from typing import Any, Callable, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+class _GradState(threading.local):
+    def __init__(self):
+        self.enabled = True
+
+
+_grad_state = _GradState()
+_node_counter = itertools.count()
+
+
+def is_grad_enabled() -> bool:
+    return _grad_state.enabled
+
+
+def set_grad_enabled(mode: bool):
+    _grad_state.enabled = bool(mode)
+
+
+class no_grad(contextlib.ContextDecorator):
+    """paddle.no_grad — usable as context manager and decorator."""
+
+    def __enter__(self):
+        self._prev = _grad_state.enabled
+        _grad_state.enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        _grad_state.enabled = self._prev
+        return False
+
+
+class enable_grad(contextlib.ContextDecorator):
+    def __enter__(self):
+        self._prev = _grad_state.enabled
+        _grad_state.enabled = True
+        return self
+
+    def __exit__(self, *exc):
+        _grad_state.enabled = self._prev
+        return False
+
+
+class GradNode:
+    """One recorded op: holds the VJP closure and edges to input tensors.
+
+    ≈ GradNodeBase (paddle/fluid/eager/grad_node_info.h:168): ``inputs`` are the
+    slot edges, ``vjp_fn`` plays the role of the generated grad-op body plus its
+    saved TensorWrappers.
+    """
+
+    __slots__ = ("seq", "vjp_fn", "inputs", "n_outputs", "out_avals", "name")
+
+    def __init__(self, vjp_fn, inputs, n_outputs, out_avals, name=""):
+        self.seq = next(_node_counter)
+        self.vjp_fn = vjp_fn
+        self.inputs = inputs          # list[Tensor] (only those requiring grad)
+        self.n_outputs = n_outputs
+        self.out_avals = out_avals    # list[(shape, dtype)] for zero cotangents
+        self.name = name
+
+    def released(self) -> bool:
+        return self.vjp_fn is None
+
+    def release(self):
+        self.vjp_fn = None
+
+
+def _zero_cotangent(shape, dtype):
+    d = jnp.dtype(dtype)
+    if not jnp.issubdtype(d, jnp.floating) and not jnp.issubdtype(d, jnp.complexfloating):
+        return np.zeros(shape, dtype=jax.dtypes.float0)
+    return jnp.zeros(shape, dtype=d)
+
+
+def backward(tensors: Sequence[Any], grad_tensors: Sequence[Any] | None = None,
+             retain_graph: bool = False, sink: dict | None = None,
+             capture: set | None = None):
+    """Run the backward pass from `tensors` (≈ egr::Backward, backward.cc:105).
+
+    sink/capture serve paddle.grad: with `sink` given, gradients are collected
+    into ``sink[id(tensor)]`` for leaves and for tensors whose id is in
+    `capture`, and NO Tensor.grad is mutated anywhere in the graph.
+    """
+    from .tensor import Tensor  # circular: Tensor imports nothing from here at module top
+
+    tensors = list(tensors)
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+
+    # grads keyed per-(node, output-slot), plus leaf accumulation on the Tensor.
+    out_grads: dict[tuple[int, int], Any] = {}
+    node_by_id: dict[int, GradNode] = {}
+
+    def _sink_add(t: Tensor, g):
+        if g.dtype != t._value.dtype:
+            g = g.astype(t._value.dtype)
+        prev = sink.get(id(t))
+        sink[id(t)] = g if prev is None else prev + g
+
+    def seed_grad(t: Tensor, g):
+        if g is None:
+            if t._value.size != 1:
+                raise RuntimeError(
+                    "grad can be implicitly created only for scalar outputs; "
+                    f"got shape {tuple(t.shape)}")
+            g = jnp.ones_like(t._value)
+        else:
+            g = g._value if isinstance(g, Tensor) else jnp.asarray(g)
+        captured = capture is not None and id(t) in capture
+        if captured:
+            _sink_add(t, g)
+        if t._grad_node is None:
+            if not captured:
+                _accumulate_leaf(t, g)
+        else:
+            node = t._grad_node
+            node_by_id[id(node)] = node
+            key = (id(node), t._grad_slot)
+            out_grads[key] = g if key not in out_grads else out_grads[key] + g
+
+    def _accumulate_leaf(t: Tensor, g):
+        if t.stop_gradient:
+            return
+        if sink is not None:
+            _sink_add(t, g)
+            return
+        if g.dtype != t._value.dtype:
+            g = g.astype(t._value.dtype)
+        if t._grad is None:
+            t._grad = Tensor(g, stop_gradient=True)
+        else:
+            t._grad = Tensor(t._grad._value + g, stop_gradient=True)
+
+    for t, g in zip(tensors, grad_tensors):
+        seed_grad(t, g)
+
+    # Discover the reachable subgraph.
+    frontier = list(node_by_id.values())
+    seen = set(node_by_id)
+    while frontier:
+        node = frontier.pop()
+        for inp in node.inputs:
+            parent = inp._grad_node
+            if parent is not None and id(parent) not in seen:
+                seen.add(id(parent))
+                node_by_id[id(parent)] = parent
+                frontier.append(parent)
+
+    # Reverse execution order == topological order for an eager tape.
+    order = sorted(node_by_id.values(), key=lambda n: n.seq, reverse=True)
+
+    for node in order:
+        if node.released():
+            raise RuntimeError(
+                "trying to backward through the graph a second time; "
+                "pass retain_graph=True to Tensor.backward() if needed")
+        cts = []
+        has_any = False
+        for slot in range(node.n_outputs):
+            g = out_grads.pop((id(node), slot), None)
+            if g is None:
+                shape, dtype = node.out_avals[slot]
+                g = _zero_cotangent(shape, dtype)
+            else:
+                has_any = True
+            cts.append(g)
+        if not has_any:
+            continue
+        ct = cts[0] if node.n_outputs == 1 else tuple(cts)
+        in_grads = node.vjp_fn(ct)
+        if not retain_graph:
+            node.release()
+        for inp, g in zip(node.inputs, in_grads):
+            captured = capture is not None and id(inp) in capture
+            if captured:
+                _sink_add(inp, g)
+            if inp._grad_node is None:
+                if not captured:
+                    _accumulate_leaf(inp, g)
+            else:
+                key = (id(inp._grad_node), inp._grad_slot)
+                out_grads[key] = g if key not in out_grads else out_grads[key] + g
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=False,
+         allow_unused=False):
+    """paddle.grad — functional gradient of eager outputs w.r.t. inputs.
+
+    Implemented by running :func:`backward` on a detached view of leaf grads.
+    create_graph (double backward) is served by the functional `jax.grad` path
+    instead and rejected here.
+    """
+    from .tensor import Tensor
+
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True in eager mode is not supported; use the functional "
+            "API (paddle_tpu.incubate.autograd or jax.grad over a pure function)")
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    from .tensor import Tensor
+    sink: dict[int, Any] = {}
+    backward(outputs, grad_outputs, retain_graph=bool(retain_graph),
+             sink=sink, capture={id(t) for t in inputs})
+    result = []
+    for t in inputs:
+        g = sink.get(id(t))
+        if g is None and not allow_unused:
+            raise RuntimeError(
+                "one of the inputs has no gradient; pass allow_unused=True "
+                "to get None for it")
+        result.append(None if g is None else Tensor(g, stop_gradient=True,
+                                                    _internal=True))
+    return result

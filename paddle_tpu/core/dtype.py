@@ -1,0 +1,106 @@
+"""Dtype system.
+
+Mirrors the reference's dtype enumeration (paddle/phi/common/data_type.h) as thin
+aliases onto JAX/numpy dtypes.  A paddle dtype is represented as a canonical
+``numpy.dtype`` instance so equality/hashing work the way user code expects
+(``t.dtype == paddle.float32``).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+import ml_dtypes
+
+# Canonical dtype objects, in the order of phi::DataType.
+bool_ = np.dtype(np.bool_)
+uint8 = np.dtype(np.uint8)
+int8 = np.dtype(np.int8)
+int16 = np.dtype(np.int16)
+int32 = np.dtype(np.int32)
+int64 = np.dtype(np.int64)
+float16 = np.dtype(np.float16)
+bfloat16 = np.dtype(ml_dtypes.bfloat16)
+float32 = np.dtype(np.float32)
+float64 = np.dtype(np.float64)
+complex64 = np.dtype(np.complex64)
+complex128 = np.dtype(np.complex128)
+
+_ALIASES = {
+    "bool": bool_,
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "fp16": float16,
+    "half": float16,
+    "bfloat16": bfloat16,
+    "bf16": bfloat16,
+    "float32": float32,
+    "fp32": float32,
+    "float": float32,
+    "float64": float64,
+    "fp64": float64,
+    "double": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+}
+
+_FLOATING = {float16, bfloat16, float32, float64}
+_INTEGRAL = {uint8, int8, int16, int32, int64}
+
+
+def convert_dtype(dtype) -> np.dtype:
+    """Normalise any user-supplied dtype spec into a canonical numpy dtype."""
+    if dtype is None:
+        raise TypeError("dtype must not be None")
+    if isinstance(dtype, str):
+        key = dtype.lower()
+        if key.startswith("paddle."):
+            key = key[len("paddle."):]
+        if key not in _ALIASES:
+            raise TypeError(f"unsupported dtype string {dtype!r}")
+        return _ALIASES[key]
+    return np.dtype(dtype)
+
+
+def dtype_name(dtype) -> str:
+    d = convert_dtype(dtype)
+    if d == bool_:
+        return "bool"
+    return d.name
+
+
+def is_floating_point(dtype) -> bool:
+    return convert_dtype(dtype) in _FLOATING
+
+
+def is_integer(dtype) -> bool:
+    d = convert_dtype(dtype)
+    return d in _INTEGRAL or d == bool_
+
+
+_default_dtype = float32
+
+
+def set_default_dtype(dtype):
+    """paddle.set_default_dtype — affects float tensor creation defaults."""
+    global _default_dtype
+    d = convert_dtype(dtype)
+    if d not in (float16, bfloat16, float32, float64):
+        raise TypeError(f"default dtype must be floating, got {d}")
+    _default_dtype = d
+
+
+def get_default_dtype() -> np.dtype:
+    return _default_dtype
+
+
+def to_jax(dtype):
+    """Canonical dtype → dtype usable by jnp."""
+    return jnp.dtype(convert_dtype(dtype))
+
+
+def promote_types(a, b):
+    return np.promote_types(convert_dtype(a), convert_dtype(b))

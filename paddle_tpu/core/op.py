@@ -1,0 +1,141 @@
+"""Op definition machinery — the PHI registry analog.
+
+The reference routes every eager op through generated C++ glue: python-C fn →
+dygraph_function (grad-node construction) → phi kernel (SURVEY §3.1).  Here one
+decorator does all three jobs:
+
+* ``@defop`` turns a raw jnp-level function into a framework op: it unwraps
+  Tensor arguments, runs the computation, wraps results back into Tensors.
+* If grads are enabled and any input requires grad, the op is executed through
+  ``jax.vjp`` and the returned VJP closure becomes the op's GradNode (residual
+  saving ≈ TensorWrapper; generated grad node ≈ the vjp closure).
+* The raw function stays reachable as ``op.raw`` so the functional/jit path and
+  Pallas-backed kernels can bypass the eager wrapper entirely.
+
+An op registry keyed by name mirrors phi::KernelFactory for introspection and the
+OpTest harness.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.tree_util as jtu
+
+from . import autograd
+from .tensor import Tensor
+
+OP_REGISTRY: dict[str, Callable] = {}
+
+
+def _is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def _wrap_outputs(out, node):
+    """Wrap raw op results back into Tensors, attaching grad-node slots."""
+    stop = node is None
+
+    def wrap(slot, val):
+        t = Tensor(val, stop_gradient=stop, _internal=True)
+        if node is not None:
+            t._grad_node = node
+            t._grad_slot = slot
+        return t
+
+    if isinstance(out, (tuple, list)):
+        wrapped = type(out)(
+            wrap(i, v) if not isinstance(v, (tuple, list)) else
+            type(v)(wrap(i, u) for u in v)  # ragged outputs unsupported for grad
+            for i, v in enumerate(out)
+        )
+        return wrapped
+    return wrap(0, out)
+
+
+def apply_op(fn, name, args, kwargs):
+    leaves, treedef = jtu.tree_flatten((args, kwargs), is_leaf=_is_tensor)
+    tensor_pos = [i for i, l in enumerate(leaves) if _is_tensor(l)]
+    raw = list(leaves)
+    for i in tensor_pos:
+        raw[i] = leaves[i]._value
+
+    # AMP autocast at the op boundary (≈ eager_amp_auto_cast.h in the reference).
+    # The cast happens INSIDE the traced computation (see closure below) so the
+    # VJP sees original-dtype primals and backward cotangents keep their dtypes.
+    from ..amp.auto_cast import amp_state, should_cast
+    mode = should_cast(name)
+    if mode is None:
+        amp_cast = None
+    else:
+        import jax.numpy as jnp
+        low = amp_state().dtype
+
+        def amp_cast(v):
+            if mode == "low" and v.dtype == jnp.float32:
+                return v.astype(low)
+            if mode == "high" and v.dtype in (jnp.float16, jnp.bfloat16):
+                return v.astype(jnp.float32)
+            return v
+
+    grad_on = autograd.is_grad_enabled()
+    diff_pos = [i for i in tensor_pos if grad_on and not leaves[i].stop_gradient]
+
+    if not diff_pos:
+        vals = raw if amp_cast is None else \
+            [amp_cast(v) if i in tensor_pos else v for i, v in enumerate(raw)]
+        a, k = jtu.tree_unflatten(treedef, vals)
+        return _wrap_outputs(fn(*a, **k), None)
+
+    def closure(*dvals):
+        vals = list(raw)
+        for p, dv in zip(diff_pos, dvals):
+            vals[p] = dv
+        if amp_cast is not None:
+            for i in tensor_pos:
+                vals[i] = amp_cast(vals[i])
+        a, k = jtu.tree_unflatten(treedef, vals)
+        return fn(*a, **k)
+
+    primals = [raw[p] for p in diff_pos]
+    out, vjp_fn = jax.vjp(closure, *primals)
+
+    outs_flat = list(out) if isinstance(out, (tuple, list)) else [out]
+    avals = [(v.shape, v.dtype) for v in outs_flat]
+    node = autograd.GradNode(
+        vjp_fn, [leaves[p] for p in diff_pos], len(outs_flat), avals, name=name)
+    return _wrap_outputs(out, node)
+
+
+def defop(fn=None, *, name=None, tensor_method=None):
+    """Declare a framework op from a raw jnp function.
+
+    tensor_method: name (or list of names) to also install as Tensor method(s).
+    """
+    if fn is None:
+        return functools.partial(defop, name=name, tensor_method=tensor_method)
+
+    op_name = name or fn.__name__
+
+    @functools.wraps(fn)
+    def op(*args, **kwargs):
+        return apply_op(fn, op_name, args, kwargs)
+
+    op.raw = fn
+    op.op_name = op_name
+    OP_REGISTRY[op_name] = op
+
+    if tensor_method:
+        names = tensor_method if isinstance(tensor_method, (list, tuple)) else [tensor_method]
+        for m in names:
+            setattr(Tensor, m, op)
+    return op
+
+
+def register_tensor_method(name):
+    """Install an already-built callable as a Tensor method."""
+    def deco(f):
+        setattr(Tensor, name, f)
+        return f
+    return deco

@@ -1,0 +1,83 @@
+"""PyLayer — user-defined autograd ops (reference: paddle/fluid/eager/pylayer/ +
+python/paddle/autograd/py_layer.py).
+
+A PyLayer's `backward` is arbitrary Python, so it records a GradNode whose
+"vjp" calls the user's backward on concrete tensors.  The functional/jit path
+should instead use `jax.custom_vjp` directly (exposed as custom_vjp here).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import autograd
+from .tensor import Tensor
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.not_inplace_tensors = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tuple(tensors)
+
+    def saved_tensor(self):
+        return self._saved
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        inputs = [a for a in args if isinstance(a, Tensor)] + \
+                 [v for v in kwargs.values() if isinstance(v, Tensor)]
+        grad_on = autograd.is_grad_enabled()
+        diff_inputs = [t for t in inputs if not t.stop_gradient] if grad_on else []
+
+        with autograd.no_grad():
+            out = cls.forward(ctx, *args, **kwargs)
+
+        single = not isinstance(out, (tuple, list))
+        outs = [out] if single else list(out)
+        outs = [o if isinstance(o, Tensor) else Tensor(o) for o in outs]
+
+        if diff_inputs:
+            avals = [(tuple(o.shape), o._value.dtype) for o in outs]
+
+            diff_mask = [not t.stop_gradient for t in inputs]
+
+            def vjp_fn(cts):
+                cts = (cts,) if len(outs) == 1 else cts
+                ct_tensors = tuple(Tensor(jnp.asarray(c), _internal=True)
+                                   for c in cts)
+                with autograd.no_grad():
+                    gin = cls.backward(ctx, *ct_tensors)
+                gin = (gin,) if isinstance(gin, Tensor) or gin is None else tuple(gin)
+                if len(gin) == len(inputs):
+                    # one grad per tensor input: select the differentiable ones
+                    gin = [g for g, m in zip(gin, diff_mask) if m]
+                out_grads = []
+                for g, t in zip(gin, diff_inputs):
+                    out_grads.append(jnp.zeros_like(t._value) if g is None
+                                     else g._value)
+                return out_grads
+
+            node = autograd.GradNode(vjp_fn, diff_inputs, len(outs), avals,
+                                     name=cls.__name__)
+            for i, o in enumerate(outs):
+                o._grad_node = node
+                o._grad_slot = i
+                o.stop_gradient = False
+        return outs[0] if single else tuple(outs)

@@ -1,0 +1,79 @@
+"""Global RNG state.
+
+The reference keeps per-device stateful generators (paddle/phi/core/generator.h,
+``paddle.seed``).  JAX randomness is functional, so the framework keeps one global
+key plus a fold-in counter: eager ops draw fresh keys from here; jitted functional
+code installs a traced key with :func:`push_key` so randomness is reproducible and
+trace-safe (no concrete key is baked into a compiled program).
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+
+
+class _RNGState(threading.local):
+    def __init__(self):
+        # key creation is lazy: touching the backend at import time would
+        # force device init before the user can pick a platform
+        self._key = None
+        self.counter = 0
+        # Stack of externally installed (possibly traced) keys — the jit path.
+        self.stack: list = []
+
+    @property
+    def key(self):
+        if self._key is None:
+            self._key = jax.random.key(0)
+        return self._key
+
+    @key.setter
+    def key(self, k):
+        self._key = k
+
+
+_state = _RNGState()
+
+
+def seed(value: int):
+    """paddle.seed — reseed the global generator."""
+    _state.key = jax.random.key(int(value))
+    _state.counter = 0
+    return _state
+
+
+def next_key():
+    """Draw a fresh PRNG key.
+
+    Inside a :func:`push_key` scope the key is folded out of the installed
+    (traced) key, so the enclosing jit stays pure; otherwise it advances the
+    global eager state.
+    """
+    _state.counter += 1
+    if _state.stack:
+        return jax.random.fold_in(_state.stack[-1], _state.counter)
+    _state.key, sub = jax.random.split(_state.key)
+    return sub
+
+
+@contextlib.contextmanager
+def push_key(key):
+    """Install `key` (may be a tracer) as the randomness source for this scope."""
+    _state.stack.append(key)
+    saved = _state.counter
+    _state.counter = 0
+    try:
+        yield
+    finally:
+        _state.stack.pop()
+        _state.counter = saved
+
+
+def get_rng_state():
+    return (_state.key, _state.counter)
+
+
+def set_rng_state(state):
+    _state.key, _state.counter = state

@@ -1,0 +1,268 @@
+"""Tensor — the user-facing array type.
+
+Wraps an immutable ``jax.Array`` and adds the reference Tensor's eager semantics
+(paddle/phi/core/dense_tensor.h + pybind eager_method.cc): ``stop_gradient``
+(default True, like the reference), ``.grad`` accumulation, ``backward()``,
+in-place-looking mutation by value rebinding, ``state``ful naming, and numpy
+interop.  Compute never lives here — ops come from ``paddle_tpu.ops`` via the
+``defop`` machinery; under ``jit`` the same methods trace straight into XLA.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Any
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import dtype as dtype_mod
+from . import place as place_mod
+
+_name_counter = itertools.count()
+
+
+class Tensor:
+    __slots__ = ("_value", "_grad", "_grad_node", "_grad_slot", "stop_gradient",
+                 "name", "persistable", "__weakref__")
+
+    def __init__(self, data: Any = None, dtype=None, place=None,
+                 stop_gradient: bool = True, name: str | None = None,
+                 _internal: bool = False):
+        if _internal:
+            value = data
+        else:
+            if isinstance(data, Tensor):
+                value = data._value
+            elif isinstance(data, (jax.Array, jnp.ndarray)):
+                value = data
+            else:
+                arr = np.asarray(data)
+                if (dtype is None and arr.dtype == np.float64
+                        and not isinstance(data, (np.ndarray, np.generic))):
+                    # Python floats / float lists default to the global default
+                    # dtype (float32), matching paddle.to_tensor semantics;
+                    # explicit numpy float64 arrays keep their dtype.
+                    arr = arr.astype(dtype_mod.get_default_dtype())
+                value = jnp.asarray(arr)
+            if dtype is not None:
+                value = value.astype(dtype_mod.to_jax(dtype))
+            if place is not None and isinstance(place, place_mod.Place):
+                value = jax.device_put(value, place.jax_device())
+        self._value = value
+        self._grad = None
+        self._grad_node = None
+        self._grad_slot = 0
+        self.stop_gradient = stop_gradient
+        self.persistable = False
+        self.name = name or f"generated_tensor_{next(_name_counter)}"
+
+    # -- core properties ----------------------------------------------------
+    @property
+    def value(self):
+        return self._value
+
+    @property
+    def shape(self) -> list[int]:
+        return list(self._value.shape)
+
+    @property
+    def ndim(self) -> int:
+        return self._value.ndim
+
+    @property
+    def dtype(self):
+        return np.dtype(self._value.dtype)
+
+    @property
+    def size(self) -> int:
+        return int(self._value.size)
+
+    @property
+    def place(self) -> place_mod.Place:
+        try:
+            dev = list(self._value.devices())[0]
+            if dev.platform == "cpu":
+                return place_mod.CPUPlace()
+            return place_mod.Place("accelerator", dev.id)
+        except Exception:  # tracer — no concrete device
+            return place_mod._get_current_place()
+
+    @property
+    def is_leaf(self) -> bool:
+        return self._grad_node is None
+
+    @property
+    def grad(self):
+        return self._grad
+
+    @grad.setter
+    def grad(self, g):
+        self._grad = g if (g is None or isinstance(g, Tensor)) else Tensor(g)
+
+    @property
+    def T(self):
+        return Tensor(self._value.T, stop_gradient=True, _internal=True) \
+            if self.stop_gradient and self._grad_node is None else self.t()
+
+    # -- autograd -----------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph: bool = False):
+        from . import autograd
+        autograd.backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self._grad = None
+
+    clear_gradient = clear_grad
+
+    def detach(self) -> "Tensor":
+        t = Tensor(self._value, stop_gradient=True, _internal=True)
+        t.name = self.name + ".detach"
+        return t
+
+    def detach_(self) -> "Tensor":
+        self._grad_node = None
+        self.stop_gradient = True
+        return self
+
+    def _replace_(self, new_value, node=None, slot=0):
+        """In-place mutation primitive: rebind value (+ graph edge)."""
+        self._value = new_value
+        if node is not None or self._grad_node is not None:
+            self._grad_node = node
+            self._grad_slot = slot
+        return self
+
+    def _snapshot(self) -> "Tensor":
+        """Pre-mutation view sharing value and graph edge — recorded as the
+        *input* of in-place ops so the grad graph stays acyclic."""
+        t = Tensor(self._value, stop_gradient=self.stop_gradient, _internal=True)
+        t._grad_node = self._grad_node
+        t._grad_slot = self._grad_slot
+        return t
+
+    # -- conversion ---------------------------------------------------------
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._value)
+
+    def item(self, *args):
+        return self._value.item(*args)
+
+    def tolist(self):
+        return np.asarray(self._value).tolist()
+
+    def numel(self) -> int:
+        return int(self._value.size)
+
+    def element_size(self) -> int:
+        return self.dtype.itemsize
+
+    def astype(self, dtype) -> "Tensor":
+        from .op import apply_op
+        return apply_op(lambda x: x.astype(dtype_mod.to_jax(dtype)), "cast",
+                        (self,), {})
+
+    cast = astype
+
+    def clone(self) -> "Tensor":
+        from .op import apply_op
+        return apply_op(lambda x: x + 0, "clone", (self,), {})
+
+    def cpu(self) -> "Tensor":
+        return Tensor(jax.device_put(self._value, jax.devices("cpu")[0]),
+                      stop_gradient=self.stop_gradient, _internal=True)
+
+    def to(self, target=None, dtype=None, blocking=None) -> "Tensor":
+        t = self
+        if isinstance(target, str) and target not in dtype_mod._ALIASES:
+            name, _, idx = target.partition(":")
+            dev = place_mod.Place(name, int(idx or 0))
+            t = Tensor(jax.device_put(t._value, dev.jax_device()),
+                       stop_gradient=t.stop_gradient, _internal=True)
+        elif isinstance(target, place_mod.Place):
+            t = Tensor(jax.device_put(t._value, target.jax_device()),
+                       stop_gradient=t.stop_gradient, _internal=True)
+        elif target is not None and dtype is None:
+            dtype = target
+        if dtype is not None:
+            t = t.astype(dtype)
+        return t
+
+    def pin_memory(self):
+        return self.cpu()
+
+    # -- python protocol ----------------------------------------------------
+    def __jax_array__(self):
+        return self._value
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._value.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def _scalar(self):
+        # paddle permits python-scalar conversion of any single-element tensor
+        return self._value.reshape(()) if self._value.ndim else self._value
+
+    def __bool__(self):
+        return bool(self._scalar())
+
+    def __int__(self):
+        return int(self._scalar())
+
+    def __float__(self):
+        return float(self._scalar())
+
+    def __index__(self):
+        return int(self._scalar())
+
+    def __format__(self, spec):
+        if self.ndim == 0:
+            return format(self.item(), spec)
+        return format(str(self), spec)
+
+    def __getitem__(self, idx):
+        from .op import apply_op
+        idx = tuple(idx) if isinstance(idx, (tuple, list)) else (idx,)
+        idx = tuple(i._value if isinstance(i, Tensor) else i for i in idx)
+        return apply_op(lambda x: x[idx], "getitem", (self,), {})
+
+    def __setitem__(self, idx, val):
+        from .op import apply_op
+        idx = tuple(idx) if isinstance(idx, (tuple, list)) else (idx,)
+        idx = tuple(i._value if isinstance(i, Tensor) else i for i in idx)
+        out = apply_op(lambda x, v: x.at[idx].set(v), "setitem",
+                       (self._snapshot(), val if isinstance(val, Tensor) else
+                        Tensor(val, dtype=self.dtype)), {})
+        self._replace_(out._value, out._grad_node, out._grad_slot)
+        self.stop_gradient = out.stop_gradient and self.stop_gradient
+
+    def __repr__(self):
+        try:
+            body = np.array2string(np.asarray(self._value), precision=8,
+                                   separator=", ")
+        except Exception:
+            body = f"<traced {self._value}>"
+        return (f"Tensor(shape={self.shape}, dtype={dtype_mod.dtype_name(self.dtype)}, "
+                f"place={self.place}, stop_gradient={self.stop_gradient},\n"
+                f"       {body})")
+
+    __str__ = __repr__
+
+    def __hash__(self):
+        return id(self)
+
+    # NB: __eq__ is element-wise (installed by ops.logic); hash stays identity
+    # like the reference's Tensor.
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True) -> Tensor:
+    """paddle.to_tensor parity (python/paddle/tensor/creation.py)."""
+    if isinstance(data, Tensor) and dtype is None and place is None:
+        t = Tensor(data._value, stop_gradient=stop_gradient, _internal=True)
+        return t
+    return Tensor(data, dtype=dtype, place=place, stop_gradient=stop_gradient)

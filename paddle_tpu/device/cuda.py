@@ -1,0 +1,93 @@
+"""paddle.device.cuda surface, mapped onto the accelerator actually present.
+
+The reference exposes CUDA memory stats (paddle/fluid/memory/stats.cc); here the
+numbers come from PJRT memory_stats on the first accelerator device.
+"""
+from __future__ import annotations
+
+import jax
+
+
+def _dev():
+    for d in jax.devices():
+        if d.platform != "cpu":
+            return d
+    return jax.devices()[0]
+
+
+def device_count() -> int:
+    return len([d for d in jax.devices() if d.platform != "cpu"]) or 1
+
+
+def _stat(key: str) -> int:
+    try:
+        stats = _dev().memory_stats() or {}
+        return int(stats.get(key, 0))
+    except Exception:
+        return 0
+
+
+def memory_allocated(device=None) -> int:
+    return _stat("bytes_in_use")
+
+
+def max_memory_allocated(device=None) -> int:
+    return _stat("peak_bytes_in_use")
+
+
+def memory_reserved(device=None) -> int:
+    return _stat("bytes_reserved") or _stat("bytes_in_use")
+
+
+def max_memory_reserved(device=None) -> int:
+    return _stat("peak_bytes_in_use")
+
+
+def empty_cache():
+    pass
+
+
+def synchronize(device=None):
+    from . import synchronize as _sync
+    _sync(device)
+
+
+def get_device_properties(device=None):
+    d = _dev()
+    class _Props:
+        name = getattr(d, "device_kind", d.platform)
+        total_memory = _stat("bytes_limit")
+        multi_processor_count = getattr(d, "core_count", 1)
+        major, minor = 0, 0
+    return _Props()
+
+
+def get_device_name(device=None) -> str:
+    return getattr(_dev(), "device_kind", _dev().platform)
+
+
+def get_device_capability(device=None):
+    return (0, 0)
+
+
+class Stream:
+    """Placeholder stream object: XLA owns stream scheduling on TPU."""
+    def synchronize(self):
+        synchronize()
+
+
+class Event:
+    def record(self, stream=None):
+        pass
+
+    def synchronize(self):
+        synchronize()
+
+
+def current_stream(device=None) -> Stream:
+    return Stream()
+
+
+def stream_guard(stream):
+    import contextlib
+    return contextlib.nullcontext()

@@ -1,0 +1,22 @@
+"""TPU device helpers (no reference analog — TPU-native addition)."""
+from __future__ import annotations
+
+import jax
+
+
+def device_count() -> int:
+    return len([d for d in jax.devices() if d.platform != "cpu"]) or 0
+
+
+def memory_stats(device_id: int = 0) -> dict:
+    devs = [d for d in jax.devices() if d.platform != "cpu"]
+    if not devs:
+        return {}
+    try:
+        return dict(devs[device_id].memory_stats() or {})
+    except Exception:
+        return {}
+
+
+def hbm_bytes(device_id: int = 0) -> int:
+    return int(memory_stats(device_id).get("bytes_limit", 0))

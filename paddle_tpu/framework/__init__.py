@@ -1,0 +1,5 @@
+"""paddle.framework parity: save/load + core re-exports."""
+from .io import save, load  # noqa: F401
+from ..core.random import seed  # noqa: F401
+from ..core.tensor import Tensor  # noqa: F401
+from ..core.dtype import get_default_dtype, set_default_dtype  # noqa: F401

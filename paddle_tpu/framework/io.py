@@ -1,0 +1,60 @@
+"""Object save/load (reference: python/paddle/framework/io.py:574,791).
+
+File contract preserved: ``paddle.save(layer.state_dict(), "model.pdparams")``
+pickles a nest of numpy arrays; ``paddle.load`` returns Tensors.  Checkpoints
+written by this framework are plain pickles of numpy data — portable across
+hosts and readable without JAX.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def _to_saveable(obj):
+    if isinstance(obj, Tensor):
+        return _SavedTensor(obj.numpy())
+    if isinstance(obj, dict):
+        return {k: _to_saveable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_saveable(v) for v in obj)
+    return obj
+
+
+class _SavedTensor:
+    """Marker wrapper so load() can distinguish tensors from raw ndarrays."""
+
+    __slots__ = ("array",)
+
+    def __init__(self, array: np.ndarray):
+        self.array = array
+
+
+def _from_saved(obj, return_numpy=False):
+    if isinstance(obj, _SavedTensor):
+        return obj.array if return_numpy else Tensor(obj.array)
+    if isinstance(obj, np.ndarray):
+        return obj if return_numpy else Tensor(obj)
+    if isinstance(obj, dict):
+        return {k: _from_saved(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_from_saved(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_saveable(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **configs):
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _from_saved(obj, return_numpy=return_numpy)

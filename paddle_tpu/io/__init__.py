@@ -1,0 +1,10 @@
+"""paddle.io parity surface."""
+from .dataset import (  # noqa: F401
+    Dataset, IterableDataset, TensorDataset, ComposeDataset, ChainDataset,
+    ConcatDataset, Subset, random_split,
+)
+from .sampler import (  # noqa: F401
+    Sampler, SequenceSampler, RandomSampler, WeightedRandomSampler,
+    BatchSampler, DistributedBatchSampler,
+)
+from .dataloader import DataLoader, get_worker_info, default_collate_fn  # noqa: F401

@@ -1,0 +1,235 @@
+"""DataLoader (reference: fluid/dataloader/dataloader_iter.py:342
+_DataLoaderIterMultiProcess — worker procs + shared memory + prefetch;
+fluid/reader.py facade).
+
+TPU-side note: feeding chips is a host job.  The multiprocess path uses
+worker processes with pickled numpy batches over queues plus a prefetch
+depth (≈ buffered_reader.cc double-buffering); batches stay numpy so the
+jitted train step controls the single H2D transfer.
+"""
+from __future__ import annotations
+
+import itertools
+import multiprocessing as mp
+import queue as queue_mod
+import threading
+import traceback
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from .dataset import Dataset, IterableDataset
+from .sampler import BatchSampler
+
+
+class _WorkerInfo:
+    def __init__(self, id, num_workers, dataset, seed):  # noqa: A002
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+        self.seed = seed
+
+
+_worker_info = None
+
+
+def get_worker_info():
+    return _worker_info
+
+
+def default_collate_fn(batch):
+    sample = batch[0]
+    if isinstance(sample, (np.ndarray, np.generic)):
+        return np.stack(batch)
+    if isinstance(sample, Tensor):
+        return Tensor(np.stack([s.numpy() for s in batch]))
+    if isinstance(sample, (int, float)):
+        return np.asarray(batch)
+    if isinstance(sample, (list, tuple)):
+        return type(sample)(default_collate_fn(list(items))
+                            for items in zip(*batch))
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([d[k] for d in batch]) for k in sample}
+    return batch
+
+
+def _to_tensor_nest(obj, return_list):
+    if isinstance(obj, np.ndarray):
+        return Tensor(obj)
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_tensor_nest(v, return_list) for v in obj)
+    if isinstance(obj, dict):
+        return {k: _to_tensor_nest(v, return_list) for k, v in obj.items()}
+    return obj
+
+
+def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_id,
+                 num_workers, seed, worker_init_fn=None):
+    global _worker_info
+    _worker_info = _WorkerInfo(worker_id, num_workers, dataset, seed)
+    np.random.seed((seed + worker_id) % (2 ** 32))
+    if worker_init_fn is not None:
+        worker_init_fn(worker_id)
+    is_iterable = isinstance(dataset, IterableDataset)
+    it = iter(dataset) if is_iterable else None
+    while True:
+        task = index_queue.get()
+        if task is None:
+            break
+        batch_id, indices = task
+        try:
+            if is_iterable:
+                samples = list(itertools.islice(it, len(indices)))
+                if not samples:
+                    data_queue.put((batch_id, StopIteration(), None))
+                    continue
+            else:
+                samples = [dataset[i] for i in indices]
+            data_queue.put((batch_id, None, collate_fn(samples)))
+        except Exception:  # noqa: BLE001
+            data_queue.put((batch_id, RuntimeError(traceback.format_exc()), None))
+
+
+class _SingleProcessIter:
+    def __init__(self, loader):
+        self.loader = loader
+        ds = loader.dataset
+        if isinstance(ds, IterableDataset):
+            self._it = iter(ds)
+            self._batches = None
+        else:
+            self._it = None
+            self._batches = iter(loader.batch_sampler)
+
+    def __next__(self):
+        loader = self.loader
+        if self._it is not None:
+            samples = list(itertools.islice(self._it, loader.batch_size or 1))
+            if not samples:
+                raise StopIteration
+        else:
+            indices = next(self._batches)
+            samples = [loader.dataset[i] for i in indices]
+        batch = loader.collate_fn(samples)
+        return _to_tensor_nest(batch, loader.return_list)
+
+
+class _MultiProcessIter:
+    def __init__(self, loader):
+        self.loader = loader
+        self.num_workers = loader.num_workers
+        ctx = mp.get_context("fork")
+        self.index_queues = [ctx.Queue() for _ in range(self.num_workers)]
+        self.data_queue = ctx.Queue()
+        seed = np.random.randint(0, 2 ** 31)
+        self.workers = []
+        for wid in range(self.num_workers):
+            w = ctx.Process(
+                target=_worker_loop,
+                args=(loader.dataset, self.index_queues[wid], self.data_queue,
+                      loader.collate_fn, wid, self.num_workers, seed,
+                      loader.worker_init_fn),
+                daemon=True)
+            w.start()
+            self.workers.append(w)
+        if isinstance(loader.dataset, IterableDataset):
+            bs = loader.batch_size or 1
+            self._batches = iter(lambda: list(range(bs)), None)  # endless
+        else:
+            self._batches = iter(loader.batch_sampler)
+        self._send_idx = 0
+        self._recv_idx = 0
+        self._reorder = {}
+        self._outstanding = 0
+        self._exhausted = False
+        for _ in range(loader.prefetch_factor * self.num_workers):
+            self._dispatch()
+
+    def _dispatch(self):
+        if self._exhausted:
+            return
+        try:
+            indices = next(self._batches)
+        except StopIteration:
+            self._exhausted = True
+            return
+        wid = self._send_idx % self.num_workers
+        self.index_queues[wid].put((self._send_idx, indices))
+        self._send_idx += 1
+        self._outstanding += 1
+
+    def __next__(self):
+        while True:
+            if self._outstanding == 0:
+                self._shutdown()
+                raise StopIteration
+            if self._recv_idx in self._reorder:
+                err, batch = self._reorder.pop(self._recv_idx)
+            else:
+                bid, err, batch = self.data_queue.get()
+                if bid != self._recv_idx:
+                    self._reorder[bid] = (err, batch)
+                    continue
+            self._recv_idx += 1
+            self._outstanding -= 1
+            self._dispatch()
+            if isinstance(err, StopIteration):
+                self._exhausted = True
+                continue
+            if err is not None:
+                self._shutdown()
+                raise err
+            return _to_tensor_nest(batch, self.loader.return_list)
+
+    def _shutdown(self):
+        for q in self.index_queues:
+            try:
+                q.put(None)
+            except Exception:  # noqa: BLE001
+                pass
+        for w in self.workers:
+            w.join(timeout=1)
+            if w.is_alive():
+                w.terminate()
+        self.workers = []
+
+    def __del__(self):
+        if self.workers:
+            self._shutdown()
+
+
+class DataLoader:
+    def __init__(self, dataset, feed_list=None, places=None, return_list=True,
+                 batch_sampler=None, batch_size=1, shuffle=False, drop_last=False,
+                 collate_fn=None, num_workers=0, use_buffer_reader=True,
+                 prefetch_factor=2, use_shared_memory=True, timeout=0,
+                 worker_init_fn=None, persistent_workers=False):
+        self.dataset = dataset
+        self.return_list = return_list
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = num_workers
+        self.batch_size = batch_size
+        self.prefetch_factor = max(1, int(prefetch_factor))
+        self.worker_init_fn = worker_init_fn
+        if batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+            self.batch_size = getattr(batch_sampler, "batch_size", batch_size)
+        elif isinstance(dataset, IterableDataset):
+            self.batch_sampler = None
+        else:
+            self.batch_sampler = BatchSampler(dataset, shuffle=shuffle,
+                                              batch_size=batch_size,
+                                              drop_last=drop_last)
+
+    def __iter__(self):
+        if self.num_workers > 0:
+            return _MultiProcessIter(self)
+        return _SingleProcessIter(self)
+
+    def __len__(self):
+        if isinstance(self.dataset, IterableDataset):
+            raise TypeError("IterableDataset has no length")
+        return len(self.batch_sampler)
+
+    def __call__(self):
+        return iter(self)

@@ -1,0 +1,2 @@
+"""Pallas TPU kernels — the analog of the reference's fused CUDA op family
+(paddle/fluid/operators/fused/) and KPS primitives (phi/kernels/primitive/)."""

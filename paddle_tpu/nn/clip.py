@@ -1,0 +1,98 @@
+"""Gradient clipping (reference: python/paddle/fluid/clip.py —
+ClipGradByValue/ByNorm/ByGlobalNorm)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.op import apply_op
+from ..core.tensor import Tensor
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        return self._clip(params_grads)
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):  # noqa: A002
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def _clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            out.append((p, apply_op(
+                lambda gv: jnp.clip(gv, self.min, self.max), "clip_by_value",
+                (g,), {})))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+
+            def impl(gv):
+                norm = jnp.sqrt(jnp.sum(jnp.square(gv)))
+                scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+                return gv * scale
+            out.append((p, apply_op(impl, "clip_by_norm", (g,), {})))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group",
+                 auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    def _clip(self, params_grads):
+        grads = [g for p, g in params_grads
+                 if g is not None and getattr(p, "need_clip", True)]
+        if not grads:
+            return params_grads
+
+        def global_norm_impl(*gs):
+            return jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                                for g in gs))
+        gnorm = apply_op(global_norm_impl, "global_norm", tuple(grads), {})
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+
+            def impl(gv, nv):
+                scale = self.clip_norm / jnp.maximum(nv, self.clip_norm)
+                return gv * scale.astype(gv.dtype)
+            out.append((p, apply_op(impl, "clip_by_global_norm", (g, gnorm), {})))
+        return out
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=False):
+    params = [parameters] if isinstance(parameters, Tensor) else list(parameters)
+    grads = [p.grad for p in params if p.grad is not None]
+    if not grads:
+        return Tensor(jnp.zeros(()))
+
+    def norm_impl(*gs):
+        if norm_type == float("inf"):
+            return jnp.max(jnp.stack([jnp.max(jnp.abs(g)) for g in gs]))
+        return jnp.sum(jnp.stack(
+            [jnp.sum(jnp.abs(g) ** norm_type) for g in gs])) ** (1.0 / norm_type)
+    total = apply_op(norm_impl, "grad_norm", tuple(grads), {})
+    scale = max_norm / (float(total.item()) + 1e-6)
+    if scale < 1.0:
+        for p in params:
+            if p.grad is not None:
+                p.grad._replace_(p.grad._value * scale, None)
+    return total

@@ -1,0 +1,9 @@
+"""paddle.nn.functional parity surface."""
+from .activation import *  # noqa: F401,F403
+from .common import *  # noqa: F401,F403
+from .conv import *  # noqa: F401,F403
+from .pooling import *  # noqa: F401,F403
+from .norm import *  # noqa: F401,F403
+from .loss import *  # noqa: F401,F403
+from ...ops.manipulation import pad  # noqa: F401
+from ...ops.creation import one_hot  # noqa: F401

@@ -1,0 +1,189 @@
+"""Activation functionals (reference: python/paddle/nn/functional/activation.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.op import defop, apply_op
+
+
+@defop
+def relu(x, name=None):
+    return jnp.maximum(x, 0)
+
+
+@defop
+def relu6(x, name=None):
+    return jnp.clip(x, 0, 6)
+
+
+@defop
+def relu_(x, name=None):
+    return jnp.maximum(x, 0)
+
+
+@defop
+def elu(x, alpha=1.0, name=None):
+    return jax.nn.elu(x, alpha)
+
+
+@defop
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+@defop
+def celu(x, alpha=1.0, name=None):
+    return jax.nn.celu(x, alpha)
+
+
+@defop
+def gelu(x, approximate=False, name=None):
+    return jax.nn.gelu(x, approximate=bool(approximate))
+
+
+@defop
+def sigmoid(x, name=None):
+    return jax.nn.sigmoid(x)
+
+
+@defop
+def log_sigmoid(x, name=None):
+    return jax.nn.log_sigmoid(x)
+
+
+@defop
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return jnp.clip(slope * x + offset, 0.0, 1.0)
+
+
+@defop
+def hardswish(x, name=None):
+    return x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0
+
+
+@defop
+def hardtanh(x, min=-1.0, max=1.0, name=None):  # noqa: A002
+    return jnp.clip(x, min, max)
+
+
+@defop
+def hardshrink(x, threshold=0.5, name=None):
+    return jnp.where(jnp.abs(x) > threshold, x, 0.0)
+
+
+@defop
+def softshrink(x, threshold=0.5, name=None):
+    return jnp.where(x > threshold, x - threshold,
+                     jnp.where(x < -threshold, x + threshold, 0.0))
+
+
+@defop
+def tanhshrink(x, name=None):
+    return x - jnp.tanh(x)
+
+
+@defop
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return jnp.where(x >= 0, x, negative_slope * x)
+
+
+@defop
+def prelu(x, weight, data_format="NCHW", name=None):
+    w = weight
+    if w.ndim == 1 and w.shape[0] > 1 and x.ndim > 1:
+        ch_axis = 1 if data_format[1] == "C" else x.ndim - 1
+        shape = [1] * x.ndim
+        shape[ch_axis] = w.shape[0]
+        w = w.reshape(shape)
+    return jnp.where(x >= 0, x, w * x)
+
+
+@defop
+def rrelu(x, lower=0.125, upper=0.3333333333333333, training=False, name=None):
+    if training:
+        from ...core import random as rnd
+        slope = jax.random.uniform(rnd.next_key(), x.shape, x.dtype, lower, upper)
+    else:
+        slope = (lower + upper) / 2.0
+    return jnp.where(x >= 0, x, slope * x)
+
+
+@defop
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return jnp.where(x * beta > threshold, x,
+                     (1.0 / beta) * jnp.log1p(jnp.exp(beta * x)))
+
+
+@defop
+def softsign(x, name=None):
+    return x / (1.0 + jnp.abs(x))
+
+
+@defop
+def silu(x, name=None):
+    return jax.nn.silu(x)
+
+
+@defop
+def swish(x, name=None):
+    return jax.nn.silu(x)
+
+
+@defop
+def mish(x, name=None):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+@defop
+def tanh(x, name=None):
+    return jnp.tanh(x)
+
+
+@defop
+def softmax(x, axis=-1, dtype=None, name=None):
+    if dtype is not None:
+        from ...core.dtype import to_jax
+        x = x.astype(to_jax(dtype))
+    return jax.nn.softmax(x, axis=int(axis))
+
+
+@defop
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    if dtype is not None:
+        from ...core.dtype import to_jax
+        x = x.astype(to_jax(dtype))
+    return jax.nn.log_softmax(x, axis=int(axis))
+
+
+@defop
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...core import random as rnd
+    g = -jnp.log(-jnp.log(
+        jax.random.uniform(rnd.next_key(), x.shape, x.dtype, 1e-20, 1.0)))
+    y = jax.nn.softmax((x + g) / temperature, axis=axis)
+    if hard:
+        idx = jnp.argmax(y, axis=axis, keepdims=True)
+        onehot = jnp.zeros_like(y)
+        onehot = jnp.put_along_axis(onehot, idx, 1.0, axis=axis, inplace=False)
+        y = onehot + y - jax.lax.stop_gradient(y)
+    return y
+
+
+@defop
+def maxout(x, groups, axis=1, name=None):
+    axis = int(axis) % x.ndim
+    c = x.shape[axis]
+    new_shape = x.shape[:axis] + (c // groups, groups) + x.shape[axis + 1:]
+    return jnp.max(x.reshape(new_shape), axis=axis + 1)
+
+
+@defop
+def glu(x, axis=-1, name=None):
+    a, b = jnp.split(x, 2, axis=int(axis))
+    return a * jax.nn.sigmoid(b)
+
+
+@defop
+def temperature_scaled_softmax(x, temperature=1.0, axis=-1, name=None):
+    return jax.nn.softmax(x / temperature, axis=axis)

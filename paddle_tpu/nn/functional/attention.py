@@ -1,0 +1,64 @@
+"""Attention functionals.
+
+Reference: the fused CUDA attention family (paddle/fluid/operators/fused/
+fused_attention_op.cu, fmha_ref.h) materialises S×S scores; here the default is
+a jnp reference implementation, and `scaled_dot_product_attention` routes to
+the Pallas flash-attention kernel (paddle_tpu.kernels.flash_attention) on TPU
+when shapes allow — the one place this framework hand-writes kernels.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core.op import defop
+
+_USE_FLASH = True
+
+
+def enable_flash_attention(flag: bool):
+    global _USE_FLASH
+    _USE_FLASH = bool(flag)
+
+
+def _sdpa_ref(q, k, v, mask, dropout_p, causal, scale, training):
+    # q,k,v: [B, T, H, D] (paddle convention)
+    qh = jnp.swapaxes(q, 1, 2)  # [B, H, T, D]
+    kh = jnp.swapaxes(k, 1, 2)
+    vh = jnp.swapaxes(v, 1, 2)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * scale
+    if causal:
+        Tq, Tk = scores.shape[-2], scores.shape[-1]
+        cm = jnp.tril(jnp.ones((Tq, Tk), bool), k=Tk - Tq)
+        scores = jnp.where(cm, scores, jnp.array(-1e30, scores.dtype))
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            scores = jnp.where(mask, scores, jnp.array(-1e30, scores.dtype))
+        else:
+            scores = scores + mask.astype(scores.dtype)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if dropout_p and training:
+        from ...core import random as rnd
+        keep = jax.random.bernoulli(rnd.next_key(), 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
+    return jnp.swapaxes(out, 1, 2)  # [B, T, H, D]
+
+
+@defop
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False, training=True,
+                                 name=None):
+    """Inputs [batch, seq, heads, head_dim] like the reference fused op."""
+    scale = 1.0 / math.sqrt(query.shape[-1])
+    if _USE_FLASH and attn_mask is None and not (dropout_p and training):
+        try:
+            from ...kernels.flash_attention import flash_attention_bthd
+            return flash_attention_bthd(query, key, value, causal=is_causal,
+                                        scale=scale)
+        except Exception:
+            pass
+    return _sdpa_ref(query, key, value, attn_mask, dropout_p, is_causal, scale,
+                     training)

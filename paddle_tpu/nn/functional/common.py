@@ -1,0 +1,239 @@
+"""Common functionals: linear/dropout/embedding/pad/interpolate/...
+(reference: python/paddle/nn/functional/common.py, input.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core import random as rnd
+from ...core.dtype import get_default_dtype, to_jax
+from ...core.op import defop, apply_op
+from ...core.tensor import Tensor
+from ...ops.manipulation import pad  # noqa: F401  (re-exported as F.pad)
+
+
+@defop
+def linear(x, weight, bias=None, name=None):
+    # paddle stores Linear weights as [in_features, out_features]
+    out = jnp.matmul(x, weight)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@defop
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    if not training or p == 0.0:
+        return x if mode == "upscale_in_train" else x * (1.0 - p)
+    if p == 1.0:
+        return jnp.zeros_like(x)
+    shape = list(x.shape)
+    if axis is not None:
+        axes = [axis] if isinstance(axis, int) else list(axis)
+        shape = [s if i in [a % x.ndim for a in axes] else 1
+                 for i, s in enumerate(shape)]
+    keep = jax.random.bernoulli(rnd.next_key(), 1.0 - p, tuple(shape))
+    if mode == "upscale_in_train":
+        return jnp.where(keep, x / (1.0 - p), 0.0)
+    return jnp.where(keep, x, 0.0)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+@defop
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    keep = jax.random.bernoulli(rnd.next_key(), 1.0 - p, x.shape)
+    a = (1.0 / np.sqrt((alpha_p ** 2 * p + 1) * (1 - p))).astype(np.float32)
+    b = -a * alpha_p * p
+    return a * jnp.where(keep, x, alpha_p) + b
+
+
+@defop
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    out = jnp.take(weight, x, axis=0)
+    if padding_idx is not None:
+        mask = (x != padding_idx)[..., None]
+        out = out * mask.astype(out.dtype)
+    return out
+
+
+def one_hot(x, num_classes, name=None):
+    from ...ops.creation import one_hot as _one_hot
+    return _one_hot(x, num_classes)
+
+
+@defop
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    n = label.shape[-1]
+    if prior_dist is not None:
+        return (1 - epsilon) * label + epsilon * prior_dist
+    return (1 - epsilon) * label + epsilon / n
+
+
+@defop
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    nrm = jnp.sum(jnp.abs(x) ** p, axis=int(axis), keepdims=True) ** (1.0 / p)
+    return x / jnp.maximum(nrm, epsilon)
+
+
+@defop
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    dot = jnp.sum(x1 * x2, axis=int(axis))
+    n1 = jnp.sqrt(jnp.sum(x1 * x1, axis=int(axis)))
+    n2 = jnp.sqrt(jnp.sum(x2 * x2, axis=int(axis)))
+    return dot / jnp.maximum(n1 * n2, eps)
+
+
+def sequence_mask(lengths, maxlen=None, dtype="int64", name=None):
+    if maxlen is None:
+        maxlen = int(np.asarray(
+            lengths._value if isinstance(lengths, Tensor) else lengths).max())
+    return apply_op(
+        lambda l: (jnp.arange(int(maxlen)) < l[..., None]).astype(to_jax(dtype)),
+        "sequence_mask", (lengths,), {})
+
+
+@defop
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = int(upscale_factor)
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x = x.reshape(n, c // (r * r), r, r, h, w)
+        x = x.transpose(0, 1, 4, 2, 5, 3)
+        return x.reshape(n, c // (r * r), h * r, w * r)
+    n, h, w, c = x.shape
+    x = x.reshape(n, h, w, r, r, c // (r * r))
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(n, h * r, w * r, c // (r * r))
+
+
+@defop
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = int(downscale_factor)
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x = x.reshape(n, c, h // r, r, w // r, r)
+        x = x.transpose(0, 1, 3, 5, 2, 4)
+        return x.reshape(n, c * r * r, h // r, w // r)
+    raise NotImplementedError("pixel_unshuffle NHWC")
+
+
+@defop
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x = x.reshape(n, groups, c // groups, h, w)
+        return x.transpose(0, 2, 1, 3, 4).reshape(n, c, h, w)
+    n, h, w, c = x.shape
+    x = x.reshape(n, h, w, groups, c // groups)
+    return x.transpose(0, 1, 2, 4, 3).reshape(n, h, w, c)
+
+
+@defop
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    if data_format in ("NCHW", "NCL", "NCDHW"):
+        spatial = list(x.shape[2:])
+        to_last = False
+    else:
+        spatial = list(x.shape[1:-1])
+        to_last = True
+    if size is not None:
+        if isinstance(size, Tensor):
+            size = [int(s) for s in np.asarray(size._value)]
+        out_spatial = [int(s) for s in (size if isinstance(size, (list, tuple))
+                                        else [size])]
+    else:
+        if isinstance(scale_factor, (list, tuple)):
+            out_spatial = [int(s * f) for s, f in zip(spatial, scale_factor)]
+        else:
+            out_spatial = [int(s * scale_factor) for s in spatial]
+
+    method = {"nearest": "nearest", "bilinear": "bilinear", "linear": "linear",
+              "trilinear": "trilinear", "bicubic": "bicubic", "area": "linear"}[mode]
+    if method in ("bilinear", "trilinear", "linear"):
+        method = "linear"
+    if to_last:
+        out_shape = (x.shape[0], *out_spatial, x.shape[-1])
+    else:
+        out_shape = (x.shape[0], x.shape[1], *out_spatial)
+    # jax.image.resize linear ≈ align_corners=False; nearest matches paddle default
+    return jax.image.resize(x, out_shape, method=method)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+             align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format)
+
+
+@defop(name="unfold_im2col")  # distinct registry key: Tensor.unfold (sliding
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    # window, ops/manipulation.py) already owns the plain "unfold" name
+    """im2col (reference: phi unfold kernel): NCHW → [N, C*kh*kw, L]."""
+    def pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+    kh, kw = pair(kernel_sizes)
+    sh, sw = pair(strides)
+    dh, dw = pair(dilations)
+    if isinstance(paddings, int):
+        ph0 = ph1 = pw0 = pw1 = paddings
+    elif len(paddings) == 2:
+        (ph0, ph1), (pw0, pw1) = (paddings[0],) * 2, (paddings[1],) * 2
+    else:
+        ph0, pw0, ph1, pw1 = paddings
+    n, c, h, w = x.shape
+    xp = jnp.pad(x, ((0, 0), (0, 0), (ph0, ph1), (pw0, pw1)))
+    oh = (h + ph0 + ph1 - (dh * (kh - 1) + 1)) // sh + 1
+    ow = (w + pw0 + pw1 - (dw * (kw - 1) + 1)) // sw + 1
+    patches = []
+    for i in range(kh):
+        for j in range(kw):
+            sl = xp[:, :, i * dh:i * dh + (oh - 1) * sh + 1:sh,
+                    j * dw:j * dw + (ow - 1) * sw + 1:sw]
+            patches.append(sl)
+    out = jnp.stack(patches, axis=2)  # N, C, kh*kw, oh, ow
+    return out.reshape(n, c * kh * kw, oh * ow)
+
+
+@defop
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    def pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+    oh, ow = pair(output_sizes)
+    kh, kw = pair(kernel_sizes)
+    sh, sw = pair(strides)
+    dh, dw = pair(dilations)
+    p = pair(paddings) if not isinstance(paddings, int) else (paddings, paddings)
+    n, ckk, L = x.shape
+    c = ckk // (kh * kw)
+    ph, pw = p
+    out_h = oh + 2 * ph
+    out_w = ow + 2 * pw
+    noh = (out_h - (dh * (kh - 1) + 1)) // sh + 1
+    now = (out_w - (dw * (kw - 1) + 1)) // sw + 1
+    xr = x.reshape(n, c, kh, kw, noh, now)
+    out = jnp.zeros((n, c, out_h, out_w), dtype=x.dtype)
+    for i in range(kh):
+        for j in range(kw):
+            out = out.at[:, :, i * dh:i * dh + (noh - 1) * sh + 1:sh,
+                         j * dw:j * dw + (now - 1) * sw + 1:sw].add(xr[:, :, i, j])
+    return out[:, :, ph:ph + oh, pw:pw + ow]
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    raise NotImplementedError("class_center_sample requires distributed PS support")

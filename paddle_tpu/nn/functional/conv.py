@@ -1,0 +1,136 @@
+"""Convolution functionals on lax.conv_general_dilated — XLA tiles these onto
+the MXU (reference: python/paddle/nn/functional/conv.py → phi conv kernels).
+
+Layout note: the reference defaults to NCHW; XLA:TPU internally prefers NHWC
+and transposes as needed, so we keep the user-facing NCHW contract and let the
+compiler pick layouts.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.op import defop
+
+
+def _tuplize(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    v = tuple(int(x) for x in v)
+    return v * n if len(v) == 1 else v
+
+
+def _norm_padding(padding, n):
+    """paddle padding spec → lax [(lo, hi)] * n, or the string codes."""
+    if isinstance(padding, str):
+        return padding.upper()  # SAME / VALID
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * n:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+    if all(isinstance(p, (list, tuple)) for p in padding):
+        # may include batch/channel dims ([[0,0],[0,0],[lo,hi],...])
+        if len(padding) == n + 2:
+            padding = padding[2:]
+        return [tuple(p) for p in padding]
+    raise ValueError(f"bad padding spec {padding}")
+
+
+def _conv_nd(x, weight, bias, stride, padding, dilation, groups, n,
+             channel_last, transpose=False, output_padding=0, output_size=None):
+    stride = _tuplize(stride, n)
+    dilation = _tuplize(dilation, n)
+    pad = _norm_padding(padding, n)
+
+    if channel_last:
+        spec_in = "N" + "DHW"[3 - n:] + "C"
+    else:
+        spec_in = "NC" + "DHW"[3 - n:]
+    spec_out = spec_in
+    # weight layout: paddle conv weights are [out_c, in_c/groups, *k];
+    # conv_transpose weights are [in_c, out_c/groups, *k]
+    spec_w = ("IO" if transpose else "OI") + "DHW"[3 - n:]
+    dn = jax.lax.conv_dimension_numbers(x.shape, weight.shape,
+                                        (spec_in, spec_w, spec_out))
+    if transpose:
+        opad = _tuplize(output_padding, n)
+        # transposed conv == gradient-of-conv: spatially flipped kernel with
+        # swapped I/O (the IO spec swaps; flip here), input dilated by stride.
+        spatial_axes = tuple(range(2, 2 + n))
+        w = jnp.flip(weight, axis=spatial_axes)
+        k = [weight.shape[2 + i] for i in range(n)]
+        if isinstance(pad, str):
+            p = [(0, 0)] * n if pad == "VALID" else [((k[i] - 1) // 2,) * 2
+                                                     for i in range(n)]
+        else:
+            p = pad
+        lax_pad = [((k[i] - 1) * dilation[i] - p[i][0],
+                    (k[i] - 1) * dilation[i] - p[i][1] + opad[i])
+                   for i in range(n)]
+        out = jax.lax.conv_general_dilated(
+            x, w, window_strides=(1,) * n, padding=lax_pad,
+            lhs_dilation=stride, rhs_dilation=dilation, dimension_numbers=dn,
+            feature_group_count=groups)
+    else:
+        out = jax.lax.conv_general_dilated(
+            x, weight, window_strides=stride, padding=pad,
+            rhs_dilation=dilation, dimension_numbers=dn,
+            feature_group_count=groups)
+    if bias is not None:
+        if channel_last:
+            out = out + bias.reshape((1,) * (n + 1) + (-1,))
+        else:
+            out = out + bias.reshape((1, -1) + (1,) * n)
+    return out
+
+
+@defop
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 1,
+                    channel_last=data_format == "NLC")
+
+
+@defop
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 2,
+                    channel_last=data_format == "NHWC")
+
+
+@defop
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 3,
+                    channel_last=data_format == "NDHWC")
+
+
+@defop
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCL",
+                     name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 1,
+                    channel_last=data_format == "NLC", transpose=True,
+                    output_padding=output_padding, output_size=output_size)
+
+
+@defop
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCHW",
+                     name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 2,
+                    channel_last=data_format == "NHWC", transpose=True,
+                    output_padding=output_padding, output_size=output_size)
+
+
+@defop
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCDHW",
+                     name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 3,
+                    channel_last=data_format == "NDHWC", transpose=True,
+                    output_padding=output_padding, output_size=output_size)

@@ -1,0 +1,250 @@
+"""Loss functionals (reference: python/paddle/nn/functional/loss.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.op import defop, apply_op
+from ...core.tensor import Tensor
+
+
+def _reduce(val, reduction):
+    if reduction == "mean":
+        return jnp.mean(val)
+    if reduction == "sum":
+        return jnp.sum(val)
+    return val
+
+
+@defop
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",  # noqa: A002
+                  soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0,
+                  name=None):
+    logits = input
+    if use_softmax:
+        logp = jax.nn.log_softmax(logits, axis=axis)
+    else:
+        logp = jnp.log(jnp.clip(logits, 1e-15, 1.0))
+    n_classes = logits.shape[axis]
+
+    if soft_label:
+        lbl = label
+        if label_smoothing > 0.0:
+            lbl = (1 - label_smoothing) * lbl + label_smoothing / n_classes
+        loss = -jnp.sum(lbl * logp, axis=axis)
+        if weight is not None:
+            w = jnp.sum(lbl * weight, axis=axis)
+            loss = loss * w
+        return _reduce(loss, reduction)
+
+    lbl = label
+    if lbl.ndim == logp.ndim:
+        lbl = jnp.squeeze(lbl, axis=axis)
+    lbl_i = lbl.astype(jnp.int32)
+    valid = lbl_i != ignore_index
+    safe = jnp.where(valid, lbl_i, 0)
+    picked = jnp.take_along_axis(
+        logp, jnp.expand_dims(safe, axis=axis), axis=axis)
+    picked = jnp.squeeze(picked, axis=axis)
+    if label_smoothing > 0.0:
+        smooth = jnp.mean(logp, axis=axis)
+        picked = (1 - label_smoothing) * picked + label_smoothing * smooth
+    loss = -picked
+    if weight is not None:
+        w = jnp.take(weight, safe)
+        loss = loss * w
+        if reduction == "mean":
+            denom = jnp.sum(jnp.where(valid, w, 0.0))
+            return jnp.sum(jnp.where(valid, loss, 0.0)) / jnp.maximum(denom, 1e-12)
+    loss = jnp.where(valid, loss, 0.0)
+    if reduction == "mean":
+        denom = jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
+        return jnp.sum(loss) / denom
+    return _reduce(loss, reduction)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               numeric_stable_mode=True, return_softmax=False,
+                               axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none", axis=axis)
+    loss_t = loss if isinstance(loss, Tensor) else Tensor(loss)
+    # reference keeps the reduced axis: unsqueeze back
+    from ...ops.manipulation import unsqueeze
+    loss_t = unsqueeze(loss_t, axis)
+    if return_softmax:
+        from .activation import softmax as softmax_fn
+        return loss_t, softmax_fn(logits, axis=axis)
+    return loss_t
+
+
+@defop
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",  # noqa: A002
+             name=None):
+    lbl = label.astype(jnp.int32)
+    valid = lbl != ignore_index
+    safe = jnp.where(valid, lbl, 0)
+    picked = jnp.take_along_axis(input, safe[:, None] if input.ndim == 2
+                                 else jnp.expand_dims(safe, 1), axis=1)
+    loss = -jnp.squeeze(picked, axis=1)
+    if weight is not None:
+        w = jnp.take(weight, safe)
+        loss = loss * w
+        if reduction == "mean":
+            return jnp.sum(jnp.where(valid, loss, 0.0)) / \
+                jnp.maximum(jnp.sum(jnp.where(valid, w, 0.0)), 1e-12)
+    loss = jnp.where(valid, loss, 0.0)
+    return _reduce(loss, reduction)
+
+
+@defop
+def mse_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    return _reduce(jnp.square(input - label), reduction)
+
+
+@defop
+def l1_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    return _reduce(jnp.abs(input - label), reduction)
+
+
+@defop
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):  # noqa: A002
+    d = input - label
+    loss = jnp.where(jnp.abs(d) < delta, 0.5 * d * d, delta * (jnp.abs(d) - 0.5 * delta))
+    return _reduce(loss, reduction)
+
+
+@defop
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):  # noqa: A002
+    x = jnp.clip(input, 1e-12, 1.0 - 1e-12)
+    loss = -(label * jnp.log(x) + (1 - label) * jnp.log1p(-x))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+@defop
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    neg_abs = -jnp.abs(logit)
+    base = jnp.maximum(logit, 0) - logit * label + jnp.log1p(jnp.exp(neg_abs))
+    if pos_weight is not None:
+        log_w = (pos_weight - 1) * label + 1
+        base = base * log_w
+    if weight is not None:
+        base = base * weight
+    return _reduce(base, reduction)
+
+
+@defop
+def kl_div(input, label, reduction="mean", name=None):  # noqa: A002
+    loss = label * (jnp.log(jnp.clip(label, 1e-12, None)) - input)
+    if reduction == "batchmean":
+        return jnp.sum(loss) / input.shape[0]
+    return _reduce(loss, reduction)
+
+
+@defop
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",  # noqa: A002
+                        name=None):
+    return _reduce(jnp.maximum(-label * (input - other) + margin, 0.0), reduction)
+
+
+@defop
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):  # noqa: A002
+    loss = jnp.where(label == 1, input, jnp.maximum(margin - input, 0.0))
+    return _reduce(loss, reduction)
+
+
+@defop
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean",
+                          name=None):
+    cos = jnp.sum(input1 * input2, axis=-1) / jnp.maximum(
+        jnp.linalg.norm(input1, axis=-1) * jnp.linalg.norm(input2, axis=-1), 1e-12)
+    loss = jnp.where(label == 1, 1 - cos, jnp.maximum(cos - margin, 0.0))
+    return _reduce(loss, reduction)
+
+
+@defop
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,  # noqa: A002
+                        epsilon=1e-6, swap=False, reduction="mean", name=None):
+    def pdist(a, b):
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(a - b) + epsilon, p), axis=-1),
+                         1.0 / p)
+    dp = pdist(input, positive)
+    dn = pdist(input, negative)
+    if swap:
+        dn = jnp.minimum(dn, pdist(positive, negative))
+    return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+
+
+@defop
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    p = jax.nn.sigmoid(logit)
+    ce = jnp.maximum(logit, 0) - logit * label + jnp.log1p(jnp.exp(-jnp.abs(logit)))
+    p_t = p * label + (1 - p) * (1 - label)
+    a_t = alpha * label + (1 - alpha) * (1 - label)
+    loss = a_t * jnp.power(1 - p_t, gamma) * ce
+    if normalizer is not None:
+        loss = loss / normalizer
+    return _reduce(loss, reduction)
+
+
+@defop
+def square_error_cost(input, label, name=None):  # noqa: A002
+    return jnp.square(input - label)
+
+
+@defop
+def log_loss(input, label, epsilon=1e-4, name=None):  # noqa: A002
+    return -label * jnp.log(input + epsilon) - \
+        (1 - label) * jnp.log(1 - input + epsilon)
+
+
+@defop
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False, name=None):
+    """CTC via the standard forward algorithm in log space (lax.scan over time).
+
+    log_probs: [T, B, C] (paddle convention: max_logit_length first).
+    """
+    if log_probs.ndim == 3 and log_probs.shape[0] != labels.shape[0]:
+        lp = log_probs  # already [T, B, C]
+    else:
+        lp = jnp.swapaxes(log_probs, 0, 1)
+    lp = jax.nn.log_softmax(lp, axis=-1)
+    T, B, C = lp.shape
+    L = labels.shape[1]
+    S = 2 * L + 1
+    NEG = jnp.array(-1e30, lp.dtype)
+
+    ext = jnp.full((B, S), blank, dtype=labels.dtype)
+    ext = ext.at[:, 1::2].set(labels)
+    same = jnp.concatenate(
+        [jnp.zeros((B, 2), bool), ext[:, 2:] == ext[:, :-2]], axis=1)
+
+    alpha0 = jnp.full((B, S), NEG)
+    alpha0 = alpha0.at[:, 0].set(lp[0, :, blank])
+    alpha0 = alpha0.at[:, 1].set(jnp.take_along_axis(lp[0], ext[:, 1:2], axis=1)[:, 0])
+
+    def step(alpha, lp_t):
+        a_shift1 = jnp.concatenate([jnp.full((B, 1), NEG), alpha[:, :-1]], axis=1)
+        a_shift2 = jnp.concatenate([jnp.full((B, 2), NEG), alpha[:, :-2]], axis=1)
+        a_shift2 = jnp.where(same, NEG, a_shift2)
+        merged = jnp.logaddexp(alpha, jnp.logaddexp(a_shift1, a_shift2))
+        emit = jnp.take_along_axis(lp_t, ext, axis=1)
+        new_alpha = merged + emit
+        return new_alpha, new_alpha
+
+    _, alphas = jax.lax.scan(step, alpha0, lp[1:])
+    alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # [T, B, S]
+
+    t_idx = jnp.clip(input_lengths.astype(jnp.int32) - 1, 0, T - 1)
+    final = alphas[t_idx, jnp.arange(B)]  # [B, S]
+    s_last = 2 * label_lengths.astype(jnp.int32)
+    a_end = jnp.take_along_axis(final, s_last[:, None], axis=1)[:, 0]
+    a_end2 = jnp.take_along_axis(final, jnp.maximum(s_last - 1, 0)[:, None],
+                                 axis=1)[:, 0]
+    loss = -jnp.logaddexp(a_end, a_end2)
+    return _reduce(loss, reduction)

@@ -1,0 +1,182 @@
+"""Pooling functionals via lax.reduce_window
+(reference: python/paddle/nn/functional/pooling.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.op import defop
+
+
+def _tuplize(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    v = tuple(int(x) for x in v)
+    return v * n if len(v) == 1 else v
+
+
+def _pad_spec(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * n:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+    return [tuple(p) for p in padding[-n:]]
+
+
+def _pool(x, kernel, stride, padding, n, channel_last, kind, ceil_mode=False,
+          exclusive=True):
+    kernel = _tuplize(kernel, n)
+    stride = _tuplize(stride if stride is not None else kernel, n)
+    pad = _pad_spec(padding, n)
+
+    if channel_last:
+        window = (1,) + kernel + (1,)
+        strides = (1,) + stride + (1,)
+        spatial = list(range(1, 1 + n))
+    else:
+        window = (1, 1) + kernel
+        strides = (1, 1) + stride
+        spatial = list(range(2, 2 + n))
+
+    if isinstance(pad, str):
+        lax_pad = pad
+    else:
+        full = [(0, 0)] * x.ndim
+        for i, d in enumerate(spatial):
+            lo, hi = pad[i]
+            if ceil_mode:
+                size = x.shape[d]
+                k, s = kernel[i], stride[i]
+                out_ceil = -(-(size + lo + hi - k) // s) + 1
+                needed = (out_ceil - 1) * s + k - (size + lo)
+                hi = max(hi, needed)
+            full[d] = (lo, hi)
+        lax_pad = full
+
+    if kind == "max":
+        init = jnp.array(-jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
+                         else jnp.iinfo(x.dtype).min, dtype=x.dtype)
+        return jax.lax.reduce_window(x, init, jax.lax.max, window, strides, lax_pad)
+
+    # avg pool: sum then divide (exclusive → divide by actual window size)
+    zero = jnp.zeros((), x.dtype)
+    summed = jax.lax.reduce_window(x, zero, jax.lax.add, window, strides, lax_pad)
+    if exclusive and (isinstance(lax_pad, str) or
+                      any(p != (0, 0) for p in lax_pad)):
+        counts = jax.lax.reduce_window(jnp.ones_like(x), zero, jax.lax.add,
+                                       window, strides, lax_pad)
+        return summed / counts
+    return summed / float(np.prod(kernel))
+
+
+@defop
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, kernel_size, stride, padding, 1, data_format == "NLC",
+                 "max", ceil_mode)
+
+
+@defop
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, data_format == "NHWC",
+                 "max", ceil_mode)
+
+
+@defop
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, data_format == "NDHWC",
+                 "max", ceil_mode)
+
+
+@defop
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, kernel_size, stride, padding, 1, data_format == "NLC",
+                 "avg", ceil_mode, exclusive)
+
+
+@defop
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    out = _pool(x, kernel_size, stride, padding, 2, data_format == "NHWC",
+                "avg", ceil_mode, exclusive)
+    if divisor_override:
+        k = _tuplize(kernel_size, 2)
+        out = out * (float(np.prod(k)) / divisor_override)
+    return out
+
+
+@defop
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 3, data_format == "NDHWC",
+                 "avg", ceil_mode, exclusive)
+
+
+def _adaptive_pool(x, output_size, n, channel_last, kind):
+    out_sizes = _tuplize(output_size, n)
+    spatial = list(range(1, 1 + n)) if channel_last else list(range(2, 2 + n))
+    # adaptive pooling = per-output-bin variable windows; implement by splitting
+    # each spatial dim into bins with integer boundaries (phi adaptive kernels)
+    out = x
+    for i, d in enumerate(spatial):
+        size = out.shape[d]
+        bins = out_sizes[i] if out_sizes[i] is not None else size
+        edges = [(size * b) // bins for b in range(bins + 1)]
+        if all(edges[b + 1] - edges[b] == edges[1] - edges[0] for b in range(bins)):
+            # uniform bins → reshape-reduce (fast path)
+            k = edges[1] - edges[0]
+            new_shape = out.shape[:d] + (bins, k) + out.shape[d + 1:]
+            r = out.reshape(new_shape)
+            out = jnp.max(r, axis=d + 1) if kind == "max" else jnp.mean(r, axis=d + 1)
+        else:
+            chunks = []
+            for b in range(bins):
+                sl = [slice(None)] * out.ndim
+                sl[d] = slice(edges[b], edges[b + 1])
+                piece = out[tuple(sl)]
+                red = jnp.max(piece, axis=d, keepdims=True) if kind == "max" \
+                    else jnp.mean(piece, axis=d, keepdims=True)
+                chunks.append(red)
+            out = jnp.concatenate(chunks, axis=d)
+    return out
+
+
+@defop
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(x, output_size, 1, False, "avg")
+
+
+@defop
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool(x, output_size, 2, data_format == "NHWC", "avg")
+
+
+@defop
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool(x, output_size, 3, data_format == "NDHWC", "avg")
+
+
+@defop
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 1, False, "max")
+
+
+@defop
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 2, False, "max")
+
+
+@defop
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 3, False, "max")

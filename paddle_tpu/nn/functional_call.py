@@ -1,0 +1,83 @@
+"""Functional execution of stateful Layers — the bridge to jit/grad/GSPMD.
+
+The reference executes eagerly per-op (C++ dispatch) or rewrites a static
+Program.  Here the compiled path works like torch.func.functional_call: swap
+every Parameter/buffer value for a (possibly traced) value, run the Layer's
+Python forward once under trace, read back mutated buffers.  Combined with
+``jax.jit`` + shardings this replaces InterpreterCore, ParallelExecutor and the
+202 fusion passes (XLA fuses).
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Callable
+
+import jax
+
+from ..core import autograd
+from ..core.tensor import Tensor
+
+
+def state_values(layer) -> dict[str, Any]:
+    """name → raw jax value for every parameter and persistable buffer."""
+    return {k: v._value for k, v in layer.state_dict().items()}
+
+
+def trainable_mask(layer) -> dict[str, bool]:
+    mask = {}
+    params = {id(p) for p in layer.parameters() if not p.stop_gradient}
+    for k, v in layer.state_dict().items():
+        mask[k] = id(v) in params
+    return mask
+
+
+@contextlib.contextmanager
+def _swapped_state(layer, values: dict[str, Any]):
+    entries = layer.state_dict()
+    saved = {}
+    for k, v in values.items():
+        t = entries.get(k)
+        if t is None:
+            continue
+        saved[k] = t._value
+        t._value = v
+    try:
+        yield entries
+    finally:
+        for k, old in saved.items():
+            entries[k]._value = old
+
+
+def functional_call(layer, values: dict[str, Any], args=(), kwargs=None,
+                    mutable_buffers: bool = True):
+    """Run ``layer(*args)`` with parameter/buffer values taken from `values`.
+
+    Returns (output, new_buffer_values) where new_buffer_values holds buffers
+    mutated during the call (BN running stats) so a jitted caller can thread
+    them through functionally.
+    """
+    kwargs = kwargs or {}
+    with _swapped_state(layer, values) as entries:
+        with autograd.no_grad():
+            out = layer(*args, **kwargs)
+        new_buffers = {}
+        if mutable_buffers:
+            param_ids = {id(p) for p in layer.parameters()}
+            for k, t in entries.items():
+                if id(t) not in param_ids and k in values \
+                        and t._value is not values[k]:
+                    new_buffers[k] = t._value
+    return out, new_buffers
+
+
+def module_fn(layer) -> Callable:
+    """layer → pure fn(values, *raw_args) -> (raw_out, new_buffers)."""
+    def fn(values, *raw_args):
+        args = tuple(Tensor(a, _internal=True) if isinstance(a, jax.Array) or
+                     hasattr(a, "dtype") else a for a in raw_args)
+        out, new_buffers = functional_call(layer, values, args)
+        raw_out = jax.tree_util.tree_map(
+            lambda t: t._value if isinstance(t, Tensor) else t, out,
+            is_leaf=lambda x: isinstance(x, Tensor))
+        return raw_out, new_buffers
+    return fn

@@ -1,0 +1,69 @@
+"""Activation layers (reference: python/paddle/nn/layer/activation.py)."""
+from __future__ import annotations
+
+from .. import functional as F
+from ..layer_base import Layer
+from .. import initializer as I
+
+
+def _simple(fn_name, **fixed):
+    class _Act(Layer):
+        def __init__(self, *args, **kwargs):
+            super().__init__()
+            self._kwargs = dict(fixed)
+            # positional args map onto the functional's keyword order
+            fn = getattr(F, fn_name)
+            import inspect
+            params = [p for p in inspect.signature(fn).parameters][1:]
+            for name, val in zip(params, args):
+                self._kwargs[name] = val
+            for k, v in kwargs.items():
+                if k != "name":
+                    self._kwargs[k] = v
+
+        def forward(self, x):
+            return getattr(F, fn_name)(x, **self._kwargs)
+
+    _Act.__name__ = fn_name
+    return _Act
+
+
+ReLU = _simple("relu")
+ReLU6 = _simple("relu6")
+ELU = _simple("elu")
+SELU = _simple("selu")
+CELU = _simple("celu")
+GELU = _simple("gelu")
+Sigmoid = _simple("sigmoid")
+LogSigmoid = _simple("log_sigmoid")
+Hardsigmoid = _simple("hardsigmoid")
+Hardswish = _simple("hardswish")
+Hardtanh = _simple("hardtanh")
+Hardshrink = _simple("hardshrink")
+Softshrink = _simple("softshrink")
+Tanhshrink = _simple("tanhshrink")
+LeakyReLU = _simple("leaky_relu")
+Softplus = _simple("softplus")
+Softsign = _simple("softsign")
+Silu = _simple("silu")
+Swish = _simple("swish")
+Mish = _simple("mish")
+Tanh = _simple("tanh")
+Softmax = _simple("softmax")
+LogSoftmax = _simple("log_softmax")
+Maxout = _simple("maxout")
+GLU = _simple("glu")
+RReLU = _simple("rrelu")
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self.data_format = data_format
+        self.weight = self.create_parameter(
+            (num_parameters,), attr=weight_attr,
+            default_initializer=I.Constant(init))
+
+    def forward(self, x):
+        return F.prelu(x, self.weight, data_format=self.data_format)

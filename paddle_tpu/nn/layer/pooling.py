@@ -1,0 +1,123 @@
+"""Pooling layers (reference: python/paddle/nn/layer/pooling.py)."""
+from __future__ import annotations
+
+from .. import functional as F
+from ..layer_base import Layer
+
+
+class _Pool(Layer):
+    def __init__(self, **kw):
+        super().__init__()
+        self._kw = {k: v for k, v in kw.items() if k != "name"}
+
+
+class MaxPool1D(_Pool):
+    def __init__(self, kernel_size, stride=None, padding=0, return_mask=False,
+                 ceil_mode=False, name=None):
+        super().__init__(kernel_size=kernel_size, stride=stride, padding=padding,
+                         ceil_mode=ceil_mode)
+
+    def forward(self, x):
+        return F.max_pool1d(x, **self._kw)
+
+
+class MaxPool2D(_Pool):
+    def __init__(self, kernel_size, stride=None, padding=0, return_mask=False,
+                 ceil_mode=False, data_format="NCHW", name=None):
+        super().__init__(kernel_size=kernel_size, stride=stride, padding=padding,
+                         ceil_mode=ceil_mode, data_format=data_format)
+
+    def forward(self, x):
+        return F.max_pool2d(x, **self._kw)
+
+
+class MaxPool3D(_Pool):
+    def __init__(self, kernel_size, stride=None, padding=0, return_mask=False,
+                 ceil_mode=False, data_format="NCDHW", name=None):
+        super().__init__(kernel_size=kernel_size, stride=stride, padding=padding,
+                         ceil_mode=ceil_mode, data_format=data_format)
+
+    def forward(self, x):
+        return F.max_pool3d(x, **self._kw)
+
+
+class AvgPool1D(_Pool):
+    def __init__(self, kernel_size, stride=None, padding=0, exclusive=True,
+                 ceil_mode=False, name=None):
+        super().__init__(kernel_size=kernel_size, stride=stride, padding=padding,
+                         exclusive=exclusive, ceil_mode=ceil_mode)
+
+    def forward(self, x):
+        return F.avg_pool1d(x, **self._kw)
+
+
+class AvgPool2D(_Pool):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 exclusive=True, divisor_override=None, data_format="NCHW",
+                 name=None):
+        super().__init__(kernel_size=kernel_size, stride=stride, padding=padding,
+                         ceil_mode=ceil_mode, exclusive=exclusive,
+                         divisor_override=divisor_override, data_format=data_format)
+
+    def forward(self, x):
+        return F.avg_pool2d(x, **self._kw)
+
+
+class AvgPool3D(_Pool):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 exclusive=True, divisor_override=None, data_format="NCDHW",
+                 name=None):
+        super().__init__(kernel_size=kernel_size, stride=stride, padding=padding,
+                         ceil_mode=ceil_mode, exclusive=exclusive,
+                         divisor_override=divisor_override, data_format=data_format)
+
+    def forward(self, x):
+        return F.avg_pool3d(x, **self._kw)
+
+
+class AdaptiveAvgPool1D(_Pool):
+    def __init__(self, output_size, name=None):
+        super().__init__(output_size=output_size)
+
+    def forward(self, x):
+        return F.adaptive_avg_pool1d(x, **self._kw)
+
+
+class AdaptiveAvgPool2D(_Pool):
+    def __init__(self, output_size, data_format="NCHW", name=None):
+        super().__init__(output_size=output_size, data_format=data_format)
+
+    def forward(self, x):
+        return F.adaptive_avg_pool2d(x, **self._kw)
+
+
+class AdaptiveAvgPool3D(_Pool):
+    def __init__(self, output_size, data_format="NCDHW", name=None):
+        super().__init__(output_size=output_size, data_format=data_format)
+
+    def forward(self, x):
+        return F.adaptive_avg_pool3d(x, **self._kw)
+
+
+class AdaptiveMaxPool1D(_Pool):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__(output_size=output_size)
+
+    def forward(self, x):
+        return F.adaptive_max_pool1d(x, **self._kw)
+
+
+class AdaptiveMaxPool2D(_Pool):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__(output_size=output_size)
+
+    def forward(self, x):
+        return F.adaptive_max_pool2d(x, **self._kw)
+
+
+class AdaptiveMaxPool3D(_Pool):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__(output_size=output_size)
+
+    def forward(self, x):
+        return F.adaptive_max_pool3d(x, **self._kw)

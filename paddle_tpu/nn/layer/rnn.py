@@ -1,0 +1,327 @@
+"""Recurrent layers (reference: python/paddle/nn/layer/rnn.py).
+
+The time loop is a single `lax.scan` per layer/direction — compiled once by XLA
+instead of the reference's per-step CUDA kernel launches or cuDNN RNN descriptors.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.op import apply_op
+from ...core.tensor import Tensor
+from .. import initializer as I
+from ..layer_base import Layer
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        batch = batch_ref.shape[batch_dim_idx]
+        from ...ops.creation import full
+        state_shape = self.state_shape
+        if isinstance(state_shape[0], (list, tuple)):
+            return tuple(full((batch,) + tuple(s), init_value) for s in state_shape)
+        return full((batch,) + tuple(state_shape), init_value)
+
+
+def _make_cell_params(layer, input_size, hidden_size, gate_mult, weight_ih_attr,
+                      weight_hh_attr, bias_ih_attr, bias_hh_attr):
+    std = 1.0 / np.sqrt(hidden_size)
+    u = I.Uniform(-std, std)
+    layer.weight_ih = layer.create_parameter(
+        (gate_mult * hidden_size, input_size), attr=weight_ih_attr,
+        default_initializer=u)
+    layer.weight_hh = layer.create_parameter(
+        (gate_mult * hidden_size, hidden_size), attr=weight_hh_attr,
+        default_initializer=u)
+    layer.bias_ih = layer.create_parameter(
+        (gate_mult * hidden_size,), attr=bias_ih_attr, is_bias=True,
+        default_initializer=u)
+    layer.bias_hh = layer.create_parameter(
+        (gate_mult * hidden_size,), attr=bias_hh_attr, is_bias=True,
+        default_initializer=u)
+
+
+def _simple_rnn_step(x, h, w_ih, w_hh, b_ih, b_hh, activation):
+    z = x @ w_ih.T + h @ w_hh.T
+    if b_ih is not None:
+        z = z + b_ih + b_hh
+    return jnp.tanh(z) if activation == "tanh" else jnp.maximum(z, 0)
+
+
+def _lstm_step(x, h, c, w_ih, w_hh, b_ih, b_hh):
+    z = x @ w_ih.T + h @ w_hh.T
+    if b_ih is not None:
+        z = z + b_ih + b_hh
+    i, f, g, o = jnp.split(z, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    g = jnp.tanh(g)
+    c_new = f * c + i * g
+    h_new = o * jnp.tanh(c_new)
+    return h_new, c_new
+
+
+def _gru_step(x, h, w_ih, w_hh, b_ih, b_hh):
+    xz = x @ w_ih.T + (b_ih if b_ih is not None else 0)
+    hz = h @ w_hh.T + (b_hh if b_hh is not None else 0)
+    xr, xu, xn = jnp.split(xz, 3, axis=-1)
+    hr, hu, hn = jnp.split(hz, 3, axis=-1)
+    r = jax.nn.sigmoid(xr + hr)
+    u = jax.nn.sigmoid(xu + hu)
+    n = jnp.tanh(xn + r * hn)
+    return (1 - u) * n + u * h
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.activation = activation
+        _make_cell_params(self, input_size, hidden_size, 1, weight_ih_attr,
+                          weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        out = apply_op(
+            lambda x, h, wi, wh, bi, bh: _simple_rnn_step(
+                x, h, wi, wh, bi, bh, self.activation),
+            "simple_rnn_cell",
+            (inputs, states, self.weight_ih, self.weight_hh, self.bias_ih,
+             self.bias_hh), {})
+        return out, out
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        _make_cell_params(self, input_size, hidden_size, 4, weight_ih_attr,
+                          weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h, c = states
+        h_new, c_new = apply_op(
+            lambda x, hh, cc, wi, wh, bi, bh: _lstm_step(x, hh, cc, wi, wh, bi, bh),
+            "lstm_cell",
+            (inputs, h, c, self.weight_ih, self.weight_hh, self.bias_ih,
+             self.bias_hh), {})
+        return h_new, (h_new, c_new)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        _make_cell_params(self, input_size, hidden_size, 3, weight_ih_attr,
+                          weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        out = apply_op(
+            lambda x, h, wi, wh, bi, bh: _gru_step(x, h, wi, wh, bi, bh),
+            "gru_cell",
+            (inputs, states, self.weight_ih, self.weight_hh, self.bias_ih,
+             self.bias_hh), {})
+        return out, out
+
+
+def _scan_layer(mode, x, h0, c0, wi, wh, bi, bh, reverse, time_major):
+    """One direction of one RNN layer as a lax.scan. x: [B, T, C] or [T, B, C]."""
+    xs = x if time_major else jnp.swapaxes(x, 0, 1)
+    if reverse:
+        xs = jnp.flip(xs, axis=0)
+
+    if mode == "LSTM":
+        def step(carry, xt):
+            h, c = carry
+            h2, c2 = _lstm_step(xt, h, c, wi, wh, bi, bh)
+            return (h2, c2), h2
+        (hT, cT), ys = jax.lax.scan(step, (h0, c0), xs)
+    elif mode == "GRU":
+        def step(h, xt):
+            h2 = _gru_step(xt, h, wi, wh, bi, bh)
+            return h2, h2
+        hT, ys = jax.lax.scan(step, h0, xs)
+        cT = hT
+    else:
+        def step(h, xt):
+            h2 = _simple_rnn_step(xt, h, wi, wh, bi, bh,
+                                  "tanh" if mode == "RNN_TANH" else "relu")
+            return h2, h2
+        hT, ys = jax.lax.scan(step, h0, xs)
+        cT = hT
+    if reverse:
+        ys = jnp.flip(ys, axis=0)
+    if not time_major:
+        ys = jnp.swapaxes(ys, 0, 1)
+    return ys, hT, cT
+
+
+class _RNNBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirect = direction in ("bidirect", "bidirectional")
+        self.num_directions = 2 if self.bidirect else 1
+        gate_mult = {"LSTM": 4, "GRU": 3}.get(mode, 1)
+        std = 1.0 / np.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self._all_weights = []
+        for layer_i in range(num_layers):
+            for d in range(self.num_directions):
+                in_sz = input_size if layer_i == 0 else \
+                    hidden_size * self.num_directions
+                sfx = f"_reverse" if d else ""
+                wi = self.create_parameter((gate_mult * hidden_size, in_sz),
+                                           attr=weight_ih_attr, default_initializer=u)
+                wh = self.create_parameter((gate_mult * hidden_size, hidden_size),
+                                           attr=weight_hh_attr, default_initializer=u)
+                bi = self.create_parameter((gate_mult * hidden_size,),
+                                           attr=bias_ih_attr, is_bias=True,
+                                           default_initializer=u)
+                bh = self.create_parameter((gate_mult * hidden_size,),
+                                           attr=bias_hh_attr, is_bias=True,
+                                           default_initializer=u)
+                self.add_parameter(f"weight_ih_l{layer_i}{sfx}", wi)
+                self.add_parameter(f"weight_hh_l{layer_i}{sfx}", wh)
+                self.add_parameter(f"bias_ih_l{layer_i}{sfx}", bi)
+                self.add_parameter(f"bias_hh_l{layer_i}{sfx}", bh)
+                self._all_weights.append((wi, wh, bi, bh))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        batch_idx = 1 if self.time_major else 0
+        batch = inputs.shape[batch_idx]
+        n_state = self.num_layers * self.num_directions
+
+        from ...ops.creation import zeros
+        if initial_states is None:
+            h0 = zeros((n_state, batch, self.hidden_size), dtype=inputs.dtype)
+            c0 = zeros((n_state, batch, self.hidden_size), dtype=inputs.dtype)
+        elif self.mode == "LSTM":
+            h0, c0 = initial_states
+        else:
+            h0, c0 = initial_states, initial_states
+
+        x = inputs
+        h_outs, c_outs = [], []
+        from .common import Dropout
+        for layer_i in range(self.num_layers):
+            dir_outs = []
+            for d in range(self.num_directions):
+                idx = layer_i * self.num_directions + d
+                wi, wh, bi, bh = self._all_weights[idx]
+                ys, hT, cT = apply_op(
+                    lambda xv, h0v, c0v, wiv, whv, biv, bhv, _mode=self.mode,
+                    _rev=bool(d), _tm=self.time_major: _scan_layer(
+                        _mode, xv, h0v, c0v, wiv, whv, biv, bhv, _rev, _tm),
+                    f"{self.mode.lower()}_layer",
+                    (x, h0[idx], c0[idx], wi, wh, bi, bh), {})
+                dir_outs.append(ys)
+                h_outs.append(hT)
+                c_outs.append(cT)
+            if self.num_directions == 2:
+                from ...ops.manipulation import concat
+                x = concat(dir_outs, axis=-1)
+            else:
+                x = dir_outs[0]
+            if self.dropout and layer_i < self.num_layers - 1 and self.training:
+                from .. import functional as Fn
+                x = Fn.dropout(x, p=self.dropout, training=True)
+        from ...ops.manipulation import stack
+        h_all = stack(h_outs, axis=0)
+        if self.mode == "LSTM":
+            c_all = stack(c_outs, axis=0)
+            return x, (h_all, c_all)
+        return x, h_all
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, activation="tanh", **kwargs):
+        mode = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        super().__init__(mode, input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kwargs)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, **kwargs):
+        super().__init__("LSTM", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kwargs)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, **kwargs):
+        super().__init__("GRU", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kwargs)
+
+
+class RNN(Layer):
+    """Wraps a cell into a recurrent layer (reference nn.RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None, **kwargs):
+        time_axis = 0 if self.time_major else 1
+        T = inputs.shape[time_axis]
+        steps = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        states = initial_states
+        outs = [None] * T
+        for ti in steps:
+            xt = inputs[ti] if self.time_major else inputs[:, ti]
+            out, states = self.cell(xt, states)
+            outs[ti] = out
+        from ...ops.manipulation import stack
+        return stack(outs, axis=time_axis), states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None, **kwargs):
+        states_fw, states_bw = (initial_states if initial_states is not None
+                                else (None, None))
+        out_fw, st_fw = self.rnn_fw(inputs, states_fw)
+        out_bw, st_bw = self.rnn_bw(inputs, states_bw)
+        from ...ops.manipulation import concat
+        return concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)

@@ -1,0 +1,363 @@
+"""nn.Layer — the module base class.
+
+Reference: python/paddle/fluid/dygraph/layers.py (1,749 LoC `Layer`): parameter /
+buffer / sublayer registries, hooks, state_dict, train/eval.  Unlike the
+reference there is no C++ VarBase underneath — parameters are Tensors holding
+jax.Arrays, and the functional/jit path swaps their values for tracers via
+``paddle_tpu.nn.functional_call``.
+"""
+from __future__ import annotations
+
+import itertools
+from collections import OrderedDict
+from typing import Callable, Iterator
+
+import numpy as np
+import jax
+
+from ..core.dtype import get_default_dtype, to_jax
+from ..core.tensor import Tensor
+from . import initializer as init_mod
+
+_layer_counter = itertools.count()
+
+
+class Parameter(Tensor):
+    """Trainable tensor: stop_gradient=False by default (fluid framework.py
+    `Parameter`)."""
+
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip", "is_distributed")
+
+    def __init__(self, data, dtype=None, name=None, trainable=True,
+                 learning_rate=1.0, regularizer=None, need_clip=True):
+        super().__init__(data, dtype=dtype, stop_gradient=not trainable, name=name)
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": learning_rate}
+        self.regularizer = regularizer
+        self.need_clip = need_clip
+        self.is_distributed = False
+        self.persistable = True
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+class ParamAttr:
+    """paddle.ParamAttr parity (python/paddle/fluid/param_attr.py)."""
+
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, do_model_average=True,
+                 need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip
+
+    @staticmethod
+    def _to_attr(attr):
+        if attr is None:
+            return ParamAttr()
+        if isinstance(attr, ParamAttr):
+            return attr
+        if isinstance(attr, str):
+            return ParamAttr(name=attr)
+        if isinstance(attr, init_mod.Initializer):
+            return ParamAttr(initializer=attr)
+        if attr is False:
+            return False
+        raise TypeError(f"cannot make ParamAttr from {attr!r}")
+
+
+class HookRemoveHelper:
+    def __init__(self, hooks: dict, hook_id: int):
+        self._hooks = hooks
+        self._hook_id = hook_id
+
+    def remove(self):
+        self._hooks.pop(self._hook_id, None)
+
+
+class Layer:
+    def __init__(self, name_scope=None, dtype=None):
+        self._dtype = dtype or get_default_dtype()
+        self._full_name = (name_scope or self.__class__.__name__.lower()) + \
+            f"_{next(_layer_counter)}"
+        self.training = True
+        self._parameters: OrderedDict[str, Parameter] = OrderedDict()
+        self._buffers: OrderedDict[str, Tensor] = OrderedDict()
+        self._non_persistable_buffer_names = set()
+        self._sub_layers: OrderedDict[str, Layer] = OrderedDict()
+        self._forward_pre_hooks: OrderedDict[int, Callable] = OrderedDict()
+        self._forward_post_hooks: OrderedDict[int, Callable] = OrderedDict()
+        self._hook_counter = itertools.count()
+
+    # -- registration -------------------------------------------------------
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call super().__init__() before assigning params")
+            for d in (layers, buffers):
+                if d is not None:
+                    d.pop(name, None)
+            params[name] = value
+        elif isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError("call super().__init__() before assigning sublayers")
+            for d in (params, buffers):
+                if d is not None:
+                    d.pop(name, None)
+            layers[name] = value
+        else:
+            for d in (params, layers):
+                if d is not None and name in d:
+                    if value is None:
+                        d.pop(name)
+                    else:
+                        raise TypeError(
+                            f"cannot assign {type(value)} to registered slot {name!r}")
+            if buffers is not None and name in buffers:
+                if value is None or isinstance(value, Tensor):
+                    buffers[name] = value
+                    return
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for registry in ("_parameters", "_buffers", "_sub_layers"):
+            d = self.__dict__.get(registry)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(
+            f"'{self.__class__.__name__}' object has no attribute {name!r}")
+
+    def __delattr__(self, name):
+        for registry in ("_parameters", "_buffers", "_sub_layers"):
+            d = self.__dict__.get(registry)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    def __dir__(self):
+        return list(super().__dir__()) + list(self._parameters) + \
+            list(self._buffers) + list(self._sub_layers)
+
+    def add_parameter(self, name: str, parameter: Parameter | None):
+        if parameter is not None and not isinstance(parameter, Parameter):
+            raise TypeError("add_parameter expects a Parameter")
+        self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name: str, sublayer: "Layer"):
+        self._sub_layers[name] = sublayer
+        return sublayer
+
+    def register_buffer(self, name: str, tensor: Tensor | None, persistable=True):
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        return tensor
+
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
+                         default_initializer=None) -> Parameter | None:
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        dtype = dtype or self._dtype
+        initializer = attr.initializer or default_initializer or (
+            init_mod.Constant(0.0) if is_bias else init_mod.XavierUniform())
+        value = initializer(tuple(int(s) for s in shape), to_jax(dtype))
+        return Parameter(value, name=attr.name, trainable=attr.trainable,
+                         learning_rate=attr.learning_rate,
+                         regularizer=attr.regularizer, need_clip=attr.need_clip)
+
+    def create_tensor(self, name=None, dtype=None, default_initializer=None):
+        return Tensor(np.zeros((), dtype=dtype or self._dtype))
+
+    # -- traversal ----------------------------------------------------------
+    def parameters(self, include_sublayers=True) -> list[Parameter]:
+        return [p for _, p in self.named_parameters(
+            include_sublayers=include_sublayers)]
+
+    def named_parameters(self, prefix="", include_sublayers=True
+                         ) -> Iterator[tuple[str, Parameter]]:
+        seen = set()
+        for name, layer_prefix, layer in self._traverse(prefix, include_sublayers):
+            for pname, p in layer._parameters.items():
+                if p is not None and id(p) not in seen:
+                    seen.add(id(p))
+                    yield f"{layer_prefix}{pname}", p
+
+    def _traverse(self, prefix="", include_sublayers=True):
+        """Yield (unused, dotted-prefix, layer) for self and sublayers."""
+        stack = [(prefix + "." if prefix else "", self)]
+        seen = set()
+        while stack:
+            pfx, layer = stack.pop(0)
+            if id(layer) in seen:
+                continue
+            seen.add(id(layer))
+            yield (None, pfx, layer)
+            if include_sublayers:
+                for name, sub in layer._sub_layers.items():
+                    if sub is not None:
+                        stack.append((f"{pfx}{name}.", sub))
+
+    def named_buffers(self, prefix="", include_sublayers=True):
+        seen = set()
+        for _, layer_prefix, layer in self._traverse(prefix, include_sublayers):
+            for bname, b in layer._buffers.items():
+                if b is not None and id(b) not in seen:
+                    seen.add(id(b))
+                    yield f"{layer_prefix}{bname}", b
+
+    def buffers(self, include_sublayers=True):
+        return [b for _, b in self.named_buffers(include_sublayers=include_sublayers)]
+
+    def children(self) -> Iterator["Layer"]:
+        for _, l in self.named_children():
+            yield l
+
+    def named_children(self):
+        for name, sub in self._sub_layers.items():
+            if sub is not None:
+                yield name, sub
+
+    def sublayers(self, include_self=False) -> list["Layer"]:
+        out = []
+        for _, _, layer in self._traverse():
+            out.append(layer)
+        return out if include_self else out[1:]
+
+    def named_sublayers(self, prefix="", include_self=False):
+        first = True
+        for _, pfx, layer in self._traverse(prefix):
+            if first and not include_self:
+                first = False
+                continue
+            first = False
+            yield pfx[:-1] if pfx.endswith(".") else pfx, layer
+
+    def apply(self, fn):
+        for layer in self.sublayers(include_self=True):
+            fn(layer)
+        return self
+
+    def full_name(self):
+        return self._full_name
+
+    # -- mode ---------------------------------------------------------------
+    def train(self):
+        for layer in self.sublayers(include_self=True):
+            layer.training = True
+        return self
+
+    def eval(self):
+        for layer in self.sublayers(include_self=True):
+            layer.training = False
+        return self
+
+    # -- state dict ---------------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers=True,
+                   use_hook=True, structured_name_prefix=""):
+        dest = destination if destination is not None else OrderedDict()
+        for _, pfx, layer in self._traverse(structured_name_prefix.rstrip("."),
+                                            include_sublayers):
+            for pname, p in layer._parameters.items():
+                if p is not None:
+                    dest[f"{pfx}{pname}"] = p
+            for bname, b in layer._buffers.items():
+                if b is not None and bname not in layer._non_persistable_buffer_names:
+                    dest[f"{pfx}{bname}"] = b
+        return dest
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        own = self.state_dict()
+        missing, unexpected = [], []
+        matched = {}
+        for k, v in state_dict.items():
+            if k in own:
+                matched[k] = v
+            else:
+                unexpected.append(k)
+        for k in own:
+            if k not in matched:
+                missing.append(k)
+        for k, v in matched.items():
+            target = own[k]
+            val = v._value if isinstance(v, Tensor) else jax.numpy.asarray(np.asarray(v))
+            if tuple(target.shape) != tuple(val.shape):
+                raise ValueError(
+                    f"shape mismatch for {k}: {tuple(target.shape)} vs {tuple(val.shape)}")
+            target._replace_(val.astype(target._value.dtype), None)
+        return missing, unexpected
+
+    load_dict = set_state_dict
+    set_dict = set_state_dict
+
+    # -- call ---------------------------------------------------------------
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in list(self._forward_pre_hooks.values()):
+            result = hook(self, inputs)
+            if result is not None:
+                inputs = result if isinstance(result, tuple) else (result,)
+        out = self.forward(*inputs, **kwargs)
+        for hook in list(self._forward_post_hooks.values()):
+            result = hook(self, inputs, out)
+            if result is not None:
+                out = result
+        return out
+
+    def register_forward_pre_hook(self, hook) -> HookRemoveHelper:
+        hid = next(self._hook_counter)
+        self._forward_pre_hooks[hid] = hook
+        return HookRemoveHelper(self._forward_pre_hooks, hid)
+
+    def register_forward_post_hook(self, hook) -> HookRemoveHelper:
+        hid = next(self._hook_counter)
+        self._forward_post_hooks[hid] = hook
+        return HookRemoveHelper(self._forward_post_hooks, hid)
+
+    # -- misc ---------------------------------------------------------------
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_grad()
+
+    def to(self, device=None, dtype=None, blocking=None):
+        for t in list(self.parameters()) + list(self.buffers()):
+            moved = t.to(device, dtype)
+            t._replace_(moved._value, None)
+        return self
+
+    def astype(self, dtype):
+        return self.to(dtype=dtype)
+
+    def float(self):
+        return self.to(dtype="float32")
+
+    def bfloat16(self):
+        return self.to(dtype="bfloat16")
+
+    def half(self):
+        return self.to(dtype="float16")
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = [f"{self.__class__.__name__}({extra}" if extra
+                 else f"{self.__class__.__name__}("]
+        for name, sub in self._sub_layers.items():
+            sub_repr = repr(sub).split("\n")
+            lines.append(f"  ({name}): " + "\n  ".join(sub_repr))
+        lines.append(")")
+        return "\n".join(lines) if len(lines) > 2 or not extra else \
+            f"{self.__class__.__name__}({extra})"
+
+    def extra_repr(self):
+        return ""

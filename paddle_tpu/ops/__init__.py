@@ -1,0 +1,11 @@
+"""The op corpus.  Importing this package registers every op (and its Tensor
+methods) with the core registry — the analog of phi kernel registration."""
+from . import creation, math, reduction, manipulation, logic, linalg, search, random_ops  # noqa: F401
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .reduction import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .random_ops import *  # noqa: F401,F403

@@ -1,0 +1,167 @@
+"""Tensor creation ops (reference: python/paddle/tensor/creation.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dtype import get_default_dtype, to_jax
+from ..core.op import defop, apply_op
+from ..core.tensor import Tensor, to_tensor  # noqa: F401  (re-export)
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape._value))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s._value) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def _dt(dtype, default=None):
+    if dtype is None:
+        return to_jax(default) if default is not None else to_jax(get_default_dtype())
+    return to_jax(dtype)
+
+
+def zeros(shape, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.zeros(_shape(shape), _dt(dtype)), _internal=True)
+
+
+def ones(shape, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.ones(_shape(shape), _dt(dtype)), _internal=True)
+
+
+def full(shape, fill_value, dtype=None, name=None) -> Tensor:
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        dtype = (get_default_dtype() if isinstance(fill_value, float)
+                 else ("int64" if isinstance(fill_value, int)
+                       and not isinstance(fill_value, bool) else
+                       ("bool" if isinstance(fill_value, bool) else None)))
+    return Tensor(jnp.full(_shape(shape), fill_value, _dt(dtype)), _internal=True)
+
+
+def empty(shape, dtype=None, name=None) -> Tensor:
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.zeros_like(x._value if isinstance(x, Tensor) else x,
+                                 dtype=to_jax(dtype) if dtype else None), _internal=True)
+
+
+def ones_like(x, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.ones_like(x._value if isinstance(x, Tensor) else x,
+                                dtype=to_jax(dtype) if dtype else None), _internal=True)
+
+
+def full_like(x, fill_value, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.full_like(x._value if isinstance(x, Tensor) else x, fill_value,
+                                dtype=to_jax(dtype) if dtype else None), _internal=True)
+
+
+def empty_like(x, dtype=None, name=None) -> Tensor:
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None) -> Tensor:
+    def val(v):
+        return v.item() if isinstance(v, Tensor) else v
+    start, end, step = val(start), val(end), val(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = ("int64" if all(isinstance(v, (int, np.integer))
+                                for v in (start, end, step)) else get_default_dtype())
+    return Tensor(jnp.arange(start, end, step, dtype=to_jax(dtype)), _internal=True)
+
+
+def linspace(start, stop, num, dtype=None, name=None) -> Tensor:
+    def val(v):
+        return v.item() if isinstance(v, Tensor) else v
+    return Tensor(jnp.linspace(val(start), val(stop), int(val(num)),
+                               dtype=_dt(dtype)), _internal=True)
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.logspace(start, stop, int(num), base=base, dtype=_dt(dtype)),
+                  _internal=True)
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.eye(int(num_rows),
+                          int(num_columns) if num_columns is not None else None,
+                          dtype=_dt(dtype)), _internal=True)
+
+
+@defop(tensor_method="tril")
+def tril(x, diagonal=0, name=None):
+    return jnp.tril(x, k=int(diagonal))
+
+
+@defop(tensor_method="triu")
+def triu(x, diagonal=0, name=None):
+    return jnp.triu(x, k=int(diagonal))
+
+
+@defop
+def diag(x, offset=0, padding_value=0, name=None):
+    if x.ndim == 1 and padding_value != 0:
+        d = jnp.diag(x, k=int(offset))
+        mask = jnp.eye(d.shape[0], dtype=bool) if offset == 0 else \
+            jnp.diag(jnp.ones_like(x, dtype=bool), k=int(offset))
+        return jnp.where(mask, d, padding_value)
+    return jnp.diag(x, k=int(offset))
+
+
+@defop
+def diagflat(x, offset=0, name=None):
+    return jnp.diagflat(x, k=int(offset))
+
+
+@defop(tensor_method="diagonal")
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return jnp.diagonal(x, offset=int(offset), axis1=int(axis1), axis2=int(axis2))
+
+
+def meshgrid(*args, name=None):
+    args = args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args
+    outs = apply_op(lambda *xs: tuple(jnp.meshgrid(*xs, indexing="ij")),
+                    "meshgrid", tuple(args), {})
+    return list(outs)
+
+
+def assign(x, output=None) -> Tensor:
+    src = x._value if isinstance(x, Tensor) else jnp.asarray(np.asarray(x))
+    if output is None:
+        return Tensor(src, _internal=True)
+    output._replace_(src.astype(output._value.dtype))
+    return output
+
+
+def clone(x, name=None) -> Tensor:
+    return x.clone()
+
+
+def numel(x, name=None) -> Tensor:
+    return Tensor(jnp.asarray(x._value.size, dtype=jnp.int64), _internal=True)
+
+
+def one_hot(x, num_classes, name=None) -> Tensor:
+    return apply_op(
+        lambda v: jax.nn.one_hot(v, int(num_classes), dtype=to_jax(get_default_dtype())),
+        "one_hot", (x,), {})
+
+
+def complex(real, imag, name=None) -> Tensor:
+    return apply_op(lambda r, i: jax.lax.complex(r, i), "complex", (real, imag), {})
+
+
+def as_complex(x, name=None) -> Tensor:
+    return apply_op(lambda v: jax.lax.complex(v[..., 0], v[..., 1]), "as_complex", (x,), {})
+
+
+def as_real(x, name=None) -> Tensor:
+    return apply_op(lambda v: jnp.stack([v.real, v.imag], axis=-1), "as_real", (x,), {})

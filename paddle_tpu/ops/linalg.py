@@ -1,0 +1,197 @@
+"""Linear algebra ops (reference: python/paddle/tensor/linalg.py → phi matmul/blas
+kernels).  matmul is THE MXU op: keep inputs batched and let XLA tile it."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.op import defop, apply_op
+
+
+@defop(tensor_method=["matmul", "mm"])
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    return jnp.matmul(x, y)
+
+
+@defop(tensor_method="dot")
+def dot(x, y, name=None):
+    return jnp.sum(x * y, axis=-1)
+
+
+@defop(tensor_method="bmm")
+def bmm(x, y, name=None):
+    return jnp.matmul(x, y)
+
+
+@defop(tensor_method="mv")
+def mv(x, vec, name=None):
+    return jnp.matmul(x, vec)
+
+
+@defop(tensor_method="norm")
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    if axis is None:
+        x = jnp.ravel(x)
+        axis = 0
+        if p == "fro" or p == 2:
+            return jnp.linalg.norm(x, keepdims=keepdim)
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(int(a) for a in axis)
+        return jnp.linalg.norm(x, ord="fro" if p == "fro" else p, axis=axis,
+                               keepdims=keepdim)
+    if p == "fro":
+        p = 2
+    if p == float("inf") or p == float("-inf"):
+        return jnp.linalg.norm(x, ord=p, axis=int(axis), keepdims=keepdim)
+    return jnp.sum(jnp.abs(x) ** p, axis=int(axis), keepdims=keepdim) ** (1.0 / p)
+
+
+@defop(tensor_method="dist")
+def dist(x, y, p=2, name=None):
+    d = x - y
+    if p == 0:
+        return jnp.sum((d != 0).astype(d.dtype)) ** 1.0
+    if p == float("inf"):
+        return jnp.max(jnp.abs(d))
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(d))
+    return jnp.sum(jnp.abs(d) ** p) ** (1.0 / p)
+
+
+@defop(tensor_method="cross")
+def cross(x, y, axis=9, name=None):
+    if axis == 9:  # paddle default: first axis of size 3
+        axis = next(i for i, s in enumerate(x.shape) if s == 3)
+    return jnp.cross(x, y, axis=int(axis))
+
+
+@defop(tensor_method="cholesky")
+def cholesky(x, upper=False, name=None):
+    L = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(L, -1, -2).conj() if upper else L
+
+
+@defop
+def cholesky_solve(x, y, upper=False, name=None):
+    return jax.scipy.linalg.cho_solve((y, not upper), x)
+
+
+@defop
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    return jax.scipy.linalg.solve_triangular(
+        x, y, lower=not upper, trans=1 if transpose else 0,
+        unit_diagonal=unitriangular)
+
+
+@defop(tensor_method="inverse")
+def inverse(x, name=None):
+    return jnp.linalg.inv(x)
+
+
+@defop
+def solve(x, y, name=None):
+    return jnp.linalg.solve(x, y)
+
+
+@defop
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(x, y, rcond=rcond)
+    return sol, res, rank, sv
+
+
+@defop
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian)
+
+
+@defop(tensor_method="matrix_power")
+def matrix_power(x, n, name=None):
+    return jnp.linalg.matrix_power(x, int(n))
+
+
+@defop
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return jnp.linalg.matrix_rank(x, rtol=tol)
+
+
+@defop
+def svd(x, full_matrices=False, name=None):
+    return jnp.linalg.svd(x, full_matrices=full_matrices)
+
+
+@defop
+def qr(x, mode="reduced", name=None):
+    return jnp.linalg.qr(x, mode=mode)
+
+
+@defop
+def eig(x, name=None):
+    return jnp.linalg.eig(x)
+
+
+@defop
+def eigh(x, UPLO="L", name=None):
+    return jnp.linalg.eigh(x, UPLO=UPLO)
+
+
+@defop
+def eigvals(x, name=None):
+    return jnp.linalg.eigvals(x)
+
+
+@defop
+def eigvalsh(x, UPLO="L", name=None):
+    return jnp.linalg.eigvalsh(x, UPLO=UPLO)
+
+
+@defop
+def slogdet(x, name=None):
+    sign, logdet = jnp.linalg.slogdet(x)
+    return jnp.stack([sign, logdet])
+
+
+@defop
+def det(x, name=None):
+    return jnp.linalg.det(x)
+
+
+def multi_dot(x, name=None):
+    return apply_op(lambda *xs: jnp.linalg.multi_dot(xs), "multi_dot", tuple(x), {})
+
+
+@defop
+def histogram(x, bins=100, min=0, max=0, name=None):  # noqa: A002
+    if min == 0 and max == 0:
+        lo, hi = jnp.min(x), jnp.max(x)
+    else:
+        lo, hi = min, max
+    hist, _ = jnp.histogram(x, bins=int(bins), range=(lo, hi))
+    return hist
+
+
+@defop(tensor_method="bincount")
+def bincount(x, weights=None, minlength=0, name=None):
+    # jnp.bincount needs static length: eager-only unless minlength given
+    length = int(minlength) if minlength else int(jnp.max(x)) + 1
+    return jnp.bincount(x, weights=weights, length=length)
+
+
+def einsum(equation, *operands, name=None):
+    if len(operands) == 1 and isinstance(operands[0], (list, tuple)):
+        operands = tuple(operands[0])
+    return apply_op(lambda *xs: jnp.einsum(equation, *xs), "einsum", operands, {})
+
+
+@defop(tensor_method="corrcoef")
+def corrcoef(x, rowvar=True, name=None):
+    return jnp.corrcoef(x, rowvar=rowvar)
+
+
+@defop(tensor_method="cov")
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0,
+                   fweights=fweights, aweights=aweights)

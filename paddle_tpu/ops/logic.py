@@ -1,0 +1,133 @@
+"""Comparison / logical / bitwise ops (reference: python/paddle/tensor/logic.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.op import defop, apply_op
+from ..core.tensor import Tensor
+
+
+@defop(tensor_method="equal")
+def equal(x, y, name=None):
+    return jnp.equal(x, y)
+
+
+@defop(tensor_method="not_equal")
+def not_equal(x, y, name=None):
+    return jnp.not_equal(x, y)
+
+
+@defop(tensor_method="less_than")
+def less_than(x, y, name=None):
+    return jnp.less(x, y)
+
+
+@defop(tensor_method="less_equal")
+def less_equal(x, y, name=None):
+    return jnp.less_equal(x, y)
+
+
+@defop(tensor_method="greater_than")
+def greater_than(x, y, name=None):
+    return jnp.greater(x, y)
+
+
+@defop(tensor_method="greater_equal")
+def greater_equal(x, y, name=None):
+    return jnp.greater_equal(x, y)
+
+
+@defop(tensor_method="logical_and")
+def logical_and(x, y, out=None, name=None):
+    return jnp.logical_and(x, y)
+
+
+@defop(tensor_method="logical_or")
+def logical_or(x, y, out=None, name=None):
+    return jnp.logical_or(x, y)
+
+
+@defop(tensor_method="logical_xor")
+def logical_xor(x, y, out=None, name=None):
+    return jnp.logical_xor(x, y)
+
+
+@defop(tensor_method="logical_not")
+def logical_not(x, out=None, name=None):
+    return jnp.logical_not(x)
+
+
+@defop(tensor_method="bitwise_and")
+def bitwise_and(x, y, name=None):
+    return jnp.bitwise_and(x, y)
+
+
+@defop(tensor_method="bitwise_or")
+def bitwise_or(x, y, name=None):
+    return jnp.bitwise_or(x, y)
+
+
+@defop(tensor_method="bitwise_xor")
+def bitwise_xor(x, y, name=None):
+    return jnp.bitwise_xor(x, y)
+
+
+@defop(tensor_method="bitwise_not")
+def bitwise_not(x, name=None):
+    return jnp.bitwise_not(x)
+
+
+@defop(tensor_method="equal_all")
+def equal_all(x, y, name=None):
+    return jnp.array_equal(x, y)
+
+
+@defop(tensor_method="allclose")
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return jnp.allclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+@defop(tensor_method="isclose")
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(x._value.size == 0), _internal=True)
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        from .search import nonzero
+        return nonzero(condition, as_tuple=True)
+    return apply_op(lambda c, a, b: jnp.where(c, a, b), "where",
+                    (condition, x, y), {})
+
+
+# operator overloads -----------------------------------------------------------
+
+def _cmp(op):
+    def method(self, other):
+        if isinstance(other, (list, tuple, np.ndarray)):
+            other = Tensor(np.asarray(other))
+        if not isinstance(other, Tensor):
+            return apply_op(lambda a: op.raw(a, other), op.op_name, (self,), {})
+        return op(self, other)
+    return method
+
+
+Tensor.__eq__ = _cmp(equal)
+Tensor.__ne__ = _cmp(not_equal)
+Tensor.__lt__ = _cmp(less_than)
+Tensor.__le__ = _cmp(less_equal)
+Tensor.__gt__ = _cmp(greater_than)
+Tensor.__ge__ = _cmp(greater_equal)
+Tensor.__and__ = _cmp(bitwise_and)
+Tensor.__or__ = _cmp(bitwise_or)
+Tensor.__xor__ = _cmp(bitwise_xor)
+Tensor.__invert__ = lambda self: bitwise_not(self)

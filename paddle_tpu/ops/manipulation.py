@@ -1,0 +1,332 @@
+"""Shape/layout manipulation ops (reference: python/paddle/tensor/manipulation.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.op import defop, apply_op
+from ..core.tensor import Tensor
+
+
+def _ints(v):
+    if isinstance(v, Tensor):
+        return tuple(int(x) for x in np.asarray(v._value).reshape(-1))
+    if isinstance(v, (int, np.integer)):
+        return (int(v),)
+    return tuple(int(x._value) if isinstance(x, Tensor) else int(x) for x in v)
+
+
+@defop(tensor_method="reshape")
+def reshape(x, shape, name=None):
+    return jnp.reshape(x, _ints(shape))
+
+
+@defop(tensor_method="flatten")
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    nd = x.ndim
+    if nd == 0:
+        return jnp.reshape(x, (1,))
+    start = start_axis % nd
+    stop = stop_axis % nd
+    shape = (x.shape[:start] + (-1,) + x.shape[stop + 1:])
+    return jnp.reshape(x, shape)
+
+
+@defop(tensor_method="transpose")
+def transpose(x, perm=None, name=None):
+    return jnp.transpose(x, _ints(perm) if perm is not None else None)
+
+
+@defop(tensor_method="t")
+def t(x, name=None):
+    if x.ndim > 2:
+        raise ValueError("paddle.t only supports ndim <= 2; use transpose")
+    return x.T
+
+
+@defop(tensor_method="moveaxis")
+def moveaxis(x, source, destination, name=None):
+    return jnp.moveaxis(x, _ints(source), _ints(destination))
+
+
+@defop(tensor_method="squeeze")
+def squeeze(x, axis=None, name=None):
+    if axis is None:
+        return jnp.squeeze(x)
+    axes = tuple(a % x.ndim for a in _ints(axis))
+    axes = tuple(a for a in axes if x.shape[a] == 1)
+    return jnp.squeeze(x, axis=axes) if axes else x
+
+
+@defop(tensor_method="unsqueeze")
+def unsqueeze(x, axis, name=None):
+    return jnp.expand_dims(x, _ints(axis))
+
+
+def concat(x, axis=0, name=None):
+    axis = int(axis._value) if isinstance(axis, Tensor) else int(axis)
+    return apply_op(lambda *xs: jnp.concatenate(xs, axis=axis), "concat",
+                    tuple(x), {})
+
+
+def stack(x, axis=0, name=None):
+    return apply_op(lambda *xs: jnp.stack(xs, axis=int(axis)), "stack",
+                    tuple(x), {})
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    axis = int(axis._value) if isinstance(axis, Tensor) else int(axis)
+    dim = x._value.shape[axis] if isinstance(x, Tensor) else x.shape[axis]
+    if isinstance(num_or_sections, int):
+        sizes = [dim // num_or_sections] * num_or_sections
+    else:
+        sizes = [int(s) for s in _ints(num_or_sections)]
+        n_unknown = sum(1 for s in sizes if s < 0)
+        if n_unknown:
+            known = sum(s for s in sizes if s >= 0)
+            sizes = [s if s >= 0 else dim - known for s in sizes]
+    offsets = np.cumsum([0] + sizes[:-1])
+
+    def impl(v):
+        return tuple(jax.lax.dynamic_slice_in_dim(v, int(o), int(s), axis)
+                     for o, s in zip(offsets, sizes))
+    return list(apply_op(impl, "split", (x,), {}))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, int(chunks), axis)
+
+
+def unbind(x, axis=0, name=None):
+    n = x.shape[int(axis)]
+    return [o for o in apply_op(
+        lambda v: tuple(jnp.squeeze(s, axis=int(axis))
+                        for s in jnp.split(v, n, axis=int(axis))),
+        "unbind", (x,), {})]
+
+
+@defop(tensor_method="tile")
+def tile(x, repeat_times, name=None):
+    return jnp.tile(x, _ints(repeat_times))
+
+
+@defop(tensor_method="expand")
+def expand(x, shape, name=None):
+    target = list(_ints(shape))
+    src = list(x.shape)
+    # paddle allows -1 meaning "keep this dim" — but only for dims that exist
+    # in the input, not for newly added leading dims
+    offset = len(target) - len(src)
+    for i, s in enumerate(target):
+        if s == -1:
+            if i < offset:
+                raise ValueError(
+                    f"expand: -1 at position {i} refers to a new leading "
+                    f"dimension that does not exist in the input shape {src}")
+            target[i] = src[i - offset]
+    return jnp.broadcast_to(x, tuple(target))
+
+
+def expand_as(x, y, name=None):
+    return expand(x, y.shape)
+
+
+@defop(tensor_method="broadcast_to")
+def broadcast_to(x, shape, name=None):
+    return jnp.broadcast_to(x, _ints(shape))
+
+
+def broadcast_tensors(inputs, name=None):
+    return list(apply_op(lambda *xs: tuple(jnp.broadcast_arrays(*xs)),
+                         "broadcast_tensors", tuple(inputs), {}))
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+@defop(tensor_method="flip")
+def flip(x, axis, name=None):
+    return jnp.flip(x, _ints(axis))
+
+
+@defop(tensor_method="rot90")
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return jnp.rot90(x, k=k, axes=tuple(axes))
+
+
+@defop(tensor_method="roll")
+def roll(x, shifts, axis=None, name=None):
+    return jnp.roll(x, _ints(shifts) if not isinstance(shifts, int) else shifts,
+                    axis=_ints(axis) if axis is not None else None)
+
+
+@defop(tensor_method="gather")
+def gather(x, index, axis=0, name=None):
+    idx = index.reshape(-1) if index.ndim > 1 else index
+    return jnp.take(x, idx, axis=int(axis) if not hasattr(axis, "item") else int(axis.item()))
+
+
+@defop(tensor_method="index_select")
+def index_select(x, index, axis=0, name=None):
+    return jnp.take(x, index.reshape(-1), axis=int(axis))
+
+
+@defop(tensor_method="gather_nd")
+def gather_nd(x, index, name=None):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x[idx]
+
+
+@defop(tensor_method="scatter")
+def scatter(x, index, updates, overwrite=True, name=None):
+    idx = index.reshape(-1)
+    if overwrite:
+        return x.at[idx].set(updates)
+    # paddle: non-overwrite first zeroes the destination rows then accumulates
+    zeroed = x.at[idx].set(jnp.zeros_like(updates))
+    return zeroed.at[idx].add(updates)
+
+
+@defop(tensor_method="scatter_nd_add")
+def scatter_nd_add(x, index, updates, name=None):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x.at[idx].add(updates)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    zero = apply_op(
+        lambda u: jnp.zeros(tuple(int(s) for s in shape), dtype=u.dtype),
+        "zeros", (updates,), {})
+    return scatter_nd_add(zero, index, updates)
+
+
+@defop(tensor_method="take_along_axis")
+def take_along_axis(x, indices, axis, name=None):
+    return jnp.take_along_axis(x, indices, axis=int(axis))
+
+
+@defop(tensor_method="put_along_axis")
+def put_along_axis(x, indices, values, axis, reduce="assign", name=None):  # noqa: A002
+    values = jnp.broadcast_to(values, indices.shape) if jnp.ndim(values) else values
+    if reduce == "assign":
+        return jnp.put_along_axis(x, indices, values, axis=int(axis), inplace=False)
+    idx = [jnp.arange(s).reshape([-1 if i == d else 1 for i in range(x.ndim)])
+           for d, s in enumerate(indices.shape)]
+    idx[int(axis) % x.ndim] = indices
+    if reduce in ("add", "sum"):
+        return x.at[tuple(idx)].add(values)
+    if reduce in ("mul", "multiply"):
+        return x.at[tuple(idx)].multiply(values)
+    raise ValueError(f"unsupported reduce {reduce}")
+
+
+@defop(tensor_method="masked_select")
+def masked_select(x, mask, name=None):
+    # dynamic-shape: eager only (like the reference's CPU/GPU kernel; cannot jit)
+    return x[mask]
+
+
+@defop(tensor_method="masked_fill")
+def masked_fill(x, mask, value, name=None):
+    return jnp.where(mask, value, x)
+
+
+@defop(tensor_method="index_sample")
+def index_sample(x, index, name=None):
+    return jnp.take_along_axis(x, index, axis=1)
+
+
+@defop(tensor_method="index_add")
+def index_add(x, index, axis, value, name=None):
+    sl = [slice(None)] * x.ndim
+    sl[int(axis) % x.ndim] = index
+    return x.at[tuple(sl)].add(value)
+
+
+@defop(tensor_method="index_put")
+def index_put(x, indices, value, accumulate=False, name=None):
+    idx = tuple(indices)
+    return x.at[idx].add(value) if accumulate else x.at[idx].set(value)
+
+
+@defop(tensor_method="repeat_interleave")
+def repeat_interleave(x, repeats, axis=None, name=None):
+    return jnp.repeat(x, repeats, axis=axis if axis is None else int(axis))
+
+
+_py_slice = slice  # saved before the paddle-named `slice` op shadows the builtin
+
+
+def slice(x, axes, starts, ends, name=None):  # noqa: A001
+    axes, starts, ends = _ints(axes), _ints(starts), _ints(ends)
+
+    def impl(v):
+        idx = [_py_slice(None)] * v.ndim
+        for a, s, e in zip(axes, starts, ends):
+            dim = v.shape[a]
+            s2 = s + dim if s < 0 else _builtin_min(s, dim)
+            e2 = e + dim if e < 0 else _builtin_min(e, dim)
+            idx[a] = _py_slice(s2, e2)
+        return v[tuple(idx)]
+    return apply_op(impl, "slice", (x,), {})
+
+
+_builtin_min = min
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    axes, starts, ends, strides = _ints(axes), _ints(starts), _ints(ends), _ints(strides)
+
+    def impl(v):
+        idx = [_py_slice(None)] * v.ndim
+        for a, s, e, st in zip(axes, starts, ends, strides):
+            idx[a] = _py_slice(s, e, st)
+        return v[tuple(idx)]
+    return apply_op(impl, "strided_slice", (x,), {})
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    shape = _ints(shape)
+    offsets = _ints(offsets) if offsets is not None else (0,) * len(shape)
+    sizes = tuple(s if s != -1 else x.shape[i] - offsets[i]
+                  for i, s in enumerate(shape))
+    return apply_op(lambda v: jax.lax.dynamic_slice(v, offsets, sizes), "crop",
+                    (x,), {})
+
+
+@defop(tensor_method="unfold")
+def unfold(x, axis, size, step, name=None):
+    starts = np.arange(0, x.shape[int(axis)] - size + 1, step)
+    return jnp.stack([jax.lax.dynamic_slice_in_dim(x, int(s), size, int(axis))
+                      for s in starts], axis=int(axis))
+
+
+@defop
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):  # noqa: A002
+    pad = _ints(pad)
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        widths = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # paddle F.pad convention: pad covers the last len(pad)//2 spatial dims
+        # in innermost-first order ([W_lo, W_hi, H_lo, H_hi, ...])
+        k = len(pad) // 2
+        widths = [(0, 0)] * nd
+        if data_format.endswith("C"):  # NHWC/NLC/NDHWC: spatial dims precede C
+            spatial = list(range(1, 1 + k))
+        else:
+            spatial = list(range(nd - k, nd))
+        for i, d in enumerate(reversed(spatial)):
+            widths[d] = (pad[2 * i], pad[2 * i + 1])
+    jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge",
+             "circular": "wrap"}[mode]
+    if jmode == "constant":
+        return jnp.pad(x, widths, mode="constant", constant_values=value)
+    return jnp.pad(x, widths, mode=jmode)
+
+
+def tensordot(x, y, axes=2, name=None):
+    return apply_op(lambda a, b: jnp.tensordot(a, b, axes=axes), "tensordot",
+                    (x, y), {})

@@ -1,0 +1,505 @@
+"""Elementwise/scalar math ops + Tensor operator overloads.
+
+Reference surface: python/paddle/tensor/math.py (wrapping phi elementwise/activation
+kernels).  Every op is a `defop` so eager autograd and jit tracing share one body.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.op import defop, apply_op, register_tensor_method
+from ..core.tensor import Tensor
+
+
+def _unwrap_scalar(v):
+    return v._value if isinstance(v, Tensor) else v
+
+
+# --- binary arithmetic --------------------------------------------------------
+
+@defop(tensor_method="add")
+def add(x, y, name=None):
+    return jnp.add(x, y)
+
+
+@defop(tensor_method="subtract")
+def subtract(x, y, name=None):
+    return jnp.subtract(x, y)
+
+
+@defop(tensor_method="multiply")
+def multiply(x, y, name=None):
+    return jnp.multiply(x, y)
+
+
+@defop(tensor_method="divide")
+def divide(x, y, name=None):
+    return jnp.true_divide(x, y)
+
+
+@defop(tensor_method="floor_divide")
+def floor_divide(x, y, name=None):
+    return jnp.floor_divide(x, y)
+
+
+@defop(tensor_method=["mod", "remainder"])
+def remainder(x, y, name=None):
+    return jnp.remainder(x, y)
+
+
+@defop(tensor_method="pow")
+def pow(x, y, name=None):  # noqa: A001
+    return jnp.power(x, y)
+
+
+@defop(tensor_method="maximum")
+def maximum(x, y, name=None):
+    return jnp.maximum(x, y)
+
+
+@defop(tensor_method="minimum")
+def minimum(x, y, name=None):
+    return jnp.minimum(x, y)
+
+
+@defop(tensor_method="fmax")
+def fmax(x, y, name=None):
+    return jnp.fmax(x, y)
+
+
+@defop(tensor_method="fmin")
+def fmin(x, y, name=None):
+    return jnp.fmin(x, y)
+
+
+@defop
+def atan2(x, y, name=None):
+    return jnp.arctan2(x, y)
+
+
+@defop
+def logaddexp(x, y, name=None):
+    return jnp.logaddexp(x, y)
+
+
+@defop(tensor_method="lerp")
+def lerp(x, y, weight, name=None):
+    return x + weight * (y - x)
+
+
+@defop(tensor_method="kron")
+def kron(x, y, name=None):
+    return jnp.kron(x, y)
+
+
+@defop(tensor_method="inner")
+def inner(x, y, name=None):
+    return jnp.inner(x, y)
+
+
+@defop(tensor_method="outer")
+def outer(x, y, name=None):
+    return jnp.outer(jnp.ravel(x), jnp.ravel(y))
+
+
+# --- unary --------------------------------------------------------------------
+
+@defop(tensor_method="abs")
+def abs(x, name=None):  # noqa: A001
+    return jnp.abs(x)
+
+
+@defop(tensor_method="neg")
+def neg(x, name=None):
+    return jnp.negative(x)
+
+
+@defop(tensor_method="exp")
+def exp(x, name=None):
+    return jnp.exp(x)
+
+
+@defop(tensor_method="expm1")
+def expm1(x, name=None):
+    return jnp.expm1(x)
+
+
+@defop(tensor_method="log")
+def log(x, name=None):
+    return jnp.log(x)
+
+
+@defop(tensor_method="log2")
+def log2(x, name=None):
+    return jnp.log2(x)
+
+
+@defop(tensor_method="log10")
+def log10(x, name=None):
+    return jnp.log10(x)
+
+
+@defop(tensor_method="log1p")
+def log1p(x, name=None):
+    return jnp.log1p(x)
+
+
+@defop(tensor_method="sqrt")
+def sqrt(x, name=None):
+    return jnp.sqrt(x)
+
+
+@defop(tensor_method="rsqrt")
+def rsqrt(x, name=None):
+    return jax.lax.rsqrt(x)
+
+
+@defop(tensor_method="square")
+def square(x, name=None):
+    return jnp.square(x)
+
+
+@defop(tensor_method="sin")
+def sin(x, name=None):
+    return jnp.sin(x)
+
+
+@defop(tensor_method="cos")
+def cos(x, name=None):
+    return jnp.cos(x)
+
+
+@defop(tensor_method="tan")
+def tan(x, name=None):
+    return jnp.tan(x)
+
+
+@defop(tensor_method="asin")
+def asin(x, name=None):
+    return jnp.arcsin(x)
+
+
+@defop(tensor_method="acos")
+def acos(x, name=None):
+    return jnp.arccos(x)
+
+
+@defop(tensor_method="atan")
+def atan(x, name=None):
+    return jnp.arctan(x)
+
+
+@defop(tensor_method="sinh")
+def sinh(x, name=None):
+    return jnp.sinh(x)
+
+
+@defop(tensor_method="cosh")
+def cosh(x, name=None):
+    return jnp.cosh(x)
+
+
+@defop(tensor_method="tanh")
+def tanh(x, name=None):
+    return jnp.tanh(x)
+
+
+@defop(tensor_method="asinh")
+def asinh(x, name=None):
+    return jnp.arcsinh(x)
+
+
+@defop(tensor_method="acosh")
+def acosh(x, name=None):
+    return jnp.arccosh(x)
+
+
+@defop(tensor_method="atanh")
+def atanh(x, name=None):
+    return jnp.arctanh(x)
+
+
+@defop(tensor_method="floor")
+def floor(x, name=None):
+    return jnp.floor(x)
+
+
+@defop(tensor_method="ceil")
+def ceil(x, name=None):
+    return jnp.ceil(x)
+
+
+@defop(tensor_method="round")
+def round(x, name=None):  # noqa: A001
+    return jnp.round(x)
+
+
+@defop(tensor_method="trunc")
+def trunc(x, name=None):
+    return jnp.trunc(x)
+
+
+@defop(tensor_method="frac")
+def frac(x, name=None):
+    return x - jnp.trunc(x)
+
+
+@defop(tensor_method="sign")
+def sign(x, name=None):
+    return jnp.sign(x)
+
+
+@defop(tensor_method="sgn")
+def sgn(x, name=None):
+    return jnp.sign(x)
+
+
+@defop(tensor_method="reciprocal")
+def reciprocal(x, name=None):
+    return jnp.reciprocal(x)
+
+
+@defop(tensor_method="erf")
+def erf(x, name=None):
+    return jax.scipy.special.erf(x)
+
+
+@defop(tensor_method="erfinv")
+def erfinv(x, name=None):
+    return jax.scipy.special.erfinv(x)
+
+
+@defop(tensor_method="lgamma")
+def lgamma(x, name=None):
+    return jax.scipy.special.gammaln(x)
+
+
+@defop(tensor_method="digamma")
+def digamma(x, name=None):
+    return jax.scipy.special.digamma(x)
+
+
+@defop(tensor_method="deg2rad")
+def deg2rad(x, name=None):
+    return jnp.deg2rad(x)
+
+
+@defop(tensor_method="rad2deg")
+def rad2deg(x, name=None):
+    return jnp.rad2deg(x)
+
+
+@defop(tensor_method="angle")
+def angle(x, name=None):
+    return jnp.angle(x)
+
+
+@defop(tensor_method="conj")
+def conj(x, name=None):
+    return jnp.conj(x)
+
+
+@defop(tensor_method="real")
+def real(x, name=None):
+    return jnp.real(x)
+
+
+@defop(tensor_method="imag")
+def imag(x, name=None):
+    return jnp.imag(x)
+
+
+@defop(tensor_method="isnan")
+def isnan(x, name=None):
+    return jnp.isnan(x)
+
+
+@defop(tensor_method="isinf")
+def isinf(x, name=None):
+    return jnp.isinf(x)
+
+
+@defop(tensor_method="isfinite")
+def isfinite(x, name=None):
+    return jnp.isfinite(x)
+
+
+@defop(tensor_method="nan_to_num")
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+@defop(tensor_method="stanh")
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+# --- scaling / clipping / fused-ish -------------------------------------------
+
+@defop(tensor_method="scale")
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    out = x * scale + bias if bias_after_scale else (x + bias) * scale
+    if act == "relu":
+        out = jnp.maximum(out, 0)
+    elif act == "tanh":
+        out = jnp.tanh(out)
+    return out
+
+
+@defop(tensor_method="clip")
+def clip(x, min=None, max=None, name=None):  # noqa: A002
+    return jnp.clip(x, _unwrap_scalar(min), _unwrap_scalar(max))
+
+
+@defop(tensor_method="increment")
+def increment(x, value=1.0, name=None):
+    return x + value
+
+
+def add_n(inputs, name=None):
+    if isinstance(inputs, Tensor):
+        return inputs
+    return apply_op(lambda *xs: sum(xs[1:], xs[0]), "add_n", tuple(inputs), {})
+
+
+@defop(tensor_method="multiplex")
+def multiplex(inputs, index, name=None):
+    stacked = jnp.stack(inputs, axis=0)
+    idx = index.reshape(-1)
+    return stacked[idx, jnp.arange(stacked.shape[1])]
+
+
+# --- cumulative ---------------------------------------------------------------
+
+@defop(tensor_method="cumsum")
+def cumsum(x, axis=None, dtype=None, name=None):
+    if axis is None:
+        x = jnp.ravel(x)
+        axis = 0
+    return jnp.cumsum(x, axis=axis, dtype=dtype)
+
+
+@defop(tensor_method="cumprod")
+def cumprod(x, dim=None, dtype=None, name=None):
+    return jnp.cumprod(x, axis=dim, dtype=dtype)
+
+
+def _cum_extreme(x, axis, combine):
+    vals = jax.lax.associative_scan(combine, x, axis=axis)
+    iota = jax.lax.broadcasted_iota(jnp.int64, x.shape, axis)
+    # index of the (last) position achieving the running extreme
+    cand = jnp.where(x == vals, iota, -1)
+    idx = jax.lax.associative_scan(jnp.maximum, cand, axis=axis)
+    return vals, idx
+
+
+@defop(tensor_method="cummax")
+def cummax(x, axis=None, dtype="int64", name=None):
+    if axis is None:
+        x = jnp.ravel(x)
+        axis = 0
+    vals, idx = _cum_extreme(x, axis, jnp.maximum)
+    return vals, idx.astype(jnp.dtype(dtype) if dtype else jnp.int64)
+
+
+@defop(tensor_method="cummin")
+def cummin(x, axis=None, dtype="int64", name=None):
+    if axis is None:
+        x = jnp.ravel(x)
+        axis = 0
+    vals, idx = _cum_extreme(x, axis, jnp.minimum)
+    return vals, idx.astype(jnp.dtype(dtype) if dtype else jnp.int64)
+
+
+@defop
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    if axis is None:
+        x = jnp.ravel(x)
+        axis = 0
+    return jax.lax.associative_scan(jnp.logaddexp, x, axis=axis)
+
+
+@defop(tensor_method="trace")
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+# --- in-place variants --------------------------------------------------------
+
+def _make_inplace(op, method_name):
+    def inplace(self, *args, **kwargs):
+        out = op(self._snapshot(), *args, **kwargs)
+        self._replace_(out._value, out._grad_node, out._grad_slot)
+        self.stop_gradient = self.stop_gradient and out.stop_gradient
+        return self
+    inplace.__name__ = method_name
+    setattr(Tensor, method_name, inplace)
+    return inplace
+
+
+add_ = _make_inplace(add, "add_")
+subtract_ = _make_inplace(subtract, "subtract_")
+multiply_ = _make_inplace(multiply, "multiply_")
+scale_ = _make_inplace(scale, "scale_")
+clip_ = _make_inplace(clip, "clip_")
+exp_ = _make_inplace(exp, "exp_")
+sqrt_ = _make_inplace(sqrt, "sqrt_")
+rsqrt_ = _make_inplace(rsqrt, "rsqrt_")
+floor_ = _make_inplace(floor, "floor_")
+ceil_ = _make_inplace(ceil, "ceil_")
+round_ = _make_inplace(round, "round_")
+reciprocal_ = _make_inplace(reciprocal, "reciprocal_")
+tanh_ = _make_inplace(tanh, "tanh_")
+remainder_ = _make_inplace(remainder, "remainder_")
+
+
+@register_tensor_method("zero_")
+def zero_(self):
+    self._replace_(jnp.zeros_like(self._value), None)
+    return self
+
+
+@register_tensor_method("fill_")
+def fill_(self, value):
+    self._replace_(jnp.full_like(self._value, _unwrap_scalar(value)), None)
+    return self
+
+
+# --- operator overloads -------------------------------------------------------
+
+def _binop(op):
+    def method(self, other):
+        if isinstance(other, (list, tuple, np.ndarray)):
+            other = Tensor(np.asarray(other))
+        return op(self, other)
+    return method
+
+
+def _rbinop(op):
+    def method(self, other):
+        if not isinstance(other, Tensor):
+            other_t = other  # scalar stays scalar: jnp broadcasting handles it
+            return apply_op(lambda a: op.raw(other_t, a), op.op_name, (self,), {})
+        return op(other, self)
+    return method
+
+
+Tensor.__add__ = _binop(add)
+Tensor.__radd__ = _rbinop(add)
+Tensor.__sub__ = _binop(subtract)
+Tensor.__rsub__ = _rbinop(subtract)
+Tensor.__mul__ = _binop(multiply)
+Tensor.__rmul__ = _rbinop(multiply)
+Tensor.__truediv__ = _binop(divide)
+Tensor.__rtruediv__ = _rbinop(divide)
+Tensor.__floordiv__ = _binop(floor_divide)
+Tensor.__rfloordiv__ = _rbinop(floor_divide)
+Tensor.__mod__ = _binop(remainder)
+Tensor.__rmod__ = _rbinop(remainder)
+Tensor.__pow__ = _binop(pow)
+Tensor.__rpow__ = _rbinop(pow)
+Tensor.__neg__ = lambda self: neg(self)
+Tensor.__abs__ = lambda self: abs(self)
+Tensor.__pos__ = lambda self: self

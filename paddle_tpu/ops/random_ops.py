@@ -1,0 +1,132 @@
+"""Random sampling ops (reference: python/paddle/tensor/random.py).
+
+All draws go through core.random.next_key() so they are reproducible under
+``paddle.seed`` eagerly AND trace-safe inside jit (where a traced key is
+installed via core.random.push_key)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import random as rnd
+from ..core.dtype import get_default_dtype, to_jax
+from ..core.op import apply_op
+from ..core.tensor import Tensor
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        import numpy as np
+        return tuple(int(s) for s in np.asarray(shape._value).reshape(-1))
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(int(s._value) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def _dt(dtype):
+    return to_jax(dtype) if dtype is not None else to_jax(get_default_dtype())
+
+
+def rand(shape, dtype=None, name=None) -> Tensor:
+    return Tensor(jax.random.uniform(rnd.next_key(), _shape(shape), _dt(dtype)),
+                  _internal=True)
+
+
+def randn(shape, dtype=None, name=None) -> Tensor:
+    return Tensor(jax.random.normal(rnd.next_key(), _shape(shape), _dt(dtype)),
+                  _internal=True)
+
+
+def standard_normal(shape, dtype=None, name=None) -> Tensor:
+    return randn(shape, dtype)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None) -> Tensor:
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        shp = jnp.broadcast_shapes(
+            tuple(mean.shape) if isinstance(mean, Tensor) else (),
+            tuple(std.shape) if isinstance(std, Tensor) else ())
+        return apply_op(
+            lambda m, s: m + s * jax.random.normal(rnd.next_key(), shp,
+                                                   _dt(None)),
+            "gaussian", (mean if isinstance(mean, Tensor) else Tensor(mean),
+                         std if isinstance(std, Tensor) else Tensor(std)), {})
+    shp = _shape(shape) if shape is not None else ()
+    return Tensor(mean + std * jax.random.normal(rnd.next_key(), shp, _dt(None)),
+                  _internal=True)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None) -> Tensor:  # noqa: A002
+    key = jax.random.key(seed) if seed else rnd.next_key()
+    return Tensor(jax.random.uniform(key, _shape(shape), _dt(dtype),
+                                     minval=float(min), maxval=float(max)),
+                  _internal=True)
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None) -> Tensor:
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(rnd.next_key(), _shape(shape), int(low),
+                                     int(high), dtype=to_jax(dtype)), _internal=True)
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None) -> Tensor:
+    dtype = dtype or x.dtype
+    return randint(low, high, tuple(x.shape), dtype)
+
+
+def randperm(n, dtype="int64", name=None) -> Tensor:
+    return Tensor(jax.random.permutation(rnd.next_key(), int(n)).astype(to_jax(dtype)),
+                  _internal=True)
+
+
+def bernoulli(x, name=None) -> Tensor:
+    return apply_op(
+        lambda p: jax.random.bernoulli(rnd.next_key(), p).astype(p.dtype),
+        "bernoulli", (x,), {})
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None) -> Tensor:
+    def impl(p):
+        orig = p.shape
+        p2 = p.reshape((-1, orig[-1]))
+        p2 = p2 / jnp.sum(p2, axis=-1, keepdims=True)
+        keys = jax.random.split(rnd.next_key(), p2.shape[0])
+
+        def one(k, pi):
+            return jax.random.choice(k, orig[-1], shape=(int(num_samples),),
+                                     replace=bool(replacement), p=pi)
+        out = jax.vmap(one)(keys, p2)
+        return out.reshape(orig[:-1] + (int(num_samples),)).astype(jnp.int64)
+    return apply_op(impl, "multinomial", (x,), {})
+
+
+def poisson(x, name=None) -> Tensor:
+    return apply_op(lambda lam: jax.random.poisson(rnd.next_key(), lam).astype(lam.dtype),
+                    "poisson", (x,), {})
+
+
+def exponential_(x, lam=1.0, name=None) -> Tensor:
+    val = jax.random.exponential(rnd.next_key(), tuple(x.shape)) / lam
+    x._replace_(val.astype(x._value.dtype), None)
+    return x
+
+
+def uniform_(x, min=-1.0, max=1.0, name=None) -> Tensor:  # noqa: A002
+    val = jax.random.uniform(rnd.next_key(), tuple(x.shape), x._value.dtype,
+                             float(min), float(max))
+    x._replace_(val, None)
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0, name=None) -> Tensor:
+    val = mean + std * jax.random.normal(rnd.next_key(), tuple(x.shape))
+    x._replace_(val.astype(x._value.dtype), None)
+    return x
+
+
+Tensor.uniform_ = uniform_
+Tensor.normal_ = normal_
+Tensor.exponential_ = exponential_
+Tensor.bernoulli = bernoulli
+Tensor.multinomial = multinomial

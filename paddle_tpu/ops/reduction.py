@@ -1,0 +1,120 @@
+"""Reduction ops (reference: python/paddle/tensor/math.py + stat.py reduce family)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.op import defop
+from ..core.tensor import Tensor
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        import numpy as np
+        a = np.asarray(axis._value)
+        return tuple(int(v) for v in a.reshape(-1))
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+@defop(tensor_method="sum")
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):  # noqa: A001
+    out = jnp.sum(x, axis=_axis(axis), keepdims=keepdim)
+    if dtype is not None:
+        from ..core.dtype import to_jax
+        out = out.astype(to_jax(dtype))
+    elif jnp.issubdtype(x.dtype, jnp.bool_):
+        out = out.astype(jnp.int64)
+    return out
+
+
+@defop(tensor_method="mean")
+def mean(x, axis=None, keepdim=False, name=None):
+    return jnp.mean(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@defop(tensor_method="max")
+def max(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return jnp.max(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@defop(tensor_method="min")
+def min(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return jnp.min(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@defop(tensor_method="amax")
+def amax(x, axis=None, keepdim=False, name=None):
+    return jnp.max(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@defop(tensor_method="amin")
+def amin(x, axis=None, keepdim=False, name=None):
+    return jnp.min(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@defop(tensor_method="prod")
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    from ..core.dtype import to_jax
+    return jnp.prod(x, axis=_axis(axis), keepdims=keepdim,
+                    dtype=to_jax(dtype) if dtype else None)
+
+
+@defop(tensor_method="logsumexp")
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return jax.scipy.special.logsumexp(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@defop(tensor_method="all")
+def all(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return jnp.all(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@defop(tensor_method="any")
+def any(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return jnp.any(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@defop(tensor_method="std")
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return jnp.std(x, axis=_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+@defop(tensor_method="var")
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return jnp.var(x, axis=_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+@defop(tensor_method="median")
+def median(x, axis=None, keepdim=False, name=None):
+    return jnp.median(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@defop(tensor_method="nanmedian")
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return jnp.nanmedian(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@defop(tensor_method="nansum")
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    from ..core.dtype import to_jax
+    return jnp.nansum(x, axis=_axis(axis), keepdims=keepdim,
+                      dtype=to_jax(dtype) if dtype else None)
+
+
+@defop(tensor_method="nanmean")
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return jnp.nanmean(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@defop(tensor_method="count_nonzero")
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return jnp.sum((x != 0).astype(jnp.int64), axis=_axis(axis), keepdims=keepdim)
+
+
+@defop(tensor_method="quantile")
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    return jnp.quantile(x, jnp.asarray(q), axis=_axis(axis), keepdims=keepdim)

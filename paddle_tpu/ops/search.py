@@ -1,0 +1,127 @@
+"""Search/sort ops (reference: python/paddle/tensor/search.py)."""
+from __future__ import annotations
+
+import jax.lax as lax
+import jax.numpy as jnp
+
+from ..core.op import defop
+from ..core.tensor import Tensor
+
+
+@defop(tensor_method="argmax")
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    out = jnp.argmax(x, axis=None if axis is None else int(axis), keepdims=keepdim)
+    return out.astype(jnp.dtype(dtype))
+
+
+@defop(tensor_method="argmin")
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    out = jnp.argmin(x, axis=None if axis is None else int(axis), keepdims=keepdim)
+    return out.astype(jnp.dtype(dtype))
+
+
+@defop(tensor_method="argsort")
+def argsort(x, axis=-1, descending=False, name=None):
+    out = jnp.argsort(-x if descending else x, axis=int(axis))
+    return out.astype(jnp.int64)
+
+
+@defop(tensor_method="sort")
+def sort(x, axis=-1, descending=False, name=None):
+    out = jnp.sort(x, axis=int(axis))
+    return jnp.flip(out, axis=int(axis)) if descending else out
+
+
+@defop(tensor_method="topk")
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):  # noqa: A002
+    if isinstance(k, Tensor):
+        k = int(k.item())
+    if axis is None:
+        axis = -1
+    axis = int(axis) % x.ndim
+    xs = jnp.moveaxis(x, axis, -1)
+    if largest:
+        vals, idx = lax.top_k(xs, int(k))
+    else:
+        vals, idx = lax.top_k(-xs, int(k))
+        vals = -vals
+    return jnp.moveaxis(vals, -1, axis), jnp.moveaxis(idx, -1, axis).astype(jnp.int64)
+
+
+@defop(tensor_method="kthvalue")
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    axis = int(axis) % x.ndim
+    vals = jnp.sort(x, axis=axis)
+    idx = jnp.argsort(x, axis=axis)
+    take = jnp.take(vals, int(k) - 1, axis=axis)
+    take_i = jnp.take(idx, int(k) - 1, axis=axis).astype(jnp.int64)
+    if keepdim:
+        take = jnp.expand_dims(take, axis)
+        take_i = jnp.expand_dims(take_i, axis)
+    return take, take_i
+
+
+@defop(tensor_method="mode")
+def mode(x, axis=-1, keepdim=False, name=None):
+    axis = int(axis) % x.ndim
+    xm = jnp.moveaxis(x, axis, -1)
+    # O(n^2) one-vs-all count; fine for the sizes this op sees
+    counts = jnp.sum(xm[..., :, None] == xm[..., None, :], axis=-1)
+    # break count ties toward the larger value, like the reference kernel
+    order = jnp.lexsort((xm, counts), axis=-1)
+    best = jnp.take_along_axis(order, jnp.full(order.shape[:-1] + (1,),
+                                               xm.shape[-1] - 1), axis=-1)
+    vals = jnp.take_along_axis(xm, best, axis=-1)
+    idx = jnp.argmax(xm == vals, axis=-1, keepdims=True)
+    vals = jnp.moveaxis(vals, -1, axis)
+    idx = jnp.moveaxis(idx, -1, axis)
+    if not keepdim:
+        vals, idx = jnp.squeeze(vals, axis), jnp.squeeze(idx, axis)
+    return vals, idx.astype(jnp.int64)
+
+
+@defop(tensor_method="nonzero")
+def nonzero(x, as_tuple=False, name=None):
+    # dynamic output shape — eager only, like masked_select
+    idx = jnp.nonzero(x)
+    if as_tuple:
+        return tuple(i.astype(jnp.int64).reshape(-1, 1) for i in idx)
+    return jnp.stack(idx, axis=1).astype(jnp.int64)
+
+
+@defop(tensor_method="searchsorted")
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    out = jnp.searchsorted(sorted_sequence, values,
+                           side="right" if right else "left")
+    return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+
+@defop(tensor_method="unique")
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    # dynamic output shape — eager only
+    res = jnp.unique(x, return_index=return_index, return_inverse=return_inverse,
+                     return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return res
+    return tuple(r.astype(jnp.int64) if i > 0 else r for i, r in enumerate(res))
+
+
+@defop(tensor_method="unique_consecutive")
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    import numpy as np
+    arr = np.asarray(x)
+    if axis is None:
+        arr = arr.reshape(-1)
+        keep = np.concatenate([[True], arr[1:] != arr[:-1]])
+    else:
+        raise NotImplementedError("unique_consecutive with axis")
+    out = arr[keep]
+    outs = [jnp.asarray(out)]
+    if return_inverse:
+        outs.append(jnp.asarray(np.cumsum(keep) - 1))
+    if return_counts:
+        idx = np.nonzero(keep)[0]
+        outs.append(jnp.asarray(np.diff(np.append(idx, arr.size))))
+    return outs[0] if len(outs) == 1 else tuple(outs)

@@ -1,0 +1,6 @@
+"""paddle.optimizer parity surface."""
+from . import lr  # noqa: F401
+from .optimizer import (  # noqa: F401
+    Optimizer, SGD, Momentum, Adam, AdamW, Adagrad, RMSProp, Adadelta, Adamax,
+    Lamb, LarsMomentum, L1Decay, L2Decay,
+)

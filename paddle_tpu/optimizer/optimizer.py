@@ -1,0 +1,387 @@
+"""Optimizers (reference: python/paddle/optimizer/optimizer.py + phi optimizer
+kernels sgd/momentum/adam/adamw/lamb).
+
+Each optimizer defines a pure functional core:
+    init_slots(param_value)                  -> dict[str, array]
+    update(p, g, slots, lr, t, ctx)          -> (new_p, new_slots)
+Eager ``step()`` applies it per-parameter; the jitted train step
+(paddle_tpu.hapi / parallel trainers) applies the same core inside one XLA
+program so param updates fuse with the backward pass.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.autograd import no_grad
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer, Parameter
+from .lr import LRScheduler
+
+
+class L2Decay:
+    """paddle.regularizer.L2Decay."""
+
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        self._lr = learning_rate
+        self._parameters = self._collect(parameters)
+        self._weight_decay = weight_decay
+        self._grad_clip = grad_clip
+        self._slots: dict[int, dict[str, jnp.ndarray]] = {}
+        self._step_count = 0
+        self.helper = None
+
+    @staticmethod
+    def _collect(parameters):
+        if parameters is None:
+            return []
+        if isinstance(parameters, Layer):
+            return parameters.parameters()
+        params = []
+        for item in parameters:
+            if isinstance(item, dict):
+                params.extend(item["params"])
+            else:
+                params.append(item)
+        return params
+
+    # -- lr -----------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._lr, LRScheduler):
+            return float(self._lr())
+        return float(self._lr)
+
+    def set_lr(self, value: float):
+        if isinstance(self._lr, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._lr = float(value)
+
+    def set_lr_scheduler(self, scheduler: LRScheduler):
+        self._lr = scheduler
+
+    # -- functional core (override per optimizer) ---------------------------
+    def init_slots(self, p_value) -> dict:
+        return {}
+
+    def update(self, p, g, slots, lr, t, ctx) -> tuple:
+        raise NotImplementedError
+
+    def _decay_coeff(self, param) -> float:
+        wd = self._weight_decay
+        reg = getattr(param, "regularizer", None) if param is not None else None
+        if reg is not None:
+            wd = reg
+        if wd is None:
+            return 0.0
+        if isinstance(wd, (int, float)):
+            return float(wd)
+        if isinstance(wd, (L2Decay,)):
+            return wd.coeff
+        return 0.0
+
+    # -- eager step ---------------------------------------------------------
+    @no_grad()
+    def step(self):
+        self._step_count += 1
+        lr = self.get_lr()
+        params_grads = [(p, p.grad) for p in self._parameters
+                        if not p.stop_gradient and p.grad is not None]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        t = self._step_count
+        for p, g in params_grads:
+            slots = self._slots.get(id(p))
+            if slots is None:
+                slots = self.init_slots(p._value)
+                self._slots[id(p)] = slots
+            plr = lr * p.optimize_attr.get("learning_rate", 1.0) \
+                if isinstance(p, Parameter) else lr
+            ctx = {"decay": self._decay_coeff(p)}
+            new_p, new_slots = self.update(p._value, g._value.astype(p._value.dtype),
+                                           slots, plr, t, ctx)
+            p._replace_(new_p, None)
+            self._slots[id(p)] = new_slots
+
+    def clear_grad(self, set_to_zero=True):
+        for p in self._parameters:
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return None, None
+
+    # -- state --------------------------------------------------------------
+    def state_dict(self) -> dict:
+        sd = {"LR_Scheduler": (self._lr.state_dict()
+                               if isinstance(self._lr, LRScheduler) else {}),
+              "master_weights": {}, "step_count": self._step_count}
+        for i, p in enumerate(self._parameters):
+            slots = self._slots.get(id(p))
+            if slots:
+                for k, v in slots.items():
+                    sd[f"{p.name}_{k}"] = Tensor(v, _internal=True)
+        return sd
+
+    def set_state_dict(self, state_dict):
+        self._step_count = int(state_dict.get("step_count", 0))
+        if isinstance(self._lr, LRScheduler) and state_dict.get("LR_Scheduler"):
+            self._lr.set_state_dict(state_dict["LR_Scheduler"])
+        for p in self._parameters:
+            slots = self.init_slots(p._value)
+            found = False
+            for k in list(slots):
+                key = f"{p.name}_{k}"
+                if key in state_dict:
+                    v = state_dict[key]
+                    slots[k] = v._value if isinstance(v, Tensor) else jnp.asarray(v)
+                    found = True
+            if found:
+                self._slots[id(p)] = slots
+
+    def _parameter_list(self):
+        return self._parameters
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+
+    def update(self, p, g, slots, lr, t, ctx):
+        if ctx["decay"]:
+            g = g + ctx["decay"] * p
+        return p - lr * g, slots
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def init_slots(self, p_value):
+        return {"velocity": jnp.zeros_like(p_value)}
+
+    def update(self, p, g, slots, lr, t, ctx):
+        if ctx["decay"]:
+            g = g + ctx["decay"] * p
+        v = self._momentum * slots["velocity"] + g
+        if self._nesterov:
+            new_p = p - lr * (g + self._momentum * v)
+        else:
+            new_p = p - lr * v
+        return new_p, {"velocity": v}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._eps = epsilon
+
+    def init_slots(self, p_value):
+        return {"moment1": jnp.zeros_like(p_value),
+                "moment2": jnp.zeros_like(p_value)}
+
+    def update(self, p, g, slots, lr, t, ctx):
+        if ctx["decay"]:
+            g = g + ctx["decay"] * p  # L2 reg folded into grad (Adam, not AdamW)
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * slots["moment1"] + (1 - b1) * g
+        v = b2 * slots["moment2"] + (1 - b2) * jnp.square(g)
+        mhat = m / (1 - b1 ** t)
+        vhat = v / (1 - b2 ** t)
+        new_p = p - lr * mhat / (jnp.sqrt(vhat) + self._eps)
+        return new_p, {"moment1": m, "moment2": v}
+
+
+class AdamW(Adam):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=0.01, lr_ratio=None,
+                 apply_decay_param_fun=None, grad_clip=None,
+                 multi_precision=False, name=None, **kw):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip)
+        self._wd = float(weight_decay) if not isinstance(weight_decay, (L1Decay, L2Decay)) \
+            else weight_decay.coeff
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _decay_coeff(self, param):
+        if self._apply_decay_param_fun is not None and param is not None \
+                and not self._apply_decay_param_fun(param.name):
+            return 0.0
+        return self._wd
+
+    def update(self, p, g, slots, lr, t, ctx):
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * slots["moment1"] + (1 - b1) * g
+        v = b2 * slots["moment2"] + (1 - b2) * jnp.square(g)
+        mhat = m / (1 - b1 ** t)
+        vhat = v / (1 - b2 ** t)
+        # decoupled weight decay (reference adamw kernel: p *= (1 - lr*coeff))
+        p = p * (1.0 - lr * ctx["decay"])
+        new_p = p - lr * mhat / (jnp.sqrt(vhat) + self._eps)
+        return new_p, {"moment1": m, "moment2": v}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._eps = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def init_slots(self, p_value):
+        return {"moment": jnp.full_like(p_value, self._init_acc)}
+
+    def update(self, p, g, slots, lr, t, ctx):
+        if ctx["decay"]:
+            g = g + ctx["decay"] * p
+        acc = slots["moment"] + jnp.square(g)
+        return p - lr * g / (jnp.sqrt(acc) + self._eps), {"moment": acc}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._rho = rho
+        self._eps = epsilon
+        self._momentum = momentum
+        self._centered = centered
+
+    def init_slots(self, p_value):
+        return {"mean_square": jnp.zeros_like(p_value),
+                "mean_grad": jnp.zeros_like(p_value),
+                "velocity": jnp.zeros_like(p_value)}
+
+    def update(self, p, g, slots, lr, t, ctx):
+        if ctx["decay"]:
+            g = g + ctx["decay"] * p
+        ms = self._rho * slots["mean_square"] + (1 - self._rho) * jnp.square(g)
+        if self._centered:
+            mg = self._rho * slots["mean_grad"] + (1 - self._rho) * g
+            denom = jnp.sqrt(ms - jnp.square(mg) + self._eps)
+        else:
+            mg = slots["mean_grad"]
+            denom = jnp.sqrt(ms + self._eps)
+        v = self._momentum * slots["velocity"] + lr * g / denom
+        return p - v, {"mean_square": ms, "mean_grad": mg, "velocity": v}
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._eps = epsilon
+        self._rho = rho
+
+    def init_slots(self, p_value):
+        return {"avg_squared_grad": jnp.zeros_like(p_value),
+                "avg_squared_update": jnp.zeros_like(p_value)}
+
+    def update(self, p, g, slots, lr, t, ctx):
+        if ctx["decay"]:
+            g = g + ctx["decay"] * p
+        asg = self._rho * slots["avg_squared_grad"] + (1 - self._rho) * jnp.square(g)
+        upd = g * jnp.sqrt(slots["avg_squared_update"] + self._eps) / \
+            jnp.sqrt(asg + self._eps)
+        asu = self._rho * slots["avg_squared_update"] + (1 - self._rho) * jnp.square(upd)
+        return p - lr * upd, {"avg_squared_grad": asg, "avg_squared_update": asu}
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def init_slots(self, p_value):
+        return {"moment": jnp.zeros_like(p_value),
+                "inf_norm": jnp.zeros_like(p_value)}
+
+    def update(self, p, g, slots, lr, t, ctx):
+        if ctx["decay"]:
+            g = g + ctx["decay"] * p
+        m = self._beta1 * slots["moment"] + (1 - self._beta1) * g
+        u = jnp.maximum(self._beta2 * slots["inf_norm"], jnp.abs(g))
+        new_p = p - lr / (1 - self._beta1 ** t) * m / (u + self._eps)
+        return new_p, {"moment": m, "inf_norm": u}
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def init_slots(self, p_value):
+        return {"moment1": jnp.zeros_like(p_value),
+                "moment2": jnp.zeros_like(p_value)}
+
+    def _decay_coeff(self, param):
+        if self._exclude_fn is not None and param is not None \
+                and self._exclude_fn(param):
+            return 0.0
+        return self._wd
+
+    def update(self, p, g, slots, lr, t, ctx):
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * slots["moment1"] + (1 - b1) * g
+        v = b2 * slots["moment2"] + (1 - b2) * jnp.square(g)
+        mhat = m / (1 - b1 ** t)
+        vhat = v / (1 - b2 ** t)
+        r = mhat / (jnp.sqrt(vhat) + self._eps) + ctx["decay"] * p
+        w_norm = jnp.linalg.norm(p)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return p - lr * trust * r, {"moment1": m, "moment2": v}
+
+
+class LarsMomentum(Momentum):
+    """LARS (reference: lars_momentum op)."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay=None, epsilon=0, name=None):
+        super().__init__(learning_rate, momentum, parameters, False, None,
+                         grad_clip)
+        self._lars_coeff = lars_coeff
+        self._lars_wd = lars_weight_decay
+        self._eps = epsilon
+
+    def update(self, p, g, slots, lr, t, ctx):
+        w_norm = jnp.linalg.norm(p)
+        g_norm = jnp.linalg.norm(g)
+        local_lr = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            self._lars_coeff * w_norm /
+            (g_norm + self._lars_wd * w_norm + self._eps), 1.0)
+        v = self._momentum * slots["velocity"] + \
+            lr * local_lr * (g + self._lars_wd * p)
+        return p - v, {"velocity": v}

@@ -1,0 +1,5 @@
+"""paddle_tpu.parallel — the distributed stack (fleet/topology/collectives/
+strategies).  Facade mirroring paddle.distributed; built on jax.sharding +
+shard_map collectives instead of ProcessGroupNCCL (SURVEY §5.8)."""
+from . import env  # noqa: F401
+from .env import get_rank, get_world_size, ParallelEnv  # noqa: F401

@@ -1,0 +1,58 @@
+"""Distributed environment (reference: python/paddle/distributed/parallel.py
+ParallelEnv — reads PADDLE_TRAINER_* env contract set by the launcher).
+"""
+from __future__ import annotations
+
+import os
+
+
+def get_rank() -> int:
+    for key in ("PADDLE_TRAINER_ID", "PADDLE_RANK", "RANK"):
+        if key in os.environ:
+            return int(os.environ[key])
+    try:
+        import jax
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def get_world_size() -> int:
+    for key in ("PADDLE_TRAINERS_NUM", "PADDLE_WORLD_SIZE", "WORLD_SIZE"):
+        if key in os.environ:
+            return int(os.environ[key])
+    try:
+        import jax
+        return jax.process_count()
+    except Exception:
+        return 1
+
+
+class ParallelEnv:
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def local_rank(self):
+        return int(os.environ.get("PADDLE_LOCAL_RANK", get_rank()))
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def nranks(self):
+        return get_world_size()
+
+    @property
+    def trainer_endpoints(self):
+        return os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+
+    @property
+    def current_endpoint(self):
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
+
+    @property
+    def device_id(self):
+        return self.local_rank

@@ -1,0 +1,18 @@
+"""Test config: run on CPU-XLA with 8 virtual devices so mesh/sharding tests
+work without TPU hardware (SURVEY §4: the reference's fake-device harness,
+fluid/tests/custom_runtime, is mirrored by CPU-simulated meshes)."""
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags +
+                               " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The axon TPU plugin ignores the JAX_PLATFORMS env var; force CPU through the
+# config so tests never round-trip the remote TPU compiler.
+jax.config.update("jax_platforms", "cpu")
+# this jaxlib's DEFAULT matmul precision is bf16-passes even on CPU; tests
+# compare against float64 numpy, so force full precision
+jax.config.update("jax_default_matmul_precision", "highest")

@@ -1,0 +1,130 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_simple_backward():
+    x = paddle.to_tensor([2., 3.], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4., 6.])
+
+
+def test_chain():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = x * x          # 4
+    z = y * x + y      # 8 + 4
+    z.backward()
+    # dz/dx = 3x^2 + 2x = 16
+    np.testing.assert_allclose(x.grad.numpy(), 16.0)
+
+
+def test_grad_accumulation():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    (x * 2).backward()
+    (x * 3).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_branching_graph():
+    x = paddle.to_tensor([1., 2.], stop_gradient=False)
+    a = x * 2
+    b = x * 3
+    (a + b).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5., 5.])
+
+
+def test_no_grad():
+    x = paddle.to_tensor([1.], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+    assert y.is_leaf
+
+
+def test_stop_gradient_propagation():
+    x = paddle.to_tensor([1.], stop_gradient=False)
+    y = x.detach() * 2
+    assert y.stop_gradient
+
+
+def test_multi_output_op():
+    x = paddle.to_tensor(np.array([[3., 1.], [2., 4.]]), stop_gradient=False)
+    vals, idx = paddle.topk(x, 1, axis=1)
+    vals.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [[1., 0.], [0., 1.]])
+
+
+def test_paddle_grad():
+    x = paddle.to_tensor([3.], stop_gradient=False)
+    y = x * x
+    (g,) = paddle.grad(y, x)
+    np.testing.assert_allclose(g.numpy(), [6.])
+    assert x.grad is None  # paddle.grad must not pollute .grad
+
+
+def test_retain_graph():
+    x = paddle.to_tensor([1.], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward(retain_graph=True)
+    y.backward(retain_graph=False)
+    np.testing.assert_allclose(x.grad.numpy(), [4.0])
+    with pytest.raises(RuntimeError):
+        y.backward()
+
+
+def test_backward_non_scalar_requires_grad_tensor():
+    x = paddle.to_tensor([1., 2.], stop_gradient=False)
+    y = x * 2
+    with pytest.raises(RuntimeError):
+        y.backward()
+    y.backward(paddle.ones_like(y))
+    np.testing.assert_allclose(x.grad.numpy(), [2., 2.])
+
+
+def test_pylayer():
+    class Double(paddle.autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * 2
+
+        @staticmethod
+        def backward(ctx, grad):
+            (x,) = ctx.saved_tensor()
+            return grad * 2
+
+    x = paddle.to_tensor([1., 2.], stop_gradient=False)
+    y = Double.apply(x)
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2., 2.])
+
+
+def test_grad_through_getitem_and_concat():
+    x = paddle.to_tensor([1., 2., 3.], stop_gradient=False)
+    y = paddle.concat([x[0:2], x[1:3]])
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1., 2., 1.])
+
+
+def test_grad_matmul():
+    a = paddle.to_tensor(np.random.randn(3, 4).astype(np.float32),
+                         stop_gradient=False)
+    b = paddle.to_tensor(np.random.randn(4, 5).astype(np.float32),
+                         stop_gradient=False)
+    paddle.matmul(a, b).sum().backward()
+    np.testing.assert_allclose(a.grad.numpy(),
+                               np.ones((3, 5)) @ b.numpy().T, rtol=1e-5)
+    np.testing.assert_allclose(b.grad.numpy(),
+                               a.numpy().T @ np.ones((3, 5)), rtol=1e-5)
+
+
+def test_setitem_grad():
+    x = paddle.to_tensor([1., 2., 3.], stop_gradient=False)
+    y = x * 2
+    y[0] = 10.0
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [0., 2., 2.])

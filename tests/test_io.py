@@ -1,0 +1,108 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.io import (BatchSampler, DataLoader, Dataset, IterableDataset,
+                           RandomSampler, Subset, TensorDataset, random_split,
+                           DistributedBatchSampler)
+
+
+class RangeDataset(Dataset):
+    def __init__(self, n):
+        self.n = n
+
+    def __getitem__(self, i):
+        return np.float32(i), np.int64(i % 3)
+
+    def __len__(self):
+        return self.n
+
+
+def test_batch_sampler():
+    ds = RangeDataset(10)
+    bs = BatchSampler(ds, batch_size=3, drop_last=False)
+    batches = list(bs)
+    assert len(batches) == 4
+    assert batches[0] == [0, 1, 2]
+    bs2 = BatchSampler(ds, batch_size=3, drop_last=True)
+    assert len(list(bs2)) == 3
+    bs3 = BatchSampler(ds, batch_size=4, shuffle=True)
+    flat = sorted(i for b in bs3 for i in b)
+    assert flat == list(range(10))
+
+
+def test_dataloader_single_process():
+    ds = RangeDataset(10)
+    loader = DataLoader(ds, batch_size=4, shuffle=False)
+    batches = list(loader)
+    assert len(batches) == 3
+    x, y = batches[0]
+    assert isinstance(x, paddle.Tensor)
+    assert x.shape == [4]
+    np.testing.assert_allclose(x.numpy(), [0, 1, 2, 3])
+    assert y.dtype == paddle.int64
+
+
+def test_dataloader_multiprocess():
+    ds = RangeDataset(20)
+    loader = DataLoader(ds, batch_size=5, num_workers=2)
+    batches = list(loader)
+    assert len(batches) == 4
+    seen = sorted(v for b in batches for v in b[0].numpy().tolist())
+    np.testing.assert_allclose(seen, np.arange(20))
+
+
+def test_iterable_dataset():
+    class Stream(IterableDataset):
+        def __iter__(self):
+            yield from (np.float32(i) for i in range(7))
+
+    loader = DataLoader(Stream(), batch_size=3)
+    sizes = [b.shape[0] for b in loader]
+    assert sizes == [3, 3, 1]
+
+
+def test_tensor_dataset_and_subset():
+    xs = paddle.randn([8, 3])
+    ys = paddle.arange(8)
+    ds = TensorDataset([xs, ys])
+    assert len(ds) == 8
+    x0, y0 = ds[2]
+    assert y0.item() == 2
+    sub = Subset(ds, [1, 3])
+    assert len(sub) == 2
+    a, b = random_split(RangeDataset(10), [7, 3])
+    assert len(a) == 7 and len(b) == 3
+
+
+def test_distributed_batch_sampler():
+    ds = RangeDataset(10)
+    s0 = DistributedBatchSampler(ds, batch_size=2, num_replicas=2, rank=0)
+    s1 = DistributedBatchSampler(ds, batch_size=2, num_replicas=2, rank=1)
+    i0 = [i for b in s0 for i in b]
+    i1 = [i for b in s1 for i in b]
+    assert len(i0) == len(i1) == 5
+    assert set(i0).isdisjoint(set(i1) - {0})  # only the pad can repeat
+    assert len(set(i0) | set(i1)) == 10
+
+
+def test_metrics():
+    from paddle_tpu.metric import Accuracy, Precision, Recall, Auc
+    m = Accuracy()
+    pred = paddle.to_tensor(np.array([[0.1, 0.9], [0.8, 0.2]], np.float32))
+    label = paddle.to_tensor(np.array([[1], [1]]))
+    correct = m.compute(pred, label)
+    m.update(correct)
+    assert m.accumulate() == pytest.approx(0.5)
+
+    p = Precision()
+    p.update(np.array([0.9, 0.9, 0.1]), np.array([1, 0, 1]))
+    assert p.accumulate() == pytest.approx(0.5)
+
+    r = Recall()
+    r.update(np.array([0.9, 0.9, 0.1]), np.array([1, 0, 1]))
+    assert r.accumulate() == pytest.approx(0.5)
+
+    auc = Auc()
+    auc.update(np.array([0.9, 0.8, 0.2, 0.1]), np.array([1, 1, 0, 0]))
+    assert auc.accumulate() == pytest.approx(1.0)

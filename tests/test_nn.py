@@ -1,0 +1,361 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def test_linear():
+    net = nn.Linear(4, 3)
+    x = paddle.randn([2, 4])
+    out = net(x)
+    assert out.shape == [2, 3]
+    np.testing.assert_allclose(
+        out.numpy(), x.numpy() @ net.weight.numpy() + net.bias.numpy(), rtol=1e-5)
+
+
+def test_layer_registry():
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(4, 8)
+            self.fc2 = nn.Linear(8, 2)
+            self.register_buffer("counter", paddle.zeros([1]))
+
+        def forward(self, x):
+            return self.fc2(F.relu(self.fc1(x)))
+
+    net = Net()
+    names = [n for n, _ in net.named_parameters()]
+    assert "fc1.weight" in names and "fc2.bias" in names
+    assert len(net.parameters()) == 4
+    sd = net.state_dict()
+    assert "counter" in sd
+    assert len(net.sublayers()) == 2
+    out = net(paddle.randn([3, 4]))
+    assert out.shape == [3, 2]
+    net.eval()
+    assert not net.fc1.training
+    net.train()
+    assert net.fc1.training
+
+
+def test_forward_hooks():
+    net = nn.Linear(2, 2)
+    calls = []
+    h1 = net.register_forward_pre_hook(lambda l, inp: calls.append("pre"))
+    h2 = net.register_forward_post_hook(lambda l, inp, out: calls.append("post"))
+    net(paddle.randn([1, 2]))
+    assert calls == ["pre", "post"]
+    h1.remove()
+    h2.remove()
+    net(paddle.randn([1, 2]))
+    assert calls == ["pre", "post"]
+
+
+def test_conv2d():
+    conv = nn.Conv2D(3, 8, 3, stride=1, padding=1)
+    x = paddle.randn([2, 3, 16, 16])
+    out = conv(x)
+    assert out.shape == [2, 8, 16, 16]
+    # compare against explicit correlation for one position
+    w = conv.weight.numpy()
+    xn = np.pad(x.numpy(), ((0, 0), (0, 0), (1, 1), (1, 1)))
+    expect = (xn[0, :, 0:3, 0:3] * w[0]).sum() + conv.bias.numpy()[0]
+    np.testing.assert_allclose(out.numpy()[0, 0, 0, 0], expect, rtol=1e-4)
+
+
+def test_conv_grouped_and_dilated():
+    conv = nn.Conv2D(4, 8, 3, groups=2, dilation=2, padding=2)
+    out = conv(paddle.randn([1, 4, 8, 8]))
+    assert out.shape == [1, 8, 8, 8]
+
+
+def test_conv_transpose():
+    deconv = nn.Conv2DTranspose(3, 6, 4, stride=2, padding=1)
+    out = deconv(paddle.randn([1, 3, 8, 8]))
+    assert out.shape == [1, 6, 16, 16]
+
+
+def test_pooling():
+    x = paddle.randn([2, 3, 8, 8])
+    assert F.max_pool2d(x, 2).shape == [2, 3, 4, 4]
+    assert F.avg_pool2d(x, 2, stride=2).shape == [2, 3, 4, 4]
+    assert F.adaptive_avg_pool2d(x, 1).shape == [2, 3, 1, 1]
+    assert F.adaptive_avg_pool2d(x, [3, 5]).shape == [2, 3, 3, 5]
+    np.testing.assert_allclose(
+        F.adaptive_avg_pool2d(x, 1).numpy()[:, :, 0, 0],
+        x.numpy().mean((2, 3)), rtol=1e-5)
+    mp = F.max_pool2d(x, 2).numpy()
+    expect = x.numpy().reshape(2, 3, 4, 2, 4, 2).max((3, 5))
+    np.testing.assert_allclose(mp, expect, rtol=1e-6)
+
+
+def test_batch_norm_updates_stats():
+    bn = nn.BatchNorm2D(3)
+    x = paddle.randn([4, 3, 5, 5]) * 2 + 1
+    bn.train()
+    out = bn(x)
+    assert out.shape == [4, 3, 5, 5]
+    # running stats moved toward batch stats
+    assert not np.allclose(bn._mean.numpy(), np.zeros(3))
+    bn.eval()
+    out_eval = bn(x)
+    assert out_eval.shape == [4, 3, 5, 5]
+    # normalized batch output should have ~0 mean / ~1 var in train mode
+    np.testing.assert_allclose(out.numpy().mean((0, 2, 3)), np.zeros(3), atol=1e-5)
+    np.testing.assert_allclose(out.numpy().var((0, 2, 3)), np.ones(3), atol=1e-3)
+
+
+def test_layer_norm():
+    ln = nn.LayerNorm(8)
+    x = paddle.randn([2, 4, 8])
+    out = ln(x)
+    np.testing.assert_allclose(out.numpy().mean(-1), np.zeros((2, 4)), atol=1e-5)
+    np.testing.assert_allclose(out.numpy().std(-1), np.ones((2, 4)), atol=1e-2)
+
+
+def test_group_norm():
+    gn = nn.GroupNorm(2, 4)
+    out = gn(paddle.randn([2, 4, 6, 6]))
+    assert out.shape == [2, 4, 6, 6]
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 5, padding_idx=0)
+    idx = paddle.to_tensor([[1, 0, 3]])
+    out = emb(idx)
+    assert out.shape == [1, 3, 5]
+    np.testing.assert_allclose(out.numpy()[0, 1], np.zeros(5))
+
+
+def test_dropout_train_eval():
+    do = nn.Dropout(0.5)
+    x = paddle.ones([1000])
+    out = do(x)
+    assert 0.2 < float((out.numpy() == 0).mean()) < 0.8
+    # upscale preserved expectation
+    assert 0.8 < float(out.numpy().mean()) < 1.2
+    do.eval()
+    np.testing.assert_allclose(do(x).numpy(), x.numpy())
+
+
+def test_activations():
+    x = paddle.to_tensor([-1., 0., 2.])
+    np.testing.assert_allclose(F.relu(x).numpy(), [0., 0., 2.])
+    np.testing.assert_allclose(F.leaky_relu(x, 0.1).numpy(), [-0.1, 0., 2.],
+                               rtol=1e-6)
+    np.testing.assert_allclose(F.sigmoid(x).numpy(),
+                               1 / (1 + np.exp(-x.numpy())), rtol=1e-6)
+    sm = F.softmax(paddle.randn([3, 5]))
+    np.testing.assert_allclose(sm.numpy().sum(-1), np.ones(3), rtol=1e-6)
+    assert nn.GELU()(x).shape == [3]
+    assert nn.Silu()(x).shape == [3]
+
+
+def test_losses():
+    logits = paddle.randn([4, 10])
+    labels = paddle.to_tensor([1, 2, 3, 4])
+    loss = F.cross_entropy(logits, labels)
+    assert loss.shape == []
+    # manual reference
+    lp = logits.numpy() - logits.numpy().max(-1, keepdims=True)
+    logp = lp - np.log(np.exp(lp).sum(-1, keepdims=True))
+    expect = -logp[np.arange(4), labels.numpy()].mean()
+    np.testing.assert_allclose(loss.numpy(), expect, rtol=1e-5)
+
+    ce = nn.CrossEntropyLoss()
+    np.testing.assert_allclose(ce(logits, labels).numpy(), expect, rtol=1e-5)
+
+    x = paddle.randn([3, 4])
+    y = paddle.randn([3, 4])
+    np.testing.assert_allclose(F.mse_loss(x, y).numpy(),
+                               ((x.numpy() - y.numpy()) ** 2).mean(), rtol=1e-5)
+    np.testing.assert_allclose(F.l1_loss(x, y).numpy(),
+                               np.abs(x.numpy() - y.numpy()).mean(), rtol=1e-5)
+    p = paddle.uniform([5], min=0.01, max=0.99)
+    t = paddle.to_tensor([1., 0., 1., 0., 1.])
+    np.testing.assert_allclose(
+        F.binary_cross_entropy(p, t).numpy(),
+        -(t.numpy() * np.log(p.numpy()) +
+          (1 - t.numpy()) * np.log(1 - p.numpy())).mean(), rtol=1e-5)
+
+
+def test_cross_entropy_ignore_index_and_soft():
+    logits = paddle.randn([4, 6])
+    labels = paddle.to_tensor([1, -100, 3, -100])
+    loss = F.cross_entropy(logits, labels, ignore_index=-100)
+    lp = logits.numpy() - logits.numpy().max(-1, keepdims=True)
+    logp = lp - np.log(np.exp(lp).sum(-1, keepdims=True))
+    expect = -(logp[0, 1] + logp[2, 3]) / 2
+    np.testing.assert_allclose(loss.numpy(), expect, rtol=1e-5)
+
+    soft = paddle.to_tensor(np.full((2, 6), 1 / 6, np.float32))
+    l2 = F.cross_entropy(paddle.randn([2, 6]), soft, soft_label=True)
+    assert l2.shape == []
+
+
+def test_sequential_and_layerlist():
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    assert len(net) == 3
+    out = net(paddle.randn([2, 4]))
+    assert out.shape == [2, 2]
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    ll.append(nn.Linear(2, 2))
+    assert len(ll) == 4
+    assert len(ll.parameters()) == 8
+
+
+def test_multihead_attention():
+    mha = nn.MultiHeadAttention(16, 4)
+    x = paddle.randn([2, 5, 16])
+    out = mha(x, x, x)
+    assert out.shape == [2, 5, 16]
+    mha2 = nn.MultiHeadAttention(16, 4, need_weights=True)
+    out, w = mha2(x)
+    assert w.shape == [2, 4, 5, 5]
+    np.testing.assert_allclose(w.numpy().sum(-1), np.ones((2, 4, 5)), rtol=1e-5)
+
+
+def test_transformer_encoder():
+    layer = nn.TransformerEncoderLayer(d_model=16, nhead=4, dim_feedforward=32)
+    enc = nn.TransformerEncoder(layer, 2)
+    out = enc(paddle.randn([2, 6, 16]))
+    assert out.shape == [2, 6, 16]
+    # separate layers must not share parameters
+    p = list(enc.parameters())
+    assert len(p) == 2 * len(list(layer.parameters()))
+
+
+def test_full_transformer():
+    model = nn.Transformer(d_model=16, nhead=4, num_encoder_layers=2,
+                           num_decoder_layers=2, dim_feedforward=32)
+    src = paddle.randn([2, 5, 16])
+    tgt = paddle.randn([2, 3, 16])
+    out = model(src, tgt)
+    assert out.shape == [2, 3, 16]
+
+
+def test_lstm_gru():
+    lstm = nn.LSTM(8, 16, num_layers=2)
+    x = paddle.randn([4, 10, 8])
+    out, (h, c) = lstm(x)
+    assert out.shape == [4, 10, 16]
+    assert h.shape == [2, 4, 16]
+    assert c.shape == [2, 4, 16]
+
+    gru = nn.GRU(8, 16, direction="bidirect")
+    out, h = gru(x)
+    assert out.shape == [4, 10, 32]
+    assert h.shape == [2, 4, 16]
+
+    cell = nn.LSTMCell(8, 16)
+    h_out, (h2, c2) = cell(paddle.randn([4, 8]))
+    assert h_out.shape == [4, 16]
+
+
+def test_rnn_grad_flows():
+    lstm = nn.LSTM(4, 8)
+    x = paddle.randn([2, 5, 4])
+    out, _ = lstm(x)
+    out.sum().backward()
+    assert lstm.weight_ih_l0.grad is not None
+    assert lstm.weight_hh_l0.grad is not None
+
+
+def test_clip_grad_by_global_norm():
+    p1 = nn.Parameter(np.array([3.0, 4.0], np.float32))
+    g1 = paddle.to_tensor([30., 40.])
+    clip = nn.ClipGradByGlobalNorm(1.0)
+    [(_, clipped)] = clip([(p1, g1)])
+    np.testing.assert_allclose(np.linalg.norm(clipped.numpy()), 1.0, rtol=1e-5)
+
+
+def test_interpolate():
+    x = paddle.randn([1, 3, 4, 4])
+    assert F.interpolate(x, scale_factor=2, mode="nearest").shape == [1, 3, 8, 8]
+    assert F.interpolate(x, size=[6, 7], mode="bilinear").shape == [1, 3, 6, 7]
+
+
+def test_amp_autocast():
+    with paddle.amp.auto_cast(True):
+        a = paddle.randn([4, 4])
+        b = paddle.randn([4, 4])
+        out = paddle.matmul(a, b)
+        assert out.dtype == paddle.bfloat16
+        s = paddle.exp(out)
+        assert s.dtype == paddle.float32
+    out2 = paddle.matmul(a, b)
+    assert out2.dtype == paddle.float32
+
+
+def test_functional_call_jit():
+    import jax
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    from paddle_tpu.nn import functional_call, state_values
+
+    values = state_values(net)
+
+    def loss_fn(vals, x):
+        out, _ = functional_call(net, vals, (paddle.Tensor(x, _internal=True),))
+        return out._value.sum()
+
+    x = np.random.randn(3, 4).astype(np.float32)
+    g = jax.jit(jax.grad(loss_fn))(values, x)
+    assert set(g) == set(values)
+    # gradient of sum through ReLU-linear matches eager backward
+    xt = paddle.to_tensor(x)
+    out = net(xt)
+    out.sum().backward()
+    np.testing.assert_allclose(np.asarray(g["2.weight"]),
+                               net[2].weight.grad.numpy(), rtol=1e-4)
+
+
+def test_amp_backward_mixed_chain():
+    # regression: cast must happen inside the VJP so cotangent dtypes match
+    with paddle.amp.auto_cast(True):
+        x = paddle.randn([4, 4])
+        x.stop_gradient = False
+        y = F.relu(x)             # not white-listed: stays fp32
+        w = paddle.randn([4, 4])
+        w.stop_gradient = False
+        out = paddle.matmul(y, w)  # white-listed: computes in bf16
+        loss = out.astype("float32").sum()
+    loss.backward()
+    assert x.grad is not None and x.grad.dtype == paddle.float32
+    assert w.grad is not None and w.grad.dtype == paddle.float32
+
+
+def test_paddle_grad_does_not_pollute_params():
+    net = nn.Linear(3, 3)
+    x = paddle.randn([2, 3])
+    x.stop_gradient = False
+    out = net(x)
+    (g,) = paddle.grad(out.sum(), x)
+    assert g.shape == [2, 3]
+    # parameters must be untouched by paddle.grad
+    assert net.weight.grad is None and net.bias.grad is None
+
+
+def test_grad_scaler_no_double_unscale():
+    net = nn.Linear(2, 2)
+    o = paddle.optimizer.SGD(0.0, parameters=net.parameters())
+    scaler = paddle.amp.GradScaler(init_loss_scaling=8.0)
+    scaler.scale(net(paddle.randn([2, 2])).sum()).backward()
+    scaler.unscale_(o)
+    g = net.weight.grad.numpy().copy()
+    scaler.step(o)  # must not unscale again
+    np.testing.assert_allclose(net.weight.grad.numpy(), g, rtol=1e-6)
+
+
+def test_instance_norm_bias_without_weight():
+    out = F.instance_norm(paddle.randn([2, 3, 4, 4]),
+                          bias=paddle.ones([3]))
+    np.testing.assert_allclose(out.numpy().mean((2, 3)),
+                               np.ones((2, 3)), atol=1e-5)
+
+
+def test_expand_invalid_minus_one():
+    with pytest.raises(ValueError):
+        paddle.expand(paddle.ones([3]), [-1, 3])

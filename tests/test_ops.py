@@ -1,0 +1,188 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+from op_test import OpTest
+
+
+class TestMatmul(OpTest):
+    def setup_method(self, method):
+        self.op = paddle.matmul
+        self.inputs = {"x": np.random.rand(3, 4).astype(np.float64),
+                       "y": np.random.rand(4, 5).astype(np.float64)}
+        self.ref = lambda x, y: x @ y
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad()
+
+
+class TestExp(OpTest):
+    def setup_method(self, method):
+        self.op = paddle.exp
+        self.inputs = {"x": np.random.rand(3, 4).astype(np.float64)}
+        self.ref = lambda x: np.exp(x)
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad()
+
+
+class TestSoftmaxCE(OpTest):
+    def setup_method(self, method):
+        import paddle_tpu.nn.functional as F
+        self.op = F.softmax
+        self.inputs = {"x": np.random.rand(4, 7).astype(np.float64)}
+        self.attrs = {"axis": -1}
+        self.ref = lambda x, axis: np.exp(x) / np.exp(x).sum(axis=axis,
+                                                            keepdims=True)
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad()
+
+
+def test_reductions():
+    x = np.random.rand(3, 4, 5).astype(np.float32)
+    t = paddle.to_tensor(x)
+    np.testing.assert_allclose(paddle.sum(t).numpy(), x.sum(), rtol=1e-5)
+    np.testing.assert_allclose(paddle.mean(t, axis=1).numpy(), x.mean(1), rtol=1e-5)
+    np.testing.assert_allclose(paddle.max(t, axis=[0, 2]).numpy(),
+                               x.max((0, 2)), rtol=1e-6)
+    np.testing.assert_allclose(paddle.prod(t, axis=-1).numpy(), x.prod(-1),
+                               rtol=1e-5)
+    np.testing.assert_allclose(paddle.logsumexp(t, axis=1).numpy(),
+                               np.log(np.exp(x).sum(1)), rtol=1e-4)
+    np.testing.assert_allclose(paddle.std(t).numpy(), x.std(ddof=1), rtol=1e-4)
+    assert paddle.all(t > -1).item()
+    assert not paddle.any(t > 2).item()
+
+
+def test_manipulation():
+    x = np.arange(24).reshape(2, 3, 4).astype(np.float32)
+    t = paddle.to_tensor(x)
+    assert paddle.reshape(t, [6, 4]).shape == [6, 4]
+    assert paddle.reshape(t, [-1]).shape == [24]
+    assert paddle.transpose(t, [2, 0, 1]).shape == [4, 2, 3]
+    assert paddle.flatten(t, 1, 2).shape == [2, 12]
+    assert paddle.squeeze(paddle.to_tensor(np.zeros((1, 3, 1)))).shape == [3]
+    assert paddle.unsqueeze(t, [0, -1]).shape == [1, 2, 3, 4, 1]
+    parts = paddle.split(t, 3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == [2, 1, 4]
+    parts = paddle.split(t, [1, -1], axis=1)
+    assert parts[1].shape == [2, 2, 4]
+    assert paddle.concat([t, t], axis=0).shape == [4, 3, 4]
+    assert paddle.stack([t, t], axis=0).shape == [2, 2, 3, 4]
+    assert paddle.tile(paddle.to_tensor([1, 2]), [2, 2]).shape == [2, 4]
+    assert paddle.expand(paddle.to_tensor([[1.], [2.]]), [2, 3]).shape == [2, 3]
+    assert paddle.flip(t, [0]).numpy()[0, 0, 0] == 12
+    assert paddle.roll(t, 1, 0).numpy()[0, 0, 0] == 12
+    un = paddle.unbind(t, axis=0)
+    assert len(un) == 2 and un[0].shape == [3, 4]
+
+
+def test_gather_scatter():
+    x = paddle.to_tensor(np.arange(12).reshape(4, 3).astype(np.float32))
+    idx = paddle.to_tensor([0, 2])
+    assert paddle.gather(x, idx).shape == [2, 3]
+    np.testing.assert_allclose(paddle.gather(x, idx).numpy(), x.numpy()[[0, 2]])
+    upd = paddle.ones([2, 3])
+    out = paddle.scatter(x, idx, upd)
+    np.testing.assert_allclose(out.numpy()[0], np.ones(3))
+    nd_idx = paddle.to_tensor(np.array([[0, 0], [1, 2]]))
+    np.testing.assert_allclose(paddle.gather_nd(x, nd_idx).numpy(), [0., 5.])
+    taken = paddle.take_along_axis(x, paddle.to_tensor(np.array([[0], [1], [2], [0]])), 1)
+    assert taken.shape == [4, 1]
+
+
+def test_search_sort():
+    x = paddle.to_tensor(np.array([[3., 1., 2.], [0., 5., 4.]]))
+    assert paddle.argmax(x).item() == 4
+    np.testing.assert_allclose(paddle.argmax(x, axis=1).numpy(), [0, 1])
+    np.testing.assert_allclose(paddle.argmin(x, axis=0).numpy(), [1, 0, 0])
+    np.testing.assert_allclose(paddle.sort(x, axis=1).numpy(),
+                               np.sort(x.numpy(), axis=1))
+    np.testing.assert_allclose(paddle.argsort(x, axis=1, descending=True).numpy(),
+                               np.argsort(-x.numpy(), axis=1))
+    vals, idx = paddle.topk(x, 2, axis=1)
+    np.testing.assert_allclose(vals.numpy(), [[3., 2.], [5., 4.]])
+    nz = paddle.nonzero(paddle.to_tensor([0, 3, 0, 4]))
+    np.testing.assert_allclose(nz.numpy(), [[1], [3]])
+    u = paddle.unique(paddle.to_tensor([3, 1, 3, 2]))
+    np.testing.assert_allclose(u.numpy(), [1, 2, 3])
+
+
+def test_where_and_logic():
+    c = paddle.to_tensor([True, False])
+    a = paddle.to_tensor([1., 2.])
+    b = paddle.to_tensor([9., 9.])
+    np.testing.assert_allclose(paddle.where(c, a, b).numpy(), [1., 9.])
+    assert paddle.allclose(a, a).item()
+    assert paddle.equal_all(a, a).item()
+    assert paddle.logical_and(c, paddle.to_tensor([True, True])).numpy().tolist() \
+        == [True, False]
+
+
+def test_linalg():
+    a = np.random.rand(4, 4).astype(np.float64) + np.eye(4)
+    t = paddle.to_tensor(a)
+    np.testing.assert_allclose(paddle.inverse(t).numpy(), np.linalg.inv(a),
+                               rtol=1e-5, atol=1e-8)
+    np.testing.assert_allclose(paddle.t(paddle.to_tensor([[1., 2.]])).numpy(),
+                               [[1.], [2.]])
+    np.testing.assert_allclose(paddle.dot(paddle.to_tensor([1., 2.]),
+                                          paddle.to_tensor([3., 4.])).numpy(), 11.)
+    np.testing.assert_allclose(paddle.norm(paddle.to_tensor([3., 4.])).numpy(), 5.)
+    b = np.random.rand(2, 3, 4).astype(np.float32)
+    c = np.random.rand(2, 4, 5).astype(np.float32)
+    np.testing.assert_allclose(paddle.bmm(paddle.to_tensor(b),
+                                          paddle.to_tensor(c)).numpy(),
+                               b @ c, rtol=1e-5)
+    e = paddle.einsum("ij,jk->ik", paddle.to_tensor(a), paddle.to_tensor(a))
+    np.testing.assert_allclose(e.numpy(), a @ a, rtol=1e-6)
+    sign_logdet = paddle.slogdet(t)
+    expect = np.linalg.slogdet(a)
+    np.testing.assert_allclose(sign_logdet.numpy(), [expect[0], expect[1]],
+                               rtol=1e-6)
+
+
+def test_cumulative():
+    x = np.random.rand(3, 4).astype(np.float32)
+    t = paddle.to_tensor(x)
+    np.testing.assert_allclose(paddle.cumsum(t, axis=1).numpy(), x.cumsum(1),
+                               rtol=1e-5)
+    np.testing.assert_allclose(paddle.cumprod(t, dim=0).numpy(), x.cumprod(0),
+                               rtol=1e-5)
+    vals, idx = paddle.cummax(paddle.to_tensor([1., 3., 2., 5.]))
+    np.testing.assert_allclose(vals.numpy(), [1., 3., 3., 5.])
+    np.testing.assert_allclose(idx.numpy(), [0, 1, 1, 3])
+
+
+def test_random_ops():
+    paddle.seed(7)
+    assert paddle.rand([3, 3]).shape == [3, 3]
+    r = paddle.randint(0, 10, [100])
+    assert r.dtype == paddle.int64
+    assert (r.numpy() >= 0).all() and (r.numpy() < 10).all()
+    p = paddle.randperm(10)
+    assert sorted(p.numpy().tolist()) == list(range(10))
+    u = paddle.uniform([1000], min=-2, max=2)
+    assert -2 <= float(u.min().item()) and float(u.max().item()) <= 2
+    m = paddle.multinomial(paddle.to_tensor([0.0, 1.0]), 5, replacement=True)
+    assert (m.numpy() == 1).all()
+
+
+def test_pad():
+    import paddle_tpu.nn.functional as F
+    x = paddle.ones([1, 2, 3, 3])
+    out = F.pad(x, [1, 1, 2, 2])  # NCHW spatial pads
+    assert out.shape == [1, 2, 7, 5]
+    out2 = F.pad(x, [0, 0, 0, 0, 1, 1, 2, 2])
+    assert out2.shape == [1, 2, 5, 7]

@@ -1,0 +1,132 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+
+
+def _quadratic_steps(optimizer_fn, steps=80):
+    """Minimise ||x W - x W*||^2 (realizable target); return final loss."""
+    paddle.seed(0)
+    net = nn.Linear(4, 4, bias_attr=False)
+    x = paddle.randn([16, 4])
+    w_true = paddle.randn([4, 4])
+    target = paddle.matmul(x, w_true)
+    optimizer = optimizer_fn(net.parameters())
+    loss_val = None
+    for _ in range(steps):
+        out = net(x)
+        loss = ((out - target) ** 2).mean()
+        loss.backward()
+        optimizer.step()
+        optimizer.clear_grad()
+        loss_val = float(loss.item())
+    return loss_val
+
+
+@pytest.mark.parametrize("maker", [
+    lambda p: opt.SGD(0.1, parameters=p),
+    lambda p: opt.Momentum(0.05, 0.9, parameters=p),
+    lambda p: opt.Adam(0.1, parameters=p),
+    lambda p: opt.AdamW(0.1, parameters=p, weight_decay=0.0),
+    lambda p: opt.RMSProp(0.02, parameters=p),
+    lambda p: opt.Adagrad(0.3, parameters=p),
+    lambda p: opt.Adamax(0.1, parameters=p),
+    lambda p: opt.Lamb(0.05, parameters=p, lamb_weight_decay=0.0),
+])
+def test_optimizers_decrease_loss(maker):
+    final = _quadratic_steps(maker)
+    assert final < 0.35, final
+
+
+def test_sgd_exact_update():
+    p = nn.Parameter(np.array([1.0, 2.0], np.float32))
+    o = opt.SGD(0.5, parameters=[p])
+    p.grad = paddle.to_tensor([1.0, 1.0])
+    o.step()
+    np.testing.assert_allclose(p.numpy(), [0.5, 1.5])
+
+
+def test_adamw_weight_decay():
+    p = nn.Parameter(np.array([10.0], np.float32))
+    o = opt.AdamW(0.1, parameters=[p], weight_decay=0.1)
+    p.grad = paddle.to_tensor([0.0])
+    o.step()
+    # decoupled decay shrinks the weight even with zero grad
+    assert float(p.item()) < 10.0
+
+
+def test_grad_clip_in_optimizer():
+    p = nn.Parameter(np.array([1.0], np.float32))
+    o = opt.SGD(1.0, parameters=[p],
+                grad_clip=nn.ClipGradByGlobalNorm(0.1))
+    p.grad = paddle.to_tensor([100.0])
+    o.step()
+    np.testing.assert_allclose(p.numpy(), [0.9], rtol=1e-5)
+
+
+def test_optimizer_state_dict_roundtrip():
+    net = nn.Linear(2, 2)
+    o = opt.Adam(0.1, parameters=net.parameters())
+    net(paddle.randn([1, 2])).sum().backward()
+    o.step()
+    sd = o.state_dict()
+    o2 = opt.Adam(0.1, parameters=net.parameters())
+    o2.set_state_dict(sd)
+    assert o2._step_count == 1
+    k = f"{net.weight.name}_moment1"
+    assert k in sd
+
+
+def test_lr_scheduler_with_optimizer():
+    sched = opt.lr.StepDecay(0.1, step_size=2, gamma=0.5)
+    o = opt.SGD(sched, parameters=[nn.Parameter(np.zeros(1, np.float32))])
+    assert o.get_lr() == pytest.approx(0.1)
+    sched.step()
+    sched.step()
+    assert o.get_lr() == pytest.approx(0.05)
+
+
+def test_lr_schedules_values():
+    s = opt.lr.PiecewiseDecay([3, 6], [1.0, 0.5, 0.1])
+    vals = []
+    for _ in range(8):
+        vals.append(s())
+        s.step()
+    assert vals[0] == 1.0 and vals[4] == 0.5 and vals[7] == 0.1
+
+    c = opt.lr.CosineAnnealingDecay(1.0, T_max=10)
+    assert c() == pytest.approx(1.0)
+    for _ in range(10):
+        c.step()
+    assert c() == pytest.approx(0.0, abs=1e-6)
+
+    w = opt.lr.LinearWarmup(0.1, warmup_steps=5, start_lr=0.0, end_lr=0.1)
+    assert w() == pytest.approx(0.0)
+    for _ in range(5):
+        w.step()
+    assert w() == pytest.approx(0.1)
+
+    n = opt.lr.NoamDecay(d_model=512, warmup_steps=100)
+    n.step()
+    assert n() > 0
+
+    r = opt.lr.ReduceOnPlateau(0.1, patience=1)
+    r.step(1.0)
+    r.step(1.0)
+    r.step(1.0)
+    assert r() < 0.1
+
+
+def test_grad_scaler():
+    net = nn.Linear(2, 2)
+    scaler = paddle.amp.GradScaler(init_loss_scaling=4.0)
+    out = net(paddle.randn([2, 2]))
+    loss = out.sum()
+    scaled = scaler.scale(loss)
+    scaled.backward()
+    g_before = net.weight.grad.numpy().copy()
+    scaler.step(opt.SGD(0.0, parameters=net.parameters()))
+    np.testing.assert_allclose(net.weight.grad.numpy(), g_before / 4.0,
+                               rtol=1e-6)

@@ -1,0 +1,126 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_to_tensor_dtypes():
+    assert paddle.to_tensor(1).dtype == paddle.int64
+    assert paddle.to_tensor(1.0).dtype == paddle.float32
+    assert paddle.to_tensor([True]).dtype == paddle.bool_
+    assert paddle.to_tensor(np.zeros(3, np.float64)).dtype == paddle.float64
+    t = paddle.to_tensor([1, 2], dtype="float32")
+    assert t.dtype == paddle.float32
+
+
+def test_creation_ops():
+    assert paddle.zeros([2, 3]).shape == [2, 3]
+    assert paddle.ones([2]).numpy().tolist() == [1.0, 1.0]
+    assert paddle.full([2], 7).numpy().tolist() == [7, 7]
+    assert paddle.arange(5).dtype == paddle.int64
+    assert paddle.arange(0, 1, 0.25).shape == [4]
+    np.testing.assert_allclose(paddle.eye(3).numpy(), np.eye(3))
+    assert paddle.linspace(0, 1, 5).shape == [5]
+    x = paddle.to_tensor([[1., 2.], [3., 4.]])
+    np.testing.assert_allclose(paddle.tril(x).numpy(), np.tril(x.numpy()))
+    assert paddle.ones_like(x).shape == [2, 2]
+
+
+def test_properties():
+    x = paddle.randn([3, 4])
+    assert x.shape == [3, 4]
+    assert x.ndim == 2
+    assert x.size == 12
+    assert x.numel() == 12
+    assert len(x) == 3
+    assert x.T.shape == [4, 3]
+    assert x.stop_gradient is True
+    assert x.is_leaf
+
+
+def test_indexing():
+    x = paddle.to_tensor(np.arange(12).reshape(3, 4).astype(np.float32))
+    assert x[0].shape == [4]
+    assert x[0, 1].item() == 1.0
+    assert x[:, 1:3].shape == [3, 2]
+    assert x[-1, -1].item() == 11.0
+    idx = paddle.to_tensor([0, 2])
+    assert x[idx].shape == [2, 4]
+    # boolean mask (eager only)
+    m = x > 5
+    assert (x[m] > 5).all().item()
+
+
+def test_setitem():
+    x = paddle.zeros([3, 3])
+    x[0, 0] = 5.0
+    assert x[0, 0].item() == 5.0
+    x[1] = paddle.ones([3])
+    np.testing.assert_allclose(x[1].numpy(), np.ones(3))
+
+
+def test_inplace_ops():
+    x = paddle.to_tensor([1., 2.])
+    y = x
+    x.add_(paddle.to_tensor([1., 1.]))
+    np.testing.assert_allclose(y.numpy(), [2., 3.])
+    x.scale_(2.0)
+    np.testing.assert_allclose(y.numpy(), [4., 6.])
+    x.zero_()
+    np.testing.assert_allclose(y.numpy(), [0., 0.])
+
+
+def test_operators():
+    a = paddle.to_tensor([4., 9.])
+    b = paddle.to_tensor([2., 3.])
+    np.testing.assert_allclose((a + b).numpy(), [6., 12.])
+    np.testing.assert_allclose((a - b).numpy(), [2., 6.])
+    np.testing.assert_allclose((a * b).numpy(), [8., 27.])
+    np.testing.assert_allclose((a / b).numpy(), [2., 3.])
+    np.testing.assert_allclose((a ** 2).numpy(), [16., 81.])
+    np.testing.assert_allclose((1 + a).numpy(), [5., 10.])
+    np.testing.assert_allclose((10 / b).numpy(), [5., 10 / 3], rtol=1e-6)
+    np.testing.assert_allclose((-a).numpy(), [-4., -9.])
+    np.testing.assert_allclose(abs(paddle.to_tensor([-1., 2.])).numpy(), [1., 2.])
+    assert (a > b).all().item()
+    assert (a == a).all().item()
+    assert (a != b).any().item()
+
+
+def test_astype_and_item():
+    x = paddle.to_tensor([1.7])
+    assert x.astype("int32").dtype == paddle.int32
+    assert x.astype(paddle.int64).item() == 1
+    assert isinstance(x.item(), float)
+    assert float(x) == pytest.approx(1.7, rel=1e-6)
+
+
+def test_clone_detach():
+    x = paddle.to_tensor([1., 2.], stop_gradient=False)
+    d = x.detach()
+    assert d.stop_gradient
+    c = x.clone()
+    assert not c.stop_gradient
+    (c.sum()).backward()
+    assert x.grad is not None
+
+
+def test_save_load(tmp_path):
+    net = paddle.nn.Linear(3, 2)
+    sd = net.state_dict()
+    path = str(tmp_path / "model.pdparams")
+    paddle.save(sd, path)
+    loaded = paddle.load(path)
+    assert set(loaded) == set(sd)
+    np.testing.assert_allclose(loaded["weight"].numpy(), sd["weight"].numpy())
+    net2 = paddle.nn.Linear(3, 2)
+    net2.set_state_dict(loaded)
+    np.testing.assert_allclose(net2.weight.numpy(), net.weight.numpy())
+
+
+def test_seed_reproducible():
+    paddle.seed(42)
+    a = paddle.randn([4])
+    paddle.seed(42)
+    b = paddle.randn([4])
+    np.testing.assert_allclose(a.numpy(), b.numpy())
