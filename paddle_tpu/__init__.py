@@ -37,6 +37,8 @@ from . import framework  # noqa: E402,F401
 from .framework.io import save, load  # noqa: E402,F401
 from . import device  # noqa: E402,F401
 from . import autograd  # noqa: E402,F401
+from . import distributed  # noqa: E402,F401
+from .distributed.parallel import DataParallel  # noqa: E402,F401
 
 __version__ = "0.1.0"
 

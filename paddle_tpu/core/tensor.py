@@ -24,7 +24,7 @@ _name_counter = itertools.count()
 
 class Tensor:
     __slots__ = ("_value", "_grad", "_grad_node", "_grad_slot", "stop_gradient",
-                 "name", "persistable", "__weakref__")
+                 "name", "persistable", "_partition_spec", "__weakref__")
 
     def __init__(self, data: Any = None, dtype=None, place=None,
                  stop_gradient: bool = True, name: str | None = None,
@@ -55,6 +55,9 @@ class Tensor:
         self._grad_slot = 0
         self.stop_gradient = stop_gradient
         self.persistable = False
+        # GSPMD placement tag (jax.sharding.PartitionSpec) — the analog of the
+        # reference's TensorDistAttr (distributed/auto_parallel/dist_attr.h)
+        self._partition_spec = None
         self.name = name or f"generated_tensor_{next(_name_counter)}"
 
     # -- core properties ----------------------------------------------------

@@ -1,0 +1,31 @@
+"""paddle.distributed parity surface (SURVEY §2.3): bootstrap, collectives,
+topology/mesh, fleet, SPMD step builder, sharding, launch.
+"""
+from .collective import (  # noqa: F401
+    ReduceOp, Group, all_gather, all_gather_concat, all_gather_object,
+    all_reduce, all_to_all, all_to_all_single, barrier, broadcast,
+    broadcast_object_list, destroy_process_group, get_group, is_initialized,
+    new_group, p2p_shift, recv, reduce, reduce_scatter, scatter, send, wait,
+)
+from .parallel import (  # noqa: F401
+    DataParallel, ParallelEnv, get_rank, get_world_size, init_parallel_env,
+)
+from .mesh import (  # noqa: F401
+    build_mesh, get_global_mesh, global_mesh, set_global_mesh, sharding_for,
+)
+from .topology import (  # noqa: F401
+    CommunicateTopology, HybridCommunicateGroup,
+    get_hybrid_communicate_group, set_hybrid_communicate_group,
+)
+from .spmd import (  # noqa: F401
+    ShardedTrainStep, TrainState, batch_spec, infer_param_specs,
+    make_train_step,
+)
+from . import fleet  # noqa: F401
+from .fleet.layers.mpu.mp_ops import split  # noqa: F401
+
+get_world_size_ = get_world_size
+
+
+def get_backend():
+    return "xla"

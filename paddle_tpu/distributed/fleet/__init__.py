@@ -1,0 +1,169 @@
+"""paddle.distributed.fleet parity (fleet/fleet.py:168 `init`,
+fleet/model.py:66 `distributed_model`, fleet/optimizer.py:67
+`distributed_optimizer`).  The module object doubles as the Fleet singleton
+like the reference's `fleet` package surface.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .base.distributed_strategy import DistributedStrategy  # noqa: F401
+from .base.role_maker import (PaddleCloudRoleMaker, Role,  # noqa: F401
+                              RoleMakerBase, UserDefinedRoleMaker)
+from .base.strategy_group import ParallelMode  # noqa: F401
+from ..topology import (CommunicateTopology, HybridCommunicateGroup,  # noqa: F401
+                        get_hybrid_communicate_group,
+                        set_hybrid_communicate_group)
+from . import model as _model_mod
+from .meta_parallel.parallel_layers.pp_layers import (  # noqa: F401
+    LayerDesc, PipelineLayer, SharedLayerDesc)
+from .meta_optimizers.dygraph_optimizer.hybrid_parallel_optimizer import (  # noqa: F401
+    HybridParallelOptimizer)
+from .utils.recompute import recompute  # noqa: F401
+
+_role_maker = None
+_user_defined_strategy: DistributedStrategy | None = None
+_hcg: HybridCommunicateGroup | None = None
+_is_initialized = False
+
+
+def init(role_maker=None, is_collective=False, strategy=None, log_level="INFO"):
+    """fleet/fleet.py:168 parity.  Collective mode builds the hybrid topology
+    + device mesh from strategy.hybrid_configs (fleet.py:340
+    _init_hybrid_parallel_env)."""
+    global _role_maker, _user_defined_strategy, _hcg, _is_initialized
+    import jax
+
+    from .. import parallel as parallel_mod
+
+    _role_maker = role_maker or PaddleCloudRoleMaker(is_collective=is_collective)
+    _user_defined_strategy = strategy or DistributedStrategy()
+    parallel_mod.init_parallel_env()
+
+    if is_collective or strategy is not None:
+        cfg = _user_defined_strategy.hybrid_configs
+        world = jax.device_count()
+        mp = max(1, cfg.get("mp_degree", 1))
+        pp = max(1, cfg.get("pp_degree", 1))
+        sh = max(1, cfg.get("sharding_degree", 1))
+        sep = max(1, cfg.get("sep_degree", 1))
+        dp = cfg.get("dp_degree", -1)
+        if dp in (-1, 0, None):
+            dp = max(1, world // (mp * pp * sh * sep))
+        names = ["data", "pipe", "sharding", "model"]
+        dims = [dp, pp, sh, mp]
+        if sep > 1:
+            names = ["data", "pipe", "sharding", "sep", "model"]
+            dims = [dp, pp, sh, sep, mp]
+        topo = CommunicateTopology(names, dims)
+        _hcg = HybridCommunicateGroup(topo)
+        set_hybrid_communicate_group(_hcg)
+    _is_initialized = True
+    return None
+
+
+def is_initialized():
+    return _is_initialized
+
+
+def get_hybrid_communicate_group_():
+    return _hcg
+
+
+def distributed_model(model):
+    import sys
+    return _model_mod.distributed_model(model, sys.modules[__name__])
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    """fleet/optimizer.py:67 parity."""
+    return HybridParallelOptimizer(optimizer, _hcg,
+                                   strategy or _user_defined_strategy)
+
+
+# -- role facade (fleet.py worker/server API) --------------------------------
+
+def worker_index():
+    return _role_maker.worker_index() if _role_maker else 0
+
+
+def worker_num():
+    import jax
+    return _role_maker.worker_num() if _role_maker else jax.process_count()
+
+
+def is_first_worker():
+    return worker_index() == 0
+
+
+def is_worker():
+    return _role_maker.is_worker() if _role_maker else True
+
+
+def is_server():
+    return _role_maker.is_server() if _role_maker else False
+
+
+def worker_endpoints(to_string=False):
+    eps = _role_maker.get_trainer_endpoints() if _role_maker else []
+    return ",".join(eps) if to_string else eps
+
+
+def server_num():
+    return _role_maker.server_num() if _role_maker else 0
+
+
+def server_endpoints(to_string=False):
+    eps = _role_maker.get_pserver_endpoints() if _role_maker else []
+    return ",".join(eps) if to_string else eps
+
+
+def barrier_worker():
+    from .. import collective as coll
+    coll.barrier()
+
+
+def init_worker(scopes=None):
+    pass
+
+
+def init_server(*args, **kwargs):
+    pass
+
+
+def run_server():
+    pass
+
+
+def stop_worker():
+    pass
+
+
+def save(dirname, feed=None, fetch=None, **configs):
+    """fleet.py:778 save facade: delegates to framework save."""
+    pass
+
+
+def save_persistables(executor, dirname, main_program=None, mode=0):
+    pass
+
+
+class UtilBase:
+    def all_reduce(self, input, mode="sum", comm_world="worker"):  # noqa: A002
+        arr = np.asarray(input)
+        return arr  # single-controller view
+
+    def barrier(self, comm_world="worker"):
+        barrier_worker()
+
+    def get_file_shard(self, files):
+        n = worker_num()
+        i = worker_index()
+        per = len(files) // n
+        rem = len(files) % n
+        start = i * per + min(i, rem)
+        end = start + per + (1 if i < rem else 0)
+        return files[start:end]
+
+
+util = UtilBase()

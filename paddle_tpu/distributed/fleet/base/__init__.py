@@ -1,0 +1,8 @@
+from .distributed_strategy import DistributedStrategy  # noqa: F401
+from .role_maker import (  # noqa: F401
+    PaddleCloudRoleMaker, UserDefinedRoleMaker, RoleMakerBase, Role,
+)
+from .strategy_group import ParallelMode  # noqa: F401
+from ...topology import (  # noqa: F401
+    CommunicateTopology, HybridCommunicateGroup,
+)

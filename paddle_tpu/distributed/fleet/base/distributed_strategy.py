@@ -1,0 +1,113 @@
+"""DistributedStrategy — parity with
+python/paddle/distributed/fleet/base/distributed_strategy.py (which wraps
+framework/distributed_strategy.proto).  Proto-free per SURVEY §5.6: one typed
+config tree of plain attributes + `*_configs` dicts, covering the Appendix-A
+capability checklist.  Toggles whose mechanism is GPU-specific (dgc,
+fp16_allreduce, heter ps) are accepted and recorded but lower to the
+TPU-native equivalent or a documented no-op.
+"""
+from __future__ import annotations
+
+import copy
+
+
+_DEFAULTS = {
+    # reference defaults from distributed_strategy.proto
+    "a_sync_configs": {"k_steps": -1, "max_merge_var_num": 1,
+                       "send_queue_size": 16, "independent_recv_thread": False,
+                       "thread_pool_size": 1, "send_wait_times": 1,
+                       "runtime_split_send_recv": False, "launch_barrier": True,
+                       "heter_worker_device_guard": "cpu", "lr_decay_steps": 10,
+                       "use_ps_gpu": 0, "use_gpu_graph": 0},
+    "amp_configs": {"init_loss_scaling": 32768.0, "incr_every_n_steps": 1000,
+                    "decr_every_n_nan_or_inf": 2, "incr_ratio": 2.0,
+                    "decr_ratio": 0.8, "use_dynamic_loss_scaling": True,
+                    "custom_white_list": [], "custom_black_list": [],
+                    "custom_black_varnames": [], "use_pure_fp16": False,
+                    "use_fp16_guard": True, "use_optimizer_fp16": False,
+                    "use_bf16": True},  # TPU: bf16 is the native half type
+    "recompute_configs": {"checkpoints": [], "enable_offload": False,
+                          "checkpoint_shape": []},
+    "sharding_configs": {"sharding_segment_strategy": "segment_broadcast_MB",
+                         "segment_broadcast_MB": 32.0, "segment_anchors": [],
+                         "sharding_degree": 8, "mp_degree": 1,
+                         "dp_degree": 1, "hybrid_dp": False,
+                         "gradient_merge_acc_step": 1, "optimize_offload": False,
+                         "pp_allreduce_in_optimize": False, "pp_degree": 1,
+                         "optimize_cast": False, "stage": 1},
+    "pipeline_configs": {"micro_batch_size": 1, "accumulate_steps": 1,
+                         "schedule_mode": "1F1B", "p2p_cache_shape": True,
+                         "enable_partial_send_recv": True},
+    "hybrid_configs": {"dp_degree": -1, "mp_degree": 1, "pp_degree": 1,
+                       "sharding_degree": 1, "sep_degree": 1,
+                       "order": ["dp", "pp", "sharding", "mp"]},
+    "tensor_parallel_configs": {"tensor_parallel_degree": 1,
+                                "tensor_init_seed": -1},
+    "localsgd_configs": {"k_steps": 1, "begin_step": 1},
+    "adaptive_localsgd_configs": {"init_k_steps": 1, "begin_step": 1},
+    "dgc_configs": {"rampup_begin_step": 0, "rampup_step": 1,
+                    "sparsity": [0.999]},
+    "gradient_merge_configs": {"k_steps": 1, "avg": True},
+    "lars_configs": {"lars_coeff": 0.001, "lars_weight_decay": 0.0005,
+                     "epsilon": 0.0, "exclude_from_weight_decay": []},
+    "lamb_configs": {"lamb_weight_decay": 0.01,
+                     "exclude_from_weight_decay": []},
+    "gradient_scale_configs": {"scale_strategy": "avg"},
+}
+
+_FLAGS = ["a_sync", "amp", "asp", "recompute", "fuse_all_reduce_ops",
+          "sharding", "fuse_grad_merge", "pipeline",
+          "without_graph_optimization", "tensor_parallel", "localsgd",
+          "adaptive_localsgd", "dgc", "fp16_allreduce", "gradient_merge",
+          "lars", "lamb", "heter_ccl_mode", "is_fl_ps_mode",
+          "find_unused_parameters", "fuse_grad_size_in_MB", "last_comm_group_size_MB"]
+
+
+class DistributedStrategy:
+    def __init__(self):
+        for f in _FLAGS:
+            object.__setattr__(self, "_" + f, False)
+        self._fuse_all_reduce_ops = True
+        self._fuse_grad_size_in_MB = 32
+        self._last_comm_group_size_MB = 1
+        self._configs = copy.deepcopy(_DEFAULTS)
+        self.auto_search = False
+        self.semi_auto = False
+
+    # flags: plain properties so `strategy.amp = True` works like the reference
+    def __getattr__(self, name):
+        if name.endswith("_configs"):
+            cfgs = object.__getattribute__(self, "_configs")
+            if name in cfgs:
+                return cfgs[name]
+        if "_" + name in self.__dict__:
+            return self.__dict__["_" + name]
+        raise AttributeError(name)
+
+    def __setattr__(self, name, value):
+        if name.endswith("_configs") and not name.startswith("_"):
+            cfgs = self.__dict__.setdefault("_configs", copy.deepcopy(_DEFAULTS))
+            base = cfgs.setdefault(name, {})
+            if base:
+                unknown = set(value) - set(base)
+                if unknown:
+                    # reference check_configs_key raises on typo'd keys
+                    raise ValueError(
+                        f"unknown key(s) {sorted(unknown)} for {name}; "
+                        f"valid keys: {sorted(base)}")
+            base.update(value)
+            return
+        if name in _FLAGS:
+            object.__setattr__(self, "_" + name, value)
+            return
+        object.__setattr__(self, name, value)
+
+    def __deepcopy__(self, memo):
+        s = DistributedStrategy()
+        s.__dict__.update(copy.deepcopy(
+            {k: v for k, v in self.__dict__.items()}, memo))
+        return s
+
+    def __repr__(self):
+        on = [f for f in _FLAGS if getattr(self, "_" + f, False) is True]
+        return f"DistributedStrategy(enabled={on}, hybrid={self.hybrid_configs})"

@@ -1,0 +1,105 @@
+"""RoleMakers — parity with fleet/base/role_maker.py (RoleMakerBase:369,
+PaddleCloudRoleMaker:526, UserDefinedRoleMaker:1112): derive this process's
+role/rank/peer endpoints from the PADDLE_* env contract set by the launcher.
+"""
+from __future__ import annotations
+
+import os
+
+
+class Role:
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+    COORDINATOR = 5
+
+
+class RoleMakerBase:
+    def __init__(self):
+        self._worker_endpoints = []
+        self._server_endpoints = []
+        self._role_is_generated = False
+        self._role = Role.WORKER
+        self._current_id = 0
+
+    def is_worker(self):
+        return self._role == Role.WORKER
+
+    def is_server(self):
+        return self._role == Role.SERVER
+
+    def is_first_worker(self):
+        return self.is_worker() and self._current_id == 0
+
+    def worker_index(self):
+        return self._current_id
+
+    def server_index(self):
+        return self._current_id
+
+    def rank(self):
+        return self._current_id
+
+    def worker_num(self):
+        return max(1, len(self._worker_endpoints))
+
+    def server_num(self):
+        return len(self._server_endpoints)
+
+    def get_trainer_endpoints(self):
+        return self._worker_endpoints
+
+    def get_pserver_endpoints(self):
+        return self._server_endpoints
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    """role_maker.py:526 parity: env-var cluster topology."""
+
+    def __init__(self, is_collective=False, **kwargs):
+        super().__init__()
+        self._is_collective = is_collective
+        self._generate_role()
+
+    def _generate_role(self):
+        if self._role_is_generated:
+            return
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        self._worker_endpoints = [e for e in eps.split(",") if e]
+        self._server_endpoints = [
+            e for e in os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST",
+                                      "").split(",") if e]
+        role = os.environ.get("TRAINING_ROLE", "TRAINER")
+        self._role = Role.SERVER if role == "PSERVER" else Role.WORKER
+        self._current_id = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        if not self._worker_endpoints:
+            try:
+                import jax
+                n = int(os.environ.get("PADDLE_TRAINERS_NUM",
+                                       str(jax.process_count())))
+            except Exception:
+                n = 1
+            self._worker_endpoints = [f"127.0.0.1:{6170+i}" for i in range(n)]
+        self._role_is_generated = True
+
+    def _get_rank(self):
+        return self._current_id
+
+    def _worker_num(self):
+        return self.worker_num()
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    """role_maker.py:1112 parity: explicit topology instead of env."""
+
+    def __init__(self, is_collective=False, init_gloo=False, **kwargs):
+        self._kwargs = kwargs
+        super().__init__(is_collective=is_collective)
+
+    def _generate_role(self):
+        self._current_id = self._kwargs.get("current_id", 0)
+        self._worker_endpoints = self._kwargs.get("worker_endpoints", [])
+        self._server_endpoints = self._kwargs.get("server_endpoints", [])
+        self._role = self._kwargs.get("role", Role.WORKER)
+        self._role_is_generated = True

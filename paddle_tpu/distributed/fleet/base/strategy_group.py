@@ -1,0 +1,10 @@
+"""ParallelMode enum + strategy groups (fleet/base/topology.py ParallelMode)."""
+from __future__ import annotations
+
+
+class ParallelMode:
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+    SEGMENT_PARALLEL = 4

@@ -1,0 +1,197 @@
+"""Megatron-style tensor-parallel layers — parity with
+fleet/layers/mpu/mp_layers.py (`VocabParallelEmbedding`:39,
+`ColumnParallelLinear`:155, `RowParallelLinear`:293, `ParallelCrossEntropy`:438).
+
+Parameters hold **global logical shapes** tagged with a
+`jax.sharding.PartitionSpec` (`param._partition_spec`); the SPMD step builder
+(paddle_tpu.distributed.spmd) turns the tags into NamedShardings.  Under GSPMD
+jit the forward is plain math — XLA partitions the matmuls along 'mp' from the
+weight specs.  Under explicit shard_map (mp axis bound) the same forward sees
+*local shards* and the mp_ops collective pairs do the communication, matching
+the reference's autograd structure line for line.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .....core.op import apply_op
+from .....core.tensor import Tensor
+from .....nn import functional as F
+from .....nn.layer_base import Layer
+from .... import mesh as mesh_mod
+from ....topology import get_hybrid_communicate_group
+from . import mp_ops
+
+
+def _mp_info():
+    hcg = get_hybrid_communicate_group()
+    if hcg is None:
+        return 1, 0, None
+    return (hcg.get_model_parallel_world_size(),
+            hcg.get_model_parallel_rank(),
+            hcg.get_model_parallel_group())
+
+
+class VocabParallelEmbedding(Layer):
+    """mp_layers.py:39: embedding table row-sharded over the vocab dim."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.world_size, self.rank, group = _mp_info()
+        self.mp_group = mp_group or group
+        self.is_mp = self.world_size > 1
+        if num_embeddings % max(self.world_size, 1) != 0:
+            raise ValueError(
+                f"vocab size {num_embeddings} not divisible by mp degree "
+                f"{self.world_size}")
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.per_part_size = num_embeddings // max(self.world_size, 1)
+        self.weight = self.create_parameter(
+            attr=weight_attr, shape=[num_embeddings, embedding_dim],
+            dtype=self._dtype)
+        self.weight.is_distributed = self.is_mp
+        self.weight._partition_spec = P("mp", None)
+
+    def forward(self, x):
+        axis = getattr(self.mp_group, "axis_name", None) or "mp"
+        if self.is_mp and mesh_mod.axis_bound(axis):
+            per_part = self.per_part_size
+
+            def raw(tbl, idx):
+                i = jax.lax.axis_index(axis)
+                start = i * per_part
+                shifted = idx - start
+                valid = (shifted >= 0) & (shifted < tbl.shape[0])
+                safe = jnp.clip(shifted, 0, tbl.shape[0] - 1)
+                out = jnp.where(valid[..., None], jnp.take(tbl, safe, axis=0), 0)
+                return jax.lax.psum(out, axis)
+
+            return apply_op(raw, "c_embedding", (self.weight, x), {})
+        out = F.embedding(x, self.weight)
+        return _constrain(out, P(None))
+
+
+class ColumnParallelLinear(Layer):
+    """mp_layers.py:155: weight column-sharded; optional output all-gather."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=None, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.world_size, self.rank, group = _mp_info()
+        self.mp_group = mp_group or group
+        self.is_mp = self.world_size > 1
+        self.gather_output = gather_output
+        if out_features % max(self.world_size, 1) != 0:
+            raise ValueError(
+                f"out_features {out_features} not divisible by mp degree "
+                f"{self.world_size}")
+        self.out_features_per_partition = out_features // max(self.world_size, 1)
+        self.weight = self.create_parameter(
+            attr=weight_attr, shape=[in_features, out_features],
+            dtype=self._dtype)
+        self.weight.is_distributed = self.is_mp
+        self.weight._partition_spec = P(None, "mp")
+        if has_bias or has_bias is None:
+            self.bias = self.create_parameter(
+                attr=None, shape=[out_features], dtype=self._dtype,
+                is_bias=True)
+            self.bias.is_distributed = self.is_mp
+            self.bias._partition_spec = P("mp")
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        if self.is_mp:
+            x = mp_ops._c_identity(x, group=self.mp_group)
+        out = F.linear(x, self.weight, self.bias)
+        out = _constrain(out, P(*([None] * (out.ndim - 1) + ["mp"])))
+        if self.is_mp and self.gather_output:
+            out = mp_ops._c_concat(out, group=self.mp_group)
+            out = _constrain(out, P(None))
+        return out
+
+
+class RowParallelLinear(Layer):
+    """mp_layers.py:293: weight row-sharded; output partial-sum allreduced."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.world_size, self.rank, group = _mp_info()
+        self.mp_group = mp_group or group
+        self.is_mp = self.world_size > 1
+        self.input_is_parallel = input_is_parallel
+        if in_features % max(self.world_size, 1) != 0:
+            raise ValueError(
+                f"in_features {in_features} not divisible by mp degree "
+                f"{self.world_size}")
+        self.weight = self.create_parameter(
+            attr=weight_attr, shape=[in_features, out_features],
+            dtype=self._dtype)
+        self.weight.is_distributed = self.is_mp
+        self.weight._partition_spec = P("mp", None)
+        if has_bias:
+            # bias applied after the allreduce, replicated (reference keeps it
+            # un-sharded and adds on every rank post-allreduce)
+            self.bias = self.create_parameter(
+                attr=None, shape=[out_features], dtype=self._dtype,
+                is_bias=True)
+            self.bias._partition_spec = P(None)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        if self.is_mp and not self.input_is_parallel:
+            x = mp_ops._c_split(x, group=self.mp_group)
+        out = F.linear(x, self.weight)
+        if self.is_mp:
+            out = mp_ops._mp_allreduce(out, group=self.mp_group)
+        out = _constrain(out, P(None))
+        if self.bias is not None:
+            out = out + self.bias
+        return out
+
+
+class ParallelCrossEntropy(Layer):
+    """mp_layers.py:438: softmax-CE over class-dim-sharded logits."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.world_size, self.rank, group = _mp_info()
+        self.mp_group = mp_group or group
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):  # noqa: A002
+        return mp_ops._c_softmax_with_cross_entropy(
+            input, label, group=self.mp_group, ignore_index=self.ignore_index)
+
+
+def _constrain(t: Tensor, spec: P):
+    """Attach a GSPMD sharding constraint when compiling over a mesh with an
+    'mp' axis; no-op otherwise (eager, no mesh, or explicit shard_map mode)."""
+    mesh = mesh_mod.get_global_mesh()
+    if mesh is None or "mp" not in mesh.axis_names or \
+            mesh.shape.get("mp", 1) == 1 or mesh_mod.axis_bound("mp"):
+        return t
+    if not isinstance(t, Tensor):
+        return t
+    try:
+        used = [a for s in spec for a in (s if isinstance(s, tuple) else (s,))
+                if a is not None]
+        if any(u not in mesh.axis_names for u in used):
+            return t
+        val = jax.lax.with_sharding_constraint(
+            t._value, jax.sharding.NamedSharding(mesh, spec))
+        return Tensor(val, stop_gradient=t.stop_gradient, _internal=True) \
+            if t.stop_gradient else apply_op(
+                lambda x: jax.lax.with_sharding_constraint(
+                    x, jax.sharding.NamedSharding(mesh, spec)),
+                "sharding_constraint", (t,), {})
+    except Exception:
+        return t

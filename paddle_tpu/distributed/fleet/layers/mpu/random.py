@@ -1,0 +1,88 @@
+"""TP-aware RNG — parity with fleet/layers/mpu/random.py
+(`RNGStatesTracker`:32, `model_parallel_random_seed`:86).
+
+Dropout inside a tensor-parallel block must differ across mp shards (each shard
+holds different activations) while everything outside must match.  The
+reference swaps CUDA generator states; here each named state is a distinct
+JAX PRNG key stack pushed onto the framework's functional RNG
+(paddle_tpu.core.random).  Inside a shard_map trace the key is additionally
+folded with `lax.axis_index('mp')` so per-shard streams diverge — the
+trace-safe analog of per-rank local seeds.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+from .....core import random as random_mod
+from .... import mesh as mesh_mod
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def add(self, name, seed):
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already exists")
+        if name in self.states_:
+            raise ValueError(f"state {name} already exists")
+        self.seeds_.add(seed)
+        self.states_[name] = jax.random.key(int(seed))
+
+    def get_states_tracker(self):
+        return dict(self.states_)
+
+    def set_states_tracker(self, states):
+        self.states_ = states
+
+    @contextlib.contextmanager
+    def rng_state(self, name=MODEL_PARALLEL_RNG):
+        if name not in self.states_:
+            raise ValueError(f"state {name} does not exist")
+        key = self.states_[name]
+        if mesh_mod.axis_bound("mp"):
+            key = jax.random.fold_in(key, jax.lax.axis_index("mp"))
+        with random_mod.push_key(key):
+            yield
+        # advance the stored stream so successive scopes differ
+        self.states_[name], _ = jax.random.split(self.states_[name])
+
+
+_RNG_STATE_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _RNG_STATE_TRACKER
+
+
+def model_parallel_random_seed(seed=None):
+    """random.py:86 parity: global seed shared across mp ranks, local seed
+    offset by mp rank (trace-level offset happens in rng_state)."""
+    from ....topology import get_hybrid_communicate_group
+    hcg = get_hybrid_communicate_group()
+    rank = hcg.get_model_parallel_rank() if hcg else 0
+    if seed:
+        global_seed = seed
+        local_seed = seed * 1024 + rank * 100
+    else:
+        global_seed = 100
+        local_seed = 41000 + rank * 100
+
+    _RNG_STATE_TRACKER.reset()
+    _RNG_STATE_TRACKER.add(MODEL_PARALLEL_RNG, local_seed)
+    random_mod.seed(global_seed)
+
+
+@contextlib.contextmanager
+def get_rng_state(name=MODEL_PARALLEL_RNG):
+    with _RNG_STATE_TRACKER.rng_state(name):
+        yield

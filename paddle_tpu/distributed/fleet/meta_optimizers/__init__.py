@@ -1,0 +1,3 @@
+from .dygraph_optimizer.hybrid_parallel_optimizer import (  # noqa: F401
+    HybridParallelOptimizer,
+)

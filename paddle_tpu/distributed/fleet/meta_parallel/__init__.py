@@ -1,0 +1,13 @@
+from .meta_parallel_base import (  # noqa: F401
+    MetaParallelBase, ShardingParallel, TensorParallel,
+)
+from .parallel_layers.pp_layers import (  # noqa: F401
+    LayerDesc, PipelineLayer, SharedLayerDesc,
+)
+from .pipeline_parallel import (  # noqa: F401
+    PipelineParallel, PipelineParallelWithInterleave,
+)
+from ..layers.mpu import (  # noqa: F401
+    ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear,
+    VocabParallelEmbedding, get_rng_state_tracker,
+)

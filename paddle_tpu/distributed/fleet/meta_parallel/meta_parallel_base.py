@@ -1,0 +1,62 @@
+"""Meta-parallel wrappers — parity with fleet/meta_parallel/
+(meta_parallel_base.py MetaParallelBase, tensor_parallel.py TensorParallel,
+sharding_parallel.py ShardingParallel).  fleet.distributed_model wraps the user
+model in one of these by parallel mode (fleet/model.py:162-196).
+"""
+from __future__ import annotations
+
+from ....nn.layer_base import Layer
+from ..utils import hybrid_parallel_util as hpu
+
+
+class MetaParallelBase(Layer):
+    def __init__(self, layers, hcg, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+        self._prepare_for_model()
+
+    def _prepare_for_model(self):
+        pass
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def parameters(self, *args, **kwargs):
+        return self._layers.parameters(*args, **kwargs)
+
+    def named_parameters(self, *args, **kwargs):
+        return self._layers.named_parameters(*args, **kwargs)
+
+    def __getattr__(self, name):
+        try:
+            return super().__getattr__(name)
+        except AttributeError:
+            inner = self.__dict__.get("_sub_layers", {}).get("_layers")
+            if inner is None:
+                raise
+            return getattr(inner, name)
+
+
+class TensorParallel(MetaParallelBase):
+    """tensor_parallel.py parity: broadcast non-distributed params across mp
+    at wrap time so replicated weights start identical."""
+
+    def _prepare_for_model(self):
+        if self._hcg and self._hcg.get_model_parallel_world_size() > 1:
+            hpu.broadcast_mp_parameters(self._layers, self._hcg)
+        if self._hcg and self._hcg.get_data_parallel_world_size() > 1:
+            hpu.broadcast_dp_parameters(self._layers, self._hcg)
+
+
+class ShardingParallel(MetaParallelBase):
+    def _prepare_for_model(self):
+        if self._hcg and self._hcg.get_sharding_parallel_world_size() > 1:
+            hpu.broadcast_sharding_parameters(self._layers, self._hcg)

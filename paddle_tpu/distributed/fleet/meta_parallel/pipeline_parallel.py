@@ -1,0 +1,85 @@
+"""Pipeline-parallel runtime — parity with
+fleet/meta_parallel/pipeline_parallel.py (`PipelineParallel`:108
+forward_backward_pipeline 1F1B, `PipelineParallelWithInterleave`:419).
+
+TPU-native design (SURVEY §7 hard-part #1): the reference hand-schedules
+micro-batch NCCL p2p between per-stage processes.  Under a single-controller
+XLA view the whole pipeline is ONE program: micro-batches are a `lax.scan`,
+stage placement is sharding (pp mesh axis), and inter-stage transfers lower to
+collective-permutes XLA overlaps with compute — the compiler realizes the
+1F1B-style overlap that section_worker.cc:159 hand-codes.  `train_batch`
+keeps the reference's exact signature/semantics (returns the averaged loss
+across micro-batches).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ....core.tensor import Tensor
+from ... import spmd
+from .meta_parallel_base import MetaParallelBase
+from .parallel_layers.pp_layers import PipelineLayer
+
+
+class PipelineParallel(MetaParallelBase):
+    def __init__(self, layers, hcg, strategy):
+        if not isinstance(layers, PipelineLayer):
+            raise TypeError(
+                "PipelineParallel expects a PipelineLayer "
+                "(fleet/meta_parallel/pipeline_parallel.py:?? same check)")
+        super().__init__(layers, hcg, strategy)
+        cfg = strategy.pipeline_configs if strategy is not None else {}
+        self.micro_batch_size = cfg.get("micro_batch_size", 1)
+        self.accumulate_steps = cfg.get("accumulate_steps", 1)
+        self.num_stages = hcg.get_pipe_parallel_world_size() if hcg else 1
+        self.stage_id = hcg.get_stage_id() if hcg else 0
+        self._train_step = None
+
+    def is_pipeline_first_stage(self):
+        return self.stage_id == 0
+
+    def is_pipeline_last_stage(self):
+        return self.stage_id == self.num_stages - 1
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        """pipeline_parallel.py:209 parity: one optimizer step over
+        accumulate_steps micro-batches; returns averaged loss."""
+        inputs, labels = data if isinstance(data, (tuple, list)) and \
+            len(data) == 2 else (data, None)
+        opt = getattr(optimizer, "_inner_opt", optimizer)
+        if self._train_step is None:
+            loss_fn = self._layers._loss_fn
+            self._train_step = spmd.ShardedTrainStep(
+                self._layers, opt,
+                loss_fn=loss_fn if loss_fn is not None else None,
+                accumulate_steps=self.accumulate_steps)
+        batch = (inputs, labels) if labels is not None else (inputs,)
+        loss = self._train_step(*batch)
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return loss
+
+    def eval_batch(self, data, compute_loss=True):
+        if self._train_step is not None:
+            self._train_step.sync_to_model()  # eval sees trained weights
+        inputs, labels = data if isinstance(data, (tuple, list)) and \
+            len(data) == 2 else (data, None)
+        out = self._layers(inputs if not isinstance(inputs, (tuple, list))
+                           else inputs[0])
+        if compute_loss and self._layers._loss_fn is not None:
+            return self._layers._loss_fn(out, labels)
+        return out
+
+    def _sync_to_model(self):
+        if self._train_step is not None:
+            self._train_step.sync_to_model()
+
+
+class PipelineParallelWithInterleave(PipelineParallel):
+    """pipeline_parallel.py:419: virtual-stage interleaved 1F1B.  Under XLA
+    the virtual-stage interleave is a scheduling decision the compiler makes;
+    the API class exists for parity and uses the same compiled path."""
+    pass

@@ -1,0 +1,40 @@
+"""fleet.distributed_model — parity with fleet/model.py:66 (mode dispatch at
+:162-196): wrap the user Layer for the active parallel mode."""
+from __future__ import annotations
+
+from ..parallel import DataParallel
+from .base.strategy_group import ParallelMode
+from .meta_parallel.meta_parallel_base import (ShardingParallel,
+                                               TensorParallel)
+from .meta_parallel.parallel_layers.pp_layers import PipelineLayer
+from .meta_parallel.pipeline_parallel import (PipelineParallel,
+                                              PipelineParallelWithInterleave)
+
+
+def distributed_model(model, fleet_obj=None):
+    if fleet_obj is None:
+        import sys
+        fleet_obj = sys.modules[__package__]
+    f = fleet_obj
+    hcg = f._hcg
+    strategy = f._user_defined_strategy
+
+    if hcg is None:
+        return DataParallel(model)
+
+    mode = hcg.get_parallel_mode()
+    if mode == ParallelMode.SHARDING_PARALLEL and hcg.get_pipe_parallel_world_size() == 1 \
+            and not isinstance(model, PipelineLayer):
+        return ShardingParallel(model, hcg, strategy)
+    if mode == ParallelMode.DATA_PARALLEL and not isinstance(model, PipelineLayer):
+        find_unused = False
+        if strategy is not None:
+            find_unused = getattr(strategy, "find_unused_parameters", False)
+        return DataParallel(model, group=hcg.get_data_parallel_group(),
+                            find_unused_parameters=find_unused)
+    if isinstance(model, PipelineLayer) or hcg.get_pipe_parallel_world_size() > 1:
+        interleave = getattr(model, "_num_virtual_pipeline_stages", 1) or 1
+        cls = PipelineParallelWithInterleave if interleave > 1 else \
+            PipelineParallel
+        return cls(model, hcg, strategy)
+    return TensorParallel(model, hcg, strategy)

@@ -1,0 +1,47 @@
+"""Hybrid-parallel utilities — parity with
+fleet/utils/hybrid_parallel_util.py (fused_allreduce_gradients,
+broadcast_dp_parameters / broadcast_mp_parameters / broadcast_sharding_parameters).
+
+In the GSPMD train step these are layout annotations (grad reduction is part
+of the compiled backward); the eager fallbacks below serve the eager hybrid
+optimizer path and API parity.
+"""
+from __future__ import annotations
+
+from ... import collective as coll
+
+
+def fused_allreduce_gradients(parameter_list, hcg):
+    group = hcg.get_data_parallel_group() if hcg else None
+    n = group.nranks if group else 1
+    if n <= 1:
+        return
+    for p in parameter_list:
+        if getattr(p, "grad", None) is not None:
+            coll.all_reduce(p.grad, group=group)
+            p.grad._replace_(p.grad._value / n)
+
+
+def broadcast_dp_parameters(model, hcg):
+    for p in model.parameters():
+        coll.broadcast(p, src=0, group=hcg.get_data_parallel_group())
+
+
+def broadcast_mp_parameters(model, hcg):
+    for p in model.parameters():
+        if not getattr(p, "is_distributed", False):
+            coll.broadcast(p, src=0, group=hcg.get_model_parallel_group())
+
+
+def broadcast_sharding_parameters(model, hcg):
+    for p in model.parameters():
+        coll.broadcast(p, src=0, group=hcg.get_sharding_parallel_group())
+
+
+def broadcast_sep_parameters(model, hcg):
+    pass
+
+
+def sharding_reduce_gradients(parameter_list, hcg):
+    fused_allreduce_gradients(
+        parameter_list, hcg) if hcg else None

@@ -1,0 +1,81 @@
+"""Activation recomputation — parity with fleet/utils/recompute.py
+(`RecomputeFunction` PyLayer:207, RNG state replay :58, user API `recompute`:350).
+
+The reference saves RNG states, drops activations and re-runs forward inside
+its PyLayer backward.  TPU-native this is `jax.checkpoint` (remat): the
+segment's primals are dropped by XLA and recomputed in the backward pass;
+RNG replay is automatic because framework randomness is functional (the same
+key produces the same dropout mask in the replay).  Works both eagerly (the
+vjp built by apply_op sees the remat) and inside the jitted SPMD train step.
+"""
+from __future__ import annotations
+
+import jax
+
+from ....core import random as random_mod
+from ....core.op import apply_op
+from ....core.tensor import Tensor
+from ....nn.functional_call import functional_call
+from ....nn.layer_base import Layer
+
+
+def recompute(function, *args, preserve_rng_state=True, use_reentrant=True,
+              **kwargs):
+    """fleet/utils/recompute.py:350 parity."""
+    if isinstance(function, Layer):
+        entries = function.state_dict()
+        names = list(entries.keys())
+        tensors = [entries[k] for k in names]
+        n = len(names)
+
+        def raw(*vals):
+            values = dict(zip(names, vals[:n]))
+            call_args = tuple(
+                Tensor(a, _internal=True) if isinstance(a, jax.Array) else a
+                for a in vals[n:])
+            out, _ = functional_call(function, values, call_args, kwargs)
+            return jax.tree_util.tree_map(
+                lambda t: t._value if isinstance(t, Tensor) else t, out,
+                is_leaf=lambda x: isinstance(x, Tensor))
+
+        key = random_mod.next_key() if preserve_rng_state else None
+
+        def with_rng(*vals):
+            if key is None:
+                return raw(*vals)
+            with random_mod.push_key(key):
+                return raw(*vals)
+
+        ckpt = jax.checkpoint(with_rng)
+        return apply_op(ckpt, "recompute", (*tensors, *args), {})
+
+    # plain callable: differentiate w.r.t. tensor args only
+    def raw_fn(*vals):
+        call_args = tuple(
+            Tensor(a, _internal=True) if isinstance(a, jax.Array) else a
+            for a in vals)
+        out = function(*call_args, **kwargs)
+        return jax.tree_util.tree_map(
+            lambda t: t._value if isinstance(t, Tensor) else t, out,
+            is_leaf=lambda x: isinstance(x, Tensor))
+
+    ckpt = jax.checkpoint(raw_fn)
+    return apply_op(ckpt, "recompute", args, {})
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """incubate recompute_sequential parity: chunk a Sequential into remat
+    segments."""
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else int(ctx or 1)
+    layers = list(functions) if not isinstance(functions, Layer) else \
+        list(functions.children())
+    if not layers:
+        return functions(*args, **kwargs)
+    chunk = max(1, len(layers) // max(1, segments))
+    out = args
+    import paddle_tpu.nn as nn
+    for i in range(0, len(layers), chunk):
+        seg = nn.Sequential(*layers[i:i + chunk])
+        res = recompute(seg, *out, **kwargs)
+        out = res if isinstance(res, tuple) else (res,)
+    return out[0] if len(out) == 1 else out

@@ -1,0 +1,75 @@
+"""Global device-mesh state.
+
+TPU-native replacement for the reference's ring/communicator registries
+(paddle/fluid/platform/collective_helper.h:70 `NCCLCommContext` and
+paddle/fluid/distributed/collective/ProcessGroup.h): instead of NCCL rings
+keyed by ring_id, parallelism is expressed as named axes of one
+``jax.sharding.Mesh``; XLA emits the collectives over ICI/DCN (SURVEY §5.8).
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+_GLOBAL_MESH: Mesh | None = None
+
+
+def build_mesh(shape: Sequence[int], axis_names: Sequence[str],
+               devices=None) -> Mesh:
+    """Create a Mesh; `shape` may contain one -1 (inferred from device count)."""
+    devices = list(devices if devices is not None else jax.devices())
+    shape = list(shape)
+    if -1 in shape:
+        known = int(np.prod([s for s in shape if s != -1]))
+        shape[shape.index(-1)] = len(devices) // known
+    n = int(np.prod(shape))
+    if n > len(devices):
+        raise ValueError(
+            f"mesh shape {shape} needs {n} devices, have {len(devices)}")
+    arr = np.array(devices[:n]).reshape(shape)
+    return Mesh(arr, tuple(axis_names))
+
+
+def set_global_mesh(mesh: Mesh | None):
+    global _GLOBAL_MESH
+    _GLOBAL_MESH = mesh
+
+
+def get_global_mesh() -> Mesh | None:
+    return _GLOBAL_MESH
+
+
+def axis_bound(name) -> bool:
+    """True when `name` is a bound SPMD axis in the current trace (i.e. we are
+    inside shard_map over a mesh that has this axis)."""
+    if name is None:
+        return False
+    try:
+        jax.lax.axis_size(name)
+        return True
+    except (NameError, KeyError, ValueError, TypeError):
+        return False
+    except Exception:
+        return False
+
+
+def sharding_for(spec: PartitionSpec, mesh: Mesh | None = None):
+    mesh = mesh or _GLOBAL_MESH
+    if mesh is None:
+        raise RuntimeError("no global mesh set; call init_parallel_env or "
+                           "fleet.init(is_collective=True) first")
+    return NamedSharding(mesh, spec)
+
+
+@contextlib.contextmanager
+def global_mesh(mesh: Mesh):
+    prev = _GLOBAL_MESH
+    set_global_mesh(mesh)
+    try:
+        yield mesh
+    finally:
+        set_global_mesh(prev)
