@@ -1,0 +1,15 @@
+"""Flagship model families built on the framework (GPT first; BERT/ERNIE,
+vision detection configs follow the same pattern)."""
+from .gpt import (  # noqa: F401
+    GPT_CONFIGS,
+    GPTConfig,
+    GPTDecoderLayer,
+    GPTEmbeddings,
+    GPTForPretraining,
+    GPTModel,
+    GPTPretrainingCriterion,
+    build_gpt,
+    gpt_config,
+    gpt_num_params,
+    gpt_train_flops_per_token,
+)

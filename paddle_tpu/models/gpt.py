@@ -1,0 +1,346 @@
+"""GPT model family — the flagship decoder-only LM, TPU-first.
+
+Parity target: the FleetX GPT-3 pretraining recipe the reference's hybrid
+parallel stack exists to serve (SURVEY.md §6 north star: GPT-3 1.3B at
+>=35% MFU).  The reference implements this model with fused CUDA ops
+(paddle/fluid/operators/fused/fused_multi_transformer_op.cu,
+fused_attention_op.cu) driven by fleet's mpu layers
+(fleet/layers/mpu/mp_layers.py:39,155,293,438).  Here the same architecture is
+written once in terms of:
+
+* mpu TP layers (VocabParallelEmbedding / ColumnParallelLinear /
+  RowParallelLinear) whose parameters carry PartitionSpecs — GSPMD partitions
+  the matmuls over the 'mp' mesh axis;
+* `scaled_dot_product_attention`, which routes to the Pallas flash-attention
+  kernel on TPU (paddle_tpu/kernels/flash_attention.py) — the analog of the
+  reference's fmha_ref.h, minus the S×S materialisation;
+* `jax.checkpoint`-backed `recompute` for activation checkpointing
+  (fleet/utils/recompute.py:350 parity);
+* sequence-axis sharding constraints so long sequences can shard over a
+  'sep' mesh axis (context parallelism — a TPU extension; the reference has
+  none, SURVEY.md §5.7).
+
+Everything is global-shape SPMD: no per-rank branches, no explicit p2p.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+from jax.sharding import PartitionSpec as P
+
+from ..core.tensor import Tensor
+from ..distributed.fleet.layers.mpu.mp_layers import (
+    ColumnParallelLinear,
+    ParallelCrossEntropy,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+    _constrain,
+    _mp_info,
+)
+from ..distributed.fleet.utils.recompute import recompute
+from ..nn import functional as F
+from ..nn.initializer import Normal
+from ..nn.layer.common import Dropout, Embedding, Linear
+from ..nn.layer.container import LayerList
+from ..nn.layer.norm import LayerNorm
+from ..nn.layer_base import Layer, ParamAttr
+from ..ops.linalg import matmul
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 0  # 0 -> 4*hidden
+    max_position_embeddings: int = 1024
+    hidden_dropout_prob: float = 0.1
+    attention_dropout_prob: float = 0.1
+    initializer_range: float = 0.02
+    layer_norm_epsilon: float = 1e-5
+    use_recompute: bool = False
+    fuse_qkv: bool = True
+    activation: str = "gelu"
+
+    def __post_init__(self):
+        if not self.intermediate_size:
+            self.intermediate_size = 4 * self.hidden_size
+
+
+# FleetX / GPT-3 paper ladder (vocab padded to a 128 multiple for MXU tiling)
+GPT_CONFIGS = {
+    "gpt-tiny": dict(vocab_size=1024, hidden_size=128, num_layers=2,
+                     num_attention_heads=4, max_position_embeddings=256),
+    "gpt2-small-en": dict(hidden_size=768, num_layers=12,
+                          num_attention_heads=12),      # 125M
+    "gpt2-medium-en": dict(hidden_size=1024, num_layers=24,
+                           num_attention_heads=16),     # 345M
+    "gpt2-large-en": dict(hidden_size=1536, num_layers=24,
+                          num_attention_heads=16),      # 760M
+    "gpt3-1.3B-en": dict(hidden_size=2048, num_layers=24,
+                         num_attention_heads=16,
+                         max_position_embeddings=2048),
+    "gpt3-2.7B-en": dict(hidden_size=2560, num_layers=32,
+                         num_attention_heads=32,
+                         max_position_embeddings=2048),
+    "gpt3-6.7B-en": dict(hidden_size=4096, num_layers=32,
+                         num_attention_heads=32,
+                         max_position_embeddings=2048),
+    "gpt3-13B-en": dict(hidden_size=5120, num_layers=40,
+                        num_attention_heads=40,
+                        max_position_embeddings=2048),
+}
+
+
+def gpt_config(name: str, **overrides) -> GPTConfig:
+    base = dict(GPT_CONFIGS[name])
+    base.update(overrides)
+    return GPTConfig(**base)
+
+
+def _init_attr(std: float) -> ParamAttr:
+    return ParamAttr(initializer=Normal(mean=0.0, std=std))
+
+
+def _activation_spec() -> P:
+    """Batch over the data axes, and over 'sep' on the sequence dim only when
+    the mesh actually has that axis (context parallelism is opt-in; a spec
+    naming a missing axis would be dropped whole by _constrain)."""
+    from ..distributed import mesh as mesh_mod
+    mesh = mesh_mod.get_global_mesh()
+    seq = "sep" if (mesh is not None and "sep" in mesh.axis_names and
+                    mesh.shape.get("sep", 1) > 1) else None
+    return P(("dp", "sharding"), seq, None)
+
+
+class GPTSelfAttention(Layer):
+    """Causal self-attention: fused QKV column-parallel projection, flash
+    attention core, row-parallel output projection — the TP structure of the
+    reference's fused_attention_op.cu + mp_layers.py column/row pair."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        h, nh = config.hidden_size, config.num_attention_heads
+        assert h % nh == 0
+        self.num_heads = nh
+        self.head_dim = h // nh
+        self.mp_degree = max(_mp_info()[0], 1)
+        assert nh % self.mp_degree == 0, (
+            f"num heads {nh} not divisible by mp degree {self.mp_degree}")
+        wa = _init_attr(config.initializer_range)
+        self.qkv_proj = ColumnParallelLinear(
+            h, 3 * h, weight_attr=wa, has_bias=True, gather_output=False)
+        # reference scales the residual-path init by 1/sqrt(2*L)
+        out_std = config.initializer_range / math.sqrt(
+            2.0 * config.num_layers)
+        self.out_proj = RowParallelLinear(
+            h, h, weight_attr=_init_attr(out_std), has_bias=True,
+            input_is_parallel=True)
+        self.attn_dropout_prob = config.attention_dropout_prob
+        # QKV interleaving must keep each head's q,k,v on the same mp shard:
+        # shard over heads, i.e. weight columns grouped [3, nh, hd] with nh
+        # sharded. ColumnParallelLinear shards the flat 3h dim; reshape below
+        # to [.., 3, nh, hd] keeps GSPMD free to re-tile (it is a constraint,
+        # not a layout change).
+
+    def forward(self, x, cache=None, use_cache=False):
+        b, t = x.shape[0], x.shape[1]
+        qkv = self.qkv_proj(x)  # [B, T, 3H/mp-sharded]
+        qkv = qkv.reshape([b, t, 3, self.num_heads, self.head_dim])
+        qkv = _constrain(qkv, P(None, None, None, "mp", None))
+        q, k, v = (qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2])
+        if cache is not None:
+            from ..ops.manipulation import concat
+            k = concat([cache[0], k], axis=1)
+            v = concat([cache[1], v], axis=1)
+        out = F.scaled_dot_product_attention(
+            q, k, v, dropout_p=self.attn_dropout_prob,
+            is_causal=True, training=self.training)
+        out = out.reshape([b, t, self.num_heads * self.head_dim])
+        out = _constrain(out, P(None, None, "mp"))
+        out = self.out_proj(out)
+        if use_cache:
+            return out, (k, v)
+        return out
+
+
+class GPTMLP(Layer):
+    """Column→Row parallel FFN (reference fused_feedforward_op.cu shape)."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        h, ffn = config.hidden_size, config.intermediate_size
+        out_std = config.initializer_range / math.sqrt(2.0 * config.num_layers)
+        self.fc0 = ColumnParallelLinear(
+            h, ffn, weight_attr=_init_attr(config.initializer_range),
+            has_bias=True, gather_output=False)
+        self.fc1 = RowParallelLinear(
+            ffn, h, weight_attr=_init_attr(out_std), has_bias=True,
+            input_is_parallel=True)
+        self.act = getattr(F, config.activation)
+
+    def forward(self, x):
+        return self.fc1(self.act(self.fc0(x)))
+
+
+class GPTDecoderLayer(Layer):
+    """Pre-LN transformer block (the GPT-2/3 arrangement the reference's
+    FusedMultiTransformer implements with normalize_before=True)."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        eps = config.layer_norm_epsilon
+        self.norm1 = LayerNorm(config.hidden_size, epsilon=eps)
+        self.self_attn = GPTSelfAttention(config)
+        self.norm2 = LayerNorm(config.hidden_size, epsilon=eps)
+        self.mlp = GPTMLP(config)
+        self.dropout1 = Dropout(config.hidden_dropout_prob)
+        self.dropout2 = Dropout(config.hidden_dropout_prob)
+
+    def forward(self, x, cache=None, use_cache=False):
+        residual = x
+        y = self.norm1(x)
+        if use_cache:
+            y, new_cache = self.self_attn(y, cache=cache, use_cache=True)
+        else:
+            y = self.self_attn(y)
+            new_cache = None
+        x = residual + self.dropout1(y)
+        residual = x
+        y = self.mlp(self.norm2(x))
+        x = residual + self.dropout2(y)
+        if use_cache:
+            return x, new_cache
+        return x
+
+
+class GPTEmbeddings(Layer):
+    """Word (vocab-parallel) + learned position embeddings."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        wa = _init_attr(config.initializer_range)
+        self.word_embeddings = VocabParallelEmbedding(
+            config.vocab_size, config.hidden_size, weight_attr=wa)
+        self.position_embeddings = Embedding(
+            config.max_position_embeddings, config.hidden_size, weight_attr=wa)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+
+    def forward(self, input_ids, position_ids=None):
+        if position_ids is None:
+            from ..ops.creation import arange
+            t = input_ids.shape[1]
+            position_ids = arange(0, t, dtype="int64").reshape([1, t])
+        w = self.word_embeddings(input_ids)
+        p = self.position_embeddings(position_ids)
+        return self.dropout(w + p)
+
+
+class GPTModel(Layer):
+    """The transformer stack.  Output: hidden states [B, T, H]."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = GPTEmbeddings(config)
+        self.layers = LayerList(
+            [GPTDecoderLayer(config) for _ in range(config.num_layers)])
+        self.final_norm = LayerNorm(config.hidden_size,
+                                    epsilon=config.layer_norm_epsilon)
+
+    def forward(self, input_ids, position_ids=None, caches=None,
+                use_cache=False):
+        use_cache = use_cache or caches is not None
+        if caches is None and use_cache:
+            caches = [None] * len(self.layers)
+        if position_ids is None and use_cache and caches[0] is not None:
+            # incremental decode: offset positions by the cached key length
+            from ..ops.creation import arange
+            past, t = caches[0][0].shape[1], input_ids.shape[1]
+            position_ids = arange(past, past + t,
+                                  dtype="int64").reshape([1, t])
+        x = self.embeddings(input_ids, position_ids)
+        x = _constrain(x, _activation_spec())
+        new_caches = [] if use_cache else None
+        for i, layer in enumerate(self.layers):
+            if use_cache:
+                x, c = layer(x, cache=caches[i], use_cache=True)
+                new_caches.append(c)
+            elif self.config.use_recompute and self.training:
+                x = recompute(layer, x)
+            else:
+                x = layer(x)
+        x = self.final_norm(x)
+        if use_cache:
+            return x, new_caches
+        return x
+
+
+class GPTForPretraining(Layer):
+    """LM head tied to the (vocab-parallel) word embedding — logits are
+    vocab-sharded over 'mp', consumed by ParallelCrossEntropy without ever
+    gathering the [B,T,V] tensor (the reference's
+    c_softmax_with_cross_entropy_op.cu pattern)."""
+
+    def __init__(self, gpt: GPTModel):
+        super().__init__()
+        self.gpt = gpt
+
+    def forward(self, input_ids, position_ids=None):
+        x = self.gpt(input_ids, position_ids)
+        w = self.gpt.embeddings.word_embeddings.weight
+        logits = matmul(x, w, transpose_y=True)
+        return _constrain(logits, P(("dp", "sharding"), None, "mp"))
+
+
+class GPTPretrainingCriterion(Layer):
+    """Masked next-token cross entropy (FleetX pretraining loss)."""
+
+    def __init__(self, topo=None, ignore_index=-100):
+        super().__init__()
+        mp_degree = max(_mp_info()[0], 1)
+        self.mp = mp_degree > 1
+        self.ignore_index = ignore_index
+        self.parallel_loss = (ParallelCrossEntropy(ignore_index=ignore_index)
+                              if self.mp else None)
+
+    def forward(self, prediction_scores, masked_lm_labels, loss_mask=None):
+        if self.parallel_loss is not None:
+            loss = self.parallel_loss(prediction_scores, masked_lm_labels)
+        else:
+            loss = F.cross_entropy(prediction_scores,
+                                   masked_lm_labels.unsqueeze(-1),
+                                   ignore_index=self.ignore_index,
+                                   reduction="none", axis=-1)
+        loss = loss.reshape([-1]).astype("float32")
+        if loss_mask is not None:
+            m = loss_mask.reshape([-1]).astype("float32")
+            return (loss * m).sum() / m.sum().clip(min=1.0)
+        return loss.mean()
+
+
+def build_gpt(name_or_config="gpt-tiny", for_pretraining=True, **overrides):
+    cfg = (name_or_config if isinstance(name_or_config, GPTConfig)
+           else gpt_config(name_or_config, **overrides))
+    model = GPTModel(cfg)
+    if for_pretraining:
+        return GPTForPretraining(model)
+    return model
+
+
+def gpt_num_params(cfg: GPTConfig) -> int:
+    h, L, V, T = (cfg.hidden_size, cfg.num_layers, cfg.vocab_size,
+                  cfg.max_position_embeddings)
+    per_layer = 4 * h * h + 4 * h + 2 * h * cfg.intermediate_size \
+        + cfg.intermediate_size + h + 4 * h  # attn + mlp + 2 LN
+    return V * h + T * h + L * per_layer + 2 * h
+
+
+def gpt_train_flops_per_token(cfg: GPTConfig, seq_len: int) -> float:
+    """6*N + 12*L*h*s — the standard train-MFU accounting (fwd+bwd = 3x fwd;
+    fwd matmuls = 2*N per token; the 12*L*h*s attention term already carries
+    the 3x and the QK^T+AV pair)."""
+    return (6.0 * gpt_num_params(cfg) +
+            12.0 * cfg.num_layers * cfg.hidden_size * seq_len)

@@ -5,5 +5,9 @@ from .conv import *  # noqa: F401,F403
 from .pooling import *  # noqa: F401,F403
 from .norm import *  # noqa: F401,F403
 from .loss import *  # noqa: F401,F403
+from .attention import (  # noqa: F401
+    enable_flash_attention,
+    scaled_dot_product_attention,
+)
 from ...ops.manipulation import pad  # noqa: F401
 from ...ops.creation import one_hot  # noqa: F401
