@@ -1,0 +1,80 @@
+"""Benchmark: flagship GPT pretraining step on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Baseline (BASELINE.md north star): GPT at >=35% MFU — vs_baseline is
+measured MFU / 0.35, so >=1.0 beats the target.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+# bf16 peak FLOPs/s per chip by TPU generation (public spec sheets)
+_PEAK = {"v5 lite": 197e12, "v5e": 197e12, "v4": 275e12, "v5p": 459e12,
+         "v6": 918e12}
+
+
+def _peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "").lower()
+    for key, val in _PEAK.items():
+        if key in kind:
+            return val
+    return 197e12  # assume v5e-class
+
+
+def main():
+    import jax
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.models import (GPTPretrainingCriterion, build_gpt,
+                                   gpt_config, gpt_train_flops_per_token)
+
+    if on_tpu:
+        name, batch, seq, steps = "gpt2-small-en", 16, 1024, 20
+    else:  # CI/CPU smoke: tiny shapes, same code path
+        name, batch, seq, steps = "gpt-tiny", 2, 128, 3
+
+    cfg = gpt_config(name, max_position_embeddings=max(seq, 1024),
+                     hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+    paddle.seed(0)
+    model = build_gpt(cfg)
+    crit = GPTPretrainingCriterion()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters(),
+                                 weight_decay=0.01)
+    step = dist.make_train_step(model, opt, loss_fn=crit,
+                                compute_dtype="bfloat16" if on_tpu else None)
+    ids = np.random.RandomState(0).randint(
+        0, cfg.vocab_size, size=(batch, seq + 1)).astype(np.int64)
+    x, y = ids[:, :-1], ids[:, 1:]
+
+    loss = step(x, y)  # compile + warmup
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(x, y)
+    float(loss)  # block on the last step
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * steps / dt
+    flops_tok = gpt_train_flops_per_token(cfg, seq)
+    mfu = tokens_per_sec * flops_tok / _peak_flops(dev) if on_tpu else 0.0
+    print(json.dumps({
+        "metric": f"gpt_{name}_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.35, 4) if on_tpu else 0.0,
+    }))
+    print(f"# device={dev.device_kind} loss={float(loss):.4f} "
+          f"mfu={mfu:.3f} steps={steps} dt={dt:.2f}s", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -72,7 +72,7 @@ class VocabParallelEmbedding(Layer):
 
             return apply_op(raw, "c_embedding", (self.weight, x), {})
         out = F.embedding(x, self.weight)
-        return _constrain(out, P(None))
+        return _constrain(out, P(*([_U] * x.ndim), None))
 
 
 class ColumnParallelLinear(Layer):
@@ -109,10 +109,10 @@ class ColumnParallelLinear(Layer):
         if self.is_mp:
             x = mp_ops._c_identity(x, group=self.mp_group)
         out = F.linear(x, self.weight, self.bias)
-        out = _constrain(out, P(*([None] * (out.ndim - 1) + ["mp"])))
+        out = _constrain(out, P(*([_U] * (out.ndim - 1) + ["mp"])))
         if self.is_mp and self.gather_output:
             out = mp_ops._c_concat(out, group=self.mp_group)
-            out = _constrain(out, P(None))
+            out = _constrain(out, P(*([_U] * (out.ndim - 1)), None))
         return out
 
 
@@ -152,7 +152,7 @@ class RowParallelLinear(Layer):
         out = F.linear(x, self.weight)
         if self.is_mp:
             out = mp_ops._mp_allreduce(out, group=self.mp_group)
-        out = _constrain(out, P(None))
+        out = _constrain(out, P(*([_U] * (out.ndim - 1)), None))
         if self.bias is not None:
             out = out + self.bias
         return out
@@ -172,20 +172,39 @@ class ParallelCrossEntropy(Layer):
             input, label, group=self.mp_group, ignore_index=self.ignore_index)
 
 
+_U = P.UNCONSTRAINED
+
+
 def _constrain(t: Tensor, spec: P):
-    """Attach a GSPMD sharding constraint when compiling over a mesh with an
-    'mp' axis; no-op otherwise (eager, no mesh, or explicit shard_map mode)."""
+    """Attach a GSPMD sharding constraint.  Spec entries mean: axis name =
+    shard over it (dropped to UNCONSTRAINED when the mesh lacks the axis),
+    None = pin replicated, P.UNCONSTRAINED = let GSPMD decide.  No-op when
+    eager without a mesh, on a single-device mesh, or under explicit
+    shard_map (axes already bound)."""
     mesh = mesh_mod.get_global_mesh()
-    if mesh is None or "mp" not in mesh.axis_names or \
-            mesh.shape.get("mp", 1) == 1 or mesh_mod.axis_bound("mp"):
+    if mesh is None or not isinstance(t, Tensor):
         return t
-    if not isinstance(t, Tensor):
+    used = [a for s in spec for a in (s if isinstance(s, tuple) else (s,))
+            if a is not None and a is not _U]
+    if any(mesh_mod.axis_bound(a) for a in used):
         return t
+    if max(mesh.shape.values(), default=1) == 1:
+        return t
+    live = {a for a in used
+            if a in mesh.axis_names and mesh.shape.get(a, 1) > 1}
+    has_pin = any(s is None for s in spec)
+    if not live and not has_pin:
+        return t
+    cleaned = []
+    for s in spec:
+        if s is None or s is _U:
+            cleaned.append(s)
+            continue
+        axes = s if isinstance(s, tuple) else (s,)
+        kept = tuple(a for a in axes if a in live)
+        cleaned.append(kept[0] if len(kept) == 1 else (kept or _U))
+    spec = P(*cleaned)
     try:
-        used = [a for s in spec for a in (s if isinstance(s, tuple) else (s,))
-                if a is not None]
-        if any(u not in mesh.axis_names for u in used):
-            return t
         val = jax.lax.with_sharding_constraint(
             t._value, jax.sharding.NamedSharding(mesh, spec))
         return Tensor(val, stop_gradient=t.stop_gradient, _internal=True) \

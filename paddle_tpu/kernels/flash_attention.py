@@ -1,0 +1,351 @@
+"""Flash attention as Pallas TPU kernels — the framework's analog of the
+reference's fused CUDA attention family (paddle/fluid/operators/fused/
+fused_attention_op.cu, fmha_ref.h), which materialises the S×S score matrix.
+Here the online-softmax tiling keeps scores in VMEM tiles only:
+
+* forward: grid (B*H, Tq/bq, Tk/bk) with VMEM accumulators carried across the
+  kv-block grid dimension (TPU grids execute sequentially, so scratch persists
+  across the innermost dimension);
+* backward: two kernels (dq; dk/dv) recomputing the tile probabilities from
+  the saved logsumexp — the standard flash-attention-2 decomposition;
+* `jax.custom_vjp` ties them together so `jax.grad` through the train step
+  uses the fused backward.
+
+Layout [B, T, H, D] at the API (the reference fused-op convention), internally
+[(B*H), T, D].  MXU work is f32-accumulated (`preferred_element_type`).
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+# interpret mode runs the kernels on CPU (tests / debugging); set via
+# use_interpret_mode() before first call
+_INTERPRET = False
+
+
+def use_interpret_mode(flag: bool):
+    global _INTERPRET
+    _INTERPRET = bool(flag)
+
+
+def _block_sizes(tq, tk):
+    bq = min(512, tq)
+    bk = min(512, tk)
+    return bq, bk
+
+
+def _pad_to(x, axis, mult):
+    n = x.shape[axis]
+    pad = (-n) % mult
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+# -- forward ------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_i, l_i, *,
+                scale, causal, offset, bq, bk, nk, t_real):
+    i, j = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_i[:] = jnp.full_like(m_i, _NEG_INF)
+        l_i[:] = jnp.zeros_like(l_i)
+        acc[:] = jnp.zeros_like(acc)
+
+    live = True
+    if causal:
+        # kv block strictly above the diagonal band → nothing to do
+        live = j * bk <= i * bq + (bq - 1) + offset
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * jnp.float32(scale)
+        col = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        mask = col < t_real
+        if causal:
+            row = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            mask = mask & (col <= row + offset)
+        s = jnp.where(mask, s, jnp.float32(_NEG_INF))
+
+        m_prev = m_i[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = alpha * l_i[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+        acc[:] = acc[:] * alpha + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_i[:] = jnp.broadcast_to(m_new, m_i.shape)
+        l_i[:] = jnp.broadcast_to(l_new, l_i.shape)
+
+    @pl.when(j == nk - 1)
+    def _finish():
+        l = jnp.maximum(l_i[:, :1], jnp.float32(1e-30))
+        o_ref[0] = (acc[:] / l).astype(o_ref.dtype)
+        lse_ref[0] = m_i[:, :1] + jnp.log(l)
+
+
+def _flash_fwd(q, k, v, scale, causal):
+    """q,k,v: [BH, T, D] → (out [BH,Tq,D], lse [BH,Tq])."""
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    bq, bk = _block_sizes(tq, tk)
+    qp = _pad_to(q, 1, bq)
+    kp = _pad_to(k, 1, bk)
+    vp = _pad_to(v, 1, bk)
+    tqp, tkp = qp.shape[1], kp.shape[1]
+    nq, nk = tqp // bq, tkp // bk
+    offset = tk - tq  # causal diagonal shift for cached decode
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, offset=offset,
+        bq=bq, bk=bk, nk=nk, t_real=tk)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, j * 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, i * 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, i * 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, j * 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, j * 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tqp, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, tqp, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_INTERPRET,
+    )(qp, kp, vp)
+    return out[:, :tq], lse[:, :tq]  # lse: [BH, Tq, 1]
+
+
+# -- backward -----------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_acc, *, scale, causal, offset, bq, bk, nk, t_real):
+    i, j = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    live = True
+    if causal:
+        live = j * bk <= i * bq + (bq - 1) + offset
+
+    @pl.when(live)
+    def _compute():
+        q, k, v = q_ref[0], k_ref[0], v_ref[0]
+        do = do_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * jnp.float32(scale)
+        col = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        mask = col < t_real
+        if causal:
+            row = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            mask = mask & (col <= row + offset)
+        s = jnp.where(mask, s, jnp.float32(_NEG_INF))
+        p = jnp.exp(s - lse_ref[0])
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0]) * jnp.float32(scale)
+        dq_acc[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(j == nk - 1)
+    def _finish():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *,
+                    scale, causal, offset, bq, bk, nq, t_real):
+    j, i = pl.program_id(1), pl.program_id(2)  # j: kv block, i: q block
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    live = True
+    if causal:
+        live = j * bk <= i * bq + (bq - 1) + offset
+
+    @pl.when(live)
+    def _compute():
+        q, k, v = q_ref[0], k_ref[0], v_ref[0]
+        do = do_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * jnp.float32(scale)
+        col = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        mask = col < t_real
+        if causal:
+            row = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            mask = mask & (col <= row + offset)
+        s = jnp.where(mask, s, jnp.float32(_NEG_INF))
+        p = jnp.exp(s - lse_ref[0])
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0]) * jnp.float32(scale)
+        dk_acc[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(i == nq - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, o, lse, do, scale, causal):
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    bq, bk = _block_sizes(tq, tk)
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
+                    keepdims=True)  # [BH, Tq, 1]
+    qp, dop = _pad_to(q, 1, bq), _pad_to(do, 1, bq)
+    kp, vp = _pad_to(k, 1, bk), _pad_to(v, 1, bk)
+    # pad lse with a huge value (and delta with zeros): padded q rows then
+    # produce p=exp(-1e30-big)=0 contributions in the dkv kernel
+    lsep = _pad_to(lse, 1, bq)
+    lsep = lsep.at[:, tq:].set(1e30) if lsep.shape[1] > tq else lsep
+    deltap = _pad_to(delta, 1, bq)
+    tqp, tkp = qp.shape[1], kp.shape[1]
+    nq, nk = tqp // bq, tkp // bk
+    offset = tk - tq
+
+    dq_kernel = functools.partial(
+        _bwd_dq_kernel, scale=scale, causal=causal, offset=offset,
+        bq=bq, bk=bk, nk=nk, t_real=tk)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, j * 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, i * 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, i * 0)),
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, j * 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, j * 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, j * 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, j * 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, tqp, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_INTERPRET,
+    )(qp, kp, vp, dop, lsep, deltap)
+
+    dkv_kernel = functools.partial(
+        _bwd_dkv_kernel, scale=scale, causal=causal, offset=offset,
+        bq=bq, bk=bk, nq=nq, t_real=tk)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(bh, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, j * 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, i * 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, i * 0)),
+            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, j * 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, j * 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, j * 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, i * 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, i * 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tkp, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, tkp, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_INTERPRET,
+    )(qp, kp, vp, dop, lsep, deltap)
+    return dq[:, :tq], dk[:, :tk], dv[:, :tk]
+
+
+# -- custom_vjp glue ----------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash(q, k, v, scale, causal):
+    out, _ = _flash_fwd(q, k, v, scale, causal)
+    return out
+
+
+def _flash_fwd_rule(q, k, v, scale, causal):
+    out, lse = _flash_fwd(q, k, v, scale, causal)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd_rule(scale, causal, res, do):
+    q, k, v, out, lse = res
+    return _flash_bwd(q, k, v, out, lse, do, scale, causal)
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+# -- public API ---------------------------------------------------------------
+
+def flash_attention_bhtd(q, k, v, causal=True, scale=None):
+    """q,k,v: [BH or (B,H), T, D] jax arrays, 3D."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    return _flash(q, k, v, float(scale), bool(causal))
+
+
+def flash_attention_bthd(q, k, v, causal=True, scale=None):
+    """Paddle fused-op layout [B, T, H, D] (Tensor or jax.Array in/out)."""
+    from ..core.op import apply_op
+    from ..core.tensor import Tensor
+
+    def raw(qv, kv, vv):
+        b, tq, h, d = qv.shape
+        tk = kv.shape[1]
+        q3 = jnp.transpose(qv, (0, 2, 1, 3)).reshape(b * h, tq, d)
+        k3 = jnp.transpose(kv, (0, 2, 1, 3)).reshape(b * h, tk, d)
+        v3 = jnp.transpose(vv, (0, 2, 1, 3)).reshape(b * h, tk, d)
+        o3 = flash_attention_bhtd(q3, k3, v3, causal=causal, scale=scale)
+        return jnp.transpose(o3.reshape(b, h, tq, d), (0, 2, 1, 3))
+
+    if isinstance(q, Tensor):
+        return apply_op(raw, "flash_attention", (q, k, v), {})
+    return raw(q, k, v)

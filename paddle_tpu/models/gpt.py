@@ -29,8 +29,8 @@ from dataclasses import dataclass
 
 from jax.sharding import PartitionSpec as P
 
-from ..core.tensor import Tensor
 from ..distributed.fleet.layers.mpu.mp_layers import (
+    _U,
     ColumnParallelLinear,
     ParallelCrossEntropy,
     RowParallelLinear,
@@ -41,7 +41,7 @@ from ..distributed.fleet.layers.mpu.mp_layers import (
 from ..distributed.fleet.utils.recompute import recompute
 from ..nn import functional as F
 from ..nn.initializer import Normal
-from ..nn.layer.common import Dropout, Embedding, Linear
+from ..nn.layer.common import Dropout, Embedding
 from ..nn.layer.container import LayerList
 from ..nn.layer.norm import LayerNorm
 from ..nn.layer_base import Layer, ParamAttr
@@ -105,14 +105,9 @@ def _init_attr(std: float) -> ParamAttr:
 
 
 def _activation_spec() -> P:
-    """Batch over the data axes, and over 'sep' on the sequence dim only when
-    the mesh actually has that axis (context parallelism is opt-in; a spec
-    naming a missing axis would be dropped whole by _constrain)."""
-    from ..distributed import mesh as mesh_mod
-    mesh = mesh_mod.get_global_mesh()
-    seq = "sep" if (mesh is not None and "sep" in mesh.axis_names and
-                    mesh.shape.get("sep", 1) > 1) else None
-    return P(("dp", "sharding"), seq, None)
+    """Batch over the data axes, sequence over 'sep' (context parallelism —
+    _constrain drops whichever axes the live mesh lacks)."""
+    return P(("dp", "sharding"), "sep", None)
 
 
 class GPTSelfAttention(Layer):
@@ -149,7 +144,7 @@ class GPTSelfAttention(Layer):
         b, t = x.shape[0], x.shape[1]
         qkv = self.qkv_proj(x)  # [B, T, 3H/mp-sharded]
         qkv = qkv.reshape([b, t, 3, self.num_heads, self.head_dim])
-        qkv = _constrain(qkv, P(None, None, None, "mp", None))
+        qkv = _constrain(qkv, P(_U, _U, _U, "mp", _U))
         q, k, v = (qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2])
         if cache is not None:
             from ..ops.manipulation import concat
@@ -159,7 +154,7 @@ class GPTSelfAttention(Layer):
             q, k, v, dropout_p=self.attn_dropout_prob,
             is_causal=True, training=self.training)
         out = out.reshape([b, t, self.num_heads * self.head_dim])
-        out = _constrain(out, P(None, None, "mp"))
+        out = _constrain(out, P(_U, _U, "mp"))
         out = self.out_proj(out)
         if use_cache:
             return out, (k, v)
@@ -288,10 +283,17 @@ class GPTForPretraining(Layer):
         super().__init__()
         self.gpt = gpt
 
-    def forward(self, input_ids, position_ids=None):
-        x = self.gpt(input_ids, position_ids)
+    def forward(self, input_ids, position_ids=None, caches=None,
+                use_cache=False):
+        if use_cache or caches is not None:
+            x, new_caches = self.gpt(input_ids, position_ids, caches=caches,
+                                     use_cache=True)
+            return self.lm_head(x), new_caches
+        return self.lm_head(self.gpt(input_ids, position_ids))
+
+    def lm_head(self, hidden_states):
         w = self.gpt.embeddings.word_embeddings.weight
-        logits = matmul(x, w, transpose_y=True)
+        logits = matmul(hidden_states, w, transpose_y=True)
         return _constrain(logits, P(("dp", "sharding"), None, "mp"))
 
 
@@ -322,8 +324,16 @@ class GPTPretrainingCriterion(Layer):
 
 
 def build_gpt(name_or_config="gpt-tiny", for_pretraining=True, **overrides):
-    cfg = (name_or_config if isinstance(name_or_config, GPTConfig)
-           else gpt_config(name_or_config, **overrides))
+    if isinstance(name_or_config, GPTConfig):
+        import dataclasses
+        if "hidden_size" in overrides and "intermediate_size" not in overrides:
+            # let __post_init__ recompute 4*hidden instead of copying the
+            # stale materialized width
+            overrides["intermediate_size"] = 0
+        cfg = (dataclasses.replace(name_or_config, **overrides)
+               if overrides else name_or_config)
+    else:
+        cfg = gpt_config(name_or_config, **overrides)
     model = GPTModel(cfg)
     if for_pretraining:
         return GPTForPretraining(model)

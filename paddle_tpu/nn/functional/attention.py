@@ -47,18 +47,67 @@ def _sdpa_ref(q, k, v, mask, dropout_p, causal, scale, training):
     return jnp.swapaxes(out, 1, 2)  # [B, T, H, D]
 
 
+def _flash_ok(q) -> bool:
+    """Route to the Pallas kernel only on TPU, for non-trivial query lengths,
+    and only when the sequence axis isn't sharded (flash needs the full K per
+    shard; ring attention covers the 'sep'-sharded case)."""
+    if not _USE_FLASH or q.shape[1] < 128:
+        return False
+    from ...distributed import mesh as mesh_mod
+    if any(mesh_mod.axis_bound(a) for a in ("mp", "dp", "sharding", "sep")):
+        return False  # explicit shard_map mode: local shards, ref math
+    mesh = mesh_mod.get_global_mesh()
+    if mesh is not None and mesh.shape.get("sep", 1) > 1:
+        return False
+    try:
+        import jax.extend.backend as jexb
+        platform = jexb.get_backend().platform
+    except Exception:
+        platform = jax.default_backend()
+    return platform not in ("cpu",)
+
+
+def _flash_spmd(q, k, v, causal, scale):
+    """Pallas call partitioned over the live mesh: batch over dp/sharding,
+    heads over mp (a pallas_call is an opaque custom-call to GSPMD, so the
+    partitioning must be made explicit with shard_map)."""
+    from ...distributed import mesh as mesh_mod
+    from jax.sharding import PartitionSpec as P
+    from ...kernels.flash_attention import flash_attention_bthd
+
+    mesh = mesh_mod.get_global_mesh()
+    live = [a for a in ("dp", "sharding", "mp")
+            if mesh is not None and a in mesh.axis_names and
+            mesh.shape.get(a, 1) > 1]
+    if not live:
+        return flash_attention_bthd(q, k, v, causal=causal, scale=scale)
+    batch = tuple(a for a in ("dp", "sharding") if a in live)
+    heads = "mp" if "mp" in live else None
+    n_batch = 1
+    for a in batch:
+        n_batch *= mesh.shape[a]
+    if q.shape[0] % n_batch or (heads and q.shape[2] % mesh.shape["mp"]):
+        raise ValueError("shapes not divisible by mesh axes")  # caller falls back
+    spec = P(batch if batch else None, None, heads, None)
+
+    def local(qv, kv, vv):
+        return flash_attention_bthd(qv, kv, vv, causal=causal, scale=scale)
+
+    return jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)
+
+
 @defop
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False, training=True,
                                  name=None):
     """Inputs [batch, seq, heads, head_dim] like the reference fused op."""
     scale = 1.0 / math.sqrt(query.shape[-1])
-    if _USE_FLASH and attn_mask is None and not (dropout_p and training):
+    if attn_mask is None and not (dropout_p and training) and \
+            _flash_ok(query):
         try:
-            from ...kernels.flash_attention import flash_attention_bthd
-            return flash_attention_bthd(query, key, value, causal=is_causal,
-                                        scale=scale)
+            return _flash_spmd(query, key, value, is_causal, scale)
         except Exception:
-            pass
+            pass  # shape/backend constraint: unfused reference path below
     return _sdpa_ref(query, key, value, attn_mask, dropout_p, is_causal, scale,
                      training)

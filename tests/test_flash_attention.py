@@ -1,0 +1,70 @@
+"""Pallas flash-attention kernel numerics (interpret mode on CPU — the
+reference validates its fused attention ops against unfused math in
+unittests/test_fused_attention_op.py; same contract here)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.kernels import flash_attention as fa
+
+
+@pytest.fixture(autouse=True)
+def _interpret():
+    fa.use_interpret_mode(True)
+    yield
+    fa.use_interpret_mode(False)
+
+
+def _ref(q, k, v, causal, scale):
+    s = jnp.einsum("btd,bsd->bts", q, k) * scale
+    if causal:
+        tq, tk = s.shape[1], s.shape[2]
+        m = jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)
+        s = jnp.where(m, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bts,bsd->btd", p, v)
+
+
+@pytest.mark.parametrize("bh,tq,tk,d,causal", [
+    (2, 128, 128, 64, True),
+    (2, 300, 300, 64, True),      # padding path
+    (1, 1, 129, 32, True),        # cached single-token decode (offset)
+    (2, 128, 128, 64, False),
+])
+def test_flash_forward_and_grad(bh, tq, tk, d, causal):
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.randn(bh, tq, d), jnp.float32)
+    k = jnp.asarray(rs.randn(bh, tk, d), jnp.float32)
+    v = jnp.asarray(rs.randn(bh, tk, d), jnp.float32)
+    scale = 1.0 / np.sqrt(d)
+
+    out = fa.flash_attention_bhtd(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(_ref(q, k, v, causal, scale)),
+                               rtol=1e-4, atol=1e-5)
+
+    g = jax.grad(lambda a, b, c: fa.flash_attention_bhtd(
+        a, b, c, causal=causal).sum(), argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda a, b, c: _ref(a, b, c, causal, scale).sum(),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=1e-4)
+
+
+def test_flash_bthd_layout():
+    rs = np.random.RandomState(1)
+    b, t, h, d = 2, 64, 4, 32
+    q = jnp.asarray(rs.randn(b, t, h, d), jnp.float32)
+    k = jnp.asarray(rs.randn(b, t, h, d), jnp.float32)
+    v = jnp.asarray(rs.randn(b, t, h, d), jnp.float32)
+    out = fa.flash_attention_bthd(q, k, v, causal=True)
+    q3 = jnp.transpose(q, (0, 2, 1, 3)).reshape(b * h, t, d)
+    k3 = jnp.transpose(k, (0, 2, 1, 3)).reshape(b * h, t, d)
+    v3 = jnp.transpose(v, (0, 2, 1, 3)).reshape(b * h, t, d)
+    expect = _ref(q3, k3, v3, True, 1.0 / np.sqrt(d))
+    expect = jnp.transpose(expect.reshape(b, h, t, d), (0, 2, 1, 3))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expect),
+                               rtol=1e-4, atol=1e-5)
