@@ -184,10 +184,12 @@ def _constrain(t: Tensor, spec: P):
     mesh = mesh_mod.get_global_mesh()
     if mesh is None or not isinstance(t, Tensor):
         return t
+    # inside shard_map ANY bound mesh axis rules the constraint out (even for
+    # pin-only specs — with_sharding_constraint rejects Manual-mode operands)
+    if any(mesh_mod.axis_bound(a) for a in mesh.axis_names):
+        return t
     used = [a for s in spec for a in (s if isinstance(s, tuple) else (s,))
             if a is not None and a is not _U]
-    if any(mesh_mod.axis_bound(a) for a in used):
-        return t
     if max(mesh.shape.values(), default=1) == 1:
         return t
     live = {a for a in used

@@ -1,0 +1,139 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+The reference has NO sequence parallelism (SURVEY §5.7: repo-wide grep for
+ring_attention/context_parallel/ulysses = zero hits); long sequences are
+handled only via recompute + TP/PP memory sharing.  This module is the
+TPU-idiomatic extension the rebuild adds (flagged as beyond-reference):
+
+* **Ring attention** — the sequence is sharded over mesh axis ``sep``; K/V
+  chunks rotate around the ring via `lax.ppermute` while each device keeps a
+  streaming-softmax accumulator (m, l, acc).  Memory per device is
+  O(T_local²) for scores instead of O(T_global²), and the per-step ppermute
+  rides ICI while the MXU chews on the current chunk.  Equivalent math to
+  blockwise attention (Liu et al. ring attention; public JAX versions exist —
+  this one is written against this repo's [B, T, H, D] paddle layout).
+* **Ulysses** — all-to-all swaps the sharded axis from sequence→heads, runs
+  dense/flash attention on the full sequence with H/sep heads per device,
+  and swaps back.  Cheaper collectives than the ring when H ≥ sep and
+  sequence fits; the ring wins at extreme lengths.
+
+Both are differentiable through plain jax autodiff (ppermute/all_to_all have
+transfer-transpose rules), so they compose with jax.grad / value_and_grad in
+the SPMD train step with no custom VJP.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG_INF = -1e30
+
+
+def _to_bhtd(x):
+    return jnp.swapaxes(x, 1, 2)  # [B,T,H,D] -> [B,H,T,D]
+
+
+def ring_attention(q, k, v, axis_name: str = "sep", causal: bool = False,
+                   scale: float | None = None):
+    """Blockwise ring attention over a sharded sequence axis.
+
+    Args are the LOCAL shards, paddle layout [B, T_local, H, D]; must be
+    called inside `shard_map` (or pjit-manual) with `axis_name` bound.
+    Token order is contiguous: ring rank i holds global positions
+    [i*T_local, (i+1)*T_local).  Returns the local output shard [B,T,H,D].
+
+    Causal note: contiguous layout means later ring ranks do more work in the
+    causal case (the striped/zigzag layout rebalances this; kept simple and
+    documented as future work).
+    """
+    S = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+
+    # K/V stay in the input dtype through the ppermutes (bf16 halves the ICI
+    # bytes per ring step); only scores/accumulators run in f32
+    qh = _to_bhtd(q)                               # [B,H,T,D]
+    kh = _to_bhtd(k)
+    vh = _to_bhtd(v)
+    B, H, T, D = qh.shape
+
+    q_pos = idx * T + jnp.arange(T)                # global query positions
+
+    perm = [(j, (j + 1) % S) for j in range(S)]
+
+    def scores_for(src, kc):
+        s = jnp.einsum("bhqd,bhkd->bhqk", qh, kc,
+                       preferred_element_type=jnp.float32) * scale
+        if causal:
+            k_pos = src * T + jnp.arange(T)
+            allowed = k_pos[None, :] <= q_pos[:, None]     # [T, T]
+            s = jnp.where(allowed[None, None], s, _NEG_INF)
+        return s
+
+    # iteration 0 peeled: the local diagonal chunk needs no ppermute and
+    # seeds the streaming-softmax accumulators (also gives them the right
+    # varying-manual-axes type for the loop carry)
+    scores = scores_for(idx, kh)
+    m = scores.max(axis=-1)
+    p = jnp.exp(scores - m[..., None])
+    l = p.sum(axis=-1)
+    acc = jnp.einsum("bhqk,bhkd->bhqd", p, vh,
+                     preferred_element_type=jnp.float32)
+
+    def step(s, carry):
+        acc, m, l, kc, vc = carry
+        # permute at loop top so the final rotation isn't computed and thrown
+        # away; after s right-shifts this device holds the chunk that
+        # originated on ring rank (idx - s) mod S
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        scores = scores_for((idx - s) % S, kc)
+        m_new = jnp.maximum(m, scores.max(axis=-1))
+        p = jnp.exp(scores - m_new[..., None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + p.sum(axis=-1)
+        acc_new = acc * corr[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, vc, preferred_element_type=jnp.float32)
+        return acc_new, m_new, l_new, kc, vc
+
+    acc, m, l, _, _ = lax.fori_loop(1, S, step, (acc, m, l, kh, vh))
+    out = acc / jnp.maximum(l[..., None], 1e-30)
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)   # [B,T,H,D]
+
+
+def ulysses_attention(q, k, v, axis_name: str = "sep", causal: bool = False,
+                      scale: float | None = None, inner=None):
+    """DeepSpeed-Ulysses style: all-to-all seq→heads, full-seq attention,
+    all-to-all heads→seq.  Local shards [B, T_local, H, D], H % sep == 0.
+    `inner(q,k,v,causal,scale) -> out` runs the per-device full-sequence
+    attention (defaults to a dense reference; a flash kernel slots in)."""
+    S = lax.psum(1, axis_name)
+    if q.shape[2] % S:
+        raise ValueError(f"num_heads {q.shape[2]} not divisible by "
+                         f"sep={S} for ulysses all-to-all")
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+
+    def swap_in(x):   # [B, T/S, H, D] -> [B, T, H/S, D]
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def swap_out(x):  # [B, T, H/S, D] -> [B, T/S, H, D]
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qg, kg, vg = swap_in(q), swap_in(k), swap_in(v)
+    if inner is None:
+        out = _dense_attention(qg, kg, vg, causal, scale)
+    else:
+        out = inner(qg, kg, vg, causal, scale)
+    return swap_out(out)
+
+
+def _dense_attention(q, k, v, causal, scale):
+    """Reference full-sequence attention, [B,T,H,D] layout (delegates to the
+    single dense implementation in nn.functional.attention)."""
+    from ..nn.functional.attention import _sdpa_ref
+    return _sdpa_ref(q, k, v, None, 0.0, causal, scale, False)

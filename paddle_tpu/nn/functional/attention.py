@@ -18,6 +18,12 @@ from ...core.op import defop
 _USE_FLASH = True
 
 
+class FlashUnsupported(ValueError):
+    """Raised by the flash routing when shape/mesh constraints rule the Pallas
+    kernel out; the caller falls back to the dense reference silently (other
+    exception types are real failures and warn loudly)."""
+
+
 def enable_flash_attention(flag: bool):
     global _USE_FLASH
     _USE_FLASH = bool(flag)
@@ -87,7 +93,7 @@ def _flash_spmd(q, k, v, causal, scale):
     for a in batch:
         n_batch *= mesh.shape[a]
     if q.shape[0] % n_batch or (heads and q.shape[2] % mesh.shape["mp"]):
-        raise ValueError("shapes not divisible by mesh axes")  # caller falls back
+        raise FlashUnsupported("shapes not divisible by mesh axes")
     spec = P(batch if batch else None, None, heads, None)
 
     def local(qv, kv, vv):
@@ -103,11 +109,30 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  name=None):
     """Inputs [batch, seq, heads, head_dim] like the reference fused op."""
     scale = 1.0 / math.sqrt(query.shape[-1])
+    from ...distributed import mesh as mesh_mod
+    if mesh_mod.axis_bound("sep"):
+        # sequence axis is sharded (context parallelism): shard-local attention
+        # would be globally wrong, so the ring path is mandatory here
+        if attn_mask is not None or (dropout_p and training) or \
+                query.shape[1] != key.shape[1]:
+            raise ValueError(
+                "context parallelism (sep axis) supports only mask-free, "
+                "dropout-free self-attention with equal q/k lengths; set "
+                "attention_dropout_prob=0 (or disable sep) — got "
+                f"mask={attn_mask is not None}, dropout_p={dropout_p}, "
+                f"tq={query.shape[1]}, tk={key.shape[1]}")
+        from ...kernels.ring_attention import ring_attention
+        return ring_attention(query, key, value, axis_name="sep",
+                              causal=is_causal, scale=scale)
     if attn_mask is None and not (dropout_p and training) and \
             _flash_ok(query):
         try:
             return _flash_spmd(query, key, value, is_causal, scale)
-        except Exception:
-            pass  # shape/backend constraint: unfused reference path below
+        except FlashUnsupported:
+            pass  # mesh-divisibility constraint: unfused reference path below
+        except Exception as e:  # genuine backend/lowering failure: degrade
+            import warnings    # loudly to the dense path rather than crash
+            warnings.warn(f"flash attention path failed ({type(e).__name__}: "
+                          f"{e}); falling back to dense reference attention")
     return _sdpa_ref(query, key, value, attn_mask, dropout_p, is_causal, scale,
                      training)

@@ -68,3 +68,55 @@ def test_flash_bthd_layout():
     expect = jnp.transpose(expect.reshape(b, h, t, d), (0, 2, 1, 3))
     np.testing.assert_allclose(np.asarray(out), np.asarray(expect),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_flash_spmd_routing_on_mesh(monkeypatch):
+    """_flash_spmd's shard_map partitioning (batch over dp, heads over mp) —
+    covered on CPU by forcing the platform gate open + interpret mode."""
+    from jax.sharding import Mesh
+    from paddle_tpu.distributed import mesh as mesh_mod
+    from paddle_tpu.nn.functional import attention as att
+    from paddle_tpu.core.tensor import Tensor
+
+    rs = np.random.RandomState(2)
+    b, t, h, d = 4, 128, 4, 32
+    q = jnp.asarray(rs.randn(b, t, h, d), jnp.float32)
+    k = jnp.asarray(rs.randn(b, t, h, d), jnp.float32)
+    v = jnp.asarray(rs.randn(b, t, h, d), jnp.float32)
+
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("dp", "mp"))
+    monkeypatch.setattr(att, "_flash_ok", lambda q: True)
+    with mesh_mod.global_mesh(mesh):
+        out = att.scaled_dot_product_attention(
+            Tensor(q, _internal=True), Tensor(k, _internal=True),
+            Tensor(v, _internal=True), is_causal=True)
+    out = out._value if isinstance(out, Tensor) else out
+    ref = att._sdpa_ref(q, k, v, None, 0.0, True, 1.0 / np.sqrt(d), False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_flash_spmd_divisibility_fallback(monkeypatch):
+    """Mesh-indivisible shapes must raise FlashUnsupported inside _flash_spmd
+    and silently fall back to the dense path in the public API."""
+    from jax.sharding import Mesh
+    from paddle_tpu.distributed import mesh as mesh_mod
+    from paddle_tpu.nn.functional import attention as att
+    from paddle_tpu.core.tensor import Tensor
+
+    rs = np.random.RandomState(3)
+    b, t, h, d = 3, 128, 5, 32   # b % dp != 0, h % mp != 0
+    q = jnp.asarray(rs.randn(b, t, h, d), jnp.float32)
+
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("dp", "mp"))
+    monkeypatch.setattr(att, "_flash_ok", lambda q: True)
+    with mesh_mod.global_mesh(mesh):
+        with pytest.raises(att.FlashUnsupported):
+            att._flash_spmd(q, q, q, True, 1.0 / np.sqrt(d))
+        out = att.scaled_dot_product_attention(
+            Tensor(q, _internal=True), Tensor(q, _internal=True),
+            Tensor(q, _internal=True), is_causal=True)
+    out = out._value if isinstance(out, Tensor) else out
+    ref = att._sdpa_ref(q, q, q, None, 0.0, True, 1.0 / np.sqrt(d), False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
