@@ -22,6 +22,7 @@ from .spmd import (  # noqa: F401
     make_train_step,
 )
 from . import fleet  # noqa: F401
+from . import sharding  # noqa: F401
 from .fleet.layers.mpu.mp_ops import split  # noqa: F401
 
 get_world_size_ = get_world_size

@@ -1,0 +1,27 @@
+"""DygraphShardingOptimizer — parity with fleet/meta_optimizers/
+dygraph_optimizer/dygraph_sharding_optimizer.py (ZeRO-1 inside the hybrid
+topology: optimizer states partitioned over the `sharding` axis ranks).
+
+Tags the inner optimizer with sharding stage 1; the compiled SPMD step lays
+the slots out over the sharding mesh axis (spmd.ShardedTrainStep), and
+HybridParallelOptimizer handles clip/grad plumbing as usual.
+"""
+from __future__ import annotations
+
+from ...utils.optimizer_delegate import InnerOptimizerDelegate
+
+
+class DygraphShardingOptimizer(InnerOptimizerDelegate):
+    def __init__(self, hcg=None, user_defined_strategy=None,
+                 params=None, inner_optimizer_class=None, **inner_kw):
+        # reference signature: (hcg, strategy, params, inner_opt_class, **kw);
+        # also accept an already-built optimizer as the sole argument
+        if inner_optimizer_class is None and hasattr(hcg, "step"):
+            inner, hcg = hcg, None
+        elif callable(inner_optimizer_class):
+            inner = inner_optimizer_class(parameters=params, **inner_kw)
+        else:
+            inner = inner_optimizer_class
+        super().__init__(inner, sharding_stage=1)
+        self._hcg = hcg
+        self._strategy = user_defined_strategy

@@ -7,6 +7,9 @@ from .parallel_layers.pp_layers import (  # noqa: F401
 from .pipeline_parallel import (  # noqa: F401
     PipelineParallel, PipelineParallelWithInterleave,
 )
+from .sharding import (  # noqa: F401
+    GroupShardedOptimizerStage2, GroupShardedStage2, GroupShardedStage3,
+)
 from ..layers.mpu import (  # noqa: F401
     ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear,
     VocabParallelEmbedding, get_rng_state_tracker,

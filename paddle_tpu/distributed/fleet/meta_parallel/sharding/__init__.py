@@ -1,0 +1,5 @@
+from .group_sharded_stage import (  # noqa: F401
+    GroupShardedOptimizerStage2,
+    GroupShardedStage2,
+    GroupShardedStage3,
+)

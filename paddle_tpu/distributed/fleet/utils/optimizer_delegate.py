@@ -1,0 +1,34 @@
+"""Shared delegation base for optimizer-wrapping facades (sharding stage
+wrappers, DygraphShardingOptimizer): mirror the inner optimizer's surface and
+tag it with a ZeRO stage consumed by the compiled SPMD step."""
+from __future__ import annotations
+
+
+class InnerOptimizerDelegate:
+    def __init__(self, inner, sharding_stage: int | None = None):
+        if inner is None or not hasattr(inner, "step"):
+            raise ValueError(
+                "an inner optimizer instance (or inner_optimizer_class) is "
+                f"required, got {inner!r}")
+        self._inner_opt = inner
+        if sharding_stage:
+            inner._sharding_stage = max(
+                getattr(inner, "_sharding_stage", 0) or 0, sharding_stage)
+
+    def step(self):
+        return self._inner_opt.step()
+
+    def clear_grad(self, set_to_zero=True):
+        return self._inner_opt.clear_grad(set_to_zero)
+
+    def minimize(self, loss, *a, **kw):
+        return self._inner_opt.minimize(loss, *a, **kw)
+
+    def state_dict(self):
+        return self._inner_opt.state_dict()
+
+    def set_state_dict(self, sd):
+        return self._inner_opt.set_state_dict(sd)
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_inner_opt"], name)

@@ -1,0 +1,22 @@
+"""Group-sharded (ZeRO) user API — parity with
+python/paddle/distributed/sharding/group_sharded.py (`group_sharded_parallel`,
+`save_group_sharded_model`).
+
+TPU-native design: instead of the reference's hook-driven runtime wrappers
+(GroupShardedStage2/3 forward hooks + allgather/reduce-scatter tasks,
+meta_parallel/sharding/group_sharded_stage3.py:60), sharding level is recorded
+on the model/optimizer and realised as GSPMD layouts over the `sharding` mesh
+axis when the train step is jitted (distributed/spmd.py).  XLA then emits the
+reduce-scatter/all-gather collectives over ICI — the same communication
+schedule ZeRO performs by hand.
+"""
+from __future__ import annotations
+
+from .group_sharded import (  # noqa: F401
+    GroupShardedScaler,
+    group_sharded_parallel,
+    save_group_sharded_model,
+)
+
+__all__ = ["group_sharded_parallel", "save_group_sharded_model",
+           "GroupShardedScaler"]

@@ -51,6 +51,21 @@ def batch_spec(mesh, ndim: int) -> P:
     return P(*([lead] + [None] * (ndim - 1)))
 
 
+def _shard_largest_free_dim(spec: P, shape, axis: str, n: int) -> P:
+    """Return `spec` with `axis` added on the largest divisible, still-free
+    dim; unchanged if `axis` is already used or nothing divides."""
+    used = {a for s in spec for a in
+            (s if isinstance(s, tuple) else (s,)) if a is not None}
+    if axis in used:
+        return spec
+    cur = list(spec) + [None] * (len(shape) - len(spec))
+    for dim in sorted(range(len(shape)), key=lambda i: -shape[i]):
+        if cur[dim] is None and shape[dim] % n == 0:
+            cur[dim] = axis
+            return P(*cur)
+    return spec
+
+
 def infer_param_specs(model, mesh, fsdp_axis: str | None = None,
                       min_fsdp_size: int = 2 ** 10) -> dict[str, P]:
     """PartitionSpec per state entry.  mpu layers pre-tag TP specs; when
@@ -74,16 +89,7 @@ def infer_param_specs(model, mesh, fsdp_axis: str | None = None,
             spec = P(*cleaned) if cleaned else P()
         if fsdp_n > 1 and t.size >= min_fsdp_size and \
                 not t.stop_gradient:
-            used = {a for s in spec for a in
-                    (s if isinstance(s, tuple) else (s,)) if a is not None}
-            if fsdp_axis not in used:
-                shape = t.shape
-                cur = list(spec) + [None] * (len(shape) - len(spec))
-                for dim in sorted(range(len(shape)), key=lambda i: -shape[i]):
-                    if cur[dim] is None and shape[dim] % fsdp_n == 0:
-                        cur[dim] = fsdp_axis
-                        spec = P(*cur)
-                        break
+            spec = _shard_largest_free_dim(spec, t.shape, fsdp_axis, fsdp_n)
         specs[name] = spec
     return specs
 
@@ -114,11 +120,31 @@ class ShardedTrainStep:
                  mesh=None, fsdp_axis: str | None = None,
                  compute_dtype=None, donate: bool = True,
                  accumulate_steps: int = 1, num_labels: int = 1,
+                 sharding_stage: int = 0, sharding_axis: str = "sharding",
                  static_argnames=()):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
         self.mesh = mesh or mesh_mod.get_global_mesh()
+        # ZeRO stages (reference: group_sharded stage 1/2/3,
+        # meta_parallel/sharding/group_sharded_optimizer_stage2.py:48 and
+        # group_sharded_stage3.py:60) expressed as GSPMD layouts over the
+        # `sharding` mesh axis: stage 1 shards optimizer slots, stage 2 also
+        # constrains gradients to that layout (XLA lowers the grad reduce to a
+        # reduce-scatter + the param update to a sharded compute), stage 3
+        # shards the parameters themselves (the fsdp path below).
+        stage = sharding_stage
+        for src in (optimizer, model):
+            m = getattr(src, "_sharding_stage", None)
+            if m:
+                stage = max(stage, int(m))
+        self.sharding_stage = stage
+        self.sharding_axis = sharding_axis
+        min_fsdp_size = 2 ** 10
+        if stage >= 3:
+            if fsdp_axis is None:
+                fsdp_axis = sharding_axis
+            min_fsdp_size = 0  # ZeRO-3 shards every trainable param
         self.compute_dtype = compute_dtype
         self.donate = donate
         self.accumulate_steps = max(1, accumulate_steps)
@@ -130,7 +156,9 @@ class ShardedTrainStep:
         self._inner = inner
         self._entries = inner.state_dict()
         self._tmask = trainable_mask(inner)
-        self._specs = infer_param_specs(inner, self.mesh, fsdp_axis)
+        self._specs = infer_param_specs(inner, self.mesh, fsdp_axis,
+                                        min_fsdp_size=min_fsdp_size)
+        self._slot_specs = self._infer_slot_specs()
 
         # copy values: the compiled step donates its state buffers, which must
         # never alias the live eager Parameter arrays (donation would delete
@@ -150,13 +178,36 @@ class ShardedTrainStep:
         self._jitted = None
 
     # -- sharding ------------------------------------------------------------
+    def _infer_slot_specs(self) -> dict[str, P]:
+        """Optimizer-slot layout.  Defaults to the param layout; ZeRO stage
+        1/2 additionally shards the largest divisible dim over the sharding
+        axis (the slot is the only copy — the reference's param-shard
+        optimizer states, group_sharded_optimizer_stage2.py:48)."""
+        specs = dict(self._specs)
+        mesh, axis = self.mesh, self.sharding_axis
+        n = mesh.shape.get(axis, 1) if mesh is not None else 1
+        if self.sharding_stage not in (1, 2) or n <= 1:
+            return specs
+        for name, t in self._entries.items():
+            if not self._tmask.get(name):
+                continue
+            specs[name] = _shard_largest_free_dim(
+                specs.get(name, P()), t.shape, axis, n)
+        return specs
+
     def _shard_value(self, name, v):
         spec = self._specs.get(name, P())
         return jax.device_put(v, NamedSharding(self.mesh, spec))
 
+    def _slot_shard_value(self, name, v):
+        spec = self._slot_specs.get(name, P())
+        if tuple(v.shape) != tuple(self._entries[name].shape):
+            spec = P()
+        return jax.device_put(v, NamedSharding(self.mesh, spec))
+
     def _shard_state(self, st: TrainState) -> TrainState:
         params = {k: self._shard_value(k, v) for k, v in st.params.items()}
-        slots = {k: {s: self._shard_value(k, v) for s, v in d.items()}
+        slots = {k: {s: self._slot_shard_value(k, v) for s, v in d.items()}
                  for k, d in st.slots.items()}
         repl = NamedSharding(self.mesh, P())
         buffers = {k: jax.device_put(v, repl) for k, v in st.buffers.items()}
@@ -184,6 +235,12 @@ class ShardedTrainStep:
         lr_scale = {k: (self._entries[k].optimize_attr or {}).get(
             "learning_rate", 1.0) for k in self.param_names}
         grad_clip = getattr(opt, "_grad_clip", None)
+        mesh = self.mesh
+        param_specs, slot_specs = self._specs, self._slot_specs
+        zero_active = (mesh is not None and self.sharding_stage in (1, 2) and
+                       mesh.shape.get(self.sharding_axis, 1) > 1)
+        zero_update_constraint = zero_active
+        zero_grad_constraint = zero_active and self.sharding_stage >= 2
 
         def loss_value(params, buffers, key, batch):
             values = dict(buffers)
@@ -248,6 +305,14 @@ class ShardedTrainStep:
                 (loss, new_buf), grads = vag(params, state_tree["buffers"],
                                              key, batch)
             grads = {k: g.astype(params[k].dtype) for k, g in grads.items()}
+            if zero_grad_constraint:
+                # ZeRO-2: pin each grad to the slot layout so XLA lowers the
+                # data-parallel grad reduction into a reduce-scatter onto the
+                # rank that owns the slot shard (reference: grad sharding via
+                # reduce-scatter hooks, group_sharded_stage2.py:49)
+                grads = {k: jax.lax.with_sharding_constraint(
+                    g, NamedSharding(mesh, slot_specs[k]))
+                    for k, g in grads.items()}
             if grad_clip is not None and hasattr(grad_clip, "clip_norm"):
                 gn = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
                                   for g in grads.values()))
@@ -259,8 +324,21 @@ class ShardedTrainStep:
             new_params, new_slots = {}, {}
             for k, p in params.items():
                 ctx = {"decay": decay_of[k]}
-                np_, ns_ = opt.update(p, grads[k], state_tree["slots"][k],
+                g = grads[k]
+                if zero_update_constraint:
+                    # ZeRO-1/2: run the element-wise update in the slot
+                    # layout (each rank updates only its shard), then gather
+                    # the fresh params back to their own layout — GSPMD's
+                    # form of "update owner shard, broadcast params"
+                    p = jax.lax.with_sharding_constraint(
+                        p, NamedSharding(mesh, slot_specs[k]))
+                    g = jax.lax.with_sharding_constraint(
+                        g, NamedSharding(mesh, slot_specs[k]))
+                np_, ns_ = opt.update(p, g, state_tree["slots"][k],
                                       lr * lr_scale[k], t, ctx)
+                if zero_update_constraint:
+                    np_ = jax.lax.with_sharding_constraint(
+                        np_, NamedSharding(mesh, param_specs[k]))
                 new_params[k] = np_.astype(p.dtype)
                 new_slots[k] = ns_
             buffers = dict(state_tree["buffers"])

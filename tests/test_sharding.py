@@ -1,0 +1,142 @@
+"""ZeRO / group-sharded tests on the 8-device CPU mesh.
+
+Reference test model: unittests dygraph_group_sharded_* drivers compare the
+sharded loss trajectory against the unsharded one (SURVEY §4); same contract
+here, plus layout assertions (slots/params actually laid out over the
+sharding axis).
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.sharding import (group_sharded_parallel,
+                                             save_group_sharded_model)
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    yield
+    dist.set_global_mesh(None)
+
+
+def _mlp():
+    paddle.seed(7)
+    return nn.Sequential(
+        nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 16))
+
+
+def _data(steps=6, bs=8):
+    rng = np.random.default_rng(0)
+    return [(rng.standard_normal((bs, 16)).astype("float32"),
+             rng.standard_normal((bs, 16)).astype("float32"))
+            for _ in range(steps)]
+
+
+def _run(step_builder, data):
+    losses = []
+    for x, y in data:
+        losses.append(float(step_builder(x, y)))
+    return losses
+
+
+def _spec_axes(arr):
+    spec = getattr(arr.sharding, "spec", None) or ()
+    return {a for s in spec for a in
+            ((s,) if not isinstance(s, tuple) else s) if a is not None}
+
+
+@pytest.mark.parametrize("stage", [1, 2, 3])
+def test_zero_stage_matches_unsharded(stage):
+    data = _data()
+    loss_fn = nn.MSELoss()
+
+    baseline_model = _mlp()
+    base_opt = opt.Adam(parameters=baseline_model.parameters(),
+                        learning_rate=0.01)
+    base_step = dist.make_train_step(baseline_model, base_opt, loss_fn,
+                                     mesh=None)
+    base_losses = _run(base_step, data)
+
+    mesh = dist.build_mesh([2, 4], ["dp", "sharding"])
+    dist.set_global_mesh(mesh)
+    model = _mlp()
+    optimizer = opt.Adam(parameters=model.parameters(), learning_rate=0.01)
+    step = dist.make_train_step(model, optimizer, loss_fn, mesh=mesh,
+                                sharding_stage=stage)
+    losses = _run(step, data)
+
+    np.testing.assert_allclose(losses, base_losses, rtol=2e-4, atol=2e-5)
+
+    # layout assertions: the ZeRO promise is that slots (stage>=1) / params
+    # (stage 3) actually live sharded over the `sharding` axis
+    slot_axes = set()
+    for d in step.state.slots.values():
+        for v in d.values():
+            slot_axes |= _spec_axes(v)
+    assert "sharding" in slot_axes
+    param_axes = set()
+    for v in step.state.params.values():
+        param_axes |= _spec_axes(v)
+    if stage == 3:
+        assert "sharding" in param_axes
+    else:
+        assert "sharding" not in param_axes
+
+
+def test_group_sharded_parallel_api(tmp_path):
+    mesh = dist.build_mesh([8], ["sharding"])
+    dist.set_global_mesh(mesh)
+    model = _mlp()
+    optimizer = opt.AdamW(parameters=model.parameters(), learning_rate=0.01)
+    scaler = paddle.amp.GradScaler(init_loss_scaling=1024)
+    model, optimizer, scaler = group_sharded_parallel(
+        model, optimizer, level="os_g", scaler=scaler)
+    assert model._sharding_stage == 2 and optimizer._sharding_stage == 2
+
+    # the tagged stage flows into the compiled step
+    step = dist.make_train_step(model, optimizer, nn.MSELoss(), mesh=mesh)
+    assert step.sharding_stage == 2
+    losses = _run(step, _data(steps=3))
+    assert losses[-1] < losses[0]
+
+    save_group_sharded_model(model, str(tmp_path), optimizer=optimizer)
+    assert (tmp_path / "model.pdmodel").exists()
+    assert (tmp_path / "model.pdopt").exists()
+
+
+def test_group_sharded_stage3_wrapper():
+    from paddle_tpu.distributed.fleet.meta_parallel import (
+        GroupShardedOptimizerStage2, GroupShardedStage2, GroupShardedStage3)
+
+    mesh = dist.build_mesh([8], ["sharding"])
+    dist.set_global_mesh(mesh)
+    model = _mlp()
+    optimizer = opt.Adam(parameters=model.parameters(), learning_rate=0.01)
+
+    sharded_opt = GroupShardedOptimizerStage2(model.parameters(), optimizer)
+    wrapped = GroupShardedStage2(model, sharded_opt)
+    assert wrapped._sharding_stage == 2
+    out = wrapped(paddle.to_tensor(np.ones((2, 16), "float32")))
+    assert tuple(out.shape) == (2, 16)
+
+    model3 = _mlp()
+    w3 = GroupShardedStage3(model3, optimizer=optimizer)
+    assert model3._sharding_stage == 3
+    assert len(w3.get_all_parameters()) == len(list(model3.parameters()))
+
+
+def test_dygraph_sharding_optimizer():
+    from paddle_tpu.distributed.fleet.meta_optimizers.dygraph_optimizer import (
+        DygraphShardingOptimizer)
+
+    model = _mlp()
+    inner = opt.Adam(parameters=model.parameters(), learning_rate=0.01)
+    sh = DygraphShardingOptimizer(inner)
+    assert sh._inner_opt._sharding_stage == 1
+    assert sh.get_lr() == pytest.approx(0.01)
