@@ -96,7 +96,10 @@ def apply_op(fn, name, args, kwargs):
             for i in tensor_pos:
                 vals[i] = amp_cast(vals[i])
         a, k = jtu.tree_unflatten(treedef, vals)
-        return fn(*a, **k)
+        out = fn(*a, **k)
+        # normalize: multi-result primitive binds return lists; backward sends
+        # tuple cotangents, and jax.vjp requires matching tree types
+        return tuple(out) if isinstance(out, list) else out
 
     primals = [raw[p] for p in diff_pos]
     out, vjp_fn = jax.vjp(closure, *primals)

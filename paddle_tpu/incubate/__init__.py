@@ -1,0 +1,4 @@
+"""paddle.incubate parity namespace (SURVEY §2.3 incubate: MoE expert
+parallelism, fused nn layers, distributed models)."""
+from . import distributed  # noqa: F401
+from . import nn  # noqa: F401

@@ -1,0 +1,17 @@
+"""Mixture-of-Experts with expert parallelism — parity with
+incubate/distributed/models/moe (MoELayer at moe_layer.py:244, gates under
+gate/, grad clip, and the global_scatter/global_gather dispatch that the
+reference implements as CUDA alltoall ops,
+paddle/fluid/operators/collective/global_scatter_op.cc).
+"""
+from .gate import BaseGate, GShardGate, NaiveGate, SwitchGate  # noqa: F401
+from .grad_clip import ClipGradForMOEByGlobalNorm  # noqa: F401
+from .moe_layer import MoELayer  # noqa: F401
+from .utils import (  # noqa: F401
+    global_gather,
+    global_scatter,
+    _limit_by_capacity,
+    _number_count,
+    _prune_gate_by_capacity,
+    _random_routing,
+)

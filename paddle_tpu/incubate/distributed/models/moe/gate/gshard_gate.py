@@ -1,0 +1,54 @@
+"""GShardGate — parity with incubate/.../moe/gate/gshard_gate.py: top-2
+gating with capacity limiting, random second-expert routing and the GShard
+load-balancing auxiliary loss (mean gate fraction x mean dispatch fraction
+per expert, scaled by E)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ......core import random as random_mod
+from ......core.op import apply_op
+from ......core.tensor import Tensor
+from .naive_gate import NaiveGate
+
+
+class GShardGate(NaiveGate):
+    def __init__(self, d_model, num_expert, world_size,
+                 topk=2, capacity=(1.2, 2.4), random_routing=True,
+                 group=None):
+        if topk != 2:
+            raise ValueError("topk should be 2 in GShardGate")
+        super().__init__(d_model, num_expert, world_size, topk=2)
+        self.capacity = capacity
+        self.random_routing = random_routing
+        self.group = group
+
+    def forward(self, x):
+        topk_val, topk_idx, gate_score = super().forward(
+            x, return_all_scores=True)
+        n_tokens, e = gate_score.shape[0], self.tot_expert
+
+        # GShard aux loss (gshard_gate.py: me*ce balance loss), kept on the
+        # tape so it can join the training loss
+        def aux(s, idx):
+            probs = jax.nn.softmax(s, axis=-1)
+            me = probs.mean(axis=0)
+            ce = jax.nn.one_hot(idx[..., 0], e, dtype=probs.dtype).mean(axis=0)
+            return (me * ce).sum() * float(e)
+
+        self.set_loss(apply_op(aux, "gshard_balance_loss",
+                               (gate_score, topk_idx), {}))
+
+        if self.random_routing:
+            # keep the 2nd expert with prob 2*p2 (random_routing_op); topk_val
+            # already holds router probabilities
+            key = random_mod.next_key()
+            prob = jax.random.uniform(key, (n_tokens,),
+                                      dtype=gate_score._value.dtype)
+            second = jnp.where(prob < 2.0 * topk_val._value[..., 1],
+                               topk_idx._value[..., 1], -1)
+            topk_idx = Tensor(
+                jnp.stack([topk_idx._value[..., 0], second], axis=-1),
+                stop_gradient=True, _internal=True)
+        return topk_val, topk_idx

@@ -1,0 +1,38 @@
+"""NaiveGate — parity with incubate/.../moe/gate/naive_gate.py: a linear
+scorer with top-k selection and no balancing loss."""
+from __future__ import annotations
+
+import jax.lax as lax
+
+from ......core.op import apply_op
+from ......nn import Linear
+from .base_gate import BaseGate
+
+
+class NaiveGate(BaseGate):
+    def __init__(self, d_model, num_expert, world_size, topk=2):
+        super().__init__(num_expert, world_size)
+        self.gate = Linear(d_model, self.tot_expert)
+        self.top_k = topk
+
+    def score(self, inp):
+        return self.gate(inp)
+
+    def forward(self, inp, return_all_scores=False):
+        gate = self.gate(inp)
+        k = self.top_k
+
+        # top-k over the full-softmax probabilities, so the returned values
+        # are router probabilities (Switch top-1 scales expert outputs by
+        # p_top1; for k>1 the combine renormalizes among the selected, which
+        # equals GShard's softmax-then-renormalize)
+        def probs_topk(g):
+            import jax
+            return lax.top_k(jax.nn.softmax(g, axis=-1), k)
+
+        gate_top_k_val, gate_top_k_idx = apply_op(
+            probs_topk, "top_k", (gate,), {})
+        gate_top_k_idx.stop_gradient = True
+        if return_all_scores:
+            return gate_top_k_val, gate_top_k_idx, gate
+        return gate_top_k_val, gate_top_k_idx

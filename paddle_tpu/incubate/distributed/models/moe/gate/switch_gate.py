@@ -1,0 +1,51 @@
+"""SwitchGate — parity with incubate/.../moe/gate/switch_gate.py: top-1
+(Switch Transformer) routing with the switch load-balancing loss."""
+from __future__ import annotations
+
+import jax
+
+from .naive_gate import NaiveGate
+
+
+class SwitchGate(NaiveGate):
+    def __init__(self, d_model, num_expert, world_size,
+                 topk=1, switch_eps=0.1, capacity=(1.2, 2.4), group=None):
+        if topk != 1:
+            raise ValueError("topk should be 1 in SwitchGate")
+        super().__init__(d_model, num_expert, world_size, topk=1)
+        self.switch_eps = switch_eps
+        self.capacity = capacity
+        self.group = group
+
+    def forward(self, inp):
+        from ......core import random as random_mod
+        from ......core.op import apply_op
+
+        score = self.gate(inp)
+        e = self.tot_expert
+        if self.training:
+            # reference adds multiplicative jitter noise while training
+            key = random_mod.next_key()
+            lo, hi = 1.0 - self.switch_eps, 1.0 + self.switch_eps
+
+            def jitter(s):
+                noise = jax.random.uniform(key, s.shape, dtype=s.dtype,
+                                           minval=lo, maxval=hi)
+                return s * noise
+
+            score = apply_op(jitter, "switch_jitter", (score,), {})
+
+        def route(s):
+            probs = jax.nn.softmax(s, axis=-1)
+            top1_val = probs.max(axis=-1, keepdims=True)
+            top1_idx = probs.argmax(axis=-1, keepdims=True)
+            # switch balance loss: E * sum_e(token_fraction_e * mean_prob_e)
+            ce = jax.nn.one_hot(top1_idx[..., 0], e,
+                                dtype=probs.dtype).mean(axis=0)
+            me = probs.mean(axis=0)
+            return top1_val, top1_idx, (me * ce).sum() * float(e)
+
+        top1_val, top1_idx, loss = apply_op(route, "switch_route", (score,), {})
+        top1_idx.stop_gradient = True
+        self.set_loss(loss)
+        return top1_val, top1_idx

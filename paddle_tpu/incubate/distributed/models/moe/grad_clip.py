@@ -1,0 +1,53 @@
+"""ClipGradForMOEByGlobalNorm — parity with incubate/.../moe/grad_clip.py.
+
+The reference computes the global norm in two parts: non-expert params
+(allreduced norm across the moe group, since they are replicated) and expert
+params (each rank's experts are distinct, so their norm contributions are
+summed WITHOUT dividing by the group size).  Under the single-controller jax
+runtime every value is already the global view, so both parts reduce to one
+sum; the class keeps the reference's surface (is_expert_param_func,
+moe_group) for source compatibility.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .....core.autograd import no_grad
+from .....core.tensor import Tensor
+from .....nn.clip import ClipGradByGlobalNorm
+
+
+class ClipGradForMOEByGlobalNorm(ClipGradByGlobalNorm):
+    def __init__(self, clip_norm, is_expert_param_func=None, moe_group=None,
+                 group_name="default_moe_group"):
+        super().__init__(clip_norm, group_name=group_name)
+        self.is_expert_param_func = is_expert_param_func
+        self.moe_group = moe_group
+
+    @no_grad()
+    def _clip(self, params_grads):
+        normal, expert = [], []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                continue
+            if self.is_expert_param_func is not None and \
+                    self.is_expert_param_func(p):
+                expert.append(g)
+            else:
+                normal.append(g)
+        sum_sq = 0.0
+        for g in normal + expert:
+            v = g._value if isinstance(g, Tensor) else g
+            sum_sq = sum_sq + jnp.sum(jnp.square(v.astype(jnp.float32)))
+        global_norm = jnp.sqrt(sum_sq)
+        scale = jnp.minimum(1.0, self.clip_norm /
+                            jnp.maximum(global_norm, 1e-12))
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            v = g._value if isinstance(g, Tensor) else g
+            out.append((p, Tensor((v * scale).astype(v.dtype),
+                                  _internal=True)))
+        return out

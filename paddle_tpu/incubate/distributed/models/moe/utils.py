@@ -1,0 +1,108 @@
+"""MoE routing utilities — parity with incubate/distributed/models/moe/utils.py
+(`_number_count`, `_limit_by_capacity`, `_prune_gate_by_capacity`,
+`_random_routing`, backed in the reference by number_count_op /
+limit_by_capacity_op / prune_gate_by_capacity_op CUDA kernels) and the
+`global_scatter`/`global_gather` token-exchange collectives
+(operators/collective/global_scatter_op.cc, global_gather_op.cc).
+
+TPU-native: the count/limit/prune helpers are O(N·E) one-hot reductions that
+XLA fuses; the global exchange is a fixed-capacity `lax.all_to_all` over the
+expert mesh axis (static shapes — the variable-length brpc-style exchange the
+reference does has no efficient XLA analog, and capacity-based dispatch is the
+GShard-standard TPU formulation anyway).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .....core.tensor import Tensor
+
+
+def _unwrap(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _number_count(numbers, upper_range):
+    """Count occurrences of each id in [0, upper_range) (number_count_op)."""
+    n = _unwrap(numbers).reshape(-1)
+    oh = jax.nn.one_hot(n, upper_range, dtype=jnp.int64)
+    return Tensor(oh.sum(axis=0), _internal=True)
+
+def _limit_by_capacity(expert_count, capacity, n_worker):
+    """Clamp per-expert counts by per-worker capacity (limit_by_capacity_op)."""
+    ec = _unwrap(expert_count)
+    cap = _unwrap(capacity)
+    ec2 = ec.reshape(n_worker, -1) if ec.ndim == 1 else ec
+    out = jnp.minimum(ec2, cap[None, :] if cap.ndim == 1 else cap)
+    return Tensor(out.reshape(ec.shape), _internal=True)
+
+
+def _prune_gate_by_capacity(gate_idx, expert_count, n_expert, n_worker):
+    """Set gate ids to -1 for tokens beyond their expert's capacity
+    (prune_gate_by_capacity_op).  Position of a token within its expert is its
+    prefix count among same-expert tokens."""
+    idx = _unwrap(gate_idx).reshape(-1)
+    counts = _unwrap(expert_count).reshape(-1)
+    total = n_expert * n_worker
+    oh = jax.nn.one_hot(idx, total, dtype=jnp.int32)
+    pos = jnp.cumsum(oh, axis=0) * oh  # 1-based rank within expert
+    rank = (pos.sum(axis=1) - 1).astype(jnp.int32)
+    cap = counts[jnp.clip(idx, 0, total - 1)]
+    keep = (idx >= 0) & (rank < cap)
+    return Tensor(jnp.where(keep, idx, -1), _internal=True)
+
+
+def _random_routing(topk_idx, topk_value, prob, topk=2):
+    """GShard 2nd-expert random routing (random_routing_op): keep the second
+    expert only with probability proportional to its gate value (drop when
+    `prob >= 2 * value`)."""
+    if topk != 2:
+        raise ValueError("_random_routing supports topk=2 only")
+    idx = _unwrap(topk_idx)
+    val = _unwrap(topk_value)
+    p = _unwrap(prob)
+    second = jnp.where(p < 2.0 * val[..., 1], idx[..., 1], -1)
+    return Tensor(jnp.stack([idx[..., 0], second], axis=-1), _internal=True)
+
+
+def global_scatter(x, local_count, global_count, group=None):
+    """Token exchange to expert-owner ranks (global_scatter_op.cc).
+
+    Fixed-capacity formulation: `x` is the dispatched tensor
+    [n_expert_global, capacity, d_model]; over a bound expert axis this is an
+    all_to_all that leaves each rank holding [n_expert_local, world*capacity,
+    d_model].  Outside shard_map it is the identity (single worker).
+    Differentiable (runs on the eager tape; lax.all_to_all has a VJP).
+    """
+    from .....core.op import apply_op
+    from .....distributed import collective as coll
+
+    g = coll._group(group)
+    if not coll._in_trace(g):
+        return x if isinstance(x, Tensor) else Tensor(_unwrap(x),
+                                                      _internal=True)
+    axis = g.axis_name
+    t = x if isinstance(x, Tensor) else Tensor(_unwrap(x), _internal=True)
+    return apply_op(
+        lambda v: jax.lax.all_to_all(v, axis, split_axis=0, concat_axis=1,
+                                     tiled=True),
+        "global_scatter", (t,), {})
+
+
+def global_gather(x, local_count, global_count, group=None):
+    """Inverse of global_scatter (global_gather_op.cc): return expert outputs
+    to the token-owner ranks."""
+    from .....core.op import apply_op
+    from .....distributed import collective as coll
+
+    g = coll._group(group)
+    if not coll._in_trace(g):
+        return x if isinstance(x, Tensor) else Tensor(_unwrap(x),
+                                                      _internal=True)
+    axis = g.axis_name
+    t = x if isinstance(x, Tensor) else Tensor(_unwrap(x), _internal=True)
+    return apply_op(
+        lambda v: jax.lax.all_to_all(v, axis, split_axis=1, concat_axis=0,
+                                     tiled=True),
+        "global_gather", (t,), {})

@@ -1,0 +1,18 @@
+"""paddle.incubate.nn parity — the fused transformer family
+(incubate/nn/layer/fused_transformer.py:79,176,437,641,914).
+
+On GPU the reference backs these with monolithic CUDA kernels
+(operators/fused/fused_attention_op.cu, fused_feedforward_op.cu,
+fused_multi_transformer_op.cu).  On TPU the same fusion is the compiler's
+job: these layers express the exact op sequence; XLA fuses the
+bias/dropout/residual/layernorm chains and the attention core routes to the
+Pallas flash kernel (paddle_tpu.kernels.flash_attention).
+"""
+from .layer.fused_transformer import (  # noqa: F401
+    FusedBiasDropoutResidualLayerNorm,
+    FusedFeedForward,
+    FusedMultiHeadAttention,
+    FusedMultiTransformer,
+    FusedTransformerEncoderLayer,
+)
+from . import functional  # noqa: F401

@@ -1,0 +1,41 @@
+"""paddle.incubate.nn.functional parity: functional forms of the fused ops
+(incubate/nn/functional/fused_transformer.py)."""
+from __future__ import annotations
+
+from ....nn import functional as _F
+from ....nn.functional.attention import scaled_dot_product_attention
+
+
+def fused_feedforward(x, linear1_weight, linear1_bias, linear2_weight,
+                      linear2_bias, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu",
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True, mode='upscale_in_train',
+                      ring_id=-1, name=None):
+    residual = x
+    if pre_layer_norm:
+        x = _F.layer_norm(x, x.shape[-1:], weight=ln1_scale, bias=ln1_bias,
+                          epsilon=ln1_epsilon)
+    y = _F.linear(x, linear1_weight, linear1_bias)
+    y = getattr(_F, activation)(y)
+    y = _F.dropout(y, p=dropout1_rate, training=training)
+    y = _F.linear(y, linear2_weight, linear2_bias)
+    y = _F.dropout(y, p=dropout2_rate, training=training)
+    out = residual + y
+    if not pre_layer_norm:
+        out = _F.layer_norm(out, out.shape[-1:], weight=ln2_scale,
+                            bias=ln2_bias, epsilon=ln2_epsilon)
+    return out
+
+
+def fused_bias_dropout_residual_layer_norm(x, residual, bias=None,
+                                           ln_scale=None, ln_bias=None,
+                                           dropout_rate=0.5, ln_epsilon=1e-5,
+                                           training=True,
+                                           mode='upscale_in_train', name=None):
+    y = x if bias is None else x + bias
+    y = _F.dropout(y, p=dropout_rate, training=training)
+    out = residual + y
+    return _F.layer_norm(out, out.shape[-1:], weight=ln_scale, bias=ln_bias,
+                         epsilon=ln_epsilon)

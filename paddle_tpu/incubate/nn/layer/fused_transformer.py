@@ -1,0 +1,193 @@
+"""Fused transformer layers — parity with
+incubate/nn/layer/fused_transformer.py (FusedBiasDropoutResidualLayerNorm:79,
+FusedMultiHeadAttention:176, FusedFeedForward:437,
+FusedTransformerEncoderLayer:641, FusedMultiTransformer:914).
+
+Semantics follow the reference's CUDA-fused ops; the "fusion" is delegated to
+XLA + the Pallas flash-attention kernel (see incubate.nn.__init__).
+"""
+from __future__ import annotations
+
+from ....core.op import apply_op
+from ....nn import functional as F
+from ....nn.functional.attention import scaled_dot_product_attention
+from ....nn.layer.common import Dropout, Linear
+from ....nn.layer.container import LayerList
+from ....nn.layer.norm import LayerNorm
+from ....nn.layer_base import Layer
+
+
+class FusedBiasDropoutResidualLayerNorm(Layer):
+    """out = layer_norm(residual + dropout(x + bias)) — fused_transformer.py:79
+    (fused_bias_dropout_residual_layer_norm op)."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
+                 bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        self.embed_dim = embed_dim
+        self.linear_bias = self.create_parameter(
+            shape=[embed_dim], attr=bias_attr, dtype=self._dtype, is_bias=True)
+        self.dropout = Dropout(dropout_rate)
+        self.norm = LayerNorm(embed_dim, epsilon=epsilon,
+                              weight_attr=weight_attr)
+
+    def forward(self, x, residual):
+        y = x + self.linear_bias
+        y = self.dropout(y)
+        return self.norm(residual + y)
+
+
+class FusedMultiHeadAttention(Layer):
+    """Pre/post-LN multi-head self-attention with fused residual path —
+    fused_transformer.py:176 (fused_attention_op.cu semantics: qkv in one
+    GEMM, flash-attention core, out-proj, bias+dropout+residual+LN)."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False,
+                 qkv_weight_attr=None, qkv_bias_attr=None,
+                 linear_weight_attr=None, linear_bias_attr=None,
+                 pre_ln_scale_attr=None, pre_ln_bias_attr=None,
+                 ln_scale_attr=None, ln_bias_attr=None,
+                 epsilon=1e-5, nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        assert embed_dim % num_heads == 0
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.normalize_before = normalize_before
+        self.qkv_proj = Linear(embed_dim, 3 * embed_dim,
+                               weight_attr=qkv_weight_attr,
+                               bias_attr=qkv_bias_attr)
+        self.out_proj = Linear(embed_dim, embed_dim,
+                               weight_attr=linear_weight_attr,
+                               bias_attr=linear_bias_attr)
+        self.pre_ln = LayerNorm(embed_dim, epsilon=epsilon,
+                                weight_attr=pre_ln_scale_attr,
+                                bias_attr=pre_ln_bias_attr)
+        self.ln = LayerNorm(embed_dim, epsilon=epsilon,
+                            weight_attr=ln_scale_attr, bias_attr=ln_bias_attr)
+        self.attn_dropout_rate = attn_dropout_rate
+        self.dropout = Dropout(dropout_rate)
+
+    def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
+        if key is not None or value is not None:
+            raise NotImplementedError(
+                "FusedMultiHeadAttention is self-attention only (fused qkv "
+                "GEMM); pass query alone, or use nn.MultiHeadAttention for "
+                "cross-attention")
+        if cache is not None:
+            raise NotImplementedError(
+                "incremental decode cache is not supported by "
+                "FusedMultiHeadAttention yet; use nn.MultiHeadAttention")
+        residual = query
+        x = self.pre_ln(query) if self.normalize_before else query
+        qkv = self.qkv_proj(x)
+        b, t = qkv.shape[0], qkv.shape[1]
+        nh, hd = self.num_heads, self.head_dim
+
+        def split_qkv(qv):
+            r = qv.reshape(b, t, 3, nh, hd)
+            return r[:, :, 0], r[:, :, 1], r[:, :, 2]
+
+        q, k, v = apply_op(split_qkv, "qkv_split", (qkv,), {})
+        out = scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, dropout_p=self.attn_dropout_rate,
+            training=self.training)
+        out = out.reshape([b, t, self.embed_dim])
+        out = self.out_proj(out)
+        out = residual + self.dropout(out)
+        if not self.normalize_before:
+            out = self.ln(out)
+        return out
+
+
+class FusedFeedForward(Layer):
+    """linear→act→dropout→linear→bias+dropout+residual+LN —
+    fused_transformer.py:437 (fused_feedforward_op.cu semantics)."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None, ln2_bias_attr=None,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.linear1 = Linear(d_model, dim_feedforward,
+                              weight_attr=linear1_weight_attr,
+                              bias_attr=linear1_bias_attr)
+        self.linear2 = Linear(dim_feedforward, d_model,
+                              weight_attr=linear2_weight_attr,
+                              bias_attr=linear2_bias_attr)
+        self.pre_ln = LayerNorm(d_model, epsilon=epsilon,
+                                weight_attr=ln1_scale_attr,
+                                bias_attr=ln1_bias_attr)
+        self.ln = LayerNorm(d_model, epsilon=epsilon,
+                            weight_attr=ln2_scale_attr, bias_attr=ln2_bias_attr)
+        self.activation = activation
+        self.act_dropout = Dropout(dropout_rate if act_dropout_rate is None
+                                   else act_dropout_rate)
+        self.dropout = Dropout(dropout_rate)
+
+    def forward(self, src):
+        residual = src
+        x = self.pre_ln(src) if self.normalize_before else src
+        x = self.linear1(x)
+        x = getattr(F, self.activation)(x)
+        x = self.act_dropout(x)
+        x = self.linear2(x)
+        out = residual + self.dropout(x)
+        if not self.normalize_before:
+            out = self.ln(out)
+        return out
+
+
+class FusedTransformerEncoderLayer(Layer):
+    """fused attention + fused FFN — fused_transformer.py:641."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate=dropout_rate,
+            attn_dropout_rate=(dropout_rate if attn_dropout_rate is None
+                               else attn_dropout_rate),
+            normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None, cache=None):
+        out = self.fused_attn(src, attn_mask=src_mask, cache=cache)
+        return self.ffn(out)
+
+
+class FusedMultiTransformer(Layer):
+    """N stacked pre-LN transformer blocks — fused_transformer.py:914
+    (fused_multi_transformer_op.cu: the whole decoder stack as one fused op;
+    here one jit region the compiler schedules)."""
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward, dropout_rate=0.0,
+                 activation="gelu", normalize_before=True, ln_scale_attrs=None,
+                 ln_bias_attrs=None, num_layers=-1, nranks=1, ring_id=-1,
+                 name=None, **kwargs):
+        super().__init__()
+        if num_layers < 0:
+            num_layers = 1
+        self.layers = LayerList([
+            FusedTransformerEncoderLayer(
+                embed_dim, num_heads, dim_feedforward,
+                dropout_rate=dropout_rate, activation=activation,
+                normalize_before=normalize_before)
+            for _ in range(num_layers)])
+
+    def forward(self, src, attn_mask=None, caches=None, **kwargs):
+        x = src
+        for layer in self.layers:
+            x = layer(x, src_mask=attn_mask)
+        return x
