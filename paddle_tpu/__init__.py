@@ -39,6 +39,7 @@ from . import device  # noqa: E402,F401
 from . import autograd  # noqa: E402,F401
 from . import distributed  # noqa: E402,F401
 from . import incubate  # noqa: E402,F401
+from . import vision  # noqa: E402,F401
 from .distributed.parallel import DataParallel  # noqa: E402,F401
 
 __version__ = "0.1.0"

@@ -132,10 +132,13 @@ def _adaptive_pool(x, output_size, n, channel_last, kind):
     for i, d in enumerate(spatial):
         size = out.shape[d]
         bins = out_sizes[i] if out_sizes[i] is not None else size
-        edges = [(size * b) // bins for b in range(bins + 1)]
-        if all(edges[b + 1] - edges[b] == edges[1] - edges[0] for b in range(bins)):
+        # window [floor(b*size/bins), ceil((b+1)*size/bins)) — never empty,
+        # also correct when bins > size (windows overlap / repeat)
+        starts = [(size * b) // bins for b in range(bins)]
+        ends = [-(-(size * (b + 1)) // bins) for b in range(bins)]
+        if size % bins == 0:
             # uniform bins → reshape-reduce (fast path)
-            k = edges[1] - edges[0]
+            k = size // bins
             new_shape = out.shape[:d] + (bins, k) + out.shape[d + 1:]
             r = out.reshape(new_shape)
             out = jnp.max(r, axis=d + 1) if kind == "max" else jnp.mean(r, axis=d + 1)
@@ -143,7 +146,7 @@ def _adaptive_pool(x, output_size, n, channel_last, kind):
             chunks = []
             for b in range(bins):
                 sl = [slice(None)] * out.ndim
-                sl[d] = slice(edges[b], edges[b + 1])
+                sl[d] = slice(starts[b], ends[b])
                 piece = out[tuple(sl)]
                 red = jnp.max(piece, axis=d, keepdims=True) if kind == "max" \
                     else jnp.mean(piece, axis=d, keepdims=True)

@@ -1,0 +1,21 @@
+"""paddle.vision parity (SURVEY §2.3: vision/models model zoo, transforms,
+ops.py detection ops, datasets)."""
+from . import datasets  # noqa: F401
+from . import models  # noqa: F401
+from . import ops  # noqa: F401
+from . import transforms  # noqa: F401
+from .models import *  # noqa: F401,F403
+
+
+def set_image_backend(backend):
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(f"unknown image backend {backend!r}")
+    global _image_backend
+    _image_backend = backend
+
+
+def get_image_backend():
+    return _image_backend
+
+
+_image_backend = "pil"

@@ -1,0 +1,11 @@
+"""paddle.vision.datasets parity (python/paddle/vision/datasets/).
+
+No-egress build: datasets load from LOCAL files (pass `image_path`/
+`data_file`); the download=True default of the reference raises with a clear
+message instead of fetching.  `FakeData` provides synthetic samples for
+tests/smoke-training (the reference's fake reader pattern).
+"""
+from .mnist import MNIST, FashionMNIST  # noqa: F401
+from .cifar import Cifar10, Cifar100  # noqa: F401
+from .fake import FakeData  # noqa: F401
+from .flowers import Flowers  # noqa: F401

@@ -1,0 +1,397 @@
+"""paddle.vision.ops parity — detection ops (reference: vision/ops.py
+yolo_box:250, deform_conv2d:427, psroi_pool:1057, roi_align:1302, nms:1517,
+backed there by CUDA kernels).
+
+TPU-native formulations: everything is expressed as dense gathers / one-hot
+matmuls with static shapes so XLA can compile it; nms uses an O(N^2) IoU
+matrix + lax.fori_loop greedy sweep (the data-dependent early-exit loop the
+CUDA kernel uses has no XLA analog).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.op import apply_op
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer
+
+
+def _unwrap(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+# -- yolo_box ----------------------------------------------------------------
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, name=None, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5):
+    """vision/ops.py:250 parity: decode a YOLOv3 head [N, A*(5+C), H, W] into
+    boxes [N, A*H*W, 4] and scores [N, A*H*W, C]."""
+    anchors_np = np.asarray(anchors, np.float32).reshape(-1, 2)
+    na = anchors_np.shape[0]
+
+    def decode(xv, img):
+        n, _, h, w = xv.shape
+        if iou_aware:
+            # iou-aware head (PP-YOLO): x = [N, na + na*(5+C), H, W], the
+            # leading na channels are predicted IoU; objectness becomes
+            # conf^(1-f) * iou^f (yolo_box kernel iou_aware branch)
+            iou_pred = jax.nn.sigmoid(xv[:, :na].reshape(n, na, h, w))
+            xv = xv[:, na:]
+        pred = xv.reshape(n, na, 5 + class_num, h, w)
+        gx = jnp.arange(w, dtype=xv.dtype)
+        gy = jnp.arange(h, dtype=xv.dtype)
+        bx = (jax.nn.sigmoid(pred[:, :, 0]) * scale_x_y -
+              (scale_x_y - 1) / 2 + gx[None, None, None, :]) / w
+        by = (jax.nn.sigmoid(pred[:, :, 1]) * scale_x_y -
+              (scale_x_y - 1) / 2 + gy[None, None, :, None]) / h
+        anc = jnp.asarray(anchors_np, xv.dtype)
+        bw = jnp.exp(pred[:, :, 2]) * anc[None, :, 0, None, None] / \
+            (w * downsample_ratio)
+        bh = jnp.exp(pred[:, :, 3]) * anc[None, :, 1, None, None] / \
+            (h * downsample_ratio)
+        conf = jax.nn.sigmoid(pred[:, :, 4])
+        if iou_aware:
+            conf = conf ** (1.0 - iou_aware_factor) * \
+                iou_pred ** iou_aware_factor
+        probs = jax.nn.sigmoid(pred[:, :, 5:])
+        scores = conf[:, :, None] * probs
+        # below-threshold boxes are zeroed like the reference
+        keep = (conf >= conf_thresh)[:, :, None]
+        img_h = img[:, 0].reshape(n, 1, 1, 1)
+        img_w = img[:, 1].reshape(n, 1, 1, 1)
+        x0 = (bx - bw / 2) * img_w
+        y0 = (by - bh / 2) * img_h
+        x1 = (bx + bw / 2) * img_w
+        y1 = (by + bh / 2) * img_h
+        if clip_bbox:
+            x0 = jnp.clip(x0, 0, img_w - 1)
+            y0 = jnp.clip(y0, 0, img_h - 1)
+            x1 = jnp.clip(x1, 0, img_w - 1)
+            y1 = jnp.clip(y1, 0, img_h - 1)
+        boxes = jnp.stack([x0, y0, x1, y1], axis=2)
+        boxes = boxes * (conf >= conf_thresh)[:, :, None]
+        boxes = boxes.transpose(0, 1, 3, 4, 2).reshape(n, na * h * w, 4)
+        scores = (scores * keep).transpose(0, 1, 3, 4, 2).reshape(
+            n, na * h * w, class_num)
+        return boxes, scores
+
+    b, s = decode(_unwrap(x), _unwrap(img_size).astype(jnp.float32))
+    return Tensor(b, _internal=True), Tensor(s, _internal=True)
+
+
+# -- roi_align ---------------------------------------------------------------
+
+def _bilinear_gather(feat, ys, xs):
+    """feat [C,H,W]; ys/xs arbitrary shape -> [C, *shape] bilinear samples."""
+    h, w = feat.shape[-2], feat.shape[-1]
+    y0 = jnp.floor(ys)
+    x0 = jnp.floor(xs)
+    y1, x1 = y0 + 1, x0 + 1
+    wy1 = ys - y0
+    wx1 = xs - x0
+    wy0, wx0 = 1 - wy1, 1 - wx1
+
+    def at(yi, xi):
+        yc = jnp.clip(yi.astype(jnp.int32), 0, h - 1)
+        xc = jnp.clip(xi.astype(jnp.int32), 0, w - 1)
+        return feat[:, yc, xc]
+
+    valid = ((ys >= -1) & (ys <= h) & (xs >= -1) & (xs <= w))
+    out = (at(y0, x0) * (wy0 * wx0) + at(y0, x1) * (wy0 * wx1) +
+           at(y1, x0) * (wy1 * wx0) + at(y1, x1) * (wy1 * wx1))
+    return out * valid
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """vision/ops.py:1302 parity.  boxes: [R, 4] (x0,y0,x1,y1) in image
+    coords; boxes_num: rois per batch image.
+
+    sampling_ratio<=0 means adaptive ceil(bin_size) samples per bin like the
+    reference kernel; per-roi counts need concrete box values, so under a jit
+    trace the adaptive path falls back to the reference's common effective
+    ratio of 2 (static shapes are an XLA requirement).
+    """
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+
+    bv_probe = _unwrap(boxes)
+    if sampling_ratio > 0:
+        sr_list = None
+        sr = sampling_ratio
+    elif isinstance(bv_probe, jax.core.Tracer):
+        sr_list = None
+        sr = 2
+    else:
+        # per-roi adaptive ratios from concrete boxes (reference semantics)
+        b_np = np.asarray(bv_probe)
+        rh_np = (b_np[:, 3] - b_np[:, 1]) * spatial_scale
+        rw_np = (b_np[:, 2] - b_np[:, 0]) * spatial_scale
+        if not aligned:
+            rh_np = np.maximum(rh_np, 1.0)
+            rw_np = np.maximum(rw_np, 1.0)
+        sr_list = [(max(1, int(np.ceil(rh_np[i] / ph))),
+                    max(1, int(np.ceil(rw_np[i] / pw))))
+                   for i in range(b_np.shape[0])]
+        sr = None
+
+    def impl(xv, bv, bn):
+        # map each roi to its image via boxes_num prefix sums
+        r = bv.shape[0]
+        starts = jnp.cumsum(bn) - bn
+        roi_img = jnp.sum(jnp.arange(r)[:, None] >=
+                          starts[None, :], axis=1) - 1
+
+        off = 0.5 if aligned else 0.0
+        x0 = bv[:, 0] * spatial_scale - off
+        y0 = bv[:, 1] * spatial_scale - off
+        x1 = bv[:, 2] * spatial_scale - off
+        y1 = bv[:, 3] * spatial_scale - off
+        rw = x1 - x0
+        rh = y1 - y0
+        if not aligned:
+            rw = jnp.maximum(rw, 1.0)
+            rh = jnp.maximum(rh, 1.0)
+        bin_h = rh / ph
+        bin_w = rw / pw
+
+        def roi_pool(ri, sr_h, sr_w):
+            feat = xv[roi_img[ri]]
+            iy = (jnp.arange(ph)[:, None] +
+                  (jnp.arange(sr_h)[None, :] + 0.5) / sr_h)
+            ix = (jnp.arange(pw)[:, None] +
+                  (jnp.arange(sr_w)[None, :] + 0.5) / sr_w)
+            yy = (y0[ri] + iy * bin_h[ri]).reshape(-1)  # ph*sr_h
+            xx = (x0[ri] + ix * bin_w[ri]).reshape(-1)  # pw*sr_w
+            grid_y = jnp.repeat(yy, xx.shape[0])
+            grid_x = jnp.tile(xx, yy.shape[0])
+            vals = _bilinear_gather(feat, grid_y, grid_x)
+            vals = vals.reshape(feat.shape[0], ph, sr_h, pw, sr_w)
+            return vals.mean(axis=(2, 4))
+
+        if sr_list is not None:
+            return jnp.stack([roi_pool(i, *sr_list[i]) for i in range(r)])
+        return jax.vmap(lambda ri: roi_pool(ri, sr, sr))(jnp.arange(r))
+
+    return apply_op(impl, "roi_align", (x, boxes, boxes_num), {})
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """vision/ops.py:1057 parity: position-sensitive RoI average pooling.
+    Input channels C = output_channels * ph * pw; bin (i,j) pools its own
+    channel slice."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+
+    def impl(xv, bv, bn):
+        c = xv.shape[1]
+        out_c = c // (ph * pw)
+        r = bv.shape[0]
+        starts = jnp.cumsum(bn) - bn
+        roi_img = jnp.sum(jnp.arange(r)[:, None] >= starts[None, :], axis=1) - 1
+        h, w = xv.shape[2], xv.shape[3]
+
+        x0 = bv[:, 0] * spatial_scale
+        y0 = bv[:, 1] * spatial_scale
+        x1 = bv[:, 2] * spatial_scale
+        y1 = bv[:, 3] * spatial_scale
+        rw = jnp.maximum(x1 - x0, 0.1)
+        rh = jnp.maximum(y1 - y0, 0.1)
+        bin_h = rh / ph
+        bin_w = rw / pw
+
+        yy = jnp.arange(h, dtype=xv.dtype)
+        xx = jnp.arange(w, dtype=xv.dtype)
+
+        def per_roi(ri):
+            feat = xv[roi_img[ri]].reshape(out_c, ph, pw, h, w)
+            ys = y0[ri] + jnp.arange(ph, dtype=xv.dtype) * bin_h[ri]
+            ye = ys + bin_h[ri]
+            xs = x0[ri] + jnp.arange(pw, dtype=xv.dtype) * bin_w[ri]
+            xe = xs + bin_w[ri]
+            my = ((yy[None, :] >= jnp.floor(ys)[:, None]) &
+                  (yy[None, :] < jnp.ceil(ye)[:, None])).astype(xv.dtype)
+            mx = ((xx[None, :] >= jnp.floor(xs)[:, None]) &
+                  (xx[None, :] < jnp.ceil(xe)[:, None])).astype(xv.dtype)
+            # bin (i,j) mean over its mask, from its own channel group
+            area = jnp.maximum(my.sum(1)[:, None] * mx.sum(1)[None, :], 1.0)
+            pooled = jnp.einsum("opqhw,ph,qw->opq", feat, my, mx) / area
+            return pooled
+
+        return jax.vmap(per_roi)(jnp.arange(r))
+
+    return apply_op(impl, "psroi_pool", (x, boxes, boxes_num), {})
+
+
+# -- nms ---------------------------------------------------------------------
+
+def _iou_matrix(boxes):
+    areas = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+    lt = jnp.maximum(boxes[:, None, :2], boxes[None, :, :2])
+    rb = jnp.minimum(boxes[:, None, 2:], boxes[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = areas[:, None] + areas[None, :] - inter
+    return inter / jnp.maximum(union, 1e-9)
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None, name=None):
+    """vision/ops.py:1517 parity: greedy hard-NMS; returns kept indices
+    sorted by descending score.  Category-aware when category_idxs given
+    (boxes of different categories never suppress each other)."""
+    bv = _unwrap(boxes)
+    n = bv.shape[0]
+    sv = _unwrap(scores) if scores is not None else jnp.ones((n,), bv.dtype)
+
+    iou = _iou_matrix(bv)
+    if category_idxs is not None:
+        cv = _unwrap(category_idxs)
+        same = cv[:, None] == cv[None, :]
+        iou = jnp.where(same, iou, 0.0)
+
+    order = jnp.argsort(-sv)
+
+    def body(i, keep):
+        idx = order[i]
+        # suppressed if any higher-scoring KEPT box overlaps > threshold
+        sup = jnp.any((iou[idx, order[:n]] > iou_threshold) &
+                      keep[order[:n]] & (jnp.arange(n) < i))
+        return keep.at[idx].set(~sup)
+
+    keep = jax.lax.fori_loop(0, n, body, jnp.zeros((n,), bool))
+    kept_sorted = order[keep[order]]
+    if top_k is not None:
+        kept_sorted = kept_sorted[:top_k]
+    return Tensor(kept_sorted, _internal=True)
+
+
+# -- deform_conv2d -----------------------------------------------------------
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """vision/ops.py:427 parity (DCNv1 when mask is None, DCNv2 with mask):
+    bilinear-sample input at offset positions, then a dense matmul — the
+    gather+GEMM decomposition of the CUDA kernel, which is also the
+    MXU-friendly layout."""
+    if isinstance(stride, int):
+        stride = (stride, stride)
+    if isinstance(padding, int):
+        padding = (padding, padding)
+    if isinstance(dilation, int):
+        dilation = (dilation, dilation)
+
+    def impl(xv, ov, wv, bv2, mv):
+        n, c, h, w = xv.shape
+        oc, cpg, kh, kw = wv.shape
+        sh, sw = stride
+        ph_, pw_ = padding
+        dh, dw = dilation
+        out_h = (h + 2 * ph_ - (dh * (kh - 1) + 1)) // sh + 1
+        out_w = (w + 2 * pw_ - (dw * (kw - 1) + 1)) // sw + 1
+        xp = jnp.pad(xv, ((0, 0), (0, 0), (ph_, ph_), (pw_, pw_)))
+
+        base_y = (jnp.arange(out_h) * sh)[:, None, None] + \
+            (jnp.arange(kh) * dh)[None, :, None]  # [oh,kh,1]
+        base_x = (jnp.arange(out_w) * sw)[:, None, None] + \
+            (jnp.arange(kw) * dw)[None, :, None]  # [ow,kw,1]
+        # offsets: [N, dg*2*kh*kw, oh, ow] (y then x per kernel point)
+        ov_r = ov.reshape(n, deformable_groups, 2, kh * kw, out_h, out_w)
+
+        def per_image(xi, oi, mi):
+            def per_dg(g):
+                oy = oi[g, 0].reshape(kh, kw, out_h, out_w)
+                ox = oi[g, 1].reshape(kh, kw, out_h, out_w)
+                gy = (jnp.arange(out_h)[None, None, :, None] * sh +
+                      jnp.arange(kh)[:, None, None, None] * dh + oy)
+                gx = (jnp.arange(out_w)[None, None, None, :] * sw +
+                      jnp.arange(kw)[None, :, None, None] * dw + ox)
+                cg = c // deformable_groups
+                feat = xi[g * cg:(g + 1) * cg]
+                samp = _bilinear_gather(feat, gy.reshape(-1), gx.reshape(-1))
+                samp = samp.reshape(cg, kh, kw, out_h, out_w)
+                if mi is not None:
+                    mg = mi[g].reshape(kh, kw, out_h, out_w)
+                    samp = samp * mg[None]
+                return samp
+
+            cols = jnp.concatenate([per_dg(g)
+                                    for g in range(deformable_groups)], axis=0)
+            # cols: [C,kh,kw,oh,ow]; grouped conv = one einsum per the
+            # gather+GEMM decomposition
+            cpg_in = c // groups
+            opg = oc // groups
+            cols_g = cols.reshape(groups, cpg_in, kh, kw, out_h, out_w)
+            w_g = wv.reshape(groups, opg, cpg, kh, kw)
+            out = jnp.einsum("gcpqij,gocpq->goij", cols_g, w_g)
+            return out.reshape(oc, out_h, out_w)
+
+        mvv = [None] * n if mv is None else \
+            mv.reshape(n, deformable_groups, kh * kw, out_h, out_w)
+        outs = jnp.stack([
+            per_image(xp[i], ov_r[i],
+                      None if mv is None else mvv[i]) for i in range(n)])
+        if bv2 is not None:
+            outs = outs + bv2[None, :, None, None]
+        return outs
+
+    return apply_op(impl, "deform_conv2d", (x, offset, weight, bias, mask), {})
+
+
+class DeformConv2D(Layer):
+    """vision/ops.py DeformConv2D layer parity."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        from ..nn import initializer as _I  # noqa: F401
+        if isinstance(kernel_size, int):
+            kernel_size = (kernel_size, kernel_size)
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._deformable_groups = deformable_groups
+        self._groups = groups
+        fan_in = in_channels // groups * kernel_size[0] * kernel_size[1]
+        bound = 1.0 / np.sqrt(fan_in)
+        from ..nn.initializer import Uniform
+        self.weight = self.create_parameter(
+            (out_channels, in_channels // groups) + tuple(kernel_size),
+            attr=weight_attr, default_initializer=Uniform(-bound, bound))
+        self.bias = self.create_parameter(
+            (out_channels,), attr=bias_attr, is_bias=True,
+            default_initializer=Uniform(-bound, bound))
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias, self._stride,
+                             self._padding, self._dilation,
+                             self._deformable_groups, self._groups, mask)
+
+
+class RoIAlign(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num, aligned=True):
+        return roi_align(x, boxes, boxes_num, self._output_size,
+                         self._spatial_scale, aligned=aligned)
+
+
+class PSRoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self._output_size,
+                          self._spatial_scale)

@@ -1,0 +1,209 @@
+"""Vision model zoo / ops / transforms / datasets tests (reference:
+python/paddle/tests/test_vision_models.py, test_ops_*.py, test_transforms)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import models, ops, transforms
+from paddle_tpu.vision.datasets import FakeData
+
+
+def _img(n=1, c=3, s=64):
+    return paddle.to_tensor(
+        np.random.RandomState(0).randn(n, c, s, s).astype("float32"))
+
+
+@pytest.mark.parametrize("factory,shape", [
+    (lambda: models.resnet18(num_classes=10), (1, 10)),
+    (lambda: models.resnet50(num_classes=10), (1, 10)),
+    (lambda: models.wide_resnet50_2(num_classes=7), (1, 7)),
+    (lambda: models.resnext50_32x4d(num_classes=5), (1, 5)),
+    (lambda: models.vgg11(num_classes=10), (1, 10)),
+    (lambda: models.mobilenet_v1(num_classes=10), (1, 10)),
+    (lambda: models.mobilenet_v2(num_classes=10), (1, 10)),
+    (lambda: models.mobilenet_v3_small(num_classes=10), (1, 10)),
+    (lambda: models.squeezenet1_0(num_classes=10), (1, 10)),
+    (lambda: models.shufflenet_v2_x0_25(num_classes=10), (1, 10)),
+    (lambda: models.densenet121(num_classes=10), (1, 10)),
+    (lambda: models.inception_v3(num_classes=10), (1, 10)),
+])
+def test_model_forward_shapes(factory, shape):
+    paddle.seed(0)
+    model = factory()
+    model.eval()
+    size = 96 if "Inception" in type(model).__name__ else 64
+    out = model(_img(s=size))
+    assert tuple(out.shape) == shape
+    assert np.isfinite(out.numpy()).all()
+
+
+def test_lenet_and_alexnet():
+    paddle.seed(0)
+    lenet = models.LeNet()
+    lenet.eval()
+    x = paddle.to_tensor(np.random.RandomState(1).randn(2, 1, 28, 28)
+                         .astype("float32"))
+    assert tuple(lenet(x).shape) == (2, 10)
+
+    alex = models.alexnet(num_classes=10)
+    alex.eval()
+    assert tuple(alex(_img(s=224)).shape) == (1, 10)
+
+
+def test_googlenet_aux_heads():
+    paddle.seed(0)
+    net = models.googlenet(num_classes=10)
+    net.eval()
+    out, out1, out2 = net(_img(s=224))
+    assert tuple(out.shape) == (1, 10)
+    assert tuple(out1.shape) == (1, 10)
+    assert tuple(out2.shape) == (1, 10)
+
+
+def test_resnet_trains():
+    paddle.seed(0)
+    model = models.resnet18(num_classes=4)
+    opt = paddle.optimizer.Adam(parameters=model.parameters(),
+                                learning_rate=1e-3)
+    ce = paddle.nn.CrossEntropyLoss()
+    x = np.random.RandomState(0).randn(8, 3, 32, 32).astype("float32")
+    y = np.random.RandomState(1).randint(0, 4, (8,)).astype("int64")
+    losses = []
+    for _ in range(5):
+        out = model(paddle.to_tensor(x))
+        loss = ce(out, paddle.to_tensor(y))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
+
+
+# -- ops ---------------------------------------------------------------------
+
+def test_nms():
+    boxes = paddle.to_tensor(np.array([
+        [0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60], [0, 0, 9, 9],
+    ], "float32"))
+    scores = paddle.to_tensor(np.array([0.9, 0.8, 0.95, 0.3], "float32"))
+    kept = ops.nms(boxes, iou_threshold=0.5, scores=scores).numpy()
+    # box1 overlaps box0 (suppressed); box3 overlaps box0 (suppressed)
+    assert list(kept) == [2, 0]
+
+
+def test_nms_categories():
+    boxes = paddle.to_tensor(np.array([
+        [0, 0, 10, 10], [1, 1, 11, 11]], "float32"))
+    scores = paddle.to_tensor(np.array([0.9, 0.8], "float32"))
+    cats = paddle.to_tensor(np.array([0, 1], "int64"))
+    kept = ops.nms(boxes, 0.5, scores, category_idxs=cats,
+                   categories=[0, 1]).numpy()
+    assert sorted(kept.tolist()) == [0, 1]  # different category → both kept
+
+
+def test_roi_align_shapes_and_values():
+    # constant feature map: every pooled value equals the constant
+    x = paddle.to_tensor(np.full((1, 2, 16, 16), 3.0, "float32"))
+    boxes = paddle.to_tensor(np.array([[0, 0, 8, 8], [4, 4, 12, 12]],
+                                      "float32"))
+    bn = paddle.to_tensor(np.array([2], "int32"))
+    out = ops.roi_align(x, boxes, bn, output_size=4)
+    assert tuple(out.shape) == (2, 2, 4, 4)
+    np.testing.assert_allclose(out.numpy(), 3.0, rtol=1e-5)
+
+
+def test_roi_align_grad():
+    x = paddle.to_tensor(np.random.RandomState(0).randn(1, 2, 8, 8)
+                         .astype("float32"))
+    x.stop_gradient = False
+    boxes = paddle.to_tensor(np.array([[0, 0, 4, 4]], "float32"))
+    bn = paddle.to_tensor(np.array([1], "int32"))
+    out = ops.roi_align(x, boxes, bn, output_size=2)
+    out.sum().backward()
+    assert x.grad is not None
+    assert float(np.abs(x.grad.numpy()).sum()) > 0
+
+
+def test_psroi_pool():
+    # C = out_c * ph * pw = 2 * 2 * 2 = 8
+    x = paddle.to_tensor(np.full((1, 8, 8, 8), 2.0, "float32"))
+    boxes = paddle.to_tensor(np.array([[0, 0, 8, 8]], "float32"))
+    bn = paddle.to_tensor(np.array([1], "int32"))
+    out = ops.psroi_pool(x, boxes, bn, output_size=2)
+    assert tuple(out.shape) == (1, 2, 2, 2)
+    np.testing.assert_allclose(out.numpy(), 2.0, rtol=1e-5)
+
+
+def test_yolo_box():
+    n, na, cls, h = 1, 3, 4, 5
+    x = paddle.to_tensor(np.random.RandomState(0).randn(
+        n, na * (5 + cls), h, h).astype("float32"))
+    img_size = paddle.to_tensor(np.array([[160, 160]], "int32"))
+    boxes, scores = ops.yolo_box(x, img_size, [10, 13, 16, 30, 33, 23], cls,
+                                 0.01, 32)
+    assert tuple(boxes.shape) == (1, na * h * h, 4)
+    assert tuple(scores.shape) == (1, na * h * h, cls)
+    b = boxes.numpy()
+    assert (b >= 0).all() and (b <= 160).all()
+
+
+def test_deform_conv2d_matches_plain_conv_with_zero_offset():
+    """Zero offsets + ones mask ⇒ deform conv == standard conv."""
+    import paddle_tpu.nn.functional as F
+    rng = np.random.RandomState(3)
+    x_np = rng.randn(1, 2, 8, 8).astype("float32")
+    w_np = rng.randn(4, 2, 3, 3).astype("float32")
+    x = paddle.to_tensor(x_np)
+    w = paddle.to_tensor(w_np)
+    offset = paddle.to_tensor(np.zeros((1, 2 * 9, 6, 6), "float32"))
+    out = ops.deform_conv2d(x, offset, w, stride=1, padding=0)
+    ref = F.conv2d(x, w, stride=1, padding=0)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-4, atol=1e-4)
+
+
+# -- transforms / datasets ---------------------------------------------------
+
+def test_transforms_pipeline():
+    t = transforms.Compose([
+        transforms.Resize(40),
+        transforms.CenterCrop(32),
+        transforms.RandomHorizontalFlip(0.5),
+        transforms.ToTensor(),
+        transforms.Normalize(mean=[0.5, 0.5, 0.5], std=[0.5, 0.5, 0.5]),
+    ])
+    img = (np.random.RandomState(0).rand(50, 60, 3) * 255).astype("uint8")
+    out = t(img)
+    assert tuple(out.shape) == (3, 32, 32)
+    assert np.asarray(out).min() >= -1.001 and np.asarray(out).max() <= 1.001
+
+
+def test_transform_functional():
+    from paddle_tpu.vision.transforms import functional as TF
+    img = (np.random.RandomState(0).rand(20, 30, 3) * 255).astype("uint8")
+    assert TF.resize(img, (10, 15)).shape == (10, 15, 3)
+    assert TF.hflip(img)[0, 0].tolist() == img[0, -1].tolist()
+    assert TF.pad(img, 2).shape == (24, 34, 3)
+    assert TF.to_grayscale(img).shape == (20, 30, 1)
+    assert TF.adjust_brightness(img, 1.5).shape == img.shape
+    assert TF.adjust_contrast(img, 0.5).shape == img.shape
+    assert TF.adjust_hue(img, 0.2).shape == img.shape
+    assert TF.rotate(img, 45).shape == img.shape
+
+
+def test_fake_data_with_dataloader():
+    from paddle_tpu.io import DataLoader
+    ds = FakeData(size=16, image_shape=(3, 8, 8), num_classes=3)
+    loader = DataLoader(ds, batch_size=4, shuffle=True)
+    batches = list(loader)
+    assert len(batches) == 4
+    xb, yb = batches[0]
+    assert tuple(xb.shape) == (4, 3, 8, 8)
+    assert tuple(yb.shape) == (4, 1)
+
+
+def test_dataset_errors():
+    from paddle_tpu.vision.datasets import MNIST, Cifar10
+    with pytest.raises((ValueError, FileNotFoundError)):
+        MNIST(image_path="/nonexistent", label_path="/nonexistent")
+    with pytest.raises(ValueError):
+        Cifar10()
