@@ -1,0 +1,5 @@
+"""hapi — the Keras-like high-level API (reference: python/paddle/hapi/,
+`Model` at hapi/model.py:915, callbacks at hapi/callbacks.py)."""
+from . import callbacks  # noqa: F401
+from .model import Model  # noqa: F401
+from .summary import summary  # noqa: F401

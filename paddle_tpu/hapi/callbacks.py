@@ -1,0 +1,386 @@
+"""hapi callbacks — parity with python/paddle/hapi/callbacks.py
+(ProgBarLogger, ModelCheckpoint:534, LRScheduler:599, EarlyStopping:690,
+VisualDL:844, ReduceLROnPlateau:960)."""
+from __future__ import annotations
+
+import numbers
+import os
+import warnings
+
+import numpy as np
+
+from .progressbar import ProgressBar
+
+
+def config_callbacks(callbacks=None, model=None, batch_size=None, epochs=None,
+                     steps=None, log_freq=2, verbose=2, save_freq=1,
+                     save_dir=None, metrics=None, mode="train"):
+    cbks = callbacks if callbacks is not None else []
+    cbks = cbks if isinstance(cbks, (list, tuple)) else [cbks]
+    if not any(isinstance(k, ProgBarLogger) for k in cbks) and verbose:
+        cbks = [ProgBarLogger(log_freq, verbose=verbose)] + list(cbks)
+    if not any(isinstance(k, LRScheduler) for k in cbks):
+        cbks = [LRScheduler()] + list(cbks)
+    if save_dir and not any(isinstance(k, ModelCheckpoint) for k in cbks):
+        cbks = list(cbks) + [ModelCheckpoint(save_freq, save_dir)]
+    cbk_list = CallbackList(cbks)
+    cbk_list.set_model(model)
+    metrics = metrics or []
+    params = {"batch_size": batch_size, "epochs": epochs, "steps": steps,
+              "verbose": verbose, "metrics": metrics}
+    cbk_list.set_params(params)
+    return cbk_list
+
+
+class CallbackList:
+    def __init__(self, callbacks):
+        self.callbacks = list(callbacks)
+
+    def set_model(self, model):
+        for c in self.callbacks:
+            c.set_model(model)
+
+    def set_params(self, params):
+        for c in self.callbacks:
+            c.set_params(params)
+
+    def _call(self, name, *args):
+        for c in self.callbacks:
+            getattr(c, name)(*args)
+
+    def __getattr__(self, name):
+        if name.startswith("on_"):
+            return lambda *args: self._call(name, *args)
+        raise AttributeError(name)
+
+
+class Callback:
+    """hapi/callbacks.py Callback base: all hooks are no-ops."""
+
+    def __init__(self):
+        self.model = None
+        self.params = {}
+
+    def set_params(self, params):
+        self.params = params
+
+    def set_model(self, model):
+        self.model = model
+
+    def on_train_begin(self, logs=None):
+        pass
+
+    def on_train_end(self, logs=None):
+        pass
+
+    def on_eval_begin(self, logs=None):
+        pass
+
+    def on_eval_end(self, logs=None):
+        pass
+
+    def on_predict_begin(self, logs=None):
+        pass
+
+    def on_predict_end(self, logs=None):
+        pass
+
+    def on_epoch_begin(self, epoch, logs=None):
+        pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        pass
+
+    def on_train_batch_begin(self, step, logs=None):
+        pass
+
+    def on_train_batch_end(self, step, logs=None):
+        pass
+
+    def on_eval_batch_begin(self, step, logs=None):
+        pass
+
+    def on_eval_batch_end(self, step, logs=None):
+        pass
+
+    def on_predict_batch_begin(self, step, logs=None):
+        pass
+
+    def on_predict_batch_end(self, step, logs=None):
+        pass
+
+
+class ProgBarLogger(Callback):
+    def __init__(self, log_freq=1, verbose=2):
+        super().__init__()
+        self.log_freq = log_freq
+        self.verbose = verbose
+
+    def on_train_begin(self, logs=None):
+        self.epochs = self.params.get("epochs")
+        names = []
+        for m in self.params.get("metrics", []):
+            n = m.name()
+            names.extend(n if isinstance(n, (list, tuple)) else [n])
+        self.train_metrics = ["loss"] + names
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.steps = self.params.get("steps")
+        self.epoch = epoch
+        self.train_step = 0
+        if self.verbose and self.epochs:
+            print(f"Epoch {epoch + 1}/{self.epochs}")
+        self.progbar = ProgressBar(num=self.steps, verbose=self.verbose)
+
+    def _updates(self, logs):
+        values = []
+        for k in getattr(self, "train_metrics", ["loss"]):
+            if k in (logs or {}):
+                v = logs[k]
+                if isinstance(v, (list, tuple, np.ndarray)):
+                    v = float(np.ravel(v)[0])
+                values.append((k, v))
+        return values
+
+    def on_train_batch_end(self, step, logs=None):
+        self.train_step += 1
+        if self.verbose and self.train_step % self.log_freq == 0:
+            self.progbar.update(self.train_step, self._updates(logs))
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.verbose:
+            self.progbar.update(self.train_step, self._updates(logs))
+
+    def on_eval_begin(self, logs=None):
+        self.eval_steps = (logs or {}).get("steps")
+        self.eval_progbar = ProgressBar(num=self.eval_steps,
+                                        verbose=self.verbose)
+        if self.verbose:
+            print("Eval begin...")
+
+    def on_eval_batch_end(self, step, logs=None):
+        if self.verbose and (step + 1) % self.log_freq == 0:
+            self.eval_progbar.update(step + 1, self._updates(logs))
+
+    def on_eval_end(self, logs=None):
+        if self.verbose:
+            items = ", ".join(f"{k}: {v}" for k, v in (logs or {}).items()
+                              if k != "batch_size")
+            print(f"Eval samples done — {items}")
+
+
+class ModelCheckpoint(Callback):
+    """hapi/callbacks.py:534: save every `save_freq` epochs + final."""
+
+    def __init__(self, save_freq=1, save_dir=None):
+        super().__init__()
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.model is not None and self.save_dir and \
+                epoch % self.save_freq == 0:
+            path = os.path.join(self.save_dir, str(epoch))
+            self.model.save(path)
+
+    def on_train_end(self, logs=None):
+        if self.model is not None and self.save_dir:
+            self.model.save(os.path.join(self.save_dir, "final"))
+
+
+class LRScheduler(Callback):
+    """hapi/callbacks.py:599: step the optimizer's LRScheduler."""
+
+    def __init__(self, by_step=True, by_epoch=False):
+        super().__init__()
+        if by_step and by_epoch:
+            raise ValueError("by_step and by_epoch are mutually exclusive")
+        self.by_step = by_step
+        self.by_epoch = by_epoch
+
+    def _sched(self):
+        opt = getattr(self.model, "_optimizer", None)
+        lr = getattr(opt, "_lr", None) if opt else None
+        return lr if hasattr(lr, "step") else None
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.by_epoch:
+            s = self._sched()
+            if s is not None:
+                s.step()
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.by_step:
+            s = self._sched()
+            if s is not None:
+                s.step()
+
+
+class EarlyStopping(Callback):
+    """hapi/callbacks.py:690."""
+
+    def __init__(self, monitor="loss", mode="auto", patience=0, verbose=1,
+                 min_delta=0, baseline=None, save_best_model=True):
+        super().__init__()
+        self.monitor = monitor
+        self.patience = patience
+        self.verbose = verbose
+        self.baseline = baseline
+        self.min_delta = abs(min_delta)
+        self.wait_epoch = 0
+        self.best_weights = None
+        self.stopped_epoch = 0
+        self.save_best_model = save_best_model
+        if mode not in ("auto", "min", "max"):
+            warnings.warn(f"EarlyStopping mode {mode} unknown, using 'auto'")
+            mode = "auto"
+        if mode == "min" or (mode == "auto" and "acc" not in monitor):
+            self.monitor_op = np.less
+            self.min_delta *= -1
+        else:
+            self.monitor_op = np.greater
+
+    def on_train_begin(self, logs=None):
+        self.wait_epoch = 0
+        self.epoch = 0
+        if self.baseline is not None:
+            self.best_value = self.baseline
+        else:
+            self.best_value = np.inf if self.monitor_op == np.less else -np.inf
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.epoch = epoch
+
+    def on_eval_end(self, logs=None):
+        if logs is None or self.monitor not in logs:
+            warnings.warn(f"Monitor of EarlyStopping should be loss or metric "
+                          f"name; {self.monitor} missing in eval logs")
+            return
+        current = logs[self.monitor]
+        if isinstance(current, (list, tuple, np.ndarray)):
+            current = float(np.ravel(current)[0])
+        if self.monitor_op(current - self.min_delta, self.best_value):
+            self.best_value = current
+            self.wait_epoch = 0
+            if self.save_best_model and self.model is not None:
+                import copy
+                self.best_weights = copy.deepcopy(
+                    {k: v.numpy() for k, v in
+                     self.model.network.state_dict().items()})
+        else:
+            self.wait_epoch += 1
+        if self.wait_epoch >= self.patience:
+            self.stopped_epoch = self.epoch
+            self.model.stop_training = True
+            if self.verbose > 0:
+                print(f"Epoch {self.stopped_epoch + 1}: early stopping")
+
+    def on_train_end(self, logs=None):
+        # restore the best weights seen during training (reference saves the
+        # best model to save_dir; without a dir we restore in place)
+        if self.save_best_model and self.best_weights is not None and \
+                self.model is not None:
+            self.model.network.set_state_dict(self.best_weights)
+
+
+class ReduceLROnPlateau(Callback):
+    """hapi/callbacks.py:960: scale LR by `factor` after `patience` epochs
+    without improvement."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
+                 mode="auto", min_delta=1e-4, cooldown=0, min_lr=0):
+        super().__init__()
+        self.monitor = monitor
+        if factor >= 1.0:
+            raise ValueError("ReduceLROnPlateau does not support factor >= 1")
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = min_delta
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self.cooldown_counter = 0
+        self.wait = 0
+        if mode == "min" or (mode == "auto" and "acc" not in monitor):
+            self.monitor_op = lambda a, b: np.less(a, b - self.min_delta)
+            self.best = np.inf
+        else:
+            self.monitor_op = lambda a, b: np.greater(a, b + self.min_delta)
+            self.best = -np.inf
+
+    def on_eval_end(self, logs=None):
+        if logs is None or self.monitor not in logs:
+            warnings.warn(f"Monitor {self.monitor} missing in eval logs")
+            return
+        current = logs[self.monitor]
+        if isinstance(current, (list, tuple, np.ndarray)):
+            current = float(np.ravel(current)[0])
+        if self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+            self.wait = 0
+        if self.monitor_op(current, self.best):
+            self.best = current
+            self.wait = 0
+        elif self.cooldown_counter <= 0:
+            self.wait += 1
+            if self.wait >= self.patience:
+                opt = getattr(self.model, "_optimizer", None)
+                if opt is not None:
+                    old_lr = opt.get_lr()
+                    new_lr = max(old_lr * self.factor, self.min_lr)
+                    if old_lr - new_lr > 1e-12:
+                        try:
+                            opt.set_lr(new_lr)
+                            if self.verbose:
+                                print(f"ReduceLROnPlateau: lr {old_lr} -> "
+                                      f"{new_lr}")
+                        except RuntimeError:
+                            warnings.warn(
+                                "ReduceLROnPlateau cannot override an "
+                                "LRScheduler-driven optimizer; skipping")
+                self.cooldown_counter = self.cooldown
+                self.wait = 0
+
+
+class VisualDL(Callback):
+    """hapi/callbacks.py:844 — VisualDL isn't installed in this build; logs
+    scalars to a jsonl file under log_dir instead (same call pattern)."""
+
+    def __init__(self, log_dir):
+        super().__init__()
+        self.log_dir = log_dir
+        self.epochs = None
+        self.steps = None
+        self.epoch = 0
+        os.makedirs(log_dir, exist_ok=True)
+        self._file = None
+
+    def _log(self, tag, values, step):
+        import json
+        if self._file is None:
+            self._file = open(os.path.join(self.log_dir, "scalars.jsonl"),
+                              "a", buffering=1)
+        for k, v in (values or {}).items():
+            if isinstance(v, (list, tuple, np.ndarray)):
+                v = float(np.ravel(v)[0])
+            if isinstance(v, numbers.Number):
+                self._file.write(json.dumps({"tag": f"{tag}/{k}",
+                                             "value": float(v),
+                                             "step": int(step)}) + "\n")
+
+    def on_train_end(self, logs=None):
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.epoch = epoch
+
+    def on_train_batch_end(self, step, logs=None):
+        self._log("train_batch", logs, step)
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._log("train", logs, epoch)
+
+    def on_eval_end(self, logs=None):
+        self._log("eval", logs, self.epoch)

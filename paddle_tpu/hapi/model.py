@@ -1,0 +1,294 @@
+"""hapi Model — parity with python/paddle/hapi/model.py:915 (prepare:1499,
+fit, evaluate, predict, train_batch/eval_batch/predict_batch, save/load).
+
+The reference maintains dual static/dygraph engines; here there is one eager
+engine whose hot math is jit-compiled underneath by the op layer, and the
+distributed path goes through fleet/spmd (prepare_distributed_context ≈
+model.py:189 is subsumed by fleet.distributed_model)."""
+from __future__ import annotations
+
+import os
+import warnings
+
+import numpy as np
+
+from ..core.autograd import no_grad
+from ..core.tensor import Tensor
+from ..framework.io import load as _load, save as _save
+from ..io.dataloader import DataLoader
+from . import callbacks as callbacks_mod
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _to_tensor(x):
+    if isinstance(x, Tensor):
+        return x
+    import jax.numpy as jnp
+    return Tensor(jnp.asarray(np.asarray(x)), _internal=True)
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self.stop_training = False
+
+    # -- setup ---------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        """model.py:1499 parity."""
+        self._optimizer = optimizer
+        if loss is not None and not callable(loss):
+            raise TypeError("loss must be a callable (Layer or function)")
+        self._loss = loss
+        self._metrics = _to_list(metrics)
+        self._amp_configs = amp_configs
+        return self
+
+    # -- batch-level ---------------------------------------------------------
+    def train_batch(self, inputs, labels=None, update=True):
+        inputs = [_to_tensor(t) for t in _to_list(inputs)]
+        labels = [_to_tensor(t) for t in _to_list(labels)]
+        self.network.train()
+        outputs = self.network(*inputs)
+        outs = _to_list(outputs)
+        losses = self._loss(*(outs + labels)) if self._loss else outputs
+        loss_list = _to_list(losses)
+        total = loss_list[0]
+        for extra in loss_list[1:]:
+            total = total + extra
+        total.backward()
+        if update and self._optimizer is not None:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = []
+        for m in self._metrics:
+            m.update(*_to_list(m.compute(*(outs + labels))))
+            metrics.append(m.accumulate())
+        out_loss = [float(np.asarray(l.numpy()).ravel()[0])
+                    for l in loss_list]
+        return (out_loss, metrics) if metrics else out_loss
+
+    @no_grad()
+    def _eval_batch_impl(self, inputs, labels=None):
+        """Always returns (loss_list, metrics) so log packing can't confuse
+        metric values for losses."""
+        inputs = [_to_tensor(t) for t in _to_list(inputs)]
+        labels = [_to_tensor(t) for t in _to_list(labels)]
+        self.network.eval()
+        outputs = self.network(*inputs)
+        outs = _to_list(outputs)
+        loss_list = []
+        if self._loss:
+            losses = self._loss(*(outs + labels))
+            loss_list = [float(np.asarray(l.numpy()).ravel()[0])
+                         for l in _to_list(losses)]
+        metrics = []
+        for m in self._metrics:
+            m.update(*_to_list(m.compute(*(outs + labels))))
+            metrics.append(m.accumulate())
+        return loss_list, metrics
+
+    def eval_batch(self, inputs, labels=None):
+        loss_list, metrics = self._eval_batch_impl(inputs, labels)
+        if loss_list and metrics:
+            return loss_list, metrics
+        return loss_list if loss_list else metrics
+
+    @no_grad()
+    def predict_batch(self, inputs):
+        inputs = [_to_tensor(t) for t in _to_list(inputs)]
+        self.network.eval()
+        outputs = self.network(*inputs)
+        return [o.numpy() for o in _to_list(outputs)]
+
+    # -- loops ---------------------------------------------------------------
+    def _loader(self, data, batch_size, shuffle, num_workers,
+                drop_last=False):
+        if isinstance(data, DataLoader):
+            return data
+        if data is None:
+            return None
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                          num_workers=num_workers, drop_last=drop_last)
+
+    @staticmethod
+    def _split_batch(batch):
+        batch = list(batch) if isinstance(batch, (list, tuple)) else [batch]
+        if len(batch) == 1:
+            return batch, []
+        return batch[:-1], batch[-1:]
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        """model.py fit parity: epoch/step loops with the callback protocol."""
+        assert train_data is not None, "train_data must be given!"
+        loader = self._loader(train_data, batch_size, shuffle, num_workers,
+                              drop_last=drop_last)
+        eval_loader = self._loader(eval_data, batch_size, False, num_workers)
+        steps = len(loader) if hasattr(loader, "__len__") else None
+        cbks = callbacks_mod.config_callbacks(
+            callbacks, model=self, epochs=epochs, steps=steps,
+            log_freq=log_freq, verbose=verbose, save_freq=save_freq,
+            save_dir=save_dir, metrics=self._metrics)
+
+        self.stop_training = False
+        cbks.on_train_begin({})
+        for epoch in range(epochs):
+            if self.stop_training:
+                break
+            cbks.on_epoch_begin(epoch, {})
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            pending_update = False
+            for step, batch in enumerate(loader):
+                cbks.on_train_batch_begin(step, {})
+                ins, lbs = self._split_batch(batch)
+                update = (step + 1) % accumulate_grad_batches == 0
+                res = self.train_batch(ins, lbs, update=update)
+                pending_update = not update
+                logs = self._pack_logs(res)
+                cbks.on_train_batch_end(step, logs)
+                if num_iters is not None and step + 1 >= num_iters:
+                    break
+            if pending_update and self._optimizer is not None:
+                # flush a trailing partial accumulation group so grads never
+                # leak across epochs
+                self._optimizer.step()
+                self._optimizer.clear_grad()
+            cbks.on_epoch_end(epoch, logs)
+
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self._run_eval(eval_loader, cbks)
+                cbks.on_eval_end(eval_logs)
+        cbks.on_train_end({})
+
+    def _pack_logs(self, res):
+        logs = {}
+        if isinstance(res, tuple):
+            loss_list, metrics = res
+        else:
+            loss_list, metrics = res, []
+        if loss_list:
+            logs["loss"] = loss_list
+        for m, v in zip(self._metrics, metrics):
+            name = m.name()
+            if isinstance(name, (list, tuple)):
+                vals = v if isinstance(v, (list, tuple, np.ndarray)) else [v]
+                for n_, v_ in zip(name, vals):
+                    logs[n_] = v_
+            else:
+                logs[name] = v
+        return logs
+
+    def _run_eval(self, eval_loader, cbks):
+        for m in self._metrics:
+            m.reset()
+        steps = len(eval_loader) if hasattr(eval_loader, "__len__") else None
+        cbks.on_eval_begin({"steps": steps})
+        logs = {}
+        for step, batch in enumerate(eval_loader):
+            cbks.on_eval_batch_begin(step, {})
+            ins, lbs = self._split_batch(batch)
+            logs = self._pack_logs(self._eval_batch_impl(ins, lbs))
+            cbks.on_eval_batch_end(step, logs)
+        return logs
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_iters=None):
+        loader = self._loader(eval_data, batch_size, False, num_workers)
+        cbks = callbacks_mod.config_callbacks(
+            callbacks, model=self, log_freq=log_freq, verbose=verbose,
+            metrics=self._metrics, mode="eval")
+        for m in self._metrics:
+            m.reset()
+        steps = len(loader) if hasattr(loader, "__len__") else None
+        cbks.on_eval_begin({"steps": steps})
+        logs = {}
+        for step, batch in enumerate(loader):
+            ins, lbs = self._split_batch(batch)
+            logs = self._pack_logs(self._eval_batch_impl(ins, lbs))
+            cbks.on_eval_batch_end(step, logs)
+            if num_iters is not None and step + 1 >= num_iters:
+                break
+        cbks.on_eval_end(logs)
+        result = {}
+        if "loss" in logs:
+            result["loss"] = logs["loss"]
+        for m in self._metrics:
+            name = m.name()
+            result[name if not isinstance(name, list) else name[0]] = \
+                m.accumulate()
+        return result
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, verbose=1, callbacks=None):
+        loader = self._loader(test_data, batch_size, False, num_workers)
+        cbks = callbacks_mod.config_callbacks(
+            callbacks, model=self, verbose=verbose, mode="predict")
+        cbks.on_predict_begin({})
+        outputs = []
+        for step, batch in enumerate(loader):
+            ins, _ = self._split_batch(batch)
+            # a loss-prepared model treats the trailing field as the label;
+            # otherwise every field is an input (reference: predict uses
+            # declared inputs when given, else the whole sample)
+            use_ins = (self._labels is not None or self._loss is not None)
+            outs = self.predict_batch(ins if use_ins else list(batch))
+            outputs.append(outs)
+            cbks.on_predict_batch_end(step, {})
+        cbks.on_predict_end({})
+        # regroup: list over outputs, each a list over batches
+        n_out = len(outputs[0]) if outputs else 0
+        grouped = [[b[i] for b in outputs] for i in range(n_out)]
+        if stack_outputs:
+            grouped = [np.concatenate(g, axis=0) for g in grouped]
+        return grouped
+
+    # -- persistence ---------------------------------------------------------
+    def save(self, path, training=True):
+        """model.py save parity: `path.pdparams` (+ `.pdopt` when training)."""
+        dirname = os.path.dirname(path)
+        if dirname:
+            os.makedirs(dirname, exist_ok=True)
+        _save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            _save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        param_path = path if path.endswith(".pdparams") else path + ".pdparams"
+        state = _load(param_path)
+        if skip_mismatch:
+            own = self.network.state_dict()
+            filtered = {}
+            for k, v in state.items():
+                if k in own and tuple(own[k].shape) == tuple(v.shape):
+                    filtered[k] = v
+                else:
+                    warnings.warn(f"skip loading {k} (missing or mismatched)")
+            state = filtered
+        self.network.set_state_dict(state)
+        opt_path = path.replace(".pdparams", "") + ".pdopt"
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(opt_path):
+            self._optimizer.set_state_dict(_load(opt_path))
+
+    # -- misc ----------------------------------------------------------------
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        from .summary import summary as _summary
+        return _summary(self.network, input_size, dtype)

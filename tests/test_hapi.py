@@ -1,0 +1,181 @@
+"""hapi Model tests (reference: python/paddle/tests/test_model.py,
+test_callbacks.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.hapi import Model
+from paddle_tpu.hapi.callbacks import (Callback, EarlyStopping,
+                                       ModelCheckpoint, ReduceLROnPlateau,
+                                       VisualDL)
+from paddle_tpu.metric import Accuracy
+from paddle_tpu.vision.datasets import FakeData
+
+
+def _net(num_classes=4):
+    paddle.seed(0)
+    return nn.Sequential(nn.Flatten(), nn.Linear(3 * 8 * 8, 32), nn.ReLU(),
+                         nn.Linear(32, num_classes))
+
+
+def _data(n=32):
+    return FakeData(size=n, image_shape=(3, 8, 8), num_classes=4)
+
+
+class _SqueezeCE(nn.Layer):
+    """FakeData labels are [N,1]; CrossEntropyLoss wants [N]."""
+
+    def __init__(self):
+        super().__init__()
+        self.ce = nn.CrossEntropyLoss()
+
+    def forward(self, pred, label):
+        return self.ce(pred, label.squeeze(-1))
+
+
+def test_fit_evaluate_predict(tmp_path):
+    model = Model(_net())
+    opt = paddle.optimizer.Adam(parameters=model.parameters(),
+                                learning_rate=1e-3)
+    model.prepare(optimizer=opt, loss=_SqueezeCE(), metrics=Accuracy())
+    model.fit(_data(), epochs=2, batch_size=8, verbose=0)
+
+    res = model.evaluate(_data(16), batch_size=8, verbose=0)
+    assert "loss" in res and "acc" in res
+    assert 0.0 <= res["acc"] <= 1.0
+
+    outs = model.predict(_data(16), batch_size=8, stack_outputs=True)
+    assert outs[0].shape == (16, 4)
+
+
+def test_train_batch_and_save_load(tmp_path):
+    model = Model(_net())
+    opt = paddle.optimizer.SGD(parameters=model.parameters(),
+                               learning_rate=0.05)
+    model.prepare(optimizer=opt, loss=_SqueezeCE(), metrics=Accuracy())
+    x = np.random.RandomState(0).randn(8, 3, 8, 8).astype("float32")
+    y = np.random.RandomState(1).randint(0, 4, (8, 1)).astype("int64")
+    losses = []
+    for _ in range(10):
+        res = model.train_batch([x], [y])
+        losses.append(res[0][0] if isinstance(res, tuple) else res[0])
+    assert losses[-1] < losses[0]
+
+    path = str(tmp_path / "ckpt" / "model")
+    model.save(path)
+    assert os.path.exists(path + ".pdparams")
+    assert os.path.exists(path + ".pdopt")
+
+    model2 = Model(_net())
+    model2.prepare(optimizer=paddle.optimizer.SGD(
+        parameters=model2.parameters(), learning_rate=0.05),
+        loss=_SqueezeCE())
+    model2.load(path)
+    p1 = model.network.state_dict()
+    p2 = model2.network.state_dict()
+    for k in p1:
+        np.testing.assert_allclose(p1[k].numpy(), p2[k].numpy())
+
+
+def test_callbacks_checkpoint_and_custom(tmp_path):
+    events = []
+
+    class Recorder(Callback):
+        def on_train_begin(self, logs=None):
+            events.append("train_begin")
+
+        def on_epoch_begin(self, epoch, logs=None):
+            events.append(f"epoch_{epoch}")
+
+        def on_train_end(self, logs=None):
+            events.append("train_end")
+
+    model = Model(_net())
+    model.prepare(optimizer=paddle.optimizer.Adam(
+        parameters=model.parameters()), loss=_SqueezeCE())
+    model.fit(_data(16), epochs=2, batch_size=8, verbose=0,
+              save_dir=str(tmp_path), save_freq=1,
+              callbacks=[Recorder()])
+    assert events[0] == "train_begin" and events[-1] == "train_end"
+    assert "epoch_0" in events and "epoch_1" in events
+    assert os.path.exists(str(tmp_path / "final.pdparams"))
+    assert os.path.exists(str(tmp_path / "0.pdparams"))
+
+
+def test_early_stopping():
+    model = Model(_net())
+    model.prepare(optimizer=paddle.optimizer.Adam(
+        parameters=model.parameters()), loss=_SqueezeCE(),
+        metrics=Accuracy())
+    es = EarlyStopping(monitor="loss", patience=0, verbose=0)
+    # eval every epoch; patience 0 stops as soon as loss doesn't improve
+    model.fit(_data(16), eval_data=_data(16), epochs=8, batch_size=8,
+              verbose=0, callbacks=[es])
+    assert model.stop_training in (True, False)  # ran through the hook
+
+
+def test_reduce_lr_on_plateau():
+    model = Model(_net())
+    opt = paddle.optimizer.Adam(parameters=model.parameters(),
+                                learning_rate=0.1)
+    model.prepare(optimizer=opt, loss=_SqueezeCE())
+    cb = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=0, verbose=0)
+    cb.set_model(model)
+    cb.on_eval_end({"loss": [1.0]})
+    cb.on_eval_end({"loss": [2.0]})  # worse → reduce
+    assert opt.get_lr() == pytest.approx(0.05)
+
+
+def test_visualdl_logs_scalars(tmp_path):
+    model = Model(_net())
+    model.prepare(optimizer=paddle.optimizer.Adam(
+        parameters=model.parameters()), loss=_SqueezeCE())
+    model.fit(_data(16), epochs=1, batch_size=8, verbose=0,
+              callbacks=[VisualDL(str(tmp_path))])
+    assert os.path.exists(str(tmp_path / "scalars.jsonl"))
+
+
+def test_lr_scheduler_steps_during_fit():
+    import paddle_tpu.optimizer as opt
+    model = Model(_net())
+    sched = opt.lr.StepDecay(learning_rate=0.1, step_size=2, gamma=0.5)
+    optimizer = opt.Adam(learning_rate=sched, parameters=model.parameters())
+    model.prepare(optimizer=optimizer, loss=_SqueezeCE())
+    model.fit(_data(16), epochs=1, batch_size=8, verbose=0)
+    # 2 steps/epoch with step_size=2 → at least one decay
+    assert optimizer.get_lr() < 0.1
+
+
+def test_topk_accuracy_metric_in_fit():
+    model = Model(_net())
+    model.prepare(optimizer=paddle.optimizer.Adam(
+        parameters=model.parameters()), loss=_SqueezeCE(),
+        metrics=Accuracy(topk=(1, 2)))
+    model.fit(_data(16), epochs=1, batch_size=8, verbose=1)
+    res = model.evaluate(_data(16), batch_size=8, verbose=0)
+    assert "top1" in res or "acc_top1" in res or "acc" in res
+
+
+def test_metrics_without_loss_logs_correct_names():
+    model = Model(_net())
+    model.prepare(optimizer=paddle.optimizer.Adam(
+        parameters=model.parameters()), metrics=Accuracy())
+    # no loss prepared: eval logs must use the metric name, not "loss"
+    logs = model._pack_logs(model._eval_batch_impl(
+        [np.zeros((4, 3, 8, 8), "float32")],
+        [np.zeros((4, 1), "int64")]))
+    assert "acc" in logs and "loss" not in logs
+
+
+def test_summary():
+    net = _net()
+    res = paddle.summary(net, (1, 3, 8, 8))
+    assert res["total_params"] > 0
+    assert res["trainable_params"] == res["total_params"]
+
+    model = Model(net)
+    res2 = model.summary((1, 3, 8, 8))
+    assert res2["total_params"] == res["total_params"]
