@@ -41,6 +41,9 @@ from . import distributed  # noqa: E402,F401
 from . import incubate  # noqa: E402,F401
 from . import vision  # noqa: E402,F401
 from . import hapi  # noqa: E402,F401
+from . import jit  # noqa: E402,F401
+from . import static  # noqa: E402,F401
+from . import inference  # noqa: E402,F401
 from .hapi import Model  # noqa: E402,F401
 from . import callbacks  # noqa: E402,F401
 from .distributed.parallel import DataParallel  # noqa: E402,F401

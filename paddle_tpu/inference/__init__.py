@@ -1,0 +1,195 @@
+"""paddle.inference parity — Config + create_predictor
+(reference: AnalysisPredictor, inference/api/analysis_predictor.cc:891 Run,
+:1618 ZeroCopyRun, driven by AnalysisConfig in analysis_config.cc).
+
+TPU-native: the Analyzer's 200-pass IR pipeline and TensorRT/Lite subgraph
+capture are the compiler's job here — the predictor loads a jit.save'd
+StableHLO artifact and runs the XLA-compiled executable; zero-copy handles
+map onto device arrays.  GPU/TRT/MKLDNN toggles are accepted for source
+compatibility and recorded but have no TPU effect.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+__all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
+           "PlaceType"]
+
+
+class PrecisionType:
+    Float32 = "float32"
+    Half = "float16"
+    Bfloat16 = "bfloat16"
+    Int8 = "int8"
+
+
+class PlaceType:
+    CPU = "cpu"
+    GPU = "gpu"
+    XPU = "xpu"
+    CUSTOM = "custom"
+
+
+class Config:
+    """AnalysisConfig parity (api/analysis_config.cc)."""
+
+    def __init__(self, prog_file=None, params_file=None):
+        if prog_file is not None and params_file is None and \
+                os.path.isdir(prog_file):
+            # dir form: find the single .pdmodel inside
+            cands = [f for f in os.listdir(prog_file)
+                     if f.endswith(".pdmodel")]
+            if len(cands) == 1:
+                base = os.path.join(prog_file, cands[0][:-len(".pdmodel")])
+                prog_file = base + ".pdmodel"
+                params_file = base + ".pdiparams"
+        self._prog_file = prog_file
+        self._params_file = params_file
+        self._use_gpu = False
+        self._device_id = 0
+        self._precision = PrecisionType.Float32
+        self._enable_memory_optim = True
+        self._cpu_math_threads = 1
+        self._ir_optim = True
+
+    # -- model ----------------------------------------------------------------
+    def set_model(self, prog_file, params_file=None):
+        self._prog_file = prog_file
+        self._params_file = params_file
+
+    def prog_file(self):
+        return self._prog_file
+
+    def params_file(self):
+        return self._params_file
+
+    def model_dir(self):
+        return os.path.dirname(self._prog_file or "")
+
+    # -- device toggles (recorded; XLA owns placement on TPU) ----------------
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0,
+                       precision=PrecisionType.Float32):
+        self._use_gpu = True
+        self._device_id = device_id
+        self._precision = precision
+
+    def disable_gpu(self):
+        self._use_gpu = False
+
+    def use_gpu(self):
+        return self._use_gpu
+
+    def enable_xpu(self, *a, **kw):
+        pass
+
+    def enable_custom_device(self, device_type, device_id=0):
+        pass
+
+    def enable_tensorrt_engine(self, *a, **kw):
+        pass  # no TRT on TPU; XLA compiles the whole graph
+
+    def tensorrt_engine_enabled(self):
+        return False
+
+    def enable_mkldnn(self):
+        pass
+
+    def set_cpu_math_library_num_threads(self, n):
+        self._cpu_math_threads = n
+
+    def switch_ir_optim(self, flag=True):
+        self._ir_optim = flag
+
+    def enable_memory_optim(self, flag=True):
+        self._enable_memory_optim = flag
+
+    def switch_use_feed_fetch_ops(self, flag):
+        pass
+
+    def switch_specify_input_names(self, flag=True):
+        pass
+
+    def summary(self):
+        return (f"Config(model={self._prog_file}, "
+                f"precision={self._precision})")
+
+
+class _IOHandle:
+    """ZeroCopy tensor handle parity (copy_from_cpu/copy_to_cpu)."""
+
+    def __init__(self, name):
+        self.name = name
+        self._value = None
+
+    def copy_from_cpu(self, arr):
+        import jax.numpy as jnp
+        self._value = jnp.asarray(np.asarray(arr))
+
+    def reshape(self, shape):
+        pass
+
+    def copy_to_cpu(self):
+        return np.asarray(self._value)
+
+    def shape(self):
+        return list(self._value.shape) if self._value is not None else None
+
+
+class Predictor:
+    """AnalysisPredictor parity over a jit.save'd artifact."""
+
+    def __init__(self, config: Config):
+        from ..jit import load as jit_load
+
+        self._config = config
+        prog = config.prog_file()
+        if prog is None:
+            raise ValueError("Config has no model; call set_model(path)")
+        base = prog[:-len(".pdmodel")] if prog.endswith(".pdmodel") else prog
+        self._layer = jit_load(base, params_path=config.params_file())
+        n_in = len(self._layer._meta.get("input_spec", [])) or 1
+        self._inputs = [_IOHandle(f"x{i}") for i in range(n_in)]
+        self._outputs = []
+
+    def get_input_names(self):
+        return [h.name for h in self._inputs]
+
+    def get_input_handle(self, name):
+        for h in self._inputs:
+            if h.name == name:
+                return h
+        raise KeyError(name)
+
+    def get_output_names(self):
+        return [h.name for h in self._outputs] or ["out0"]
+
+    def get_output_handle(self, name):
+        for h in self._outputs:
+            if h.name == name:
+                return h
+        raise KeyError(name)
+
+    def run(self, inputs=None):
+        """ZeroCopyRun (handles) or Run(list-of-arrays) → list of numpy."""
+        if inputs is not None:
+            vals = [np.asarray(getattr(t, "numpy", lambda: t)())
+                    if not isinstance(t, np.ndarray) else t for t in inputs]
+            for h, v in zip(self._inputs, vals):
+                h.copy_from_cpu(v)
+        args = [h._value for h in self._inputs]
+        out = self._layer._exported.call(self._layer._values, *args)
+        outs = list(out) if isinstance(out, (tuple, list)) else [out]
+        self._outputs = []
+        for i, o in enumerate(outs):
+            h = _IOHandle(f"out{i}")
+            h._value = o
+            self._outputs.append(h)
+        if inputs is not None:
+            return [np.asarray(o) for o in outs]
+        return None
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

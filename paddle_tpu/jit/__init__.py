@@ -1,0 +1,306 @@
+"""paddle.jit parity — `to_static`, `save`, `load`, `not_to_static`
+(reference: python/paddle/fluid/dygraph/jit.py + the 30-file dy2static AST
+transpiler under dygraph_to_static/).
+
+TPU-native design: the reference transpiles Python ASTs into ProgramDesc ops
+because its static runtime needs a graph; here tracing IS compilation —
+`to_static` wraps the callable in a cached `jax.jit` trace over
+`functional_call`, and `save` exports the traced function to serialized
+StableHLO (jax.export) + a params archive: `.pdmodel` = StableHLO bytes (the
+ProgramDesc analog), `.pdiparams` = parameters.  `load` restores a
+TranslatedLayer that calls the compiled artifact (fluid/dygraph/io.py:1200)."""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer
+from ..static.input_spec import InputSpec
+
+__all__ = ["to_static", "save", "load", "not_to_static", "TranslatedLayer",
+           "StaticFunction"]
+
+def not_to_static(fn):
+    """Mark `fn` to run eagerly even under to_static (program_translator
+    parity)."""
+    fn._not_to_static = True
+    return fn
+
+
+def _as_value(x):
+    import jax.numpy as jnp
+    if isinstance(x, Tensor):
+        return x._value
+    return jnp.asarray(x)
+
+
+class StaticFunction:
+    """The to_static wrapper (program_translator.py StaticFunction parity):
+    per-input-signature jit cache; `.code` shows the traced jaxpr."""
+
+    def __init__(self, function, input_spec=None, build_strategy=None,
+                 layer=None):
+        self._function = function
+        self._input_spec = input_spec
+        self._layer = layer
+        self._cache = {}
+        self._last_jaxpr = None
+
+    def __get__(self, instance, owner):
+        """Class-level `@to_static def forward(self, x)`: bind the instance
+        and cache the bound StaticFunction on it so the jit cache survives
+        across calls."""
+        if instance is None:
+            return self
+        key = f"_staticfn_{id(self)}"
+        cached = instance.__dict__.get(key)
+        if cached is None:
+            bound = self._function.__get__(instance, owner)
+            cached = StaticFunction(bound, self._input_spec, layer=instance)
+            instance.__dict__[key] = cached
+        return cached
+
+    def _make_callable(self):
+        layer = self._layer
+        fn = self._function
+        if layer is not None:
+            from ..nn.functional_call import _swapped_state
+
+            def pure(values, *args):
+                args = tuple(Tensor(a, _internal=True) for a in args)
+                # call the ORIGINAL forward (not layer.__call__, which would
+                # re-enter this StaticFunction) with swapped param values
+                with _swapped_state(layer, values):
+                    out = fn(*args)
+                return _strip(out)
+        else:
+            def pure(values, *args):
+                args = tuple(Tensor(a, _internal=True) for a in args)
+                return _strip(fn(*args))
+        return pure
+
+    def __call__(self, *args, **kwargs):
+        import jax
+
+        from ..core import autograd
+
+        if getattr(self._function, "_not_to_static", False) or kwargs:
+            return self._function(*args, **kwargs)
+        if self._layer is not None and self._layer.training and \
+                autograd.is_grad_enabled():
+            # training stays on the eager tape (autograd + BN stat updates);
+            # the inference/jit path below serves eval/export — reference
+            # to_static runs both through ProgramDesc, here the compiled
+            # artifact is for serving and the eager ops already hit XLA
+            return self._function(*args, **kwargs)
+        vals = [_as_value(a) for a in args]
+        key = tuple((tuple(v.shape), str(v.dtype)) for v in vals)
+        if key not in self._cache:
+            pure = self._make_callable()
+            jitted = jax.jit(pure)
+            self._cache[key] = jitted
+        values = {k: v._value for k, v in self._layer.state_dict().items()} \
+            if self._layer is not None else {}
+        out = self._cache[key](values, *vals)
+        return _rewrap(out)
+
+    @property
+    def code(self):
+        """Pretty-printed jaxpr of the last/spec trace (dy2static shows the
+        transpiled Python; the jaxpr is this build's program text)."""
+        import jax
+        pure = self._make_callable()
+        specs = self._trace_specs()
+        values = {k: v._value for k, v in self._layer.state_dict().items()} \
+            if self._layer is not None else {}
+        jaxpr = jax.make_jaxpr(pure)(values, *specs)
+        return str(jaxpr)
+
+    def _trace_specs(self, fill=1):
+        import jax
+        if self._input_spec is None:
+            raise ValueError("input_spec required (none recorded from calls)")
+        return [s._to_sds(fill) if isinstance(s, InputSpec) else s
+                for s in self._input_spec]
+
+    def concrete_program(self):
+        return self
+
+
+def _strip(out):
+    if isinstance(out, (tuple, list)):
+        return type(out)(_strip(o) for o in out)
+    return out._value if isinstance(out, Tensor) else out
+
+
+def _rewrap(out):
+    if isinstance(out, (tuple, list)):
+        return type(out)(_rewrap(o) for o in out)
+    import jax
+    if isinstance(out, jax.Array):
+        return Tensor(out, _internal=True)
+    return out
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, **kwargs):
+    """@paddle.jit.to_static parity (program_translator.py:to_static)."""
+
+    def deco(fn):
+        if isinstance(fn, Layer):
+            sf = StaticFunction(fn.forward, input_spec, layer=fn)
+            fn.forward = sf
+            return fn
+        return StaticFunction(fn, input_spec)
+
+    if function is not None:
+        return deco(function)
+    return deco
+
+
+def _resolve_specs(layer, input_spec):
+    if input_spec is None:
+        fwd = getattr(layer, "forward", layer)
+        input_spec = getattr(fwd, "_input_spec", None)
+    if input_spec is None:
+        raise ValueError(
+            "paddle.jit.save needs input_spec (or a @to_static layer with "
+            "recorded specs)")
+    return input_spec
+
+
+def _export_specs(input_spec):
+    """InputSpecs → ShapeDtypeStructs; None/-1 dims become jax.export
+    symbolic dimensions (shared scope) so the saved artifact accepts any
+    size there — e.g. a dynamic batch dim."""
+    import itertools
+
+    import jax
+    from jax import export as jexport
+
+    counter = itertools.count()
+    scope = None
+    out = []
+    for s in input_spec:
+        shape = tuple(s.shape)
+        dtype = np.dtype(str(s.dtype))
+        if any(d is None or (isinstance(d, int) and d < 0) for d in shape):
+            names = [str(d) if d is not None and not (isinstance(d, int) and
+                                                      d < 0)
+                     else f"_dyn{next(counter)}" for d in shape]
+            sym = jexport.symbolic_shape(", ".join(names), scope=scope)
+            if scope is None:
+                scope = next(d for d in sym
+                             if not isinstance(d, int)).scope
+            out.append(jax.ShapeDtypeStruct(sym, dtype))
+        else:
+            out.append(jax.ShapeDtypeStruct(shape, dtype))
+    return out
+
+
+def save(layer, path, input_spec=None, **configs):
+    """paddle.jit.save parity: `path.pdmodel` = serialized StableHLO export,
+    `path.pdiparams` = params; loadable by paddle_tpu.jit.load and the
+    inference Predictor."""
+    import jax
+    from jax import export as jexport
+
+    dirname = os.path.dirname(path)
+    if dirname:
+        os.makedirs(dirname, exist_ok=True)
+
+    if isinstance(layer, Layer):
+        input_spec = _resolve_specs(layer, input_spec)
+        values = {k: v._value for k, v in layer.state_dict().items()}
+        fwd = layer.forward
+        if isinstance(fwd, StaticFunction):
+            fwd = fwd._function  # unwrap to_static to avoid re-entry
+
+        from ..nn.functional_call import _swapped_state
+
+        def pure(values, *args):
+            args = tuple(Tensor(a, _internal=True) for a in args)
+            with _swapped_state(layer, values):
+                out = fwd(*args)
+            return _strip(out)
+    else:
+        sf = layer if isinstance(layer, StaticFunction) else None
+        if sf is None:
+            raise TypeError("save expects a Layer or @to_static function")
+        input_spec = input_spec or sf._input_spec
+        values = {}
+
+        def pure(values, *args):
+            args = tuple(Tensor(a, _internal=True) for a in args)
+            return _strip(sf._function(*args))
+
+    specs = _export_specs(input_spec)
+    val_specs = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                 for k, v in values.items()}
+    was_training = isinstance(layer, Layer) and layer.training
+    if was_training:
+        layer.eval()  # export inference behavior (dropout off, BN stats)
+    try:
+        exported = jexport.export(jax.jit(pure))(val_specs, *specs)
+    finally:
+        if was_training:
+            layer.train()
+
+    with open(path + ".pdmodel", "wb") as f:
+        f.write(exported.serialize())
+    with open(path + ".pdiparams", "wb") as f:
+        pickle.dump({k: np.asarray(v) for k, v in values.items()}, f,
+                    protocol=4)
+    meta = {"input_spec": [
+        (tuple(d if isinstance(d, int) and d >= 0 else None
+               for d in s.shape), str(np.dtype(str(s.dtype))))
+        for s in input_spec]}
+    with open(path + ".pdiparams.info", "wb") as f:
+        pickle.dump(meta, f, protocol=4)
+
+
+class TranslatedLayer(Layer):
+    """fluid/dygraph/io.py:1200 parity: a Layer running a saved program."""
+
+    def __init__(self, exported, params, meta):
+        super().__init__()
+        self._exported = exported
+        self._params_np = params
+        self._meta = meta
+        import jax.numpy as jnp
+        self._values = {k: jnp.asarray(v) for k, v in params.items()}
+
+    def forward(self, *args):
+        vals = [_as_value(a) for a in args]
+        out = self._exported.call(self._values, *vals)
+        return _rewrap(out)
+
+    def program(self):
+        return self._exported.mlir_module()
+
+    def state_dict(self, *a, **kw):
+        return {k: Tensor(v, _internal=True) for k, v in self._values.items()}
+
+
+def load(path, params_path=None, **configs):
+    """paddle.jit.load parity.  `params_path` overrides the default
+    `<path>.pdiparams` (the inference Config two-file form)."""
+    from jax import export as jexport
+
+    with open(path + ".pdmodel", "rb") as f:
+        exported = jexport.deserialize(f.read())
+    params = {}
+    ppath = params_path or (path + ".pdiparams")
+    if os.path.exists(ppath):
+        with open(ppath, "rb") as f:
+            params = pickle.load(f)
+    meta = {}
+    info = (params_path + ".info") if params_path else \
+        (path + ".pdiparams.info")
+    if os.path.exists(info):
+        with open(info, "rb") as f:
+            meta = pickle.load(f)
+    return TranslatedLayer(exported, params, meta)

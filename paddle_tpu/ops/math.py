@@ -500,6 +500,22 @@ Tensor.__mod__ = _binop(remainder)
 Tensor.__rmod__ = _rbinop(remainder)
 Tensor.__pow__ = _binop(pow)
 Tensor.__rpow__ = _rbinop(pow)
+def _matmul_op(self, other):
+    from .linalg import matmul as _mm
+    if isinstance(other, (list, tuple, np.ndarray)):
+        other = Tensor(np.asarray(other))
+    return _mm(self, other)
+
+
+def _rmatmul_op(self, other):
+    from .linalg import matmul as _mm
+    if isinstance(other, (list, tuple, np.ndarray)):
+        other = Tensor(np.asarray(other))
+    return _mm(other, self)
+
+
+Tensor.__matmul__ = _matmul_op
+Tensor.__rmatmul__ = _rmatmul_op
 Tensor.__neg__ = lambda self: neg(self)
 Tensor.__abs__ = lambda self: abs(self)
 Tensor.__pos__ = lambda self: self

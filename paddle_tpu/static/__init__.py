@@ -1,0 +1,251 @@
+"""paddle.static parity — Program/Executor/CompiledProgram facades.
+
+The reference's static graph is a ProgramDesc interpreted by InterpreterCore
+(SURVEY §3.3).  Here a Program wraps a traced, AOT-compilable function (its
+"desc" is the jaxpr / StableHLO text); `Executor.run` feeds/fetches through
+the compiled artifact — XLA plays the role of the 202-pass pipeline and the
+multi-stream interpreter.  The legacy append-op program builder is
+intentionally NOT reproduced (SURVEY §7: fluid legacy dual-op system is
+dropped); programs are built by tracing callables (`build_program` /
+`Program.from_callable` / @to_static).
+"""
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from .input_spec import InputSpec
+
+__all__ = ["InputSpec", "Program", "Executor", "CompiledProgram",
+           "build_program", "default_main_program", "default_startup_program",
+           "program_guard", "data", "save_inference_model",
+           "load_inference_model"]
+
+
+class Program:
+    """A traced program: callable + input specs + fetch names."""
+
+    def __init__(self, fn: Callable | None = None,
+                 input_specs: Sequence[InputSpec] | None = None,
+                 layer=None):
+        self._fn = fn
+        self._layer = layer
+        self._input_specs = list(input_specs or [])
+        self._feed_names = [s.name or f"x{i}"
+                            for i, s in enumerate(self._input_specs)]
+        self._compiled = None
+        self.random_seed = None
+
+    @classmethod
+    def from_callable(cls, fn, input_specs):
+        return cls(fn=fn, input_specs=input_specs)
+
+    def desc(self) -> str:
+        """Program text (jaxpr) — the ProgramDesc analog."""
+        import jax
+        if self._fn is None:
+            return "<empty program>"
+        sds = [s._to_sds() for s in self._input_specs]
+        return str(jax.make_jaxpr(self._fn)(*sds))
+
+    def _compile(self):
+        import jax
+        if self._compiled is None:
+            if self._fn is None:
+                raise ValueError("empty Program has nothing to run")
+            self._compiled = jax.jit(self._fn)
+        return self._compiled
+
+    def clone(self, for_test=False):
+        p = Program(self._fn, self._input_specs, self._layer)
+        return p
+
+    def global_block(self):
+        return self
+
+    # parity no-ops
+    def all_parameters(self):
+        return list(self._layer.parameters()) if self._layer else []
+
+
+_default_main = Program()
+_default_startup = Program()
+
+
+def default_main_program() -> Program:
+    return _default_main
+
+
+def default_startup_program() -> Program:
+    return _default_startup
+
+
+class _ProgramGuard:
+    def __init__(self, main, startup):
+        self.main = main
+        self.startup = startup
+
+    def __enter__(self):
+        global _default_main, _default_startup
+        self._saved = (_default_main, _default_startup)
+        _default_main = self.main
+        if self.startup is not None:
+            _default_startup = self.startup
+        return self
+
+    def __exit__(self, *exc):
+        global _default_main, _default_startup
+        _default_main, _default_startup = self._saved
+        return False
+
+
+def program_guard(main_program, startup_program=None):
+    return _ProgramGuard(main_program, startup_program)
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """paddle.static.data parity: returns the InputSpec placeholder and
+    registers it on the current default program."""
+    spec = InputSpec(shape, dtype, name)
+    _default_main._input_specs.append(spec)
+    _default_main._feed_names.append(name)
+    return spec
+
+
+def build_program(fn, input_specs) -> Program:
+    """Trace `fn(*tensors)` into a Program (the dy2static entry for users
+    who had static build_program workflows)."""
+    from ..jit import _strip
+
+    def raw(*vals):
+        args = tuple(Tensor(v, _internal=True) for v in vals)
+        return _strip(fn(*args))
+
+    return Program.from_callable(raw, input_specs)
+
+
+class CompiledProgram:
+    """compiler.py CompiledProgram parity: AOT-compile with explicit lowering
+    so repeat Executor.run calls hit the cache."""
+
+    def __init__(self, program, build_strategy=None):
+        self._program = program if isinstance(program, Program) else \
+            Program(program)
+        self._lowered = None
+
+    def _compile(self, *vals):
+        import jax
+        if self._lowered is None:
+            self._lowered = jax.jit(self._program._fn).lower(*vals).compile()
+        return self._lowered
+
+
+class Executor:
+    """executor.py:815 parity: run(program, feed, fetch_list).
+
+    The reference walks ops through InterpreterCore; here run() executes the
+    program's compiled function.  fetch_list entries may be output indices or
+    names ('out0'...); feed keys follow the program's input specs.
+    """
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None,
+            return_numpy=True):
+        import jax.numpy as jnp
+        program = program or _default_main
+        inner = program._program if isinstance(program, CompiledProgram) \
+            else program
+        feed = feed or {}
+        vals = []
+        for i, name in enumerate(inner._feed_names):
+            if name in feed:
+                vals.append(jnp.asarray(np.asarray(feed[name])))
+            else:
+                raise KeyError(f"feed is missing input {name!r}")
+        if isinstance(program, CompiledProgram):
+            out = program._compile(*vals)(*vals)
+        else:
+            out = inner._compile()(*vals)
+        outs = list(out) if isinstance(out, (tuple, list)) else [out]
+        if fetch_list is not None:
+            import re
+            picked = []
+            for f in fetch_list:
+                if isinstance(f, int):
+                    picked.append(outs[f])
+                    continue
+                m = re.fullmatch(r"out(\d+)", f) if isinstance(f, str) \
+                    else None
+                if m:
+                    picked.append(outs[int(m.group(1))])
+                elif isinstance(f, str) and len(outs) == 1:
+                    # single-output program: any name fetches it
+                    picked.append(outs[0])
+                else:
+                    raise KeyError(
+                        f"unknown fetch target {f!r}; use an output index "
+                        f"or 'out<i>' (program has {len(outs)} outputs)")
+            outs = picked
+        if return_numpy:
+            outs = [np.asarray(o) for o in outs]
+        return outs
+
+    def close(self):
+        pass
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
+                         program=None, **kwargs):
+    """static.save_inference_model parity: delegates to jit.save over the
+    program's callable."""
+    from ..jit import save as jit_save
+
+    program = program or _default_main
+    if program._layer is not None:
+        jit_save(program._layer, path_prefix,
+                 input_spec=program._input_specs)
+    else:
+        from ..jit import StaticFunction
+        sf = StaticFunction(lambda *a: _rewrap_out(program, a),
+                            input_spec=program._input_specs)
+        jit_save(sf, path_prefix, input_spec=program._input_specs)
+
+
+def _rewrap_out(program, args):
+    from ..jit import _rewrap
+
+    vals = [a._value if isinstance(a, Tensor) else a for a in args]
+    return _rewrap(program._compile()(*vals))
+
+
+def load_inference_model(path_prefix, executor, **kwargs):
+    """Returns (program, feed_names, fetch_names) like the reference."""
+    from ..jit import load as jit_load
+
+    tl = jit_load(path_prefix)
+    specs = [InputSpec(s[0], s[1]) for s in tl._meta.get("input_spec", [])]
+
+    def fn(*vals):
+        out = tl._exported.call(tl._values, *vals)
+        return out
+
+    prog = Program.from_callable(fn, specs)
+    prog._translated = tl
+    return prog, prog._feed_names, ["out0"]
+
+
+# nn facade for static users (conv/fc built on the dygraph layers)
+class _StaticNN:
+    @staticmethod
+    def fc(x, size, **kw):
+        raise NotImplementedError(
+            "static.nn append-op builders are not reproduced; build models "
+            "with paddle_tpu.nn layers and trace via build_program/to_static "
+            "(SURVEY §7: legacy fluid op system intentionally dropped)")
+
+
+nn = _StaticNN()

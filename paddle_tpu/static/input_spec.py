@@ -1,0 +1,48 @@
+"""InputSpec — parity with paddle.static.InputSpec (python/paddle/static/
+input_spec.py): symbolic shape/dtype/name descriptor used by @to_static and
+jit.save.  Maps onto jax.ShapeDtypeStruct; None dims become polymorphic or
+are concretized at trace time."""
+from __future__ import annotations
+
+import numpy as np
+
+
+class InputSpec:
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=False):
+        self.shape = tuple(shape)
+        self.dtype = np.dtype(dtype).name if dtype is not None else None
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tuple(tensor.shape), str(tensor.dtype), name)
+
+    @classmethod
+    def from_numpy(cls, ndarray, name=None):
+        return cls(ndarray.shape, ndarray.dtype, name)
+
+    def batch(self, batch_size):
+        return InputSpec((batch_size,) + self.shape, self.dtype, self.name)
+
+    def unbatch(self):
+        if not self.shape:
+            raise ValueError("cannot unbatch a 0-d spec")
+        return InputSpec(self.shape[1:], self.dtype, self.name)
+
+    def _to_sds(self, fill=1):
+        """jax.ShapeDtypeStruct with None dims concretized to `fill`."""
+        import jax
+        shape = tuple(fill if d is None or d < 0 else d for d in self.shape)
+        return jax.ShapeDtypeStruct(shape, np.dtype(self.dtype))
+
+    def __repr__(self):
+        return (f"InputSpec(shape={self.shape}, dtype={self.dtype}, "
+                f"name={self.name})")
+
+    def __eq__(self, other):
+        return (isinstance(other, InputSpec) and self.shape == other.shape
+                and self.dtype == other.dtype and self.name == other.name)
+
+    def __hash__(self):
+        return hash((self.shape, self.dtype, self.name))

@@ -1,0 +1,199 @@
+"""jit.to_static/save/load + static Program/Executor + inference Predictor
+tests (reference: dygraph_to_static tests, test_jit_save_load.py,
+inference api tests)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.static import InputSpec
+
+
+def _net():
+    paddle.seed(0)
+    return nn.Sequential(nn.Linear(8, 16), nn.GELU(), nn.Linear(16, 4))
+
+
+def test_to_static_function():
+    @paddle.jit.to_static
+    def f(x):
+        return x * 2 + 1
+
+    x = paddle.to_tensor(np.arange(4, dtype="float32"))
+    out = f(x)
+    np.testing.assert_allclose(out.numpy(), np.arange(4) * 2 + 1)
+
+
+def test_to_static_layer_matches_eager():
+    net = _net()
+    x_np = np.random.RandomState(0).randn(2, 8).astype("float32")
+    net.eval()
+    eager = net(paddle.to_tensor(x_np)).numpy()
+    snet = paddle.jit.to_static(net)
+    static_out = snet(paddle.to_tensor(x_np)).numpy()
+    np.testing.assert_allclose(static_out, eager, rtol=1e-6)
+
+
+def test_to_static_code():
+    from paddle_tpu.jit import StaticFunction
+
+    def f(x):
+        return x + 1
+
+    sf = StaticFunction(f, input_spec=[InputSpec([4], "float32")])
+    assert "add" in sf.code
+
+
+def test_to_static_method_decorator():
+    """@to_static on a class-defined forward binds self and keeps one jit
+    cache per instance (regression: descriptor dropped the instance)."""
+    class M(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+
+        @paddle.jit.to_static
+        def forward(self, x):
+            return self.fc(x) * 2
+
+    paddle.seed(0)
+    m = M()
+    m.eval()
+    x = paddle.to_tensor(np.ones((2, 4), "float32"))
+    out1 = m(x)
+    assert tuple(out1.shape) == (2, 4)
+    # second access reuses the same bound StaticFunction (stable cache)
+    assert m.forward is m.forward
+    out2 = m(x)
+    np.testing.assert_allclose(out1.numpy(), out2.numpy())
+
+
+def test_save_dynamic_batch_dim(tmp_path):
+    """InputSpec None dims export symbolically: the artifact serves any
+    batch size (regression: None was concretized to 1)."""
+    net = _net()
+    net.eval()
+    path = str(tmp_path / "dyn" / "net")
+    paddle.jit.save(net, path, input_spec=[InputSpec([None, 8], "float32")])
+    loaded = paddle.jit.load(path)
+    for bs in (1, 3, 7):
+        x = np.random.RandomState(bs).randn(bs, 8).astype("float32")
+        out = loaded(paddle.to_tensor(x))
+        assert tuple(out.shape) == (bs, 4)
+
+
+def test_matmul_operator_with_list():
+    t = paddle.to_tensor(np.ones((2, 2), "float32"))
+    out = t @ [[1.0, 2.0], [3.0, 4.0]]
+    np.testing.assert_allclose(out.numpy(), [[4.0, 6.0], [4.0, 6.0]])
+    out2 = [[1.0, 0.0], [0.0, 1.0]] @ t
+    np.testing.assert_allclose(out2.numpy(), np.ones((2, 2)))
+
+
+def test_executor_unknown_fetch_errors():
+    import paddle_tpu.static as static
+
+    def fn(x):
+        return x + 1, x + 2
+
+    prog = static.build_program(fn, [static.InputSpec([2], "float32")])
+    exe = static.Executor()
+    with pytest.raises(KeyError):
+        exe.run(prog, feed={"x0": np.zeros(2, "float32")},
+                fetch_list=["loss"])
+
+
+def test_jit_save_load_roundtrip(tmp_path):
+    net = _net()
+    net.eval()
+    x_np = np.random.RandomState(1).randn(3, 8).astype("float32")
+    expected = net(paddle.to_tensor(x_np)).numpy()
+
+    path = str(tmp_path / "model" / "net")
+    paddle.jit.save(net, path, input_spec=[InputSpec([3, 8], "float32")])
+    assert os.path.exists(path + ".pdmodel")
+    assert os.path.exists(path + ".pdiparams")
+
+    loaded = paddle.jit.load(path)
+    out = loaded(paddle.to_tensor(x_np)).numpy()
+    np.testing.assert_allclose(out, expected, rtol=1e-5, atol=1e-6)
+    # the saved program text is StableHLO (ProgramDesc analog)
+    assert "module" in loaded.program()
+
+
+def test_static_program_executor():
+    import paddle_tpu.static as static
+
+    def fn(x, y):
+        return x @ y + 1.0
+
+    prog = static.build_program(fn, [static.InputSpec([2, 3]),
+                                     static.InputSpec([3, 2])])
+    assert "dot" in prog.desc() or "matmul" in prog.desc()
+
+    exe = static.Executor()
+    x = np.ones((2, 3), "float32")
+    y = np.full((3, 2), 2.0, "float32")
+    (out,) = exe.run(prog, feed={"x0": x, "x1": y}, fetch_list=[0])
+    np.testing.assert_allclose(out, np.full((2, 2), 7.0))
+
+    # missing feed errors with the input name
+    with pytest.raises(KeyError):
+        exe.run(prog, feed={"x0": x}, fetch_list=[0])
+
+
+def test_program_guard_and_data():
+    import paddle_tpu.static as static
+
+    main = static.Program()
+    with static.program_guard(main):
+        spec = static.data("img", [4, 8], "float32")
+        assert static.default_main_program() is main
+    assert spec.name == "img"
+    assert static.default_main_program() is not main
+
+
+def test_inference_predictor(tmp_path):
+    from paddle_tpu import inference
+
+    net = _net()
+    net.eval()
+    x_np = np.random.RandomState(2).randn(2, 8).astype("float32")
+    expected = net(paddle.to_tensor(x_np)).numpy()
+    path = str(tmp_path / "serve" / "model")
+    paddle.jit.save(net, path, input_spec=[InputSpec([2, 8], "float32")])
+
+    config = inference.Config(path + ".pdmodel", path + ".pdiparams")
+    config.enable_memory_optim()
+    predictor = inference.create_predictor(config)
+
+    names = predictor.get_input_names()
+    h = predictor.get_input_handle(names[0])
+    h.copy_from_cpu(x_np)
+    predictor.run()
+    out_h = predictor.get_output_handle(predictor.get_output_names()[0])
+    np.testing.assert_allclose(out_h.copy_to_cpu(), expected, rtol=1e-5,
+                               atol=1e-6)
+
+    # Run(list) form
+    outs = predictor.run([x_np])
+    np.testing.assert_allclose(outs[0], expected, rtol=1e-5, atol=1e-6)
+
+
+def test_save_inference_model_roundtrip(tmp_path):
+    import paddle_tpu.static as static
+
+    def fn(x):
+        return x * 3.0
+
+    prog = static.build_program(fn, [static.InputSpec([4], "float32",
+                                                      name="inp")])
+    exe = static.Executor()
+    path = str(tmp_path / "sim" / "m")
+    static.save_inference_model(path, ["inp"], ["out"], exe, program=prog)
+    prog2, feeds, fetches = static.load_inference_model(path, exe)
+    (out,) = exe.run(prog2, feed={feeds[0]: np.ones(4, "float32")},
+                     fetch_list=[0])
+    np.testing.assert_allclose(out, np.full(4, 3.0))
