@@ -1,0 +1,317 @@
+// TCPStore — native KV rendezvous store.
+//
+// Parity target: paddle/fluid/distributed/store/tcp_store.h:120 (the C++
+// TCPStore behind python/paddle/distributed/parallel.py:248) and its socket
+// layer tcp_utils.cc.  Re-implemented for the TPU build: a single poll()-loop
+// server thread with a mutex-guarded map, plus a blocking client.  Exposed as
+// a C ABI for ctypes (no pybind11 in this image).
+//
+// Protocol (little-endian):
+//   request : u8 op | u32 klen | key | [u32 vlen | val] | [i64 delta]
+//   ops     : 1=SET 2=GET 3=ADD 4=DEL 5=NUMKEYS
+//   reply   : GET -> u32 vlen (0xFFFFFFFF = missing) | val
+//             SET/DEL -> u8 1;  ADD -> i64 new value; NUMKEYS -> i64 count
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr uint8_t kSet = 1, kGet = 2, kAdd = 3, kDel = 4, kNumKeys = 5;
+constexpr uint32_t kMissing = 0xFFFFFFFFu;
+
+bool read_exact(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_exact(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+struct Server {
+  int listen_fd = -1;
+  int port = 0;
+  std::thread loop;
+  std::atomic<bool> stop{false};
+  std::mutex mu;
+  std::map<std::string, std::string> kv;
+
+  ~Server() { shutdown(); }
+
+  void shutdown() {
+    bool expected = false;
+    if (!stop.compare_exchange_strong(expected, true)) return;
+    if (listen_fd >= 0) ::shutdown(listen_fd, SHUT_RDWR);
+    if (loop.joinable()) loop.join();
+    if (listen_fd >= 0) ::close(listen_fd);
+    listen_fd = -1;
+  }
+
+  bool handle(int fd) {
+    uint8_t op;
+    if (!read_exact(fd, &op, 1)) return false;
+    uint32_t klen;
+    if (!read_exact(fd, &klen, 4) || klen > (1u << 20)) return false;
+    std::string key(klen, '\0');
+    if (!read_exact(fd, key.data(), klen)) return false;
+
+    switch (op) {
+      case kSet: {
+        uint32_t vlen;
+        if (!read_exact(fd, &vlen, 4) || vlen > (1u << 28)) return false;
+        std::string val(vlen, '\0');
+        if (!read_exact(fd, val.data(), vlen)) return false;
+        {
+          std::lock_guard<std::mutex> g(mu);
+          kv[key] = std::move(val);
+        }
+        uint8_t ok = 1;
+        return write_exact(fd, &ok, 1);
+      }
+      case kGet: {
+        std::string val;
+        bool found;
+        {
+          std::lock_guard<std::mutex> g(mu);
+          auto it = kv.find(key);
+          found = it != kv.end();
+          if (found) val = it->second;
+        }
+        uint32_t vlen = found ? static_cast<uint32_t>(val.size()) : kMissing;
+        if (!write_exact(fd, &vlen, 4)) return false;
+        if (found && !val.empty() &&
+            !write_exact(fd, val.data(), val.size()))
+          return false;
+        return true;
+      }
+      case kAdd: {
+        int64_t delta;
+        if (!read_exact(fd, &delta, 8)) return false;
+        int64_t cur = 0;
+        {
+          std::lock_guard<std::mutex> g(mu);
+          auto it = kv.find(key);
+          if (it != kv.end() && it->second.size() == 8)
+            std::memcpy(&cur, it->second.data(), 8);
+          cur += delta;
+          std::string val(8, '\0');
+          std::memcpy(val.data(), &cur, 8);
+          kv[key] = std::move(val);
+        }
+        return write_exact(fd, &cur, 8);
+      }
+      case kDel: {
+        {
+          std::lock_guard<std::mutex> g(mu);
+          kv.erase(key);
+        }
+        uint8_t ok = 1;
+        return write_exact(fd, &ok, 1);
+      }
+      case kNumKeys: {
+        int64_t n;
+        {
+          std::lock_guard<std::mutex> g(mu);
+          n = static_cast<int64_t>(kv.size());
+        }
+        return write_exact(fd, &n, 8);
+      }
+      default:
+        return false;
+    }
+  }
+
+  void run() {
+    std::vector<struct pollfd> fds;
+    fds.push_back({listen_fd, POLLIN, 0});
+    while (!stop.load()) {
+      int rc = ::poll(fds.data(), fds.size(), 200 /*ms*/);
+      if (rc < 0) {
+        if (errno == EINTR) continue;
+        break;
+      }
+      if (rc == 0) continue;
+      // accept new connections
+      if (fds[0].revents & POLLIN) {
+        int cfd = ::accept(listen_fd, nullptr, nullptr);
+        if (cfd >= 0) {
+          int one = 1;
+          ::setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+          fds.push_back({cfd, POLLIN, 0});
+        }
+      }
+      for (size_t i = fds.size(); i-- > 1;) {
+        if (fds[i].revents & (POLLIN | POLLHUP | POLLERR)) {
+          if (!(fds[i].revents & POLLIN) || !handle(fds[i].fd)) {
+            ::close(fds[i].fd);
+            fds.erase(fds.begin() + static_cast<long>(i));
+          }
+        }
+      }
+    }
+    for (size_t i = 1; i < fds.size(); ++i) ::close(fds[i].fd);
+  }
+};
+
+struct Client {
+  int fd = -1;
+  std::mutex mu;  // one request/response at a time per client
+
+  ~Client() {
+    if (fd >= 0) ::close(fd);
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// returns opaque handle or null; port 0 picks a free port (query with
+// tcpstore_server_port)
+void* tcpstore_server_start(const char* host, int port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  addr.sin_addr.s_addr = host && *host ? ::inet_addr(host) : INADDR_ANY;
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 ||
+      ::listen(fd, 128) < 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  socklen_t len = sizeof(addr);
+  ::getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &len);
+  auto* s = new Server();
+  s->listen_fd = fd;
+  s->port = ntohs(addr.sin_port);
+  s->loop = std::thread([s] { s->run(); });
+  return s;
+}
+
+int tcpstore_server_port(void* h) { return static_cast<Server*>(h)->port; }
+
+void tcpstore_server_stop(void* h) {
+  auto* s = static_cast<Server*>(h);
+  s->shutdown();
+  delete s;
+}
+
+void* tcpstore_client_connect(const char* host, int port, int timeout_ms) {
+  addrinfo hints{}, *res = nullptr;
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  char portstr[16];
+  std::snprintf(portstr, sizeof(portstr), "%d", port);
+  if (::getaddrinfo(host, portstr, &hints, &res) != 0 || !res) return nullptr;
+  int fd = -1;
+  // retry until the server is up or the deadline passes (rendezvous races)
+  for (int waited = 0; waited <= timeout_ms; waited += 100) {
+    fd = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+    if (fd >= 0 && ::connect(fd, res->ai_addr, res->ai_addrlen) == 0) break;
+    if (fd >= 0) ::close(fd);
+    fd = -1;
+    ::usleep(100 * 1000);
+  }
+  ::freeaddrinfo(res);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  auto* c = new Client();
+  c->fd = fd;
+  return c;
+}
+
+void tcpstore_client_free(void* h) { delete static_cast<Client*>(h); }
+
+static bool send_key(int fd, uint8_t op, const char* key, uint32_t klen) {
+  return write_exact(fd, &op, 1) && write_exact(fd, &klen, 4) &&
+         write_exact(fd, key, klen);
+}
+
+int tcpstore_set(void* h, const char* key, const char* val, int vlen) {
+  auto* c = static_cast<Client*>(h);
+  std::lock_guard<std::mutex> g(c->mu);
+  uint32_t v = static_cast<uint32_t>(vlen);
+  if (!send_key(c->fd, kSet, key, std::strlen(key))) return -1;
+  if (!write_exact(c->fd, &v, 4)) return -1;
+  if (vlen > 0 && !write_exact(c->fd, val, v)) return -1;
+  uint8_t ok;
+  return read_exact(c->fd, &ok, 1) && ok == 1 ? 0 : -1;
+}
+
+// returns length, -1 = missing, -2 = error; caller buffer must hold cap bytes
+int tcpstore_get(void* h, const char* key, char* buf, int cap) {
+  auto* c = static_cast<Client*>(h);
+  std::lock_guard<std::mutex> g(c->mu);
+  if (!send_key(c->fd, kGet, key, std::strlen(key))) return -2;
+  uint32_t vlen;
+  if (!read_exact(c->fd, &vlen, 4)) return -2;
+  if (vlen == kMissing) return -1;
+  if (vlen > static_cast<uint32_t>(cap)) {
+    // drain to keep the stream aligned, then report under-capacity
+    std::vector<char> tmp(vlen);
+    read_exact(c->fd, tmp.data(), vlen);
+    return -3;
+  }
+  if (vlen > 0 && !read_exact(c->fd, buf, vlen)) return -2;
+  return static_cast<int>(vlen);
+}
+
+long long tcpstore_add(void* h, const char* key, long long delta) {
+  auto* c = static_cast<Client*>(h);
+  std::lock_guard<std::mutex> g(c->mu);
+  int64_t d = delta, out = 0;
+  if (!send_key(c->fd, kAdd, key, std::strlen(key))) return -1;
+  if (!write_exact(c->fd, &d, 8)) return -1;
+  if (!read_exact(c->fd, &out, 8)) return -1;
+  return out;
+}
+
+int tcpstore_delete(void* h, const char* key) {
+  auto* c = static_cast<Client*>(h);
+  std::lock_guard<std::mutex> g(c->mu);
+  if (!send_key(c->fd, kDel, key, std::strlen(key))) return -1;
+  uint8_t ok;
+  return read_exact(c->fd, &ok, 1) && ok == 1 ? 0 : -1;
+}
+
+long long tcpstore_num_keys(void* h) {
+  auto* c = static_cast<Client*>(h);
+  std::lock_guard<std::mutex> g(c->mu);
+  if (!send_key(c->fd, kNumKeys, "", 0)) return -1;
+  int64_t out = 0;
+  if (!read_exact(c->fd, &out, 8)) return -1;
+  return out;
+}
+
+}  // extern "C"

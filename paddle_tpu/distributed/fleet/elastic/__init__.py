@@ -1,0 +1,1 @@
+from .manager import ElasticLevel, ElasticManager, ElasticStatus  # noqa: F401

@@ -1,0 +1,182 @@
+"""ElasticManager — parity with python/paddle/distributed/fleet/elastic/
+manager.py:131 (etcd node registry with leased heartbeats :253-288, np range
+'min:max' parse :361, fault levels ElasticLevel:48, scale-out :469 /
+scale-in :490, watch loop :570-613).
+
+The etcd client is injected (tests use a mock, exactly like the reference's
+MockEtcdClient harness, unittests/test_fleet_elastic_manager.py:76-101); any
+object with put/get/delete/lease/add_watch_prefix_callback works.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+
+class ElasticLevel:
+    """manager.py ElasticLevel:48."""
+    GOD = 0        # no fault tolerance
+    FAULT_TOLERANCE = 1  # restart on failure, fixed np
+    ELASTIC = 2    # scale in/out within [min_np, max_np]
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+def _parse_np(np_str):
+    """'4' -> (4, 4); '2:8' -> (2, 8)  (manager.py:361)."""
+    s = str(np_str)
+    if ":" in s:
+        lo, hi = s.split(":")
+        lo, hi = int(lo), int(hi)
+    else:
+        lo = hi = int(s)
+    if lo < 1 or hi < lo:
+        raise ValueError(f"invalid np range {np_str!r}")
+    return lo, hi
+
+
+class ElasticManager:
+    def __init__(self, args=None, etcd_client=None, np=None, host=None,
+                 job_id=None, scale=0, force=False):
+        args = args or type("A", (), {})()
+        self.job_id = job_id or getattr(args, "job_id", None) or \
+            os.getenv("PADDLE_ELASTIC_JOB_ID", "default")
+        np_arg = np or getattr(args, "np", None) or \
+            os.getenv("PADDLE_ELASTIC_NP", "1")
+        self.np_min, self.np_max = _parse_np(np_arg)
+        self.np = self.np_min
+        self.host = host or getattr(args, "host", None) or \
+            os.getenv("POD_IP", "127.0.0.1")
+        self.scale = scale
+        self.force = force
+        self.elastic_level = int(getattr(
+            args, "elastic_level",
+            os.getenv("PADDLE_ELASTIC_FAULT_TOLERANC_LEVEL",
+                      ElasticLevel.FAULT_TOLERANCE)))
+
+        self.etcd = etcd_client
+        self.prefix = f"/paddle/{self.job_id}"
+        self.node_prefix = f"{self.prefix}/nodes"
+        self.np_path = f"{self.prefix}/np"
+        self.endpoints_path = f"{self.prefix}/endpoints"
+        self.hosts: list[str] = []
+        self.stopped = False
+        self._leases = []
+        self.enable = etcd_client is not None and \
+            self.elastic_level != ElasticLevel.GOD
+
+        if self.enable:
+            self._register()
+
+    # -- registry ------------------------------------------------------------
+    def _my_key(self):
+        return f"{self.node_prefix}/{self.host}"
+
+    def _register(self):
+        """Leased registration + heartbeat keepalive (manager.py:253-288)."""
+        lease = self.etcd.lease(10)
+        self._leases.append(lease)
+        self.etcd.put(self._my_key(), self.host.encode(), lease=lease)
+
+        def keepalive():
+            while not self.stopped:
+                try:
+                    lease.refresh()
+                except Exception:
+                    try:
+                        nl = self.etcd.lease(10)
+                        self.etcd.put(self._my_key(), self.host.encode(),
+                                      lease=nl)
+                        self._leases.append(nl)
+                    except Exception:
+                        pass
+                time.sleep(3)
+
+        self._ka = threading.Thread(target=keepalive, daemon=True)
+        self._ka.start()
+
+    def cur_hosts(self) -> list[str]:
+        vals = self.etcd.get_prefix(self.node_prefix)
+        hosts = []
+        for v, _meta in vals:
+            hosts.append(v.decode() if isinstance(v, bytes) else str(v))
+        return sorted(hosts)
+
+    # -- decisions -----------------------------------------------------------
+    def exit(self, completed=True):
+        self.stopped = True
+        if self.enable:
+            try:
+                self.etcd.delete(self._my_key())
+            except Exception:
+                pass
+
+    def _match(self, hosts=None) -> bool:
+        """Membership matches the expected world (manager.py watch logic)."""
+        hosts = hosts if hosts is not None else \
+            (self.cur_hosts() if self.enable else [self.host])
+        n = len(hosts)
+        if self.elastic_level == ElasticLevel.FAULT_TOLERANCE:
+            return n == self.np
+        if self.elastic_level == ElasticLevel.ELASTIC:
+            return self.np_min <= n <= self.np_max
+        return True
+
+    def should_scale_out(self, hosts=None) -> bool:
+        hosts = hosts if hosts is not None else self.cur_hosts()
+        return min(len(hosts), self.np_max) > self.np
+
+    def should_scale_in(self, hosts=None) -> bool:
+        hosts = hosts if hosts is not None else self.cur_hosts()
+        return len(hosts) < self.np
+
+    def _scale_out(self, hosts):
+        """manager.py:469: adopt the larger membership (clamped to np_max);
+        ranks reassigned by sorted host order."""
+        hosts = sorted(hosts)[:self.np_max]
+        self.np = len(hosts)
+        self.hosts = hosts
+        return self.hosts
+
+    def _scale_in(self, hosts):
+        """manager.py:490: shrink to the survivors (never below np_min)."""
+        if len(hosts) < self.np_min:
+            raise RuntimeError(
+                f"cluster shrank to {len(hosts)} < min np {self.np_min}")
+        self.np = len(hosts)
+        self.hosts = sorted(hosts)
+        return self.hosts
+
+    def adjust(self, hosts=None):
+        """One watch-loop step: returns (status, hosts)."""
+        hosts = hosts if hosts is not None else \
+            (self.cur_hosts() if self.enable else [self.host])
+        if self.elastic_level == ElasticLevel.ELASTIC:
+            if self.should_scale_out(hosts):
+                return ElasticStatus.RESTART, self._scale_out(hosts)
+            if self.should_scale_in(hosts):
+                if len(hosts) < self.np_min:
+                    return ElasticStatus.HOLD, sorted(hosts)
+                return ElasticStatus.RESTART, self._scale_in(hosts)
+        elif self.elastic_level == ElasticLevel.FAULT_TOLERANCE:
+            if len(hosts) != self.np:
+                return ElasticStatus.HOLD, sorted(hosts)
+        return ElasticStatus.COMPLETED, sorted(hosts)
+
+    def wait(self, timeout=600):
+        """Block until membership matches (manager.py watch :570-613)."""
+        deadline = time.time() + timeout
+        while not self.stopped:
+            if self._match():
+                return True
+            if time.time() > deadline:
+                return False
+            time.sleep(2)
+        return False

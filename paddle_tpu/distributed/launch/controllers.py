@@ -1,0 +1,133 @@
+"""Launch controllers — parity with launch/controllers/collective.py
+(CollectiveController.build_pod:36, env export at :72-75) and master.py
+(KV-store rendezvous :25,181-187).
+
+The env contract exported per rank (the config bus between launcher and
+runtime, SURVEY §5.6):
+  PADDLE_MASTER, PADDLE_GLOBAL_SIZE, PADDLE_LOCAL_SIZE, PADDLE_GLOBAL_RANK,
+  PADDLE_LOCAL_RANK, PADDLE_NNODES, PADDLE_TRAINER_ENDPOINTS,
+  PADDLE_CURRENT_ENDPOINT, PADDLE_TRAINER_ID, PADDLE_TRAINERS_NUM
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+from .context import Context, Node
+from .job import Container, Pod
+
+
+class Master:
+    """Rendezvous over the native TCPStore (reference: HTTP KV / etcd).
+    Each node announces its endpoint list; everyone reads the full set.
+    Node ranks are store-assigned when --rank is not given (the reference
+    launcher's auto-negotiation)."""
+
+    def __init__(self, endpoint, is_master, nnodes, job_id="default"):
+        from ..store import TCPStore
+
+        host, port = endpoint.split(":")
+        self.nnodes = nnodes
+        self.job_id = job_id
+        self.store = TCPStore(host, int(port), is_master=is_master,
+                              world_size=nnodes, timeout=300)
+
+    def assign_rank(self) -> int:
+        return int(self.store.add(f"/{self.job_id}/noderank", 1)) - 1
+
+    def sync_peers(self, rank: int, my_endpoints: list[str],
+                   attempt: int = 0) -> list[str]:
+        # attempt-scoped keys so a fault-tolerant restart never reads the
+        # previous incarnation's endpoints
+        prefix = f"/{self.job_id}/try{attempt}/ep"
+        self.store.set(f"{prefix}/{rank}", ",".join(my_endpoints))
+        self.store.wait([f"{prefix}/{r}" for r in range(self.nnodes)])
+        eps = []
+        for r in range(self.nnodes):
+            eps.extend(self.store.get(f"{prefix}/{r}").decode().split(","))
+        return eps
+
+
+class CollectiveController:
+    """collective.py:24 parity."""
+
+    def __init__(self, ctx: Context):
+        self.ctx = ctx
+        self.pod = Pod()
+        self._master = None
+        self._attempt = 0
+
+    def build_pod(self) -> Pod:
+        ctx = self.ctx
+        nproc = ctx.nproc_per_node()
+        nnodes, _ = ctx.nnodes_range()
+        node_rank = ctx.args.rank
+
+        ports = [Node.get_free_port() for _ in range(nproc)]
+        my_eps = [f"{ctx.node.ip}:{p}" for p in ports]
+
+        if nnodes > 1:
+            if not ctx.args.master:
+                raise ValueError("--master ip:port required when nnodes > 1")
+            if self._master is None:
+                master_host = ctx.args.master.split(":")[0]
+                # the node whose IP owns the master endpoint binds the store;
+                # with an explicit --rank, rank 0 binds (reference behavior)
+                is_master = node_rank == 0 if node_rank >= 0 else \
+                    master_host in ("127.0.0.1", "localhost", ctx.node.ip)
+                self._master = Master(ctx.args.master, is_master, nnodes,
+                                      ctx.args.job_id)
+            if node_rank < 0:
+                node_rank = self._master.assign_rank()
+            all_eps = self._master.sync_peers(node_rank, my_eps,
+                                              self._attempt)
+        else:
+            node_rank = max(node_rank, 0)
+            all_eps = my_eps
+
+        world = len(all_eps)
+        base = node_rank * nproc
+        script = ctx.args.training_script
+        entry_prefix = [sys.executable] if script.endswith(".py") else []
+        for i in range(nproc):
+            rank = base + i
+            env = {
+                "PADDLE_MASTER": ctx.args.master or "",
+                "PADDLE_GLOBAL_SIZE": world,
+                "PADDLE_LOCAL_SIZE": nproc,
+                "PADDLE_GLOBAL_RANK": rank,
+                "PADDLE_LOCAL_RANK": i,
+                "PADDLE_NNODES": nnodes,
+                "PADDLE_TRAINER_ENDPOINTS": ",".join(all_eps),
+                "PADDLE_CURRENT_ENDPOINT": all_eps[rank],
+                "PADDLE_TRAINER_ID": rank,
+                "PADDLE_TRAINERS_NUM": world,
+                "PADDLE_RANK_IN_NODE": i,
+                "FLAGS_selected_devices": str(i),
+            }
+            out = os.path.join(ctx.args.log_dir,
+                               f"workerlog.{rank}")
+            self.pod.containers.append(Container(
+                entry_prefix + [script] + list(ctx.args.training_script_args),
+                env, out))
+        return self.pod
+
+    def run(self) -> int:
+        max_restart = max(0, self.ctx.args.max_restart)
+        attempt = 0
+        while True:
+            self.build_pod() if not self.pod.containers else None
+            self.pod.deploy()
+            code = self.pod.join()
+            if code == 0:
+                return 0
+            attempt += 1
+            if attempt > max_restart or self.ctx.args.elastic_level < 0:
+                sys.stderr.write(self.pod.logs()[-4000:] + "\n")
+                return code
+            # fault-tolerant restart (reference watcher --max_restart); the
+            # master store stays up, rendezvous keys are attempt-scoped
+            self._attempt = attempt
+            time.sleep(1)
+            self.pod = Pod()

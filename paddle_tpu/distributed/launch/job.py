@@ -1,0 +1,103 @@
+"""Pod/Container process model — parity with launch/job/
+(container.py subprocess deploy with per-rank env + log files)."""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+
+
+class Container:
+    def __init__(self, entrypoint, env, out_path, err_path=None):
+        self.entrypoint = entrypoint
+        self.env = env
+        self.out_path = out_path
+        self.err_path = err_path or out_path
+        self.proc = None
+        self._out_f = None
+        self._err_f = None
+        self.restarts = 0
+
+    def start(self):
+        os.makedirs(os.path.dirname(self.out_path) or ".", exist_ok=True)
+        self._out_f = open(self.out_path, "ab")
+        self._err_f = self._out_f if self.err_path == self.out_path \
+            else open(self.err_path, "ab")
+        full_env = dict(os.environ)
+        full_env.update({k: str(v) for k, v in self.env.items()})
+        # make the (possibly uninstalled) framework importable in workers
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))))
+        pp = full_env.get("PYTHONPATH", "")
+        if pkg_root not in pp.split(os.pathsep):
+            full_env["PYTHONPATH"] = (pkg_root + os.pathsep + pp) if pp \
+                else pkg_root
+        self.proc = subprocess.Popen(self.entrypoint, env=full_env,
+                                     stdout=self._out_f, stderr=self._err_f)
+
+    def poll(self):
+        return self.proc.poll() if self.proc else None
+
+    def alive(self):
+        return self.proc is not None and self.proc.poll() is None
+
+    def terminate(self, timeout=10):
+        if self.proc and self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait()
+        for f in {self._out_f, self._err_f} - {None}:
+            try:
+                f.close()
+            except Exception:
+                pass
+        self._out_f = self._err_f = None
+
+    def exit_code(self):
+        return self.proc.returncode if self.proc else None
+
+    def tail(self, n=2000):
+        try:
+            with open(self.out_path, "rb") as f:
+                f.seek(0, 2)
+                size = f.tell()
+                f.seek(max(0, size - n))
+                return f.read().decode(errors="replace")
+        except OSError:
+            return ""
+
+
+class Pod:
+    def __init__(self):
+        self.containers: list[Container] = []
+
+    def deploy(self):
+        for c in self.containers:
+            c.start()
+
+    def alive(self):
+        return any(c.alive() for c in self.containers)
+
+    def join(self, poll_interval=0.5):
+        """Wait for all containers; returns the first nonzero exit code or 0.
+        A failed container triggers pod teardown (reference watcher
+        semantics: one rank dying kills the pod)."""
+        while True:
+            codes = [c.poll() for c in self.containers]
+            if any(c is not None and c != 0 for c in codes):
+                self.stop()
+                return next(c for c in codes if c is not None and c != 0)
+            if all(c == 0 for c in codes):
+                return 0
+            time.sleep(poll_interval)
+
+    def stop(self, timeout=10):
+        for c in self.containers:
+            c.terminate(timeout)
+
+    def logs(self):
+        return "\n".join(c.tail() for c in self.containers)

@@ -1,0 +1,22 @@
+"""`python -m paddle_tpu.distributed.launch` — parity with
+python/paddle/distributed/launch/main.py:18."""
+from __future__ import annotations
+
+import sys
+
+from .context import Context
+from .controllers import CollectiveController
+
+
+def launch(argv=None):
+    ctx = Context(argv)
+    if ctx.args.run_mode not in ("collective", "ps"):
+        raise ValueError(f"unknown run_mode {ctx.args.run_mode!r}")
+    controller = CollectiveController(ctx)
+    code = controller.run()
+    if code != 0:
+        sys.exit(code)
+
+
+if __name__ == "__main__":
+    launch()

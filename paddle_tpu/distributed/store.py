@@ -1,0 +1,224 @@
+"""TCPStore — the rendezvous KV store behind init_parallel_env
+(reference: paddle/fluid/distributed/store/tcp_store.h:120, bound as
+core.TCPStore and used at python/paddle/distributed/parallel.py:248).
+
+The store itself is native C++ (csrc/tcp_store.cpp), compiled on first use
+with the system toolchain and loaded through ctypes (no pybind11 in this
+image).  A pure-Python socket fallback keeps the API alive if no compiler is
+available.  API parity: TCPStore(host, port, is_master, world_size, timeout)
+with set/get/add/wait/barrier semantics.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+_LIB = None
+_LIB_ERR = None
+
+
+def _build_lib():
+    """Compile csrc/tcp_store.cpp into a cached shared object."""
+    src = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "csrc", "tcp_store.cpp")
+    cache_dir = os.environ.get(
+        "PADDLE_TPU_BUILD_DIR",
+        os.path.join(tempfile.gettempdir(),
+                     f"paddle_tpu_build_{os.getuid()}"))
+    os.makedirs(cache_dir, exist_ok=True)
+    so = os.path.join(cache_dir, "libtcp_store.so")
+    if os.path.exists(so) and os.path.getmtime(so) >= os.path.getmtime(src):
+        return so
+    cxx = os.environ.get("CXX", "g++")
+    # per-pid temp + atomic replace: concurrent ranks may all compile on a
+    # cold cache; each produces a valid .so and the replace is atomic
+    tmp = f"{so}.{os.getpid()}.tmp"
+    cmd = [cxx, "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           src, "-o", tmp]
+    subprocess.run(cmd, check=True, capture_output=True)
+    os.replace(tmp, so)
+    return so
+
+
+def _lib():
+    global _LIB, _LIB_ERR
+    if _LIB is not None or _LIB_ERR is not None:
+        return _LIB
+    try:
+        lib = ctypes.CDLL(_build_lib())
+        lib.tcpstore_server_start.restype = ctypes.c_void_p
+        lib.tcpstore_server_start.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.tcpstore_server_port.restype = ctypes.c_int
+        lib.tcpstore_server_port.argtypes = [ctypes.c_void_p]
+        lib.tcpstore_server_stop.argtypes = [ctypes.c_void_p]
+        lib.tcpstore_client_connect.restype = ctypes.c_void_p
+        lib.tcpstore_client_connect.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                                ctypes.c_int]
+        lib.tcpstore_client_free.argtypes = [ctypes.c_void_p]
+        lib.tcpstore_set.restype = ctypes.c_int
+        lib.tcpstore_set.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_char_p, ctypes.c_int]
+        lib.tcpstore_get.restype = ctypes.c_int
+        lib.tcpstore_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_char_p, ctypes.c_int]
+        lib.tcpstore_add.restype = ctypes.c_longlong
+        lib.tcpstore_add.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_longlong]
+        lib.tcpstore_delete.restype = ctypes.c_int
+        lib.tcpstore_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.tcpstore_num_keys.restype = ctypes.c_longlong
+        lib.tcpstore_num_keys.argtypes = [ctypes.c_void_p]
+        _LIB = lib
+    except Exception as e:  # pragma: no cover - toolchain always present here
+        _LIB_ERR = e
+        _LIB = None
+    return _LIB
+
+
+class _PyStoreServer:
+    """Pure-Python fallback server (same wire protocol is unnecessary here;
+    it simply serves in-process)."""
+
+    def __init__(self):
+        self.kv = {}
+        self.mu = threading.Lock()
+
+
+class TCPStore:
+    """TCPStore(host, port, is_master, world_size, timeout) parity."""
+
+    def __init__(self, host="127.0.0.1", port=0, is_master=False,
+                 world_size=1, timeout=300):
+        self._timeout = timeout
+        self._server_h = None
+        self._client_h = None
+        self._py = None
+        lib = _lib()
+        if lib is not None:
+            if is_master:
+                self._server_h = lib.tcpstore_server_start(
+                    host.encode() if host != "0.0.0.0" else b"", int(port))
+                if not self._server_h:
+                    raise RuntimeError(
+                        f"TCPStore master failed to bind {host}:{port}")
+                port = lib.tcpstore_server_port(self._server_h)
+            self.port = int(port)
+            self.host = host
+            self._client_h = lib.tcpstore_client_connect(
+                host.encode(), int(port), int(timeout * 1000))
+            if not self._client_h:
+                if self._server_h:
+                    lib.tcpstore_server_stop(self._server_h)
+                raise RuntimeError(
+                    f"TCPStore could not connect to {host}:{port} within "
+                    f"{timeout}s")
+        else:  # pure-python in-process fallback
+            if not is_master:
+                raise RuntimeError(
+                    "no C++ toolchain for the TCP store client and no "
+                    f"in-process master (compile error: {_LIB_ERR})")
+            self._py = _PyStoreServer()
+            self.port = int(port) or 6170
+            self.host = host
+
+    # -- API -----------------------------------------------------------------
+    def set(self, key: str, value) -> None:
+        data = value if isinstance(value, bytes) else str(value).encode()
+        if self._py is not None:
+            with self._py.mu:
+                self._py.kv[key] = data
+            return
+        rc = _lib().tcpstore_set(self._client_h, key.encode(), data,
+                                 len(data))
+        if rc != 0:
+            raise RuntimeError(f"TCPStore.set({key!r}) failed")
+
+    def _get_once(self, key: str):
+        if self._py is not None:
+            with self._py.mu:
+                return self._py.kv.get(key)
+        cap = 1 << 16
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            rc = _lib().tcpstore_get(self._client_h, key.encode(), buf, cap)
+            if rc == -3:
+                cap *= 16
+                continue
+            if rc == -2:
+                raise RuntimeError(f"TCPStore.get({key!r}) I/O error")
+            if rc == -1:
+                return None
+            return buf.raw[:rc]
+
+    def get(self, key: str) -> bytes:
+        """Blocking get (the reference's get waits for the key)."""
+        self.wait([key])
+        return self._get_once(key)
+
+    def add(self, key: str, amount: int = 1) -> int:
+        if self._py is not None:
+            with self._py.mu:
+                cur = int.from_bytes(self._py.kv.get(key, b"\0" * 8),
+                                     "little", signed=True) + amount
+                self._py.kv[key] = cur.to_bytes(8, "little", signed=True)
+                return cur
+        out = _lib().tcpstore_add(self._client_h, key.encode(), amount)
+        return int(out)
+
+    def delete_key(self, key: str) -> None:
+        if self._py is not None:
+            with self._py.mu:
+                self._py.kv.pop(key, None)
+            return
+        _lib().tcpstore_delete(self._client_h, key.encode())
+
+    def num_keys(self) -> int:
+        if self._py is not None:
+            with self._py.mu:
+                return len(self._py.kv)
+        return int(_lib().tcpstore_num_keys(self._client_h))
+
+    def wait(self, keys, timeout=None) -> None:
+        deadline = time.time() + (timeout if timeout is not None
+                                  else self._timeout)
+        keys = [keys] if isinstance(keys, str) else list(keys)
+        interval = 0.005
+        while True:
+            missing = [k for k in keys if self._get_once(k) is None]
+            if not missing:
+                return
+            if time.time() > deadline:
+                raise TimeoutError(f"TCPStore.wait timed out on {missing}")
+            time.sleep(interval)
+            interval = min(interval * 2, 0.25)
+
+    def barrier(self, name: str, world_size: int, timeout=None) -> None:
+        """All `world_size` participants call barrier(name) to proceed."""
+        n = self.add(f"__barrier/{name}", 1)
+        deadline = time.time() + (timeout if timeout is not None
+                                  else self._timeout)
+        while n < world_size:
+            time.sleep(0.01)
+            cur = self._get_once(f"__barrier/{name}")
+            n = int.from_bytes(cur, "little", signed=True) if cur else 0
+            if time.time() > deadline:
+                raise TimeoutError(f"barrier {name}: {n}/{world_size}")
+
+    def __del__(self):
+        lib = _LIB
+        if lib is None:
+            return
+        try:
+            if self._client_h:
+                lib.tcpstore_client_free(self._client_h)
+                self._client_h = None
+            if self._server_h:
+                lib.tcpstore_server_stop(self._server_h)
+                self._server_h = None
+        except Exception:
+            pass

@@ -169,6 +169,13 @@ class Predictor:
         for h in self._outputs:
             if h.name == name:
                 return h
+        # pre-run fetch of an advertised name: create the handle now; run()
+        # fills it in place
+        import re
+        if re.fullmatch(r"out\d+", name):
+            h = _IOHandle(name)
+            self._outputs.append(h)
+            return h
         raise KeyError(name)
 
     def run(self, inputs=None):
@@ -181,9 +188,11 @@ class Predictor:
         args = [h._value for h in self._inputs]
         out = self._layer._exported.call(self._layer._values, *args)
         outs = list(out) if isinstance(out, (tuple, list)) else [out]
+        # fill pre-fetched handles in place; create any that are missing
+        by_name = {h.name: h for h in self._outputs}
         self._outputs = []
         for i, o in enumerate(outs):
-            h = _IOHandle(f"out{i}")
+            h = by_name.get(f"out{i}") or _IOHandle(f"out{i}")
             h._value = o
             self._outputs.append(h)
         if inputs is not None:

@@ -85,37 +85,40 @@ class StaticFunction:
     def __call__(self, *args, **kwargs):
         import jax
 
-        from ..core import autograd
-
         if getattr(self._function, "_not_to_static", False) or kwargs:
             return self._function(*args, **kwargs)
-        if self._layer is not None and self._layer.training and \
-                autograd.is_grad_enabled():
-            # training stays on the eager tape (autograd + BN stat updates);
-            # the inference/jit path below serves eval/export — reference
-            # to_static runs both through ProgramDesc, here the compiled
-            # artifact is for serving and the eager ops already hit XLA
+        if self._layer is not None and self._layer.training:
+            # training stays on the eager tape so buffer mutation (BN stats)
+            # and per-op rng match eager semantics; eager ops hit XLA anyway
             return self._function(*args, **kwargs)
         vals = [_as_value(a) for a in args]
         key = tuple((tuple(v.shape), str(v.dtype)) for v in vals)
         if key not in self._cache:
-            pure = self._make_callable()
-            jitted = jax.jit(pure)
-            self._cache[key] = jitted
-        values = {k: v._value for k, v in self._layer.state_dict().items()} \
-            if self._layer is not None else {}
-        out = self._cache[key](values, *vals)
-        return _rewrap(out)
+            self._cache[key] = jax.jit(self._make_callable())
+        jitted = self._cache[key]
+        entries = dict(self._layer.state_dict()) if self._layer is not None \
+            else {}
+
+        # run through apply_op so the eager tape sees the compiled call:
+        # grads flow to inputs AND to the layer's parameters (the dict's
+        # Tensor leaves), with jax.vjp differentiating through the jit
+        from ..core.op import apply_op
+
+        def raw(values, *vv):
+            return jitted(values, *vv)
+
+        return apply_op(raw, "to_static", (entries, *args), {})
 
     @property
     def code(self):
         """Pretty-printed jaxpr of the last/spec trace (dy2static shows the
         transpiled Python; the jaxpr is this build's program text)."""
         import jax
+
+        from ..nn.functional_call import state_values
         pure = self._make_callable()
         specs = self._trace_specs()
-        values = {k: v._value for k, v in self._layer.state_dict().items()} \
-            if self._layer is not None else {}
+        values = state_values(self._layer) if self._layer is not None else {}
         jaxpr = jax.make_jaxpr(pure)(values, *specs)
         return str(jaxpr)
 
@@ -213,8 +216,10 @@ def save(layer, path, input_spec=None, **configs):
         os.makedirs(dirname, exist_ok=True)
 
     if isinstance(layer, Layer):
+        from ..nn.functional_call import state_values
+
         input_spec = _resolve_specs(layer, input_spec)
-        values = {k: v._value for k, v in layer.state_dict().items()}
+        values = state_values(layer)
         fwd = layer.forward
         if isinstance(fwd, StaticFunction):
             fwd = fwd._function  # unwrap to_static to avoid re-entry

@@ -133,13 +133,15 @@ class CompiledProgram:
     def __init__(self, program, build_strategy=None):
         self._program = program if isinstance(program, Program) else \
             Program(program)
-        self._lowered = None
+        self._lowered = {}
 
     def _compile(self, *vals):
         import jax
-        if self._lowered is None:
-            self._lowered = jax.jit(self._program._fn).lower(*vals).compile()
-        return self._lowered
+        key = tuple((tuple(v.shape), str(v.dtype)) for v in vals)
+        if key not in self._lowered:
+            self._lowered[key] = \
+                jax.jit(self._program._fn).lower(*vals).compile()
+        return self._lowered[key]
 
 
 class Executor:
