@@ -28,6 +28,25 @@ from .tensor import Tensor
 
 OP_REGISTRY: dict[str, Callable] = {}
 
+# FLAGS_check_nan_inf (paddle_tpu.flags): per-op output scan, parity with
+# framework/details/nan_inf_utils_detail.cc:341 CheckVarHasNanOrInf
+CHECK_NAN_INF = False
+
+
+def _scan_nan_inf(name, out):
+    import jax.numpy as jnp
+
+    vals = out if isinstance(out, (tuple, list)) else (out,)
+    for i, v in enumerate(vals):
+        if hasattr(v, "dtype") and jnp.issubdtype(v.dtype, jnp.floating):
+            bad = ~jnp.isfinite(v)
+            if bool(bad.any()):
+                raise RuntimeError(
+                    f"Operator {name} output {i} contains "
+                    f"{int(jnp.isnan(v).sum())} NaN and "
+                    f"{int(jnp.isinf(v).sum())} Inf values "
+                    f"(FLAGS_check_nan_inf is set)")
+
 
 def _is_tensor(x):
     return isinstance(x, Tensor)
@@ -86,7 +105,10 @@ def apply_op(fn, name, args, kwargs):
         vals = raw if amp_cast is None else \
             [amp_cast(v) if i in tensor_pos else v for i, v in enumerate(raw)]
         a, k = jtu.tree_unflatten(treedef, vals)
-        return _wrap_outputs(fn(*a, **k), None)
+        out = fn(*a, **k)
+        if CHECK_NAN_INF:
+            _scan_nan_inf(name, out)
+        return _wrap_outputs(out, None)
 
     def closure(*dvals):
         vals = list(raw)
@@ -108,6 +130,8 @@ def apply_op(fn, name, args, kwargs):
     avals = [(v.shape, v.dtype) for v in outs_flat]
     node = autograd.GradNode(
         vjp_fn, [leaves[p] for p in diff_pos], len(outs_flat), avals, name=name)
+    if CHECK_NAN_INF:
+        _scan_nan_inf(name, out)
     return _wrap_outputs(out, node)
 
 

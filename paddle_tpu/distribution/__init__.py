@@ -1,0 +1,34 @@
+"""paddle.distribution parity (python/paddle/distribution/): probability
+distributions over framework Tensors, backed by jax math + the framework rng
+(core.random) so sampling composes with paddle.seed."""
+from .distributions import (  # noqa: F401
+    Bernoulli,
+    Beta,
+    Categorical,
+    Dirichlet,
+    Distribution,
+    Exponential,
+    Gamma,
+    Geometric,
+    Gumbel,
+    Laplace,
+    LogNormal,
+    Multinomial,
+    Normal,
+    Poisson,
+    Uniform,
+    kl_divergence,
+    register_kl,
+)
+from .transform import (  # noqa: F401
+    AbsTransform,
+    AffineTransform,
+    ChainTransform,
+    ExpTransform,
+    PowerTransform,
+    SigmoidTransform,
+    SoftmaxTransform,
+    TanhTransform,
+    Transform,
+    TransformedDistribution,
+)

@@ -1,0 +1,607 @@
+"""Distribution classes — parity with python/paddle/distribution/
+(normal.py, uniform.py, categorical.py, beta.py, dirichlet.py,
+multinomial.py, bernoulli.py, ...; kl.py kl_divergence/register_kl).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import jax.scipy.special as jsp
+
+from ..core import random as random_mod
+from ..core.tensor import Tensor
+
+
+def _t(x):
+    if isinstance(x, Tensor):
+        return x._value
+    return jnp.asarray(x, jnp.float32) if not isinstance(x, jnp.ndarray) \
+        else x
+
+
+def _wrap(v):
+    return Tensor(v, _internal=True)
+
+
+def _shape(sample_shape):
+    if sample_shape is None:
+        return ()
+    return tuple(int(s) for s in sample_shape)
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return _wrap(jnp.exp(self.log_prob(value)._value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return _wrap(jnp.broadcast_to(jnp.square(self.scale),
+                                      self.batch_shape))
+
+    @property
+    def stddev(self):
+        return _wrap(jnp.broadcast_to(self.scale, self.batch_shape))
+
+    def sample(self, shape=(), seed=0):
+        key = random_mod.next_key()
+        shp = _shape(shape) + self.batch_shape
+        eps = jax.random.normal(key, shp, dtype=jnp.float32)
+        return _wrap(self.loc + eps * self.scale)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _t(value)
+        var = jnp.square(self.scale)
+        return _wrap(-jnp.square(v - self.loc) / (2 * var) -
+                     jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        return _wrap(jnp.broadcast_to(
+            0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale),
+            self.batch_shape))
+
+
+class LogNormal(Normal):
+    def sample(self, shape=(), seed=0):
+        return _wrap(jnp.exp(super().sample(shape)._value))
+
+    rsample = sample
+
+    @property
+    def mean(self):
+        return _wrap(jnp.exp(self.loc + jnp.square(self.scale) / 2))
+
+    @property
+    def variance(self):
+        s2 = jnp.square(self.scale)
+        return _wrap((jnp.exp(s2) - 1) * jnp.exp(2 * self.loc + s2))
+
+    def log_prob(self, value):
+        v = _t(value)
+        logv = jnp.log(v)
+        return _wrap(Normal.log_prob(self, logv)._value - logv)
+
+    def entropy(self):
+        return _wrap(Normal.entropy(self)._value + self.loc)
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _t(low)
+        self.high = _t(high)
+        super().__init__(jnp.broadcast_shapes(self.low.shape,
+                                              self.high.shape))
+
+    @property
+    def mean(self):
+        return _wrap((self.low + self.high) / 2)
+
+    @property
+    def variance(self):
+        return _wrap(jnp.square(self.high - self.low) / 12)
+
+    def sample(self, shape=(), seed=0):
+        key = random_mod.next_key()
+        shp = _shape(shape) + self.batch_shape
+        u = jax.random.uniform(key, shp, dtype=jnp.float32)
+        return _wrap(self.low + u * (self.high - self.low))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _t(value)
+        inside = (v >= self.low) & (v < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return _wrap(jnp.where(inside, lp, -jnp.inf))
+
+    def entropy(self):
+        return _wrap(jnp.log(self.high - self.low))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs = _t(probs)
+        super().__init__(self.probs.shape)
+
+    @property
+    def mean(self):
+        return _wrap(self.probs)
+
+    @property
+    def variance(self):
+        return _wrap(self.probs * (1 - self.probs))
+
+    def sample(self, shape=()):
+        key = random_mod.next_key()
+        shp = _shape(shape) + self.batch_shape
+        return _wrap(jax.random.bernoulli(
+            key, self.probs, shp).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _t(value)
+        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        return _wrap(v * jnp.log(p) + (1 - v) * jnp.log1p(-p))
+
+    def entropy(self):
+        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        return _wrap(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        self.logits = _t(logits)
+        self._log_norm = self.logits - jsp.logsumexp(
+            self.logits, axis=-1, keepdims=True)
+        super().__init__(self.logits.shape[:-1])
+
+    @property
+    def probs_(self):
+        return jnp.exp(self._log_norm)
+
+    def sample(self, shape=()):
+        key = random_mod.next_key()
+        shp = _shape(shape) + self.batch_shape
+        return _wrap(jax.random.categorical(key, self.logits,
+                                            shape=shp).astype(jnp.int64))
+
+    def log_prob(self, value):
+        v = _t(value).astype(jnp.int32)
+        ln = self._log_norm
+        if ln.ndim == 1:  # scalar batch: any value shape indexes the pmf
+            return _wrap(ln[v])
+        return _wrap(jnp.take_along_axis(ln, v[..., None], axis=-1)[..., 0])
+
+    def probs(self, value):
+        return _wrap(jnp.exp(self.log_prob(value)._value))
+
+    def entropy(self):
+        p = self.probs_
+        return _wrap(-(p * self._log_norm).sum(-1))
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = _t(probs)
+        self.probs = self.probs / self.probs.sum(-1, keepdims=True)
+        super().__init__(self.probs.shape[:-1], self.probs.shape[-1:])
+
+    @property
+    def mean(self):
+        return _wrap(self.total_count * self.probs)
+
+    @property
+    def variance(self):
+        return _wrap(self.total_count * self.probs * (1 - self.probs))
+
+    def sample(self, shape=()):
+        key = random_mod.next_key()
+        shp = _shape(shape) + self.batch_shape
+        logits = jnp.log(jnp.clip(self.probs, 1e-12))
+        draws = jax.random.categorical(
+            key, logits, shape=(self.total_count,) + shp)
+        k = self.probs.shape[-1]
+        counts = jax.nn.one_hot(draws, k).sum(axis=0)
+        return _wrap(counts.astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _t(value)
+        logits = jnp.log(jnp.clip(self.probs, 1e-12))
+        return _wrap(jsp.gammaln(self.total_count + 1.0) -
+                     jsp.gammaln(v + 1.0).sum(-1) + (v * logits).sum(-1))
+
+    def entropy(self):
+        # no closed form; reference computes via sampling-free bound — use
+        # the categorical entropy scaled (approximation used by torch too)
+        p = self.probs
+        cat_ent = -(p * jnp.log(jnp.clip(p, 1e-12))).sum(-1)
+        return _wrap(self.total_count * cat_ent)
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _t(alpha)
+        self.beta = _t(beta)
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape,
+                                              self.beta.shape))
+
+    @property
+    def mean(self):
+        return _wrap(self.alpha / (self.alpha + self.beta))
+
+    @property
+    def variance(self):
+        s = self.alpha + self.beta
+        return _wrap(self.alpha * self.beta / (jnp.square(s) * (s + 1)))
+
+    def sample(self, shape=()):
+        key = random_mod.next_key()
+        shp = _shape(shape) + self.batch_shape
+        return _wrap(jax.random.beta(key, self.alpha, self.beta, shp))
+
+    def log_prob(self, value):
+        v = _t(value)
+        return _wrap((self.alpha - 1) * jnp.log(v) +
+                     (self.beta - 1) * jnp.log1p(-v) -
+                     (jsp.gammaln(self.alpha) + jsp.gammaln(self.beta) -
+                      jsp.gammaln(self.alpha + self.beta)))
+
+    def entropy(self):
+        a, b = self.alpha, self.beta
+        lbeta = jsp.gammaln(a) + jsp.gammaln(b) - jsp.gammaln(a + b)
+        return _wrap(lbeta - (a - 1) * jsp.digamma(a) -
+                     (b - 1) * jsp.digamma(b) +
+                     (a + b - 2) * jsp.digamma(a + b))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = _t(concentration)
+        super().__init__(self.concentration.shape[:-1],
+                         self.concentration.shape[-1:])
+
+    @property
+    def mean(self):
+        c = self.concentration
+        return _wrap(c / c.sum(-1, keepdims=True))
+
+    @property
+    def variance(self):
+        c = self.concentration
+        c0 = c.sum(-1, keepdims=True)
+        m = c / c0
+        return _wrap(m * (1 - m) / (c0 + 1))
+
+    def sample(self, shape=()):
+        key = random_mod.next_key()
+        shp = _shape(shape) + self.batch_shape
+        return _wrap(jax.random.dirichlet(key, self.concentration, shp))
+
+    def log_prob(self, value):
+        v = _t(value)
+        c = self.concentration
+        return _wrap(((c - 1) * jnp.log(v)).sum(-1) +
+                     jsp.gammaln(c.sum(-1)) - jsp.gammaln(c).sum(-1))
+
+    def entropy(self):
+        c = self.concentration
+        c0 = c.sum(-1)
+        k = c.shape[-1]
+        lnB = jsp.gammaln(c).sum(-1) - jsp.gammaln(c0)
+        return _wrap(lnB + (c0 - k) * jsp.digamma(c0) -
+                     ((c - 1) * jsp.digamma(c)).sum(-1))
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return _wrap(2 * jnp.square(self.scale))
+
+    def sample(self, shape=()):
+        key = random_mod.next_key()
+        shp = _shape(shape) + self.batch_shape
+        return _wrap(self.loc + self.scale * jax.random.laplace(
+            key, shp, dtype=jnp.float32))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _t(value)
+        return _wrap(-jnp.abs(v - self.loc) / self.scale -
+                     jnp.log(2 * self.scale))
+
+    def entropy(self):
+        return _wrap(jnp.broadcast_to(1 + jnp.log(2 * self.scale),
+                                      self.batch_shape))
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    _EULER = 0.5772156649015329
+
+    @property
+    def mean(self):
+        return _wrap(self.loc + self.scale * self._EULER)
+
+    @property
+    def variance(self):
+        return _wrap(jnp.square(jnp.pi * self.scale) / 6)
+
+    def sample(self, shape=()):
+        key = random_mod.next_key()
+        shp = _shape(shape) + self.batch_shape
+        return _wrap(self.loc + self.scale * jax.random.gumbel(
+            key, shp, dtype=jnp.float32))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        z = (_t(value) - self.loc) / self.scale
+        return _wrap(-(z + jnp.exp(-z)) - jnp.log(self.scale))
+
+    def entropy(self):
+        return _wrap(jnp.broadcast_to(jnp.log(self.scale) + 1 + self._EULER,
+                                      self.batch_shape))
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _t(rate)
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        return _wrap(1.0 / self.rate)
+
+    @property
+    def variance(self):
+        return _wrap(1.0 / jnp.square(self.rate))
+
+    def sample(self, shape=()):
+        key = random_mod.next_key()
+        shp = _shape(shape) + self.batch_shape
+        return _wrap(jax.random.exponential(key, shp,
+                                            dtype=jnp.float32) / self.rate)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _t(value)
+        return _wrap(jnp.log(self.rate) - self.rate * v)
+
+    def entropy(self):
+        return _wrap(1.0 - jnp.log(self.rate))
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _t(concentration)
+        self.rate = _t(rate)
+        super().__init__(jnp.broadcast_shapes(self.concentration.shape,
+                                              self.rate.shape))
+
+    @property
+    def mean(self):
+        return _wrap(self.concentration / self.rate)
+
+    @property
+    def variance(self):
+        return _wrap(self.concentration / jnp.square(self.rate))
+
+    def sample(self, shape=()):
+        key = random_mod.next_key()
+        shp = _shape(shape) + self.batch_shape
+        return _wrap(jax.random.gamma(key, self.concentration,
+                                      shp) / self.rate)
+
+    def log_prob(self, value):
+        v = _t(value)
+        a, b = self.concentration, self.rate
+        return _wrap(a * jnp.log(b) + (a - 1) * jnp.log(v) - b * v -
+                     jsp.gammaln(a))
+
+    def entropy(self):
+        a, b = self.concentration, self.rate
+        return _wrap(a - jnp.log(b) + jsp.gammaln(a) +
+                     (1 - a) * jsp.digamma(a))
+
+
+class Poisson(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _t(rate)
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        return _wrap(self.rate)
+
+    @property
+    def variance(self):
+        return _wrap(self.rate)
+
+    def sample(self, shape=()):
+        key = random_mod.next_key()
+        shp = _shape(shape) + self.batch_shape
+        return _wrap(jax.random.poisson(key, self.rate,
+                                        shp).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _t(value)
+        return _wrap(v * jnp.log(self.rate) - self.rate -
+                     jsp.gammaln(v + 1.0))
+
+    def entropy(self):
+        # second-order Stirling approximation (reference poisson.py)
+        r = self.rate
+        return _wrap(0.5 * jnp.log(2 * jnp.pi * jnp.e * r) -
+                     1 / (12 * r) - 1 / (24 * jnp.square(r)))
+
+
+class Geometric(Distribution):
+    """Failures-before-first-success convention: support {0, 1, ...},
+    pmf p(1-p)^k (matches sample() and log_prob())."""
+
+    def __init__(self, probs, name=None):
+        self.probs = _t(probs)
+        super().__init__(self.probs.shape)
+
+    @property
+    def mean(self):
+        return _wrap((1.0 - self.probs) / self.probs)
+
+    @property
+    def variance(self):
+        return _wrap((1 - self.probs) / jnp.square(self.probs))
+
+    def sample(self, shape=()):
+        key = random_mod.next_key()
+        shp = _shape(shape) + self.batch_shape
+        u = jax.random.uniform(key, shp, dtype=jnp.float32)
+        return _wrap(jnp.floor(jnp.log1p(-u) / jnp.log1p(-self.probs)))
+
+    def log_prob(self, value):
+        v = _t(value)
+        return _wrap(v * jnp.log1p(-self.probs) + jnp.log(self.probs))
+
+    def entropy(self):
+        p = self.probs
+        q = 1 - p
+        return _wrap(-(q * jnp.log(q) + p * jnp.log(p)) / p)
+
+
+# -- KL registry (distribution/kl.py parity) ---------------------------------
+
+_KL_REGISTRY = {}
+
+
+def register_kl(p_cls, q_cls):
+    def deco(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+    return deco
+
+
+def kl_divergence(p: Distribution, q: Distribution):
+    for (pc, qc), fn in _KL_REGISTRY.items():
+        if isinstance(p, pc) and isinstance(q, qc):
+            return fn(p, q)
+    raise NotImplementedError(
+        f"no KL registered for ({type(p).__name__}, {type(q).__name__})")
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    var_ratio = jnp.square(p.scale / q.scale)
+    t1 = jnp.square((p.loc - q.loc) / q.scale)
+    return _wrap(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    return _wrap(jnp.log((q.high - q.low) / (p.high - p.low)))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    pr = p.probs_
+    return _wrap((pr * (p._log_norm - q._log_norm)).sum(-1))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    pp = jnp.clip(p.probs, 1e-7, 1 - 1e-7)
+    qp = jnp.clip(q.probs, 1e-7, 1 - 1e-7)
+    return _wrap(pp * (jnp.log(pp) - jnp.log(qp)) +
+                 (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qp)))
+
+
+@register_kl(Beta, Beta)
+def _kl_beta(p, q):
+    def lbeta(a, b):
+        return jsp.gammaln(a) + jsp.gammaln(b) - jsp.gammaln(a + b)
+    a1, b1, a2, b2 = p.alpha, p.beta, q.alpha, q.beta
+    s1 = a1 + b1
+    return _wrap(lbeta(a2, b2) - lbeta(a1, b1) +
+                 (a1 - a2) * jsp.digamma(a1) + (b1 - b2) * jsp.digamma(b1) +
+                 (a2 - a1 + b2 - b1) * jsp.digamma(s1))
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet(p, q):
+    c1, c2 = p.concentration, q.concentration
+    s1 = c1.sum(-1)
+    return _wrap(jsp.gammaln(s1) - jsp.gammaln(c2.sum(-1)) -
+                 (jsp.gammaln(c1) - jsp.gammaln(c2)).sum(-1) +
+                 ((c1 - c2) * (jsp.digamma(c1) -
+                               jsp.digamma(s1)[..., None])).sum(-1))
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential(p, q):
+    ratio = q.rate / p.rate
+    return _wrap(jnp.log(p.rate) - jnp.log(q.rate) + ratio - 1)

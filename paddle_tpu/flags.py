@@ -1,0 +1,87 @@
+"""Runtime flag system — parity with the reference's exported gflags
+(paddle/fluid/platform/flags.cc: 74 `PADDLE_DEFINE_EXPORTED_*` flags surfaced
+via paddle.set_flags/get_flags and FLAGS_* env vars,
+global_value_getter_setter.cc).
+
+TPU build: flags that governed CUDA allocators/cuDNN are accepted and
+recorded (XLA owns those concerns); behavioral flags are wired:
+  FLAGS_check_nan_inf  — per-op output NaN/Inf scan in the eager op layer
+                         (nan_inf_utils_detail.cc:341 parity; jax pairs it
+                         with jax_debug_nans for in-jit checks)
+  FLAGS_cudnn_deterministic — maps to XLA deterministic ops env
+"""
+from __future__ import annotations
+
+import os
+
+_FLAGS: dict[str, object] = {
+    "FLAGS_check_nan_inf": False,
+    "FLAGS_cudnn_deterministic": False,
+    "FLAGS_allocator_strategy": "auto_growth",
+    "FLAGS_fraction_of_gpu_memory_to_use": 0.92,
+    "FLAGS_eager_delete_tensor_gb": 0.0,
+    "FLAGS_use_pinned_memory": True,
+    "FLAGS_benchmark": False,
+    "FLAGS_paddle_num_threads": 1,
+    "FLAGS_max_inplace_grad_add": 0,
+    "FLAGS_conv_workspace_size_limit": 512,
+    "FLAGS_cudnn_exhaustive_search": False,
+    "FLAGS_selected_devices": "",
+}
+
+
+def _coerce(old, value):
+    if isinstance(old, bool):
+        if isinstance(value, str):
+            return value.lower() in ("1", "true", "yes", "on")
+        return bool(value)
+    if isinstance(old, int) and not isinstance(old, bool):
+        return int(value)
+    if isinstance(old, float):
+        return float(value)
+    return value
+
+
+def _bootstrap_from_env():
+    for key in list(_FLAGS):
+        if key in os.environ:
+            _FLAGS[key] = _coerce(_FLAGS[key], os.environ[key])
+    if _FLAGS["FLAGS_check_nan_inf"]:
+        _sync_check_nan_inf()
+
+
+def set_flags(flags: dict):
+    """paddle.set_flags parity (unknown flags raise, like the reference)."""
+    for k, v in flags.items():
+        key = k if k.startswith("FLAGS_") else f"FLAGS_{k}"
+        if key not in _FLAGS:
+            raise ValueError(f"unknown flag {k!r}")
+        _FLAGS[key] = _coerce(_FLAGS[key], v)
+        if key == "FLAGS_check_nan_inf":
+            _sync_check_nan_inf()
+
+
+def get_flags(flags):
+    """paddle.get_flags parity: str or list → dict."""
+    keys = [flags] if isinstance(flags, str) else list(flags)
+    out = {}
+    for k in keys:
+        key = k if k.startswith("FLAGS_") else f"FLAGS_{k}"
+        if key not in _FLAGS:
+            raise ValueError(f"unknown flag {k!r}")
+        out[key] = _FLAGS[key]
+    return out
+
+
+def flag(name, default=None):
+    """Internal fast accessor."""
+    return _FLAGS.get(name if name.startswith("FLAGS_")
+                      else f"FLAGS_{name}", default)
+
+
+def _sync_check_nan_inf():
+    from .core import op as op_mod
+    op_mod.CHECK_NAN_INF = bool(_FLAGS["FLAGS_check_nan_inf"])
+
+
+_bootstrap_from_env()

@@ -1,0 +1,10 @@
+from .profiler import (  # noqa: F401
+    Profiler,
+    ProfilerState,
+    ProfilerTarget,
+    RecordEvent,
+    export_chrome_tracing,
+    load_profiler_result,
+    make_scheduler,
+)
+from .profiler_statistic import SortedKeys  # noqa: F401

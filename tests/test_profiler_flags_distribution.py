@@ -1,0 +1,231 @@
+"""Profiler / flags / nan-inf / distribution tests (reference:
+test_profiler.py, test_nan_inf.py, python/paddle/fluid/tests/unittests/
+distribution/)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import profiler
+from paddle_tpu.profiler import (Profiler, ProfilerState, RecordEvent,
+                                 export_chrome_tracing, make_scheduler)
+
+
+# -- scheduler state machine -------------------------------------------------
+
+def test_make_scheduler_states():
+    sched = make_scheduler(closed=1, ready=1, record=2, repeat=1,
+                           skip_first=1)
+    states = [sched(i) for i in range(6)]
+    assert states == [ProfilerState.CLOSED,   # skip_first
+                      ProfilerState.CLOSED, ProfilerState.READY,
+                      ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN,
+                      ProfilerState.CLOSED]  # repeat exhausted
+
+
+def test_profiler_record_and_export(tmp_path):
+    traces = []
+
+    def on_ready(prof):
+        path = str(tmp_path / "trace.json")
+        prof._export_chrome(path)
+        traces.append(path)
+
+    p = Profiler(targets=[profiler.ProfilerTarget.CPU],
+                 scheduler=make_scheduler(closed=0, ready=0, record=2,
+                                          repeat=1),
+                 on_trace_ready=on_ready, timer_only=True)
+    p.start()
+    for _ in range(3):
+        with RecordEvent("my_op"):
+            x = paddle.to_tensor(np.ones((8, 8), "float32"))
+            (x @ x).numpy()
+        p.step()
+    p.stop()
+    assert traces, "on_trace_ready never fired"
+    data = json.load(open(traces[0]))
+    names = {e["name"] for e in data["traceEvents"]}
+    assert "my_op" in names
+    # summary builds a table
+    s = p.summary()
+    assert "my_op" in s
+    assert "steps" in p.step_info()
+
+
+def test_profiler_repeat_cycles_capture_distinct_events(tmp_path):
+    """Back-to-back record windows each capture their own events
+    (regression: cycle 2 re-fired cycle 1's stale spans)."""
+    captured = []
+
+    def on_ready(prof):
+        captured.append([e["name"] for e in prof._events])
+
+    p = Profiler(scheduler=make_scheduler(closed=0, ready=0, record=2,
+                                          repeat=2),
+                 on_trace_ready=on_ready, timer_only=True)
+    p.start()
+    for i in range(4):
+        with RecordEvent(f"op{i}"):
+            pass
+        p.step()
+    p.stop()
+    assert len(captured) == 2
+    assert captured[0] == ["op0", "op1"]
+    assert captured[1] == ["op2", "op3"]
+
+
+def test_env_var_enables_nan_check():
+    """FLAGS_check_nan_inf=1 in the environment activates the scan
+    (regression: env bootstrap never synced the op layer)."""
+    import subprocess
+    import sys
+    code = (
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "import numpy as np\n"
+        "import paddle_tpu as paddle\n"
+        "try:\n"
+        "    paddle.log(paddle.to_tensor(np.array([-1.0], 'float32')))\n"
+        "    print('NO-RAISE')\n"
+        "except RuntimeError as e:\n"
+        "    print('RAISED' if 'NaN' in str(e) else 'WRONG')\n")
+    env = dict(os.environ, FLAGS_check_nan_inf="1")
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         cwd="/root/repo", capture_output=True, timeout=120)
+    assert b"RAISED" in out.stdout, out.stdout + out.stderr
+
+
+def test_set_flags_unknown_raises():
+    with pytest.raises(ValueError):
+        paddle.set_flags({"FLAGS_check_nan_imf": True})  # typo
+
+
+def test_geometric_mean_matches_samples():
+    from paddle_tpu.distribution import Geometric
+    paddle.seed(5)
+    g = Geometric(0.5)
+    s = g.sample([50000]).numpy()
+    assert abs(s.mean() - float(g.mean.numpy())) < 0.05  # both ≈ 1.0
+
+
+# -- flags + nan/inf ---------------------------------------------------------
+
+def test_set_get_flags():
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+    assert paddle.get_flags("FLAGS_check_nan_inf")["FLAGS_check_nan_inf"]
+    paddle.set_flags({"FLAGS_check_nan_inf": False})
+    out = paddle.get_flags(["FLAGS_check_nan_inf",
+                            "FLAGS_allocator_strategy"])
+    assert out["FLAGS_check_nan_inf"] is False
+    assert out["FLAGS_allocator_strategy"] == "auto_growth"
+    with pytest.raises(ValueError):
+        paddle.get_flags("FLAGS_nonexistent_flag")
+
+
+def test_check_nan_inf_raises():
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        x = paddle.to_tensor(np.array([1.0, 0.0], "float32"))
+        with pytest.raises(RuntimeError, match="divide"):
+            _ = (x / paddle.to_tensor(np.array([1.0, 0.0], "float32")))
+        # log of negative → NaN
+        with pytest.raises(RuntimeError, match="NaN"):
+            paddle.log(paddle.to_tensor(np.array([-1.0], "float32")))
+    finally:
+        paddle.set_flags({"FLAGS_check_nan_inf": False})
+    # disabled again: no raise
+    y = paddle.to_tensor(np.array([1.0], "float32")) / \
+        paddle.to_tensor(np.array([0.0], "float32"))
+    assert np.isinf(y.numpy()).all()
+
+
+# -- distributions -----------------------------------------------------------
+
+def test_normal_moments_and_sampling():
+    from paddle_tpu.distribution import Normal
+    paddle.seed(0)
+    d = Normal(loc=1.5, scale=2.0)
+    s = d.sample([20000])
+    assert abs(float(s.numpy().mean()) - 1.5) < 0.1
+    assert abs(float(s.numpy().std()) - 2.0) < 0.1
+    lp = d.log_prob(paddle.to_tensor(np.array([1.5], "float32")))
+    expected = -np.log(2.0) - 0.5 * np.log(2 * np.pi)
+    np.testing.assert_allclose(lp.numpy()[0], expected, rtol=1e-5)
+    assert float(d.entropy().numpy()) == pytest.approx(
+        0.5 + 0.5 * np.log(2 * np.pi) + np.log(2.0), rel=1e-5)
+
+
+def test_uniform_categorical():
+    from paddle_tpu.distribution import Categorical, Uniform
+    paddle.seed(1)
+    u = Uniform(low=-1.0, high=3.0)
+    s = u.sample([10000]).numpy()
+    assert s.min() >= -1 and s.max() < 3
+    assert abs(s.mean() - 1.0) < 0.1
+    assert float(u.entropy().numpy()) == pytest.approx(np.log(4.0), rel=1e-5)
+
+    logits = np.log(np.array([0.2, 0.3, 0.5], "float32"))
+    c = Categorical(paddle.to_tensor(logits))
+    s = c.sample([20000]).numpy()
+    freq = np.bincount(s, minlength=3) / len(s)
+    np.testing.assert_allclose(freq, [0.2, 0.3, 0.5], atol=0.02)
+    np.testing.assert_allclose(
+        c.log_prob(paddle.to_tensor(np.array([2], "int64"))).numpy(),
+        [np.log(0.5)], rtol=1e-5)
+
+
+def test_beta_dirichlet_multinomial():
+    from paddle_tpu.distribution import Beta, Dirichlet, Multinomial
+    paddle.seed(2)
+    b = Beta(2.0, 3.0)
+    assert float(b.mean.numpy()) == pytest.approx(0.4)
+    s = b.sample([5000]).numpy()
+    assert abs(s.mean() - 0.4) < 0.05
+
+    d = Dirichlet(paddle.to_tensor(np.array([1.0, 2.0, 3.0], "float32")))
+    m = d.mean.numpy()
+    np.testing.assert_allclose(m, [1 / 6, 2 / 6, 3 / 6], rtol=1e-5)
+    s = d.sample([2]).numpy()
+    np.testing.assert_allclose(s.sum(-1), 1.0, rtol=1e-5)
+
+    mn = Multinomial(10, paddle.to_tensor(np.array([0.2, 0.8], "float32")))
+    s = mn.sample([4]).numpy()
+    assert s.shape == (4, 2)
+    np.testing.assert_allclose(s.sum(-1), 10.0)
+    lp = mn.log_prob(paddle.to_tensor(np.array([2.0, 8.0], "float32")))
+    # closed form check: C(10,2) * .2^2 * .8^8
+    import math
+    expected = math.log(math.comb(10, 2) * 0.2 ** 2 * 0.8 ** 8)
+    np.testing.assert_allclose(float(lp.numpy()), expected, rtol=1e-4)
+
+
+def test_kl_divergence():
+    from paddle_tpu.distribution import Normal, kl_divergence
+    p = Normal(0.0, 1.0)
+    q = Normal(1.0, 2.0)
+    kl = float(kl_divergence(p, q).numpy())
+    expected = np.log(2.0) + (1 + 1) / (2 * 4) - 0.5
+    np.testing.assert_allclose(kl, expected, rtol=1e-5)
+    # KL(p, p) == 0
+    assert float(kl_divergence(p, Normal(0.0, 1.0)).numpy()) == \
+        pytest.approx(0.0, abs=1e-6)
+
+
+def test_transformed_distribution():
+    from paddle_tpu.distribution import (AffineTransform, ExpTransform,
+                                         Normal, TransformedDistribution)
+    paddle.seed(3)
+    base = Normal(0.0, 1.0)
+    logn = TransformedDistribution(base, [ExpTransform()])
+    s = logn.sample([5000]).numpy()
+    assert (s > 0).all()
+    # log_prob matches the LogNormal closed form
+    v = np.array([0.5, 1.0, 2.0], "float32")
+    lp = logn.log_prob(paddle.to_tensor(v)).numpy()
+    expected = -np.log(v) - 0.5 * np.log(2 * np.pi) - np.log(v) ** 2 / 2
+    np.testing.assert_allclose(lp, expected, rtol=1e-4)
+
+    aff = TransformedDistribution(base, [AffineTransform(2.0, 3.0)])
+    s = aff.sample([20000]).numpy()
+    assert abs(s.mean() - 2.0) < 0.1 and abs(s.std() - 3.0) < 0.1
