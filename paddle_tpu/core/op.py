@@ -34,10 +34,15 @@ CHECK_NAN_INF = False
 
 
 def _scan_nan_inf(name, out):
+    import jax
     import jax.numpy as jnp
 
     vals = out if isinstance(out, (tuple, list)) else (out,)
     for i, v in enumerate(vals):
+        if isinstance(v, jax.core.Tracer):
+            # inside jit the scan can't branch on values; jax_debug_nans is
+            # the in-jit counterpart (SURVEY §5.2)
+            continue
         if hasattr(v, "dtype") and jnp.issubdtype(v.dtype, jnp.floating):
             bad = ~jnp.isfinite(v)
             if bool(bad.any()):

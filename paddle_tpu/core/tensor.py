@@ -24,7 +24,8 @@ _name_counter = itertools.count()
 
 class Tensor:
     __slots__ = ("_value", "_grad", "_grad_node", "_grad_slot", "stop_gradient",
-                 "name", "persistable", "_partition_spec", "__weakref__")
+                 "name", "persistable", "_partition_spec", "_process_mesh",
+                 "__weakref__")
 
     def __init__(self, data: Any = None, dtype=None, place=None,
                  stop_gradient: bool = True, name: str | None = None,

@@ -21,8 +21,12 @@ from .spmd import (  # noqa: F401
     ShardedTrainStep, TrainState, batch_spec, infer_param_specs,
     make_train_step,
 )
+from . import auto_parallel  # noqa: F401
 from . import fleet  # noqa: F401
 from . import sharding  # noqa: F401
+from .auto_parallel import (  # noqa: F401
+    ProcessMesh, dtensor_from_fn, reshard, shard_op, shard_tensor,
+)
 from .fleet.layers.mpu.mp_ops import split  # noqa: F401
 
 get_world_size_ = get_world_size

@@ -1,0 +1,4 @@
+from .api import dtensor_from_fn, reshard, shard_op, shard_tensor  # noqa: F401
+from .engine import Engine  # noqa: F401
+from .process_mesh import ProcessMesh  # noqa: F401
+from .strategy import Strategy  # noqa: F401

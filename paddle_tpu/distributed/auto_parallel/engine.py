@@ -1,0 +1,170 @@
+"""auto_parallel Engine — parity with
+python/paddle/distributed/auto_parallel/engine.py:55 (fit:485, evaluate,
+predict; _build traces the program, _plan runs the Completer, _parallel
+partitions it).
+
+TPU-native collapse: the Completer/Partitioner/Resharder pipeline is GSPMD —
+the Engine builds one compiled SPMD train step (distributed/spmd.py) over the
+annotated model (shard_tensor tags + Strategy.sharding) and drives it from
+a DataLoader, reusing the reference's fit/evaluate/predict surface.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...core.tensor import Tensor
+from ...io.dataloader import DataLoader
+from .. import mesh as mesh_mod
+from ..spmd import ShardedTrainStep
+from .process_mesh import ProcessMesh
+from .strategy import Strategy
+
+
+class Engine:
+    def __init__(self, model=None, loss=None, optimizer=None, metrics=None,
+                 cluster=None, strategy=None):
+        self._model = model
+        self._loss = loss
+        self._optimizer = optimizer
+        self._metrics = metrics if isinstance(metrics, (list, tuple)) else \
+            ([metrics] if metrics else [])
+        self._strategy = strategy or Strategy()
+        self._step = None
+        self.history = None
+
+    # -- planning ------------------------------------------------------------
+    def _mesh(self):
+        # an explicit ProcessMesh annotation anywhere on the model wins;
+        # otherwise the global mesh; otherwise 1-D data-parallel world
+        for p in self._model.parameters():
+            pm = getattr(p, "_process_mesh", None)
+            if isinstance(pm, ProcessMesh):
+                return pm.to_jax()
+        m = mesh_mod.get_global_mesh()
+        if m is not None:
+            return m
+        import jax
+        return mesh_mod.build_mesh([len(jax.devices())], ["dp"])
+
+    def _build_step(self):
+        if self._step is None:
+            sh = self._strategy.sharding
+            stage = sh.stage if getattr(sh, "enable", False) else 0
+            self._step = ShardedTrainStep(
+                self._model, self._optimizer, loss_fn=self._loss,
+                mesh=self._mesh(), sharding_stage=stage,
+                compute_dtype="bfloat16"
+                if getattr(self._strategy.amp, "enable", False) else None,
+                accumulate_steps=max(
+                    1, getattr(self._strategy.gradient_merge, "k_steps", 1)
+                    if getattr(self._strategy.gradient_merge, "enable", False)
+                    else 1))
+        return self._step
+
+    # -- loops ---------------------------------------------------------------
+    def _loader(self, data, batch_size, shuffle=False):
+        if isinstance(data, DataLoader):
+            return data
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                          drop_last=True)
+
+    def fit(self, train_data, train_sample_split=None, batch_size=1,
+            epochs=1, steps_per_epoch=None, log_freq=10, save_dir=None,
+            save_freq=1, valid_data=None, valid_sample_split=None,
+            valid_freq=1, valid_steps=None, collate_fn=None, callbacks=None,
+            verbose=2, nvprof_range=(-1, -1)):
+        step = self._build_step()
+        loader = self._loader(train_data, batch_size, shuffle=True)
+        history = {"loss": []}
+        for epoch in range(epochs):
+            epoch_losses = []
+            for i, batch in enumerate(loader):
+                arrs = [b.numpy() if isinstance(b, Tensor) else np.asarray(b)
+                        for b in (batch if isinstance(batch, (list, tuple))
+                                  else [batch])]
+                loss = step(*arrs)
+                epoch_losses.append(float(loss.numpy()))
+                if steps_per_epoch and i + 1 >= steps_per_epoch:
+                    break
+            history["loss"].append(float(np.mean(epoch_losses)))
+            if verbose:
+                print(f"[AutoParallel] epoch {epoch}: "
+                      f"loss {history['loss'][-1]:.6f}")
+        step.sync_to_model()
+        self.history = history
+        return history
+
+    def evaluate(self, valid_data, valid_sample_split=None, batch_size=1,
+                 steps=None, log_freq=10, collate_fn=None, callbacks=None,
+                 verbose=2):
+        from ...core.autograd import no_grad
+
+        loader = self._loader(valid_data, batch_size)
+        losses = []
+        self._model.eval()
+        try:
+            with no_grad():
+                for i, batch in enumerate(loader):
+                    parts = list(batch) if isinstance(batch, (list, tuple)) \
+                        else [batch]
+                    ins, lbs = parts[:-1], parts[-1:]
+                    out = self._model(*[self._to_t(b) for b in ins])
+                    if self._loss is not None:
+                        loss = self._loss(out, *[self._to_t(b) for b in lbs])
+                        losses.append(float(loss.numpy()))
+                    if steps and i + 1 >= steps:
+                        break
+        finally:
+            self._model.train()
+        return {"loss": float(np.mean(losses)) if losses else None}
+
+    def predict(self, test_data, test_sample_split=None, batch_size=1,
+                steps=None, collate_fn=None, callbacks=None, verbose=2):
+        from ...core.autograd import no_grad
+
+        loader = self._loader(test_data, batch_size)
+        outs = []
+        self._model.eval()
+        try:
+            with no_grad():
+                for i, batch in enumerate(loader):
+                    parts = list(batch) if isinstance(batch, (list, tuple)) \
+                        else [batch]
+                    out = self._model(self._to_t(parts[0]))
+                    outs.append(out.numpy())
+                    if steps and i + 1 >= steps:
+                        break
+        finally:
+            self._model.train()
+        return outs
+
+    @staticmethod
+    def _to_t(b):
+        if isinstance(b, Tensor):
+            return b
+        import jax.numpy as jnp
+        return Tensor(jnp.asarray(np.asarray(b)), _internal=True)
+
+    # -- persistence ---------------------------------------------------------
+    def save(self, path, training=True):
+        import os
+
+        from ...framework.io import save as _save
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        if self._step is not None:
+            self._step.sync_to_model()
+        _save(self._model.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            _save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, strict=True, load_optimizer=True):
+        import os
+
+        from ...framework.io import load as _load
+        self._model.set_state_dict(_load(path + ".pdparams"))
+        if load_optimizer and self._optimizer is not None and \
+                os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(_load(path + ".pdopt"))
+        self._step = None  # rebuild with fresh values

@@ -1,0 +1,72 @@
+"""ProcessMesh — parity with paddle/fluid/distributed/auto_parallel/
+process_mesh.h and python auto_parallel/process_mesh.py.
+
+A ProcessMesh IS a jax.sharding.Mesh here: the reference's (topology, process
+ids, dim names) triple maps onto a device-array mesh; GSPMD consumes it
+directly."""
+from __future__ import annotations
+
+import numpy as np
+
+
+class ProcessMesh:
+    def __init__(self, mesh, dim_names=None, shape=None, process_ids=None):
+        if mesh is not None:
+            arr = np.asarray(mesh)
+        else:
+            arr = np.asarray(process_ids).reshape(shape)
+        self._mesh_ids = arr
+        self._dim_names = list(dim_names) if dim_names is not None else \
+            [f"d{i}" for i in range(arr.ndim)]
+        if len(self._dim_names) != arr.ndim:
+            raise ValueError(
+                f"{len(self._dim_names)} dim_names for a {arr.ndim}-d mesh")
+        self._jax_mesh = None
+
+    @property
+    def shape(self):
+        return list(self._mesh_ids.shape)
+
+    @property
+    def ndim(self):
+        return self._mesh_ids.ndim
+
+    @property
+    def process_ids(self):
+        return [int(i) for i in self._mesh_ids.reshape(-1)]
+
+    @property
+    def dim_names(self):
+        return list(self._dim_names)
+
+    @property
+    def mesh(self):
+        return self._mesh_ids
+
+    def get_dim_size(self, dim_name):
+        return self._mesh_ids.shape[self._dim_names.index(dim_name)]
+
+    def to_jax(self):
+        """Materialize as a jax Mesh over the process-id devices."""
+        if self._jax_mesh is None:
+            import jax
+            from jax.sharding import Mesh
+
+            devices = {d.id: d for d in jax.devices()}
+            try:
+                arr = np.vectorize(lambda i: devices[int(i)])(self._mesh_ids)
+            except KeyError as e:
+                raise ValueError(
+                    f"mesh references device id {e} but only "
+                    f"{sorted(devices)} exist") from None
+            self._jax_mesh = Mesh(arr, tuple(self._dim_names))
+        return self._jax_mesh
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh) and
+                np.array_equal(self._mesh_ids, other._mesh_ids) and
+                self._dim_names == other._dim_names)
+
+    def __repr__(self):
+        return (f"ProcessMesh(shape={self.shape}, "
+                f"dim_names={self._dim_names})")

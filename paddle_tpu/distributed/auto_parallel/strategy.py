@@ -1,0 +1,37 @@
+"""auto_parallel Strategy — parity with
+python/paddle/distributed/auto_parallel/strategy.py (typed config blocks with
+the constants.py defaults)."""
+from __future__ import annotations
+
+
+class _Config:
+    def __init__(self, **defaults):
+        self.__dict__.update(defaults)
+
+    def to_dict(self):
+        return dict(self.__dict__)
+
+
+class Strategy:
+    def __init__(self, config=None):
+        self.auto_mode = "semi"
+        self.seed = None
+        self.amp = _Config(enable=False, dtype="bfloat16", level="O1",
+                           init_loss_scaling=32768.0,
+                           custom_white_list=[], custom_black_list=[])
+        self.recompute = _Config(enable=False, checkpoints=None,
+                                 no_recompute_segments=[], sr=0)
+        self.sharding = _Config(enable=False, stage=1, degree=8,
+                                overlap_grad_comm=False)
+        self.gradient_merge = _Config(enable=False, k_steps=1, avg=True)
+        self.pipeline = _Config(enable=False, schedule_mode="1F1B",
+                                micro_batch_size=1, accumulate_steps=1)
+        self.fused_passes = _Config(enable=False, fused_passes_list=[])
+        self.dataset = _Config(num_shards=1, shard_idx=0)
+        if config:
+            for k, v in config.items():
+                blk = getattr(self, k, None)
+                if isinstance(blk, _Config) and isinstance(v, dict):
+                    blk.__dict__.update(v)
+                else:
+                    setattr(self, k, v)

@@ -547,9 +547,23 @@ def register_kl(p_cls, q_cls):
 
 
 def kl_divergence(p: Distribution, q: Distribution):
+    exact = _KL_REGISTRY.get((type(p), type(q)))
+    if exact is not None:
+        return exact(p, q)
+    # subclass pairs with DIFFERENT types (e.g. LogNormal vs Normal) must not
+    # fall through to a base-class formula: the supports differ
+    if type(p) is not type(q) and (isinstance(p, type(q)) or
+                                   isinstance(q, type(p))):
+        raise NotImplementedError(
+            f"no KL registered for ({type(p).__name__}, {type(q).__name__})")
+    best = None
     for (pc, qc), fn in _KL_REGISTRY.items():
         if isinstance(p, pc) and isinstance(q, qc):
-            return fn(p, q)
+            score = type(p).__mro__.index(pc) + type(q).__mro__.index(qc)
+            if best is None or score < best[0]:
+                best = (score, fn)
+    if best is not None:
+        return best[1](p, q)
     raise NotImplementedError(
         f"no KL registered for ({type(p).__name__}, {type(q).__name__})")
 
@@ -559,6 +573,12 @@ def _kl_normal(p, q):
     var_ratio = jnp.square(p.scale / q.scale)
     t1 = jnp.square((p.loc - q.loc) / q.scale)
     return _wrap(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+
+
+@register_kl(LogNormal, LogNormal)
+def _kl_lognormal(p, q):
+    # KL is invariant under the shared exp bijection
+    return _kl_normal(p, q)
 
 
 @register_kl(Uniform, Uniform)

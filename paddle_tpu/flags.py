@@ -3,12 +3,12 @@
 via paddle.set_flags/get_flags and FLAGS_* env vars,
 global_value_getter_setter.cc).
 
-TPU build: flags that governed CUDA allocators/cuDNN are accepted and
-recorded (XLA owns those concerns); behavioral flags are wired:
+TPU build: flags that governed CUDA allocators/cuDNN (allocator_strategy,
+cudnn_deterministic, gpu memory fractions, ...) are accepted and RECORDED
+ONLY — XLA owns those concerns.  Behavioral flags that are wired:
   FLAGS_check_nan_inf  — per-op output NaN/Inf scan in the eager op layer
                          (nan_inf_utils_detail.cc:341 parity; jax pairs it
                          with jax_debug_nans for in-jit checks)
-  FLAGS_cudnn_deterministic — maps to XLA deterministic ops env
 """
 from __future__ import annotations
 
@@ -71,12 +71,6 @@ def get_flags(flags):
             raise ValueError(f"unknown flag {k!r}")
         out[key] = _FLAGS[key]
     return out
-
-
-def flag(name, default=None):
-    """Internal fast accessor."""
-    return _FLAGS.get(name if name.startswith("FLAGS_")
-                      else f"FLAGS_{name}", default)
 
 
 def _sync_check_nan_inf():

@@ -1,0 +1,187 @@
+"""auto_parallel tests on the 8-device CPU mesh (reference:
+unittests/auto_parallel/ — annotation, reshard, engine runs)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import ProcessMesh, reshard, shard_op, shard_tensor
+from paddle_tpu.distributed.auto_parallel import Engine, Strategy
+from paddle_tpu.io import Dataset
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    yield
+    dist.set_global_mesh(None)
+
+
+def test_process_mesh_basics():
+    pm = ProcessMesh([[0, 1, 2, 3], [4, 5, 6, 7]], dim_names=["x", "y"])
+    assert pm.shape == [2, 4]
+    assert pm.ndim == 2
+    assert pm.process_ids == list(range(8))
+    assert pm.get_dim_size("y") == 4
+    m = pm.to_jax()
+    assert m.shape == {"x": 2, "y": 4}
+    with pytest.raises(ValueError):
+        ProcessMesh([[0, 1]], dim_names=["a", "b", "c"])
+
+
+def test_shard_tensor_lays_out_values():
+    pm = ProcessMesh([[0, 1, 2, 3], [4, 5, 6, 7]], dim_names=["x", "y"])
+    t = paddle.to_tensor(np.arange(32, dtype="float32").reshape(8, 4))
+    shard_tensor(t, pm, ["x", "y"])
+    spec = t._value.sharding.spec
+    assert tuple(spec) == ("x", "y")
+    assert t._partition_spec == jax.sharding.PartitionSpec("x", "y")
+    # unknown dim errors
+    with pytest.raises(ValueError):
+        shard_tensor(t, pm, ["z", None])
+
+
+def test_reshard_moves_layout():
+    pm = ProcessMesh([0, 1, 2, 3, 4, 5, 6, 7], dim_names=["x"])
+    t = paddle.to_tensor(np.ones((8, 8), "float32"))
+    a = reshard(t, pm, ["x", None])
+    assert tuple(a._value.sharding.spec) in (("x",), ("x", None))
+    b = reshard(a, pm, [None, "x"])
+    spec_b = tuple(b._value.sharding.spec)
+    assert spec_b == (None, "x")
+    np.testing.assert_allclose(b.numpy(), t.numpy())
+
+
+def test_shard_op_annotates_output():
+    pm = ProcessMesh([0, 1, 2, 3, 4, 5, 6, 7], dim_names=["x"])
+
+    def f(a, b):
+        return a + b
+
+    sharded_f = shard_op(f, pm, out_shard_specs=[["x", None]])
+    out = sharded_f(paddle.to_tensor(np.ones((8, 4), "float32")),
+                    paddle.to_tensor(np.ones((8, 4), "float32")))
+    assert tuple(out._value.sharding.spec) in (("x",), ("x", None))
+
+
+def test_reshard_and_shard_op_preserve_grad():
+    """Sharding annotations ride the autograd tape (regression: fresh
+    Tensors severed it)."""
+    pm = ProcessMesh(list(range(8)), dim_names=["x"])
+    t = paddle.to_tensor(np.ones((8, 4), "float32"))
+    t.stop_gradient = False
+    out = reshard(t, pm, ["x", None])
+    (out * 3.0).sum().backward()
+    np.testing.assert_allclose(t.grad.numpy(), np.full((8, 4), 3.0))
+
+    t2 = paddle.to_tensor(np.ones((8, 4), "float32"))
+    t2.stop_gradient = False
+    f = shard_op(lambda a: a * 2.0, pm, out_shard_specs=[["x", None]])
+    (f(t2)).sum().backward()
+    np.testing.assert_allclose(t2.grad.numpy(), np.full((8, 4), 2.0))
+
+
+def test_kl_subclass_pairs_guarded():
+    from paddle_tpu.distribution import Normal, kl_divergence
+    from paddle_tpu.distribution.distributions import LogNormal
+    # same-type subclass pair works (invariant under shared bijection)
+    kl = kl_divergence(LogNormal(0.0, 1.0), LogNormal(1.0, 1.0))
+    assert float(kl.numpy()) == pytest.approx(0.5)
+    # mixed supports must refuse the base-class formula
+    with pytest.raises(NotImplementedError):
+        kl_divergence(LogNormal(0.0, 1.0), Normal(0.0, 1.0))
+
+
+def test_nan_check_skips_jit_tracers():
+    """FLAGS_check_nan_inf must not crash compiled steps (regression:
+    bool() on tracers)."""
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        mesh = dist.build_mesh([8], ["dp"])
+        paddle.seed(0)
+        net = nn.Linear(4, 4)
+        opt = paddle.optimizer.SGD(parameters=net.parameters(),
+                                   learning_rate=0.1)
+        step = dist.make_train_step(net, opt, nn.MSELoss(), mesh=mesh)
+        loss = step(np.ones((8, 4), "float32"), np.zeros((8, 4), "float32"))
+        assert np.isfinite(float(loss.numpy()))
+    finally:
+        paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+
+class _RegDataset(Dataset):
+    def __init__(self, n=64):
+        rng = np.random.default_rng(0)
+        self.x = rng.standard_normal((n, 16)).astype("float32")
+        w = rng.standard_normal((16, 8)).astype("float32") * 0.3
+        self.y = (self.x @ w).astype("float32")
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+def test_engine_fit_with_annotations():
+    pm = ProcessMesh([[0, 1, 2, 3], [4, 5, 6, 7]], dim_names=["dp", "mp"])
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 8))
+    # Megatron-style: first weight column-sharded, second row-sharded
+    shard_tensor(model[0].weight, pm, [None, "mp"])
+    shard_tensor(model[2].weight, pm, ["mp", None])
+
+    opt = paddle.optimizer.Adam(parameters=model.parameters(),
+                                learning_rate=1e-2)
+    engine = Engine(model=model, loss=nn.MSELoss(), optimizer=opt)
+    history = engine.fit(_RegDataset(), batch_size=16, epochs=4, verbose=0)
+    assert history["loss"][-1] < history["loss"][0] * 0.5
+
+    res = engine.evaluate(_RegDataset(32), batch_size=16, verbose=0)
+    assert res["loss"] is not None and np.isfinite(res["loss"])
+    outs = engine.predict(_RegDataset(16), batch_size=16, verbose=0)
+    assert outs[0].shape == (16, 8)
+
+
+def test_engine_matches_unsharded(tmp_path):
+    paddle.seed(3)
+    ds = _RegDataset(32)
+
+    def make(stage):
+        paddle.seed(3)
+        m = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 8))
+        o = paddle.optimizer.Adam(parameters=m.parameters(),
+                                  learning_rate=1e-2)
+        s = Strategy()
+        if stage:
+            s.sharding.enable = True
+            s.sharding.stage = stage
+        return Engine(model=m, loss=nn.MSELoss(), optimizer=o, strategy=s)
+
+    dist.set_global_mesh(dist.build_mesh([2, 4], ["dp", "sharding"]))
+    import random
+
+    def seeded_fit(engine):
+        random.seed(99)
+        np.random.seed(99)
+        paddle.seed(99)
+        return engine.fit(ds, batch_size=16, epochs=2, verbose=0)
+
+    h0 = seeded_fit(make(0))
+    h2 = seeded_fit(make(2))
+    np.testing.assert_allclose(h2["loss"], h0["loss"], rtol=1e-4)
+
+    # save/load roundtrip
+    e = make(0)
+    e.fit(ds, batch_size=16, epochs=1, verbose=0)
+    path = str(tmp_path / "ap" / "model")
+    e.save(path)
+    e2 = make(0)
+    e2.load(path)
+    sd1 = e._model.state_dict()
+    sd2 = e2._model.state_dict()
+    for k in sd1:
+        np.testing.assert_allclose(sd1[k].numpy(), sd2[k].numpy())
