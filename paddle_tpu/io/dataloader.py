@@ -86,11 +86,20 @@ def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_id,
             else:
                 samples = [dataset[i] for i in indices]
             data_queue.put((batch_id, None, collate_fn(samples)))
+        except BrokenPipeError:  # shm ring closed by parent shutdown
+            break
         except Exception:  # noqa: BLE001
-            data_queue.put((batch_id, RuntimeError(traceback.format_exc()), None))
+            try:
+                data_queue.put((batch_id,
+                                RuntimeError(traceback.format_exc()), None))
+            except BrokenPipeError:
+                break
 
 
 class _SingleProcessIter:
+    def __iter__(self):
+        return self
+
     def __init__(self, loader):
         self.loader = loader
         ds = loader.dataset
@@ -114,13 +123,55 @@ class _SingleProcessIter:
         return _to_tensor_nest(batch, loader.return_list)
 
 
+class _ShmDataQueue:
+    """mp.Queue-compatible (put/get of (bid, err, batch)) over the native
+    shared-memory ring (csrc/shm_ring.cpp): numpy batch payloads cross the
+    process boundary without pickling — the reference's shared-memory tensor
+    path (use_shared_memory, dataloader_iter.py)."""
+
+    _EXC_KEY = "__pt_exc__"
+
+    def __init__(self, capacity=64 << 20):
+        from .shm_channel import ShmQueue
+        self._q = ShmQueue(capacity=capacity)
+
+    def put(self, item):
+        from .shm_channel import encode_batch
+        bid, err, batch = item
+        if err is None:
+            # encode_batch keeps the container (tuple/list/bare array) and
+            # falls back to pickle for anything non-array
+            self._q.put(encode_batch(bid, batch))
+        else:
+            self._q.put(encode_batch(bid, {self._EXC_KEY: err,
+                                           "batch": batch}))
+
+    def get(self):
+        from .shm_channel import decode_batch
+        bid, payload = decode_batch(self._q.get())
+        if isinstance(payload, dict) and self._EXC_KEY in payload:
+            return bid, payload[self._EXC_KEY], payload.get("batch")
+        return bid, None, payload
+
+    def close(self):
+        self._q.close()
+        self._q.free()
+
+
 class _MultiProcessIter:
     def __init__(self, loader):
         self.loader = loader
         self.num_workers = loader.num_workers
         ctx = mp.get_context("fork")
         self.index_queues = [ctx.Queue() for _ in range(self.num_workers)]
-        self.data_queue = ctx.Queue()
+        self.data_queue = None
+        if loader.use_shared_memory:
+            from . import shm_channel
+            if shm_channel.available():
+                self.data_queue = _ShmDataQueue(
+                    capacity=loader.shm_ring_capacity)
+        if self.data_queue is None:
+            self.data_queue = ctx.Queue()
         seed = np.random.randint(0, 2 ** 31)
         self.workers = []
         for wid in range(self.num_workers):
@@ -181,12 +232,19 @@ class _MultiProcessIter:
                 raise err
             return _to_tensor_nest(batch, self.loader.return_list)
 
+    def __iter__(self):
+        return self
+
     def _shutdown(self):
         for q in self.index_queues:
             try:
                 q.put(None)
             except Exception:  # noqa: BLE001
                 pass
+        # close the ring FIRST so writers blocked on a full ring wake with
+        # BrokenPipeError and exit cleanly instead of being SIGTERM'd
+        if isinstance(self.data_queue, _ShmDataQueue):
+            self.data_queue.close()
         for w in self.workers:
             w.join(timeout=1)
             if w.is_alive():
@@ -203,13 +261,16 @@ class DataLoader:
                  batch_sampler=None, batch_size=1, shuffle=False, drop_last=False,
                  collate_fn=None, num_workers=0, use_buffer_reader=True,
                  prefetch_factor=2, use_shared_memory=True, timeout=0,
-                 worker_init_fn=None, persistent_workers=False):
+                 worker_init_fn=None, persistent_workers=False,
+                 shm_ring_capacity=64 << 20):
         self.dataset = dataset
         self.return_list = return_list
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.batch_size = batch_size
         self.prefetch_factor = max(1, int(prefetch_factor))
+        self.use_shared_memory = use_shared_memory
+        self.shm_ring_capacity = shm_ring_capacity
         self.worker_init_fn = worker_init_fn
         if batch_sampler is not None:
             self.batch_sampler = batch_sampler

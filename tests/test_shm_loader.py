@@ -1,0 +1,111 @@
+"""Native shm-ring DataLoader tests (reference: use_shared_memory worker
+transfer, dataloader_iter.py)."""
+import multiprocessing as mp
+
+import numpy as np
+import pytest
+
+from paddle_tpu.io import DataLoader, Dataset
+from paddle_tpu.io.shm_channel import (ShmQueue, available, decode_batch,
+                                       encode_batch)
+
+pytestmark = pytest.mark.skipif(not available(),
+                                reason="no C++ toolchain for shm ring")
+
+
+def test_codec_roundtrip():
+    arrs = [np.arange(12, dtype="float32").reshape(3, 4),
+            np.array([7], "int64"), np.zeros((), "float64")]
+    bid, out = decode_batch(encode_batch(3, arrs))
+    assert bid == 3
+    assert isinstance(out, list)  # container preserved
+    for a, b in zip(arrs, out):
+        np.testing.assert_array_equal(a, b)
+        assert a.dtype == b.dtype
+    # tuple container preserved
+    _, out_t = decode_batch(encode_batch(4, tuple(arrs)))
+    assert isinstance(out_t, tuple)
+    # single bare ndarray stays bare (the common plain-array dataset shape)
+    _, single = decode_batch(encode_batch(5, arrs[0]))
+    assert isinstance(single, np.ndarray)
+    np.testing.assert_array_equal(single, arrs[0])
+    # object-dtype arrays take the pickle path (raw pointers must never
+    # cross the process boundary)
+    obj_arr = np.array([None, "x"], dtype=object)
+    _, out_o = decode_batch(encode_batch(6, [obj_arr]))
+    assert out_o[0].tolist() == [None, "x"]
+    # pickle fallback
+    bid, obj = decode_batch(encode_batch(9, {"k": [1, 2]}))
+    assert bid == 9 and obj == {"k": [1, 2]}
+
+
+def test_ring_blocking_backpressure():
+    q = ShmQueue(capacity=1 << 11)  # tiny ring: holds exactly one message
+    msg = encode_batch(0, [np.zeros(450, "float32")])
+    q.put(msg)
+    # second write would overflow → times out rather than corrupting
+    with pytest.raises(TimeoutError):
+        q.put(msg, timeout_ms=200)
+    _ = q.get()
+    q.put(msg, timeout_ms=200)  # space reclaimed
+    q.close()
+    q.free()
+
+
+def test_oversized_message_rejected():
+    q = ShmQueue(capacity=1 << 12)
+    with pytest.raises(ValueError):
+        q.put(b"x" * (1 << 13))
+    q.close()
+    q.free()
+
+
+class _DS(Dataset):
+    def __init__(self, n=64):
+        self.n = n
+
+    def __getitem__(self, i):
+        return (np.full((4, 4), i, "float32"),
+                np.array([i], "int64"))
+
+    def __len__(self):
+        return self.n
+
+
+def test_dataloader_workers_over_shm():
+    loader = DataLoader(_DS(), batch_size=8, num_workers=2, shuffle=False,
+                        use_shared_memory=True)
+    it = iter(loader)
+    from paddle_tpu.io.dataloader import _ShmDataQueue
+    assert isinstance(it.data_queue, _ShmDataQueue)
+    seen = []
+    for xb, yb in it:
+        assert tuple(xb.shape) == (8, 4, 4)
+        seen.extend(int(v) for v in yb.numpy().ravel())
+    assert seen == list(range(64))
+
+
+def test_dataloader_shm_propagates_worker_error():
+    class Bad(Dataset):
+        def __getitem__(self, i):
+            if i == 5:
+                raise ValueError("boom at 5")
+            return np.zeros(2, "float32")
+
+        def __len__(self):
+            return 8
+
+    loader = DataLoader(Bad(), batch_size=4, num_workers=1,
+                        use_shared_memory=True)
+    with pytest.raises(RuntimeError, match="boom at 5"):
+        list(loader)
+
+
+def test_dataloader_matches_single_process():
+    ref = [b for b in DataLoader(_DS(32), batch_size=8, num_workers=0)]
+    shm = [b for b in DataLoader(_DS(32), batch_size=8, num_workers=2,
+                                 use_shared_memory=True)]
+    assert len(ref) == len(shm)
+    for (x1, y1), (x2, y2) in zip(ref, shm):
+        np.testing.assert_array_equal(x1.numpy(), x2.numpy())
+        np.testing.assert_array_equal(y1.numpy(), y2.numpy())
