@@ -63,8 +63,7 @@ void ring_copy_out(Handle* hd, uint8_t* dst, uint64_t n) {
   h->used -= n;
 }
 
-int wait_ms(pthread_cond_t* cv, pthread_mutex_t* mu, int timeout_ms) {
-  if (timeout_ms < 0) return pthread_cond_wait(cv, mu);
+struct timespec deadline_from_ms(int timeout_ms) {
   struct timespec ts;
   clock_gettime(CLOCK_REALTIME, &ts);
   ts.tv_sec += timeout_ms / 1000;
@@ -73,7 +72,23 @@ int wait_ms(pthread_cond_t* cv, pthread_mutex_t* mu, int timeout_ms) {
     ts.tv_sec += 1;
     ts.tv_nsec -= 1000000000L;
   }
-  return pthread_cond_timedwait(cv, mu, &ts);
+  return ts;
+}
+
+// absolute deadline so repeated wakeups can't extend the timeout
+int wait_until(pthread_cond_t* cv, pthread_mutex_t* mu, int timeout_ms,
+               const struct timespec* deadline) {
+  if (timeout_ms < 0) return pthread_cond_wait(cv, mu);
+  return pthread_cond_timedwait(cv, mu, deadline);
+}
+
+// a peer died holding the lock: the ring byte-state (length prefixes,
+// head/tail/used) can no longer be trusted — poison the ring
+void poison(Header* h) {
+  pthread_mutex_consistent(&h->mu);
+  h->closed = 1;
+  pthread_cond_broadcast(&h->can_read);
+  pthread_cond_broadcast(&h->can_write);
 }
 
 }  // namespace
@@ -139,13 +154,17 @@ void* shmring_open(const char* name) {
   return hd;
 }
 
-static int lock_robust(pthread_mutex_t* mu) {
-  int rc = pthread_mutex_lock(mu);
-  if (rc == EOWNERDEAD) {  // a worker died holding the lock
-    pthread_mutex_consistent(mu);
-    rc = 0;
+// returns 0 when the lock is held and the ring is trustworthy; -1 after a
+// peer died holding it (ring is poisoned, caller must bail but the mutex IS
+// held when -1 from EOWNERDEAD... so callers unlock)
+static int lock_robust(Header* h) {
+  int rc = pthread_mutex_lock(&h->mu);
+  if (rc == EOWNERDEAD) {
+    poison(h);
+    pthread_mutex_unlock(&h->mu);
+    return -1;
   }
-  return rc;
+  return rc == 0 ? 0 : -1;
 }
 
 // write one message (length-prefixed); blocks while the ring is full.
@@ -155,14 +174,19 @@ int shmring_write(void* vh, const void* buf, uint64_t n, int timeout_ms) {
   Header* h = hd->h;
   uint64_t need = n + 8;
   if (need > h->capacity) return -3;
-  if (lock_robust(&h->mu) != 0) return -1;
+  if (lock_robust(h) != 0) return -1;
+  struct timespec dl = deadline_from_ms(timeout_ms < 0 ? 0 : timeout_ms);
   while (!h->closed && h->capacity - h->used < need) {
-    int rc = wait_ms(&h->can_write, &h->mu, timeout_ms);
+    int rc = wait_until(&h->can_write, &h->mu, timeout_ms, &dl);
     if (rc == ETIMEDOUT) {
       pthread_mutex_unlock(&h->mu);
       return -2;
     }
-    if (rc == EOWNERDEAD) pthread_mutex_consistent(&h->mu);
+    if (rc == EOWNERDEAD) {
+      poison(h);
+      pthread_mutex_unlock(&h->mu);
+      return -1;
+    }
   }
   if (h->closed) {
     pthread_mutex_unlock(&h->mu);
@@ -183,14 +207,19 @@ long long shmring_read(void* vh, void* buf, uint64_t cap, int timeout_ms,
                        uint64_t* need_out) {
   auto* hd = static_cast<Handle*>(vh);
   Header* h = hd->h;
-  if (lock_robust(&h->mu) != 0) return -1;
+  if (lock_robust(h) != 0) return -1;
+  struct timespec dl = deadline_from_ms(timeout_ms < 0 ? 0 : timeout_ms);
   while (!h->closed && h->used < 8) {
-    int rc = wait_ms(&h->can_read, &h->mu, timeout_ms);
+    int rc = wait_until(&h->can_read, &h->mu, timeout_ms, &dl);
     if (rc == ETIMEDOUT) {
       pthread_mutex_unlock(&h->mu);
       return -2;
     }
-    if (rc == EOWNERDEAD) pthread_mutex_consistent(&h->mu);
+    if (rc == EOWNERDEAD) {
+      poison(h);
+      pthread_mutex_unlock(&h->mu);
+      return -1;
+    }
   }
   if (h->used < 8) {  // closed and drained
     pthread_mutex_unlock(&h->mu);
@@ -220,7 +249,7 @@ long long shmring_read(void* vh, void* buf, uint64_t cap, int timeout_ms,
 void shmring_close(void* vh) {
   auto* hd = static_cast<Handle*>(vh);
   Header* h = hd->h;
-  if (lock_robust(&h->mu) == 0) {
+  if (lock_robust(h) == 0) {
     h->closed = 1;
     pthread_cond_broadcast(&h->can_read);
     pthread_cond_broadcast(&h->can_write);

@@ -12,9 +12,6 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
-import sys
-import tempfile
 import threading
 import time
 
@@ -24,25 +21,11 @@ _LIB_ERR = None
 
 def _build_lib():
     """Compile csrc/tcp_store.cpp into a cached shared object."""
+    from ..utils.native_build import build_native_lib
+
     src = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "csrc", "tcp_store.cpp")
-    cache_dir = os.environ.get(
-        "PADDLE_TPU_BUILD_DIR",
-        os.path.join(tempfile.gettempdir(),
-                     f"paddle_tpu_build_{os.getuid()}"))
-    os.makedirs(cache_dir, exist_ok=True)
-    so = os.path.join(cache_dir, "libtcp_store.so")
-    if os.path.exists(so) and os.path.getmtime(so) >= os.path.getmtime(src):
-        return so
-    cxx = os.environ.get("CXX", "g++")
-    # per-pid temp + atomic replace: concurrent ranks may all compile on a
-    # cold cache; each produces a valid .so and the replace is atomic
-    tmp = f"{so}.{os.getpid()}.tmp"
-    cmd = [cxx, "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
-           src, "-o", tmp]
-    subprocess.run(cmd, check=True, capture_output=True)
-    os.replace(tmp, so)
-    return so
+    return build_native_lib(src, "libtcp_store.so")
 
 
 def _lib():

@@ -21,25 +21,11 @@ _LIB_ERR = None
 
 
 def _build():
-    import subprocess
-    import tempfile
+    from ..utils.native_build import build_native_lib
 
     src = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "csrc", "shm_ring.cpp")
-    cache_dir = os.environ.get(
-        "PADDLE_TPU_BUILD_DIR",
-        os.path.join(tempfile.gettempdir(),
-                     f"paddle_tpu_build_{os.getuid()}"))
-    os.makedirs(cache_dir, exist_ok=True)
-    so = os.path.join(cache_dir, "libshm_ring.so")
-    if os.path.exists(so) and os.path.getmtime(so) >= os.path.getmtime(src):
-        return so
-    tmp = f"{so}.{os.getpid()}.tmp"
-    cxx = os.environ.get("CXX", "g++")
-    subprocess.run([cxx, "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
-                    src, "-o", tmp, "-lrt"], check=True, capture_output=True)
-    os.replace(tmp, so)
-    return so
+    return build_native_lib(src, "libshm_ring.so", extra_flags=("-lrt",))
 
 
 def _lib():
@@ -167,6 +153,8 @@ class ShmQueue:
         return ShmQueue(name=self.name, create=False)
 
     def put(self, data: bytes, timeout_ms=-1):
+        if self._closed or not self._h:
+            raise BrokenPipeError("shm ring closed")
         rc = _lib().shmring_write(self._h, data, len(data), timeout_ms)
         if rc == -3:
             raise ValueError(
@@ -178,6 +166,8 @@ class ShmQueue:
             raise BrokenPipeError("shm ring closed")
 
     def get(self, timeout_ms=-1) -> bytes:
+        if self._closed or not self._h:
+            raise BrokenPipeError("shm ring closed")
         cap = 1 << 20
         need = ctypes.c_uint64(0)
         while True:
@@ -191,7 +181,7 @@ class ShmQueue:
                 raise TimeoutError("shm ring read timed out")
             if n < 0:
                 raise BrokenPipeError("shm ring closed")
-            return buf.raw[:n]
+            return ctypes.string_at(buf, n)
 
     def close(self):
         if not self._closed and self._h:

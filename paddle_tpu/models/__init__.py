@@ -1,5 +1,18 @@
-"""Flagship model families built on the framework (GPT first; BERT/ERNIE,
+"""Flagship model families built on the framework (GPT, BERT/ERNIE;
 vision detection configs follow the same pattern)."""
+from .bert import (  # noqa: F401
+    BERT_CONFIGS,
+    BertConfig,
+    BertForPretraining,
+    BertModel,
+    BertPretrainingCriterion,
+    ErnieConfig,
+    ErnieForPretraining,
+    ErnieModel,
+    bert_config,
+    build_bert,
+    build_ernie,
+)
 from .gpt import (  # noqa: F401
     GPT_CONFIGS,
     GPTConfig,

@@ -1,0 +1,10 @@
+"""paddle.utils parity surface (native build helper, cpp_extension later)."""
+from .native_build import build_native_lib, get_build_directory  # noqa: F401
+
+
+def try_import(name):
+    import importlib
+    try:
+        return importlib.import_module(name)
+    except ImportError:
+        return None

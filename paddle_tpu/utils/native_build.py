@@ -1,0 +1,40 @@
+"""Shared compile-and-cache recipe for the framework's native C++ pieces
+(TCP store, shm ring, user cpp_extension ops): mtime-checked cache dir,
+per-pid temp output, atomic publish — safe under concurrent ranks."""
+from __future__ import annotations
+
+import os
+import subprocess
+import tempfile
+
+
+def get_build_directory() -> str:
+    """utils/cpp_extension.get_build_directory parity."""
+    return os.environ.get(
+        "PADDLE_TPU_BUILD_DIR",
+        os.path.join(tempfile.gettempdir(),
+                     f"paddle_tpu_build_{os.getuid()}"))
+
+
+def build_native_lib(src_path: str, so_name: str,
+                     extra_flags: tuple = ()) -> str:
+    """Compile `src_path` into <build_dir>/<so_name>; returns the .so path.
+    Rebuilds only when the source is newer than the cached artifact."""
+    cache_dir = get_build_directory()
+    os.makedirs(cache_dir, exist_ok=True)
+    so = os.path.join(cache_dir, so_name)
+    if os.path.exists(so) and os.path.getmtime(so) >= \
+            os.path.getmtime(src_path):
+        return so
+    tmp = f"{so}.{os.getpid()}.tmp"
+    cxx = os.environ.get("CXX", "g++")
+    cmd = [cxx, "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           src_path, "-o", tmp, *extra_flags]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True)
+    except subprocess.CalledProcessError as e:
+        raise RuntimeError(
+            f"native build failed: {' '.join(cmd)}\n"
+            f"{e.stderr.decode(errors='replace')[-2000:]}") from None
+    os.replace(tmp, so)
+    return so

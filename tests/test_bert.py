@@ -1,0 +1,153 @@
+"""BERT/ERNIE family tests (model zoo contract: shapes, masking, training
+convergence, TP parity on the 8-device CPU mesh)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import fleet
+from paddle_tpu.models import (BertConfig, BertForPretraining, BertModel,
+                               BertPretrainingCriterion, bert_config,
+                               build_bert, build_ernie)
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    yield
+    dist.collective.destroy_process_group()
+    dist.set_global_mesh(None)
+    dist.set_hybrid_communicate_group(None)
+    fleet._hcg = None
+    fleet._is_initialized = False
+
+
+def _ids(b=2, t=16, vocab=1024, seed=0):
+    return np.random.RandomState(seed).randint(0, vocab, (b, t)).astype(
+        "int64")
+
+
+def test_bert_model_shapes():
+    paddle.seed(0)
+    model = build_bert("bert-tiny", for_pretraining=False)
+    model.eval()
+    seq, pooled = model(paddle.to_tensor(_ids()))
+    assert tuple(seq.shape) == (2, 16, 128)
+    assert tuple(pooled.shape) == (2, 128)
+
+
+def test_bert_attention_mask_effect():
+    """Padded positions must not influence unmasked outputs."""
+    paddle.seed(0)
+    model = build_bert("bert-tiny", for_pretraining=False)
+    model.eval()
+    ids = _ids(1, 8)
+    mask_full = np.ones((1, 8), "int64")
+    seq_full, _ = model(paddle.to_tensor(ids),
+                        attention_mask=paddle.to_tensor(mask_full))
+    # garble the last 3 tokens but mask them out
+    ids2 = ids.copy()
+    ids2[:, 5:] = 7
+    mask = np.ones((1, 8), "int64")
+    mask[:, 5:] = 0
+    seq_a, _ = model(paddle.to_tensor(ids),
+                     attention_mask=paddle.to_tensor(mask))
+    seq_b, _ = model(paddle.to_tensor(ids2),
+                     attention_mask=paddle.to_tensor(mask))
+    np.testing.assert_allclose(seq_a.numpy()[:, :5], seq_b.numpy()[:, :5],
+                               rtol=1e-4, atol=1e-5)
+    # and masking changes results vs full attention
+    assert np.abs(seq_a.numpy()[:, :5] - seq_full.numpy()[:, :5]).max() > 1e-4
+
+
+def test_bert_pretraining_heads_and_loss():
+    paddle.seed(1)
+    model = build_bert("bert-tiny")
+    crit = BertPretrainingCriterion()
+    ids = _ids(2, 16)
+    labels = ids.copy()
+    labels[:, ::2] = -100  # only odd positions supervised
+    nsp_labels = np.array([0, 1], "int64")
+    mlm_logits, nsp_logits = model(paddle.to_tensor(ids))
+    assert tuple(mlm_logits.shape) == (2, 16, 1024)
+    assert tuple(nsp_logits.shape) == (2, 2)
+    loss = crit(mlm_logits, nsp_logits, paddle.to_tensor(labels),
+                paddle.to_tensor(nsp_labels))
+    assert np.isfinite(float(loss.numpy()))
+
+    # ignore_index: all-masked labels give ~log-uniform from nsp only
+    all_ignored = np.full_like(labels, -100)
+    loss2 = crit(mlm_logits, nsp_logits, paddle.to_tensor(all_ignored),
+                 paddle.to_tensor(nsp_labels))
+    assert float(loss2.numpy()) < float(loss.numpy())
+
+
+def test_bert_trains():
+    paddle.seed(2)
+    model = build_bert("bert-tiny", hidden_dropout_prob=0.0,
+                       attention_dropout_prob=0.0)
+    crit = BertPretrainingCriterion()
+    opt = paddle.optimizer.AdamW(parameters=model.parameters(),
+                                 learning_rate=1e-3, weight_decay=0.01)
+    ids = _ids(4, 32)
+    labels = ids.copy()
+    losses = []
+    for _ in range(20):
+        mlm, nsp = model(paddle.to_tensor(ids))
+        loss = crit(mlm, nsp, paddle.to_tensor(labels))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0] * 0.7
+
+
+def test_ernie_task_type_embedding():
+    paddle.seed(3)
+    model = build_ernie("ernie-3.0-medium", for_pretraining=False,
+                        vocab_size=512, hidden_size=64, num_layers=2,
+                        num_attention_heads=2, intermediate_size=128,
+                        max_position_embeddings=64)
+    model.eval()
+    ids = _ids(2, 8, vocab=512)
+    task_ids = np.zeros((2, 8), "int64")
+    seq0, _ = model(paddle.to_tensor(ids),
+                    task_type_ids=paddle.to_tensor(task_ids))
+    seq1, _ = model(paddle.to_tensor(ids),
+                    task_type_ids=paddle.to_tensor(task_ids + 1))
+    # a different task id changes the representation
+    assert np.abs(seq0.numpy() - seq1.numpy()).max() > 1e-4
+
+
+def test_bert_sharded_train_step_compiles():
+    """BERT through the SPMD step on a dp x mp mesh."""
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": 2, "mp_degree": 2, "pp_degree": 1,
+                        "sharding_degree": 2}
+    fleet.init(is_collective=True, strategy=s)
+    hcg = fleet.get_hybrid_communicate_group()
+    mesh = hcg.get_mesh()
+
+    paddle.seed(4)
+    model = build_bert("bert-tiny", hidden_dropout_prob=0.0,
+                       attention_dropout_prob=0.0)
+
+    class _Crit(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.crit = BertPretrainingCriterion()
+
+        def forward(self, outs, labels):
+            mlm, nsp = outs
+            return self.crit(mlm, nsp, labels)
+
+    opt = paddle.optimizer.AdamW(parameters=model.parameters(),
+                                 learning_rate=1e-4)
+    step = dist.make_train_step(model, opt, loss_fn=_Crit(), mesh=mesh,
+                                sharding_stage=2)
+    ids = _ids(8, 16)
+    loss = step(ids, ids.copy())
+    assert np.isfinite(float(loss.numpy()))
