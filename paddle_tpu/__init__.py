@@ -47,6 +47,10 @@ from . import inference  # noqa: E402,F401
 from . import profiler  # noqa: E402,F401
 from . import distribution  # noqa: E402,F401
 from .flags import get_flags, set_flags  # noqa: E402,F401
+from . import text  # noqa: E402,F401
+from . import audio  # noqa: E402,F401
+from . import sparse  # noqa: E402,F401
+from . import utils  # noqa: E402,F401
 from .hapi import Model  # noqa: E402,F401
 from . import callbacks  # noqa: E402,F401
 from .distributed.parallel import DataParallel  # noqa: E402,F401

@@ -1,0 +1,9 @@
+"""paddle.audio parity (python/paddle/audio/): spectral features over the
+framework's fft ops (SURVEY §2.3 audio: Spectrogram/MelSpectrogram/MFCC)."""
+from . import functional  # noqa: F401
+from .features import (  # noqa: F401
+    LogMelSpectrogram,
+    MFCC,
+    MelSpectrogram,
+    Spectrogram,
+)

@@ -1,0 +1,122 @@
+"""paddle.audio.features layers (python/paddle/audio/features/layers.py):
+Spectrogram, MelSpectrogram, LogMelSpectrogram, MFCC — STFT via jnp fft
+(MXU-friendly framing matmul + rfft)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.op import apply_op
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer
+from . import functional as AF
+
+
+def _frame_stft(x, n_fft, hop_length, win, center, pad_mode, power):
+    """x: [..., T] → power spectrogram [..., 1 + n_fft//2, frames]."""
+    if center:
+        pad = [(0, 0)] * (x.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+        x = jnp.pad(x, pad, mode=pad_mode)
+    t = x.shape[-1]
+    n_frames = 1 + (t - n_fft) // hop_length
+    idx = (jnp.arange(n_frames)[:, None] * hop_length +
+           jnp.arange(n_fft)[None, :])  # [frames, n_fft]
+    frames = x[..., idx]  # [..., frames, n_fft]
+    frames = frames * win[None, :]
+    spec = jnp.fft.rfft(frames, n=n_fft, axis=-1)
+    mag = jnp.abs(spec)
+    if power is not None:
+        mag = mag ** power
+    return jnp.swapaxes(mag, -1, -2)  # [..., freq, frames]
+
+
+class Spectrogram(Layer):
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        w = AF.get_window(window, self.win_length, dtype=dtype)._value
+        if self.win_length < n_fft:  # center-pad the window to n_fft
+            lpad = (n_fft - self.win_length) // 2
+            w = jnp.pad(w, (lpad, n_fft - self.win_length - lpad))
+        self._window = w.astype(dtype)
+
+    def forward(self, x):
+        win, n_fft, hop = self._window, self.n_fft, self.hop_length
+        center, pad_mode, power = self.center, self.pad_mode, self.power
+
+        def raw(v):
+            return _frame_stft(v, n_fft, hop, win, center, pad_mode,
+                               power).astype(v.dtype)
+
+        return apply_op(raw, "spectrogram", (x,), {})
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 dtype="float32"):
+        super().__init__()
+        self._spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                        window, power, center, pad_mode,
+                                        dtype)
+        self._fbank = AF.compute_fbank_matrix(
+            sr, n_fft, n_mels, f_min, f_max, htk, norm,
+            dtype)._value.astype(dtype)
+
+    def forward(self, x):
+        spec = self._spectrogram(x)
+        fb = self._fbank
+
+        def raw(s):
+            return jnp.einsum("mf,...ft->...mt", fb.astype(s.dtype), s)
+
+        return apply_op(raw, "mel_spectrogram", (spec,), {})
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 ref_value=1.0, amin=1e-10, top_db=None, dtype="float32"):
+        super().__init__()
+        self._melspectrogram = MelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, dtype)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        mel = self._melspectrogram(x)
+        return AF.power_to_db(mel, self.ref_value, self.amin, self.top_db)
+
+
+class MFCC(Layer):
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
+                 top_db=None, dtype="float32"):
+        super().__init__()
+        self._log_melspectrogram = LogMelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, ref_value, amin,
+            top_db, dtype)
+        self._dct = AF.create_dct(n_mfcc, n_mels,
+                                  dtype=dtype)._value.astype(dtype)
+
+    def forward(self, x):
+        logmel = self._log_melspectrogram(x)
+        dct = self._dct
+
+        def raw(m):
+            return jnp.einsum("mk,...mt->...kt", dct.astype(m.dtype), m)
+
+        return apply_op(raw, "mfcc", (logmel,), {})

@@ -1,0 +1,125 @@
+"""paddle.audio.functional parity: windows, mel scales, dct matrices
+(python/paddle/audio/functional/)."""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def get_window(window, win_length, fftbins=True, dtype="float64"):
+    """functional/window.py parity (hann/hamming/blackman/...)."""
+    if isinstance(window, tuple):
+        name, *args = window
+    else:
+        name, args = window, []
+    n = win_length
+    m = n if fftbins else n - 1
+    x = np.arange(n)
+    if name in ("hann", "hanning"):
+        w = 0.5 - 0.5 * np.cos(2 * np.pi * x / m)
+    elif name == "hamming":
+        w = 0.54 - 0.46 * np.cos(2 * np.pi * x / m)
+    elif name == "blackman":
+        w = (0.42 - 0.5 * np.cos(2 * np.pi * x / m) +
+             0.08 * np.cos(4 * np.pi * x / m))
+    elif name == "bohman":
+        fac = np.abs(2 * x / m - 1)
+        w = (1 - fac) * np.cos(np.pi * fac) + np.sin(np.pi * fac) / np.pi
+    elif name == "rect" or name == "boxcar":
+        w = np.ones(n)
+    elif name == "gaussian":
+        std = args[0] if args else 0.4 * n
+        w = np.exp(-0.5 * ((x - m / 2) / std) ** 2)
+    elif name == "triang":
+        w = 1 - np.abs(2 * x / m - 1)
+    else:
+        raise ValueError(f"unknown window {name!r}")
+    return Tensor(jnp.asarray(w.astype(dtype)), _internal=True)
+
+
+def hz_to_mel(freq, htk=False):
+    if htk:
+        return 2595.0 * np.log10(1.0 + np.asarray(freq) / 700.0)
+    f = np.asarray(freq, dtype="float64")
+    f_sp = 200.0 / 3
+    mels = f / f_sp
+    min_log_hz = 1000.0
+    min_log_mel = min_log_hz / f_sp
+    logstep = math.log(6.4) / 27.0
+    return np.where(f >= min_log_hz,
+                    min_log_mel + np.log(np.maximum(f, 1e-10) /
+                                         min_log_hz) / logstep, mels)
+
+
+def mel_to_hz(mel, htk=False):
+    if htk:
+        return 700.0 * (10.0 ** (np.asarray(mel) / 2595.0) - 1.0)
+    m = np.asarray(mel, dtype="float64")
+    f_sp = 200.0 / 3
+    freqs = m * f_sp
+    min_log_hz = 1000.0
+    min_log_mel = min_log_hz / f_sp
+    logstep = math.log(6.4) / 27.0
+    return np.where(m >= min_log_mel,
+                    min_log_hz * np.exp(logstep * (m - min_log_mel)), freqs)
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False,
+                    dtype="float64"):
+    mels = np.linspace(hz_to_mel(f_min, htk), hz_to_mel(f_max, htk), n_mels)
+    return Tensor(jnp.asarray(mel_to_hz(mels, htk).astype(dtype)),
+                  _internal=True)
+
+
+def fft_frequencies(sr, n_fft, dtype="float64"):
+    return Tensor(jnp.asarray(
+        np.linspace(0, sr / 2, 1 + n_fft // 2).astype(dtype)),
+        _internal=True)
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney", dtype="float64"):
+    """Triangular mel filterbank [n_mels, 1 + n_fft//2]."""
+    f_max = f_max or sr / 2.0
+    fftfreqs = np.linspace(0, sr / 2, 1 + n_fft // 2)
+    melpts = mel_to_hz(np.linspace(hz_to_mel(f_min, htk),
+                                   hz_to_mel(f_max, htk), n_mels + 2), htk)
+    fdiff = np.diff(melpts)
+    ramps = melpts[:, None] - fftfreqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = np.maximum(0, np.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (melpts[2:n_mels + 2] - melpts[:n_mels])
+        weights *= enorm[:, None]
+    return Tensor(jnp.asarray(weights.astype(dtype)), _internal=True)
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float64"):
+    """DCT-II matrix [n_mels, n_mfcc]."""
+    n = np.arange(n_mels)
+    k = np.arange(n_mfcc)[None, :]
+    dct = np.cos(np.pi / n_mels * (n[:, None] + 0.5) * k)
+    if norm == "ortho":
+        dct[:, 0] *= 1.0 / math.sqrt(2)
+        dct *= math.sqrt(2.0 / n_mels)
+    else:
+        dct *= 2.0
+    return Tensor(jnp.asarray(dct.astype(dtype)), _internal=True)
+
+
+def power_to_db(magnitude, ref_value=1.0, amin=1e-10, top_db=80.0):
+    from ..core.op import apply_op
+
+    def raw(x):
+        log_spec = 10.0 * (jnp.log10(jnp.maximum(x, amin)) -
+                           jnp.log10(max(ref_value, amin)))
+        if top_db is not None:
+            log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+        return log_spec
+
+    return apply_op(raw, "power_to_db", (magnitude,), {})

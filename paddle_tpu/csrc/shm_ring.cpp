@@ -82,11 +82,15 @@ int wait_until(pthread_cond_t* cv, pthread_mutex_t* mu, int timeout_ms,
   return pthread_cond_timedwait(cv, mu, deadline);
 }
 
+// closed states: 0 = open, 1 = graceful close (readers may drain),
+// 2 = poisoned (byte-state untrustworthy — nobody drains)
+constexpr uint32_t kClosed = 1, kPoisoned = 2;
+
 // a peer died holding the lock: the ring byte-state (length prefixes,
-// head/tail/used) can no longer be trusted — poison the ring
+// head/tail/used) can no longer be trusted
 void poison(Header* h) {
   pthread_mutex_consistent(&h->mu);
-  h->closed = 1;
+  h->closed = kPoisoned;
   pthread_cond_broadcast(&h->can_read);
   pthread_cond_broadcast(&h->can_write);
 }
@@ -221,7 +225,8 @@ long long shmring_read(void* vh, void* buf, uint64_t cap, int timeout_ms,
       return -1;
     }
   }
-  if (h->used < 8) {  // closed and drained
+  if (h->closed == kPoisoned || h->used < 8) {
+    // poisoned bytes must never be drained; graceful close drains
     pthread_mutex_unlock(&h->mu);
     return -1;
   }
@@ -250,7 +255,7 @@ void shmring_close(void* vh) {
   auto* hd = static_cast<Handle*>(vh);
   Header* h = hd->h;
   if (lock_robust(h) == 0) {
-    h->closed = 1;
+    if (h->closed == 0) h->closed = kClosed;  // never mask a poisoned state
     pthread_cond_broadcast(&h->can_read);
     pthread_cond_broadcast(&h->can_write);
     pthread_mutex_unlock(&h->mu);

@@ -1,4 +1,5 @@
 """paddle.incubate parity namespace (SURVEY §2.3 incubate: MoE expert
 parallelism, fused nn layers, distributed models)."""
+from . import autograd  # noqa: F401
 from . import distributed  # noqa: F401
 from . import nn  # noqa: F401

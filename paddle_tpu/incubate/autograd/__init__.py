@@ -1,0 +1,169 @@
+"""paddle.incubate.autograd parity — higher-order/functional AD
+(incubate/autograd: primrules.py/primx.py prim system, primapi.py, and the
+functional Jacobian/Hessian/jvp/vjp API).
+
+The reference lowers ops to primitive pairs (orig2prim/prim2orig) to get
+transposable linearizations; jax's jvp/vjp/jacobian transforms ARE that
+machinery, so this module is a thin functional surface over them operating
+on framework Tensors.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+
+__all__ = ["jvp", "vjp", "Jacobian", "Hessian", "forward_grad", "grad"]
+
+
+def _unwrap(x):
+    if isinstance(x, Tensor):
+        return x._value
+    if isinstance(x, (list, tuple)):
+        return type(x)(_unwrap(v) for v in x)
+    return jnp.asarray(x)
+
+
+def _wrap(v):
+    if isinstance(v, (list, tuple)):
+        return type(v)(_wrap(u) for u in v)
+    return Tensor(v, _internal=True)
+
+
+def _pure(func):
+    def fn(*raw):
+        out = func(*[Tensor(r, _internal=True) for r in raw])
+        return _unwrap(out)
+    return fn
+
+
+def jvp(func, xs, v=None):
+    """Forward-mode: returns (outputs, jvp_result) (primapi.jvp parity)."""
+    xs = xs if isinstance(xs, (list, tuple)) else [xs]
+    raw = [_unwrap(x) for x in xs]
+    if v is None:
+        tangents = [jnp.ones_like(r) for r in raw]
+    else:
+        v = v if isinstance(v, (list, tuple)) else [v]
+        tangents = [_unwrap(t) for t in v]
+    out, tangent_out = jax.jvp(_pure(func), tuple(raw), tuple(tangents))
+    return _wrap(out), _wrap(tangent_out)
+
+
+def vjp(func, xs, v=None):
+    """Reverse-mode: returns (outputs, vjp_result) (primapi.vjp parity)."""
+    xs = xs if isinstance(xs, (list, tuple)) else [xs]
+    raw = [_unwrap(x) for x in xs]
+    out, vjp_fn = jax.vjp(_pure(func), *raw)
+    if v is None:
+        cot = jnp.ones_like(out) if not isinstance(out, (tuple, list)) else \
+            tuple(jnp.ones_like(o) for o in out)
+    else:
+        cot = _unwrap(v)
+    grads = vjp_fn(cot)
+    grads = grads[0] if len(grads) == 1 else list(grads)
+    return _wrap(out), _wrap(grads)
+
+
+class Jacobian:
+    """autograd.Jacobian parity: lazy J[i, j] over a function of one or more
+    inputs; materialized via jax.jacrev."""
+
+    def __init__(self, func, xs, is_batched=False):
+        self._func = func
+        self._xs = xs if isinstance(xs, (list, tuple)) else [xs]
+        self._is_batched = is_batched
+        self._mat = None
+
+    def _compute(self):
+        if self._mat is None:
+            raw = [_unwrap(x) for x in self._xs]
+            jac = jax.jacrev(_pure(self._func),
+                             argnums=tuple(range(len(raw))))(*raw)
+            jac = jac[0] if len(raw) == 1 else jac
+            if self._is_batched:
+                # [B, out, B, in] diagonal → [B, out, in]
+                def take_diag(j):
+                    b = j.shape[0]
+                    return jnp.stack([j[i].reshape(-1, *j.shape[2:])[..., :]
+                                      [:, i] for i in range(b)])
+                jac = jax.tree_util.tree_map(take_diag, jac)
+            self._mat = jax.tree_util.tree_map(
+                lambda j: Tensor(j, _internal=True), jac)
+        return self._mat
+
+    def __getitem__(self, idx):
+        m = self._compute()
+        if isinstance(m, Tensor):
+            return m[idx]
+        return [t[idx] for t in m] if isinstance(m, (list, tuple)) else m
+
+    @property
+    def shape(self):
+        m = self._compute()
+        return m.shape if isinstance(m, Tensor) else [t.shape for t in m]
+
+    def numpy(self):
+        m = self._compute()
+        return m.numpy() if isinstance(m, Tensor) else m
+
+
+class Hessian:
+    """autograd.Hessian parity over a scalar-output function; is_batched
+    treats the leading dim as a batch of independent samples ([B, N] input,
+    per-sample scalar output → [B, N, N])."""
+
+    def __init__(self, func, xs, is_batched=False):
+        self._func = func
+        self._xs = xs if isinstance(xs, (list, tuple)) else [xs]
+        self._is_batched = is_batched
+        self._mat = None
+
+    def _compute(self):
+        if self._mat is None:
+            raw = [_unwrap(x) for x in self._xs]
+
+            if self._is_batched:
+                if len(raw) != 1:
+                    raise ValueError("batched Hessian supports one input")
+
+                def single(row):
+                    out = _pure(self._func)(row[None])
+                    return jnp.ravel(out)[0]
+
+                hess = jax.vmap(jax.hessian(single))(raw[0])
+            else:
+                def scalar(*a):
+                    out = _pure(self._func)(*a)
+                    return out.reshape(()) if hasattr(out, "reshape") else out
+
+                hess = jax.hessian(scalar,
+                                   argnums=tuple(range(len(raw))))(*raw)
+                hess = hess[0][0] if len(raw) == 1 else hess
+            self._mat = jax.tree_util.tree_map(
+                lambda h: Tensor(h, _internal=True), hess)
+        return self._mat
+
+    def __getitem__(self, idx):
+        return self._compute()[idx]
+
+    @property
+    def shape(self):
+        m = self._compute()
+        return m.shape if isinstance(m, Tensor) else None
+
+    def numpy(self):
+        return self._compute().numpy()
+
+
+def forward_grad(outputs_fn, xs, v=None):
+    """primapi.forward_grad parity: forward-mode gradient."""
+    _, tangent = jvp(outputs_fn, xs, v)
+    return tangent
+
+
+def grad(func, xs, v=None):
+    """Functional reverse grad of `func` at xs (primapi.grad parity)."""
+    _, g = vjp(func, xs, v)
+    return g

@@ -17,7 +17,7 @@ from dataclasses import dataclass
 from jax.sharding import PartitionSpec as P
 
 from ..distributed.fleet.layers.mpu.mp_layers import (
-    ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear,
+    ColumnParallelLinear, RowParallelLinear,
     VocabParallelEmbedding, _constrain, _mp_info)
 from ..nn import functional as F
 from ..nn.layer.common import Dropout, Embedding, Linear
@@ -180,7 +180,9 @@ class BertEmbeddings(Layer):
         x = (self.word_embeddings(input_ids) +
              self.position_embeddings(position_ids) +
              self.token_type_embeddings(token_type_ids))
-        if self._has_task_types and task_type_ids is not None:
+        if self._has_task_types:
+            if task_type_ids is None:  # default task 0 like the reference
+                task_type_ids = zeros_like(input_ids)
             x = x + self.task_type_embeddings(task_type_ids)
         return self.dropout(self.norm(x))
 
@@ -270,9 +272,9 @@ class BertForPretraining(Layer):
                           weight_attr=_init_attr(config.initializer_range))
 
     def forward(self, input_ids, token_type_ids=None, position_ids=None,
-                attention_mask=None):
+                attention_mask=None, task_type_ids=None):
         seq, pooled = self.bert(input_ids, token_type_ids, position_ids,
-                                attention_mask)
+                                attention_mask, task_type_ids=task_type_ids)
         return self.cls(seq), self.nsp(pooled)
 
 

@@ -1,0 +1,224 @@
+"""paddle.sparse parity (python/paddle/incubate/sparse → paddle.sparse):
+SparseCooTensor/SparseCsrTensor (phi/core sparse_coo_tensor.h /
+sparse_csr_tensor.h analogs) over jax.experimental.sparse BCOO.
+
+The reference keeps a dedicated sparse kernel tree (phi/kernels/sparse/, 29
+files); XLA's sparse support is BCOO-based, so COO is the native layout here
+and CSR is a view-style wrapper that converts through COO.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor
+
+__all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
+           "SparseCsrTensor", "add", "multiply", "matmul", "masked_matmul",
+           "relu", "transpose", "is_same_shape"]
+
+
+def _val(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class SparseCooTensor:
+    """COO sparse tensor (dense_tensor.h's SparseCooTensor analog)."""
+
+    def __init__(self, bcoo: jsparse.BCOO):
+        self._bcoo = bcoo
+
+    # -- paddle surface ------------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        return self._bcoo.dtype
+
+    def indices(self) -> Tensor:
+        return Tensor(self._bcoo.indices.T, _internal=True)  # [ndim, nnz]
+
+    def values(self) -> Tensor:
+        return Tensor(self._bcoo.data, _internal=True)
+
+    def nnz(self) -> int:
+        return int(self._bcoo.nse)
+
+    def to_dense(self) -> Tensor:
+        return Tensor(self._bcoo.todense(), _internal=True)
+
+    def to_sparse_csr(self) -> "SparseCsrTensor":
+        return SparseCsrTensor.from_coo(self)
+
+    def coalesce(self) -> "SparseCooTensor":
+        return SparseCooTensor(self._bcoo.sum_duplicates())
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+
+class SparseCsrTensor:
+    """CSR view (crows/cols/values surface); stored as COO underneath."""
+
+    def __init__(self, crows, cols, values, shape):
+        self._crows = jnp.asarray(_val(crows), jnp.int64)
+        self._cols = jnp.asarray(_val(cols), jnp.int64)
+        self._values = _val(values)
+        self._shape = tuple(int(s) for s in shape)
+
+    @classmethod
+    def from_coo(cls, coo: SparseCooTensor):
+        coo = coo.coalesce()
+        idx = np.asarray(coo._bcoo.indices)
+        vals = coo._bcoo.data
+        rows, cols = idx[:, 0], idx[:, 1]
+        order = np.lexsort((cols, rows))
+        rows, cols = rows[order], cols[order]
+        vals = vals[jnp.asarray(order)]
+        n_rows = coo.shape[0]
+        crows = np.zeros(n_rows + 1, np.int64)
+        np.add.at(crows, rows + 1, 1)
+        crows = np.cumsum(crows)
+        return cls(crows, cols, vals, coo.shape)
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def dtype(self):
+        return self._values.dtype
+
+    def crows(self) -> Tensor:
+        return Tensor(self._crows, _internal=True)
+
+    def cols(self) -> Tensor:
+        return Tensor(self._cols, _internal=True)
+
+    def values(self) -> Tensor:
+        return Tensor(self._values, _internal=True)
+
+    def nnz(self) -> int:
+        return int(self._cols.shape[0])
+
+    def to_sparse_coo(self, sparse_dim=2) -> SparseCooTensor:
+        crows = np.asarray(self._crows)
+        rows = np.repeat(np.arange(len(crows) - 1), np.diff(crows))
+        idx = jnp.stack([jnp.asarray(rows),
+                         jnp.asarray(self._cols)], axis=1)
+        bcoo = jsparse.BCOO((self._values, idx), shape=self._shape)
+        return SparseCooTensor(bcoo)
+
+    def to_dense(self) -> Tensor:
+        return self.to_sparse_coo().to_dense()
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+
+# -- constructors ------------------------------------------------------------
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      place=None, stop_gradient=True):
+    idx = jnp.asarray(_val(indices), jnp.int64)
+    vals = _val(values)
+    if dtype is not None:
+        vals = vals.astype(dtype)
+    if idx.ndim != 2:
+        raise ValueError("indices must be [sparse_dim, nnz]")
+    if shape is None:
+        shape = tuple(int(i) for i in np.asarray(idx.max(axis=1)) + 1)
+    bcoo = jsparse.BCOO((vals, idx.T), shape=tuple(shape))
+    return SparseCooTensor(bcoo)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True):
+    vals = _val(values)
+    if dtype is not None:
+        vals = vals.astype(dtype)
+    return SparseCsrTensor(crows, cols, vals, shape)
+
+
+def is_same_shape(x, y) -> bool:
+    return list(x.shape) == list(y.shape)
+
+
+# -- ops (phi/kernels/sparse parity subset) ----------------------------------
+
+def _coerce_coo(x):
+    if isinstance(x, SparseCsrTensor):
+        return x.to_sparse_coo()
+    return x
+
+
+def add(x, y, name=None):
+    x, y = _coerce_coo(x), _coerce_coo(y)
+    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+        idx = jnp.concatenate([x._bcoo.indices, y._bcoo.indices], axis=0)
+        data = jnp.concatenate([x._bcoo.data, y._bcoo.data], axis=0)
+        out = jsparse.BCOO((data, idx), shape=x._bcoo.shape).sum_duplicates()
+        return SparseCooTensor(out)
+    dense = _val(y if isinstance(x, SparseCooTensor) else x)
+    sp = x if isinstance(x, SparseCooTensor) else y
+    return Tensor(sp._bcoo.todense() + dense, _internal=True)
+
+
+def multiply(x, y, name=None):
+    x = _coerce_coo(x)
+    if isinstance(y, (SparseCooTensor, SparseCsrTensor)):
+        y = _coerce_coo(y).to_dense()
+    yv = _val(y)
+    # elementwise multiply only touches stored values
+    gathered = yv[tuple(x._bcoo.indices[:, d]
+                        for d in range(x._bcoo.indices.shape[1]))] \
+        if yv.ndim else yv
+    return SparseCooTensor(jsparse.BCOO((x._bcoo.data * gathered,
+                                         x._bcoo.indices),
+                                        shape=x._bcoo.shape))
+
+
+def matmul(x, y, name=None):
+    """sparse @ dense → dense (phi sparse matmul kernels)."""
+    x = _coerce_coo(x)
+    yv = _val(y)
+    out = x._bcoo @ yv
+    return Tensor(out, _internal=True)
+
+
+def masked_matmul(x, y, mask, name=None):
+    """dense @ dense sampled at mask's sparsity (SDDMM)."""
+    xv, yv = _val(x), _val(y)
+    mask = _coerce_coo(mask)
+    idx = mask._bcoo.indices
+    rows, cols = idx[:, 0], idx[:, 1]
+    vals = jnp.einsum("nk,nk->n", xv[rows, :], yv[:, cols].T)
+    return SparseCooTensor(jsparse.BCOO((vals, idx), shape=mask._bcoo.shape))
+
+
+def relu(x, name=None):
+    x = _coerce_coo(x)
+    return SparseCooTensor(jsparse.BCOO((jnp.maximum(x._bcoo.data, 0),
+                                         x._bcoo.indices),
+                                        shape=x._bcoo.shape))
+
+
+def transpose(x, perm, name=None):
+    x = _coerce_coo(x)
+    idx = x._bcoo.indices[:, jnp.asarray(perm)]
+    shape = tuple(x._bcoo.shape[p] for p in perm)
+    return SparseCooTensor(jsparse.BCOO((x._bcoo.data, idx), shape=shape))
+
+
+class nn:
+    """paddle.sparse.nn subset: ReLU layer."""
+
+    class ReLU:
+        def __call__(self, x):
+            return relu(x)

@@ -120,6 +120,18 @@ def test_ernie_task_type_embedding():
                     task_type_ids=paddle.to_tensor(task_ids + 1))
     # a different task id changes the representation
     assert np.abs(seq0.numpy() - seq1.numpy()).max() > 1e-4
+    # omitted task ids default to task 0 (reference ErnieModel behavior)
+    seq_none, _ = model(paddle.to_tensor(ids))
+    np.testing.assert_allclose(seq_none.numpy(), seq0.numpy(), rtol=1e-5)
+    # pretraining head accepts task_type_ids
+    from paddle_tpu.models import build_ernie as be
+    paddle.seed(3)
+    pre = be("ernie-3.0-medium", vocab_size=512, hidden_size=64,
+             num_layers=2, num_attention_heads=2, intermediate_size=128,
+             max_position_embeddings=64)
+    mlm, nsp = pre(paddle.to_tensor(ids),
+                   task_type_ids=paddle.to_tensor(task_ids))
+    assert tuple(mlm.shape) == (2, 8, 512)
 
 
 def test_bert_sharded_train_step_compiles():

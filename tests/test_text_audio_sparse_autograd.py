@@ -1,0 +1,204 @@
+"""text / audio / sparse / higher-order-autograd tests (reference:
+test_viterbi_decode.py, audio feature tests, sparse unittests,
+autograd/test_jacobian_hessian)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+# -- text: viterbi -----------------------------------------------------------
+
+def _brute_viterbi(pot, trans):
+    """Exhaustive search reference (no bos/eos)."""
+    t, n = pot.shape
+    import itertools
+    best, best_path = -np.inf, None
+    for path in itertools.product(range(n), repeat=t):
+        s = pot[0, path[0]]
+        for i in range(1, t):
+            s += trans[path[i - 1], path[i]] + pot[i, path[i]]
+        if s > best:
+            best, best_path = s, path
+    return best, list(best_path)
+
+
+def test_viterbi_matches_bruteforce():
+    from paddle_tpu.text import viterbi_decode
+    rng = np.random.RandomState(0)
+    pot = rng.randn(1, 5, 3).astype("float32")
+    trans = rng.randn(3, 3).astype("float32")
+    scores, paths = viterbi_decode(paddle.to_tensor(pot),
+                                   paddle.to_tensor(trans),
+                                   include_bos_eos_tag=False)
+    ref_score, ref_path = _brute_viterbi(pot[0].astype("float64"),
+                                         trans.astype("float64"))
+    assert float(scores.numpy()[0]) == pytest.approx(ref_score, rel=1e-5)
+    assert paths.numpy()[0].tolist() == ref_path
+
+
+def test_viterbi_decoder_layer_batched():
+    from paddle_tpu.text import ViterbiDecoder
+    rng = np.random.RandomState(1)
+    pot = rng.randn(3, 6, 5).astype("float32")
+    trans = rng.randn(5, 5).astype("float32")
+    dec = ViterbiDecoder(paddle.to_tensor(trans), include_bos_eos_tag=True)
+    scores, paths = dec(paddle.to_tensor(pot))
+    assert tuple(scores.shape) == (3,)
+    assert tuple(paths.shape) == (3, 6)
+    assert int(paths.numpy().max()) < 5
+
+
+# -- audio -------------------------------------------------------------------
+
+def test_spectrogram_parseval():
+    from paddle_tpu.audio import Spectrogram
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 2048).astype("float32")
+    spec = Spectrogram(n_fft=256, hop_length=64, window="hann", power=2.0)
+    out = spec(paddle.to_tensor(x))
+    f = 1 + 256 // 2
+    assert out.shape[0] == 2 and out.shape[1] == f
+    assert (out.numpy() >= 0).all()
+
+
+def test_pure_tone_peaks_at_right_bin():
+    from paddle_tpu.audio import Spectrogram
+    sr, n_fft = 8000, 512
+    tt = np.arange(sr, dtype="float32") / sr
+    freq = 1000.0
+    x = np.sin(2 * np.pi * freq * tt).astype("float32")
+    out = Spectrogram(n_fft=n_fft, hop_length=n_fft,
+                      power=2.0)(paddle.to_tensor(x[None])).numpy()[0]
+    peak_bin = out.mean(axis=-1).argmax()
+    expected = round(freq * n_fft / sr)
+    assert abs(int(peak_bin) - expected) <= 1
+
+
+def test_mel_and_mfcc_shapes():
+    from paddle_tpu.audio import LogMelSpectrogram, MelSpectrogram, MFCC
+    x = paddle.to_tensor(np.random.RandomState(2).randn(1, 4096)
+                         .astype("float32"))
+    mel = MelSpectrogram(sr=16000, n_fft=512, n_mels=40)(x)
+    assert mel.shape[1] == 40
+    logmel = LogMelSpectrogram(sr=16000, n_fft=512, n_mels=40)(x)
+    assert logmel.shape[1] == 40
+    mfcc = MFCC(sr=16000, n_mfcc=13, n_fft=512, n_mels=40)(x)
+    assert mfcc.shape[1] == 13
+
+
+def test_fbank_matrix_rows_cover_spectrum():
+    from paddle_tpu.audio.functional import compute_fbank_matrix
+    fb = compute_fbank_matrix(16000, 512, n_mels=26).numpy()
+    assert fb.shape == (26, 257)
+    assert (fb >= 0).all()
+    assert (fb.sum(axis=1) > 0).all()  # every filter non-empty
+
+
+# -- sparse ------------------------------------------------------------------
+
+def test_sparse_coo_roundtrip():
+    import paddle_tpu.sparse as sparse
+    indices = np.array([[0, 1, 2], [1, 2, 0]], "int64")
+    values = np.array([1.0, 2.0, 3.0], "float32")
+    s = sparse.sparse_coo_tensor(indices, values, shape=[3, 3])
+    assert s.nnz() == 3
+    dense = s.to_dense().numpy()
+    expected = np.zeros((3, 3), "float32")
+    expected[0, 1], expected[1, 2], expected[2, 0] = 1, 2, 3
+    np.testing.assert_array_equal(dense, expected)
+
+    csr = s.to_sparse_csr()
+    np.testing.assert_array_equal(csr.crows().numpy(), [0, 1, 2, 3])
+    np.testing.assert_array_equal(csr.to_dense().numpy(), expected)
+    back = csr.to_sparse_coo()
+    np.testing.assert_array_equal(back.to_dense().numpy(), expected)
+
+
+def test_sparse_ops():
+    import paddle_tpu.sparse as sparse
+    a = sparse.sparse_coo_tensor(np.array([[0, 1], [0, 1]], "int64"),
+                                 np.array([1.0, -2.0], "float32"), [2, 2])
+    b = sparse.sparse_coo_tensor(np.array([[0, 1], [1, 1]], "int64"),
+                                 np.array([5.0, 4.0], "float32"), [2, 2])
+    s = sparse.add(a, b)
+    np.testing.assert_array_equal(s.to_dense().numpy(),
+                                  [[1, 5], [0, 2]])
+    r = sparse.relu(a)
+    np.testing.assert_array_equal(r.to_dense().numpy(), [[1, 0], [0, 0]])
+    d = paddle.to_tensor(np.arange(4, dtype="float32").reshape(2, 2))
+    out = sparse.matmul(a, d)
+    ref = a.to_dense().numpy() @ d.numpy()
+    np.testing.assert_allclose(out.numpy(), ref)
+    t = sparse.transpose(a, [1, 0])
+    np.testing.assert_array_equal(t.to_dense().numpy(),
+                                  a.to_dense().numpy().T)
+
+
+def test_sparse_masked_matmul():
+    import paddle_tpu.sparse as sparse
+    rng = np.random.RandomState(3)
+    x = rng.randn(4, 8).astype("float32")
+    y = rng.randn(8, 4).astype("float32")
+    mask = sparse.sparse_coo_tensor(np.array([[0, 2], [1, 3]], "int64"),
+                                    np.array([1.0, 1.0], "float32"), [4, 4])
+    out = sparse.masked_matmul(paddle.to_tensor(x), paddle.to_tensor(y),
+                               mask)
+    full = x @ y
+    dense = out.to_dense().numpy()
+    assert dense[0, 1] == pytest.approx(full[0, 1], rel=1e-5)
+    assert dense[2, 3] == pytest.approx(full[2, 3], rel=1e-5)
+    assert dense[1, 1] == 0
+
+
+# -- higher-order autograd ---------------------------------------------------
+
+def test_jvp_vjp():
+    from paddle_tpu.incubate.autograd import jvp, vjp
+
+    def f(x):
+        return x * x
+
+    x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], "float32"))
+    out, tangent = jvp(f, x)
+    np.testing.assert_allclose(tangent.numpy(), [2.0, 4.0, 6.0])
+    out, g = vjp(f, x)
+    np.testing.assert_allclose(g.numpy(), [2.0, 4.0, 6.0])
+
+
+def test_jacobian():
+    from paddle_tpu.incubate.autograd import Jacobian
+
+    def f(x):
+        return x ** 2
+
+    x = paddle.to_tensor(np.array([1.0, 2.0], "float32"))
+    J = Jacobian(f, x)
+    np.testing.assert_allclose(np.asarray(J.numpy()),
+                               [[2.0, 0.0], [0.0, 4.0]])
+
+
+def test_hessian_batched():
+    from paddle_tpu.incubate.autograd import Hessian
+
+    def f(x):  # per-sample scalar: sum of cubes per row
+        return (x ** 3).sum(-1, keepdim=True)
+
+    x = paddle.to_tensor(np.array([[1.0, 2.0], [3.0, 4.0]], "float32"))
+    H = Hessian(f, x, is_batched=True)
+    out = np.asarray(H.numpy())
+    assert out.shape == (2, 2, 2)
+    np.testing.assert_allclose(out[0], np.diag([6.0, 12.0]), rtol=1e-5)
+    np.testing.assert_allclose(out[1], np.diag([18.0, 24.0]), rtol=1e-5)
+
+
+def test_hessian():
+    from paddle_tpu.incubate.autograd import Hessian
+
+    def f(x):
+        return (x * x).sum()
+
+    x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], "float32"))
+    H = Hessian(f, x)
+    np.testing.assert_allclose(np.asarray(H.numpy()), 2 * np.eye(3),
+                               atol=1e-6)
