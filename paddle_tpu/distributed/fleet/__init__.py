@@ -140,12 +140,40 @@ def stop_worker():
 
 
 def save(dirname, feed=None, fetch=None, **configs):
-    """fleet.py:778 save facade: delegates to framework save."""
-    pass
+    """fleet.py:778 save facade: sharded-checkpoint the registered model(s).
+    Pass model=<Layer> (and optionally optimizer=) in configs, or a
+    state=<dict> directly."""
+    from ...framework.checkpoint import save_sharded
+
+    state = configs.get("state")
+    if state is None:
+        state = {}
+        if configs.get("model") is not None:
+            state["model"] = configs["model"].state_dict()
+        if configs.get("optimizer") is not None:
+            state["optimizer"] = configs["optimizer"].state_dict()
+    if not state:
+        raise ValueError("fleet.save needs model=/optimizer=/state= kwargs")
+    save_sharded(state, dirname)
+
+
+def load_model(dirname, **configs):
+    from ...framework.checkpoint import load_sharded
+
+    state = load_sharded(dirname)
+    if configs.get("model") is not None and "model" in state:
+        configs["model"].set_state_dict(state["model"])
+    if configs.get("optimizer") is not None and "optimizer" in state:
+        configs["optimizer"].set_state_dict(state["optimizer"])
+    return state
 
 
 def save_persistables(executor, dirname, main_program=None, mode=0):
-    pass
+    """Static-path facade: main_program carries a layer in this build."""
+    layer = getattr(main_program, "_layer", None)
+    if layer is not None:
+        from ...framework.checkpoint import save_sharded
+        save_sharded({"model": layer.state_dict()}, dirname)
 
 
 class UtilBase:

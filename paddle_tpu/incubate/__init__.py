@@ -1,5 +1,6 @@
 """paddle.incubate parity namespace (SURVEY §2.3 incubate: MoE expert
 parallelism, fused nn layers, distributed models)."""
 from . import autograd  # noqa: F401
+from . import checkpoint  # noqa: F401
 from . import distributed  # noqa: F401
 from . import nn  # noqa: F401

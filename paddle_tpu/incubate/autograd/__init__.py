@@ -57,8 +57,8 @@ def vjp(func, xs, v=None):
     raw = [_unwrap(x) for x in xs]
     out, vjp_fn = jax.vjp(_pure(func), *raw)
     if v is None:
-        cot = jnp.ones_like(out) if not isinstance(out, (tuple, list)) else \
-            tuple(jnp.ones_like(o) for o in out)
+        # cotangent must mirror the output's container type exactly
+        cot = jax.tree_util.tree_map(jnp.ones_like, out)
     else:
         cot = _unwrap(v)
     grads = vjp_fn(cot)

@@ -139,20 +139,54 @@ class Optimizer:
         return sd
 
     def set_state_dict(self, state_dict):
-        self._step_count = int(state_dict.get("step_count", 0))
+        sc = state_dict.get("step_count", 0)
+        self._step_count = int(sc.numpy()) if hasattr(sc, "numpy") else int(sc)
         if isinstance(self._lr, LRScheduler) and state_dict.get("LR_Scheduler"):
             self._lr.set_state_dict(state_dict["LR_Scheduler"])
+        if not self._parameters:
+            return
+        # group slot entries by parameter-name prefix (insertion order ==
+        # the order state_dict() wrote them, i.e. parameter order)
+        special = {"LR_Scheduler", "master_weights", "step_count"}
+        probe = jnp.zeros((1,), self._parameters[0]._value.dtype)
+        slot_names = set(self.init_slots(probe))
+        by_prefix: dict = {}
+        for key, v in state_dict.items():
+            if key in special:
+                continue
+            for sn in slot_names:
+                if key.endswith(f"_{sn}"):
+                    prefix = key[: -len(sn) - 1]
+                    by_prefix.setdefault(prefix, {})[sn] = v
+                    break
+        prefixes = list(by_prefix)
+        # matching policy: EITHER all-by-name OR all-by-position — mixing
+        # the two can pair shifted auto-generated names with the wrong
+        # parameter's slots (silent same-shape corruption)
+        if all(p.name in by_prefix for p in self._parameters):
+            src_of = {id(p): by_prefix[p.name] for p in self._parameters}
+        elif len(prefixes) == len(self._parameters):
+            src_of = {id(p): by_prefix[prefixes[i]]
+                      for i, p in enumerate(self._parameters)}
+        else:
+            import warnings
+            warnings.warn(
+                "optimizer state restore: checkpoint slot names don't match "
+                "this optimizer's parameters and counts differ "
+                f"({len(prefixes)} vs {len(self._parameters)}); slots not "
+                "restored")
+            src_of = {}
         for p in self._parameters:
+            src = src_of.get(id(p))
+            if not src:
+                continue
             slots = self.init_slots(p._value)
-            found = False
             for k in list(slots):
-                key = f"{p.name}_{k}"
-                if key in state_dict:
-                    v = state_dict[key]
-                    slots[k] = v._value if isinstance(v, Tensor) else jnp.asarray(v)
-                    found = True
-            if found:
-                self._slots[id(p)] = slots
+                if k in src:
+                    v = src[k]
+                    slots[k] = v._value if isinstance(v, Tensor) \
+                        else jnp.asarray(v)
+            self._slots[id(p)] = slots
 
     def _parameter_list(self):
         return self._parameters

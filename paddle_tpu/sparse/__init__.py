@@ -73,6 +73,10 @@ class SparseCsrTensor:
 
     @classmethod
     def from_coo(cls, coo: SparseCooTensor):
+        if len(coo.shape) != 2:
+            raise ValueError(
+                f"CSR conversion supports 2-D tensors, got shape "
+                f"{coo.shape}; keep batched sparse data in COO")
         coo = coo.coalesce()
         idx = np.asarray(coo._bcoo.indices)
         vals = coo._bcoo.data

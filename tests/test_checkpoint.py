@@ -1,0 +1,162 @@
+"""Checkpoint subsystem tests (sharded save/load, async writer, auto
+checkpoint resume — reference: auto_checkpoint tests + group-sharded save)."""
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.framework.checkpoint import (AsyncCheckpointSaver,
+                                             load_sharded, save_sharded)
+from paddle_tpu.incubate.checkpoint import TrainEpochRange
+
+
+def _net():
+    paddle.seed(0)
+    return nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+
+
+def test_sharded_roundtrip(tmp_path):
+    net = _net()
+    opt = paddle.optimizer.Adam(parameters=net.parameters())
+    state = {"model": net.state_dict(), "opt": opt.state_dict(),
+             "step": np.array(7)}
+    d = str(tmp_path / "ckpt")
+    save_sharded(state, d)
+    assert os.path.exists(os.path.join(d, "manifest.json"))
+
+    loaded = load_sharded(d)
+    for k, v in net.state_dict().items():
+        np.testing.assert_array_equal(loaded["model"][k].numpy(), v.numpy())
+    assert int(np.asarray(loaded["step"].numpy())) == 7
+
+    # atomic: re-save over the same dir works
+    save_sharded(state, d)
+    assert load_sharded(d)["model"] is not None
+
+
+def test_async_saver_and_prune(tmp_path):
+    saver = AsyncCheckpointSaver(str(tmp_path / "auto"), keep_last=2)
+    net = _net()
+    for step in range(4):
+        saver.save({"model": net.state_dict()}, step=step)
+    saver.wait()
+    assert saver.steps() == [2, 3]  # pruned to keep_last
+    assert saver.latest_step() == 3
+    restored = saver.restore()
+    for k, v in net.state_dict().items():
+        np.testing.assert_array_equal(restored["model"][k].numpy(),
+                                      v.numpy())
+
+
+def test_async_saver_snapshot_isolation(tmp_path):
+    """The async write must capture values at save() time, not write time."""
+    saver = AsyncCheckpointSaver(str(tmp_path / "iso"), keep_last=2)
+    net = _net()
+    w_before = net.state_dict()["0.weight"].numpy().copy()
+    saver.save({"model": net.state_dict()}, step=0)
+    # mutate immediately after scheduling
+    net[0].weight._replace_(net[0].weight._value * 0 + 5.0, None)
+    saver.wait()
+    restored = saver.restore(0)
+    np.testing.assert_array_equal(restored["model"]["0.weight"].numpy(),
+                                  w_before)
+
+
+def test_train_epoch_range_resume(tmp_path):
+    d = str(tmp_path / "acp")
+
+    # run 1: the job only gets through 3 epochs before "crashing"
+    net = _net()
+    opt = paddle.optimizer.SGD(parameters=net.parameters(),
+                               learning_rate=0.1)
+    r = TrainEpochRange(3, name="job1", checkpoint_dir=d)
+    r.register(net, "model").register(opt, "opt")
+    assert r.start_epoch == 0
+    seen = []
+    for epoch in r:
+        seen.append(epoch)
+        # one train step so the state changes each epoch
+        loss = (net(paddle.to_tensor(np.ones((2, 4), "float32"))) ** 2).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert seen == [0, 1, 2]
+    w_at_crash = net.state_dict()["0.weight"].numpy().copy()
+
+    # run 2: fresh process state, resumes at epoch 3 with restored weights
+    net2 = _net()
+    opt2 = paddle.optimizer.SGD(parameters=net2.parameters(),
+                                learning_rate=0.1)
+    r2 = TrainEpochRange(6, name="job1", checkpoint_dir=d)
+    r2.register(net2, "model").register(opt2, "opt")
+    assert r2.start_epoch == 3
+    np.testing.assert_array_equal(net2.state_dict()["0.weight"].numpy(),
+                                  w_at_crash)
+    remaining = list(r2)
+    assert remaining == [3, 4, 5]
+
+
+def test_optimizer_restore_never_mixes_name_and_position(tmp_path):
+    """Regression: shifted auto-generated names must not pair a parameter
+    with ANOTHER parameter's slots."""
+    import warnings as W
+
+    def train_once(net, opt):
+        loss = (net(paddle.to_tensor(np.ones((2, 4), "float32"))) ** 2).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+
+    paddle.seed(0)
+    net1 = nn.Sequential(nn.Linear(4, 4), nn.Linear(4, 4))
+    opt1 = paddle.optimizer.Adam(parameters=net1.parameters())
+    train_once(net1, opt1)
+    sd = opt1.state_dict()
+
+    # identical architecture → positional restore must reproduce slots in
+    # parameter order even though fresh names differ
+    paddle.seed(0)
+    net2 = nn.Sequential(nn.Linear(4, 4), nn.Linear(4, 4))
+    opt2 = paddle.optimizer.Adam(parameters=net2.parameters())
+    opt2.set_state_dict(sd)
+    for p1, p2 in zip(net1.parameters(), net2.parameters()):
+        s1 = opt1._slots[id(p1)]
+        s2 = opt2._slots[id(p2)]
+        np.testing.assert_array_equal(np.asarray(s1["moment1"]),
+                                      np.asarray(s2["moment1"]))
+
+    # mismatched count → warn and skip, never guess
+    net3 = nn.Sequential(nn.Linear(4, 4))
+    opt3 = paddle.optimizer.Adam(parameters=net3.parameters())
+    with W.catch_warnings(record=True) as rec:
+        W.simplefilter("always")
+        opt3.set_state_dict(sd)
+    assert any("not restored" in str(r.message) for r in rec)
+
+
+def test_save_sharded_keeps_old_copy_until_promoted(tmp_path):
+    """Crash-safety: the previous checkpoint is moved aside, not deleted,
+    before the new one is promoted."""
+    d = str(tmp_path / "ck")
+    save_sharded({"a": np.arange(3, dtype="float32")}, d)
+    save_sharded({"a": np.arange(3, dtype="float32") * 2}, d)
+    out = load_sharded(d, return_numpy=True)
+    np.testing.assert_array_equal(out["a"], [0, 2, 4])
+    assert not os.path.exists(d + ".old")  # cleaned after promote
+
+
+def test_fleet_save_load(tmp_path):
+    from paddle_tpu.distributed import fleet
+    net = _net()
+    opt = paddle.optimizer.Adam(parameters=net.parameters())
+    d = str(tmp_path / "fleet_ckpt")
+    fleet.save(d, model=net, optimizer=opt)
+
+    net2 = _net()
+    net2[0].weight._replace_(net2[0].weight._value * 0, None)
+    fleet.load_model(d, model=net2)
+    np.testing.assert_array_equal(net2.state_dict()["0.weight"].numpy(),
+                                  net.state_dict()["0.weight"].numpy())
