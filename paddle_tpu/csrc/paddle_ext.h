@@ -1,0 +1,31 @@
+// paddle_ext.h — the custom-operator ABI for paddle_tpu's cpp_extension
+// (parity target: paddle/fluid/framework/custom_operator.cc PD_BUILD_OP +
+// utils/cpp_extension; the plugin-facing struct mirrors the spirit of
+// phi/backends/custom/device_ext.h's C tables, SURVEY §2.1).
+//
+// A custom op is an exported C function named  pt_op_<name>  with the
+// signature below.  Tensors are host buffers: custom C++ runs on the host
+// CPU (the TPU compute path is XLA/Pallas); the framework bridges it into
+// jitted programs via a host callback.
+#pragma once
+#include <cstdint>
+
+extern "C" {
+
+typedef struct {
+  void* data;           // contiguous buffer
+  const int64_t* shape; // dims
+  int ndim;
+  int dtype;            // 0=f32 1=f64 2=i32 3=i64 4=u8 5=bool
+} PT_Tensor;
+
+// return 0 on success; nonzero aborts the op with an error
+typedef int (*PT_OpFn)(const PT_Tensor* inputs, int n_inputs,
+                       PT_Tensor* outputs, int n_outputs);
+
+}  // extern "C"
+
+// convenience: declare an op with the canonical exported name
+#define PT_BUILD_OP(name)                                            \
+  extern "C" int pt_op_##name(const PT_Tensor* inputs, int n_inputs, \
+                              PT_Tensor* outputs, int n_outputs)

@@ -1,4 +1,5 @@
-"""paddle.utils parity surface (native build helper, cpp_extension later)."""
+"""paddle.utils parity surface (native build helper + cpp_extension)."""
+from . import cpp_extension  # noqa: F401
 from .native_build import build_native_lib, get_build_directory  # noqa: F401
 
 

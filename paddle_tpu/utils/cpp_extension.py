@@ -1,0 +1,238 @@
+"""utils.cpp_extension — runtime C++ custom-op JIT, parity with
+python/paddle/utils/cpp_extension (setup()/load()/CppExtension, pairing with
+framework/custom_operator.cc PD_BUILD_OP).
+
+TPU-native contract: user C++ implements `pt_op_<name>` per csrc/paddle_ext.h
+(host buffers — custom native kernels run on host CPU, exactly like the
+reference's custom CPU kernels; the XLA graph reaches them through
+`jax.pure_callback`, so custom ops compose with jit/vmap-free paths).
+Gradients: pass grad_op_map={"fwd": "fwd_grad"} where `pt_op_<fwd_grad>`
+takes (inputs..., grad_out) and writes grad_inputs — wired via
+jax.custom_vjp (PD_BUILD_GRAD_OP analog).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import types
+
+import numpy as np
+
+from .native_build import build_native_lib, get_build_directory
+
+__all__ = ["load", "setup", "CppExtension", "CUDAExtension",
+           "get_build_directory"]
+
+_DTYPE_CODE = {"float32": 0, "float64": 1, "int32": 2, "int64": 3,
+               "uint8": 4, "bool": 5}
+
+
+class _PTTensor(ctypes.Structure):
+    _fields_ = [("data", ctypes.c_void_p),
+                ("shape", ctypes.POINTER(ctypes.c_int64)),
+                ("ndim", ctypes.c_int),
+                ("dtype", ctypes.c_int)]
+
+
+def _as_pt(arr: np.ndarray, holder):
+    code = _DTYPE_CODE.get(str(arr.dtype))
+    if code is None:
+        raise TypeError(
+            f"dtype {arr.dtype} is not supported by custom C++ ops "
+            f"(supported: {sorted(_DTYPE_CODE)}); cast to float32 before "
+            "the op (bf16 compute stays in the XLA graph)")
+    shape = (ctypes.c_int64 * max(arr.ndim, 1))(*(arr.shape or (0,)))
+    holder.append(shape)   # keep ctypes shape alive
+    holder.append(arr)     # keep the buffer alive for the native call
+    return _PTTensor(arr.ctypes.data_as(ctypes.c_void_p), shape, arr.ndim,
+                     code)
+
+
+class CppExtension:
+    def __init__(self, sources, include_dirs=None, extra_compile_args=None,
+                 **kwargs):
+        self.sources = sources if isinstance(sources, (list, tuple)) \
+            else [sources]
+        self.include_dirs = include_dirs or []
+        self.extra_compile_args = extra_compile_args or []
+
+
+def CUDAExtension(*args, **kwargs):
+    raise RuntimeError(
+        "CUDAExtension has no TPU analog — device kernels are Pallas "
+        "(paddle_tpu.kernels); CppExtension builds host ops")
+
+
+def _compile(name, sources, include_dirs=(), extra_flags=(),
+             build_directory=None):
+    hdr_dir = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "csrc")
+    bdir = build_directory or get_build_directory()
+    os.makedirs(bdir, exist_ok=True)
+    if len(sources) != 1:
+        # concatenate into one TU (the reference runs a full setuptools
+        # build); only rewrite when the include list changed so the mtime
+        # cache in build_native_lib stays effective — the .so is still
+        # rebuilt whenever any REAL source is newer (mtime bump below)
+        cat = os.path.join(bdir, f"{name}_all.cpp")
+        content = "".join(f'#include "{os.path.abspath(s)}"\n'
+                          for s in sources)
+        if not os.path.exists(cat) or open(cat).read() != content:
+            with open(cat, "w") as f:
+                f.write(content)
+        else:
+            newest = max(os.path.getmtime(os.path.abspath(s))
+                         for s in sources)
+            if newest > os.path.getmtime(cat):
+                os.utime(cat, (newest, newest))
+        src = cat
+    else:
+        src = os.path.abspath(sources[0])
+    flags = [f"-I{hdr_dir}"] + [f"-I{d}" for d in include_dirs] + \
+        list(extra_flags)
+    return build_native_lib(src, f"lib{name}.so", extra_flags=tuple(flags),
+                            build_dir=build_directory)
+
+
+def _make_op(lib, op_name, infer_shape, infer_dtype, grad_name=None,
+             n_outputs=1):
+    import jax
+    import jax.numpy as jnp
+
+    from ..core.op import apply_op
+    from ..core.tensor import Tensor
+
+    fn = getattr(lib, f"pt_op_{op_name}")
+    fn.restype = ctypes.c_int
+    fn.argtypes = [ctypes.POINTER(_PTTensor), ctypes.c_int,
+                   ctypes.POINTER(_PTTensor), ctypes.c_int]
+    grad_fn = None
+    if grad_name is not None:
+        grad_fn = getattr(lib, f"pt_op_{grad_name}")
+        grad_fn.restype = ctypes.c_int
+        grad_fn.argtypes = fn.argtypes
+
+    def run_native(native, in_arrs, out_specs):
+        holder = []
+        ins = (_PTTensor * len(in_arrs))(
+            *[_as_pt(np.ascontiguousarray(a), holder) for a in in_arrs])
+        outs_np = [np.empty(s.shape, s.dtype) for s in out_specs]
+        outs = (_PTTensor * len(outs_np))(
+            *[_as_pt(a, holder) for a in outs_np])
+        rc = native(ins, len(in_arrs), outs, len(outs_np))
+        if rc != 0:
+            raise RuntimeError(f"custom op {op_name} returned {rc}")
+        return outs_np[0] if len(outs_np) == 1 else tuple(outs_np)
+
+    def out_specs_of(*vals):
+        shapes = infer_shape(*[tuple(v.shape) for v in vals])
+        dtypes = infer_dtype(*[str(v.dtype) for v in vals])
+        if not isinstance(shapes, list):
+            shapes = [shapes]
+        if not isinstance(dtypes, list):
+            dtypes = [dtypes]
+        return [jax.ShapeDtypeStruct(tuple(s), np.dtype(d))
+                for s, d in zip(shapes, dtypes)]
+
+    def raw_call(*vals):
+        specs = out_specs_of(*vals)
+        res = jax.pure_callback(
+            lambda *a: run_native(fn, [np.asarray(x) for x in a], specs),
+            specs[0] if len(specs) == 1 else tuple(specs), *vals,
+            vmap_method="sequential")
+        return res
+
+    if grad_fn is not None:
+        @jax.custom_vjp
+        def op_impl(*vals):
+            return raw_call(*vals)
+
+        def fwd(*vals):
+            return raw_call(*vals), vals
+
+        def bwd(res, g):
+            vals = res
+            gspecs = [jax.ShapeDtypeStruct(tuple(v.shape), v.dtype)
+                      for v in vals]
+            cots = g if isinstance(g, (tuple, list)) else (g,)
+            grads = jax.pure_callback(
+                lambda *a: run_native(grad_fn,
+                                      [np.asarray(x) for x in a], gspecs),
+                gspecs[0] if len(gspecs) == 1 else tuple(gspecs),
+                *vals, *cots, vmap_method="sequential")
+            return grads if isinstance(grads, tuple) else (grads,)
+
+        op_impl.defvjp(fwd, bwd)
+    else:
+        def op_impl(*vals):
+            return raw_call(*vals)
+
+    def op(*args):
+        tensors = [a if isinstance(a, Tensor)
+                   else Tensor(jnp.asarray(np.asarray(a)), _internal=True)
+                   for a in args]
+        if grad_fn is None:
+            # no grad op registered: detach so the tape never tries to vjp
+            # through the pure_callback (reference custom ops without
+            # PD_BUILD_GRAD_OP are likewise non-differentiable)
+            tensors = [Tensor(t._value, _internal=True) for t in tensors]
+        out = apply_op(op_impl, f"custom_{op_name}", tuple(tensors), {})
+        if grad_fn is None:
+            if isinstance(out, tuple):
+                for o in out:
+                    o.stop_gradient = True
+            else:
+                out.stop_gradient = True
+        return out
+
+    op.__name__ = op_name
+    return op
+
+
+def load(name, sources, functions=None, extra_cxx_cflags=None,
+         build_directory=None, verbose=False, grad_op_map=None,
+         infer_shapes=None, infer_dtypes=None, **kwargs):
+    """cpp_extension.load parity: compile `sources` and return a module-like
+    object exposing one python callable per op in `functions` (list of op
+    names; each C symbol is pt_op_<name>).
+
+    infer_shapes/infer_dtypes: per-op callables mapping input shapes/dtypes
+    to output ones; default = same as first input (the common elementwise
+    case, like the reference's default InferShape).
+    """
+    if not functions:
+        raise ValueError("pass functions=[op_name, ...] (C symbols "
+                         "pt_op_<name> in the sources)")
+    so = _compile(name, sources, extra_flags=tuple(extra_cxx_cflags or ()),
+                  build_directory=build_directory)
+    lib = ctypes.CDLL(so)
+    grad_op_map = grad_op_map or {}
+    infer_shapes = infer_shapes or {}
+    infer_dtypes = infer_dtypes or {}
+    mod = types.SimpleNamespace()
+    for op_name in functions:
+        ishape = infer_shapes.get(op_name, lambda *shapes: shapes[0])
+        idtype = infer_dtypes.get(op_name, lambda *dts: dts[0])
+        setattr(mod, op_name,
+                _make_op(lib, op_name, ishape, idtype,
+                         grad_name=grad_op_map.get(op_name)))
+    mod.__file__ = so
+    return mod
+
+
+def setup(name=None, ext_modules=None, **kwargs):
+    """cpp_extension.setup parity (build-only: compiles the extension into
+    the build dir; import via load())."""
+    exts = ext_modules if isinstance(ext_modules, (list, tuple)) \
+        else [ext_modules]
+    outs = []
+    for i, ext in enumerate(exts):
+        if not isinstance(ext, CppExtension):
+            raise TypeError("ext_modules must be CppExtension instances")
+        ext_name = name or "paddle_tpu_ext"
+        if len(exts) > 1:  # one .so per extension, never overwritten
+            ext_name = f"{ext_name}_{i}"
+        outs.append(_compile(ext_name, ext.sources,
+                             include_dirs=ext.include_dirs,
+                             extra_flags=tuple(ext.extra_compile_args)))
+    return outs

@@ -17,10 +17,11 @@ def get_build_directory() -> str:
 
 
 def build_native_lib(src_path: str, so_name: str,
-                     extra_flags: tuple = ()) -> str:
+                     extra_flags: tuple = (),
+                     build_dir: str | None = None) -> str:
     """Compile `src_path` into <build_dir>/<so_name>; returns the .so path.
     Rebuilds only when the source is newer than the cached artifact."""
-    cache_dir = get_build_directory()
+    cache_dir = build_dir or get_build_directory()
     os.makedirs(cache_dir, exist_ok=True)
     so = os.path.join(cache_dir, so_name)
     if os.path.exists(so) and os.path.getmtime(so) >= \

@@ -1,0 +1,111 @@
+"""cpp_extension tests (reference: fluid/tests/custom_op — build a C++ op at
+test time, run it, check autograd through the custom grad op)."""
+import os
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.utils import cpp_extension
+
+_SRC = textwrap.dedent("""
+    #include "paddle_ext.h"
+    #include <cmath>
+
+    // y = x^3 ; dy/dx = 3x^2
+    PT_BUILD_OP(cube) {
+      if (n_inputs != 1 || n_outputs != 1) return 1;
+      const float* x = static_cast<const float*>(inputs[0].data);
+      float* y = static_cast<float*>(outputs[0].data);
+      int64_t n = 1;
+      for (int d = 0; d < inputs[0].ndim; ++d) n *= inputs[0].shape[d];
+      for (int64_t i = 0; i < n; ++i) y[i] = x[i] * x[i] * x[i];
+      return 0;
+    }
+
+    // grad: inputs = (x, grad_y) -> grad_x
+    PT_BUILD_OP(cube_grad) {
+      if (n_inputs != 2 || n_outputs != 1) return 1;
+      const float* x = static_cast<const float*>(inputs[0].data);
+      const float* gy = static_cast<const float*>(inputs[1].data);
+      float* gx = static_cast<float*>(outputs[0].data);
+      int64_t n = 1;
+      for (int d = 0; d < inputs[0].ndim; ++d) n *= inputs[0].shape[d];
+      for (int64_t i = 0; i < n; ++i) gx[i] = 3.0f * x[i] * x[i] * gy[i];
+      return 0;
+    }
+
+    // pairwise sum with broadcast-free contract: same shapes
+    PT_BUILD_OP(myadd) {
+      if (n_inputs != 2 || n_outputs != 1) return 1;
+      const float* a = static_cast<const float*>(inputs[0].data);
+      const float* b = static_cast<const float*>(inputs[1].data);
+      float* y = static_cast<float*>(outputs[0].data);
+      int64_t n = 1;
+      for (int d = 0; d < inputs[0].ndim; ++d) n *= inputs[0].shape[d];
+      for (int64_t i = 0; i < n; ++i) y[i] = a[i] + b[i];
+      return 0;
+    }
+""")
+
+
+@pytest.fixture(scope="module")
+def ext(tmp_path_factory):
+    d = tmp_path_factory.mktemp("ext")
+    src = d / "my_ops.cpp"
+    src.write_text(_SRC)
+    return cpp_extension.load(
+        name="my_ops", sources=[str(src)],
+        functions=["cube", "myadd"],
+        grad_op_map={"cube": "cube_grad"})
+
+
+def test_custom_op_forward(ext):
+    x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], "float32"))
+    out = ext.cube(x)
+    np.testing.assert_allclose(out.numpy(), [1.0, 8.0, 27.0])
+
+    a = paddle.to_tensor(np.full((2, 3), 2.0, "float32"))
+    b = paddle.to_tensor(np.full((2, 3), 5.0, "float32"))
+    np.testing.assert_allclose(ext.myadd(a, b).numpy(), np.full((2, 3), 7.0))
+
+
+def test_custom_op_grad(ext):
+    x = paddle.to_tensor(np.array([1.0, 2.0], "float32"))
+    x.stop_gradient = False
+    out = ext.cube(x)
+    out.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [3.0, 12.0])
+
+
+def test_custom_op_without_grad_stops_gradient(ext):
+    x = paddle.to_tensor(np.ones((2,), "float32"))
+    x.stop_gradient = False
+    out = ext.myadd(x, x)
+    assert out.stop_gradient
+
+
+def test_custom_op_under_jit(ext):
+    import jax
+
+    @paddle.jit.to_static
+    def f(x):
+        return ext.cube(x) * 2
+
+    x = paddle.to_tensor(np.array([2.0], "float32"))
+    np.testing.assert_allclose(f(x).numpy(), [16.0])
+
+
+def test_setup_builds(tmp_path):
+    src = tmp_path / "noop.cpp"
+    src.write_text(_SRC)
+    outs = cpp_extension.setup(
+        name="noop_ext",
+        ext_modules=cpp_extension.CppExtension(sources=[str(src)]))
+    assert outs and os.path.exists(outs[0])
+
+
+def test_cuda_extension_rejected():
+    with pytest.raises(RuntimeError, match="Pallas"):
+        cpp_extension.CUDAExtension(sources=["x.cu"])
