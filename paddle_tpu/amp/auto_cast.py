@@ -19,7 +19,8 @@ white_list = {"matmul", "mm", "bmm", "mv", "conv1d", "conv2d", "conv3d",
               "linear", "einsum", "attention", "scaled_dot_product_attention"}
 black_list = {"exp", "square", "log", "mean", "sum", "cos_sim", "softmax",
               "log_softmax", "softmax_with_cross_entropy", "sigmoid_cross_entropy_with_logits",
-              "cross_entropy", "layer_norm", "batch_norm", "reduce_sum", "pow"}
+              "cross_entropy", "fused_nll_loss", "layer_norm", "batch_norm",
+              "reduce_sum", "pow"}
 
 
 class _AmpState(threading.local):

@@ -288,23 +288,10 @@ class BertPretrainingCriterion(Layer):
 
     def forward(self, prediction_logits, nsp_logits, masked_lm_labels,
                 next_sentence_labels=None):
-        from ..core.op import apply_op
-
-        def raw(logits, labels):
-            import jax
-            import jax.numpy as jnp
-            v = logits.shape[-1]
-            flat = logits.reshape(-1, v).astype(jnp.float32)
-            lab = labels.reshape(-1)
-            valid = lab != -100
-            safe = jnp.clip(lab, 0, v - 1)
-            logp = jax.nn.log_softmax(flat, axis=-1)
-            nll = -jnp.take_along_axis(logp, safe[:, None], axis=1)[:, 0]
-            nll = jnp.where(valid, nll, 0.0)
-            return nll.sum() / jnp.maximum(valid.sum(), 1)
-
-        loss = apply_op(raw, "mlm_loss",
-                        (prediction_logits, masked_lm_labels), {})
+        nll = F.fused_nll_loss(prediction_logits, masked_lm_labels,
+                               ignore_index=-100)
+        valid = (masked_lm_labels != -100).astype("float32")
+        loss = nll.reshape([-1]).sum() / valid.sum().clip(min=1.0)
         if next_sentence_labels is not None:
             nsp = F.cross_entropy(nsp_logits,
                                   next_sentence_labels.reshape([-1]))

@@ -312,10 +312,8 @@ class GPTPretrainingCriterion(Layer):
         if self.parallel_loss is not None:
             loss = self.parallel_loss(prediction_scores, masked_lm_labels)
         else:
-            loss = F.cross_entropy(prediction_scores,
-                                   masked_lm_labels.unsqueeze(-1),
-                                   ignore_index=self.ignore_index,
-                                   reduction="none", axis=-1)
+            loss = F.fused_nll_loss(prediction_scores, masked_lm_labels,
+                                    ignore_index=self.ignore_index)
         loss = loss.reshape([-1]).astype("float32")
         if loss_mask is not None:
             m = loss_mask.reshape([-1]).astype("float32")

@@ -16,6 +16,26 @@ def _reduce(val, reduction):
     return val
 
 
+def fused_nll_loss(logits, labels, ignore_index=-100):
+    """Fused logsumexp-gather NLL over the last axis: per-position losses
+    [..., ] in fp32, zeros at ignored labels.
+
+    Never materializes the [..., V] log-softmax (or an fp32 logits copy) —
+    on TPU this recovers the whole LM loss-head cost (the fused form matches
+    the no-loss throughput ceiling on the GPT bench).  Ignored positions use
+    `where`, so NaN/Inf rows with ignore_index labels can't poison the loss.
+    """
+    def raw(lg, lb):
+        lse = jax.nn.logsumexp(lg.astype(jnp.float32), axis=-1)
+        valid = lb != ignore_index
+        safe = jnp.where(valid, lb, 0)
+        tgt = jnp.take_along_axis(
+            lg, safe[..., None], axis=-1)[..., 0].astype(jnp.float32)
+        return jnp.where(valid, lse - tgt, 0.0)
+
+    return apply_op(raw, "fused_nll_loss", (logits, labels), {})
+
+
 @defop
 def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",  # noqa: A002
                   soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0,

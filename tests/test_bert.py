@@ -85,6 +85,28 @@ def test_bert_pretraining_heads_and_loss():
     assert float(loss2.numpy()) < float(loss.numpy())
 
 
+def test_fused_nll_loss_nan_at_ignored_position():
+    """NaN logits at ignore_index positions must not poison the loss
+    (regression: multiply-masking propagated NaN*0)."""
+    import paddle_tpu.nn.functional as F
+    logits = np.random.RandomState(0).randn(2, 4, 8).astype("float32")
+    logits[0, 1] = np.nan
+    labels = np.random.RandomState(1).randint(0, 8, (2, 4)).astype("int64")
+    labels[0, 1] = -100
+    out = F.fused_nll_loss(paddle.to_tensor(logits),
+                           paddle.to_tensor(labels))
+    assert np.isfinite(out.numpy()).all()
+    # parity with the reference cross_entropy on clean input
+    clean = np.random.RandomState(2).randn(3, 5, 7).astype("float32")
+    lab = np.random.RandomState(3).randint(0, 7, (3, 5)).astype("int64")
+    a = F.fused_nll_loss(paddle.to_tensor(clean),
+                         paddle.to_tensor(lab)).numpy()
+    b = F.cross_entropy(paddle.to_tensor(clean),
+                        paddle.to_tensor(lab[..., None]),
+                        reduction="none", axis=-1).numpy().reshape(3, 5)
+    np.testing.assert_allclose(a, b, rtol=1e-5)
+
+
 def test_bert_trains():
     paddle.seed(2)
     model = build_bert("bert-tiny", hidden_dropout_prob=0.0,
