@@ -24,6 +24,8 @@ from .shufflenetv2 import (  # noqa: F401
 )
 from .squeezenet import SqueezeNet, squeezenet1_0, squeezenet1_1  # noqa: F401
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
+from .yolo import YOLOv3, YOLOv3Loss, yolov3  # noqa: F401
+from .crnn import CRNN, CTCHeadLoss, crnn, ctc_greedy_decode  # noqa: F401
 
 __all__ = [  # noqa: F405
     "ResNet", "resnet18", "resnet34", "resnet50", "resnet101", "resnet152",
@@ -42,4 +44,6 @@ __all__ = [  # noqa: F405
     "shufflenet_v2_x0_5", "shufflenet_v2_x1_0", "shufflenet_v2_x1_5",
     "shufflenet_v2_x2_0", "shufflenet_v2_swish",
     "GoogLeNet", "googlenet", "InceptionV3", "inception_v3",
+    "YOLOv3", "YOLOv3Loss", "yolov3",
+    "CRNN", "CTCHeadLoss", "crnn", "ctc_greedy_decode",
 ]

@@ -1,0 +1,91 @@
+"""CRNN text recognizer — the PP-OCRv3-class recognition config from the
+BASELINE matrix (conv feature extractor → BiLSTM sequence encoder → CTC
+head; the reference recipe lives in PaddleOCR, built here from the in-repo
+layer corpus + F.ctc_loss).
+"""
+from __future__ import annotations
+
+from ... import nn
+
+
+class CRNN(nn.Layer):
+    """Input [N, C, H, W] (H fixed, e.g. 32) → logits [N, W/4, num_classes]
+    for CTC (class 0 = blank, reference convention)."""
+
+    def __init__(self, num_classes, in_channels=1, hidden_size=96,
+                 channels=(32, 64, 128), img_h=32):
+        super().__init__()
+        if img_h % 8 != 0:
+            raise ValueError("img_h must be divisible by 8")
+        c1, c2, c3 = channels
+        self.convs = nn.Sequential(
+            nn.Conv2D(in_channels, c1, 3, padding=1), nn.BatchNorm2D(c1),
+            nn.ReLU(), nn.MaxPool2D(2, 2),                  # H/2, W/2
+            nn.Conv2D(c1, c2, 3, padding=1), nn.BatchNorm2D(c2),
+            nn.ReLU(), nn.MaxPool2D(2, 2),                  # H/4, W/4
+            nn.Conv2D(c2, c3, 3, padding=1), nn.BatchNorm2D(c3),
+            nn.ReLU(), nn.MaxPool2D(kernel_size=(2, 1), stride=(2, 1)),
+        )                                                   # H/8, W/4
+        self.img_h = img_h
+        feat_dim = c3 * (img_h // 8)
+        self.lstm = nn.LSTM(feat_dim, hidden_size, direction="bidirect")
+        self.fc = nn.Linear(2 * hidden_size, num_classes)
+
+    def forward(self, x):
+        if x.shape[2] != self.img_h:
+            raise ValueError(
+                f"CRNN built for input height {self.img_h}, got {x.shape[2]}")
+        f = self.convs(x)                      # [N, C, H', W']
+        n, c, h, w = f.shape
+        f = f.transpose([0, 3, 1, 2]).reshape([n, w, c * h])  # [N, T, C*H']
+        out, _ = self.lstm(f)                  # [N, T, 2*hidden]
+        return self.fc(out)                    # [N, T, num_classes]
+
+
+class CTCHeadLoss(nn.Layer):
+    """CTC loss over CRNN logits (F.ctc_loss; blank=0)."""
+
+    def __init__(self, blank=0):
+        super().__init__()
+        self.blank = blank
+
+    def forward(self, logits, labels, input_lengths=None, label_lengths=None):
+        import numpy as np
+
+        import paddle_tpu as paddle
+        import paddle_tpu.nn.functional as F
+
+        n, t, _ = logits.shape
+        if input_lengths is None:
+            input_lengths = paddle.to_tensor(np.full((n,), t, "int64"))
+        if label_lengths is None:
+            label_lengths = paddle.to_tensor(
+                np.full((n,), labels.shape[1], "int64"))
+        # pass batch-first [N,T,C]: F.ctc_loss's layout detection handles
+        # the time-major swap itself (pre-transposing breaks when T == N)
+        return F.ctc_loss(logits, labels, input_lengths, label_lengths,
+                          blank=self.blank, reduction="mean")
+
+
+def crnn(num_classes, pretrained=False, **kwargs):
+    if pretrained:
+        raise ValueError("pretrained weights are not bundled; load a local "
+                         "state_dict instead")
+    return CRNN(num_classes, **kwargs)
+
+
+def ctc_greedy_decode(logits, blank=0):
+    """Collapse repeats then drop blanks (PP-OCR greedy decoder)."""
+    import numpy as np
+
+    ids = logits.numpy().argmax(-1)  # [N, T]
+    results = []
+    for row in ids:
+        out = []
+        prev = -1
+        for tok in row:
+            if tok != prev and tok != blank:
+                out.append(int(tok))
+            prev = tok
+        results.append(out)
+    return results

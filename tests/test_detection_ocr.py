@@ -1,0 +1,126 @@
+"""YOLO detector + CRNN recognizer tests (BASELINE matrix: PP-YOLOE /
+PP-OCR-class models train and export through the predictor path)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision.models import (CRNN, CTCHeadLoss, YOLOv3, YOLOv3Loss,
+                                      crnn, ctc_greedy_decode, yolov3)
+
+
+def test_yolo_head_shapes():
+    paddle.seed(0)
+    model = yolov3(num_classes=4, width=16, neck_channel=32)
+    model.eval()
+    x = paddle.to_tensor(np.random.RandomState(0).randn(1, 3, 128, 128)
+                         .astype("float32"))
+    heads = model(x)
+    assert len(heads) == 3
+    # strides 8/16/32 → 16/8/4 cells; 3 anchors * (5+4) = 27 channels
+    assert tuple(heads[0].shape) == (1, 27, 16, 16)
+    assert tuple(heads[1].shape) == (1, 27, 8, 8)
+    assert tuple(heads[2].shape) == (1, 27, 4, 4)
+
+    boxes, scores = model.decode(heads,
+                                 paddle.to_tensor(np.array([[128, 128]],
+                                                           "int32")))
+    m = 3 * (16 * 16 + 8 * 8 + 4 * 4)
+    assert tuple(boxes.shape) == (1, m, 4)
+    assert tuple(scores.shape) == (1, m, 4)
+
+
+def test_yolo_predict_returns_rows():
+    paddle.seed(1)
+    model = yolov3(num_classes=3, width=16, neck_channel=32,
+                   conf_thresh=0.0)
+    x = paddle.to_tensor(np.random.RandomState(1).randn(2, 3, 64, 64)
+                         .astype("float32"))
+    results = model.predict(x, paddle.to_tensor(np.array([[64, 64]] * 2,
+                                                         "int32")),
+                            top_k=10)
+    assert len(results) == 2
+    for rows in results:
+        assert rows.shape[1] == 6  # x0 y0 x1 y1 score cls
+        assert rows.shape[0] <= 10
+
+
+def test_yolo_loss_decreases():
+    paddle.seed(2)
+    model = yolov3(num_classes=2, width=16, neck_channel=32)
+    crit = YOLOv3Loss(model)
+    opt = paddle.optimizer.Adam(parameters=model.parameters(),
+                                learning_rate=2e-3)
+    x = np.random.RandomState(2).randn(2, 3, 64, 64).astype("float32")
+    gt = [
+        (np.array([[8, 8, 30, 30]], "float32"), np.array([0])),
+        (np.array([[20, 12, 50, 40], [2, 2, 12, 18]], "float32"),
+         np.array([1, 0])),
+    ]
+    losses = []
+    for _ in range(8):
+        heads = model(paddle.to_tensor(x))
+        loss = crit(heads, gt)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
+
+
+def test_yolo_exports_via_predictor(tmp_path):
+    from paddle_tpu import inference
+    from paddle_tpu.static import InputSpec
+
+    paddle.seed(3)
+    model = yolov3(num_classes=2, width=16, neck_channel=32)
+    model.eval()
+    x_np = np.random.RandomState(3).randn(1, 3, 64, 64).astype("float32")
+    expected = model(paddle.to_tensor(x_np))
+    path = str(tmp_path / "yolo" / "model")
+    paddle.jit.save(model, path,
+                    input_spec=[InputSpec([1, 3, 64, 64], "float32")])
+    cfg = inference.Config(path + ".pdmodel", path + ".pdiparams")
+    pred = inference.create_predictor(cfg)
+    outs = pred.run([x_np])
+    assert len(outs) == 3
+    np.testing.assert_allclose(outs[0], expected[0].numpy(), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_crnn_shapes_and_ctc():
+    paddle.seed(4)
+    model = crnn(num_classes=11, in_channels=1, hidden_size=32,
+                 channels=(8, 16, 32))
+    x = paddle.to_tensor(np.random.RandomState(4).randn(2, 1, 32, 64)
+                         .astype("float32"))
+    logits = model(x)
+    assert tuple(logits.shape) == (2, 16, 11)  # W/4 timesteps
+
+    crit = CTCHeadLoss()
+    labels = paddle.to_tensor(
+        np.random.RandomState(5).randint(1, 11, (2, 5)).astype("int64"))
+    loss = crit(logits, labels)
+    assert np.isfinite(float(loss.numpy()))
+
+
+def test_crnn_learns_sequence():
+    """CRNN + CTC memorizes a tiny fixed image → label pair."""
+    paddle.seed(6)
+    model = crnn(num_classes=5, in_channels=1, hidden_size=24,
+                 channels=(8, 16, 24))
+    crit = CTCHeadLoss()
+    opt = paddle.optimizer.Adam(parameters=model.parameters(),
+                                learning_rate=5e-3)
+    x = np.random.RandomState(6).randn(1, 1, 32, 48).astype("float32")
+    label = np.array([[1, 2, 3]], "int64")
+    losses = []
+    for _ in range(30):
+        logits = model(paddle.to_tensor(x))
+        loss = crit(logits, paddle.to_tensor(label))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0] * 0.5
+    decoded = ctc_greedy_decode(model(paddle.to_tensor(x)))
+    assert decoded[0] == [1, 2, 3]
