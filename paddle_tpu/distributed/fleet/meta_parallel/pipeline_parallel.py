@@ -52,10 +52,23 @@ class PipelineParallel(MetaParallelBase):
         opt = getattr(optimizer, "_inner_opt", optimizer)
         if self._train_step is None:
             loss_fn = self._layers._loss_fn
-            self._train_step = spmd.ShardedTrainStep(
-                self._layers, opt,
-                loss_fn=loss_fn if loss_fn is not None else None,
-                accumulate_steps=self.accumulate_steps)
+            if self.num_stages > 1:
+                # explicit GPipe schedule over the pipe axis (shard_map +
+                # ppermute; distributed/pipeline.py).  Falls back to the
+                # one-GSPMD-program path when the stages aren't uniform.
+                try:
+                    from ...pipeline import (GPipeTrainStep,
+                                             decompose_pipeline_layer)
+                    pre, blocks, post = decompose_pipeline_layer(self._layers)
+                    self._train_step = GPipeTrainStep(
+                        pre, blocks, post, loss_fn, opt,
+                        num_micro=max(2, self.accumulate_steps))
+                except ValueError:
+                    self._train_step = None
+            if self._train_step is None:
+                self._train_step = spmd.ShardedTrainStep(
+                    self._layers, opt, loss_fn=loss_fn,
+                    accumulate_steps=self.accumulate_steps)
         batch = (inputs, labels) if labels is not None else (inputs,)
         loss = self._train_step(*batch)
         if lr_scheduler is not None:

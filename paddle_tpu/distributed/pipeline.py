@@ -1,0 +1,347 @@
+"""Explicit pipeline-parallel schedule — SURVEY §7 hard-part #1.
+
+The reference hand-schedules micro-batch NCCL p2p between per-stage
+processes (pipeline_parallel.py:108 1F1B, section_worker.cc:144/159).  The
+TPU-native equivalent implemented here is the shard_map GPipe schedule:
+
+* the repeated transformer blocks are STACKED along a leading layer dim and
+  sharded over the `pipe` mesh axis — each pipe rank holds 1/S of the depth;
+* one jitted program runs M + S - 1 "ticks"; at each tick every stage runs
+  its local blocks and hands activations to the next stage with a single
+  `lax.ppermute` (an ICI neighbor exchange, overlapped by XLA);
+* differentiating straight through the schedule gives the reverse pipeline
+  (ppermute's transpose is the inverted permute), so backward pipelines too
+  — bubble fraction (S-1)/(M+S-1), the GPipe figure;
+* the heterogeneous ends (embedding before, norm+head after) run OUTSIDE the
+  shard_map in plain GSPMD, where XLA shards them over dp/mp as usual.
+
+This composes with the other mesh axes: TP layers inside the blocks see the
+`mp` axis bound and take their shard_map collective path; the batch stays
+sharded over `dp`.
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core import random as random_mod
+from ..core.tensor import Tensor
+from ..nn.functional_call import functional_call, state_values
+from . import mesh as mesh_mod
+
+
+def _stack_blocks(blocks):
+    """Per-block state dicts → {name: [L, ...]} stacked leaves.  All blocks
+    must be structurally identical (the GPipe contract)."""
+    dicts = [state_values(b) for b in blocks]
+    keys = list(dicts[0])
+    for d in dicts[1:]:
+        if list(d) != keys:
+            raise ValueError(
+                "pipeline blocks are not structurally identical; explicit "
+                "pipeline needs uniform stages (reference segments by layer "
+                "count for the same reason)")
+    return {k: jnp.stack([d[k] for d in dicts]) for k in keys}
+
+
+class GPipeTrainStep:
+    """Compiled train step with an explicit GPipe schedule over `pipe`.
+
+    model parts: `pre` (first-stage-only layers, e.g. embeddings), `blocks`
+    (list of identical Layers, len divisible by the pipe degree), `post`
+    (last-stage layers, e.g. final norm + head).  `loss_fn(out, *labels)`.
+    """
+
+    def __init__(self, pre, blocks, post, loss_fn, optimizer, mesh=None,
+                 num_micro=4, pipe_axis=None, compute_dtype=None):
+        self.mesh = mesh or mesh_mod.get_global_mesh()
+        if pipe_axis is None and self.mesh is not None:
+            pipe_axis = next((a for a in ("pipe", "pp")
+                              if a in self.mesh.axis_names), "pipe")
+        if self.mesh is None or pipe_axis not in self.mesh.axis_names:
+            raise ValueError(f"GPipe needs a mesh with a {pipe_axis!r} axis")
+        self.S = self.mesh.shape[pipe_axis]
+        if len(blocks) % self.S != 0:
+            raise ValueError(
+                f"{len(blocks)} blocks not divisible by pipe degree {self.S}")
+        self.pre, self.blocks, self.post = pre, list(blocks), post
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.num_micro = num_micro
+        self.pipe_axis = pipe_axis
+        self.compute_dtype = compute_dtype
+        self._template = blocks[0]
+
+        # entry metadata from the live layers: trainable mask, per-param
+        # decay/lr attrs, and any TP PartitionSpec tags — the same contracts
+        # ShardedTrainStep honors
+        self._meta = {}
+        for grp, layer in (("pre", pre), ("blocks", self._template),
+                           ("post", post)):
+            entries = layer.state_dict()
+            self._meta[grp] = {
+                k: {
+                    "trainable": not t.stop_gradient,
+                    "decay": optimizer._decay_coeff(t),
+                    "lr": (t.optimize_attr or {}).get("learning_rate", 1.0)
+                    if getattr(t, "optimize_attr", None) else 1.0,
+                    "spec": self._clean_spec(
+                        getattr(t, "_partition_spec", None)),
+                } for k, t in entries.items()}
+
+        raw = {
+            "pre": state_values(pre),
+            "blocks": _stack_blocks(blocks),
+            "post": state_values(post),
+        }
+
+        def leaf_spec(grp, k):
+            tp = self._meta[grp][k]["spec"]
+            if grp == "blocks":  # stacked layer dim leads, sharded over pipe
+                return P(self.pipe_axis, *tuple(tp))
+            return tp
+
+        self._specs = {grp: {k: leaf_spec(grp, k) for k in tree}
+                       for grp, tree in raw.items()}
+        placed = {grp: {k: jax.device_put(
+            v, NamedSharding(self.mesh, self._specs[grp][k]))
+            for k, v in tree.items()} for grp, tree in raw.items()}
+        # trainable/buffer split: buffers ride along read-only (BN-style
+        # running-stat mutation inside the schedule is not supported)
+        self.params = {grp: {k: v for k, v in tree.items()
+                             if self._meta[grp][k]["trainable"]}
+                       for grp, tree in placed.items()}
+        self.buffers = {grp: {k: v for k, v in tree.items()
+                              if not self._meta[grp][k]["trainable"]}
+                        for grp, tree in placed.items()}
+        self.slots = {
+            grp: {k: {s: jax.device_put(
+                v, NamedSharding(self.mesh, self._specs[grp][k]))
+                for s, v in optimizer.init_slots(val).items()}
+                for k, val in tree.items()}
+            for grp, tree in self.params.items()
+        }
+        self.step_count = jnp.zeros((), jnp.int32)
+        self._jitted = None
+        self._num_micro_eff = None
+
+    def _clean_spec(self, spec) -> P:
+        if spec is None:
+            return P()
+        cleaned = []
+        for s in spec:
+            axes = s if isinstance(s, tuple) else (s,)
+            kept = tuple(a for a in axes if a in self.mesh.axis_names and
+                         self.mesh.shape.get(a, 1) > 1)
+            cleaned.append(kept[0] if len(kept) == 1 else (kept or None))
+        return P(*cleaned)
+
+    # -- the pipelined block stack (runs inside shard_map) -------------------
+    def _make_pipeline_fn(self, M):
+        template = self._template
+        S, axis = self.S, self.pipe_axis
+        perm = [(i, (i + 1) % S) for i in range(S)]
+
+        def block_apply(x, layer_values):
+            out, _ = functional_call(template, layer_values,
+                                     (Tensor(x, _internal=True),))
+            return out._value if isinstance(out, Tensor) else out
+
+        def local_stage(x, local_params):
+            # scan over this stage's L/S layers
+            def body(h, layer_vals):
+                return block_apply(h, layer_vals), None
+
+            out, _ = jax.lax.scan(body, x, local_params)
+            return out
+
+        def pipeline(h, block_params):
+            # h: LOCAL activations [B_loc, T, H]; block_params leaves
+            # [L/S, ...] (this stage's slice)
+            s = jax.lax.axis_index(axis)
+            b_loc = h.shape[0]
+            if b_loc % M:
+                raise ValueError(
+                    f"local batch {b_loc} not divisible by num_micro {M}")
+            mb = b_loc // M
+            u = h.reshape(M, mb, *h.shape[1:])
+            zero = jnp.zeros_like(u[0])
+            outputs0 = jnp.zeros_like(u)
+
+            def tick(carry, t):
+                cur_out, outputs = carry
+                recv = jax.lax.ppermute(cur_out, axis, perm)
+                inject = u[jnp.clip(t, 0, M - 1)]
+                x_in = jnp.where(s == 0, inject, recv)
+                y = local_stage(x_in, block_params)
+                out_t = t - (S - 1)
+                write = (s == S - 1) & (out_t >= 0) & (out_t < M)
+                idx = jnp.clip(out_t, 0, M - 1)
+                slot = jnp.where(write, y, outputs[idx])
+                outputs = outputs.at[idx].set(slot)
+                return (y, outputs), None
+
+            (last, outputs), _ = jax.lax.scan(
+                tick, (zero, outputs0), jnp.arange(M + S - 1))
+            # only the last stage holds real outputs; make the result
+            # pipe-invariant so GSPMD continues cleanly
+            outputs = jnp.where(s == S - 1, outputs, 0.0)
+            outputs = jax.lax.psum(outputs, axis)
+            return outputs.reshape(b_loc, *h.shape[1:])
+
+        return pipeline
+
+    # -- full step -----------------------------------------------------------
+    def _build(self, num_micro):
+        pre, post, loss_fn = self.pre, self.post, self.loss_fn
+        opt = self.optimizer
+        mesh, axis = self.mesh, self.pipe_axis
+        pipeline = self._make_pipeline_fn(num_micro)
+        compute_dtype = self.compute_dtype
+        data_axes = tuple(a for a in ("dp", "sharding")
+                          if a in mesh.axis_names and mesh.shape[a] > 1)
+        batch_axis = data_axes if data_axes else None
+        blk_specs = {k: self._specs["blocks"][k]
+                     for k in set(self.params["blocks"]) |
+                     set(self.buffers["blocks"])}
+        meta = self._meta
+        grad_clip = getattr(opt, "_grad_clip", None)
+        buffers = self.buffers
+
+        def merged(grp, params):
+            vals = dict(buffers[grp])
+            vals.update(params[grp])
+            return vals
+
+        def fwd_loss(params, key, batch):
+            x, y = batch[0], batch[1] if len(batch) > 1 else None
+
+            def cast(tree):
+                if compute_dtype is None:
+                    return tree
+                return {k: (v.astype(compute_dtype)
+                            if jnp.issubdtype(v.dtype, jnp.floating) else v)
+                        for k, v in tree.items()}
+
+            with random_mod.push_key(key):
+                h, _ = functional_call(pre, cast(merged("pre", params)),
+                                       (Tensor(x, _internal=True),))
+                h = h._value if isinstance(h, Tensor) else h
+                blk_vals = cast(merged("blocks", params))
+                h_spec = P(batch_axis, *([None] * (h.ndim - 1)))
+                h = jax.shard_map(
+                    pipeline, mesh=mesh,
+                    in_specs=(h_spec,
+                              {k: blk_specs[k] for k in blk_vals}),
+                    out_specs=h_spec, check_vma=False,
+                )(h, blk_vals)
+                out, _ = functional_call(post, cast(merged("post", params)),
+                                         (Tensor(h, _internal=True),))
+                if loss_fn is not None and y is not None:
+                    loss = loss_fn(out, Tensor(y, _internal=True))
+                else:
+                    loss = out
+            raw = loss._value if isinstance(loss, Tensor) else loss
+            return raw.mean().astype(jnp.float32)
+
+        grad_fn = jax.value_and_grad(fwd_loss)
+
+        def step_fn(params, slots, step, lr, key, batch):
+            loss, grads = grad_fn(params, key, batch)
+            if grad_clip is not None and hasattr(grad_clip, "clip_norm"):
+                sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                         for grp in grads for g in grads[grp].values())
+                scale = jnp.minimum(1.0, grad_clip.clip_norm /
+                                    jnp.maximum(jnp.sqrt(sq), 1e-12))
+                grads = {grp: {k: g * scale for k, g in grads[grp].items()}
+                         for grp in grads}
+            t = step + 1
+            new_params = {}
+            new_slots = {}
+            for grp in params:
+                new_params[grp] = {}
+                new_slots[grp] = {}
+                for k, p in params[grp].items():
+                    m = meta[grp][k]
+                    np_, ns_ = opt.update(p, grads[grp][k].astype(p.dtype),
+                                          slots[grp][k], lr * m["lr"], t,
+                                          {"decay": m["decay"]})
+                    new_params[grp][k] = np_.astype(p.dtype)
+                    new_slots[grp][k] = ns_
+            return new_params, new_slots, t, loss
+
+        return jax.jit(step_fn, donate_argnums=(0, 1))
+
+    def _pick_num_micro(self, local_batch: int) -> int:
+        """Largest M ≤ requested that divides the local batch (≥1) — a
+        non-divisible config degrades gracefully instead of crashing."""
+        m = min(self.num_micro, local_batch)
+        while m > 1 and local_batch % m:
+            m -= 1
+        return max(m, 1)
+
+    def __call__(self, *batch):
+        vals = []
+        data_axes = tuple(a for a in ("dp", "sharding")
+                          if a in self.mesh.axis_names and
+                          self.mesh.shape[a] > 1)
+        for b in batch:
+            v = b._value if isinstance(b, Tensor) else jnp.asarray(b)
+            vals.append(jax.device_put(
+                v, NamedSharding(self.mesh, P(data_axes or None))))
+        if self._jitted is None:
+            n_data = 1
+            for a in data_axes:
+                n_data *= self.mesh.shape[a]
+            local_batch = max(vals[0].shape[0] // n_data, 1)
+            self._num_micro_eff = self._pick_num_micro(local_batch)
+            self._jitted = self._build(self._num_micro_eff)
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        key = jax.random.key(np.random.randint(0, 2 ** 31 - 1))
+        self.params, self.slots, self.step_count, loss = self._jitted(
+            self.params, self.slots, self.step_count, lr, key, tuple(vals))
+        self.optimizer._step_count += 1
+        return Tensor(loss, _internal=True)
+
+    def sync_to_model(self):
+        """Write trained values back into the eager layers (unstacking the
+        block dimension)."""
+        for grp, layer in (("pre", self.pre), ("post", self.post)):
+            sd = layer.state_dict()
+            for k, v in self.params[grp].items():
+                sd[k]._replace_(jnp.copy(v), None)
+        for i, block in enumerate(self.blocks):
+            sd = block.state_dict()
+            for k, stacked in self.params["blocks"].items():
+                sd[k]._replace_(jnp.copy(stacked[i]), None)
+
+
+def decompose_pipeline_layer(pipe_layer):
+    """Split a PipelineLayer's run_function into (pre, blocks, post): the
+    maximal run of same-typed Layers is the block stack; everything before/
+    after goes to the heterogeneous ends."""
+    from ..nn.layer_base import Layer
+    from ..nn.layer.container import Sequential
+
+    entries = [l for l, fwd in pipe_layer.run_function]
+    # find the longest run of identical types
+    best = (0, 0)
+    i = 0
+    while i < len(entries):
+        j = i
+        while j < len(entries) and isinstance(entries[j], Layer) and \
+                type(entries[j]) is type(entries[i]):
+            j += 1
+        if j - i > best[1] - best[0]:
+            best = (i, j)
+        i = max(j, i + 1)
+    lo, hi = best
+    if hi - lo < 2:
+        raise ValueError("no uniform block run found for explicit pipelining")
+    pre = Sequential(*entries[:lo]) if lo else Sequential()
+    post = Sequential(*entries[hi:]) if hi < len(entries) else Sequential()
+    return pre, entries[lo:hi], post
