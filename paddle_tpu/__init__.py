@@ -51,6 +51,13 @@ from . import text  # noqa: E402,F401
 from . import audio  # noqa: E402,F401
 from . import sparse  # noqa: E402,F401
 from . import utils  # noqa: E402,F401
+from . import fft  # noqa: E402,F401
+from . import signal  # noqa: E402,F401
+# `from .ops import *` already bound the name `linalg` to ops.linalg, which
+# makes `from . import linalg` a no-op; import the namespace module explicitly
+import importlib as _importlib  # noqa: E402
+
+linalg = _importlib.import_module(".linalg", __name__)
 from .hapi import Model  # noqa: E402,F401
 from . import callbacks  # noqa: E402,F401
 from .distributed.parallel import DataParallel  # noqa: E402,F401

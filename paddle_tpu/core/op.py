@@ -59,6 +59,8 @@ def _is_tensor(x):
 
 def _wrap_outputs(out, node):
     """Wrap raw op results back into Tensors, attaching grad-node slots."""
+    if isinstance(out, tuple) and hasattr(out, "_fields"):
+        out = tuple(out)  # namedtuple results (jnp.linalg.svd/qr/...)
     stop = node is None
 
     def wrap(slot, val):
@@ -124,9 +126,13 @@ def apply_op(fn, name, args, kwargs):
                 vals[i] = amp_cast(vals[i])
         a, k = jtu.tree_unflatten(treedef, vals)
         out = fn(*a, **k)
-        # normalize: multi-result primitive binds return lists; backward sends
-        # tuple cotangents, and jax.vjp requires matching tree types
-        return tuple(out) if isinstance(out, list) else out
+        # normalize: multi-result primitive binds return lists, linalg ops
+        # return namedtuples; backward sends tuple cotangents and jax.vjp
+        # requires matching tree types
+        if isinstance(out, list) or (isinstance(out, tuple) and
+                                     hasattr(out, "_fields")):
+            out = tuple(out)
+        return out
 
     primals = [raw[p] for p in diff_pos]
     out, vjp_fn = jax.vjp(closure, *primals)

@@ -63,7 +63,8 @@ class PipelineParallel(MetaParallelBase):
                     self._train_step = GPipeTrainStep(
                         pre, blocks, post, loss_fn, opt,
                         num_micro=max(2, self.accumulate_steps))
-                except ValueError:
+                except (ValueError, AttributeError, TypeError):
+                    # non-uniform / shared / callable stages: GSPMD path
                     self._train_step = None
             if self._train_step is None:
                 self._train_step = spmd.ShardedTrainStep(

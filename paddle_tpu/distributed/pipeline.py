@@ -293,13 +293,15 @@ class GPipeTrainStep:
             v = b._value if isinstance(b, Tensor) else jnp.asarray(b)
             vals.append(jax.device_put(
                 v, NamedSharding(self.mesh, P(data_axes or None))))
-        if self._jitted is None:
-            n_data = 1
-            for a in data_axes:
-                n_data *= self.mesh.shape[a]
-            local_batch = max(vals[0].shape[0] // n_data, 1)
-            self._num_micro_eff = self._pick_num_micro(local_batch)
-            self._jitted = self._build(self._num_micro_eff)
+        n_data = 1
+        for a in data_axes:
+            n_data *= self.mesh.shape[a]
+        local_batch = max(vals[0].shape[0] // n_data, 1)
+        m_eff = self._pick_num_micro(local_batch)
+        if self._jitted is None or self._num_micro_eff != m_eff:
+            # per-batch-size micro count (e.g. a smaller trailing batch)
+            self._num_micro_eff = m_eff
+            self._jitted = self._build(m_eff)
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         key = jax.random.key(np.random.randint(0, 2 ** 31 - 1))
         self.params, self.slots, self.step_count, loss = self._jitted(
@@ -327,7 +329,20 @@ def decompose_pipeline_layer(pipe_layer):
     from ..nn.layer_base import Layer
     from ..nn.layer.container import Sequential
 
+    if any(fwd is not None for _, fwd in pipe_layer.run_function):
+        raise ValueError(
+            "PipelineLayer uses custom forward_funcs (shared/tied layers); "
+            "the explicit GPipe schedule can't preserve those semantics — "
+            "falling back to the one-program GSPMD path")
+    if getattr(pipe_layer, "_shared", None):
+        raise ValueError(
+            "PipelineLayer has SharedLayerDescs (tied weights across "
+            "stages); explicit GPipe would untie them — falling back")
     entries = [l for l, fwd in pipe_layer.run_function]
+    if not all(isinstance(e, Layer) for e in entries):
+        raise ValueError(
+            "PipelineLayer contains bare callables; explicit GPipe needs "
+            "Layer entries — falling back")
     # find the longest run of identical types
     best = (0, 0)
     i = 0

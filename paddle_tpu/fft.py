@@ -1,0 +1,74 @@
+"""paddle.fft parity (python/paddle/fft.py — the pocketfft-backed op family;
+here jnp.fft, which XLA lowers to the TPU FFT custom-call)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.op import apply_op
+from .core.tensor import Tensor
+
+__all__ = ["fft", "ifft", "rfft", "irfft", "hfft", "ihfft", "fft2", "ifft2",
+           "rfft2", "irfft2", "fftn", "ifftn", "rfftn", "irfftn",
+           "fftfreq", "rfftfreq", "fftshift", "ifftshift"]
+
+
+def _norm(norm):
+    return None if norm in (None, "backward") else norm
+
+
+def _wrap1(jfn, op_name):
+    def fn(x, n=None, axis=-1, norm="backward", name=None):
+        return apply_op(lambda v: jfn(v, n=n, axis=axis, norm=_norm(norm)),
+                        op_name, (x,), {})
+    fn.__name__ = op_name
+    return fn
+
+
+def _wrap2(jfn, op_name):
+    def fn(x, s=None, axes=(-2, -1), norm="backward", name=None):
+        return apply_op(lambda v: jfn(v, s=s, axes=axes, norm=_norm(norm)),
+                        op_name, (x,), {})
+    fn.__name__ = op_name
+    return fn
+
+
+def _wrapn(jfn, op_name):
+    def fn(x, s=None, axes=None, norm="backward", name=None):
+        return apply_op(lambda v: jfn(v, s=s, axes=axes, norm=_norm(norm)),
+                        op_name, (x,), {})
+    fn.__name__ = op_name
+    return fn
+
+
+fft = _wrap1(jnp.fft.fft, "fft")
+ifft = _wrap1(jnp.fft.ifft, "ifft")
+rfft = _wrap1(jnp.fft.rfft, "rfft")
+irfft = _wrap1(jnp.fft.irfft, "irfft")
+hfft = _wrap1(jnp.fft.hfft, "hfft")
+ihfft = _wrap1(jnp.fft.ihfft, "ihfft")
+fft2 = _wrap2(jnp.fft.fft2, "fft2")
+ifft2 = _wrap2(jnp.fft.ifft2, "ifft2")
+rfft2 = _wrap2(jnp.fft.rfft2, "rfft2")
+irfft2 = _wrap2(jnp.fft.irfft2, "irfft2")
+fftn = _wrapn(jnp.fft.fftn, "fftn")
+ifftn = _wrapn(jnp.fft.ifftn, "ifftn")
+rfftn = _wrapn(jnp.fft.rfftn, "rfftn")
+irfftn = _wrapn(jnp.fft.irfftn, "irfftn")
+
+
+def fftfreq(n, d=1.0, dtype="float32", name=None):
+    return Tensor(jnp.fft.fftfreq(n, d).astype(dtype), _internal=True)
+
+
+def rfftfreq(n, d=1.0, dtype="float32", name=None):
+    return Tensor(jnp.fft.rfftfreq(n, d).astype(dtype), _internal=True)
+
+
+def fftshift(x, axes=None, name=None):
+    return apply_op(lambda v: jnp.fft.fftshift(v, axes=axes), "fftshift",
+                    (x,), {})
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply_op(lambda v: jnp.fft.ifftshift(v, axes=axes), "ifftshift",
+                    (x,), {})

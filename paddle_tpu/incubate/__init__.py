@@ -1,6 +1,8 @@
 """paddle.incubate parity namespace (SURVEY §2.3 incubate: MoE expert
 parallelism, fused nn layers, distributed models)."""
+from . import asp  # noqa: F401
 from . import autograd  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import distributed  # noqa: F401
 from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401

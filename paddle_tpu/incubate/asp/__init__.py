@@ -1,0 +1,109 @@
+"""ASP (Automatic SParsity) — parity with the reference incubate/asp/
+(2:4 structured sparsity masks + OptimizerWithSparsityGuarantee; the CUDA
+side uses cuSPARSELt, on TPU the mask is a plain elementwise multiply XLA
+fuses into the consumer matmul).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor
+
+__all__ = ["calculate_density", "create_mask", "check_mask_2d", "prune_model",
+           "decorate", "OptimizerWithSparsityGuarantee", "reset_excluded_layers",
+           "set_excluded_layers"]
+
+_EXCLUDED: set = set()
+# param id -> mask, filled by prune_model; consulted by every
+# OptimizerWithSparsityGuarantee so decorate-before-prune (the reference's
+# canonical order) still keeps sparsity after steps
+_MASK_REGISTRY: dict = {}
+
+
+def calculate_density(x) -> float:
+    arr = x.numpy() if isinstance(x, Tensor) else np.asarray(x)
+    return float((arr != 0).sum() / arr.size)
+
+
+def create_mask(tensor, func_name="mask_2d_best", n=2, m=4):
+    """2:4 (n-of-m) mask along the last dim: keep the n largest-|w| entries
+    of every m-group."""
+    arr = tensor.numpy() if isinstance(tensor, Tensor) else np.asarray(tensor)
+    if arr.ndim < 1 or arr.shape[-1] % m:
+        return np.ones_like(arr)
+    groups = np.abs(arr).reshape(-1, m)
+    order = np.argsort(-groups, axis=1)[:, :n]
+    mask = np.zeros_like(groups)
+    np.put_along_axis(mask, order, 1.0, axis=1)
+    return mask.reshape(arr.shape).astype(arr.dtype)
+
+
+def check_mask_2d(mask, n=2, m=4) -> bool:
+    arr = np.asarray(mask)
+    if arr.shape[-1] % m:
+        return False
+    groups = arr.reshape(-1, m)
+    return bool((groups.sum(axis=1) == n).all())
+
+
+def set_excluded_layers(param_names, main_program=None):
+    _EXCLUDED.update(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    _EXCLUDED.clear()
+
+
+def _prunable(p) -> bool:
+    return (not p.stop_gradient and p.name not in _EXCLUDED and
+            len(p.shape) == 2 and p.shape[-1] % 4 == 0)
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_2d_best", with_mask=True):
+    """Apply n:m masks to every prunable 2-D weight; returns {name: mask}."""
+    masks = {}
+    for p in model.parameters():
+        if not _prunable(p):
+            continue
+        mask = create_mask(p, n=n, m=m)
+        p._replace_(p._value * jnp.asarray(mask), None)
+        masks[p.name] = mask
+        _MASK_REGISTRY[id(p)] = jnp.asarray(mask)
+    return masks
+
+
+class OptimizerWithSparsityGuarantee:
+    """Reference ASPHelper.decorate result: after each optimizer step the
+    masks are re-applied so pruned entries stay zero."""
+
+    def __init__(self, optimizer):
+        self._optimizer = optimizer
+        self._masks = {}  # id(param) -> jnp mask
+
+    def _register(self, masks_by_param):
+        self._masks = {id(p): jnp.asarray(m) for p, m in masks_by_param}
+
+    def step(self):
+        self._optimizer.step()
+        for p in self._optimizer._parameters:
+            mask = self._masks.get(id(p))
+            if mask is None:
+                mask = _MASK_REGISTRY.get(id(p))
+            if mask is not None:
+                p._replace_(p._value * mask, None)
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_optimizer"], name)
+
+
+def decorate(optimizer, model=None, n=2, m=4):
+    """asp.decorate parity: wrap the optimizer; if `model` is given, prune it
+    now and register the masks."""
+    wrapped = OptimizerWithSparsityGuarantee(optimizer)
+    if model is not None:
+        masks = prune_model(model, n=n, m=m)
+        by_param = [(p, masks[p.name]) for p in model.parameters()
+                    if p.name in masks]
+        wrapped._register(by_param)
+    return wrapped

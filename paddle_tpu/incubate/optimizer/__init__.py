@@ -1,0 +1,6 @@
+"""paddle.incubate.optimizer parity (SURVEY §2.3 incubate:
+DistributedFusedLamb at incubate/optimizer/distributed_fused_lamb.py:86,
+LookAhead, ModelAverage)."""
+from .lookahead import LookAhead  # noqa: F401
+from .modelaverage import ModelAverage  # noqa: F401
+from .distributed_fused_lamb import DistributedFusedLamb  # noqa: F401

@@ -1,0 +1,30 @@
+"""DistributedFusedLamb — parity with incubate/optimizer/
+distributed_fused_lamb.py:86.
+
+The reference's CUDA kernel (operators/optimizers/distributed_fused_lamb_op.cu)
+flattens all params into one buffer, shards the LAMB math across ranks and
+allgathers results.  Under GSPMD the same schedule falls out of running the
+regular Lamb update with ZeRO-sharded slots (spmd.ShardedTrainStep,
+sharding_stage>=1), so this class is Lamb tagged for slot sharding — the
+compiled step does the shard/allgather.
+"""
+from __future__ import annotations
+
+from ...optimizer.optimizer import Lamb
+
+
+class DistributedFusedLamb(Lamb):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6,
+                 parameters=None, grad_clip=None, exclude_from_weight_decay_fn=None,
+                 clip_after_allreduce=True, is_grad_scaled_by_nranks=True,
+                 use_master_param_norm=True, gradient_accumulation_steps=1,
+                 use_master_acc_grad=True, nproc_per_node=None, name=None):
+        super().__init__(learning_rate=learning_rate,
+                         lamb_weight_decay=lamb_weight_decay,
+                         beta1=beta1, beta2=beta2, epsilon=epsilon,
+                         parameters=parameters, grad_clip=grad_clip,
+                         exclude_from_weight_decay_fn=exclude_from_weight_decay_fn)
+        # consumed by ShardedTrainStep: shard LAMB state over the sharding axis
+        self._sharding_stage = 1
+        self.gradient_accumulation_steps = gradient_accumulation_steps

@@ -1,0 +1,61 @@
+"""LookAhead optimizer — parity with incubate/optimizer/lookahead.py:
+slow weights track fast weights every k steps
+(slow += alpha * (fast - slow); fast = slow)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...optimizer.optimizer import Optimizer
+
+
+class LookAhead(Optimizer):
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        if not isinstance(inner_optimizer, Optimizer):
+            raise TypeError("inner_optimizer must be an Optimizer")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._parameters = inner_optimizer._parameters
+        self._grad_clip = None
+        # slow weights start at the INITIAL fast weights (reference
+        # lookahead.py), so the first k-step sync really interpolates
+        self._slow = {id(p): p._value for p in self._parameters}
+        self._lookahead_step = 0
+        self._step_count = 0
+        self._lr = inner_optimizer._lr
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._step_count = self.inner_optimizer._step_count
+        self._lookahead_step += 1
+        if self._lookahead_step % self.k == 0:
+            for p in self._parameters:
+                slow = self._slow[id(p)]
+                slow = slow + self.alpha * (p._value - slow)
+                self._slow[id(p)] = slow
+                p._replace_(slow, None)
+
+    def clear_grad(self, set_to_zero=True):
+        self.inner_optimizer.clear_grad(set_to_zero)
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def set_lr(self, lr):
+        return self.inner_optimizer.set_lr(lr)
+
+    def minimize(self, loss, *a, **kw):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return None, None
+
+    def state_dict(self):
+        sd = self.inner_optimizer.state_dict()
+        sd["@lookahead_step"] = self._lookahead_step
+        return sd
+
+    def set_state_dict(self, sd):
+        self._lookahead_step = int(sd.pop("@lookahead_step", 0)) \
+            if isinstance(sd, dict) else 0
+        self.inner_optimizer.set_state_dict(sd)

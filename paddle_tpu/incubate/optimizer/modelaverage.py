@@ -1,0 +1,57 @@
+"""ModelAverage — parity with incubate/optimizer/modelaverage.py: keeps a
+running average of parameters over a sliding window; `apply()` swaps the
+averaged weights in (restorable with `restore()`)."""
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+
+
+class ModelAverage:
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self.rate = average_window_rate
+        self.min_window = min_average_window
+        self.max_window = max_average_window
+        self._parameters = list(parameters or [])
+        self._sum = {id(p): jnp.zeros_like(p._value)
+                     for p in self._parameters}
+        self._count = 0
+        self._backup = None
+
+    def step(self):
+        """Accumulate current weights (call after optimizer.step())."""
+        window = max(self.min_window,
+                     min(self.max_window, int(self._count * self.rate) + 1))
+        if self._count >= window:
+            # restart the window (reference resets sums when exceeded)
+            self._sum = {id(p): jnp.zeros_like(p._value)
+                         for p in self._parameters}
+            self._count = 0
+        for p in self._parameters:
+            self._sum[id(p)] = self._sum[id(p)] + p._value
+        self._count += 1
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        """Swap in averaged weights within the context (no-op before any
+        step() has accumulated — never zeroes the live weights)."""
+        if self._count == 0:
+            yield
+            return
+        self._backup = {id(p): p._value for p in self._parameters}
+        for p in self._parameters:
+            p._replace_(self._sum[id(p)] / self._count, None)
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        if self._backup is not None:
+            for p in self._parameters:
+                p._replace_(self._backup[id(p)], None)
+            self._backup = None

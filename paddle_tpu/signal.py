@@ -1,0 +1,92 @@
+"""paddle.signal parity (python/paddle/signal.py): stft / istft over the fft
+family."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.op import apply_op
+from .core.tensor import Tensor
+
+__all__ = ["stft", "istft"]
+
+
+def _window_arr(window, n_fft, win_length, dtype):
+    if window is None:
+        w = jnp.ones((win_length,), dtype)  # rect window of win_length
+    else:
+        w = window._value if isinstance(window, Tensor) \
+            else jnp.asarray(window)
+    if w.shape[0] != n_fft:  # center-pad to n_fft (paddle semantics)
+        lpad = (n_fft - w.shape[0]) // 2
+        w = jnp.pad(w, (lpad, n_fft - w.shape[0] - lpad))
+    return w.astype(dtype)
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """signal.stft parity: x [B, T] (or [T]) → complex [B, F, frames]."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    win = _window_arr(window, n_fft, win_length, jnp.float32)
+
+    def raw(v):
+        squeeze = v.ndim == 1
+        if squeeze:
+            v = v[None]
+        if center:
+            v = jnp.pad(v, ((0, 0), (n_fft // 2, n_fft // 2)), mode=pad_mode)
+        t = v.shape[-1]
+        n_frames = 1 + (t - n_fft) // hop_length
+        idx = (jnp.arange(n_frames)[:, None] * hop_length +
+               jnp.arange(n_fft)[None, :])
+        frames = v[:, idx] * win[None, None, :].astype(v.dtype)
+        if onesided:
+            spec = jnp.fft.rfft(frames, n=n_fft, axis=-1)
+        else:
+            spec = jnp.fft.fft(frames, n=n_fft, axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        spec = jnp.swapaxes(spec, -1, -2)  # [B, F, frames]
+        return spec[0] if squeeze else spec
+
+    return apply_op(raw, "stft", (x,), {})
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """signal.istft parity: complex [B, F, frames] → [B, T] via weighted
+    overlap-add."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    win = _window_arr(window, n_fft, win_length, jnp.float32)
+
+    def raw(v):
+        squeeze = v.ndim == 2
+        if squeeze:
+            v = v[None]
+        spec = jnp.swapaxes(v, -1, -2)  # [B, frames, F]
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        if onesided:
+            frames = jnp.fft.irfft(spec, n=n_fft, axis=-1)
+        else:
+            frames = jnp.fft.ifft(spec, axis=-1).real
+        frames = frames * win[None, None, :]
+        b, n_frames, _ = frames.shape
+        t_len = n_fft + hop_length * (n_frames - 1)
+        out = jnp.zeros((b, t_len), frames.dtype)
+        wsum = jnp.zeros((t_len,), frames.dtype)
+        for i in range(n_frames):  # static unroll; n_frames is static
+            sl = slice(i * hop_length, i * hop_length + n_fft)
+            out = out.at[:, sl].add(frames[:, i])
+            wsum = wsum.at[sl].add(jnp.square(win))
+        out = out / jnp.maximum(wsum, 1e-8)[None, :]
+        if center:
+            out = out[:, n_fft // 2: t_len - n_fft // 2]
+        if length is not None:
+            out = out[:, :length]
+        return out[0] if squeeze else out
+
+    return apply_op(raw, "istft", (x,), {})
