@@ -19,6 +19,8 @@ from .gpt import (  # noqa: F401
     GPTDecoderLayer,
     GPTEmbeddings,
     GPTForPretraining,
+    GPTMoEMLP,
+    GPTMoEPretrainingCriterion,
     GPTModel,
     GPTPretrainingCriterion,
     build_gpt,

@@ -63,6 +63,13 @@ class GPTConfig:
     use_recompute: bool = False
     fuse_qkv: bool = True
     activation: str = "gelu"
+    # MoE (GPT-MoE / GShard-style FFN replacement): 0 = dense FFN
+    moe_num_experts: int = 0
+    moe_top_k: int = 2
+    moe_every_n_layers: int = 2  # every n-th block becomes MoE
+    moe_capacity_factor: float = 1.2
+    moe_aux_loss_weight: float = 0.01
+    moe_gate: str = "gshard"
 
     def __post_init__(self):
         if not self.intermediate_size:
@@ -180,17 +187,46 @@ class GPTMLP(Layer):
         return self.fc1(self.act(self.fc0(x)))
 
 
+class GPTMoEMLP(Layer):
+    """GShard-style FFN: the dense MLP becomes a mixture of expert MLPs with
+    capacity-based token dispatch (GPT-MoE / FleetX moe recipe; backed by
+    incubate MoELayer → all_to_all over the expert axis when bound).  The
+    gate's balance loss is surfaced via `last_aux_loss` and folded into the
+    LM loss by GPTForPretraining."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        from ..incubate.distributed.models.moe import MoELayer
+
+        cf = config.moe_capacity_factor
+        gate = {"type": config.moe_gate}
+        if config.moe_gate == "naive":
+            gate["top_k"] = config.moe_top_k  # gshard/switch fix their own k
+        if config.moe_gate in ("gshard", "switch"):
+            gate["capacity"] = (cf, 2 * cf)  # train/eval caps the gate uses
+        self.moe = MoELayer(
+            config.hidden_size,
+            [GPTMLP(config) for _ in range(config.moe_num_experts)],
+            gate=gate, capacity_factor=cf)
+        self.last_aux_loss = None
+
+    def forward(self, x):
+        out = self.moe(x)
+        self.last_aux_loss = self.moe.gate.get_loss()
+        return out
+
+
 class GPTDecoderLayer(Layer):
     """Pre-LN transformer block (the GPT-2/3 arrangement the reference's
     FusedMultiTransformer implements with normalize_before=True)."""
 
-    def __init__(self, config: GPTConfig):
+    def __init__(self, config: GPTConfig, use_moe: bool = False):
         super().__init__()
         eps = config.layer_norm_epsilon
         self.norm1 = LayerNorm(config.hidden_size, epsilon=eps)
         self.self_attn = GPTSelfAttention(config)
         self.norm2 = LayerNorm(config.hidden_size, epsilon=eps)
-        self.mlp = GPTMLP(config)
+        self.mlp = GPTMoEMLP(config) if use_moe else GPTMLP(config)
         self.dropout1 = Dropout(config.hidden_dropout_prob)
         self.dropout2 = Dropout(config.hidden_dropout_prob)
 
@@ -241,7 +277,11 @@ class GPTModel(Layer):
         self.config = config
         self.embeddings = GPTEmbeddings(config)
         self.layers = LayerList(
-            [GPTDecoderLayer(config) for _ in range(config.num_layers)])
+            [GPTDecoderLayer(
+                config,
+                use_moe=(config.moe_num_experts > 0 and
+                         (i + 1) % max(config.moe_every_n_layers, 1) == 0))
+             for i in range(config.num_layers)])
         self.final_norm = LayerNorm(config.hidden_size,
                                     epsilon=config.layer_norm_epsilon)
 
@@ -263,7 +303,10 @@ class GPTModel(Layer):
             if use_cache:
                 x, c = layer(x, cache=caches[i], use_cache=True)
                 new_caches.append(c)
-            elif self.config.use_recompute and self.training:
+            elif self.config.use_recompute and self.training and \
+                    not isinstance(layer.mlp, GPTMoEMLP):
+                # MoE layers run outside remat: the recorded gate aux loss
+                # would otherwise leak a jax.checkpoint tracer
                 x = recompute(layer, x)
             else:
                 x = layer(x)
@@ -271,6 +314,16 @@ class GPTModel(Layer):
         if use_cache:
             return x, new_caches
         return x
+
+    def moe_aux_loss(self):
+        """Sum of gate balance losses from the last forward (None when the
+        model has no MoE layers)."""
+        total = None
+        for layer in self.layers:
+            aux = getattr(layer.mlp, "last_aux_loss", None)
+            if aux is not None:
+                total = aux if total is None else total + aux
+        return total
 
 
 class GPTForPretraining(Layer):
@@ -319,6 +372,33 @@ class GPTPretrainingCriterion(Layer):
             m = loss_mask.reshape([-1]).astype("float32")
             return (loss * m).sum() / m.sum().clip(min=1.0)
         return loss.mean()
+
+
+class GPTMoEPretrainingCriterion(Layer):
+    """LM loss + weighted MoE gate balance loss (the GShard/GPT-MoE training
+    objective).  Reads the aux loss the model recorded during ITS forward in
+    the same trace, so it works eagerly and inside the compiled step."""
+
+    def __init__(self, model, aux_loss_weight=None, ignore_index=-100):
+        super().__init__()
+        # read-only references: bypass Layer registration so the criterion
+        # never claims the model's parameters/state as its own
+        object.__setattr__(self, "_model", model)
+        gpt = getattr(model, "gpt", model)
+        object.__setattr__(self, "_gpt", gpt)
+        w = aux_loss_weight
+        if w is None:
+            w = getattr(gpt, "config", None)
+            w = w.moe_aux_loss_weight if w is not None else 0.01
+        self.aux_weight = w
+        self.lm = GPTPretrainingCriterion(ignore_index=ignore_index)
+
+    def forward(self, prediction_scores, masked_lm_labels, loss_mask=None):
+        loss = self.lm(prediction_scores, masked_lm_labels, loss_mask)
+        aux = self._gpt.moe_aux_loss()
+        if aux is not None:
+            loss = loss + self.aux_weight * aux
+        return loss
 
 
 def build_gpt(name_or_config="gpt-tiny", for_pretraining=True, **overrides):
