@@ -58,6 +58,22 @@ from . import signal  # noqa: E402,F401
 import importlib as _importlib  # noqa: E402
 
 linalg = _importlib.import_module(".linalg", __name__)
+from . import onnx  # noqa: E402,F401
+from . import version  # noqa: E402,F401
+
+
+def iinfo(dtype):
+    import numpy as _np
+
+    from .core.dtype import convert_dtype as _cd
+    return _np.iinfo(_cd(dtype))
+
+
+def finfo(dtype):
+    import ml_dtypes as _mld  # handles bfloat16/fp8 plus all numpy floats
+
+    from .core.dtype import convert_dtype as _cd
+    return _mld.finfo(_cd(dtype))
 from .hapi import Model  # noqa: E402,F401
 from . import callbacks  # noqa: E402,F401
 from .distributed.parallel import DataParallel  # noqa: E402,F401

@@ -65,7 +65,7 @@ class GPTConfig:
     activation: str = "gelu"
     # MoE (GPT-MoE / GShard-style FFN replacement): 0 = dense FFN
     moe_num_experts: int = 0
-    moe_top_k: int = 2
+    moe_top_k: int = 0  # 0 = the gate's own default (gshard 2, switch 1)
     moe_every_n_layers: int = 2  # every n-th block becomes MoE
     moe_capacity_factor: float = 1.2
     moe_aux_loss_weight: float = 0.01
@@ -200,8 +200,13 @@ class GPTMoEMLP(Layer):
 
         cf = config.moe_capacity_factor
         gate = {"type": config.moe_gate}
-        if config.moe_gate == "naive":
-            gate["top_k"] = config.moe_top_k  # gshard/switch fix their own k
+        fixed_k = {"gshard": 2, "switch": 1}.get(config.moe_gate)
+        if fixed_k is None:
+            gate["top_k"] = config.moe_top_k or 2
+        elif config.moe_top_k not in (0, fixed_k):
+            raise ValueError(
+                f"moe_gate={config.moe_gate!r} requires moe_top_k={fixed_k} "
+                f"(got {config.moe_top_k}); use moe_gate='naive' for other k")
         if config.moe_gate in ("gshard", "switch"):
             gate["capacity"] = (cf, 2 * cf)  # train/eval caps the gate uses
         self.moe = MoELayer(
@@ -317,12 +322,21 @@ class GPTModel(Layer):
 
     def moe_aux_loss(self):
         """Sum of gate balance losses from the last forward (None when the
-        model has no MoE layers)."""
+        model has no MoE layers, or when the last forward ran inside a
+        now-finished trace — the compiled step consumes the aux loss inside
+        its own program, so a stale tracer outside it is meaningless)."""
+        import jax
+
         total = None
-        for layer in self.layers:
-            aux = getattr(layer.mlp, "last_aux_loss", None)
-            if aux is not None:
-                total = aux if total is None else total + aux
+        try:
+            for layer in self.layers:
+                aux = getattr(layer.mlp, "last_aux_loss", None)
+                if aux is not None:
+                    total = aux if total is None else total + aux
+            if total is not None:
+                total._value + 0  # probe: stale tracers raise here
+        except jax.errors.UnexpectedTracerError:
+            return None
         return total
 
 
@@ -381,9 +395,8 @@ class GPTMoEPretrainingCriterion(Layer):
 
     def __init__(self, model, aux_loss_weight=None, ignore_index=-100):
         super().__init__()
-        # read-only references: bypass Layer registration so the criterion
+        # read-only reference: bypass Layer registration so the criterion
         # never claims the model's parameters/state as its own
-        object.__setattr__(self, "_model", model)
         gpt = getattr(model, "gpt", model)
         object.__setattr__(self, "_gpt", gpt)
         w = aux_loss_weight

@@ -1,0 +1,23 @@
+"""paddle.onnx parity — the reference is a thin wrapper over the external
+paddle2onnx package (python/paddle/onnx/export.py).  That converter has no
+TPU analog in this build (no egress, no onnx runtime); the portable export
+format here is the StableHLO artifact written by `paddle.jit.save`, which
+this module produces while raising a clear error for true .onnx requests.
+"""
+from __future__ import annotations
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    """Exports the model in this build's portable serving format (StableHLO
+    via jit.save).  A real .onnx file would require paddle2onnx, which is not
+    bundled."""
+    from . import jit
+
+    if str(path).endswith(".onnx"):
+        raise RuntimeError(
+            "onnx bytecode export needs the external paddle2onnx converter "
+            "(not bundled in this TPU build); use paddle.jit.save — the "
+            ".pdmodel artifact is serialized StableHLO loadable by "
+            "paddle.jit.load and the inference Predictor")
+    jit.save(layer, str(path), input_spec=input_spec)
+    return str(path) + ".pdmodel"

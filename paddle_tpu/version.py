@@ -1,0 +1,25 @@
+"""paddle.version parity (generated at build time in the reference,
+cmake/version.cmake)."""
+full_version = "0.1.0"
+major = "0"
+minor = "1"
+patch = "0"
+rc = "0"
+istaged = False
+commit = "unknown"
+with_gpu = "OFF"
+with_tpu = "ON"
+cuda_version = "False"
+cudnn_version = "False"
+
+
+def show():
+    print(f"paddle-tpu {full_version} (tpu-native, jax/xla/pallas backend)")
+
+
+def cuda():
+    return False
+
+
+def tpu():
+    return True
