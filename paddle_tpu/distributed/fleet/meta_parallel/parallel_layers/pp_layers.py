@@ -61,6 +61,7 @@ class PipelineLayer(Layer):
         self._layers_desc = list(layers)
         self._loss_fn = loss_fn
         self._topo = topology
+        self._num_virtual_pipeline_stages = num_virtual_pipeline_stages or 1
         self._recompute_interval = recompute_interval
         hcg = get_hybrid_communicate_group()
         if num_stages is None:

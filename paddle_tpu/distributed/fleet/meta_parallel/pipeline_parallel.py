@@ -60,9 +60,12 @@ class PipelineParallel(MetaParallelBase):
                     from ...pipeline import (GPipeTrainStep,
                                              decompose_pipeline_layer)
                     pre, blocks, post = decompose_pipeline_layer(self._layers)
+                    num_virtual = getattr(
+                        self._layers, "_num_virtual_pipeline_stages", 1) or 1
                     self._train_step = GPipeTrainStep(
                         pre, blocks, post, loss_fn, opt,
-                        num_micro=max(2, self.accumulate_steps))
+                        num_micro=max(2, self.accumulate_steps),
+                        num_virtual=num_virtual)
                 except (ValueError, AttributeError, TypeError):
                     # non-uniform / shared / callable stages: GSPMD path
                     self._train_step = None

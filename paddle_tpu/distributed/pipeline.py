@@ -57,7 +57,8 @@ class GPipeTrainStep:
     """
 
     def __init__(self, pre, blocks, post, loss_fn, optimizer, mesh=None,
-                 num_micro=4, pipe_axis=None, compute_dtype=None):
+                 num_micro=4, pipe_axis=None, compute_dtype=None,
+                 num_virtual=1):
         self.mesh = mesh or mesh_mod.get_global_mesh()
         if pipe_axis is None and self.mesh is not None:
             pipe_axis = next((a for a in ("pipe", "pp")
@@ -65,9 +66,23 @@ class GPipeTrainStep:
         if self.mesh is None or pipe_axis not in self.mesh.axis_names:
             raise ValueError(f"GPipe needs a mesh with a {pipe_axis!r} axis")
         self.S = self.mesh.shape[pipe_axis]
-        if len(blocks) % self.S != 0:
+        self.V = max(1, int(num_virtual))
+        if len(blocks) % (self.S * self.V) != 0:
             raise ValueError(
-                f"{len(blocks)} blocks not divisible by pipe degree {self.S}")
+                f"{len(blocks)} blocks not divisible by pipe degree "
+                f"{self.S} x virtual stages {self.V}")
+        if self.V > 1:
+            # circular (interleaved / virtual-stage) assignment: stage s
+            # holds blocks (r*S + s)*per + i for rounds r — permute the
+            # stacking order so the contiguous pipe shard IS that set.
+            # (sync_to_model needs no inverse: the permuted list aliases the
+            # original Layer objects.)
+            per = len(blocks) // (self.S * self.V)
+            order = [(r * self.S + s) * per + i
+                     for s in range(self.S)
+                     for r in range(self.V)
+                     for i in range(per)]
+            blocks = [blocks[j] for j in order]
         self.pre, self.blocks, self.post = pre, list(blocks), post
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -143,7 +158,7 @@ class GPipeTrainStep:
     # -- the pipelined block stack (runs inside shard_map) -------------------
     def _make_pipeline_fn(self, M):
         template = self._template
-        S, axis = self.S, self.pipe_axis
+        S, V, axis = self.S, self.V, self.pipe_axis
         perm = [(i, (i + 1) % S) for i in range(S)]
 
         def block_apply(x, layer_values):
@@ -161,7 +176,8 @@ class GPipeTrainStep:
 
         def pipeline(h, block_params):
             # h: LOCAL activations [B_loc, T, H]; block_params leaves
-            # [L/S, ...] (this stage's slice)
+            # [L/S, ...] (this stage's slice; for V>1 rounds are stacked as
+            # [V*per, ...] in round-major order)
             s = jax.lax.axis_index(axis)
             b_loc = h.shape[0]
             if b_loc % M:
@@ -172,21 +188,60 @@ class GPipeTrainStep:
             zero = jnp.zeros_like(u[0])
             outputs0 = jnp.zeros_like(u)
 
-            def tick(carry, t):
-                cur_out, outputs = carry
-                recv = jax.lax.ppermute(cur_out, axis, perm)
-                inject = u[jnp.clip(t, 0, M - 1)]
-                x_in = jnp.where(s == 0, inject, recv)
-                y = local_stage(x_in, block_params)
-                out_t = t - (S - 1)
-                write = (s == S - 1) & (out_t >= 0) & (out_t < M)
-                idx = jnp.clip(out_t, 0, M - 1)
-                slot = jnp.where(write, y, outputs[idx])
-                outputs = outputs.at[idx].set(slot)
-                return (y, outputs), None
+            if V == 1:
+                def tick(carry, t):
+                    cur_out, outputs = carry
+                    recv = jax.lax.ppermute(cur_out, axis, perm)
+                    inject = u[jnp.clip(t, 0, M - 1)]
+                    x_in = jnp.where(s == 0, inject, recv)
+                    y = local_stage(x_in, block_params)
+                    out_t = t - (S - 1)
+                    write = (s == S - 1) & (out_t >= 0) & (out_t < M)
+                    idx = jnp.clip(out_t, 0, M - 1)
+                    outputs = outputs.at[idx].set(
+                        jnp.where(write, y, outputs[idx]))
+                    return (y, outputs), None
 
-            (last, outputs), _ = jax.lax.scan(
-                tick, (zero, outputs0), jnp.arange(M + S - 1))
+                (_, outputs), _ = jax.lax.scan(
+                    tick, (zero, outputs0), jnp.arange(M + S - 1))
+            else:
+                # circular (interleaved) schedule: every microbatch cycles
+                # through the ring V times; stage s applies its round-r
+                # block group.  Bubble (S-1)/(V*M + S-1) — V x smaller than
+                # plain GPipe (the reference's virtual-stage 1F1B,
+                # pipeline_parallel.py:419).  Needs M >= S so the wrap-around
+                # value is back at stage 0 before it's consumed.
+                some_leaf = next(iter(block_params.values()))
+                per = some_leaf.shape[0] // V
+                buf0 = jnp.zeros((M,) + u.shape[1:], u.dtype)
+
+                def tick(carry, t):
+                    y_prev, buf, outputs = carry
+                    recv = jax.lax.ppermute(y_prev, axis, perm)
+                    q = t - s
+                    qc = jnp.clip(q, 0, V * M - 1)
+                    m, r = qc % M, qc // M
+                    # stage 0 buffers wrap-around arrivals (round r-1 output
+                    # of microbatch m_arr, produced S ticks ago ring-wide)
+                    q_arr = t - S
+                    m_arr = jnp.clip(q_arr, 0, V * M - 1) % M
+                    keep = (s == 0) & (q_arr >= 0) & (q_arr < V * M)
+                    buf = buf.at[m_arr].set(
+                        jnp.where(keep, recv, buf[m_arr]))
+                    x0 = jnp.where(r == 0, u[m], buf[m])
+                    x_in = jnp.where(s == 0, x0, recv)
+                    lp = {k: jax.lax.dynamic_slice_in_dim(a, r * per, per, 0)
+                          for k, a in block_params.items()}
+                    y = local_stage(x_in, lp)
+                    write = (s == S - 1) & (r == V - 1) & (q >= 0) & \
+                        (q < V * M)
+                    outputs = outputs.at[m].set(
+                        jnp.where(write, y, outputs[m]))
+                    return (y, buf, outputs), None
+
+                (_, _, outputs), _ = jax.lax.scan(
+                    tick, (zero, buf0, outputs0),
+                    jnp.arange(V * M + S - 1))
             # only the last stage holds real outputs; make the result
             # pipe-invariant so GSPMD continues cleanly
             outputs = jnp.where(s == S - 1, outputs, 0.0)
@@ -196,7 +251,7 @@ class GPipeTrainStep:
         return pipeline
 
     # -- full step -----------------------------------------------------------
-    def _build(self, num_micro):
+    def _build(self, num_micro, pad_local=0):
         pre, post, loss_fn = self.pre, self.post, self.loss_fn
         opt = self.optimizer
         mesh, axis = self.mesh, self.pipe_axis
@@ -231,6 +286,17 @@ class GPipeTrainStep:
                 h, _ = functional_call(pre, cast(merged("pre", params)),
                                        (Tensor(x, _internal=True),))
                 h = h._value if isinstance(h, Tensor) else h
+                real_rows = h.shape[0]
+                if pad_local:
+                    # grow each data shard to a micro-divisible size; the
+                    # padded rows are garbage and sliced off below, so the
+                    # loss only sees real samples
+                    n_data = 1
+                    for a in (batch_axis or ()):
+                        n_data *= mesh.shape[a]
+                    widths = [(0, pad_local * n_data)] + \
+                        [(0, 0)] * (h.ndim - 1)
+                    h = jnp.pad(h, widths)
                 blk_vals = cast(merged("blocks", params))
                 h_spec = P(batch_axis, *([None] * (h.ndim - 1)))
                 h = jax.shard_map(
@@ -239,6 +305,8 @@ class GPipeTrainStep:
                               {k: blk_specs[k] for k in blk_vals}),
                     out_specs=h_spec, check_vma=False,
                 )(h, blk_vals)
+                if pad_local:
+                    h = h[:real_rows]
                 out, _ = functional_call(post, cast(merged("post", params)),
                                          (Tensor(h, _internal=True),))
                 if loss_fn is not None and y is not None:
@@ -278,11 +346,20 @@ class GPipeTrainStep:
 
     def _pick_num_micro(self, local_batch: int) -> int:
         """Largest M ≤ requested that divides the local batch (≥1) — a
-        non-divisible config degrades gracefully instead of crashing."""
+        non-divisible config degrades gracefully instead of crashing.  The
+        circular schedule additionally needs M ≥ S (wrap-around latency)."""
         m = min(self.num_micro, local_batch)
         while m > 1 and local_batch % m:
             m -= 1
-        return max(m, 1)
+        m = max(m, 1)
+        if self.V > 1 and m < self.S:
+            cand = [d for d in range(self.S, local_batch + 1)
+                    if local_batch % d == 0]
+            # no divisor >= S (e.g. a small trailing batch): pad rows up to
+            # a multiple of S inside the step and slice them back off —
+            # graceful degradation instead of a mid-epoch crash
+            m = cand[0] if cand else self.S
+        return m
 
     def __call__(self, *batch):
         vals = []
@@ -298,10 +375,11 @@ class GPipeTrainStep:
             n_data *= self.mesh.shape[a]
         local_batch = max(vals[0].shape[0] // n_data, 1)
         m_eff = self._pick_num_micro(local_batch)
-        if self._jitted is None or self._num_micro_eff != m_eff:
+        pad_local = (-local_batch) % m_eff
+        if self._jitted is None or self._num_micro_eff != (m_eff, pad_local):
             # per-batch-size micro count (e.g. a smaller trailing batch)
-            self._num_micro_eff = m_eff
-            self._jitted = self._build(m_eff)
+            self._num_micro_eff = (m_eff, pad_local)
+            self._jitted = self._build(m_eff, pad_local)
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         key = jax.random.key(np.random.randint(0, 2 ** 31 - 1))
         self.params, self.slots, self.step_count, loss = self._jitted(
