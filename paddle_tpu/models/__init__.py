@@ -25,6 +25,7 @@ from .gpt import (  # noqa: F401
     GPTPretrainingCriterion,
     build_gpt,
     gpt_config,
+    gpt_pipeline_descs,
     gpt_num_params,
     gpt_train_flops_per_token,
 )

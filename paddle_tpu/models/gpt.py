@@ -388,6 +388,48 @@ class GPTPretrainingCriterion(Layer):
         return loss.mean()
 
 
+class GPTHeadPipe(Layer):
+    """Last pipeline stage: final norm + (untied) vocab-parallel LM head.
+    The tied-weight head needs the embedding table on the same stage, which
+    the explicit pipeline schedule can't provide — FleetX's PP GPT recipe
+    likewise unties or all-reduces the shared grads (SharedLayerDesc); here
+    untied.  Under mp the head column-shards the vocab dim so the [B,T,V]
+    logits stay mp-sharded for ParallelCrossEntropy."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.final_norm = LayerNorm(config.hidden_size,
+                                    epsilon=config.layer_norm_epsilon)
+        self.lm_head = ColumnParallelLinear(
+            config.hidden_size, config.vocab_size,
+            weight_attr=_init_attr(config.initializer_range),
+            has_bias=False, gather_output=False)
+
+    def forward(self, x):
+        logits = self.lm_head(self.final_norm(x))
+        return _constrain(logits, P(("dp", "sharding"), None, "mp"))
+
+
+def gpt_pipeline_descs(config: GPTConfig):
+    """LayerDesc list for fleet.PipelineLayer — the FleetX GPT PP recipe
+    shape (embeddings | N decoder layers | norm+head); a uniform decoder run
+    is what the explicit GPipe schedule stacks over the pipe axis.  MoE
+    configs produce their MoE layers here too (structurally non-uniform
+    stages then take the one-program GSPMD pipeline path).  Recompute is a
+    PipelineLayer concern: pass recompute_interval=1 to PipelineLayer when
+    config.use_recompute is set."""
+    from ..distributed.fleet.meta_parallel.parallel_layers.pp_layers import (
+        LayerDesc)
+
+    return ([LayerDesc(GPTEmbeddings, config)] +
+            [LayerDesc(
+                GPTDecoderLayer, config,
+                use_moe=(config.moe_num_experts > 0 and
+                         (i + 1) % max(config.moe_every_n_layers, 1) == 0))
+             for i in range(config.num_layers)] +
+            [LayerDesc(GPTHeadPipe, config)])
+
+
 class GPTMoEPretrainingCriterion(Layer):
     """LM loss + weighted MoE gate balance loss (the GShard/GPT-MoE training
     objective).  Reads the aux loss the model recorded during ITS forward in

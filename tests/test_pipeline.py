@@ -240,6 +240,40 @@ def test_gpipe_with_tensor_parallel_blocks():
     assert losses[-1] < losses[0]
 
 
+def test_gpt_through_fleet_pipeline():
+    """The FleetX GPT PP recipe: gpt_pipeline_descs → PipelineLayer →
+    fleet.distributed_model → explicit GPipe schedule trains."""
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet import PipelineLayer
+    from paddle_tpu.distributed.pipeline import GPipeTrainStep
+    from paddle_tpu.models import (GPTPretrainingCriterion, gpt_config,
+                                   gpt_pipeline_descs)
+
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": 2, "mp_degree": 1, "pp_degree": 4,
+                        "sharding_degree": 1}
+    s.pipeline_configs = {"accumulate_steps": 2}
+    fleet.init(is_collective=True, strategy=s)
+
+    paddle.seed(7)
+    cfg = gpt_config("gpt-tiny", num_layers=4, hidden_dropout_prob=0.0,
+                     attention_dropout_prob=0.0)
+    pl = PipelineLayer(gpt_pipeline_descs(cfg),
+                       loss_fn=GPTPretrainingCriterion())
+    model = fleet.distributed_model(pl)
+    opt = fleet.distributed_optimizer(paddle.optimizer.AdamW(
+        parameters=pl.parameters(), learning_rate=1e-3))
+
+    ids = np.random.RandomState(0).randint(0, cfg.vocab_size,
+                                           (8, 17)).astype("int64")
+    x, y = ids[:, :-1], ids[:, 1:]
+    losses = [float(model.train_batch((x, y), opt).numpy())
+              for _ in range(5)]
+    assert isinstance(model._train_step, GPipeTrainStep)
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]
+
+
 def test_decompose_pipeline_layer():
     from paddle_tpu.distributed.fleet import LayerDesc, PipelineLayer
 
