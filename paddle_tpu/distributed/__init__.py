@@ -22,8 +22,10 @@ from .spmd import (  # noqa: F401
     make_train_step,
 )
 from . import auto_parallel  # noqa: F401
+from . import communication  # noqa: F401
 from . import fleet  # noqa: F401
 from . import sharding  # noqa: F401
+from .spawn import spawn  # noqa: F401
 from .auto_parallel import (  # noqa: F401
     ProcessMesh, dtensor_from_fn, reshard, shard_op, shard_tensor,
 )

@@ -1,0 +1,59 @@
+"""paddle.distributed.communication.stream parity: the stream-explicit
+collective surface (communication/stream/all_reduce.py etc.).
+
+The reference separates compute/comm CUDA streams; under XLA the async
+start/done pair is the compiler's scheduling decision, so these functions
+alias the regular collectives while keeping the `sync_op`/`use_calc_stream`
+signature (SURVEY Appendix B's "collective stream semantics to preserve").
+"""
+from __future__ import annotations
+
+from .. import collective as _c
+
+
+def all_reduce(tensor, op=None, group=None, sync_op=True,
+               use_calc_stream=False):
+    return _c.all_reduce(tensor, op=op if op is not None else _c.ReduceOp.SUM,
+                         group=group, sync_op=sync_op)
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True,
+               use_calc_stream=False):
+    return _c.all_gather(tensor_list, tensor, group=group, sync_op=sync_op)
+
+
+def reduce_scatter(tensor, tensor_list, op=None, group=None, sync_op=True,
+                   use_calc_stream=False):
+    return _c.reduce_scatter(tensor, tensor_list,
+                             op=op if op is not None else _c.ReduceOp.SUM,
+                             group=group, sync_op=sync_op)
+
+
+def broadcast(tensor, src, group=None, sync_op=True, use_calc_stream=False):
+    return _c.broadcast(tensor, src, group=group, sync_op=sync_op)
+
+
+def reduce(tensor, dst, op=None, group=None, sync_op=True,
+           use_calc_stream=False):
+    return _c.reduce(tensor, dst, op=op if op is not None else _c.ReduceOp.SUM,
+                     group=group, sync_op=sync_op)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True,
+            use_calc_stream=False):
+    return _c.scatter(tensor, tensor_list, src=src, group=group,
+                      sync_op=sync_op)
+
+
+def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True,
+             use_calc_stream=False):
+    return _c.all_to_all(out_tensor_list, in_tensor_list, group=group,
+                         sync_op=sync_op)
+
+
+def send(tensor, dst=0, group=None, sync_op=True, use_calc_stream=False):
+    return _c.send(tensor, dst, group=group, sync_op=sync_op)
+
+
+def recv(tensor, src=0, group=None, sync_op=True, use_calc_stream=False):
+    return _c.recv(tensor, src, group=group, sync_op=sync_op)

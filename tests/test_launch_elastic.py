@@ -209,3 +209,54 @@ def test_elastic_fault_tolerance_holds_on_loss():
     status, _ = m.adjust(["h1", "h2", "h3"])
     assert status == ElasticStatus.COMPLETED
     m.exit()
+
+
+# -- spawn + stream collectives ---------------------------------------------
+
+def _spawn_target(tag_dir):
+    import os
+    rank = os.environ["PADDLE_TRAINER_ID"]
+    world = os.environ["PADDLE_TRAINERS_NUM"]
+    with open(os.path.join(tag_dir, f"rank{rank}.txt"), "w") as f:
+        f.write(world)
+
+
+def test_spawn_runs_workers(tmp_path):
+    import paddle_tpu.distributed as dist
+    dist.spawn(_spawn_target, args=(str(tmp_path),), nprocs=2)
+    for r in range(2):
+        assert (tmp_path / f"rank{r}.txt").read_text() == "2"
+
+
+def test_spawn_propagates_failure(tmp_path):
+    import paddle_tpu.distributed as dist
+
+    with pytest.raises(RuntimeError, match="failed"):
+        dist.spawn(_spawn_fail, nprocs=2)
+
+
+def _spawn_fail():
+    raise ValueError("worker boom")
+
+
+def test_stream_collectives_alias():
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed.communication import stream
+
+    mesh = dist.build_mesh([8], ["dp"])
+    g = dist.new_group(list(range(8)), axis_name="dp")
+    data = jnp.arange(8.0).reshape(8, 1)
+
+    def f(x):
+        return stream.all_reduce(paddle.to_tensor(x), group=g,
+                                 use_calc_stream=True)._value
+
+    out = jax.shard_map(f, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))(data)
+    np.testing.assert_allclose(np.asarray(out), np.full((8, 1), 28.0))
+    dist.collective.destroy_process_group()
+    dist.set_global_mesh(None)
