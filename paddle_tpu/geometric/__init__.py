@@ -1,0 +1,131 @@
+"""paddle.geometric parity (python/paddle/geometric/: message passing
+send_u_recv / send_ue_recv / send_uv + segment reductions, backed in the
+reference by graph_send_recv ops; here jax segment reductions, which XLA
+lowers to sorted scatter-adds on TPU)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.op import apply_op
+from ..core.tensor import Tensor
+
+__all__ = ["send_u_recv", "send_ue_recv", "send_uv", "segment_sum",
+           "segment_mean", "segment_max", "segment_min"]
+
+_SEG = {
+    "sum": jax.ops.segment_sum,
+    "mean": None,  # composed below
+    "max": jax.ops.segment_max,
+    "min": jax.ops.segment_min,
+}
+
+
+def _seg_reduce(vals, dst, pool, n):
+    if pool == "mean":
+        s = jax.ops.segment_sum(vals, dst, num_segments=n)
+        # count in f32: bf16 can't represent integers > 256 exactly
+        cnt = jax.ops.segment_sum(jnp.ones((vals.shape[0],), jnp.float32),
+                                  dst, num_segments=n)
+        cnt = jnp.maximum(cnt, 1.0).reshape((-1,) + (1,) * (vals.ndim - 1))
+        return (s.astype(jnp.float32) / cnt).astype(vals.dtype)
+    out = _SEG[pool](vals, dst, num_segments=n)
+    if pool in ("max", "min"):
+        # reference yields 0 for untouched segments (not +-inf)
+        touched = jax.ops.segment_sum(
+            jnp.ones((vals.shape[0],), jnp.float32), dst, num_segments=n) > 0
+        out = jnp.where(touched.reshape((-1,) + (1,) * (vals.ndim - 1)),
+                        out, 0.0).astype(vals.dtype)
+    return out
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """geometric.send_u_recv parity: gather x[src], reduce at dst."""
+    pool = reduce_op.lower()
+    if pool not in ("sum", "mean", "max", "min"):
+        raise ValueError(f"unknown reduce_op {reduce_op!r}")
+
+    def raw(xv, si, di):
+        n = out_size if out_size is not None else xv.shape[0]
+        return _seg_reduce(xv[si], di, pool, n)
+
+    return apply_op(raw, "graph_send_recv", (x, src_index, dst_index), {})
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """geometric.send_ue_recv parity: combine node features x[src] with edge
+    features y, reduce at dst."""
+    pool = reduce_op.lower()
+    comb = message_op.lower()
+    if pool not in ("sum", "mean", "max", "min"):
+        raise ValueError(f"unknown reduce_op {reduce_op!r}")
+    if comb not in ("add", "sub", "mul", "div"):
+        raise ValueError(f"unknown message_op {message_op!r}")
+
+    def raw(xv, yv, si, di):
+        m = xv[si]
+        if comb == "add":
+            m = m + yv
+        elif comb == "sub":
+            m = m - yv
+        elif comb == "mul":
+            m = m * yv
+        else:
+            m = m / yv
+        n = out_size if out_size is not None else xv.shape[0]
+        return _seg_reduce(m, di, pool, n)
+
+    return apply_op(raw, "graph_send_ue_recv", (x, y, src_index, dst_index),
+                    {})
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """geometric.send_uv parity: per-edge message x[src] (op) y[dst]."""
+    comb = message_op.lower()
+
+    def raw(xv, yv, si, di):
+        a, b = xv[si], yv[di]
+        if comb == "add":
+            return a + b
+        if comb == "sub":
+            return a - b
+        if comb == "mul":
+            return a * b
+        if comb == "div":
+            return a / b
+        raise ValueError(f"unknown message_op {message_op!r}")
+
+    return apply_op(raw, "graph_send_uv", (x, y, src_index, dst_index), {})
+
+
+def _segment(pool):
+    def fn(data, segment_ids, num_segments=None, name=None):
+        # segment count fixed at call time (concrete ids: max+1 like the
+        # reference).  Under jit the ids are traced so the count CANNOT be
+        # derived — require it explicitly rather than silently changing the
+        # output shape between eager and jit.
+        ids = segment_ids._value if isinstance(segment_ids, Tensor) \
+            else jnp.asarray(segment_ids)
+        if num_segments is not None:
+            n = int(num_segments)
+        elif isinstance(ids, jax.core.Tracer):
+            raise ValueError(
+                f"segment_{pool} under jit needs num_segments= (segment ids "
+                "are traced, so the output shape can't be derived)")
+        else:
+            n = int(ids.max()) + 1 if ids.size else 0
+
+        def raw(d, s):
+            return _seg_reduce(d, s, pool, n)
+
+        return apply_op(raw, f"segment_{pool}", (data, segment_ids), {})
+    fn.__name__ = f"segment_{pool}"
+    return fn
+
+
+segment_sum = _segment("sum")
+segment_mean = _segment("mean")
+segment_max = _segment("max")
+segment_min = _segment("min")
