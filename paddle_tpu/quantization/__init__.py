@@ -1,0 +1,223 @@
+"""paddle.quantization parity (reference: python/paddle/static/quantization
+post-training + QAT passes, and the paddle.quantization QAT config API).
+
+TPU-native scope: simulated int8 quantization.  QAT inserts fake-quant
+(quantize-dequantize with a straight-through estimator) on weights and
+activations of Linear/Conv2D; PTQ observes abs-max ranges on calibration
+batches (observation is independent of train/eval mode).  `convert` bakes
+weight quantization onto the int8 grid and freezes the observers — the
+quant/dequant ops stay in the inference graph with the calibrated scales,
+matching the reference's converted-program shape.  The reference's int8
+GEMM kernels (cuDNN/oneDNN) have no public TPU analog, so compute stays in
+float with quantized values — the standard simulated-quant formulation.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.op import apply_op
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer
+
+__all__ = ["QuantConfig", "QAT", "PTQ", "FakeQuanterWithAbsMaxObserver",
+           "quant_dequant", "QuantedLinear", "QuantedConv2D"]
+
+
+# -- fake quant with straight-through estimator ------------------------------
+
+@jax.custom_vjp
+def _fake_quant(x, scale, qmax):
+    q = jnp.clip(jnp.round(x / scale * qmax), -qmax, qmax)
+    return q * scale / qmax
+
+
+def _fq_fwd(x, scale, qmax):
+    return _fake_quant(x, scale, qmax), (x, scale)
+
+
+def _fq_bwd(res, g):
+    x, scale = res
+    # STE: pass gradients through inside the clip range, zero outside
+    inside = (jnp.abs(x) <= scale).astype(g.dtype)
+    return g * inside, jnp.zeros_like(scale), None
+
+
+_fake_quant.defvjp(_fq_fwd, _fq_bwd)
+
+
+def quant_dequant(x, scale, bits=8):
+    """Simulated quantization op (fake_quantize_dequantize_abs_max)."""
+    qmax = float(2 ** (bits - 1) - 1)
+
+    def raw(v, s):
+        return _fake_quant(v, jnp.maximum(s, 1e-8), qmax)
+
+    return apply_op(raw, "fake_quantize_dequantize", (x, scale), {})
+
+
+class FakeQuanterWithAbsMaxObserver(Layer):
+    """QAT quanter: tracks a running abs-max and fake-quants through it
+    (reference FakeQuanterWithAbsMaxObserverLayer).  Observation is gated by
+    `observing`, NOT the train/eval flag, so the standard PTQ flow
+    (net.eval() before calibration) still collects statistics; convert()
+    freezes it."""
+
+    def __init__(self, moving_rate=0.9, bit_length=8, name=None):
+        super().__init__()
+        self.moving_rate = moving_rate
+        self.bit_length = bit_length
+        self.register_buffer("scale", Tensor(jnp.ones((), jnp.float32)))
+        self._seen = False
+        self.observing = True
+
+    def forward(self, x):
+        if self.observing:
+            if isinstance(x._value, jax.core.Tracer):
+                if not self._seen:
+                    import warnings
+                    warnings.warn(
+                        "quant observer ran only under jit: calibration "
+                        "needs eager forwards (scale stays at init)")
+            else:
+                cur = float(jnp.max(jnp.abs(x._value)))
+                old = float(np.asarray(self.scale._value))
+                new = cur if not self._seen else \
+                    self.moving_rate * old + (1 - self.moving_rate) * cur
+                self.scale._replace_(jnp.asarray(new, jnp.float32), None)
+                self._seen = True
+        return quant_dequant(x, self.scale, bits=self.bit_length)
+
+
+class _QuantedWrapper(Layer):
+    """Wraps a Linear/Conv2D: fake-quant activation + weight, then run the
+    original layer with the quantized weight."""
+
+    def __init__(self, inner, a_quanter=None, w_bits=8):
+        super().__init__()
+        self.inner = inner
+        self.activation_quanter = a_quanter
+        self.w_bits = w_bits
+
+    def _wq(self):
+        w = self.inner.weight
+        qmax = float(2 ** (self.w_bits - 1) - 1)
+
+        def raw(wv):
+            s = jnp.maximum(jnp.max(jnp.abs(wv)), 1e-8)
+            return _fake_quant(wv, s, qmax)
+
+        return apply_op(raw, "weight_quantize", (w,), {})
+
+    def forward(self, x):
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        w = self._wq()
+        return self._call_with_weight(x, w)
+
+
+class QuantedLinear(_QuantedWrapper):
+    def _call_with_weight(self, x, w):
+        from ..nn import functional as F
+        return F.linear(x, w, self.inner.bias)
+
+
+class QuantedConv2D(_QuantedWrapper):
+    def _call_with_weight(self, x, w):
+        from ..nn import functional as F
+        i = self.inner
+        return F.conv2d(x, w, i.bias, i._stride, i._padding, i._dilation,
+                        i._groups, i._data_format)
+
+
+class QuantConfig:
+    """paddle.quantization.QuantConfig parity (subset: global activation /
+    weight quanter factories)."""
+
+    def __init__(self, activation=None, weight=None, activation_bits=8,
+                 weight_bits=8):
+        self.activation = activation
+        if weight is not None:
+            raise NotImplementedError(
+                "custom weight quanters are not supported; weights use "
+                "abs-max fake quant at weight_bits precision")
+        self.activation_bits = activation_bits
+        self.weight_bits = weight_bits
+
+    def add_layer_config(self, *a, **kw):
+        pass  # per-layer overrides not needed for the subset
+
+    def _make_act_quanter(self):
+        import copy
+
+        if self.activation is None:
+            return FakeQuanterWithAbsMaxObserver(
+                bit_length=self.activation_bits)
+        if isinstance(self.activation, type):
+            return self.activation()
+        # instance template: each wrapped layer needs its OWN observer
+        return copy.deepcopy(self.activation)
+
+
+def _swap_layers(model, factory):
+    """Replace Linear/Conv2D sublayers via `factory(layer)` (in place)."""
+    from ..nn.layer.common import Linear
+    from ..nn.layer.conv import Conv2D
+
+    for layer in model.sublayers(include_self=True):
+        for name, sub in list(layer._sub_layers.items()):
+            if isinstance(sub, (Linear, Conv2D)) and \
+                    not isinstance(sub, _QuantedWrapper):
+                layer._sub_layers[name] = factory(sub)
+    return model
+
+
+class QAT:
+    """Quantization-aware training driver (reference QAT class)."""
+
+    def __init__(self, config: QuantConfig | None = None):
+        self.config = config or QuantConfig()
+
+    def quantize(self, model, inplace=True):
+        from ..nn.layer.common import Linear
+
+        if not inplace:
+            import copy
+            model = copy.deepcopy(model)
+
+        def factory(sub):
+            cls = QuantedLinear if isinstance(sub, Linear) else QuantedConv2D
+            return cls(sub, self.config._make_act_quanter(),
+                       w_bits=self.config.weight_bits)
+
+        return _swap_layers(model, factory)
+
+    def convert(self, model, inplace=True):
+        """Freeze for inference: bake weight quantization into the stored
+        weights and STOP observing — the quant/dequant ops stay in the graph
+        with the calibrated activation scales (reference converted-program
+        semantics)."""
+        if not inplace:
+            import copy
+            model = copy.deepcopy(model)
+        for layer in model.sublayers(include_self=True):
+            if isinstance(layer, FakeQuanterWithAbsMaxObserver):
+                layer.observing = False
+            if isinstance(layer, _QuantedWrapper):
+                qmax = float(2 ** (layer.w_bits - 1) - 1)
+                wv = layer.inner.weight._value
+                s = jnp.maximum(jnp.max(jnp.abs(wv)), 1e-8)
+                layer.inner.weight._replace_(
+                    jnp.clip(jnp.round(wv / s * qmax), -qmax, qmax) *
+                    s / qmax, None)
+        return model
+
+
+class PTQ(QAT):
+    """Post-training quantization: quantize(), run calibration batches (any
+    train/eval mode — observers watch until convert), then convert()."""
+
+    # observers are `observing` from construction regardless of train/eval
+    # mode, so plain QAT.quantize already yields a calibratable PTQ model
+    pass

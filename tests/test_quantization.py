@@ -1,0 +1,122 @@
+"""Quantization tests (reference: static/quantization QAT/PTQ tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.quantization import (PTQ, QAT, FakeQuanterWithAbsMaxObserver,
+                                     QuantConfig, QuantedLinear, quant_dequant)
+
+
+def test_quant_dequant_roundtrip_and_ste():
+    x = paddle.to_tensor(np.linspace(-1, 1, 17).astype("float32"))
+    scale = paddle.to_tensor(np.asarray(1.0, "float32"))
+    out = quant_dequant(x, scale)
+    # 8-bit sim-quant error bounded by scale/127
+    assert np.abs(out.numpy() - x.numpy()).max() <= 1.0 / 127 + 1e-6
+
+    # STE: grads pass through inside the range, die outside
+    x2 = paddle.to_tensor(np.array([0.5, 2.0, -3.0], "float32"))
+    x2.stop_gradient = False
+    quant_dequant(x2, scale).sum().backward()
+    np.testing.assert_allclose(x2.grad.numpy(), [1.0, 0.0, 0.0])
+
+
+def test_qat_quantize_and_train():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    qat = QAT(QuantConfig())
+    net = qat.quantize(net)
+    assert isinstance(net[0], QuantedLinear)
+    assert isinstance(net[2], QuantedLinear)
+
+    opt = paddle.optimizer.Adam(parameters=net.parameters(),
+                                learning_rate=5e-3)
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((32, 8)).astype("float32")
+    Y = X[:, :4]
+    mse = nn.MSELoss()
+    losses = []
+    for _ in range(30):
+        loss = mse(net(paddle.to_tensor(X)), paddle.to_tensor(Y))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0] * 0.5  # trains THROUGH the fake quant
+
+    # convert: observers frozen, weights on the int8 grid, outputs close
+    net.eval()
+    before = net(paddle.to_tensor(X)).numpy()
+    qat.convert(net)
+    assert isinstance(net[0], QuantedLinear)  # quant ops stay in the graph
+    assert net[0].activation_quanter.observing is False
+    w = net[0].inner.weight.numpy()
+    s = np.abs(w).max()
+    grid = np.round(w / s * 127)
+    np.testing.assert_allclose(grid, np.round(grid), atol=1e-4)
+    after = net(paddle.to_tensor(X)).numpy()
+    np.testing.assert_allclose(after, before, atol=0.1)
+
+
+def test_ptq_calibrate_then_convert():
+    paddle.seed(1)
+    net = nn.Sequential(nn.Linear(8, 8), nn.ReLU(), nn.Linear(8, 2))
+    ref = nn.Sequential(nn.Linear(8, 8), nn.ReLU(), nn.Linear(8, 2))
+    ref.set_state_dict(net.state_dict())
+
+    ptq = PTQ(QuantConfig())
+    net = ptq.quantize(net)
+    net.eval()  # the standard PTQ flow: calibrate in eval mode
+    rng = np.random.default_rng(1)
+    calib = rng.standard_normal((64, 8)).astype("float32")
+    for i in range(4):  # calibration passes update observers despite eval()
+        net(paddle.to_tensor(calib[i * 16:(i + 1) * 16]))
+    obs = [l for l in net.sublayers()
+           if isinstance(l, FakeQuanterWithAbsMaxObserver)]
+    assert obs and all(o._seen for o in obs)
+    scales = [float(o.scale.numpy()) for o in obs]
+    assert all(s != 1.0 for s in scales)  # really observed, not init
+
+    ptq.convert(net)
+    assert all(o.observing is False for o in obs)
+    ref.eval()
+    x = paddle.to_tensor(calib[:8])
+    # int8 sim-quant stays close to the fp model, using calibrated scales
+    np.testing.assert_allclose(net(x).numpy(), ref(x).numpy(), atol=0.15)
+
+
+def test_quantize_inplace_false_preserves_original():
+    paddle.seed(3)
+    net = nn.Sequential(nn.Linear(4, 4))
+    q = QAT().quantize(net, inplace=False)
+    assert isinstance(q[0], QuantedLinear)
+    assert isinstance(net[0], nn.Linear)  # original untouched
+
+
+def test_quanter_instance_template():
+    tmpl = FakeQuanterWithAbsMaxObserver(moving_rate=0.5)
+    cfg = QuantConfig(activation=tmpl)
+    net = nn.Sequential(nn.Linear(4, 4), nn.Linear(4, 4))
+    q = QAT(cfg).quantize(net, inplace=False)
+    q0, q1 = q[0].activation_quanter, q[1].activation_quanter
+    assert q0 is not q1 and q0 is not tmpl  # per-layer copies
+    assert q0.moving_rate == 0.5
+
+
+def test_quantized_model_exports(tmp_path):
+    from paddle_tpu.static import InputSpec
+
+    paddle.seed(2)
+    net = nn.Sequential(nn.Linear(4, 4))
+    qat = QAT()
+    net = qat.quantize(net)
+    net(paddle.to_tensor(np.ones((2, 4), "float32")))  # observe
+    qat.convert(net)
+    net.eval()
+    path = str(tmp_path / "q" / "model")
+    paddle.jit.save(net, path, input_spec=[InputSpec([2, 4], "float32")])
+    loaded = paddle.jit.load(path)
+    x = np.ones((2, 4), "float32")
+    np.testing.assert_allclose(loaded(paddle.to_tensor(x)).numpy(),
+                               net(paddle.to_tensor(x)).numpy(), rtol=1e-5)
