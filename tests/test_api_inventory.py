@@ -120,6 +120,15 @@ SURFACE = {
     "paddle_tpu.incubate.distributed.models.moe": [
         "MoELayer", "GShardGate", "SwitchGate", "NaiveGate",
         "global_scatter", "global_gather", "ClipGradForMOEByGlobalNorm"],
+    "paddle_tpu.geometric": ["send_u_recv", "send_ue_recv", "send_uv",
+                             "segment_sum", "segment_mean", "segment_max",
+                             "segment_min"],
+    "paddle_tpu.quantization": ["QuantConfig", "QAT", "PTQ", "quant_dequant",
+                                "FakeQuanterWithAbsMaxObserver"],
+    "paddle_tpu.distributed.spawn": ["spawn"],
+    "paddle_tpu.distributed.communication.stream": ["all_reduce",
+                                                    "reduce_scatter",
+                                                    "alltoall"],
     # utils / native
     "paddle_tpu.utils.cpp_extension": ["load", "setup", "CppExtension",
                                        "get_build_directory"],
