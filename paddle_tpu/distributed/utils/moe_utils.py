@@ -1,0 +1,7 @@
+"""paddle.distributed.utils.moe_utils parity: the public aliases for the MoE
+token-exchange collectives (reference: global_scatter/global_gather ops,
+operators/collective/global_scatter_op.cc)."""
+from ...incubate.distributed.models.moe.utils import (  # noqa: F401
+    global_gather,
+    global_scatter,
+)

@@ -240,6 +240,12 @@ def load_inference_model(path_prefix, executor, **kwargs):
     return prog, prog._feed_names, ["out0"]
 
 
+# static.amp facade: the dygraph amp module serves both modes here (the
+# reference keeps separate static AMP passes; autocast at the op boundary
+# covers traced programs too)
+from .. import amp  # noqa: E402,F401
+
+
 # nn facade for static users (conv/fc built on the dygraph layers)
 class _StaticNN:
     @staticmethod

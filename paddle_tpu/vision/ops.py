@@ -228,6 +228,67 @@ def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
     return apply_op(impl, "psroi_pool", (x, boxes, boxes_num), {})
 
 
+# -- box_coder ---------------------------------------------------------------
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True, axis=0,
+              name=None):
+    """vision/ops box_coder parity (encode/decode_center_size; the R-CNN
+    bbox-delta transform).  axis=1 decode layout is not implemented."""
+    if axis != 0:
+        raise NotImplementedError("box_coder axis=1 layout not implemented")
+    if isinstance(prior_box_var, (list, tuple)):
+        prior_box_var = Tensor(jnp.asarray(prior_box_var, jnp.float32),
+                               _internal=True)
+
+    def impl(pb, pbv, tb):
+        px0, py0, px1, py1 = pb[:, 0], pb[:, 1], pb[:, 2], pb[:, 3]
+        norm = 0.0 if box_normalized else 1.0
+        pw = px1 - px0 + norm
+        ph = py1 - py0 + norm
+        pcx = px0 + pw * 0.5
+        pcy = py0 + ph * 0.5
+        if pbv is None:
+            var = jnp.ones((4,), tb.dtype)
+        else:
+            var = pbv
+        if code_type == "encode_center_size":
+            tx0, ty0, tx1, ty1 = tb[:, 0], tb[:, 1], tb[:, 2], tb[:, 3]
+            tw = tx1 - tx0 + norm
+            th = ty1 - ty0 + norm
+            tcx = tx0 + tw * 0.5
+            tcy = ty0 + th * 0.5
+            if pbv is not None and pbv.ndim == 2:
+                vx, vy, vw, vh = var[:, 0], var[:, 1], var[:, 2], var[:, 3]
+            else:
+                vx, vy, vw, vh = var[0], var[1], var[2], var[3]
+            out = jnp.stack([
+                (tcx[:, None] - pcx[None, :]) / pw[None, :] / vx,
+                (tcy[:, None] - pcy[None, :]) / ph[None, :] / vy,
+                jnp.log(tw[:, None] / pw[None, :]) / vw,
+                jnp.log(th[:, None] / ph[None, :]) / vh,
+            ], axis=-1)  # [T, P, 4]
+            return out
+        if code_type == "decode_center_size":
+            # tb: [N, P, 4] deltas (or [N, 4] broadcast on prior axis)
+            d = tb if tb.ndim == 3 else tb[:, None, :]
+            if pbv is not None and pbv.ndim == 2:
+                v = pbv[None, :, :]
+            else:
+                v = var.reshape(1, 1, 4)
+            cx = d[..., 0] * v[..., 0] * pw[None, :] + pcx[None, :]
+            cy = d[..., 1] * v[..., 1] * ph[None, :] + pcy[None, :]
+            w = jnp.exp(d[..., 2] * v[..., 2]) * pw[None, :]
+            h = jnp.exp(d[..., 3] * v[..., 3]) * ph[None, :]
+            return jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                              cx + w * 0.5 - norm, cy + h * 0.5 - norm],
+                             axis=-1)
+        raise ValueError(f"unknown code_type {code_type!r}")
+
+    return apply_op(impl, "box_coder",
+                    (prior_box, prior_box_var, target_box), {})
+
+
 # -- nms ---------------------------------------------------------------------
 
 def _iou_matrix(boxes):

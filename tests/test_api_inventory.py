@@ -155,3 +155,49 @@ def test_top_level_surface():
         assert hasattr(paddle, name), f"paddle.{name} missing"
     assert paddle.finfo("float32").max > 1e38
     assert paddle.iinfo("int32").max == 2 ** 31 - 1
+
+
+def test_hub_local(tmp_path):
+    hubconf = tmp_path / "hubconf.py"
+    hubconf.write_text(
+        "def tiny_model(width=4):\n"
+        "    '''A tiny linear model.'''\n"
+        "    import paddle_tpu.nn as nn\n"
+        "    return nn.Linear(width, width)\n")
+    import paddle_tpu as paddle
+    assert paddle.hub.list(str(tmp_path)) == ["tiny_model"]
+    assert "tiny" in paddle.hub.help(str(tmp_path), "tiny_model")
+    m = paddle.hub.load(str(tmp_path), "tiny_model", width=3)
+    assert tuple(m.weight.shape) == (3, 3)
+    with pytest.raises(RuntimeError, match="egress"):
+        paddle.hub.load("user/repo", "m", source="github")
+
+
+def test_box_coder_roundtrip():
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.vision.ops import box_coder
+
+    priors = np.array([[0, 0, 10, 10], [5, 5, 20, 25]], "float32")
+    targets = np.array([[1, 1, 9, 11], [4, 6, 22, 24]], "float32")
+    enc = box_coder(paddle.to_tensor(priors), None,
+                    paddle.to_tensor(targets),
+                    code_type="encode_center_size")
+    assert tuple(enc.shape) == (2, 2, 4)
+    # decode the diagonal deltas back onto their own priors
+    deltas = np.stack([enc.numpy()[i, i] for i in range(2)])[None]  # [1,P,4]
+    dec = box_coder(paddle.to_tensor(priors), None,
+                    paddle.to_tensor(deltas.astype("float32")),
+                    code_type="decode_center_size")
+    np.testing.assert_allclose(dec.numpy()[0], targets, rtol=1e-4, atol=1e-4)
+
+
+def test_moe_utils_namespace():
+    from paddle_tpu.distributed.utils.moe_utils import (global_gather,
+                                                        global_scatter)
+    assert callable(global_scatter) and callable(global_gather)
+
+
+def test_static_amp_facade():
+    import paddle_tpu.static as static
+    assert hasattr(static.amp, "auto_cast") or hasattr(static.amp, "decorate")
