@@ -11,6 +11,7 @@ Pallas flash kernel (paddle_tpu.kernels.flash_attention).
 from .layer.fused_transformer import (  # noqa: F401
     FusedBiasDropoutResidualLayerNorm,
     FusedFeedForward,
+    FusedMoELayer,
     FusedMultiHeadAttention,
     FusedMultiTransformer,
     FusedTransformerEncoderLayer,

@@ -167,6 +167,51 @@ class FusedTransformerEncoderLayer(Layer):
         return self.ffn(out)
 
 
+class FusedMoELayer(Layer):
+    """incubate/nn FusedMoELayer parity: an MoE FFN block with the fused-op
+    signature (d_model, dim_feedforward, num_expert, top_k); expert compute
+    and the capacity dispatch ride the incubate MoELayer (all_to_all over
+    the expert axis when bound)."""
+
+    def __init__(self, d_model, dim_feedforward, num_expert, top_k=2,
+                 approximate=True, moe_group=None, mp_group=None,
+                 ln_scale=None, ln_bias=None, gate_weight=None,
+                 gate_bias=None, linear1_weights=None, linear1_biases=None,
+                 linear2_weights=None, linear2_biases=None):
+        super().__init__()
+        from ....incubate.distributed.models.moe import MoELayer
+        from ....nn.layer.activation import GELU
+        from ....nn.layer.container import Sequential
+
+        injected = [ln_scale, ln_bias, gate_weight, gate_bias,
+                    linear1_weights, linear1_biases, linear2_weights,
+                    linear2_biases]
+        if any(v is not None for v in injected) or mp_group is not None:
+            raise NotImplementedError(
+                "FusedMoELayer weight injection / mp_group are not "
+                "supported; build the layer then set_state_dict the "
+                "converted weights")
+
+        def expert():
+            return Sequential(Linear(d_model, dim_feedforward),
+                              GELU(approximate=approximate),
+                              Linear(dim_feedforward, d_model))
+
+        self.norm = LayerNorm(d_model)
+        if top_k == 2:
+            gate = {"type": "gshard"}
+        elif top_k == 1:
+            gate = {"type": "switch"}  # Switch routing keeps balance loss
+        else:
+            gate = {"type": "naive", "top_k": top_k}
+        self.moe = MoELayer(d_model,
+                            [expert() for _ in range(num_expert)],
+                            gate=gate, moe_group=moe_group)
+
+    def forward(self, x):
+        return x + self.moe(self.norm(x))
+
+
 class FusedMultiTransformer(Layer):
     """N stacked pre-LN transformer blocks — fused_transformer.py:914
     (fused_multi_transformer_op.cu: the whole decoder stack as one fused op;

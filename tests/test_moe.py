@@ -219,3 +219,16 @@ def test_fused_transformer_layers():
     bdrln = inn.FusedBiasDropoutResidualLayerNorm(16, dropout_rate=0.0)
     bdrln.eval()
     assert tuple(bdrln(x, x).shape) == (2, 6, 16)
+
+
+def test_fused_moe_layer():
+    import paddle_tpu.incubate.nn as inn
+    paddle.seed(8)
+    layer = inn.FusedMoELayer(16, 32, num_expert=4, top_k=2)
+    x = paddle.to_tensor(np.random.RandomState(9).randn(2, 6, 16)
+                         .astype("float32"))
+    out = layer(x)
+    assert tuple(out.shape) == (2, 6, 16)
+    (out * out).sum().backward()
+    grads = [p for p in layer.parameters() if p.grad is not None]
+    assert len(grads) >= 4
