@@ -1,0 +1,26 @@
+"""Actor-model multi-node runtime — parity with the reference's
+fleet_executor (paddle/fluid/distributed/fleet_executor/: FleetExecutor
+fleet_executor.h:35, Carrier carrier.h:49, Interceptor interceptor.h:46,
+MessageBus message_bus.h:40, TaskNode task_node.h, InterceptorMessage
+interceptor_message.proto).
+
+TPU-native stance: *inside* a slice, pipeline parallelism is compiled into
+one XLA program (distributed/pipeline.py — GSPMD + ppermute); the actor
+runtime here is the **host-level** orchestration layer the reference uses
+brpc for: micro-batch credit flow between stage programs that are each a
+jitted XLA computation, running intra-process (threads + queues) or
+cross-process (socket message bus rendezvoused through the TCPStore).
+"""
+from .task_node import TaskNode
+from .interceptor import (Interceptor, ComputeInterceptor,
+                          AmplifierInterceptor, SourceInterceptor,
+                          SinkInterceptor, InterceptorMessage, MessageType)
+from .message_bus import MessageBus
+from .carrier import Carrier
+from .fleet_executor import FleetExecutor, RuntimeGraph
+
+__all__ = [
+    "TaskNode", "Interceptor", "ComputeInterceptor", "AmplifierInterceptor",
+    "SourceInterceptor", "SinkInterceptor", "InterceptorMessage",
+    "MessageType", "MessageBus", "Carrier", "FleetExecutor", "RuntimeGraph",
+]

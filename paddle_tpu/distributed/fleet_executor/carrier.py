@@ -1,0 +1,94 @@
+"""Carrier — hosts this rank's interceptors and dispatches messages.
+
+Reference: paddle/fluid/distributed/fleet_executor/carrier.h:49 (owns the
+interceptor map, creates them from the local TaskNodes, wakes them with
+START, waits for completion).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from .interceptor import (AmplifierInterceptor, ComputeInterceptor,
+                          Interceptor, InterceptorMessage, MessageType,
+                          SinkInterceptor, SourceInterceptor)
+from .message_bus import MessageBus
+
+_KINDS = {
+    "Compute": ComputeInterceptor,
+    "Amplifier": AmplifierInterceptor,
+    "Source": SourceInterceptor,
+    "Sink": SinkInterceptor,
+}
+
+
+class Carrier:
+    def __init__(self, rank: int = 0, bus: Optional[MessageBus] = None):
+        self.rank = rank
+        self.bus = bus or MessageBus(rank)
+        self.bus.carrier = self
+        self.interceptors: Dict[int, Interceptor] = {}
+        self._done = threading.Event()
+        self._pending = set()
+        self._mu = threading.Lock()
+        self.error: Optional[BaseException] = None
+
+    def create_interceptor(self, node) -> Interceptor:
+        cls = _KINDS.get(node.node_type, ComputeInterceptor)
+        icpt = cls(node.task_id, node)
+        self.add_interceptor(icpt)
+        return icpt
+
+    def add_interceptor(self, icpt: Interceptor) -> None:
+        icpt.carrier = self
+        self.interceptors[icpt.interceptor_id] = icpt
+        self.bus.rank_of[icpt.interceptor_id] = self.rank
+
+    # -- routing --------------------------------------------------------------
+    def send(self, msg: InterceptorMessage) -> None:
+        self.bus.send(msg)
+
+    def enqueue_local(self, msg: InterceptorMessage) -> None:
+        icpt = self.interceptors.get(msg.dst_id)
+        if icpt is None:
+            raise KeyError(f"carrier {self.rank}: no interceptor "
+                           f"{msg.dst_id} for {msg.message_type}")
+        icpt.enqueue(msg)
+
+    # -- lifecycle ------------------------------------------------------------
+    def start(self) -> None:
+        self._done.clear()
+        self.error = None
+        with self._mu:
+            self._pending = set(self.interceptors)
+        for icpt in self.interceptors.values():
+            icpt.start()
+        for icpt in self.interceptors.values():
+            icpt.enqueue(InterceptorMessage(dst_id=icpt.interceptor_id,
+                                            message_type=MessageType.START))
+
+    def on_interceptor_done(self, icpt: Interceptor) -> None:
+        with self._mu:
+            self._pending.discard(icpt.interceptor_id)
+            if not self._pending:
+                self._done.set()
+
+    def on_error(self, icpt: Interceptor, err: BaseException) -> None:
+        self.error = err
+        self._done.set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        ok = self._done.wait(timeout)
+        if self.error is not None:
+            raise RuntimeError(
+                f"fleet_executor interceptor failed: {self.error}"
+            ) from self.error
+        return ok
+
+    def stop(self) -> None:
+        for icpt in self.interceptors.values():
+            icpt.enqueue(InterceptorMessage(dst_id=icpt.interceptor_id,
+                                            message_type=MessageType.STOP))
+        for icpt in self.interceptors.values():
+            icpt.join(timeout=5)
+        self.bus.shutdown()

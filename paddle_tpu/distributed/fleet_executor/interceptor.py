@@ -1,0 +1,204 @@
+"""Interceptors — message-driven actors that execute TaskNodes.
+
+Reference: paddle/fluid/distributed/fleet_executor/interceptor.h:46 and
+compute_interceptor.cc (credit-based flow control: DATA_IS_READY flows
+downstream, DATA_IS_USELESS flows upstream returning buffer credit),
+amplifier_interceptor.cc, source_interceptor.cc, sink_interceptor.cc.
+
+Each interceptor runs on its own thread inside a Carrier, consuming an
+inbox queue.  The data plane rides with the control plane: DATA_IS_READY
+messages carry the actual payload (host arrays / pytrees) — between two
+jitted stage programs the payload stays on device when intra-process.
+"""
+from __future__ import annotations
+
+import collections
+import enum
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+class MessageType(enum.Enum):
+    # interceptor_message.proto MessageType values, minus brpc specifics
+    STOP = 0
+    DATA_IS_READY = 1
+    DATA_IS_USELESS = 2
+    ERR = 3
+    RESET = 4
+    START = 5
+
+
+@dataclass
+class InterceptorMessage:
+    src_id: int = -1
+    dst_id: int = -1
+    message_type: MessageType = MessageType.DATA_IS_READY
+    scope_idx: int = 0            # micro-batch index
+    payload: Any = None           # pytree of arrays (None for pure control)
+    ctrl: dict = field(default_factory=dict)
+
+
+class Interceptor:
+    """Base actor: thread + inbox; subclasses override _handle."""
+
+    def __init__(self, interceptor_id: int, node):
+        self.interceptor_id = interceptor_id
+        self.node = node
+        self.carrier = None            # set by Carrier.add_interceptor
+        self.inbox: "queue.Queue[InterceptorMessage]" = queue.Queue()
+        self._thread: Optional[threading.Thread] = None
+        self._stopped = threading.Event()
+        self.error: Optional[BaseException] = None
+
+    # -- wiring ---------------------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return  # already running; a START message resets per-step state
+        self._thread = threading.Thread(
+            target=self._loop, name=f"interceptor-{self.interceptor_id}",
+            daemon=True)
+        self._thread.start()
+
+    def enqueue(self, msg: InterceptorMessage) -> None:
+        self.inbox.put(msg)
+
+    def send(self, dst_id: int, msg_type: MessageType, scope_idx: int = 0,
+             payload: Any = None, **ctrl) -> None:
+        self.carrier.send(InterceptorMessage(
+            src_id=self.interceptor_id, dst_id=dst_id, message_type=msg_type,
+            scope_idx=scope_idx, payload=payload, ctrl=ctrl))
+
+    def join(self, timeout=None) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    @property
+    def stopped(self) -> bool:
+        return self._stopped.is_set()
+
+    # -- actor loop -----------------------------------------------------------
+    def _loop(self) -> None:
+        while not self._stopped.is_set():
+            msg = self.inbox.get()
+            if msg.message_type == MessageType.STOP:
+                self._stopped.set()
+                break
+            try:
+                self._handle(msg)
+            except BaseException as e:  # propagate to carrier
+                self.error = e
+                self._stopped.set()
+                if self.carrier is not None:
+                    self.carrier.on_error(self, e)
+                break
+
+    def _handle(self, msg: InterceptorMessage) -> None:
+        raise NotImplementedError
+
+
+class ComputeInterceptor(Interceptor):
+    """Credit-flow compute actor (compute_interceptor.cc semantics).
+
+    State per upstream: count of ready micro-batches (+ their payloads);
+    per downstream: used buffer slots.  Run condition: every upstream has
+    >=1 ready AND every downstream has a free slot; then run the node's
+    program once, DATA_IS_USELESS upstream (credit return), DATA_IS_READY
+    downstream (with the result payload).  A node with no upstreams is
+    self-triggered by START for max_run_times micro-batches.
+    """
+
+    def __init__(self, interceptor_id: int, node):
+        super().__init__(interceptor_id, node)
+        self._in_ready: Dict[int, collections.deque] = {
+            u: collections.deque() for u in node.upstream}
+        self._out_used: Dict[int, int] = {d: 0 for d in node.downstream}
+        self._step = 0
+
+    def _can_run(self) -> bool:
+        if self._step >= self.node.max_run_times:
+            return False
+        ins = all(len(q) > 0 for q in self._in_ready.values())
+        outs = all(self._out_used[d] < self.node.downstream[d]
+                   for d in self._out_used)
+        return ins and outs
+
+    def _run_program(self, payloads):
+        prog = self.node.program
+        if prog is None:
+            # pass-through: single upstream payload forwarded unchanged
+            return payloads[0] if payloads else None
+        return prog(*payloads) if payloads else prog()
+
+    def _try_run(self) -> None:
+        while self._can_run():
+            payloads = []
+            for up_id, q in self._in_ready.items():
+                scope_idx, payload = q.popleft()
+                payloads.append(payload)
+                # return the buffer credit upstream
+                self.send(up_id, MessageType.DATA_IS_USELESS,
+                          scope_idx=scope_idx)
+            out = self._run_program(payloads)
+            for down_id in self._out_used:
+                self._out_used[down_id] += 1
+                self.send(down_id, MessageType.DATA_IS_READY,
+                          scope_idx=self._step, payload=out)
+            self._step += 1
+        if (self._step >= self.node.max_run_times
+                and not any(self._in_ready.values())
+                and all(v == 0 for v in self._out_used.values())):
+            # all work done and credits returned: this step is complete
+            self.carrier.on_interceptor_done(self)
+
+    def _handle(self, msg: InterceptorMessage) -> None:
+        if msg.message_type == MessageType.START:
+            self._step = 0
+            for q in self._in_ready.values():
+                q.clear()
+            for d in self._out_used:
+                self._out_used[d] = 0
+            self._try_run()
+        elif msg.message_type == MessageType.DATA_IS_READY:
+            self._in_ready[msg.src_id].append((msg.scope_idx, msg.payload))
+            self._try_run()
+        elif msg.message_type == MessageType.DATA_IS_USELESS:
+            self._out_used[msg.src_id] -= 1
+            self._try_run()
+        elif msg.message_type == MessageType.RESET:
+            self._step = 0
+
+
+class AmplifierInterceptor(ComputeInterceptor):
+    """Runs its program only on steps where step % run_per_steps ==
+    run_at_offset, forwarding unchanged otherwise
+    (amplifier_interceptor.cc — rate conversion between graph regions)."""
+
+    def _run_program(self, payloads):
+        if (self._step % self.node.run_per_steps) == self.node.run_at_offset:
+            return super()._run_program(payloads)
+        return payloads[0] if payloads else None
+
+
+class SourceInterceptor(ComputeInterceptor):
+    """Feeds micro-batches into the graph (source_interceptor.cc).  Its
+    program is `micro_batch_idx -> payload`."""
+
+    def _run_program(self, payloads):
+        return self.node.program(self._step)
+
+
+class SinkInterceptor(ComputeInterceptor):
+    """Terminal node collecting results (sink_interceptor.cc); retrieves
+    per-micro-batch outputs into .results."""
+
+    def __init__(self, interceptor_id: int, node):
+        super().__init__(interceptor_id, node)
+        self.results = []
+
+    def _run_program(self, payloads):
+        out = (self.node.program(*payloads) if self.node.program is not None
+               else (payloads[0] if payloads else None))
+        self.results.append(out)
+        return out

@@ -1,0 +1,121 @@
+"""MessageBus — routes InterceptorMessages between carriers.
+
+Reference: paddle/fluid/distributed/fleet_executor/message_bus.h:40 —
+intra-process delivery is a direct call, cross-process goes through brpc.
+Here: intra-process = direct Carrier dispatch; cross-process = a small
+length-prefixed pickle protocol over TCP, with rank -> (host, port)
+addresses rendezvoused through the TCPStore (the same store that backs
+init_parallel_env, distributed/store.py).
+"""
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+from typing import Dict, Optional
+
+from .interceptor import InterceptorMessage
+
+_HDR = struct.Struct("<Q")
+
+
+class MessageBus:
+    def __init__(self, rank: int = 0, store=None):
+        self.rank = rank
+        self.store = store
+        self.carrier = None                      # local Carrier
+        # interceptor_id -> rank (the routing table; message_bus.h keeps
+        # the same map built from the runtime graph)
+        self.rank_of: Dict[int, int] = {}
+        self._addr: Dict[int, tuple] = {}
+        self._server: Optional[socket.socket] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self._conns: Dict[int, socket.socket] = {}
+        self._conn_mu = threading.Lock()
+        self._stopping = False
+
+    # -- bootstrap ------------------------------------------------------------
+    def listen(self, host: str = "127.0.0.1") -> None:
+        """Open the cross-process endpoint and publish it in the store."""
+        self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._server.bind((host, 0))
+        self._server.listen(64)
+        port = self._server.getsockname()[1]
+        if self.store is not None:
+            self.store.set(f"__msgbus/{self.rank}", f"{host}:{port}")
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               daemon=True)
+        self._accept_thread.start()
+
+    def _lookup(self, rank: int) -> tuple:
+        if rank not in self._addr:
+            raw = self.store.get(f"__msgbus/{rank}").decode()
+            host, port = raw.rsplit(":", 1)
+            self._addr[rank] = (host, int(port))
+        return self._addr[rank]
+
+    def _accept_loop(self) -> None:
+        while not self._stopping:
+            try:
+                conn, _ = self._server.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._recv_loop, args=(conn,),
+                             daemon=True).start()
+
+    def _recv_loop(self, conn: socket.socket) -> None:
+        try:
+            while True:
+                hdr = self._recv_exact(conn, _HDR.size)
+                if hdr is None:
+                    return
+                (n,) = _HDR.unpack(hdr)
+                body = self._recv_exact(conn, n)
+                if body is None:
+                    return
+                msg: InterceptorMessage = pickle.loads(body)
+                self.carrier.enqueue_local(msg)
+        except (OSError, EOFError):
+            return
+
+    @staticmethod
+    def _recv_exact(conn, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = conn.recv(n - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    # -- send path ------------------------------------------------------------
+    def send(self, msg: InterceptorMessage) -> None:
+        dst_rank = self.rank_of.get(msg.dst_id, self.rank)
+        if dst_rank == self.rank:
+            self.carrier.enqueue_local(msg)
+            return
+        data = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
+        with self._conn_mu:
+            conn = self._conns.get(dst_rank)
+            if conn is None:
+                conn = socket.create_connection(self._lookup(dst_rank),
+                                                timeout=60)
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                self._conns[dst_rank] = conn
+            conn.sendall(_HDR.pack(len(data)) + data)
+
+    def shutdown(self) -> None:
+        self._stopping = True
+        for conn in self._conns.values():
+            try:
+                conn.close()
+            except OSError:
+                pass
+        self._conns.clear()
+        if self._server is not None:
+            try:
+                self._server.close()
+            except OSError:
+                pass

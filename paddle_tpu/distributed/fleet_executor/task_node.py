@@ -1,0 +1,57 @@
+"""TaskNode — one actor's worth of work in the runtime graph.
+
+Reference: paddle/fluid/distributed/fleet_executor/task_node.h (task id,
+rank, max_run_times, upstream/downstream with buffer sizes, node type).
+Here the "program" carried by a node is a Python callable (typically a
+`jax.jit`-compiled stage function) instead of a ProgramDesc slice.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+
+class TaskNode:
+    """A node in the runtime graph.
+
+    Args:
+        rank: which process/carrier hosts this node.
+        node_type: "Compute" | "Amplifier" | "Source" | "Sink".
+        max_run_times: how many micro-batches this node processes per step.
+        program: callable run once per micro-batch: payload -> payload.
+        run_per_steps / run_at_offset: amplifier scheduling knobs — the node
+            runs its program only when `(step % run_per_steps) == run_at_offset`
+            (the reference uses these to decimate/amplify message rates, e.g.
+            a LR-scheduler node that fires once per accumulation window).
+    """
+
+    _next_id = [0]
+
+    def __init__(self, rank: int = 0, node_type: str = "Compute",
+                 max_run_times: int = 1,
+                 program: Optional[Callable] = None,
+                 task_id: Optional[int] = None,
+                 run_per_steps: int = 1, run_at_offset: int = 0):
+        if task_id is None:
+            task_id = TaskNode._next_id[0]
+            TaskNode._next_id[0] += 1
+        self.task_id = task_id
+        self.rank = rank
+        self.node_type = node_type
+        self.max_run_times = max_run_times
+        self.program = program
+        self.run_per_steps = run_per_steps
+        self.run_at_offset = run_at_offset
+        # task_id -> buffer size (credit window), like task_node.h's
+        # upstream_/downstream_ maps.
+        self.upstream: Dict[int, int] = {}
+        self.downstream: Dict[int, int] = {}
+
+    def add_upstream_task(self, task_id: int, buff_size: int = 1) -> None:
+        self.upstream[task_id] = buff_size
+
+    def add_downstream_task(self, task_id: int, buff_size: int = 1) -> None:
+        self.downstream[task_id] = buff_size
+
+    def __repr__(self):
+        return (f"TaskNode(id={self.task_id}, rank={self.rank}, "
+                f"type={self.node_type}, runs={self.max_run_times})")

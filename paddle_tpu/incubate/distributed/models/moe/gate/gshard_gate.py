@@ -40,9 +40,10 @@ class GShardGate(NaiveGate):
         self.set_loss(apply_op(aux, "gshard_balance_loss",
                                (gate_score, topk_idx), {}))
 
-        if self.random_routing:
+        if self.random_routing and self.training:
             # keep the 2nd expert with prob 2*p2 (random_routing_op); topk_val
-            # already holds router probabilities
+            # already holds router probabilities.  Training-only: eval keeps
+            # deterministic top-2 so serving is reproducible.
             key = random_mod.next_key()
             prob = jax.random.uniform(key, (n_tokens,),
                                       dtype=gate_score._value.dtype)
