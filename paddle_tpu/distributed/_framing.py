@@ -1,0 +1,37 @@
+"""Shared wire framing for the host-side RPC planes (fleet_executor message
+bus + ps service): length-prefixed pickle over TCP.  One implementation so
+protocol fixes (size guards, versioning) land in both planes.
+"""
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+
+HDR = struct.Struct("<Q")
+
+
+def send_msg(conn: socket.socket, obj) -> None:
+    data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    conn.sendall(HDR.pack(len(data)) + data)
+
+
+def recv_exact(conn: socket.socket, n: int):
+    buf = b""
+    while len(buf) < n:
+        chunk = conn.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+def recv_msg(conn: socket.socket):
+    hdr = recv_exact(conn, HDR.size)
+    if hdr is None:
+        return None
+    (n,) = HDR.unpack(hdr)
+    body = recv_exact(conn, n)
+    if body is None:
+        return None
+    return pickle.loads(body)

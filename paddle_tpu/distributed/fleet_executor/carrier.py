@@ -57,15 +57,21 @@ class Carrier:
 
     # -- lifecycle ------------------------------------------------------------
     def start(self) -> None:
+        if self.error is not None:
+            raise RuntimeError(
+                "carrier is defunct after a previous error; build a new "
+                "FleetExecutor") from self.error
         self._done.clear()
-        self.error = None
         with self._mu:
             self._pending = set(self.interceptors)
-        for icpt in self.interceptors.values():
-            icpt.start()
+        # Enqueue every START while no thread is running yet: each inbox is
+        # FIFO, so START is guaranteed to be handled before any neighbor's
+        # DATA_IS_READY can land and be wiped by the START reset.
         for icpt in self.interceptors.values():
             icpt.enqueue(InterceptorMessage(dst_id=icpt.interceptor_id,
                                             message_type=MessageType.START))
+        for icpt in self.interceptors.values():
+            icpt.start()
 
     def on_interceptor_done(self, icpt: Interceptor) -> None:
         with self._mu:
@@ -73,7 +79,10 @@ class Carrier:
             if not self._pending:
                 self._done.set()
 
-    def on_error(self, icpt: Interceptor, err: BaseException) -> None:
+    def on_error(self, icpt: Optional[Interceptor],
+                 err: BaseException) -> None:
+        """Fatal error from an interceptor thread or from the message bus
+        (icpt=None); wakes wait() which re-raises."""
         self.error = err
         self._done.set()
 

@@ -49,17 +49,20 @@ class FleetExecutor:
         for node in graph.nodes.values():
             bus.rank_of[node.task_id] = node.rank
         self.carrier = Carrier(rank, bus)
-        if nranks > 1:
-            if store is None:
-                raise ValueError("multi-rank FleetExecutor needs a store "
-                                 "for message-bus rendezvous")
-            bus.listen()
-            store.barrier("__fe_init", nranks)
+        if nranks > 1 and store is None:
+            raise ValueError("multi-rank FleetExecutor needs a store "
+                             "for message-bus rendezvous")
         self._sinks = []
         for node in graph.nodes_for_rank(rank):
             icpt = self.carrier.create_interceptor(node)
             if node.node_type == "Sink":
                 self._sinks.append(icpt)
+        if nranks > 1:
+            # barrier only after the local interceptors exist: a fast peer
+            # may fire its first cross-rank message the moment it passes the
+            # barrier, and enqueue_local must be able to deliver it
+            bus.listen()
+            store.barrier("__fe_init", nranks)
 
     # -- builders -------------------------------------------------------------
     @classmethod

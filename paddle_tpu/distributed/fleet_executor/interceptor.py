@@ -56,6 +56,8 @@ class Interceptor:
     def start(self) -> None:
         if self._thread is not None and self._thread.is_alive():
             return  # already running; a START message resets per-step state
+        self._stopped.clear()  # restart after a stop(); errors are fatal at
+        # the carrier level (Carrier.start refuses a defunct carrier)
         self._thread = threading.Thread(
             target=self._loop, name=f"interceptor-{self.interceptor_id}",
             daemon=True)
@@ -154,11 +156,12 @@ class ComputeInterceptor(Interceptor):
 
     def _handle(self, msg: InterceptorMessage) -> None:
         if msg.message_type == MessageType.START:
+            # Only the step counter resets: queues/credits are clean at step
+            # boundaries by the credit invariant, and a neighbor's first
+            # DATA_IS_READY for the new step may legally arrive BEFORE our
+            # START (it queues behind it or ahead of it either way) — wiping
+            # queues here would drop that micro-batch and hang the step.
             self._step = 0
-            for q in self._in_ready.values():
-                q.clear()
-            for d in self._out_used:
-                self._out_used[d] = 0
             self._try_run()
         elif msg.message_type == MessageType.DATA_IS_READY:
             self._in_ready[msg.src_id].append((msg.scope_idx, msg.payload))
@@ -167,7 +170,12 @@ class ComputeInterceptor(Interceptor):
             self._out_used[msg.src_id] -= 1
             self._try_run()
         elif msg.message_type == MessageType.RESET:
+            # full reset (error recovery): drop queued work and credits
             self._step = 0
+            for q in self._in_ready.values():
+                q.clear()
+            for d in self._out_used:
+                self._out_used[d] = 0
 
 
 class AmplifierInterceptor(ComputeInterceptor):

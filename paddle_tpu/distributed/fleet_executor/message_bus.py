@@ -2,22 +2,19 @@
 
 Reference: paddle/fluid/distributed/fleet_executor/message_bus.h:40 —
 intra-process delivery is a direct call, cross-process goes through brpc.
-Here: intra-process = direct Carrier dispatch; cross-process = a small
-length-prefixed pickle protocol over TCP, with rank -> (host, port)
-addresses rendezvoused through the TCPStore (the same store that backs
-init_parallel_env, distributed/store.py).
+Here: intra-process = direct Carrier dispatch; cross-process = the shared
+length-prefixed pickle protocol (.._framing) over TCP, with rank ->
+(host, port) addresses rendezvoused through the TCPStore (the same store
+that backs init_parallel_env, distributed/store.py).
 """
 from __future__ import annotations
 
-import pickle
 import socket
-import struct
 import threading
 from typing import Dict, Optional
 
+from .._framing import recv_msg, send_msg
 from .interceptor import InterceptorMessage
-
-_HDR = struct.Struct("<Q")
 
 
 class MessageBus:
@@ -32,7 +29,10 @@ class MessageBus:
         self._server: Optional[socket.socket] = None
         self._accept_thread: Optional[threading.Thread] = None
         self._conns: Dict[int, socket.socket] = {}
-        self._conn_mu = threading.Lock()
+        # per-destination locks so one slow/stalled peer doesn't serialize
+        # sends to every other rank
+        self._conn_mu: Dict[int, threading.Lock] = {}
+        self._table_mu = threading.Lock()
         self._stopping = False
 
     # -- bootstrap ------------------------------------------------------------
@@ -68,27 +68,18 @@ class MessageBus:
     def _recv_loop(self, conn: socket.socket) -> None:
         try:
             while True:
-                hdr = self._recv_exact(conn, _HDR.size)
-                if hdr is None:
+                msg = recv_msg(conn)
+                if msg is None:
                     return
-                (n,) = _HDR.unpack(hdr)
-                body = self._recv_exact(conn, n)
-                if body is None:
-                    return
-                msg: InterceptorMessage = pickle.loads(body)
                 self.carrier.enqueue_local(msg)
         except (OSError, EOFError):
             return
-
-    @staticmethod
-    def _recv_exact(conn, n):
-        buf = b""
-        while len(buf) < n:
-            chunk = conn.recv(n - len(buf))
-            if not chunk:
-                return None
-            buf += chunk
-        return buf
+        except BaseException as e:
+            # an undeliverable message (e.g. unknown interceptor id) must not
+            # silently kill the recv thread — surface it as a fatal carrier
+            # error so run() raises instead of hanging to timeout
+            if self.carrier is not None:
+                self.carrier.on_error(None, e)
 
     # -- send path ------------------------------------------------------------
     def send(self, msg: InterceptorMessage) -> None:
@@ -96,15 +87,16 @@ class MessageBus:
         if dst_rank == self.rank:
             self.carrier.enqueue_local(msg)
             return
-        data = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
-        with self._conn_mu:
+        with self._table_mu:
+            mu = self._conn_mu.setdefault(dst_rank, threading.Lock())
+        with mu:
             conn = self._conns.get(dst_rank)
             if conn is None:
                 conn = socket.create_connection(self._lookup(dst_rank),
                                                 timeout=60)
                 conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 self._conns[dst_rank] = conn
-            conn.sendall(_HDR.pack(len(data)) + data)
+            send_msg(conn, msg)
 
     def shutdown(self) -> None:
         self._stopping = True

@@ -1,0 +1,27 @@
+"""Parameter-server training — host-side rebuild of the reference PS stack
+(paddle/fluid/distributed/ps/: brpc_ps_server.cc / brpc_ps_client.cc
+services, table/memory_sparse_table.cc, memory_dense_table.cc,
+sparse_sgd_rule.cc accessors; python/paddle/distributed/ps/the_one_ps.py).
+
+TPU-native stance: dense compute stays on-device under XLA; the PS is the
+*host-side* storage/update plane for huge sparse embedding tables that
+cannot live in HBM.  brpc -> a length-prefixed pickle RPC over TCP,
+RocksDB/SSD tables -> in-memory dict-of-rows with save/load, CTR
+accessors -> pluggable per-row SGD rules.  Workers reach the tables
+through `PsClient` (ids sharded by hash across servers, like the
+reference's shard-by-id table partition) and train sparse embeddings with
+`SparseEmbedding`, whose backward pushes gradients straight to the
+servers.  Async and geo-SGD update modes mirror DistributedStrategy
+a_sync/a_sync_configs (SURVEY Appendix A).
+"""
+from .table import (DenseTable, SparseTable, SparseAdaGradRule,
+                    SparseAdamRule, SparseNaiveSGDRule, sgd_rule)
+from .service import PsClient, PsServer
+from .the_one_ps import TheOnePS
+from .sparse_embedding import SparseEmbedding
+
+__all__ = [
+    "DenseTable", "SparseTable", "SparseNaiveSGDRule", "SparseAdaGradRule",
+    "SparseAdamRule", "sgd_rule", "PsServer", "PsClient", "TheOnePS",
+    "SparseEmbedding",
+]

@@ -1,0 +1,277 @@
+"""PS RPC plane: PsServer / PsClient.
+
+Reference: paddle/fluid/distributed/ps/service/brpc_ps_server.cc and
+brpc_ps_client.cc (PsService RPC endpoints pull/push dense+sparse, save,
+load, barrier, stop_server; sendrecv.proto message schema).  brpc ->
+length-prefixed pickle over TCP; each connection is served by a thread;
+pushes can be fire-and-forget (`async_push`, the a_sync mode) in which
+case the server replies before applying.
+
+Sharding contract (matches the reference's id partition): sparse id ->
+server `fid % num_servers`; dense tables live on server
+`hash(table_name) % num_servers`.
+"""
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .._framing import recv_msg as _recv_msg, send_msg as _send_msg
+from .table import DenseTable, SparseTable
+
+
+class PsServer:
+    """One PS shard: owns its slice of every table and serves the RPC loop
+    (brpc_ps_server.cc's PsService)."""
+
+    def __init__(self, server_idx: int = 0, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.server_idx = server_idx
+        self.sparse_tables: Dict[str, SparseTable] = {}
+        self.dense_tables: Dict[str, DenseTable] = {}
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(128)
+        self.host, self.port = self._sock.getsockname()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._barrier_count = 0
+        self._barrier_gen = 0
+        self._barrier_cv = threading.Condition()
+
+    @property
+    def endpoint(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    # -- table management -----------------------------------------------------
+    def add_sparse_table(self, name: str, dim: int, rule: str = "adagrad",
+                         **kw) -> None:
+        self.sparse_tables[name] = SparseTable(
+            name, dim, rule, seed=self.server_idx * 7919 + 1, **kw)
+
+    def add_dense_table(self, name: str, shape, lr: float = 0.01) -> None:
+        # deterministic across processes (str hash() is salted per process)
+        self.dense_tables[name] = DenseTable(name, shape, lr,
+                                             seed=sum(name.encode()) & 0xFFFF)
+
+    # -- serving --------------------------------------------------------------
+    def run(self, block: bool = False) -> None:
+        self._thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._thread.start()
+        if block:
+            self._stop.wait()
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            while True:
+                req = _recv_msg(conn)
+                if req is None:
+                    return
+                op = req["op"]
+                if op == "stop":
+                    _send_msg(conn, {"ok": True})
+                    self.shutdown()
+                    return
+                is_async = req.get("async", False)
+                if is_async:
+                    _send_msg(conn, {"ok": True})
+                try:
+                    out = self._dispatch(req)
+                    if not is_async:
+                        _send_msg(conn, {"ok": True, "out": out})
+                except Exception as e:  # table errors back to the client
+                    if not is_async:
+                        _send_msg(conn, {"ok": False, "err": repr(e)})
+        except OSError:
+            return
+
+    def _dispatch(self, req):
+        op = req["op"]
+        if op == "pull_sparse":
+            return self.sparse_tables[req["table"]].pull(req["ids"])
+        if op == "push_sparse":
+            return self.sparse_tables[req["table"]].push(req["ids"],
+                                                         req["grads"])
+        if op == "push_sparse_delta":
+            return self.sparse_tables[req["table"]].push_delta(req["ids"],
+                                                               req["grads"])
+        if op == "pull_dense":
+            return self.dense_tables[req["table"]].pull()
+        if op == "push_dense":
+            return self.dense_tables[req["table"]].push(req["grad"])
+        if op == "push_dense_delta":
+            return self.dense_tables[req["table"]].push_delta(req["grad"])
+        if op == "save":
+            return self._save(req["dirname"])
+        if op == "load":
+            return self._load(req["dirname"])
+        if op == "barrier":
+            # real rendezvous: block until `world` participants arrive
+            # (generation counter so consecutive barriers don't bleed)
+            world = int(req.get("world", 1))
+            with self._barrier_cv:
+                gen = self._barrier_gen
+                self._barrier_count += 1
+                if self._barrier_count >= world:
+                    self._barrier_count = 0
+                    self._barrier_gen += 1
+                    self._barrier_cv.notify_all()
+                else:
+                    if not self._barrier_cv.wait_for(
+                            lambda: self._barrier_gen > gen, timeout=300):
+                        raise TimeoutError(
+                            f"PS barrier timed out waiting for {world} "
+                            f"workers")
+            return None
+        if op == "table_size":
+            return len(self.sparse_tables[req["table"]])
+        raise ValueError(f"unknown PS op {op!r}")
+
+    def _save(self, dirname: str) -> None:
+        import os
+        os.makedirs(dirname, exist_ok=True)
+        for name, t in self.sparse_tables.items():
+            t.save(f"{dirname}/sparse_{name}.shard{self.server_idx}")
+        for name, t in self.dense_tables.items():
+            t.save(f"{dirname}/dense_{name}")
+
+    def _load(self, dirname: str) -> None:
+        for name, t in self.sparse_tables.items():
+            t.load(f"{dirname}/sparse_{name}.shard{self.server_idx}")
+        for name, t in self.dense_tables.items():
+            t.load(f"{dirname}/dense_{name}")
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class PsClient:
+    """Worker-side handle to all PS shards (brpc_ps_client.cc).
+
+    Sparse ids are partitioned `fid % num_servers`; pulls fan out to the
+    owning shards and re-assemble in input order.  `async_push=True` makes
+    pushes fire-and-forget (a_sync mode).
+    """
+
+    def __init__(self, endpoints: List[str], async_push: bool = False):
+        self.endpoints = list(endpoints)
+        self.async_push = async_push
+        self._conns: List[Optional[socket.socket]] = [None] * len(endpoints)
+        self._mu = [threading.Lock() for _ in endpoints]
+
+    def _conn(self, idx: int) -> socket.socket:
+        if self._conns[idx] is None:
+            host, port = self.endpoints[idx].rsplit(":", 1)
+            conn = socket.create_connection((host, int(port)), timeout=120)
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._conns[idx] = conn
+        return self._conns[idx]
+
+    def _call(self, idx: int, req: dict):
+        with self._mu[idx]:
+            conn = self._conn(idx)
+            _send_msg(conn, req)
+            resp = _recv_msg(conn)
+        if resp is None:
+            raise ConnectionError(f"PS server {self.endpoints[idx]} closed")
+        if not resp.get("ok"):
+            raise RuntimeError(f"PS error from {self.endpoints[idx]}: "
+                               f"{resp.get('err')}")
+        return resp.get("out")
+
+    # -- sparse ---------------------------------------------------------------
+    def _shard_ids(self, ids: np.ndarray):
+        ids = np.asarray(ids).reshape(-1)
+        owner = ids % len(self.endpoints)
+        return ids, owner
+
+    def pull_sparse(self, table: str, ids) -> np.ndarray:
+        ids, owner = self._shard_ids(ids)
+        if len(ids) == 0:
+            # the owning table knows dim; shard 0 answers for empty pulls
+            return self._call(0, {"op": "pull_sparse", "table": table,
+                                  "ids": ids})
+        out = None
+        for s in range(len(self.endpoints)):
+            mask = owner == s
+            if not mask.any():
+                continue
+            rows = self._call(s, {"op": "pull_sparse", "table": table,
+                                  "ids": ids[mask]})
+            if out is None:
+                out = np.empty((len(ids), rows.shape[1]), rows.dtype)
+            out[mask] = rows
+        return out
+
+    def push_sparse(self, table: str, ids, grads, delta: bool = False) -> None:
+        ids, owner = self._shard_ids(ids)
+        grads = np.asarray(grads, np.float32).reshape(len(ids), -1)
+        op = "push_sparse_delta" if delta else "push_sparse"
+        for s in range(len(self.endpoints)):
+            mask = owner == s
+            if not mask.any():
+                continue
+            self._call(s, {"op": op, "table": table, "ids": ids[mask],
+                           "grads": grads[mask], "async": self.async_push})
+
+    # -- dense ----------------------------------------------------------------
+    def _dense_owner(self, table: str) -> int:
+        return sum(table.encode()) % len(self.endpoints)
+
+    def pull_dense(self, table: str) -> np.ndarray:
+        return self._call(self._dense_owner(table),
+                          {"op": "pull_dense", "table": table})
+
+    def push_dense(self, table: str, grad, delta: bool = False) -> None:
+        self._call(self._dense_owner(table),
+                   {"op": "push_dense_delta" if delta else "push_dense",
+                    "table": table, "grad": np.asarray(grad, np.float32),
+                    "async": self.async_push})
+
+    # -- control --------------------------------------------------------------
+    def save(self, dirname: str) -> None:
+        for s in range(len(self.endpoints)):
+            self._call(s, {"op": "save", "dirname": dirname})
+
+    def load(self, dirname: str) -> None:
+        for s in range(len(self.endpoints)):
+            self._call(s, {"op": "load", "dirname": dirname})
+
+    def barrier(self, world: int = 1) -> None:
+        """Block until `world` workers have reached this barrier (served by
+        shard 0 — one rendezvous point, like the reference's barrier table)."""
+        self._call(0, {"op": "barrier", "world": world})
+
+    def stop_server(self) -> None:
+        for s in range(len(self.endpoints)):
+            try:
+                self._call(s, {"op": "stop"})
+            except (ConnectionError, OSError, RuntimeError):
+                pass
+
+    def close(self) -> None:
+        for conn in self._conns:
+            if conn is not None:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+        self._conns = [None] * len(self.endpoints)

@@ -1,0 +1,115 @@
+"""SparseEmbedding — a PS-backed embedding layer.
+
+Reference: the `distributed_lookup_table` / `distributed_push_sparse` op
+pair (paddle/fluid/operators/pscore/distributed_lookup_table_op.cc) that
+backs paddle.static.nn.sparse_embedding: forward pulls rows for the
+minibatch ids from the PS, backward pushes the row gradients.
+
+Autograd wiring: the pull happens on host; the gathered rows enter the
+eager tape as a leaf produced by a GradNode whose vjp pushes gradients to
+the PS (and returns nothing upward — the table itself is remote, there is
+no local parameter).  In geo mode the layer keeps a local cache and
+pushes accumulated deltas every `geo_step` forwards.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...core import autograd
+from ...core.tensor import Tensor
+from ...nn import Layer
+from .the_one_ps import _active
+
+
+class SparseEmbedding(Layer):
+    def __init__(self, table_name: str, embedding_dim: int,
+                 client=None, dtype: str = "float32",
+                 geo_lr: float = 0.01):
+        super().__init__()
+        self.table_name = table_name
+        self.embedding_dim = embedding_dim
+        self._client = client
+        self._dtype = dtype
+        # geo-SGD applies plain local SGD between delta pushes (geo's defining
+        # semantics — the table's server-side rule is bypassed by design);
+        # the step size must be the trainer's choice, not a constant
+        self.geo_lr = geo_lr
+        # geo mode state
+        self._geo_cache: dict = {}
+        self._geo_accum: dict = {}
+        self._step = 0
+
+    @property
+    def client(self):
+        if self._client is not None:
+            return self._client
+        ps = _active()
+        if ps is None or ps.client is None:
+            raise RuntimeError(
+                "SparseEmbedding needs a PsClient: call TheOnePS."
+                "init_worker() first or pass client=")
+        return ps.client
+
+    def _mode(self) -> str:
+        ps = _active()
+        return ps.mode if ps is not None else "sync"
+
+    def _geo_pull(self, flat: np.ndarray) -> np.ndarray:
+        """Geo-SGD: serve from the local cache, refreshing missing ids from
+        the servers; deltas accumulate locally between pushes."""
+        missing = [i for i in np.unique(flat) if int(i) not in self._geo_cache]
+        if missing:
+            rows = self.client.pull_sparse(self.table_name,
+                                           np.asarray(missing))
+            for i, r in zip(missing, rows):
+                self._geo_cache[int(i)] = r.copy()
+        return np.stack([self._geo_cache[int(i)] for i in flat])
+
+    def _geo_apply_grad(self, flat: np.ndarray, grads: np.ndarray) -> None:
+        for i, g in zip(flat, grads):
+            i = int(i)
+            delta = -self.geo_lr * g
+            self._geo_cache[i] += delta
+            self._geo_accum[i] = self._geo_accum.get(
+                i, np.zeros(self.embedding_dim, np.float32)) + delta
+        self._step += 1
+        ps = _active()
+        k = ps.geo_step if ps is not None else 8
+        if self._step % k == 0 and self._geo_accum:
+            ids = np.fromiter(self._geo_accum, dtype=np.int64)
+            deltas = np.stack([self._geo_accum[int(i)] for i in ids])
+            self.client.push_sparse(self.table_name, ids, deltas, delta=True)
+            self._geo_accum.clear()
+
+    def forward(self, ids):
+        ids_np = np.asarray(ids._value if isinstance(ids, Tensor) else ids)
+        shape = ids_np.shape
+        flat = ids_np.reshape(-1).astype(np.int64)
+        geo = self._mode() == "geo"
+        rows = (self._geo_pull(flat) if geo
+                else self.client.pull_sparse(self.table_name, flat))
+        out_val = jnp.asarray(rows.reshape(*shape, self.embedding_dim),
+                              dtype=self._dtype)
+        out = Tensor(out_val, _internal=True)
+
+        if autograd.is_grad_enabled() and self.training:
+            def vjp_fn(cts):
+                g = np.asarray(cts[0] if isinstance(cts, (tuple, list))
+                               else cts, np.float32)
+                g = g.reshape(-1, self.embedding_dim)
+                if geo:
+                    self._geo_apply_grad(flat, g)
+                else:
+                    self.client.push_sparse(self.table_name, flat, g)
+                return []
+
+            node = autograd.GradNode(
+                vjp_fn, [], 1, [(tuple(out.shape), out._value.dtype)],
+                name="distributed_lookup_table")
+            out._grad_node = node
+            out._grad_slot = 0
+            out.stop_gradient = False
+        return out

@@ -1,0 +1,223 @@
+"""PS tables + per-row SGD rules.
+
+Reference: paddle/fluid/distributed/ps/table/memory_sparse_table.cc
+(shard-local id->row hashmap, lazy row init), memory_dense_table.cc
+(contiguous dense block), sparse_sgd_rule.cc (SparseNaiveSGDRule /
+SparseAdaGradSGDRule / SparseAdamSGDRule applying per-row updates with
+embedded optimizer state).
+
+Rows live in numpy on the host — the whole point of the PS plane is
+capacity beyond HBM; the TPU only ever sees the gathered minibatch rows.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# per-row SGD rules (sparse_sgd_rule.cc).  State is stored alongside the
+# embedding in the row: [emb | rule state...]
+# ---------------------------------------------------------------------------
+class SparseNaiveSGDRule:
+    """row <- row - lr * g"""
+
+    name = "naive"
+
+    def __init__(self, dim: int, lr: float = 0.01,
+                 initial_range: float = 0.05):
+        self.dim = dim
+        self.lr = lr
+        self.initial_range = initial_range
+
+    @property
+    def state_dim(self) -> int:
+        return 0
+
+    def init_row(self, rng: np.random.RandomState) -> np.ndarray:
+        emb = rng.uniform(-self.initial_range, self.initial_range,
+                          self.dim).astype(np.float32)
+        return emb
+
+    def update(self, row: np.ndarray, grad: np.ndarray) -> None:
+        row[:self.dim] -= self.lr * grad
+
+
+class SparseAdaGradRule(SparseNaiveSGDRule):
+    """AdaGrad with a scalar accumulator per row (the reference's
+    std_adagrad keeps g2sum per feature; scalar keeps rows compact)."""
+
+    name = "adagrad"
+
+    def __init__(self, dim: int, lr: float = 0.05, initial_range: float = 0.05,
+                 initial_g2sum: float = 3.0, eps: float = 1e-8):
+        super().__init__(dim, lr, initial_range)
+        self.initial_g2sum = initial_g2sum
+        self.eps = eps
+
+    @property
+    def state_dim(self) -> int:
+        return 1
+
+    def init_row(self, rng) -> np.ndarray:
+        emb = super().init_row(rng)
+        return np.concatenate([emb, np.full(1, self.initial_g2sum,
+                                            np.float32)])
+
+    def update(self, row, grad) -> None:
+        g2sum = row[self.dim] + float((grad * grad).mean())
+        row[self.dim] = g2sum
+        row[:self.dim] -= self.lr * grad / (np.sqrt(g2sum) + self.eps)
+
+
+class SparseAdamRule(SparseNaiveSGDRule):
+    """Adam with per-row m/v vectors + shared beta powers
+    (sparse_sgd_rule.cc SparseAdamSGDRule keeps beta1/2_pow in-row)."""
+
+    name = "adam"
+
+    def __init__(self, dim: int, lr: float = 0.001, initial_range: float = 0.05,
+                 beta1: float = 0.9, beta2: float = 0.999, eps: float = 1e-8):
+        super().__init__(dim, lr, initial_range)
+        self.beta1, self.beta2, self.eps = beta1, beta2, eps
+
+    @property
+    def state_dim(self) -> int:
+        return 2 * self.dim + 2
+
+    def init_row(self, rng) -> np.ndarray:
+        emb = super().init_row(rng)
+        state = np.zeros(2 * self.dim + 2, np.float32)
+        state[-2:] = 1.0  # beta1_pow, beta2_pow
+        return np.concatenate([emb, state])
+
+    def update(self, row, grad) -> None:
+        d = self.dim
+        m, v = row[d:2 * d], row[2 * d:3 * d]
+        row[-2] *= self.beta1
+        row[-1] *= self.beta2
+        m[:] = self.beta1 * m + (1 - self.beta1) * grad
+        v[:] = self.beta2 * v + (1 - self.beta2) * grad * grad
+        mhat = m / (1 - row[-2])
+        vhat = v / (1 - row[-1])
+        row[:d] -= self.lr * mhat / (np.sqrt(vhat) + self.eps)
+
+
+_RULES = {r.name: r for r in
+          (SparseNaiveSGDRule, SparseAdaGradRule, SparseAdamRule)}
+
+
+def sgd_rule(name: str, dim: int, **kw):
+    if name not in _RULES:
+        raise ValueError(f"unknown sparse SGD rule {name!r}; "
+                         f"have {sorted(_RULES)}")
+    return _RULES[name](dim, **kw)
+
+
+# ---------------------------------------------------------------------------
+# tables
+# ---------------------------------------------------------------------------
+class SparseTable:
+    """One server shard of a distributed id->embedding table
+    (memory_sparse_table.cc).  Rows are created lazily on first pull,
+    deterministically seeded per id so every shard layout reproduces."""
+
+    def __init__(self, name: str, dim: int, rule: str = "adagrad",
+                 seed: int = 0, **rule_kw):
+        self.name = name
+        self.dim = dim
+        self.rule = sgd_rule(rule, dim, **rule_kw)
+        self.seed = seed
+        self._rows: Dict[int, np.ndarray] = {}
+        self._mu = threading.Lock()
+
+    def _row(self, fid: int) -> np.ndarray:
+        row = self._rows.get(fid)
+        if row is None:
+            rng = np.random.RandomState(
+                (self.seed * 0x9E3779B1 + fid) & 0x7FFFFFFF)
+            row = self.rule.init_row(rng)
+            self._rows[fid] = row
+        return row
+
+    def pull(self, ids: np.ndarray) -> np.ndarray:
+        if len(ids) == 0:
+            return np.zeros((0, self.dim), np.float32)
+        with self._mu:
+            return np.stack([self._row(int(i))[:self.dim] for i in ids])
+
+    def push(self, ids: np.ndarray, grads: np.ndarray) -> None:
+        """Apply the SGD rule per id; duplicate ids accumulate first (the
+        reference merges gradients by key before update)."""
+        ids = np.asarray(ids)
+        grads = np.asarray(grads, np.float32)
+        uniq, inv = np.unique(ids, return_inverse=True)
+        merged = np.zeros((len(uniq), self.dim), np.float32)
+        np.add.at(merged, inv, grads)
+        with self._mu:
+            for fid, g in zip(uniq, merged):
+                self.rule.update(self._row(int(fid)), g)
+
+    def push_delta(self, ids: np.ndarray, deltas: np.ndarray) -> None:
+        """Geo-SGD: add raw parameter deltas (no rule state touched)."""
+        ids = np.asarray(ids)
+        deltas = np.asarray(deltas, np.float32)
+        with self._mu:
+            for fid, d in zip(ids, deltas):
+                self._row(int(fid))[:self.dim] += d
+
+    def __len__(self):
+        return len(self._rows)
+
+    # -- persistence (ssd_sparse_table's save/load contract, pickle form) ----
+    def save(self, path: str) -> None:
+        with self._mu, open(path, "wb") as f:
+            pickle.dump({"dim": self.dim, "rule": self.rule.name,
+                         "rows": self._rows}, f)
+
+    def load(self, path: str) -> None:
+        with open(path, "rb") as f:
+            blob = pickle.load(f)
+        if blob["dim"] != self.dim:
+            raise ValueError(f"table {self.name}: dim mismatch "
+                             f"{blob['dim']} vs {self.dim}")
+        with self._mu:
+            self._rows = blob["rows"]
+
+
+class DenseTable:
+    """Server-resident dense parameter block (memory_dense_table.cc) with a
+    plain-SGD update; used for the small dense side of PS recipes."""
+
+    def __init__(self, name: str, shape, lr: float = 0.01, seed: int = 0):
+        self.name = name
+        self.lr = lr
+        rng = np.random.RandomState(seed & 0x7FFFFFFF)
+        limit = np.sqrt(6.0 / max(1, int(np.prod(shape))))
+        self.value = rng.uniform(-limit, limit, shape).astype(np.float32)
+        self._mu = threading.Lock()
+
+    def pull(self) -> np.ndarray:
+        with self._mu:
+            return self.value.copy()
+
+    def push(self, grad: np.ndarray) -> None:
+        with self._mu:
+            self.value -= self.lr * np.asarray(grad, np.float32)
+
+    def push_delta(self, delta: np.ndarray) -> None:
+        with self._mu:
+            self.value += np.asarray(delta, np.float32)
+
+    def save(self, path: str) -> None:
+        with self._mu:
+            np.save(path, self.value)
+
+    def load(self, path: str) -> None:
+        val = np.load(path if path.endswith(".npy") else path + ".npy")
+        with self._mu:
+            self.value = val.astype(np.float32)

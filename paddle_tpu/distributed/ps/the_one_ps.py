@@ -1,0 +1,124 @@
+"""TheOnePS — the unified PS runtime bootstrap.
+
+Reference: python/paddle/distributed/ps/the_one_ps.py (builds table
+descriptors from the program, starts servers on PS ranks, initializes
+worker clients, run_server/init_worker/stop_worker lifecycle) and
+fleet/runtime/the_one_ps.py.
+
+Table specs here are declared explicitly (dataclass-style dicts) instead
+of being mined out of a ProgramDesc — the sparse side of a TPU recipe is
+whatever `SparseEmbedding` layers the model declares.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+from .service import PsClient, PsServer
+
+_ACTIVE: Optional["TheOnePS"] = None
+
+
+def _active() -> Optional["TheOnePS"]:
+    return _ACTIVE
+
+
+class TheOnePS:
+    """Lifecycle: on server ranks `run_server()` (blocks); on workers
+    `init_worker()` then train, `barrier()`, `stop()`.
+
+    Args:
+        role_maker: fleet RoleMaker (worker/server identity + endpoints);
+            optional — defaults to the PADDLE_* env contract via
+            PaddleCloudRoleMaker.
+        mode: "sync" | "async" | "geo" (DistributedStrategy a_sync /
+            a_sync_configs{geo}: sync pushes apply inline, async pushes are
+            fire-and-forget, geo accumulates local deltas pushed every
+            `geo_step` steps by the SparseEmbedding layers).
+        geo_step: push cadence for geo mode (a_sync_configs.k_steps).
+    """
+
+    def __init__(self, role_maker=None, mode: str = "sync",
+                 geo_step: int = 8):
+        global _ACTIVE
+        if role_maker is None:
+            from ..fleet.base.role_maker import PaddleCloudRoleMaker
+            role_maker = PaddleCloudRoleMaker(is_collective=False)
+        self.role_maker = role_maker
+        if mode not in ("sync", "async", "geo"):
+            raise ValueError(f"unknown PS mode {mode!r}")
+        self.mode = mode
+        self.geo_step = geo_step
+        self.tables: List[dict] = []
+        self.server: Optional[PsServer] = None
+        self.client: Optional[PsClient] = None
+        _ACTIVE = self
+
+    # -- declaration ----------------------------------------------------------
+    def add_sparse_table(self, name: str, dim: int, rule: str = "adagrad",
+                         **kw) -> None:
+        self.tables.append(dict(kind="sparse", name=name, dim=dim,
+                                rule=rule, kw=kw))
+
+    def add_dense_table(self, name: str, shape, lr: float = 0.01) -> None:
+        self.tables.append(dict(kind="dense", name=name, shape=shape, lr=lr))
+
+    # -- server side ----------------------------------------------------------
+    def init_server(self, port: Optional[int] = None,
+                    model_dir: Optional[str] = None) -> PsServer:
+        idx = self.role_maker.server_index()
+        eps = self.role_maker.get_pserver_endpoints()
+        if port is None and idx < len(eps):
+            port = int(eps[idx].rsplit(":", 1)[1])
+        self.server = PsServer(server_idx=idx, port=port or 0)
+        for spec in self.tables:
+            if spec["kind"] == "sparse":
+                self.server.add_sparse_table(spec["name"], spec["dim"],
+                                             spec["rule"], **spec["kw"])
+            else:
+                self.server.add_dense_table(spec["name"], spec["shape"],
+                                            spec["lr"])
+        if model_dir:
+            self.server._load(model_dir)
+        return self.server
+
+    def run_server(self, block: bool = True) -> None:
+        if self.server is None:
+            self.init_server()
+        self.server.run(block=block)
+
+    # -- worker side ----------------------------------------------------------
+    def init_worker(self, endpoints: Optional[List[str]] = None) -> PsClient:
+        eps = endpoints or self.role_maker.get_pserver_endpoints()
+        if not eps:
+            raise RuntimeError("no pserver endpoints: set "
+                               "PADDLE_PSERVERS_IP_PORT_LIST or pass "
+                               "endpoints=")
+        self.client = PsClient(eps, async_push=(self.mode == "async"))
+        return self.client
+
+    def barrier_worker(self) -> None:
+        if self.client is not None:
+            try:
+                world = int(self.role_maker.worker_num())
+            except (AttributeError, TypeError, ValueError):
+                world = 1
+            self.client.barrier(world=world)
+
+    def save(self, dirname: str) -> None:
+        self.client.save(dirname)
+
+    def load(self, dirname: str) -> None:
+        self.client.load(dirname)
+
+    def stop(self) -> None:
+        global _ACTIVE
+        if self.client is not None:
+            self.client.stop_server()
+            self.client.close()
+            self.client = None
+        if self.server is not None:
+            self.server.shutdown()
+            self.server = None
+        if _ACTIVE is self:
+            _ACTIVE = None

@@ -90,6 +90,9 @@ def test_interceptor_error_propagates():
                                    feed_fn=lambda i: i)
     with pytest.raises(RuntimeError, match="stage failed"):
         fe.run(timeout=60)
+    # a defunct carrier refuses re-use fast instead of hanging to timeout
+    with pytest.raises(RuntimeError, match="defunct"):
+        fe.run(timeout=60)
     fe.shutdown()
 
 

@@ -1,0 +1,242 @@
+"""Parameter-server tests (reference pattern: unittests/test_dist_base.py
+runs pservers+trainers as local processes; here servers are in-process
+threads with real TCP sockets, which exercises the same RPC plane)."""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.ps import (PsClient, PsServer, SparseAdamRule,
+                                       SparseEmbedding, SparseNaiveSGDRule,
+                                       SparseTable, TheOnePS)
+
+
+# ---------------------------------------------------------------------------
+# table-level unit tests (sparse_sgd_rule.cc semantics)
+# ---------------------------------------------------------------------------
+def test_sparse_table_lazy_init_deterministic():
+    t1 = SparseTable("emb", 4, rule="naive", seed=3)
+    t2 = SparseTable("emb", 4, rule="naive", seed=3)
+    np.testing.assert_array_equal(t1.pull(np.array([7, 9])),
+                                  t2.pull(np.array([7, 9])))
+    assert len(t1) == 2
+
+
+def test_sparse_naive_rule_update():
+    t = SparseTable("emb", 3, rule="naive", lr=0.5)
+    before = t.pull(np.array([5]))[0].copy()
+    g = np.array([[1.0, 2.0, 3.0]], np.float32)
+    t.push(np.array([5]), g)
+    np.testing.assert_allclose(t.pull(np.array([5]))[0],
+                               before - 0.5 * g[0], rtol=1e-6)
+
+
+def test_duplicate_ids_merge_before_update():
+    """Two grads for the same id in one push must accumulate, then apply
+    the rule once (the reference merges by key)."""
+    t = SparseTable("emb", 2, rule="naive", lr=1.0)
+    before = t.pull(np.array([1]))[0].copy()
+    t.push(np.array([1, 1]), np.array([[1., 0.], [0., 1.]], np.float32))
+    np.testing.assert_allclose(t.pull(np.array([1]))[0],
+                               before - np.array([1., 1.]), rtol=1e-6)
+
+
+def test_adam_rule_matches_reference_math():
+    t = SparseTable("emb", 2, rule="adam", lr=0.1)
+    w0 = t.pull(np.array([0]))[0].copy()
+    g = np.array([[0.5, -0.5]], np.float32)
+    t.push(np.array([0]), g)
+    # first adam step: mhat=g, vhat=g^2 -> w - lr*g/(|g|+eps) = w -+ 0.1
+    np.testing.assert_allclose(t.pull(np.array([0]))[0],
+                               w0 - 0.1 * np.sign(g[0]), rtol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# RPC plane over real sockets, 2 server shards
+# ---------------------------------------------------------------------------
+@pytest.fixture
+def two_servers():
+    servers = []
+    for idx in range(2):
+        s = PsServer(server_idx=idx)
+        s.add_sparse_table("emb", 4, rule="naive", lr=1.0)
+        s.add_dense_table("fc_w", (3, 2), lr=1.0)
+        s.run()
+        servers.append(s)
+    client = PsClient([s.endpoint for s in servers])
+    yield servers, client
+    client.stop_server()
+    client.close()
+
+
+def test_pull_push_sparse_sharded(two_servers):
+    servers, client = two_servers
+    ids = np.array([0, 1, 2, 3, 5, 8])          # mixed parity -> both shards
+    rows = client.pull_sparse("emb", ids)
+    assert rows.shape == (6, 4)
+    # ids 1,3,5 live on shard 1, evens on shard 0
+    assert len(servers[0].sparse_tables["emb"]) == 3
+    assert len(servers[1].sparse_tables["emb"]) == 3
+    g = np.ones((6, 4), np.float32)
+    client.push_sparse("emb", ids, g)
+    after = client.pull_sparse("emb", ids)
+    np.testing.assert_allclose(after, rows - 1.0, rtol=1e-6)
+
+
+def test_dense_table_roundtrip(two_servers):
+    _, client = two_servers
+    w = client.pull_dense("fc_w")
+    assert w.shape == (3, 2)
+    client.push_dense("fc_w", np.ones((3, 2)))
+    np.testing.assert_allclose(client.pull_dense("fc_w"), w - 1.0, rtol=1e-6)
+
+
+def test_pull_sparse_empty_ids(two_servers):
+    _, client = two_servers
+    rows = client.pull_sparse("emb", np.array([], np.int64))
+    assert rows.shape == (0, 4)
+
+
+def test_barrier_blocks_until_world_arrives(two_servers):
+    """barrier(world=2) must rendezvous two workers — the first caller
+    blocks until the second arrives (brpc_ps_server barrier semantics)."""
+    import threading
+    _, client = two_servers
+    order = []
+
+    def w(name):
+        client2 = PsClient(client.endpoints)
+        client2.barrier(world=2)
+        order.append(name)
+        client2.close()
+
+    t1 = threading.Thread(target=w, args=("a",))
+    t1.start()
+    time.sleep(0.3)
+    assert order == []           # first worker is parked at the barrier
+    t2 = threading.Thread(target=w, args=("b",))
+    t2.start()
+    t1.join(10)
+    t2.join(10)
+    assert sorted(order) == ["a", "b"]
+
+
+def test_save_load_roundtrip(two_servers, tmp_path):
+    _, client = two_servers
+    ids = np.arange(6)
+    before = client.pull_sparse("emb", ids)
+    client.push_sparse("emb", ids, np.full((6, 4), 0.25, np.float32))
+    client.save(str(tmp_path))
+    client.push_sparse("emb", ids, np.ones((6, 4), np.float32))
+    client.load(str(tmp_path))
+    np.testing.assert_allclose(client.pull_sparse("emb", ids),
+                               before - 0.25, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# TheOnePS + SparseEmbedding end-to-end (the_one_ps.py lifecycle)
+# ---------------------------------------------------------------------------
+def _launch_ps(mode="sync", dim=8, rule="adagrad", n_servers=2):
+    servers = []
+    eps = []
+    for idx in range(n_servers):
+        s = PsServer(server_idx=idx)
+        s.add_sparse_table("word_emb", dim, rule=rule)
+        s.run()
+        servers.append(s)
+        eps.append(s.endpoint)
+    ps = TheOnePS(role_maker=_FakeRole(eps), mode=mode)
+    ps.add_sparse_table("word_emb", dim, rule=rule)
+    ps.init_worker(endpoints=eps)
+    return ps, servers
+
+
+class _FakeRole:
+    def __init__(self, eps):
+        self._eps = eps
+
+    def get_pserver_endpoints(self):
+        return self._eps
+
+    def server_index(self):
+        return 0
+
+
+def test_sparse_embedding_trains():
+    ps, servers = _launch_ps()
+    try:
+        emb = SparseEmbedding("word_emb", 8)
+        proj = paddle.to_tensor(np.linspace(-1, 1, 8).astype(np.float32),
+                                stop_gradient=False)
+        ids = np.array([[1, 2, 3], [2, 4, 6]])
+        target = paddle.to_tensor(np.array([0.5, -0.5], np.float32))
+        losses = []
+        for _ in range(30):
+            e = emb(paddle.to_tensor(ids))          # [2, 3, 8]
+            pred = (e * proj).sum(axis=[1, 2])
+            loss = ((pred - target) ** 2).mean()
+            loss.backward()
+            proj.clear_grad()                        # train only the table
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.2, losses[::10]
+    finally:
+        ps.stop()
+
+
+def test_sparse_embedding_eval_does_not_push():
+    ps, servers = _launch_ps()
+    try:
+        emb = SparseEmbedding("word_emb", 8)
+        emb.eval()
+        ids = np.array([0, 1])
+        before = ps.client.pull_sparse("word_emb", ids).copy()
+        out = emb(paddle.to_tensor(ids))
+        assert out.stop_gradient
+        np.testing.assert_array_equal(
+            ps.client.pull_sparse("word_emb", ids), before)
+    finally:
+        ps.stop()
+
+
+def test_geo_mode_pushes_every_k_steps():
+    ps, servers = _launch_ps(mode="geo")
+    ps.geo_step = 4
+    try:
+        emb = SparseEmbedding("word_emb", 8)
+        ids = paddle.to_tensor(np.array([2, 4]))
+        server_before = ps.client.pull_sparse("word_emb", [2, 4]).copy()
+        for step in range(4):
+            loss = emb(ids).sum()
+            loss.backward()
+            after = ps.client.pull_sparse("word_emb", [2, 4])
+            if step < 3:   # not yet pushed: server unchanged, cache diverges
+                np.testing.assert_array_equal(after, server_before)
+        # 4th step pushed accumulated deltas
+        after = ps.client.pull_sparse("word_emb", [2, 4])
+        assert np.abs(after - server_before).max() > 1e-6
+        # server now matches the worker's local cache
+        np.testing.assert_allclose(
+            after, np.stack([emb._geo_cache[2], emb._geo_cache[4]]),
+            rtol=1e-5)
+    finally:
+        ps.stop()
+
+
+def test_async_push_applies_eventually():
+    ps, servers = _launch_ps(mode="async", rule="naive")
+    try:
+        ids = np.array([3, 5])
+        before = ps.client.pull_sparse("word_emb", ids).copy()
+        ps.client.push_sparse("word_emb", ids,
+                              np.ones((2, 8), np.float32))
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if np.abs(ps.client.pull_sparse("word_emb", ids)
+                      - before).max() > 1e-6:
+                break
+            time.sleep(0.01)
+        else:
+            raise AssertionError("async push never applied")
+    finally:
+        ps.stop()
