@@ -107,7 +107,12 @@ class FleetExecutor:
             icpt.results = []
         self.carrier.start()
         if not self.carrier.wait(timeout):
-            raise TimeoutError("FleetExecutor.run timed out")
+            # in-flight micro-batches/credits are now in an unknown state;
+            # poison the carrier so a retry fails fast instead of silently
+            # mixing stale payloads into the next step
+            err = TimeoutError("FleetExecutor.run timed out")
+            self.carrier.error = err
+            raise err
         outs: List = []
         for icpt in self._sinks:
             outs.extend(icpt.results)

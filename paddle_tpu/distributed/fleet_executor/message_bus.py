@@ -95,17 +95,20 @@ class MessageBus:
                 conn = socket.create_connection(self._lookup(dst_rank),
                                                 timeout=60)
                 conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                self._conns[dst_rank] = conn
+                with self._table_mu:  # shutdown() snapshots under this lock
+                    self._conns[dst_rank] = conn
             send_msg(conn, msg)
 
     def shutdown(self) -> None:
         self._stopping = True
-        for conn in self._conns.values():
+        with self._table_mu:
+            conns = list(self._conns.values())
+            self._conns.clear()
+        for conn in conns:
             try:
                 conn.close()
             except OSError:
                 pass
-        self._conns.clear()
         if self._server is not None:
             try:
                 self._server.close()

@@ -81,6 +81,13 @@ class PsServer:
                 req = _recv_msg(conn)
                 if req is None:
                     return
+                if not isinstance(req, dict) or "op" not in req:
+                    # malformed request: reply with the error instead of
+                    # silently killing this serving thread
+                    _send_msg(conn, {"ok": False,
+                                     "err": f"malformed PS request: "
+                                            f"{type(req).__name__}"})
+                    continue
                 op = req["op"]
                 if op == "stop":
                     _send_msg(conn, {"ok": True})
@@ -98,6 +105,11 @@ class PsServer:
                         _send_msg(conn, {"ok": False, "err": repr(e)})
         except OSError:
             return
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
 
     def _dispatch(self, req):
         op = req["op"]
@@ -131,8 +143,13 @@ class PsServer:
                     self._barrier_gen += 1
                     self._barrier_cv.notify_all()
                 else:
-                    if not self._barrier_cv.wait_for(
-                            lambda: self._barrier_gen > gen, timeout=300):
+                    ok = self._barrier_cv.wait_for(
+                        lambda: self._barrier_gen > gen, timeout=300)
+                    if not ok:
+                        # roll our arrival back so the next round still
+                        # requires a full quorum
+                        if self._barrier_gen == gen:
+                            self._barrier_count -= 1
                         raise TimeoutError(
                             f"PS barrier timed out waiting for {world} "
                             f"workers")
@@ -223,6 +240,8 @@ class PsClient:
 
     def push_sparse(self, table: str, ids, grads, delta: bool = False) -> None:
         ids, owner = self._shard_ids(ids)
+        if len(ids) == 0:
+            return
         grads = np.asarray(grads, np.float32).reshape(len(ids), -1)
         op = "push_sparse_delta" if delta else "push_sparse"
         for s in range(len(self.endpoints)):

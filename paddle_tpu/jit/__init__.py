@@ -21,7 +21,16 @@ from ..nn.layer_base import Layer
 from ..static.input_spec import InputSpec
 
 __all__ = ["to_static", "save", "load", "not_to_static", "TranslatedLayer",
-           "StaticFunction"]
+           "StaticFunction", "enable_to_static"]
+
+_to_static_enabled = [True]
+
+
+def enable_to_static(flag: bool) -> None:
+    """ProgramTranslator.enable parity: globally toggle to_static; when off,
+    decorated functions run their original eager bodies."""
+    _to_static_enabled[0] = bool(flag)
+
 
 def not_to_static(fn):
     """Mark `fn` to run eagerly even under to_static (program_translator
@@ -48,6 +57,16 @@ class StaticFunction:
         self._layer = layer
         self._cache = {}
         self._last_jaxpr = None
+        self._converted = None
+
+    @property
+    def _fn(self):
+        """The dy2static-converted body (AST control-flow rewrite); falls
+        back to the original on unconvertible source."""
+        if self._converted is None:
+            from .dy2static import convert_to_static
+            self._converted = convert_to_static(self._function)
+        return self._converted
 
     def __get__(self, instance, owner):
         """Class-level `@to_static def forward(self, x)`: bind the instance
@@ -65,7 +84,7 @@ class StaticFunction:
 
     def _make_callable(self):
         layer = self._layer
-        fn = self._function
+        fn = self._fn
         if layer is not None:
             from ..nn.functional_call import _swapped_state
 
@@ -85,12 +104,15 @@ class StaticFunction:
     def __call__(self, *args, **kwargs):
         import jax
 
-        if getattr(self._function, "_not_to_static", False) or kwargs:
+        if (getattr(self._function, "_not_to_static", False) or kwargs
+                or not _to_static_enabled[0]):
             return self._function(*args, **kwargs)
         if self._layer is not None and self._layer.training:
             # training stays on the eager tape so buffer mutation (BN stats)
-            # and per-op rng match eager semantics; eager ops hit XLA anyway
-            return self._function(*args, **kwargs)
+            # and per-op rng match eager semantics; eager ops hit XLA anyway.
+            # The converted body keeps identical eager semantics (concrete
+            # predicates take the Python path in convert_operators).
+            return self._fn(*args, **kwargs)
         vals = [_as_value(a) for a in args]
         key = tuple((tuple(v.shape), str(v.dtype)) for v in vals)
         if key not in self._cache:
@@ -222,7 +244,7 @@ def save(layer, path, input_spec=None, **configs):
         values = state_values(layer)
         fwd = layer.forward
         if isinstance(fwd, StaticFunction):
-            fwd = fwd._function  # unwrap to_static to avoid re-entry
+            fwd = fwd._fn  # unwrap to_static (converted body) — no re-entry
 
         from ..nn.functional_call import _swapped_state
 
@@ -240,7 +262,7 @@ def save(layer, path, input_spec=None, **configs):
 
         def pure(values, *args):
             args = tuple(Tensor(a, _internal=True) for a in args)
-            return _strip(sf._function(*args))
+            return _strip(sf._fn(*args))
 
     specs = _export_specs(input_spec)
     val_specs = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)

@@ -1,0 +1,171 @@
+"""dy2static — AST transpilation entry (reference:
+dygraph_to_static/program_translator.py `convert_to_static`, the function
+cache, and `ProgramTranslator.enable`).
+
+`convert_to_static(fn)` parses fn's source, rewrites data-dependent
+control flow through convert_operators (lax.cond / lax.while_loop under a
+tensor predicate, plain Python otherwise), and compiles the rewritten
+function in fn's own global/closure environment.  Unconvertible sources
+(no source text, unsupported constructs) fall back to the original
+function — identical behavior for trace-friendly code.
+"""
+from __future__ import annotations
+
+import ast
+import inspect
+import linecache
+import textwrap
+import types
+from typing import Callable
+
+from . import convert_operators as _jst_mod
+from .convert_operators import (UNDEFINED, convert_ifelse,
+                                convert_logical_and, convert_logical_not,
+                                convert_logical_or, convert_range,
+                                convert_while_loop)
+from .transformer import Dy2StaticTransformer
+
+__all__ = ["convert_to_static", "unwrap_converted", "convert_ifelse",
+           "convert_while_loop", "convert_logical_and", "convert_logical_or",
+           "convert_logical_not", "convert_range", "UNDEFINED"]
+
+_CACHE: dict = {}
+_counter = [0]
+
+
+def _strip_decorators(fn_def: ast.FunctionDef) -> None:
+    fn_def.decorator_list = []
+
+
+class _SuperTransformer(ast.NodeTransformer):
+    """zero-arg `super()` -> `super(__class__, <self>)`: the recompiled def
+    no longer lives in a class body, so the compiler would not create the
+    implicit __class__ cell; the explicit reference makes __class__ a free
+    variable that our closure rewiring binds to the ORIGINAL cell."""
+
+    def __init__(self, first_arg: str):
+        self.first_arg = first_arg
+
+    def visit_Call(self, node):
+        self.generic_visit(node)
+        if (isinstance(node.func, ast.Name) and node.func.id == "super"
+                and not node.args and not node.keywords):
+            node.args = [ast.Name(id="__class__", ctx=ast.Load()),
+                         ast.Name(id=self.first_arg, ctx=ast.Load())]
+        return node
+
+
+def convert_to_static(fn: Callable) -> Callable:
+    """Return the control-flow-converted twin of `fn` (cached); `fn` itself
+    on any conversion failure."""
+    if isinstance(fn, types.MethodType):
+        return types.MethodType(convert_to_static(fn.__func__), fn.__self__)
+    if fn in _CACHE:
+        return _CACHE[fn]
+    out = _convert(fn)
+    _CACHE[fn] = out
+    return out
+
+
+def unwrap_converted(fn: Callable) -> Callable:
+    return getattr(fn, "__dy2st_original__", fn)
+
+
+def _convert(fn: Callable) -> Callable:
+    """Bound methods convert their underlying function and re-bind."""
+    if isinstance(fn, types.MethodType):
+        converted = convert_to_static(fn.__func__)
+        return types.MethodType(converted, fn.__self__)
+    if not isinstance(fn, types.FunctionType):
+        return fn
+    return _convert_function(fn)
+
+
+def _convert_function(fn: types.FunctionType) -> Callable:
+    try:
+        raw = inspect.getsource(fn)
+    except (OSError, TypeError):
+        return fn
+    src = textwrap.dedent(raw)
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return fn
+    fn_def = next((n for n in tree.body
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))), None)
+    if fn_def is None or isinstance(fn_def, ast.AsyncFunctionDef):
+        return fn
+    _strip_decorators(fn_def)
+    if "__class__" in fn.__code__.co_freevars and fn_def.args.args:
+        _SuperTransformer(fn_def.args.args[0].arg).visit(fn_def)
+    transformer = Dy2StaticTransformer()
+    new_tree = transformer.visit(tree)
+    if transformer._n == 0:
+        return fn        # nothing converted: keep the original
+    ast.fix_missing_locations(new_tree)
+
+    _counter[0] += 1
+    filename = f"<dy2static:{fn.__qualname__}:{_counter[0]}>"
+
+    # Closure-preserving compile: wrap the transformed def in an outer def
+    # whose parameters are fn's free variables, so the inner code object
+    # carries the same co_freevars; then rebuild the function with the
+    # ORIGINAL closure cells.  Live rebinding keeps working and zero-arg
+    # super() keeps its __class__ cell — a plain module-level recompile
+    # would snapshot (or lose) both.
+    freevars = list(fn.__code__.co_freevars)
+    if freevars:
+        outer = ast.FunctionDef(
+            name="__dy2st_outer__",
+            args=ast.arguments(posonlyargs=[],
+                               args=[ast.arg(arg=n) for n in freevars],
+                               vararg=None, kwonlyargs=[], kw_defaults=[],
+                               kwarg=None, defaults=[]),
+            body=[fn_def, ast.Return(value=ast.Name(id=fn_def.name,
+                                                    ctx=ast.Load()))],
+            decorator_list=[], returns=None)
+        new_tree = ast.Module(body=[outer], type_ignores=[])
+        ast.fix_missing_locations(new_tree)
+    try:
+        code = compile(new_tree, filename, "exec")
+    except SyntaxError:
+        return fn
+    # make the transpiled source introspectable (error tracebacks, .code)
+    transpiled_src = ast.unparse(new_tree)
+    linecache.cache[filename] = (len(transpiled_src), None,
+                                 [l + "\n" for l in
+                                  transpiled_src.splitlines()], filename)
+
+    namespace = dict(fn.__globals__)
+    namespace["__jst__"] = _jst_mod
+    local_ns: dict = {}
+    try:
+        exec(code, namespace, local_ns)
+    except Exception:
+        return fn
+    if freevars:
+        outer_fn = local_ns.get("__dy2st_outer__")
+        inner_code = next(
+            (c for c in outer_fn.__code__.co_consts
+             if isinstance(c, types.CodeType) and c.co_name == fn_def.name),
+            None)
+        if inner_code is None:
+            return fn
+        cells = dict(zip(fn.__code__.co_freevars, fn.__closure__ or ()))
+        try:
+            closure = tuple(cells[n] for n in inner_code.co_freevars)
+        except KeyError:
+            return fn
+        new_fn = types.FunctionType(inner_code, namespace, fn_def.name,
+                                    fn.__defaults__, closure)
+    else:
+        new_fn = local_ns.get(fn_def.name)
+        if not isinstance(new_fn, types.FunctionType):
+            return fn
+        new_fn.__defaults__ = fn.__defaults__
+    new_fn.__kwdefaults__ = fn.__kwdefaults__
+    new_fn.__dict__.update(fn.__dict__)
+    new_fn.__dy2st_original__ = fn
+    new_fn.__dy2st_source__ = transpiled_src
+    return new_fn

@@ -1,0 +1,285 @@
+"""dy2static runtime conversion ops.
+
+Reference: python/paddle/fluid/dygraph/dygraph_to_static/convert_operators.py
+(convert_ifelse, convert_while_loop, convert_logical_and/or/not, convert_len)
+— the transpiled code calls these, and each decides AT RUNTIME whether the
+condition is a live graph value (here: a JAX tracer) or plain Python:
+
+* tracer condition  -> structured control flow the XLA compiler understands
+  (`lax.cond` / `lax.while_loop` — the TPU-native replacement for the
+  reference's conditional_block/while ops),
+* concrete condition -> ordinary Python control flow (eager semantics, or
+  static unrolling under trace when the predicate is compile-time known).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...core.tensor import Tensor
+
+
+class _UndefinedVar:
+    """Placeholder for a name unbound at the control-flow site (the
+    reference's UndefinedVar).  Any real use raises a clear error."""
+
+    _singleton = None
+
+    def __new__(cls):
+        if cls._singleton is None:
+            cls._singleton = super().__new__(cls)
+        return cls._singleton
+
+    def __repr__(self):
+        return "<undefined>"
+
+    def _die(self, *a, **k):
+        raise NameError(
+            "variable is undefined on (at least) one branch of a converted "
+            "if/while — assign it on every path before using it after the "
+            "control flow")
+
+    __call__ = __getattr__ = __add__ = __radd__ = __mul__ = __bool__ = _die
+
+
+UNDEFINED = _UndefinedVar()
+
+
+def ld(local_dict: dict, name: str):
+    """Load `name` from the frame's locals, or UNDEFINED."""
+    return local_dict.get(name, UNDEFINED)
+
+
+def _is_tracer(x) -> bool:
+    if isinstance(x, Tensor):
+        x = x._value
+    return isinstance(x, jax.core.Tracer)
+
+
+def _to_bool(pred) -> bool:
+    if isinstance(pred, Tensor):
+        return bool(pred._value)
+    return bool(pred)
+
+
+def _strip(tree, where: str = "control flow"):
+    """Tensor leaves -> raw values; remember which were Tensors."""
+    leaves, treedef = jax.tree.flatten(
+        tree, is_leaf=lambda x: isinstance(x, (Tensor, _UndefinedVar)))
+    vals, was_tensor = [], []
+    for leaf in leaves:
+        if isinstance(leaf, _UndefinedVar):
+            raise ValueError(
+                f"a variable leaving a converted {where} is undefined on at "
+                "least one path; bind it on every branch (reference: "
+                "UndefinedVar in convert_operators.py)")
+        if isinstance(leaf, Tensor):
+            vals.append(leaf._value)
+            was_tensor.append(True)
+        else:
+            vals.append(leaf)
+            was_tensor.append(False)
+    return vals, was_tensor, treedef
+
+
+def _rewrap(vals, was_tensor, treedef):
+    leaves = [Tensor(v, _internal=True) if t else v
+              for v, t in zip(vals, was_tensor)]
+    return jax.tree.unflatten(treedef, leaves)
+
+
+def convert_ifelse(pred, true_fn: Callable, false_fn: Callable,
+                   args: Tuple) -> Any:
+    """`if pred: ... else: ...` with branch vars threaded through `args`."""
+    if not _is_tracer(pred):
+        return true_fn(*args) if _to_bool(pred) else false_fn(*args)
+
+    pred_val = pred._value if isinstance(pred, Tensor) else jnp.asarray(pred)
+    if pred_val.ndim:
+        raise ValueError(
+            f"if-condition must be a scalar under jit, got shape "
+            f"{pred_val.shape}; reduce it (e.g. .any()/.all()) first")
+    # Names bound only INSIDE the branches arrive as UNDEFINED; they cannot
+    # ride the cond operands (not a jax type) so they travel statically —
+    # each branch sees UNDEFINED (a read raises NameError) and must bind a
+    # real value before returning.
+    leaves, treedef = jax.tree.flatten(
+        args, is_leaf=lambda x: isinstance(x, (Tensor, _UndefinedVar)))
+    undef = [isinstance(l, _UndefinedVar) for l in leaves]
+    was_tensor = [isinstance(l, Tensor) for l in leaves]
+    operands = [jnp.zeros(()) if u else (l._value if t else l)
+                for l, u, t in zip(leaves, undef, was_tensor)]
+    out_template = {}
+
+    def _branch(fn):
+        def wrapped(ops):
+            ins = [UNDEFINED if u else
+                   (Tensor(v, _internal=True) if t else v)
+                   for v, u, t in zip(ops, undef, was_tensor)]
+            out = fn(*jax.tree.unflatten(treedef, ins))
+            out_vals, out_wt, out_td = _strip(out, "if/else")
+            # trace-time record: both branches must agree (lax checks values)
+            out_template["wt"], out_template["td"] = out_wt, out_td
+            return tuple(out_vals)
+        return wrapped
+
+    try:
+        out_vals = lax.cond(pred_val, _branch(true_fn), _branch(false_fn),
+                            tuple(operands))
+    except TypeError as e:
+        raise TypeError(
+            "converted if/else branches must produce matching shapes/dtypes "
+            f"for every assigned variable under jit: {e}") from e
+    return _rewrap(list(out_vals), out_template["wt"], out_template["td"])
+
+
+def convert_while_loop(cond_fn: Callable, body_fn: Callable,
+                       loop_vars: Tuple) -> Tuple:
+    """`while cond: body` with carried vars `loop_vars`."""
+    pred = cond_fn(*loop_vars)
+    if not _is_tracer(pred):
+        while _to_bool(pred):
+            loop_vars = body_fn(*loop_vars)
+            pred = cond_fn(*loop_vars)
+        return loop_vars
+
+    vals, was_tensor, treedef = _strip(loop_vars)
+
+    def cond_wrapped(carry):
+        p = cond_fn(*_rewrap(list(carry), was_tensor, treedef))
+        return p._value if isinstance(p, Tensor) else p
+
+    def body_wrapped(carry):
+        out = body_fn(*_rewrap(list(carry), was_tensor, treedef))
+        out_vals, _, _ = _strip(out)
+        return tuple(out_vals)
+
+    try:
+        out_vals = lax.while_loop(cond_wrapped, body_wrapped, tuple(vals))
+    except TypeError as e:
+        raise TypeError(
+            "converted while-loop carried variables must keep stable "
+            f"shapes/dtypes across iterations under jit: {e}") from e
+    return _rewrap(list(out_vals), was_tensor, treedef)
+
+
+def convert_logical_and(lhs_fn: Callable, rhs_fn: Callable):
+    """`a and b` keeping Python short-circuit when `a` is concrete."""
+    lhs = lhs_fn()
+    if not _is_tracer(lhs):
+        return rhs_fn() if _to_bool(lhs) else lhs
+    rhs = rhs_fn()
+    lval = lhs._value if isinstance(lhs, Tensor) else lhs
+    rval = rhs._value if isinstance(rhs, Tensor) else rhs
+    return Tensor(jnp.logical_and(lval, rval), _internal=True)
+
+
+def convert_logical_or(lhs_fn: Callable, rhs_fn: Callable):
+    lhs = lhs_fn()
+    if not _is_tracer(lhs):
+        return lhs if _to_bool(lhs) else rhs_fn()
+    rhs = rhs_fn()
+    lval = lhs._value if isinstance(lhs, Tensor) else lhs
+    rval = rhs._value if isinstance(rhs, Tensor) else rhs
+    return Tensor(jnp.logical_or(lval, rval), _internal=True)
+
+
+def convert_logical_not(x):
+    if not _is_tracer(x):
+        return not _to_bool(x)
+    val = x._value if isinstance(x, Tensor) else x
+    return Tensor(jnp.logical_not(val), _internal=True)
+
+
+class _TensorRange:
+    """range() whose bounds are live graph values: supports len_ and [i]
+    as traced arithmetic (backs converted `for i in range(t)` loops)."""
+
+    def __init__(self, start, stop, step):
+        as_val = lambda v: v._value if isinstance(v, Tensor) else v  # noqa
+        self.start = jnp.asarray(as_val(start))
+        self.stop = jnp.asarray(as_val(stop))
+        self.step = jnp.asarray(as_val(step))
+
+    def length(self):
+        n = (self.stop - self.start + self.step
+             - jnp.sign(self.step)) // self.step
+        return Tensor(jnp.maximum(n, 0), _internal=True)
+
+    def __getitem__(self, i):
+        ival = i._value if isinstance(i, Tensor) else i
+        return Tensor(self.start + ival * self.step, _internal=True)
+
+
+def convert_range(*args):
+    """range(...) that degrades to _TensorRange when any bound is traced."""
+    if any(_is_tracer(a) for a in args):
+        if len(args) == 1:
+            start, stop, step = 0, args[0], 1
+        elif len(args) == 2:
+            start, stop, step = args[0], args[1], 1
+        else:
+            start, stop, step = args
+        return _TensorRange(start, stop, step)
+    return range(*(int(a) if isinstance(a, Tensor) else a for a in args))
+
+
+class _Indexable:
+    """Uniform [i]/length view over tensors, sequences and ranges for
+    converted for-loops."""
+
+    def __init__(self, obj):
+        if not isinstance(obj, (Tensor, _TensorRange, list, tuple, str,
+                                range)):
+            try:
+                import numpy as _np
+                is_arr = isinstance(obj, _np.ndarray)
+            except ImportError:      # pragma: no cover
+                is_arr = False
+            if not is_arr:
+                # generators, dict views, sets...: materialize so [i] works
+                # and dict iteration yields keys (python `for` semantics)
+                obj = list(obj)
+        self.obj = obj
+
+    def length(self):
+        if isinstance(self.obj, _TensorRange):
+            return self.obj.length()
+        if isinstance(self.obj, Tensor):
+            return int(self.obj.shape[0])
+        return len(self.obj)
+
+    def __getitem__(self, i):
+        if isinstance(self.obj, (Tensor, _TensorRange)):
+            return self.obj[i]
+        ival = int(i) if isinstance(i, Tensor) else i
+        return self.obj[ival]
+
+
+def indexable(obj):
+    return obj if isinstance(obj, _Indexable) else _Indexable(obj)
+
+
+def loop_target_init(it: _Indexable):
+    """Pre-bind a converted for-loop's target so it can ride the
+    lax.while_loop carry: first element when the iterable is (or may be)
+    non-empty, UNDEFINED for a statically-empty one (the loop body then
+    never runs and python keeps the name unbound, matching `for` over an
+    empty sequence)."""
+    n = it.length()
+    if isinstance(n, (int, float)) and n == 0:
+        return UNDEFINED
+    return it[0]
+
+
+def len_(obj):
+    if isinstance(obj, _Indexable):
+        return obj.length()
+    if isinstance(obj, _TensorRange):
+        return obj.length()
+    if isinstance(obj, Tensor):
+        return int(obj.shape[0])
+    return len(obj)

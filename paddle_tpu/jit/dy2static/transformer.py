@@ -1,0 +1,312 @@
+"""dy2static AST transpiler.
+
+Reference: python/paddle/fluid/dygraph/dygraph_to_static/ — the 30-file
+transformer family (ifelse_transformer.py, loop_transformer.py,
+logical_transformer.py, program_translator.py CodeGenerator).  This build
+needs a far smaller rewrite because tracing already handles everything
+except *data-dependent Python control flow*; only `if`/`while`/`for` whose
+predicate is a live tensor must become `lax.cond`/`lax.while_loop`, and the
+decision is deferred to runtime via convert_operators.
+
+Rewrites (names mangled with __dy2st_*):
+
+    if T:  A=..          def __dy2st_true_0(A): ..; return (A,)
+    else:  A=..    ->    def __dy2st_false_0(A): ..; return (A,)
+                         (A,) = __jst__.convert_ifelse(T, true, false,
+                                       (__jst__.ld(locals(), 'A'),))
+
+    while T: body  ->    def __dy2st_cond_0(V,..): return T
+                         def __dy2st_body_0(V,..): body; return (V,..)
+                         (V,..) = __jst__.convert_while_loop(cond, body,
+                                       (__jst__.ld(locals(), 'V'),..))
+
+    for t in X: body ->  index-based while over __jst__.indexable(X)
+                         (then converted by the while rule)
+
+`and`/`or`/`not` inside converted predicates become short-circuit-preserving
+convert_logical_* lambdas; `range` in a for-iterable becomes convert_range.
+
+Statements that jump out of the block (return/break/continue) or mutate
+through attributes/subscripts keep plain Python control flow — they work
+eagerly and under trace with concrete predicates; a tensor predicate there
+raises JAX's TracerBoolConversionError pointing at the offending line
+(matching the reference's partial-support stance where unsupported syntax
+falls back with an error).
+"""
+from __future__ import annotations
+
+import ast
+import copy
+from typing import List, Set
+
+_JST = "__jst__"
+
+
+def _copy_target(t: ast.expr) -> ast.expr:
+    return copy.deepcopy(t)
+
+
+def _name_load(name: str) -> ast.Name:
+    return ast.Name(id=name, ctx=ast.Load())
+
+
+def _jst_call(fn: str, args: List[ast.expr]) -> ast.Call:
+    return ast.Call(
+        func=ast.Attribute(value=_name_load(_JST), attr=fn, ctx=ast.Load()),
+        args=args, keywords=[])
+
+
+def _ld(name: str) -> ast.expr:
+    """__jst__.ld(locals(), 'name')"""
+    return _jst_call("ld", [ast.Call(func=_name_load("locals"), args=[],
+                                     keywords=[]),
+                            ast.Constant(value=name)])
+
+
+class _StoreCollector(ast.NodeVisitor):
+    """Names bound by a statement list (assign/augassign/for-target/with-as),
+    not descending into nested function/class scopes."""
+
+    def __init__(self):
+        self.names: Set[str] = set()
+        self.blocked = False   # saw a store we cannot thread (attr/subscr)
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            self.names.add(node.id)
+
+    def visit_Attribute(self, node):
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            self.blocked = True
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node):
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            self.blocked = True
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        self.names.add(node.name)      # the def binds its name; skip body
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node):
+        self.names.add(node.name)
+
+    def visit_Lambda(self, node):
+        pass
+
+
+def _stores(stmts) -> "tuple[Set[str], bool]":
+    c = _StoreCollector()
+    for s in stmts:
+        c.visit(s)
+    return c.names, c.blocked
+
+
+class _JumpFinder(ast.NodeVisitor):
+    """Return/break/continue at this control-flow level (not inside nested
+    defs or nested loops for break/continue)."""
+
+    def __init__(self, in_loop: bool):
+        self.found = False
+        self._loop_depth = 1 if in_loop else 0
+
+    def visit_Return(self, node):
+        self.found = True
+
+    def visit_Break(self, node):
+        self.found = True
+
+    def visit_Continue(self, node):
+        self.found = True
+
+    def visit_FunctionDef(self, node):
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+
+def _has_jump(stmts) -> bool:
+    f = _JumpFinder(in_loop=False)
+    for s in stmts:
+        f.visit(s)
+    return f.found
+
+
+class _LoadCollector(ast.NodeVisitor):
+    def __init__(self):
+        self.names: Set[str] = set()
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, ast.Load):
+            self.names.add(node.id)
+
+
+def _loads(node) -> Set[str]:
+    c = _LoadCollector()
+    c.visit(node)
+    return c.names
+
+
+class _PredicateTransformer(ast.NodeTransformer):
+    """Inside a converted predicate: and/or/not -> convert_logical_* with
+    short-circuit lambdas (logical_transformer.py)."""
+
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        fn = ("convert_logical_and" if isinstance(node.op, ast.And)
+              else "convert_logical_or")
+        out = node.values[0]
+        for nxt in node.values[1:]:
+            out = _jst_call(fn, [
+                ast.Lambda(args=_empty_args(), body=out),
+                ast.Lambda(args=_empty_args(), body=nxt)])
+        return out
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return _jst_call("convert_logical_not", [node.operand])
+        return node
+
+
+def _empty_args() -> ast.arguments:
+    return ast.arguments(posonlyargs=[], args=[], vararg=None,
+                         kwonlyargs=[], kw_defaults=[], kwarg=None,
+                         defaults=[])
+
+
+def _fn_args(names: List[str]) -> ast.arguments:
+    return ast.arguments(posonlyargs=[],
+                         args=[ast.arg(arg=n) for n in names],
+                         vararg=None, kwonlyargs=[], kw_defaults=[],
+                         kwarg=None, defaults=[])
+
+
+def _ret_tuple(names: List[str]) -> ast.Return:
+    return ast.Return(value=ast.Tuple(
+        elts=[_name_load(n) for n in names], ctx=ast.Load()))
+
+
+def _assign_tuple(names: List[str], value: ast.expr) -> ast.stmt:
+    if not names:
+        return ast.Expr(value=value)
+    return ast.Assign(
+        targets=[ast.Tuple(elts=[ast.Name(id=n, ctx=ast.Store())
+                                 for n in names], ctx=ast.Store())],
+        value=value)
+
+
+def _ld_tuple(names: List[str]) -> ast.Tuple:
+    return ast.Tuple(elts=[_ld(n) for n in names], ctx=ast.Load())
+
+
+class Dy2StaticTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self._n = 0
+
+    def _uid(self) -> int:
+        self._n += 1
+        return self._n
+
+    # -- if/else --------------------------------------------------------------
+    def visit_If(self, node: ast.If):
+        self.generic_visit(node)
+        if _has_jump(node.body) or _has_jump(node.orelse):
+            return node
+        body_names, b_blocked = _stores(node.body)
+        else_names, e_blocked = _stores(node.orelse)
+        if b_blocked or e_blocked:
+            return node
+        names = sorted(body_names | else_names)
+        uid = self._uid()
+        true_name, false_name = f"__dy2st_true_{uid}", f"__dy2st_false_{uid}"
+        test = _PredicateTransformer().visit(node.test)
+
+        true_fn = ast.FunctionDef(
+            name=true_name, args=_fn_args(names),
+            body=(node.body or [ast.Pass()]) + [_ret_tuple(names)],
+            decorator_list=[], returns=None)
+        false_fn = ast.FunctionDef(
+            name=false_name, args=_fn_args(names),
+            body=(node.orelse or [ast.Pass()]) + [_ret_tuple(names)],
+            decorator_list=[], returns=None)
+        call = _jst_call("convert_ifelse", [
+            test, _name_load(true_name), _name_load(false_name),
+            _ld_tuple(names)])
+        return [true_fn, false_fn, _assign_tuple(names, call)]
+
+    # -- while ----------------------------------------------------------------
+    def visit_While(self, node: ast.While):
+        self.generic_visit(node)
+        if node.orelse or _has_jump(node.body):
+            return node
+        body_names, blocked = _stores(node.body)
+        if blocked:
+            return node
+        # carried vars: everything the body rebinds, plus predicate loads
+        # that the body rebinds are already included; predicate-only loads
+        # stay closure-captured (constants w.r.t. the loop)
+        names = sorted(body_names)
+        uid = self._uid()
+        cond_name, body_name = f"__dy2st_cond_{uid}", f"__dy2st_body_{uid}"
+        test = _PredicateTransformer().visit(node.test)
+
+        cond_fn = ast.FunctionDef(
+            name=cond_name, args=_fn_args(names),
+            body=[ast.Return(value=test)], decorator_list=[], returns=None)
+        body_fn = ast.FunctionDef(
+            name=body_name, args=_fn_args(names),
+            body=list(node.body) + [_ret_tuple(names)],
+            decorator_list=[], returns=None)
+        call = _jst_call("convert_while_loop", [
+            _name_load(cond_name), _name_load(body_name), _ld_tuple(names)])
+        return [cond_fn, body_fn, _assign_tuple(names, call)]
+
+    # -- for ------------------------------------------------------------------
+    def visit_For(self, node: ast.For):
+        # rewrite to an index-while FIRST, then run the while conversion on
+        # the result (loop_transformer.py does the same for->while step)
+        if node.orelse or _has_jump(node.body):
+            self.generic_visit(node)
+            return node
+        body_names, blocked = _stores(node.body)
+        if blocked:
+            self.generic_visit(node)
+            return node
+        uid = self._uid()
+        it, idx = f"__dy2st_iter_{uid}", f"__dy2st_i_{uid}"
+        iter_expr = node.iter
+        if (isinstance(iter_expr, ast.Call)
+                and isinstance(iter_expr.func, ast.Name)
+                and iter_expr.func.id == "range" and not iter_expr.keywords):
+            iter_expr = _jst_call("convert_range", iter_expr.args)
+
+        setup = [
+            ast.Assign(targets=[ast.Name(id=it, ctx=ast.Store())],
+                       value=_jst_call("indexable", [iter_expr])),
+            ast.Assign(targets=[ast.Name(id=idx, ctx=ast.Store())],
+                       value=ast.Constant(value=0)),
+            # pre-bind the loop target so lax.while_loop can carry it (and
+            # after-loop reads see the last element, as in Python)
+            ast.Assign(targets=[_copy_target(node.target)],
+                       value=_jst_call("loop_target_init",
+                                       [_name_load(it)])),
+        ]
+        target_assign = ast.Assign(
+            targets=[node.target],
+            value=ast.Subscript(value=_name_load(it),
+                                slice=_name_load(idx), ctx=ast.Load()))
+        bump = ast.AugAssign(target=ast.Name(id=idx, ctx=ast.Store()),
+                             op=ast.Add(), value=ast.Constant(value=1))
+        while_node = ast.While(
+            test=ast.Compare(left=_name_load(idx), ops=[ast.Lt()],
+                             comparators=[_jst_call("len_",
+                                                    [_name_load(it)])]),
+            body=[target_assign] + list(node.body) + [bump], orelse=[])
+        converted = self.visit_While(while_node)
+        if isinstance(converted, list):
+            return setup + converted
+        return setup + [converted]
