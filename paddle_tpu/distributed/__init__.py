@@ -24,6 +24,9 @@ from .spmd import (  # noqa: F401
 from . import auto_parallel  # noqa: F401
 from . import communication  # noqa: F401
 from . import fleet  # noqa: F401
+from .fleet.dataset import InMemoryDataset, QueueDataset  # noqa: F401
+from . import ps  # noqa: F401
+from . import fleet_executor  # noqa: F401
 from . import sharding  # noqa: F401
 from .spawn import spawn  # noqa: F401
 from .auto_parallel import (  # noqa: F401

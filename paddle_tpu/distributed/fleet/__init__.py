@@ -20,6 +20,9 @@ from .meta_parallel.parallel_layers.pp_layers import (  # noqa: F401
 from .meta_optimizers.dygraph_optimizer.hybrid_parallel_optimizer import (  # noqa: F401
     HybridParallelOptimizer)
 from .utils.recompute import recompute  # noqa: F401
+from .dataset import (DatasetBase, InMemoryDataset,  # noqa: F401
+                      QueueDataset)
+from . import metrics  # noqa: F401
 
 _role_maker = None
 _user_defined_strategy: DistributedStrategy | None = None

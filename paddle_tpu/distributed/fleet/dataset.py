@@ -1,0 +1,255 @@
+"""Fleet datasets — InMemoryDataset / QueueDataset.
+
+Reference: python/paddle/distributed/fleet/dataset/dataset.py driving the
+C++ data pipeline (framework/data_set.cc in-memory shuffled datasets,
+data_feed.cc MultiSlot parsing, pipe_command preprocess subprocesses).
+
+TPU-native: the ingest plane stays on host.  Files are read by a thread
+pool, optionally filtered through `pipe_command` (a shell filter, same
+contract as the reference's pipe) or a Python `parse_fn`, parsed into
+per-slot numpy rows, then shuffled (local or across trainers) and served
+as ready-to-feed numpy batches — the device only ever sees dense batch
+arrays.
+"""
+from __future__ import annotations
+
+import os
+import queue
+import subprocess
+import threading
+from typing import Callable, Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["DatasetBase", "InMemoryDataset", "QueueDataset"]
+
+
+class _Slot:
+    def __init__(self, name: str, dim: int = 1, dtype: str = "float32"):
+        self.name = name
+        self.dim = dim
+        self.dtype = dtype
+
+
+def _default_parse(line: str, slots: List[_Slot]):
+    """Whitespace-separated values, consumed slot by slot in declaration
+    order (the MultiSlot dense layout)."""
+    parts = line.split()
+    total = sum(s.dim for s in slots)
+    if len(parts) != total:
+        raise ValueError(
+            f"line has {len(parts)} fields, slots need {total}: {line!r}")
+    out, i = [], 0
+    for s in slots:
+        vals = parts[i:i + s.dim]
+        i += s.dim
+        out.append(np.asarray(vals, dtype=s.dtype))
+    return out
+
+
+class DatasetBase:
+    """dataset.py DatasetBase parity: holds batch size, worker threads, the
+    slot list (`use_var`) and the input file list."""
+
+    def __init__(self):
+        self.batch_size = 1
+        self.thread_num = 1
+        self.pipe_command: Optional[str] = None
+        self.parse_fn: Optional[Callable] = None
+        self.slots: List[_Slot] = []
+        self.filelist: List[str] = []
+        self._rng = np.random.RandomState(0)
+
+    def init(self, batch_size: int = 1, thread_num: int = 1,
+             use_var: Sequence = (), pipe_command: Optional[str] = None,
+             parse_fn: Optional[Callable] = None, input_type: int = 0,
+             fs_name: str = "", fs_ugi: str = "", **kwargs):
+        self.batch_size = int(batch_size)
+        self.thread_num = max(1, int(thread_num))
+        self.pipe_command = pipe_command
+        self.parse_fn = parse_fn
+        self.slots = []
+        for v in use_var:
+            name = getattr(v, "name", None) or str(v)
+            shape = getattr(v, "shape", None)
+            dim = 1
+            if shape:
+                dims = [d for d in shape if d is not None and d > 0]
+                dim = int(np.prod(dims)) if dims else 1
+            dtype = str(getattr(v, "dtype", "float32")).replace("paddle.", "")
+            self.slots.append(_Slot(name, dim, dtype))
+        return self
+
+    def set_filelist(self, filelist: Sequence[str]) -> None:
+        self.filelist = list(filelist)
+
+    # -- file -> sample stream ------------------------------------------------
+    def _read_lines(self, path: str) -> Iterator[str]:
+        if self.pipe_command:
+            proc = subprocess.Popen(
+                self.pipe_command, shell=True, stdin=open(path, "rb"),
+                stdout=subprocess.PIPE)
+            try:
+                for raw in proc.stdout:
+                    line = raw.decode().strip()
+                    if line:
+                        yield line
+            finally:
+                proc.stdout.close()
+                proc.wait()
+        else:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        yield line
+
+    def _parse_line(self, line: str):
+        if self.parse_fn is not None:
+            return self.parse_fn(line)
+        return _default_parse(line, self.slots)
+
+    def _samples_of(self, path: str) -> List:
+        return [self._parse_line(l) for l in self._read_lines(path)]
+
+    def _collate(self, samples: List) -> Dict[str, np.ndarray]:
+        batch = {}
+        for i, s in enumerate(self.slots):
+            batch[s.name] = np.stack([smp[i] for smp in samples])
+        return batch
+
+
+class InMemoryDataset(DatasetBase):
+    """dataset.py InMemoryDataset parity: load_into_memory ->
+    local_shuffle/global_shuffle -> iterate batches; release_memory frees.
+    """
+
+    def __init__(self):
+        super().__init__()
+        self._memory: List = []
+        self._loaded = False
+
+    # -- loading --------------------------------------------------------------
+    def load_into_memory(self) -> None:
+        if not self.filelist:
+            raise ValueError("set_filelist before load_into_memory")
+        results: List = [None] * len(self.filelist)
+
+        def worker(idx_q: "queue.Queue[int]"):
+            while True:
+                try:
+                    i = idx_q.get_nowait()
+                except queue.Empty:
+                    return
+                results[i] = self._samples_of(self.filelist[i])
+
+        idx_q: "queue.Queue[int]" = queue.Queue()
+        for i in range(len(self.filelist)):
+            idx_q.put(i)
+        threads = [threading.Thread(target=worker, args=(idx_q,), daemon=True)
+                   for _ in range(min(self.thread_num, len(self.filelist)))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        self._memory = [s for chunk in results for s in chunk]
+        self._loaded = True
+
+    def preload_into_memory(self, thread_num: Optional[int] = None) -> None:
+        # reference splits preload/wait; host threads make it one phase
+        if thread_num:
+            self.thread_num = thread_num
+        self.load_into_memory()
+
+    def wait_preload_done(self) -> None:
+        pass
+
+    # -- shuffles -------------------------------------------------------------
+    def local_shuffle(self) -> None:
+        self._rng.shuffle(self._memory)
+
+    def global_shuffle(self, fleet=None, thread_num: int = 12) -> None:
+        """Exchange samples across trainers by hash (data_set.cc
+        GlobalShuffle): every trainer gathers all samples and keeps those
+        hashing to its rank; single-trainer reduces to a local shuffle."""
+        from .. import collective as C
+        world = 1
+        rank = 0
+        if fleet is not None:
+            world = int(getattr(fleet, "worker_num", lambda: 1)())
+            rank = int(getattr(fleet, "worker_index", lambda: 0)())
+        if world <= 1:
+            self.local_shuffle()
+            return
+        gathered: List = []
+        C.all_gather_object(gathered, self._memory)
+        flat = [s for part in gathered for s in part]
+        self._memory = [s for i, s in enumerate(flat) if i % world == rank]
+        self.local_shuffle()
+
+    # -- accounting / release -------------------------------------------------
+    def get_memory_data_size(self, fleet=None) -> int:
+        n = len(self._memory)
+        if fleet is not None:
+            from .. import collective as C
+            out: List = []
+            C.all_gather_object(out, n)
+            return int(sum(out))
+        return n
+
+    def get_shuffle_data_size(self, fleet=None) -> int:
+        return self.get_memory_data_size(fleet)
+
+    def release_memory(self) -> None:
+        self._memory = []
+        self._loaded = False
+
+    # -- serving --------------------------------------------------------------
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        if not self._loaded:
+            raise RuntimeError("load_into_memory before iterating")
+        n = len(self._memory)
+        for lo in range(0, n - n % self.batch_size, self.batch_size):
+            yield self._collate(self._memory[lo:lo + self.batch_size])
+        tail = n % self.batch_size
+        if tail:
+            yield self._collate(self._memory[n - tail:])
+
+
+class QueueDataset(DatasetBase):
+    """dataset.py QueueDataset parity: streaming — no memory residency, a
+    reader thread per file feeds a bounded queue (the reference's
+    data_feed channel), batches come off the queue in arrival order."""
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        if not self.filelist:
+            raise ValueError("set_filelist before iterating")
+        q: "queue.Queue" = queue.Queue(maxsize=max(4, self.thread_num) * 16)
+        done = object()
+
+        def reader(paths: List[str]):
+            try:
+                for p in paths:
+                    for line in self._read_lines(p):
+                        q.put(self._parse_line(line))
+            finally:
+                q.put(done)
+
+        shards = [self.filelist[i::self.thread_num]
+                  for i in range(min(self.thread_num, len(self.filelist)))]
+        for shard in shards:
+            threading.Thread(target=reader, args=(shard,),
+                             daemon=True).start()
+        open_readers = len(shards)
+        buf: List = []
+        while open_readers:
+            item = q.get()
+            if item is done:
+                open_readers -= 1
+                continue
+            buf.append(item)
+            if len(buf) == self.batch_size:
+                yield self._collate(buf)
+                buf = []
+        if buf:
+            yield self._collate(buf)

@@ -1,0 +1,110 @@
+"""Distributed metrics — global AUC/sum/max/min/acc/mae/rmse across
+trainers.
+
+Reference: python/paddle/distributed/fleet/metrics/metric.py aggregating
+local stats with allreduce, and the C++ BasicAucCalculator
+(framework/fleet/metrics.cc:29) that merges per-trainer positive/negative
+histogram buckets before integrating the ROC curve.
+
+Each function takes the LOCAL statistic (numpy array / Tensor / scalar),
+allreduces it over the data-parallel world (no-op when single trainer),
+and returns the global value — the same contract the reference exposes as
+`fleet.metrics.*`.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...core.tensor import Tensor
+
+__all__ = ["sum", "max", "min", "acc", "mae", "rmse", "auc"]
+
+_pysum, _pymax, _pymin = sum, max, min
+
+
+def _np(x) -> np.ndarray:
+    if isinstance(x, Tensor):
+        return np.asarray(x._value)
+    return np.asarray(x)
+
+
+def _allreduce(arr: np.ndarray, op: str) -> np.ndarray:
+    from .. import collective as C
+    from .. import parallel
+
+    world = 1
+    try:
+        world = parallel.get_world_size()
+    except Exception:
+        pass
+    if world <= 1:
+        return arr
+    t = Tensor(arr)
+    red = {"sum": C.ReduceOp.SUM, "max": C.ReduceOp.MAX,
+           "min": C.ReduceOp.MIN}[op]
+    C.all_reduce(t, op=red)
+    return np.asarray(t._value)
+
+
+def sum(input, scope=None, util=None):  # noqa: A001 (reference name)
+    return _allreduce(_np(input).astype(np.float64), "sum")
+
+
+def max(input, scope=None, util=None):  # noqa: A001
+    return _allreduce(_np(input).astype(np.float64), "max")
+
+
+def min(input, scope=None, util=None):  # noqa: A001
+    return _allreduce(_np(input).astype(np.float64), "min")
+
+
+def acc(correct, total, scope=None, util=None) -> float:
+    c = _allreduce(_np(correct).astype(np.float64), "sum")
+    t = _allreduce(_np(total).astype(np.float64), "sum")
+    return float(c.sum() / _pymax(float(t.sum()), 1.0))
+
+
+def mae(abserr, total_ins_num, scope=None, util=None) -> float:
+    e = _allreduce(_np(abserr).astype(np.float64), "sum")
+    n = _allreduce(_np(total_ins_num).astype(np.float64), "sum")
+    return float(e.sum() / _pymax(float(n.sum()), 1.0))
+
+
+def rmse(sqrerr, total_ins_num, scope=None, util=None) -> float:
+    e = _allreduce(_np(sqrerr).astype(np.float64), "sum")
+    n = _allreduce(_np(total_ins_num).astype(np.float64), "sum")
+    return float(np.sqrt(e.sum() / _pymax(float(n.sum()), 1.0)))
+
+
+def local_auc_buckets(predict, label, num_buckets: int = 4096):
+    """Histogram the positive/negative predictions into score buckets —
+    the per-trainer half of BasicAucCalculator.add_data."""
+    p = _np(predict).reshape(-1)
+    if p.ndim == 0:
+        p = p.reshape(1)
+    y = _np(label).reshape(-1)
+    idx = np.clip((p * num_buckets).astype(np.int64), 0, num_buckets - 1)
+    stat_pos = np.bincount(idx[y > 0.5], minlength=num_buckets)
+    stat_neg = np.bincount(idx[y <= 0.5], minlength=num_buckets)
+    return stat_pos.astype(np.float64), stat_neg.astype(np.float64)
+
+
+def auc(stat_pos, stat_neg, scope=None, util=None) -> float:
+    """Global AUC from per-trainer bucket stats (metrics.cc
+    BasicAucCalculator::compute): allreduce the buckets, then integrate
+    the ROC curve with trapezoids over descending score buckets."""
+    pos = _allreduce(_np(stat_pos).astype(np.float64), "sum").reshape(-1)
+    neg = _allreduce(_np(stat_neg).astype(np.float64), "sum").reshape(-1)
+    if pos.shape != neg.shape:
+        raise ValueError(f"stat_pos {pos.shape} vs stat_neg {neg.shape}")
+    tot_pos = new_pos = 0.0
+    tot_neg = new_neg = 0.0
+    area = 0.0
+    for i in range(len(pos) - 1, -1, -1):
+        new_pos = tot_pos + pos[i]
+        new_neg = tot_neg + neg[i]
+        area += (new_neg - tot_neg) * (tot_pos + new_pos) / 2.0
+        tot_pos, tot_neg = new_pos, new_neg
+    if tot_pos == 0.0 or tot_neg == 0.0:
+        return 0.5
+    return float(area / (tot_pos * tot_neg))

@@ -90,12 +90,17 @@ class MessageBus:
         with self._table_mu:
             mu = self._conn_mu.setdefault(dst_rank, threading.Lock())
         with mu:
+            if self._stopping:
+                raise RuntimeError("message bus is shut down")
             conn = self._conns.get(dst_rank)
             if conn is None:
                 conn = socket.create_connection(self._lookup(dst_rank),
                                                 timeout=60)
                 conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 with self._table_mu:  # shutdown() snapshots under this lock
+                    if self._stopping:
+                        conn.close()
+                        raise RuntimeError("message bus is shut down")
                     self._conns[dst_rank] = conn
             send_msg(conn, msg)
 

@@ -37,6 +37,18 @@ def _strip_decorators(fn_def: ast.FunctionDef) -> None:
     fn_def.decorator_list = []
 
 
+class _LiveGlobals(dict):
+    """Function-globals dict that falls through to the original module
+    globals on miss, so the converted function sees live rebinding."""
+
+    def __init__(self, base: dict, **extra):
+        super().__init__(**extra)
+        self._base = base
+
+    def __missing__(self, key):
+        return self._base[key]
+
+
 class _SuperTransformer(ast.NodeTransformer):
     """zero-arg `super()` -> `super(__class__, <self>)`: the recompiled def
     no longer lives in a class body, so the compiler would not create the
@@ -72,10 +84,7 @@ def unwrap_converted(fn: Callable) -> Callable:
 
 
 def _convert(fn: Callable) -> Callable:
-    """Bound methods convert their underlying function and re-bind."""
-    if isinstance(fn, types.MethodType):
-        converted = convert_to_static(fn.__func__)
-        return types.MethodType(converted, fn.__self__)
+    # bound methods are unwrapped/re-bound by convert_to_static before this
     if not isinstance(fn, types.FunctionType):
         return fn
     return _convert_function(fn)
@@ -137,8 +146,12 @@ def _convert_function(fn: types.FunctionType) -> Callable:
                                  [l + "\n" for l in
                                   transpiled_src.splitlines()], filename)
 
-    namespace = dict(fn.__globals__)
-    namespace["__jst__"] = _jst_mod
+    # live view over the ORIGINAL module globals (snapshotting would hide
+    # later rebinding / monkeypatching of module-level names from the
+    # converted twin), plus the __jst__ runtime injected without polluting
+    # the user's module namespace.  dict subclass __missing__ is honored
+    # for function globals since CPython 3.3.
+    namespace = _LiveGlobals(fn.__globals__, __jst__=_jst_mod)
     local_ns: dict = {}
     try:
         exec(code, namespace, local_ns)

@@ -112,7 +112,7 @@ def convert_ifelse(pred, true_fn: Callable, false_fn: Callable,
     was_tensor = [isinstance(l, Tensor) for l in leaves]
     operands = [jnp.zeros(()) if u else (l._value if t else l)
                 for l, u, t in zip(leaves, undef, was_tensor)]
-    out_template = {}
+    out_template = {"wt": None, "td": None}
 
     def _branch(fn):
         def wrapped(ops):
@@ -121,8 +121,14 @@ def convert_ifelse(pred, true_fn: Callable, false_fn: Callable,
                    for v, u, t in zip(ops, undef, was_tensor)]
             out = fn(*jax.tree.unflatten(treedef, ins))
             out_vals, out_wt, out_td = _strip(out, "if/else")
-            # trace-time record: both branches must agree (lax checks values)
-            out_template["wt"], out_template["td"] = out_wt, out_td
+            # trace-time record; OR across branches so a var that is a
+            # Tensor on either branch stays a Tensor (lax.cond unifies the
+            # raw values anyway)
+            if out_template["wt"] is None:
+                out_template["wt"], out_template["td"] = out_wt, out_td
+            else:
+                out_template["wt"] = [a or b for a, b in
+                                      zip(out_template["wt"], out_wt)]
             return tuple(out_vals)
         return wrapped
 
@@ -146,16 +152,42 @@ def convert_while_loop(cond_fn: Callable, body_fn: Callable,
             pred = cond_fn(*loop_vars)
         return loop_vars
 
-    vals, was_tensor, treedef = _strip(loop_vars)
+    leaves, treedef = jax.tree.flatten(
+        loop_vars, is_leaf=lambda x: isinstance(x, (Tensor, _UndefinedVar)))
+    undef = [isinstance(l, _UndefinedVar) for l in leaves]
+    was_tensor = [isinstance(l, Tensor) for l in leaves]
+    vals = [None if u else (l._value if t else l)
+            for l, u, t in zip(leaves, undef, was_tensor)]
+    tmpl = {"wt": None, "td": None}
 
-    def cond_wrapped(carry):
-        p = cond_fn(*_rewrap(list(carry), was_tensor, treedef))
-        return p._value if isinstance(p, Tensor) else p
+    def _rebuild(carry):
+        return jax.tree.unflatten(treedef, [
+            UNDEFINED if u else (Tensor(v, _internal=True) if t else v)
+            for v, u, t in zip(carry, undef, was_tensor)])
 
     def body_wrapped(carry):
-        out = body_fn(*_rewrap(list(carry), was_tensor, treedef))
-        out_vals, _, _ = _strip(out)
+        out = body_fn(*_rebuild(carry))
+        out_vals, out_wt, out_td = _strip(out, "while loop")
+        tmpl["wt"], tmpl["td"] = out_wt, out_td
         return tuple(out_vals)
+
+    if any(undef):
+        # A temp first bound INSIDE the body has no init value to carry.
+        # Discover its shape/dtype by abstractly evaluating one body pass
+        # (it sees UNDEFINED and must bind before reading), then carry a
+        # zeros placeholder — sound because a bind-before-read temp never
+        # reads the carried-in slot.
+        probe = [jnp.zeros(()) if u else v for v, u in zip(vals, undef)]
+        out_avals = jax.eval_shape(body_wrapped, tuple(probe))
+        for i, u in enumerate(undef):
+            if u:
+                vals[i] = jnp.zeros(out_avals[i].shape, out_avals[i].dtype)
+                was_tensor[i] = tmpl["wt"][i]
+        undef = [False] * len(undef)
+
+    def cond_wrapped(carry):
+        p = cond_fn(*_rebuild(carry))
+        return p._value if isinstance(p, Tensor) else p
 
     try:
         out_vals = lax.while_loop(cond_wrapped, body_wrapped, tuple(vals))
@@ -163,7 +195,9 @@ def convert_while_loop(cond_fn: Callable, body_fn: Callable,
         raise TypeError(
             "converted while-loop carried variables must keep stable "
             f"shapes/dtypes across iterations under jit: {e}") from e
-    return _rewrap(list(out_vals), was_tensor, treedef)
+    wt = tmpl["wt"] if tmpl["wt"] is not None else was_tensor
+    td = tmpl["td"] if tmpl["td"] is not None else treedef
+    return _rewrap(list(out_vals), wt, td)
 
 
 def convert_logical_and(lhs_fn: Callable, rhs_fn: Callable):
@@ -263,14 +297,18 @@ def indexable(obj):
     return obj if isinstance(obj, _Indexable) else _Indexable(obj)
 
 
-def loop_target_init(it: _Indexable):
+def loop_target_init(it: _Indexable, n_targets: int = 0):
     """Pre-bind a converted for-loop's target so it can ride the
     lax.while_loop carry: first element when the iterable is (or may be)
     non-empty, UNDEFINED for a statically-empty one (the loop body then
     never runs and python keeps the name unbound, matching `for` over an
-    empty sequence)."""
+    empty sequence).  `n_targets > 0` = tuple-unpacking target: a
+    statically-empty iterable yields per-element UNDEFINEDs so the unpack
+    assignment itself does not crash."""
     n = it.length()
     if isinstance(n, (int, float)) and n == 0:
+        if n_targets:
+            return (UNDEFINED,) * n_targets
         return UNDEFINED
     return it[0]
 

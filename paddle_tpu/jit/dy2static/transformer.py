@@ -46,6 +46,18 @@ def _copy_target(t: ast.expr) -> ast.expr:
     return copy.deepcopy(t)
 
 
+_HELPER_PREFIXES = ("__dy2st_true_", "__dy2st_false_", "__dy2st_cond_",
+                    "__dy2st_body_")
+
+
+def _is_helper_fn(name: str) -> bool:
+    """Synthesized branch/loop closures from already-converted NESTED
+    control flow: they are code, not data, and must not be threaded through
+    lax.cond/while_loop carriers (the __dy2st_i_*/__dy2st_iter_* loop DATA
+    vars, by contrast, must be)."""
+    return name.startswith(_HELPER_PREFIXES)
+
+
 def _name_load(name: str) -> ast.Name:
     return ast.Name(id=name, ctx=ast.Load())
 
@@ -220,7 +232,8 @@ class Dy2StaticTransformer(ast.NodeTransformer):
         else_names, e_blocked = _stores(node.orelse)
         if b_blocked or e_blocked:
             return node
-        names = sorted(body_names | else_names)
+        names = sorted(n for n in (body_names | else_names)
+                       if not _is_helper_fn(n))
         uid = self._uid()
         true_name, false_name = f"__dy2st_true_{uid}", f"__dy2st_false_{uid}"
         test = _PredicateTransformer().visit(node.test)
@@ -249,7 +262,7 @@ class Dy2StaticTransformer(ast.NodeTransformer):
         # carried vars: everything the body rebinds, plus predicate loads
         # that the body rebinds are already included; predicate-only loads
         # stay closure-captured (constants w.r.t. the loop)
-        names = sorted(body_names)
+        names = sorted(n for n in body_names if not _is_helper_fn(n))
         uid = self._uid()
         cond_name, body_name = f"__dy2st_cond_{uid}", f"__dy2st_body_{uid}"
         test = _PredicateTransformer().visit(node.test)
@@ -292,8 +305,12 @@ class Dy2StaticTransformer(ast.NodeTransformer):
             # pre-bind the loop target so lax.while_loop can carry it (and
             # after-loop reads see the last element, as in Python)
             ast.Assign(targets=[_copy_target(node.target)],
-                       value=_jst_call("loop_target_init",
-                                       [_name_load(it)])),
+                       value=_jst_call("loop_target_init", [
+                           _name_load(it),
+                           ast.Constant(value=len(node.target.elts)
+                                        if isinstance(node.target,
+                                                      (ast.Tuple, ast.List))
+                                        else 0)])),
         ]
         target_assign = ast.Assign(
             targets=[node.target],

@@ -161,6 +161,109 @@ def test_unconvertible_break_falls_back_to_python():
     np.testing.assert_allclose(np.asarray(out._value), [2.0])
 
 
+def test_nested_if_inside_converted_if():
+    """Helper defs synthesized for a NESTED if must not be threaded through
+    the outer lax.cond carrier (they are code, not data)."""
+    def fn(x, flag):
+        if x.sum() > 0:
+            if flag > 0:
+                y = x * 2.0
+            else:
+                y = x * 3.0
+        else:
+            y = -x
+        return y
+
+    conv = convert_to_static(fn)
+    assert conv is not fn
+
+    def raw(xv, fv):
+        from paddle_tpu.core.tensor import Tensor
+        return conv(Tensor(xv, _internal=True),
+                    Tensor(fv, _internal=True))._value
+
+    jitted = jax.jit(raw)
+    np.testing.assert_allclose(
+        np.asarray(jitted(jnp.array([1.0]), jnp.array(1.0))), [2.0])
+    np.testing.assert_allclose(
+        np.asarray(jitted(jnp.array([1.0]), jnp.array(-1.0))), [3.0])
+    np.testing.assert_allclose(
+        np.asarray(jitted(jnp.array([-1.0]), jnp.array(1.0))), [1.0])
+
+
+def test_while_body_temp_var_under_jit():
+    """A temp first bound inside the loop body rides the carry via a
+    shape-discovered placeholder instead of raising."""
+    def fn(x):
+        while (x * x).sum() > 1.0:
+            t = x / 2.0
+            x = t
+        return x
+
+    out = _run_both(fn, np.array([8.0], np.float32))
+    np.testing.assert_allclose(out, [1.0])
+
+
+def test_branch_tensor_scalar_mix_stays_tensor():
+    """If one branch yields a Tensor and the other a Python scalar, the
+    converted result is still a Tensor (no silent unwrap)."""
+    from paddle_tpu.core.tensor import Tensor
+
+    def fn(x):
+        if x.sum() > 0:
+            y = x.sum() * 2.0
+        else:
+            y = 0.0
+        return y
+
+    conv = convert_to_static(fn)
+
+    def raw(xv):
+        out = conv(Tensor(xv, _internal=True))
+        assert isinstance(out, Tensor), type(out)
+        return out._value
+
+    jitted = jax.jit(raw)
+    np.testing.assert_allclose(float(jitted(jnp.array([2.0]))), 4.0)
+    np.testing.assert_allclose(float(jitted(jnp.array([-2.0]))), 0.0)
+
+
+def test_for_over_empty_tuple_target_skips():
+    def fn(x):
+        s = x
+        for a, b in []:
+            s = s + a + b
+        return s
+
+    conv = convert_to_static(fn)
+    out = conv(paddle.to_tensor(np.array([1.5], np.float32)))
+    np.testing.assert_allclose(np.asarray(out._value), [1.5])
+
+
+_GLOBAL_SCALE = 2.0
+
+
+def test_module_global_rebinding_is_live():
+    def fn(x):
+        if x.sum() > 0:
+            y = x * _GLOBAL_SCALE
+        else:
+            y = x
+        return y
+
+    conv = convert_to_static(fn)
+    assert conv is not fn
+    x = paddle.to_tensor(np.array([1.0], np.float32))
+    np.testing.assert_allclose(np.asarray(conv(x)._value), [2.0])
+    global _GLOBAL_SCALE
+    old = _GLOBAL_SCALE
+    _GLOBAL_SCALE = 5.0
+    try:
+        np.testing.assert_allclose(np.asarray(conv(x)._value), [5.0])
+    finally:
+        _GLOBAL_SCALE = old
+
+
 def test_super_and_class_cell_survive_conversion():
     """Zero-arg super() inside a converted body needs the __class__ closure
     cell; the conversion must rebuild the function with the ORIGINAL cells."""

@@ -1,0 +1,161 @@
+"""InMemoryDataset/QueueDataset + fleet.metrics tests (reference pattern:
+unittests/test_dataset.py writes slot text files, loads, shuffles,
+iterates; test_fleet_metric.py checks global metric math)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.fleet import metrics as fmetrics
+
+
+@pytest.fixture
+def slot_files(tmp_path):
+    """Two files, 3 slots per line: x(2 floats), y(1 float), label(1)."""
+    rng = np.random.RandomState(7)
+    rows = []
+    for fi in range(2):
+        lines = []
+        for _ in range(10):
+            vals = rng.randn(3)
+            label = rng.randint(0, 2)
+            lines.append(" ".join(f"{v:.6f}" for v in vals) + f" {label}")
+            rows.append([float(x) for x in lines[-1].split()])
+        (tmp_path / f"part-{fi}").write_text("\n".join(lines) + "\n")
+    return [str(tmp_path / "part-0"), str(tmp_path / "part-1")], rows
+
+
+class _Var:
+    def __init__(self, name, shape, dtype="float32"):
+        self.name, self.shape, self.dtype = name, shape, dtype
+
+
+def _make(cls, files, batch_size=4, **kw):
+    ds = cls()
+    ds.init(batch_size=batch_size, thread_num=2,
+            use_var=[_Var("x", [2]), _Var("y", [1]),
+                     _Var("label", [1], "int64")], **kw)
+    ds.set_filelist(files)
+    return ds
+
+
+def test_in_memory_dataset_loads_and_batches(slot_files):
+    files, rows = slot_files
+    ds = _make(dist.InMemoryDataset, files)
+    ds.load_into_memory()
+    assert ds.get_memory_data_size() == 20
+    batches = list(ds)
+    assert len(batches) == 5
+    assert batches[0]["x"].shape == (4, 2)
+    assert batches[0]["label"].dtype == np.int64
+    got = np.concatenate([b["x"] for b in batches])
+    want = np.array([r[:2] for r in rows], np.float32)
+    np.testing.assert_allclose(np.sort(got, axis=0), np.sort(want, axis=0),
+                               rtol=1e-5)
+
+
+def test_local_shuffle_permutes(slot_files):
+    files, _ = slot_files
+    ds = _make(dist.InMemoryDataset, files)
+    ds.load_into_memory()
+    before = np.concatenate([b["y"] for b in ds]).ravel()
+    ds.local_shuffle()
+    after = np.concatenate([b["y"] for b in ds]).ravel()
+    assert not np.array_equal(before, after)
+    np.testing.assert_allclose(np.sort(before), np.sort(after))
+
+
+def test_global_shuffle_single_trainer(slot_files):
+    files, _ = slot_files
+    ds = _make(dist.InMemoryDataset, files)
+    ds.load_into_memory()
+    ds.global_shuffle()
+    assert ds.get_shuffle_data_size() == 20
+
+
+def test_release_memory(slot_files):
+    files, _ = slot_files
+    ds = _make(dist.InMemoryDataset, files)
+    ds.load_into_memory()
+    ds.release_memory()
+    assert ds.get_memory_data_size() == 0
+    with pytest.raises(RuntimeError):
+        next(iter(ds))
+
+
+def test_pipe_command_filters_lines(slot_files, tmp_path):
+    files, _ = slot_files
+    # prepend junk lines, filter them out with the pipe (data_feed's
+    # pipe_command preprocessing contract)
+    dirty = tmp_path / "dirty"
+    raw = open(files[0]).read()
+    dirty.write_text("#junk a b c\n" + raw)
+    ds = _make(dist.InMemoryDataset, [str(dirty)],
+               pipe_command="grep -v '^#'")
+    ds.load_into_memory()
+    assert ds.get_memory_data_size() == 10
+
+
+def test_queue_dataset_streams_same_data(slot_files):
+    files, rows = slot_files
+    ds = _make(dist.QueueDataset, files, batch_size=3)
+    got = np.concatenate([b["x"] for b in ds])
+    assert got.shape == (20, 2)
+    want = np.array([r[:2] for r in rows], np.float32)
+    np.testing.assert_allclose(np.sort(got, axis=0), np.sort(want, axis=0),
+                               rtol=1e-5)
+
+
+def test_custom_parse_fn(slot_files):
+    files, _ = slot_files
+
+    def parse(line):
+        p = [float(v) for v in line.split()]
+        return [np.asarray(p[:2], np.float32),
+                np.asarray(p[2:3], np.float32),
+                np.asarray(p[3:], np.int64)]
+
+    ds = _make(dist.InMemoryDataset, files, parse_fn=parse)
+    ds.load_into_memory()
+    assert ds.get_memory_data_size() == 20
+
+
+# ---------------------------------------------------------------------------
+# fleet.metrics
+# ---------------------------------------------------------------------------
+def test_metric_sum_max_min_single_trainer():
+    np.testing.assert_allclose(fmetrics.sum(np.array([1.0, 2.0])), [1.0, 2.0])
+    np.testing.assert_allclose(fmetrics.max(np.array([3.0])), [3.0])
+    np.testing.assert_allclose(fmetrics.min(np.array([4.0])), [4.0])
+
+
+def test_metric_acc_mae_rmse():
+    assert fmetrics.acc(np.array(8.0), np.array(10.0)) == pytest.approx(0.8)
+    assert fmetrics.mae(np.array(5.0), np.array(10.0)) == pytest.approx(0.5)
+    assert fmetrics.rmse(np.array(40.0), np.array(10.0)) == pytest.approx(2.0)
+
+
+def test_auc_matches_sklearn_style_reference():
+    """Bucketed AUC must approach the exact rank-based AUC."""
+    rng = np.random.RandomState(0)
+    n = 4000
+    label = rng.randint(0, 2, n)
+    # informative scores
+    score = np.clip(0.3 * rng.randn(n) + 0.35 + 0.3 * label, 0, 0.999)
+    pos, neg = fmetrics.local_auc_buckets(score, label, num_buckets=1 << 14)
+    got = fmetrics.auc(pos, neg)
+
+    # exact AUC via rank statistic
+    order = np.argsort(score, kind="mergesort")
+    ranks = np.empty(n)
+    ranks[order] = np.arange(1, n + 1)
+    n_pos = label.sum()
+    n_neg = n - n_pos
+    exact = (ranks[label == 1].sum() - n_pos * (n_pos + 1) / 2) \
+        / (n_pos * n_neg)
+    assert got == pytest.approx(exact, abs=2e-3)
+
+
+def test_auc_degenerate_cases():
+    assert fmetrics.auc(np.zeros(16), np.ones(16)) == 0.5
+    assert fmetrics.auc(np.ones(16), np.zeros(16)) == 0.5
