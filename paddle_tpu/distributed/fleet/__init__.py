@@ -79,9 +79,15 @@ def distributed_model(model):
 
 
 def distributed_optimizer(optimizer, strategy=None):
-    """fleet/optimizer.py:67 parity."""
-    return HybridParallelOptimizer(optimizer, _hcg,
-                                   strategy or _user_defined_strategy)
+    """fleet/optimizer.py:67 parity: strategy-selected meta optimizers
+    (gradient merge / localsgd / dgc / fp16 allreduce / lars / lamb, the
+    strategy_compiler composition) wrap the user optimizer, then the
+    hybrid-parallel layer adds DP reduction + hybrid-aware clipping."""
+    from .meta_optimizers.strategy_optimizers import apply_meta_optimizers
+
+    strat = strategy or _user_defined_strategy
+    optimizer = apply_meta_optimizers(optimizer, strat)
+    return HybridParallelOptimizer(optimizer, _hcg, strat)
 
 
 # -- role facade (fleet.py worker/server API) --------------------------------

@@ -86,17 +86,23 @@ class DatasetBase:
     # -- file -> sample stream ------------------------------------------------
     def _read_lines(self, path: str) -> Iterator[str]:
         if self.pipe_command:
-            proc = subprocess.Popen(
-                self.pipe_command, shell=True, stdin=open(path, "rb"),
-                stdout=subprocess.PIPE)
-            try:
-                for raw in proc.stdout:
-                    line = raw.decode().strip()
-                    if line:
-                        yield line
-            finally:
-                proc.stdout.close()
-                proc.wait()
+            with open(path, "rb") as stdin_f:
+                proc = subprocess.Popen(
+                    self.pipe_command, shell=True, stdin=stdin_f,
+                    stdout=subprocess.PIPE)
+                try:
+                    for raw in proc.stdout:
+                        line = raw.decode().strip()
+                        if line:
+                            yield line
+                finally:
+                    proc.stdout.close()
+                    rc = proc.wait()
+            if rc != 0:
+                # a failed filter must not masquerade as an empty dataset
+                raise RuntimeError(
+                    f"pipe_command {self.pipe_command!r} exited with "
+                    f"status {rc} on {path}")
         else:
             with open(path) as f:
                 for line in f:
@@ -134,6 +140,7 @@ class InMemoryDataset(DatasetBase):
         if not self.filelist:
             raise ValueError("set_filelist before load_into_memory")
         results: List = [None] * len(self.filelist)
+        errors: List[BaseException] = []
 
         def worker(idx_q: "queue.Queue[int]"):
             while True:
@@ -141,7 +148,11 @@ class InMemoryDataset(DatasetBase):
                     i = idx_q.get_nowait()
                 except queue.Empty:
                     return
-                results[i] = self._samples_of(self.filelist[i])
+                try:
+                    results[i] = self._samples_of(self.filelist[i])
+                except BaseException as e:
+                    errors.append(e)
+                    return
 
         idx_q: "queue.Queue[int]" = queue.Queue()
         for i in range(len(self.filelist)):
@@ -152,6 +163,9 @@ class InMemoryDataset(DatasetBase):
             t.start()
         for t in threads:
             t.join()
+        if errors:
+            raise RuntimeError(
+                f"dataset load failed: {errors[0]!r}") from errors[0]
         self._memory = [s for chunk in results for s in chunk]
         self._loaded = True
 
@@ -232,8 +246,11 @@ class QueueDataset(DatasetBase):
                 for p in paths:
                     for line in self._read_lines(p):
                         q.put(self._parse_line(line))
-            finally:
                 q.put(done)
+            except BaseException as e:
+                # a crashed reader must surface the error, not pose as a
+                # normal end-of-shard with silently truncated data
+                q.put(("__reader_error__", e))
 
         shards = [self.filelist[i::self.thread_num]
                   for i in range(min(self.thread_num, len(self.filelist)))]
@@ -247,6 +264,11 @@ class QueueDataset(DatasetBase):
             if item is done:
                 open_readers -= 1
                 continue
+            if isinstance(item, tuple) and len(item) == 2 \
+                    and isinstance(item[0], str) \
+                    and item[0] == "__reader_error__":
+                raise RuntimeError(
+                    f"dataset reader failed: {item[1]!r}") from item[1]
             buf.append(item)
             if len(buf) == self.batch_size:
                 yield self._collate(buf)

@@ -1,3 +1,8 @@
 from .dygraph_optimizer.hybrid_parallel_optimizer import (  # noqa: F401
     HybridParallelOptimizer,
 )
+from .strategy_optimizers import (  # noqa: F401
+    AdaptiveLocalSGDOptimizer, DGCOptimizer, FP16AllReduceOptimizer,
+    GradientMergeOptimizer, LocalSGDOptimizer, MetaOptimizerBase,
+    apply_meta_optimizers,
+)

@@ -1,0 +1,306 @@
+"""Strategy-driven meta optimizers (gradient merge, LocalSGD, DGC,
+fp16-allreduce, LARS/LAMB selection).
+
+Reference: python/paddle/distributed/fleet/meta_optimizers/ — the static
+program-rewriting optimizer family composed by strategy_compiler.py
+(gradient_merge_optimizer.py, localsgd_optimizer.py, dgc_optimizer.py,
+fp16_allreduce_optimizer.py, lars_optimizer.py, lamb_optimizer.py),
+selected by DistributedStrategy flags (SURVEY Appendix A).
+
+TPU-native: there is no program to rewrite — the mechanisms are optimizer
+*wrappers* over the eager step (the compiled SPMD path gets the same
+effects from its jitted train step), composed by `apply_meta_optimizers`
+in the reference's application order.  Communication uses the collective
+API (no-op in a single-trainer world, XLA collectives on a mesh).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ....core.autograd import no_grad
+from ....core.tensor import Tensor
+
+__all__ = ["MetaOptimizerBase", "GradientMergeOptimizer",
+           "LocalSGDOptimizer", "AdaptiveLocalSGDOptimizer", "DGCOptimizer",
+           "FP16AllReduceOptimizer", "apply_meta_optimizers"]
+
+
+class MetaOptimizerBase:
+    """Wraps an inner optimizer, delegating everything it does not
+    override (meta_optimizer_base.py)."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def __getattr__(self, name):
+        if name == "_inner":      # not yet set during __init__
+            raise AttributeError(name)
+        return getattr(self._inner, name)
+
+    # HybridParallelOptimizer replaces the user's grad clip with the
+    # hybrid-aware one by ASSIGNING _grad_clip; without this property the
+    # assignment would land on the wrapper while the base optimizer's
+    # step() keeps reading its own attribute — silently skipping the
+    # cross-rank norm reduction.
+    @property
+    def _grad_clip(self):
+        return self._inner._grad_clip
+
+    @_grad_clip.setter
+    def _grad_clip(self, value):
+        self._inner._grad_clip = value
+
+    def step(self):
+        self._inner.step()
+
+    def clear_grad(self, set_to_zero=True):
+        self._inner.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return None, None
+
+    @property
+    def inner_opt(self):
+        return self._inner
+
+
+class GradientMergeOptimizer(MetaOptimizerBase):
+    """Accumulate k micro-steps of gradients, apply once
+    (gradient_merge_optimizer.py; gradient_merge_configs {k_steps, avg})."""
+
+    def __init__(self, inner, k_steps: int = 1, avg: bool = True):
+        super().__init__(inner)
+        self.k_steps = max(1, int(k_steps))
+        self.avg = avg
+        self._buf: dict = {}
+        self._count = 0
+
+    @no_grad()
+    def step(self):
+        self._count += 1
+        params = [p for p in self._inner._parameters
+                  if not p.stop_gradient and p.grad is not None]
+        for p in params:
+            entry = self._buf.get(id(p))
+            g = p.grad._value
+            self._buf[id(p)] = (p, g if entry is None else entry[1] + g)
+        if self._count % self.k_steps != 0:
+            # boundary not reached: swallow this micro-step's grads so an
+            # unconditional user-side clear_grad cannot lose them
+            for p in params:
+                p.clear_grad()
+            return
+        scale = 1.0 / self.k_steps if self.avg else 1.0
+        # apply EVERY buffered accumulation, including for params that got
+        # no grad on this particular micro-step (conditional branches)
+        for p, acc in self._buf.values():
+            p.grad = Tensor(acc * scale, _internal=True)
+        self._inner.step()
+        self._buf.clear()
+
+
+class LocalSGDOptimizer(MetaOptimizerBase):
+    """Step locally, average parameters across the data-parallel world
+    every k steps (localsgd_optimizer.py; localsgd_configs {k_steps,
+    begin_step})."""
+
+    def __init__(self, inner, k_steps: int = 1, begin_step: int = 1):
+        super().__init__(inner)
+        self.k_steps = max(1, int(k_steps))
+        self.begin_step = int(begin_step)
+        self._count = 0
+
+    def _sync_params(self):
+        from ... import collective as C
+        if C.get_world_size() <= 1:
+            return
+        for p in self._inner._parameters:
+            if p.stop_gradient:
+                continue
+            t = Tensor(p._value, _internal=True)
+            C.all_reduce(t, op=C.ReduceOp.AVG)
+            p._replace_(t._value, None)
+
+    @no_grad()
+    def step(self):
+        self._inner.step()
+        self._count += 1
+        if self._count >= self.begin_step and \
+                self._count % self.k_steps == 0:
+            self._sync_params()
+
+
+class AdaptiveLocalSGDOptimizer(LocalSGDOptimizer):
+    """adaptive_localsgd: the sync interval adapts to training progress
+    (reference uses a loss-variance heuristic; here k grows as the update
+    magnitude shrinks — same intent: sync often early, rarely late)."""
+
+    def __init__(self, inner, init_k_steps: int = 1, begin_step: int = 1,
+                 max_k_steps: int = 16):
+        super().__init__(inner, k_steps=init_k_steps, begin_step=begin_step)
+        self.init_k_steps = max(1, int(init_k_steps))
+        self.max_k_steps = int(max_k_steps)
+        self._first_norm: Optional[float] = None
+
+    def _grad_norm(self) -> float:
+        tot = 0.0
+        for p in self._inner._parameters:
+            if p.grad is not None:
+                tot += float(jnp.sum(jnp.square(
+                    p.grad._value.astype(jnp.float32))))
+        return float(np.sqrt(tot))
+
+    @no_grad()
+    def step(self):
+        norm = self._grad_norm()
+        if self._first_norm is None and norm > 0:
+            self._first_norm = norm
+        super().step()
+        if self._first_norm and norm > 0 and \
+                self._count % self.k_steps == 0:
+            ratio = self._first_norm / norm
+            self.k_steps = int(np.clip(self.init_k_steps * np.sqrt(ratio),
+                                       1, self.max_k_steps))
+
+
+class DGCOptimizer(MetaOptimizerBase):
+    """Deep Gradient Compression (dgc_optimizer.py / dgc_momentum_op):
+    momentum-corrected gradients are top-k sparsified before communication
+    with local error feedback; before rampup_begin_step no compression.
+
+    dgc_configs: {rampup_begin_step, rampup_step, sparsity: [..]} — the
+    sparsity list ramps (0.75 -> 0.9375 -> ...) over rampup_step steps.
+    """
+
+    def __init__(self, inner, rampup_begin_step: int = 0,
+                 rampup_step: int = 1, sparsity=(0.999,),
+                 momentum: float = 0.9):
+        super().__init__(inner)
+        self.rampup_begin_step = int(rampup_begin_step)
+        self.rampup_step = max(1, int(rampup_step))
+        self.sparsity = list(sparsity)
+        self.momentum = momentum
+        self._u: dict = {}        # momentum-corrected velocity
+        self._r: dict = {}        # error-feedback residual (unsent mass)
+        self._count = 0
+
+    def _current_sparsity(self) -> float:
+        t = self._count - self.rampup_begin_step
+        if t < 0:
+            return 0.0
+        idx = min(len(self.sparsity) - 1,
+                  t * len(self.sparsity) // self.rampup_step)
+        return float(self.sparsity[idx])
+
+    @no_grad()
+    def step(self):
+        from ... import collective as C
+        self._count += 1
+        s = self._current_sparsity()
+        world = C.get_world_size()
+        for p in self._inner._parameters:
+            if p.stop_gradient or p.grad is None:
+                continue
+            g = p.grad._value.astype(jnp.float32)
+            # DGC keeps two accumulators: momentum-corrected velocity u and
+            # the error-feedback residual r of mass not yet transmitted
+            u = self._u.get(id(p))
+            u = g if u is None else self.momentum * u + g
+            self._u[id(p)] = u
+            acc = self._r.get(id(p), 0.0) + u
+            if s > 0.0 and acc.size > 1:
+                k = max(1, int(round(acc.size * (1.0 - s))))
+                flat = jnp.abs(acc.reshape(-1))
+                thresh = jnp.sort(flat)[-k]
+                mask = (jnp.abs(acc) >= thresh).astype(acc.dtype)
+                sparse = acc * mask
+                self._r[id(p)] = acc - sparse
+            else:
+                sparse = acc
+                self._r[id(p)] = jnp.zeros_like(acc)
+            if world > 1:
+                t = Tensor(sparse, _internal=True)
+                C.all_reduce(t, op=C.ReduceOp.AVG)
+                sparse = t._value
+            p.grad = Tensor(sparse.astype(p.grad._value.dtype),
+                            _internal=True)
+        self._inner.step()
+
+
+class FP16AllReduceOptimizer(MetaOptimizerBase):
+    """fp16_allreduce_optimizer.py: gradients are cast to fp16 for the
+    data-parallel reduction (half the wire bytes), then back."""
+
+    @no_grad()
+    def step(self):
+        from ... import collective as C
+        world = C.get_world_size()
+        for p in self._inner._parameters:
+            if p.stop_gradient or p.grad is None:
+                continue
+            orig_dtype = p.grad._value.dtype
+            g16 = p.grad._value.astype(jnp.float16)
+            if world > 1:
+                t = Tensor(g16, _internal=True)
+                C.all_reduce(t, op=C.ReduceOp.AVG)
+                g16 = t._value
+            p.grad = Tensor(g16.astype(orig_dtype), _internal=True)
+        self._inner.step()
+
+
+def apply_meta_optimizers(optimizer, strategy):
+    """strategy_compiler.py: pick + chain meta optimizers from the
+    DistributedStrategy flags.  Application order (innermost first):
+    lars/lamb replace the update rule, fp16_allreduce and dgc transform
+    gradients, gradient_merge batches them, localsgd wraps the whole step.
+    """
+    if strategy is None:
+        return optimizer
+
+    from ....optimizer import Lamb, LarsMomentum, Momentum, SGD
+
+    opt = optimizer
+    if getattr(strategy, "lars", False) and isinstance(opt, (SGD, Momentum)):
+        cfg = strategy.lars_configs
+        opt = LarsMomentum(
+            learning_rate=opt._lr, parameters=opt._parameters,
+            momentum=getattr(opt, "_momentum", 0.9),
+            lars_coeff=cfg.get("lars_coeff", 0.001),
+            lars_weight_decay=cfg.get("lars_weight_decay", 0.0005),
+            epsilon=cfg.get("epsilon", 0.0))
+    elif getattr(strategy, "lamb", False):
+        cfg = strategy.lamb_configs
+        opt = Lamb(learning_rate=opt._lr, parameters=opt._parameters,
+                   lamb_weight_decay=cfg.get("lamb_weight_decay", 0.01))
+
+    if getattr(strategy, "fp16_allreduce", False):
+        opt = FP16AllReduceOptimizer(opt)
+    if getattr(strategy, "dgc", False):
+        cfg = strategy.dgc_configs
+        opt = DGCOptimizer(opt,
+                           rampup_begin_step=cfg.get("rampup_begin_step", 0),
+                           rampup_step=cfg.get("rampup_step", 1),
+                           sparsity=cfg.get("sparsity", [0.999]))
+    if getattr(strategy, "gradient_merge", False):
+        cfg = strategy.gradient_merge_configs
+        opt = GradientMergeOptimizer(opt, k_steps=cfg.get("k_steps", 1),
+                                     avg=cfg.get("avg", True))
+    if getattr(strategy, "localsgd", False):
+        cfg = strategy.localsgd_configs
+        opt = LocalSGDOptimizer(opt, k_steps=cfg.get("k_steps", 1),
+                                begin_step=cfg.get("begin_step", 1))
+    elif getattr(strategy, "adaptive_localsgd", False):
+        cfg = strategy.adaptive_localsgd_configs
+        opt = AdaptiveLocalSGDOptimizer(
+            opt, init_k_steps=cfg.get("init_k_steps", 1),
+            begin_step=cfg.get("begin_step", 1))
+    return opt

@@ -80,8 +80,6 @@ def local_auc_buckets(predict, label, num_buckets: int = 4096):
     """Histogram the positive/negative predictions into score buckets —
     the per-trainer half of BasicAucCalculator.add_data."""
     p = _np(predict).reshape(-1)
-    if p.ndim == 0:
-        p = p.reshape(1)
     y = _np(label).reshape(-1)
     idx = np.clip((p * num_buckets).astype(np.int64), 0, num_buckets - 1)
     stat_pos = np.bincount(idx[y > 0.5], minlength=num_buckets)

@@ -96,6 +96,32 @@ def test_pipe_command_filters_lines(slot_files, tmp_path):
     assert ds.get_memory_data_size() == 10
 
 
+def test_load_surfaces_parse_errors(slot_files, tmp_path):
+    files, _ = slot_files
+    bad = tmp_path / "bad"
+    bad.write_text("not numeric at all\n")
+    ds = _make(dist.InMemoryDataset, files + [str(bad)])
+    with pytest.raises(RuntimeError, match="load failed"):
+        ds.load_into_memory()
+
+
+def test_failed_pipe_command_raises(slot_files):
+    files, _ = slot_files
+    ds = _make(dist.InMemoryDataset, files,
+               pipe_command="definitely-not-a-command-xyz")
+    with pytest.raises(RuntimeError):
+        ds.load_into_memory()
+
+
+def test_queue_dataset_surfaces_reader_errors(slot_files, tmp_path):
+    files, _ = slot_files
+    bad = tmp_path / "bad"
+    bad.write_text("x y\n")
+    ds = _make(dist.QueueDataset, files + [str(bad)])
+    with pytest.raises(RuntimeError, match="reader failed"):
+        list(ds)
+
+
 def test_queue_dataset_streams_same_data(slot_files):
     files, rows = slot_files
     ds = _make(dist.QueueDataset, files, batch_size=3)

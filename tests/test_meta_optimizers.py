@@ -1,0 +1,180 @@
+"""Strategy meta-optimizer tests (reference pattern: unittests/
+test_fleet_gradient_merge_meta_optimizer.py et al. assert the rewritten
+program's behavior; here we assert the wrapper semantics directly)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed.fleet as fleet
+from paddle_tpu.distributed.fleet import DistributedStrategy
+from paddle_tpu.distributed.fleet.meta_optimizers import (
+    AdaptiveLocalSGDOptimizer, DGCOptimizer, FP16AllReduceOptimizer,
+    GradientMergeOptimizer, LocalSGDOptimizer, apply_meta_optimizers)
+
+
+def _param(val):
+    return paddle.to_tensor(np.asarray(val, np.float32),
+                            stop_gradient=False)
+
+
+def _set_grad(p, g):
+    from paddle_tpu.core.tensor import Tensor
+    p.grad = Tensor(np.asarray(g, np.float32))
+
+
+def test_gradient_merge_accumulates_then_applies():
+    w = _param([0.0])
+    inner = paddle.optimizer.SGD(learning_rate=1.0, parameters=[w])
+    opt = GradientMergeOptimizer(inner, k_steps=2, avg=True)
+    _set_grad(w, [1.0])
+    opt.step()
+    np.testing.assert_allclose(w.numpy(), [0.0])   # not applied yet
+    assert w.grad is None                          # swallowed into the buffer
+    _set_grad(w, [3.0])
+    opt.step()
+    np.testing.assert_allclose(w.numpy(), [-2.0])  # -(1+3)/2
+
+
+def test_gradient_merge_no_avg():
+    w = _param([0.0])
+    opt = GradientMergeOptimizer(
+        paddle.optimizer.SGD(learning_rate=1.0, parameters=[w]),
+        k_steps=2, avg=False)
+    for g in ([1.0], [3.0]):
+        _set_grad(w, g)
+        opt.step()
+    np.testing.assert_allclose(w.numpy(), [-4.0])
+
+
+def test_gradient_merge_applies_param_missing_grad_on_boundary():
+    """A param whose grad appears on micro-step 1 but not on the boundary
+    step must still receive its accumulated update."""
+    w1, w2 = _param([0.0]), _param([0.0])
+    opt = GradientMergeOptimizer(
+        paddle.optimizer.SGD(learning_rate=1.0, parameters=[w1, w2]),
+        k_steps=2, avg=False)
+    _set_grad(w1, [1.0])
+    _set_grad(w2, [5.0])
+    opt.step()
+    _set_grad(w1, [1.0])        # w2 gets NO grad on the boundary step
+    opt.step()
+    np.testing.assert_allclose(w1.numpy(), [-2.0])
+    np.testing.assert_allclose(w2.numpy(), [-5.0])
+
+
+def test_grad_clip_assignment_reaches_base_optimizer():
+    """HybridParallelOptimizer swaps _grad_clip by assignment; the wrapper
+    must forward it to the base optimizer whose step() reads it."""
+    w = _param([0.0])
+    base = paddle.optimizer.SGD(learning_rate=1.0, parameters=[w])
+    opt = GradientMergeOptimizer(base, k_steps=1)
+    marker = object()
+    opt._grad_clip = marker
+    assert base._grad_clip is marker
+
+
+def test_localsgd_single_trainer_is_plain_sgd():
+    w = _param([1.0])
+    opt = LocalSGDOptimizer(
+        paddle.optimizer.SGD(learning_rate=0.5, parameters=[w]), k_steps=2)
+    for _ in range(4):
+        _set_grad(w, [1.0])
+        opt.step()
+        w.clear_grad()
+    np.testing.assert_allclose(w.numpy(), [-1.0])
+
+
+def test_adaptive_localsgd_grows_interval():
+    w = _param([0.0])
+    opt = AdaptiveLocalSGDOptimizer(
+        paddle.optimizer.SGD(learning_rate=0.1, parameters=[w]),
+        init_k_steps=1)
+    _set_grad(w, [8.0])
+    opt.step()
+    k_early = opt.k_steps
+    _set_grad(w, [0.01])     # much smaller gradient -> longer interval
+    opt.step()
+    assert opt.k_steps > k_early
+
+
+def test_dgc_sparsifies_and_feeds_back_error():
+    w = _param(np.zeros(8))
+    seen = []
+    inner = paddle.optimizer.SGD(learning_rate=1.0, parameters=[w])
+    orig_step = inner.step
+
+    def spy_step():
+        seen.append(w.grad.numpy().copy())
+        orig_step()
+    inner.step = spy_step
+
+    opt = DGCOptimizer(inner, rampup_begin_step=0, rampup_step=1,
+                       sparsity=[0.75], momentum=0.0)
+    g = np.array([8, 7, 6, 5, 4, 3, 2, 1], np.float32)
+    _set_grad(w, g)
+    opt.step()
+    # 75% sparsity -> only top-2 magnitudes transmitted
+    assert (seen[0] != 0).sum() == 2
+    np.testing.assert_allclose(seen[0][:2], [8.0, 7.0])
+    # error feedback: the suppressed coordinates return on the next step
+    _set_grad(w, np.zeros(8, np.float32))
+    opt.step()
+    np.testing.assert_allclose(seen[1][2:4], [6.0, 5.0])
+
+
+def test_dgc_no_compression_before_rampup():
+    w = _param(np.zeros(4))
+    seen = []
+    inner = paddle.optimizer.SGD(learning_rate=1.0, parameters=[w])
+    orig = inner.step
+    inner.step = lambda: (seen.append(w.grad.numpy().copy()), orig())
+    opt = DGCOptimizer(inner, rampup_begin_step=5, sparsity=[0.75])
+    _set_grad(w, [1.0, 2.0, 3.0, 4.0])
+    opt.step()
+    assert (seen[0] != 0).all()
+
+
+def test_fp16_allreduce_rounds_to_half():
+    w = _param([0.0])
+    opt = FP16AllReduceOptimizer(
+        paddle.optimizer.SGD(learning_rate=1.0, parameters=[w]))
+    g = 1.0 + 2.0 ** -12                       # not representable in fp16
+    _set_grad(w, [g])
+    opt.step()
+    np.testing.assert_allclose(w.numpy(), [-np.float16(g)], rtol=0)
+
+
+def test_apply_meta_optimizers_composition():
+    w = _param([1.0])
+    base = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w])
+    strat = DistributedStrategy()
+    strat.gradient_merge = True
+    strat.gradient_merge_configs = {"k_steps": 4, "avg": True}
+    strat.localsgd = True
+    strat.localsgd_configs = {"k_steps": 2, "begin_step": 1}
+    opt = apply_meta_optimizers(base, strat)
+    assert isinstance(opt, LocalSGDOptimizer)
+    assert isinstance(opt.inner_opt, GradientMergeOptimizer)
+    assert opt.inner_opt.k_steps == 4
+
+
+def test_apply_lars_replaces_update_rule():
+    w = _param([1.0])
+    base = paddle.optimizer.Momentum(learning_rate=0.1, parameters=[w])
+    strat = DistributedStrategy()
+    strat.lars = True
+    opt = apply_meta_optimizers(base, strat)
+    from paddle_tpu.optimizer import LarsMomentum
+    assert isinstance(opt, LarsMomentum)
+
+
+def test_distributed_optimizer_threads_strategy():
+    strat = DistributedStrategy()
+    strat.gradient_merge = True
+    strat.gradient_merge_configs = {"k_steps": 2, "avg": True}
+    fleet.init(is_collective=True, strategy=strat)
+    w = _param([0.0])
+    opt = fleet.distributed_optimizer(
+        paddle.optimizer.SGD(learning_rate=1.0, parameters=[w]))
+    inner = opt._inner_opt
+    assert isinstance(inner, GradientMergeOptimizer)
