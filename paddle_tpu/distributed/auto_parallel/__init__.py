@@ -2,3 +2,7 @@ from .api import dtensor_from_fn, reshard, shard_op, shard_tensor  # noqa: F401
 from .engine import Engine  # noqa: F401
 from .process_mesh import ProcessMesh  # noqa: F401
 from .strategy import Strategy  # noqa: F401
+from .cluster import Cluster, Device, LinkSpec, Machine  # noqa: F401
+from .cost_model import (CostModel, PlanConfig, PlanCost,  # noqa: F401
+                         WorkloadSpec)
+from .planner import Planner, build_mesh  # noqa: F401

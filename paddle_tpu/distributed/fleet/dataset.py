@@ -240,17 +240,28 @@ class QueueDataset(DatasetBase):
             raise ValueError("set_filelist before iterating")
         q: "queue.Queue" = queue.Queue(maxsize=max(4, self.thread_num) * 16)
         done = object()
+        stop = threading.Event()   # consumer gone: readers must unwind
+
+        def put(item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
 
         def reader(paths: List[str]):
             try:
                 for p in paths:
                     for line in self._read_lines(p):
-                        q.put(self._parse_line(line))
-                q.put(done)
+                        if not put(self._parse_line(line)):
+                            return   # consumer stopped: close files, exit
+                put(done)
             except BaseException as e:
                 # a crashed reader must surface the error, not pose as a
                 # normal end-of-shard with silently truncated data
-                q.put(("__reader_error__", e))
+                put(("__reader_error__", e))
 
         shards = [self.filelist[i::self.thread_num]
                   for i in range(min(self.thread_num, len(self.filelist)))]
@@ -259,19 +270,24 @@ class QueueDataset(DatasetBase):
                              daemon=True).start()
         open_readers = len(shards)
         buf: List = []
-        while open_readers:
-            item = q.get()
-            if item is done:
-                open_readers -= 1
-                continue
-            if isinstance(item, tuple) and len(item) == 2 \
-                    and isinstance(item[0], str) \
-                    and item[0] == "__reader_error__":
-                raise RuntimeError(
-                    f"dataset reader failed: {item[1]!r}") from item[1]
-            buf.append(item)
-            if len(buf) == self.batch_size:
+        try:
+            while open_readers:
+                item = q.get()
+                if item is done:
+                    open_readers -= 1
+                    continue
+                if isinstance(item, tuple) and len(item) == 2 \
+                        and isinstance(item[0], str) \
+                        and item[0] == "__reader_error__":
+                    raise RuntimeError(
+                        f"dataset reader failed: {item[1]!r}") from item[1]
+                buf.append(item)
+                if len(buf) == self.batch_size:
+                    yield self._collate(buf)
+                    buf = []
+            if buf:
                 yield self._collate(buf)
-                buf = []
-        if buf:
-            yield self._collate(buf)
+        finally:
+            # error raised above or the consumer broke out of iteration:
+            # release blocked readers so threads/files/pipes are reclaimed
+            stop.set()

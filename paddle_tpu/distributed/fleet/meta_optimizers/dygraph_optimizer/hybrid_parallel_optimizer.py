@@ -64,7 +64,14 @@ class HybridParallelOptimizer:
     @no_grad()
     def step(self):
         hcg = self._hcg
-        if hcg is not None and hcg.get_data_parallel_world_size() > 1:
+        # communication-reducing meta optimizers (dgc / fp16_allreduce /
+        # localsgd / gradient_merge) own the DP synchronization themselves;
+        # a dense per-micro-step allreduce here would defeat them
+        # (strategy_compiler disables raw DP allreduce the same way)
+        inner_handles_comm = getattr(self._inner_opt, "_handles_dp_comm",
+                                     False)
+        if hcg is not None and not inner_handles_comm \
+                and hcg.get_data_parallel_world_size() > 1:
             from ...utils.hybrid_parallel_util import fused_allreduce_gradients
             fused_allreduce_gradients(self._inner_opt._parameters, hcg)
         self._inner_opt.step()

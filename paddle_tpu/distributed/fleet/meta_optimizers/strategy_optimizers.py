@@ -75,7 +75,11 @@ class MetaOptimizerBase:
 
 class GradientMergeOptimizer(MetaOptimizerBase):
     """Accumulate k micro-steps of gradients, apply once
-    (gradient_merge_optimizer.py; gradient_merge_configs {k_steps, avg})."""
+    (gradient_merge_optimizer.py; gradient_merge_configs {k_steps, avg}).
+    Owns DP sync: gradients are allreduced ONCE at the boundary instead of
+    per micro-step (the whole point of merging)."""
+
+    _handles_dp_comm = True
 
     def __init__(self, inner, k_steps: int = 1, avg: bool = True):
         super().__init__(inner)
@@ -83,6 +87,13 @@ class GradientMergeOptimizer(MetaOptimizerBase):
         self.avg = avg
         self._buf: dict = {}
         self._count = 0
+
+    def _dp_sync(self, params):
+        from ...topology import get_hybrid_communicate_group
+        from ..utils.hybrid_parallel_util import fused_allreduce_gradients
+        hcg = get_hybrid_communicate_group()
+        if hcg is not None and hcg.get_data_parallel_world_size() > 1:
+            fused_allreduce_gradients(params, hcg)
 
     @no_grad()
     def step(self):
@@ -102,8 +113,10 @@ class GradientMergeOptimizer(MetaOptimizerBase):
         scale = 1.0 / self.k_steps if self.avg else 1.0
         # apply EVERY buffered accumulation, including for params that got
         # no grad on this particular micro-step (conditional branches)
+        merged = [p for p, _ in self._buf.values()]
         for p, acc in self._buf.values():
             p.grad = Tensor(acc * scale, _internal=True)
+        self._dp_sync(merged)
         self._inner.step()
         self._buf.clear()
 
@@ -111,7 +124,10 @@ class GradientMergeOptimizer(MetaOptimizerBase):
 class LocalSGDOptimizer(MetaOptimizerBase):
     """Step locally, average parameters across the data-parallel world
     every k steps (localsgd_optimizer.py; localsgd_configs {k_steps,
-    begin_step})."""
+    begin_step}).  Owns DP sync: per-step gradient allreduce is exactly
+    what LocalSGD removes."""
+
+    _handles_dp_comm = True
 
     def __init__(self, inner, k_steps: int = 1, begin_step: int = 1):
         super().__init__(inner)
@@ -179,7 +195,10 @@ class DGCOptimizer(MetaOptimizerBase):
 
     dgc_configs: {rampup_begin_step, rampup_step, sparsity: [..]} — the
     sparsity list ramps (0.75 -> 0.9375 -> ...) over rampup_step steps.
+    Owns DP sync (the sparse allreduce IS the communication).
     """
+
+    _handles_dp_comm = True
 
     def __init__(self, inner, rampup_begin_step: int = 0,
                  rampup_step: int = 1, sparsity=(0.999,),
@@ -215,7 +234,6 @@ class DGCOptimizer(MetaOptimizerBase):
             # the error-feedback residual r of mass not yet transmitted
             u = self._u.get(id(p))
             u = g if u is None else self.momentum * u + g
-            self._u[id(p)] = u
             acc = self._r.get(id(p), 0.0) + u
             if s > 0.0 and acc.size > 1:
                 k = max(1, int(round(acc.size * (1.0 - s))))
@@ -224,9 +242,14 @@ class DGCOptimizer(MetaOptimizerBase):
                 mask = (jnp.abs(acc) >= thresh).astype(acc.dtype)
                 sparse = acc * mask
                 self._r[id(p)] = acc - sparse
+                # momentum-factor masking (DGC paper §3.2): transmitted
+                # coordinates also clear their velocity so already-sent
+                # mass does not re-enter in decayed form
+                self._u[id(p)] = u * (1.0 - mask)
             else:
                 sparse = acc
                 self._r[id(p)] = jnp.zeros_like(acc)
+                self._u[id(p)] = jnp.zeros_like(u)
             if world > 1:
                 t = Tensor(sparse, _internal=True)
                 C.all_reduce(t, op=C.ReduceOp.AVG)
@@ -238,7 +261,10 @@ class DGCOptimizer(MetaOptimizerBase):
 
 class FP16AllReduceOptimizer(MetaOptimizerBase):
     """fp16_allreduce_optimizer.py: gradients are cast to fp16 for the
-    data-parallel reduction (half the wire bytes), then back."""
+    data-parallel reduction (half the wire bytes), then back.  Owns DP
+    sync (the fp16 allreduce replaces the dense fp32 one)."""
+
+    _handles_dp_comm = True
 
     @no_grad()
     def step(self):
@@ -276,20 +302,31 @@ def apply_meta_optimizers(optimizer, strategy):
             momentum=getattr(opt, "_momentum", 0.9),
             lars_coeff=cfg.get("lars_coeff", 0.001),
             lars_weight_decay=cfg.get("lars_weight_decay", 0.0005),
-            epsilon=cfg.get("epsilon", 0.0))
+            epsilon=cfg.get("epsilon", 0.0),
+            grad_clip=optimizer._grad_clip)
     elif getattr(strategy, "lamb", False):
         cfg = strategy.lamb_configs
         opt = Lamb(learning_rate=opt._lr, parameters=opt._parameters,
-                   lamb_weight_decay=cfg.get("lamb_weight_decay", 0.01))
+                   lamb_weight_decay=cfg.get("lamb_weight_decay", 0.01),
+                   grad_clip=optimizer._grad_clip)
 
     if getattr(strategy, "fp16_allreduce", False):
         opt = FP16AllReduceOptimizer(opt)
     if getattr(strategy, "dgc", False):
         cfg = strategy.dgc_configs
+        # the reference REPLACES Momentum with DGCMomentum: DGC's own
+        # momentum correction supplies the momentum, so the inner update
+        # must be momentum-free or the 0.9 factor compounds twice
+        dgc_momentum = 0.9
+        if isinstance(opt, Momentum):
+            dgc_momentum = getattr(opt, "_momentum", 0.9)
+            opt = SGD(learning_rate=opt._lr, parameters=opt._parameters,
+                      grad_clip=opt._grad_clip)
         opt = DGCOptimizer(opt,
                            rampup_begin_step=cfg.get("rampup_begin_step", 0),
                            rampup_step=cfg.get("rampup_step", 1),
-                           sparsity=cfg.get("sparsity", [0.999]))
+                           sparsity=cfg.get("sparsity", [0.999]),
+                           momentum=dgc_momentum)
     if getattr(strategy, "gradient_merge", False):
         cfg = strategy.gradient_merge_configs
         opt = GradientMergeOptimizer(opt, k_steps=cfg.get("k_steps", 1),

@@ -1,0 +1,133 @@
+"""Cluster / cost model / planner tests (reference pattern:
+unittests/auto_parallel/test_cluster.py builds clusters from json,
+test_new_cost_model.py checks comm/comp cost math, planner tests check
+the chosen dist attrs)."""
+import numpy as np
+import pytest
+
+import jax
+
+from paddle_tpu.distributed.auto_parallel import (Cluster, CostModel,
+                                                  PlanConfig, Planner,
+                                                  WorkloadSpec, build_mesh)
+from paddle_tpu.distributed.auto_parallel.cluster import LinkSpec
+from paddle_tpu.distributed.auto_parallel.cost_model import (
+    allgather_time, allreduce_time, alltoall_time, p2p_time)
+
+
+def _v5e_pod(n_machines=4, per_machine=4):
+    return Cluster.from_dict({
+        "machines": [
+            {"devices": [{"type": "tpu v5e", "global_id": m * per_machine + i}
+                         for i in range(per_machine)]}
+            for m in range(n_machines)
+        ],
+        "links": {"ici_bandwidth": 186e9, "dcn_bandwidth": 25e9},
+    })
+
+
+def test_cluster_auto_introspects_backend():
+    c = Cluster.auto()
+    assert c.device_count() == jax.device_count()
+    assert c.peak_flops() > 0
+    assert c.device_memory() > 0
+
+
+def test_cluster_from_dict_and_links():
+    c = _v5e_pod()
+    assert c.device_count() == 16
+    assert c.devices_per_machine() == 4
+    assert c.link(4) is c.ici          # fits one machine
+    assert c.link(8) is c.dcn          # spans machines
+
+
+def test_comm_cost_math():
+    link = LinkSpec(bandwidth=100e9, latency=1e-6)
+    nbytes = 1e9
+    # ring allreduce moves 2(n-1)/n of the data
+    t8 = allreduce_time(nbytes, 8, link)
+    assert t8 == pytest.approx(2 * nbytes * 7 / 8 / 100e9, rel=0.01)
+    assert allreduce_time(nbytes, 1, link) == 0.0
+    assert allgather_time(nbytes, 8, link) < t8
+    assert alltoall_time(nbytes, 8, link) < t8
+    assert p2p_time(nbytes, link) == pytest.approx(nbytes / 100e9, rel=0.01)
+
+
+def test_memory_estimate_scales_with_sharding():
+    w = WorkloadSpec(hidden=2048, layers=24, global_batch=64)
+    cm = CostModel(_v5e_pod())
+    base = cm.memory_per_device(w, PlanConfig(dp=16))
+    zero2 = cm.memory_per_device(w, PlanConfig(dp=16, sharding_stage=2))
+    zero3 = cm.memory_per_device(w, PlanConfig(dp=16, sharding_stage=3))
+    assert zero2 < base
+    assert zero3 < zero2
+    mp = cm.memory_per_device(w, PlanConfig(dp=4, mp=4))
+    assert mp < base
+
+
+def test_cost_model_tp_adds_comm_time():
+    w = WorkloadSpec(hidden=4096, layers=32, global_batch=64)
+    cm = CostModel(_v5e_pod())
+    dp_plan = cm.step_time(w, PlanConfig(dp=16))
+    tp_plan = cm.step_time(w, PlanConfig(dp=4, mp=4))
+    assert tp_plan.breakdown["tp"] > 0
+    assert dp_plan.breakdown["tp"] == 0
+    # same total FLOPs -> identical compute term
+    assert dp_plan.breakdown["compute"] == \
+        pytest.approx(tp_plan.breakdown["compute"])
+
+
+def test_pp_bubble_grows_with_stages():
+    w = WorkloadSpec(hidden=2048, layers=32, global_batch=64,
+                     micro_batches=8)
+    cm = CostModel(_v5e_pod())
+    b2 = cm.step_time(w, PlanConfig(dp=8, pp=2)).breakdown["bubble"]
+    b4 = cm.step_time(w, PlanConfig(dp=4, pp=4)).breakdown["bubble"]
+    assert b4 > b2 > 0
+
+
+def test_planner_small_model_prefers_data_parallel():
+    """A model that fits easily should not pay TP/PP comm tax."""
+    w = WorkloadSpec(hidden=1024, layers=12, global_batch=256,
+                     vocab=32000)
+    plan = Planner(w, _v5e_pod()).best()
+    assert plan.mp == 1 and plan.pp == 1
+    assert plan.dp == 16
+
+
+def test_planner_big_model_shards():
+    """A ~10B-param model cannot sit replicated in 16GB; the planner must
+    pick a sharded plan."""
+    w = WorkloadSpec(hidden=4096, layers=48, global_batch=64,
+                     micro_batches=8)
+    planner = Planner(w, _v5e_pod())
+    plan = planner.best()
+    assert plan.mp * plan.pp * max(1, plan.sharding_stage) > 1
+    cost = planner.cost_model.step_time(w, plan)
+    assert cost.feasible
+
+
+def test_planner_respects_divisibility():
+    w = WorkloadSpec(hidden=1000, layers=24, global_batch=64)  # 1000 % mp
+    for plan in Planner(w, _v5e_pod()).candidates():
+        assert 1000 % plan.mp == 0
+        assert 24 % plan.pp == 0
+
+
+def test_planner_raises_when_nothing_fits():
+    w = WorkloadSpec(hidden=8192, layers=96, global_batch=2048,
+                     micro_batches=2)
+    tiny = Cluster.from_dict({
+        "machines": [{"devices": [{"type": "tpu v5e"}]}]})
+    with pytest.raises(RuntimeError):
+        Planner(w, tiny).best()
+
+
+def test_build_mesh_axes_order():
+    plan = PlanConfig(dp=2, mp=2, pp=2)
+    mesh = build_mesh(plan, devices=jax.devices())
+    assert mesh.axis_names == ("data", "pipe", "model")
+    assert mesh.devices.shape == (2, 2, 2)
+    # model axis innermost: adjacent device ids differ along it
+    ids = np.vectorize(lambda d: d.id)(mesh.devices)
+    assert abs(int(ids[0, 0, 1]) - int(ids[0, 0, 0])) == 1
