@@ -58,7 +58,7 @@ class Cluster:
                  ici: Optional[LinkSpec] = None,
                  dcn: Optional[LinkSpec] = None):
         self.machines = machines or []
-        self.ici = ici or LinkSpec(bandwidth=186e9 / 8 * 8, latency=1e-6)
+        self.ici = ici or LinkSpec(bandwidth=186e9, latency=1e-6)
         self.dcn = dcn or LinkSpec(bandwidth=25e9, latency=10e-6)
 
     # -- constructors ---------------------------------------------------------

@@ -89,6 +89,8 @@ class GradientMergeOptimizer(MetaOptimizerBase):
         self._count = 0
 
     def _dp_sync(self, params):
+        if getattr(self._inner, "_handles_dp_comm", False):
+            return   # an inner dgc/fp16 wrapper owns (and compresses) comm
         from ...topology import get_hybrid_communicate_group
         from ..utils.hybrid_parallel_util import fused_allreduce_gradients
         hcg = get_hybrid_communicate_group()
@@ -247,9 +249,13 @@ class DGCOptimizer(MetaOptimizerBase):
                 # mass does not re-enter in decayed form
                 self._u[id(p)] = u * (1.0 - mask)
             else:
+                # dense mode (pre-rampup warmup, or tiny params): transmit
+                # the velocity and RETAIN it — that is exactly standard
+                # momentum SGD; zeroing u here would strip momentum from
+                # the whole warmup phase
                 sparse = acc
                 self._r[id(p)] = jnp.zeros_like(acc)
-                self._u[id(p)] = jnp.zeros_like(u)
+                self._u[id(p)] = u
             if world > 1:
                 t = Tensor(sparse, _internal=True)
                 C.all_reduce(t, op=C.ReduceOp.AVG)
@@ -310,23 +316,28 @@ def apply_meta_optimizers(optimizer, strategy):
                    lamb_weight_decay=cfg.get("lamb_weight_decay", 0.01),
                    grad_clip=optimizer._grad_clip)
 
-    if getattr(strategy, "fp16_allreduce", False):
-        opt = FP16AllReduceOptimizer(opt)
     if getattr(strategy, "dgc", False):
         cfg = strategy.dgc_configs
-        # the reference REPLACES Momentum with DGCMomentum: DGC's own
-        # momentum correction supplies the momentum, so the inner update
-        # must be momentum-free or the 0.9 factor compounds twice
-        dgc_momentum = 0.9
-        if isinstance(opt, Momentum):
+        # plain Momentum is REPLACED (the reference's DGCMomentum): DGC's
+        # own momentum correction supplies the velocity, so the inner
+        # update must be momentum-free or the 0.9 factor compounds twice.
+        # Any OTHER rule (LarsMomentum, Lamb, Adam...) keeps its own
+        # momentum machinery and DGC runs compression-only (momentum=0).
+        if type(opt) is Momentum:
             dgc_momentum = getattr(opt, "_momentum", 0.9)
             opt = SGD(learning_rate=opt._lr, parameters=opt._parameters,
                       grad_clip=opt._grad_clip)
+        else:
+            dgc_momentum = 0.0
         opt = DGCOptimizer(opt,
                            rampup_begin_step=cfg.get("rampup_begin_step", 0),
                            rampup_step=cfg.get("rampup_step", 1),
                            sparsity=cfg.get("sparsity", [0.999]),
                            momentum=dgc_momentum)
+    elif getattr(strategy, "fp16_allreduce", False):
+        # dgc supersedes fp16_allreduce: its sparse allreduce IS the comm;
+        # stacking both would pay for two reductions
+        opt = FP16AllReduceOptimizer(opt)
     if getattr(strategy, "gradient_merge", False):
         cfg = strategy.gradient_merge_configs
         opt = GradientMergeOptimizer(opt, k_steps=cfg.get("k_steps", 1),

@@ -6,3 +6,4 @@ from . import checkpoint  # noqa: F401
 from . import distributed  # noqa: F401
 from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
+from . import multiprocessing  # noqa: F401

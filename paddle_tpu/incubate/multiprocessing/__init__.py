@@ -1,0 +1,96 @@
+"""paddle.incubate.multiprocessing parity — share Tensors across python
+processes.
+
+Reference: python/paddle/incubate/multiprocessing/reductions.py (registers
+ForkingPickler reduce functions so Tensors travel through mp.Queue /
+Pipe via CUDA IPC handles or shared-memory files instead of pickled
+copies).
+
+TPU-native: device memory is not host-shareable, so tensors are staged
+through POSIX shared memory (multiprocessing.shared_memory) on the host —
+the same route the reference takes for CPU tensors (mmap files).  The
+consumer re-materializes a device array lazily on first use.  API:
+
+    import paddle_tpu.incubate.multiprocessing as mp
+    q = mp.Queue()            # a context with tensor reductions installed
+    q.put(tensor)             # zero-pickle-copy via shm
+"""
+from __future__ import annotations
+
+import multiprocessing as _std_mp
+from multiprocessing import shared_memory
+from multiprocessing.reduction import ForkingPickler
+
+import numpy as np
+
+from ...core.tensor import Tensor
+
+__all__ = ["init_reductions", "Queue", "Pipe", "Process", "get_context"]
+
+_INITIALIZED = False
+# keep producer-side segments alive until the process exits (the consumer
+# unlinks; reference keeps the same "sender leaks until GC" contract via
+# its LRU of mmap files)
+_LIVE_SEGMENTS: list = []
+
+
+def _rebuild_tensor_from_shm(shm_name: str, shape, dtype_str: str,
+                             stop_gradient: bool):
+    shm = shared_memory.SharedMemory(name=shm_name)
+    try:
+        arr = np.ndarray(shape, dtype=np.dtype(dtype_str),
+                         buffer=shm.buf).copy()
+    finally:
+        shm.close()
+        try:
+            shm.unlink()
+        except FileNotFoundError:
+            pass
+    t = Tensor(arr)
+    t.stop_gradient = stop_gradient
+    return t
+
+
+def _reduce_tensor(t: Tensor):
+    arr = np.asarray(t._value)
+    shm = shared_memory.SharedMemory(create=True, size=max(1, arr.nbytes))
+    dst = np.ndarray(arr.shape, dtype=arr.dtype, buffer=shm.buf)
+    dst[...] = arr
+    _LIVE_SEGMENTS.append(shm)
+    if len(_LIVE_SEGMENTS) > 64:          # bounded producer-side cache
+        old = _LIVE_SEGMENTS.pop(0)
+        old.close()
+    return (_rebuild_tensor_from_shm,
+            (shm.name, arr.shape, arr.dtype.str, t.stop_gradient))
+
+
+def init_reductions() -> None:
+    """Install the Tensor reducer on ForkingPickler (reductions.py
+    init_reductions)."""
+    global _INITIALIZED
+    if _INITIALIZED:
+        return
+    ForkingPickler.register(Tensor, _reduce_tensor)
+    _INITIALIZED = True
+
+
+# -- thin context surface (reference re-exports multiprocessing with the
+# reducers installed) --------------------------------------------------------
+def get_context(method=None):
+    init_reductions()
+    return _std_mp.get_context(method)
+
+
+def Queue(*args, **kwargs):
+    init_reductions()
+    return _std_mp.get_context("spawn").Queue(*args, **kwargs)
+
+
+def Pipe(duplex=True):
+    init_reductions()
+    return _std_mp.get_context("spawn").Pipe(duplex)
+
+
+def Process(*args, **kwargs):
+    init_reductions()
+    return _std_mp.get_context("spawn").Process(*args, **kwargs)

@@ -92,3 +92,5 @@ class ViterbiDecoder(Layer):
     def forward(self, potentials, lengths=None):
         return viterbi_decode(potentials, self.transitions, lengths,
                               self.include_bos_eos_tag)
+
+from . import datasets  # noqa: F401  (Imdb/Imikolov/UCIHousing/Movielens)

@@ -1,0 +1,215 @@
+"""paddle.text.datasets parity — Imdb, Imikolov, UCIHousing, Movielens.
+
+Reference: python/paddle/text/datasets/{imdb,imikolov,uci_housing,
+movielens}.py.  The reference downloads from its mirror at construction;
+this build has no network egress, so every dataset takes a local
+`data_file` in the SAME archive format the reference downloads, and
+parses it identically (tokenization, vocabulary building, rating tuples).
+"""
+from __future__ import annotations
+
+import os
+import re
+import tarfile
+import zipfile
+from collections import Counter
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+__all__ = ["Imdb", "Imikolov", "UCIHousing", "Movielens"]
+
+
+def _require(data_file: Optional[str], name: str) -> str:
+    if data_file is None:
+        raise ValueError(
+            f"{name}: this build has no network egress; pass data_file= "
+            f"pointing at the locally-downloaded archive")
+    if not os.path.exists(data_file):
+        raise FileNotFoundError(data_file)
+    return data_file
+
+
+class Imdb(Dataset):
+    """IMDB sentiment (aclImdb tar.gz layout: aclImdb/<mode>/<pos|neg>/
+    *.txt).  Builds the word vocab from the archive like imdb.py, yields
+    (ids int64 array, label 0/1)."""
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 cutoff: int = 150):
+        data_file = _require(data_file, "Imdb")
+        if mode not in ("train", "test"):
+            raise ValueError(f"mode must be train|test, got {mode!r}")
+        self.mode = mode
+        pat = re.compile(rf"aclImdb/{mode}/(pos|neg)/.*\.txt$")
+        tokenize = re.compile(r"[A-Za-z0-9']+")
+        texts: List[List[str]] = []
+        labels: List[int] = []
+        counter: Counter = Counter()
+        with tarfile.open(data_file, "r:*") as tf:
+            for member in tf.getmembers():
+                m = pat.search(member.name)
+                if not m:
+                    continue
+                words = tokenize.findall(
+                    tf.extractfile(member).read().decode(
+                        "utf-8", "ignore").lower())
+                texts.append(words)
+                labels.append(0 if m.group(1) == "neg" else 1)
+                counter.update(words)
+        # vocab: most frequent first, cut at `cutoff`, <unk> = last id
+        vocab_words = [w for w, _ in counter.most_common(cutoff - 1)]
+        self.word_idx: Dict[str, int] = {w: i for i, w in
+                                         enumerate(vocab_words)}
+        self.word_idx["<unk>"] = len(self.word_idx)
+        unk = self.word_idx["<unk>"]
+        self.docs = [np.array([self.word_idx.get(w, unk) for w in words],
+                              dtype=np.int64) for words in texts]
+        self.labels = np.array(labels, dtype=np.int64)
+
+    def __getitem__(self, idx):
+        return self.docs[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class Imikolov(Dataset):
+    """PTB language-model n-grams (imikolov.py): simple-examples tar.gz
+    with ./data/ptb.{train,valid}.txt; data_type NGRAM -> sliding windows
+    of `window_size`, SEQ -> whole <s> .. <e> sentences."""
+
+    def __init__(self, data_file: Optional[str] = None, data_type="NGRAM",
+                 window_size: int = 5, mode: str = "train",
+                 min_word_freq: int = 50):
+        data_file = _require(data_file, "Imikolov")
+        if data_type not in ("NGRAM", "SEQ"):
+            raise ValueError("data_type must be NGRAM or SEQ")
+        split = {"train": "train", "test": "valid"}[
+            "train" if mode == "train" else "test"]
+        with tarfile.open(data_file, "r:*") as tf:
+            train_lines = self._lines(tf, "ptb.train.txt")
+            lines = train_lines if split == "train" else \
+                self._lines(tf, "ptb.valid.txt")
+        counter: Counter = Counter()
+        for ln in train_lines:
+            counter.update(["<s>"] + ln + ["<e>"])   # markers join the vocab
+        counter.pop("<unk>", None)
+        vocab = sorted((w for w, c in counter.items()
+                        if c >= min_word_freq))
+        self.word_idx = {w: i for i, w in enumerate(vocab)}
+        self.word_idx["<unk>"] = len(self.word_idx)
+        unk = self.word_idx["<unk>"]
+        self.data: List[np.ndarray] = []
+        for ln in lines:
+            ids = [self.word_idx.get(w, unk)
+                   for w in (["<s>"] + ln + ["<e>"])]
+            if data_type == "NGRAM":
+                if len(ids) >= window_size:
+                    for i in range(window_size, len(ids) + 1):
+                        self.data.append(np.array(ids[i - window_size:i],
+                                                  dtype=np.int64))
+            else:
+                self.data.append(np.array(ids, dtype=np.int64))
+
+    @staticmethod
+    def _lines(tf: tarfile.TarFile, name: str) -> List[List[str]]:
+        member = next(m for m in tf.getmembers() if m.name.endswith(name))
+        raw = tf.extractfile(member).read().decode("utf-8", "ignore")
+        return [ln.strip().split() for ln in raw.splitlines() if ln.strip()]
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class UCIHousing(Dataset):
+    """Boston housing regression (uci_housing.py): whitespace table of 14
+    columns, feature-normalized, 80/20 train/test split."""
+
+    FEATURE_DIM = 13
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train"):
+        data_file = _require(data_file, "UCIHousing")
+        raw = np.loadtxt(data_file).astype(np.float32)
+        if raw.ndim != 2 or raw.shape[1] != self.FEATURE_DIM + 1:
+            raise ValueError(
+                f"UCIHousing expects {self.FEATURE_DIM + 1} columns, got "
+                f"{raw.shape}")
+        # normalize features by train-portion statistics (uci_housing.py
+        # max/min/avg normalization)
+        split = int(raw.shape[0] * 0.8)
+        feats = raw[:, :-1]
+        mx, mn, avg = (feats[:split].max(0), feats[:split].min(0),
+                       feats[:split].mean(0))
+        denom = np.where(mx - mn == 0, 1.0, mx - mn)
+        feats = (feats - avg) / denom
+        data = np.concatenate([feats, raw[:, -1:]], axis=1)
+        self.data = data[:split] if mode == "train" else data[split:]
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        return row[:-1], row[-1:]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Movielens(Dataset):
+    """MovieLens-1M ratings (movielens.py): ml-1m.zip with users.dat /
+    movies.dat / ratings.dat ('::' separated); yields (user_id, gender,
+    age, job, movie_id, title_ids, category_ids, rating)."""
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 test_ratio: float = 0.1, rand_seed: int = 0):
+        data_file = _require(data_file, "Movielens")
+        users: Dict[int, tuple] = {}
+        movies: Dict[int, tuple] = {}
+        with zipfile.ZipFile(data_file) as zf:
+            def read(name):
+                member = next(n for n in zf.namelist()
+                              if n.endswith(name))
+                return zf.read(member).decode("latin1").splitlines()
+
+            categories: Dict[str, int] = {}
+            title_words: Dict[str, int] = {}
+            for ln in read("movies.dat"):
+                mid, title, cats = ln.strip().split("::")
+                cat_ids = [categories.setdefault(c, len(categories))
+                           for c in cats.split("|")]
+                tw = [title_words.setdefault(w, len(title_words))
+                      for w in re.findall(r"[A-Za-z0-9']+", title.lower())]
+                movies[int(mid)] = (np.array(tw, np.int64),
+                                    np.array(cat_ids, np.int64))
+            for ln in read("users.dat"):
+                uid, gender, age, job, _zip = ln.strip().split("::")
+                users[int(uid)] = (0 if gender == "M" else 1, int(age),
+                                   int(job))
+            rng = np.random.RandomState(rand_seed)
+            self.samples = []
+            for ln in read("ratings.dat"):
+                uid, mid, rating, _ts = ln.strip().split("::")
+                uid, mid = int(uid), int(mid)
+                if uid not in users or mid not in movies:
+                    continue
+                is_test = rng.rand() < test_ratio
+                if (mode == "test") != is_test:
+                    continue
+                g, a, j = users[uid]
+                tw, cats = movies[mid]
+                self.samples.append((np.int64(uid), np.int64(g),
+                                     np.int64(a), np.int64(j),
+                                     np.int64(mid), tw, cats,
+                                     np.float32(rating)))
+        self.categories_dict = categories
+        self.movie_title_dict = title_words
+
+    def __getitem__(self, idx):
+        return self.samples[idx]
+
+    def __len__(self):
+        return len(self.samples)

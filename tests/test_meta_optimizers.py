@@ -134,6 +134,62 @@ def test_dgc_no_compression_before_rampup():
     assert (seen[0] != 0).all()
 
 
+def test_dgc_dense_warmup_keeps_momentum():
+    """Before rampup (dense mode) DGC must behave exactly like momentum
+    SGD: velocity transmitted AND retained."""
+    w = _param(np.zeros(4))
+    seen = []
+    inner = paddle.optimizer.SGD(learning_rate=1.0, parameters=[w])
+    orig = inner.step
+    inner.step = lambda: (seen.append(w.grad.numpy().copy()), orig())
+    opt = DGCOptimizer(inner, rampup_begin_step=100, sparsity=[0.75],
+                       momentum=0.9)
+    g = np.ones(4, np.float32)
+    _set_grad(w, g)
+    opt.step()
+    _set_grad(w, g)
+    opt.step()
+    np.testing.assert_allclose(seen[0], g)
+    np.testing.assert_allclose(seen[1], 1.9 * g)   # v = 0.9*v + g
+
+
+def test_dgc_replaces_plain_momentum_only():
+    """type(opt) is Momentum -> momentum moves into DGC over SGD;
+    LarsMomentum keeps its trust-ratio rule with DGC compression-only."""
+    from paddle_tpu.optimizer import LarsMomentum, SGD
+
+    w = _param([1.0])
+    strat = DistributedStrategy()
+    strat.dgc = True
+    opt = apply_meta_optimizers(
+        paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.8,
+                                  parameters=[w]), strat)
+    assert isinstance(opt, DGCOptimizer)
+    assert type(opt.inner_opt) is SGD
+    assert opt.momentum == pytest.approx(0.8)
+
+    strat2 = DistributedStrategy()
+    strat2.lars = True
+    strat2.dgc = True
+    opt2 = apply_meta_optimizers(
+        paddle.optimizer.Momentum(learning_rate=0.1, parameters=[w]),
+        strat2)
+    assert isinstance(opt2, DGCOptimizer)
+    assert isinstance(opt2.inner_opt, LarsMomentum)
+    assert opt2.momentum == 0.0                    # compression-only
+
+
+def test_dgc_supersedes_fp16_allreduce():
+    w = _param([1.0])
+    strat = DistributedStrategy()
+    strat.dgc = True
+    strat.fp16_allreduce = True
+    opt = apply_meta_optimizers(
+        paddle.optimizer.SGD(learning_rate=0.1, parameters=[w]), strat)
+    assert isinstance(opt, DGCOptimizer)
+    assert not isinstance(opt.inner_opt, FP16AllReduceOptimizer)
+
+
 def test_fp16_allreduce_rounds_to_half():
     w = _param([0.0])
     opt = FP16AllReduceOptimizer(

@@ -1,0 +1,147 @@
+"""text.datasets + incubate.multiprocessing tests (reference pattern:
+unittests/test_datasets.py builds tiny archives in the reference's own
+download format and checks parsing; test_multiprocess_* round-trips
+tensors through mp queues)."""
+import io
+import os
+import tarfile
+import zipfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.text.datasets import Imdb, Imikolov, Movielens, UCIHousing
+
+
+# ---------------------------------------------------------------------------
+# archive builders in the exact formats the reference downloads
+# ---------------------------------------------------------------------------
+def _make_imdb(tmp_path):
+    path = tmp_path / "aclImdb_v1.tar.gz"
+    with tarfile.open(path, "w:gz") as tf:
+        def add(name, text):
+            data = text.encode()
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+        add("aclImdb/train/pos/0_9.txt", "a great great movie")
+        add("aclImdb/train/pos/1_8.txt", "loved this great film")
+        add("aclImdb/train/neg/0_2.txt", "a terrible movie")
+        add("aclImdb/test/pos/0_10.txt", "great")
+        add("aclImdb/test/neg/0_1.txt", "terrible terrible")
+    return str(path)
+
+
+def _make_ptb(tmp_path):
+    path = tmp_path / "simple-examples.tgz"
+    train = "\n".join(["the cat sat on the mat"] * 30
+                      + ["a dog ran fast"] * 30)
+    valid = "the cat ran"
+    with tarfile.open(path, "w:gz") as tf:
+        for name, text in [("simple-examples/data/ptb.train.txt", train),
+                           ("simple-examples/data/ptb.valid.txt", valid)]:
+            data = text.encode()
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+    return str(path)
+
+
+def _make_housing(tmp_path):
+    path = tmp_path / "housing.data"
+    rng = np.random.RandomState(0)
+    rows = np.hstack([rng.rand(50, 13), rng.rand(50, 1) * 50])
+    np.savetxt(path, rows)
+    return str(path)
+
+
+def _make_ml1m(tmp_path):
+    path = tmp_path / "ml-1m.zip"
+    with zipfile.ZipFile(path, "w") as zf:
+        zf.writestr("ml-1m/users.dat",
+                    "1::M::25::4::10001\n2::F::35::7::10002\n")
+        zf.writestr("ml-1m/movies.dat",
+                    "10::Toy Story (1995)::Animation|Comedy\n"
+                    "20::Heat (1995)::Action|Crime\n")
+        zf.writestr("ml-1m/ratings.dat",
+                    "1::10::5::978300760\n1::20::3::978302109\n"
+                    "2::10::4::978301968\n")
+    return str(path)
+
+
+def test_imdb_parses_and_builds_vocab(tmp_path):
+    ds = Imdb(data_file=_make_imdb(tmp_path), mode="train")
+    assert len(ds) == 3
+    assert "great" in ds.word_idx          # frequent word in vocab
+    doc, label = ds[0]
+    assert doc.dtype == np.int64
+    assert set(np.unique(ds.labels)) == {0, 1}
+    test = Imdb(data_file=_make_imdb(tmp_path), mode="test")
+    assert len(test) == 2
+
+
+def test_imikolov_ngram_and_seq(tmp_path):
+    ptb = _make_ptb(tmp_path)
+    ng = Imikolov(data_file=ptb, data_type="NGRAM", window_size=3,
+                  mode="train", min_word_freq=10)
+    assert len(ng) > 0
+    assert all(len(x) == 3 for x in ng.data)
+    seq = Imikolov(data_file=ptb, data_type="SEQ", mode="test",
+                   min_word_freq=10)
+    # valid split: one sentence <s> the cat ran <e>
+    assert len(seq) == 1
+    assert seq[0][0] == seq.word_idx["<s>"]
+    assert seq[0][-1] == seq.word_idx["<e>"]
+
+
+def test_uci_housing_split_and_normalization(tmp_path):
+    housing = _make_housing(tmp_path)
+    train = UCIHousing(data_file=housing, mode="train")
+    test = UCIHousing(data_file=housing, mode="test")
+    assert len(train) == 40 and len(test) == 10
+    x, y = train[0]
+    assert x.shape == (13,) and y.shape == (1,)
+    # normalized features are centred-ish
+    assert abs(np.stack([train[i][0] for i in range(40)]).mean()) < 0.5
+
+
+def test_movielens_joins_tables(tmp_path):
+    ds = Movielens(data_file=_make_ml1m(tmp_path), mode="train",
+                   test_ratio=0.0)
+    assert len(ds) == 3
+    uid, gender, age, job, mid, title, cats, rating = ds[0]
+    assert rating in (3.0, 4.0, 5.0)
+    assert title.dtype == np.int64 and cats.dtype == np.int64
+    assert "Action" in ds.categories_dict
+
+
+def test_datasets_require_local_file():
+    with pytest.raises(ValueError, match="egress"):
+        Imdb()
+    with pytest.raises(FileNotFoundError):
+        UCIHousing(data_file="/nonexistent/housing.data")
+
+
+# ---------------------------------------------------------------------------
+# incubate.multiprocessing tensor IPC
+# ---------------------------------------------------------------------------
+def test_tensor_reduction_roundtrip_in_process():
+    """ForkingPickler reduce/rebuild round-trips a Tensor through shared
+    memory without pickling the payload."""
+    import paddle_tpu.incubate.multiprocessing as pmp
+    pmp.init_reductions()
+    t = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+    fn, args = pmp._reduce_tensor(t)
+    out = fn(*args)
+    np.testing.assert_array_equal(out.numpy(), t.numpy())
+    assert out.stop_gradient == t.stop_gradient
+
+
+def test_tensor_through_real_mp_queue():
+    import paddle_tpu.incubate.multiprocessing as pmp
+    q = pmp.Queue()
+    t = paddle.to_tensor(np.ones((4,), np.float32) * 7)
+    q.put(t)
+    out = q.get(timeout=30)
+    np.testing.assert_array_equal(out.numpy(), t.numpy())
