@@ -34,11 +34,21 @@ _INITIALIZED = False
 _LIVE_SEGMENTS: list = []
 
 
+def _np_dtype(name: str):
+    """Resolve a dtype NAME — numpy's own, or an ml_dtypes extension
+    (bfloat16, float8_*): dtype.str would be an opaque '<V2' for those."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
 def _rebuild_tensor_from_shm(shm_name: str, shape, dtype_str: str,
                              stop_gradient: bool):
     shm = shared_memory.SharedMemory(name=shm_name)
     try:
-        arr = np.ndarray(shape, dtype=np.dtype(dtype_str),
+        arr = np.ndarray(shape, dtype=_np_dtype(dtype_str),
                          buffer=shm.buf).copy()
     finally:
         shm.close()
@@ -61,7 +71,7 @@ def _reduce_tensor(t: Tensor):
         old = _LIVE_SEGMENTS.pop(0)
         old.close()
     return (_rebuild_tensor_from_shm,
-            (shm.name, arr.shape, arr.dtype.str, t.stop_gradient))
+            (shm.name, arr.shape, arr.dtype.name, t.stop_gradient))
 
 
 def init_reductions() -> None:

@@ -43,6 +43,10 @@ class Imdb(Dataset):
         if mode not in ("train", "test"):
             raise ValueError(f"mode must be train|test, got {mode!r}")
         self.mode = mode
+        # vocabulary spans BOTH splits (imdb.py build_dict scans
+        # train|test) so train/test instances share word ids; `cutoff` is a
+        # minimum-frequency threshold, not a vocab size
+        vocab_pat = re.compile(r"aclImdb/(train|test)/(pos|neg)/.*\.txt$")
         pat = re.compile(rf"aclImdb/{mode}/(pos|neg)/.*\.txt$")
         tokenize = re.compile(r"[A-Za-z0-9']+")
         texts: List[List[str]] = []
@@ -50,17 +54,19 @@ class Imdb(Dataset):
         counter: Counter = Counter()
         with tarfile.open(data_file, "r:*") as tf:
             for member in tf.getmembers():
-                m = pat.search(member.name)
-                if not m:
+                vm = vocab_pat.search(member.name)
+                if not vm:
                     continue
                 words = tokenize.findall(
                     tf.extractfile(member).read().decode(
                         "utf-8", "ignore").lower())
-                texts.append(words)
-                labels.append(0 if m.group(1) == "neg" else 1)
                 counter.update(words)
-        # vocab: most frequent first, cut at `cutoff`, <unk> = last id
-        vocab_words = [w for w, _ in counter.most_common(cutoff - 1)]
+                m = pat.search(member.name)
+                if m:
+                    texts.append(words)
+                    labels.append(0 if m.group(1) == "neg" else 1)
+        # frequency-sorted vocab above the cutoff, <unk> = last id
+        vocab_words = [w for w, c in counter.most_common() if c > cutoff]
         self.word_idx: Dict[str, int] = {w: i for i, w in
                                          enumerate(vocab_words)}
         self.word_idx["<unk>"] = len(self.word_idx)
@@ -87,8 +93,9 @@ class Imikolov(Dataset):
         data_file = _require(data_file, "Imikolov")
         if data_type not in ("NGRAM", "SEQ"):
             raise ValueError("data_type must be NGRAM or SEQ")
-        split = {"train": "train", "test": "valid"}[
-            "train" if mode == "train" else "test"]
+        if mode not in ("train", "test"):
+            raise ValueError(f"mode must be train|test, got {mode!r}")
+        split = {"train": "train", "test": "valid"}[mode]
         with tarfile.open(data_file, "r:*") as tf:
             train_lines = self._lines(tf, "ptb.train.txt")
             lines = train_lines if split == "train" else \

@@ -59,7 +59,33 @@ SURFACE = {
         "ColumnParallelLinear", "RowParallelLinear", "VocabParallelEmbedding",
         "ParallelCrossEntropy", "get_rng_state_tracker"],
     "paddle_tpu.distributed.fleet.elastic": ["ElasticManager", "ElasticLevel"],
-    "paddle_tpu.distributed.auto_parallel": ["Engine", "Strategy"],
+    "paddle_tpu.distributed.auto_parallel": ["Engine", "Strategy", "Cluster",
+                                             "CostModel", "Planner",
+                                             "WorkloadSpec", "PlanConfig"],
+    # actor runtime + parameter server + serving
+    "paddle_tpu.distributed.fleet_executor": [
+        "FleetExecutor", "RuntimeGraph", "Carrier", "MessageBus", "TaskNode",
+        "ComputeInterceptor", "AmplifierInterceptor"],
+    "paddle_tpu.distributed.ps": ["PsServer", "PsClient", "TheOnePS",
+                                  "SparseEmbedding", "SparseTable",
+                                  "DenseTable", "sgd_rule"],
+    "paddle_tpu.inference.dist_model": ["DistModel", "DistModelConfig"],
+    # dy2static transpiler
+    "paddle_tpu.jit.dy2static": ["convert_to_static", "convert_ifelse",
+                                 "convert_while_loop", "convert_logical_and"],
+    # fleet datasets / metrics / strategy meta optimizers
+    "paddle_tpu.distributed.fleet.dataset": ["InMemoryDataset",
+                                             "QueueDataset", "DatasetBase"],
+    "paddle_tpu.distributed.fleet.metrics": ["auc", "acc", "mae", "rmse",
+                                             "local_auc_buckets"],
+    "paddle_tpu.distributed.fleet.meta_optimizers": [
+        "GradientMergeOptimizer", "LocalSGDOptimizer", "DGCOptimizer",
+        "FP16AllReduceOptimizer", "apply_meta_optimizers"],
+    # text datasets + tensor IPC
+    "paddle_tpu.text.datasets": ["Imdb", "Imikolov", "UCIHousing",
+                                 "Movielens"],
+    "paddle_tpu.incubate.multiprocessing": ["Queue", "Process",
+                                            "init_reductions"],
     # kernels
     "paddle_tpu.kernels.flash_attention": ["flash_attention_bthd"],
     "paddle_tpu.kernels.ring_attention": [],

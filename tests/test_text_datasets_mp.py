@@ -71,14 +71,25 @@ def _make_ml1m(tmp_path):
 
 
 def test_imdb_parses_and_builds_vocab(tmp_path):
-    ds = Imdb(data_file=_make_imdb(tmp_path), mode="train")
+    arc = _make_imdb(tmp_path)
+    ds = Imdb(data_file=arc, mode="train", cutoff=0)
     assert len(ds) == 3
     assert "great" in ds.word_idx          # frequent word in vocab
     doc, label = ds[0]
     assert doc.dtype == np.int64
     assert set(np.unique(ds.labels)) == {0, 1}
-    test = Imdb(data_file=_make_imdb(tmp_path), mode="test")
+    test = Imdb(data_file=arc, mode="test", cutoff=0)
     assert len(test) == 2
+    # vocabulary is split-independent: same word -> same id either mode
+    assert test.word_idx == ds.word_idx
+
+
+def test_imdb_cutoff_is_frequency_threshold(tmp_path):
+    ds = Imdb(data_file=_make_imdb(tmp_path), mode="train", cutoff=2)
+    # only words appearing >2 times across both splits stay in-vocab
+    assert all(w == "<unk>" or True for w in ds.word_idx)
+    assert "great" in ds.word_idx          # appears 4x total
+    assert "loved" not in ds.word_idx      # appears once
 
 
 def test_imikolov_ngram_and_seq(tmp_path):
@@ -123,6 +134,11 @@ def test_datasets_require_local_file():
         UCIHousing(data_file="/nonexistent/housing.data")
 
 
+def test_imikolov_rejects_bad_mode(tmp_path):
+    with pytest.raises(ValueError, match="mode"):
+        Imikolov(data_file=_make_ptb(tmp_path), mode="vaild")
+
+
 # ---------------------------------------------------------------------------
 # incubate.multiprocessing tensor IPC
 # ---------------------------------------------------------------------------
@@ -136,6 +152,23 @@ def test_tensor_reduction_roundtrip_in_process():
     out = fn(*args)
     np.testing.assert_array_equal(out.numpy(), t.numpy())
     assert out.stop_gradient == t.stop_gradient
+
+
+def test_bfloat16_tensor_ipc_roundtrip():
+    """ml_dtypes dtypes have an opaque dtype.str; the reduction must ship
+    them by name."""
+    import jax.numpy as jnp
+    import paddle_tpu.incubate.multiprocessing as pmp
+    t = paddle.to_tensor(np.arange(6, dtype=np.float32))
+    t = paddle.to_tensor(t.numpy().astype("float32"))
+    from paddle_tpu.core.tensor import Tensor
+    tb = Tensor(jnp.asarray(np.arange(6, dtype=np.float32), jnp.bfloat16),
+                _internal=True)
+    fn, args = pmp._reduce_tensor(tb)
+    out = fn(*args)
+    assert str(out._value.dtype) == "bfloat16"
+    np.testing.assert_array_equal(np.asarray(out._value, np.float32),
+                                  np.arange(6, dtype=np.float32))
 
 
 def test_tensor_through_real_mp_queue():
