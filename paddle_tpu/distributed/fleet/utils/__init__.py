@@ -1,2 +1,5 @@
 from .recompute import recompute, recompute_sequential  # noqa: F401
 from . import hybrid_parallel_util  # noqa: F401
+from .hybrid_parallel_inference import (  # noqa: F401
+    HybridParallelInferenceHelper,
+)

@@ -167,3 +167,26 @@ def grad(func, xs, v=None):
     """Functional reverse grad of `func` at xs (primapi.grad parity)."""
     _, g = vjp(func, xs, v)
     return g
+
+
+# -- prim-system toggles ------------------------------------------------------
+# Reference: primapi/primx enable_prim()/disable_prim()/prim_enabled() switch
+# static autodiff onto primitive-op lowering (orig2prim/prim2orig program
+# passes).  jax traces through composable primitives ALWAYS, so the toggle
+# holds state for API parity and reporting only.
+_prim_enabled = [False]
+
+
+def enable_prim():
+    _prim_enabled[0] = True
+
+
+def disable_prim():
+    _prim_enabled[0] = False
+
+
+def prim_enabled() -> bool:
+    return _prim_enabled[0]
+
+
+__all__ += ["enable_prim", "disable_prim", "prim_enabled"]

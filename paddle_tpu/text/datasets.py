@@ -65,8 +65,11 @@ class Imdb(Dataset):
                 if m:
                     texts.append(words)
                     labels.append(0 if m.group(1) == "neg" else 1)
-        # frequency-sorted vocab above the cutoff, <unk> = last id
-        vocab_words = [w for w, c in counter.most_common() if c > cutoff]
+        # frequency-sorted vocab above the cutoff (alphabetical on ties,
+        # matching the reference's (-count, word) sort), <unk> = last id
+        vocab_words = [w for w, c in sorted(counter.items(),
+                                            key=lambda kv: (-kv[1], kv[0]))
+                       if c > cutoff]
         self.word_idx: Dict[str, int] = {w: i for i, w in
                                          enumerate(vocab_words)}
         self.word_idx["<unk>"] = len(self.word_idx)

@@ -32,6 +32,8 @@ def _ref(q, k, v, causal, scale):
     (2, 300, 300, 64, True),      # padding path
     (1, 1, 129, 32, True),        # cached single-token decode (offset)
     (2, 128, 128, 64, False),
+    (1, 1100, 1100, 64, True),    # > 1024: multi-block online-softmax
+    (1, 1100, 1100, 64, False),   # ... and the split backward kernels
 ])
 def test_flash_forward_and_grad(bh, tq, tk, d, causal):
     rs = np.random.RandomState(0)

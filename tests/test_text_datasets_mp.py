@@ -87,8 +87,7 @@ def test_imdb_parses_and_builds_vocab(tmp_path):
 def test_imdb_cutoff_is_frequency_threshold(tmp_path):
     ds = Imdb(data_file=_make_imdb(tmp_path), mode="train", cutoff=2)
     # only words appearing >2 times across both splits stay in-vocab
-    assert all(w == "<unk>" or True for w in ds.word_idx)
-    assert "great" in ds.word_idx          # appears 4x total
+    assert set(ds.word_idx) == {"great", "terrible", "<unk>"}
     assert "loved" not in ds.word_idx      # appears once
 
 
@@ -159,8 +158,6 @@ def test_bfloat16_tensor_ipc_roundtrip():
     them by name."""
     import jax.numpy as jnp
     import paddle_tpu.incubate.multiprocessing as pmp
-    t = paddle.to_tensor(np.arange(6, dtype=np.float32))
-    t = paddle.to_tensor(t.numpy().astype("float32"))
     from paddle_tpu.core.tensor import Tensor
     tb = Tensor(jnp.asarray(np.arange(6, dtype=np.float32), jnp.bfloat16),
                 _internal=True)
