@@ -82,7 +82,7 @@ class HybridParallelInferenceHelper:
             return logits.argmax(axis=-1)
         logits = logits / max(temperature, 1e-6)
         if top_k:
-            kth = np.partition(logits, -top_k, axis=-1)[:, -top_k:-top_k + 1]
+            kth = np.partition(logits, -top_k, axis=-1)[:, [-top_k]]
             logits = np.where(logits < kth, -1e30, logits)
         logits = logits - logits.max(axis=-1, keepdims=True)
         p = np.exp(logits)
@@ -107,7 +107,8 @@ class HybridParallelInferenceHelper:
             ids = np.asarray(
                 input_ids._value if isinstance(input_ids, Tensor)
                 else input_ids).astype(np.int64)
-            n_new = max_new_tokens or self.max_length
+            n_new = self.max_length if max_new_tokens is None \
+                else max_new_tokens
             values = state_values(self.model)
             rng = np.random.RandomState(seed)
 

@@ -1,0 +1,151 @@
+"""Tree index for retrieval models (TDM-style).
+
+Reference: paddle/fluid/distributed/index_dataset/ (index_wrapper.cc
+TreeIndex loaded from a proto of TreeNodes; index_sampler.cc
+LayerWiseSampler producing per-layer negative samples) and the python
+wrapper python/paddle/distributed/fleet/dataset/index_dataset.py.
+
+Host-side rebuild: the tree is a complete k-ary tree over item ids
+(leaves), built by recursive (or caller-provided) clustering order;
+`LayerWiseSampler` draws, for each positive item, its ancestor path plus
+uniform negatives per layer — the batch the TDM matching network trains
+on.  The TPU only ever sees the dense sampled id/label arrays.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["TreeIndex", "LayerWiseSampler"]
+
+
+class TreeIndex:
+    """Complete k-ary tree over item ids.
+
+    Leaves hold the item ids in the given order (callers pre-sort by
+    cluster affinity, as the reference's tree-building tools do); internal
+    nodes get fresh codes.  Node code scheme matches the reference's
+    breadth-first layout: root = 0, children of c = c*k+1 .. c*k+k.
+    """
+
+    def __init__(self, item_ids: Sequence[int], branch: int = 2):
+        if branch < 2:
+            raise ValueError("branch must be >= 2")
+        self.branch = int(branch)
+        self.item_ids = np.asarray(list(item_ids), np.int64)
+        n = len(self.item_ids)
+        if n == 0:
+            raise ValueError("TreeIndex needs at least one item")
+        # depth of the complete tree holding n leaves
+        depth = 0
+        while branch ** depth < n:
+            depth += 1
+        self.height = depth + 1           # layers incl. root
+        self._leaf_start = (branch ** depth - 1) // (branch - 1)
+        # leaf slot -> item id (dense complete layer; missing slots = -1)
+        self._leaf_codes = self._leaf_start + np.arange(n)
+        self._code_of: Dict[int, int] = {
+            int(i): int(c) for i, c in zip(self.item_ids, self._leaf_codes)}
+
+    # -- queries (index_wrapper.cc surface) -----------------------------------
+    def total_node_nums(self) -> int:
+        return self._leaf_start + len(self.item_ids)
+
+    def emb_size(self) -> int:
+        return self.total_node_nums()
+
+    def get_nodes_given_level(self, level: int) -> np.ndarray:
+        """Codes of layer `level` (root = level 0) that have descendants."""
+        if not 0 <= level < self.height:
+            raise ValueError(f"level {level} out of [0, {self.height})")
+        ancestors = self.ancestor_codes(self._leaf_codes, level)
+        return np.asarray(sorted({int(c) for c in ancestors}), np.int64)
+
+    def ancestor_codes(self, codes: np.ndarray, level: int) -> np.ndarray:
+        """Ancestor at layer `level` for each node code."""
+        codes = np.asarray(codes, np.int64)
+        out = codes.copy()
+        # walk up until the ancestor layer is reached
+        def layer_of(c):
+            lvl = 0
+            first = 0
+            while not (first <= c < first + self.branch ** lvl):
+                first += self.branch ** lvl
+                lvl += 1
+            return lvl
+        for idx, c in enumerate(codes):
+            lvl = layer_of(int(c))
+            cc = int(c)
+            while lvl > level:
+                cc = (cc - 1) // self.branch
+                lvl -= 1
+            out[idx] = cc
+        return out
+
+    def get_travel_codes(self, item_id: int) -> List[int]:
+        """Leaf-to-root ancestor path of an item (index_wrapper GetTravel)."""
+        code = self._code_of.get(int(item_id))
+        if code is None:
+            raise KeyError(f"item {item_id} not in tree")
+        path = [code]
+        while code > 0:
+            code = (code - 1) // self.branch
+            path.append(code)
+        return path
+
+    def get_children_codes(self, code: int) -> List[int]:
+        first = code * self.branch + 1
+        return [c for c in range(first, first + self.branch)
+                if c < self.total_node_nums()]
+
+
+class LayerWiseSampler:
+    """index_sampler.cc LayerWiseSampler: for each (user, positive item)
+    pair emit, per tree layer, the positive ancestor (label 1) and
+    `layer_counts[i]` uniform negatives (label 0) from the same layer."""
+
+    def __init__(self, tree: TreeIndex,
+                 layer_counts: Optional[Sequence[int]] = None,
+                 seed: int = 0, start_level: int = 1):
+        self.tree = tree
+        self.start_level = max(1, int(start_level))
+        n_layers = tree.height - self.start_level
+        if layer_counts is None:
+            layer_counts = [1] * n_layers
+        if len(layer_counts) != n_layers:
+            raise ValueError(
+                f"layer_counts needs {n_layers} entries "
+                f"(levels {self.start_level}..{tree.height - 1}), got "
+                f"{len(layer_counts)}")
+        self.layer_counts = [int(c) for c in layer_counts]
+        self._rng = np.random.RandomState(seed)
+
+    def sample(self, user_feats: np.ndarray, item_ids: Sequence[int]):
+        """Returns (user_rows, node_codes, labels) int64 arrays, one row
+        per emitted (positive|negative) sample."""
+        users, codes, labels = [], [], []
+        # layer node sets are item-independent: compute once per call, not
+        # per (item, layer) — get_nodes_given_level walks every leaf
+        layer_nodes = {lvl: self.tree.get_nodes_given_level(lvl)
+                       for lvl in range(self.start_level, self.tree.height)}
+        for row, item in zip(np.asarray(user_feats), item_ids):
+            path = self.tree.get_travel_codes(int(item))
+            # path is leaf..root; walk layers start_level..height-1
+            for depth_i, level in enumerate(
+                    range(self.start_level, self.tree.height)):
+                pos_code = path[self.tree.height - 1 - level]
+                users.append(row)
+                codes.append(pos_code)
+                labels.append(1)
+                layer = layer_nodes[level]
+                neg_pool = layer[layer != pos_code]
+                k = min(self.layer_counts[depth_i], len(neg_pool))
+                if k > 0:
+                    for c in self._rng.choice(neg_pool, size=k,
+                                              replace=False):
+                        users.append(row)
+                        codes.append(int(c))
+                        labels.append(0)
+        return (np.asarray(users), np.asarray(codes, np.int64),
+                np.asarray(labels, np.int64))

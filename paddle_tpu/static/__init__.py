@@ -246,14 +246,7 @@ def load_inference_model(path_prefix, executor, **kwargs):
 from .. import amp  # noqa: E402,F401
 
 
-# nn facade for static users (conv/fc built on the dygraph layers)
-class _StaticNN:
-    @staticmethod
-    def fc(x, size, **kw):
-        raise NotImplementedError(
-            "static.nn append-op builders are not reproduced; build models "
-            "with paddle_tpu.nn layers and trace via build_program/to_static "
-            "(SURVEY §7: legacy fluid op system intentionally dropped)")
-
-
-nn = _StaticNN()
+# static.nn lives in its own submodule (sparse_embedding is real; the
+# append-op builders raise with guidance) — bind it here so
+# `paddle.static.nn` and `from paddle_tpu.static import nn` agree
+from . import nn  # noqa: E402,F401

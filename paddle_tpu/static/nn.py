@@ -1,0 +1,51 @@
+"""paddle.static.nn facade — the few builders with framework-level
+mechanisms behind them.
+
+Reference: python/paddle/static/nn/__init__.py exposes append-op builders
+(fc, conv2d, ...); those are intentionally not reproduced (SURVEY §7:
+build models with paddle.nn under to_static/Program tracing instead).
+What IS here:
+
+* `sparse_embedding` — the PS-backed lookup (reference static.nn.
+  sparse_embedding -> distributed_lookup_table op, pscore/
+  distributed_lookup_table_op.cc), routed to distributed.ps.
+* `embedding`, `fc` — thin functional conveniences over paddle.nn layers
+  for scripts ported from static-graph recipes.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+__all__ = ["sparse_embedding", "embedding", "fc"]
+
+
+def sparse_embedding(input, size, padding_idx=None, is_test=False,
+                     entry=None, table_name: str = "embedding",
+                     param_attr=None, dtype: str = "float32"):
+    """PS-backed sparse lookup (static.nn.sparse_embedding parity): rows
+    live on the parameter servers; forward pulls, backward pushes.  Needs
+    an initialized PS worker (TheOnePS.init_worker)."""
+    from ..distributed.ps import SparseEmbedding
+
+    layer = SparseEmbedding(table_name, int(size[-1]), dtype=dtype)
+    if is_test:
+        layer.eval()
+    return layer(input)
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None,
+              param_attr=None, dtype: str = "float32"):
+    raise NotImplementedError(
+        "static.nn append-op builders are not reproduced: a per-call layer "
+        "would re-initialize its weights every step (no persistable Program "
+        "parameters here). Build models with paddle_tpu.nn.Embedding and "
+        "trace via build_program/to_static (SURVEY §7).")
+
+
+def fc(x, size: int, num_flatten_dims: int = 1,
+       activation: Optional[str] = None, name: Optional[str] = None):
+    raise NotImplementedError(
+        "static.nn append-op builders are not reproduced: a per-call layer "
+        "would re-initialize its weights every step (no persistable Program "
+        "parameters here). Build models with paddle_tpu.nn.Linear and "
+        "trace via build_program/to_static (SURVEY §7).")

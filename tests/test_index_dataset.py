@@ -1,0 +1,92 @@
+"""TreeIndex / LayerWiseSampler tests (reference pattern:
+fluid/tests/unittests/test_index_dataset.py builds a small tree and
+checks travel paths, layer nodes and sampler output shapes)."""
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.index_dataset import LayerWiseSampler, TreeIndex
+
+
+def test_tree_structure_binary():
+    tree = TreeIndex(item_ids=[10, 11, 12, 13], branch=2)
+    assert tree.height == 3                 # 4 leaves -> depth 2
+    assert tree.total_node_nums() == 3 + 4  # internal 3 + leaves
+    # leaf-to-root path of first item: leaf 3 -> 1 -> 0
+    assert tree.get_travel_codes(10) == [3, 1, 0]
+    assert tree.get_travel_codes(13) == [6, 2, 0]
+    with pytest.raises(KeyError):
+        tree.get_travel_codes(99)
+
+
+def test_layer_nodes_and_children():
+    tree = TreeIndex(item_ids=list(range(8)), branch=2)
+    np.testing.assert_array_equal(tree.get_nodes_given_level(0), [0])
+    np.testing.assert_array_equal(tree.get_nodes_given_level(1), [1, 2])
+    assert tree.get_children_codes(0) == [1, 2]
+
+
+def test_ancestor_codes():
+    tree = TreeIndex(item_ids=list(range(8)), branch=2)
+    leaves = np.array([7, 8, 13, 14])       # layer-3 codes
+    np.testing.assert_array_equal(tree.ancestor_codes(leaves, 1),
+                                  [1, 1, 2, 2])
+
+
+def test_incomplete_leaf_layer():
+    tree = TreeIndex(item_ids=[1, 2, 3, 4, 5], branch=2)  # 5 leaves, depth 3
+    assert tree.height == 4
+    # all travel paths end at root and start at distinct leaf codes
+    paths = [tree.get_travel_codes(i) for i in (1, 2, 3, 4, 5)]
+    assert len({p[0] for p in paths}) == 5
+    assert all(p[-1] == 0 for p in paths)
+
+
+def test_layerwise_sampler_labels_and_counts():
+    tree = TreeIndex(item_ids=list(range(16)), branch=2)
+    sampler = LayerWiseSampler(tree, layer_counts=[1, 2, 2, 3], seed=0)
+    users = np.arange(3)[:, None]           # 3 "users" with 1 feature
+    items = [0, 5, 9]
+    u, codes, labels = sampler.sample(users, items)
+    # per pair: sum over layers of (1 positive + negatives)
+    per_pair = sum(1 + c for c in [1, 2, 2, 3])
+    assert len(u) == len(codes) == len(labels) == 3 * per_pair
+    assert labels.sum() == 3 * 4            # one positive per layer
+    # positives are exactly the ancestor paths
+    for row in range(3):
+        lo = row * per_pair
+        pos_codes = codes[lo:lo + per_pair][labels[lo:lo + per_pair] == 1]
+        path = tree.get_travel_codes(items[row])
+        np.testing.assert_array_equal(
+            sorted(pos_codes), sorted(path[:-1]))
+
+
+def test_sampler_validates_layer_counts():
+    tree = TreeIndex(item_ids=list(range(4)), branch=2)
+    with pytest.raises(ValueError, match="layer_counts"):
+        LayerWiseSampler(tree, layer_counts=[1])
+
+
+def test_static_nn_sparse_embedding_routes_to_ps():
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.ps import PsServer, TheOnePS
+    from paddle_tpu.static.nn import sparse_embedding
+
+    s = PsServer(server_idx=0)
+    s.add_sparse_table("embedding", 8, rule="naive")
+    s.run()
+
+    class Role:
+        def get_pserver_endpoints(self):
+            return [s.endpoint]
+
+        def server_index(self):
+            return 0
+
+    ps = TheOnePS(role_maker=Role())
+    ps.init_worker(endpoints=[s.endpoint])
+    try:
+        out = sparse_embedding(paddle.to_tensor(np.array([1, 2])),
+                               size=[100, 8])
+        assert tuple(out.shape) == (2, 8)
+    finally:
+        ps.stop()
