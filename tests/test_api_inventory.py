@@ -70,6 +70,10 @@ SURFACE = {
                                   "SparseEmbedding", "SparseTable",
                                   "DenseTable", "sgd_rule"],
     "paddle_tpu.inference.dist_model": ["DistModel", "DistModelConfig"],
+    "paddle_tpu.distributed.index_dataset": ["TreeIndex", "LayerWiseSampler"],
+    "paddle_tpu.distributed.fleet.utils": ["HybridParallelInferenceHelper",
+                                           "recompute"],
+    "paddle_tpu.static.nn": ["sparse_embedding"],
     # dy2static transpiler
     "paddle_tpu.jit.dy2static": ["convert_to_static", "convert_ifelse",
                                  "convert_while_loop", "convert_logical_and"],
