@@ -9,3 +9,4 @@ from .mnist import MNIST, FashionMNIST  # noqa: F401
 from .cifar import Cifar10, Cifar100  # noqa: F401
 from .fake import FakeData  # noqa: F401
 from .flowers import Flowers  # noqa: F401
+from .voc2012 import VOC2012  # noqa: F401

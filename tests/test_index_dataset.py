@@ -90,3 +90,4 @@ def test_static_nn_sparse_embedding_routes_to_ps():
         assert tuple(out.shape) == (2, 8)
     finally:
         ps.stop()
+

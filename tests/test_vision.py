@@ -207,3 +207,50 @@ def test_dataset_errors():
         MNIST(image_path="/nonexistent", label_path="/nonexistent")
     with pytest.raises(ValueError):
         Cifar10()
+
+
+def test_voc2012_parses_local_archive(tmp_path):
+    """VOC2012 indexes the VOCtrainval tar layout and decodes image/mask
+    pairs (voc2012.py parity, local archive)."""
+    import io as _io
+    import tarfile
+    from PIL import Image
+
+    from paddle_tpu.vision.datasets import VOC2012
+
+    arc = tmp_path / "VOCtrainval_11-May-2012.tar"
+    root = "VOCdevkit/VOC2012/"
+    with tarfile.open(arc, "w") as tf:
+        def add(name, data):
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tf.addfile(info, _io.BytesIO(data))
+
+        def png(arr):
+            buf = _io.BytesIO()
+            Image.fromarray(arr).save(buf, format="PNG")
+            return buf.getvalue()
+
+        def jpg(arr):
+            buf = _io.BytesIO()
+            Image.fromarray(arr).save(buf, format="JPEG")
+            return buf.getvalue()
+
+        rng = np.random.RandomState(0)
+        for name in ("2007_000032", "2007_000033"):
+            add(f"{root}JPEGImages/{name}.jpg",
+                jpg(rng.randint(0, 255, (32, 48, 3), dtype=np.uint8)))
+            add(f"{root}SegmentationClass/{name}.png",
+                png(rng.randint(0, 20, (32, 48), dtype=np.uint8)))
+        add(f"{root}ImageSets/Segmentation/train.txt",
+            b"2007_000032\n2007_000033\n")
+        add(f"{root}ImageSets/Segmentation/val.txt", b"2007_000033\n")
+
+    train = VOC2012(data_file=str(arc), mode="train")
+    assert len(train) == 2
+    img, seg = train[0]
+    assert img.shape == (32, 48, 3) and seg.shape == (32, 48)
+    val = VOC2012(data_file=str(arc), mode="valid")
+    assert len(val) == 1
+    with pytest.raises(ValueError, match="mode"):
+        VOC2012(data_file=str(arc), mode="bogus")
