@@ -73,3 +73,53 @@ def global_mesh(mesh: Mesh):
         yield mesh
     finally:
         set_global_mesh(prev)
+
+
+def build_hybrid_mesh(dcn_shape: Sequence[int], ici_shape: Sequence[int],
+                      axis_names: Sequence[str], devices=None) -> Mesh:
+    """Multi-slice mesh: outer axes span slices over DCN, inner axes stay
+    inside a slice on ICI — the reference's two-level ProcessGroupHeter
+    topology (ProcessGroupHeter.h:128-134 `inner_pg_` NCCL intra-node +
+    `inter_pg_` cross-node, SURVEY §5.8).
+
+    `dcn_shape` sizes the outer (cross-slice) axes, `ici_shape` the inner
+    ones; `axis_names` covers both in order.  On real multi-slice TPU
+    hardware devices are grouped by `slice_index` so each DCN coordinate
+    is one slice; elsewhere (single slice, CPU sim) the grouping falls
+    back to contiguous blocks — same program, laxer physical locality.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    dcn_shape, ici_shape = list(dcn_shape), list(ici_shape)
+    if len(dcn_shape) + len(ici_shape) != len(axis_names):
+        raise ValueError(
+            f"axis_names {list(axis_names)} must cover dcn {dcn_shape} + "
+            f"ici {ici_shape}")
+    n_slices = int(np.prod(dcn_shape))
+    per_slice = int(np.prod(ici_shape))
+    if n_slices * per_slice > len(devices):
+        raise ValueError(
+            f"hybrid mesh needs {n_slices}x{per_slice} devices, have "
+            f"{len(devices)}")
+    slice_ids = {getattr(d, "slice_index", None) for d in devices}
+    multi_slice = len(slice_ids - {None}) > 1
+    if multi_slice:
+        if n_slices * per_slice != len(devices):
+            raise ValueError(
+                f"multi-slice hybrid mesh must use every device: "
+                f"{n_slices}x{per_slice} != {len(devices)}")
+        from jax.experimental import mesh_utils
+
+        # create_hybrid_device_mesh takes PER-AXIS (ici, dcn) factors of
+        # equal rank; model "outer dcn axes + inner ici axes" as dcn
+        # factors on the leading axes and ici factors on the trailing ones
+        mesh_shape = [1] * len(dcn_shape) + ici_shape
+        dcn_factors = dcn_shape + [1] * len(ici_shape)
+        arr = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape, dcn_factors, devices=devices)
+        arr = arr.reshape(dcn_shape + ici_shape)
+    else:
+        # single slice (or CPU sim): contiguous blocks — same program,
+        # laxer physical locality
+        arr = np.array(devices[:n_slices * per_slice]).reshape(
+            dcn_shape + ici_shape)
+    return Mesh(arr, axis_names=tuple(axis_names))

@@ -1,0 +1,57 @@
+"""build_hybrid_mesh + op-bench tooling tests (reference pattern:
+ProcessGroupHeter topology tests and the tools/ CI-gate scripts)."""
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from paddle_tpu.distributed.mesh import build_hybrid_mesh
+
+
+def test_hybrid_mesh_axes_and_compute():
+    m = build_hybrid_mesh([2], [2, 2], ["dcn_data", "data", "model"])
+    assert m.axis_names == ("dcn_data", "data", "model")
+    assert m.devices.shape == (2, 2, 2)
+    x = jnp.arange(64.0).reshape(8, 8)
+    sharded = jax.device_put(
+        x, NamedSharding(m, P(("dcn_data", "data"), "model")))
+    out = jax.jit(lambda v: (v * 3).sum())(sharded)
+    np.testing.assert_allclose(float(out), x.sum() * 3)
+
+
+def test_hybrid_mesh_validates_shapes():
+    with pytest.raises(ValueError, match="axis_names"):
+        build_hybrid_mesh([2], [2, 2], ["a", "b"])
+    with pytest.raises(ValueError, match="devices"):
+        build_hybrid_mesh([4], [4], ["a", "b"])
+
+
+def test_op_bench_and_regression_gate(tmp_path):
+    """op_bench emits JSON rows; the gate passes on identical runs and
+    fails on an injected slowdown (check_op_benchmark_result contract)."""
+    from tools.op_bench import bench_op
+
+    us = bench_op(lambda a: a * 2.0, (jnp.ones((64, 64)),), iters=3)
+    assert us > 0
+
+    base = [{"op": "matmul", "config": "c", "speed_us": 100.0,
+             "device": "cpu"}]
+    head_ok = [{"op": "matmul", "config": "c", "speed_us": 105.0,
+                "device": "cpu"}]
+    head_bad = [{"op": "matmul", "config": "c", "speed_us": 200.0,
+                 "device": "cpu"}]
+    paths = {}
+    for name, rows in [("base", base), ("ok", head_ok), ("bad", head_bad)]:
+        p = tmp_path / f"{name}.json"
+        p.write_text(json.dumps(rows))
+        paths[name] = str(p)
+
+    from tools.check_op_benchmark_result import main as gate
+    assert gate([paths["base"], paths["ok"], "--threshold", "0.15"]) == 0
+    assert gate([paths["base"], paths["bad"], "--threshold", "0.15"]) == 1

@@ -1,0 +1,64 @@
+"""Per-op latency regression gate.
+
+Reference: tools/check_op_benchmark_result.py (parses "Speed" logs from
+the op benchmark, fails CI when an op slows down beyond a relative
+threshold).
+
+Usage:
+    python tools/op_bench.py --output base.json     # on the baseline tree
+    python tools/op_bench.py --output head.json     # on the change
+    python tools/check_op_benchmark_result.py base.json head.json \
+        --threshold 0.15
+Exit 0 = no regression beyond threshold; exit 1 lists offenders.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def load(path: str) -> dict:
+    with open(path) as f:
+        rows = json.load(f)
+    return {f"{r['op']}/{r['config']}": r for r in rows}
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("baseline")
+    parser.add_argument("head")
+    parser.add_argument("--threshold", type=float, default=0.15,
+                        help="max allowed relative slowdown (0.15 = +15%)")
+    args = parser.parse_args(argv)
+
+    base, head = load(args.baseline), load(args.head)
+    failures = []
+    for key, b in sorted(base.items()):
+        h = head.get(key)
+        if h is None:
+            failures.append(f"{key}: missing from head run")
+            continue
+        if "error" in h and "error" not in b:
+            failures.append(f"{key}: now errors: {h['error']}")
+            continue
+        if "speed_us" not in b or "speed_us" not in h:
+            continue
+        rel = (h["speed_us"] - b["speed_us"]) / max(b["speed_us"], 1e-9)
+        status = "OK" if rel <= args.threshold else "REGRESSED"
+        print(f"[{status}] {key}: {b['speed_us']:.1f}us -> "
+              f"{h['speed_us']:.1f}us ({rel * 100:+.1f}%)")
+        if rel > args.threshold:
+            failures.append(
+                f"{key}: {rel * 100:+.1f}% (> {args.threshold * 100:.0f}%)")
+    if failures:
+        print("\nFAILED:", file=sys.stderr)
+        for f in failures:
+            print(f"  {f}", file=sys.stderr)
+        return 1
+    print(f"\nall {len(base)} ops within +{args.threshold * 100:.0f}%")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
