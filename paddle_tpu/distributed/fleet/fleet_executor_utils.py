@@ -1,0 +1,87 @@
+"""fleet_executor_utils — build actor-runtime task graphs from fleet
+pipeline models.
+
+Reference: python/paddle/distributed/fleet/fleet_executor_utils.py
+(TaskNode/FleetExecutorUtils convert a sectioned Program into the
+fleet_executor's runtime graph: one compute task per pipeline stage plus
+the amplifier-style scheduling attributes).
+
+TPU-native: a `PipelineLayer` already knows its stage segmentation; each
+stage's `forward_stage` becomes one ComputeInterceptor program (an XLA
+computation per micro-batch), chained source -> stages -> sink with
+credit-based double buffering.  This is the HOST-level pipeline (cross
+process over the socket bus when `ranks`/`store` are given); inside a
+chip slice the compiled GPipe/1F1B schedule (distributed/pipeline.py)
+remains the fast path.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+from ...core.tensor import Tensor
+from ..fleet_executor import FleetExecutor
+
+__all__ = ["build_pipeline_fleet_executor", "run_pipeline_micro_batches"]
+
+
+def _stage_fn(pipeline_layer, stage_id: int) -> Callable:
+    def run(x):
+        t = x if isinstance(x, Tensor) else Tensor(x)
+        out = pipeline_layer.forward_stage(t, stage_id)
+        return out
+    return run
+
+
+def build_pipeline_fleet_executor(
+        pipeline_layer, num_micro_batches: int,
+        feed_fn: Callable, loss_fn: Optional[Callable] = None,
+        labels_fn: Optional[Callable] = None, buff_size: int = 2,
+        ranks: Optional[Sequence[int]] = None, rank: int = 0,
+        store=None, nranks: int = 1) -> FleetExecutor:
+    """One compute task per pipeline stage (the reference utils' per-
+    section task nodes).  `feed_fn(i)` supplies micro-batch i; when
+    `loss_fn` is given the sink computes loss_fn(out, labels_fn(i))."""
+    n_stages = pipeline_layer._num_stages
+    stages = [_stage_fn(pipeline_layer, s) for s in range(n_stages)]
+
+    collect = None
+    if loss_fn is not None:
+        if labels_fn is None:
+            raise ValueError("loss_fn needs labels_fn(micro_idx)")
+        counter = [0]
+
+        def collect(out):  # noqa: F811 - sink program
+            i = counter[0]
+            counter[0] += 1
+            y = labels_fn(i % num_micro_batches)
+            y = y if isinstance(y, Tensor) else Tensor(y)
+            return loss_fn(out, y)
+
+    stage_ranks = list(ranks) if ranks is not None else None
+    return FleetExecutor.from_stages(
+        stages, num_micro_batches=num_micro_batches, feed_fn=feed_fn,
+        collect_fn=collect, buff_size=buff_size, ranks=stage_ranks,
+        rank=rank, store=store, nranks=nranks)
+
+
+def run_pipeline_micro_batches(pipeline_layer, micro_batches: Sequence,
+                               loss_fn: Optional[Callable] = None,
+                               labels: Optional[Sequence] = None,
+                               buff_size: int = 2) -> List:
+    """Single-process convenience: pipeline `micro_batches` through the
+    actor runtime and return per-micro-batch outputs (or losses)."""
+    feeds = list(micro_batches)
+
+    def feed(i):
+        x = feeds[i]
+        return x if isinstance(x, Tensor) else Tensor(x)
+
+    fe = build_pipeline_fleet_executor(
+        pipeline_layer, num_micro_batches=len(feeds), feed_fn=feed,
+        loss_fn=loss_fn,
+        labels_fn=(lambda i: labels[i]) if labels is not None else None,
+        buff_size=buff_size)
+    try:
+        return fe.run()
+    finally:
+        fe.shutdown()

@@ -118,8 +118,6 @@ def build_hybrid_mesh(dcn_shape: Sequence[int], ici_shape: Sequence[int],
             mesh_shape, dcn_factors, devices=devices)
         arr = arr.reshape(dcn_shape + ici_shape)
     else:
-        # single slice (or CPU sim): contiguous blocks — same program,
-        # laxer physical locality
-        arr = np.array(devices[:n_slices * per_slice]).reshape(
-            dcn_shape + ici_shape)
+        # single slice (or CPU sim): same program, laxer physical locality
+        return build_mesh(dcn_shape + ici_shape, axis_names, devices)
     return Mesh(arr, axis_names=tuple(axis_names))

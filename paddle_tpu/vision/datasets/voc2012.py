@@ -33,11 +33,11 @@ class VOC2012(Dataset):
             raise FileNotFoundError(data_file)
         if mode not in _SETS:
             raise ValueError(f"mode must be one of {sorted(_SETS)}")
-        if backend not in (None, "pil", "numpy"):
-            # decoding always goes through Pillow into ndarrays; reject
-            # silently-unsupported backends (e.g. 'cv2') loudly
-            raise ValueError(f"unsupported backend {backend!r}; "
-                             "use None/'pil'/'numpy'")
+        if backend not in (None, "numpy"):
+            # decoding always yields ndarrays; reject backends whose return
+            # type we would silently betray ('pil' images, 'cv2') loudly
+            raise ValueError(f"unsupported backend {backend!r}; this build "
+                             "returns numpy arrays (use None or 'numpy')")
         self.transform = transform
         self._tar_path = data_file
         # one TarFile per (pid) — forked DataLoader workers must not share

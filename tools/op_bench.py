@@ -27,43 +27,49 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def _configs():
-    import jax.numpy as jnp
-
-    r = np.random.RandomState(0)
-
-    def t(*shape, dtype=jnp.bfloat16):
-        return jnp.asarray(r.randn(*shape), dtype)
-
+    """Configs hold tensor SHAPES, not tensors: arguments are materialized
+    lazily per selected op (float64 host randn for the big vocab shapes
+    alone would be multiple GB)."""
     import jax
+    import jax.numpy as jnp
 
     cfgs = {}
 
-    def add(op, config, fn, *args):
-        cfgs[f"{op}/{config}"] = (op, config, fn, args)
+    def add(op, config, fn, *shapes):
+        cfgs[f"{op}/{config}"] = (op, config, fn, shapes)
 
     add("matmul", "4096x4096x4096",
-        lambda a, b: a @ b, t(4096, 4096), t(4096, 4096))
+        lambda a, b: a @ b, (4096, 4096), (4096, 4096))
     add("matmul", "batch16_1024x768x3072",
         lambda a, b: jnp.einsum("bsh,hf->bsf", a, b),
-        t(16, 1024, 768), t(768, 3072))
+        (16, 1024, 768), (768, 3072))
     add("softmax", "16x1024x50304",
-        lambda a: jax.nn.softmax(a, axis=-1), t(16, 1024, 50304))
+        lambda a: jax.nn.softmax(a, axis=-1), (16, 1024, 50304))
     add("layernorm", "16x1024x2048",
         lambda a: (a - a.mean(-1, keepdims=True))
-        / jnp.sqrt(a.var(-1, keepdims=True) + 1e-5), t(16, 1024, 2048))
-    add("gelu", "16x1024x8192", jax.nn.gelu, t(16, 1024, 8192))
+        / jnp.sqrt(a.var(-1, keepdims=True) + 1e-5), (16, 1024, 2048))
+    add("gelu", "16x1024x8192", jax.nn.gelu, (16, 1024, 8192))
     add("conv2d", "32x3x224x224_k7s2",
         lambda x, w: jax.lax.conv_general_dilated(
             x, w, (2, 2), "SAME", dimension_numbers=("NCHW", "OIHW", "NCHW")),
-        t(32, 3, 224, 224), t(64, 3, 7, 7))
+        (32, 3, 224, 224), (64, 3, 7, 7))
     add("reduce_sum", "16x1024x50304",
-        lambda a: a.sum(), t(16, 1024, 50304))
+        lambda a: a.sum(), (16, 1024, 50304))
 
     def _flash(q):
         from paddle_tpu.kernels.flash_attention import flash_attention_bhtd
         return flash_attention_bhtd(q, q, q, causal=True)
-    add("flash_attention", "192x1024x64", _flash, t(192, 1024, 64))
+    add("flash_attention", "192x1024x64", _flash, (192, 1024, 64))
     return cfgs
+
+
+def _materialize(shapes):
+    import jax.numpy as jnp
+
+    r = np.random.RandomState(0)
+    # float32 host draws: float64 at vocab-sized shapes is pointless bulk
+    return tuple(jnp.asarray(r.standard_normal(s).astype(np.float32),
+                             jnp.bfloat16) for s in shapes)
 
 
 def bench_op(fn, args, iters: int = 20, warmup: int = 2) -> float:
@@ -104,11 +110,13 @@ def main(argv=None):
 
     device = jax.devices()[0]
     results = []
-    for key, (op, config, fn, tensors) in sorted(_configs().items()):
+    for key, (op, config, fn, shapes) in sorted(_configs().items()):
         if args.ops and op not in args.ops:
             continue
         try:
+            tensors = _materialize(shapes)
             us = bench_op(fn, tensors, iters=args.iters)
+            del tensors
             row = {"op": op, "config": config, "speed_us": round(us, 2),
                    "device": str(getattr(device, "device_kind", device))}
         except Exception as e:  # report, keep going (op_tester.cc contract)
