@@ -1,0 +1,79 @@
+"""fleet runtime factory — parity with python/paddle/distributed/fleet/
+runtime/{runtime_factory,collective_runtime,parameter_server_runtime,
+the_one_ps}.py: fleet.init selects a runtime by role (collective training
+vs parameter-server training) and delegates server/worker lifecycle.
+"""
+from __future__ import annotations
+
+__all__ = ["RuntimeBase", "CollectiveRuntime", "ParameterServerRuntime",
+           "RuntimeFactory"]
+
+
+class RuntimeBase:
+    def __init__(self, role_maker=None):
+        self.role_maker = role_maker
+
+    def _init_server(self, *args, **kwargs):
+        pass
+
+    def _run_server(self):
+        pass
+
+    def _init_worker(self):
+        pass
+
+    def _stop_worker(self):
+        pass
+
+
+class CollectiveRuntime(RuntimeBase):
+    """collective_runtime.py: nothing to bootstrap beyond
+    init_parallel_env — collectives are in-program (GSPMD)."""
+
+    def _init_worker(self):
+        from ... import parallel
+        parallel.init_parallel_env()
+
+
+class ParameterServerRuntime(RuntimeBase):
+    """the_one_ps.py runtime: owns a TheOnePS instance; server ranks serve
+    tables, workers get a PsClient."""
+
+    def __init__(self, role_maker=None, mode: str = "sync"):
+        super().__init__(role_maker)
+        from ...ps import TheOnePS
+        self.ps = TheOnePS(role_maker=role_maker, mode=mode)
+
+    def _init_server(self, *args, model_dir=None, **kwargs):
+        self.ps.init_server(model_dir=model_dir)
+
+    def _run_server(self):
+        self.ps.run_server(block=True)
+
+    def _init_worker(self):
+        self.ps.init_worker()
+
+    def _stop_worker(self):
+        self.ps.stop()
+
+
+class RuntimeFactory:
+    """runtime_factory.py: pick the runtime from the role maker."""
+
+    @staticmethod
+    def create(role_maker=None, strategy=None):
+        is_ps = False
+        if role_maker is not None:
+            try:
+                is_ps = bool(role_maker.get_pserver_endpoints())
+            except Exception:
+                is_ps = False
+        a_sync = bool(getattr(strategy, "a_sync", False)) if strategy else \
+            False
+        if is_ps:
+            mode = "async" if a_sync else "sync"
+            cfg = getattr(strategy, "a_sync_configs", {}) if strategy else {}
+            if a_sync and cfg.get("k_steps", -1) > 0:
+                mode = "geo"
+            return ParameterServerRuntime(role_maker, mode=mode)
+        return CollectiveRuntime(role_maker)

@@ -71,6 +71,11 @@ SURFACE = {
                                   "DenseTable", "sgd_rule"],
     "paddle_tpu.inference.dist_model": ["DistModel", "DistModelConfig"],
     "paddle_tpu.distributed.index_dataset": ["TreeIndex", "LayerWiseSampler"],
+    "paddle_tpu.distributed.fleet.fleet_executor_utils": [
+        "build_pipeline_fleet_executor", "run_pipeline_micro_batches"],
+    "paddle_tpu.distributed.mesh": ["build_mesh", "build_hybrid_mesh"],
+    "paddle_tpu.vision.datasets": ["MNIST", "Cifar10", "Flowers", "VOC2012",
+                                   "FakeData"],
     "paddle_tpu.distributed.fleet.utils": ["HybridParallelInferenceHelper",
                                            "recompute"],
     "paddle_tpu.static.nn": ["sparse_embedding"],

@@ -240,3 +240,42 @@ def test_async_push_applies_eventually():
             raise AssertionError("async push never applied")
     finally:
         ps.stop()
+
+
+def test_runtime_factory_selects_by_role():
+    """runtime_factory parity: PS endpoints -> ParameterServerRuntime with
+    the right mode; none -> CollectiveRuntime."""
+    from paddle_tpu.distributed.fleet.runtime import (CollectiveRuntime,
+                                                      ParameterServerRuntime,
+                                                      RuntimeFactory)
+
+    class PsRole:
+        def get_pserver_endpoints(self):
+            return ["127.0.0.1:9000"]
+
+        def server_index(self):
+            return 0
+
+    class CollRole:
+        def get_pserver_endpoints(self):
+            return []
+
+    class Strat:
+        a_sync = True
+        a_sync_configs = {"k_steps": 4}
+
+    rt = RuntimeFactory.create(PsRole(), Strat())
+    assert isinstance(rt, ParameterServerRuntime)
+    assert rt.ps.mode == "geo"
+    rt.ps.stop()
+
+    class StratSync:
+        a_sync = False
+        a_sync_configs = {}
+
+    rt2 = RuntimeFactory.create(PsRole(), StratSync())
+    assert rt2.ps.mode == "sync"
+    rt2.ps.stop()
+
+    assert isinstance(RuntimeFactory.create(CollRole(), None),
+                      CollectiveRuntime)
