@@ -1,18 +1,99 @@
 """Shared wire framing for the host-side RPC planes (fleet_executor message
-bus + ps service): length-prefixed pickle over TCP.  One implementation so
-protocol fixes (size guards, versioning) land in both planes.
+bus + ps service): length-prefixed restricted-pickle over TCP.  One
+implementation so protocol fixes (size guards, versioning) land in both
+planes.
+
+Security contract: the reference's transport is brpc/protobuf
+(interceptor_message.proto), which cannot instantiate arbitrary objects.
+Plain ``pickle.loads`` can — so deserialization goes through a restricted
+Unpickler that only resolves an allowlist of types (our message dataclasses,
+numpy array reconstruction, stdlib containers).  Frames are capped at
+``MAX_FRAME_BYTES`` (env ``PADDLE_TPU_MAX_RPC_FRAME``) so a hostile or
+corrupt header can't trigger an unbounded allocation.  These planes are
+still designed for a trusted network (loopback or a private cluster fabric,
+the same assumption the reference's brpc endpoints make) — the allowlist is
+defense in depth, not an authentication layer.
 """
 from __future__ import annotations
 
+import io
+import os
 import pickle
 import socket
 import struct
 
 HDR = struct.Struct("<Q")
 
+MAX_FRAME_BYTES = int(os.environ.get("PADDLE_TPU_MAX_RPC_FRAME",
+                                     2 * 1024 * 1024 * 1024))
+
+# module -> allowed names resolvable during deserialization
+_ALLOWED = {
+    "builtins": {"dict", "list", "tuple", "set", "frozenset", "bytes",
+                 "bytearray", "str", "int", "float", "bool", "complex",
+                 "slice", "range", "NoneType"},
+    "collections": {"OrderedDict", "defaultdict", "deque"},
+    "numpy": {"ndarray", "dtype", "float32", "float64", "int32", "int64",
+              "bool_", "uint8", "int8", "int16", "uint16", "uint32",
+              "uint64", "float16"},
+    "numpy.core.multiarray": {"_reconstruct", "scalar"},
+    "numpy._core.multiarray": {"_reconstruct", "scalar"},
+    "numpy.core.numeric": {"_frombuffer"},
+    "numpy._core.numeric": {"_frombuffer"},
+    "paddle_tpu.distributed.fleet_executor.interceptor": {
+        "InterceptorMessage", "MessageType"},
+}
+
+
+class _RestrictedUnpickler(pickle.Unpickler):
+    def find_class(self, module, name):
+        allowed = _ALLOWED.get(module)
+        if allowed is not None and name in allowed:
+            return super().find_class(module, name)
+        raise pickle.UnpicklingError(
+            f"refusing to deserialize {module}.{name}: not on the RPC "
+            f"type allowlist (_framing._ALLOWED)")
+
+
+def _loads(data: bytes):
+    return _RestrictedUnpickler(io.BytesIO(data)).load()
+
+
+def _sanitize(obj):
+    """Map framework Tensors / jax Arrays inside a message to numpy before
+    pickling: the wire format is numpy-only (mirrors the reference where
+    interceptor_message.proto carries raw buffers, not framework objects),
+    and the receive-side allowlist can then stay small."""
+    from ..core.tensor import Tensor
+    import jax
+    import numpy as np
+
+    def leaf(x):
+        if isinstance(x, Tensor):
+            return np.asarray(x._value)
+        if isinstance(x, jax.Array):
+            return np.asarray(x)
+        return x
+
+    if isinstance(obj, (Tensor, jax.Array)):
+        return leaf(obj)
+    if isinstance(obj, dict):
+        return {k: _sanitize(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_sanitize(v) for v in obj)
+    if hasattr(obj, "payload") and hasattr(obj, "__dataclass_fields__"):
+        import dataclasses
+        return dataclasses.replace(obj, payload=_sanitize(obj.payload))
+    return obj
+
 
 def send_msg(conn: socket.socket, obj) -> None:
-    data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    data = pickle.dumps(_sanitize(obj), protocol=pickle.HIGHEST_PROTOCOL)
+    if len(data) > MAX_FRAME_BYTES:
+        raise ValueError(
+            f"RPC frame of {len(data)} bytes exceeds MAX_FRAME_BYTES "
+            f"({MAX_FRAME_BYTES}); raise PADDLE_TPU_MAX_RPC_FRAME if this "
+            f"payload is legitimate")
     conn.sendall(HDR.pack(len(data)) + data)
 
 
@@ -31,7 +112,11 @@ def recv_msg(conn: socket.socket):
     if hdr is None:
         return None
     (n,) = HDR.unpack(hdr)
+    if n > MAX_FRAME_BYTES:
+        raise ValueError(
+            f"incoming RPC frame claims {n} bytes > MAX_FRAME_BYTES "
+            f"({MAX_FRAME_BYTES}); refusing unbounded allocation")
     body = recv_exact(conn, n)
     if body is None:
         return None
-    return pickle.loads(body)
+    return _loads(body)

@@ -28,6 +28,20 @@ __all__ = ["MetaOptimizerBase", "GradientMergeOptimizer",
            "FP16AllReduceOptimizer", "apply_meta_optimizers"]
 
 
+def _dp_comm():
+    """(world_size, group) of the DATA-PARALLEL axis.  Meta-optimizer
+    reductions must never span mp/pp/sharding ranks — under a hybrid
+    topology averaging over the global world would mix unrelated tensor
+    shards (the reference restricts these optimizers to collective-DP mode
+    for the same reason, meta_optimizer_base.py _can_apply checks)."""
+    from ... import collective as C
+    from ...topology import get_hybrid_communicate_group
+    hcg = get_hybrid_communicate_group()
+    if hcg is None:
+        return C.get_world_size(), None
+    return hcg.get_data_parallel_world_size(), hcg.get_data_parallel_group()
+
+
 class MetaOptimizerBase:
     """Wraps an inner optimizer, delegating everything it does not
     override (meta_optimizer_base.py)."""
@@ -139,13 +153,14 @@ class LocalSGDOptimizer(MetaOptimizerBase):
 
     def _sync_params(self):
         from ... import collective as C
-        if C.get_world_size() <= 1:
+        world, group = _dp_comm()
+        if world <= 1:
             return
         for p in self._inner._parameters:
             if p.stop_gradient:
                 continue
             t = Tensor(p._value, _internal=True)
-            C.all_reduce(t, op=C.ReduceOp.AVG)
+            C.all_reduce(t, op=C.ReduceOp.AVG, group=group)
             p._replace_(t._value, None)
 
     @no_grad()
@@ -227,7 +242,7 @@ class DGCOptimizer(MetaOptimizerBase):
         from ... import collective as C
         self._count += 1
         s = self._current_sparsity()
-        world = C.get_world_size()
+        world, group = _dp_comm()
         for p in self._inner._parameters:
             if p.stop_gradient or p.grad is None:
                 continue
@@ -258,7 +273,7 @@ class DGCOptimizer(MetaOptimizerBase):
                 self._u[id(p)] = u
             if world > 1:
                 t = Tensor(sparse, _internal=True)
-                C.all_reduce(t, op=C.ReduceOp.AVG)
+                C.all_reduce(t, op=C.ReduceOp.AVG, group=group)
                 sparse = t._value
             p.grad = Tensor(sparse.astype(p.grad._value.dtype),
                             _internal=True)
@@ -275,7 +290,7 @@ class FP16AllReduceOptimizer(MetaOptimizerBase):
     @no_grad()
     def step(self):
         from ... import collective as C
-        world = C.get_world_size()
+        world, group = _dp_comm()
         for p in self._inner._parameters:
             if p.stop_gradient or p.grad is None:
                 continue
@@ -283,7 +298,7 @@ class FP16AllReduceOptimizer(MetaOptimizerBase):
             g16 = p.grad._value.astype(jnp.float16)
             if world > 1:
                 t = Tensor(g16, _internal=True)
-                C.all_reduce(t, op=C.ReduceOp.AVG)
+                C.all_reduce(t, op=C.ReduceOp.AVG, group=group)
                 g16 = t._value
             p.grad = Tensor(g16.astype(orig_dtype), _internal=True)
         self._inner.step()

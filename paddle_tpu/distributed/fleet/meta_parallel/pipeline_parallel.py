@@ -31,6 +31,7 @@ class PipelineParallel(MetaParallelBase):
         cfg = strategy.pipeline_configs if strategy is not None else {}
         self.micro_batch_size = cfg.get("micro_batch_size", 1)
         self.accumulate_steps = cfg.get("accumulate_steps", 1)
+        self.schedule_mode = cfg.get("schedule_mode", "1F1B")
         self.num_stages = hcg.get_pipe_parallel_world_size() if hcg else 1
         self.stage_id = hcg.get_stage_id() if hcg else 0
         self._train_step = None
@@ -53,21 +54,33 @@ class PipelineParallel(MetaParallelBase):
         if self._train_step is None:
             loss_fn = self._layers._loss_fn
             if self.num_stages > 1:
-                # explicit GPipe schedule over the pipe axis (shard_map +
+                # explicit pipeline schedule over the pipe axis (shard_map +
                 # ppermute; distributed/pipeline.py).  Falls back to the
-                # one-GSPMD-program path when the stages aren't uniform.
+                # one-GSPMD-program path ONLY when the stages aren't uniform
+                # enough for the explicit schedule (decompose raises
+                # ValueError for those documented cases) — and says so.
+                from ...pipeline import (GPipeTrainStep,
+                                         decompose_pipeline_layer)
                 try:
-                    from ...pipeline import (GPipeTrainStep,
-                                             decompose_pipeline_layer)
                     pre, blocks, post = decompose_pipeline_layer(self._layers)
                     num_virtual = getattr(
                         self._layers, "_num_virtual_pipeline_stages", 1) or 1
                     self._train_step = GPipeTrainStep(
                         pre, blocks, post, loss_fn, opt,
                         num_micro=max(2, self.accumulate_steps),
-                        num_virtual=num_virtual)
-                except (ValueError, AttributeError, TypeError):
-                    # non-uniform / shared / callable stages: GSPMD path
+                        num_virtual=num_virtual,
+                        schedule=self.schedule_mode)
+                except ValueError as e:
+                    # decompose_pipeline_layer raises for non-uniform/shared
+                    # stages; GPipeTrainStep for divisibility/mesh mismatch —
+                    # both are documented "can't explicit-pipeline" cases
+                    import warnings
+                    warnings.warn(
+                        f"pipeline degree {self.num_stages} requested but "
+                        f"the explicit pipeline schedule can't apply "
+                        f"({e}); degrading to the one-program GSPMD path "
+                        f"WITHOUT micro-batch pipelining", RuntimeWarning,
+                        stacklevel=3)
                     self._train_step = None
             if self._train_step is None:
                 self._train_step = spmd.ShardedTrainStep(
@@ -96,7 +109,16 @@ class PipelineParallel(MetaParallelBase):
 
 
 class PipelineParallelWithInterleave(PipelineParallel):
-    """pipeline_parallel.py:419: virtual-stage interleaved 1F1B.  Under XLA
-    the virtual-stage interleave is a scheduling decision the compiler makes;
-    the API class exists for parity and uses the same compiled path."""
-    pass
+    """pipeline_parallel.py:419: virtual-stage interleaved 1F1B.  The
+    interleave itself is the circular schedule in GPipeTrainStep (num_virtual
+    rounds through the ring); this class enforces the reference's contract
+    that the layer was built with virtual stages."""
+
+    def __init__(self, layers, hcg, strategy):
+        super().__init__(layers, hcg, strategy)
+        nv = getattr(layers, "_num_virtual_pipeline_stages", 1) or 1
+        if nv <= 1:
+            raise ValueError(
+                "PipelineParallelWithInterleave needs a PipelineLayer built "
+                "with num_virtual_pipeline_stages > 1 (reference "
+                "pipeline_parallel.py:419 same check)")

@@ -18,18 +18,39 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 _GLOBAL_MESH: Mesh | None = None
 
 
+def cpu_fallback_devices(n_need: int):
+    """`jax.devices('cpu')` when it can host an ``n_need``-device simulated
+    mesh, else None.  The axon TPU plugin ignores JAX_PLATFORMS=cpu, so the
+    default backend on a 1-chip host can't build multi-device meshes — but the
+    CPU backend still honors --xla_force_host_platform_device_count."""
+    try:
+        cpu = jax.devices("cpu")
+    except RuntimeError:
+        return None
+    return list(cpu) if len(cpu) >= n_need else None
+
+
 def build_mesh(shape: Sequence[int], axis_names: Sequence[str],
                devices=None) -> Mesh:
-    """Create a Mesh; `shape` may contain one -1 (inferred from device count)."""
-    devices = list(devices if devices is not None else jax.devices())
+    """Create a Mesh; `shape` may contain one -1 (inferred from device count).
+
+    When `devices` is omitted and the default backend is too small (the axon
+    TPU plugin ignores JAX_PLATFORMS=cpu, so a 1-chip host can't host a
+    simulated mesh), falls back to the CPU backend.  An EXPLICIT device list
+    is never substituted — a short one is a caller error."""
+    explicit = devices is not None
+    devices = list(devices if explicit else jax.devices())
     shape = list(shape)
     if -1 in shape:
         known = int(np.prod([s for s in shape if s != -1]))
         shape[shape.index(-1)] = len(devices) // known
     n = int(np.prod(shape))
     if n > len(devices):
-        raise ValueError(
-            f"mesh shape {shape} needs {n} devices, have {len(devices)}")
+        fallback = None if explicit else cpu_fallback_devices(n)
+        if fallback is None:
+            raise ValueError(
+                f"mesh shape {shape} needs {n} devices, have {len(devices)}")
+        devices = fallback
     arr = np.array(devices[:n]).reshape(shape)
     return Mesh(arr, tuple(axis_names))
 

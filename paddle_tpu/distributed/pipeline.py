@@ -58,7 +58,7 @@ class GPipeTrainStep:
 
     def __init__(self, pre, blocks, post, loss_fn, optimizer, mesh=None,
                  num_micro=4, pipe_axis=None, compute_dtype=None,
-                 num_virtual=1):
+                 num_virtual=1, schedule="gpipe", chunk_micro=None):
         self.mesh = mesh or mesh_mod.get_global_mesh()
         if pipe_axis is None and self.mesh is not None:
             pipe_axis = next((a for a in ("pipe", "pp")
@@ -89,6 +89,11 @@ class GPipeTrainStep:
         self.num_micro = num_micro
         self.pipe_axis = pipe_axis
         self.compute_dtype = compute_dtype
+        schedule = schedule.lower().replace("-", "")
+        if schedule not in ("gpipe", "fthenb", "1f1b"):
+            raise ValueError(f"unknown pipeline schedule {schedule!r}")
+        self.schedule = "gpipe" if schedule == "fthenb" else schedule
+        self.chunk_micro = chunk_micro
         self._template = blocks[0]
 
         # entry metadata from the live layers: trainable mask, per-param
@@ -318,8 +323,68 @@ class GPipeTrainStep:
 
         grad_fn = jax.value_and_grad(fwd_loss)
 
+        # -- 1F1B-class memory bound (reference pipeline_parallel.py:108,
+        # section_worker.cc:43-63: at most ~S micro-batches of activations
+        # live at once).  Differentiating the whole GPipe scan retains all M
+        # micro-batch activations; instead scan over G groups of C
+        # micro-batches, running forward AND backward per group and
+        # accumulating gradients — peak live activations are one C-micro
+        # group's worth, the same bound 1F1B achieves by interleaving.
+        num_groups = 1
+        if self.schedule == "1f1b":
+            target = max(1, min(self.chunk_micro or max(self.S, 1),
+                                num_micro))
+            chunk = target
+            while num_micro % chunk:
+                chunk += 1  # smallest divisor-compatible chunk >= target
+            if pad_local == 0:
+                num_groups = num_micro // chunk
+            if num_groups > 1:
+                num_micro = chunk
+                pipeline = self._make_pipeline_fn(num_micro)
+            elif num_micro > target:
+                # the memory bound was requested but can't apply to THIS
+                # batch shape (padding needed, or no chunk divisor): the
+                # step still trains correctly but retains all micro-batch
+                # activations — a silent OOM trap on real hardware
+                import warnings
+                warnings.warn(
+                    f"1F1B memory bound disabled for this batch: "
+                    f"num_micro={num_micro}, chunk={chunk}, "
+                    f"pad_local={pad_local}; differentiating the full "
+                    f"GPipe scan (all micro-batch activations live)",
+                    RuntimeWarning, stacklevel=3)
+
+        def step_fn_grads(params, key, batch):
+            if num_groups == 1:
+                return grad_fn(params, key, batch)
+            G = num_groups
+            keys = jax.random.split(key, G)
+
+            def chunkify(v):
+                c = v.reshape(G, v.shape[0] // G, *v.shape[1:])
+                spec = P(None, batch_axis, *([None] * (v.ndim - 1)))
+                return jax.lax.with_sharding_constraint(
+                    c, NamedSharding(mesh, spec))
+
+            xs = tuple(chunkify(b) for b in batch)
+
+            def body(acc, inp):
+                k, bg = inp[0], tuple(inp[1:])
+                loss_g, g_g = grad_fn(params, k, bg)
+                loss_acc, gacc = acc
+                gacc = jax.tree.map(
+                    lambda a, b: a + b.astype(jnp.float32), gacc, g_g)
+                return (loss_acc + loss_g, gacc), None
+
+            zero_g = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params)
+            (loss_sum, gsum), _ = jax.lax.scan(
+                body, (jnp.zeros((), jnp.float32), zero_g), (keys,) + xs)
+            return loss_sum / G, jax.tree.map(lambda g: g / G, gsum)
+
         def step_fn(params, slots, step, lr, key, batch):
-            loss, grads = grad_fn(params, key, batch)
+            loss, grads = step_fn_grads(params, key, batch)
             if grad_clip is not None and hasattr(grad_clip, "clip_norm"):
                 sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
                          for grp in grads for g in grads[grp].values())

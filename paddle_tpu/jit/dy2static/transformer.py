@@ -102,6 +102,14 @@ class _StoreCollector(ast.NodeVisitor):
 
     visit_AsyncFunctionDef = visit_FunctionDef
 
+    def visit_Global(self, node):
+        # a `global`/`nonlocal` binding inside the block cannot be threaded
+        # through the synthesized helper's tuple-assign — rebinding it there
+        # would silently shadow the outer binding with a function local
+        self.blocked = True
+
+    visit_Nonlocal = visit_Global
+
     def visit_ClassDef(self, node):
         self.names.add(node.name)
 
@@ -114,6 +122,27 @@ def _stores(stmts) -> "tuple[Set[str], bool]":
     for s in stmts:
         c.visit(s)
     return c.names, c.blocked
+
+
+class _DeclFinder(ast.NodeVisitor):
+    """global/nonlocal names declared in ONE function scope (not nested
+    defs — those push their own scope)."""
+
+    def __init__(self):
+        self.names: Set[str] = set()
+
+    def visit_Global(self, node):
+        self.names.update(node.names)
+
+    visit_Nonlocal = visit_Global
+
+    def visit_FunctionDef(self, node):
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        pass
 
 
 class _JumpFinder(ast.NodeVisitor):
@@ -218,10 +247,34 @@ def _ld_tuple(names: List[str]) -> ast.Tuple:
 class Dy2StaticTransformer(ast.NodeTransformer):
     def __init__(self):
         self._n = 0
+        self._decl_stack: list[Set[str]] = []
 
     def _uid(self) -> int:
         self._n += 1
         return self._n
+
+    def _declared(self) -> Set[str]:
+        """global/nonlocal names of every enclosing function scope; a block
+        that stores one of these cannot be converted (the synthesized
+        helper's tuple-assign would rebind it as a plain local, silently
+        diverging from eager semantics)."""
+        out: Set[str] = set()
+        for s in self._decl_stack:
+            out |= s
+        return out
+
+    def visit_FunctionDef(self, node):
+        d = _DeclFinder()
+        for s in node.body:
+            d.visit(s)
+        self._decl_stack.append(d.names)
+        try:
+            self.generic_visit(node)
+        finally:
+            self._decl_stack.pop()
+        return node
+
+    visit_AsyncFunctionDef = visit_FunctionDef
 
     # -- if/else --------------------------------------------------------------
     def visit_If(self, node: ast.If):
@@ -230,7 +283,8 @@ class Dy2StaticTransformer(ast.NodeTransformer):
             return node
         body_names, b_blocked = _stores(node.body)
         else_names, e_blocked = _stores(node.orelse)
-        if b_blocked or e_blocked:
+        if b_blocked or e_blocked or \
+                ((body_names | else_names) & self._declared()):
             return node
         names = sorted(n for n in (body_names | else_names)
                        if not _is_helper_fn(n))
@@ -257,7 +311,7 @@ class Dy2StaticTransformer(ast.NodeTransformer):
         if node.orelse or _has_jump(node.body):
             return node
         body_names, blocked = _stores(node.body)
-        if blocked:
+        if blocked or (body_names & self._declared()):
             return node
         # carried vars: everything the body rebinds, plus predicate loads
         # that the body rebinds are already included; predicate-only loads
@@ -286,7 +340,7 @@ class Dy2StaticTransformer(ast.NodeTransformer):
             self.generic_visit(node)
             return node
         body_names, blocked = _stores(node.body)
-        if blocked:
+        if blocked or (body_names & self._declared()):
             self.generic_visit(node)
             return node
         uid = self._uid()

@@ -27,7 +27,10 @@ from __future__ import annotations
 import math
 from dataclasses import dataclass
 
+import jax
 from jax.sharding import PartitionSpec as P
+
+from ..distributed import mesh as mesh_mod
 
 from ..distributed.fleet.layers.mpu.mp_layers import (
     _U,
@@ -141,18 +144,31 @@ class GPTSelfAttention(Layer):
             h, h, weight_attr=_init_attr(out_std), has_bias=True,
             input_is_parallel=True)
         self.attn_dropout_prob = config.attention_dropout_prob
-        # QKV interleaving must keep each head's q,k,v on the same mp shard:
-        # shard over heads, i.e. weight columns grouped [3, nh, hd] with nh
-        # sharded. ColumnParallelLinear shards the flat 3h dim; reshape below
-        # to [.., 3, nh, hd] keeps GSPMD free to re-tile (it is a constraint,
-        # not a layout change).
+        # QKV interleaving must keep each head's q,k,v on the same mp shard.
+        # The fused columns are grouped HEAD-major [nh, 3, hd] (vs the
+        # reference's [3, nh, hd], fused_attention_op.cu): a contiguous
+        # column shard is then a set of complete (q,k,v) head triples, so
+        # the same weight layout serves both the GSPMD path (constraint on
+        # the nh dim) and the explicit shard_map pipeline path where the
+        # local shard is reshaped directly.
+        # CHECKPOINT NOTE: importing reference-layout fused qkv weights
+        # requires permuting the output columns
+        # W.reshape(h, 3, nh, hd).transpose(0, 2, 1, 3).reshape(h, 3*h)
+        # (and the same on the bias); shapes match either way, so loaders
+        # cannot detect the mismatch.
 
     def forward(self, x, cache=None, use_cache=False):
         b, t = x.shape[0], x.shape[1]
         qkv = self.qkv_proj(x)  # [B, T, 3H/mp-sharded]
-        qkv = qkv.reshape([b, t, 3, self.num_heads, self.head_dim])
-        qkv = _constrain(qkv, P(_U, _U, _U, "mp", _U))
-        q, k, v = (qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2])
+        # under explicit shard_map (pipeline stage bodies) the mp axis is
+        # bound and qkv is the LOCAL column shard: reshape over local heads
+        nh = self.num_heads
+        axis = getattr(self.qkv_proj.mp_group, "axis_name", None) or "mp"
+        if self.mp_degree > 1 and mesh_mod.axis_bound(axis):
+            nh //= jax.lax.axis_size(axis)
+        qkv = qkv.reshape([b, t, nh, 3, self.head_dim])
+        qkv = _constrain(qkv, P(_U, _U, "mp", _U, _U))
+        q, k, v = (qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2])
         if cache is not None:
             from ..ops.manipulation import concat
             k = concat([cache[0], k], axis=1)
@@ -160,7 +176,7 @@ class GPTSelfAttention(Layer):
         out = F.scaled_dot_product_attention(
             q, k, v, dropout_p=self.attn_dropout_prob,
             is_causal=True, training=self.training)
-        out = out.reshape([b, t, self.num_heads * self.head_dim])
+        out = out.reshape([b, t, nh * self.head_dim])
         out = _constrain(out, P(_U, _U, "mp"))
         out = self.out_proj(out)
         if use_cache:

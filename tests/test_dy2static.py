@@ -347,3 +347,29 @@ def test_enable_to_static_off_runs_original():
         assert calls  # original body executed eagerly
     finally:
         paddle.jit.enable_to_static(True)
+
+
+_GLOBAL_SINK = 0.0
+
+
+def test_global_store_in_branch_skips_conversion():
+    """A block that declares `global` and assigns it cannot be threaded
+    through the synthesized helper (the tuple-assign would rebind it as a
+    function local); conversion must skip the node so the module global is
+    really updated (ADVICE round-1)."""
+    def fn(x, flag):
+        global _GLOBAL_SINK
+        if flag:
+            _GLOBAL_SINK = 7.0
+            y = x * 2.0
+        else:
+            y = x
+        return y
+
+    conv = convert_to_static(fn)
+    x = paddle.to_tensor(np.array([1.0], np.float32))
+    global _GLOBAL_SINK
+    _GLOBAL_SINK = 0.0
+    out = conv(x, True)
+    assert _GLOBAL_SINK == 7.0, "global assignment was swallowed"
+    np.testing.assert_allclose(np.asarray(out._value), [2.0])

@@ -154,3 +154,55 @@ def test_dist_model_single_rank_micro_batching():
     dm.shutdown()
     got = np.concatenate([np.asarray(o) for o in outs], axis=0)
     np.testing.assert_allclose(got, x @ np.asarray(w), rtol=1e-5)
+
+
+def test_framing_rejects_hostile_pickle_and_oversized_frames():
+    """The RPC planes must not deserialize arbitrary objects (the reference
+    transport is brpc/protobuf, interceptor_message.proto, which can't) and
+    must bound frame allocation."""
+    import pickle
+    import pytest
+    from paddle_tpu.distributed import _framing
+
+    class Evil:
+        def __reduce__(self):
+            return (eval, ("1+1",))
+
+    with pytest.raises(pickle.UnpicklingError, match="allowlist"):
+        _framing._loads(pickle.dumps(Evil()))
+
+    # legit payloads round-trip: control dicts, numpy arrays, messages
+    from paddle_tpu.distributed.fleet_executor.interceptor import (
+        InterceptorMessage, MessageType)
+    msg = InterceptorMessage(1, 2, MessageType.DATA_IS_READY, 0,
+                             {"x": np.ones((2, 3), np.float32)}, {})
+    back = _framing._loads(pickle.dumps(msg))
+    assert back.dst_id == 2 and back.payload["x"].shape == (2, 3)
+
+    # oversized header refused before allocation
+    import socket
+    a, b = socket.socketpair()
+    try:
+        a.sendall(_framing.HDR.pack(_framing.MAX_FRAME_BYTES + 1))
+        with pytest.raises(ValueError, match="unbounded"):
+            _framing.recv_msg(b)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_framing_sanitizes_tensor_payloads():
+    """Tensor / jax.Array payloads cross the wire as numpy (the allowlist
+    does not admit framework types; the reference wire format is raw
+    buffers in interceptor_message.proto)."""
+    import pickle
+    from paddle_tpu.distributed import _framing
+    import paddle_tpu as paddle
+
+    t = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    msg = InterceptorMessage(0, 1, MessageType.DATA_IS_READY, 0,
+                             {"act": t, "arr": jnp.ones(4)}, {})
+    back = _framing._loads(pickle.dumps(_framing._sanitize(msg)))
+    assert isinstance(back.payload["act"], np.ndarray)
+    np.testing.assert_allclose(back.payload["act"], t.numpy())
+    np.testing.assert_allclose(back.payload["arr"], np.ones(4))

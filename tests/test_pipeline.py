@@ -274,6 +274,135 @@ def test_gpt_through_fleet_pipeline():
     assert losses[-1] < losses[0]
 
 
+def test_1f1b_matches_unpipelined():
+    """schedule="1f1b" (chunked per-group backward) computes the same math
+    as the unpipelined model — the reference asserts 1F1B loss against the
+    single-GPU baseline the same way (hybrid_parallel_pp_alexnet.py)."""
+    mesh = dist.build_mesh([2, 2], ["dp", "pipe"])
+    dist.set_global_mesh(mesh)
+    x, y = _data(b=8)
+    loss_fn = nn.MSELoss()
+
+    pre, blocks, post = _parts(n_blocks=4)
+    ref_model = _full_model(pre, blocks, post)
+    ref_opt = paddle.optimizer.Adam(parameters=ref_model.parameters(),
+                                    learning_rate=1e-2)
+    ref_step = dist.make_train_step(ref_model, ref_opt, loss_fn, mesh=None)
+    ref_losses = [float(ref_step(x, y)) for _ in range(4)]
+
+    pre2, blocks2, post2 = _parts(n_blocks=4)
+    opt = paddle.optimizer.Adam(parameters=(pre2.parameters() +
+                                            [p for b in blocks2
+                                             for p in b.parameters()] +
+                                            post2.parameters()),
+                                learning_rate=1e-2)
+    step = GPipeTrainStep(pre2, blocks2, post2, loss_fn, opt, mesh=mesh,
+                          num_micro=4, schedule="1F1B")
+    losses = [float(step(x, y)) for _ in range(4)]
+    np.testing.assert_allclose(losses, ref_losses, rtol=2e-4, atol=1e-5)
+
+
+def test_1f1b_bounds_activation_memory():
+    """The memory contract of 1F1B (reference pipeline_parallel.py:108,
+    section_worker.cc:43-63): live activations bounded to ~one chunk of
+    micro-batches instead of all M.  Compare XLA's compiled temp-buffer
+    size: the chunked schedule must need materially less scratch than
+    differentiating straight through the full GPipe scan."""
+    import jax.numpy as jnp
+
+    mesh = dist.build_mesh([1, 2], ["dp", "pipe"])
+    dist.set_global_mesh(mesh)
+    rng = np.random.default_rng(0)
+    b, t, h = 16, 8, 32
+    x = rng.standard_normal((b, t, 8)).astype("float32")
+    y = rng.standard_normal((b, t, 4)).astype("float32")
+
+    def build(schedule, chunk=None):
+        paddle.seed(0)
+        pre = nn.Sequential(nn.Linear(8, h))
+        blocks = [Block(h) for _ in range(8)]
+        post = nn.Sequential(nn.LayerNorm(h), nn.Linear(h, 4))
+        opt = paddle.optimizer.SGD(
+            parameters=(pre.parameters() +
+                        [p for bl in blocks for p in bl.parameters()] +
+                        post.parameters()), learning_rate=1e-2)
+        return GPipeTrainStep(pre, blocks, post, nn.MSELoss(), opt,
+                              mesh=mesh, num_micro=8, schedule=schedule,
+                              chunk_micro=chunk)
+
+    def temp_bytes(step):
+        fn = step._build(8, 0)
+        lowered = fn.lower(step.params, step.slots, step.step_count,
+                           jnp.float32(1e-2), jax.random.key(0),
+                           (jnp.asarray(x), jnp.asarray(y)))
+        return lowered.compile().memory_analysis().temp_size_in_bytes
+
+    mem_gpipe = temp_bytes(build("gpipe"))
+    mem_1f1b = temp_bytes(build("1f1b", chunk=2))
+    assert mem_1f1b < 0.7 * mem_gpipe, (mem_1f1b, mem_gpipe)
+
+    # and the chunked schedule still trains identically
+    sg, s1 = build("gpipe"), build("1f1b", chunk=2)
+    lg = [float(sg(x, y)) for _ in range(3)]
+    l1 = [float(s1(x, y)) for _ in range(3)]
+    np.testing.assert_allclose(l1, lg, rtol=2e-4, atol=1e-5)
+
+
+def test_fleet_schedule_mode_wired():
+    """strategy.pipeline_configs schedule_mode reaches the compiled step;
+    F-then-B selects plain GPipe (distributed_strategy.py:1384 parity)."""
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet import LayerDesc, PipelineLayer
+
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": 2, "mp_degree": 1, "pp_degree": 4,
+                        "sharding_degree": 1}
+    s.pipeline_configs = {"accumulate_steps": 4, "schedule_mode": "F-then-B"}
+    fleet.init(is_collective=True, strategy=s)
+
+    paddle.seed(2)
+    descs = [LayerDesc(nn.Linear, 8, 16)] + \
+        [LayerDesc(Block, 16) for _ in range(4)] + \
+        [LayerDesc(nn.Linear, 16, 4)]
+    pl = PipelineLayer(descs, loss_fn=nn.MSELoss())
+    model = fleet.distributed_model(pl)
+    opt = fleet.distributed_optimizer(paddle.optimizer.Adam(
+        parameters=pl.parameters(), learning_rate=1e-2))
+    x, y = _data()
+    assert np.isfinite(float(model.train_batch((x, y), opt).numpy()))
+    assert model._train_step.schedule == "gpipe"
+
+
+def test_pp_fallback_warns_instead_of_silently_degrading():
+    """A PipelineLayer the explicit schedule can't handle degrades to the
+    GSPMD path WITH a RuntimeWarning (round-1 weakness: silent except)."""
+    import warnings
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet import LayerDesc, PipelineLayer
+
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": 2, "mp_degree": 1, "pp_degree": 4,
+                        "sharding_degree": 1}
+    fleet.init(is_collective=True, strategy=s)
+
+    paddle.seed(3)
+    # alternating types → no uniform block run of length >= 2
+    descs = [LayerDesc(nn.Linear, 8, 16), LayerDesc(nn.LayerNorm, 16),
+             LayerDesc(nn.Linear, 16, 16), LayerDesc(nn.LayerNorm, 16),
+             LayerDesc(nn.Linear, 16, 4)]
+    pl = PipelineLayer(descs, loss_fn=nn.MSELoss())
+    model = fleet.distributed_model(pl)
+    opt = fleet.distributed_optimizer(paddle.optimizer.Adam(
+        parameters=pl.parameters(), learning_rate=1e-2))
+    x, y = _data()
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        loss = model.train_batch((x, y), opt)
+    assert np.isfinite(float(loss.numpy()))
+    assert any("WITHOUT micro-batch pipelining" in str(w.message)
+               for w in rec), [str(w.message) for w in rec]
+
+
 def test_decompose_pipeline_layer():
     from paddle_tpu.distributed.fleet import LayerDesc, PipelineLayer
 
