@@ -3,6 +3,10 @@
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 Baseline (BASELINE.md north star): GPT at >=35% MFU — vs_baseline is
 measured MFU / 0.35, so >=1.0 beats the target.
+
+`python bench.py --all` additionally runs the other BASELINE.md configs
+(ResNet-50 images/s/chip, BERT-base step) as extra JSON lines; the default
+invocation stays single-line for the driver.
 """
 from __future__ import annotations
 
@@ -76,5 +80,112 @@ def main():
           f"mfu={mfu:.3f} steps={steps} dt={dt:.2f}s", file=sys.stderr)
 
 
+def bench_resnet50():
+    """ResNet-50 ImageNet-shape training step, images/s/chip (BASELINE.md
+    row 1; reference model zoo resnet50)."""
+    import jax
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    import paddle_tpu.nn as nn
+    from paddle_tpu.vision.models import resnet50
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    batch, steps = (64, 10) if on_tpu else (2, 2)
+    size = 224 if on_tpu else 32
+
+    paddle.seed(0)
+    model = resnet50(num_classes=1000)
+    crit = nn.CrossEntropyLoss()
+    opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                    parameters=model.parameters(),
+                                    weight_decay=1e-4)
+    step = dist.make_train_step(
+        model, opt, loss_fn=crit,
+        compute_dtype="bfloat16" if on_tpu else None)
+    rng = np.random.RandomState(0)
+    # device-resident batch: a real input pipeline overlaps H2D with
+    # compute; through the remote tunnel an un-overlapped 38 MB image batch
+    # would otherwise dominate the measurement (docs/PERF.md)
+    import jax.numpy as jnp
+    x = jnp.asarray(
+        rng.standard_normal((batch, 3, size, size)).astype(np.float32))
+    y = jnp.asarray(rng.randint(0, 1000, (batch,)).astype(np.int64))
+    loss = step(x, y)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(x, y)
+    float(loss)
+    dt = time.perf_counter() - t0
+    ips = batch * steps / dt
+    # ~3.8 GFLOP/image fwd at 224², x3 for fwd+bwd
+    mfu = ips * 3 * 3.8e9 / _peak_flops(dev) if on_tpu else 0.0
+    print(json.dumps({
+        "metric": "resnet50_images_per_sec_per_chip",
+        "value": round(ips, 1),
+        "unit": "images/s/chip",
+        "vs_baseline": round(mfu / 0.35, 4) if on_tpu else 0.0,
+    }))
+    print(f"# resnet50 device={dev.device_kind} loss={float(loss):.4f} "
+          f"mfu={mfu:.3f} batch={batch} dt={dt:.2f}s", file=sys.stderr)
+
+
+def bench_bert():
+    """BERT-base MLM-shape step, tokens/s/chip (BASELINE.md row 2; the DP
+    scaling leg runs on the CPU-sim mesh in tests/test_bert.py)."""
+    import jax
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.models import (BertPretrainingCriterion, bert_config,
+                                   build_bert)
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    batch, seq, steps = (16, 512, 10) if on_tpu else (2, 64, 2)
+    name = "bert-base-uncased" if on_tpu else "bert-tiny"
+
+    paddle.seed(0)
+    cfg = bert_config(name, hidden_dropout_prob=0.0,
+                      attention_dropout_prob=0.0)
+    model = build_bert(cfg)
+    crit = BertPretrainingCriterion()
+
+    def loss_fn(out, labels, nsp_labels):
+        mlm, nsp = out
+        return crit(mlm, nsp, labels, nsp_labels)
+
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+    step = dist.make_train_step(
+        model, opt, loss_fn=loss_fn, num_labels=2,
+        compute_dtype="bfloat16" if on_tpu else None)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int64)
+    labels = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int64)
+    nsp = rng.randint(0, 2, (batch,)).astype(np.int64)
+    loss = step(ids, labels, nsp)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(ids, labels, nsp)
+    float(loss)
+    dt = time.perf_counter() - t0
+    tps = batch * seq * steps / dt
+    # 6 * params flops/token (110M)
+    mfu = tps * 6 * 110e6 / _peak_flops(dev) if on_tpu else 0.0
+    print(json.dumps({
+        "metric": "bert_base_tokens_per_sec_per_chip",
+        "value": round(tps, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.35, 4) if on_tpu else 0.0,
+    }))
+    print(f"# bert device={dev.device_kind} loss={float(loss):.4f} "
+          f"mfu={mfu:.3f} dt={dt:.2f}s", file=sys.stderr)
+
+
 if __name__ == "__main__":
     main()
+    if "--all" in sys.argv:
+        bench_resnet50()
+        bench_bert()

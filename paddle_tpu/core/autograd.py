@@ -146,6 +146,21 @@ def backward(tensors: Sequence[Any], grad_tensors: Sequence[Any] | None = None,
     def _accumulate_leaf(t: Tensor, g):
         if t.stop_gradient:
             return
+        from .selected_rows import SelectedRows
+        if isinstance(g, SelectedRows):
+            # sparse embedding grads: keep the rows/values form on the leaf
+            # (selected_rows.h contract); mixing with a dense grad densifies
+            if sink is not None:
+                _sink_add(t, g.to_dense())
+                return
+            if t._grad is None:
+                t._grad = g
+            elif isinstance(t._grad, SelectedRows):
+                t._grad = t._grad.concat(g)
+            else:
+                t._grad = Tensor(t._grad._value + g.to_dense(),
+                                 stop_gradient=True)
+            return
         if sink is not None:
             _sink_add(t, g)
             return
@@ -153,6 +168,8 @@ def backward(tensors: Sequence[Any], grad_tensors: Sequence[Any] | None = None,
             g = g.astype(t._value.dtype)
         if t._grad is None:
             t._grad = Tensor(g, stop_gradient=True)
+        elif isinstance(t._grad, SelectedRows):
+            t._grad = Tensor(t._grad.to_dense() + g, stop_gradient=True)
         else:
             t._grad = Tensor(t._grad._value + g, stop_gradient=True)
 

@@ -1,0 +1,105 @@
+"""SelectedRows — sparse row-wise gradients for embedding tables.
+
+Reference: paddle/phi/core/selected_rows.h + phi/kernels/selected_rows/
+(31 kernel files): `Embedding(sparse=True)` produces a (rows, values)
+gradient so the optimizer touches only the rows a batch actually used —
+the difference between O(batch·D) and O(V·D) update cost for
+recommendation-scale vocabularies.
+
+TPU-native scope: the EAGER tape carries SelectedRows grads end-to-end
+(lookup vjp → Tensor.grad → optimizer lazy row update).  The compiled SPMD
+path keeps dense grads on purpose — there GSPMD shards the table and XLA
+already emits the scatter-add fused with the update; sparse bookkeeping
+would force dynamic shapes into the program.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class SelectedRows:
+    """rows [K] int32/64, values [K, D]; duplicate rows allowed and
+    accumulate on apply (selected_rows.h `rows_` may repeat until merged)."""
+
+    __slots__ = ("rows", "values", "height")
+
+    def __init__(self, rows, values, height: int):
+        self.rows = rows
+        self.values = values
+        self.height = int(height)
+
+    @property
+    def shape(self):
+        return (self.height,) + tuple(self.values.shape[1:])
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    def concat(self, other: "SelectedRows") -> "SelectedRows":
+        """Gradient accumulation (phi MergeAdd semantics, deferred)."""
+        if other.height != self.height:
+            raise ValueError("SelectedRows height mismatch")
+        return SelectedRows(jnp.concatenate([self.rows, other.rows]),
+                            jnp.concatenate([self.values, other.values]),
+                            self.height)
+
+    def merged(self) -> "SelectedRows":
+        """Unique rows with summed values (phi funcs::MergeAdd).  Eager-only
+        (concrete shapes)."""
+        rows = np.asarray(self.rows)
+        uniq, inv = np.unique(rows, return_inverse=True)
+        vals = jnp.zeros((len(uniq),) + tuple(self.values.shape[1:]),
+                         self.values.dtype)
+        vals = vals.at[jnp.asarray(inv)].add(self.values)
+        return SelectedRows(jnp.asarray(uniq), vals, self.height)
+
+    def to_dense(self):
+        out = jnp.zeros(self.shape, self.values.dtype)
+        return out.at[self.rows].add(self.values)
+
+    def astype(self, dtype):
+        return SelectedRows(self.rows, self.values.astype(dtype),
+                            self.height)
+
+    def __repr__(self):
+        return (f"SelectedRows(height={self.height}, "
+                f"nnz_rows={self.rows.shape[0]}, "
+                f"dim={tuple(self.values.shape[1:])})")
+
+
+def sparse_embedding_lookup(weight, ids, padding_idx=None):
+    """Embedding lookup whose weight-gradient is a SelectedRows — the
+    `Embedding(sparse=True)` path (phi embedding_sparse_grad_kernel.cu)."""
+    from . import autograd
+    from .op import _wrap_outputs
+    from .tensor import Tensor
+
+    w = weight._value
+    idv = ids._value
+    out = jnp.take(w, jnp.clip(idv, 0, w.shape[0] - 1), axis=0)
+    if padding_idx is not None:
+        out = jnp.where((idv == padding_idx)[..., None], 0.0, out)
+
+    if not autograd.is_grad_enabled() or weight.stop_gradient:
+        return Tensor(out, _internal=True)
+
+    height = w.shape[0]
+    dim = w.shape[1]
+
+    def vjp_fn(ct):
+        # grads flow to the rows the FORWARD actually read (clipped), never
+        # to raw out-of-range ids (negative ids would otherwise wrap and
+        # corrupt unrelated rows)
+        rows = jnp.clip(idv.reshape(-1), 0, height - 1)
+        vals = ct.reshape(-1, dim)
+        if padding_idx is not None:
+            vals = jnp.where((idv.reshape(-1) == padding_idx)[:, None],
+                             0.0, vals)
+        return (SelectedRows(rows, vals, height),)
+
+    node = autograd.GradNode(vjp_fn, [weight], 1,
+                             [(out.shape, out.dtype)],
+                             name="sparse_embedding_lookup")
+    return _wrap_outputs(out, node)

@@ -72,9 +72,18 @@ def _constrain_value(v, mesh, spec):
 
 
 def reshard(x, process_mesh: ProcessMesh, shard_spec=None, placements=None):
-    """auto_parallel Resharder (reshard.py:2,297 LoC in the reference)
-    collapses to one device_put: XLA moves/reshuffles the shards.  Runs on
-    the eager tape (device_put is identity under vjp) so grads survive."""
+    """auto_parallel Resharder parity (reshard.py, 2,297 LoC of cross-mesh
+    send/recv planning in the reference).
+
+    A CROSS-MESH reshard — source sharded over mesh A, target a different
+    mesh B (different shape/axis names, same or overlapping device set, incl.
+    the hybrid DCN×ICI meshes from build_hybrid_mesh) — is one device_put
+    with the target NamedSharding: the runtime computes the shard-to-shard
+    transfer plan that reshard.py hand-codes.  Runs on the eager tape
+    (device_put is identity under vjp) so grads survive.  Inside a trace it
+    lowers to a sharding constraint (same-mesh only — XLA cannot change
+    meshes mid-program; the reference partitions cross-mesh programs into
+    separate executables for the same reason)."""
     from ...core.op import apply_op
 
     spec = _to_spec(process_mesh, shard_spec)

@@ -27,6 +27,10 @@ class GroupShardedOptimizerStage2(InnerOptimizerDelegate):
         super().__init__(optim, sharding_stage=1)
         self._group = group
         self.offload = offload
+        # the compiled step reads this tag and keeps slots in pinned host
+        # memory (reference: offload=True host slots, stage2:48)
+        self._sharding_offload = bool(offload)
+        getattr(self, "_inner_opt", optim)._sharding_offload = bool(offload)
 
 
 class GroupShardedStage2(MetaParallelBase):
@@ -64,8 +68,10 @@ class GroupShardedStage3(MetaParallelBase):
         layers._sharding_stage = 3
         self._sharding_stage = 3
         self._offload = offload
+        layers._sharding_offload = bool(offload)
         if optimizer is not None:
             optimizer._sharding_stage = 3
+            optimizer._sharding_offload = bool(offload)
         self._optimizer = optimizer
 
     def get_all_parameters(self, convert2cpu=False):

@@ -1,4 +1,6 @@
 from .recompute import recompute, recompute_sequential  # noqa: F401
+from .fs import (ExecuteError, FS, FSFileNotExistsError,  # noqa: F401
+                 GCSClient, HDFSClient, LocalFS)
 from . import hybrid_parallel_util  # noqa: F401
 from .hybrid_parallel_inference import (  # noqa: F401
     HybridParallelInferenceHelper,

@@ -60,6 +60,7 @@ def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
     model._sharding_offload = bool(offload)
     optimizer._sharding_stage = stage
     optimizer._sharding_group = group
+    optimizer._sharding_offload = bool(offload)
     if scaler is not None and not isinstance(scaler, GroupShardedScaler):
         scaler = GroupShardedScaler(scaler)
     return model, optimizer, scaler

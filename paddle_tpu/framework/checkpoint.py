@@ -117,14 +117,24 @@ def load_sharded(dirname: str, return_numpy: bool = False) -> dict:
 class AsyncCheckpointSaver:
     """Non-blocking checkpoint writer: snapshot on the caller, IO in a
     worker thread.  keep_last prunes old step dirs (reference auto_checkpoint
-    keeps a bounded history)."""
+    keeps a bounded history).
 
-    def __init__(self, base_dir: str, keep_last: int = 3):
+    `fs` (fleet.utils.fs client) selects the storage backend: a remote
+    client (HDFSClient/GCSClient, `need_upload_download()` True) stages the
+    sharded write through a local temp dir then uploads — the reference's
+    checkpoint_saver.py + fs.py path (auto_checkpoint.py:636)."""
+
+    def __init__(self, base_dir: str, keep_last: int = 3, fs=None):
         self.base_dir = base_dir
         self.keep_last = keep_last
         self._thread: threading.Thread | None = None
         self._error: BaseException | None = None
-        os.makedirs(base_dir, exist_ok=True)
+        self._fs = fs
+        self._remote = fs is not None and fs.need_upload_download()
+        if self._remote:
+            fs.mkdirs(base_dir)
+        else:
+            os.makedirs(base_dir, exist_ok=True)
 
     def _step_dir(self, step: int) -> str:
         return os.path.join(self.base_dir, f"step_{step}")
@@ -135,7 +145,14 @@ class AsyncCheckpointSaver:
 
         def work():
             try:
-                save_sharded(_unflatten(snapshot), self._step_dir(step))
+                if self._remote:
+                    import tempfile
+                    with tempfile.TemporaryDirectory() as tmp:
+                        local = os.path.join(tmp, f"step_{step}")
+                        save_sharded(_unflatten(snapshot), local)
+                        self._fs.upload(local, self._step_dir(step))
+                else:
+                    save_sharded(_unflatten(snapshot), self._step_dir(step))
                 self._prune()
             except BaseException as e:  # noqa: BLE001
                 self._error = e
@@ -159,8 +176,13 @@ class AsyncCheckpointSaver:
             raise RuntimeError(f"async checkpoint write failed: {err}")
 
     def steps(self) -> list[int]:
+        if self._remote:
+            dirs, _ = self._fs.ls_dir(self.base_dir)
+            names = dirs
+        else:
+            names = os.listdir(self.base_dir)
         out = []
-        for name in os.listdir(self.base_dir):
+        for name in names:
             if name.startswith("step_") and not name.endswith(".tmp"):
                 try:
                     out.append(int(name[len("step_"):]))
@@ -176,9 +198,18 @@ class AsyncCheckpointSaver:
         step = step if step is not None else self.latest_step()
         if step is None:
             return None
+        if self._remote:
+            import tempfile
+            with tempfile.TemporaryDirectory() as tmp:
+                local = os.path.join(tmp, f"step_{step}")
+                self._fs.download(self._step_dir(step), local)
+                return load_sharded(local, return_numpy)
         return load_sharded(self._step_dir(step), return_numpy)
 
     def _prune(self):
         steps = self.steps()
         for s in steps[:-self.keep_last] if self.keep_last else []:
-            shutil.rmtree(self._step_dir(s), ignore_errors=True)
+            if self._remote:
+                self._fs.delete(self._step_dir(s))
+            else:
+                shutil.rmtree(self._step_dir(s), ignore_errors=True)

@@ -28,12 +28,14 @@ class TrainEpochRange:
 
     def __init__(self, max_epoch_num: int, name: str | None = None,
                  save_checkpoint_inter: int = 1, checkpoint_dir=None,
-                 keep_last: int = 3):
+                 keep_last: int = 3, fs=None):
         self.max_epoch_num = max_epoch_num
         self.name = name or _job_id()
         self.save_inter = max(1, save_checkpoint_inter)
         base = checkpoint_dir or os.path.join(_root_dir(), self.name)
-        self._saver = AsyncCheckpointSaver(base, keep_last=keep_last)
+        # fs: a fleet.utils.fs client; HDFS/GCS checkpoints stage through
+        # a local temp dir (reference auto_checkpoint.py:636 fs plumbing)
+        self._saver = AsyncCheckpointSaver(base, keep_last=keep_last, fs=fs)
         self._registered = []  # (obj with state_dict/set_state_dict, tag)
         self._start_epoch = 0
         self._restored_state = None

@@ -14,9 +14,17 @@ consumer re-materializes a device array lazily on first use.  API:
     import paddle_tpu.incubate.multiprocessing as mp
     q = mp.Queue()            # a context with tensor reductions installed
     q.put(tensor)             # zero-pickle-copy via shm
+
+DELIVERY CONTRACT: each sent tensor is deserializable exactly ONCE — the
+first consumer copies out of the segment and unlinks it (duplicated
+delivery / multi-consumer fan-out must send one message per consumer).
+Producer-side segments are bounded (64 in flight); segments evicted from
+that window and any still live at exit are unlinked by an atexit hook, so
+/dev/shm cannot leak past process lifetime.
 """
 from __future__ import annotations
 
+import atexit
 import multiprocessing as _std_mp
 from multiprocessing import shared_memory
 from multiprocessing.reduction import ForkingPickler
@@ -28,10 +36,34 @@ from ...core.tensor import Tensor
 __all__ = ["init_reductions", "Queue", "Pipe", "Process", "get_context"]
 
 _INITIALIZED = False
-# keep producer-side segments alive until the process exits (the consumer
-# unlinks; reference keeps the same "sender leaks until GC" contract via
-# its LRU of mmap files)
+# keep producer-side segments alive until the consumer rebuilds (which
+# unlinks); bounded window, see _reduce_tensor
 _LIVE_SEGMENTS: list = []
+# names evicted from the window whose consumers may not have rebuilt yet:
+# unlinked at exit (an unconsumed name would otherwise survive the process
+# in /dev/shm until reboot)
+_EVICTED_NAMES: list = []
+
+
+def _cleanup_segments():
+    for shm in _LIVE_SEGMENTS:
+        try:
+            shm.close()
+            shm.unlink()
+        except Exception:
+            pass
+    for name in _EVICTED_NAMES:
+        try:
+            s = shared_memory.SharedMemory(name=name)
+            s.close()
+            s.unlink()
+        except Exception:
+            pass
+    _LIVE_SEGMENTS.clear()
+    _EVICTED_NAMES.clear()
+
+
+atexit.register(_cleanup_segments)
 
 
 def _np_dtype(name: str):
@@ -70,6 +102,10 @@ def _reduce_tensor(t: Tensor):
     if len(_LIVE_SEGMENTS) > 64:          # bounded producer-side cache
         old = _LIVE_SEGMENTS.pop(0)
         old.close()
+        # consumer may already have rebuilt (then this name is gone and
+        # the atexit unlink is a no-op); if not, the name is reclaimed at
+        # process exit instead of leaking in /dev/shm
+        _EVICTED_NAMES.append(old.name)
     return (_rebuild_tensor_from_shm,
             (shm.name, arr.shape, arr.dtype.name, t.stop_gradient))
 

@@ -50,7 +50,15 @@ def _block_sizes(tq, tk):
 
 def _head_block(bh: int, bq: int, bk: int) -> int:
     """Heads per grid invocation: the largest divisor of bh with the f32
-    score tile (nb, bq, bk) comfortably inside VMEM."""
+    score tile (nb, bq, bk) comfortably inside VMEM.
+
+    The 16 MB figure budgets the score tile only; the exp/p temporary,
+    q/k/v/o tiles and double buffering ride in the remaining headroom of
+    the 100 MB vmem_limit_bytes.  The resulting hot config — nb=4 at
+    bq=bk=1024 one-pass forward, nbf=2 fused backward — is validated on
+    real v5e hardware by every `python bench.py` run (docs/PERF.md);
+    Mosaic rejects at compile time (scoped-vmem OOM), not silently, if a
+    future shape breaks the envelope."""
     budget = 16 * 1024 * 1024   # bytes for the f32 score tile
     for nb in (8, 4, 2, 1):
         if bh % nb == 0 and nb * bq * bk * 4 <= budget:
@@ -105,14 +113,21 @@ def _tile_mask(i, j, bq, bk, causal, offset, t_real, pad_cols):
 
 # -- forward ------------------------------------------------------------------
 
-def _scaled_scores(q_ref, k_ref, i, j, *, scale, causal, offset, bq, bk,
+def _rld(ref):
+    """Load a q/k/v tile.  3D blocks load as-is; 4D (nb, 1, b*, d) blocks —
+    the role-sliced views of a fused [BH, 3, T, D] qkv operand — squeeze
+    the singleton role dim."""
+    x = ref[...]
+    return x[:, 0] if x.ndim == 4 else x
+
+
+def _scaled_scores(q, k, i, j, *, scale, causal, offset, bq, bk,
                    pad_cols, t_real):
     """Masked scaled scores for one tile.  The scale folds into the small
     (nb,bq,d) q operand instead of the (nb,bq,bk) score tile — 16x fewer
     VPU multiplies at d=64."""
-    q = (q_ref[...].astype(jnp.float32) * jnp.float32(scale)).astype(
-        q_ref.dtype)
-    s = _qk(q, k_ref[...])
+    q = (q.astype(jnp.float32) * jnp.float32(scale)).astype(q.dtype)
+    s = _qk(q, k)
     mask = _tile_mask(i, j, bq, bk, causal, offset, t_real, pad_cols)
     if mask is not None:
         s = jnp.where(mask, s, jnp.float32(_NEG_INF))
@@ -122,19 +137,20 @@ def _scaled_scores(q_ref, k_ref, i, j, *, scale, causal, offset, bq, bk,
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *scratch,
                 scale, causal, offset, bq, bk, nk, t_real, pad_cols):
     i, j = pl.program_id(1), pl.program_id(2)
+    qv, kv, vv = _rld(q_ref), _rld(k_ref), _rld(v_ref)
 
     if nk == 1:
         # no scratch is declared for the one-pass path (scratch == ())
         # one-pass softmax: the whole kv row is in this tile, so the online
         # rescaling carry (alpha, running m/l broadcasts) is dead weight
-        s = _scaled_scores(q_ref, k_ref, i, j, scale=scale, causal=causal,
+        s = _scaled_scores(qv, kv, i, j, scale=scale, causal=causal,
                            offset=offset, bq=bq, bk=bk, pad_cols=pad_cols,
                            t_real=t_real)
         m = jnp.max(s, axis=2, keepdims=True)
         p = jnp.exp(s - m)
         l = jnp.maximum(jnp.sum(p, axis=2, keepdims=True),
                         jnp.float32(1e-30))
-        o_ref[...] = (_pv(p.astype(v_ref.dtype), v_ref[...]) / l).astype(
+        o_ref[...] = (_pv(p.astype(vv.dtype), vv) / l).astype(
             o_ref.dtype)
         lse_ref[...] = m + jnp.log(l)
         return
@@ -154,7 +170,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *scratch,
 
     @pl.when(live)
     def _compute():
-        s = _scaled_scores(q_ref, k_ref, i, j, scale=scale, causal=causal,
+        s = _scaled_scores(qv, kv, i, j, scale=scale, causal=causal,
                            offset=offset, bq=bq, bk=bk, pad_cols=pad_cols,
                            t_real=t_real)
         m_prev = m_i[:, :, :1]
@@ -162,7 +178,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *scratch,
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)
         l_new = alpha * l_i[:, :, :1] + jnp.sum(p, axis=2, keepdims=True)
-        acc[:] = acc[:] * alpha + _pv(p.astype(v_ref.dtype), v_ref[...])
+        acc[:] = acc[:] * alpha + _pv(p.astype(vv.dtype), vv)
         m_i[:] = jnp.broadcast_to(m_new, m_i.shape)
         l_i[:] = jnp.broadcast_to(l_new, l_i.shape)
 
@@ -221,28 +237,39 @@ def _flash_fwd(q, k, v, scale, causal):
 # -- backward -----------------------------------------------------------------
 
 def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                      dq_ref, dk_ref, dv_ref, *, scale, causal, offset,
-                      bq, bk, t_real, pad_cols):
+                      *out_refs, scale, causal, offset,
+                      bq, bk, t_real, pad_cols, fused_out=False):
     """Single-tile backward (nq == nk == 1): dq, dk, dv in one pass sharing
     one recomputation of s/p — the two-kernel split exists only to give
     each output a sequential accumulation dimension, which a single tile
-    does not need."""
-    q, v = q_ref[...], v_ref[...]
+    does not need.  With ``fused_out`` the three grads go into role slices
+    of ONE (nbf, 3, bq, d) output block, so XLA materializes a single
+    layout copy for d_qkv instead of three."""
+    q, k, v = _rld(q_ref), _rld(k_ref), _rld(v_ref)
     do = do_ref[...]
     qs = (q.astype(jnp.float32) * jnp.float32(scale)).astype(q.dtype)
-    s = _qk(qs, k_ref[...])
+    s = _qk(qs, k)
     mask = _tile_mask(0, 0, bq, bk, causal, offset, t_real, pad_cols)
     if mask is not None:
         s = jnp.where(mask, s, jnp.float32(_NEG_INF))
     p = jnp.exp(s - lse_ref[...])
     pt = p.astype(do.dtype)
-    dv_ref[...] = _tq_contract(pt, do).astype(dv_ref.dtype)
+    dv = _tq_contract(pt, do)
     dp = _qk(do, v)
     ds = (p * (dp - delta_ref[...])).astype(q.dtype)  # scale folded below
-    ks = (k_ref[...].astype(jnp.float32) * jnp.float32(scale)).astype(
-        q.dtype)
-    dq_ref[...] = _pv(ds, ks).astype(dq_ref.dtype)
-    dk_ref[...] = _tq_contract(ds, qs).astype(dk_ref.dtype)
+    ks = (k.astype(jnp.float32) * jnp.float32(scale)).astype(q.dtype)
+    dq = _pv(ds, ks)
+    dk = _tq_contract(ds, qs)
+    if fused_out:
+        (dqkv_ref,) = out_refs
+        dqkv_ref[:, 0] = dq.astype(dqkv_ref.dtype)
+        dqkv_ref[:, 1] = dk.astype(dqkv_ref.dtype)
+        dqkv_ref[:, 2] = dv.astype(dqkv_ref.dtype)
+    else:
+        dq_ref, dk_ref, dv_ref = out_refs
+        dq_ref[...] = dq.astype(dq_ref.dtype)
+        dk_ref[...] = dk.astype(dk_ref.dtype)
+        dv_ref[...] = dv.astype(dv_ref.dtype)
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
@@ -260,9 +287,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     @pl.when(live)
     def _compute():
-        k, v = k_ref[...], v_ref[...]
+        q, k, v = _rld(q_ref), _rld(k_ref), _rld(v_ref)
         do = do_ref[...]
-        s = _scaled_scores(q_ref, k_ref, i, j, scale=scale, causal=causal,
+        s = _scaled_scores(q, k, i, j, scale=scale, causal=causal,
                            offset=offset, bq=bq, bk=bk, pad_cols=pad_cols,
                            t_real=t_real)
         p = jnp.exp(s - lse_ref[...])
@@ -292,10 +319,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(live)
     def _compute():
-        q, v = q_ref[...], v_ref[...]
+        q, k, v = _rld(q_ref), _rld(k_ref), _rld(v_ref)
         do = do_ref[...]
         qs = (q.astype(jnp.float32) * jnp.float32(scale)).astype(q.dtype)
-        s = _qk(qs, k_ref[...])
+        s = _qk(qs, k)
         mask = _tile_mask(i, j, bq, bk, causal, offset, t_real, pad_cols)
         if mask is not None:
             s = jnp.where(mask, s, jnp.float32(_NEG_INF))
@@ -424,6 +451,137 @@ def _flash_bwd(q, k, v, o, lse, do, scale, causal):
         interpret=_INTERPRET,
     )(qp, kp, vp, dop, lsep, deltap)
     return dq[:, :tq], dk[:, :tk], dv[:, :tk]
+
+
+# -- fused-qkv drivers --------------------------------------------------------
+#
+# Layout [BH, 3, T, D]: ONE custom-call operand carries q, k and v.  The
+# same array is passed three times with role-selecting index maps, so XLA
+# materializes a single layout copy at the call boundary instead of three
+# (docs/PERF.md layout-copy tax); the single-tile backward writes the three
+# grads into role slices of one output for the same reason.
+
+def _role_specs(nb, bq, bk, d):
+    # NOTE: every index-map coordinate must involve a grid variable — this
+    # backend's Mosaic fails to legalize constant-only coordinates
+    # ("failed to legalize func.return", docs/PERF.md), so the role constants
+    # are written j*0 + r
+    qmap = lambda b, i, j: (b, j * 0, i, j * 0)            # noqa: E731
+    kmap = lambda b, i, j: (b, i * 0 + 1, j, i * 0)        # noqa: E731
+    vmap = lambda b, i, j: (b, i * 0 + 2, j, i * 0)        # noqa: E731
+    return [pl.BlockSpec((nb, 1, bq, d), qmap),
+            pl.BlockSpec((nb, 1, bk, d), kmap),
+            pl.BlockSpec((nb, 1, bk, d), vmap)]
+
+
+def _flash_fused_fwd_impl(qkv, scale, causal):
+    """qkv: [BH, 3, T, D] → (out [BH, T, D], lse [BH, T, 1])."""
+    bh, three, t, d = qkv.shape
+    assert three == 3
+    bq, bk = _block_sizes(t, t)
+    nb = _head_block(bh, bq, bk)
+    qkvp = _pad_to(qkv, 2, max(bq, bk))
+    tp = qkvp.shape[2]
+    nq, nk = tp // bq, tp // bk
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, offset=0,
+        bq=bq, bk=bk, nk=nk, t_real=t, pad_cols=(tp != t))
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(bh // nb, nq, nk),
+        in_specs=_role_specs(nb, bq, bk, d),
+        out_specs=[
+            pl.BlockSpec((nb, bq, d), lambda b, i, j: (b, i, j * 0)),
+            pl.BlockSpec((nb, bq, 1), lambda b, i, j: (b, i, j * 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tp, d), qkv.dtype),
+            jax.ShapeDtypeStruct((bh, tp, 1), jnp.float32),
+        ],
+        scratch_shapes=[] if nk == 1 else [
+            pltpu.VMEM((nb, bq, d), jnp.float32),
+            pltpu.VMEM((nb, bq, 128), jnp.float32),
+            pltpu.VMEM((nb, bq, 128), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+            vmem_limit_bytes=100 * 1024 * 1024),
+        interpret=_INTERPRET,
+    )(qkvp, qkvp, qkvp)
+    return out[:, :t], lse[:, :t]
+
+
+def _flash_fused_bwd_impl(qkv, o, lse, do, scale, causal):
+    bh, _, t, d = qkv.shape
+    bq, bk = _block_sizes(t, t)
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
+                    keepdims=True)
+    qkvp = _pad_to(qkv, 2, max(bq, bk))
+    dop = _pad_to(do, 1, bq)
+    lsep = _pad_to(lse, 1, bq)
+    lsep = lsep.at[:, t:].set(1e30) if lsep.shape[1] > t else lsep
+    deltap = _pad_to(delta, 1, bq)
+    tp = qkvp.shape[2]
+    nq, nk = tp // bq, tp // bk
+
+    if nq == 1 and nk == 1:
+        fused = functools.partial(
+            _bwd_fused_kernel, scale=scale, causal=causal, offset=0,
+            bq=bq, bk=bk, t_real=t, pad_cols=(tp != t), fused_out=True)
+        nbf = max(1, _head_block(bh, bq, bk) // 2)
+        qmap3 = lambda b, i, j: (b, i, j * 0)      # noqa: E731
+        dqkv = pl.pallas_call(
+            fused,
+            grid=(bh // nbf, 1, 1),
+            in_specs=_role_specs(nbf, bq, bk, d) + [
+                pl.BlockSpec((nbf, bq, d), qmap3),
+                pl.BlockSpec((nbf, bq, 1), qmap3),
+                pl.BlockSpec((nbf, bq, 1), qmap3),
+            ],
+            out_specs=pl.BlockSpec((nbf, 3, bq, d),
+                                   lambda b, i, j: (b, j * 0, i, j * 0)),
+            out_shape=jax.ShapeDtypeStruct((bh, 3, tp, d), qkv.dtype),
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "arbitrary"),
+                vmem_limit_bytes=100 * 1024 * 1024),
+            interpret=_INTERPRET,
+        )(qkvp, qkvp, qkvp, dop, lsep, deltap)
+        return dqkv[:, :, :t]
+
+    # multi-tile fallback: role views through the split kernels, stacked at
+    # the end (one extra copy — the single-tile path is the hot one)
+    q3 = qkv[:, 0]
+    k3 = qkv[:, 1]
+    v3 = qkv[:, 2]
+    dq, dk, dv = _flash_bwd(q3, k3, v3, o, lse, do, scale, causal)
+    return jnp.stack([dq, dk, dv], axis=1)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _flash_fused(qkv, scale, causal):
+    out, _ = _flash_fused_fwd_impl(qkv, scale, causal)
+    return out
+
+
+def _flash_fused_fwd_rule(qkv, scale, causal):
+    out, lse = _flash_fused_fwd_impl(qkv, scale, causal)
+    return out, (qkv, out, lse)
+
+
+def _flash_fused_bwd_rule(scale, causal, res, do):
+    qkv, out, lse = res
+    return (_flash_fused_bwd_impl(qkv, out, lse, do, scale, causal),)
+
+
+_flash_fused.defvjp(_flash_fused_fwd_rule, _flash_fused_bwd_rule)
+
+
+def flash_attention_qkv_fused(qkv, causal=True, scale=None):
+    """Self-attention on the fused [BH, 3, T, D] qkv tensor (jax arrays)."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(qkv.shape[-1])
+    return _flash_fused(qkv, float(scale), bool(causal))
 
 
 # -- custom_vjp glue ----------------------------------------------------------

@@ -1,0 +1,213 @@
+"""Fused LayerNorm as Pallas TPU kernels — the analog of the reference's
+layer_norm CUDA kernels (paddle/phi/kernels/gpu/layer_norm_kernel.cu,
+layer_norm_grad_kernel.cu), which fuse the row statistics, the affine and
+the three backward reductions.
+
+Measured verdict (docs/PERF.md): on the GPT-2-small bench this kernel is a
+net LOSS (0.479 -> 0.457 MFU) — XLA's LN fusions look slow in isolation
+(~10x off roofline) but they carry neighboring elementwise work (residual
+adds, casts) that the opaque custom call forces back into separate passes.
+The kernel therefore ships OFF by default (`enable_fused_layernorm(True)`
+to opt in, e.g. for layouts where LN dominates); the measurement is kept
+so the next tuning round doesn't re-learn it.
+
+Layout: x flattened to [N, C]; C must be lane-aligned (%128).  Forward
+saves per-row (mean, rstd) in f32 — the standard fused-LN decomposition.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from . import flash_attention as _fa  # shared _INTERPRET toggle
+
+
+def _interpret():
+    return _fa._INTERPRET
+
+
+_ENABLED = False
+
+
+def enable_fused_layernorm(flag: bool):
+    global _ENABLED
+    _ENABLED = bool(flag)
+
+
+def _ln_fwd_kernel(x_ref, w_ref, b_ref, y_ref, mu_ref, rs_ref, *, eps):
+    x = x_ref[...].astype(jnp.float32)
+    mu = jnp.mean(x, axis=1, keepdims=True)
+    d = x - mu
+    var = jnp.mean(d * d, axis=1, keepdims=True)
+    rs = jax.lax.rsqrt(var + eps)
+    y = d * rs * w_ref[...].astype(jnp.float32) + \
+        b_ref[...].astype(jnp.float32)
+    y_ref[...] = y.astype(y_ref.dtype)
+    mu_ref[...] = mu
+    rs_ref[...] = rs
+
+
+def _ln_bwd_kernel(x_ref, w_ref, mu_ref, rs_ref, dy_ref,
+                   dx_ref, dw_ref, db_ref, dw_acc, db_acc, *, nb):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        dw_acc[...] = jnp.zeros_like(dw_acc)
+        db_acc[...] = jnp.zeros_like(db_acc)
+
+    x = x_ref[...].astype(jnp.float32)
+    dy = dy_ref[...].astype(jnp.float32)
+    mu, rs = mu_ref[...], rs_ref[...]
+    xhat = (x - mu) * rs
+    dyw = dy * w_ref[...].astype(jnp.float32)
+    m1 = jnp.mean(dyw, axis=1, keepdims=True)
+    m2 = jnp.mean(dyw * xhat, axis=1, keepdims=True)
+    dx_ref[...] = (rs * (dyw - m1 - xhat * m2)).astype(dx_ref.dtype)
+    dw_acc[...] += jnp.sum(dy * xhat, axis=0, keepdims=True)
+    db_acc[...] += jnp.sum(dy, axis=0, keepdims=True)
+
+    @pl.when(i == nb - 1)
+    def _finish():
+        dw_ref[...] = dw_acc[...]
+        db_ref[...] = db_acc[...]
+
+
+_ROWS = 512  # rows per block: (512, C) f32 tiles + temporaries in VMEM
+
+
+def _pad_rows(x, rb):
+    n = x.shape[0]
+    pad = (-n) % rb
+    if pad:
+        x = jnp.pad(x, ((0, pad), (0, 0)))
+    return x
+
+
+def _ln_fwd_impl(x2, w, b, eps):
+    n, c = x2.shape
+    rb = min(_ROWS, max(8, n))
+    xp = _pad_rows(x2, rb)
+    npad = xp.shape[0]
+    nb = npad // rb
+    wmap = lambda i: (i * 0,)                      # noqa: E731
+    y, mu, rs = pl.pallas_call(
+        functools.partial(_ln_fwd_kernel, eps=eps),
+        grid=(nb,),
+        in_specs=[
+            pl.BlockSpec((rb, c), lambda i: (i, i * 0)),
+            pl.BlockSpec((c,), wmap),
+            pl.BlockSpec((c,), wmap),
+        ],
+        out_specs=[
+            pl.BlockSpec((rb, c), lambda i: (i, i * 0)),
+            pl.BlockSpec((rb, 1), lambda i: (i, i * 0)),
+            pl.BlockSpec((rb, 1), lambda i: (i, i * 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((npad, c), x2.dtype),
+            jax.ShapeDtypeStruct((npad, 1), jnp.float32),
+            jax.ShapeDtypeStruct((npad, 1), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+            vmem_limit_bytes=64 * 1024 * 1024),
+        interpret=_interpret(),
+    )(xp, w, b)
+    return y[:n], mu[:n], rs[:n]
+
+
+def _ln_bwd_impl(x2, w, mu, rs, dy, eps):
+    n, c = x2.shape
+    rb = min(_ROWS, max(8, n))
+    xp = _pad_rows(x2, rb)
+    dyp = _pad_rows(dy, rb)
+    mup = _pad_rows(mu, rb)
+    rsp = _pad_rows(rs, rb)
+    npad = xp.shape[0]
+    nb = npad // rb
+    wmap = lambda i: (i * 0,)                      # noqa: E731
+    omap = lambda i: (i * 0, i * 0)                # noqa: E731
+    dx, dw, db = pl.pallas_call(
+        functools.partial(_ln_bwd_kernel, nb=nb),
+        grid=(nb,),
+        in_specs=[
+            pl.BlockSpec((rb, c), lambda i: (i, i * 0)),
+            pl.BlockSpec((c,), wmap),
+            pl.BlockSpec((rb, 1), lambda i: (i, i * 0)),
+            pl.BlockSpec((rb, 1), lambda i: (i, i * 0)),
+            pl.BlockSpec((rb, c), lambda i: (i, i * 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((rb, c), lambda i: (i, i * 0)),
+            pl.BlockSpec((1, c), omap),
+            pl.BlockSpec((1, c), omap),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((npad, c), dy.dtype),
+            jax.ShapeDtypeStruct((1, c), jnp.float32),
+            jax.ShapeDtypeStruct((1, c), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((1, c), jnp.float32),
+            pltpu.VMEM((1, c), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+            vmem_limit_bytes=64 * 1024 * 1024),
+        interpret=_interpret(),
+    )(xp, w, mup, rsp, dyp)
+    return dx[:n], dw[0], db[0]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _fused_ln(x2, w, b, eps):
+    y, _, _ = _ln_fwd_impl(x2, w, b, eps)
+    return y
+
+
+def _fused_ln_fwd(x2, w, b, eps):
+    y, mu, rs = _ln_fwd_impl(x2, w, b, eps)
+    return y, (x2, w, mu, rs)
+
+
+def _fused_ln_bwd(eps, res, dy):
+    x2, w, mu, rs = res
+    dx, dw, db = _ln_bwd_impl(x2, w, mu, rs, dy, eps)
+    return dx, dw.astype(w.dtype), db.astype(w.dtype)
+
+
+_fused_ln.defvjp(_fused_ln_fwd, _fused_ln_bwd)
+
+
+def layer_norm_fused(x, weight, bias, eps):
+    """Fused LN over the LAST axis; x any rank >= 2, weight/bias [C]."""
+    shape = x.shape
+    c = shape[-1]
+    x2 = x.reshape(-1, c)
+    y = _fused_ln(x2, weight, bias, float(eps))
+    return y.reshape(shape)
+
+
+def layer_norm_fused_ok(x, axes, weight, bias) -> bool:
+    """Routing predicate: opt-in (see module docstring), last-axis-only
+    affine LN, lane-aligned C, on a real accelerator (or interpret mode
+    for tests)."""
+    if not _ENABLED:
+        return False
+    if weight is None or bias is None or len(axes) != 1:
+        return False
+    if axes[0] != x.ndim - 1 or x.ndim < 2 or x.shape[-1] % 128:
+        return False
+    if _interpret():
+        return True
+    try:
+        import jax.extend.backend as jexb
+        platform = jexb.get_backend().platform
+    except Exception:
+        platform = jax.default_backend()
+    return platform not in ("cpu",)

@@ -168,15 +168,23 @@ class GPTSelfAttention(Layer):
             nh //= jax.lax.axis_size(axis)
         qkv = qkv.reshape([b, t, nh, 3, self.head_dim])
         qkv = _constrain(qkv, P(_U, _U, "mp", _U, _U))
-        q, k, v = (qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2])
-        if cache is not None:
-            from ..ops.manipulation import concat
-            k = concat([cache[0], k], axis=1)
-            v = concat([cache[1], v], axis=1)
-        out = F.scaled_dot_product_attention(
-            q, k, v, dropout_p=self.attn_dropout_prob,
-            is_causal=True, training=self.training)
-        out = out.reshape([b, t, nh * self.head_dim])
+        if cache is None and not use_cache:
+            # fused path: ONE whole-qkv transpose (fuses into the projection
+            # matmul) instead of three per-operand layout copies at the
+            # flash custom-call boundary (docs/PERF.md)
+            out = F.fused_qkv_attention(
+                qkv, dropout_p=self.attn_dropout_prob, is_causal=True,
+                training=self.training)
+        else:
+            q, k, v = (qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2])
+            if cache is not None:
+                from ..ops.manipulation import concat
+                k = concat([cache[0], k], axis=1)
+                v = concat([cache[1], v], axis=1)
+            out = F.scaled_dot_product_attention(
+                q, k, v, dropout_p=self.attn_dropout_prob,
+                is_causal=True, training=self.training)
+            out = out.reshape([b, t, nh * self.head_dim])
         out = _constrain(out, P(_U, _U, "mp"))
         out = self.out_proj(out)
         if use_cache:

@@ -104,6 +104,84 @@ def _flash_spmd(q, k, v, causal, scale):
 
 
 @defop
+def fused_qkv_attention(qkv, dropout_p=0.0, is_causal=True, training=True,
+                        name=None):
+    """Self-attention on the FUSED head-major qkv tensor
+    [batch, seq, heads, 3, head_dim] (the layout GPT/BERT qkv projections
+    produce), returning [batch, seq, heads*head_dim].
+
+    Purpose is performance: one whole-qkv transpose (which XLA fuses into
+    the projection matmul) replaces the three per-operand layout copies the
+    flash custom call otherwise forces, and the flat output feeds the row-
+    parallel out-projection without another boundary copy (docs/PERF.md
+    layout-copy tax; reference analog: fused_attention_op.cu keeps qkv fused
+    for the same reason)."""
+    b, t, nh, three, hd = qkv.shape
+    scale = 1.0 / math.sqrt(hd)
+    from ...distributed import mesh as mesh_mod
+    if three == 3 and dropout_p == 0.0 and not mesh_mod.axis_bound("sep") \
+            and _flash_ok(qkv) and qkv.shape[1] >= 128:
+        try:
+            return _fused_flash_spmd(qkv, is_causal, scale)
+        except FlashUnsupported:
+            pass
+    q, k, v = qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2]
+    if mesh_mod.axis_bound("sep"):
+        if dropout_p and training:
+            raise ValueError(
+                "context parallelism (sep axis) supports only dropout-free "
+                "attention; set attention_dropout_prob=0 or disable sep")
+        from ...kernels.ring_attention import ring_attention
+        out = ring_attention(q, k, v, axis_name="sep", causal=is_causal,
+                             scale=scale)
+    else:
+        out = _sdpa_ref(q, k, v, None, dropout_p, is_causal, scale, training)
+    return out.reshape(b, t, nh * hd)
+
+
+def _fused_flash_spmd(qkv, causal, scale):
+    """Flash path for the fused tensor, shard_map-partitioned when a mesh is
+    live (batch over dp/sharding, heads over mp; output stays head-sharded
+    on the flat hidden dim, which is exactly RowParallelLinear's
+    input_is_parallel convention)."""
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from ...distributed import mesh as mesh_mod
+    from ...kernels.flash_attention import flash_attention_qkv_fused
+
+    b, t, nh, _, hd = qkv.shape
+
+    def local(qkv5):
+        bl, tl, nhl, _, hdl = qkv5.shape
+        # ONE fused operand [BH, 3, T, D]: a single layout copy at the
+        # custom-call boundary covers q, k and v
+        qkvh = jnp.transpose(qkv5, (0, 2, 3, 1, 4)).reshape(
+            bl * nhl, 3, tl, hdl)
+        o3 = flash_attention_qkv_fused(qkvh, causal=causal, scale=scale)
+        return jnp.transpose(o3.reshape(bl, nhl, tl, hdl),
+                             (0, 2, 1, 3)).reshape(bl, tl, nhl * hdl)
+
+    mesh = mesh_mod.get_global_mesh()
+    live = [a for a in ("dp", "sharding", "mp")
+            if mesh is not None and a in mesh.axis_names and
+            mesh.shape.get(a, 1) > 1]
+    if not live:
+        return local(qkv)
+    batch = tuple(a for a in ("dp", "sharding") if a in live)
+    heads = "mp" if "mp" in live else None
+    n_batch = 1
+    for a in batch:
+        n_batch *= mesh.shape[a]
+    if qkv.shape[0] % n_batch or (heads and nh % mesh.shape["mp"]):
+        raise FlashUnsupported("shapes not divisible by mesh axes")
+    import jax
+    in_spec = P(batch if batch else None, None, heads, None, None)
+    out_spec = P(batch if batch else None, None, heads)
+    return jax.shard_map(local, mesh=mesh, in_specs=(in_spec,),
+                         out_specs=out_spec, check_vma=False)(qkv)
+
+
+@defop
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False, training=True,
                                  name=None):

@@ -18,6 +18,11 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
         normalized_shape = (normalized_shape,)
     n_axes = len(tuple(normalized_shape))
     axes = tuple(range(x.ndim - n_axes, x.ndim))
+    from ...kernels.layer_norm import layer_norm_fused, layer_norm_fused_ok
+    if layer_norm_fused_ok(x, axes, weight, bias):
+        # fused Pallas path: one pass per row block incl. the backward's
+        # dgamma/dbeta accumulation (reference layer_norm_kernel.cu analog)
+        return layer_norm_fused(x, weight, bias, epsilon)
     mean = jnp.mean(x, axis=axes, keepdims=True)
     var = jnp.mean(jnp.square(x - mean), axis=axes, keepdims=True)
     out = (x - mean) * jax.lax.rsqrt(var + epsilon)

@@ -10,9 +10,9 @@ from ...core.op import defop
 
 
 def _tuplize(v, n):
-    if isinstance(v, int):
+    if isinstance(v, int) or v is None:
         return (v,) * n
-    v = tuple(int(x) for x in v)
+    v = tuple(None if x is None else int(x) for x in v)
     return v * n if len(v) == 1 else v
 
 
@@ -29,8 +29,80 @@ def _pad_spec(padding, n):
     return [tuple(p) for p in padding[-n:]]
 
 
+def _max_pool_patches(x, kernel, stride, lax_pad, n, channel_last, spatial,
+                      with_index=False):
+    """Max pooling as window-patch extraction + reduce-max.  Channel-first
+    internally; returns (out, flat_spatial_indices) when with_index (the
+    reference max_pool*d return_mask contract: indices into the flattened
+    UNPADDED input spatial volume)."""
+    # pad with a LARGE finite negative, not -inf and not f32-min: patch
+    # extraction is a one-hot convolution, -inf * 0 = NaN, and f32-min
+    # overflows to -inf under the TPU's default bf16 conv passes
+    neg = (jnp.asarray(-1e30, x.dtype)
+           if jnp.issubdtype(x.dtype, jnp.floating)
+           else jnp.iinfo(x.dtype).min)
+    if channel_last:
+        perm = (0, x.ndim - 1) + tuple(range(1, x.ndim - 1))
+        x = jnp.transpose(x, perm)
+    orig_spatial = x.shape[2:]
+    if isinstance(lax_pad, str):
+        if lax_pad.upper() == "SAME":
+            # materialize SAME pads explicitly: the patch conv would pad
+            # with 0 (wrong identity for max) and the mask indices need
+            # the true low pads
+            sp = []
+            for i in range(n):
+                size = orig_spatial[i]
+                out = -(-size // stride[i])
+                total = max(0, (out - 1) * stride[i] + kernel[i] - size)
+                sp.append((total // 2, total - total // 2))
+            lax_pad = None  # handled below
+        else:
+            sp = [(0, 0)] * n
+    else:
+        sp = [lax_pad[d] for d in spatial]
+    pads = "VALID"
+    pad_lo = [p[0] for p in sp]
+    if any(p != (0, 0) for p in sp):
+        x = jnp.pad(x, [(0, 0), (0, 0)] + list(sp), constant_values=neg)
+    c = x.shape[1]
+    # HIGHEST precision: the one-hot conv must not round the values
+    # through bf16 passes
+    patches = jax.lax.conv_general_dilated_patches(
+        x, kernel, stride, pads, precision=jax.lax.Precision.HIGHEST)
+    ksz = int(np.prod(kernel))
+    out_spatial = patches.shape[2:]
+    # feature dim ordering: [C, *kernel] (C slowest)
+    patches = patches.reshape((patches.shape[0], c, ksz) + out_spatial)
+    out = jnp.max(patches, axis=2)
+
+    def to_layout(t):
+        if channel_last:
+            return jnp.transpose(t, (0,) + tuple(range(2, t.ndim)) + (1,))
+        return t
+
+    if not with_index:
+        return to_layout(out)
+    widx = jnp.argmax(patches, axis=2)  # row-major index within the window
+    offs = []
+    rem = widx
+    for k in reversed(kernel):
+        offs.append(rem % k)
+        rem = rem // k
+    offs = offs[::-1]
+    flat = None
+    for i in range(n):
+        grid = jnp.arange(out_spatial[i]) * stride[i]
+        shape = [1] * widx.ndim
+        shape[2 + i] = out_spatial[i]
+        coord = grid.reshape(shape) + offs[i] - pad_lo[i]
+        coord = jnp.clip(coord, 0, orig_spatial[i] - 1)
+        flat = coord if flat is None else flat * orig_spatial[i] + coord
+    return to_layout(out), to_layout(flat.astype(jnp.int64))
+
+
 def _pool(x, kernel, stride, padding, n, channel_last, kind, ceil_mode=False,
-          exclusive=True):
+          exclusive=True, return_mask=False):
     kernel = _tuplize(kernel, n)
     stride = _tuplize(stride if stride is not None else kernel, n)
     pad = _pad_spec(padding, n)
@@ -60,9 +132,14 @@ def _pool(x, kernel, stride, padding, n, channel_last, kind, ceil_mode=False,
         lax_pad = full
 
     if kind == "max":
-        init = jnp.array(-jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
-                         else jnp.iinfo(x.dtype).min, dtype=x.dtype)
-        return jax.lax.reduce_window(x, init, jax.lax.max, window, strides, lax_pad)
+        # patches + jnp.max instead of reduce_window(lax.max): the generic
+        # reduce_window JVP fails partial-eval when nested inside the eager
+        # tape's per-op vjp ("Linearization failed to produce known
+        # values"), and the patch form yields window argmax indices for
+        # return_mask anyway
+        return _max_pool_patches(x, kernel, stride, lax_pad, n,
+                                 channel_last, spatial,
+                                 with_index=return_mask)
 
     # avg pool: sum then divide (exclusive → divide by actual window size)
     zero = jnp.zeros((), x.dtype)
@@ -79,21 +156,21 @@ def _pool(x, kernel, stride, padding, n, channel_last, kind, ceil_mode=False,
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCL", name=None):
     return _pool(x, kernel_size, stride, padding, 1, data_format == "NLC",
-                 "max", ceil_mode)
+                 "max", ceil_mode, return_mask=return_mask)
 
 
 @defop
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCHW", name=None):
     return _pool(x, kernel_size, stride, padding, 2, data_format == "NHWC",
-                 "max", ceil_mode)
+                 "max", ceil_mode, return_mask=return_mask)
 
 
 @defop
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW", name=None):
     return _pool(x, kernel_size, stride, padding, 3, data_format == "NDHWC",
-                 "max", ceil_mode)
+                 "max", ceil_mode, return_mask=return_mask)
 
 
 @defop

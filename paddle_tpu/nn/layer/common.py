@@ -92,6 +92,7 @@ class Embedding(Layer):
         self._embedding_dim = embedding_dim
         self._padding_idx = None if padding_idx is None else \
             (padding_idx if padding_idx >= 0 else num_embeddings + padding_idx)
+        self._sparse = bool(sparse)
         self.weight = self.create_parameter(
             (num_embeddings, embedding_dim), attr=weight_attr,
             default_initializer=I.XavierUniform())
@@ -100,6 +101,17 @@ class Embedding(Layer):
                 self.weight._value.at[self._padding_idx].set(0), None)
 
     def forward(self, x):
+        from ...core import autograd
+        if self._sparse and autograd.is_grad_enabled():
+            import jax
+            if not isinstance(self.weight._value, jax.core.Tracer):
+                # eager: SelectedRows weight-grad (reference
+                # Embedding(sparse=True) -> selected-rows lookup grad);
+                # under trace the dense GSPMD path applies (see
+                # core/selected_rows.py scope note)
+                from ...core.selected_rows import sparse_embedding_lookup
+                return sparse_embedding_lookup(self.weight, x,
+                                               self._padding_idx)
         return F.embedding(x, self.weight, padding_idx=self._padding_idx)
 
     def extra_repr(self):

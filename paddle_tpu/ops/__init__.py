@@ -1,6 +1,7 @@
 """The op corpus.  Importing this package registers every op (and its Tensor
 methods) with the core registry — the analog of phi kernel registration."""
-from . import creation, math, reduction, manipulation, logic, linalg, search, random_ops  # noqa: F401
+from . import creation, math, reduction, manipulation, logic, linalg, search, random_ops, extended  # noqa: F401
+from .extended import *  # noqa: F401,F403
 from .creation import *  # noqa: F401,F403
 from .math import *  # noqa: F401,F403
 from .reduction import *  # noqa: F401,F403

@@ -1,0 +1,406 @@
+"""Long-tail ops from the reference op inventory (phi/api/yaml/ops.yaml)
+that have no alias elsewhere in this registry: math extensions (addmm,
+logit, renorm, norm clips), tensor surgery (diag_embed, fill_diagonal,
+unstack, crop, shard_index), signal framing (frame / overlap_add), sequence
+decoding (gather_tree, viterbi_decode, edit_distance), LU factorization,
+and the sampling-grid family (affine_grid / grid_sample / temporal_shift /
+max_unpool2d).  Kernels cited per op; all are jnp/lax compositions — XLA
+fuses them, no hand kernels needed at these sizes.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.op import defop
+
+__all__ = [
+    "addmm", "logit", "renorm", "clip_by_norm", "squared_l2_norm",
+    "unstack", "diag_embed", "fill", "fill_diagonal",
+    "fill_diagonal_tensor", "crop_tensor", "shard_index", "tril_indices",
+    "triu_indices", "frame", "overlap_add", "gather_tree",
+    "viterbi_decode", "edit_distance", "lu", "lu_unpack", "affine_grid",
+    "grid_sample", "temporal_shift", "bilinear_tensor_product",
+    "max_unpool2d",
+]
+
+
+@defop
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):  # noqa: A002
+    """phi addmm_kernel: beta*input + alpha*(x@y)."""
+    return beta * input + alpha * (x @ y)
+
+
+@defop
+def logit(x, eps=None, name=None):
+    """phi logit_kernel: log(x/(1-x)), clipped to [eps, 1-eps]."""
+    if eps is not None:
+        x = jnp.clip(x, eps, 1.0 - eps)
+    return jnp.log(x) - jnp.log1p(-x)
+
+
+@defop
+def renorm(x, p, axis, max_norm, name=None):
+    """phi renorm_kernel: clamp the p-norm of every slice along `axis`."""
+    axes = tuple(i for i in range(x.ndim) if i != axis % x.ndim)
+    norms = jnp.sum(jnp.abs(x) ** p, axis=axes, keepdims=True) ** (1.0 / p)
+    scale = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+    return x * scale
+
+
+@defop
+def clip_by_norm(x, max_norm, name=None):
+    """phi clip_by_norm_kernel: x * min(1, max_norm/||x||2)."""
+    n = jnp.sqrt(jnp.sum(jnp.square(x)))
+    return x * jnp.minimum(1.0, max_norm / jnp.maximum(n, 1e-12))
+
+
+@defop
+def squared_l2_norm(x, name=None):
+    """phi squared_l2_norm_kernel (the grad-clip building block)."""
+    return jnp.sum(jnp.square(x)).reshape(1)
+
+
+@defop
+def unstack(x, axis=0, num=None, name=None):
+    """phi unstack_kernel: split into `num` rank-1-lower tensors."""
+    n = num or x.shape[axis]
+    parts = jnp.split(x, n, axis=axis)
+    return [jnp.squeeze(p, axis=axis) for p in parts]
+
+
+@defop
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):  # noqa: A002
+    """phi diag_embed_kernel: batched vector -> banded matrix."""
+    last = input.shape[-1]
+    size = last + abs(offset)
+    batch = input.shape[:-1]
+    out = jnp.zeros(batch + (size, size), input.dtype)
+    rng = jnp.arange(last)
+    rows = rng + max(-offset, 0)
+    cols = rng + max(offset, 0)
+    out = out.at[..., rows, cols].set(input)
+    d1 = dim1 % (out.ndim)
+    d2 = dim2 % (out.ndim)
+    if (d1, d2) != (out.ndim - 2, out.ndim - 1):
+        perm = [i for i in range(out.ndim) if i not in (out.ndim - 2,
+                                                        out.ndim - 1)]
+        order = list(range(out.ndim - 2))
+        full = [None] * out.ndim
+        full[d1] = out.ndim - 2
+        full[d2] = out.ndim - 1
+        it = iter(order)
+        for i in range(out.ndim):
+            if full[i] is None:
+                full[i] = next(it)
+        out = jnp.transpose(out, full)
+    return out
+
+
+@defop
+def fill(x, value, name=None):
+    """fill_ kernel semantics (value-broadcast copy)."""
+    return jnp.full_like(x, value)
+
+
+@defop
+def fill_diagonal(x, value, offset=0, wrap=False, name=None):
+    """phi fill_diagonal_kernel (2-D)."""
+    n = min(x.shape[0], x.shape[1])
+    rng = jnp.arange(n)
+    rows = rng + max(-offset, 0)
+    cols = rng + max(offset, 0)
+    keep = (rows < x.shape[0]) & (cols < x.shape[1])
+    rows = jnp.where(keep, rows, 0)
+    cols = jnp.where(keep, cols, 0)
+    vals = jnp.where(keep, jnp.full((n,), value, x.dtype), x[rows, cols])
+    return x.at[rows, cols].set(vals)
+
+
+@defop
+def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1, name=None):
+    """phi fill_diagonal_tensor_kernel: write tensor y onto a diagonal."""
+    n = y.shape[-1] if hasattr(y, "shape") and y.ndim else \
+        min(x.shape[dim1], x.shape[dim2])
+    rng = jnp.arange(n)
+    idx = [slice(None)] * x.ndim
+    idx[dim1] = rng + max(-offset, 0)
+    idx[dim2] = rng + max(offset, 0)
+    return x.at[tuple(idx)].set(y)
+
+
+@defop
+def crop_tensor(x, shape, offsets=None, name=None):
+    """phi crop_kernel: static-window crop."""
+    offsets = offsets or [0] * x.ndim
+    slices = tuple(np.s_[o:o + s] for o, s in zip(offsets, shape))
+    return x[slices]
+
+
+@defop
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1,  # noqa: A002
+                name=None):
+    """phi shard_index_kernel (PS sharded-embedding id relocation)."""
+    per = (index_num + nshards - 1) // nshards
+    local = input - shard_id * per
+    mine = (input // per) == shard_id
+    return jnp.where(mine, local, ignore_value)
+
+
+@defop
+def tril_indices(row, col=None, offset=0, dtype="int64", name=None):
+    r, c = np.tril_indices(row, offset, col or row)
+    return jnp.asarray(np.stack([r, c]), dtype)
+
+
+@defop
+def triu_indices(row, col=None, offset=0, dtype="int64", name=None):
+    r, c = np.triu_indices(row, offset, col or row)
+    return jnp.asarray(np.stack([r, c]), dtype)
+
+
+@defop
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """phi frame_kernel (STFT framing): [..., T] -> [..., frame_length,
+    num_frames] for axis=-1 (the reference's default layout)."""
+    t = x.shape[axis]
+    n = 1 + (t - frame_length) // hop_length
+    starts = np.arange(n) * hop_length
+    frames = jnp.stack([jax.lax.slice_in_dim(x, int(s), int(s) +
+                                             frame_length, axis=axis)
+                        for s in starts], axis=-1)
+    return frames
+
+
+@defop
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """phi overlap_add_kernel: inverse of `frame` ([..., frame_length, n]
+    -> [..., T])."""
+    frame_length = x.shape[-2]
+    n = x.shape[-1]
+    t = (n - 1) * hop_length + frame_length
+    out = jnp.zeros(x.shape[:-2] + (t,), x.dtype)
+    for i in range(n):
+        sl = [np.s_[:]] * out.ndim
+        sl[-1] = np.s_[i * hop_length:i * hop_length + frame_length]
+        out = out.at[tuple(sl)].add(x[..., i])
+    return out
+
+
+@defop
+def gather_tree(ids, parents, name=None):
+    """phi gather_tree_kernel: beam-search backtrace over
+    [max_time, batch, beam]."""
+    t_max = ids.shape[0]
+    out = [None] * t_max
+    out[t_max - 1] = ids[t_max - 1]
+    parent = parents[t_max - 1]
+    beams = jnp.arange(ids.shape[2])[None, :]
+    cur = parent
+    for t in range(t_max - 2, -1, -1):
+        out[t] = jnp.take_along_axis(ids[t], cur, axis=1)
+        cur = jnp.take_along_axis(parents[t], cur, axis=1)
+    return jnp.stack(out, axis=0)
+
+
+@defop
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag=True, name=None):
+    """phi viterbi_decode_kernel: CRF max-sum decode.
+    potentials [B, T, C], transition [C, C] -> (scores [B], paths [B, T])."""
+    b, t, c = potentials.shape
+    if include_bos_eos_tag:
+        # reference convention: last two tags are BOS/EOS
+        start = transition_params[c - 2][None, :]
+        stop = transition_params[:, c - 1]
+    else:
+        start = jnp.zeros((1, c), potentials.dtype)
+        stop = jnp.zeros((c,), potentials.dtype)
+    if lengths is None:
+        lens = jnp.full((b,), t, jnp.int32)
+    else:
+        lens = jnp.asarray(lengths).astype(jnp.int32)
+    alpha = potentials[:, 0] + start
+    back = []
+    ident = jnp.broadcast_to(jnp.arange(c)[None], (b, c))
+    for i in range(1, t):
+        # [B, C_from, C_to]
+        scores = alpha[:, :, None] + transition_params[None]
+        live = (i < lens)[:, None]
+        # past a sequence's length: freeze alpha and record an identity
+        # backpointer so the backtrace passes through unchanged
+        best = jnp.where(live, jnp.argmax(scores, axis=1), ident)
+        alpha = jnp.where(live,
+                          jnp.max(scores, axis=1) + potentials[:, i],
+                          alpha)
+        back.append(best)
+    alpha = alpha + stop[None, :] if include_bos_eos_tag else alpha
+    last = jnp.argmax(alpha, axis=1)
+    scores = jnp.max(alpha, axis=1)
+    path = [last]
+    for best in reversed(back):
+        last = jnp.take_along_axis(best, last[:, None], axis=1)[:, 0]
+        path.append(last)
+    return scores, jnp.stack(path[::-1], axis=1).astype(jnp.int64)
+
+
+@defop
+def edit_distance(input, label, normalized=True, input_length=None,  # noqa: A002
+                  label_length=None, name=None):
+    """phi edit_distance_kernel: batched Levenshtein distance over int id
+    sequences ([B, T1] vs [B, T2]); returns (distances [B, 1],
+    sequence_num [1])."""
+    a = np.asarray(input)
+    lb = np.asarray(label)
+    il = (np.asarray(input_length) if input_length is not None
+          else np.full(a.shape[0], a.shape[1]))
+    ll = (np.asarray(label_length) if label_length is not None
+          else np.full(lb.shape[0], lb.shape[1]))
+    out = np.zeros((a.shape[0], 1), np.float32)
+    for bi in range(a.shape[0]):
+        n, m = int(il[bi]), int(ll[bi])
+        d = np.arange(m + 1, dtype=np.int64)
+        for i in range(1, n + 1):
+            prev = d.copy()
+            d[0] = i
+            for j in range(1, m + 1):
+                cost = 0 if a[bi, i - 1] == lb[bi, j - 1] else 1
+                d[j] = min(d[j - 1] + 1, prev[j] + 1, prev[j - 1] + cost)
+        dist = float(d[m])
+        out[bi, 0] = dist / m if (normalized and m) else dist
+    return jnp.asarray(out), jnp.asarray([a.shape[0]], jnp.int64)
+
+
+@defop
+def lu(x, pivot=True, name=None):
+    """phi lu_kernel: packed LU factorization (factor, pivots, info)."""
+    lu_mat, piv = jax.scipy.linalg.lu_factor(x)
+    info = jnp.zeros(x.shape[:-2], jnp.int32)
+    return lu_mat, (piv + 1).astype(jnp.int32), info  # 1-based like paddle
+
+
+@defop
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """phi lu_unpack_kernel: (packed_lu, pivots) -> (P, L, U); batched over
+    leading dims like the reference."""
+    n, m = x.shape[-2], x.shape[-1]
+    l = jnp.tril(x, -1) + jnp.eye(n, m, dtype=x.dtype)
+    u = jnp.triu(x)
+    piv = np.asarray(y).reshape((-1, np.asarray(y).shape[-1])) - 1
+    batch = piv.shape[0]
+    pmats = np.zeros((batch, n, n), np.float64)
+    for bi in range(batch):
+        perm = np.arange(n)
+        for i, p in enumerate(piv[bi][:n]):
+            perm[i], perm[int(p)] = perm[int(p)], perm[i]
+        pmats[bi] = np.eye(n)[perm].T
+    pmat = jnp.asarray(pmats, x.dtype).reshape(x.shape[:-2] + (n, n))
+    return pmat, l[..., :n, :min(n, m)], u
+
+
+@defop
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """phi affine_grid_kernel: [N, 2, 3] -> sampling grid [N, H, W, 2]."""
+    n, h, w = out_shape[0], out_shape[-2], out_shape[-1]
+    if align_corners:
+        ys = jnp.linspace(-1.0, 1.0, h)
+        xs = jnp.linspace(-1.0, 1.0, w)
+    else:
+        ys = (jnp.arange(h) * 2 + 1) / h - 1
+        xs = (jnp.arange(w) * 2 + 1) / w - 1
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # [H, W, 3]
+    return jnp.einsum("nij,hwj->nhwi", theta.astype(jnp.float32),
+                      base.astype(jnp.float32))
+
+
+@defop
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """phi grid_sample_kernel: NCHW bilinear/nearest sampling at
+    normalized grid coords [N, H', W', 2]."""
+    n, c, h, w = x.shape
+    gx, gy = grid[..., 0], grid[..., 1]
+    if align_corners:
+        fx = (gx + 1) * 0.5 * (w - 1)
+        fy = (gy + 1) * 0.5 * (h - 1)
+    else:
+        fx = ((gx + 1) * w - 1) * 0.5
+        fy = ((gy + 1) * h - 1) * 0.5
+
+    def sample(ix, iy):
+        inb = (ix >= 0) & (ix < w) & (iy >= 0) & (iy < h)
+        ixc = jnp.clip(ix, 0, w - 1)
+        iyc = jnp.clip(iy, 0, h - 1)
+        vals = x[jnp.arange(n)[:, None, None], :, iyc, ixc]  # [N,H',W',C]
+        if padding_mode == "zeros":
+            vals = jnp.where(inb[..., None], vals, 0.0)
+        return vals
+
+    if mode == "nearest":
+        out = sample(jnp.round(fx).astype(jnp.int32),
+                     jnp.round(fy).astype(jnp.int32))
+    else:
+        x0 = jnp.floor(fx).astype(jnp.int32)
+        y0 = jnp.floor(fy).astype(jnp.int32)
+        x1, y1 = x0 + 1, y0 + 1
+        wa = (x1 - fx) * (y1 - fy)
+        wb = (fx - x0) * (y1 - fy)
+        wc = (x1 - fx) * (fy - y0)
+        wd = (fx - x0) * (fy - y0)
+        out = (sample(x0, y0) * wa[..., None] +
+               sample(x1, y0) * wb[..., None] +
+               sample(x0, y1) * wc[..., None] +
+               sample(x1, y1) * wd[..., None])
+    return jnp.moveaxis(out, -1, 1)  # [N, C, H', W']
+
+
+@defop
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    """phi temporal_shift_kernel (TSM): shift a channel fraction one step
+    along the segment (time) axis; x is [N*T, C, H, W]."""
+    nt, c, h, w = x.shape
+    n = nt // seg_num
+    v = x.reshape(n, seg_num, c, h, w)
+    fold = int(c * shift_ratio)
+    left = jnp.concatenate([v[:, 1:, :fold], jnp.zeros_like(v[:, :1, :fold])],
+                           axis=1)
+    right = jnp.concatenate([jnp.zeros_like(v[:, :1, fold:2 * fold]),
+                             v[:, :-1, fold:2 * fold]], axis=1)
+    rest = v[:, :, 2 * fold:]
+    return jnp.concatenate([left, right, rest], axis=2).reshape(nt, c, h, w)
+
+
+@defop
+def bilinear_tensor_product(x, y, weight, bias=None, name=None):
+    """phi bilinear_kernel: out[b, o] = x[b] @ W[o] @ y[b] (+ bias)."""
+    out = jnp.einsum("bm,omn,bn->bo", x, weight, y)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@defop
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCHW", name=None):
+    """phi unpool_kernel: scatter pooled values back to the indices
+    recorded by max_pool2d(return_mask=True)."""
+    n, c, h, w = x.shape
+    stride = stride or kernel_size
+    if output_size is None:
+        oh = (h - 1) * (stride if isinstance(stride, int) else stride[0]) \
+            + (kernel_size if isinstance(kernel_size, int)
+               else kernel_size[0]) - 2 * padding
+        ow = (w - 1) * (stride if isinstance(stride, int) else stride[1]) \
+            + (kernel_size if isinstance(kernel_size, int)
+               else kernel_size[1]) - 2 * padding
+    else:
+        oh, ow = output_size[-2], output_size[-1]
+    flat = jnp.zeros((n, c, oh * ow), x.dtype)
+    idx = indices.reshape(n, c, -1)
+    flat = flat.at[jnp.arange(n)[:, None, None],
+                   jnp.arange(c)[None, :, None], idx].set(
+        x.reshape(n, c, -1))
+    return flat.reshape(n, c, oh, ow)

@@ -240,7 +240,8 @@ def index_sample(x, index, name=None):
 
 @defop(tensor_method="index_add")
 def index_add(x, index, axis, value, name=None):
-    sl = [slice(None)] * x.ndim
+    # np.s_[:] — the module-level `slice` op shadows the builtin here
+    sl = [np.s_[:]] * x.ndim
     sl[int(axis) % x.ndim] = index
     return x.at[tuple(sl)].add(value)
 

@@ -94,11 +94,17 @@ class Optimizer:
     # -- eager step ---------------------------------------------------------
     @no_grad()
     def step(self):
+        from ..core.selected_rows import SelectedRows
         self._step_count += 1
         lr = self.get_lr()
         params_grads = [(p, p.grad) for p in self._parameters
                         if not p.stop_gradient and p.grad is not None]
         if self._grad_clip is not None:
+            # selected-rows grads densify for global clipping (the
+            # reference merges selected_rows in ClipGradByGlobalNorm too)
+            params_grads = [(p, Tensor(g.to_dense(), stop_gradient=True)
+                             if isinstance(g, SelectedRows) else g)
+                            for p, g in params_grads]
             params_grads = self._grad_clip(params_grads)
         t = self._step_count
         for p, g in params_grads:
@@ -109,10 +115,37 @@ class Optimizer:
             plr = lr * p.optimize_attr.get("learning_rate", 1.0) \
                 if isinstance(p, Parameter) else lr
             ctx = {"decay": self._decay_coeff(p)}
-            new_p, new_slots = self.update(p._value, g._value.astype(p._value.dtype),
-                                           slots, plr, t, ctx)
+            if isinstance(g, SelectedRows):
+                new_p, new_slots = self.update_sparse(
+                    p._value, g.merged(), slots, plr, t, ctx)
+            else:
+                new_p, new_slots = self.update(
+                    p._value, g._value.astype(p._value.dtype), slots, plr,
+                    t, ctx)
             p._replace_(new_p, None)
             self._slots[id(p)] = new_slots
+
+    def update_sparse(self, p, g, slots, lr, t, ctx):
+        """Row-wise update for SelectedRows grads.  Default: LAZY mode
+        (the reference's sparse adam `lazy_mode`, adam_op.h:470): gather
+        the touched rows of param+slots, run the dense rule on that slice,
+        scatter back — untouched rows see no decay and no moment decay."""
+        rows = g.rows
+        sub_p = p[rows]
+        sub_slots = {k: (v[rows] if getattr(v, "ndim", 0) and
+                         v.shape[:1] == p.shape[:1] else v)
+                     for k, v in slots.items()}
+        new_sub, new_sub_slots = self.update(
+            sub_p, g.values.astype(p.dtype), sub_slots, lr, t, ctx)
+        new_p = p.at[rows].set(new_sub.astype(p.dtype))
+        new_slots = {}
+        for k, v in slots.items():
+            nv = new_sub_slots[k]
+            if getattr(v, "ndim", 0) and v.shape[:1] == p.shape[:1]:
+                new_slots[k] = v.at[rows].set(nv)
+            else:
+                new_slots[k] = nv
+        return new_p, new_slots
 
     def clear_grad(self, set_to_zero=True):
         for p in self._parameters:

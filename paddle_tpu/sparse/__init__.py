@@ -226,3 +226,105 @@ class nn:
     class ReLU:
         def __call__(self, x):
             return relu(x)
+
+
+# -- value-wise unary family (sparse_ops.yaml: abs/sin/.../sqrt applied to
+# stored values only, zero-preserving by construction) ------------------------
+
+def _valuewise(fn):
+    def op(x, name=None):
+        x = _coerce_coo(x)
+        return SparseCooTensor(jsparse.BCOO((fn(x._bcoo.data),
+                                             x._bcoo.indices),
+                                            shape=x._bcoo.shape))
+    return op
+
+
+abs = _valuewise(jnp.abs)          # noqa: A001
+sin = _valuewise(jnp.sin)
+sinh = _valuewise(jnp.sinh)
+asin = _valuewise(jnp.arcsin)
+asinh = _valuewise(jnp.arcsinh)
+tan = _valuewise(jnp.tan)
+tanh = _valuewise(jnp.tanh)
+atan = _valuewise(jnp.arctan)
+atanh = _valuewise(jnp.arctanh)
+sqrt = _valuewise(jnp.sqrt)
+square = _valuewise(jnp.square)
+log1p = _valuewise(jnp.log1p)
+expm1 = _valuewise(jnp.expm1)
+relu6 = _valuewise(lambda v: jnp.clip(v, 0, 6))
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return _valuewise(lambda v: jnp.where(v > 0, v,
+                                          negative_slope * v))(x)
+
+
+def pow(x, factor, name=None):  # noqa: A001
+    return _valuewise(lambda v: v ** factor)(x)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, name=None):
+    # bias on a sparse tensor only touches stored values (yaml scale op)
+    return _valuewise(lambda v: v * scale + bias if bias_after_scale
+                      else (v + bias) * scale)(x)
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    x = _coerce_coo(x)
+    idx = x._bcoo.indices.astype(index_dtype) if index_dtype else \
+        x._bcoo.indices
+    data = x._bcoo.data.astype(value_dtype) if value_dtype else x._bcoo.data
+    return SparseCooTensor(jsparse.BCOO((data, idx), shape=x._bcoo.shape))
+
+
+def subtract(x, y, name=None):
+    return add(x, scale(_coerce_coo(y), -1.0)
+               if isinstance(y, (SparseCooTensor, SparseCsrTensor))
+               else Tensor(-_val(y), _internal=True))
+
+
+def divide(x, y, name=None):
+    if isinstance(y, (SparseCooTensor, SparseCsrTensor)):
+        raise ValueError("sparse/sparse divide is undefined off the "
+                         "intersection; densify first")
+    return multiply(x, Tensor(1.0 / _val(y), _internal=True))
+
+
+def divide_scalar(x, scalar, name=None):
+    return _valuewise(lambda v: v / scalar)(x)
+
+
+def mv(x, vec, name=None):
+    """sparse matrix @ dense vector (sparse_ops.yaml mv)."""
+    x = _coerce_coo(x)
+    return Tensor(x._bcoo @ _val(vec), _internal=True)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):  # noqa: A002
+    """beta*input + alpha*(sparse x @ dense y)."""
+    x = _coerce_coo(x)
+    return Tensor(beta * _val(input) + alpha * (x._bcoo @ _val(y)),
+                  _internal=True)
+
+
+def softmax(x, axis=-1, name=None):
+    """Row-wise softmax over stored values only (phi sparse softmax:
+    implicit zeros do NOT participate) — one segment_max/segment_sum pass
+    over the CSR values, O(1) device dispatches regardless of row count."""
+    if axis not in (-1, 1):
+        raise ValueError("sparse softmax supports the last axis only")
+    csr = SparseCsrTensor.from_coo(_coerce_coo(x)) \
+        if isinstance(x, SparseCooTensor) else x
+    import numpy as _np
+    crows = _np.asarray(csr._crows)
+    counts = _np.diff(crows)
+    row_ids = jnp.asarray(_np.repeat(_np.arange(len(counts)), counts))
+    vals = csr._values
+    nrows = len(counts)
+    row_max = jax.ops.segment_max(vals, row_ids, num_segments=nrows)
+    e = jnp.exp(vals - row_max[row_ids])
+    row_sum = jax.ops.segment_sum(e, row_ids, num_segments=nrows)
+    out = e / row_sum[row_ids]
+    return SparseCsrTensor(csr._crows, csr._cols, out, csr.shape)

@@ -1,0 +1,61 @@
+"""paddle.strings parity — the phi strings op family
+(paddle/phi/api/yaml/strings_ops.yaml: empty, empty_like, lower, upper over
+StringTensor; kernels in phi/kernels/strings/, CPU-only in the reference
+too).
+
+TPU-native scope: string data never touches the accelerator (same as the
+reference — pstring lives on host); the StringTensor here wraps a numpy
+unicode array and the ops vectorize via np.char.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["StringTensor", "to_string_tensor", "empty", "empty_like",
+           "lower", "upper"]
+
+
+class StringTensor:
+    """Host-resident string tensor (phi/core/string_tensor.h analog)."""
+
+    def __init__(self, data):
+        self._data = np.asarray(data, dtype=np.str_)
+
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    def numpy(self):
+        return self._data
+
+    def tolist(self):
+        return self._data.tolist()
+
+    def __repr__(self):
+        return f"StringTensor(shape={self.shape}, {self._data!r})"
+
+    def __eq__(self, other):
+        other = other._data if isinstance(other, StringTensor) else other
+        return bool(np.array_equal(self._data, np.asarray(other)))
+
+
+def to_string_tensor(data) -> StringTensor:
+    return data if isinstance(data, StringTensor) else StringTensor(data)
+
+
+def empty(shape, name=None) -> StringTensor:
+    return StringTensor(np.full(tuple(shape), "", dtype=np.str_))
+
+
+def empty_like(x, name=None) -> StringTensor:
+    return empty(to_string_tensor(x).shape)
+
+
+def lower(x, use_utf8_encoding=True, name=None) -> StringTensor:
+    """strings_lower kernel: elementwise lowercase (utf8-aware — numpy
+    unicode arrays are code-point based, matching the utf8 path)."""
+    return StringTensor(np.char.lower(to_string_tensor(x).numpy()))
+
+
+def upper(x, use_utf8_encoding=True, name=None) -> StringTensor:
+    return StringTensor(np.char.upper(to_string_tensor(x).numpy()))

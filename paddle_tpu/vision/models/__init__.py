@@ -26,6 +26,9 @@ from .squeezenet import SqueezeNet, squeezenet1_0, squeezenet1_1  # noqa: F401
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
 from .yolo import YOLOv3, YOLOv3Loss, yolov3  # noqa: F401
 from .crnn import CRNN, CTCHeadLoss, crnn, ctc_greedy_decode  # noqa: F401
+from .ppyoloe import (PPYOLOE, PPYOLOELoss, ppyoloe_crn_s,  # noqa: F401
+                      ppyoloe_s)
+from .ppocr import SVTRRec, ppocrv3_rec  # noqa: F401
 
 __all__ = [  # noqa: F405
     "ResNet", "resnet18", "resnet34", "resnet50", "resnet101", "resnet152",
@@ -46,4 +49,6 @@ __all__ = [  # noqa: F405
     "GoogLeNet", "googlenet", "InceptionV3", "inception_v3",
     "YOLOv3", "YOLOv3Loss", "yolov3",
     "CRNN", "CTCHeadLoss", "crnn", "ctc_greedy_decode",
+    "PPYOLOE", "PPYOLOELoss", "ppyoloe_s", "ppyoloe_crn_s",
+    "SVTRRec", "ppocrv3_rec",
 ]

@@ -1,0 +1,116 @@
+"""PP-OCRv3-class text recognizer (BASELINE.md row 6).
+
+PP-OCRv3's recognition model is SVTR-LCNet (PaddleOCR
+ppocr/modeling/{backbones/rec_svtrnet.py, heads/rec_ctc_head.py}): a conv
+stem that patch-embeds the text line, mixing stages that alternate LOCAL
+mixing (depthwise-conv over a neighborhood) with GLOBAL mixing (multi-head
+self-attention over the width), then a CTC head.  The reference repo
+in-tree only carries the kernel surface (warpctc / ctc_loss).
+
+TPU-first notes: height is collapsed early so attention runs over the
+width sequence only (short, ~40 tokens — dense attention, no flash
+needed); all mixing is matmul/conv on MXU; CTC training reuses
+vision.models.crnn.CTCHeadLoss (lax.scan forward algorithm)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ... import nn
+from ...ops.manipulation import concat
+from .crnn import CTCHeadLoss  # noqa: F401  (re-export for recipes)
+
+
+class _ConvBNAct(nn.Layer):
+    def __init__(self, cin, cout, k=3, stride=1, groups=1):
+        super().__init__()
+        self.conv = nn.Conv2D(cin, cout, k, stride=stride,
+                              padding=(k - 1) // 2, groups=groups,
+                              bias_attr=False)
+        self.bn = nn.BatchNorm2D(cout)
+        self.act = nn.Swish()
+
+    def forward(self, x):
+        return self.act(self.bn(self.conv(x)))
+
+
+class LocalMixBlock(nn.Layer):
+    """SVTR local mixing: depthwise conv neighborhood mixing + pointwise
+    channel MLP, both residual (rec_svtrnet.py ConvMixer shape)."""
+
+    def __init__(self, dim, mlp_ratio=2.0):
+        super().__init__()
+        self.norm1 = nn.LayerNorm(dim)
+        self.dw = nn.Conv2D(dim, dim, 3, padding=1, groups=dim)
+        self.norm2 = nn.LayerNorm(dim)
+        hidden = int(dim * mlp_ratio)
+        self.fc1 = nn.Linear(dim, hidden)
+        self.fc2 = nn.Linear(hidden, dim)
+
+    def forward(self, x):
+        # x: [N, T, C] over a [H=1, W=T] lattice
+        n, t, c = x.shape
+        y = self.norm1(x).transpose([0, 2, 1]).reshape([n, c, 1, t])
+        x = x + self.dw(y).reshape([n, c, t]).transpose([0, 2, 1])
+        return x + self.fc2(nn.functional.gelu(self.fc1(self.norm2(x))))
+
+
+class GlobalMixBlock(nn.Layer):
+    """SVTR global mixing: MHSA over the width sequence + MLP."""
+
+    def __init__(self, dim, num_heads=8, mlp_ratio=2.0):
+        super().__init__()
+        self.norm1 = nn.LayerNorm(dim)
+        self.attn = nn.MultiHeadAttention(dim, num_heads)
+        self.norm2 = nn.LayerNorm(dim)
+        hidden = int(dim * mlp_ratio)
+        self.fc1 = nn.Linear(dim, hidden)
+        self.fc2 = nn.Linear(hidden, dim)
+
+    def forward(self, x):
+        y = self.norm1(x)
+        x = x + self.attn(y, y, y)
+        return x + self.fc2(nn.functional.gelu(self.fc1(self.norm2(x))))
+
+
+class SVTRRec(nn.Layer):
+    """SVTR-tiny-class recognizer: [N, C, 32, W] text line -> CTC logits
+    [N, W/4, num_classes] (class 0 = blank, reference convention)."""
+
+    def __init__(self, num_classes, in_channels=3, dims=(64, 128, 256),
+                 depths=(3, 6, 3), num_heads=8, max_width=320):
+        super().__init__()
+        # patch-embed stem: /4 in W, /8 in H (like PP-OCRv3's 32-high lines)
+        self.stem = nn.Sequential(
+            _ConvBNAct(in_channels, dims[0] // 2, 3, stride=2),
+            _ConvBNAct(dims[0] // 2, dims[0], 3, stride=2))
+        self.pool_h = nn.AdaptiveAvgPool2D((1, None))
+        blocks = []
+        dim = dims[0]
+        for si, (d, depth) in enumerate(zip(dims, depths)):
+            if d != dim:
+                blocks.append(nn.Linear(dim, d))
+                dim = d
+            for bi in range(depth):
+                # alternate local / global mixing (SVTR recipe: local early,
+                # global late — here interleaved per stage parity)
+                if si == 0 or bi % 2 == 0:
+                    blocks.append(LocalMixBlock(d))
+                else:
+                    blocks.append(GlobalMixBlock(d, num_heads))
+        self.blocks = nn.LayerList(blocks)
+        self.norm = nn.LayerNorm(dims[-1])
+        self.head = nn.Linear(dims[-1], num_classes)
+
+    def forward(self, x):
+        f = self.stem(x)                     # [N, C, H/4, W/4]
+        f = self.pool_h(f)                   # [N, C, 1, W/4]
+        n, c, _, w = f.shape
+        seq = f.reshape([n, c, w]).transpose([0, 2, 1])   # [N, T, C]
+        for blk in self.blocks:
+            seq = blk(seq)
+        return self.head(self.norm(seq))     # [N, T, num_classes]
+
+
+def ppocrv3_rec(num_classes, **kw):
+    """PP-OCRv3 recognition config (SVTR-LCNet class)."""
+    return SVTRRec(num_classes, **kw)

@@ -22,10 +22,24 @@ class OpTest:
     atol = 1e-6
     grad_rtol = 1e-2
     grad_atol = 1e-3
+    # per-dtype tolerances, mirroring the reference's fp16/bf16 variants
+    # (op_test.py:309 check_output_with_place dtype iteration)
+    dtype_tols = {
+        "float64": (1e-7, 1e-9),
+        "float32": (1e-5, 1e-6),
+        "bfloat16": (2e-2, 2e-2),
+        "float16": (1e-3, 1e-3),
+    }
+    check_dtypes = ("float32", "bfloat16")
 
-    def make_tensors(self, stop_gradient=True):
+    def make_tensors(self, stop_gradient=True, dtype=None):
+        vals = self.inputs
+        if dtype is not None:
+            vals = {k: (v.astype(dtype) if np.issubdtype(
+                np.asarray(v).dtype, np.floating) else v)
+                for k, v in vals.items()}
         return {k: paddle.to_tensor(v, stop_gradient=stop_gradient)
-                for k, v in self.inputs.items()}
+                for k, v in vals.items()}
 
     def check_output(self):
         tensors = self.make_tensors()
@@ -38,6 +52,28 @@ class OpTest:
             np.testing.assert_allclose(o.numpy().astype(np.float64),
                                        np.asarray(e, dtype=np.float64),
                                        rtol=self.rtol, atol=self.atol)
+
+    def check_output_dtypes(self, dtypes=None):
+        """Run the op in each low/mixed precision dtype and compare against
+        the float64 numpy reference under that dtype's tolerance — the
+        reference iterates fp16/bf16 variants of every OpTest the same way."""
+        import jax.numpy as jnp
+        for dt in dtypes or self.check_dtypes:
+            rtol, atol = self.dtype_tols[dt]
+            tensors = self.make_tensors(dtype=dt)
+            out = self.op(**tensors, **getattr(self, "attrs", {}))
+            expected = self.ref(**{k: v for k, v in self.inputs.items()},
+                                **getattr(self, "attrs", {}))
+            outs = out if isinstance(out, (tuple, list)) else [out]
+            exps = (expected if isinstance(expected, (tuple, list))
+                    else [expected])
+            for o, e in zip(outs, exps):
+                got = np.asarray(o._value.astype(jnp.float64)
+                                 if hasattr(o, "_value") else o)
+                np.testing.assert_allclose(
+                    got, np.asarray(e, dtype=np.float64),
+                    rtol=rtol, atol=atol,
+                    err_msg=f"dtype {dt} output mismatch")
 
     def check_grad(self, wrt=None, eps=1e-4):
         """Numeric jacobian-vector check: compare autograd grads against

@@ -128,3 +128,68 @@ def test_setitem_grad():
     y[0] = 10.0
     y.sum().backward()
     np.testing.assert_allclose(x.grad.numpy(), [0., 2., 2.])
+
+
+def test_sparse_embedding_selected_rows_grads():
+    """Embedding(sparse=True) produces SelectedRows grads on the eager tape
+    and the optimizer applies a lazy row-wise update identical to the dense
+    path on touched rows, leaving untouched rows alone (reference:
+    phi selected_rows kernels + sparse adam lazy_mode)."""
+    import paddle_tpu.nn as nn
+    from paddle_tpu.core.selected_rows import SelectedRows
+
+    paddle.seed(0)
+    emb_s = nn.Embedding(10, 4, sparse=True)
+    paddle.seed(0)
+    emb_d = nn.Embedding(10, 4, sparse=False)
+    np.testing.assert_allclose(emb_s.weight.numpy(), emb_d.weight.numpy())
+
+    ids = paddle.to_tensor(np.array([[1, 3, 3], [7, 1, 0]], "int64"))
+    tgt = paddle.to_tensor(
+        np.random.RandomState(0).randn(2, 3, 4).astype("float32"))
+
+    loss_s = ((emb_s(ids) - tgt) ** 2).sum()
+    loss_s.backward()
+    g = emb_s.weight.grad
+    assert isinstance(g, SelectedRows)
+    assert g.height == 10
+
+    loss_d = ((emb_d(ids) - tgt) ** 2).sum()
+    loss_d.backward()
+    np.testing.assert_allclose(np.asarray(g.to_dense()),
+                               emb_d.weight.grad.numpy(), rtol=1e-6)
+
+    # SGD: sparse update == dense update exactly
+    before = emb_s.weight.numpy().copy()
+    opt_s = paddle.optimizer.SGD(parameters=emb_s.parameters(),
+                                 learning_rate=0.1)
+    opt_d = paddle.optimizer.SGD(parameters=emb_d.parameters(),
+                                 learning_rate=0.1)
+    opt_s.step()
+    opt_d.step()
+    np.testing.assert_allclose(emb_s.weight.numpy(), emb_d.weight.numpy(),
+                               rtol=1e-6)
+    # untouched rows unchanged
+    untouched = [2, 4, 5, 6, 8, 9]
+    np.testing.assert_allclose(emb_s.weight.numpy()[untouched],
+                               before[untouched])
+
+
+def test_sparse_embedding_lazy_adam_touches_only_rows():
+    import paddle_tpu.nn as nn
+    from paddle_tpu.core.selected_rows import SelectedRows
+
+    paddle.seed(1)
+    emb = nn.Embedding(8, 4, sparse=True)
+    opt = paddle.optimizer.Adam(parameters=emb.parameters(),
+                                learning_rate=0.05)
+    ids = paddle.to_tensor(np.array([0, 2, 2], "int64"))
+    before = emb.weight.numpy().copy()
+    loss = emb(ids).sum()
+    loss.backward()
+    assert isinstance(emb.weight.grad, SelectedRows)
+    opt.step()
+    after = emb.weight.numpy()
+    changed = np.abs(after - before).max(axis=1) > 0
+    assert changed[0] and changed[2]
+    assert not changed[[1, 3, 4, 5, 6, 7]].any()

@@ -124,3 +124,77 @@ def test_crnn_learns_sequence():
     assert losses[-1] < losses[0] * 0.5
     decoded = ctc_greedy_decode(model(paddle.to_tensor(x)))
     assert decoded[0] == [1, 2, 3]
+
+
+def test_ppyoloe_trains_and_decodes():
+    """PP-YOLOE-class detector (BASELINE.md row 6): forward shapes, TAL
+    loss decreases, decode+fuse round-trip."""
+    from paddle_tpu.vision.models import PPYOLOE, PPYOLOELoss
+
+    paddle.seed(0)
+    m = PPYOLOE(num_classes=4, width=(8, 16, 32, 64, 128),
+                depth=(1, 1, 1, 1))
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(2, 3, 64, 64).astype("float32"))
+    cls_l, reg_l = m(x)
+    assert [tuple(c.shape) for c in cls_l] == \
+        [(2, 4, 8, 8), (2, 4, 4, 4), (2, 4, 2, 2)]
+    assert [tuple(r.shape) for r in reg_l] == \
+        [(2, 68, 8, 8), (2, 68, 4, 4), (2, 68, 2, 2)]
+
+    gt_boxes = paddle.to_tensor(np.array(
+        [[[4, 4, 40, 40], [20, 10, 60, 50]],
+         [[8, 8, 32, 48], [0, 0, 0, 0]]], "float32"))
+    gt_labels = paddle.to_tensor(np.array([[1, 3], [2, -1]], "int64"))
+    loss_fn = PPYOLOELoss(m)
+    opt = paddle.optimizer.Adam(parameters=m.parameters(),
+                                learning_rate=1e-3)
+    losses = []
+    for _ in range(5):
+        loss = loss_fn(m(x), gt_boxes, gt_labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert all(np.isfinite(l) for l in losses), losses
+    assert losses[-1] < losses[0]
+
+    boxes, scores = m.decode(m(x))
+    assert tuple(boxes.shape) == (2, 84, 4)
+    assert tuple(scores.shape) == (2, 84, 4)
+
+    # deploy-time fusion keeps eval forward close (BN-fold exactness)
+    m.eval()
+    ref_cls, _ = m(x)
+    m.fuse()
+    fused_cls, _ = m(x)
+    np.testing.assert_allclose(fused_cls[0].numpy(), ref_cls[0].numpy(),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_ppocrv3_rec_trains_with_ctc():
+    """PP-OCRv3-class SVTR recognizer: logits shape + CTC loss decreases."""
+    from paddle_tpu.vision.models import CTCHeadLoss, ppocrv3_rec
+
+    paddle.seed(1)
+    m = ppocrv3_rec(num_classes=12, dims=(16, 32, 48), depths=(1, 2, 1),
+                    num_heads=4)
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(2, 3, 32, 64).astype("float32"))
+    logits = m(x)
+    assert tuple(logits.shape) == (2, 16, 12)
+
+    labels = paddle.to_tensor(
+        np.random.RandomState(1).randint(1, 12, (2, 5)).astype("int64"))
+    loss_fn = CTCHeadLoss()
+    opt = paddle.optimizer.Adam(parameters=m.parameters(),
+                                learning_rate=2e-3)
+    losses = []
+    for _ in range(5):
+        loss = loss_fn(m(x), labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert all(np.isfinite(l) for l in losses), losses
+    assert losses[-1] < losses[0]

@@ -122,3 +122,41 @@ def test_flash_spmd_divisibility_fallback(monkeypatch):
     ref = att._sdpa_ref(q, q, q, None, 0.0, True, 1.0 / np.sqrt(d), False)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_fused_layernorm_matches_reference():
+    """Pallas fused LN (opt-in, kernels/layer_norm.py) matches the jnp LN
+    in forward and all three grads, including the row-padding path."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.kernels.layer_norm import (enable_fused_layernorm,
+                                               layer_norm_fused,
+                                               layer_norm_fused_ok)
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(37, 5, 256), jnp.float32)  # 185 rows: pad path
+    w = jnp.asarray(rng.randn(256), jnp.float32)
+    b = jnp.asarray(rng.randn(256), jnp.float32)
+
+    def ref(x, w, b):
+        m = jnp.mean(x, -1, keepdims=True)
+        v = jnp.mean(jnp.square(x - m), -1, keepdims=True)
+        return (x - m) * jax.lax.rsqrt(v + 1e-5) * w + b
+
+    assert not layer_norm_fused_ok(x, (x.ndim - 1,), w, b)  # off by default
+    enable_fused_layernorm(True)
+    try:
+        assert layer_norm_fused_ok(x, (x.ndim - 1,), w, b)
+        np.testing.assert_allclose(np.asarray(layer_norm_fused(x, w, b, 1e-5)),
+                                   np.asarray(ref(x, w, b)),
+                                   rtol=2e-5, atol=2e-5)
+        coef = jnp.arange(256.0)
+        g1 = jax.grad(lambda *a: (layer_norm_fused(*a, 1e-5) * coef).sum(),
+                      argnums=(0, 1, 2))(x, w, b)
+        g0 = jax.grad(lambda *a: (ref(*a) * coef).sum(),
+                      argnums=(0, 1, 2))(x, w, b)
+        for got, want in zip(g1, g0):
+            np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                       rtol=3e-4, atol=3e-4)
+    finally:
+        enable_fused_layernorm(False)

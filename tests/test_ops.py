@@ -19,6 +19,9 @@ class TestMatmul(OpTest):
     def test_grad(self):
         self.check_grad()
 
+    def test_dtypes(self):
+        self.check_output_dtypes()
+
 
 class TestExp(OpTest):
     def setup_method(self, method):
@@ -31,6 +34,9 @@ class TestExp(OpTest):
 
     def test_grad(self):
         self.check_grad()
+
+    def test_dtypes(self):
+        self.check_output_dtypes()
 
 
 class TestSoftmaxCE(OpTest):
@@ -47,6 +53,9 @@ class TestSoftmaxCE(OpTest):
 
     def test_grad(self):
         self.check_grad()
+
+    def test_dtypes(self):
+        self.check_output_dtypes()
 
 
 def test_reductions():

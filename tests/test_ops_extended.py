@@ -1,0 +1,195 @@
+"""Correctness tests for the long-tail ops (ops/extended.py) against numpy
+references — the per-op depth the registry sweep's smoke pass doesn't give."""
+import numpy as np
+
+import paddle_tpu as paddle
+
+rng = np.random.RandomState(3)
+
+
+def T(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+def test_addmm_logit_renorm():
+    i = rng.randn(3, 5).astype("float32")
+    x = rng.randn(3, 4).astype("float32")
+    y = rng.randn(4, 5).astype("float32")
+    got = paddle.addmm(T(i), T(x), T(y), beta=0.5, alpha=2.0).numpy()
+    np.testing.assert_allclose(got, 0.5 * i + 2.0 * (x @ y), rtol=1e-5)
+
+    p = rng.uniform(0.1, 0.9, (3, 4)).astype("float32")
+    np.testing.assert_allclose(paddle.logit(T(p)).numpy(),
+                               np.log(p / (1 - p)), rtol=1e-4, atol=1e-5)
+
+    v = rng.randn(3, 6).astype("float32") * 5
+    out = paddle.renorm(T(v), p=2.0, axis=0, max_norm=1.0).numpy()
+    norms = np.linalg.norm(out, axis=1)
+    assert (norms <= 1.0 + 1e-4).all()
+
+
+def test_frame_overlap_add_roundtrip():
+    x = rng.randn(2, 16).astype("float32")
+    fr = paddle.frame(T(x), frame_length=4, hop_length=4)
+    assert tuple(fr.shape) == (2, 4, 4)
+    back = paddle.overlap_add(fr, hop_length=4)
+    np.testing.assert_allclose(back.numpy(), x, rtol=1e-6)
+    # overlapping windows sum
+    fr2 = paddle.frame(T(x), frame_length=4, hop_length=2)
+    assert tuple(fr2.shape) == (2, 4, 7)
+
+
+def test_lu_roundtrip():
+    a = rng.randn(4, 4).astype("float32") + 4 * np.eye(4, dtype="float32")
+    lu_mat, piv, info = paddle.lu(T(a))
+    p, l, u = paddle.lu_unpack(lu_mat, piv)
+    rec = p.numpy() @ l.numpy() @ u.numpy()
+    np.testing.assert_allclose(rec, a, rtol=1e-4, atol=1e-4)
+    assert int(info.numpy().sum()) == 0
+
+
+def test_grid_sample_identity():
+    x = rng.randn(2, 3, 5, 5).astype("float32")
+    theta = np.tile(np.array([[1, 0, 0], [0, 1, 0]], "float32"), (2, 1, 1))
+    grid = paddle.affine_grid(T(theta), [2, 3, 5, 5])
+    out = paddle.grid_sample(x if not hasattr(x, "numpy") else x, grid)
+    got = out.numpy() if hasattr(out, "numpy") else np.asarray(out)
+    np.testing.assert_allclose(got, x, rtol=1e-4, atol=1e-5)
+
+
+def test_viterbi_decode_matches_bruteforce():
+    b, t, c = 1, 4, 3
+    pot = rng.randn(b, t, c).astype("float32")
+    trans = rng.randn(c, c).astype("float32")
+    scores, path = paddle.viterbi_decode(T(pot), T(trans),
+                                         include_bos_eos_tag=False)
+    # brute force over all 3^4 paths
+    best, best_path = -1e30, None
+    import itertools
+    for p in itertools.product(range(c), repeat=t):
+        s = pot[0, 0, p[0]]
+        for i in range(1, t):
+            s += trans[p[i - 1], p[i]] + pot[0, i, p[i]]
+        if s > best:
+            best, best_path = s, p
+    np.testing.assert_allclose(float(scores.numpy()[0]), best, rtol=1e-5)
+    assert tuple(path.numpy()[0]) == best_path
+
+
+def test_edit_distance():
+    hyp = np.array([[1, 2, 3, 4]], "int64")
+    ref = np.array([[1, 3, 3, 5]], "int64")
+    d, n = paddle.edit_distance(T(hyp), T(ref), normalized=False)
+    assert float(d.numpy()[0, 0]) == 2.0
+    dn, _ = paddle.edit_distance(T(hyp), T(ref), normalized=True)
+    np.testing.assert_allclose(float(dn.numpy()[0, 0]), 2.0 / 4)
+
+
+def test_gather_tree():
+    # beams: at t=2, beam0 came from parent beam1, beam1 from beam0
+    ids = np.array([[[1, 2]], [[3, 4]], [[5, 6]]], "int64")  # [T=3,B=1,W=2]
+    parents = np.array([[[0, 0]], [[1, 0]], [[1, 0]]], "int64")
+    out = paddle.gather_tree(T(ids), T(parents)).numpy()
+    # beam 0 backtrace: t2 id 5, parent beam 1 -> t1 id 4 (ids[1][1]);
+    # beam 1@t1's parent is beam 0 -> t0 id 1 (ids[0][0])
+    assert list(out[:, 0, 0]) == [1, 4, 5]
+
+
+def test_temporal_shift_moves_channels():
+    x = rng.randn(4, 8, 2, 2).astype("float32")  # N*T with T=2
+    out = paddle.temporal_shift(T(x), seg_num=2, shift_ratio=0.25).numpy()
+    v = x.reshape(2, 2, 8, 2, 2)
+    o = out.reshape(2, 2, 8, 2, 2)
+    # first fold (2 channels) shifted left: o[:, 0, :2] == v[:, 1, :2]
+    np.testing.assert_allclose(o[:, 0, :2], v[:, 1, :2])
+    np.testing.assert_allclose(o[:, 1, :2], 0.0)
+    # second fold shifted right
+    np.testing.assert_allclose(o[:, 1, 2:4], v[:, 0, 2:4])
+    # rest untouched
+    np.testing.assert_allclose(o[:, :, 4:], v[:, :, 4:])
+
+
+def test_max_unpool2d_roundtrip():
+    x = rng.randn(1, 2, 4, 4).astype("float32")
+    import paddle_tpu.nn.functional as F
+    pooled, idx = F.max_pool2d(T(x), kernel_size=2, return_mask=True)
+    restored = paddle.max_unpool2d(pooled, idx, kernel_size=2).numpy()
+    # restored holds each max at its original location, zeros elsewhere
+    assert restored.shape == x.shape
+    np.testing.assert_allclose(np.sort(restored[restored != 0]),
+                               np.sort(pooled.numpy().ravel()))
+
+
+def test_fill_family_and_shard_index():
+    x = rng.randn(4, 4).astype("float32")
+    assert (paddle.fill(T(x), 3.0).numpy() == 3.0).all()
+    fd = paddle.fill_diagonal(T(x), 7.0).numpy()
+    np.testing.assert_allclose(np.diag(fd), 7.0)
+    v = np.arange(4, dtype="float32")
+    fdt = paddle.fill_diagonal_tensor(T(x), T(v)).numpy()
+    np.testing.assert_allclose(np.diag(fdt), v)
+
+    ids = np.array([0, 5, 9, 15], "int64")
+    out = paddle.shard_index(T(ids), index_num=16, nshards=4,
+                             shard_id=1).numpy()
+    np.testing.assert_array_equal(out, [-1, 1, -1, -1])
+
+
+def test_diag_embed_and_indices():
+    v = rng.randn(2, 3).astype("float32")
+    m = paddle.diag_embed(T(v)).numpy()
+    for b in range(2):
+        np.testing.assert_allclose(np.diag(m[b]), v[b])
+    tl = paddle.tril_indices(4, offset=0).numpy()
+    r, c = np.tril_indices(4)
+    np.testing.assert_array_equal(tl, np.stack([r, c]))
+
+
+def test_max_pool_same_padding_and_identity():
+    """Review regressions: SAME padding must use the max-identity (not the
+    conv's zero pad), and padded pooling must stay finite (the pad value
+    must survive bf16 conv passes)."""
+    import paddle_tpu.nn.functional as F
+    xneg = np.full((1, 1, 4, 4), -5.0, "float32")
+    out = F.max_pool2d(T(xneg), kernel_size=3, stride=1, padding="SAME")
+    np.testing.assert_allclose(out.numpy(), -5.0)
+    x = rng.randn(1, 2, 6, 6).astype("float32")
+    out2 = F.max_pool2d(T(x), kernel_size=3, stride=2, padding=1)
+    assert np.isfinite(out2.numpy()).all()
+
+
+def test_viterbi_decode_respects_lengths():
+    pot = rng.randn(2, 4, 3).astype("float32")
+    trans = rng.randn(3, 3).astype("float32")
+    s_full, p_full = paddle.viterbi_decode(T(pot[:1, :2]), T(trans),
+                                           include_bos_eos_tag=False)
+    s_len, p_len = paddle.viterbi_decode(
+        T(pot[:1]), T(trans), lengths=T(np.array([2], "int64")),
+        include_bos_eos_tag=False)
+    np.testing.assert_allclose(float(s_len.numpy()[0]),
+                               float(s_full.numpy()[0]), rtol=1e-6)
+    assert tuple(p_len.numpy()[0][:2]) == tuple(p_full.numpy()[0])
+
+
+def test_lu_unpack_batched():
+    a = rng.randn(3, 4, 4).astype("float32") + 4 * np.eye(4, dtype="float32")
+    lu_mat, piv, _ = paddle.lu(T(a))
+    p, l, u = paddle.lu_unpack(lu_mat, piv)
+    rec = np.einsum("bij,bjk,bkl->bil", p.numpy(), l.numpy(), u.numpy())
+    np.testing.assert_allclose(rec, a, rtol=1e-3, atol=1e-3)
+
+
+def test_sparse_embedding_negative_id_grad_targets_clipped_row():
+    import paddle_tpu.nn as nn
+    from paddle_tpu.core.selected_rows import SelectedRows
+
+    emb = nn.Embedding(5, 3, sparse=True)
+    ids = paddle.to_tensor(np.array([-1, 2], "int64"))
+    loss = emb(ids).sum()
+    loss.backward()
+    g = emb.weight.grad
+    assert isinstance(g, SelectedRows)
+    rows = np.asarray(g.rows)
+    assert (rows >= 0).all() and set(rows.tolist()) == {0, 2}
+    dense = np.asarray(g.to_dense())
+    assert np.abs(dense[4]).max() == 0.0  # last row untouched

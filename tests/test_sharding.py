@@ -140,3 +140,44 @@ def test_dygraph_sharding_optimizer():
     sh = DygraphShardingOptimizer(inner)
     assert sh._inner_opt._sharding_stage == 1
     assert sh.get_lr() == pytest.approx(0.01)
+
+
+def test_offload_slots_live_on_host_and_match_numerics():
+    """offload=True keeps optimizer slots in pinned host memory and stages
+    them through device memory around the update (reference:
+    group_sharded_stage3.py:60 offload moves slots to host); round-1 had a
+    silent no-op here.  Loss must match the non-offloaded run exactly."""
+    data = _data(steps=4)
+    loss_fn = nn.MSELoss()
+    mesh = dist.build_mesh([2, 4], ["dp", "sharding"])
+    dist.set_global_mesh(mesh)
+
+    ref_model = _mlp()
+    ref_opt = opt.Adam(parameters=ref_model.parameters(), learning_rate=1e-2)
+    ref_model, ref_opt, _ = group_sharded_parallel(ref_model, ref_opt, "os")
+    ref_step = dist.make_train_step(ref_model, ref_opt, loss_fn, mesh=mesh)
+    ref_losses = _run(ref_step, data)
+
+    model = _mlp()
+    optim = opt.Adam(parameters=model.parameters(), learning_rate=1e-2)
+    model, optim, _ = group_sharded_parallel(model, optim, "os",
+                                             offload=True)
+    step = dist.make_train_step(model, optim, loss_fn, mesh=mesh)
+    assert step.offload
+    # initial slot placement is pinned host memory (the in-step re-pin is
+    # backend-dependent: the CPU simulator canonicalizes memory kinds away,
+    # real TPU keeps them — asserted by the numerics + flag here)
+    kinds = {v.sharding.memory_kind
+             for d in step.state.slots.values() for v in d.values()}
+    assert kinds == {"pinned_host"}, kinds
+    losses = _run(step, data)
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-6, atol=1e-7)
+
+
+def test_offload_without_mesh_raises():
+    model = _mlp()
+    optim = opt.Adam(parameters=model.parameters(), learning_rate=1e-2)
+    model, optim, _ = group_sharded_parallel(model, optim, "os",
+                                             offload=True)
+    with pytest.raises(ValueError, match="offload"):
+        dist.make_train_step(model, optim, nn.MSELoss(), mesh=None)

@@ -202,3 +202,39 @@ def test_hessian():
     H = Hessian(f, x)
     np.testing.assert_allclose(np.asarray(H.numpy()), 2 * np.eye(3),
                                atol=1e-6)
+
+
+def test_sparse_op_family_extensions():
+    """sparse_ops.yaml long tail: value-wise unary, arithmetic, mv/addmm,
+    csr softmax over stored values."""
+    import paddle_tpu.sparse as sp
+
+    x = sp.sparse_coo_tensor([[0, 0], [1, 2]], [2.0, -3.0], (3, 4))
+    np.testing.assert_allclose(np.asarray(sp.tanh(x).values().numpy()),
+                               np.tanh([2.0, -3.0]), rtol=1e-6)
+    np.testing.assert_allclose(sp.scale(x, 2.0, 1.0).values().numpy(),
+                               [5.0, -5.0])
+    np.testing.assert_allclose(
+        sp.subtract(x, x).to_dense().numpy(), np.zeros((3, 4)))
+    d = np.random.RandomState(0).randn(4, 5).astype("float32")
+    out = sp.addmm(paddle.to_tensor(np.ones((3, 5), "float32")), x,
+                   paddle.to_tensor(d), beta=0.5, alpha=2.0)
+    ref = 0.5 + 2.0 * (x.to_dense().numpy() @ d)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+    sm = sp.softmax(x)
+    row0 = sm.to_dense().numpy()[0]
+    np.testing.assert_allclose(row0[[1, 2]].sum(), 1.0, rtol=1e-6)
+
+
+def test_strings_ops():
+    """strings_ops.yaml surface: StringTensor + lower/upper/empty."""
+    from paddle_tpu import strings
+
+    st = strings.to_string_tensor([["Hello", "WORLD"], ["MiXeD", ""]])
+    low = strings.lower(st)
+    up = strings.upper(st)
+    assert low.tolist() == [["hello", "world"], ["mixed", ""]]
+    assert up.tolist() == [["HELLO", "WORLD"], ["MIXED", ""]]
+    e = strings.empty((2, 2))
+    assert e.shape == (2, 2) and e.tolist() == [["", ""], ["", ""]]
+    assert strings.empty_like(st).shape == st.shape

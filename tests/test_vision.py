@@ -4,6 +4,7 @@ import numpy as np
 import pytest
 
 import paddle_tpu as paddle
+import paddle_tpu.nn as nn
 from paddle_tpu.vision import models, ops, transforms
 from paddle_tpu.vision.datasets import FakeData
 
@@ -254,3 +255,37 @@ def test_voc2012_parses_local_archive(tmp_path):
     assert len(val) == 1
     with pytest.raises(ValueError, match="mode"):
         VOC2012(data_file=str(arc), mode="bogus")
+
+
+def test_resnet_trains_through_compiled_step():
+    """BASELINE.md row 1 regression: ResNet must train through the jitted
+    SPMD step (round-2 found reduce_window-max's JVP failing inside the
+    eager tape's nested vjp, and -inf pool padding turning to NaN through
+    the one-hot patch convolution)."""
+    import paddle_tpu.distributed as dist
+
+    paddle.seed(0)
+    m = models.resnet18(num_classes=10)
+    opt = paddle.optimizer.Momentum(learning_rate=0.01, momentum=0.9,
+                                    parameters=m.parameters(),
+                                    weight_decay=1e-4)
+    step = dist.make_train_step(m, opt, loss_fn=nn.CrossEntropyLoss())
+    x = np.random.RandomState(0).randn(8, 3, 32, 32).astype("float32")
+    y = np.random.RandomState(1).randint(0, 10, (8,)).astype("int64")
+    losses = [float(step(x, y)) for _ in range(5)]
+    assert all(np.isfinite(l) for l in losses), losses
+    assert losses[-1] < losses[0]
+
+
+def test_max_pool_return_mask_roundtrip():
+    """max_pool2d(return_mask=True) yields flat spatial indices that
+    max_unpool2d inverts (reference unpool contract)."""
+    x = np.random.RandomState(0).randn(2, 3, 6, 6).astype("float32")
+    pooled, idx = nn.functional.max_pool2d(paddle.to_tensor(x),
+                                           kernel_size=2, return_mask=True)
+    assert tuple(idx.shape) == tuple(pooled.shape)
+    flat = x.reshape(2, 3, -1)
+    gathered = np.take_along_axis(flat, idx.numpy().reshape(2, 3, -1),
+                                  axis=2)
+    np.testing.assert_allclose(gathered.reshape(pooled.shape),
+                               pooled.numpy())
