@@ -78,6 +78,19 @@ def axis_bound(name) -> bool:
         return False
 
 
+def put_global(value, sharding):
+    """device_put that also works under MULTI-PROCESS meshes: a global
+    NamedSharding is not addressable from one process, so the array is
+    assembled from per-shard callbacks (each process materializes only its
+    addressable shards — the jax.distributed analog of the reference's
+    per-trainer feed split)."""
+    if getattr(sharding, "is_fully_addressable", True):
+        return jax.device_put(value, sharding)
+    arr = np.asarray(value)
+    return jax.make_array_from_callback(arr.shape, sharding,
+                                        lambda idx: arr[idx])
+
+
 def sharding_for(spec: PartitionSpec, mesh: Mesh | None = None):
     mesh = mesh or _GLOBAL_MESH
     if mesh is None:

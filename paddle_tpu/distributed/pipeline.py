@@ -446,7 +446,9 @@ class GPipeTrainStep:
             self._num_micro_eff = (m_eff, pad_local)
             self._jitted = self._build(m_eff, pad_local)
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
-        key = jax.random.key(np.random.randint(0, 2 ** 31 - 1))
+        # framework-seeded key: identical across ranks of a multi-process
+        # mesh (same reasoning as ShardedTrainStep's train-state rng)
+        key = random_mod.next_key()
         self.params, self.slots, self.step_count, loss = self._jitted(
             self.params, self.slots, self.step_count, lr, key, tuple(vals))
         self.optimizer._step_count += 1

@@ -180,7 +180,12 @@ class ShardedTrainStep:
         params = {k: values[k] for k in self.param_names}
         buffers = {k: values[k] for k in self.buffer_names}
         slots = {k: optimizer.init_slots(params[k]) for k in self.param_names}
-        rng = jax.random.key(np.random.randint(0, 2 ** 31 - 1))
+        # derive the train-state key from the framework's seeded generator,
+        # NOT an unseeded np.random draw: under a multi-process mesh every
+        # rank must carry the SAME key into the SPMD step (all ranks call
+        # paddle.seed(n) per the single-program convention; an unseeded
+        # per-rank draw would give mp/pp peers different dropout masks)
+        rng = random_mod.next_key()
         step0 = jnp.zeros((), jnp.int32)
         self.state = TrainState(params, slots, buffers, step0, rng)
         if self.mesh is not None:
@@ -212,7 +217,7 @@ class ShardedTrainStep:
 
     def _shard_value(self, name, v):
         spec = self._specs.get(name, P())
-        return jax.device_put(v, NamedSharding(self.mesh, spec))
+        return mesh_mod.put_global(v, NamedSharding(self.mesh, spec))
 
     def _slot_sharding(self, name, v, kind=None):
         spec = self._slot_specs.get(name, P())
@@ -224,24 +229,26 @@ class ShardedTrainStep:
 
     def _slot_shard_value(self, name, v):
         kind = "pinned_host" if self.offload else None
-        return jax.device_put(v, self._slot_sharding(name, v, kind))
+        return mesh_mod.put_global(v, self._slot_sharding(name, v, kind))
 
     def _shard_state(self, st: TrainState) -> TrainState:
         params = {k: self._shard_value(k, v) for k, v in st.params.items()}
         slots = {k: {s: self._slot_shard_value(k, v) for s, v in d.items()}
                  for k, d in st.slots.items()}
         repl = NamedSharding(self.mesh, P())
-        buffers = {k: jax.device_put(v, repl) for k, v in st.buffers.items()}
+        buffers = {k: mesh_mod.put_global(v, repl)
+                   for k, v in st.buffers.items()}
         return TrainState(params, slots, buffers,
-                          jax.device_put(st.step, repl),
-                          jax.device_put(st.rng, repl))
+                          mesh_mod.put_global(st.step, repl),
+                          jax.device_put(st.rng, repl)
+                          if repl.is_fully_addressable else st.rng)
 
     def shard_batch(self, *batch):
         out = []
         for b in batch:
             v = b._value if isinstance(b, Tensor) else jnp.asarray(b)
             if self.mesh is not None:
-                v = jax.device_put(
+                v = mesh_mod.put_global(
                     v, NamedSharding(self.mesh, batch_spec(self.mesh, v.ndim)))
             out.append(v)
         return tuple(out)
@@ -442,7 +449,7 @@ class ShardedTrainStep:
             v = b._value if isinstance(b, Tensor) else jnp.asarray(b)
             if self.mesh is not None:
                 spec = batch_spec(self.mesh, v.ndim - 1)
-                v = jax.device_put(v, NamedSharding(
+                v = mesh_mod.put_global(v, NamedSharding(
                     self.mesh, P(None, *tuple(spec))))
             vals.append(v)
         if self._jitted is None:

@@ -176,6 +176,99 @@ def _has_jump(stmts) -> bool:
     return f.found
 
 
+class _ReturnFinder(ast.NodeVisitor):
+    """Return anywhere in the block (incl. nested loops, excl. nested
+    defs) — loops containing returns keep Python control flow."""
+
+    def __init__(self):
+        self.found = False
+
+    def visit_Return(self, node):
+        self.found = True
+
+    def visit_FunctionDef(self, node):
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+
+class _ThisLevelJumpFinder(ast.NodeVisitor):
+    """break/continue belonging to THIS loop (not to loops nested inside
+    it, not inside nested defs)."""
+
+    def __init__(self):
+        self.found = False
+
+    def visit_Break(self, node):
+        self.found = True
+
+    visit_Continue = visit_Break
+
+    def visit_While(self, node):
+        pass           # inner loop owns its jumps
+
+    visit_For = visit_While
+
+    def visit_FunctionDef(self, node):
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+
+def _returns_in(stmts) -> bool:
+    f = _ReturnFinder()
+    for s in stmts:
+        f.visit(s)
+    return f.found
+
+
+def _this_level_jumps(stmts) -> bool:
+    f = _ThisLevelJumpFinder()
+    for s in stmts:
+        f.visit(s)
+    return f.found
+
+
+def _assign_flag(name: str, value: bool) -> ast.Assign:
+    return ast.Assign(targets=[ast.Name(id=name, ctx=ast.Store())],
+                      value=ast.Constant(value=value))
+
+
+def _lower_jump_block(stmts, brk: str, cnt: str):
+    """Replace this-level break/continue with flag assignments; guard every
+    statement after a possibly-jumping one with `if not cnt:` (break sets
+    BOTH flags, so one guard covers both; the loop predicate checks brk).
+    Returns (new_stmts, had_jump)."""
+    out = []
+    for i, s in enumerate(stmts):
+        if isinstance(s, ast.Break):
+            out.append(_assign_flag(brk, True))
+            out.append(_assign_flag(cnt, True))
+            return out, True            # rest of the block is unreachable
+        if isinstance(s, ast.Continue):
+            out.append(_assign_flag(cnt, True))
+            return out, True
+        jumped = False
+        if isinstance(s, ast.If):
+            nb, jb = _lower_jump_block(s.body, brk, cnt)
+            ne, je = _lower_jump_block(s.orelse, brk, cnt)
+            if jb or je:
+                jumped = True
+                s = ast.If(test=s.test, body=nb, orelse=ne)
+        out.append(s)
+        if jumped and i + 1 < len(stmts):
+            rest, _ = _lower_jump_block(stmts[i + 1:], brk, cnt)
+            out.append(ast.If(
+                test=ast.UnaryOp(op=ast.Not(), operand=_name_load(cnt)),
+                body=rest, orelse=[]))
+            return out, True
+        if jumped:
+            return out, True
+    return out, False
+
+
 class _LoadCollector(ast.NodeVisitor):
     def __init__(self):
         self.names: Set[str] = set()
@@ -308,8 +401,29 @@ class Dy2StaticTransformer(ast.NodeTransformer):
     # -- while ----------------------------------------------------------------
     def visit_While(self, node: ast.While):
         self.generic_visit(node)
-        if node.orelse or _has_jump(node.body):
+        if node.orelse or _returns_in(node.body):
             return node
+        if _this_level_jumps(node.body):
+            # lower break/continue into carried flags + guarded remainders
+            # (loop_transformer.py's break/continue rewrite), then convert
+            # the now-jump-free loop through the normal path
+            uid = self._uid()
+            brk, cnt = f"__dy2st_jbrk_{uid}", f"__dy2st_jcnt_{uid}"
+            body, _ = _lower_jump_block(list(node.body), brk, cnt)
+            if _this_level_jumps(body):
+                # a jump survives inside a compound statement the lowering
+                # doesn't thread (with/try): keep Python control flow —
+                # recursing would loop forever on the unlowered break
+                return node
+            new_body = [_assign_flag(cnt, False)] + body
+            new_test = ast.BoolOp(
+                op=ast.And(),
+                values=[ast.UnaryOp(op=ast.Not(), operand=_name_load(brk)),
+                        node.test])
+            rewritten = ast.While(test=new_test, body=new_body, orelse=[])
+            converted = self.visit_While(rewritten)
+            conv = converted if isinstance(converted, list) else [converted]
+            return [_assign_flag(brk, False)] + conv
         body_names, blocked = _stores(node.body)
         if blocked or (body_names & self._declared()):
             return node
@@ -335,8 +449,10 @@ class Dy2StaticTransformer(ast.NodeTransformer):
     # -- for ------------------------------------------------------------------
     def visit_For(self, node: ast.For):
         # rewrite to an index-while FIRST, then run the while conversion on
-        # the result (loop_transformer.py does the same for->while step)
-        if node.orelse or _has_jump(node.body):
+        # the result (loop_transformer.py does the same for->while step);
+        # break/continue are fine (the while conversion lowers them), only
+        # return keeps Python control flow
+        if node.orelse or _returns_in(node.body):
             self.generic_visit(node)
             return node
         body_names, blocked = _stores(node.body)
@@ -372,11 +488,13 @@ class Dy2StaticTransformer(ast.NodeTransformer):
                                 slice=_name_load(idx), ctx=ast.Load()))
         bump = ast.AugAssign(target=ast.Name(id=idx, ctx=ast.Store()),
                              op=ast.Add(), value=ast.Constant(value=1))
+        # the index bump sits BEFORE the user body: a lowered `continue`
+        # guards out everything after it, and must not skip the bump
         while_node = ast.While(
             test=ast.Compare(left=_name_load(idx), ops=[ast.Lt()],
                              comparators=[_jst_call("len_",
                                                     [_name_load(it)])]),
-            body=[target_assign] + list(node.body) + [bump], orelse=[])
+            body=[target_assign, bump] + list(node.body), orelse=[])
         converted = self.visit_While(while_node)
         if isinstance(converted, list):
             return setup + converted

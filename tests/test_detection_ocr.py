@@ -198,3 +198,70 @@ def test_ppocrv3_rec_trains_with_ctc():
         losses.append(float(loss.numpy()))
     assert all(np.isfinite(l) for l in losses), losses
     assert losses[-1] < losses[0]
+
+
+def test_ppyoloe_exports_through_predictor(tmp_path):
+    """BASELINE.md row 6 tail: the detector exports via jit.save and runs
+    through the inference Predictor (AnalysisPredictor parity path)."""
+    import paddle_tpu.jit as jit
+    from paddle_tpu.inference import Config, Predictor
+    from paddle_tpu.static import InputSpec
+    from paddle_tpu.vision.models import PPYOLOE
+
+    paddle.seed(0)
+    m = PPYOLOE(num_classes=3, width=(8, 16, 32, 64, 128),
+                depth=(1, 1, 1, 1))
+    m.eval()
+    m.fuse()
+
+    class Deploy(paddle.nn.Layer):
+        def __init__(self, det):
+            super().__init__()
+            self.det = det
+
+        def forward(self, x):
+            boxes, scores = self.det.decode(self.det(x))
+            return boxes, scores
+
+    dep = Deploy(m)
+    x = np.random.RandomState(0).randn(1, 3, 64, 64).astype("float32")
+    ref_boxes, ref_scores = dep(paddle.to_tensor(x))
+
+    path = str(tmp_path / "ppyoloe" / "model")
+    jit.save(dep, path,
+             input_spec=[InputSpec([1, 3, 64, 64], "float32", "image")])
+
+    cfg = Config(path + ".pdmodel", path + ".pdiparams")
+    pred = Predictor(cfg)
+    handle = pred.get_input_handle(pred.get_input_names()[0])
+    handle.copy_from_cpu(x)
+    pred.run()
+    outs = [pred.get_output_handle(n).copy_to_cpu()
+            for n in pred.get_output_names()]
+    np.testing.assert_allclose(outs[0], ref_boxes.numpy(), rtol=1e-4,
+                               atol=1e-4)
+    np.testing.assert_allclose(outs[1], ref_scores.numpy(), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_svtr_exports_through_predictor(tmp_path):
+    import paddle_tpu.jit as jit
+    from paddle_tpu.inference import Config, Predictor
+    from paddle_tpu.static import InputSpec
+    from paddle_tpu.vision.models import ppocrv3_rec
+
+    paddle.seed(1)
+    m = ppocrv3_rec(num_classes=10, dims=(16, 32, 48), depths=(1, 1, 1),
+                    num_heads=4)
+    m.eval()
+    x = np.random.RandomState(0).randn(1, 3, 32, 64).astype("float32")
+    ref = m(paddle.to_tensor(x)).numpy()
+    path = str(tmp_path / "svtr" / "model")
+    jit.save(m, path,
+             input_spec=[InputSpec([1, 3, 32, 64], "float32", "image")])
+    pred = Predictor(Config(path + ".pdmodel", path + ".pdiparams"))
+    h = pred.get_input_handle(pred.get_input_names()[0])
+    h.copy_from_cpu(x)
+    pred.run()
+    out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)

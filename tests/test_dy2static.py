@@ -373,3 +373,87 @@ def test_global_store_in_branch_skips_conversion():
     out = conv(x, True)
     assert _GLOBAL_SINK == 7.0, "global assignment was swallowed"
     np.testing.assert_allclose(np.asarray(out._value), [2.0])
+
+
+def test_while_break_converts_to_lax():
+    """break in a tensor-predicate while lowers to carried flags
+    (loop_transformer.py break rewrite) and matches eager semantics."""
+    def fn(x, n):
+        i = paddle.to_tensor(np.array(0.0, np.float32))
+        total = x * 0.0
+        while i < n:
+            total = total + i
+            if total > 6.0:
+                break
+            i = i + 1.0
+        return total, i
+
+    conv = convert_to_static(fn)
+    x = paddle.to_tensor(np.array(0.0, np.float32))
+    n = paddle.to_tensor(np.array(100.0, np.float32))
+    total, i = conv(x, n)
+    # eager reference
+    tr, ir = fn(x, n)
+    np.testing.assert_allclose(float(total._value), float(tr._value))
+    np.testing.assert_allclose(float(i._value), float(ir._value))
+
+
+def test_for_continue_and_break_convert():
+    def fn(x):
+        acc = x * 0.0
+        for i in range(10):
+            if i % 2 == 0:
+                continue
+            acc = acc + float(i)
+            if acc > 8.0:
+                break
+        return acc
+
+    conv = convert_to_static(fn)
+    x = paddle.to_tensor(np.array(0.0, np.float32))
+    got = conv(x)
+    ref = fn(x)
+    np.testing.assert_allclose(float(got._value), float(ref._value))
+
+
+def test_break_under_jit_trace():
+    """The lowered loop must compile: tensor-dependent break inside a
+    jitted function becomes lax.while_loop with the flag in the carry."""
+    import jax
+
+    def fn(x):
+        i = x * 0.0
+        while i < 50.0:
+            i = i + 1.0
+            if i * i > x:
+                break
+        return i
+
+    conv = convert_to_static(fn)
+
+    def jfn(xv):
+        from paddle_tpu.core.tensor import Tensor
+        return conv(Tensor(xv, _internal=True))._value
+
+    out = jax.jit(jfn)(jnp.asarray(17.0))
+    assert float(out) == 5.0  # smallest i with i^2 > 17
+
+
+def test_break_inside_with_falls_back_to_python():
+    """A this-level break nested in a compound statement the lowering
+    doesn't thread (with/try) must keep Python control flow, not recurse
+    forever (review regression)."""
+    import io
+
+    def fn(x):
+        i = 0
+        while i < 5:
+            with io.StringIO() as _:
+                i = i + 1
+                if i >= 3:
+                    break
+        return x + i
+
+    conv = convert_to_static(fn)
+    x = paddle.to_tensor(np.array(0.0, np.float32))
+    np.testing.assert_allclose(float(conv(x)._value), float(fn(x)._value))
