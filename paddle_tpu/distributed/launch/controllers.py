@@ -90,10 +90,16 @@ class CollectiveController:
         base = node_rank * nproc
         script = ctx.args.training_script
         entry_prefix = [sys.executable] if script.endswith(".py") else []
+        master = ctx.args.master or ""
+        if not master and world > 1 and nnodes <= 1:
+            # single-node multi-process collective job: the workers still
+            # need a jax.distributed coordinator address; pick a free local
+            # port (multi-node requires --master explicitly)
+            master = f"127.0.0.1:{Node.get_free_port()}"
         for i in range(nproc):
             rank = base + i
             env = {
-                "PADDLE_MASTER": ctx.args.master or "",
+                "PADDLE_MASTER": master,
                 "PADDLE_GLOBAL_SIZE": world,
                 "PADDLE_LOCAL_SIZE": nproc,
                 "PADDLE_GLOBAL_RANK": rank,

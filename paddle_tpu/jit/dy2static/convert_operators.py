@@ -321,3 +321,24 @@ def len_(obj):
     if isinstance(obj, Tensor):
         return int(obj.shape[0])
     return len(obj)
+
+
+def convert_assert(pred, msg_fn=None):
+    """`assert` statement conversion (reference assert_transformer.py →
+    the Assert op, which is a no-op in compiled inference graphs).
+
+    Eager / concrete predicate: a real Python assert.  Traced tensor
+    predicate: XLA has no aborting assert, so — exactly like the
+    reference's compiled Assert op — the check is skipped; use
+    FLAGS_check_nan_inf-style runtime scans for in-graph validation.
+    `msg_fn` is a thunk so the message expression stays lazy (Python only
+    evaluates an assert message on failure)."""
+    from ...core.tensor import Tensor
+
+    p = pred._value if isinstance(pred, Tensor) else pred
+    if _is_tracer(p):
+        return  # traced: compiled graphs drop asserts (reference parity)
+    import numpy as np
+    ok = bool(np.all(np.asarray(p))) if hasattr(p, "shape") else bool(p)
+    if not ok:
+        raise AssertionError(msg_fn() if msg_fn is not None else "")

@@ -369,6 +369,19 @@ class Dy2StaticTransformer(ast.NodeTransformer):
 
     visit_AsyncFunctionDef = visit_FunctionDef
 
+    # -- assert ---------------------------------------------------------------
+    def visit_Assert(self, node: ast.Assert):
+        # assert_transformer.py parity: tensor predicates can't drive a
+        # Python assert under trace; route through convert_assert (real
+        # assert eagerly, dropped in compiled graphs like the Assert op)
+        self.generic_visit(node)
+        self._uid()   # counts as a conversion (assert-only fns convert too)
+        args = [_PredicateTransformer().visit(node.test)]
+        if node.msg is not None:
+            # lazy msg, like Python's assert: evaluated only on failure
+            args.append(ast.Lambda(args=_empty_args(), body=node.msg))
+        return ast.Expr(value=_jst_call("convert_assert", args))
+
     # -- if/else --------------------------------------------------------------
     def visit_If(self, node: ast.If):
         self.generic_visit(node)

@@ -457,3 +457,64 @@ def test_break_inside_with_falls_back_to_python():
     conv = convert_to_static(fn)
     x = paddle.to_tensor(np.array(0.0, np.float32))
     np.testing.assert_allclose(float(conv(x)._value), float(fn(x)._value))
+
+
+def test_assert_converts():
+    """assert statements: real assert eagerly, dropped under trace
+    (reference assert_transformer -> Assert op semantics)."""
+    import jax
+
+    def fn(x, thresh):
+        assert x.sum() > thresh, "too small"
+        return x * 2.0
+
+    conv = convert_to_static(fn)
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    np.testing.assert_allclose(np.asarray(conv(x, 0.0)._value), [2.0, 4.0])
+    try:
+        conv(x, 100.0)
+        raise RuntimeError("assert not raised")
+    except AssertionError as e:
+        assert "too small" in str(e)
+    # under trace the assert is dropped, not a TracerBoolConversionError
+    from paddle_tpu.core.tensor import Tensor
+    out = jax.jit(lambda v: conv(Tensor(v, _internal=True),
+                                 -1.0)._value)(jnp.ones(2))
+    np.testing.assert_allclose(np.asarray(out), [2.0, 2.0])
+
+
+def test_assert_compound_predicate_and_lazy_msg():
+    """Review regressions: compound (and/or) tensor predicates in asserts
+    are dropped under trace like simple ones, and the assert message stays
+    lazy (only evaluated on failure)."""
+    import jax
+
+    evals = []
+
+    def expensive_msg():
+        evals.append(1)
+        return "boom"
+
+    def fn(x):
+        assert (x.sum() > -100.0) and (x.sum() < 100.0), expensive_msg()
+        return x + 1.0
+
+    conv = convert_to_static(fn)
+    x = paddle.to_tensor(np.array([1.0], np.float32))
+    np.testing.assert_allclose(np.asarray(conv(x)._value), [2.0])
+    assert evals == []  # success path never evaluates the message
+    from paddle_tpu.core.tensor import Tensor
+    out = jax.jit(lambda v: conv(Tensor(v, _internal=True))._value)(
+        jnp.ones(1))
+    np.testing.assert_allclose(np.asarray(out), [2.0])
+
+    def fail_fn(x):
+        assert x.sum() > 100.0, expensive_msg()
+        return x
+
+    conv2 = convert_to_static(fail_fn)
+    try:
+        conv2(x)
+        raise RuntimeError("should have asserted")
+    except AssertionError as e:
+        assert "boom" in str(e) and evals == [1]

@@ -139,3 +139,41 @@ def _run_two_process(tmp_path, worker_src):
     assert set(losses) == {"RANK0", "RANK1"}, losses
     # the single-controller program must produce identical losses per rank
     np.testing.assert_array_equal(losses["RANK0"], losses["RANK1"])
+
+
+def test_launch_cli_end_to_end_collective(tmp_path):
+    """`python -m paddle_tpu.distributed.launch --nproc_per_node=2 t.py`
+    gives the workers a coordinator address (auto-picked on single node)
+    and the workers really form one jax.distributed world — the reference's
+    paddle.distributed.launch collective flow end-to-end."""
+    script = tmp_path / "train.py"
+    script.write_text(r"""
+import os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, os.environ["REPO_ROOT"])
+import paddle_tpu.distributed as dist
+dist.init_parallel_env()
+assert jax.process_count() == 2, jax.process_count()
+assert jax.device_count() == 4, jax.device_count()
+print(f"WORKER{os.environ['PADDLE_TRAINER_ID']} WORLD{jax.device_count()}",
+      flush=True)
+""")
+    env = dict(os.environ)
+    env["REPO_ROOT"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    env.pop("JAX_PLATFORMS", None)
+    log_dir = tmp_path / "logs"
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--log_dir", str(log_dir), str(script)],
+        env=env, capture_output=True, text=True, timeout=240,
+        cwd=env["REPO_ROOT"])
+    logs = ""
+    if log_dir.exists():
+        for f in sorted(log_dir.iterdir()):
+            logs += f"--- {f.name}\n{f.read_text()[-2000:]}\n"
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:],
+                                  logs)
+    assert "WORKER0 WORLD4" in logs and "WORKER1 WORLD4" in logs, logs
