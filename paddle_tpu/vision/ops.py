@@ -456,3 +456,140 @@ class PSRoIPool(Layer):
     def forward(self, x, boxes, boxes_num):
         return psroi_pool(x, boxes, boxes_num, self._output_size,
                           self._spatial_scale)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),  # noqa: A002
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False,
+              name=None):
+    """SSD anchor generator (phi prior_box_kernel.cc): returns
+    (boxes [H, W, P, 4] normalized xyxy, variances [H, W, P, 4]).
+    `min_max_aspect_ratios_order=False` (the reference default) emits
+    [min, ar..., max] per min-size; True emits [min, max, ar...]."""
+    feat = _unwrap(input)
+    img = _unwrap(image)
+    fh, fw = feat.shape[2], feat.shape[3]
+    ih, iw = img.shape[2], img.shape[3]
+    step_w = steps[0] or iw / fw
+    step_h = steps[1] or ih / fh
+
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - a) < 1e-6 for a in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+    ar_tail = [a for a in ars if abs(a - 1.0) >= 1e-6]
+
+    whs = []
+    for i, ms in enumerate(min_sizes):
+        ar_boxes = [(ms * np.sqrt(a), ms / np.sqrt(a)) for a in ar_tail]
+        max_box = []
+        if max_sizes:
+            sq = np.sqrt(ms * max_sizes[i])
+            max_box = [(sq, sq)]
+        if min_max_aspect_ratios_order:
+            whs += [(ms, ms)] + max_box + ar_boxes
+        else:
+            whs += [(ms, ms)] + ar_boxes + max_box
+
+    p = len(whs)
+    cx = (np.arange(fw) + offset) * step_w
+    cy = (np.arange(fh) + offset) * step_h
+    gx, gy = np.meshgrid(cx, cy)               # [H, W]
+    w = np.asarray([wh[0] for wh in whs])      # [P]
+    h = np.asarray([wh[1] for wh in whs])
+    boxes = np.stack([
+        (gx[..., None] - w * 0.5) / iw,
+        (gy[..., None] - h * 0.5) / ih,
+        (gx[..., None] + w * 0.5) / iw,
+        (gy[..., None] + h * 0.5) / ih,
+    ], axis=-1).astype(np.float32)             # [H, W, P, 4]
+    if clip:
+        boxes = np.clip(boxes, 0.0, 1.0)
+    vars_ = np.broadcast_to(np.asarray(variance, np.float32),
+                            (fh, fw, p, 4)).copy()
+    return (Tensor(jnp.asarray(boxes), _internal=True),
+            Tensor(jnp.asarray(vars_), _internal=True))
+
+
+def multiclass_nms(bboxes, scores, score_threshold=0.05, nms_top_k=400,
+                   keep_top_k=100, nms_threshold=0.3, normalized=True,
+                   nms_eta=1.0, background_label=0, rois_num=None,
+                   name=None):
+    """Per-class NMS (phi multiclass_nms3 CPU kernel — host-side
+    POST-PROCESSING in the reference too, not a traced op).
+
+    bboxes [M, 4] or batched [N, M, 4]; scores [C, M] or [N, C, M].
+    Returns (dets [K, 6] rows [label, score, x1, y1, x2, y2], index [K],
+    nms_rois_num [N]).  keep_top_k/nms_top_k of -1 mean unlimited;
+    `normalized=False` uses the pixel (+1 extent) IoU convention;
+    `nms_eta` < 1 adaptively decays the threshold like the reference.
+    """
+    b = np.asarray(_unwrap(bboxes))
+    s = np.asarray(_unwrap(scores))
+    batched = b.ndim == 3
+    if not batched:
+        b = b[None]
+        s = s[None]
+    norm = 0.0 if normalized else 1.0
+
+    def _np_nms(boxes, cscores):
+        order = np.argsort(-cscores)
+        if nms_top_k > -1:
+            order = order[:nms_top_k]
+        keep = []
+        thresh = nms_threshold
+        areas = (boxes[:, 2] - boxes[:, 0] + norm) * \
+            (boxes[:, 3] - boxes[:, 1] + norm)
+        while order.size:
+            i = order[0]
+            keep.append(int(i))
+            if order.size == 1:
+                break
+            rest = order[1:]
+            lt = np.maximum(boxes[i, :2], boxes[rest, :2])
+            rb = np.minimum(boxes[i, 2:], boxes[rest, 2:])
+            wh = np.clip(rb - lt + norm, 0, None)
+            inter = wh[:, 0] * wh[:, 1]
+            iou = inter / np.maximum(areas[i] + areas[rest] - inter, 1e-10)
+            order = rest[iou <= thresh]
+            if nms_eta < 1.0 and thresh > 0.5:
+                thresh *= nms_eta
+        return keep
+
+    all_dets, all_picks, per_img = [], [], []
+    base = 0
+    for n in range(b.shape[0]):
+        dets, picks = [], []
+        for c in range(s.shape[1]):
+            if c == background_label:
+                continue
+            cs = s[n, c]
+            cand = np.where(cs > score_threshold)[0]
+            if cand.size == 0:
+                continue
+            for k in _np_nms(b[n][cand], cs[cand]):
+                gi = int(cand[k])
+                dets.append([float(c), float(cs[gi])] + b[n, gi].tolist())
+                picks.append(base + gi)
+        if dets:
+            order = np.argsort(-np.asarray([d[1] for d in dets]))
+            if keep_top_k > -1:
+                order = order[:keep_top_k]
+            dets = np.asarray(dets, np.float32)[order]
+            picks = np.asarray(picks, np.int64)[order]
+        else:
+            dets = np.zeros((0, 6), np.float32)
+            picks = np.zeros((0,), np.int64)
+        all_dets.append(dets)
+        all_picks.append(picks)
+        per_img.append(len(dets))
+        base += b.shape[1]
+    dets = np.concatenate(all_dets) if all_dets else \
+        np.zeros((0, 6), np.float32)
+    picks = np.concatenate(all_picks) if all_picks else \
+        np.zeros((0,), np.int64)
+    return (Tensor(jnp.asarray(dets), _internal=True),
+            Tensor(jnp.asarray(picks), _internal=True),
+            Tensor(jnp.asarray(per_img, jnp.int32), _internal=True))

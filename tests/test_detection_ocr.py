@@ -265,3 +265,84 @@ def test_svtr_exports_through_predictor(tmp_path):
     pred.run()
     out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
     np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_prior_box_matches_ssd_geometry():
+    """phi prior_box kernel semantics: center/step/offset geometry,
+    min/max/aspect box set, normalized output."""
+    from paddle_tpu.vision import ops as vops
+
+    feat = paddle.to_tensor(np.zeros((1, 8, 4, 4), "float32"))
+    img = paddle.to_tensor(np.zeros((1, 3, 64, 64), "float32"))
+    boxes, variances = vops.prior_box(
+        feat, img, min_sizes=[16.0], max_sizes=[32.0],
+        aspect_ratios=[2.0], flip=True, clip=True,
+        variance=[0.1, 0.1, 0.2, 0.2])
+    b = boxes.numpy()
+    v = variances.numpy()
+    # P = min + sqrt(min*max) + 2 flipped aspect boxes
+    assert b.shape == (4, 4, 4, 4) and v.shape == b.shape
+    # cell (0,0): center at offset*step = 8 px
+    cx = (b[0, 0, 0, 0] + b[0, 0, 0, 2]) / 2 * 64
+    cy = (b[0, 0, 0, 1] + b[0, 0, 0, 3]) / 2 * 64
+    np.testing.assert_allclose([cx, cy], [8.0, 8.0], atol=1e-4)
+    # first box is the min-size square (16px -> 0.25 normalized)
+    np.testing.assert_allclose(b[0, 0, 0, 2] - b[0, 0, 0, 0], 16 / 64,
+                               atol=1e-5)
+    # second is the sqrt(16*32) square (probe an interior cell — the
+    # corner cell's large boxes are clipped to the image)
+    np.testing.assert_allclose(b[1, 1, 1, 2] - b[1, 1, 1, 0],
+                               np.sqrt(16 * 32) / 64, atol=1e-5)
+    assert (b >= 0).all() and (b <= 1).all()
+    np.testing.assert_allclose(v[0, 0, 0], [0.1, 0.1, 0.2, 0.2])
+
+
+def test_multiclass_nms_per_class_and_topk():
+    from paddle_tpu.vision import ops as vops
+
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]],
+                     "float32")
+    scores = np.array([
+        [0.9, 0.85, 0.1],    # class 0: two overlapping + one below thresh
+        [0.2, 0.3, 0.95],    # class 1
+    ], "float32")
+    dets, idx, num = vops.multiclass_nms(
+        paddle.to_tensor(boxes), paddle.to_tensor(scores),
+        score_threshold=0.25, nms_threshold=0.5, background_label=-1)
+    d = dets.numpy()
+    assert int(num.numpy()[0]) == len(d)
+    # class 0 keeps only the 0.9 box (0.85 suppressed); class 1 keeps both
+    # its candidates (disjoint boxes) above threshold
+    labels_scores = {(int(r[0]), round(float(r[1]), 2)) for r in d}
+    assert (0, 0.9) in labels_scores
+    assert (1, 0.95) in labels_scores and (1, 0.3) in labels_scores
+    assert (0, 0.85) not in labels_scores
+    # sorted by score desc and keep_top_k respected
+    assert list(d[:, 1]) == sorted(d[:, 1], reverse=True)
+    d2, _, _ = vops.multiclass_nms(
+        paddle.to_tensor(boxes), paddle.to_tensor(scores),
+        score_threshold=0.25, nms_threshold=0.5, keep_top_k=1,
+        background_label=-1)
+    assert len(d2.numpy()) == 1
+
+    # reference default background_label=0 skips class 0 entirely
+    d3, _, _ = vops.multiclass_nms(
+        paddle.to_tensor(boxes), paddle.to_tensor(scores),
+        score_threshold=0.25, nms_threshold=0.5)
+    assert set(d3.numpy()[:, 0]) == {1.0}
+
+    # -1 sentinels mean unlimited (reference contract)
+    d4, _, _ = vops.multiclass_nms(
+        paddle.to_tensor(boxes), paddle.to_tensor(scores),
+        score_threshold=0.25, nms_threshold=0.5, keep_top_k=-1,
+        nms_top_k=-1, background_label=-1)
+    assert len(d4.numpy()) == 3
+
+    # batched [N, M, 4] / [N, C, M] with per-image counts
+    bb = np.stack([boxes, boxes])
+    ss = np.stack([scores, scores])
+    d5, idx5, num5 = vops.multiclass_nms(
+        paddle.to_tensor(bb), paddle.to_tensor(ss),
+        score_threshold=0.25, nms_threshold=0.5, background_label=-1)
+    assert list(num5.numpy()) == [3, 3] and len(d5.numpy()) == 6
+    assert (idx5.numpy()[3:] >= 3).all()  # second image indexes offset

@@ -36,7 +36,7 @@ ALIASES = {
     "linear_interp": "nn.functional.interpolate",
     "nearest_interp": "nn.functional.interpolate",
     "trilinear_interp": "nn.functional.interpolate",
-    "box_coder": None,  # see DROPPED
+    "box_coder": "vision.ops.box_coder",
     "brelu": "nn.functional.hardtanh",
     "cast": "core.tensor.Tensor.astype",
     "cross_entropy_with_softmax": "nn.functional.softmax_with_cross_entropy",
@@ -65,6 +65,8 @@ ALIASES = {
     "max_pool3d_with_index": "nn.functional.max_pool3d",
     "mean_all": "mean",
     "nms": "vision.ops.nms",
+    "multiclass_nms3": "vision.ops.multiclass_nms",
+    "prior_box": "vision.ops.prior_box",
     "p_norm": "linalg.norm",
     "pad3d": "nn.functional.pad",
     "pool2d": "nn.functional.avg_pool2d",
@@ -124,13 +126,8 @@ SUBSUMED = {
 
 # deliberately not carried (reason on record; see docs/DESIGN_DECISIONS.md)
 DROPPED = {
-    "box_coder": "SSD/FasterRCNN anchor-box codec; the detection path here "
-                 "is the anchor-free PPYOLOE family + YOLOv3 (vision/)",
-    "prior_box": "SSD anchor generator — same scope decision as box_coder",
     "matrix_nms": "PP-YOLOv2-era NMS variant; vision.ops.nms covers the "
                   "predictor path",
-    "multiclass_nms3": "per-class NMS wrapper over nms; trivially "
-                       "composable from vision.ops.nms",
     "distribute_fpn_proposals": "FasterRCNN FPN routing, out of the "
                                 "supported detector families",
     "generate_proposals_v2": "RPN proposal stage, same scope decision",
@@ -204,7 +201,7 @@ def test_every_yaml_op_is_accounted_for():
              if op in OP_REGISTRY or op in top]
     assert not stale, f"SUBSUMED/DROPPED entries now implemented: {stale}"
     overlap = (set(ALIASES) & set(SUBSUMED)) | \
-        (set(ALIASES) - {"box_coder"}) & set(DROPPED) | \
+        (set(ALIASES) & set(DROPPED)) | \
         (set(SUBSUMED) & set(DROPPED))
     assert not overlap, f"tables overlap: {overlap}"
 
