@@ -91,7 +91,9 @@ def bench_resnet50():
 
     dev = jax.devices()[0]
     on_tpu = dev.platform != "cpu"
-    batch, steps = (64, 10) if on_tpu else (2, 2)
+    # batch 128 amortizes the fixed per-op costs best on one v5e chip
+    # (measured: 64 -> 0.130 MFU, 128 -> 0.146, 256 -> 0.143)
+    batch, steps = (128, 10) if on_tpu else (2, 2)
     size = 224 if on_tpu else 32
 
     paddle.seed(0)
@@ -106,19 +108,30 @@ def bench_resnet50():
     rng = np.random.RandomState(0)
     # device-resident batch: a real input pipeline overlaps H2D with
     # compute; through the remote tunnel an un-overlapped 38 MB image batch
-    # would otherwise dominate the measurement (docs/PERF.md)
+    # would otherwise dominate the measurement (docs/PERF.md).  The K-step
+    # stack is materialized ON DEVICE (broadcast of one batch) and stepped
+    # through run_steps — one dispatch for all K steps, the same
+    # amortization the reference gets from its C++ trainer run loop
+    # (trainer.cc); at ~26 ms device steps the per-dispatch tunnel cost
+    # would otherwise add ~8 ms/step.
     import jax.numpy as jnp
-    x = jnp.asarray(
+    x1 = jnp.asarray(
         rng.standard_normal((batch, 3, size, size)).astype(np.float32))
-    y = jnp.asarray(rng.randint(0, 1000, (batch,)).astype(np.int64))
-    loss = step(x, y)
-    float(loss)
+    y1 = jnp.asarray(rng.randint(0, 1000, (batch,)).astype(np.int64))
+    rep = jax.jit(lambda a, k: jnp.broadcast_to(a[None], (k,) + a.shape) + 0,
+                  static_argnums=1)
+    x, y = rep(x1, steps), rep(y1, steps)
+    jax.block_until_ready(x)
+    loss = step.run_steps(x, y)  # compile + warmup
+    np.asarray(loss.numpy() if hasattr(loss, "numpy") else loss)
+    reps = 3
     t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = step(x, y)
-    float(loss)
+    for _ in range(reps):
+        loss = step.run_steps(x, y)
+    losses = np.asarray(loss.numpy() if hasattr(loss, "numpy") else loss)
     dt = time.perf_counter() - t0
-    ips = batch * steps / dt
+    loss = float(losses[-1])
+    ips = batch * steps * reps / dt
     # ~3.8 GFLOP/image fwd at 224², x3 for fwd+bwd
     mfu = ips * 3 * 3.8e9 / _peak_flops(dev) if on_tpu else 0.0
     print(json.dumps({
@@ -129,6 +142,57 @@ def bench_resnet50():
     }))
     print(f"# resnet50 device={dev.device_kind} loss={float(loss):.4f} "
           f"mfu={mfu:.3f} batch={batch} dt={dt:.2f}s", file=sys.stderr)
+
+
+def bench_ppyoloe():
+    """PP-YOLOE-s-class detector train step at 640x640 (BASELINE.md row 6;
+    conv-heavy detection workload on top of the same conv/BN path as
+    ResNet).  No reference number exists in-tree, so vs_baseline reports
+    MFU/0.35 like the other rows (FLOPs ~17.4 GFLOP/image fwd at 6402 for
+    the s scale)."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.vision.models import PPYOLOE, PPYOLOELoss
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    batch, size, steps = (8, 640, 10) if on_tpu else (2, 64, 2)
+
+    paddle.seed(0)
+    model = PPYOLOE(num_classes=80)
+    loss_fn = PPYOLOELoss(model)
+    opt = paddle.optimizer.Momentum(learning_rate=0.01, momentum=0.9,
+                                    parameters=model.parameters(),
+                                    weight_decay=5e-4)
+    step = dist.make_train_step(
+        model, opt, loss_fn=loss_fn, num_labels=2,
+        compute_dtype="bfloat16" if on_tpu else None)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(
+        rng.standard_normal((batch, 3, size, size)).astype(np.float32))
+    gtb = jnp.asarray(np.stack([np.array([[4, 4, 300, 300], [64, 32, 400,
+                                          500]], "float32")] * batch))
+    gtl = jnp.asarray(np.stack([np.array([1, 3], "int64")] * batch))
+    loss = step(x, gtb, gtl)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(x, gtb, gtl)
+    float(loss)
+    dt = time.perf_counter() - t0
+    ips = batch * steps / dt
+    mfu = ips * 3 * 17.4e9 / _peak_flops(dev) if on_tpu else 0.0
+    print(json.dumps({
+        "metric": "ppyoloe_s_images_per_sec_per_chip",
+        "value": round(ips, 1),
+        "unit": "images/s/chip",
+        "vs_baseline": round(mfu / 0.35, 4) if on_tpu else 0.0,
+    }))
+    print(f"# ppyoloe device={dev.device_kind} loss={float(loss):.4f} "
+          f"step={dt / steps * 1000:.1f}ms mfu={mfu:.3f}", file=sys.stderr)
 
 
 def bench_bert():
@@ -189,3 +253,4 @@ if __name__ == "__main__":
     if "--all" in sys.argv:
         bench_resnet50()
         bench_bert()
+        bench_ppyoloe()

@@ -380,6 +380,13 @@ class ShardedTrainStep:
                 # memory for the update, return to pinned host after
                 slots_tree = stage_slots(slots_tree, "device")
             new_params, new_slots = {}, {}
+            # NOTE: a fused flat optimizer update (concatenate all params,
+            # one element-wise kernel, slice back — the reference's
+            # fuse_all_optimizer_ops) was measured HARMFUL here: ResNet-50
+            # went 1855 -> 716 img/s because the reshape(-1)/concat forces
+            # layout copies of every custom-layout conv weight and the
+            # sliced outputs can no longer alias the donated input buffers
+            # (docs/PERF.md "Dead ends").  The per-param loop stays.
             for k, p in params.items():
                 ctx = {"decay": decay_of[k]}
                 g = grads[k]
@@ -409,13 +416,17 @@ class ShardedTrainStep:
                          "rng": state_tree["rng"]}
             return new_state, loss
 
-        donate = []
-        if self.donate:
-            donate.append(0)
-            if not offload:
-                donate.append(1)
         self._raw_step = step_fn
-        return jax.jit(step_fn, donate_argnums=tuple(donate))
+        return jax.jit(step_fn, donate_argnums=self._donate_argnums())
+
+    def _donate_argnums(self):
+        """Shared donation policy for the single- and multi-step jits:
+        donate the core state (arg 0) only when the caller opted in, and
+        the slot tree (arg 1) only when it is not offloaded to pinned host
+        memory (input/output memory kinds must match for donation)."""
+        if not self.donate:
+            return ()
+        return (0,) if self.offload else (0, 1)
 
     def _split_tree(self):
         tree = self.state.tree()
@@ -471,8 +482,8 @@ class ShardedTrainStep:
                 out["slots"] = slots_f
                 return out, losses
 
-            donate = (0,) if self.offload else (0, 1)
-            self._jitted_multi = jax.jit(multi_fn, donate_argnums=donate)
+            self._jitted_multi = jax.jit(
+                multi_fn, donate_argnums=self._donate_argnums())
         # per-step learning rates: schedules keyed on the optimizer step
         # count must see the same sequence K single-step calls would
         opt = self.optimizer

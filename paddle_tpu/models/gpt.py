@@ -28,8 +28,11 @@ import math
 from dataclasses import dataclass
 
 import jax
+import jax.numpy as jnp
+import numpy as np
 from jax.sharding import PartitionSpec as P
 
+from ..core.tensor import Tensor
 from ..distributed import mesh as mesh_mod
 
 from ..distributed.fleet.layers.mpu.mp_layers import (
@@ -120,6 +123,11 @@ def _activation_spec() -> P:
     return P(("dp", "sharding"), "sep", None)
 
 
+# fused-qkv column layout versions: 1 = role-major [3, nh, hd] (round-1 /
+# reference fused_attention_op.cu layout), 2 = head-major [nh, 3, hd]
+QKV_LAYOUT_HEAD_MAJOR = 2
+
+
 class GPTSelfAttention(Layer):
     """Causal self-attention: fused QKV column-parallel projection, flash
     attention core, row-parallel output projection — the TP structure of the
@@ -151,11 +159,59 @@ class GPTSelfAttention(Layer):
         # the same weight layout serves both the GSPMD path (constraint on
         # the nh dim) and the explicit shard_map pipeline path where the
         # local shard is reshaped directly.
-        # CHECKPOINT NOTE: importing reference-layout fused qkv weights
-        # requires permuting the output columns
-        # W.reshape(h, 3, nh, hd).transpose(0, 2, 1, 3).reshape(h, 3*h)
-        # (and the same on the bias); shapes match either way, so loaders
-        # cannot detect the mismatch.
+        # The fused-column layout is versioned: qkv_layout==2 means
+        # head-major [nh, 3, hd]. Checkpoints without the marker (round-1
+        # saves, reference exports) are role-major [3, nh, hd] and are
+        # permuted on load by _state_dict_compat_ below.
+        self.register_buffer(
+            "qkv_layout",
+            Tensor(jnp.asarray(QKV_LAYOUT_HEAD_MAJOR, jnp.int32),
+                   _internal=True))
+
+    # What a checkpoint WITHOUT a qkv_layout marker means.  Markerless
+    # checkpoints are ambiguous: saves made after the head-major layout
+    # landed but before the marker existed are head-major, while reference
+    # exports (fused_attention_op.cu) are role-major.  Head-major is the
+    # default because that is what every save from this codebase since the
+    # layout change contains; set to "role_major" (class-wide) before
+    # set_state_dict to import reference-layout fused qkv weights.
+    markerless_qkv_layout = "head_major"
+
+    def _state_dict_compat_(self, state, prefix):
+        """Migrate role-major fused-qkv checkpoints (qkv_layout marker < 2,
+        or markerless with markerless_qkv_layout == "role_major") to the
+        head-major column layout in place."""
+        wkey = prefix + "qkv_proj.weight"
+        mkey = prefix + "qkv_layout"
+        if wkey not in state:
+            return
+        marker = state.get(mkey)
+        if marker is None:
+            if self.markerless_qkv_layout != "role_major":
+                # assume head-major (every post-layout-change save); stamp
+                # the marker so the re-saved checkpoint is unambiguous
+                state[mkey] = jnp.asarray(QKV_LAYOUT_HEAD_MAJOR, jnp.int32)
+                return
+        elif int(np.asarray(
+                marker._value if hasattr(marker, "_value") else marker)) \
+                >= QKV_LAYOUT_HEAD_MAJOR:
+            return
+        nh_hd = self.num_heads * self.head_dim
+
+        def _permute(arr, is_bias):
+            a = np.asarray(arr._value if hasattr(arr, "_value") else arr)
+            if is_bias:
+                return a.reshape(3, self.num_heads, self.head_dim) \
+                        .transpose(1, 0, 2).reshape(3 * nh_hd)
+            h = a.shape[0]
+            return a.reshape(h, 3, self.num_heads, self.head_dim) \
+                    .transpose(0, 2, 1, 3).reshape(h, 3 * nh_hd)
+
+        state[wkey] = jnp.asarray(_permute(state[wkey], False))
+        bkey = prefix + "qkv_proj.bias"
+        if bkey in state:
+            state[bkey] = jnp.asarray(_permute(state[bkey], True))
+        state[mkey] = jnp.asarray(QKV_LAYOUT_HEAD_MAJOR, jnp.int32)
 
     def forward(self, x, cache=None, use_cache=False):
         b, t = x.shape[0], x.shape[1]

@@ -49,15 +49,30 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
         shape = [1] * xv.ndim
         shape[channel_axis] = xv.shape[channel_axis]
         if use_batch_stats:
-            mean = jnp.mean(xv, axis=reduce_axes)
-            var = jnp.var(xv, axis=reduce_axes)
+            # one-pass stats: E[x²]−E[x]² lets XLA fuse both channel
+            # reductions into a single read of the activation, where the
+            # two-pass mean→var form forces a second dependent pass
+            # (measured on ResNet-50, tools/profile_model.py).  The
+            # subtraction MUST happen in f32: jnp.mean returns the input
+            # half dtype, and a bf16 E[x²]−E[x]² cancels catastrophically
+            # when |mean| >> std (bf16 x with mean 10, std 0.1 gives
+            # var == 0).  The f32 cast fuses into the same reduce pass.
+            xf = xv if xv.dtype == jnp.float32 else xv.astype(jnp.float32)
+            mean = jnp.mean(xf, axis=reduce_axes)
+            var = jnp.maximum(
+                jnp.mean(jnp.square(xf), axis=reduce_axes)
+                - jnp.square(mean), 0)
         else:
             mean, var = rm, rv
-        out = (xv - mean.reshape(shape)) * jax.lax.rsqrt(var.reshape(shape) + epsilon)
-        if w is not None:
-            out = out * w.reshape(shape)
+        # fold the normalisation into one scale+shift over x: out =
+        # x*scale + shift with per-channel scalars, a single fused pass
+        inv = jax.lax.rsqrt(var.astype(jnp.float32) + epsilon)
+        scale = inv if w is None else inv * w.astype(jnp.float32)
+        shift = -mean.astype(jnp.float32) * scale
         if b is not None:
-            out = out + b.reshape(shape)
+            shift = shift + b.astype(jnp.float32)
+        out = xv * scale.reshape(shape).astype(xv.dtype) \
+            + shift.reshape(shape).astype(xv.dtype)
         return out, mean, var
 
     out, mean, var = apply_op(impl, "batch_norm",

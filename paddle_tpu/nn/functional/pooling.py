@@ -2,11 +2,44 @@
 (reference: python/paddle/nn/functional/pooling.py)."""
 from __future__ import annotations
 
+import functools
+
 import numpy as np
 import jax
 import jax.numpy as jnp
 
 from ...core.op import defop
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def _rw_max_pool(x, window, strides, pads):
+    """Max pool as reduce_window with an explicit select-and-scatter
+    backward.  The generic reduce_window JVP fails partial-eval when nested
+    inside the eager tape's per-op jax.vjp (docs/PERF.md); this custom rule
+    sidesteps it AND avoids the patches form, which materializes a
+    kernel-size× copy of the activation (measured 9 ms/step of ResNet-50's
+    38 ms, tools/profile_model.py)."""
+    neg = (-jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
+           else jnp.iinfo(x.dtype).min)
+    return jax.lax.reduce_window(x, jnp.asarray(neg, x.dtype), jax.lax.max,
+                                 window, strides, pads)
+
+
+def _rw_max_pool_fwd(x, window, strides, pads):
+    return _rw_max_pool(x, window, strides, pads), x
+
+
+def _rw_max_pool_bwd(window, strides, pads, x, g):
+    from jax._src.lax import lax as lax_internal
+    from jax._src.lax.windowed_reductions import select_and_scatter_add_p
+    dx = select_and_scatter_add_p.bind(
+        g, x, select_prim=lax_internal.ge_p,
+        window_dimensions=tuple(window), window_strides=tuple(strides),
+        padding=tuple(pads))
+    return (dx,)
+
+
+_rw_max_pool.defvjp(_rw_max_pool_fwd, _rw_max_pool_bwd)
 
 
 def _tuplize(v, n):
@@ -132,14 +165,24 @@ def _pool(x, kernel, stride, padding, n, channel_last, kind, ceil_mode=False,
         lax_pad = full
 
     if kind == "max":
-        # patches + jnp.max instead of reduce_window(lax.max): the generic
-        # reduce_window JVP fails partial-eval when nested inside the eager
-        # tape's per-op vjp ("Linearization failed to produce known
-        # values"), and the patch form yields window argmax indices for
-        # return_mask anyway
-        return _max_pool_patches(x, kernel, stride, lax_pad, n,
-                                 channel_last, spatial,
-                                 with_index=return_mask)
+        if return_mask:
+            if jnp.issubdtype(x.dtype, jnp.integer):
+                raise NotImplementedError(
+                    "max_pool with return_mask=True is not supported for "
+                    "integer dtypes: the window-argmax path is a one-hot "
+                    "convolution, which does not lower for integers on "
+                    "TPU; cast to a float dtype or drop return_mask")
+            # the patch form is the only one that yields window argmax
+            # indices; it materializes kernel-size× the activation, so it
+            # is reserved for the mask case
+            return _max_pool_patches(x, kernel, stride, lax_pad, n,
+                                     channel_last, spatial, with_index=True)
+        if isinstance(lax_pad, str):
+            pads = jax.lax.padtype_to_pads(x.shape, window, strides, lax_pad)
+        else:
+            pads = lax_pad
+        return _rw_max_pool(x, tuple(window), tuple(strides),
+                            tuple(tuple(p) for p in pads))
 
     # avg pool: sum then divide (exclusive → divide by actual window size)
     zero = jnp.zeros((), x.dtype)
@@ -233,8 +276,8 @@ def _adaptive_pool(x, output_size, n, channel_last, kind):
 
 
 @defop
-def adaptive_avg_pool1d(x, output_size, name=None):
-    return _adaptive_pool(x, output_size, 1, False, "avg")
+def adaptive_avg_pool1d(x, output_size, data_format="NCL", name=None):
+    return _adaptive_pool(x, output_size, 1, data_format == "NLC", "avg")
 
 
 @defop
@@ -248,15 +291,18 @@ def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
 
 
 @defop
-def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
-    return _adaptive_pool(x, output_size, 1, False, "max")
+def adaptive_max_pool1d(x, output_size, return_mask=False,
+                        data_format="NCL", name=None):
+    return _adaptive_pool(x, output_size, 1, data_format == "NLC", "max")
 
 
 @defop
-def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
-    return _adaptive_pool(x, output_size, 2, False, "max")
+def adaptive_max_pool2d(x, output_size, return_mask=False,
+                        data_format="NCHW", name=None):
+    return _adaptive_pool(x, output_size, 2, data_format == "NHWC", "max")
 
 
 @defop
-def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
-    return _adaptive_pool(x, output_size, 3, False, "max")
+def adaptive_max_pool3d(x, output_size, return_mask=False,
+                        data_format="NCDHW", name=None):
+    return _adaptive_pool(x, output_size, 3, data_format == "NDHWC", "max")

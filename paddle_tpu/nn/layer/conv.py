@@ -6,6 +6,7 @@ import numpy as np
 from .. import functional as F
 from .. import initializer as I
 from ..layer_base import Layer
+from ..layout import resolve_data_format
 
 
 def _tuplize(v, n):
@@ -29,7 +30,7 @@ class _ConvNd(Layer):
         self._output_padding = output_padding
         self._dilation = dilation
         self._groups = groups
-        self._data_format = data_format
+        self._data_format = resolve_data_format(data_format)
         self._n = n
         if transpose:
             w_shape = (in_channels, out_channels // groups) + self._kernel_size

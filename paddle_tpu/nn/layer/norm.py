@@ -7,6 +7,7 @@ from ...core.tensor import Tensor
 from .. import functional as F
 from .. import initializer as I
 from ..layer_base import Layer
+from ..layout import resolve_data_format as _resolve_data_format
 
 
 class _BatchNormBase(Layer):
@@ -17,7 +18,7 @@ class _BatchNormBase(Layer):
         self._num_features = num_features
         self._momentum = momentum
         self._epsilon = epsilon
-        self._data_format = data_format
+        self._data_format = _resolve_data_format(data_format)
         self._use_global_stats = use_global_stats
         self.weight = self.create_parameter(
             (num_features,), attr=weight_attr,
@@ -102,7 +103,7 @@ class GroupNorm(Layer):
         super().__init__()
         self._num_groups = num_groups
         self._epsilon = epsilon
-        self._data_format = data_format
+        self._data_format = _resolve_data_format(data_format)
         self.weight = (None if weight_attr is False else self.create_parameter(
             (num_channels,), attr=weight_attr,
             default_initializer=I.Constant(1.0)))

@@ -3,19 +3,23 @@ from __future__ import annotations
 
 from .. import functional as F
 from ..layer_base import Layer
+from ..layout import resolve_data_format
 
 
 class _Pool(Layer):
     def __init__(self, **kw):
         super().__init__()
         self._kw = {k: v for k, v in kw.items() if k != "name"}
+        if "data_format" in self._kw:
+            self._kw["data_format"] = resolve_data_format(
+                self._kw["data_format"])
 
 
 class MaxPool1D(_Pool):
     def __init__(self, kernel_size, stride=None, padding=0, return_mask=False,
                  ceil_mode=False, name=None):
         super().__init__(kernel_size=kernel_size, stride=stride, padding=padding,
-                         ceil_mode=ceil_mode)
+                         ceil_mode=ceil_mode, data_format="NCL")
 
     def forward(self, x):
         return F.max_pool1d(x, **self._kw)
@@ -45,7 +49,8 @@ class AvgPool1D(_Pool):
     def __init__(self, kernel_size, stride=None, padding=0, exclusive=True,
                  ceil_mode=False, name=None):
         super().__init__(kernel_size=kernel_size, stride=stride, padding=padding,
-                         exclusive=exclusive, ceil_mode=ceil_mode)
+                         exclusive=exclusive, ceil_mode=ceil_mode,
+                         data_format="NCL")
 
     def forward(self, x):
         return F.avg_pool1d(x, **self._kw)
@@ -77,7 +82,7 @@ class AvgPool3D(_Pool):
 
 class AdaptiveAvgPool1D(_Pool):
     def __init__(self, output_size, name=None):
-        super().__init__(output_size=output_size)
+        super().__init__(output_size=output_size, data_format="NCL")
 
     def forward(self, x):
         return F.adaptive_avg_pool1d(x, **self._kw)
@@ -101,7 +106,7 @@ class AdaptiveAvgPool3D(_Pool):
 
 class AdaptiveMaxPool1D(_Pool):
     def __init__(self, output_size, return_mask=False, name=None):
-        super().__init__(output_size=output_size)
+        super().__init__(output_size=output_size, data_format="NCL")
 
     def forward(self, x):
         return F.adaptive_max_pool1d(x, **self._kw)
@@ -109,7 +114,7 @@ class AdaptiveMaxPool1D(_Pool):
 
 class AdaptiveMaxPool2D(_Pool):
     def __init__(self, output_size, return_mask=False, name=None):
-        super().__init__(output_size=output_size)
+        super().__init__(output_size=output_size, data_format="NCHW")
 
     def forward(self, x):
         return F.adaptive_max_pool2d(x, **self._kw)
@@ -117,7 +122,7 @@ class AdaptiveMaxPool2D(_Pool):
 
 class AdaptiveMaxPool3D(_Pool):
     def __init__(self, output_size, return_mask=False, name=None):
-        super().__init__(output_size=output_size)
+        super().__init__(output_size=output_size, data_format="NCDHW")
 
     def forward(self, x):
         return F.adaptive_max_pool3d(x, **self._kw)

@@ -276,6 +276,13 @@ class Layer:
         return dest
 
     def set_state_dict(self, state_dict, use_structured_name=True):
+        state_dict = dict(state_dict)
+        # layers may define _state_dict_compat_(state, prefix) to migrate
+        # legacy/foreign checkpoint layouts in place before matching
+        for _, pfx, layer in self._traverse("", True):
+            hook = getattr(layer, "_state_dict_compat_", None)
+            if hook is not None:
+                hook(state_dict, pfx)
         own = self.state_dict()
         missing, unexpected = [], []
         matched = {}

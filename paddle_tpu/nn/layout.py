@@ -1,0 +1,59 @@
+"""Channels-last construction mode — build any image model NHWC for TPU.
+
+The reference keeps NCHW as the only model-zoo layout (its cuDNN kernels
+prefer it).  TPU prefers channels-LAST: the channel dim lands on the
+128-lane minor axis, so BatchNorm's per-channel reductions and the conv
+epilogues vectorize without the layout copies NCHW forces (measured on
+ResNet-50: the NCHW step spends ~2/3 of its device time in BN reduce /
+apply passes and transposes, docs/PERF.md).
+
+Usage::
+
+    with paddle_tpu.nn.channels_last():
+        model = resnet50()          # every image layer built as NHWC
+    out = model(nhwc_images)        # inputs/outputs are channel-last
+
+Inside the context every image layer constructed with a channel-FIRST
+``data_format`` (the reference default) is flipped to its channel-last
+equivalent; explicitly channel-last arguments pass through unchanged.
+Parameter shapes are identical either way (conv weights stay OIHW), so
+state dicts move freely between NCHW- and NHWC-built models.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+__all__ = ["channels_last", "is_channels_last", "resolve_data_format"]
+
+_state = threading.local()
+
+_TO_CHANNEL_LAST = {
+    "NCHW": "NHWC",
+    "NCL": "NLC",
+    "NCDHW": "NDHWC",
+}
+
+
+def is_channels_last() -> bool:
+    """True while inside a channels_last() construction context."""
+    return getattr(_state, "on", False)
+
+
+@contextlib.contextmanager
+def channels_last(enable: bool = True):
+    """Construction context: image layers default to channel-last layouts."""
+    prev = getattr(_state, "on", False)
+    _state.on = bool(enable)
+    try:
+        yield
+    finally:
+        _state.on = prev
+
+
+def resolve_data_format(data_format: str) -> str:
+    """Map a channel-first data_format to channel-last when constructing
+    inside channels_last(); otherwise return it unchanged."""
+    if data_format and is_channels_last():
+        return _TO_CHANNEL_LAST.get(data_format, data_format)
+    return data_format

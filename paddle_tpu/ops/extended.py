@@ -106,16 +106,26 @@ def fill(x, value, name=None):
 
 @defop
 def fill_diagonal(x, value, offset=0, wrap=False, name=None):
-    """phi fill_diagonal_kernel (2-D)."""
-    n = min(x.shape[0], x.shape[1])
-    rng = jnp.arange(n)
-    rows = rng + max(-offset, 0)
-    cols = rng + max(offset, 0)
-    keep = (rows < x.shape[0]) & (cols < x.shape[1])
-    rows = jnp.where(keep, rows, 0)
-    cols = jnp.where(keep, cols, 0)
-    vals = jnp.where(keep, jnp.full((n,), value, x.dtype), x[rows, cols])
-    return x.at[rows, cols].set(vals)
+    """phi fill_diagonal_kernel (cpu/fill_diagonal_kernel.cc:36-55): walks
+    the FLAT buffer in diagonal-stride steps; `offset` shifts the write
+    within the row (skipped where it leaves the row), and `wrap` extends
+    the walk past the first n*n elements so tall matrices get the diagonal
+    refilled in cycles."""
+    n_last = x.shape[-1]
+    # diagonal step = sum of all dim strides (CalStride); for 2-D this is
+    # n+1, for the >2-D all-equal-dims case the same formula applies
+    strides = np.cumprod((x.shape[1:] + (1,))[::-1])[::-1]
+    step = int(strides.sum())
+    size = int(np.prod(x.shape))
+    if not wrap:
+        size = min(size, n_last * n_last)
+    flat_idx = np.arange(0, size, step)
+    cols = flat_idx % n_last + offset
+    flat_idx = flat_idx[(cols >= 0) & (cols < n_last)] + offset
+    if flat_idx.size == 0:
+        return x
+    return x.reshape(-1).at[jnp.asarray(flat_idx)].set(
+        jnp.asarray(value, x.dtype)).reshape(x.shape)
 
 
 @defop
@@ -208,41 +218,48 @@ def gather_tree(ids, parents, name=None):
 def viterbi_decode(potentials, transition_params, lengths=None,
                    include_bos_eos_tag=True, name=None):
     """phi viterbi_decode_kernel: CRF max-sum decode.
-    potentials [B, T, C], transition [C, C] -> (scores [B], paths [B, T])."""
-    b, t, c = potentials.shape
-    if include_bos_eos_tag:
-        # reference convention: last two tags are BOS/EOS
-        start = transition_params[c - 2][None, :]
-        stop = transition_params[:, c - 1]
-    else:
-        start = jnp.zeros((1, c), potentials.dtype)
-        stop = jnp.zeros((c,), potentials.dtype)
+    potentials [B, T, C], transition [C, C] -> (scores [B], paths [B, T]).
+    Single source for both paddle.viterbi_decode and paddle.text."""
+    pot, trans = potentials, transition_params
+    b, t, c = pot.shape
     if lengths is None:
         lens = jnp.full((b,), t, jnp.int32)
     else:
         lens = jnp.asarray(lengths).astype(jnp.int32)
-    alpha = potentials[:, 0] + start
-    back = []
-    ident = jnp.broadcast_to(jnp.arange(c)[None], (b, c))
-    for i in range(1, t):
-        # [B, C_from, C_to]
-        scores = alpha[:, :, None] + transition_params[None]
-        live = (i < lens)[:, None]
+    if include_bos_eos_tag:
+        # reference convention (cpu/viterbi_decode_kernel.cc:226-236): the
+        # transition matrix is split by ROW into [rest (0..c-3), stop=row
+        # c-2, start=row c-1]; both start and stop are rows of shape [C].
+        init = pot[:, 0] + trans[c - 1][None, :]
+    else:
+        init = pot[:, 0]
+
+    def step(alpha, xs):
+        idx, emit = xs["i"], xs["emit"]
+        scores = alpha[:, :, None] + trans[None, :, :] + emit[:, None, :]
         # past a sequence's length: freeze alpha and record an identity
         # backpointer so the backtrace passes through unchanged
-        best = jnp.where(live, jnp.argmax(scores, axis=1), ident)
-        alpha = jnp.where(live,
-                          jnp.max(scores, axis=1) + potentials[:, i],
-                          alpha)
-        back.append(best)
-    alpha = alpha + stop[None, :] if include_bos_eos_tag else alpha
-    last = jnp.argmax(alpha, axis=1)
-    scores = jnp.max(alpha, axis=1)
-    path = [last]
-    for best in reversed(back):
-        last = jnp.take_along_axis(best, last[:, None], axis=1)[:, 0]
-        path.append(last)
-    return scores, jnp.stack(path[::-1], axis=1).astype(jnp.int64)
+        active = (idx < lens)[:, None]
+        new_alpha = jnp.where(active, scores.max(axis=1), alpha)
+        best_prev = jnp.where(active, scores.argmax(axis=1),
+                              jnp.arange(c)[None, :])
+        return new_alpha, best_prev
+
+    xs = {"emit": jnp.moveaxis(pot[:, 1:], 1, 0), "i": jnp.arange(1, t)}
+    alpha, backptrs = jax.lax.scan(step, init, xs)
+    if include_bos_eos_tag:
+        alpha = alpha + trans[c - 2][None, :]
+    scores = alpha.max(axis=1)
+    last_tag = alpha.argmax(axis=1)
+
+    def backward(carry, bp):
+        prev = jnp.take_along_axis(bp, carry[:, None], axis=1)[:, 0]
+        return prev, prev
+
+    _, path_rev = jax.lax.scan(backward, last_tag, backptrs, reverse=True)
+    paths = jnp.concatenate([jnp.moveaxis(path_rev, 0, 1),
+                             last_tag[:, None]], axis=1)
+    return scores, paths.astype(jnp.int64)
 
 
 @defop

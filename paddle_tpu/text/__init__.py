@@ -3,10 +3,8 @@ labeling (ViterbiDecoder at text/viterbi_decode.py:93, backed by the
 viterbi_decode op)."""
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 
-from ..core.op import apply_op
 from ..core.tensor import Tensor
 from ..nn.layer_base import Layer
 
@@ -17,66 +15,24 @@ def viterbi_decode(potentials, transition_params, lengths=None,
                    include_bos_eos_tag=True, name=None):
     """viterbi_decode op parity: returns (scores, best_paths).
 
-    potentials: [B, T, N] emission scores; transition_params: [N, N] (with
-    BOS=N-2/EOS=N-1 rows/cols when include_bos_eos_tag, matching the
-    reference convention); lengths: [B] int actual lengths.
+    potentials: [B, T, N] emission scores; transition_params: [N, N] (when
+    include_bos_eos_tag, row N-1 is the start/BOS transition and row N-2
+    the stop/EOS transition, matching the reference kernel's row split);
+    lengths: [B] int actual lengths.
+
+    Delegates to the registered viterbi_decode op (ops/extended.py) — the
+    single implementation of the decode recurrence.
     """
-
-    def raw(pot, trans, lens):
-        b, t, n = pot.shape
-        if lens is None:
-            lens = jnp.full((b,), t, jnp.int32)
-        if include_bos_eos_tag:
-            bos, eos = n - 2, n - 1
-            init = pot[:, 0] + trans[bos][None, :]
-        else:
-            init = pot[:, 0]
-
-        def step(carry, xs):
-            alpha, idx = carry, xs["i"]
-            emit = xs["emit"]  # [B, N]
-            scores = alpha[:, :, None] + trans[None, :, :] + \
-                emit[:, None, :]
-            best_prev = scores.argmax(axis=1)  # [B, N]
-            new_alpha = scores.max(axis=1)
-            # positions beyond a sequence's length keep their alpha frozen
-            active = (idx < lens)[:, None]
-            new_alpha = jnp.where(active, new_alpha, alpha)
-            best_prev = jnp.where(active, best_prev,
-                                  jnp.arange(n)[None, :])
-            return new_alpha, best_prev
-
-        xs = {"emit": jnp.moveaxis(pot[:, 1:], 1, 0),
-              "i": jnp.arange(1, t)}
-        alpha, backptrs = jax.lax.scan(step, init, xs)
-        if include_bos_eos_tag:
-            alpha = alpha + trans[:, eos][None, :]
-        scores = alpha.max(axis=1)
-        last_tag = alpha.argmax(axis=1)  # [B]
-
-        def backward(carry, bp):
-            # carry = tag at step i+1; emit tag_i = bp[tag_{i+1}]
-            prev = jnp.take_along_axis(bp, carry[:, None], axis=1)[:, 0]
-            return prev, prev
-
-        _, path_rev = jax.lax.scan(backward, last_tag, backptrs,
-                                   reverse=True)
-        paths = jnp.concatenate([jnp.moveaxis(path_rev, 0, 1),
-                                 last_tag[:, None]], axis=1)  # [B, T]
-        return scores, paths.astype(jnp.int64)
+    from ..ops.extended import viterbi_decode as _op
 
     pot = potentials if isinstance(potentials, Tensor) else \
         Tensor(jnp.asarray(potentials), _internal=True)
     trans = transition_params if isinstance(transition_params, Tensor) else \
         Tensor(jnp.asarray(transition_params), _internal=True)
-    if lengths is None:
-        scores, paths = apply_op(lambda p, tr: raw(p, tr, None),
-                                 "viterbi_decode", (pot, trans), {})
-    else:
-        lens = lengths if isinstance(lengths, Tensor) else \
-            Tensor(jnp.asarray(lengths), _internal=True)
-        scores, paths = apply_op(raw, "viterbi_decode", (pot, trans, lens),
-                                 {})
+    if lengths is not None and not isinstance(lengths, Tensor):
+        lengths = Tensor(jnp.asarray(lengths), _internal=True)
+    scores, paths = _op(pot, trans, lengths=lengths,
+                        include_bos_eos_tag=include_bos_eos_tag)
     paths.stop_gradient = True
     return scores, paths
 

@@ -145,3 +145,71 @@ def test_gpt_hybrid_dp_mp_sharding():
     losses = [float(step(x, y)) for _ in range(4)]
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0]
+
+
+def test_gpt_qkv_layout_migration():
+    """A role-major (reference-layout) checkpoint loads with its fused-qkv
+    columns permuted to head-major when the caller declares the markerless
+    layout, giving identical logits to a direct save/load; markerless
+    checkpoints default to head-major (what every post-layout-change save
+    contains) and load unpermuted."""
+    paddle.seed(11)
+    model = build_gpt("gpt-tiny", hidden_dropout_prob=0.0,
+                      attention_dropout_prob=0.0)
+    model.eval()
+    x, _ = _batch(np.random.RandomState(4), b=1, t=8)
+    want = model(paddle.to_tensor(x)).numpy()
+
+    sd = model.state_dict()
+    # build a role-major checkpoint: inverse-permute every fused qkv
+    # weight/bias and strip the layout markers
+    legacy = {}
+    cfg = gpt_config("gpt-tiny")
+    nh = cfg.num_attention_heads
+    hd = cfg.hidden_size // nh
+    for k, v in sd.items():
+        if k.endswith("qkv_layout"):
+            continue
+        a = np.asarray(v.numpy())
+        if k.endswith("qkv_proj.weight"):
+            h = a.shape[0]
+            a = a.reshape(h, nh, 3, hd).transpose(0, 2, 1, 3).reshape(h, -1)
+        elif k.endswith("qkv_proj.bias"):
+            a = a.reshape(nh, 3, hd).transpose(1, 0, 2).reshape(-1)
+        legacy[k] = a
+
+    from paddle_tpu.models.gpt import GPTSelfAttention
+    paddle.seed(12)
+    fresh = build_gpt("gpt-tiny", hidden_dropout_prob=0.0,
+                      attention_dropout_prob=0.0)
+    fresh.eval()
+    GPTSelfAttention.markerless_qkv_layout = "role_major"
+    try:
+        missing, unexpected = fresh.set_state_dict(legacy)
+    finally:
+        GPTSelfAttention.markerless_qkv_layout = "head_major"
+    assert not missing and not unexpected
+    got = fresh(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    # markerless head-major (a save made between the layout change and the
+    # marker's introduction) must load UNPERMUTED under the default
+    headmajor = {k: np.asarray(v.numpy()) for k, v in sd.items()
+                 if not k.endswith("qkv_layout")}
+    paddle.seed(14)
+    fresh3 = build_gpt("gpt-tiny", hidden_dropout_prob=0.0,
+                       attention_dropout_prob=0.0)
+    fresh3.eval()
+    missing, unexpected = fresh3.set_state_dict(headmajor)
+    assert not missing and not unexpected
+    got3 = fresh3(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(got3, want, rtol=1e-5, atol=1e-5)
+
+    # a marker-bearing (current-layout) state dict must load unpermuted
+    paddle.seed(13)
+    fresh2 = build_gpt("gpt-tiny", hidden_dropout_prob=0.0,
+                       attention_dropout_prob=0.0)
+    fresh2.eval()
+    fresh2.set_state_dict(sd)
+    got2 = fresh2(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(got2, want, rtol=1e-5, atol=1e-5)

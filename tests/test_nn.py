@@ -90,6 +90,13 @@ def test_pooling():
     mp = F.max_pool2d(x, 2).numpy()
     expect = x.numpy().reshape(2, 3, 4, 2, 4, 2).max((3, 5))
     np.testing.assert_allclose(mp, expect, rtol=1e-6)
+    # integer dtypes take the reduce_window path (the patch path is a conv,
+    # which does not lower for ints on TPU)
+    xi = np.random.RandomState(0).randint(-50, 50, (2, 3, 8, 8), "int32")
+    mpi = F.max_pool2d(paddle.to_tensor(xi), 2).numpy()
+    np.testing.assert_array_equal(
+        mpi, xi.reshape(2, 3, 4, 2, 4, 2).max((3, 5)))
+    assert mpi.dtype == np.int32
 
 
 def test_batch_norm_updates_stats():
@@ -359,3 +366,63 @@ def test_instance_norm_bias_without_weight():
 def test_expand_invalid_minus_one():
     with pytest.raises(ValueError):
         paddle.expand(paddle.ones([3]), [-1, 3])
+
+
+def test_channels_last_layer_sweep():
+    """Every pool/conv/norm image layer built inside channels_last() must
+    flip to the channel-last layout — including the layers whose reference
+    signatures carry no data_format argument (AdaptiveMaxPool*, 1-D pools)."""
+    import paddle_tpu.nn as pnn
+    rs = np.random.RandomState(0)
+    x4 = rs.randn(2, 3, 8, 8).astype("float32")
+    x3 = rs.randn(2, 3, 12).astype("float32")
+    builders_4d = [
+        lambda: pnn.MaxPool2D(2),
+        lambda: pnn.AvgPool2D(2),
+        lambda: pnn.AdaptiveAvgPool2D(2),
+        lambda: pnn.AdaptiveMaxPool2D(2),
+        lambda: pnn.BatchNorm2D(3),
+        lambda: pnn.GroupNorm(1, 3),
+    ]
+    builders_3d = [
+        lambda: pnn.MaxPool1D(2),
+        lambda: pnn.AvgPool1D(2),
+        lambda: pnn.AdaptiveAvgPool1D(3),
+        lambda: pnn.AdaptiveMaxPool1D(3),
+        lambda: pnn.BatchNorm1D(3),
+    ]
+    for build, x, perm_in, perm_out in \
+            [(b, x4, (0, 2, 3, 1), (0, 3, 1, 2)) for b in builders_4d] + \
+            [(b, x3, (0, 2, 1), (0, 2, 1)) for b in builders_3d]:
+        paddle.seed(0)
+        ref_layer = build()
+        with pnn.channels_last():
+            paddle.seed(0)
+            cl_layer = build()
+        if ref_layer.state_dict():
+            cl_layer.set_state_dict(ref_layer.state_dict())
+        ref_layer.eval(); cl_layer.eval()
+        want = ref_layer(paddle.to_tensor(x)).numpy()
+        got = cl_layer(paddle.to_tensor(x.transpose(perm_in))).numpy()
+        got = got.transpose(perm_out)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5,
+                                   err_msg=type(ref_layer).__name__)
+
+
+def test_batch_norm_bf16_large_mean_variance():
+    """bf16 activations with |mean| >> std must not cancel the one-pass
+    variance to zero (stats are computed in f32)."""
+    import jax.numpy as jnp
+    import paddle_tpu.nn.functional as F
+    rs = np.random.RandomState(0)
+    x = (rs.randn(8, 4, 16, 16) * 0.1 + 10.0).astype("float32")
+    xb = paddle.to_tensor(jnp.asarray(x, jnp.bfloat16))
+    rm = paddle.to_tensor(np.zeros(4, "float32"))
+    rv = paddle.to_tensor(np.ones(4, "float32"))
+    out = F.batch_norm(xb, rm, rv, training=True, momentum=0.0)
+    # running_var now holds the batch var; bf16 rounding of x costs ~2%,
+    # catastrophic cancellation would give ~0
+    true_var = x.var((0, 2, 3))
+    assert np.all(rv.numpy() > 0.5 * true_var), (rv.numpy(), true_var)
+    out_np = np.asarray(out.numpy(), "float32")
+    assert abs(out_np.mean()) < 0.05 and 0.8 < out_np.std() < 1.2

@@ -135,6 +135,25 @@ def test_fill_family_and_shard_index():
     np.testing.assert_array_equal(out, [-1, 1, -1, -1])
 
 
+def test_fill_diagonal_wrap_tall():
+    """Reference flat-stride semantics (fill_diagonal_kernel.cc:36-55):
+    wrap refills the diagonal in cycles on tall matrices, matching
+    np.fill_diagonal(..., wrap=...)."""
+    tall = rng.randn(7, 3).astype("float32")
+    for wrap in (False, True):
+        want = tall.copy()
+        np.fill_diagonal(want, 5.0, wrap=wrap)
+        got = paddle.fill_diagonal(T(tall), 5.0, wrap=wrap).numpy()
+        np.testing.assert_allclose(got, want)
+    # offset shifts the write within each row, skipping row exits
+    got = paddle.fill_diagonal(T(tall), 5.0, offset=1, wrap=True).numpy()
+    want = tall.copy()
+    for i in range(0, tall.size, 4):
+        if i % 3 + 1 < 3:
+            want.flat[i + 1] = 5.0
+    np.testing.assert_allclose(got, want)
+
+
 def test_diag_embed_and_indices():
     v = rng.randn(2, 3).astype("float32")
     m = paddle.diag_embed(T(v)).numpy()

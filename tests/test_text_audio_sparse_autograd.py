@@ -37,6 +37,39 @@ def test_viterbi_matches_bruteforce():
     assert paths.numpy()[0].tolist() == ref_path
 
 
+def _brute_viterbi_bos_eos(pot, trans):
+    """Exhaustive search with the reference BOS/EOS convention
+    (cpu/viterbi_decode_kernel.cc:226-236): transition rows split as
+    [rest, stop=row c-2, start=row c-1]."""
+    t, c = pot.shape
+    import itertools
+    start, stop = trans[c - 1], trans[c - 2]
+    best, best_path = -np.inf, None
+    for path in itertools.product(range(c), repeat=t):
+        s = start[path[0]] + pot[0, path[0]]
+        for i in range(1, t):
+            s += trans[path[i - 1], path[i]] + pot[i, path[i]]
+        s += stop[path[-1]]
+        if s > best:
+            best, best_path = s, path
+    return best, list(best_path)
+
+
+def test_viterbi_bos_eos_matches_bruteforce():
+    from paddle_tpu.text import viterbi_decode
+    rng = np.random.RandomState(3)
+    pot = rng.randn(2, 4, 4).astype("float32")
+    trans = rng.randn(4, 4).astype("float32")
+    scores, paths = viterbi_decode(paddle.to_tensor(pot),
+                                   paddle.to_tensor(trans),
+                                   include_bos_eos_tag=True)
+    for b in range(2):
+        ref_score, ref_path = _brute_viterbi_bos_eos(
+            pot[b].astype("float64"), trans.astype("float64"))
+        assert float(scores.numpy()[b]) == pytest.approx(ref_score, rel=1e-5)
+        assert paths.numpy()[b].tolist() == ref_path
+
+
 def test_viterbi_decoder_layer_batched():
     from paddle_tpu.text import ViterbiDecoder
     rng = np.random.RandomState(1)
