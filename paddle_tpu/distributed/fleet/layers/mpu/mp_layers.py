@@ -55,6 +55,10 @@ class VocabParallelEmbedding(Layer):
             dtype=self._dtype)
         self.weight.is_distributed = self.is_mp
         self.weight._partition_spec = P("mp", None)
+        # vocab-sharded gather is handled by the explicit shift/mask/psum
+        # path below; additional FSDP sharding of the embed dim would send
+        # GSPMD through replicate-then-partition on every lookup
+        self.weight._gather_indexed = True
 
     def forward(self, x):
         axis = getattr(self.mp_group, "axis_name", None) or "mp"

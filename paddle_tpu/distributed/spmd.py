@@ -88,7 +88,10 @@ def infer_param_specs(model, mesh, fsdp_axis: str | None = None,
                 cleaned.append(kept[0] if len(kept) == 1 else (kept or None))
             spec = P(*cleaned) if cleaned else P()
         if fsdp_n > 1 and t.size >= min_fsdp_size and \
-                not t.stop_gradient:
+                not t.stop_gradient and \
+                not getattr(t, "_gather_indexed", False):
+            # _gather_indexed (embedding tables): sharding a gather operand
+            # forces SPMD's replicate-then-partition fallback every lookup
             spec = _shard_largest_free_dim(spec, t.shape, fsdp_axis, fsdp_n)
         specs[name] = spec
     return specs
@@ -210,6 +213,12 @@ class ShardedTrainStep:
             return specs
         for name, t in self._entries.items():
             if not self._tmask.get(name):
+                continue
+            if getattr(t, "_gather_indexed", False):
+                # embedding tables: a ZeRO-sharded slot layout forces the
+                # grad/update constraints into the gather-scatter chain and
+                # SPMD falls back to replicate-then-partition per step; the
+                # tables are small, so leave their slots in the param layout
                 continue
             specs[name] = _shard_largest_free_dim(
                 specs.get(name, P()), t.shape, axis, n)

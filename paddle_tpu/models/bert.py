@@ -177,6 +177,15 @@ class BertEmbeddings(Layer):
             position_ids = arange(0, t, dtype="int64").reshape([1, t])
         if token_type_ids is None:
             token_type_ids = zeros_like(input_ids)
+        if position_ids.shape[0] == 1 and input_ids.shape[0] != 1:
+            # expand the [1, T] position row to the full batch BEFORE the
+            # lookup: a [1, T, H] broadcast operand picks up a degenerate
+            # batch sharding from GSPMD propagation (its size-1 dim split
+            # across the whole dp x sharding axis) and the backward
+            # cotangent then pays a replicate-then-partition ("Involuntary
+            # full rematerialization"); the batched lookup shards cleanly
+            # like the token-type path
+            position_ids = position_ids + zeros_like(input_ids)
         x = (self.word_embeddings(input_ids) +
              self.position_embeddings(position_ids) +
              self.token_type_embeddings(token_type_ids))

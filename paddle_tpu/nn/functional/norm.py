@@ -48,29 +48,39 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
     def impl(xv, w, b, rm, rv):
         shape = [1] * xv.ndim
         shape[channel_axis] = xv.shape[channel_axis]
+        half = jnp.issubdtype(xv.dtype, jnp.floating) and \
+            jnp.finfo(xv.dtype).bits < 32
         if use_batch_stats:
-            # one-pass stats: E[x²]−E[x]² lets XLA fuse both channel
-            # reductions into a single read of the activation, where the
-            # two-pass mean→var form forces a second dependent pass
-            # (measured on ResNet-50, tools/profile_model.py).  The
-            # subtraction MUST happen in f32: jnp.mean returns the input
-            # half dtype, and a bf16 E[x²]−E[x]² cancels catastrophically
-            # when |mean| >> std (bf16 x with mean 10, std 0.1 gives
-            # var == 0).  The f32 cast fuses into the same reduce pass.
-            xf = xv if xv.dtype == jnp.float32 else xv.astype(jnp.float32)
-            mean = jnp.mean(xf, axis=reduce_axes)
-            var = jnp.maximum(
-                jnp.mean(jnp.square(xf), axis=reduce_axes)
-                - jnp.square(mean), 0)
+            if half:
+                # one-pass stats for half dtypes: E[x²]−E[x]² in f32 lets
+                # XLA fuse both channel reductions into a single read of
+                # the activation, where the two-pass mean→var form forces
+                # a second dependent pass (measured on ResNet-50,
+                # tools/profile_model.py).  The f32 accumulation is as
+                # accurate as half-precision data allows: cancellation
+                # only bites when |mean|/std exceeds what the input's own
+                # mantissa can represent.
+                xf = xv.astype(jnp.float32)
+                mean = jnp.mean(xf, axis=reduce_axes)
+                var = jnp.maximum(
+                    jnp.mean(jnp.square(xf), axis=reduce_axes)
+                    - jnp.square(mean), 0)
+            else:
+                # full-precision inputs keep the exact two-pass form in
+                # their own dtype (E[x²]−E[x]² cancels catastrophically
+                # for |mean| >> std even in f32)
+                mean = jnp.mean(xv, axis=reduce_axes)
+                var = jnp.var(xv, axis=reduce_axes)
         else:
             mean, var = rm, rv
         # fold the normalisation into one scale+shift over x: out =
         # x*scale + shift with per-channel scalars, a single fused pass
-        inv = jax.lax.rsqrt(var.astype(jnp.float32) + epsilon)
-        scale = inv if w is None else inv * w.astype(jnp.float32)
-        shift = -mean.astype(jnp.float32) * scale
+        stat_dtype = mean.dtype
+        inv = jax.lax.rsqrt(var.astype(stat_dtype) + epsilon)
+        scale = inv if w is None else inv * w.astype(stat_dtype)
+        shift = -mean * scale
         if b is not None:
-            shift = shift + b.astype(jnp.float32)
+            shift = shift + b.astype(stat_dtype)
         out = xv * scale.reshape(shape).astype(xv.dtype) \
             + shift.reshape(shape).astype(xv.dtype)
         return out, mean, var

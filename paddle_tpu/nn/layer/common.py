@@ -96,6 +96,11 @@ class Embedding(Layer):
         self.weight = self.create_parameter(
             (num_embeddings, embedding_dim), attr=weight_attr,
             default_initializer=I.XavierUniform())
+        # consumed by gather: FSDP/ZeRO-3 auto-sharding must leave this
+        # table alone — GSPMD lowers gathers from a sharded table through a
+        # full replicate-then-partition ("Involuntary full
+        # rematerialization"), costing a [B,T,H] materialization per step
+        self.weight._gather_indexed = True
         if self._padding_idx is not None:
             self.weight._replace_(
                 self.weight._value.at[self._padding_idx].set(0), None)

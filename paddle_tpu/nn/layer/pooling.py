@@ -19,7 +19,8 @@ class MaxPool1D(_Pool):
     def __init__(self, kernel_size, stride=None, padding=0, return_mask=False,
                  ceil_mode=False, name=None):
         super().__init__(kernel_size=kernel_size, stride=stride, padding=padding,
-                         ceil_mode=ceil_mode, data_format="NCL")
+                         return_mask=return_mask, ceil_mode=ceil_mode,
+                         data_format="NCL")
 
     def forward(self, x):
         return F.max_pool1d(x, **self._kw)
@@ -29,7 +30,8 @@ class MaxPool2D(_Pool):
     def __init__(self, kernel_size, stride=None, padding=0, return_mask=False,
                  ceil_mode=False, data_format="NCHW", name=None):
         super().__init__(kernel_size=kernel_size, stride=stride, padding=padding,
-                         ceil_mode=ceil_mode, data_format=data_format)
+                         return_mask=return_mask, ceil_mode=ceil_mode,
+                         data_format=data_format)
 
     def forward(self, x):
         return F.max_pool2d(x, **self._kw)
@@ -39,7 +41,8 @@ class MaxPool3D(_Pool):
     def __init__(self, kernel_size, stride=None, padding=0, return_mask=False,
                  ceil_mode=False, data_format="NCDHW", name=None):
         super().__init__(kernel_size=kernel_size, stride=stride, padding=padding,
-                         ceil_mode=ceil_mode, data_format=data_format)
+                         return_mask=return_mask, ceil_mode=ceil_mode,
+                         data_format=data_format)
 
     def forward(self, x):
         return F.max_pool3d(x, **self._kw)
@@ -106,6 +109,10 @@ class AdaptiveAvgPool3D(_Pool):
 
 class AdaptiveMaxPool1D(_Pool):
     def __init__(self, output_size, return_mask=False, name=None):
+        if return_mask:
+            raise NotImplementedError(
+                "AdaptiveMaxPool1D(return_mask=True) is not supported: the "
+                "adaptive bins carry no window-argmax path")
         super().__init__(output_size=output_size, data_format="NCL")
 
     def forward(self, x):
@@ -114,6 +121,10 @@ class AdaptiveMaxPool1D(_Pool):
 
 class AdaptiveMaxPool2D(_Pool):
     def __init__(self, output_size, return_mask=False, name=None):
+        if return_mask:
+            raise NotImplementedError(
+                "AdaptiveMaxPool2D(return_mask=True) is not supported: the "
+                "adaptive bins carry no window-argmax path")
         super().__init__(output_size=output_size, data_format="NCHW")
 
     def forward(self, x):
@@ -122,6 +133,10 @@ class AdaptiveMaxPool2D(_Pool):
 
 class AdaptiveMaxPool3D(_Pool):
     def __init__(self, output_size, return_mask=False, name=None):
+        if return_mask:
+            raise NotImplementedError(
+                "AdaptiveMaxPool3D(return_mask=True) is not supported: the "
+                "adaptive bins carry no window-argmax path")
         super().__init__(output_size=output_size, data_format="NCDHW")
 
     def forward(self, x):

@@ -26,7 +26,11 @@ class Parameter(Tensor):
     """Trainable tensor: stop_gradient=False by default (fluid framework.py
     `Parameter`)."""
 
-    __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip", "is_distributed")
+    # _gather_indexed: the param is consumed by a gather (embedding table)
+    # and must be exempt from FSDP auto-sharding (distributed/spmd.py
+    # infer_param_specs)
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip",
+                 "is_distributed", "_gather_indexed")
 
     def __init__(self, data, dtype=None, name=None, trainable=True,
                  learning_rate=1.0, regularizer=None, need_clip=True):

@@ -112,6 +112,15 @@ def fill_diagonal(x, value, offset=0, wrap=False, name=None):
     the walk past the first n*n elements so tall matrices get the diagonal
     refilled in cycles."""
     n_last = x.shape[-1]
+    if x.ndim < 2:
+        raise ValueError("fill_diagonal needs a tensor with ndim >= 2")
+    if x.ndim > 2:
+        if len(set(x.shape)) != 1:
+            raise ValueError(
+                "fill_diagonal requires all dims equal when ndim > 2")
+        # the reference API forces wrap for >2-D inputs
+        # (tensor/manipulation.py:862-869 passes wrap=True to the kernel)
+        wrap = True
     # diagonal step = sum of all dim strides (CalStride); for 2-D this is
     # n+1, for the >2-D all-equal-dims case the same formula applies
     strides = np.cumprod((x.shape[1:] + (1,))[::-1])[::-1]

@@ -425,3 +425,48 @@ def test_pipeline_train_batch():
     y = np.random.RandomState(1).randn(4, 8).astype(np.float32)
     loss = pp.train_batch((paddle.to_tensor(x), paddle.to_tensor(y)), optimizer)
     assert np.isfinite(float(loss.numpy()))
+
+
+def test_zero2_compile_has_no_involuntary_remat(capfd):
+    """ZeRO-2 on dp x sharding must compile without the SPMD partitioner's
+    "Involuntary full rematerialization" fallback: embedding tables (gather
+    operands) are exempt from FSDP/slot auto-sharding precisely so the
+    gather/scatter chains keep efficiently transitionable layouts
+    (distributed/spmd.py infer_param_specs/_infer_slot_specs)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.distributed.sharding import group_sharded_parallel
+    from paddle_tpu.models import (BertPretrainingCriterion, bert_config,
+                                   build_ernie)
+
+    mesh = dist.build_mesh([4, 2], ["dp", "sharding"])
+    dist.set_global_mesh(mesh)
+    paddle.seed(9)
+    cfg = bert_config("ernie-3.0-medium", vocab_size=512, hidden_size=64,
+                      num_layers=1, num_attention_heads=2,
+                      intermediate_size=128, max_position_embeddings=64,
+                      hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+    model = build_ernie(cfg)
+    crit = BertPretrainingCriterion()
+
+    def loss_fn(out, labels, nsp):
+        mlm, nsp_logits = out
+        return crit(mlm, nsp_logits, labels, nsp)
+
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+    model, opt, _ = group_sharded_parallel(model, opt, "os_g")
+    step = dist.make_train_step(model, opt, loss_fn=loss_fn, num_labels=2,
+                                mesh=mesh)
+    rs = np.random.RandomState(4)
+    ids = rs.randint(0, 512, (8, 16)).astype(np.int64)
+    lbl = rs.randint(0, 512, (8, 16)).astype(np.int64)
+    nsp = rs.randint(0, 2, (8,)).astype(np.int64)
+    batch = step.shard_batch(ids, lbl, nsp)
+    core, slots = step._split_tree()
+    step._jitted = step._build(len(batch))
+    capfd.readouterr()  # drop build noise
+    step._jitted.lower(core, slots, jnp.asarray(1e-4, jnp.float32),
+                       batch).compile()
+    err = capfd.readouterr().err
+    assert "Involuntary full rematerialization" not in err, err[:2000]
