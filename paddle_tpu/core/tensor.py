@@ -32,6 +32,11 @@ class Tensor:
                  _internal: bool = False):
         if _internal:
             value = data
+        elif isinstance(data, jax.ShapeDtypeStruct):
+            # abstract (meta) construction: the tensor carries shape/dtype
+            # only — used by nn.abstract_build for AOT capacity planning
+            value = data if dtype is None else \
+                jax.ShapeDtypeStruct(data.shape, dtype_mod.to_jax(dtype))
         else:
             if isinstance(data, Tensor):
                 value = data._value
@@ -80,7 +85,10 @@ class Tensor:
 
     @property
     def size(self) -> int:
-        return int(self._value.size)
+        import math
+        v = self._value
+        return int(v.size) if hasattr(v, "size") else \
+            math.prod(v.shape)
 
     @property
     def place(self) -> place_mod.Place:

@@ -1,0 +1,81 @@
+"""PS-side graph storage (reference:
+paddle/fluid/distributed/ps/table/common_graph_table.cc `GraphTable`).
+
+One shard holds the adjacency lists of the nodes it owns (node_id %
+num_servers == shard); workers load edges once and then sample neighbors
+over RPC, feeding the host-side mini-batch pipeline
+(`paddle_tpu.geometric.sample_neighbors` semantics, distributed).
+Node features ride the existing SparseTable (the reference stores feature
+columns beside the adjacency; here features reuse the id->row machinery).
+"""
+from __future__ import annotations
+
+import pickle
+import threading
+from typing import Dict, List
+
+import numpy as np
+
+__all__ = ["GraphTable"]
+
+
+class GraphTable:
+    """One server shard of a distributed graph (common_graph_table.cc)."""
+
+    def __init__(self, name: str, seed: int = 0):
+        self.name = name
+        self.seed = seed
+        self._adj: Dict[int, List[int]] = {}
+        self._rng = np.random.default_rng(seed)
+        self._mu = threading.Lock()
+
+    # -- build ---------------------------------------------------------------
+    def add_edges(self, src, dst) -> int:
+        """Insert directed edges src->dst (src nodes must belong to this
+        shard); duplicates are kept like the reference's edge lists."""
+        src = np.asarray(src).reshape(-1)
+        dst = np.asarray(dst).reshape(-1)
+        with self._mu:
+            for s, d in zip(src, dst):
+                self._adj.setdefault(int(s), []).append(int(d))
+        return len(src)
+
+    def node_degree(self, ids) -> np.ndarray:
+        with self._mu:
+            return np.asarray([len(self._adj.get(int(i), []))
+                               for i in np.asarray(ids).reshape(-1)],
+                              np.int64)
+
+    def node_ids(self) -> np.ndarray:
+        with self._mu:
+            return np.asarray(sorted(self._adj.keys()), np.int64)
+
+    # -- sampling ------------------------------------------------------------
+    def sample_neighbors(self, ids, sample_size: int = -1):
+        """Per node: up to sample_size neighbors without replacement
+        (sample_size < 0 = all).  Returns (neighbors concat, counts)."""
+        out, counts = [], []
+        with self._mu:
+            for i in np.asarray(ids).reshape(-1):
+                nb = self._adj.get(int(i), [])
+                if 0 <= sample_size < len(nb):
+                    picked = self._rng.choice(len(nb), size=sample_size,
+                                              replace=False)
+                    nb = [nb[j] for j in picked]
+                counts.append(len(nb))
+                out.extend(nb)
+        return (np.asarray(out, np.int64), np.asarray(counts, np.int32))
+
+    def __len__(self):
+        return len(self._adj)
+
+    # -- persistence (graph table save/load contract) ------------------------
+    def save(self, path: str) -> None:
+        with self._mu, open(path, "wb") as f:
+            pickle.dump({"adj": self._adj}, f)
+
+    def load(self, path: str) -> None:
+        with open(path, "rb") as f:
+            blob = pickle.load(f)
+        with self._mu:
+            self._adj = blob["adj"]

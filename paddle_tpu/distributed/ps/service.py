@@ -32,6 +32,7 @@ class PsServer:
         self.server_idx = server_idx
         self.sparse_tables: Dict[str, SparseTable] = {}
         self.dense_tables: Dict[str, DenseTable] = {}
+        self.graph_tables: Dict = {}  # name -> GraphTable
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -127,6 +128,19 @@ class PsServer:
             return self.dense_tables[req["table"]].push(req["grad"])
         if op == "push_dense_delta":
             return self.dense_tables[req["table"]].push_delta(req["grad"])
+        if op == "add_graph_table":
+            from .graph_table import GraphTable
+            self.graph_tables[req["table"]] = GraphTable(
+                req["table"], seed=self.server_idx * 104729 + 3)
+            return None
+        if op == "graph_add_edges":
+            return self.graph_tables[req["table"]].add_edges(req["src"],
+                                                             req["dst"])
+        if op == "graph_sample_neighbors":
+            return self.graph_tables[req["table"]].sample_neighbors(
+                req["ids"], req.get("sample_size", -1))
+        if op == "graph_node_degree":
+            return self.graph_tables[req["table"]].node_degree(req["ids"])
         if op == "save":
             return self._save(req["dirname"])
         if op == "load":
@@ -165,12 +179,16 @@ class PsServer:
             t.save(f"{dirname}/sparse_{name}.shard{self.server_idx}")
         for name, t in self.dense_tables.items():
             t.save(f"{dirname}/dense_{name}")
+        for name, t in self.graph_tables.items():
+            t.save(f"{dirname}/graph_{name}.shard{self.server_idx}")
 
     def _load(self, dirname: str) -> None:
         for name, t in self.sparse_tables.items():
             t.load(f"{dirname}/sparse_{name}.shard{self.server_idx}")
         for name, t in self.dense_tables.items():
             t.load(f"{dirname}/dense_{name}")
+        for name, t in self.graph_tables.items():
+            t.load(f"{dirname}/graph_{name}.shard{self.server_idx}")
 
     def shutdown(self) -> None:
         self._stop.set()
@@ -264,6 +282,55 @@ class PsClient:
                    {"op": "push_dense_delta" if delta else "push_dense",
                     "table": table, "grad": np.asarray(grad, np.float32),
                     "async": self.async_push})
+
+    # -- graph (common_graph_table.cc worker API) -----------------------------
+    def create_graph_table(self, table: str) -> None:
+        for s in range(len(self.endpoints)):
+            self._call(s, {"op": "add_graph_table", "table": table})
+
+    def graph_add_edges(self, table: str, src, dst) -> None:
+        """Directed edges, sharded to the server owning each SOURCE node."""
+        src = np.asarray(src, np.int64).reshape(-1)
+        dst = np.asarray(dst, np.int64).reshape(-1)
+        owner = src % len(self.endpoints)
+        for s in range(len(self.endpoints)):
+            mask = owner == s
+            if mask.any():
+                self._call(s, {"op": "graph_add_edges", "table": table,
+                               "src": src[mask], "dst": dst[mask]})
+
+    def graph_sample_neighbors(self, table: str, ids, sample_size: int = -1):
+        """Distributed sample_neighbors: fan out by owner shard, then
+        reassemble neighbors/counts in input-id order (the layout
+        paddle_tpu.geometric.reindex_graph expects)."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        owner = ids % len(self.endpoints)
+        per_id_nb: List[np.ndarray] = [None] * len(ids)  # type: ignore
+        for s in range(len(self.endpoints)):
+            mask = owner == s
+            if not mask.any():
+                continue
+            nb, cnt = self._call(s, {"op": "graph_sample_neighbors",
+                                     "table": table, "ids": ids[mask],
+                                     "sample_size": sample_size})
+            offs = np.cumsum(np.concatenate([[0], cnt]))
+            for j, pos in enumerate(np.nonzero(mask)[0]):
+                per_id_nb[pos] = nb[offs[j]:offs[j + 1]]
+        counts = np.asarray([len(v) for v in per_id_nb], np.int32)
+        neighbors = (np.concatenate(per_id_nb) if len(ids)
+                     else np.zeros((0,), np.int64))
+        return neighbors, counts
+
+    def graph_node_degree(self, table: str, ids) -> np.ndarray:
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        owner = ids % len(self.endpoints)
+        out = np.zeros(len(ids), np.int64)
+        for s in range(len(self.endpoints)):
+            mask = owner == s
+            if mask.any():
+                out[mask] = self._call(s, {"op": "graph_node_degree",
+                                           "table": table, "ids": ids[mask]})
+        return out
 
     # -- control --------------------------------------------------------------
     def save(self, dirname: str) -> None:

@@ -124,7 +124,8 @@ class ShardedTrainStep:
                  compute_dtype=None, donate: bool = True,
                  accumulate_steps: int = 1, num_labels: int = 1,
                  sharding_stage: int = 0, sharding_axis: str = "sharding",
-                 offload: bool = False, static_argnames=()):
+                 offload: bool = False, static_argnames=(),
+                 abstract: bool = False):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
@@ -173,11 +174,55 @@ class ShardedTrainStep:
                                         min_fsdp_size=min_fsdp_size)
         self._slot_specs = self._infer_slot_specs()
 
+        self.abstract = bool(abstract)
+        self.param_names = [k for k, m in self._tmask.items() if m]
+        if self.abstract:
+            # AOT planning mode: the model may have been built under
+            # abstract_build() — parameter values are shape/dtype only and
+            # were never materialized.  State holds ShapeDtypeStructs (with
+            # shardings attached) so the step can be lowered + compiled for
+            # memory/cost analysis without the bytes existing anywhere.
+            def struct(v, spec=None):
+                sh = (NamedSharding(self.mesh, spec)
+                      if self.mesh is not None and spec is not None else None)
+                return jax.ShapeDtypeStruct(tuple(v.shape), v.dtype,
+                                            sharding=sh)
+
+            values = {k: struct(e._value, self._specs.get(k, P()))
+                      for k, e in self._entries.items()}
+            self.buffer_names = [k for k in values
+                                 if k not in self.param_names]
+            params = {k: values[k] for k in self.param_names}
+            buffers = {k: values[k] for k in self.buffer_names}
+            slots = {}
+            for k in self.param_names:
+                raw = jax.eval_shape(optimizer.init_slots, params[k])
+                slots[k] = {s: jax.ShapeDtypeStruct(
+                    v.shape, v.dtype,
+                    sharding=(NamedSharding(self.mesh,
+                                            self._slot_specs.get(k, P()))
+                              if self.mesh is not None else None))
+                    for s, v in raw.items()}
+            # struct-only: tracing random_mod.next_key() here would leak a
+            # tracer into the global RNG state; a fresh key(0) has the same
+            # aval as the train-state key
+            rng = jax.eval_shape(lambda: jax.random.key(0))
+            step0 = jax.ShapeDtypeStruct((), jnp.int32)
+            if self.mesh is not None:
+                repl = NamedSharding(self.mesh, P())
+                rng = jax.ShapeDtypeStruct(rng.shape, rng.dtype,
+                                           sharding=repl)
+                step0 = jax.ShapeDtypeStruct((), jnp.int32, sharding=repl)
+            self.state = TrainState(params, slots, buffers, step0, rng)
+            self._jitted = None
+            if self.offload and self.mesh is None:
+                raise ValueError("offload=True needs a device mesh")
+            return
+
         # copy values: the compiled step donates its state buffers, which must
         # never alias the live eager Parameter arrays (donation would delete
         # them on non-CPU backends)
         values = {k: jnp.copy(v._value) for k, v in self._entries.items()}
-        self.param_names = [k for k, m in self._tmask.items() if m]
         self.buffer_names = [k for k in values if k not in self.param_names]
 
         params = {k: values[k] for k in self.param_names}
@@ -427,6 +472,28 @@ class ShardedTrainStep:
 
         self._raw_step = step_fn
         return jax.jit(step_fn, donate_argnums=self._donate_argnums())
+
+    def aot_compile(self, *batch_structs):
+        """AOT-compile the step from batch ShapeDtypeStructs (abstract mode:
+        nothing is materialized) and return the jax `Compiled` object —
+        `compiled.memory_analysis()` is the per-device memory plan, the
+        capacity-planning path for recipes bigger than the local host
+        (e.g. the GPT-3 6.7B v5e-16 budget, __graft_entry__ phase 5)."""
+        assert self.abstract, "aot_compile requires abstract=True"
+        batch = []
+        for b in batch_structs:
+            sh = (NamedSharding(self.mesh,
+                                batch_spec(self.mesh, len(b.shape)))
+                  if self.mesh is not None else None)
+            batch.append(jax.ShapeDtypeStruct(tuple(b.shape), b.dtype,
+                                              sharding=sh))
+        if self._jitted is None:
+            self._jitted = self._build(len(batch))
+        lr_sh = (NamedSharding(self.mesh, P())
+                 if self.mesh is not None else None)
+        lr = jax.ShapeDtypeStruct((), jnp.float32, sharding=lr_sh)
+        core, slots = self._split_tree()
+        return self._jitted.lower(core, slots, lr, tuple(batch)).compile()
 
     def _donate_argnums(self):
         """Shared donation policy for the single- and multi-step jits:

@@ -10,8 +10,12 @@ import jax.numpy as jnp
 from ..core.op import apply_op
 from ..core.tensor import Tensor
 
+from .sampling import (  # noqa: F401
+    reindex_graph, reindex_heter_graph, sample_neighbors)
+
 __all__ = ["send_u_recv", "send_ue_recv", "send_uv", "segment_sum",
-           "segment_mean", "segment_max", "segment_min"]
+           "segment_mean", "segment_max", "segment_min",
+           "sample_neighbors", "reindex_graph", "reindex_heter_graph"]
 
 _SEG = {
     "sum": jax.ops.segment_sum,

@@ -1,0 +1,130 @@
+"""Graph sampling + reindex (reference:
+python/paddle/geometric/sampling/neighbors.py:24 `sample_neighbors`,
+geometric/reindex.py:25 `reindex_graph`; kernels
+phi/kernels/cpu/graph_sample_neighbors_kernel.cc, graph_reindex_kernel.cc).
+
+TPU-first placement note: neighbor sampling and reindexing have
+data-dependent output SHAPES, so they belong on the HOST input pipeline —
+like the reference's CPU sampling path feeding its GPU trainers — not
+inside jit.  They run in numpy and return Tensors; the fixed-shape
+mini-graph they produce is what enters the compiled step.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["sample_neighbors", "reindex_graph", "reindex_heter_graph"]
+
+
+def _np(x):
+    if isinstance(x, Tensor):
+        return np.asarray(x.numpy())
+    return np.asarray(x)
+
+
+_rng = None
+
+
+def _module_rng() -> np.random.Generator:
+    """Lazily seeded from the framework seed, then advances per call."""
+    global _rng
+    if _rng is None:
+        try:
+            from ..core import random as random_mod
+            seed = int(getattr(random_mod, "_seed", 0) or 0)
+        except Exception:
+            seed = 0
+        _rng = np.random.default_rng(seed)
+    return _rng
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    """Sample up to `sample_size` neighbors of each input node from a CSC
+    graph (row = concatenated neighbor lists, colptr = per-node offsets).
+
+    Returns (out_neighbors, out_count[, out_eids]); counts align with
+    `input_nodes` and neighbors are concatenated in input order, matching
+    the reference kernel's layout.
+    """
+    if return_eids and eids is None:
+        raise ValueError("`eids` should not be None if `return_eids` is "
+                         "True.")
+    row_np = _np(row).reshape(-1)
+    colptr_np = _np(colptr).reshape(-1)
+    nodes = _np(input_nodes).reshape(-1)
+    eids_np = _np(eids).reshape(-1) if eids is not None else None
+    # persistent module RNG: repeated calls over the same frontier must
+    # draw DIFFERENT samples (each epoch re-samples); perm_buffer pins a
+    # reproducible stream like the reference's fisher-yates buffer
+    rng = _module_rng() if perm_buffer is None else \
+        np.random.default_rng(int(_np(perm_buffer).reshape(-1)[0]) & 0xFFFF)
+
+    out_neigh, out_eids, counts = [], [], []
+    for u in nodes:
+        lo, hi = int(colptr_np[u]), int(colptr_np[u + 1])
+        idx = np.arange(lo, hi)
+        if 0 <= sample_size < len(idx):
+            idx = rng.choice(idx, size=sample_size, replace=False)
+        counts.append(len(idx))
+        out_neigh.append(row_np[idx])
+        if eids_np is not None:
+            out_eids.append(eids_np[idx])
+    dtype = row_np.dtype
+    neighbors = Tensor(np.concatenate(out_neigh).astype(dtype)
+                       if out_neigh else np.zeros((0,), dtype))
+    count = Tensor(np.asarray(counts, np.int32))
+    if return_eids:
+        e = Tensor(np.concatenate(out_eids).astype(dtype)
+                   if out_eids else np.zeros((0,), dtype))
+        return neighbors, count, e
+    return neighbors, count
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """Reindex sampled node ids from 0: out_nodes = unique(x ++ neighbors)
+    with x first and neighbors in first-appearance order; reindex_src maps
+    `neighbors` into that space, reindex_dst repeats each input node's new
+    id `count` times (reindex.py:25 contract)."""
+    x_np = _np(x).reshape(-1)
+    nb = _np(neighbors).reshape(-1)
+    cnt = _np(count).reshape(-1)
+    mapping = {int(v): i for i, v in enumerate(x_np)}
+    order = list(x_np)
+    for v in nb:
+        vi = int(v)
+        if vi not in mapping:
+            mapping[vi] = len(order)
+            order.append(vi)
+    dtype = x_np.dtype
+    reindex_src = np.asarray([mapping[int(v)] for v in nb], dtype)
+    reindex_dst = np.repeat(np.arange(len(x_np), dtype=dtype), cnt)
+    return (Tensor(reindex_src), Tensor(reindex_dst),
+            Tensor(np.asarray(order, dtype)))
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """Heterogeneous variant (reindex.py reindex_heter_graph): `neighbors`
+    and `count` are lists, one per edge type, sharing one id space."""
+    x_np = _np(x).reshape(-1)
+    mapping = {int(v): i for i, v in enumerate(x_np)}
+    order = list(x_np)
+    srcs, dsts = [], []
+    dtype = x_np.dtype
+    for nb_t, cnt_t in zip(neighbors, count):
+        nb = _np(nb_t).reshape(-1)
+        cnt = _np(cnt_t).reshape(-1)
+        for v in nb:
+            vi = int(v)
+            if vi not in mapping:
+                mapping[vi] = len(order)
+                order.append(vi)
+        srcs.append(np.asarray([mapping[int(v)] for v in nb], dtype))
+        dsts.append(np.repeat(np.arange(len(x_np), dtype=dtype), cnt))
+    return (Tensor(np.concatenate(srcs) if srcs else np.zeros((0,), dtype)),
+            Tensor(np.concatenate(dsts) if dsts else np.zeros((0,), dtype)),
+            Tensor(np.asarray(order, dtype)))

@@ -3,6 +3,7 @@ from . import functional  # noqa: F401
 from . import initializer  # noqa: F401
 from .layer_base import Layer, Parameter, ParamAttr  # noqa: F401
 from .layout import channels_last, is_channels_last  # noqa: F401
+from .meta import abstract_init, is_abstract_init  # noqa: F401
 from .functional_call import functional_call, module_fn, state_values  # noqa: F401
 from .clip import ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm  # noqa: F401
 from .clip import clip_grad_norm_  # noqa: F401

@@ -101,7 +101,8 @@ class Embedding(Layer):
         # full replicate-then-partition ("Involuntary full
         # rematerialization"), costing a [B,T,H] materialization per step
         self.weight._gather_indexed = True
-        if self._padding_idx is not None:
+        if self._padding_idx is not None and \
+                hasattr(self.weight._value, "at"):  # skipped in abstract init
             self.weight._replace_(
                 self.weight._value.at[self._padding_idx].set(0), None)
 

@@ -172,9 +172,16 @@ class Layer:
         if attr is False:
             return None
         dtype = dtype or self._dtype
-        initializer = attr.initializer or default_initializer or (
-            init_mod.Constant(0.0) if is_bias else init_mod.XavierUniform())
-        value = initializer(tuple(int(s) for s in shape), to_jax(dtype))
+        from .meta import is_abstract_init
+        if is_abstract_init():
+            # meta construction: shape/dtype only, no initializer run
+            import jax
+            value = jax.ShapeDtypeStruct(tuple(int(s) for s in shape),
+                                         to_jax(dtype))
+        else:
+            initializer = attr.initializer or default_initializer or (
+                init_mod.Constant(0.0) if is_bias else init_mod.XavierUniform())
+            value = initializer(tuple(int(s) for s in shape), to_jax(dtype))
         return Parameter(value, name=attr.name, trainable=attr.trainable,
                          learning_rate=attr.learning_rate,
                          regularizer=attr.regularizer, need_clip=attr.need_clip)

@@ -1,0 +1,39 @@
+"""Abstract (meta) model construction — build a Layer tree whose parameters
+are shape/dtype only, never materialized.
+
+This is the AOT capacity-planning path: a GPT-3-6.7B-class model is far too
+big to initialize on a dev host, but its train step can still be lowered,
+compiled, and memory-analyzed for a target mesh
+(`make_train_step(..., abstract=True).aot_compile(...)`) — plan the
+v5e-16 recipe from a 1-core CPU box.  The reference has no analog; its
+capacity planning is run-it-and-see on the cluster.
+
+Usage::
+
+    with paddle_tpu.nn.abstract_init():
+        model = build_gpt("gpt3-6.7B-en")      # no bytes allocated
+    step = dist.make_train_step(model, opt, mesh=mesh, abstract=True)
+    mem = step.aot_compile(x_struct, y_struct).memory_analysis()
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+__all__ = ["abstract_init", "is_abstract_init"]
+
+_state = threading.local()
+
+
+def is_abstract_init() -> bool:
+    return getattr(_state, "on", False)
+
+
+@contextlib.contextmanager
+def abstract_init(enable: bool = True):
+    prev = getattr(_state, "on", False)
+    _state.on = bool(enable)
+    try:
+        yield
+    finally:
+        _state.on = prev

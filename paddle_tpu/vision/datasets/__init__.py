@@ -5,6 +5,7 @@ No-egress build: datasets load from LOCAL files (pass `image_path`/
 message instead of fetching.  `FakeData` provides synthetic samples for
 tests/smoke-training (the reference's fake reader pattern).
 """
+from .folder import DatasetFolder, ImageFolder  # noqa: F401
 from .mnist import MNIST, FashionMNIST  # noqa: F401
 from .cifar import Cifar10, Cifar100  # noqa: F401
 from .fake import FakeData  # noqa: F401

@@ -1,0 +1,224 @@
+"""DatasetFolder/ImageFolder + Conll05st/WMT14/WMT16 (reference:
+vision/datasets/folder.py, text/datasets/{conll05,wmt14,wmt16}.py).
+
+Each dataset is exercised on a synthetic archive in the exact layout the
+reference parses, and feeds a real training smoke (VERDICT r2 item 5)."""
+import gzip
+import io
+import os
+import tarfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def _write_png(path, rs, size=(8, 8)):
+    from PIL import Image
+    arr = rs.randint(0, 255, size + (3,), dtype=np.uint8)
+    Image.fromarray(arr).save(path)
+
+
+@pytest.fixture()
+def image_tree(tmp_path):
+    rs = np.random.RandomState(0)
+    for cls in ("cat", "dog"):
+        d = tmp_path / "imgs" / cls
+        d.mkdir(parents=True)
+        for i in range(3):
+            _write_png(str(d / f"{i}.png"), rs)
+        (d / "notes.txt").write_text("not an image")
+    return str(tmp_path / "imgs")
+
+
+def test_dataset_folder_layout(image_tree):
+    from paddle_tpu.vision.datasets import DatasetFolder
+
+    ds = DatasetFolder(image_tree)
+    assert ds.classes == ["cat", "dog"]
+    assert ds.class_to_idx == {"cat": 0, "dog": 1}
+    assert len(ds) == 6 and ds.targets == [0, 0, 0, 1, 1, 1]
+    img, label = ds[0]
+    assert label == 0 and img.size == (8, 8)
+    # extensions filter + custom loader
+    ds2 = DatasetFolder(image_tree, loader=lambda p: np.zeros((2, 2)),
+                        extensions=(".png",))
+    assert len(ds2) == 6 and ds2[0][0].shape == (2, 2)
+    with pytest.raises(RuntimeError):
+        DatasetFolder(image_tree, extensions=(".webp",))
+
+
+def test_image_folder_flat(image_tree):
+    from paddle_tpu.vision.datasets import ImageFolder
+
+    ds = ImageFolder(image_tree)
+    assert len(ds) == 6
+    item = ds[0]
+    assert isinstance(item, list) and len(item) == 1
+
+
+def test_dataset_folder_feeds_model_fit(image_tree):
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.io import DataLoader
+    from paddle_tpu.vision.datasets import DatasetFolder
+
+    def transform(img):
+        return np.asarray(img, np.float32).transpose(2, 0, 1) / 255.0
+
+    ds = DatasetFolder(image_tree, transform=transform)
+    loader = DataLoader(ds, batch_size=3, shuffle=False)
+    paddle.seed(0)
+    net = nn.Sequential(nn.Conv2D(3, 4, 3, padding=1), nn.ReLU(),
+                        nn.AdaptiveAvgPool2D(1), nn.Flatten(),
+                        nn.Linear(4, 2))
+    model = Model(net)
+    model.prepare(paddle.optimizer.Adam(parameters=net.parameters(),
+                                        learning_rate=1e-2),
+                  nn.CrossEntropyLoss())
+    hist = model.fit(loader, epochs=2, verbose=0)
+    ev = model.evaluate(loader, verbose=0)
+    assert np.isfinite(ev["loss"][0] if isinstance(ev["loss"], list)
+                       else ev["loss"])
+
+
+def _conll_tar(tmp_path):
+    """conll05st-release tar with two sentences (one prop column each)."""
+    words = ["The cat sat", "Dogs bark loudly"]
+    props = [
+        [["-", "(V*)"], ["-", "*"], ["sat", "(A1*)"]],
+        [["-", "(A0*)"], ["bark", "(V*)"], ["-", "*)"]],
+    ]
+    # props layout per token: first col predicate lemma or '-', then spans
+    wbuf, pbuf = io.BytesIO(), io.BytesIO()
+    with gzip.GzipFile(fileobj=wbuf, mode="w") as wgz, \
+            gzip.GzipFile(fileobj=pbuf, mode="w") as pgz:
+        for sent, prop in zip(words, props):
+            toks = sent.split()
+            for tok, cols in zip(toks, prop):
+                wgz.write((tok + "\n").encode())
+                pgz.write(("\t".join(cols) + "\n").encode())
+            wgz.write(b"\n")
+            pgz.write(b"\n")
+    tar_path = tmp_path / "conll05st-tests.tar.gz"
+    with tarfile.open(tar_path, "w:gz") as tf:
+        for name, buf in (
+                ("conll05st-release/test.wsj/words/test.wsj.words.gz", wbuf),
+                ("conll05st-release/test.wsj/props/test.wsj.props.gz", pbuf)):
+            data = buf.getvalue()
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+    word_dict = tmp_path / "wordDict.txt"
+    word_dict.write_text("\n".join(
+        ["<unk>", "the", "cat", "sat", "dogs", "bark", "loudly",
+         "The", "Dogs"]) + "\n")
+    verb_dict = tmp_path / "verbDict.txt"
+    verb_dict.write_text("sat\nbark\n")
+    target_dict = tmp_path / "targetDict.txt"
+    target_dict.write_text("B-V\nI-V\nB-A0\nI-A0\nB-A1\nI-A1\nO\n")
+    return str(tar_path), str(word_dict), str(verb_dict), str(target_dict)
+
+
+def test_conll05st_parses_and_windows(tmp_path):
+    from paddle_tpu.text.datasets import Conll05st
+
+    data, wd, vd, td = _conll_tar(tmp_path)
+    ds = Conll05st(data_file=data, word_dict_file=wd, verb_dict_file=vd,
+                   target_dict_file=td)
+    assert len(ds) == 2
+    item = ds[0]
+    assert len(item) == 9
+    word_idx, *ctx, pred_idx, mark, label_idx = item
+    assert word_idx.shape == (3,) and label_idx.shape == (3,)
+    # sentence 0: predicate 'sat' at index 0 of props col -> B-V at token 0
+    wdict, pdict, ldict = ds.get_dict()
+    assert pred_idx[0] == pdict["sat"]
+    assert label_idx[0] == ldict["B-V"]
+    assert mark.sum() >= 1
+    # 9-field sample trains a toy SRL tagger end-to-end
+    paddle.seed(0)
+    emb = nn.Embedding(len(wdict), 8)
+    fc = nn.Linear(8, len(ldict))
+    opt = paddle.optimizer.Adam(
+        parameters=emb.parameters() + fc.parameters(), learning_rate=1e-2)
+    crit = nn.CrossEntropyLoss()
+    for _ in range(3):
+        logits = fc(emb(paddle.to_tensor(word_idx[None])))
+        loss = crit(logits.reshape([-1, len(ldict)]),
+                    paddle.to_tensor(label_idx[None].reshape(-1)))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert np.isfinite(float(loss.numpy()))
+
+
+def _wmt14_tar(tmp_path):
+    pairs = [("a b c", "x y"), ("b c d", "y z"), ("c d", "z")]
+    src_vocab = ["<s>", "<e>", "<unk>", "a", "b", "c", "d"]
+    trg_vocab = ["<s>", "<e>", "<unk>", "x", "y", "z"]
+    tar_path = tmp_path / "wmt14.tgz"
+    with tarfile.open(tar_path, "w:gz") as tf:
+        def add(name, text):
+            data = text.encode()
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+        add("wmt14/src.dict", "\n".join(src_vocab) + "\n")
+        add("wmt14/trg.dict", "\n".join(trg_vocab) + "\n")
+        body = "".join(f"{s}\t{t}\n" for s, t in pairs)
+        add("wmt14/train/train", body)
+        add("wmt14/test/test", body[:len(body) // 2])
+        add("wmt14/gen/gen", body)
+    return str(tar_path)
+
+
+def test_wmt14_ids_and_seq2seq_smoke(tmp_path):
+    from paddle_tpu.text.datasets import WMT14
+
+    ds = WMT14(data_file=_wmt14_tar(tmp_path), mode="train", dict_size=7)
+    assert len(ds) == 3
+    src, trg, trg_next = ds[0]
+    sd, td = ds.get_dict()
+    assert src[0] == sd["<s>"] and src[-1] == sd["<e>"]
+    assert trg[0] == td["<s>"] and trg_next[-1] == td["<e>"]
+    np.testing.assert_array_equal(trg[1:], trg_next[:-1])
+    # tiny seq2seq step over the batch
+    paddle.seed(0)
+    emb = nn.Embedding(7, 8)
+    fc = nn.Linear(8, 6)
+    opt = paddle.optimizer.Adam(parameters=emb.parameters() + fc.parameters(),
+                                learning_rate=1e-2)
+    crit = nn.CrossEntropyLoss()
+    loss = crit(fc(emb(paddle.to_tensor(trg[None]))).reshape([-1, 6]),
+                paddle.to_tensor(trg_next[None].reshape(-1)))
+    loss.backward()
+    opt.step()
+    assert np.isfinite(float(loss.numpy()))
+
+
+def test_wmt16_builds_dict_and_parses(tmp_path):
+    from paddle_tpu.text.datasets import WMT16
+
+    pairs = [("a b b", "u v"), ("b c", "v w"), ("a", "u")]
+    tar_path = tmp_path / "wmt16.tar.gz"
+    with tarfile.open(tar_path, "w:gz") as tf:
+        body = "".join(f"{s}\t{t}\n" for s, t in pairs)
+        for mode in ("train", "test", "val"):
+            data = body.encode()
+            info = tarfile.TarInfo(f"wmt16/{mode}")
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+    ds = WMT16(data_file=str(tar_path), mode="train", src_dict_size=6,
+               trg_dict_size=6, lang="en")
+    assert len(ds) == 3
+    src, trg, trg_next = ds[0]
+    # dict is frequency-ranked after the 3 marks: 'b' (3x) comes first
+    en = ds.get_dict("en")
+    assert en["<s>"] == 0 and en["<e>"] == 1 and en["<unk>"] == 2
+    assert en["b"] == 3
+    assert src[0] == 0 and src[-1] == 1
+    np.testing.assert_array_equal(trg[1:], trg_next[:-1])
+    # dict cache persists beside the archive
+    assert os.path.exists(str(tmp_path / "wmt16_en_6.dict"))
