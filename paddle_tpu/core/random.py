@@ -37,8 +37,13 @@ class _RNGState(threading.local):
 _state = _RNGState()
 
 
+_seed = 0  # last framework seed (host-side RNG consumers read this)
+
+
 def seed(value: int):
     """paddle.seed — reseed the global generator."""
+    global _seed
+    _seed = int(value)
     _state.key = jax.random.key(int(value))
     _state.counter = 0
     return _state

@@ -47,6 +47,19 @@ def recompute(function, *args, preserve_rng_state=True, use_reentrant=True,
                 return raw(*vals)
 
         ckpt = jax.checkpoint(with_rng)
+        vals = [t._value if isinstance(t, Tensor) else t
+                for t in (*tensors, *args)]
+        if any(isinstance(v, jax.core.Tracer) for v in vals):
+            # under an outer trace (make_train_step's value_and_grad) call
+            # the checkpointed fn DIRECTLY: routing it through apply_op's
+            # per-op jax.vjp pre-linearizes the forward, so the outer
+            # autodiff differentiates the already-expanded graph and the
+            # remat boundary is lost — measured on the 6.7B AOT plan as
+            # ~1.9 GiB/layer of retained activations (docs/PERF.md)
+            out = ckpt(*vals)
+            return jax.tree_util.tree_map(
+                lambda v: Tensor(v, _internal=True)
+                if isinstance(v, jax.Array) else v, out)
         return apply_op(ckpt, "recompute", (*tensors, *args), {})
 
     # plain callable: differentiate w.r.t. tensor args only
@@ -60,6 +73,13 @@ def recompute(function, *args, preserve_rng_state=True, use_reentrant=True,
             is_leaf=lambda x: isinstance(x, Tensor))
 
     ckpt = jax.checkpoint(raw_fn)
+    vals = [t._value if isinstance(t, Tensor) else t for t in args]
+    if any(isinstance(v, jax.core.Tracer) for v in vals):
+        # same remat-boundary preservation as the Layer branch above
+        out = ckpt(*vals)
+        return jax.tree_util.tree_map(
+            lambda v: Tensor(v, _internal=True)
+            if isinstance(v, jax.Array) else v, out)
     return apply_op(ckpt, "recompute", args, {})
 
 

@@ -34,6 +34,14 @@ _counter = [0]
 
 
 def _strip_decorators(fn_def: ast.FunctionDef) -> None:
+    """Strip ALL decorator lines from the recompiled def (reference
+    decorator_transformer.py concern, resolved differently): when `fn`
+    reaches conversion as a RAW function whose source still shows
+    decorators (the `@other` above `@to_static` stack), the outer
+    decorators are applied at the ORIGINAL def site to whatever we return
+    — re-emitting them in the recompiled module would apply them twice.
+    Decorators below to_static wrap `fn` itself before we ever see it and
+    convert as ordinary closures."""
     fn_def.decorator_list = []
 
 

@@ -342,3 +342,41 @@ def convert_assert(pred, msg_fn=None):
     ok = bool(np.all(np.asarray(p))) if hasattr(p, "shape") else bool(p)
     if not ok:
         raise AssertionError(msg_fn() if msg_fn is not None else "")
+
+
+def convert_cast(x, kind: str):
+    """`int(x)` / `float(x)` / `bool(x)` conversion (reference
+    cast_transformer.py → paddle.cast).  Tensors cast via astype (bool(x)
+    on a traced tensor would otherwise raise TracerBoolConversionError);
+    everything else takes the plain Python builtin."""
+    from ...core.tensor import Tensor
+
+    if isinstance(x, Tensor):
+        if _is_tracer(x._value):
+            target = {"int": "int64", "float": "float32",
+                      "bool": "bool"}[kind]
+            return x.astype(target)
+        # eager concrete tensor: match Python semantics exactly (0-d only)
+        return {"int": int, "float": float, "bool": bool}[kind](x)
+    return {"int": int, "float": float, "bool": bool}[kind](x)
+
+
+def convert_print(*args, **kwargs):
+    """`print(...)` conversion (reference print_transformer.py → the Print
+    op).  Eager: plain print.  Under trace: traced tensors route through
+    jax.debug.print so the values appear at RUN time with the computed
+    contents (printing the tracer object would show an abstract value)."""
+    from ...core.tensor import Tensor
+
+    vals = [a._value if isinstance(a, Tensor) else a for a in args]
+    if any(_is_tracer(v) for v in vals):
+        import jax
+
+        sep = kwargs.get("sep", " ")
+        end = kwargs.get("end", "\n")
+        # file/flush cannot be honored inside a compiled graph: the print
+        # happens device-side at RUN time via the debug-callback channel
+        fmt = sep.join("{}" for _ in vals) + (end if end != "\n" else "")
+        jax.debug.print(fmt, *vals)
+        return
+    print(*args, **kwargs)

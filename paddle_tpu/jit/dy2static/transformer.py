@@ -361,6 +361,7 @@ class Dy2StaticTransformer(ast.NodeTransformer):
         for s in node.body:
             d.visit(s)
         self._decl_stack.append(d.names)
+        node.body = self._normalize_early_returns(node.body)
         try:
             self.generic_visit(node)
         finally:
@@ -368,6 +369,53 @@ class Dy2StaticTransformer(ast.NodeTransformer):
         return node
 
     visit_AsyncFunctionDef = visit_FunctionDef
+
+    # -- early return ---------------------------------------------------------
+    def _normalize_early_returns(self, stmts):
+        """early_return_transformer.py parity: `if c: ...return` followed
+        by trailing statements becomes `if c: ...return  else: <rest>` —
+        semantically identical in any block, and it turns early-return
+        functions into the both-branches-return shape visit_If can convert
+        to a value-returning lax.cond."""
+        out = []
+        for i, s in enumerate(stmts):
+            if isinstance(s, (ast.If,)):
+                s.body = self._normalize_early_returns(s.body)
+                s.orelse = self._normalize_early_returns(s.orelse)
+                if (not s.orelse and s.body
+                        and isinstance(s.body[-1], ast.Return)
+                        and i + 1 < len(stmts)):
+                    s.orelse = self._normalize_early_returns(stmts[i + 1:])
+                    out.append(s)
+                    return out
+            elif isinstance(s, (ast.While, ast.For, ast.With)):
+                s.body = self._normalize_early_returns(s.body)
+            out.append(s)
+        return out
+
+    # -- cast / print calls ---------------------------------------------------
+    def visit_Call(self, node: ast.Call):
+        self.generic_visit(node)
+        fn = node.func
+        if isinstance(fn, ast.Name) and not node.keywords:
+            # cast_transformer.py parity: bool/int/float on a traced tensor
+            # must become astype, not a Python conversion of the tracer
+            if fn.id in ("int", "float", "bool") and len(node.args) == 1 \
+                    and not isinstance(node.args[0], ast.Starred):
+                self._uid()
+                return _jst_call("convert_cast",
+                                 [node.args[0],
+                                  ast.Constant(value=fn.id)])
+        if isinstance(fn, ast.Name) and fn.id == "print" and \
+                not any(isinstance(a, ast.Starred) for a in node.args):
+            # print_transformer.py parity: traced tensors print their RUN-
+            # time values via jax.debug.print instead of the tracer repr
+            self._uid()
+            return ast.Call(
+                func=ast.Attribute(value=_name_load(_JST),
+                                   attr="convert_print", ctx=ast.Load()),
+                args=node.args, keywords=node.keywords)
+        return node
 
     # -- assert ---------------------------------------------------------------
     def visit_Assert(self, node: ast.Assert):
@@ -385,6 +433,45 @@ class Dy2StaticTransformer(ast.NodeTransformer):
     # -- if/else --------------------------------------------------------------
     def visit_If(self, node: ast.If):
         self.generic_visit(node)
+        if (node.body and node.orelse
+                and isinstance(node.body[-1], ast.Return)
+                and isinstance(node.orelse[-1], ast.Return)
+                and not _has_jump(node.body[:-1])
+                and not _has_jump(node.orelse[:-1])):
+            # both branches return (the early_return normalization above
+            # produces this shape): convert to a VALUE-returning cond —
+            # helper fns return (retval,), the rewritten statement returns
+            # it.  Branch-local stores stay local to the helpers.
+            body_names, b_blocked = _stores(node.body[:-1])
+            else_names, e_blocked = _stores(node.orelse[:-1])
+            if not b_blocked and not e_blocked and \
+                    not ((body_names | else_names) & self._declared()):
+                uid = self._uid()
+                tn, fn_ = f"__dy2st_true_{uid}", f"__dy2st_false_{uid}"
+                test = _PredicateTransformer().visit(node.test)
+
+                def _ret_branch(stmts):
+                    ret = stmts[-1]
+                    val = ret.value if ret.value is not None \
+                        else ast.Constant(value=None)
+                    return list(stmts[:-1]) + [
+                        ast.Return(value=ast.Tuple(elts=[val],
+                                                   ctx=ast.Load()))]
+
+                true_fn = ast.FunctionDef(
+                    name=tn, args=_fn_args([]),
+                    body=_ret_branch(node.body), decorator_list=[],
+                    returns=None)
+                false_fn = ast.FunctionDef(
+                    name=fn_, args=_fn_args([]),
+                    body=_ret_branch(node.orelse), decorator_list=[],
+                    returns=None)
+                tmp = f"__dy2st_ret_{uid}"
+                call = _jst_call("convert_ifelse", [
+                    test, _name_load(tn), _name_load(fn_),
+                    ast.Tuple(elts=[], ctx=ast.Load())])
+                return [true_fn, false_fn, _assign_tuple([tmp], call),
+                        ast.Return(value=_name_load(tmp))]
         if _has_jump(node.body) or _has_jump(node.orelse):
             return node
         body_names, b_blocked = _stores(node.body)

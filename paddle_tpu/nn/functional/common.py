@@ -115,10 +115,13 @@ def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
         x = x.reshape(n, c // (r * r), r, r, h, w)
         x = x.transpose(0, 1, 4, 2, 5, 3)
         return x.reshape(n, c // (r * r), h * r, w * r)
+    # NHWC channels grouped [c_out, r, r] with c_out SLOWEST
+    # (pixel_shuffle_kernel_impl.h:42 resize + {0,1,4,2,5,3} permute)
     n, h, w, c = x.shape
-    x = x.reshape(n, h, w, r, r, c // (r * r))
-    x = x.transpose(0, 1, 3, 2, 4, 5)
-    return x.reshape(n, h * r, w * r, c // (r * r))
+    co = c // (r * r)
+    x = x.reshape(n, h, w, co, r, r)
+    x = x.transpose(0, 1, 4, 2, 5, 3)
+    return x.reshape(n, h * r, w * r, co)
 
 
 @defop
@@ -129,7 +132,13 @@ def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
         x = x.reshape(n, c, h // r, r, w // r, r)
         x = x.transpose(0, 1, 3, 5, 2, 4)
         return x.reshape(n, c * r * r, h // r, w // r)
-    raise NotImplementedError("pixel_unshuffle NHWC")
+    # NHWC: output channels grouped [c, r, r] with c SLOWEST
+    # (pixel_unshuffle_kernel_impl.h:42 resize + {0,1,3,5,2,4} permute) —
+    # the exact inverse of the NHWC pixel_shuffle above
+    n, h, w, c = x.shape
+    x = x.reshape(n, h // r, r, w // r, r, c)
+    x = x.transpose(0, 1, 3, 5, 2, 4)
+    return x.reshape(n, h // r, w // r, c * r * r)
 
 
 @defop
@@ -236,4 +245,44 @@ def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name
 
 
 def class_center_sample(label, num_classes, num_samples, group=None):
-    raise NotImplementedError("class_center_sample requires distributed PS support")
+    """PartialFC class-center sampling (reference
+    class_center_sample_op.cu / nn/functional/common.py:1850): keep every
+    positive class center, sample negatives up to num_samples, return
+    (remapped_label, sorted sampled class ids).  Dynamic output shape →
+    host-side op feeding the margin-softmax's gathered centers.
+
+    group=False / single-process group: local sampling (the supported
+    scope; a real multi-rank group would need the cross-rank allgather of
+    positives, which this build routes through mp_ops when a bound mesh
+    axis exists)."""
+    import numpy as np
+
+    from ...core.tensor import Tensor
+
+    if group not in (None, False) and getattr(group, "nranks", 1) > 1:
+        raise NotImplementedError(
+            "class_center_sample across a multi-rank group is not "
+            "supported in-process; shard class centers with "
+            "VocabParallelEmbedding + mp_ops instead")
+    lab = np.asarray(label.numpy() if isinstance(label, Tensor)
+                     else label).reshape(-1).astype(np.int64)
+    if lab.size and (lab.min() < 0 or lab.max() >= num_classes):
+        raise ValueError(
+            f"labels must lie in [0, {num_classes}), got range "
+            f"[{lab.min()}, {lab.max()}]")
+    pos = np.unique(lab)
+    if len(pos) < num_samples:
+        neg_pool = np.setdiff1d(np.arange(num_classes, dtype=np.int64),
+                                pos, assume_unique=True)
+        # persistent stream (advances per call): identical batches must
+        # still draw fresh negatives each epoch, like the reference kernel
+        from ...geometric.sampling import _module_rng
+        rng = _module_rng()
+        k = min(num_samples - len(pos), len(neg_pool))
+        chosen = rng.choice(neg_pool, size=k, replace=False)
+        sampled = np.sort(np.concatenate([pos, chosen]))
+    else:
+        sampled = pos  # all positives kept (may exceed num_samples)
+    remapped = np.searchsorted(sampled, lab)
+    return (Tensor(remapped.astype(np.int64)),
+            Tensor(sampled.astype(np.int64)))

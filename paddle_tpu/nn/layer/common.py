@@ -7,6 +7,7 @@ from ...core.tensor import Tensor
 from .. import functional as F
 from .. import initializer as I
 from ..layer_base import Layer, ParamAttr
+from ..layout import resolve_data_format
 
 
 class Linear(Layer):
@@ -57,7 +58,7 @@ class Dropout2D(Layer):
     def __init__(self, p=0.5, data_format="NCHW", name=None):
         super().__init__()
         self.p = p
-        self.data_format = data_format
+        self.data_format = resolve_data_format(data_format)
 
     def forward(self, x):
         return F.dropout2d(x, p=self.p, training=self.training,
@@ -68,7 +69,7 @@ class Dropout3D(Layer):
     def __init__(self, p=0.5, data_format="NCDHW", name=None):
         super().__init__()
         self.p = p
-        self.data_format = data_format
+        self.data_format = resolve_data_format(data_format)
 
     def forward(self, x):
         return F.dropout3d(x, p=self.p, training=self.training,
@@ -157,7 +158,7 @@ class Upsample(Layer):
         self.mode = mode
         self.align_corners = align_corners
         self.align_mode = align_mode
-        self.data_format = data_format
+        self.data_format = resolve_data_format(data_format)
 
     def forward(self, x):
         return F.interpolate(x, self.size, self.scale_factor, self.mode,
@@ -178,7 +179,7 @@ class PixelShuffle(Layer):
     def __init__(self, upscale_factor, data_format="NCHW", name=None):
         super().__init__()
         self.upscale_factor = upscale_factor
-        self.data_format = data_format
+        self.data_format = resolve_data_format(data_format)
 
     def forward(self, x):
         return F.pixel_shuffle(x, self.upscale_factor, self.data_format)
@@ -188,7 +189,7 @@ class PixelUnshuffle(Layer):
     def __init__(self, downscale_factor, data_format="NCHW", name=None):
         super().__init__()
         self.downscale_factor = downscale_factor
-        self.data_format = data_format
+        self.data_format = resolve_data_format(data_format)
 
     def forward(self, x):
         return F.pixel_unshuffle(x, self.downscale_factor, self.data_format)
@@ -198,7 +199,7 @@ class ChannelShuffle(Layer):
     def __init__(self, groups, data_format="NCHW", name=None):
         super().__init__()
         self.groups = groups
-        self.data_format = data_format
+        self.data_format = resolve_data_format(data_format)
 
     def forward(self, x):
         return F.channel_shuffle(x, self.groups, self.data_format)
@@ -237,7 +238,7 @@ class _PadNd(Layer):
         self.padding = padding
         self.mode = mode
         self.value = value
-        self.data_format = data_format
+        self.data_format = resolve_data_format(data_format)
 
     def forward(self, x):
         return F.pad(x, self.padding, mode=self.mode, value=self.value,

@@ -115,13 +115,20 @@ def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
     if axis is None:
         arr = arr.reshape(-1)
         keep = np.concatenate([[True], arr[1:] != arr[:-1]])
+        out = arr[keep]
+        n_runs = arr.size
     else:
-        raise NotImplementedError("unique_consecutive with axis")
-    out = arr[keep]
+        # slice-wise: a "run" is a stretch of identical slices along axis
+        a = np.moveaxis(arr, axis, 0)
+        flat = a.reshape(a.shape[0], -1)
+        neq = np.any(flat[1:] != flat[:-1], axis=1)
+        keep = np.concatenate([[True], neq])
+        out = np.moveaxis(a[keep], 0, axis)
+        n_runs = a.shape[0]
     outs = [jnp.asarray(out)]
     if return_inverse:
         outs.append(jnp.asarray(np.cumsum(keep) - 1))
     if return_counts:
         idx = np.nonzero(keep)[0]
-        outs.append(jnp.asarray(np.diff(np.append(idx, arr.size))))
+        outs.append(jnp.asarray(np.diff(np.append(idx, n_runs))))
     return outs[0] if len(outs) == 1 else tuple(outs)

@@ -234,9 +234,12 @@ def box_coder(prior_box, prior_box_var, target_box,
               code_type="encode_center_size", box_normalized=True, axis=0,
               name=None):
     """vision/ops box_coder parity (encode/decode_center_size; the R-CNN
-    bbox-delta transform).  axis=1 decode layout is not implemented."""
-    if axis != 0:
-        raise NotImplementedError("box_coder axis=1 layout not implemented")
+    bbox-delta transform).  For decode, `axis` selects which dim of the
+    [row, col, 4] target the prior boxes broadcast over: axis=0 -> prior
+    per COLUMN (cpu/box_coder.cc:122 `j * len`), axis=1 -> prior per ROW
+    (`i * len`).  Encode ignores axis like the reference."""
+    if axis not in (0, 1):
+        raise ValueError(f"box_coder axis must be 0 or 1, got {axis}")
     if isinstance(prior_box_var, (list, tuple)):
         prior_box_var = Tensor(jnp.asarray(prior_box_var, jnp.float32),
                                _internal=True)
@@ -270,16 +273,22 @@ def box_coder(prior_box, prior_box_var, target_box,
             ], axis=-1)  # [T, P, 4]
             return out
         if code_type == "decode_center_size":
-            # tb: [N, P, 4] deltas (or [N, 4] broadcast on prior axis)
-            d = tb if tb.ndim == 3 else tb[:, None, :]
+            # tb: [row, col, 4] deltas (or [N, 4] broadcast on prior axis);
+            # the prior stats broadcast over dim (1-axis)
+            d = tb if tb.ndim == 3 else (tb[:, None, :] if axis == 0
+                                         else tb[None, :, :])
+
+            def bc(t):
+                return t[None, :] if axis == 0 else t[:, None]
+
             if pbv is not None and pbv.ndim == 2:
-                v = pbv[None, :, :]
+                v = pbv[None, :, :] if axis == 0 else pbv[:, None, :]
             else:
                 v = var.reshape(1, 1, 4)
-            cx = d[..., 0] * v[..., 0] * pw[None, :] + pcx[None, :]
-            cy = d[..., 1] * v[..., 1] * ph[None, :] + pcy[None, :]
-            w = jnp.exp(d[..., 2] * v[..., 2]) * pw[None, :]
-            h = jnp.exp(d[..., 3] * v[..., 3]) * ph[None, :]
+            cx = d[..., 0] * v[..., 0] * bc(pw) + bc(pcx)
+            cy = d[..., 1] * v[..., 1] * bc(ph) + bc(pcy)
+            w = jnp.exp(d[..., 2] * v[..., 2]) * bc(pw)
+            h = jnp.exp(d[..., 3] * v[..., 3]) * bc(ph)
             return jnp.stack([cx - w * 0.5, cy - h * 0.5,
                               cx + w * 0.5 - norm, cy + h * 0.5 - norm],
                              axis=-1)

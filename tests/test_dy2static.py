@@ -518,3 +518,181 @@ def test_assert_compound_predicate_and_lazy_msg():
         raise RuntimeError("should have asserted")
     except AssertionError as e:
         assert "boom" in str(e) and evals == [1]
+
+
+# -- round-3 long tail: cast / print / early-return / decorator / shape ------
+
+def test_early_return():
+    """early_return_transformer.py: trailing statements fold into the else
+    branch and the if converts to a value-returning lax.cond."""
+    def fn(x):
+        if x.sum() > 0:
+            return x * 2.0
+        y = x - 1.0
+        return y * 3.0
+
+    _run_both(fn, np.array([1.0, 2.0], "float32"))
+    _run_both(fn, np.array([-1.0, -2.0], "float32"))
+
+
+def test_early_return_chain():
+    def fn(x):
+        if x.sum() > 10.0:
+            return x * 10.0
+        if x.sum() > 0:
+            return x + 1.0
+        return -x
+
+    for v in ([20.0], [1.0], [-5.0]):
+        _run_both(fn, np.array(v, "float32"))
+
+
+def test_both_branches_return():
+    def fn(x):
+        if x.max() > 0:
+            z = x + 1.0
+            return z * 2.0
+        else:
+            return x * 0.5
+
+    _run_both(fn, np.array([3.0, -1.0], "float32"))
+    _run_both(fn, np.array([-3.0, -1.0], "float32"))
+
+
+def test_cast_float_of_sum_in_branch():
+    """cast_transformer.py: float(tensor) under trace becomes astype."""
+    def fn(x):
+        s = float(x.sum())
+        if x.sum() > 0:
+            y = x * s
+        else:
+            y = x - s
+        return y
+
+    _run_both(fn, np.array([1.0, 3.0], "float32"))
+    _run_both(fn, np.array([-1.0, -3.0], "float32"))
+
+
+def test_cast_int_truncates():
+    def fn(x):
+        n = int(x.sum())
+        return x + n
+
+    out = _run_both(fn, np.array([1.7, 1.0], "float32"))
+    np.testing.assert_allclose(out, [3.7, 3.0], rtol=1e-6)
+
+
+def test_cast_python_values_untouched():
+    def fn(x):
+        k = int(3.9)
+        f = float(2)
+        b = bool(0)
+        if x.sum() > 0:
+            y = x * k + f + (1.0 if b else 0.0)
+        else:
+            y = x
+        return y
+
+    out = _run_both(fn, np.array([1.0], "float32"))
+    np.testing.assert_allclose(out, [5.0], rtol=1e-6)
+
+
+def test_print_of_traced_tensor(capsys):
+    """print_transformer.py: printing a traced tensor must not crash and
+    eager printing still writes the value."""
+    def fn(x):
+        if x.sum() > 0:
+            y = x * 2.0
+        else:
+            y = x
+        print("value:", y.sum())
+        return y
+
+    _run_both(fn, np.array([1.0, 2.0], "float32"))
+    # eager path printed the concrete value at least once
+    assert "value:" in capsys.readouterr().out
+
+
+def test_decorator_above_to_static_applies_once():
+    """`@other` above `@to_static`: the outer decorator wraps the CONVERTED
+    function at the def site exactly once (decorator_transformer.py
+    concern — re-emitting decorator lines in the recompiled module would
+    double-apply them)."""
+    import functools
+
+    def double_result(f):
+        @functools.wraps(f)
+        def wrap(*a, **k):
+            return f(*a, **k) * 2.0
+        return wrap
+
+    from paddle_tpu.jit import to_static
+
+    @double_result
+    @to_static
+    def fn(x):
+        if x.sum() > 0:
+            y = x + 1.0
+        else:
+            y = x - 1.0
+        return y
+
+    out = fn(paddle.to_tensor(np.array([1.0], "float32")))
+    np.testing.assert_allclose(np.asarray(out._value), [4.0], rtol=1e-6)
+    out = fn(paddle.to_tensor(np.array([-1.0], "float32")))
+    np.testing.assert_allclose(np.asarray(out._value), [-4.0], rtol=1e-6)
+
+
+def test_tensor_shape_in_predicate():
+    """tensor_shape_transformer.py concern is moot under XLA: shapes are
+    static at trace time, so shape-dependent control flow is resolved as
+    plain Python — but it must still CONVERT cleanly when mixed with
+    tensor predicates."""
+    def fn(x):
+        if x.shape[0] > 2:
+            y = x[:2]
+        else:
+            y = x
+        if y.sum() > 0:
+            z = y * 2.0
+        else:
+            z = -y
+        return z
+
+    _run_both(fn, np.array([1.0, 2.0, 3.0], "float32"))
+    _run_both(fn, np.array([-1.0, -2.0], "float32"))
+
+
+def test_list_append_static_loop():
+    """list_transformer.py scope: appends in STATIC loops unroll under
+    trace (the dynamic tensor-array case is impossible under XLA's static
+    shapes and fails loudly instead)."""
+    def fn(x):
+        acc = []
+        for i in range(3):
+            acc.append(x * float(i + 1))
+        total = acc[0]
+        for t in acc[1:]:
+            total = total + t
+        if total.sum() > 0:
+            out = total
+        else:
+            out = -total
+        return out
+
+    out = _run_both(fn, np.array([1.0], "float32"))
+    np.testing.assert_allclose(out, [6.0], rtol=1e-6)
+
+
+def test_early_return_inside_loop_body():
+    def fn(x):
+        i = 0
+        while i < 3:
+            x = x + 1.0
+            i += 1
+        if x.sum() > 100.0:
+            return x * 0.0
+        return x
+
+    out = _run_both(fn, np.array([1.0], "float32"))
+    np.testing.assert_allclose(out, [4.0], rtol=1e-6)

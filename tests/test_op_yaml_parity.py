@@ -37,6 +37,7 @@ ALIASES = {
     "nearest_interp": "nn.functional.interpolate",
     "trilinear_interp": "nn.functional.interpolate",
     "box_coder": "vision.ops.box_coder",
+    "class_center_sample": "nn.functional.class_center_sample",
     "brelu": "nn.functional.hardtanh",
     "cast": "core.tensor.Tensor.astype",
     "cross_entropy_with_softmax": "nn.functional.softmax_with_cross_entropy",
@@ -136,8 +137,6 @@ DROPPED = {
                 "consumer in the supported model zoo",
     "decode_jpeg": "device-side JPEG decode is CUDA-specific (nvJPEG); "
                    "image IO is host-side in vision.datasets/transforms",
-    "class_center_sample": "PLSC large-scale-face training sampler, "
-                           "outside the supported recipes",
     "hierarchical_sigmoid": "legacy tree-softmax for rec-sys; the PS "
                             "sparse-table + tree-index (TDM) path covers "
                             "that workload family",

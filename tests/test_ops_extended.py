@@ -1,6 +1,7 @@
 """Correctness tests for the long-tail ops (ops/extended.py) against numpy
 references — the per-op depth the registry sweep's smoke pass doesn't give."""
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
 
@@ -212,3 +213,73 @@ def test_sparse_embedding_negative_id_grad_targets_clipped_row():
     assert (rows >= 0).all() and set(rows.tolist()) == {0, 2}
     dense = np.asarray(g.to_dense())
     assert np.abs(dense[4]).max() == 0.0  # last row untouched
+
+
+def test_pixel_unshuffle_nhwc_roundtrip():
+    """NHWC pixel_unshuffle is the exact inverse of NHWC pixel_shuffle
+    (and NCHW stays the inverse of NCHW)."""
+    import paddle_tpu.nn.functional as F
+    x_nchw = rng.randn(2, 8, 6, 6).astype("float32")
+    for fmt, x in (("NCHW", x_nchw), ("NHWC", x_nchw.transpose(0, 2, 3, 1))):
+        un = F.pixel_unshuffle(T(x), 2, data_format=fmt)
+        back = F.pixel_shuffle(un, 2, data_format=fmt)
+        np.testing.assert_allclose(back.numpy(), x, rtol=1e-6)
+    # NHWC result equals transposed NCHW result up to channel grouping
+    un_c = F.pixel_unshuffle(T(x_nchw), 2, data_format="NCHW").numpy()
+    un_l = F.pixel_unshuffle(T(x_nchw.transpose(0, 2, 3, 1)), 2,
+                             data_format="NHWC").numpy()
+    assert un_l.shape == (2, 3, 3, 32) and un_c.shape == (2, 32, 3, 3)
+
+
+def test_unique_consecutive_axis():
+    """Slice-wise runs along an axis (reference unique_consecutive axis)."""
+    x = np.array([[1, 1, 2, 2, 2, 3],
+                  [1, 1, 2, 2, 2, 3]], "int64")
+    out, inv, cnt = paddle.unique_consecutive(
+        T(x), return_inverse=True, return_counts=True, axis=1)
+    np.testing.assert_array_equal(out.numpy(), [[1, 2, 3], [1, 2, 3]])
+    np.testing.assert_array_equal(inv.numpy(), [0, 0, 1, 1, 1, 2])
+    np.testing.assert_array_equal(cnt.numpy(), [2, 3, 1])
+    # rows
+    y = np.array([[0, 1], [0, 1], [2, 3]], "int64")
+    out0 = paddle.unique_consecutive(T(y), axis=0)
+    np.testing.assert_array_equal(out0.numpy(), [[0, 1], [2, 3]])
+
+
+def test_box_coder_axis1_decode():
+    """axis selects the prior broadcast dim (cpu/box_coder.cc:122): decode
+    with axis=1 must equal axis=0 on the transposed delta layout."""
+    from paddle_tpu.vision.ops import box_coder
+    pb = rng.rand(4, 4).astype("float32")
+    pb[:, 2:] += pb[:, :2] + 0.5  # valid boxes
+    deltas = rng.randn(3, 4, 4).astype("float32") * 0.1
+    var = [0.1, 0.1, 0.2, 0.2]
+    out0 = box_coder(T(pb), var, T(deltas),
+                     code_type="decode_center_size", axis=0).numpy()
+    out1 = box_coder(T(pb), var, T(deltas.transpose(1, 0, 2)),
+                     code_type="decode_center_size", axis=1).numpy()
+    np.testing.assert_allclose(out0, out1.transpose(1, 0, 2), rtol=1e-5)
+
+
+def test_class_center_sample():
+    """PartialFC sampler (reference nn/functional/common.py:1850): all
+    positives kept, negatives fill to num_samples, remap = index into the
+    sorted sampled set."""
+    import paddle_tpu.nn.functional as F
+    label = np.array([11, 5, 1, 3, 12, 2, 15, 19, 18, 19], "int64")
+    remapped, sampled = F.class_center_sample(T(label), 20, 6)
+    s = sampled.numpy()
+    # more positives than num_samples: every positive kept, sorted
+    np.testing.assert_array_equal(s, np.unique(label))
+    np.testing.assert_array_equal(remapped.numpy(),
+                                  np.searchsorted(s, label))
+    # fewer positives: negatives fill up to num_samples
+    label2 = np.array([3, 3, 7], "int64")
+    remapped2, sampled2 = F.class_center_sample(T(label2), 20, 6)
+    s2 = sampled2.numpy()
+    assert len(s2) == 6 and set([3, 7]) <= set(s2.tolist())
+    assert (np.diff(s2) > 0).all()  # sorted unique
+    np.testing.assert_array_equal(remapped2.numpy(),
+                                  np.searchsorted(s2, label2))
+    with pytest.raises(ValueError):
+        F.class_center_sample(T(np.array([25], "int64")), 20, 6)
