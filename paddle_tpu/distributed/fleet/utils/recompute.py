@@ -19,6 +19,22 @@ from ....nn.functional_call import functional_call
 from ....nn.layer_base import Layer
 
 
+def _call_direct_if_traced(ckpt, flat_args):
+    """Under an outer trace (make_train_step's value_and_grad) the
+    checkpointed fn must be called DIRECTLY: routing it through apply_op's
+    per-op jax.vjp pre-linearizes the forward, so the outer autodiff
+    differentiates the already-expanded graph and the remat boundary is
+    lost — measured on the 6.7B AOT plan as ~1.9 GiB/layer of retained
+    activations (docs/PERF.md).  Returns None when not traced."""
+    vals = [t._value if isinstance(t, Tensor) else t for t in flat_args]
+    if not any(isinstance(v, jax.core.Tracer) for v in vals):
+        return None
+    out = ckpt(*vals)
+    return jax.tree_util.tree_map(
+        lambda v: Tensor(v, _internal=True)
+        if isinstance(v, jax.Array) else v, out)
+
+
 def recompute(function, *args, preserve_rng_state=True, use_reentrant=True,
               **kwargs):
     """fleet/utils/recompute.py:350 parity."""
@@ -47,19 +63,9 @@ def recompute(function, *args, preserve_rng_state=True, use_reentrant=True,
                 return raw(*vals)
 
         ckpt = jax.checkpoint(with_rng)
-        vals = [t._value if isinstance(t, Tensor) else t
-                for t in (*tensors, *args)]
-        if any(isinstance(v, jax.core.Tracer) for v in vals):
-            # under an outer trace (make_train_step's value_and_grad) call
-            # the checkpointed fn DIRECTLY: routing it through apply_op's
-            # per-op jax.vjp pre-linearizes the forward, so the outer
-            # autodiff differentiates the already-expanded graph and the
-            # remat boundary is lost — measured on the 6.7B AOT plan as
-            # ~1.9 GiB/layer of retained activations (docs/PERF.md)
-            out = ckpt(*vals)
-            return jax.tree_util.tree_map(
-                lambda v: Tensor(v, _internal=True)
-                if isinstance(v, jax.Array) else v, out)
+        direct = _call_direct_if_traced(ckpt, (*tensors, *args))
+        if direct is not None:
+            return direct
         return apply_op(ckpt, "recompute", (*tensors, *args), {})
 
     # plain callable: differentiate w.r.t. tensor args only
@@ -73,13 +79,9 @@ def recompute(function, *args, preserve_rng_state=True, use_reentrant=True,
             is_leaf=lambda x: isinstance(x, Tensor))
 
     ckpt = jax.checkpoint(raw_fn)
-    vals = [t._value if isinstance(t, Tensor) else t for t in args]
-    if any(isinstance(v, jax.core.Tracer) for v in vals):
-        # same remat-boundary preservation as the Layer branch above
-        out = ckpt(*vals)
-        return jax.tree_util.tree_map(
-            lambda v: Tensor(v, _internal=True)
-            if isinstance(v, jax.Array) else v, out)
+    direct = _call_direct_if_traced(ckpt, args)
+    if direct is not None:
+        return direct
     return apply_op(ckpt, "recompute", args, {})
 
 

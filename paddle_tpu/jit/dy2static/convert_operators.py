@@ -351,13 +351,10 @@ def convert_cast(x, kind: str):
     everything else takes the plain Python builtin."""
     from ...core.tensor import Tensor
 
-    if isinstance(x, Tensor):
-        if _is_tracer(x._value):
-            target = {"int": "int64", "float": "float32",
-                      "bool": "bool"}[kind]
-            return x.astype(target)
-        # eager concrete tensor: match Python semantics exactly (0-d only)
-        return {"int": int, "float": float, "bool": bool}[kind](x)
+    if isinstance(x, Tensor) and _is_tracer(x._value):
+        target = {"int": "int64", "float": "float32", "bool": "bool"}[kind]
+        return x.astype(target)
+    # concrete tensor or plain Python value: exact builtin semantics
     return {"int": int, "float": float, "bool": bool}[kind](x)
 
 
@@ -372,11 +369,14 @@ def convert_print(*args, **kwargs):
     if any(_is_tracer(v) for v in vals):
         import jax
 
-        sep = kwargs.get("sep", " ")
-        end = kwargs.get("end", "\n")
-        # file/flush cannot be honored inside a compiled graph: the print
-        # happens device-side at RUN time via the debug-callback channel
-        fmt = sep.join("{}" for _ in vals) + (end if end != "\n" else "")
+        sep = kwargs.get("sep") or " "   # sep=None means the default
+        end = kwargs.get("end")
+        # file/flush cannot be honored inside a compiled graph, and the
+        # debug-callback channel is line-based (a newline always follows);
+        # a non-default `end` is emitted before it so no content is lost
+        fmt = sep.join("{}" for _ in vals)
+        if end is not None and end != "\n":
+            fmt += end
         jax.debug.print(fmt, *vals)
         return
     print(*args, **kwargs)

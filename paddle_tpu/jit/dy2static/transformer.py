@@ -446,6 +446,13 @@ class Dy2StaticTransformer(ast.NodeTransformer):
             else_names, e_blocked = _stores(node.orelse[:-1])
             if not b_blocked and not e_blocked and \
                     not ((body_names | else_names) & self._declared()):
+                # stored names must be THREADED as helper args (like the
+                # regular path): a branch assigning a name also bound
+                # before the `if` would otherwise shadow it as an unbound
+                # helper-local (reads of un-stored outer names still work
+                # through the closure)
+                names = sorted(n for n in (body_names | else_names)
+                               if not _is_helper_fn(n))
                 uid = self._uid()
                 tn, fn_ = f"__dy2st_true_{uid}", f"__dy2st_false_{uid}"
                 test = _PredicateTransformer().visit(node.test)
@@ -459,17 +466,17 @@ class Dy2StaticTransformer(ast.NodeTransformer):
                                                    ctx=ast.Load()))]
 
                 true_fn = ast.FunctionDef(
-                    name=tn, args=_fn_args([]),
+                    name=tn, args=_fn_args(names),
                     body=_ret_branch(node.body), decorator_list=[],
                     returns=None)
                 false_fn = ast.FunctionDef(
-                    name=fn_, args=_fn_args([]),
+                    name=fn_, args=_fn_args(names),
                     body=_ret_branch(node.orelse), decorator_list=[],
                     returns=None)
                 tmp = f"__dy2st_ret_{uid}"
                 call = _jst_call("convert_ifelse", [
                     test, _name_load(tn), _name_load(fn_),
-                    ast.Tuple(elts=[], ctx=ast.Load())])
+                    _ld_tuple(names)])
                 return [true_fn, false_fn, _assign_tuple([tmp], call),
                         ast.Return(value=_name_load(tmp))]
         if _has_jump(node.body) or _has_jump(node.orelse):

@@ -67,6 +67,15 @@ class GPTConfig:
     initializer_range: float = 0.02
     layer_norm_epsilon: float = 1e-5
     use_recompute: bool = False
+    # scan-over-layers: run the (uniform) decoder stack as ONE lax.scan
+    # over stacked per-layer params.  TPU-native big-model form: compile
+    # time stops scaling with depth (the body compiles once) and, with
+    # use_recompute, the scan's sequential backward ENFORCES one-layer-at-
+    # a-time rematerialization — the unrolled form leaves the scheduler
+    # free to float recomputed forwards early (measured ~1.9 GiB/layer
+    # retained on the 6.7B AOT plan, docs/PERF.md).  No reference analog
+    # (its static graphs unroll).
+    scan_layers: bool = False
     fuse_qkv: bool = True
     activation: str = "gelu"
     # MoE (GPT-MoE / GShard-style FFN replacement): 0 = dense FFN
@@ -384,21 +393,65 @@ class GPTModel(Layer):
         x = self.embeddings(input_ids, position_ids)
         x = _constrain(x, _activation_spec())
         new_caches = [] if use_cache else None
-        for i, layer in enumerate(self.layers):
-            if use_cache:
-                x, c = layer(x, cache=caches[i], use_cache=True)
-                new_caches.append(c)
-            elif self.config.use_recompute and self.training and \
-                    not isinstance(layer.mlp, GPTMoEMLP):
-                # MoE layers run outside remat: the recorded gate aux loss
-                # would otherwise leak a jax.checkpoint tracer
-                x = recompute(layer, x)
-            else:
-                x = layer(x)
+        if self.config.scan_layers and not use_cache and \
+                self.config.moe_num_experts == 0:
+            x = self._scan_layers(x)
+        else:
+            for i, layer in enumerate(self.layers):
+                if use_cache:
+                    x, c = layer(x, cache=caches[i], use_cache=True)
+                    new_caches.append(c)
+                elif self.config.use_recompute and self.training and \
+                        not isinstance(layer.mlp, GPTMoEMLP):
+                    # MoE layers run outside remat: the recorded gate aux
+                    # loss would otherwise leak a jax.checkpoint tracer
+                    x = recompute(layer, x)
+                else:
+                    x = layer(x)
         x = self.final_norm(x)
         if use_cache:
             return x, new_caches
         return x
+
+    def _scan_layers(self, x):
+        """Uniform decoder stack as ONE lax.scan over stacked per-layer
+        params; body optionally under jax.checkpoint (see
+        GPTConfig.scan_layers).  Parameters stay per-layer objects (state
+        dict / checkpoint layout unchanged); the stack happens at trace
+        time and autodiff routes layer grads back through it."""
+        from ..core import random as random_mod
+        from ..nn.functional_call import functional_call
+
+        template = self.layers[0]
+        names = list(template.state_dict().keys())
+        param_names = {k for k, _ in template.named_parameters()}
+        stacked, static_vals = {}, {}
+        for k in names:
+            per = [layer.state_dict()[k]._value for layer in self.layers]
+            if k in param_names:
+                stacked[k] = jnp.stack(per)
+            else:
+                # non-param buffers (layout markers) are identical across
+                # layers; bind layer 0's
+                static_vals[k] = per[0]
+        base_key = random_mod.next_key()
+        xs = (jnp.arange(len(self.layers)), stacked)
+
+        def body(h, sl):
+            idx, vals = sl
+            values = dict(static_vals)
+            values.update(vals)
+            # per-layer RNG stream (dropout masks must differ by depth)
+            with random_mod.push_key(jax.random.fold_in(base_key, idx)):
+                out, _ = functional_call(template, values,
+                                         (Tensor(h, _internal=True),))
+            return (out._value if isinstance(out, Tensor) else out), None
+
+        if self.config.use_recompute and self.training:
+            body = jax.checkpoint(body)
+        h0 = x._value if isinstance(x, Tensor) else x
+        h, _ = jax.lax.scan(body, h0, xs)
+        return Tensor(h, _internal=True)
 
     def moe_aux_loss(self):
         """Sum of gate balance losses from the last forward (None when the

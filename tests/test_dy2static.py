@@ -696,3 +696,34 @@ def test_early_return_inside_loop_body():
 
     out = _run_both(fn, np.array([1.0], "float32"))
     np.testing.assert_allclose(out, [4.0], rtol=1e-6)
+
+
+def test_both_branches_return_threads_outer_local():
+    """A branch that reassigns a name bound BEFORE the if must thread it
+    through the cond helpers (review regression: unbound helper-local)."""
+    def fn(x):
+        y = x * 2.0
+        if x.sum() > 0:
+            y = y + 1.0
+            return y
+        return y - 1.0
+
+    out = _run_both(fn, np.array([1.0], "float32"))
+    np.testing.assert_allclose(out, [3.0], rtol=1e-6)
+    out = _run_both(fn, np.array([-1.0], "float32"))
+    np.testing.assert_allclose(out, [-3.0], rtol=1e-6)
+
+
+def test_print_sep_none_and_end(capsys):
+    def fn(x):
+        if x.sum() > 0:
+            y = x * 2.0
+        else:
+            y = x
+        print("a", "b", sep=None)
+        print("c", end="")
+        return y
+
+    _run_both(fn, np.array([1.0], "float32"))
+    out = capsys.readouterr().out
+    assert "a b" in out and "c" in out

