@@ -25,12 +25,13 @@ def _call_direct_if_traced(ckpt, flat_args):
     per-op jax.vjp pre-linearizes the forward, so the outer autodiff
     differentiates the already-expanded graph and the remat boundary is
     lost — measured on the 6.7B AOT plan as ~1.9 GiB/layer of retained
-    activations (docs/PERF.md).  Returns None when not traced."""
+    activations (docs/PERF.md).  Returns (handled, out) — a plain None
+    result is a legitimate checkpointed output, not a sentinel."""
     vals = [t._value if isinstance(t, Tensor) else t for t in flat_args]
     if not any(isinstance(v, jax.core.Tracer) for v in vals):
-        return None
+        return False, None
     out = ckpt(*vals)
-    return jax.tree_util.tree_map(
+    return True, jax.tree_util.tree_map(
         lambda v: Tensor(v, _internal=True)
         if isinstance(v, jax.Array) else v, out)
 
@@ -63,8 +64,8 @@ def recompute(function, *args, preserve_rng_state=True, use_reentrant=True,
                 return raw(*vals)
 
         ckpt = jax.checkpoint(with_rng)
-        direct = _call_direct_if_traced(ckpt, (*tensors, *args))
-        if direct is not None:
+        handled, direct = _call_direct_if_traced(ckpt, (*tensors, *args))
+        if handled:
             return direct
         return apply_op(ckpt, "recompute", (*tensors, *args), {})
 
@@ -79,8 +80,8 @@ def recompute(function, *args, preserve_rng_state=True, use_reentrant=True,
             is_leaf=lambda x: isinstance(x, Tensor))
 
     ckpt = jax.checkpoint(raw_fn)
-    direct = _call_direct_if_traced(ckpt, args)
-    if direct is not None:
+    handled, direct = _call_direct_if_traced(ckpt, args)
+    if handled:
         return direct
     return apply_op(ckpt, "recompute", args, {})
 

@@ -14,14 +14,16 @@ reference's shard-by-id table partition) and train sparse embeddings with
 servers.  Async and geo-SGD update modes mirror DistributedStrategy
 a_sync/a_sync_configs (SURVEY Appendix A).
 """
-from .table import (DenseTable, SparseTable, SparseAdaGradRule,
+from .table import (DenseTable, SparseTable, SSDSparseTable,
+                    SparseAdaGradRule,
                     SparseAdamRule, SparseNaiveSGDRule, sgd_rule)
 from .service import PsClient, PsServer
 from .the_one_ps import TheOnePS
 from .sparse_embedding import SparseEmbedding
+from .coordinator import CoordinatorClient, CoordinatorServer
 
 __all__ = [
-    "DenseTable", "SparseTable", "SparseNaiveSGDRule", "SparseAdaGradRule",
+    "DenseTable", "SparseTable", "SSDSparseTable", "SparseNaiveSGDRule", "SparseAdaGradRule",
     "SparseAdamRule", "sgd_rule", "PsServer", "PsClient", "TheOnePS",
-    "SparseEmbedding",
+    "SparseEmbedding", "CoordinatorServer", "CoordinatorClient",
 ]

@@ -1,0 +1,199 @@
+"""FL coordinator (reference:
+paddle/fluid/distributed/ps/service/coordinator_client.cc —
+CoordinatorService collecting per-client reports, the trainer-side
+CoordinatorClient pushing info and waiting for its FL strategy).
+
+One round: every FL client pushes its report (possibly empty = heartbeat),
+the coordinator blocks in `query_clients_info()` until all
+`n_clients` reported, computes per-client strategies (the user's federated
+logic — FedAvg weights, local-epoch counts, participation flags), and
+`save_fl_strategy()` releases the clients blocked in
+`pull_fl_strategy()`.  Transport rides the same length-prefixed-pickle TCP
+plane as the PS services.
+"""
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Dict, Optional
+
+from .service import _recv_msg, _send_msg
+
+__all__ = ["CoordinatorServer", "CoordinatorClient"]
+
+
+class CoordinatorServer:
+    """coordinator_client.h CoordinatorServiceHandle analog."""
+
+    def __init__(self, n_clients: int, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.n_clients = int(n_clients)
+        self._info: Dict[int, object] = {}
+        self._reported: set[int] = set()
+        self._strategies: Dict[int, object] = {}
+        self._strategy_ready = False
+        self._cv = threading.Condition()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        self.host, self.port = self._sock.getsockname()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._active_conns: set = set()
+
+    @property
+    def endpoint(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def run(self) -> None:
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        daemon=True)
+        self._thread.start()
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn: socket.socket) -> None:
+        self._active_conns.add(conn)
+        try:
+            while True:
+                req = _recv_msg(conn)
+                if req is None:
+                    return
+                try:
+                    out = self._dispatch(req)
+                    _send_msg(conn, {"ok": True, "out": out})
+                except Exception as e:
+                    _send_msg(conn, {"ok": False, "err": repr(e)})
+        except OSError:
+            return
+        finally:
+            self._active_conns.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _dispatch(self, req: dict):
+        op = req["op"]
+        if op == "push_fl_client_info":
+            cid = int(req["client_id"])
+            with self._cv:
+                # empty info = heartbeat, still counts toward the round
+                # (coordinator_client.h SaveFLClientInfo)
+                if req.get("info") is not None:
+                    self._info[cid] = req["info"]
+                self._reported.add(cid)
+                if len(self._reported) >= self.n_clients:
+                    self._cv.notify_all()
+            return None
+        if op == "pull_fl_strategy":
+            cid = int(req["client_id"])
+            with self._cv:
+                self._cv.wait_for(
+                    lambda: self._strategy_ready, timeout=req.get(
+                        "timeout", 120))
+                if not self._strategy_ready:
+                    raise TimeoutError("FL strategy not ready")
+                return self._strategies.get(cid)
+        if op == "stop":
+            self.shutdown()
+            return None
+        raise ValueError(f"unknown coordinator op {op!r}")
+
+    # -- coordinator-side API -------------------------------------------------
+    def query_clients_info(self, timeout: float = 120) -> Dict[int, object]:
+        """Block until every client of the round reported; returns the
+        client-id -> info map (QueryFLClientsInfo)."""
+        with self._cv:
+            ok = self._cv.wait_for(
+                lambda: len(self._reported) >= self.n_clients, timeout)
+            if not ok:
+                raise TimeoutError(
+                    f"only {len(self._reported)}/{self.n_clients} FL "
+                    f"clients reported")
+            return dict(self._info)
+
+    def save_fl_strategy(self, strategies: Dict[int, object]) -> None:
+        """Release clients blocked in pull_fl_strategy (SaveFLStrategy +
+        the ready flag)."""
+        with self._cv:
+            self._strategies = dict(strategies)
+            self._strategy_ready = True
+            self._cv.notify_all()
+
+    def reset_round(self) -> None:
+        with self._cv:
+            self._reported.clear()
+            self._info.clear()
+            self._strategy_ready = False
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        try:
+            # wake the blocked accept() so the listener fd really closes
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        # fail blocked pull_fl_strategy clients fast instead of letting
+        # them sit out their socket timeout
+        with self._cv:
+            self._cv.notify_all()
+        for conn in list(self._active_conns):
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+class CoordinatorClient:
+    """Trainer-side handle (CoordinatorClient::PushFLClientInfoSync /
+    PullFlStrategy)."""
+
+    def __init__(self, endpoint: str, client_id: int):
+        self.endpoint = endpoint
+        self.client_id = int(client_id)
+        self._conn: Optional[socket.socket] = None
+        self._mu = threading.Lock()
+
+    def _call(self, req: dict):
+        # the socket deadline tracks (and exceeds) the request's own
+        # timeout so a long strategy wait isn't cut off by the transport
+        deadline = float(req.get("timeout", 120)) + 30
+        with self._mu:
+            if self._conn is None:
+                host, port = self.endpoint.rsplit(":", 1)
+                self._conn = socket.create_connection((host, int(port)),
+                                                      timeout=deadline)
+            self._conn.settimeout(deadline)
+            _send_msg(self._conn, req)
+            resp = _recv_msg(self._conn)
+        if resp is None:
+            raise ConnectionError("coordinator closed")
+        if not resp.get("ok"):
+            raise RuntimeError(f"coordinator error: {resp.get('err')}")
+        return resp.get("out")
+
+    def push_fl_client_info(self, info=None) -> None:
+        self._call({"op": "push_fl_client_info",
+                    "client_id": self.client_id, "info": info})
+
+    def pull_fl_strategy(self, timeout: float = 120):
+        return self._call({"op": "pull_fl_strategy",
+                           "client_id": self.client_id,
+                           "timeout": timeout})

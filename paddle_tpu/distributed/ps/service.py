@@ -43,6 +43,7 @@ class PsServer:
         self._barrier_count = 0
         self._barrier_gen = 0
         self._barrier_cv = threading.Condition()
+        self._active_conns: set = set()
 
     @property
     def endpoint(self) -> str:
@@ -50,8 +51,15 @@ class PsServer:
 
     # -- table management -----------------------------------------------------
     def add_sparse_table(self, name: str, dim: int, rule: str = "adagrad",
-                         **kw) -> None:
-        self.sparse_tables[name] = SparseTable(
+                         storage: str = "memory", **kw) -> None:
+        """storage='ssd' selects the two-tier disk-backed table
+        (ssd_sparse_table.cc analog) — capacity bounded by disk, not RAM."""
+        if storage == "ssd":
+            from .table import SSDSparseTable
+            cls = SSDSparseTable
+        else:
+            cls = SparseTable
+        self.sparse_tables[name] = cls(
             name, dim, rule, seed=self.server_idx * 7919 + 1, **kw)
 
     def add_dense_table(self, name: str, shape, lr: float = 0.01) -> None:
@@ -77,6 +85,7 @@ class PsServer:
                              daemon=True).start()
 
     def _serve_conn(self, conn: socket.socket) -> None:
+        self._active_conns.add(conn)
         try:
             while True:
                 req = _recv_msg(conn)
@@ -107,6 +116,7 @@ class PsServer:
         except OSError:
             return
         finally:
+            self._active_conns.discard(conn)
             try:
                 conn.close()
             except OSError:
@@ -193,9 +203,27 @@ class PsServer:
     def shutdown(self) -> None:
         self._stop.set()
         try:
+            # wake the thread blocked in accept(): a plain close() leaves
+            # the kernel socket LISTENing (and the port bound) until the
+            # in-flight accept syscall returns
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
             self._sock.close()
         except OSError:
             pass
+        # close live connections so serving threads exit and release the
+        # port — a restarted shard must be able to rebind immediately
+        for conn in list(getattr(self, "_active_conns", ())):
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
 
 
 class PsClient:
@@ -220,17 +248,48 @@ class PsClient:
             self._conns[idx] = conn
         return self._conns[idx]
 
+    #: reconnect-with-backoff policy (brpc_ps_client.cc keeps channels
+    #: alive across server restarts; FLAGS_pserver_connect_timeout_ms-class
+    #: knobs).  A worker must survive a PS shard bouncing.  Retries give
+    #: AT-LEAST-ONCE delivery: a push whose reply was lost may re-apply on
+    #: the restarted shard — the same contract as the reference's brpc
+    #: retry path (async grad application tolerates duplicates).
+    max_retries = 4
+    retry_backoff = 0.5
+
     def _call(self, idx: int, req: dict):
-        with self._mu[idx]:
-            conn = self._conn(idx)
-            _send_msg(conn, req)
-            resp = _recv_msg(conn)
-        if resp is None:
-            raise ConnectionError(f"PS server {self.endpoints[idx]} closed")
-        if not resp.get("ok"):
-            raise RuntimeError(f"PS error from {self.endpoints[idx]}: "
-                               f"{resp.get('err')}")
-        return resp.get("out")
+        import time as _time
+
+        last_err: Exception | None = None
+        for attempt in range(self.max_retries + 1):
+            try:
+                with self._mu[idx]:
+                    conn = self._conn(idx)
+                    _send_msg(conn, req)
+                    resp = _recv_msg(conn)
+                if resp is None:
+                    raise ConnectionError(
+                        f"PS server {self.endpoints[idx]} closed")
+                if not resp.get("ok"):
+                    # table-level errors are NOT transport faults: no retry
+                    raise RuntimeError(
+                        f"PS error from {self.endpoints[idx]}: "
+                        f"{resp.get('err')}")
+                return resp.get("out")
+            except (ConnectionError, OSError) as e:
+                last_err = e
+                with self._mu[idx]:
+                    try:
+                        if self._conns[idx] is not None:
+                            self._conns[idx].close()
+                    except OSError:
+                        pass
+                    self._conns[idx] = None
+                if attempt < self.max_retries:
+                    _time.sleep(self.retry_backoff * (attempt + 1))
+        raise ConnectionError(
+            f"PS server {self.endpoints[idx]} unreachable after "
+            f"{self.max_retries + 1} attempts") from last_err
 
     # -- sparse ---------------------------------------------------------------
     def _shard_ids(self, ids: np.ndarray):

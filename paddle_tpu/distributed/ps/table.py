@@ -221,3 +221,110 @@ class DenseTable:
         val = np.load(path if path.endswith(".npy") else path + ".npy")
         with self._mu:
             self.value = val.astype(np.float32)
+
+
+class SSDSparseTable(SparseTable):
+    """Two-tier sparse table: hot rows in memory, cold rows on local disk
+    (reference ssd_sparse_table.cc: MemorySparseTable + RocksDB cold tier,
+    UpdateTable() migrating rows by access recency).
+
+    The cold tier is sqlite3 (stdlib; the same LSM-on-SSD role RocksDB
+    plays for the reference) keyed by feature id.  Capacity is bounded by
+    DISK, not RAM: `max_memory_rows` caps the hot dict and
+    `update_table()` evicts least-recently-used rows to the cold store.
+    Eviction also runs inline when a push overflows the hot tier.
+    """
+
+    def __init__(self, name: str, dim: int, rule: str = "adagrad",
+                 seed: int = 0, path: Optional[str] = None,
+                 max_memory_rows: int = 100_000, **rule_kw):
+        super().__init__(name, dim, rule, seed, **rule_kw)
+        import sqlite3
+        import tempfile
+
+        self.max_memory_rows = int(max_memory_rows)
+        if path is None:
+            # per-INSTANCE default: multiple shards of one table in one
+            # process must never share a cold store (a shared file would
+            # cross-wipe on load() and resurrect stale rows on recreate)
+            import uuid
+            path = os.path.join(
+                tempfile.gettempdir(),
+                f"pt_ssd_{name}_{os.getpid()}_{uuid.uuid4().hex[:8]}.db")
+        self._path = path
+        self._db = sqlite3.connect(self._path, check_same_thread=False)
+        self._db.execute(
+            "CREATE TABLE IF NOT EXISTS rows (fid INTEGER PRIMARY KEY, "
+            "val BLOB)")
+        self._lru: Dict[int, int] = {}   # fid -> access tick
+        self._tick = 0
+
+    def _touch(self, fid: int):
+        self._tick += 1
+        self._lru[fid] = self._tick
+
+    def _row(self, fid: int) -> np.ndarray:
+        row = self._rows.get(fid)
+        if row is None:
+            cur = self._db.execute(
+                "SELECT val FROM rows WHERE fid=?", (int(fid),)).fetchone()
+            if cur is not None:
+                row = np.frombuffer(cur[0], np.float32).copy()
+                self._db.execute("DELETE FROM rows WHERE fid=?",
+                                 (int(fid),))
+            else:
+                rng = np.random.RandomState(
+                    (self.seed * 0x9E3779B1 + fid) & 0x7FFFFFFF)
+                row = self.rule.init_row(rng)
+            self._rows[fid] = row
+        self._touch(fid)
+        if len(self._rows) > self.max_memory_rows:
+            self.update_table()
+        return row
+
+    def update_table(self) -> int:
+        """Evict LRU rows until the hot tier is at half capacity
+        (ssd_sparse_table.cc UpdateTable's migrate-by-recency contract).
+        Caller must hold self._mu."""
+        target = max(1, self.max_memory_rows // 2)
+        if len(self._rows) <= target:
+            return 0
+        order = sorted(self._rows, key=lambda f: self._lru.get(f, 0))
+        n_evict = len(self._rows) - target
+        for fid in order[:n_evict]:
+            row = self._rows.pop(fid)
+            self._db.execute(
+                "INSERT OR REPLACE INTO rows (fid, val) VALUES (?, ?)",
+                (int(fid), row.astype(np.float32).tobytes()))
+            self._lru.pop(fid, None)
+        self._db.commit()
+        return n_evict
+
+    def __len__(self):
+        n_cold = self._db.execute("SELECT COUNT(*) FROM rows").fetchone()[0]
+        return len(self._rows) + n_cold
+
+    def save(self, path: str) -> None:
+        with self._mu:
+            self.update_table() if len(self._rows) else None
+            cold = {int(fid): np.frombuffer(blob, np.float32).copy()
+                    for fid, blob in
+                    self._db.execute("SELECT fid, val FROM rows")}
+            cold.update(self._rows)
+            with open(path, "wb") as f:
+                pickle.dump({"dim": self.dim, "rule": self.rule.name,
+                             "rows": cold}, f)
+
+    def load(self, path: str) -> None:
+        with open(path, "rb") as f:
+            blob = pickle.load(f)
+        if blob["dim"] != self.dim:
+            raise ValueError(f"table {self.name}: dim mismatch "
+                             f"{blob['dim']} vs {self.dim}")
+        with self._mu:
+            self._rows = dict(blob["rows"])
+            self._db.execute("DELETE FROM rows")
+            self._db.commit()
+            self._lru = {}
+            if len(self._rows) > self.max_memory_rows:
+                self.update_table()

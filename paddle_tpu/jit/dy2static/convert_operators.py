@@ -369,7 +369,8 @@ def convert_print(*args, **kwargs):
     if any(_is_tracer(v) for v in vals):
         import jax
 
-        sep = kwargs.get("sep") or " "   # sep=None means the default
+        sep = kwargs.get("sep")
+        sep = " " if sep is None else sep   # sep=None means default; "" is legal
         end = kwargs.get("end")
         # file/flush cannot be honored inside a compiled graph, and the
         # debug-callback channel is line-based (a newline always follows);

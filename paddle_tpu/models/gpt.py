@@ -423,17 +423,16 @@ class GPTModel(Layer):
         from ..nn.functional_call import functional_call
 
         template = self.layers[0]
-        names = list(template.state_dict().keys())
+        sds = [layer.state_dict() for layer in self.layers]
         param_names = {k for k, _ in template.named_parameters()}
         stacked, static_vals = {}, {}
-        for k in names:
-            per = [layer.state_dict()[k]._value for layer in self.layers]
+        for k in sds[0]:
             if k in param_names:
-                stacked[k] = jnp.stack(per)
+                stacked[k] = jnp.stack([sd[k]._value for sd in sds])
             else:
                 # non-param buffers (layout markers) are identical across
                 # layers; bind layer 0's
-                static_vals[k] = per[0]
+                static_vals[k] = sds[0][k]._value
         base_key = random_mod.next_key()
         xs = (jnp.arange(len(self.layers)), stacked)
 
