@@ -5,6 +5,6 @@ from .strategy import Strategy  # noqa: F401
 from .cluster import Cluster, Device, LinkSpec, Machine  # noqa: F401
 from .cost_model import (CostModel, PlanConfig, PlanCost,  # noqa: F401
                          WorkloadSpec)
-from .planner import Planner, build_mesh  # noqa: F401
+from .planner import Planner, build_mesh, compile_and_rank  # noqa: F401
 from .completion import Completion, complete  # noqa: F401
 from .tuner import Candidate, Tuner  # noqa: F401

@@ -98,8 +98,10 @@ class CoordinatorServer:
             cid = int(req["client_id"])
             with self._cv:
                 self._cv.wait_for(
-                    lambda: self._strategy_ready, timeout=req.get(
-                        "timeout", 120))
+                    lambda: self._strategy_ready or self._stop.is_set(),
+                    timeout=req.get("timeout", 120))
+                if self._stop.is_set() and not self._strategy_ready:
+                    raise RuntimeError("coordinator shut down")
                 if not self._strategy_ready:
                     raise TimeoutError("FL strategy not ready")
                 return self._strategies.get(cid)

@@ -257,11 +257,18 @@ class PsClient:
     max_retries = 4
     retry_backoff = 0.5
 
+    #: ops that must NOT be resent on a transport fault: re-sending a
+    #: barrier would double-count this worker's arrival and release the
+    #: rendezvous early.  Pull/push are safe (idempotent / at-least-once).
+    _NON_RETRY_OPS = frozenset({"barrier"})
+
     def _call(self, idx: int, req: dict):
         import time as _time
 
+        retries = 0 if req.get("op") in self._NON_RETRY_OPS \
+            else self.max_retries
         last_err: Exception | None = None
-        for attempt in range(self.max_retries + 1):
+        for attempt in range(retries + 1):
             try:
                 with self._mu[idx]:
                     conn = self._conn(idx)
@@ -285,11 +292,11 @@ class PsClient:
                     except OSError:
                         pass
                     self._conns[idx] = None
-                if attempt < self.max_retries:
+                if attempt < retries:
                     _time.sleep(self.retry_backoff * (attempt + 1))
         raise ConnectionError(
             f"PS server {self.endpoints[idx]} unreachable after "
-            f"{self.max_retries + 1} attempts") from last_err
+            f"{retries + 1} attempts") from last_err
 
     # -- sparse ---------------------------------------------------------------
     def _shard_ids(self, ids: np.ndarray):

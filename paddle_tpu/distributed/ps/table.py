@@ -304,27 +304,40 @@ class SSDSparseTable(SparseTable):
         n_cold = self._db.execute("SELECT COUNT(*) FROM rows").fetchone()[0]
         return len(self._rows) + n_cold
 
+    def _spill_all(self) -> None:
+        """Move every hot row to the cold store (caller holds _mu)."""
+        for fid, row in self._rows.items():
+            self._db.execute(
+                "INSERT OR REPLACE INTO rows (fid, val) VALUES (?, ?)",
+                (int(fid), row.astype(np.float32).tobytes()))
+        self._rows.clear()
+        self._lru.clear()
+        self._db.commit()
+
     def save(self, path: str) -> None:
+        """O(hot-tier) RAM: spill the hot rows into the cold sqlite file
+        and copy THAT file — a table used because it exceeds RAM must not
+        be materialized as one dict to checkpoint it."""
+        import shutil
+
         with self._mu:
-            self.update_table() if len(self._rows) else None
-            cold = {int(fid): np.frombuffer(blob, np.float32).copy()
-                    for fid, blob in
-                    self._db.execute("SELECT fid, val FROM rows")}
-            cold.update(self._rows)
-            with open(path, "wb") as f:
-                pickle.dump({"dim": self.dim, "rule": self.rule.name,
-                             "rows": cold}, f)
+            self._spill_all()
+            shutil.copyfile(self._path, path)
+            with open(path + ".meta", "wb") as f:
+                pickle.dump({"dim": self.dim, "rule": self.rule.name}, f)
 
     def load(self, path: str) -> None:
-        with open(path, "rb") as f:
-            blob = pickle.load(f)
-        if blob["dim"] != self.dim:
+        import shutil
+        import sqlite3
+
+        with open(path + ".meta", "rb") as f:
+            meta = pickle.load(f)
+        if meta["dim"] != self.dim:
             raise ValueError(f"table {self.name}: dim mismatch "
-                             f"{blob['dim']} vs {self.dim}")
+                             f"{meta['dim']} vs {self.dim}")
         with self._mu:
-            self._rows = dict(blob["rows"])
-            self._db.execute("DELETE FROM rows")
-            self._db.commit()
+            self._db.close()
+            shutil.copyfile(path, self._path)
+            self._db = sqlite3.connect(self._path, check_same_thread=False)
+            self._rows = {}
             self._lru = {}
-            if len(self._rows) > self.max_memory_rows:
-                self.update_table()

@@ -30,12 +30,29 @@ def _interpret():
     return _fa._INTERPRET
 
 
-_ENABLED = False
+#: "off" | "full" (pallas fwd+bwd) | "bwd" (XLA fwd, pallas bwd).
+#: "bwd" is the hybrid: the forward stays jnp so XLA keeps fusing it into
+#: its neighbors (the reason "full" measured as a net loss), while the
+#: backward — whose XLA reduce fusions run ~60x off roofline on the GPT
+#: shapes (docs/PERF.md round-3 profile) — runs as the pallas kernel.
+_MODE = "off"
 
 
-def enable_fused_layernorm(flag: bool):
-    global _ENABLED
-    _ENABLED = bool(flag)
+def enable_fused_layernorm(flag):
+    """False/"off" disables; any other truthy non-string (incl. True) =
+    "full" (pallas fwd+bwd, the pre-mode behavior); "bwd" = hybrid (XLA
+    forward, pallas backward)."""
+    global _MODE
+    if not flag:
+        _MODE = "off"
+    elif not isinstance(flag, str):
+        _MODE = "full"
+    elif flag in ("off", "full", "bwd"):
+        _MODE = flag
+    else:
+        raise ValueError(
+            f"enable_fused_layernorm: unknown mode {flag!r} "
+            f"(expected off|full|bwd)")
 
 
 def _ln_fwd_kernel(x_ref, w_ref, b_ref, y_ref, mu_ref, rs_ref, *, eps):
@@ -184,12 +201,37 @@ def _fused_ln_bwd(eps, res, dy):
 _fused_ln.defvjp(_fused_ln_fwd, _fused_ln_bwd)
 
 
+def _jnp_ln(x2, w, b, eps):
+    xf = x2.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=1, keepdims=True)
+    d = xf - mu
+    var = jnp.mean(d * d, axis=1, keepdims=True)
+    rs = jax.lax.rsqrt(var + eps)
+    y = (d * rs * w.astype(jnp.float32) +
+         b.astype(jnp.float32)).astype(x2.dtype)
+    return y, mu, rs
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _hybrid_ln(x2, w, b, eps):
+    return _jnp_ln(x2, w, b, eps)[0]
+
+
+def _hybrid_ln_fwd(x2, w, b, eps):
+    y, mu, rs = _jnp_ln(x2, w, b, eps)
+    return y, (x2, w, mu, rs)
+
+
+_hybrid_ln.defvjp(_hybrid_ln_fwd, _fused_ln_bwd)
+
+
 def layer_norm_fused(x, weight, bias, eps):
     """Fused LN over the LAST axis; x any rank >= 2, weight/bias [C]."""
     shape = x.shape
     c = shape[-1]
     x2 = x.reshape(-1, c)
-    y = _fused_ln(x2, weight, bias, float(eps))
+    fn = _hybrid_ln if _MODE == "bwd" else _fused_ln
+    y = fn(x2, weight, bias, float(eps))
     return y.reshape(shape)
 
 
@@ -197,7 +239,7 @@ def layer_norm_fused_ok(x, axes, weight, bias) -> bool:
     """Routing predicate: opt-in (see module docstring), last-axis-only
     affine LN, lane-aligned C, on a real accelerator (or interpret mode
     for tests)."""
-    if not _ENABLED:
+    if _MODE == "off":
         return False
     if weight is None or bias is None or len(axes) != 1:
         return False

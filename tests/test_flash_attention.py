@@ -124,9 +124,11 @@ def test_flash_spmd_divisibility_fallback(monkeypatch):
                                rtol=1e-4, atol=1e-5)
 
 
-def test_fused_layernorm_matches_reference():
+@pytest.mark.parametrize("mode", ["full", "bwd"])
+def test_fused_layernorm_matches_reference(mode):
     """Pallas fused LN (opt-in, kernels/layer_norm.py) matches the jnp LN
-    in forward and all three grads, including the row-padding path."""
+    in forward and all three grads, including the row-padding path —
+    both the full pallas form and the hybrid (XLA fwd, pallas bwd)."""
     import jax
     import jax.numpy as jnp
     from paddle_tpu.kernels.layer_norm import (enable_fused_layernorm,
@@ -144,7 +146,7 @@ def test_fused_layernorm_matches_reference():
         return (x - m) * jax.lax.rsqrt(v + 1e-5) * w + b
 
     assert not layer_norm_fused_ok(x, (x.ndim - 1,), w, b)  # off by default
-    enable_fused_layernorm(True)
+    enable_fused_layernorm(mode)
     try:
         assert layer_norm_fused_ok(x, (x.ndim - 1,), w, b)
         np.testing.assert_allclose(np.asarray(layer_norm_fused(x, w, b, 1e-5)),
