@@ -129,8 +129,6 @@ def compile_and_rank(model_factory, batch_structs, plans=None,
         for plan in plans:
             metrics: dict = {"plan": plan}
             try:
-                import jax
-
                 if plan.sharding_stage > 0:
                     dims = [1, plan.dp, plan.mp]
                 else:
@@ -141,13 +139,13 @@ def compile_and_rank(model_factory, batch_structs, plans=None,
                 with abstract_init():
                     model, opt, loss_fn, num_labels = model_factory(
                         mesh, plan)
+                # pass the stage straight through: ShardedTrainStep derives
+                # fsdp_axis itself for stage >= 3 (and drops min_fsdp_size
+                # to 0 so small params shard exactly as a real run would)
                 step = make_train_step(
                     model, opt, loss_fn=loss_fn, mesh=mesh,
                     num_labels=num_labels,
-                    fsdp_axis="sharding" if plan.sharding_stage >= 3
-                    else None,
-                    sharding_stage=plan.sharding_stage
-                    if plan.sharding_stage in (1, 2) else 0,
+                    sharding_stage=plan.sharding_stage,
                     abstract=True)
                 compiled = step.aot_compile(*batch_structs)
                 mem = compiled.memory_analysis()

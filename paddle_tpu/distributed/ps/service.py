@@ -265,14 +265,16 @@ class PsClient:
     def _call(self, idx: int, req: dict):
         import time as _time
 
-        retries = 0 if req.get("op") in self._NON_RETRY_OPS \
-            else self.max_retries
+        non_retry = req.get("op") in self._NON_RETRY_OPS
+        retries = self.max_retries
         last_err: Exception | None = None
         for attempt in range(retries + 1):
+            sent = False
             try:
                 with self._mu[idx]:
                     conn = self._conn(idx)
                     _send_msg(conn, req)
+                    sent = True
                     resp = _recv_msg(conn)
                 if resp is None:
                     raise ConnectionError(
@@ -292,6 +294,14 @@ class PsClient:
                     except OSError:
                         pass
                     self._conns[idx] = None
+                if non_retry and sent:
+                    # the request may already have been APPLIED (e.g. a
+                    # barrier arrival counted) — resending would double it;
+                    # pre-send faults (connect refused) are always safe
+                    raise ConnectionError(
+                        f"PS server {self.endpoints[idx]} failed after "
+                        f"a non-retryable {req.get('op')!r} was sent"
+                    ) from e
                 if attempt < retries:
                     _time.sleep(self.retry_backoff * (attempt + 1))
         raise ConnectionError(

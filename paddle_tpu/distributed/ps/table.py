@@ -315,14 +315,25 @@ class SSDSparseTable(SparseTable):
         self._db.commit()
 
     def save(self, path: str) -> None:
-        """O(hot-tier) RAM: spill the hot rows into the cold sqlite file
-        and copy THAT file — a table used because it exceeds RAM must not
-        be materialized as one dict to checkpoint it."""
-        import shutil
+        """O(hot-tier) RAM and no cache cliff: back up the live cold db
+        (sqlite's online backup API) and merge the hot rows into the COPY
+        — the in-memory tier stays warm, and a table used because it
+        exceeds RAM is never materialized as one dict."""
+        import sqlite3
 
         with self._mu:
-            self._spill_all()
-            shutil.copyfile(self._path, path)
+            self._db.commit()
+            dst = sqlite3.connect(path)
+            try:
+                self._db.backup(dst)
+                for fid, row in self._rows.items():
+                    dst.execute(
+                        "INSERT OR REPLACE INTO rows (fid, val) "
+                        "VALUES (?, ?)",
+                        (int(fid), row.astype(np.float32).tobytes()))
+                dst.commit()
+            finally:
+                dst.close()
             with open(path + ".meta", "wb") as f:
                 pickle.dump({"dim": self.dim, "rule": self.rule.name}, f)
 
@@ -335,9 +346,18 @@ class SSDSparseTable(SparseTable):
         if meta["dim"] != self.dim:
             raise ValueError(f"table {self.name}: dim mismatch "
                              f"{meta['dim']} vs {self.dim}")
+        # stage the incoming file BEFORE touching the live connection so a
+        # truncated/unreadable checkpoint leaves the table usable
+        tmp = self._path + ".loading"
+        shutil.copyfile(path, tmp)
+        check = sqlite3.connect(tmp)
+        try:
+            check.execute("SELECT COUNT(*) FROM rows").fetchone()
+        finally:
+            check.close()
         with self._mu:
             self._db.close()
-            shutil.copyfile(path, self._path)
+            os.replace(tmp, self._path)
             self._db = sqlite3.connect(self._path, check_same_thread=False)
             self._rows = {}
             self._lru = {}

@@ -177,7 +177,9 @@ class BertEmbeddings(Layer):
             position_ids = arange(0, t, dtype="int64").reshape([1, t])
         if token_type_ids is None:
             token_type_ids = zeros_like(input_ids)
-        if position_ids.shape[0] == 1 and input_ids.shape[0] != 1:
+        from ..distributed import mesh as _mesh_mod
+        if position_ids.shape[0] == 1 and input_ids.shape[0] != 1 and \
+                _mesh_mod.get_global_mesh() is not None:
             # expand the [1, T] position row to the full batch BEFORE the
             # lookup: a [1, T, H] broadcast operand picks up a degenerate
             # batch sharding from GSPMD propagation (its size-1 dim split

@@ -38,6 +38,10 @@ def _isolate_global_state():
     st = amp_state()
     st.enabled, st.dtype, st.level = False, jnp.bfloat16, "O1"
     st.custom_white, st.custom_black = set(), set()
+    # framework invariants a test may have toggled
+    if not jax.config.read("jax_enable_x64"):
+        jax.config.update("jax_enable_x64", True)
+    jax.config.update("jax_default_matmul_precision", "highest")
     if dist.get_global_mesh() is not None:
         dist.set_global_mesh(None)
     dist.set_hybrid_communicate_group(None)

@@ -15,7 +15,8 @@ SURFACE = {
     "paddle_tpu.core.op": ["OP_REGISTRY", "apply_op", "defop"],
     "paddle_tpu.core.autograd": ["backward", "grad", "no_grad"],
     # nn corpus
-    "paddle_tpu.nn": ["Layer", "Linear", "Conv2D", "BatchNorm2D", "LSTM",
+    "paddle_tpu.nn": ["channels_last", "abstract_init",
+                      "Layer", "Linear", "Conv2D", "BatchNorm2D", "LSTM",
                       "MultiHeadAttention", "Transformer", "CrossEntropyLoss",
                       "ClipGradByGlobalNorm", "Sequential", "LayerList"],
     "paddle_tpu.nn.functional": ["conv2d", "softmax", "cross_entropy",
@@ -66,7 +67,9 @@ SURFACE = {
     "paddle_tpu.distributed.fleet_executor": [
         "FleetExecutor", "RuntimeGraph", "Carrier", "MessageBus", "TaskNode",
         "ComputeInterceptor", "AmplifierInterceptor"],
-    "paddle_tpu.distributed.ps": ["PsServer", "PsClient", "TheOnePS",
+    "paddle_tpu.distributed.ps": ["SSDSparseTable", "CoordinatorServer",
+                                  "CoordinatorClient",
+                                  "PsServer", "PsClient", "TheOnePS",
                                   "SparseEmbedding", "SparseTable",
                                   "DenseTable", "sgd_rule"],
     "paddle_tpu.inference.dist_model": ["DistModel", "DistModelConfig"],
@@ -155,7 +158,9 @@ SURFACE = {
     "paddle_tpu.incubate.distributed.models.moe": [
         "MoELayer", "GShardGate", "SwitchGate", "NaiveGate",
         "global_scatter", "global_gather", "ClipGradForMOEByGlobalNorm"],
-    "paddle_tpu.geometric": ["send_u_recv", "send_ue_recv", "send_uv",
+    "paddle_tpu.geometric": ["sample_neighbors", "reindex_graph",
+                             "reindex_heter_graph",
+                             "send_u_recv", "send_ue_recv", "send_uv",
                              "segment_sum", "segment_mean", "segment_max",
                              "segment_min"],
     "paddle_tpu.quantization": ["QuantConfig", "QAT", "PTQ", "quant_dequant",

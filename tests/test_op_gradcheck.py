@@ -60,6 +60,20 @@ def _diffable_ops():
 
 @pytest.mark.parametrize("op_name", _diffable_ops())
 def test_numeric_grad(op_name):
+    import jax
+
+    # the framework enables x64 at import (f64 parity); the f64 inputs
+    # below rely on it, so assert the invariant instead of trusting that
+    # no earlier test leaked it off (rare order-dependent flakes were
+    # seen on windowed ops: conv2d_transpose r2, avg_pool3d r3 — the
+    # conftest isolation fixture now restores x64 after every test)
+    assert jax.config.read("jax_enable_x64"), \
+        "jax_enable_x64 leaked off — gradcheck inputs would silently " \
+        "downcast to f32"
+    _numeric_grad_body(op_name)
+
+
+def _numeric_grad_body(op_name):
     import test_op_registry_sweep as sweep
     args_fn, kwargs, _ = SPECS[op_name]
     op = OP_REGISTRY[op_name]
