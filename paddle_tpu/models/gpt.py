@@ -222,9 +222,16 @@ class GPTSelfAttention(Layer):
             state[bkey] = jnp.asarray(_permute(state[bkey], True))
         state[mkey] = jnp.asarray(QKV_LAYOUT_HEAD_MAJOR, jnp.int32)
 
-    def forward(self, x, cache=None, use_cache=False):
+    def forward(self, x, cache=None, use_cache=False, pre_norm=None):
         b, t = x.shape[0], x.shape[1]
-        qkv = self.qkv_proj(x)  # [B, T, 3H/mp-sharded]
+        if pre_norm is not None:
+            # fused pre-LN -> qkv projection (kernels/ln_matmul.py); bias
+            # stays outside the kernel so XLA fuses it downstream
+            qkv = F.fused_ln_linear(
+                x, pre_norm.weight, pre_norm.bias, self.qkv_proj.weight,
+                self.qkv_proj.bias, eps=pre_norm._epsilon)
+        else:
+            qkv = self.qkv_proj(x)  # [B, T, 3H/mp-sharded]
         # under explicit shard_map (pipeline stage bodies) the mp axis is
         # bound and qkv is the LOCAL column shard: reshape over local heads
         nh = self.num_heads
@@ -272,8 +279,14 @@ class GPTMLP(Layer):
             input_is_parallel=True)
         self.act = getattr(F, config.activation)
 
-    def forward(self, x):
-        return self.fc1(self.act(self.fc0(x)))
+    def forward(self, x, pre_norm=None):
+        if pre_norm is not None:
+            h = F.fused_ln_linear(x, pre_norm.weight, pre_norm.bias,
+                                  self.fc0.weight, self.fc0.bias,
+                                  eps=pre_norm._epsilon)
+        else:
+            h = self.fc0(x)
+        return self.fc1(self.act(h))
 
 
 class GPTMoEMLP(Layer):
@@ -324,8 +337,23 @@ class GPTDecoderLayer(Layer):
         self.dropout1 = Dropout(config.hidden_dropout_prob)
         self.dropout2 = Dropout(config.hidden_dropout_prob)
 
+    def _fuse_ln_proj(self):
+        """Route the pre-LNs INTO their consuming projections (one pallas
+        ln->matmul custom call per projection) when the opt-in kernel
+        applies — single device, dense MLP, no KV cache."""
+        from ..kernels.ln_matmul import ln_matmul_enabled
+        return (ln_matmul_enabled() and self.self_attn.mp_degree <= 1
+                and mesh_mod.get_global_mesh() is None
+                and not isinstance(self.mlp, GPTMoEMLP))
+
     def forward(self, x, cache=None, use_cache=False):
         residual = x
+        if not use_cache and self._fuse_ln_proj():
+            y = self.self_attn(x, pre_norm=self.norm1)
+            x = residual + self.dropout1(y)
+            residual = x
+            y = self.mlp(x, pre_norm=self.norm2)
+            return residual + self.dropout2(y)
         y = self.norm1(x)
         if use_cache:
             y, new_cache = self.self_attn(y, cache=cache, use_cache=True)

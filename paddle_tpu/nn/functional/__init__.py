@@ -7,6 +7,7 @@ from .norm import *  # noqa: F401,F403
 from .loss import *  # noqa: F401,F403
 from .attention import (  # noqa: F401
     enable_flash_attention,
+    fused_ln_linear,
     fused_qkv_attention,
     scaled_dot_product_attention,
 )

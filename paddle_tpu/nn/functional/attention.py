@@ -214,3 +214,28 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
                           f"{e}); falling back to dense reference attention")
     return _sdpa_ref(query, key, value, attn_mask, dropout_p, is_causal, scale,
                      training)
+
+
+@defop
+def fused_ln_linear(x, ln_weight, ln_bias, weight, bias=None, eps=1e-5,
+                    name=None):
+    """Pre-LN fused into its consuming projection: y = LN(x) @ weight
+    (+ bias) as ONE pallas custom call (kernels/ln_matmul.py) — the LN
+    boundary disappears into the matmul's operand read (docs/PERF.md:
+    standalone LN boundaries lose; reference analog: the pre-LN fusion in
+    fused_attention_op.cu / fused_feedforward_op.cu).  Falls back to the
+    jnp composition when the kernel doesn't apply (CPU, unaligned dims)."""
+    from ...distributed import mesh as _mesh_mod
+    from ...kernels.ln_matmul import ln_matmul, ln_matmul_ok
+
+    if ln_matmul_ok(x, weight,
+                    mesh_free=_mesh_mod.get_global_mesh() is None):
+        return ln_matmul(x, ln_weight, ln_bias, weight, bias, eps)
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    d = xf - mu
+    var = jnp.mean(d * d, axis=-1, keepdims=True)
+    xln = ((d * jax.lax.rsqrt(var + eps)) * ln_weight + ln_bias) \
+        .astype(x.dtype)
+    y = jnp.matmul(xln, weight)
+    return y if bias is None else y + bias

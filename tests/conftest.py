@@ -49,3 +49,7 @@ def _isolate_global_state():
     fleet._is_initialized = False
     fa._INTERPRET = False
     layout._state.on = False
+    from paddle_tpu.kernels import layer_norm as _ln
+    from paddle_tpu.kernels import ln_matmul as _lnmm
+    _ln._MODE = "off"
+    _lnmm._ENABLED = False

@@ -162,3 +162,47 @@ def test_fused_layernorm_matches_reference(mode):
                                        rtol=3e-4, atol=3e-4)
     finally:
         enable_fused_layernorm(False)
+
+
+def test_fused_ln_matmul_matches_reference():
+    """Opt-in ln->matmul kernel (kernels/ln_matmul.py): forward and all
+    four grads match the jnp composition (docs/PERF.md records it as a
+    measured perf dead end on GPT shapes; correctness stays covered)."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.kernels.ln_matmul import (enable_ln_matmul, ln_matmul,
+                                              ln_matmul_ok)
+
+    rng = np.random.RandomState(0)
+    # 300 rows > _BN=256 and not a multiple of it: the pad-and-slice path
+    # really runs (bn = min(_BN, n) would make smaller inputs a no-op)
+    x = jnp.asarray(rng.randn(300, 256), jnp.float32)
+    g = jnp.asarray(rng.randn(256), jnp.float32)
+    b = jnp.asarray(rng.randn(256), jnp.float32)
+    w = jnp.asarray(rng.randn(256, 384), jnp.float32)
+
+    def ref(x, g, b, w):
+        m = jnp.mean(x, -1, keepdims=True)
+        v = jnp.mean(jnp.square(x - m), -1, keepdims=True)
+        xln = (x - m) * jax.lax.rsqrt(v + 1e-5) * g + b
+        return xln @ w
+
+    assert not ln_matmul_ok(x, w, mesh_free=True)  # off by default
+    enable_ln_matmul(True)
+    try:
+        assert ln_matmul_ok(x, w, mesh_free=True)
+        assert not ln_matmul_ok(x, w, mesh_free=False)
+        np.testing.assert_allclose(np.asarray(ln_matmul(x, g, b, w)),
+                                   np.asarray(ref(x, g, b, w)),
+                                   rtol=2e-4, atol=2e-4)
+        coef = jnp.arange(384.0)
+        g1 = jax.grad(lambda *a: (ln_matmul(*a) * coef).sum(),
+                      argnums=(0, 1, 2, 3))(x, g, b, w)
+        g0 = jax.grad(lambda *a: (ref(*a) * coef).sum(),
+                      argnums=(0, 1, 2, 3))(x, g, b, w)
+        for got, want in zip(g1, g0):
+            np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                       rtol=3e-3, atol=3e-3)
+    finally:
+        enable_ln_matmul(False)

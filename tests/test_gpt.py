@@ -273,3 +273,32 @@ def test_gpt_scan_layers_matches_unrolled():
     paddle.seed(6)
     c = m_do(paddle.to_tensor(x)).numpy()
     assert np.abs(a - c).max() > 1e-3
+
+
+def test_gpt_fused_ln_proj_matches():
+    """enable_ln_matmul routes pre-LNs into their projections inside
+    GPTDecoderLayer; train-step losses must match the plain path."""
+    from paddle_tpu.kernels import flash_attention as fa
+    from paddle_tpu.kernels.ln_matmul import enable_ln_matmul
+
+    ids = np.random.RandomState(1).randint(0, 1024, (2, 17)).astype(np.int64)
+
+    def losses(enabled):
+        enable_ln_matmul(enabled)
+        paddle.seed(4)
+        m = build_gpt("gpt-tiny", hidden_dropout_prob=0.0,
+                      attention_dropout_prob=0.0)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=m.parameters())
+        step = dist.make_train_step(m, opt,
+                                    loss_fn=GPTPretrainingCriterion())
+        return [float(step(ids[:, :-1], ids[:, 1:])) for _ in range(2)]
+
+    fa._INTERPRET = True
+    try:
+        base = losses(False)
+        fused = losses(True)
+    finally:
+        enable_ln_matmul(False)
+        fa._INTERPRET = False
+    assert all(abs(a - b) < 5e-4 for a, b in zip(base, fused)), (base, fused)
