@@ -125,7 +125,7 @@ class ShardedTrainStep:
                  accumulate_steps: int = 1, num_labels: int = 1,
                  sharding_stage: int = 0, sharding_axis: str = "sharding",
                  offload: bool = False, static_argnames=(),
-                 abstract: bool = False):
+                 abstract: bool = False, fuse_optimizer="auto"):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
@@ -176,6 +176,8 @@ class ShardedTrainStep:
 
         self.abstract = bool(abstract)
         self.param_names = [k for k, m in self._tmask.items() if m]
+        self._flat_segs, self._flat_len = None, {}
+        self._fuse_optimizer = fuse_optimizer
         if self.abstract:
             # AOT planning mode: the model may have been built under
             # abstract_build() — parameter values are shape/dtype only and
@@ -194,9 +196,16 @@ class ShardedTrainStep:
                                  if k not in self.param_names]
             params = {k: values[k] for k in self.param_names}
             buffers = {k: values[k] for k in self.buffer_names}
+            # abstract mode must plan the SAME program the concrete step
+            # executes: pack the flat store here too (struct-only), so an
+            # aot_compile'd plan matches the real state tree and the
+            # compile-and-rank tuner ranks the fused update, not ~#params
+            # per-param fusions
+            if self._want_flat(fuse_optimizer, params):
+                params = self._init_flat(params)
             slots = {}
-            for k in self.param_names:
-                raw = jax.eval_shape(optimizer.init_slots, params[k])
+            for k, p in params.items():
+                raw = jax.eval_shape(optimizer.init_slots, p)
                 slots[k] = {s: jax.ShapeDtypeStruct(
                     v.shape, v.dtype,
                     sharding=(NamedSharding(self.mesh,
@@ -227,7 +236,19 @@ class ShardedTrainStep:
 
         params = {k: values[k] for k in self.param_names}
         buffers = {k: values[k] for k in self.buffer_names}
-        slots = {k: optimizer.init_slots(params[k]) for k in self.param_names}
+        # fused flat master store (reference analog: fuse_all_optimizer_ops /
+        # DistributedFusedLamb's flat fp32 master params): all trainables of
+        # one dtype live in ONE contiguous buffer, so the optimizer update is
+        # one whole-buffer fusion instead of ~#params tiny kernels.  Measured
+        # on ResNet-50 (161 params): the per-param update fusions cost
+        # ~4.7 ms/step — ~30 us fixed cost each plus tile-padding waste on
+        # [O,I,1,1] conv-weight layouts — vs ~0.8 ms of intrinsic traffic.
+        if self._want_flat(fuse_optimizer, params):
+            params = self._init_flat(params)
+            slots = {fk: optimizer.init_slots(v) for fk, v in params.items()}
+        else:
+            slots = {k: optimizer.init_slots(params[k])
+                     for k in self.param_names}
         # derive the train-state key from the framework's seeded generator,
         # NOT an unseeded np.random draw: under a multi-process mesh every
         # rank must carry the SAME key into the SPMD step (all ranks call
@@ -244,6 +265,79 @@ class ShardedTrainStep:
                 "offload=True needs a device mesh (host slots are staged "
                 "through memory-kind shardings); pass mesh= or init the "
                 "global mesh first")
+
+    # -- fused flat master store --------------------------------------------
+    _FLAT_ALIGN = 512  # elements; keeps every segment lane-tile aligned
+
+    @staticmethod
+    def _flat_key(dt: str) -> str:
+        return f"__flat_{dt}"
+
+    def _want_flat(self, flag, params) -> bool:
+        if flag is False:
+            return False
+        auto_ok = (self.mesh is None and not self.offload
+                   and getattr(self.optimizer, "_elementwise_update", False)
+                   and bool(self.param_names)
+                   and all(jnp.issubdtype(v.dtype, jnp.floating)
+                           for v in params.values()))
+        if flag is True and not auto_ok:
+            raise ValueError(
+                "fuse_optimizer=True needs a mesh-free, non-offloaded step "
+                "and an element-wise optimizer over floating params")
+        return auto_ok
+
+    @staticmethod
+    def _flat_eligible(v) -> bool:
+        # rank<=1 only: a 1-D slice of the 1-D buffer is layout-free, while
+        # materializing a [O,I,kh,kw] weight from a linear buffer costs a
+        # tiled-layout relayout per weight per step (measured: +12 ms/step
+        # of `reshape` ops on ResNet-50 when every param went flat)
+        return v.ndim <= 1
+
+    def _init_flat(self, params) -> dict:
+        """Pack rank<=1 params into one contiguous buffer per dtype;
+        remembers (name, offset, size, shape) segments for slicing them
+        back out.  Higher-rank weights keep their own named buffers."""
+        segs_by, parts_by, off_by = {}, {}, {}
+        out = {}
+        for k in self.param_names:
+            v = params[k]
+            if not self._flat_eligible(v):
+                out[k] = v
+                continue
+            dt = jnp.dtype(v.dtype).name
+            off = off_by.get(dt, 0)
+            size = int(np.prod(v.shape)) if len(v.shape) else 1
+            segs_by.setdefault(dt, []).append((k, off, size, tuple(v.shape)))
+            if not self.abstract:
+                parts_by.setdefault(dt, []).append(v.reshape(-1))
+            pad = (-size) % self._FLAT_ALIGN
+            if pad and not self.abstract:
+                parts_by[dt].append(jnp.zeros((pad,), v.dtype))
+            off_by[dt] = off + size + pad
+        self._flat_segs = segs_by or None
+        self._flat_len = off_by
+        if self.abstract:
+            out.update({self._flat_key(dt):
+                        jax.ShapeDtypeStruct((length,), jnp.dtype(dt))
+                        for dt, length in off_by.items()})
+        else:
+            out.update({self._flat_key(dt): jnp.concatenate(parts)
+                        for dt, parts in parts_by.items()})
+        return out
+
+    def _unflatten_params(self, params: dict) -> dict:
+        """Named view of the flat buffers (static slices — XLA fuses each
+        into its consumer's operand read); non-flat params pass through."""
+        named = {k: v for k, v in params.items()
+                 if not k.startswith("__flat_")}
+        for dt, segs in self._flat_segs.items():
+            buf = params[self._flat_key(dt)]
+            for k, off, size, shape in segs:
+                named[k] = jax.lax.slice(buf, (off,), (off + size,)
+                                         ).reshape(shape)
+        return named
 
     # -- sharding ------------------------------------------------------------
     def _infer_slot_specs(self) -> dict[str, P]:
@@ -316,6 +410,45 @@ class ShardedTrainStep:
                     for k in self.param_names}
         lr_scale = {k: (self._entries[k].optimize_attr or {}).get(
             "learning_rate", 1.0) for k in self.param_names}
+        flat_segs, flat_len = self._flat_segs, self._flat_len
+        flat_names = {k for segs in (flat_segs or {}).values()
+                      for (k, _, _, _) in segs}
+        if flat_segs:
+            # per-FLAT-KEY coefficients: scalar when uniform across segments,
+            # else a per-element vector (padding gaps get 0 decay / lr 1 —
+            # their params and grads are zero either way)
+            def seg_coeff(dt, named, default):
+                segs = flat_segs[dt]
+                vals = [named[k] for k, _, _, _ in segs]
+                if len(set(vals)) == 1:
+                    return vals[0]
+                vec = np.full(flat_len[dt], default, np.float32)
+                for (k, off, size, _), v in zip(segs, vals):
+                    vec[off:off + size] = v
+                return jnp.asarray(vec)
+
+            decay_of.update({self._flat_key(dt): seg_coeff(dt, decay_of, 0.0)
+                             for dt in flat_segs})
+            lr_scale.update({self._flat_key(dt): seg_coeff(dt, lr_scale, 1.0)
+                             for dt in flat_segs})
+
+        def flatten_grads(grads):
+            """Flat-eligible grads -> flat buffers (ONE concatenate per
+            dtype: a single dense pass, unlike per-param update fusions);
+            weight grads pass through by name."""
+            out = {k: g for k, g in grads.items() if k not in flat_names}
+            for dt, segs in flat_segs.items():
+                dtype = jnp.dtype(dt)
+                pieces, cur = [], 0
+                for k, off, size, _ in segs:
+                    if off > cur:
+                        pieces.append(jnp.zeros((off - cur,), dtype))
+                    pieces.append(grads[k].reshape(-1).astype(dtype))
+                    cur = off + size
+                if cur < flat_len[dt]:
+                    pieces.append(jnp.zeros((flat_len[dt] - cur,), dtype))
+                out[self._flat_key(dt)] = jnp.concatenate(pieces)
+            return out
         grad_clip = getattr(opt, "_grad_clip", None)
         mesh = self.mesh
         param_specs, slot_specs = self._specs, self._slot_specs
@@ -384,6 +517,10 @@ class ShardedTrainStep:
             state_tree = dict(core_tree)
             state_tree["slots"] = slots_arg
             params = state_tree["params"]
+            # flat mode: the model differentiates against the NAMED views of
+            # the flat buffers; the optimizer below updates the flat buffers
+            params_model = self._unflatten_params(params) if flat_segs \
+                else params
             key = jax.random.fold_in(state_tree["rng"], state_tree["step"])
             if accum > 1:
                 # micro-batch gradient accumulation (reference: gradient_merge
@@ -394,14 +531,14 @@ class ShardedTrainStep:
                 def body(carry, xs):
                     gsum, lsum, bufs, i = carry
                     mb_key = jax.random.fold_in(key, i)
-                    (l, nb), g = vag(params, bufs, mb_key, xs)
+                    (l, nb), g = vag(params_model, bufs, mb_key, xs)
                     gsum = jax.tree_util.tree_map(jnp.add, gsum, g)
                     bufs = dict(bufs)
                     bufs.update({k: v for k, v in nb.items() if k in bufs})
                     return (gsum, lsum + l, bufs, i + 1), None
 
                 zeros = jax.tree_util.tree_map(
-                    lambda p: jnp.zeros(p.shape, jnp.float32), params)
+                    lambda p: jnp.zeros(p.shape, jnp.float32), params_model)
                 (grads, loss, new_buf, _), _ = jax.lax.scan(
                     body, (zeros, jnp.zeros((), jnp.float32),
                            state_tree["buffers"], jnp.zeros((), jnp.int32)),
@@ -409,9 +546,13 @@ class ShardedTrainStep:
                 grads = jax.tree_util.tree_map(lambda g: g / accum, grads)
                 loss = loss / accum
             else:
-                (loss, new_buf), grads = vag(params, state_tree["buffers"],
+                (loss, new_buf), grads = vag(params_model,
+                                             state_tree["buffers"],
                                              key, batch)
-            grads = {k: g.astype(params[k].dtype) for k, g in grads.items()}
+            grads = {k: g.astype(params_model[k].dtype)
+                     for k, g in grads.items()}
+            if flat_segs:
+                grads = flatten_grads(grads)
             if zero_grad_constraint:
                 # ZeRO-2: pin each grad to the slot layout so XLA lowers the
                 # data-parallel grad reduction into a reduce-scatter onto the
@@ -579,8 +720,15 @@ class ShardedTrainStep:
     def sync_to_model(self):
         """Write compiled-state values back into the eager Layer.  Values are
         copied so the next (donating) step can't delete the Layer's arrays."""
+        params = self.state.params
+        if self._flat_segs:
+            if not hasattr(self, "_unflatten_jit"):
+                # cached: a fresh jax.jit wrapper per call would retrace +
+                # recompile the slice graph at every checkpoint sync
+                self._unflatten_jit = jax.jit(self._unflatten_params)
+            params = self._unflatten_jit(params)
         for k in self.param_names:
-            self._entries[k]._replace_(jnp.copy(self.state.params[k]), None)
+            self._entries[k]._replace_(jnp.copy(params[k]), None)
         for k in self.buffer_names:
             self._entries[k]._replace_(jnp.copy(self.state.buffers[k]), None)
 

@@ -28,8 +28,34 @@ _ENABLED = False
 
 
 def enable_ln_matmul(flag: bool):
+    """Opt in to the fused kernel.  Enabling PROBE-COMPILES a canonical
+    shape first: inside a jitted train step the pallas_call only traces —
+    a Mosaic failure would otherwise surface at the OUTER step compile,
+    where no per-op fallback can catch it.  If the probe fails, the flag
+    stays off and a warning names the error."""
     global _ENABLED
-    _ENABLED = bool(flag)
+    if not flag:
+        _ENABLED = False
+        return
+    try:
+        import jax.extend.backend as jexb
+        platform = jexb.get_backend().platform
+    except Exception:
+        platform = jax.default_backend()
+    if platform in ("tpu", "axon") and not _fa._INTERPRET:
+        try:
+            x = jnp.zeros((256, 256), jnp.bfloat16)
+            g = jnp.ones((256,), jnp.float32)
+            w = jnp.zeros((256, 256), jnp.bfloat16)
+            jax.block_until_ready(_ln_matmul_fwd_impl(x, g, g, w, 1e-5))
+        except Exception as e:
+            import warnings
+            warnings.warn(
+                f"ln_matmul kernel probe failed on this backend "
+                f"({type(e).__name__}: {e}); keeping the fused path OFF")
+            _ENABLED = False
+            return
+    _ENABLED = True
 
 
 def ln_matmul_enabled() -> bool:
@@ -159,4 +185,6 @@ def ln_matmul_ok(x, w, mesh_free: bool) -> bool:
         platform = jexb.get_backend().platform
     except Exception:
         platform = jax.default_backend()
-    return platform not in ("cpu",)
+    # TPU-class backends only: the kernel is built on pltpu.CompilerParams;
+    # any other accelerator would fail Mosaic lowering at call time
+    return platform in ("tpu", "axon")

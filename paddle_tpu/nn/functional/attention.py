@@ -230,8 +230,16 @@ def fused_ln_linear(x, ln_weight, ln_bias, weight, bias=None, eps=1e-5,
 
     if ln_matmul_ok(x, weight,
                     mesh_free=_mesh_mod.get_global_mesh() is None):
-        return ln_matmul(x, ln_weight, ln_bias, weight, bias, eps)
-    xf = x.astype(jnp.float32)
+        try:
+            return ln_matmul(x, ln_weight, ln_bias, weight, bias, eps)
+        except Exception as e:  # genuine lowering/compile failure: degrade
+            import warnings    # loudly to the jnp composition (the same
+            # contract as the flash paths above — an opt-in kernel must
+            # never turn a training run into a crash)
+            warnings.warn(f"ln_matmul kernel failed ({type(e).__name__}: "
+                          f"{e}); falling back to jnp LN+matmul")
+    # promote, never downcast: f64 inputs (x64 gradcheck mode) keep f64
+    xf = x.astype(jnp.promote_types(x.dtype, jnp.float32))
     mu = jnp.mean(xf, axis=-1, keepdims=True)
     d = xf - mu
     var = jnp.mean(d * d, axis=-1, keepdims=True)

@@ -40,6 +40,47 @@ def _norm_padding(padding, n):
     raise ValueError(f"bad padding spec {padding}")
 
 
+_POINTWISE_AS_DOT = False
+
+
+def pointwise_as_dot(flag: bool):
+    """Toggle the 1x1-conv->dot_general lowering (measured A/B on ResNet-50,
+    docs/PERF.md: the dot form wins in isolation but loses ~2 ms/step in
+    model context to backward-side layout fixups)."""
+    global _POINTWISE_AS_DOT
+    _POINTWISE_AS_DOT = bool(flag)
+
+
+def _pointwise_conv(x, weight, stride, pad, groups, n, channel_last):
+    """1x1 conv as dot_general when it is one (kernel 1, pad 0, groups 1).
+
+    TPU rationale (measured, docs/PERF.md): lax.conv on kxk=1 kernels gets
+    [O,I,1,1] weight layouts whose unit minor dims waste up to 128x of each
+    lane tile — the momentum/Adam update fusions on those weights cost
+    ~340us apiece — and the conv op itself trails XLA's dot pipelines.
+    Contracting C with a [O,C]-reshaped weight fixes the weight layout for
+    every consumer (optimizer included) and runs on the tuned MXU matmul
+    path.  Strides subsample the input FIRST (less matmul work, exact same
+    result for k=1)."""
+    if not _POINTWISE_AS_DOT:
+        return None
+    if groups != 1 or isinstance(pad, str) or any(p != (0, 0) for p in pad):
+        return None
+    if any(weight.shape[2 + i] != 1 for i in range(n)):
+        return None
+    w2 = weight.reshape(weight.shape[0], weight.shape[1])  # [O, C]
+    if any(s != 1 for s in stride):
+        sl = [slice(None)] * x.ndim
+        for i, s in enumerate(stride):
+            sl[(1 if channel_last else 2) + i] = slice(None, None, s)
+        x = x[tuple(sl)]
+    cdim = x.ndim - 1 if channel_last else 1
+    out = jax.lax.dot_general(x, w2, (((cdim,), (1,)), ((), ())))
+    if not channel_last:
+        out = jnp.moveaxis(out, -1, 1)
+    return out
+
+
 def _conv_nd(x, weight, bias, stride, padding, dilation, groups, n,
              channel_last, transpose=False, output_padding=0, output_size=None):
     stride = _tuplize(stride, n)
@@ -76,10 +117,12 @@ def _conv_nd(x, weight, bias, stride, padding, dilation, groups, n,
             lhs_dilation=stride, rhs_dilation=dilation, dimension_numbers=dn,
             feature_group_count=groups)
     else:
-        out = jax.lax.conv_general_dilated(
-            x, weight, window_strides=stride, padding=pad,
-            rhs_dilation=dilation, dimension_numbers=dn,
-            feature_group_count=groups)
+        out = _pointwise_conv(x, weight, stride, pad, groups, n, channel_last)
+        if out is None:
+            out = jax.lax.conv_general_dilated(
+                x, weight, window_strides=stride, padding=pad,
+                rhs_dilation=dilation, dimension_numbers=dn,
+                feature_group_count=groups)
     if bias is not None:
         if channel_last:
             out = out + bias.reshape((1,) * (n + 1) + (-1,))

@@ -32,7 +32,24 @@ class L1Decay:
         self.coeff = float(coeff)
 
 
+def _has_decay(ctx) -> bool:
+    """Truthiness of the decay coefficient that also accepts the fused flat
+    path's per-element coefficient VECTOR (spmd.py flat master store), where
+    plain `if coeff:` would raise on a traced array."""
+    c = ctx.get("decay")
+    if c is None or isinstance(c, (int, float)):
+        return bool(c)
+    return True
+
+
 class Optimizer:
+    # True for optimizers whose update is purely element-wise (broadcasts
+    # over any shape with vector lr/decay) — the contract the fused flat
+    # parameter store needs.  Per-TENSOR-norm optimizers (Lamb, LARS) must
+    # leave this False: their trust ratios would silently collapse to one
+    # global norm on a flat buffer.
+    _elementwise_update = False
+
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
                  grad_clip=None, name=None):
         self._lr = learning_rate
@@ -226,17 +243,19 @@ class Optimizer:
 
 
 class SGD(Optimizer):
+    _elementwise_update = True
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
                  grad_clip=None, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip)
 
     def update(self, p, g, slots, lr, t, ctx):
-        if ctx["decay"]:
+        if _has_decay(ctx):
             g = g + ctx["decay"] * p
         return p - lr * g, slots
 
 
 class Momentum(Optimizer):
+    _elementwise_update = True
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
                  use_nesterov=False, weight_decay=None, grad_clip=None, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip)
@@ -247,7 +266,7 @@ class Momentum(Optimizer):
         return {"velocity": jnp.zeros_like(p_value)}
 
     def update(self, p, g, slots, lr, t, ctx):
-        if ctx["decay"]:
+        if _has_decay(ctx):
             g = g + ctx["decay"] * p
         v = self._momentum * slots["velocity"] + g
         if self._nesterov:
@@ -258,6 +277,7 @@ class Momentum(Optimizer):
 
 
 class Adam(Optimizer):
+    _elementwise_update = True
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
                  parameters=None, weight_decay=None, grad_clip=None,
                  lazy_mode=False, multi_precision=False, name=None):
@@ -271,7 +291,7 @@ class Adam(Optimizer):
                 "moment2": jnp.zeros_like(p_value)}
 
     def update(self, p, g, slots, lr, t, ctx):
-        if ctx["decay"]:
+        if _has_decay(ctx):
             g = g + ctx["decay"] * p  # L2 reg folded into grad (Adam, not AdamW)
         b1, b2 = self._beta1, self._beta2
         m = b1 * slots["moment1"] + (1 - b1) * g
@@ -283,6 +303,7 @@ class Adam(Optimizer):
 
 
 class AdamW(Adam):
+    _elementwise_update = True
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
                  parameters=None, weight_decay=0.01, lr_ratio=None,
                  apply_decay_param_fun=None, grad_clip=None,
@@ -312,6 +333,7 @@ class AdamW(Adam):
 
 
 class Adagrad(Optimizer):
+    _elementwise_update = True
     def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
                  weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
                  name=None):
@@ -323,13 +345,14 @@ class Adagrad(Optimizer):
         return {"moment": jnp.full_like(p_value, self._init_acc)}
 
     def update(self, p, g, slots, lr, t, ctx):
-        if ctx["decay"]:
+        if _has_decay(ctx):
             g = g + ctx["decay"] * p
         acc = slots["moment"] + jnp.square(g)
         return p - lr * g / (jnp.sqrt(acc) + self._eps), {"moment": acc}
 
 
 class RMSProp(Optimizer):
+    _elementwise_update = True
     def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
                  centered=False, parameters=None, weight_decay=None,
                  grad_clip=None, name=None):
@@ -345,7 +368,7 @@ class RMSProp(Optimizer):
                 "velocity": jnp.zeros_like(p_value)}
 
     def update(self, p, g, slots, lr, t, ctx):
-        if ctx["decay"]:
+        if _has_decay(ctx):
             g = g + ctx["decay"] * p
         ms = self._rho * slots["mean_square"] + (1 - self._rho) * jnp.square(g)
         if self._centered:
@@ -359,6 +382,7 @@ class RMSProp(Optimizer):
 
 
 class Adadelta(Optimizer):
+    _elementwise_update = True
     def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
                  parameters=None, weight_decay=None, grad_clip=None, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip)
@@ -370,7 +394,7 @@ class Adadelta(Optimizer):
                 "avg_squared_update": jnp.zeros_like(p_value)}
 
     def update(self, p, g, slots, lr, t, ctx):
-        if ctx["decay"]:
+        if _has_decay(ctx):
             g = g + ctx["decay"] * p
         asg = self._rho * slots["avg_squared_grad"] + (1 - self._rho) * jnp.square(g)
         upd = g * jnp.sqrt(slots["avg_squared_update"] + self._eps) / \
@@ -380,6 +404,7 @@ class Adadelta(Optimizer):
 
 
 class Adamax(Optimizer):
+    _elementwise_update = True
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
                  parameters=None, weight_decay=None, grad_clip=None, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip)
@@ -390,7 +415,7 @@ class Adamax(Optimizer):
                 "inf_norm": jnp.zeros_like(p_value)}
 
     def update(self, p, g, slots, lr, t, ctx):
-        if ctx["decay"]:
+        if _has_decay(ctx):
             g = g + ctx["decay"] * p
         m = self._beta1 * slots["moment"] + (1 - self._beta1) * g
         u = jnp.maximum(self._beta2 * slots["inf_norm"], jnp.abs(g))
@@ -432,6 +457,10 @@ class Lamb(Optimizer):
 
 class LarsMomentum(Momentum):
     """LARS (reference: lars_momentum op)."""
+
+    # per-TENSOR trust ratio (norm(p)/norm(g)): flat packing would collapse
+    # it to one global norm — opt out of the inherited Momentum flag
+    _elementwise_update = False
 
     def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
                  lars_weight_decay=0.0005, parameters=None, grad_clip=None,

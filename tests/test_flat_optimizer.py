@@ -1,0 +1,129 @@
+"""Fused flat master-parameter store (spmd.py `fuse_optimizer`).
+
+Reference analog: fuse_all_optimizer_ops / DistributedFusedLamb's flat
+fp32 master params (python/paddle/incubate/optimizer/distributed_fused_lamb.py).
+Contract: bitwise-identical training vs the unfused per-param path, with
+rank<=1 params packed into one buffer per dtype.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+
+
+def _build(opt_name):
+    paddle.seed(0)
+    m = nn.Sequential(nn.Conv2D(3, 8, 3, padding=1), nn.BatchNorm2D(8),
+                      nn.ReLU(), nn.Conv2D(8, 16, 1), nn.BatchNorm2D(16),
+                      nn.AdaptiveAvgPool2D((1, 1)), nn.Flatten(),
+                      nn.Linear(16, 10))
+    crit = nn.CrossEntropyLoss()
+    if opt_name == "momentum":
+        opt = paddle.optimizer.Momentum(
+            learning_rate=0.1, momentum=0.9, parameters=m.parameters(),
+            weight_decay=1e-4)
+    elif opt_name == "adamw":
+        opt = paddle.optimizer.AdamW(
+            learning_rate=1e-2, parameters=m.parameters(),
+            # per-param decay filter exercises the VECTOR coefficient path
+            apply_decay_param_fun=lambda n: "weight" in n)
+    else:
+        opt = paddle.optimizer.Lamb(learning_rate=1e-2,
+                                    parameters=m.parameters())
+    return m, crit, opt
+
+
+@pytest.mark.parametrize("opt_name", ["momentum", "adamw"])
+def test_flat_store_matches_unfused(opt_name):
+    rng = np.random.RandomState(0)
+    x = rng.standard_normal((4, 3, 8, 8)).astype(np.float32)
+    y = rng.randint(0, 10, (4,)).astype(np.int64)
+    out = {}
+    for mode in (False, "auto"):
+        m, crit, opt = _build(opt_name)
+        step = dist.make_train_step(m, opt, loss_fn=crit,
+                                    fuse_optimizer=mode)
+        assert (step._flat_segs is not None) == (mode == "auto")
+        losses = [float(step(x, y)) for _ in range(5)]
+        step.sync_to_model()
+        out[mode] = (losses,
+                     {k: np.asarray(v._value)
+                      for k, v in m.state_dict().items()})
+    np.testing.assert_array_equal(out[False][0], out["auto"][0])
+    for k in out[False][1]:
+        np.testing.assert_allclose(out[False][1][k], out["auto"][1][k],
+                                   rtol=2e-6, atol=1e-7, err_msg=k)
+
+
+def test_flat_store_packs_rank_le_1_only():
+    m, crit, opt = _build("momentum")
+    step = dist.make_train_step(m, opt, loss_fn=crit)
+    assert step._flat_segs, "elementwise optimizer should auto-fuse"
+    flat_names = {k for segs in step._flat_segs.values()
+                  for (k, _, _, _) in segs}
+    entries = dict(m.state_dict())
+    for k in flat_names:
+        assert entries[k]._value.ndim <= 1, k
+    # conv/linear weights stay named (their unflatten relayout is the
+    # measured 12 ms/step regression, docs/PERF.md)
+    assert any(v.ndim > 1 for v in step.state.params.values()
+               if not isinstance(v, str))
+
+
+def test_non_elementwise_optimizer_stays_unfused():
+    m, crit, opt = _build("lamb")
+    step = dist.make_train_step(m, opt, loss_fn=crit)
+    assert step._flat_segs is None
+    with pytest.raises(ValueError):
+        dist.make_train_step(m, opt, loss_fn=crit, fuse_optimizer=True)
+    # LARS has a per-TENSOR trust ratio: it must not inherit Momentum's
+    # elementwise flag (flat packing would collapse the ratio to one norm)
+    m2, crit2, _ = _build("momentum")
+    lars = paddle.optimizer.LarsMomentum(learning_rate=0.1,
+                                         parameters=m2.parameters())
+    step2 = dist.make_train_step(m2, lars, loss_fn=crit2)
+    assert step2._flat_segs is None
+
+
+def test_abstract_mode_plans_the_same_tree():
+    import jax
+
+    paddle.seed(0)
+    with nn.abstract_init():
+        ma = nn.Sequential(nn.Linear(16, 32), nn.LayerNorm(32),
+                           nn.Linear(32, 4))
+    opta = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                  parameters=ma.parameters())
+    stepa = dist.make_train_step(ma, opta, loss_fn=nn.CrossEntropyLoss(),
+                                 abstract=True)
+    paddle.seed(0)
+    mc = nn.Sequential(nn.Linear(16, 32), nn.LayerNorm(32),
+                       nn.Linear(32, 4))
+    optc = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                  parameters=mc.parameters())
+    stepc = dist.make_train_step(mc, optc, loss_fn=nn.CrossEntropyLoss())
+    assert ({k: tuple(v.shape) for k, v in stepa.state.params.items()}
+            == {k: tuple(v.shape) for k, v in stepc.state.params.items()})
+    assert (jax.tree_util.tree_structure(stepa.state.slots)
+            == jax.tree_util.tree_structure(stepc.state.slots))
+
+
+def test_run_steps_and_resume_through_flat():
+    rng = np.random.RandomState(1)
+    m, crit, opt = _build("momentum")
+    step = dist.make_train_step(m, opt, loss_fn=crit)
+    import jax.numpy as jnp
+    x = jnp.asarray(rng.standard_normal((3, 4, 3, 8, 8)).astype(np.float32))
+    y = jnp.asarray(rng.randint(0, 10, (3, 4)).astype(np.int64))
+    losses = np.asarray(step.run_steps(x, y).numpy())
+    assert losses.shape == (3,) and np.isfinite(losses).all()
+    step.sync_to_model()
+    # a fresh step built from the synced model continues from its values
+    opt2 = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                     parameters=m.parameters(),
+                                     weight_decay=1e-4)
+    step2 = dist.make_train_step(m, opt2, loss_fn=crit)
+    l2 = float(step2(np.asarray(x[0]), np.asarray(y[0])))
+    assert np.isfinite(l2)

@@ -297,6 +297,7 @@ spec("bilinear_tensor_product", lambda: (F(3, 4), F(3, 5), F(2, 4, 5)))
 spec("max_unpool2d",
      lambda: (F(1, 2, 2, 2), I64(1, 2, 2, 2, hi=16)),
      {"kernel_size": 2}, grad=False)
+spec("fused_ln_linear", lambda: (F(2, 4, 16), F(16), F(16), F(16, 8)))
 
 # ops exercised via dedicated test files, not callable with simple
 # positional tensors here (reason recorded so the sweep stays exhaustive)

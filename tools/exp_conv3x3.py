@@ -1,0 +1,123 @@
+"""Stage-B gate: pallas direct 3x3 conv (NHWC, stride 1, SAME) with
+BN-apply+relu prologue and BN-stats epilogue, vs XLA's conv on the same
+work.  Decides whether the fused-bottleneck-block plan is viable.
+
+Kernel: grid (Cout blocks, N blocks); x block = [bn, H, W, C] full
+spatial; in-kernel zero-pad H/W by 1, then 9 shifted [bn*H*W, C] @
+[C, bc] dots accumulate.
+"""
+from __future__ import annotations
+
+import functools
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _kernel(x_ref, s_ref, b_ref, w_ref, o_ref, st_ref, *, H, W):
+    j = pl.program_id(1)
+    x = x_ref[...]  # [bn, H, W, C]
+    bn, _, _, c = x.shape
+    bc = w_ref.shape[3]
+    sf = s_ref[...].astype(jnp.float32).reshape(1, 1, 1, c)
+    bf = b_ref[...].astype(jnp.float32).reshape(1, 1, 1, c)
+    xn = jnp.maximum(x.astype(jnp.float32) * sf + bf, 0).astype(x.dtype)
+    xp = jnp.pad(xn, ((0, 0), (1, 1), (1, 1), (0, 0)))
+    acc = jnp.zeros((bn * H * W, bc), jnp.float32)
+    for di in range(3):
+        for dj in range(3):
+            xs = jax.lax.slice(xp, (0, di, dj, 0), (bn, di + H, dj + W, c))
+            acc = acc + jax.lax.dot_general(
+                xs.reshape(bn * H * W, c), w_ref[di, dj],
+                (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+    o_ref[...] = acc.reshape(bn, H, W, bc).astype(o_ref.dtype)
+    ps = jnp.sum(acc, axis=0, keepdims=True)
+    pq = jnp.sum(acc * acc, axis=0, keepdims=True)
+    stat = jnp.concatenate([ps, pq], axis=0)
+
+    @pl.when(j == 0)
+    def _():
+        st_ref[...] = stat
+
+    @pl.when(j > 0)
+    def _():
+        st_ref[...] += stat
+
+
+def fused3x3(x, s, b, w, bn_blk=8, bc=None):
+    n, H, W, c = x.shape
+    co = w.shape[3]
+    bc = bc or co
+    bn_blk = min(bn_blk, n)
+    assert n % bn_blk == 0 and co % bc == 0
+    y, st = pl.pallas_call(
+        functools.partial(_kernel, H=H, W=W),
+        grid=(co // bc, n // bn_blk),
+        in_specs=[pl.BlockSpec((bn_blk, H, W, c), lambda i, j: (j, 0, 0, 0)),
+                  pl.BlockSpec((1, c), lambda i, j: (0, 0)),
+                  pl.BlockSpec((1, c), lambda i, j: (0, 0)),
+                  pl.BlockSpec((3, 3, c, bc), lambda i, j: (0, 0, 0, i))],
+        out_specs=[pl.BlockSpec((bn_blk, H, W, bc),
+                                lambda i, j: (j, 0, 0, i)),
+                   pl.BlockSpec((2, bc), lambda i, j: (0, i))],
+        out_shape=[jax.ShapeDtypeStruct((n, H, W, co), x.dtype),
+                   jax.ShapeDtypeStruct((2, co), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+            vmem_limit_bytes=110 * 1024 * 1024),
+    )(x, s.reshape(1, -1), b.reshape(1, -1), w)
+    return y, st
+
+
+def xla_chain(x, s, b, w):
+    xn = jnp.maximum(x.astype(jnp.float32) * s + b, 0).astype(x.dtype)
+    y = jax.lax.conv_general_dilated(
+        xn, w, (1, 1), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    yf = y.astype(jnp.float32)
+    return y, jnp.sum(yf, (0, 1, 2)), jnp.sum(yf * yf, (0, 1, 2))
+
+
+def main():
+    from exp_dtime import dtime
+
+    r = np.random.RandomState(0)
+    shapes = [(64, 56, 56, 64, 64), (64, 28, 28, 128, 128),
+              (64, 14, 14, 256, 256), (64, 7, 7, 512, 512)]
+    for n, H, W, c, co in shapes:
+        x = jnp.asarray(r.standard_normal((n, H, W, c)).astype(np.float32),
+                        jnp.bfloat16)
+        s = jnp.asarray(r.standard_normal(c).astype(np.float32)) * .1 + 1
+        b = jnp.asarray(r.standard_normal(c).astype(np.float32)) * .1
+        w = jnp.asarray(r.standard_normal((3, 3, c, co)).astype(np.float32)
+                        / np.sqrt(9 * c), jnp.bfloat16)
+        yx, sx, qx = jax.jit(xla_chain)(x, s, b, w)
+        t_x = dtime(xla_chain, (x, s, b, w))
+        line = (f"N={n} {H}x{W} C={c}->{co}  xla={t_x:7.1f}us "
+                f"(roofline {2 * 9 * n * H * W * c * co / 197e12 * 1e6:5.1f})")
+        for bnb in (2, 4, 8, 16):
+            if n % bnb:
+                continue
+            try:
+                fn = functools.partial(fused3x3, bn_blk=bnb)
+                yf, st = jax.jit(fn)(x, s, b, w)
+                err = float(jnp.max(jnp.abs(yf.astype(jnp.float32)
+                                            - yx.astype(jnp.float32))))
+                t = dtime(fn, (x, s, b, w))
+                line += f" | bn{bnb}:{t:7.1f} (err {err:.2g})"
+            except Exception as e:
+                line += f" | bn{bnb}:ERR({type(e).__name__})"
+        print(line, flush=True)
+
+
+if __name__ == "__main__":
+    main()

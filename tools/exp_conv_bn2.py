@@ -1,0 +1,126 @@
+"""Isolate which part of the fused conv+BN kernel is slow: pure pallas
+matmul vs +prologue vs +stats epilogue, against XLA dot on the same shape."""
+from __future__ import annotations
+
+import functools
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from exp_conv_bn import _time, fused_conv1x1_bn, xla_chain
+
+
+def _k_mm(x_ref, w_ref, o_ref):
+    o_ref[...] = jax.lax.dot_general(
+        x_ref[...], w_ref[...], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(o_ref.dtype)
+
+
+def _k_pro(x_ref, s_ref, b_ref, w_ref, o_ref):
+    x = x_ref[...].astype(jnp.float32)
+    xn = jnp.maximum(x * s_ref[...].astype(jnp.float32)
+                     + b_ref[...].astype(jnp.float32), 0).astype(x_ref.dtype)
+    o_ref[...] = jax.lax.dot_general(
+        xn, w_ref[...], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(o_ref.dtype)
+
+
+def _k_stat(x_ref, w_ref, o_ref, st_ref):
+    i = pl.program_id(1)
+    y = jax.lax.dot_general(
+        x_ref[...], w_ref[...], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    o_ref[...] = y.astype(o_ref.dtype)
+    ps = jnp.sum(y, axis=0, keepdims=True)
+    pq = jnp.sum(y * y, axis=0, keepdims=True)
+
+    @pl.when(i == 0)
+    def _init():
+        st_ref[...] = jnp.concatenate([ps, pq], axis=0)
+
+    @pl.when(i > 0)
+    def _acc():
+        st_ref[...] += jnp.concatenate([ps, pq], axis=0)
+
+
+def run_mm(x2, w, bm=1024, bn=512, kern=_k_mm, nstat=False):
+    m, k = x2.shape
+    n = w.shape[1]
+    bn = min(bn, n)
+    bm = min(bm, m)
+    assert m % bm == 0
+    grid = (n // bn, m // bm)
+    outs = [jax.ShapeDtypeStruct((m, n), x2.dtype)]
+    out_specs = [pl.BlockSpec((bm, bn), lambda j, i: (i, j))]
+    if nstat:
+        outs.append(jax.ShapeDtypeStruct((2, n), jnp.float32))
+        out_specs.append(pl.BlockSpec((2, bn), lambda j, i: (0, j)))
+    r = pl.pallas_call(
+        kern, grid=grid,
+        in_specs=[pl.BlockSpec((bm, k), lambda j, i: (i, 0)),
+                  pl.BlockSpec((k, bn), lambda j, i: (0, j))],
+        out_specs=out_specs if nstat else out_specs[0],
+        out_shape=outs if nstat else outs[0],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+            vmem_limit_bytes=100 * 1024 * 1024),
+    )(x2, w)
+    return r
+
+
+def run_pro(x2, s, b, w, bm=1024, bn=512):
+    m, k = x2.shape
+    n = w.shape[1]
+    bn = min(bn, n)
+    bm = min(bm, m)
+    return pl.pallas_call(
+        _k_pro, grid=(n // bn, m // bm),
+        in_specs=[pl.BlockSpec((bm, k), lambda j, i: (i, 0)),
+                  pl.BlockSpec((1, k), lambda j, i: (0, 0)),
+                  pl.BlockSpec((1, k), lambda j, i: (0, 0)),
+                  pl.BlockSpec((k, bn), lambda j, i: (0, j))],
+        out_specs=pl.BlockSpec((bm, bn), lambda j, i: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((m, n), x2.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+            vmem_limit_bytes=100 * 1024 * 1024),
+    )(x2, s.reshape(1, -1), b.reshape(1, -1), w)
+
+
+def main():
+    shapes = [(50176, 512, 128), (12544, 1024, 256), (200704, 64, 256)]
+    rng = np.random.RandomState(0)
+    for m, k, n in shapes:
+        m = -(-m // 1024) * 1024  # pad-free for this experiment
+        x2 = jnp.asarray(rng.standard_normal((m, k)).astype(np.float32),
+                         jnp.bfloat16)
+        s = jnp.asarray(rng.standard_normal(k).astype(np.float32)) * .1 + 1
+        b = jnp.asarray(rng.standard_normal(k).astype(np.float32)) * .1
+        w = jnp.asarray(rng.standard_normal((k, n)).astype(np.float32) /
+                        np.sqrt(k), jnp.bfloat16)
+        t_xla_mm = _time(lambda a, c: jax.lax.dot_general(
+            a, c, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(jnp.bfloat16),
+            (x2, w), perturb=1)
+        t_mm = _time(run_mm, (x2, w), perturb=1)
+        t_pro = _time(run_pro, (x2, s, b, w), perturb=1)
+        t_stat = _time(functools.partial(run_mm, kern=_k_stat, nstat=True),
+                       (x2, w), perturb=1)
+        t_full = _time(fused_conv1x1_bn, (x2, s, b, w), perturb=1)
+        t_chain = _time(xla_chain, (x2, s, b, w), perturb=1)
+        print(f"M={m:7d} K={k:4d} N={n:4d}  xla_mm={t_xla_mm:7.1f} "
+              f"pl_mm={t_mm:7.1f} pl_pro={t_pro:7.1f} pl_stat={t_stat:7.1f} "
+              f"pl_full={t_full:7.1f} xla_chain={t_chain:7.1f} (us)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,86 @@
+"""Join an xplane device profile with the step's optimized-HLO metadata so
+each device op gets attributed to its SOURCE (model op + file:line), not
+just its XLA fusion kind.
+
+Usage: python tools/profile_join.py [resnet|gpt] [--steps N]
+"""
+from __future__ import annotations
+
+import collections
+import glob
+import os
+import re
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def hlo_meta(txt: str) -> dict:
+    """instruction name -> (op_name, source:line) from optimized HLO text."""
+    meta = {}
+    for m in re.finditer(
+            r"%(\S+?) = [^\n]*?metadata=\{op_name=\"([^\"]*)\""
+            r"(?:[^\n]*?source_file=\"([^\"]*)\")?"
+            r"(?:[^\n]*?source_line=(\d+))?", txt):
+        name, op, f, line = m.groups()
+        src = f"{os.path.basename(f)}:{line}" if f else ""
+        meta[name] = (op, src)
+    return meta
+
+
+def run(which="resnet", steps=5, fmt="NCHW"):
+    import jax
+    import jax.numpy as jnp
+    from profile_model import _build_resnet, _build_gpt, profile
+
+    if which == "resnet":
+        step, args = _build_resnet(batch=64, data_format=fmt)
+    else:
+        step, args = _build_gpt()
+    batch = step.shard_batch(*args)
+    if step._jitted is None:
+        step._jitted = step._build(len(batch))
+    core, slots = step._split_tree()
+    lr = jnp.float32(0.1)
+    txt = step._jitted.lower(core, slots, lr, batch).compile().as_text()
+    meta = hlo_meta(txt)
+
+    outdir = profile(step, args, steps=steps)
+    paths = glob.glob(os.path.join(outdir, "**", "*.xplane.pb"),
+                      recursive=True)
+    data = jax.profiler.ProfileData.from_file(paths[-1])
+    plane = next(p for p in data.planes
+                 if "TPU" in p.name or "/device" in p.name.lower())
+    groups = collections.Counter()
+    examples = {}
+    total = 0.0
+    for line in plane.lines:
+        if line.name != "XLA Ops":
+            continue
+        for ev in line.events:
+            dur = ev.duration_ns / 1e6
+            total += dur
+            base = ev.name.split(" = ")[0].lstrip("%")
+            op, src = meta.get(base, ("?", "?"))
+            # collapse jit scopes/uniquifiers: keep the trailing primitive
+            prim = op.split("/")[-1] if op != "?" else "?"
+            scope = "bwd" if "transpose(jvp" in op else "fwd"
+            key = (prim, scope, src)
+            groups[key] += dur
+            examples.setdefault(key, base)
+    print(f"total device {total / steps:.2f} ms/step")
+    print(f"{'ms/step':>8}  {'prim':40} {'pass':3}  source")
+    for (prim, scope, src), t in groups.most_common(30):
+        print(f"{t / steps:8.3f}  {prim[:40]:40} {scope:3}  {src}  "
+              f"e.g. {examples[(prim, scope, src)][:40]}")
+
+
+if __name__ == "__main__":
+    which = sys.argv[1] if len(sys.argv) > 1 else "resnet"
+    steps = 5
+    if "--steps" in sys.argv:
+        steps = int(sys.argv[sys.argv.index("--steps") + 1])
+    fmt = "NHWC" if "--nhwc" in sys.argv else "NCHW"
+    run(which, steps, fmt)
