@@ -6,5 +6,8 @@ from .cluster import Cluster, Device, LinkSpec, Machine  # noqa: F401
 from .cost_model import (CostModel, PlanConfig, PlanCost,  # noqa: F401
                          WorkloadSpec)
 from .planner import Planner, build_mesh, compile_and_rank  # noqa: F401
-from .completion import Completion, complete  # noqa: F401
+from .completion import (Completion, complete,  # noqa: F401
+                         complete_bidirectional)
+from .partitioner import (DotSite, ShardingPlan, apply_plan,  # noqa: F401
+                          extract_dot_graph, search_op_shardings)
 from .tuner import Candidate, Tuner  # noqa: F401

@@ -205,17 +205,156 @@ def _concat_rule(eqn, in_specs):
 class Completion:
     """Result of a completion pass: specs for every jaxpr var."""
 
-    def __init__(self, jaxpr, out_specs, eqn_specs, notes):
+    def __init__(self, jaxpr, out_specs, eqn_specs, notes, in_specs=None):
         self.jaxpr = jaxpr
         self.out_specs = out_specs
         self.eqn_specs = eqn_specs   # list of (prim_name, [out PartitionSpec])
         self.notes = notes           # [("psum", axis_name), ...]
+        self.in_specs = in_specs     # completed INPUT specs (bwd inference)
 
     def implied_collectives(self):
         """Axis names whose sharding is consumed by a contraction/reduction —
         GSPMD will emit a psum/reduce-scatter there (the reference Completer
         marks the same positions with partial dist-attrs)."""
         return [a for kind, a in self.notes if kind == "psum"]
+
+
+# -- backward (use-site -> operand) inference --------------------------------
+#
+# The reference Completer runs forward AND backward passes to a fixpoint
+# (completion.py complete_forward_annotation / _update_dims_mapping_between
+# walking both directions): a tensor annotated nowhere inherits its spec
+# from HOW IT IS USED.  The canonical case: the user marks only the batch
+# input and one activation, and the matmul weights receive their
+# column/row-parallel specs from the marked activations.
+
+def _bwd_elementwise(eqn, out_spec):
+    outs = []
+    rank_out = len(eqn.outvars[0].aval.shape)
+    o = _norm(out_spec, rank_out)
+    for v in eqn.invars:
+        rank = len(v.aval.shape)
+        off = rank_out - rank
+        spec = [o[i + off] if v.aval.shape[i] == eqn.outvars[0].aval.shape[i + off]
+                else None for i in range(rank)]
+        outs.append(P(*spec))
+    return outs
+
+
+def _bwd_dot(eqn, out_spec):
+    lhs, rhs = eqn.invars
+    (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+    lrank, rrank = len(lhs.aval.shape), len(rhs.aval.shape)
+    o = _norm(out_spec, len(eqn.outvars[0].aval.shape))
+    ls, rs = [None] * lrank, [None] * rrank
+    pos = 0
+    for i in lb:
+        ls[i] = o[pos]
+        pos += 1
+    # batch dims appear on rhs too (paired in order)
+    for bi, i in enumerate(rb):
+        rs[i] = o[bi]
+    for i in range(lrank):
+        if i not in lc and i not in lb:
+            ls[i] = o[pos]
+            pos += 1
+    for i in range(rrank):
+        if i not in rc and i not in rb:
+            rs[i] = o[pos]
+            pos += 1
+    # contracted dims are unconstrained by the output — leave None
+    return [P(*ls), P(*rs)]
+
+
+def _bwd_transpose(eqn, out_spec):
+    perm = eqn.params["permutation"]
+    o = _norm(out_spec, len(eqn.outvars[0].aval.shape))
+    inv = [None] * len(perm)
+    for i, p in enumerate(perm):
+        inv[p] = o[i]
+    return [P(*inv)]
+
+
+def _bwd_broadcast(eqn, out_spec):
+    dims = eqn.params["broadcast_dimensions"]
+    o = _norm(out_spec, len(eqn.outvars[0].aval.shape))
+    src_shape = eqn.invars[0].aval.shape
+    dst_shape = eqn.outvars[0].aval.shape
+    spec = [o[d] if src_shape[i] == dst_shape[d] else None
+            for i, d in enumerate(dims)]
+    return [P(*spec)]
+
+
+def _bwd_reshape(eqn, out_spec):
+    src = eqn.invars[0].aval.shape
+    dst = eqn.outvars[0].aval.shape
+    o = _norm(out_spec, len(dst))
+    spec = [None] * len(src)
+    i = j = 0
+    while i < len(src) and j < len(dst):
+        if src[i] == dst[j]:
+            spec[i] = o[j]
+            i += 1
+            j += 1
+        elif src[i] == 1:
+            i += 1
+        elif dst[j] == 1:
+            j += 1
+        else:
+            break
+    return [P(*spec)]
+
+
+def _bwd_reduce(eqn, out_spec):
+    axes = set(eqn.params.get("axes", ()))
+    rank = len(eqn.invars[0].aval.shape)
+    o = list(_norm(out_spec, rank - len(axes)))
+    spec, j = [], 0
+    for i in range(rank):
+        if i in axes:
+            spec.append(None)
+        else:
+            spec.append(o[j])
+            j += 1
+    return [P(*spec)]
+
+
+def _sibling_dot(eqn, known, put) -> bool:
+    """Known-operand -> unknown-operand inference across a dot's
+    contraction: contracted dims must agree (and batch dims pair up)."""
+    lhs, rhs = eqn.invars
+    (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+    ls, rs = known(lhs), known(rhs)
+    changed = False
+    if ls is not None and rs is None:
+        l = _norm(ls, len(lhs.aval.shape))
+        spec = [None] * len(rhs.aval.shape)
+        for i, j in zip(lc, rc):
+            spec[j] = l[i]
+        for i, j in zip(lb, rb):
+            spec[j] = l[i]
+        if any(s is not None for s in spec):  # never lock in "replicated"
+            changed |= put(rhs, P(*spec))
+    elif rs is not None and ls is None:
+        r = _norm(rs, len(rhs.aval.shape))
+        spec = [None] * len(lhs.aval.shape)
+        for i, j in zip(lc, rc):
+            spec[i] = r[j]
+        for i, j in zip(lb, rb):
+            spec[i] = r[j]
+        if any(s is not None for s in spec):
+            changed |= put(lhs, P(*spec))
+    return changed
+
+
+_BWD_RULES = {
+    "dot_general": _bwd_dot,
+    "transpose": _bwd_transpose,
+    "broadcast_in_dim": _bwd_broadcast,
+    "reshape": _bwd_reshape,
+    "reduce_sum": _bwd_reduce, "reduce_max": _bwd_reduce,
+    "reduce_min": _bwd_reduce, "reduce_prod": _bwd_reduce,
+}
 
 
 def complete(fn, in_specs: Sequence[P], *example_args) -> Completion:
@@ -226,6 +365,114 @@ def complete(fn, in_specs: Sequence[P], *example_args) -> Completion:
             f"got {len(list(in_specs))} input specs for "
             f"{len(closed.jaxpr.invars)} jaxpr inputs")
     return complete_closed(closed, in_specs)
+
+
+def complete_bidirectional(fn, in_specs: Sequence, *example_args,
+                           out_specs: Sequence = None,
+                           max_iters: int = 4) -> Completion:
+    """Fixpoint completion in BOTH directions (the reference Completer's
+    forward/backward dims-mapping walk, completion.py:complete_forward_
+    annotation): entries of `in_specs` (and optionally `out_specs`) may be
+    None = "infer me".  A weight whose spec is None receives it from the
+    annotated activations it meets at its use sites — annotate one matmul
+    output with P(None, "mp") and its weight completes to column-parallel.
+
+    Merge policy mirrors the reference's compatibility rule: an explicit
+    annotation is never overwritten; unknowns take the first inferred
+    spec; conflicting inferences keep the earlier one.
+    """
+    closed = jax.make_jaxpr(fn)(*example_args)
+    jaxpr = closed.jaxpr
+    if len(list(in_specs)) != len(jaxpr.invars):
+        raise ValueError(
+            f"got {len(list(in_specs))} input specs for "
+            f"{len(jaxpr.invars)} jaxpr inputs")
+
+    env: dict = {}
+    for var, spec in zip(jaxpr.invars, in_specs):
+        if spec is not None:
+            env[var] = spec
+    for var in jaxpr.constvars:
+        env[var] = P()
+    if out_specs is not None:
+        for var, spec in zip(jaxpr.outvars, out_specs):
+            if spec is not None and not isinstance(
+                    var, jax.extend.core.Literal):
+                env[var] = spec
+
+    def known(v):
+        if isinstance(v, jax.extend.core.Literal):
+            return P()
+        return env.get(v)
+
+    def put(v, spec):
+        if isinstance(v, jax.extend.core.Literal) or spec is None:
+            return False
+        if v in env:
+            return False
+        env[v] = spec
+        return True
+
+    def nontrivial(spec):
+        return spec is not None and any(a is not None for a in spec)
+
+    for _ in range(max_iters):
+        changed = False
+        # forward sweep — only NONTRIVIAL inferences are recorded: locking
+        # a tensor to "replicated" mid-fixpoint would block a later,
+        # better inference (the all-None default is applied at the end)
+        for eqn in jaxpr.eqns:
+            ins = [known(v) for v in eqn.invars]
+            if any(i is None for i in ins):
+                continue
+            outs, _ = _fwd_eqn(eqn, ins)
+            for v, s in zip(eqn.outvars, outs):
+                if nontrivial(s):
+                    changed |= put(v, s)
+        # backward sweep
+        for eqn in reversed(jaxpr.eqns):
+            prim = eqn.primitive.name
+            out_spec = known(eqn.outvars[0])
+            if out_spec is not None and nontrivial(out_spec):
+                if prim in _BWD_RULES:
+                    ins = _BWD_RULES[prim](eqn, out_spec)
+                elif prim in _ELEMENTWISE:
+                    ins = _bwd_elementwise(eqn, out_spec)
+                else:
+                    ins = [None] * len(eqn.invars)
+                for v, s in zip(eqn.invars, ins):
+                    if nontrivial(s):
+                        changed |= put(v, s)
+            # operand<->operand propagation (reference: the Completer's op
+            # dist-attr COMPATIBILITY rule — both dot operands' contracted
+            # dims must carry the same dims_mapping): a known lhs with a
+            # sharded contraction dim implies the matching rhs dim, the
+            # row-parallel pairing
+            if prim == "dot_general":
+                changed |= _sibling_dot(eqn, known, put)
+        if not changed:
+            break
+    # final forward pass for eqn specs/notes with everything known
+    fwd = complete_closed(
+        closed, [env.get(v, P()) for v in jaxpr.invars])
+    return Completion(closed, fwd.out_specs, fwd.eqn_specs, fwd.notes,
+                      in_specs=[env.get(v, P()) for v in jaxpr.invars])
+
+
+def _fwd_eqn(eqn, ins):
+    """Shared forward dispatch: (out_specs, notes) for one equation —
+    used by complete_closed and the bidirectional fixpoint (keeping pjit
+    recursion in ONE place)."""
+    prim = eqn.primitive.name
+    if prim in _RULES:
+        return _RULES[prim](eqn, ins)
+    if prim in _ELEMENTWISE:
+        return [_merge_elementwise(
+            ins, [v.aval for v in eqn.invars], eqn.outvars[0].aval)], []
+    if prim in ("pjit", "jit"):  # jax renamed the primitive in 0.9
+        inner = complete_closed(eqn.params["jaxpr"], ins)
+        return inner.out_specs, inner.notes
+    return [P() for _ in eqn.outvars], []
 
 
 def complete_closed(closed, in_specs):
@@ -245,22 +492,11 @@ def complete_closed(closed, in_specs):
     eqn_specs = []
     notes = []
     for eqn in jaxpr.eqns:
-        prim = eqn.primitive.name
         ins = [read(v) for v in eqn.invars]
-        if prim in _RULES:
-            outs, n = _RULES[prim](eqn, ins)
-            notes.extend(n)
-        elif prim in _ELEMENTWISE:
-            outs = [_merge_elementwise(
-                ins, [v.aval for v in eqn.invars], eqn.outvars[0].aval)]
-        elif prim == "pjit":
-            inner = complete_closed(eqn.params["jaxpr"], ins)
-            outs = inner.out_specs
-            notes.extend(inner.notes)
-        else:
-            outs = [P() for _ in eqn.outvars]
+        outs, n = _fwd_eqn(eqn, ins)
+        notes.extend(n)
         for v, s in zip(eqn.outvars, outs):
             env[v] = s
-        eqn_specs.append((prim, list(outs)))
+        eqn_specs.append((eqn.primitive.name, list(outs)))
     return Completion(closed, [read(v) for v in jaxpr.outvars],
                       eqn_specs, notes)

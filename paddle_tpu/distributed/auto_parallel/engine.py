@@ -61,6 +61,54 @@ class Engine:
                     else 1))
         return self._step
 
+    def plan_op_shardings(self, *example_inputs, batch_axes=("dp", "data"),
+                          model_axes=("mp", "model"), **search_kw):
+        """Per-op sharding search over the model's forward, applied back
+        onto the parameters as partition specs — the reference Engine's
+        _plan (Completer) + _parallel (Partitioner) pipeline
+        (engine.py:485 _plan; planner.py PlanSpace), re-thought as:
+        search per-dot strategies (partitioner.search_op_shardings), tag
+        each matmul weight's `_partition_spec` with the winning layout,
+        and let GSPMD execute the choice through the normal SPMD step.
+
+        `example_inputs`: arrays or ShapeDtypeStructs for the model's
+        forward inputs.  Returns the ShardingPlan (inspect .decisions /
+        .cost).  Call BEFORE fit(); fit's step builder then picks the
+        tags up via infer_param_specs.
+        """
+        import jax
+
+        from ...nn.functional_call import functional_call
+        from .partitioner import search_op_shardings
+
+        mesh = self._mesh()
+        entries = self._model.state_dict()
+        names = list(entries)
+        structs = [jax.ShapeDtypeStruct(tuple(v._value.shape),
+                                        v._value.dtype)
+                   for v in entries.values()]
+        xs = [jax.ShapeDtypeStruct(tuple(x.shape), x.dtype)
+              for x in example_inputs]
+
+        def fwd(vals, *inputs):
+            values = dict(zip(names, vals))
+            args = tuple(Tensor(b, _internal=True) for b in inputs)
+            out, _ = functional_call(self._model, values, args)
+            return out._value if isinstance(out, Tensor) else out
+
+        axes = {a: int(s) for a, s in mesh.shape.items() if int(s) > 1}
+        plan = search_op_shardings(
+            fwd, (structs, *xs), axes,
+            batch_axes=tuple(a for a in batch_axes if a in axes),
+            model_axes=tuple(a for a in model_axes if a in axes),
+            **search_kw)
+        for idx, spec in plan.weight_specs().items():
+            if idx >= len(names):   # an activation input, not a parameter
+                continue
+            if any(a is not None for a in spec):
+                entries[names[idx]]._partition_spec = spec
+        return plan
+
     # -- loops ---------------------------------------------------------------
     def _loader(self, data, batch_size, shuffle=False):
         if isinstance(data, DataLoader):

@@ -1,0 +1,317 @@
+"""Per-op sharding search + program partitioner.
+
+Reference: python/paddle/distributed/auto_parallel/planner.py (PlanSpace
+enumerates per-op dist-attr candidates, MCMC searches the joint space)
+and partitioner.py (applies the chosen dist-attrs to the program).
+
+TPU-native reshape: the "program" is a jaxpr, an op's dist-attr is a
+PartitionSpec triple for its operands/output, and applying a plan means
+inserting `with_sharding_constraint` at the chosen tensors and handing
+the constrained program to GSPMD.  The search is what GSPMD does NOT do:
+GSPMD propagates whatever shardings it is given; it does not *choose*
+them.  This module chooses — e.g. it discovers the Megatron column->row
+pairing for back-to-back projections (no collective between them, one
+psum after the second) purely from the cost model.
+
+Granularity: the cost-carrying ops are the dot_generals (matmuls).
+Everything between two dots (elementwise/transpose/reshape chains) is
+spec-transparent, so the search space is one strategy per dot:
+
+    rep        x:rep       w:rep        y:rep          (baseline)
+    dp(a)      x:(a,-)     w:rep        y:(a,-)        batch parallel
+    col(a)     x:rep       w:(-,a)      y:(-,a)        column parallel
+    row(a)     x:(-,a)     w:(a,-)      y:rep + psum   row parallel
+    dp+col     x:(d,-)     w:(-,a)      y:(d,a)
+    dp+row     x:(d,a)     w:(a,-)      y:(d,-) + psum
+
+Edge cost between a producer's output spec and a consumer's required
+input spec is the GSPMD resharding collective (all_gather per lost axis,
+local slice is free); node cost is flops/parallelism plus the row psum.
+Beam search over topological order (the joint space is exponential; the
+reference uses MCMC — a beam is deterministic and exact on chains).
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.extend
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+__all__ = ["DotSite", "Strategy", "ShardingPlan", "extract_dot_graph",
+           "search_op_shardings", "apply_plan"]
+
+
+_PASSTHROUGH = {
+    "add", "sub", "mul", "div", "max", "min", "tanh", "logistic", "exp",
+    "log", "neg", "abs", "sqrt", "rsqrt", "erf", "convert_element_type",
+    "stop_gradient", "select_n", "integer_pow", "square", "custom_jvp_call",
+    "custom_vjp_call", "copy", "broadcast_in_dim", "transpose", "reshape",
+}
+
+
+@dataclass
+class DotSite:
+    """One dot_general in the traced program."""
+    eqn_index: int
+    m: int                      # rows (batch-like free dims, flattened)
+    k: int                      # contraction
+    n: int                      # cols (rhs free dims, flattened)
+    lhs_src: Optional[int]      # producing DotSite index (or None = input)
+    rhs_invar: Optional[int]    # jaxpr INVAR INDEX of the weight (or None)
+    out_bytes: int = 0
+    lhs_bytes: int = 0
+
+
+@dataclass(frozen=True)
+class Strategy:
+    kind: str                   # rep | dp | col | row | dp_col | dp_row
+    dp_axis: Optional[str] = None
+    tp_axis: Optional[str] = None
+
+    def x_spec(self):
+        return P(self.dp_axis,
+                 self.tp_axis if self.kind in ("row", "dp_row") else None)
+
+    def w_spec(self):
+        if self.kind in ("col", "dp_col"):
+            return P(None, self.tp_axis)
+        if self.kind in ("row", "dp_row"):
+            return P(self.tp_axis, None)
+        return P()
+
+    def y_spec(self):
+        return P(self.dp_axis,
+                 self.tp_axis if self.kind in ("col", "dp_col") else None)
+
+
+@dataclass
+class ShardingPlan:
+    sites: List[DotSite]
+    decisions: List[Strategy]
+    cost: float
+    mesh_axes: Dict[str, int]
+
+    def weight_specs(self):
+        """jaxpr INVAR INDEX -> PartitionSpec for every weight the plan
+        shards (2-D canonical [K, N] orientation; indices are stable
+        across re-traces of the same fn, unlike Var objects)."""
+        out = {}
+        for site, strat in zip(self.sites, self.decisions):
+            if site.rhs_invar is not None:
+                out[site.rhs_invar] = strat.w_spec()
+        return out
+
+
+def _flat(shape, dims):
+    return int(np.prod([shape[d] for d in dims])) if dims else 1
+
+
+def extract_dot_graph(closed) -> List[DotSite]:
+    """Find the dot_generals and which earlier dot feeds each one's lhs
+    (tracing through spec-transparent ops)."""
+    jaxpr = closed.jaxpr
+    producer: Dict[object, int] = {}   # var -> DotSite index
+    alias: Dict[object, object] = {}   # var -> upstream var
+    invar_index = {v: i for i, v in enumerate(jaxpr.invars)}
+    sites: List[DotSite] = []
+
+    def root(v):
+        seen = set()
+        while v in alias and v not in seen:
+            seen.add(v)
+            v = alias[v]
+        return v
+
+    for idx, eqn in enumerate(jaxpr.eqns):
+        prim = eqn.primitive.name
+        if prim == "dot_general":
+            lhs, rhs = eqn.invars
+            (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+            lfree = [i for i in range(len(lhs.aval.shape))
+                     if i not in lc and i not in lb]
+            rfree = [i for i in range(len(rhs.aval.shape))
+                     if i not in rc and i not in rb]
+            m = _flat(lhs.aval.shape, lb) * _flat(lhs.aval.shape, lfree)
+            k = _flat(lhs.aval.shape, list(lc))
+            n = _flat(rhs.aval.shape, rfree)
+            lr = root(lhs)
+            site = DotSite(
+                eqn_index=idx, m=m, k=k, n=n,
+                lhs_src=producer.get(lr),
+                # DIRECT invar only: a rhs reached through transpose/reshape
+                # would need the spec re-oriented to tag the raw parameter
+                rhs_invar=invar_index.get(rhs),
+                out_bytes=int(np.prod(eqn.outvars[0].aval.shape))
+                * eqn.outvars[0].aval.dtype.itemsize,
+                lhs_bytes=int(np.prod(lhs.aval.shape))
+                * lhs.aval.dtype.itemsize)
+            sites.append(site)
+            producer[eqn.outvars[0]] = len(sites) - 1
+        elif prim in _PASSTHROUGH and eqn.invars:
+            # output aliases its first array operand for tracing purposes
+            src = next((v for v in eqn.invars
+                        if not isinstance(v, jax.extend.core.Literal)), None)
+            if src is not None:
+                for ov in eqn.outvars:
+                    alias[ov] = src
+                r = root(src)
+                if r in producer:
+                    for ov in eqn.outvars:
+                        producer[ov] = producer[r]
+    return sites
+
+
+def _candidates(mesh_axes: Dict[str, int], batch_axes: Sequence[str],
+                model_axes: Sequence[str]) -> List[Strategy]:
+    cands = [Strategy("rep")]
+    for d in batch_axes:
+        cands.append(Strategy("dp", dp_axis=d))
+    for a in model_axes:
+        cands.append(Strategy("col", tp_axis=a))
+        cands.append(Strategy("row", tp_axis=a))
+        for d in batch_axes:
+            cands.append(Strategy("dp_col", dp_axis=d, tp_axis=a))
+            cands.append(Strategy("dp_row", dp_axis=d, tp_axis=a))
+    return cands
+
+
+def _divisible(site: DotSite, strat: Strategy, axes: Dict[str, int]) -> bool:
+    if strat.dp_axis and site.m % axes[strat.dp_axis]:
+        return False
+    if strat.tp_axis:
+        s = axes[strat.tp_axis]
+        if strat.kind.endswith("col") and site.n % s:
+            return False
+        if strat.kind.endswith("row") and site.k % s:
+            return False
+    return True
+
+
+def _reshard_bytes(src: P, dst: P, nbytes: int, axes: Dict[str, int]) -> float:
+    """all_gather bytes to convert a tensor from `src` to `dst` layout.
+    Slicing a replicated dim is free; gathering a lost axis moves
+    (s-1)/s of the tensor per device."""
+    src_axes = {a for a in (tuple(src) if src else ()) if a}
+    dst_axes = {a for a in (tuple(dst) if dst else ()) if a}
+    cost = 0.0
+    local = nbytes / math.prod(axes[a] for a in src_axes) \
+        if src_axes else float(nbytes)
+    for a in src_axes - dst_axes:
+        s = axes[a]
+        cost += local * (s - 1)
+    return cost
+
+
+def search_op_shardings(fn, example_args, mesh_axes: Dict[str, int],
+                        batch_axes: Sequence[str] = ("dp",),
+                        model_axes: Sequence[str] = ("mp",),
+                        chip_flops: float = 197e12,
+                        ici_bytes_per_s: float = 9e10,
+                        beam: int = 64) -> ShardingPlan:
+    """Choose a Strategy per dot_general minimizing predicted step time.
+
+    Beam search over the dots in topological order: a state is the
+    strategy tuple so far; edge costs come from resharding each dot's lhs
+    from its producer's output spec, node costs from sharded flops + the
+    row-parallel psum.  Exact on chains (beam >= |candidates|), the
+    reference's MCMC-searched space restricted to the strategies that
+    matter on a TPU mesh.
+
+    `ici_bytes_per_s` defaults to ~half of a v5e's 186 GB/s per-link ICI
+    — the effective all-reduce bandwidth after protocol overheads.  The
+    physics this encodes: TP's psum costs ~2*(s-1)/s * n * itemsize per
+    row while its compute saving is ~2*k*n*(s-1)/s / chip_flops per row,
+    so the Megatron column->row pattern starts paying around
+    k > chip_flops * itemsize / ici_bw (~4k hidden at these defaults) —
+    below that the search correctly prefers replicated or pure-dp plans.
+    """
+    closed = jax.make_jaxpr(fn)(*example_args)
+    sites = extract_dot_graph(closed)
+    if not sites:
+        return ShardingPlan([], [], 0.0, dict(mesh_axes))
+    batch_axes = [a for a in batch_axes if a in mesh_axes]
+    model_axes = [a for a in model_axes if a in mesh_axes]
+    cands = _candidates(mesh_axes, batch_axes, model_axes)
+
+    def node_cost(site, strat):
+        par = 1
+        if strat.dp_axis:
+            par *= mesh_axes[strat.dp_axis]
+        if strat.tp_axis:
+            par *= mesh_axes[strat.tp_axis]
+        t = 2.0 * site.m * site.k * site.n / par / chip_flops
+        if strat.kind.endswith("row"):
+            s = mesh_axes[strat.tp_axis]
+            dp = mesh_axes[strat.dp_axis] if strat.dp_axis else 1
+            t += (site.out_bytes / dp) * 2 * (s - 1) / s / ici_bytes_per_s
+        return t
+
+    def edge_cost(site, prev_strat, strat):
+        src = prev_strat.y_spec() if prev_strat is not None else P()
+        return _reshard_bytes(src, strat.x_spec(), site.lhs_bytes,
+                              mesh_axes) / ici_bytes_per_s
+
+    # beam over topological (program) order
+    states: List[Tuple[float, List[Strategy]]] = [(0.0, [])]
+    for site in sites:
+        nxt = []
+        for cost, hist in states:
+            prev = hist[site.lhs_src] if site.lhs_src is not None else None
+            for strat in cands:
+                if not _divisible(site, strat, mesh_axes):
+                    continue
+                c = cost + node_cost(site, strat) \
+                    + edge_cost(site, prev, strat)
+                nxt.append((c, hist + [strat]))
+        nxt.sort(key=lambda t: t[0])
+        states = nxt[:beam]
+    best_cost, best = states[0]
+    return ShardingPlan(sites, best, best_cost, dict(mesh_axes))
+
+
+def apply_plan(fn, plan: ShardingPlan, mesh):
+    """Partitioner: re-trace `fn` and pin each planned dot's output with
+    with_sharding_constraint (reference partitioner.py applies dist-attrs
+    to the serial program the same way); GSPMD propagates the rest."""
+    by_eqn = {s.eqn_index: strat
+              for s, strat in zip(plan.sites, plan.decisions)}
+
+    def wrapped(*args):
+        closed = jax.make_jaxpr(fn)(*args)
+        jaxpr = closed.jaxpr
+        env = {}
+
+        def read(v):
+            if isinstance(v, jax.extend.core.Literal):
+                return v.val
+            return env[v]
+
+        for var, val in zip(jaxpr.invars,
+                            jax.tree_util.tree_leaves(args)):
+            env[var] = val
+        for var, val in zip(jaxpr.constvars, closed.consts):
+            env[var] = val
+        for idx, eqn in enumerate(jaxpr.eqns):
+            vals = eqn.primitive.bind(*[read(v) for v in eqn.invars],
+                                      **eqn.params)
+            if not eqn.primitive.multiple_results:
+                vals = [vals]
+            if idx in by_eqn:
+                spec = by_eqn[idx].y_spec()
+                rank = len(eqn.outvars[0].aval.shape)
+                ent = list(spec)[:rank]
+                # y_spec is 2-D canonical (rows, cols): pad middle dims
+                if rank > 2:
+                    ent = [ent[0]] + [None] * (rank - 2) + [ent[-1]]
+                vals = [jax.lax.with_sharding_constraint(
+                    vals[0], NamedSharding(mesh, P(*ent)))] + vals[1:]
+            for v, val in zip(eqn.outvars, vals):
+                env[v] = val
+        outs = [read(v) for v in jaxpr.outvars]
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+    return wrapped
