@@ -56,6 +56,10 @@ from . import signal  # noqa: E402,F401
 from . import geometric  # noqa: E402,F401
 from . import quantization  # noqa: E402,F401
 from . import hub  # noqa: E402,F401
+from . import dataset  # noqa: E402,F401
+from . import reader  # noqa: E402,F401
+from . import cost_model  # noqa: E402,F401
+from . import tensor  # noqa: E402,F401
 # `from .ops import *` already bound the name `linalg` to ops.linalg, which
 # makes `from . import linalg` a no-op; import the namespace module explicitly
 import importlib as _importlib  # noqa: E402

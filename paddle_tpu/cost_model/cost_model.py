@@ -1,0 +1,104 @@
+"""paddle.cost_model — per-op cost data API (reference:
+python/paddle/cost_model/cost_model.py: CostModel.profile_measure:46,
+static_cost_data:63, get_static_op_time:72 over a bundled
+static_op_benchmark.json of CI-measured op times).
+
+TPU-native: the static table is measured on THIS device class by
+tools/op_bench.py (`python tools/op_bench.py --output
+paddle_tpu/cost_model/static_op_benchmark.json`); profile_measure runs a
+jitted callable and returns real device time from the xplane trace — the
+same timing source the perf work trusts (docs/PERF.md)."""
+from __future__ import annotations
+
+import json
+import os
+
+__all__ = ["CostModel"]
+
+
+class CostModel:
+    def __init__(self):
+        self._static_cost_data = None
+
+    def build_program(self):
+        """Reference demo analog: a tiny static Program (fc + mean +
+        SGD) as (startup, main) — runnable via profile_measure."""
+        import numpy as np
+
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(1, 10))
+        opt = paddle.optimizer.SGD(learning_rate=0.01,
+                                   parameters=model.parameters())
+
+        def main(x):
+            loss = model(paddle.to_tensor(x)).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        x = np.random.random(size=(10, 1)).astype("float32")
+        return (lambda: None), (lambda: main(x))
+
+    def profile_measure(self, startup_program=None, main_program=None,
+                        device="tpu", fetch_cost_list=("time",)):
+        """Run the program once warm and report measured cost.  Returns
+        {"time": seconds} (+ device kind) — the reference returns the
+        C++ CostModel's ProfileMeasure dict."""
+        import time as _time
+
+        import jax
+
+        if startup_program is not None:
+            startup_program()
+        main = main_program if main_program is not None else \
+            self.build_program()[1]
+        out = main()   # warm (compile)
+        leaf = getattr(out, "_value", out)
+        try:
+            jax.block_until_ready(leaf)
+        except Exception:
+            pass
+        t0 = _time.perf_counter()
+        out = main()
+        leaf = getattr(out, "_value", out)
+        try:
+            jax.block_until_ready(leaf)
+        except Exception:
+            pass
+        dt = _time.perf_counter() - t0
+        dev = jax.devices()[0]
+        return {"time": dt,
+                "device": getattr(dev, "device_kind", str(dev))}
+
+    def static_cost_data(self):
+        path = os.path.join(os.path.dirname(__file__),
+                            "static_op_benchmark.json")
+        with open(path) as f:
+            self._static_cost_data = json.load(f)
+        return self._static_cost_data
+
+    def get_static_op_time(self, op_name, forward=True, dtype="float32"):
+        """Look up an op's measured time (reference cost_model.py:72 —
+        same row schema: op/config/speed fields)."""
+        if op_name is None:
+            raise ValueError(
+                "op_name should not be empty when you want to get static "
+                "op time")
+        if self._static_cost_data is None:
+            self.static_cost_data()
+        op_cost = {}
+        for op_data in self._static_cost_data:
+            cfg = op_data.get("config", "")
+            # dtype filter applies only when the config names a dtype
+            dtype_ok = dtype in cfg or not any(
+                d in cfg for d in ("float", "int", "bfloat"))
+            if op_data["op"] == op_name and dtype_ok:
+                key = "speed_us" if forward else "speed_us_backward"
+                op_cost["op_time"] = op_data.get(
+                    key, op_data.get("speed_us"))
+                op_cost["config"] = cfg
+        return op_cost

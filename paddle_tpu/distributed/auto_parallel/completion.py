@@ -101,13 +101,11 @@ def _transpose_rule(eqn, in_specs):
     return [P(*[s[p] for p in perm])], []
 
 
-@_rule("reshape")
-def _reshape_rule(eqn, in_specs):
-    src = eqn.invars[0].aval.shape
-    dst = eqn.outvars[0].aval.shape
-    s = _norm(in_specs[0], len(src))
-    # keep specs on dims whose sizes line up from the left until the first
-    # divergence (covers squeeze/unsqueeze/flatten-tail patterns)
+def _map_reshape_spec(src, dst, s):
+    """Carry specs across a reshape for dims whose sizes line up from the
+    left until the first divergence (squeeze/unsqueeze/flatten-tail
+    patterns) — the ONE dim-correspondence walk shared by the forward and
+    backward rules, so the matching semantics cannot diverge."""
     out = [None] * len(dst)
     i = j = 0
     while i < len(src) and j < len(dst):
@@ -121,7 +119,15 @@ def _reshape_rule(eqn, in_specs):
             j += 1
         else:
             break
-    return [P(*out)], []
+    return out
+
+
+@_rule("reshape")
+def _reshape_rule(eqn, in_specs):
+    src = eqn.invars[0].aval.shape
+    dst = eqn.outvars[0].aval.shape
+    s = _norm(in_specs[0], len(src))
+    return [P(*_map_reshape_spec(src, dst, s))], []
 
 
 @_rule("reduce_sum", "reduce_max", "reduce_min", "reduce_prod",
@@ -286,23 +292,11 @@ def _bwd_broadcast(eqn, out_spec):
 
 
 def _bwd_reshape(eqn, out_spec):
+    # the same correspondence walk, with src/dst swapped
     src = eqn.invars[0].aval.shape
     dst = eqn.outvars[0].aval.shape
     o = _norm(out_spec, len(dst))
-    spec = [None] * len(src)
-    i = j = 0
-    while i < len(src) and j < len(dst):
-        if src[i] == dst[j]:
-            spec[i] = o[j]
-            i += 1
-            j += 1
-        elif src[i] == 1:
-            i += 1
-        elif dst[j] == 1:
-            j += 1
-        else:
-            break
-    return [P(*spec)]
+    return [P(*_map_reshape_spec(dst, src, o))]
 
 
 def _bwd_reduce(eqn, out_spec):
@@ -395,6 +389,10 @@ def complete_bidirectional(fn, in_specs: Sequence, *example_args,
     for var in jaxpr.constvars:
         env[var] = P()
     if out_specs is not None:
+        if len(list(out_specs)) != len(jaxpr.outvars):
+            raise ValueError(
+                f"got {len(list(out_specs))} output specs for "
+                f"{len(jaxpr.outvars)} jaxpr outputs")
         for var, spec in zip(jaxpr.outvars, out_specs):
             if spec is not None and not isinstance(
                     var, jax.extend.core.Literal):

@@ -105,8 +105,10 @@ class Engine:
         for idx, spec in plan.weight_specs().items():
             if idx >= len(names):   # an activation input, not a parameter
                 continue
-            if any(a is not None for a in spec):
-                entries[names[idx]]._partition_spec = spec
+            # always assign — a trivial spec must OVERWRITE a stale tag
+            # from an earlier plan on a different mesh, or infer_param_specs
+            # would build a NamedSharding over an axis that no longer exists
+            entries[names[idx]]._partition_spec = spec
         return plan
 
     # -- loops ---------------------------------------------------------------

@@ -64,6 +64,7 @@ class DotSite:
     rhs_invar: Optional[int]    # jaxpr INVAR INDEX of the weight (or None)
     out_bytes: int = 0
     lhs_bytes: int = 0
+    lead: int = 1               # leading row dim (what P(dp, ...) shards)
 
 
 @dataclass(frozen=True)
@@ -139,8 +140,10 @@ def extract_dot_graph(closed) -> List[DotSite]:
             k = _flat(lhs.aval.shape, list(lc))
             n = _flat(rhs.aval.shape, rfree)
             lr = root(lhs)
+            lead_dims = list(lb) or lfree[:1]
             site = DotSite(
                 eqn_index=idx, m=m, k=k, n=n,
+                lead=int(lhs.aval.shape[lead_dims[0]]) if lead_dims else 1,
                 lhs_src=producer.get(lr),
                 # DIRECT invar only: a rhs reached through transpose/reshape
                 # would need the spec re-oriented to tag the raw parameter
@@ -151,10 +154,20 @@ def extract_dot_graph(closed) -> List[DotSite]:
                 * lhs.aval.dtype.itemsize)
             sites.append(site)
             producer[eqn.outvars[0]] = len(sites) - 1
-        elif prim in _PASSTHROUGH and eqn.invars:
-            # output aliases its first array operand for tracing purposes
-            src = next((v for v in eqn.invars
-                        if not isinstance(v, jax.extend.core.Literal)), None)
+        elif eqn.invars and (prim in _PASSTHROUGH
+                             or prim in ("jit", "pjit")):
+            # output aliases the first SAME-SHAPE array operand: this
+            # skips select_n's bool predicate and traces through jitted
+            # elementwise sub-functions (jnp.where and friends lower to a
+            # `jit` eqn) so masking/dropout between matmuls doesn't break
+            # the producer chain and silently zero the resharding edges
+            out_aval = eqn.outvars[0].aval
+            src = next(
+                (v for v in eqn.invars
+                 if not isinstance(v, jax.extend.core.Literal)
+                 and getattr(v.aval, "shape", None) == out_aval.shape
+                 and getattr(v.aval, "dtype", None) == out_aval.dtype),
+                None)
             if src is not None:
                 for ov in eqn.outvars:
                     alias[ov] = src
@@ -180,7 +193,10 @@ def _candidates(mesh_axes: Dict[str, int], batch_axes: Sequence[str],
 
 
 def _divisible(site: DotSite, strat: Strategy, axes: Dict[str, int]) -> bool:
-    if strat.dp_axis and site.m % axes[strat.dp_axis]:
+    # dp shards the LEADING dim (that is what P(dp, ...) pins), not the
+    # flattened batch*free product — a (4,16,256) lhs on dp=8 must be
+    # rejected even though 4*16 divides 8
+    if strat.dp_axis and site.lead % axes[strat.dp_axis]:
         return False
     if strat.tp_axis:
         s = axes[strat.tp_axis]
@@ -237,24 +253,6 @@ def search_op_shardings(fn, example_args, mesh_axes: Dict[str, int],
     model_axes = [a for a in model_axes if a in mesh_axes]
     cands = _candidates(mesh_axes, batch_axes, model_axes)
 
-    def node_cost(site, strat):
-        par = 1
-        if strat.dp_axis:
-            par *= mesh_axes[strat.dp_axis]
-        if strat.tp_axis:
-            par *= mesh_axes[strat.tp_axis]
-        t = 2.0 * site.m * site.k * site.n / par / chip_flops
-        if strat.kind.endswith("row"):
-            s = mesh_axes[strat.tp_axis]
-            dp = mesh_axes[strat.dp_axis] if strat.dp_axis else 1
-            t += (site.out_bytes / dp) * 2 * (s - 1) / s / ici_bytes_per_s
-        return t
-
-    def edge_cost(site, prev_strat, strat):
-        src = prev_strat.y_spec() if prev_strat is not None else P()
-        return _reshard_bytes(src, strat.x_spec(), site.lhs_bytes,
-                              mesh_axes) / ici_bytes_per_s
-
     # beam over topological (program) order
     states: List[Tuple[float, List[Strategy]]] = [(0.0, [])]
     for site in sites:
@@ -264,13 +262,56 @@ def search_op_shardings(fn, example_args, mesh_axes: Dict[str, int],
             for strat in cands:
                 if not _divisible(site, strat, mesh_axes):
                     continue
-                c = cost + node_cost(site, strat) \
-                    + edge_cost(site, prev, strat)
+                c = cost + node_cost(site, strat, mesh_axes, chip_flops,
+                                     ici_bytes_per_s) \
+                    + edge_cost(site, prev, strat, mesh_axes,
+                                ici_bytes_per_s)
                 nxt.append((c, hist + [strat]))
         nxt.sort(key=lambda t: t[0])
         states = nxt[:beam]
     best_cost, best = states[0]
     return ShardingPlan(sites, best, best_cost, dict(mesh_axes))
+
+
+def node_cost(site: DotSite, strat: Strategy, mesh_axes: Dict[str, int],
+              chip_flops: float = 197e12,
+              ici_bytes_per_s: float = 9e10) -> float:
+    """Predicted seconds for one dot under `strat`: sharded flops + the
+    row-parallel psum."""
+    par = 1
+    if strat.dp_axis:
+        par *= mesh_axes[strat.dp_axis]
+    if strat.tp_axis:
+        par *= mesh_axes[strat.tp_axis]
+    t = 2.0 * site.m * site.k * site.n / par / chip_flops
+    if strat.kind.endswith("row"):
+        s = mesh_axes[strat.tp_axis]
+        dp = mesh_axes[strat.dp_axis] if strat.dp_axis else 1
+        t += (site.out_bytes / dp) * 2 * (s - 1) / s / ici_bytes_per_s
+    return t
+
+
+def edge_cost(site: DotSite, prev_strat: Optional[Strategy],
+              strat: Strategy, mesh_axes: Dict[str, int],
+              ici_bytes_per_s: float = 9e10) -> float:
+    """Resharding seconds to feed this dot's lhs from its producer."""
+    src = prev_strat.y_spec() if prev_strat is not None else P()
+    return _reshard_bytes(src, strat.x_spec(), site.lhs_bytes,
+                          mesh_axes) / ici_bytes_per_s
+
+
+def plan_cost(sites: Sequence[DotSite], decisions: Sequence[Strategy],
+              mesh_axes: Dict[str, int], chip_flops: float = 197e12,
+              ici_bytes_per_s: float = 9e10) -> float:
+    """Score an explicit strategy assignment with the SAME model the
+    search uses — lets callers/tests compare rejected plans."""
+    total = 0.0
+    for site, strat in zip(sites, decisions):
+        prev = decisions[site.lhs_src] if site.lhs_src is not None else None
+        total += node_cost(site, strat, mesh_axes, chip_flops,
+                           ici_bytes_per_s)
+        total += edge_cost(site, prev, strat, mesh_axes, ici_bytes_per_s)
+    return total
 
 
 def apply_plan(fn, plan: ShardingPlan, mesh):

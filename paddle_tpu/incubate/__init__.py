@@ -2,8 +2,14 @@
 parallelism, fused nn layers, distributed models)."""
 from . import asp  # noqa: F401
 from . import autograd  # noqa: F401
+from . import autotune  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import distributed  # noqa: F401
 from . import nn  # noqa: F401
+from . import operators  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import multiprocessing  # noqa: F401
+from . import sparse  # noqa: F401
+from . import tensor  # noqa: F401
+from .tensor import (segment_max, segment_mean, segment_min,  # noqa: F401
+                     segment_sum)

@@ -1,0 +1,67 @@
+"""paddle.incubate.autotune — parity with
+python/paddle/incubate/autotune.py (set_config:23: three tuning domains
+"kernel" / "layout" / "dataloader", accepting a dict or a JSON file).
+
+TPU mapping of each domain:
+- kernel: XLA autotunes its own kernels during compilation; the knob
+  gates our opt-in Pallas alternates instead (flash attention is always
+  on; the measured-off-by-default LN kernels stay off unless the user
+  flips them explicitly — see docs/PERF.md dead-end list).
+- layout: toggles nn.channels_last (NHWC), the reference's AMP layout
+  autotune analog.  Measured neutral on TPU (XLA re-lays out convs) but
+  kept for API parity.
+- dataloader: records the requested tuning for inspection via
+  get_config() (the reference's reader.set_autotune_config analog; the
+  DataLoader's worker heuristics are already dynamic here).
+"""
+from __future__ import annotations
+
+import json
+import warnings
+
+__all__ = ["set_config"]
+
+_config = {"kernel": {"enable": False, "tuning_range": [1, 10]},
+           "layout": {"enable": False},
+           "dataloader": {"enable": False}}
+
+
+def get_config() -> dict:
+    return dict(_config)
+
+
+def set_config(config=None):
+    """Enable/disable the autotune domains.  config: None (enable all),
+    a dict like {"kernel": {"enable": True, "tuning_range": [1, 3]}},
+    or a path to a JSON file with the same shape."""
+    if config is None:
+        for dom in _config.values():
+            dom["enable"] = True
+        _apply()
+        return
+    if isinstance(config, str):
+        with open(config) as f:
+            config = json.load(f)
+    if not isinstance(config, dict):
+        raise ValueError(
+            "config should be a dict, a json file path, or None")
+    for key in ("kernel", "layout", "dataloader"):
+        if key not in config:
+            continue
+        dom = config[key]
+        if "enable" in dom:
+            if not isinstance(dom["enable"], bool):
+                warnings.warn(f"{key}.enable should be bool")
+            else:
+                _config[key]["enable"] = dom["enable"]
+        if key == "kernel" and "tuning_range" in dom:
+            if isinstance(dom["tuning_range"], (list, tuple)):
+                _config[key]["tuning_range"] = list(dom["tuning_range"])
+            else:
+                warnings.warn("kernel.tuning_range should be a list")
+    _apply()
+
+
+def _apply():
+    from ..nn import layout as _layout
+    _layout.set_global_channels_last(_config["layout"]["enable"])

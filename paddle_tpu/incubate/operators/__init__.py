@@ -1,0 +1,21 @@
+"""paddle.incubate.operators — parity with
+python/paddle/incubate/operators/ (graph_send_recv:30,
+graph_sample_neighbors, graph_reindex, graph_khop_sampler:23,
+softmax_mask_fuse:23, softmax_mask_fuse_upper_triangle:23,
+resnet_unit.ResNetUnit:125).
+
+The graph ops delegate to paddle.geometric (same kernels, older names);
+the softmax-mask fusions are expressed functionally — XLA fuses the mask
+add into the softmax the way the reference's hand-written CUDA kernel
+does; ResNetUnit composes conv+BN(+add)+relu, which is exactly the op
+set the fused cudnn path computes, left to XLA's fusion on TPU."""
+from .graph_ops import (graph_khop_sampler, graph_reindex,  # noqa: F401
+                        graph_sample_neighbors, graph_send_recv)
+from .resnet_unit import ResNetUnit, resnet_unit  # noqa: F401
+from .softmax_mask_fuse import softmax_mask_fuse  # noqa: F401
+from .softmax_mask_fuse_upper_triangle import (  # noqa: F401
+    softmax_mask_fuse_upper_triangle)
+
+__all__ = ["graph_send_recv", "graph_sample_neighbors", "graph_reindex",
+           "graph_khop_sampler", "softmax_mask_fuse",
+           "softmax_mask_fuse_upper_triangle", "ResNetUnit", "resnet_unit"]

@@ -1,0 +1,79 @@
+"""Graph incubate operators — legacy names over paddle.geometric
+(reference: incubate/operators/graph_send_recv.py:30,
+graph_sample_neighbors.py, graph_reindex.py, graph_khop_sampler.py:23 —
+all later stabilized under paddle.geometric, which is where our kernels
+live)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ...core.tensor import Tensor
+
+__all__ = ["graph_send_recv", "graph_sample_neighbors", "graph_reindex",
+           "graph_khop_sampler"]
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum",
+                    out_size=None, name=None):
+    from ...geometric import send_u_recv
+    return send_u_recv(x, src_index, dst_index, reduce_op=pool_type,
+                       out_size=out_size)
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
+                           perm_buffer=None, sample_size=-1,
+                           return_eids=False, flag_perm_buffer=False,
+                           name=None):
+    from ...geometric import sample_neighbors
+    return sample_neighbors(
+        row, colptr, input_nodes, sample_size=sample_size, eids=eids,
+        return_eids=return_eids,
+        perm_buffer=perm_buffer if flag_perm_buffer else None)
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  flag_buffer_hashtable=False, name=None):
+    from ...geometric import reindex_graph
+    return reindex_graph(x, neighbors, count, value_buffer, index_buffer)
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """Multi-hop sampling + one reindex over the union frontier
+    (reference graph_khop_sampler.py:23: returns edge_src, edge_dst,
+    sample_index, reindex_nodes[, edge_eids])."""
+    from ...geometric import reindex_graph, sample_neighbors
+
+    frontier = input_nodes
+    all_neigh, all_cnt, all_eids = [], [], []
+    dst_nodes = []   # per-hop source frontiers, concatenated for reindex
+    for size in sample_sizes:
+        if return_eids:
+            neigh, cnt, eids = sample_neighbors(
+                row, colptr, frontier, sample_size=size,
+                eids=sorted_eids, return_eids=True)
+            all_eids.append(np.asarray(eids.numpy()).reshape(-1))
+        else:
+            neigh, cnt = sample_neighbors(row, colptr, frontier,
+                                          sample_size=size)
+        all_neigh.append(np.asarray(neigh.numpy()).reshape(-1))
+        all_cnt.append(np.asarray(cnt.numpy()).reshape(-1))
+        dst_nodes.append(np.asarray(
+            frontier.numpy() if hasattr(frontier, "numpy") else frontier
+        ).reshape(-1))
+        frontier = Tensor(np.unique(all_neigh[-1]))
+    dst_cat = np.concatenate(dst_nodes)
+    neigh_cat = np.concatenate(all_neigh)
+    cnt_cat = np.concatenate(all_cnt).astype(np.int32)
+    edge_src, edge_dst, sample_index = reindex_graph(
+        Tensor(dst_cat), Tensor(neigh_cat), Tensor(cnt_cat))
+    # reindex id of the ORIGINAL input nodes = their positions (x-first
+    # ordering contract of reindex_graph)
+    n_in = len(np.asarray(
+        input_nodes.numpy() if hasattr(input_nodes, "numpy")
+        else input_nodes).reshape(-1))
+    reindex_nodes = Tensor(np.arange(n_in, dtype=dst_cat.dtype))
+    if return_eids:
+        return (edge_src, edge_dst, sample_index, reindex_nodes,
+                Tensor(np.concatenate(all_eids)))
+    return edge_src, edge_dst, sample_index, reindex_nodes

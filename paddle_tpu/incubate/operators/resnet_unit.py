@@ -1,0 +1,109 @@
+"""ResNetUnit — parity with incubate/operators/resnet_unit.py:125 (the
+cudnn fused conv+BN(+add)+relu block used by performance ResNets).
+
+TPU-native: the same math composed from conv2d + batch_norm + add +
+relu; XLA's conv/elementwise fusion is the TPU counterpart of the cudnn
+fused op (docs/PERF.md measured XLA's conv+BN chains at roofline in
+isolation — a hand kernel buys nothing here)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ... import nn
+from ...nn import functional as F
+
+__all__ = ["ResNetUnit", "resnet_unit"]
+
+
+def resnet_unit(x, filter_x, scale_x, bias_x, mean_x, var_x, z=None,
+                filter_z=None, scale_z=None, bias_z=None, mean_z=None,
+                var_z=None, stride=1, stride_z=1, padding=0, dilation=1,
+                groups=1, momentum=0.9, eps=1e-5, data_format="NHWC",
+                fuse_add=False, has_shortcut=False, use_global_stats=False,
+                is_test=False, act="relu"):
+    """Functional form: y = act(BN(conv(x)) [+ BN(conv(z)) | + z])."""
+    def branch(inp, w, scale, bias, mean, var, s, pad):
+        out = F.conv2d(inp, w, stride=s, padding=pad,
+                       dilation=dilation, groups=groups,
+                       data_format=data_format)
+        return F.batch_norm(out, mean, var, scale, bias,
+                            training=not is_test, momentum=momentum,
+                            epsilon=eps, data_format=data_format,
+                            use_global_stats=use_global_stats)
+
+    out = branch(x, filter_x, scale_x, bias_x, mean_x, var_x, stride,
+                 padding)
+    if has_shortcut:
+        # the shortcut conv is 1x1: no spatial padding (reference builds
+        # its conv_z attrs with padding 0)
+        out = out + branch(z, filter_z, scale_z, bias_z, mean_z, var_z,
+                           stride_z, 0)
+    elif fuse_add:
+        out = out + z
+    if act == "relu":
+        out = F.relu(out)
+    return out
+
+
+class ResNetUnit(nn.Layer):
+    """Layer form (reference ResNetUnit Layer): owns the conv filters and
+    BN params for the main branch and (optionally) the shortcut."""
+
+    def __init__(self, num_channels_x, num_filters, filter_size, stride=1,
+                 momentum=0.9, eps=1e-5, data_format="NHWC", act="relu",
+                 fuse_add=False, has_shortcut=False, use_global_stats=False,
+                 is_test=False, filter_x_attr=None, scale_x_attr=None,
+                 bias_x_attr=None, moving_mean_x_name=None,
+                 moving_var_x_name=None, num_channels_z=1, stride_z=1,
+                 filter_z_attr=None, scale_z_attr=None, bias_z_attr=None,
+                 moving_mean_z_name=None, moving_var_z_name=None):
+        super().__init__()
+        self._stride = stride
+        self._stride_z = stride_z
+        self._padding = (filter_size - 1) // 2
+        self._momentum = momentum
+        self._eps = eps
+        self._data_format = data_format
+        self._act = act
+        self._fuse_add = fuse_add
+        self._has_shortcut = has_shortcut
+        self._use_global_stats = use_global_stats
+        self._is_test = is_test
+
+        k = (filter_size, filter_size)
+        self.filter_x = self.create_parameter(
+            (num_filters, num_channels_x // 1) + k, attr=filter_x_attr)
+        self.scale_x = self.create_parameter(
+            (num_filters,), attr=scale_x_attr, is_bias=False,
+            default_initializer=nn.initializer.Constant(1.0))
+        self.bias_x = self.create_parameter(
+            (num_filters,), attr=bias_x_attr, is_bias=True)
+        from ...core.tensor import Tensor
+        self.register_buffer("mean_x",
+                             Tensor(np.zeros(num_filters, "float32")))
+        self.register_buffer("var_x",
+                             Tensor(np.ones(num_filters, "float32")))
+        if has_shortcut:
+            self.filter_z = self.create_parameter(
+                (num_filters, num_channels_z) + (1, 1), attr=filter_z_attr)
+            self.scale_z = self.create_parameter(
+                (num_filters,), attr=scale_z_attr,
+                default_initializer=nn.initializer.Constant(1.0))
+            self.bias_z = self.create_parameter(
+                (num_filters,), attr=bias_z_attr, is_bias=True)
+            self.register_buffer(
+                "mean_z", Tensor(np.zeros(num_filters, "float32")))
+            self.register_buffer(
+                "var_z", Tensor(np.ones(num_filters, "float32")))
+        else:
+            self.filter_z = self.scale_z = self.bias_z = None
+            self.mean_z = self.var_z = None
+
+    def forward(self, x, z=None):
+        return resnet_unit(
+            x, self.filter_x, self.scale_x, self.bias_x, self.mean_x,
+            self.var_x, z, self.filter_z, self.scale_z, self.bias_z,
+            self.mean_z, self.var_z, self._stride, self._stride_z,
+            self._padding, 1, 1, self._momentum, self._eps,
+            self._data_format, self._fuse_add, self._has_shortcut,
+            self._use_global_stats, self._is_test, self._act)

@@ -1,0 +1,19 @@
+"""softmax_mask_fuse — parity with
+incubate/operators/softmax_mask_fuse.py:23 (fused_softmax_mask CUDA
+kernel: softmax(x + mask) in one pass).  On TPU the add feeds XLA's
+softmax fusion directly — same single-pass execution, no custom kernel
+needed."""
+from __future__ import annotations
+
+import jax
+
+from ...core.op import defop
+
+__all__ = ["softmax_mask_fuse"]
+
+
+@defop
+def softmax_mask_fuse(x, mask, name=None):
+    """x: [B, H, T, T] attention scores; mask: [B, 1, T, T] additive mask
+    (-10000-style).  Returns softmax(x + mask, axis=-1)."""
+    return jax.nn.softmax(x + mask, axis=-1)

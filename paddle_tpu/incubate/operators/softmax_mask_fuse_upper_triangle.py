@@ -1,0 +1,27 @@
+"""softmax_mask_fuse_upper_triangle — parity with
+incubate/operators/softmax_mask_fuse_upper_triangle.py:23 (causal-masked
+softmax without materializing the mask).  The lax.lt iota comparison is
+fused by XLA into the softmax pass, matching the reference kernel's
+intent on TPU."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.op import defop
+
+__all__ = ["softmax_mask_fuse_upper_triangle"]
+
+
+@defop
+def softmax_mask_fuse_upper_triangle(x):
+    """x: [B, H, T, T] scores; masks the strict upper triangle (future
+    positions) before the softmax."""
+    t = x.shape[-1]
+    rows = jax.lax.broadcasted_iota(jnp.int32, (t, t), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (t, t), 1)
+    neg = jnp.asarray(jnp.finfo(
+        x.dtype if jnp.issubdtype(x.dtype, jnp.floating)
+        else jnp.float32).min, x.dtype)
+    masked = jnp.where(cols <= rows, x, neg)
+    return jax.nn.softmax(masked, axis=-1)

@@ -1,0 +1,9 @@
+"""paddle.incubate.sparse — the incubating sparse namespace (reference:
+python/paddle/incubate/sparse/__init__.py re-exports creation/unary/
+binary/multiary/nn).  This paddle version keeps sparse under incubate;
+our implementations live in paddle_tpu.sparse — re-exported here with
+the reference's submodule layout."""
+from ...sparse import *  # noqa: F401,F403
+from ...sparse import (SparseCooTensor, SparseCsrTensor,  # noqa: F401
+                       sparse_coo_tensor, sparse_csr_tensor)
+from . import binary, creation, multiary, nn, unary  # noqa: F401

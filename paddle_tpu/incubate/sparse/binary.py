@@ -1,0 +1,3 @@
+"""incubate/sparse/binary.py parity."""
+from ...sparse import (add, divide, masked_matmul, matmul,  # noqa: F401
+                       multiply, mv, subtract)

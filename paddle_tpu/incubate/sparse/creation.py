@@ -1,0 +1,2 @@
+"""incubate/sparse/creation.py parity."""
+from ...sparse import sparse_coo_tensor, sparse_csr_tensor  # noqa: F401

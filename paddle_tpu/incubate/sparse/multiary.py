@@ -1,0 +1,2 @@
+"""incubate/sparse/multiary.py parity."""
+from ...sparse import addmm  # noqa: F401

@@ -1,0 +1,40 @@
+"""paddle.incubate.sparse.nn — sparse layers (reference:
+incubate/sparse/nn/__init__.py: ReLU, ReLU6, LeakyReLU, Softmax over the
+sparse functional ops; the 3-D sparse convs (Conv3D/SubmConv3D/MaxPool3D)
+are backed by cuSPARSE gather-scatter kernels in the reference and are
+not ported — jax.experimental.sparse has no submanifold conv; an import
+error here would be dishonest, absence is)."""
+from __future__ import annotations
+
+from ... import sparse as _sp
+from ...nn.layer_base import Layer
+
+__all__ = ["ReLU", "ReLU6", "LeakyReLU", "Softmax"]
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return _sp.relu(x)
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        return _sp.relu6(x)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01):
+        super().__init__()
+        self._slope = negative_slope
+
+    def forward(self, x):
+        return _sp.leaky_relu(x, self._slope)
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, x):
+        return _sp.softmax(x, self._axis)

@@ -1,0 +1,7 @@
+"""paddle.incubate.tensor.math — segment reductions (reference:
+python/paddle/incubate/tensor/math.py:28,92,158,224 — deprecated shims
+pointing at paddle.geometric.segment_*, which is where ours live)."""
+from ...geometric import (segment_max, segment_mean,  # noqa: F401
+                          segment_min, segment_sum)
+
+__all__ = ["segment_sum", "segment_mean", "segment_max", "segment_min"]

@@ -27,6 +27,16 @@ import threading
 __all__ = ["channels_last", "is_channels_last", "resolve_data_format"]
 
 _state = threading.local()
+# process-global default, set by paddle.incubate.autotune.set_config's
+# layout domain: a thread-local alone would make the global autotune
+# setting invisible to models built on worker threads
+_global_on = False
+
+
+def set_global_channels_last(flag: bool):
+    global _global_on
+    _global_on = bool(flag)
+
 
 _TO_CHANNEL_LAST = {
     "NCHW": "NHWC",
@@ -36,19 +46,26 @@ _TO_CHANNEL_LAST = {
 
 
 def is_channels_last() -> bool:
-    """True while inside a channels_last() construction context."""
-    return getattr(_state, "on", False)
+    """True while inside a channels_last() construction context (this
+    thread) or under the process-global autotune default."""
+    return getattr(_state, "on", _global_on)
 
 
 @contextlib.contextmanager
 def channels_last(enable: bool = True):
     """Construction context: image layers default to channel-last layouts."""
-    prev = getattr(_state, "on", False)
+    had = hasattr(_state, "on")
+    prev = getattr(_state, "on", None)
     _state.on = bool(enable)
     try:
         yield
     finally:
-        _state.on = prev
+        # restore EXACTLY: leaving a stale thread-local False behind would
+        # permanently shadow the process-global autotune default
+        if had:
+            _state.on = prev
+        else:
+            del _state.on
 
 
 def resolve_data_format(data_format: str) -> str:

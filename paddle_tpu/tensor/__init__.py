@@ -1,0 +1,26 @@
+"""paddle.tensor — the tensor-function namespace (reference:
+python/paddle/tensor/__init__.py re-exports math/creation/manipulation/
+linalg/logic/random/search/stat/attribute/einsum submodules).
+
+Here the implementations live in paddle_tpu.ops; this package provides
+the reference's import paths (`import paddle.tensor as T; T.math.add`,
+`from paddle.tensor.creation import arange`) over the same functions.
+"""
+from .. import ops as _ops
+from ..ops import creation, linalg, logic, manipulation, search  # noqa: F401
+from ..ops import math  # noqa: F401
+from ..ops import random_ops as random  # noqa: F401
+from ..ops import reduction as stat  # noqa: F401
+
+# every public tensor function is importable from paddle.tensor directly,
+# like the reference's flat re-export
+from ..ops.creation import *      # noqa: F401,F403
+from ..ops.linalg import *        # noqa: F401,F403
+from ..ops.logic import *         # noqa: F401,F403
+from ..ops.manipulation import *  # noqa: F401,F403
+from ..ops.math import *          # noqa: F401,F403
+from ..ops.random_ops import *    # noqa: F401,F403
+from ..ops.reduction import *     # noqa: F401,F403
+from ..ops.search import *        # noqa: F401,F403
+from ..ops.extended import *      # noqa: F401,F403
+from ..ops.linalg import einsum   # noqa: F401

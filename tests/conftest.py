@@ -48,7 +48,9 @@ def _isolate_global_state():
     fleet._hcg = None
     fleet._is_initialized = False
     fa._INTERPRET = False
-    layout._state.on = False
+    if hasattr(layout._state, "on"):
+        del layout._state.on
+    layout.set_global_channels_last(False)
     from paddle_tpu.kernels import layer_norm as _ln
     from paddle_tpu.kernels import ln_matmul as _lnmm
     _ln._MODE = "off"

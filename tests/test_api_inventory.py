@@ -128,6 +128,26 @@ SURFACE = {
                          "MFCC"],
     "paddle_tpu.sparse": ["sparse_coo_tensor", "sparse_csr_tensor", "matmul",
                           "masked_matmul", "relu"],
+    # legacy reader-creator dataset namespace + reader decorators
+    "paddle_tpu.dataset": ["mnist", "cifar", "flowers", "uci_housing",
+                           "imdb", "imikolov", "movielens", "conll05",
+                           "wmt14", "wmt16", "voc2012", "common", "image"],
+    "paddle_tpu.reader": ["cache", "map_readers", "buffered", "compose",
+                          "chain", "shuffle", "firstn", "xmap_readers",
+                          "multiprocess_reader"],
+    "paddle_tpu.tensor": ["math", "creation", "manipulation", "linalg",
+                          "logic", "random", "search", "stat", "einsum"],
+    "paddle_tpu.cost_model": ["CostModel"],
+    "paddle_tpu.incubate.operators": [
+        "graph_send_recv", "graph_sample_neighbors", "graph_reindex",
+        "graph_khop_sampler", "softmax_mask_fuse",
+        "softmax_mask_fuse_upper_triangle", "ResNetUnit", "resnet_unit"],
+    "paddle_tpu.incubate.sparse": ["sparse_coo_tensor", "matmul", "relu",
+                                   "creation", "unary", "binary",
+                                   "multiary", "nn"],
+    "paddle_tpu.incubate.tensor": ["segment_sum", "segment_mean",
+                                   "segment_max", "segment_min"],
+    "paddle_tpu.incubate.autotune": ["set_config"],
     "paddle_tpu.distribution": ["Normal", "Uniform", "Categorical", "Beta",
                                 "Dirichlet", "Multinomial", "kl_divergence",
                                 "TransformedDistribution"],

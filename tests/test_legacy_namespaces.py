@@ -1,0 +1,219 @@
+"""Legacy/parity namespaces added for reference coverage:
+paddle.dataset (reader creators), paddle.reader (decorators),
+paddle.tensor (function namespace), paddle.cost_model, and the
+paddle.incubate long tail (operators / sparse / tensor / autotune)."""
+import json
+import os
+import struct
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+# -- paddle.reader -----------------------------------------------------------
+
+def _range_reader(n):
+    def reader():
+        return iter(range(n))
+    return reader
+
+
+def test_reader_decorators_basic():
+    from paddle_tpu import reader as R
+    assert list(R.firstn(_range_reader(10), 3)()) == [0, 1, 2]
+    assert list(R.chain(_range_reader(2), _range_reader(2))()) == [0, 1, 0, 1]
+    assert list(R.map_readers(lambda a, b: a + b, _range_reader(3),
+                              _range_reader(3))()) == [0, 2, 4]
+    assert sorted(R.shuffle(_range_reader(5), 3)()) == list(range(5))
+    assert list(R.buffered(_range_reader(5), 2)()) == list(range(5))
+    cached = R.cache(_range_reader(4))
+    assert list(cached()) == list(cached()) == list(range(4))
+
+
+def test_reader_compose_alignment():
+    from paddle_tpu import reader as R
+    r = R.compose(_range_reader(3), _range_reader(3))
+    assert list(r()) == [(0, 0), (1, 1), (2, 2)]
+    bad = R.compose(_range_reader(2), _range_reader(3))
+    with pytest.raises(Exception):
+        list(bad())
+
+
+def test_reader_xmap_ordered_and_unordered():
+    from paddle_tpu import reader as R
+    sq = lambda x: x * x
+    out = list(R.xmap_readers(sq, _range_reader(20), 4, 8, order=True)())
+    assert out == [i * i for i in range(20)]
+    out = sorted(R.xmap_readers(sq, _range_reader(20), 4, 8)())
+    assert out == sorted(i * i for i in range(20))
+
+
+def test_reader_multiprocess():
+    from paddle_tpu import reader as R
+    out = sorted(R.multiprocess_reader(
+        [_range_reader(5), _range_reader(5)])())
+    assert out == sorted(list(range(5)) * 2)
+
+
+# -- paddle.dataset ----------------------------------------------------------
+
+def test_dataset_common_split_and_cluster(tmp_path):
+    from paddle_tpu.dataset import common
+    cwd = os.getcwd()
+    os.chdir(tmp_path)
+    try:
+        common.split(_range_reader(10), 4)
+        r = common.cluster_files_reader(str(tmp_path / "*.pickle"), 2, 0)
+        r2 = common.cluster_files_reader(str(tmp_path / "*.pickle"), 2, 1)
+        got = sorted(list(r()) + list(r2()))
+        assert got == list(range(10))
+    finally:
+        os.chdir(cwd)
+
+
+def test_dataset_common_download_is_local_only(tmp_path):
+    from paddle_tpu.dataset import common
+    with pytest.raises(IOError, match="egress"):
+        common.download("http://x/y.tgz", "nosuch", "0" * 32)
+
+
+def _write_idx(path, arr):
+    arr = np.asarray(arr, np.uint8)
+    with open(path, "wb") as f:
+        f.write(struct.pack(">I", 0x800 + arr.ndim))
+        for d in arr.shape:
+            f.write(struct.pack(">I", d))
+        f.write(arr.tobytes())
+
+
+def test_dataset_mnist_reader(tmp_path):
+    imgs = np.random.RandomState(0).randint(0, 255, (5, 28, 28))
+    labels = np.arange(5) % 10
+    _write_idx(tmp_path / "im.idx", imgs)
+    _write_idx(tmp_path / "lb.idx", labels)
+    r = paddle.dataset.mnist.train(image_path=str(tmp_path / "im.idx"),
+                                   label_path=str(tmp_path / "lb.idx"))
+    samples = list(r())
+    assert len(samples) == 5
+    img, label = samples[0]
+    assert img.shape == (784,) and img.min() >= -1 and img.max() <= 1
+    assert label == 0
+
+
+# -- paddle.tensor -----------------------------------------------------------
+
+def test_tensor_namespace():
+    import paddle_tpu.tensor as T
+    x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+    assert float(T.math.add(x, x).numpy()[1]) == 4.0
+    assert float(T.stat.mean(x)) == 2.0
+    assert T.creation.arange(3).shape == [3]
+    y = T.einsum("i,i->", x, x)
+    assert float(y) == 14.0
+
+
+# -- paddle.cost_model -------------------------------------------------------
+
+def test_cost_model_static_data_and_lookup():
+    from paddle_tpu.cost_model import CostModel
+    cm = CostModel()
+    data = cm.static_cost_data()
+    assert isinstance(data, list) and data
+    row = cm.get_static_op_time("matmul")
+    assert "op_time" in row and float(row["op_time"]) > 0
+
+
+def test_cost_model_profile_measure():
+    from paddle_tpu.cost_model import CostModel
+    cm = CostModel()
+    startup, main = cm.build_program()
+    out = cm.profile_measure(startup, main)
+    assert out["time"] > 0
+
+
+# -- paddle.incubate.operators ----------------------------------------------
+
+def test_softmax_mask_fuse():
+    from paddle_tpu.incubate.operators import (
+        softmax_mask_fuse, softmax_mask_fuse_upper_triangle)
+    rng = np.random.RandomState(0)
+    x = rng.standard_normal((2, 2, 4, 4)).astype(np.float32)
+    mask = np.where(rng.rand(2, 1, 4, 4) > 0.5, 0.0, -10000.0
+                    ).astype(np.float32)
+    out = softmax_mask_fuse(paddle.to_tensor(x), paddle.to_tensor(mask))
+    import jax
+    ref = np.asarray(jax.nn.softmax(x + mask, axis=-1))
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+
+    out = softmax_mask_fuse_upper_triangle(paddle.to_tensor(x))
+    causal = np.triu(np.full((4, 4), np.finfo(np.float32).min), k=1)
+    ref = np.asarray(jax.nn.softmax(x + causal, axis=-1))
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+    # rows sum to 1, strict upper triangle is ~0
+    assert abs(float(out.numpy()[0, 0, 0, 1:].sum())) < 1e-5
+
+
+def test_graph_khop_sampler():
+    from paddle_tpu.incubate.operators import graph_khop_sampler
+    # CSC graph: 4 nodes, edges into each node
+    colptr = paddle.to_tensor(np.array([0, 2, 4, 5, 6], np.int64))
+    row = paddle.to_tensor(np.array([1, 2, 0, 3, 0, 1], np.int64))
+    nodes = paddle.to_tensor(np.array([0, 1], np.int64))
+    src, dst, sample_index, reindex_nodes = graph_khop_sampler(
+        row, colptr, nodes, [2, 2])
+    assert src.shape[0] == dst.shape[0] > 0
+    si = np.asarray(sample_index.numpy())
+    assert si[0] == 0 and si[1] == 1  # input nodes lead the index space
+    assert np.asarray(reindex_nodes.numpy()).tolist() == [0, 1]
+    # all reindexed ids are valid positions in sample_index
+    assert int(np.asarray(src.numpy()).max()) < len(si)
+
+
+def test_resnet_unit_layer():
+    from paddle_tpu.incubate.operators import ResNetUnit
+    paddle.seed(0)
+    unit = ResNetUnit(num_channels_x=8, num_filters=16, filter_size=3,
+                      stride=2, data_format="NCHW", fuse_add=False,
+                      has_shortcut=True, num_channels_z=8, stride_z=2)
+    x = paddle.to_tensor(np.random.RandomState(0).standard_normal(
+        (2, 8, 8, 8)).astype(np.float32))
+    out = unit(x, x)
+    assert list(out.shape) == [2, 16, 4, 4]
+    assert float(out.numpy().min()) >= 0.0  # relu applied
+
+
+# -- paddle.incubate.{sparse,tensor,autotune} --------------------------------
+
+def test_incubate_sparse_alias():
+    import paddle_tpu.incubate.sparse as isp
+    i = paddle.to_tensor(np.array([[0, 1], [1, 0]], np.int64))
+    v = paddle.to_tensor(np.array([2.0, 3.0], np.float32))
+    coo = isp.sparse_coo_tensor(i, v, (2, 2))
+    dense = coo.to_dense().numpy()
+    assert dense[0, 1] == 2.0 and dense[1, 0] == 3.0
+    assert isp.creation.sparse_coo_tensor is isp.sparse_coo_tensor
+
+
+def test_incubate_segment_sum():
+    out = paddle.incubate.segment_sum(
+        paddle.to_tensor(np.array([[1.0, 2], [3, 4], [5, 6]], np.float32)),
+        paddle.to_tensor(np.array([0, 0, 1], np.int64)))
+    np.testing.assert_allclose(out.numpy(), [[4.0, 6.0], [5.0, 6.0]])
+
+
+def test_autotune_set_config(tmp_path):
+    from paddle_tpu.incubate import autotune
+    from paddle_tpu.nn import layout
+    autotune.set_config({"layout": {"enable": True}})
+    assert layout.is_channels_last()
+    autotune.set_config({"layout": {"enable": False}})
+    assert not layout.is_channels_last()
+    cfg = tmp_path / "c.json"
+    cfg.write_text(json.dumps(
+        {"kernel": {"enable": True, "tuning_range": [1, 3]}}))
+    autotune.set_config(str(cfg))
+    assert autotune.get_config()["kernel"]["tuning_range"] == [1, 3]
+    with pytest.raises(ValueError):
+        autotune.set_config(42)
