@@ -58,6 +58,10 @@ from . import quantization  # noqa: E402,F401
 from . import hub  # noqa: E402,F401
 from . import dataset  # noqa: E402,F401
 from . import reader  # noqa: E402,F401
+from . import regularizer  # noqa: E402,F401
+from . import sysconfig  # noqa: E402,F401
+from . import compat  # noqa: E402,F401
+from .batch import batch  # noqa: E402,F401
 from . import cost_model  # noqa: E402,F401
 from . import tensor  # noqa: E402,F401
 # `from .ops import *` already bound the name `linalg` to ops.linalg, which
@@ -117,4 +121,28 @@ def summary(net, input_size=None, dtypes=None):
 
 
 def flops(net, input_size, custom_ops=None, print_detail=False):
-    return 0
+    """Forward-pass FLOPs of `net` at `input_size` — measured from XLA's
+    own cost analysis of the traced forward (reference hapi/dynamic_flops
+    keeps a hand-maintained per-layer registry; the compiler's count
+    covers every op, custom ones included, so `custom_ops` is accepted
+    for API parity but unnecessary)."""
+    import numpy as _np
+
+    import jax as _j
+    import jax.numpy as _jnp
+
+    x = _jnp.zeros(tuple(input_size), _jnp.float32)
+
+    def fwd(xv):
+        out = net(Tensor(xv, _internal=True))
+        return out._value if isinstance(out, Tensor) else out
+
+    try:
+        cost = _j.jit(fwd).lower(x).compile().cost_analysis()
+    except Exception:
+        return 0
+    total = int(cost.get("flops", 0.0)) if cost else 0
+    if print_detail:
+        per_param = sum(int(_np.prod(p.shape)) for p in net.parameters())
+        print(f"Total Flops: {total}  Total Params: {per_param}")
+    return total

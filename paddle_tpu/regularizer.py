@@ -1,0 +1,6 @@
+"""paddle.regularizer — parity with python/paddle/regularizer.py
+(L1Decay/L2Decay; the coefficient objects optimizers and per-param
+`regularizer=` attrs consume — implementations live in optimizer)."""
+from .optimizer.optimizer import L1Decay, L2Decay  # noqa: F401
+
+__all__ = ["L1Decay", "L2Decay"]

@@ -1,0 +1,22 @@
+"""paddle.sysconfig — parity with python/paddle/sysconfig.py
+(get_include:20, get_lib:37): include/lib dirs for building extensions
+against this package (paired with utils.cpp_extension)."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include() -> str:
+    """Directory with the C headers extensions compile against (our
+    csrc/ ships paddle_ext.h, the PT_BUILD_OP ABI)."""
+    return os.path.join(_ROOT, "csrc")
+
+
+def get_lib() -> str:
+    """Directory holding compiled native libraries (cpp_extension JIT
+    outputs land beside the sources)."""
+    return os.path.join(_ROOT, "csrc")

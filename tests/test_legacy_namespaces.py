@@ -102,6 +102,29 @@ def test_dataset_mnist_reader(tmp_path):
     assert label == 0
 
 
+def test_batch_and_compat_and_sysconfig():
+    r = paddle.batch(lambda: iter(range(7)), 3)
+    assert list(r()) == [[0, 1, 2], [3, 4, 5], [6]]
+    r = paddle.batch(lambda: iter(range(7)), 3, drop_last=True)
+    assert list(r()) == [[0, 1, 2], [3, 4, 5]]
+    with pytest.raises(ValueError):
+        paddle.batch(lambda: iter([]), 0)
+    assert paddle.compat.to_text(b"ab") == "ab"
+    assert paddle.compat.to_bytes("ab") == b"ab"
+    assert paddle.compat.round(2.5) == 3.0 and paddle.compat.round(-2.5) == -3.0
+    assert paddle.regularizer.L2Decay(0.1).coeff == 0.1
+    assert os.path.isdir(paddle.sysconfig.get_include())
+
+
+def test_flops_counts_matmul():
+    import paddle_tpu.nn as nn
+    paddle.seed(0)
+    net = nn.Linear(64, 128)
+    f = paddle.flops(net, (4, 64))
+    # 2*M*K*N plus bias-add noise
+    assert f >= 2 * 4 * 64 * 128
+
+
 # -- paddle.tensor -----------------------------------------------------------
 
 def test_tensor_namespace():
