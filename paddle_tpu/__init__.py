@@ -139,7 +139,11 @@ def flops(net, input_size, custom_ops=None, print_detail=False):
 
     try:
         cost = _j.jit(fwd).lower(x).compile().cost_analysis()
-    except Exception:
+    except Exception as e:
+        import warnings as _w
+        _w.warn(f"paddle.flops could not trace the forward at input_size="
+                f"{tuple(input_size)} ({type(e).__name__}: {e}); "
+                f"returning 0")
         return 0
     total = int(cost.get("flops", 0.0)) if cost else 0
     if print_detail:

@@ -1,9 +1,158 @@
 """paddle.incubate.nn.functional parity: functional forms of the fused ops
-(incubate/nn/functional/fused_transformer.py)."""
+(incubate/nn/functional/fused_transformer.py: fused_multi_head_attention
+:371, fused_multi_transformer:661; fused_matmul_bias.py:21,80).  Each is
+the reference kernel's pseudo-code composed from jnp ops — XLA fuses the
+epilogues; the attention core rides the flash kernel via
+scaled_dot_product_attention."""
 from __future__ import annotations
 
+import jax.numpy as jnp
+
+from ....core.tensor import Tensor
 from ....nn import functional as _F
 from ....nn.functional.attention import scaled_dot_product_attention
+
+
+def _val(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
+                      name=None):
+    """fused_matmul_bias.py:21 (cublasLt epilogue fusion; XLA fuses the
+    bias add into the matmul's consumer chain here)."""
+    from .... import ops as _ops
+    out = _ops.matmul(x, y, transpose_x=transpose_x,
+                      transpose_y=transpose_y)
+    return out if bias is None else out + bias
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    """fused_matmul_bias.py:80."""
+    return fused_matmul_bias(x, weight, bias,
+                             transpose_y=transpose_weight)
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight,
+                               pre_layer_norm=False, pre_ln_scale=None,
+                               pre_ln_bias=None, ln_scale=None,
+                               ln_bias=None, pre_ln_epsilon=1e-5,
+                               qkv_bias=None, linear_bias=None,
+                               cache_kv=None, attn_mask=None,
+                               dropout_rate=0.5, attn_dropout_rate=0.5,
+                               ln_epsilon=1e-5, training=True,
+                               mode="upscale_in_train", ring_id=-1,
+                               add_residual=True, name=None):
+    """fused_transformer.py:371 — self-attention with the reference's
+    fused-op semantics: qkv_weight [3, nh, hd, e], qkv_bias [3, nh, hd];
+    returns out (and the updated cache_kv when one is passed)."""
+    xv = _val(x)
+    qkv_w = _val(qkv_weight)
+    residual = xv
+    h = xv
+    if pre_layer_norm:
+        h = _val(_F.layer_norm(Tensor(xv, _internal=True), xv.shape[-1:],
+                               weight=pre_ln_scale, bias=pre_ln_bias,
+                               epsilon=pre_ln_epsilon))
+    three, nh, hd, e = qkv_w.shape
+    qkv = jnp.einsum("bse,thde->bsthd", h, qkv_w)
+    if qkv_bias is not None:
+        qkv = qkv + _val(qkv_bias)[None, None]
+    q, k, v = (qkv[:, :, i] for i in range(3))          # [b, s, nh, hd]
+    if cache_kv is not None:
+        ckv = _val(cache_kv)                             # [2, b, nh, t, hd]
+        k = jnp.concatenate([jnp.moveaxis(ckv[0], 2, 1), k], axis=1)
+        v = jnp.concatenate([jnp.moveaxis(ckv[1], 2, 1), v], axis=1)
+    del e  # embed dim only documents the qkv_weight layout
+    out = _val(scaled_dot_product_attention(
+        Tensor(q, _internal=True), Tensor(k, _internal=True),
+        Tensor(v, _internal=True),
+        attn_mask=attn_mask, dropout_p=attn_dropout_rate,
+        training=training))                              # [b, s, nh, hd]
+    out = out.reshape(out.shape[0], out.shape[1], nh * hd)
+    out = _val(_F.linear(Tensor(out, _internal=True), linear_weight,
+                         linear_bias))
+    out = _val(_F.dropout(Tensor(out, _internal=True), p=dropout_rate,
+                          training=training, mode=mode))
+    if add_residual:
+        out = residual + out
+    if not pre_layer_norm:
+        out = _val(_F.layer_norm(Tensor(out, _internal=True),
+                                 out.shape[-1:], weight=ln_scale,
+                                 bias=ln_bias, epsilon=ln_epsilon))
+    result = Tensor(out, _internal=True)
+    if cache_kv is not None:
+        new_cache = jnp.stack([jnp.moveaxis(k, 1, 2),
+                               jnp.moveaxis(v, 1, 2)])
+        return result, Tensor(new_cache, _internal=True)
+    return result
+
+
+def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights,
+                            qkv_biases, linear_weights, linear_biases,
+                            ffn_ln_scales, ffn_ln_biases, ffn1_weights,
+                            ffn1_biases, ffn2_weights, ffn2_biases,
+                            pre_layer_norm=True, epsilon=1e-5,
+                            cache_kvs=None, time_step=None, attn_mask=None,
+                            dropout_rate=0.0, activation="gelu",
+                            training=False, mode="upscale_in_train",
+                            trans_qkvw=True, ring_id=-1, name=None):
+    """fused_transformer.py:661 — N pre-LN transformer layers in one
+    call (per-layer weight LISTS, optional KV caches for generation).
+    qkv_weights[i]: [3, nh, hd, e] when trans_qkvw (the reference
+    default)."""
+    out = x
+    new_caches = [] if cache_kvs is not None else None
+    n = len(qkv_weights)
+    for i in range(n):
+        qw = _val(qkv_weights[i])
+        if not trans_qkvw:                 # [e, 3, nh, hd] -> [3, nh, hd, e]
+            qw = jnp.moveaxis(qw, 0, -1)
+        cache_i = None
+        if cache_kvs is not None:
+            cache_i = cache_kvs[i]
+            if time_step is not None:
+                # reference decode contract: a FIXED-size cache
+                # [2, b, nh, max_len, hd] whose valid prefix is
+                # time_step — attending over the unwritten tail would
+                # softmax against garbage keys
+                t = int(time_step)
+                cache_i = Tensor(_val(cache_i)[:, :, :, :t], _internal=True)
+        ln_s = ln_scales[i] if ln_scales else None
+        ln_b = ln_biases[i] if ln_biases else None
+        attn = fused_multi_head_attention(
+            out, Tensor(qw, _internal=True), linear_weights[i],
+            pre_layer_norm=pre_layer_norm,
+            # pre-LN consumes ln as the PRE norm; post-LN as the POST one
+            pre_ln_scale=ln_s if pre_layer_norm else None,
+            pre_ln_bias=ln_b if pre_layer_norm else None,
+            ln_scale=None if pre_layer_norm else ln_s,
+            ln_bias=None if pre_layer_norm else ln_b,
+            pre_ln_epsilon=epsilon, ln_epsilon=epsilon,
+            qkv_bias=qkv_biases[i] if qkv_biases else None,
+            linear_bias=linear_biases[i] if linear_biases else None,
+            cache_kv=cache_i,
+            attn_mask=attn_mask, dropout_rate=dropout_rate,
+            attn_dropout_rate=dropout_rate, training=training, mode=mode)
+        if cache_kvs is not None:
+            attn, cache = attn
+            new_caches.append(cache)
+        fln_s = ffn_ln_scales[i] if ffn_ln_scales else None
+        fln_b = ffn_ln_biases[i] if ffn_ln_biases else None
+        out = fused_feedforward(
+            attn, ffn1_weights[i],
+            ffn1_biases[i] if ffn1_biases else None,
+            ffn2_weights[i],
+            ffn2_biases[i] if ffn2_biases else None,
+            ln1_scale=fln_s, ln1_bias=fln_b,
+            ln2_scale=fln_s, ln2_bias=fln_b,
+            ln1_epsilon=epsilon, ln2_epsilon=epsilon,
+            dropout1_rate=dropout_rate,
+            dropout2_rate=dropout_rate, activation=activation,
+            pre_layer_norm=pre_layer_norm, training=training, mode=mode)
+    if cache_kvs is not None:
+        return out, new_caches
+    return out
 
 
 def fused_feedforward(x, linear1_weight, linear1_bias, linear2_weight,

@@ -240,3 +240,86 @@ def test_autotune_set_config(tmp_path):
     assert autotune.get_config()["kernel"]["tuning_range"] == [1, 3]
     with pytest.raises(ValueError):
         autotune.set_config(42)
+
+
+# -- paddle.incubate.nn.functional fused forms -------------------------------
+
+def test_fused_mha_and_multi_transformer():
+    """Functional fused ops (reference incubate/nn/functional/
+    fused_transformer.py:371,661 and fused_matmul_bias.py:21,80):
+    reference qkv layout [3, nh, hd, e], KV-cache round trip, and the
+    N-layer fused_multi_transformer composition."""
+    from paddle_tpu.incubate.nn import functional as IF
+    rng = np.random.RandomState(0)
+    b, s, e, nh = 2, 6, 16, 4
+    hd = e // nh
+    x = paddle.to_tensor(rng.standard_normal((b, s, e)).astype(np.float32))
+    qkv_w = paddle.to_tensor(
+        rng.standard_normal((3, nh, hd, e)).astype(np.float32) * 0.1)
+    qkv_b = paddle.to_tensor(np.zeros((3, nh, hd), np.float32))
+    lw = paddle.to_tensor(
+        rng.standard_normal((e, e)).astype(np.float32) * 0.1)
+    lb = paddle.to_tensor(np.zeros((e,), np.float32))
+    ones_e = paddle.to_tensor(np.ones(e, np.float32))
+    zeros_e = paddle.to_tensor(np.zeros(e, np.float32))
+    out = IF.fused_multi_head_attention(
+        x, qkv_w, lw, pre_layer_norm=True, pre_ln_scale=ones_e,
+        pre_ln_bias=zeros_e, qkv_bias=qkv_b, linear_bias=lb,
+        dropout_rate=0.0, attn_dropout_rate=0.0, training=False)
+    assert list(out.shape) == [b, s, e]
+    # empty KV cache must reproduce the uncached output and grow to s
+    cache = paddle.to_tensor(np.zeros((2, b, nh, 0, hd), np.float32))
+    out2, newc = IF.fused_multi_head_attention(
+        x, qkv_w, lw, pre_layer_norm=True, pre_ln_scale=ones_e,
+        pre_ln_bias=zeros_e, qkv_bias=qkv_b, linear_bias=lb,
+        cache_kv=cache, dropout_rate=0.0, attn_dropout_rate=0.0,
+        training=False)
+    np.testing.assert_allclose(out.numpy(), out2.numpy(), rtol=1e-5)
+    assert list(newc.shape) == [2, b, nh, s, hd]
+
+    f1w = paddle.to_tensor(
+        rng.standard_normal((e, 4 * e)).astype(np.float32) * 0.1)
+    f1b = paddle.to_tensor(np.zeros(4 * e, np.float32))
+    f2w = paddle.to_tensor(
+        rng.standard_normal((4 * e, e)).astype(np.float32) * 0.1)
+    out3 = IF.fused_multi_transformer(
+        x, [ones_e] * 2, [zeros_e] * 2, [qkv_w] * 2, [qkv_b] * 2,
+        [lw] * 2, [lb] * 2, [ones_e] * 2, [zeros_e] * 2, [f1w] * 2,
+        [f1b] * 2, [f2w] * 2, [zeros_e] * 2, dropout_rate=0.0,
+        training=False)
+    assert list(out3.shape) == [b, s, e]
+    w8 = paddle.to_tensor(rng.standard_normal((e, 8)).astype(np.float32))
+    assert list(IF.fused_linear(x, w8).shape) == [b, s, 8]
+    assert list(IF.fused_matmul_bias(
+        x, w8, paddle.to_tensor(np.ones(8, np.float32))).shape) == [b, s, 8]
+
+    # post-LN mode must consume the provided norm weights (review fix):
+    # scaling ln gamma must change the output
+    outA = IF.fused_multi_transformer(
+        x, [ones_e] * 1, [zeros_e] * 1, [qkv_w] * 1, [qkv_b] * 1,
+        [lw] * 1, [lb] * 1, [ones_e] * 1, [zeros_e] * 1, [f1w] * 1,
+        [f1b] * 1, [f2w] * 1, [zeros_e] * 1, pre_layer_norm=False,
+        dropout_rate=0.0, training=False)
+    big = paddle.to_tensor(np.full(e, 3.0, np.float32))
+    outB = IF.fused_multi_transformer(
+        x, [big] * 1, [zeros_e] * 1, [qkv_w] * 1, [qkv_b] * 1,
+        [lw] * 1, [lb] * 1, [ones_e] * 1, [zeros_e] * 1, [f1w] * 1,
+        [f1b] * 1, [f2w] * 1, [zeros_e] * 1, pre_layer_norm=False,
+        dropout_rate=0.0, training=False)
+    assert np.abs(outA.numpy() - outB.numpy()).max() > 1e-3
+    # fixed-size cache + time_step: only the valid prefix is attended
+    max_len = 10
+    padded = np.zeros((2, b, nh, max_len, hd), np.float32)
+    out4, _ = IF.fused_multi_transformer(
+        x, [ones_e] * 1, [zeros_e] * 1, [qkv_w] * 1, [qkv_b] * 1,
+        [lw] * 1, [lb] * 1, [ones_e] * 1, [zeros_e] * 1, [f1w] * 1,
+        [f1b] * 1, [f2w] * 1, [zeros_e] * 1,
+        cache_kvs=[paddle.to_tensor(padded)], time_step=0,
+        dropout_rate=0.0, training=False)
+    out5, _ = IF.fused_multi_transformer(
+        x, [ones_e] * 1, [zeros_e] * 1, [qkv_w] * 1, [qkv_b] * 1,
+        [lw] * 1, [lb] * 1, [ones_e] * 1, [zeros_e] * 1, [f1w] * 1,
+        [f1b] * 1, [f2w] * 1, [zeros_e] * 1, dropout_rate=0.0,
+        training=False, cache_kvs=[paddle.to_tensor(
+            np.zeros((2, b, nh, 0, hd), np.float32))])
+    np.testing.assert_allclose(out4.numpy(), out5.numpy(), rtol=1e-5)
