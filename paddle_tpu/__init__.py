@@ -85,9 +85,64 @@ def finfo(dtype):
 
     from .core.dtype import convert_dtype as _cd
     return _mld.finfo(_cd(dtype))
+from .nn import ParamAttr  # noqa: E402,F401
 from .hapi import Model  # noqa: E402,F401
 from . import callbacks  # noqa: E402,F401
 from .distributed.parallel import DataParallel  # noqa: E402,F401
+from .ops.compat_surface import *  # noqa: E402,F401,F403
+
+# remaining reference top-level aliases (paddle/__init__.py __all__)
+bool = bool_  # noqa: A001 — the reference exports `paddle.bool`
+dtype = type(float32)
+VarBase = Tensor                      # legacy eager tensor alias
+LazyGuard = None                      # bound below (needs nn)
+CustomPlace = IPUPlace = MLUPlace = NPUPlace = XPUPlace = Place
+get_cuda_rng_state = get_rng_state    # device-agnostic RNG state here
+set_cuda_rng_state = set_rng_state
+commit = "unknown"                    # filled by release tooling upstream
+full_version = "0.1.0"
+
+
+def is_compiled_with_cinn() -> bool:  # noqa: A003
+    return False
+
+
+def is_compiled_with_ipu() -> bool:
+    return False
+
+
+def is_compiled_with_mlu() -> bool:
+    return False
+
+
+def is_compiled_with_npu() -> bool:
+    return False
+
+
+def get_cudnn_version():
+    """None: no cuDNN in a TPU build (reference returns an int or None)."""
+    return None
+
+
+def disable_signal_handler():
+    """No-op: the runtime installs no custom signal handlers to disable
+    (the reference unhooks its C++ fault handlers here)."""
+
+
+class LazyGuard:  # noqa: F811
+    """Delayed parameter materialization (reference paddle.LazyGuard) —
+    maps onto nn.abstract_init: layers built inside the guard carry
+    shape/dtype only until a train step or explicit init materializes
+    them."""
+
+    def __enter__(self):
+        from .nn import abstract_init
+        self._cm = abstract_init()
+        self._cm.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self._cm.__exit__(*exc)
 
 __version__ = "0.1.0"
 

@@ -7,6 +7,7 @@ from . import checkpoint  # noqa: F401
 from . import distributed  # noqa: F401
 from . import nn  # noqa: F401
 from . import operators  # noqa: F401
+from . import passes  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import multiprocessing  # noqa: F401
 from . import sparse  # noqa: F401

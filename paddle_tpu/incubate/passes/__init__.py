@@ -1,0 +1,23 @@
+"""paddle.incubate.passes — IR-pass namespace (reference:
+incubate/passes/fuse_resnet_unit_pass.py rewrites conv+BN(+add)+relu
+subgraphs into the fused resnet_unit op).
+
+TPU-native: XLA's fusion pipeline performs this rewrite during
+compilation (docs/PERF.md measured its conv+BN chains at roofline), so
+`fuse_resnet_unit()` records the request and returns — the semantics the
+pass would produce are what the compiler already emits.  The
+`ResNetUnit` layer itself lives in paddle.incubate.operators."""
+from __future__ import annotations
+
+_requested = False
+
+
+def fuse_resnet_unit():
+    """API-parity entry: on TPU the fusion is the compiler's job; this
+    marks the intent (inspectable via `fuse_resnet_unit_requested()`)."""
+    global _requested
+    _requested = True
+
+
+def fuse_resnet_unit_requested() -> bool:
+    return _requested

@@ -1,0 +1,217 @@
+"""Top-level API surface long tail — functions the reference exports
+from `paddle.*` that compose from existing ops (reference:
+python/paddle/tensor/{math,manipulation,attribute,stat}.py entries in
+paddle/__init__.py __all__): gcd/lcm/heaviside/diff/bucketize/take/
+nanquantile/vsplit/rank/shape/is_* dtype predicates, the in-place
+`*_` variants, and legacy aliases (mm/mod/floor_mod/reverse/cast)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.op import defop
+from ..core.tensor import Tensor
+
+__all__ = ["gcd", "lcm", "heaviside", "diff", "bucketize", "take",
+           "nanquantile", "vsplit", "rank", "shape", "is_complex",
+           "is_floating_point", "is_integer", "cast", "mm", "mod",
+           "floor_mod", "reverse", "tolist", "squeeze_", "unsqueeze_",
+           "reshape_", "scatter_", "index_add_", "set_printoptions",
+           "create_parameter"]
+
+
+@defop
+def gcd(x, y, name=None):
+    return jnp.gcd(x, y)
+
+
+@defop
+def lcm(x, y, name=None):
+    return jnp.lcm(x, y)
+
+
+@defop
+def heaviside(x, y, name=None):
+    """Heaviside step with y giving the value at 0 (math.heaviside)."""
+    return jnp.heaviside(x, y)
+
+
+@defop
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    return jnp.diff(x, n=n, axis=axis,
+                    prepend=prepend if prepend is None else jnp.asarray(
+                        prepend._value if isinstance(prepend, Tensor)
+                        else prepend),
+                    append=append if append is None else jnp.asarray(
+                        append._value if isinstance(append, Tensor)
+                        else append))
+
+
+@defop
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    """Index of the bucket each x falls into (searchsorted over a 1-D
+    boundary sequence; manipulation.bucketize)."""
+    side = "right" if right else "left"
+    out = jnp.searchsorted(sorted_sequence, x, side=side)
+    return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+
+@defop
+def take(x, index, mode="raise", name=None):
+    """Flat-index gather (tensor/math.take): x treated as 1-D."""
+    flat = x.reshape(-1)
+    idx = index.astype(jnp.int64)
+    n = flat.shape[0]
+    if mode == "wrap":
+        idx = ((idx % n) + n) % n
+    elif mode == "clip":
+        idx = jnp.clip(idx, 0, n - 1)
+    else:  # "raise": jit cannot raise on device values; clamp like gather
+        idx = jnp.where(idx < 0, idx + n, idx)
+        idx = jnp.clip(idx, 0, n - 1)
+    return flat[idx]
+
+
+@defop
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    return jnp.nanquantile(x.astype(jnp.float64)
+                           if x.dtype == jnp.float64 else
+                           x.astype(jnp.float32), q, axis=axis,
+                           keepdims=keepdim)
+
+
+def vsplit(x, num_or_indices, name=None):
+    """Split along dim 0 (manipulation.vsplit)."""
+    from . import manipulation as M
+    if getattr(x, "ndim", 2) < 2:
+        raise ValueError(
+            f"vsplit expects a tensor with at least 2 dims, got {x.ndim}")
+    return M.split(x, num_or_indices, axis=0)
+
+
+def rank(input, name=None):
+    """0-D int32 tensor holding input's ndim (attribute.rank)."""
+    v = input._value if isinstance(input, Tensor) else jnp.asarray(input)
+    return Tensor(jnp.asarray(v.ndim, jnp.int32), _internal=True)
+
+
+def shape(input, name=None):
+    """1-D int32 tensor of the runtime shape (attribute.shape)."""
+    v = input._value if isinstance(input, Tensor) else jnp.asarray(input)
+    return Tensor(jnp.asarray(np.asarray(v.shape, np.int32)),
+                  _internal=True)
+
+
+def _dtype_of(x):
+    return x.dtype if not isinstance(x, Tensor) else np.dtype(
+        x._value.dtype)
+
+
+def is_complex(x) -> bool:
+    return jnp.issubdtype(_dtype_of(x), jnp.complexfloating)
+
+
+def is_floating_point(x) -> bool:
+    return jnp.issubdtype(_dtype_of(x), jnp.floating)
+
+
+def is_integer(x) -> bool:
+    return jnp.issubdtype(_dtype_of(x), jnp.integer)
+
+
+def cast(x, dtype):
+    """Top-level cast (the Tensor method's functional form)."""
+    return x.cast(dtype) if isinstance(x, Tensor) else \
+        Tensor(jnp.asarray(x), _internal=True).cast(dtype)
+
+
+def mm(input, mat2, name=None):
+    from .linalg import matmul
+    return matmul(input, mat2)
+
+
+def mod(x, y, name=None):
+    from .math import remainder
+    return remainder(x, y)
+
+
+floor_mod = mod
+
+
+def reverse(x, axis, name=None):
+    """Legacy alias of flip (fluid layers.reverse)."""
+    from .manipulation import flip
+    return flip(x, axis)
+
+
+def tolist(x):
+    return x.tolist() if isinstance(x, Tensor) else np.asarray(x).tolist()
+
+
+# -- in-place variants (reference *_ ops mutate the argument and return
+# it; here the Tensor's buffer is replaced, matching visible semantics) --
+
+def _inplace(x, new_value):
+    x._replace_(new_value._value if isinstance(new_value, Tensor)
+                else new_value, None)
+    return x
+
+
+def squeeze_(x, axis=None, name=None):
+    from .manipulation import squeeze
+    return _inplace(x, squeeze(x, axis))
+
+
+def unsqueeze_(x, axis, name=None):
+    from .manipulation import unsqueeze
+    return _inplace(x, unsqueeze(x, axis))
+
+
+def reshape_(x, shape, name=None):
+    from .manipulation import reshape
+    return _inplace(x, reshape(x, shape))
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    from .manipulation import scatter
+    return _inplace(x, scatter(x, index, updates, overwrite))
+
+
+def index_add_(x, index, axis, value, name=None):
+    from .manipulation import index_add
+    return _inplace(x, index_add(x, index, axis, value))
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Tensor repr formatting (reference framework.set_printoptions) —
+    mapped onto numpy's printoptions, which our Tensor repr uses."""
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """Standalone Parameter factory (reference paddle.create_parameter)."""
+    from .. import nn
+    from ..nn.layer_base import Parameter
+
+    from ..core.dtype import convert_dtype
+
+    init = default_initializer
+    if init is None:
+        init = nn.initializer.Constant(0.0) if is_bias \
+            else nn.initializer.XavierUniform()
+    value = init(tuple(shape), convert_dtype(dtype))
+    return Parameter(value, name=name)

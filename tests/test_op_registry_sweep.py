@@ -298,6 +298,16 @@ spec("max_unpool2d",
      lambda: (F(1, 2, 2, 2), I64(1, 2, 2, 2, hi=16)),
      {"kernel_size": 2}, grad=False)
 spec("fused_ln_linear", lambda: (F(2, 4, 16), F(16), F(16), F(16, 8)))
+spec("gcd", lambda: (I64(4, hi=20), I64(4, hi=20)), grad=False)
+spec("lcm", lambda: (I64(4, hi=12), I64(4, hi=12)), grad=False)
+spec("heaviside", lambda: (F(3, 4), F(3, 4)))
+spec("diff", lambda: (F(3, 6),))
+spec("bucketize",
+     lambda: (F(3, 4), np.sort(np.asarray(F(5), np.float64))), grad=False)
+spec("take", lambda: (F(2, 6), I64(4, hi=12)))
+spec("nanquantile", lambda: (F(3, 5),), {"q": 0.5}, grad=False)
+spec("softmax_mask_fuse", lambda: (F(2, 2, 4, 4), F(2, 1, 4, 4)))
+spec("softmax_mask_fuse_upper_triangle", lambda: (F(2, 2, 4, 4),))
 
 # ops exercised via dedicated test files, not callable with simple
 # positional tensors here (reason recorded so the sweep stays exhaustive)
