@@ -47,3 +47,13 @@ from .layer.transformer import (  # noqa: F401
     MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
     TransformerDecoderLayer, TransformerDecoder, Transformer,
 )
+from .layer.extra import (  # noqa: F401
+    BeamSearchDecoder, Fold, HSigmoidLoss, MaxUnPool1D, MaxUnPool2D,
+    MaxUnPool3D, MultiLabelSoftMarginLoss, PairwiseDistance, SoftMarginLoss,
+    Softmax2D, ThresholdedReLU, TripletMarginWithDistanceLoss,
+    dynamic_decode, Unfold,
+)
+from . import utils  # noqa: F401
+from .utils import spectral_norm  # noqa: F401
+from .layer import loss  # noqa: F401  (reference exports nn.loss)
+from . import quant  # noqa: F401

@@ -13,3 +13,8 @@ from .attention import (  # noqa: F401
 )
 from ...ops.manipulation import pad  # noqa: F401
 from ...ops.creation import one_hot  # noqa: F401
+from .extra import *  # noqa: F401,F403
+# vision/sequence functionals whose kernels live in ops.extended
+from ...ops.extended import (affine_grid, diag_embed,  # noqa: F401
+                             gather_tree, grid_sample, max_unpool2d,
+                             temporal_shift)

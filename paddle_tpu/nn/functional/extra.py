@@ -1,0 +1,307 @@
+"""nn.functional long tail — parity with the reference exports that were
+still absent (python/paddle/nn/functional/__init__.py): distance /
+margin losses, hierarchical sigmoid, ArcFace-style margin softmax,
+sparse (CSR-masked) attention, pad/unpool variants and in-place
+activation forms."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.op import defop
+from ...core.tensor import Tensor
+
+__all__ = ["bilinear", "dice_loss", "npair_loss", "zeropad2d",
+           "pairwise_distance", "soft_margin_loss",
+           "multi_label_soft_margin_loss",
+           "triplet_margin_with_distance_loss", "thresholded_relu",
+           "hsigmoid_loss", "margin_cross_entropy", "sparse_attention",
+           "max_unpool1d", "max_unpool3d", "elu_", "softmax_", "tanh_"]
+
+
+def _reduce(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    if reduction == "none":
+        return loss
+    raise ValueError(f"reduction should be mean|sum|none, got {reduction}")
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    """common.bilinear: out[b,o] = x1[b,i] W[o,i,j] x2[b,j] (+ bias) —
+    the same kernel as ops.extended.bilinear_tensor_product (one einsum
+    to optimize/shard, two API names)."""
+    from ...ops.extended import bilinear_tensor_product
+    return bilinear_tensor_product(x1, x2, weight, bias)
+
+
+@defop
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """loss.dice_loss: input [N, ..., C] probabilities, label [N, ..., 1]
+    class ids."""
+    label_oh = jax.nn.one_hot(label.squeeze(-1), input.shape[-1],
+                              dtype=input.dtype)
+    reduce_dims = tuple(range(1, input.ndim))
+    inter = jnp.sum(input * label_oh, axis=reduce_dims)
+    union = jnp.sum(input, axis=reduce_dims) + jnp.sum(label_oh,
+                                                       axis=reduce_dims)
+    dice = (2 * inter + epsilon) / (union + epsilon)
+    return jnp.mean(1 - dice)
+
+
+@defop
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    """loss.npair_loss (the reference's N-pair metric loss): cross
+    entropy over anchor·positiveᵀ similarities + L2 on the embeddings."""
+    reg = l2_reg * (jnp.sum(anchor * anchor) / max(anchor.shape[0], 1)
+                    + jnp.sum(positive * positive)
+                    / max(positive.shape[0], 1)) * 0.25
+    sim = anchor @ positive.T
+    lab = labels.reshape(-1)
+    same = (lab[:, None] == lab[None, :]).astype(sim.dtype)
+    target = same / jnp.maximum(jnp.sum(same, axis=1, keepdims=True), 1)
+    logp = jax.nn.log_softmax(sim, axis=1)
+    ce = -jnp.mean(jnp.sum(target * logp, axis=1))
+    return ce + reg
+
+
+@defop
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    l, r, t, b = (padding if not hasattr(padding, "tolist")
+                  else padding.tolist())
+    if data_format == "NCHW":
+        widths = ((0, 0), (0, 0), (t, b), (l, r))
+    else:
+        widths = ((0, 0), (t, b), (l, r), (0, 0))
+    return jnp.pad(x, widths)
+
+
+@defop
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    d = x - y + epsilon
+    return jnp.linalg.norm(d.astype(jnp.promote_types(d.dtype,
+                                                      jnp.float32)),
+                           ord=p, axis=-1, keepdims=keepdim
+                           ).astype(d.dtype)
+
+
+@defop
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    loss = jnp.log1p(jnp.exp(-label.astype(input.dtype) * input))
+    return _reduce(loss, reduction)
+
+
+@defop
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction="mean", name=None):
+    lab = label.astype(input.dtype)
+    loss = -(lab * jax.nn.log_sigmoid(input)
+             + (1 - lab) * jax.nn.log_sigmoid(-input))
+    if weight is not None:
+        loss = loss * weight
+    loss = jnp.mean(loss, axis=-1)
+    return _reduce(loss, reduction)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    dist = distance_function or pairwise_distance
+
+    def dval(a, b):
+        out = dist(a, b)
+        return out._value if isinstance(out, Tensor) else jnp.asarray(out)
+
+    dp = dval(input, positive)
+    dn = dval(input, negative)
+    if swap:
+        dn = jnp.minimum(dn, dval(positive, negative))
+    loss = jnp.maximum(dp - dn + margin, 0)
+    out = _reduce(loss, reduction)
+    return out if isinstance(out, Tensor) else Tensor(out, _internal=True)
+
+
+@defop
+def thresholded_relu(x, threshold=1.0, name=None):
+    return jnp.where(x > threshold, x, 0)
+
+
+def _default_tree_paths(num_classes):
+    """Complete-binary-tree paths for the default hsigmoid tree: leaf of
+    class c sits at heap position c + num_classes - 1 over internal
+    nodes 0..num_classes-2 (the reference kernel's implicit layout)."""
+    depth_max = int(np.ceil(np.log2(max(num_classes, 2))))
+    table = np.full((num_classes, depth_max), -1, np.int64)
+    code = np.zeros((num_classes, depth_max), np.float32)
+    for c in range(num_classes):
+        node = c + num_classes - 1
+        path, bits = [], []
+        while node > 0:
+            parent = (node - 1) // 2
+            path.append(parent)
+            bits.append(1.0 if node == 2 * parent + 2 else 0.0)
+            node = parent
+        path.reverse()
+        bits.reverse()
+        table[c, :len(path)] = path
+        code[c, :len(bits)] = bits
+    return table, code
+
+
+@defop
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """loss.hsigmoid_loss (hierarchical sigmoid): sum of BCE losses
+    along each label's root-to-leaf path.  Default path = complete
+    binary tree over `num_classes-1` internal nodes; custom trees pass
+    path_table/path_code (the reference kernel contract)."""
+    if path_table is None or path_code is None:
+        t, c = _default_tree_paths(int(num_classes))
+        path_table, path_code = jnp.asarray(t), jnp.asarray(c)
+    lab = label.reshape(-1)
+    tbl = path_table[lab]                       # [N, D]
+    code = path_code[lab].astype(input.dtype)   # [N, D]
+    valid = (tbl >= 0)
+    idx = jnp.maximum(tbl, 0)
+    w = weight[idx]                             # [N, D, E]
+    logits = jnp.einsum("nde,ne->nd", w, input)
+    if bias is not None:
+        logits = logits + bias.reshape(-1)[idx]
+    # BCE with target = code: -[code*log σ(z) + (1-code)*log σ(-z)]
+    loss = -(code * jax.nn.log_sigmoid(logits)
+             + (1 - code) * jax.nn.log_sigmoid(-logits))
+    loss = jnp.sum(jnp.where(valid, loss, 0), axis=1, keepdims=True)
+    return loss
+
+
+@defop
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean",
+                         name=None):
+    """loss.margin_cross_entropy (ArcFace family): logits are cosines;
+    the target class logit θ becomes cos(m1·θ + m2) − m3, everything
+    scales by s, then softmax CE.  Single-group form (the reference's
+    model-parallel group path shards classes; here GSPMD shards the
+    same dense math)."""
+    lab = label.reshape(-1)
+    oh = jax.nn.one_hot(lab, logits.shape[-1], dtype=logits.dtype)
+    # keep strictly inside (-1, 1): d(arccos)/dx blows up at the ends and
+    # would poison the backward for saturated cosines
+    eps = 1e-6
+    cos = jnp.clip(logits, -1.0 + eps, 1.0 - eps)
+    theta = jnp.arccos(cos)
+    target_logit = jnp.cos(margin1 * theta + margin2) - margin3
+    adjusted = jnp.where(oh > 0, target_logit, cos) * scale
+    logp = jax.nn.log_softmax(adjusted, axis=-1)
+    loss = -jnp.sum(oh * logp, axis=-1, keepdims=True)
+    if reduction == "mean":
+        loss = jnp.mean(loss)
+    elif reduction == "sum":
+        loss = jnp.sum(loss)
+    elif reduction is not None and reduction != "none":
+        raise ValueError(f"bad reduction {reduction}")
+    if return_softmax:
+        return loss, jnp.exp(logp)
+    return loss
+
+
+@defop
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    """functional.sparse_attention: attention restricted to the CSR
+    sparsity pattern (offset [B,H,L+1], columns [B,H,nnz]).  The
+    reference's CUDA kernel walks the CSR lists; here the pattern
+    becomes a dense mask feeding XLA's fused softmax — same output,
+    TPU-shaped execution."""
+    b, h, L, d = query.shape
+    scores = jnp.einsum("bhld,bhmd->bhlm", query, key) / np.sqrt(d)
+    # scatter the CSR pattern into a dense [B,H,L,L] mask: entry j of the
+    # columns list belongs to the row whose offset range contains j
+    mask = jnp.zeros((b, h, L, L), bool)
+    nnz = sparse_csr_columns.shape[-1]
+
+    def row_ids(off):
+        return jnp.clip(jnp.searchsorted(off, jnp.arange(nnz),
+                                         side="right") - 1, 0, L - 1)
+
+    rids = jax.vmap(jax.vmap(row_ids))(sparse_csr_offset)  # [B,H,nnz]
+    bi = jnp.arange(b)[:, None, None]
+    hi = jnp.arange(h)[None, :, None]
+    mask = mask.at[bi, hi, rids, sparse_csr_columns].set(True)
+    neg = jnp.asarray(jnp.finfo(jnp.float32).min, scores.dtype)
+    scores = jnp.where(mask, scores, neg)
+    if attn_mask is not None:
+        scores = scores + attn_mask
+    if key_padding_mask is not None:
+        scores = jnp.where(key_padding_mask[:, None, None, :] > 0,
+                           scores, neg)
+    probs = jax.nn.softmax(scores, axis=-1)
+    probs = jnp.where(mask, probs, 0)
+    return jnp.einsum("bhlm,bhmd->bhld", probs, value)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCL", name=None):
+    from ...ops.extended import max_unpool2d as _u2
+    x4 = x.unsqueeze(-2) if isinstance(x, Tensor) else x[..., None, :]
+    i4 = indices.unsqueeze(-2) if isinstance(indices, Tensor) \
+        else indices[..., None, :]
+    out_sz = None if output_size is None else \
+        list(output_size[:-1]) + [1, output_size[-1]]
+    out = _u2(x4, i4, (1, kernel_size), (1, stride or kernel_size),
+              padding, out_sz, data_format="NCHW")
+    return out.squeeze(-2)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCDHW", name=None):
+    """Scatter pooled values back along D,H,W (unpool3d kernel)."""
+    v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    iv = indices._value if isinstance(indices, Tensor) \
+        else jnp.asarray(indices)
+    ks = (kernel_size,) * 3 if isinstance(kernel_size, int) \
+        else tuple(kernel_size)
+    st = ks if stride is None else ((stride,) * 3 if isinstance(stride, int)
+                                    else tuple(stride))
+    pd = (padding,) * 3 if isinstance(padding, int) else tuple(padding)
+    n, c, dd, hh, ww = v.shape
+    if output_size is None:
+        od = (dd - 1) * st[0] + ks[0] - 2 * pd[0]
+        oh = (hh - 1) * st[1] + ks[1] - 2 * pd[1]
+        ow = (ww - 1) * st[2] + ks[2] - 2 * pd[2]
+    else:
+        od, oh, ow = output_size[-3:]
+    flat = jnp.zeros((n, c, od * oh * ow), v.dtype)
+    idx = iv.reshape(n, c, -1)
+    flat = flat.at[jnp.arange(n)[:, None, None],
+                   jnp.arange(c)[None, :, None], idx].set(
+        v.reshape(n, c, -1))
+    return Tensor(flat.reshape(n, c, od, oh, ow), _internal=True)
+
+
+# -- in-place activation forms ----------------------------------------------
+
+from ...ops.compat_surface import _inplace  # noqa: E402  (one helper,
+# shared with the paddle.*_ in-place surface)
+
+
+def elu_(x, alpha=1.0, name=None):
+    from .activation import elu
+    return _inplace(x, elu(x, alpha))
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    from .activation import softmax
+    return _inplace(x, softmax(x, axis=axis, dtype=dtype))
+
+
+def tanh_(x, name=None):
+    from ...ops.math import tanh
+    return _inplace(x, tanh(x))

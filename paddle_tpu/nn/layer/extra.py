@@ -1,0 +1,242 @@
+"""nn layer long tail — parity with reference nn/__init__ exports that
+were still absent: Fold/Unfold, MaxUnPool1D/2D/3D, Softmax2D,
+ThresholdedReLU, the distance/margin loss layers, HSigmoidLoss, and the
+seq2seq BeamSearchDecoder/dynamic_decode pair (nn/decode.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..layer_base import Layer
+from .. import functional as F
+from ...core.tensor import Tensor
+
+__all__ = ["Fold", "Unfold", "MaxUnPool1D", "MaxUnPool2D", "MaxUnPool3D",
+           "Softmax2D", "ThresholdedReLU", "PairwiseDistance",
+           "SoftMarginLoss", "MultiLabelSoftMarginLoss",
+           "TripletMarginWithDistanceLoss", "HSigmoidLoss",
+           "BeamSearchDecoder", "dynamic_decode"]
+
+
+class Fold(Layer):
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
+                 dilations=1, name=None):
+        super().__init__()
+        self._args = (output_sizes, kernel_sizes, strides, paddings,
+                      dilations)
+
+    def forward(self, x):
+        return F.fold(x, *self._args)
+
+
+class Unfold(Layer):
+    def __init__(self, kernel_sizes, strides=1, paddings=0, dilations=1,
+                 name=None):
+        super().__init__()
+        self._args = (kernel_sizes, strides, paddings, dilations)
+
+    def forward(self, x):
+        return F.unfold(x, *self._args)
+
+
+class MaxUnPool1D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+        super().__init__()
+        self._kw = dict(kernel_size=kernel_size, stride=stride,
+                        padding=padding, data_format=data_format,
+                        output_size=output_size)
+
+    def forward(self, x, indices):
+        return F.max_unpool1d(x, indices, **self._kw)
+
+
+class MaxUnPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+        super().__init__()
+        self._kw = dict(kernel_size=kernel_size, stride=stride,
+                        padding=padding, data_format=data_format,
+                        output_size=output_size)
+
+    def forward(self, x, indices):
+        return F.max_unpool2d(x, indices, **self._kw)
+
+
+class MaxUnPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+        super().__init__()
+        self._kw = dict(kernel_size=kernel_size, stride=stride,
+                        padding=padding, data_format=data_format,
+                        output_size=output_size)
+
+    def forward(self, x, indices):
+        return F.max_unpool3d(x, indices, **self._kw)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel dim of NCHW inputs (activation.Softmax2D)."""
+
+    def forward(self, x):
+        assert x.ndim in (3, 4), "Softmax2D expects 3D/4D input"
+        return F.softmax(x, axis=-3)
+
+
+class ThresholdedReLU(Layer):
+    def __init__(self, threshold=1.0, name=None):
+        super().__init__()
+        self._threshold = threshold
+
+    def forward(self, x):
+        return F.thresholded_relu(x, self._threshold)
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self._kw = dict(p=p, epsilon=epsilon, keepdim=keepdim)
+
+    def forward(self, x, y):
+        return F.pairwise_distance(x, y, **self._kw)
+
+
+class SoftMarginLoss(Layer):
+    def __init__(self, reduction="mean", name=None):
+        super().__init__()
+        self._reduction = reduction
+
+    def forward(self, input, label):
+        return F.soft_margin_loss(input, label, self._reduction)
+
+
+class MultiLabelSoftMarginLoss(Layer):
+    def __init__(self, weight=None, reduction="mean", name=None):
+        super().__init__()
+        self._weight = weight
+        self._reduction = reduction
+
+    def forward(self, input, label):
+        return F.multi_label_soft_margin_loss(input, label, self._weight,
+                                              self._reduction)
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self._kw = dict(distance_function=distance_function, margin=margin,
+                        swap=swap, reduction=reduction)
+
+    def forward(self, input, positive, negative):
+        return F.triplet_margin_with_distance_loss(input, positive,
+                                                   negative, **self._kw)
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid classifier head (nn/layer/loss.HSigmoidLoss):
+    owns the [num_classes-1, feature_size] internal-node weights."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        if num_classes < 2:
+            raise ValueError("num_classes must be >= 2")
+        self._num_classes = num_classes
+        self.weight = self.create_parameter(
+            (num_classes - 1, feature_size), attr=weight_attr)
+        self.bias = self.create_parameter((num_classes - 1, 1),
+                                          attr=bias_attr, is_bias=True)
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return F.hsigmoid_loss(input, label, self._num_classes,
+                               self.weight, self.bias, path_table,
+                               path_code)
+
+
+# -- seq2seq decoding (reference nn/decode.py) -------------------------------
+
+class BeamSearchDecoder:
+    """Beam-search decoder over a step cell (reference
+    nn/decode.py:BeamSearchDecoder).  The cell is any callable
+    `cell(inputs, states) -> (logits_or_out, new_states)`; the embedding
+    and output layers mirror the reference's `embedding_fn`/`output_fn`
+    hooks.  Drive it with `dynamic_decode`."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    # the eager protocol mirrors the reference's initialize/step/finalize
+    def initialize(self, initial_states, batch_size):
+        k = self.beam_size
+        tokens = np.full((batch_size, k), self.start_token, np.int64)
+        log_probs = np.full((batch_size, k), -1e9, np.float64)
+        log_probs[:, 0] = 0.0   # only beam 0 live at t=0 (reference kNegInf)
+        finished = np.zeros((batch_size, k), bool)
+        return tokens, log_probs, finished, initial_states
+
+    def step(self, tokens, states):
+        import jax
+
+        inp = Tensor(jnp.asarray(tokens.reshape(-1)), _internal=True)
+        if self.embedding_fn is not None:
+            inp = self.embedding_fn(inp)
+        out, new_states = self.cell(inp, states)
+        if self.output_fn is not None:
+            out = self.output_fn(out)
+        logits = out._value if isinstance(out, Tensor) else jnp.asarray(out)
+        return np.asarray(jax.nn.log_softmax(logits, axis=-1)), new_states
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None, batch_size=1,
+                   output_time_major=False, impute_finished=False,
+                   is_test=False, return_length=False, **kwargs):
+    """Reference nn/decode.dynamic_decode: run the decoder until every
+    beam finishes or max_step_num; returns (token ids [B, T, beam],
+    final log-probs) (+ lengths)."""
+    assert max_step_num is not None, "max_step_num is required"
+    tokens, log_probs, finished, states = decoder.initialize(inits,
+                                                             batch_size)
+    b, k = tokens.shape
+    history = []
+    lengths = np.zeros((b, k), np.int64)
+    for _ in range(int(max_step_num)):
+        logp, states = decoder.step(tokens, states)
+        v = logp.shape[-1]
+        logp = logp.reshape(b, k, v)
+        # finished beams only extend with end_token at zero cost
+        end_only = np.full((v,), -1e9)
+        end_only[decoder.end_token] = 0.0
+        step_logp = np.where(finished[:, :, None], end_only[None, None],
+                             logp)
+        total = log_probs[:, :, None] + step_logp       # [b, k, v]
+        flat = total.reshape(b, k * v)
+        top = np.argsort(-flat, axis=1)[:, :k]          # [b, k]
+        log_probs = np.take_along_axis(flat, top, axis=1)
+        beam_src = top // v
+        tokens = (top % v).astype(np.int64)
+        finished = np.take_along_axis(finished, beam_src, axis=1) | (
+            tokens == decoder.end_token)
+        lengths = np.take_along_axis(lengths, beam_src, axis=1)
+        lengths = lengths + (~finished).astype(np.int64)
+        # reorder history to follow the surviving beams
+        history = [np.take_along_axis(hst, beam_src, axis=1)
+                   for hst in history]
+        history.append(tokens)
+        if finished.all():
+            break
+    out = np.stack(history, axis=1)                     # [b, T, k]
+    if output_time_major:
+        out = out.transpose(1, 0, 2)
+    ids = Tensor(out)
+    scores = Tensor(log_probs)
+    if return_length:
+        return ids, scores, Tensor(lengths)
+    return ids, scores

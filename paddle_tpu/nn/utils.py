@@ -1,0 +1,203 @@
+"""paddle.nn.utils — parity with python/paddle/nn/utils/
+(weight_norm_hook.py weight_norm/remove_weight_norm, spectral_norm_hook,
+clip_grad_norm_/clip_grad_value_, transform_parameters.py
+parameters_to_vector/vector_to_parameters).
+
+Gradient correctness: the reparameterized weight is rebuilt each forward
+FROM THE PARAMETERS with Tensor ops (eager-autograd-taped), so
+weight_g/weight_v (and the spectral-normalized orig weight) receive
+gradients — a raw-jnp recompute would silently freeze them."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .layer_base import Parameter
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm",
+           "clip_grad_norm_", "clip_grad_value_", "parameters_to_vector",
+           "vector_to_parameters"]
+
+
+def _norm_except_t(v: Tensor, dim) -> Tensor:
+    """||v|| reduced over every axis but `dim` (Tensor ops, taped)."""
+    axes = [i for i in range(v.ndim) if i != dim]
+    return (v * v).sum(axis=axes, keepdim=True).sqrt()
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparameterize layer.<name> as g * v/||v|| (weight_norm_hook.py):
+    registers <name>_g / <name>_v and rebuilds <name> in a forward
+    pre-hook.  dim=None puts ONE scalar g over the whole tensor."""
+    w = getattr(layer, name)
+    ndim = w.ndim
+    if dim is not None:
+        dim = dim % ndim   # negative dims normalize like positive ones
+    wv = w._value
+    if dim is None:
+        g0 = jnp.sqrt(jnp.sum(jnp.square(wv))).reshape(1)
+    else:
+        axes = tuple(i for i in range(ndim) if i != dim)
+        g0 = jnp.sqrt(jnp.sum(jnp.square(wv), axis=axes)).reshape(-1)
+    v = Parameter(jnp.copy(wv), name=f"{w.name}_v")
+    g = Parameter(g0, name=f"{w.name}_g")
+    del layer._parameters[name]
+    if name in layer.__dict__:
+        del layer.__dict__[name]
+    layer.add_parameter(f"{name}_v", v)
+    layer.add_parameter(f"{name}_g", g)
+    layer._weight_norm_cfg = (name, dim)
+
+    def _compute(lay):
+        vv = getattr(lay, f"{name}_v")
+        gg = getattr(lay, f"{name}_g")
+        if dim is None:
+            nrm = (vv * vv).sum().sqrt()
+            wnew = vv * (gg.reshape([]) / nrm)
+        else:
+            nrm = _norm_except_t(vv, dim)
+            shape = [1] * vv.ndim
+            shape[dim] = -1
+            wnew = vv / nrm * gg.reshape(shape)
+        object.__setattr__(lay, name, wnew)
+
+    _compute(layer)
+
+    def pre_hook(lay, inputs):
+        _compute(lay)
+        return inputs
+
+    layer._weight_norm_hook = layer.register_forward_pre_hook(pre_hook)
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    if not hasattr(layer, "_weight_norm_hook"):
+        raise ValueError(f"weight_norm was not applied to {layer}")
+    layer._weight_norm_hook.remove()
+    nm, dim = layer._weight_norm_cfg
+    v = getattr(layer, f"{name}_v")
+    g = getattr(layer, f"{name}_g")
+    if dim is None:
+        nrm = jnp.sqrt(jnp.sum(jnp.square(v._value)))
+        w = v._value * (g._value.reshape(()) / nrm)
+    else:
+        axes = tuple(i for i in range(v.ndim) if i != dim)
+        nrm = jnp.sqrt(jnp.sum(jnp.square(v._value), axis=axes,
+                               keepdims=True))
+        shape = [1] * v.ndim
+        shape[dim] = -1
+        w = v._value / nrm * g._value.reshape(shape)
+    del layer._parameters[f"{name}_v"]
+    del layer._parameters[f"{name}_g"]
+    if name in layer.__dict__:      # drop the taped shadow from the hook
+        del layer.__dict__[name]
+    layer.add_parameter(name, Parameter(w, name=nm))
+    del layer._weight_norm_hook
+    del layer._weight_norm_cfg
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    """Spectral normalization (spectral_norm_hook.py): each forward
+    divides the CURRENT parameter (kept as <name>_orig) by its leading
+    singular value.  The u/v power-iteration vectors are non-trainable
+    state updated with raw values; sigma = u·W·v is computed with Tensor
+    ops so the orig weight still trains."""
+    w = getattr(layer, name)
+    if dim is None:
+        dim = 0
+    dim = dim % w.ndim
+    del layer._parameters[name]
+    if name in layer.__dict__:
+        del layer.__dict__[name]
+    layer.add_parameter(f"{name}_orig", w)
+
+    wv = w._value
+    mat0 = jnp.moveaxis(wv, dim, 0).reshape(wv.shape[dim], -1)
+    rng = np.random.RandomState(0)
+    u = jnp.asarray(rng.standard_normal(mat0.shape[0]), jnp.float32)
+    u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+    v = mat0.astype(jnp.float32).T @ u
+    v = v / jnp.maximum(jnp.linalg.norm(v), eps)
+    state = {"u": u, "v": v}
+
+    def _compute(lay):
+        worig = getattr(lay, f"{name}_orig")
+        val = worig._value
+        m = jnp.moveaxis(val, dim, 0).reshape(val.shape[dim], -1
+                                              ).astype(jnp.float32)
+        u, v = state["u"], state["v"]
+        for _ in range(n_power_iterations):
+            v = m.T @ u
+            v = v / jnp.maximum(jnp.linalg.norm(v), eps)
+            u = m @ v
+            u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+        state["u"], state["v"] = u, v
+        # sigma differentiable wrt the param: u/v enter as constants
+        perm = [dim] + [i for i in range(val.ndim) if i != dim]
+        wm = worig.transpose(perm).reshape([val.shape[dim], -1])
+        sigma = (Tensor(u[None, :], _internal=True).matmul(wm)
+                 .matmul(Tensor(v[:, None], _internal=True))).reshape([])
+        object.__setattr__(lay, name, worig / sigma)
+
+    _compute(layer)
+
+    def pre_hook(lay, inputs):
+        _compute(lay)
+        return inputs
+
+    layer.register_forward_pre_hook(pre_hook)
+    return layer
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    """In-place global-norm gradient clip over eager grads
+    (clip_grad_norm_.py); returns the total norm."""
+    params = [p for p in (parameters if isinstance(parameters, (list, tuple))
+                          else [parameters]) if p.grad is not None]
+    if not params:
+        return Tensor(jnp.zeros(()), _internal=True)
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack(
+            [jnp.max(jnp.abs(p.grad._value)) for p in params]))
+    else:
+        total = jnp.sum(jnp.stack(
+            [jnp.sum(jnp.abs(p.grad._value.astype(jnp.float64))
+                     ** norm_type) for p in params])) ** (1.0 / norm_type)
+    if error_if_nonfinite and not bool(jnp.isfinite(total)):
+        raise RuntimeError(
+            "the total norm for gradients is non-finite; disable "
+            "error_if_nonfinite to clip anyway")
+    scale = jnp.minimum(max_norm / jnp.maximum(total, 1e-12), 1.0)
+    for p in params:
+        p.grad._replace_((p.grad._value * scale).astype(
+            p.grad._value.dtype), None)
+    return Tensor(total, _internal=True)
+
+
+def clip_grad_value_(parameters, clip_value):
+    params = parameters if isinstance(parameters, (list, tuple)) \
+        else [parameters]
+    for p in params:
+        if p.grad is not None:
+            p.grad._replace_(
+                jnp.clip(p.grad._value, -clip_value, clip_value), None)
+
+
+def parameters_to_vector(parameters, name=None):
+    return Tensor(jnp.concatenate(
+        [p._value.reshape(-1) for p in parameters]), _internal=True)
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    v = vec._value if isinstance(vec, Tensor) else jnp.asarray(vec)
+    off = 0
+    for p in parameters:
+        n = int(np.prod(p.shape)) if len(p.shape) else 1
+        p._replace_(v[off:off + n].reshape(tuple(p.shape)).astype(
+            p._value.dtype), None)
+        off += n

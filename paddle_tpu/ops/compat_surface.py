@@ -82,12 +82,22 @@ def nanquantile(x, q, axis=None, keepdim=False, name=None):
 
 
 def vsplit(x, num_or_indices, name=None):
-    """Split along dim 0 (manipulation.vsplit)."""
+    """Split along dim 0 (manipulation.vsplit).  An int divides evenly;
+    a list holds split INDICES (tensor_split semantics — NOT section
+    sizes, which is what plain split takes)."""
     from . import manipulation as M
     if getattr(x, "ndim", 2) < 2:
         raise ValueError(
             f"vsplit expects a tensor with at least 2 dims, got {x.ndim}")
-    return M.split(x, num_or_indices, axis=0)
+    if isinstance(num_or_indices, int):
+        return M.split(x, num_or_indices, axis=0)
+    idx = list(num_or_indices)
+    n = x.shape[0]
+    bounds = [0] + [min(int(i), n) for i in idx] + [n]
+    sizes = [b - a for a, b in zip(bounds[:-1], bounds[1:])]
+    if any(s < 0 for s in sizes):
+        raise ValueError(f"split indices {idx} must be increasing")
+    return M.split(x, sizes, axis=0)
 
 
 def rank(input, name=None):
@@ -203,15 +213,17 @@ def set_printoptions(precision=None, threshold=None, edgeitems=None,
 
 def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
                      default_initializer=None):
-    """Standalone Parameter factory (reference paddle.create_parameter)."""
-    from .. import nn
-    from ..nn.layer_base import Parameter
+    """Standalone Parameter factory (reference paddle.create_parameter) —
+    routed through Layer.create_parameter so ParamAttr (initializer /
+    trainable / learning_rate / name) and abstract-init (LazyGuard)
+    behave exactly like layer-owned parameters."""
+    from ..nn.layer_base import Layer, ParamAttr
 
-    from ..core.dtype import convert_dtype
-
-    init = default_initializer
-    if init is None:
-        init = nn.initializer.Constant(0.0) if is_bias \
-            else nn.initializer.XavierUniform()
-    value = init(tuple(shape), convert_dtype(dtype))
-    return Parameter(value, name=name)
+    if name is not None and attr is None:
+        attr = ParamAttr(name=name)
+    holder = Layer()
+    holder._dtype = dtype
+    p = holder.create_parameter(tuple(shape), attr=attr, dtype=dtype,
+                                is_bias=is_bias,
+                                default_initializer=default_initializer)
+    return p

@@ -308,6 +308,24 @@ spec("take", lambda: (F(2, 6), I64(4, hi=12)))
 spec("nanquantile", lambda: (F(3, 5),), {"q": 0.5}, grad=False)
 spec("softmax_mask_fuse", lambda: (F(2, 2, 4, 4), F(2, 1, 4, 4)))
 spec("softmax_mask_fuse_upper_triangle", lambda: (F(2, 2, 4, 4),))
+spec("bilinear", lambda: (F(3, 4), F(3, 5), F(2, 4, 5)))
+spec("dice_loss", lambda: (Fpos(2, 3, 4), I64(2, 3, 1, hi=4)))
+spec("npair_loss", lambda: (F(4, 6), F(4, 6), I64(4, hi=2)))
+spec("zeropad2d", lambda: (F(1, 2, 3, 3),), {"padding": [1, 1, 0, 1]})
+spec("pairwise_distance", lambda: (F(3, 6), F(3, 6)))
+spec("soft_margin_loss", lambda: (F(3, 4), F(3, 4)))
+spec("multi_label_soft_margin_loss",
+     lambda: (F(3, 4), I64(3, 4, hi=2)))
+spec("thresholded_relu", lambda: (F(3, 4),))
+spec("hsigmoid_loss",
+     lambda: (F(3, 6), I64(3, hi=5), 5, F(4, 6), F(4)))
+spec("margin_cross_entropy", lambda: (F(3, 6), I64(3, hi=6)),
+     {"margin2": 0.0, "scale": 2.0})
+spec("sparse_attention",
+     lambda: (F(1, 1, 4, 8), F(1, 1, 4, 8), F(1, 1, 4, 8),
+              np.tile(np.arange(5) * 4, (1, 1, 1)).astype(np.int64),
+              np.tile(np.tile(np.arange(4), 4), (1, 1, 1)).astype(np.int64)),
+     grad=False)
 
 # ops exercised via dedicated test files, not callable with simple
 # positional tensors here (reason recorded so the sweep stays exhaustive)
