@@ -3,3 +3,43 @@ from ..core.autograd import backward, no_grad, enable_grad, grad, set_grad_enabl
 from ..core.pylayer import PyLayer, PyLayerContext  # noqa: F401
 
 PyLayerMeta = type(PyLayer)
+
+# legacy aliases (reference autograd/__init__.py exports both eager and
+# legacy PyLayer names; one implementation serves all four here)
+EagerPyLayer = PyLayer
+LegacyPyLayer = PyLayer
+EagerPyLayerContext = PyLayerContext
+LegacyPyLayerContext = PyLayerContext
+
+
+def no_grad_(func=None):
+    """Decorator alias of no_grad (reference exports `no_grad_`)."""
+    return no_grad(func) if func is not None else no_grad()
+
+
+def backward_mode():  # pragma: no cover - introspection helper
+    """'eager': one autograd engine here (the reference reports which of
+    its two engines is active)."""
+    return "eager"
+
+
+class saved_tensors_hooks:
+    """Context registering pack/unpack hooks for the residual arrays the
+    autograd tape saves (reference autograd/saved_tensors_hooks.py:20 —
+    the activation-offload hook pair).  Ops recorded inside the context
+    run `pack` over every saved residual immediately and `unpack` lazily
+    when the backward pass needs the vjp (GradNode._materialized_vjp)."""
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        from ..core import autograd as _ag
+        self._prev = _ag._saved_tensor_hooks
+        _ag._saved_tensor_hooks = (self.pack_hook, self.unpack_hook)
+        return self
+
+    def __exit__(self, *exc):
+        from ..core import autograd as _ag
+        _ag._saved_tensor_hooks = self._prev

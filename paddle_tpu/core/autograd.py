@@ -65,6 +65,9 @@ class enable_grad(contextlib.ContextDecorator):
         return False
 
 
+_saved_tensor_hooks = None
+
+
 class GradNode:
     """One recorded op: holds the VJP closure and edges to input tensors.
 
@@ -73,21 +76,48 @@ class GradNode:
     saved TensorWrappers.
     """
 
-    __slots__ = ("seq", "vjp_fn", "inputs", "n_outputs", "out_avals", "name")
+    __slots__ = ("seq", "vjp_fn", "inputs", "n_outputs", "out_avals", "name",
+                 "_packed")
 
     def __init__(self, vjp_fn, inputs, n_outputs, out_avals, name=""):
         self.seq = next(_node_counter)
-        self.vjp_fn = vjp_fn
         self.inputs = inputs          # list[Tensor] (only those requiring grad)
         self.n_outputs = n_outputs
         self.out_avals = out_avals    # list[(shape, dtype)] for zero cotangents
         self.name = name
+        self._packed = None
+        hooks = _saved_tensor_hooks
+        if hooks is not None:
+            # saved-tensor hooks (reference saved_tensors_hooks.py, the
+            # activation-offload hook pair): the jax.vjp closure is a
+            # pytree whose array leaves ARE the saved residuals — pack
+            # them now, unpack lazily at backward time
+            import jax.tree_util as _jtu
+            pack, _ = hooks
+            leaves, treedef = _jtu.tree_flatten(vjp_fn)
+            was_array = [hasattr(x, "dtype") for x in leaves]
+            packed = [pack(x) if a else x
+                      for x, a in zip(leaves, was_array)]
+            self._packed = (treedef, packed, was_array, hooks)
+            self.vjp_fn = None
+        else:
+            self.vjp_fn = vjp_fn
+
+    def _materialized_vjp(self):
+        if self._packed is not None:
+            import jax.tree_util as _jtu
+            treedef, packed, was_array, (_, unpack) = self._packed
+            leaves = [unpack(x) if a else x
+                      for x, a in zip(packed, was_array)]
+            return _jtu.tree_unflatten(treedef, leaves)
+        return self.vjp_fn
 
     def released(self) -> bool:
-        return self.vjp_fn is None
+        return self.vjp_fn is None and self._packed is None
 
     def release(self):
         self.vjp_fn = None
+        self._packed = None
 
 
 def _zero_cotangent(shape, dtype):
@@ -209,7 +239,7 @@ def backward(tensors: Sequence[Any], grad_tensors: Sequence[Any] | None = None,
         if not has_any:
             continue
         ct = cts[0] if node.n_outputs == 1 else tuple(cts)
-        in_grads = node.vjp_fn(ct)
+        in_grads = node._materialized_vjp()(ct)
         if not retain_graph:
             node.release()
         for inp, g in zip(node.inputs, in_grads):

@@ -2,10 +2,12 @@
 topology/mesh, fleet, SPMD step builder, sharding, launch.
 """
 from .collective import (  # noqa: F401
-    ReduceOp, Group, all_gather, all_gather_concat, all_gather_object,
-    all_reduce, all_to_all, all_to_all_single, barrier, broadcast,
-    broadcast_object_list, destroy_process_group, get_group, is_initialized,
-    new_group, p2p_shift, recv, reduce, reduce_scatter, scatter, send, wait,
+    P2POp, ReduceOp, Group, all_gather, all_gather_concat,
+    all_gather_object, all_reduce, all_to_all, all_to_all_single, alltoall,
+    alltoall_single, barrier, batch_isend_irecv, broadcast,
+    broadcast_object_list, destroy_process_group, get_group, irecv,
+    is_initialized, isend, new_group, p2p_shift, recv, reduce,
+    reduce_scatter, scatter, send, wait,
 )
 from .parallel import (  # noqa: F401
     DataParallel, ParallelEnv, get_rank, get_world_size, init_parallel_env,
@@ -39,3 +41,15 @@ get_world_size_ = get_world_size
 
 def get_backend():
     return "xla"
+
+
+class ParallelMode:
+    """Parallel-mode enum (reference fleet/base/topology.py:29)."""
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+
+
+from . import launch  # noqa: E402,F401
+from .fleet import utils  # noqa: E402,F401

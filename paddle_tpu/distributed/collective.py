@@ -412,3 +412,69 @@ def get_world_size(group=None) -> int:
         return group.nranks
     from .parallel import get_world_size as _gws
     return _gws()
+
+
+# -- legacy/P2P aliases (reference collective.py:1239 alltoall,
+# :1340 alltoall_single, :1583 isend, :1633 irecv, :1682 P2POp,
+# :1740 batch_isend_irecv) --------------------------------------------------
+
+def alltoall(in_tensor_list, out_tensor_list, group=None,
+             use_calc_stream=True):
+    """Legacy arg-order alias of all_to_all (inputs first)."""
+    return all_to_all(out_tensor_list, in_tensor_list, group=group)
+
+
+def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
+                    out_split_sizes=None, group=None, use_calc_stream=True):
+    return all_to_all_single(out_tensor, in_tensor,
+                             out_split_sizes=out_split_sizes,
+                             in_split_sizes=in_split_sizes, group=group)
+
+
+class _P2PTask:
+    """Completed-on-return task handle: the eager send/recv here complete
+    synchronously (device-to-device copies through the host bus), so
+    wait() is a no-op — the same contract a finished NCCL task exposes."""
+
+    def __init__(self, result=None):
+        self.result = result
+
+    def wait(self):
+        return self.result
+
+    def is_completed(self):
+        return True
+
+
+def isend(tensor, dst, group=None):
+    send(tensor, dst=dst, group=group, sync_op=False)
+    return _P2PTask()
+
+
+def irecv(tensor, src=None, group=None):
+    out = recv(tensor, src=src or 0, group=group, sync_op=False)
+    return _P2PTask(out)
+
+
+class P2POp:
+    """One deferred point-to-point op for batch_isend_irecv
+    (collective.py:1682): op is `isend` or `irecv`."""
+
+    def __init__(self, op, tensor, peer, group=None):
+        if op not in (isend, irecv):
+            raise RuntimeError(
+                "Invalid ``op`` function. Expected ``op`` to be of type "
+                "``paddle.distributed.isend`` or ``paddle.distributed.irecv``.")
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list):
+    """Run the deferred P2P ops; returns their task handles
+    (collective.py:1740)."""
+    if not p2p_op_list or not all(isinstance(p, P2POp)
+                                  for p in p2p_op_list):
+        raise RuntimeError("Invalid ``p2p_op_list``.")
+    return [p.op(p.tensor, p.peer, p.group) for p in p2p_op_list]

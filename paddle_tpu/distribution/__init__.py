@@ -3,6 +3,8 @@ distributions over framework Tensors, backed by jax math + the framework rng
 (core.random) so sampling composes with paddle.seed."""
 from .distributions import (  # noqa: F401
     Bernoulli,
+    ExponentialFamily,
+    Independent,
     Beta,
     Categorical,
     Dirichlet,

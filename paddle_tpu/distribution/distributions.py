@@ -625,3 +625,83 @@ def _kl_dirichlet(p, q):
 def _kl_exponential(p, q):
     ratio = q.rate / p.rate
     return _wrap(jnp.log(p.rate) - jnp.log(q.rate) + ratio - 1)
+
+
+class Independent(Distribution):
+    """Reinterpret the rightmost `reinterpreted_batch_rank` batch dims of
+    a base distribution as event dims (reference
+    distribution/independent.py:18): log_prob sums over them."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        if not isinstance(base, Distribution):
+            raise TypeError("base should be a Distribution")
+        r = int(reinterpreted_batch_rank)
+        if not (0 < r <= len(base.batch_shape)):
+            raise ValueError(
+                "reinterpreted_batch_rank must be in (0, "
+                f"{len(base.batch_shape)}], got {reinterpreted_batch_rank}")
+        self._base = base
+        self._rank = r
+        super().__init__(batch_shape=base.batch_shape[:-r],
+                         event_shape=base.batch_shape[-r:]
+                         + base.event_shape)
+
+    @property
+    def mean(self):
+        return self._base.mean
+
+    @property
+    def variance(self):
+        return self._base.variance
+
+    def sample(self, shape=()):
+        return self._base.sample(shape)
+
+    def log_prob(self, value):
+        lp = self._base.log_prob(value)
+        v = lp._value if hasattr(lp, "_value") else jnp.asarray(lp)
+        out = jnp.sum(v, axis=tuple(range(-self._rank, 0)))
+        return Tensor(out, _internal=True)
+
+    def entropy(self):
+        e = self._base.entropy()
+        v = e._value if hasattr(e, "_value") else jnp.asarray(e)
+        return Tensor(jnp.sum(v, axis=tuple(range(-self._rank, 0))),
+                      _internal=True)
+
+
+class ExponentialFamily(Distribution):
+    """Base for exponential-family distributions (reference
+    distribution/exponential_family.py:20): subclasses expose natural
+    parameters + log-normalizer and inherit a Bregman-divergence entropy
+    computed via autodiff of the log normalizer."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        raise NotImplementedError
+
+    def entropy(self):
+        import jax
+
+        nat = [p._value if hasattr(p, "_value") else jnp.asarray(p)
+               for p in self._natural_parameters]
+
+        def logz(*ps):
+            out = self._log_normalizer(*ps)
+            return jnp.sum(out._value if hasattr(out, "_value")
+                           else jnp.asarray(out))
+
+        grads = jax.grad(logz, argnums=tuple(range(len(nat))))(*nat)
+        lz = self._log_normalizer(*nat)
+        lzv = lz._value if hasattr(lz, "_value") else jnp.asarray(lz)
+        ent = lzv - self._mean_carrier_measure
+        for p, g in zip(nat, grads):
+            ent = ent - p * g
+        return Tensor(ent, _internal=True)

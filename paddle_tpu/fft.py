@@ -7,7 +7,7 @@ import jax.numpy as jnp
 from .core.op import apply_op
 from .core.tensor import Tensor
 
-__all__ = ["fft", "ifft", "rfft", "irfft", "hfft", "ihfft", "fft2", "ifft2",
+__all__ = ["fft", "ifft", "rfft", "irfft", "hfft", "ihfft", "fft2", "ifft2", "hfft2", "ihfft2", "hfftn", "ihfftn",
            "rfft2", "irfft2", "fftn", "ifftn", "rfftn", "irfftn",
            "fftfreq", "rfftfreq", "fftshift", "ifftshift"]
 
@@ -50,6 +50,38 @@ fft2 = _wrap2(jnp.fft.fft2, "fft2")
 ifft2 = _wrap2(jnp.fft.ifft2, "ifft2")
 rfft2 = _wrap2(jnp.fft.rfft2, "rfft2")
 irfft2 = _wrap2(jnp.fft.irfft2, "irfft2")
+# hfft2/hfftn compose hermitian fft over the last axis with fft over the
+# rest (the reference kernels do the same decomposition)
+hfft2 = _wrap2(lambda a, s=None, axes=(-2, -1), norm=None:
+               jnp.fft.fft(jnp.fft.hfft(a, n=None if s is None else s[-1],
+                                        axis=axes[-1], norm=norm),
+                           axis=axes[0], norm=norm), "hfft2")
+ihfft2 = _wrap2(lambda a, s=None, axes=(-2, -1), norm=None:
+                jnp.fft.ihfft(jnp.fft.ifft(a, axis=axes[0], norm=norm),
+                              n=None if s is None else s[-1],
+                              axis=axes[-1], norm=norm), "ihfft2")
+def _hfftn_impl(a, s=None, axes=None, norm=None):
+    import jax.numpy as _jnp
+    ax = tuple(range(a.ndim)) if axes is None else tuple(axes)
+    out = _jnp.fft.hfft(a, n=None if s is None else s[-1], axis=ax[-1],
+                        norm=norm)
+    for d in ax[:-1][::-1]:
+        out = _jnp.fft.fft(out, axis=d, norm=norm)
+    return out
+
+
+def _ihfftn_impl(a, s=None, axes=None, norm=None):
+    import jax.numpy as _jnp
+    ax = tuple(range(a.ndim)) if axes is None else tuple(axes)
+    out = a
+    for d in ax[:-1]:
+        out = _jnp.fft.ifft(out, axis=d, norm=norm)
+    return _jnp.fft.ihfft(out, n=None if s is None else s[-1],
+                          axis=ax[-1], norm=norm)
+
+
+hfftn = _wrapn(_hfftn_impl, "hfftn")
+ihfftn = _wrapn(_ihfftn_impl, "ihfftn")
 fftn = _wrapn(jnp.fft.fftn, "fftn")
 ifftn = _wrapn(jnp.fft.ifftn, "ifftn")
 rfftn = _wrapn(jnp.fft.rfftn, "rfftn")

@@ -331,3 +331,70 @@ def load(path, params_path=None, **configs):
         with open(info, "rb") as f:
             meta = pickle.load(f)
     return TranslatedLayer(exported, params, meta)
+
+
+# -- legacy surface (reference jit/__init__.py re-exports) -------------------
+
+declarative = to_static     # pre-2.0 name for @to_static
+
+from . import dy2static  # noqa: E402,F401
+
+_code_level = 0
+_verbosity = 0
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """Log converted code at/below `level` (reference dy2static logging
+    facade; the transpiled source is reachable via
+    StaticFunction.code either way)."""
+    global _code_level
+    _code_level = level
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    global _verbosity
+    _verbosity = level
+
+
+class ProgramTranslator:
+    """Singleton switch for dy2static conversion (reference
+    dygraph_to_static/program_translator.py): enable(False) makes
+    @to_static functions run eagerly."""
+
+    _instance = None
+
+    @classmethod
+    def get_instance(cls):
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def enable(self, enable_to_static_flag):
+        enable_to_static(bool(enable_to_static_flag))
+
+    def get_code(self, dygraph_func):
+        fn = to_static(dygraph_func)
+        return getattr(fn, "code", "")
+
+
+class TracedLayer:
+    """Trace-and-replay wrapper (fluid/dygraph/jit.py:1387): `trace`
+    runs the layer once under to_static and returns (outputs, traced);
+    the traced object replays the compiled program and supports
+    save_inference_model."""
+
+    def __init__(self, static_fn, layer):
+        self._fn = static_fn
+        self._layer = layer
+
+    @classmethod
+    def trace(cls, layer, inputs):
+        fn = to_static(layer.forward.__get__(layer, type(layer)))
+        outs = fn(*inputs)
+        return outs, cls(fn, layer)
+
+    def __call__(self, *args, **kwargs):
+        return self._fn(*args, **kwargs)
+
+    def save_inference_model(self, path, feed=None, fetch=None, **kwargs):
+        save(self._layer, path)

@@ -33,3 +33,5 @@ from .ops.linalg import (  # noqa: F401
 )
 
 inv = inverse
+from .ops.extended import lu, lu_unpack  # noqa: E402,F401
+from .ops.linalg import cond  # noqa: E402,F401

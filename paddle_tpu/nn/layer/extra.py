@@ -195,6 +195,28 @@ class BeamSearchDecoder:
         return np.asarray(jax.nn.log_softmax(logits, axis=-1)), new_states
 
 
+def _reorder_states(states, beam_src, b, k):
+    """Gather every [b*k, ...] leaf of the cell state along the beam axis
+    so hidden state stays paired with the beam that produced it."""
+    import jax
+    import jax.numpy as jnp
+
+    idx = jnp.asarray(beam_src + np.arange(b)[:, None] * k).reshape(-1)
+
+    def gather(leaf):
+        val = leaf._value if isinstance(leaf, Tensor) else leaf
+        if hasattr(val, "shape") and getattr(val, "ndim", 0) >= 1 \
+                and val.shape[0] == b * k:
+            out = jnp.asarray(val)[idx]
+            return Tensor(out, _internal=True) if isinstance(leaf, Tensor) \
+                else out
+        return leaf
+
+    return jax.tree_util.tree_map(
+        gather, states,
+        is_leaf=lambda x: isinstance(x, Tensor) or hasattr(x, "shape"))
+
+
 def dynamic_decode(decoder, inits=None, max_step_num=None, batch_size=1,
                    output_time_major=False, impute_finished=False,
                    is_test=False, return_length=False, **kwargs):
@@ -222,6 +244,9 @@ def dynamic_decode(decoder, inits=None, max_step_num=None, batch_size=1,
         log_probs = np.take_along_axis(flat, top, axis=1)
         beam_src = top // v
         tokens = (top % v).astype(np.int64)
+        # recurrent cell state must follow the surviving beams too: any
+        # leaf with a leading b*k dim is gathered by beam_src
+        states = _reorder_states(states, beam_src, b, k)
         finished = np.take_along_axis(finished, beam_src, axis=1) | (
             tokens == decoder.end_token)
         lengths = np.take_along_axis(lengths, beam_src, axis=1)

@@ -93,7 +93,8 @@ def vsplit(x, num_or_indices, name=None):
         return M.split(x, num_or_indices, axis=0)
     idx = list(num_or_indices)
     n = x.shape[0]
-    bounds = [0] + [min(int(i), n) for i in idx] + [n]
+    bounds = [0] + [min(int(i) + n if int(i) < 0 else int(i), n)
+                    for i in idx] + [n]
     sizes = [b - a for a, b in zip(bounds[:-1], bounds[1:])]
     if any(s < 0 for s in sizes):
         raise ValueError(f"split indices {idx} must be increasing")

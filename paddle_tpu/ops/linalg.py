@@ -195,3 +195,25 @@ def corrcoef(x, rowvar=True, name=None):
 def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
     return jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0,
                    fweights=fweights, aweights=aweights)
+
+
+@defop
+def cond(x, p=None, name=None):
+    """Condition number (linalg.cond; phi cond via SVD/norms).  p in
+    {None/2, 'fro', 'nuc', 1, -1, 2, -2, inf, -inf} like the reference."""
+    if p is None:
+        p = 2
+    if p in (2, -2):
+        s = jnp.linalg.svd(x, compute_uv=False)
+        return (s[..., 0] / s[..., -1]) if p == 2 \
+            else (s[..., -1] / s[..., 0])
+    norm = jnp.linalg.norm
+    inv = jnp.linalg.inv(x)
+    if p == "fro":
+        return norm(x, "fro", axis=(-2, -1)) * norm(inv, "fro",
+                                                    axis=(-2, -1))
+    if p == "nuc":
+        s = jnp.linalg.svd(x, compute_uv=False)
+        si = jnp.linalg.svd(inv, compute_uv=False)
+        return jnp.sum(s, -1) * jnp.sum(si, -1)
+    return norm(x, p, axis=(-2, -1)) * norm(inv, p, axis=(-2, -1))

@@ -4,6 +4,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from .ops.extended import frame, overlap_add  # noqa: F401
 from .core.op import apply_op
 from .core.tensor import Tensor
 

@@ -8,7 +8,11 @@ import jax.numpy as jnp
 from ..core.tensor import Tensor
 from ..nn.layer_base import Layer
 
-__all__ = ["viterbi_decode", "ViterbiDecoder"]
+from .datasets import (Conll05st, Imdb, Imikolov,  # noqa: F401
+                       Movielens, UCIHousing, WMT14, WMT16)
+
+__all__ = ["viterbi_decode", "ViterbiDecoder", "Imdb", "Imikolov",
+           "UCIHousing", "Movielens", "Conll05st", "WMT14", "WMT16"]
 
 
 def viterbi_decode(potentials, transition_params, lengths=None,

@@ -1,5 +1,10 @@
-"""paddle.utils parity surface (native build helper + cpp_extension)."""
+"""paddle.utils parity surface (native build helper + cpp_extension +
+deprecated/dlpack/download/unique_name helpers)."""
 from . import cpp_extension  # noqa: F401
+from . import dlpack  # noqa: F401
+from . import download  # noqa: F401
+from . import unique_name  # noqa: F401
+from .deprecated import deprecated  # noqa: F401
 from .native_build import build_native_lib, get_build_directory  # noqa: F401
 
 
@@ -9,3 +14,38 @@ def try_import(name):
         return importlib.import_module(name)
     except ImportError:
         return None
+
+
+def require_version(min_version, max_version=None):
+    """Check the installed framework version (utils/op_version.py analog):
+    raises if this build is outside [min_version, max_version]."""
+    from ..version import full_version as v
+
+    def parse(s):
+        return tuple(int(p) for p in str(s).split(".")[:3] if p.isdigit())
+
+    if parse(v) < parse(min_version):
+        raise Exception(
+            f"installed version {v} < required {min_version}")
+    if max_version is not None and parse(v) > parse(max_version):
+        raise Exception(
+            f"installed version {v} > maximum {max_version}")
+
+
+def run_check():
+    """Install sanity check (reference install_check.run_check): run one
+    tiny training step on the default device and report."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+
+    paddle.seed(0)
+    lin = nn.Linear(4, 4)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=lin.parameters())
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    loss = (lin(x) ** 2).mean()
+    loss.backward()
+    opt.step()
+    print("PaddlePaddle(TPU) is installed successfully!")

@@ -1,0 +1,35 @@
+"""paddle.utils.deprecated — parity with utils/deprecated.py:34 (decorator
+stamping a deprecation notice onto the docstring and warning on call)."""
+from __future__ import annotations
+
+import functools
+import warnings
+
+__all__ = ["deprecated"]
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    def decorator(func):
+        msg = f"API \"{func.__module__}.{func.__name__}\" is deprecated"
+        if since:
+            msg += f" since {since}"
+        if update_to:
+            msg += f", and will be removed in future versions. Please use "\
+                   f"\"{update_to}\" instead"
+        if reason:
+            msg += f". Reason: {reason}"
+        if func.__doc__:
+            func.__doc__ = ("\n\nWarning:\n    " + msg + "\n\n"
+                            + func.__doc__)
+        if level == 2:
+            raise RuntimeError(msg)
+
+        @functools.wraps(func)
+        def wrapper(*args, **kwargs):
+            if level == 1:
+                warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return func(*args, **kwargs)
+
+        return wrapper
+
+    return decorator

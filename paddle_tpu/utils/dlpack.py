@@ -1,0 +1,20 @@
+"""paddle.utils.dlpack — to_dlpack/from_dlpack (reference utils/dlpack.py)
+over jax's dlpack bridge: zero-copy exchange with torch/numpy/cupy."""
+from __future__ import annotations
+
+from ..core.tensor import Tensor
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+def to_dlpack(x):
+    v = x._value if isinstance(x, Tensor) else x
+    # jax arrays implement the standard __dlpack__ protocol (jax 0.9
+    # removed the explicit to_dlpack shim)
+    return v.__dlpack__()
+
+
+def from_dlpack(dlpack):
+    import jax
+
+    return Tensor(jax.dlpack.from_dlpack(dlpack), _internal=True)

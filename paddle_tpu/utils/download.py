@@ -1,0 +1,39 @@
+"""paddle.utils.download — get_path_from_url parity (utils/download.py).
+This build has no network egress: the helper resolves/extracts LOCAL
+archives and errors with instructions for remote URLs."""
+from __future__ import annotations
+
+import os
+import shutil
+import tarfile
+import zipfile
+
+__all__ = ["get_weights_path_from_url", "get_path_from_url"]
+
+
+def get_path_from_url(url, root_dir, md5sum=None, check_exist=True,
+                      decompress=True):
+    fname = os.path.join(root_dir, os.path.basename(url))
+    if os.path.exists(url):              # already a local path
+        fname = url
+    elif not os.path.exists(fname):
+        raise IOError(
+            f"no network egress: place {os.path.basename(url)} under "
+            f"{root_dir} (from {url}) and retry")
+    if decompress and tarfile.is_tarfile(fname):
+        with tarfile.open(fname) as tf:
+            names = tf.getnames()
+            tf.extractall(root_dir)
+        return os.path.join(root_dir, names[0].split("/")[0])
+    if decompress and zipfile.is_zipfile(fname):
+        with zipfile.ZipFile(fname) as zf:
+            names = zf.namelist()
+            zf.extractall(root_dir)
+        return os.path.join(root_dir, names[0].split("/")[0])
+    return fname
+
+
+def get_weights_path_from_url(url, md5sum=None):
+    home = os.path.expanduser("~/.cache/paddle/weights")
+    os.makedirs(home, exist_ok=True)
+    return get_path_from_url(url, home, md5sum)

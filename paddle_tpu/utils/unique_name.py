@@ -1,0 +1,47 @@
+"""paddle.utils.unique_name — generate/guard/switch (reference
+fluid/unique_name.py): process-wide unique names for layers/params."""
+from __future__ import annotations
+
+import contextlib
+from collections import defaultdict
+
+__all__ = ["generate", "guard", "switch"]
+
+
+class _Generator:
+    def __init__(self):
+        self.ids = defaultdict(int)
+        self.prefix = ""
+
+    def __call__(self, key):
+        n = self.ids[key]
+        self.ids[key] += 1
+        return "_".join([self.prefix + key, str(n)]) if self.prefix \
+            else f"{key}_{n}"
+
+
+_generator = _Generator()
+
+
+def generate(key):
+    return _generator(key)
+
+
+def switch(new_generator=None):
+    global _generator
+    old = _generator
+    _generator = new_generator or _Generator()
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    if isinstance(new_generator, str):
+        g = _Generator()
+        g.prefix = new_generator
+        new_generator = g
+    old = switch(new_generator)
+    try:
+        yield
+    finally:
+        switch(old)

@@ -5,6 +5,17 @@ from . import models  # noqa: F401
 from . import ops  # noqa: F401
 from . import transforms  # noqa: F401
 from .models import *  # noqa: F401,F403
+from .datasets import *  # noqa: F401,F403
+from .transforms import *  # noqa: F401,F403
+# the star imports above leak inner-module attributes (e.g. the package's
+# own `transforms` attr = transforms/transforms.py) over the package
+# bindings; `from . import X` would just re-read the shadowed attr, so
+# restore from sys.modules explicitly
+import sys as _sys  # noqa: E402
+
+datasets = _sys.modules[__name__ + ".datasets"]
+models = _sys.modules[__name__ + ".models"]
+transforms = _sys.modules[__name__ + ".transforms"]
 
 
 def set_image_backend(backend):

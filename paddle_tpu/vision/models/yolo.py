@@ -270,3 +270,128 @@ def yolov3(num_classes=80, pretrained=False, **kwargs):
         raise ValueError("pretrained weights are not bundled; load a local "
                          "state_dict instead")
     return YOLOv3(num_classes=num_classes, **kwargs)
+
+
+def yolo_head_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                   ignore_thresh, downsample_ratio, gt_score=None,
+                   use_label_smooth=True, scale_x_y=1.0):
+    """Functional single-head YOLOv3 loss with the yolo_loss OP contract
+    (vision/ops.py yolo_loss; kernel yolov3_loss_op): x [N, A*(5+C), H, W],
+    gt_box [N, B, 4] normalized (cx, cy, w, h), anchors a flat pixel
+    list, anchor_mask the indices this head owns.  Returns loss [N].
+    Same math as YOLOv3Loss above, head-local."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+
+    xv = x if hasattr(x, "shape") else paddle.to_tensor(x)
+    n, _, h, w = xv.shape
+    stride = downsample_ratio
+    in_h, in_w = h * stride, w * stride
+    anc_all = np.asarray(anchors, "float32").reshape(-1, 2)
+    anc = anc_all[np.asarray(anchor_mask, int)]
+    na = anc.shape[0]
+    gb = np.asarray(gt_box.numpy() if hasattr(gt_box, "numpy") else gt_box,
+                    "float32")
+    gl = np.asarray(gt_label.numpy() if hasattr(gt_label, "numpy")
+                    else gt_label)
+    tobj = np.zeros((n, na, h, w), "float32")
+    tbox = np.zeros((n, na, h, w, 4), "float32")
+    tcls = np.zeros((n, na, h, w), "int64")
+    for i in range(n):
+        for bx, cl in zip(gb[i], gl[i]):
+            cx, cy, bw, bh = bx
+            if bw <= 0 or bh <= 0:
+                continue
+            bw_p, bh_p = bw * in_w, bh * in_h
+            gx, gy = int(cx * w), int(cy * h)
+            if not (0 <= gx < w and 0 <= gy < h):
+                continue
+            inter = np.minimum(anc_all[:, 0], bw_p) * \
+                np.minimum(anc_all[:, 1], bh_p)
+            union = anc_all[:, 0] * anc_all[:, 1] + bw_p * bh_p - inter
+            best = int((inter / union).argmax())
+            if best not in list(anchor_mask):
+                continue
+            a = list(anchor_mask).index(best)
+            tobj[i, a, gy, gx] = 1.0
+            tbox[i, a, gy, gx] = [cx * w - gx, cy * h - gy,
+                                  np.log(max(bw_p, 1e-3) / anc[a, 0]),
+                                  np.log(max(bh_p, 1e-3) / anc[a, 1])]
+            tcls[i, a, gy, gx] = int(cl)
+    p = xv.reshape([n, na, 5 + class_num, h, w])
+    pxy, pwh = p[:, :, 0:2], p[:, :, 2:4]
+    pobj, pcls = p[:, :, 4], p[:, :, 5:]
+    # ignore_thresh (yolov3_loss_op contract): decode the predictions and
+    # EXCLUDE unassigned anchors whose best IoU with any GT exceeds the
+    # threshold from the no-object loss.  Host bookkeeping on detached
+    # values, like the target assignment above.
+    pv = np.asarray(p.numpy() if hasattr(p, "numpy") else p)
+    sig = 1.0 / (1.0 + np.exp(-pv[:, :, 0:2]))
+    gyx = np.stack(np.meshgrid(np.arange(h), np.arange(w),
+                               indexing="ij"))          # [2, h, w]
+    pcx = (sig[:, :, 0] + gyx[1][None, None]) / w
+    pcy = (sig[:, :, 1] + gyx[0][None, None]) / h
+    pw_ = np.exp(np.clip(pv[:, :, 2], -10, 10)) \
+        * anc[:, 0][None, :, None, None] / in_w
+    ph_ = np.exp(np.clip(pv[:, :, 3], -10, 10)) \
+        * anc[:, 1][None, :, None, None] / in_h
+    ignore = np.zeros((n, na, h, w), "float32")
+    for i in range(n):
+        valid = [(bx, ) for bx in gb[i] if bx[2] > 0 and bx[3] > 0]
+        if not valid:
+            continue
+        gtb = np.asarray([bx for (bx,) in valid], "float32")  # [M, 4]
+        px1 = pcx[i] - pw_[i] / 2
+        py1 = pcy[i] - ph_[i] / 2
+        px2 = pcx[i] + pw_[i] / 2
+        py2 = pcy[i] + ph_[i] / 2
+        gx1 = gtb[:, 0] - gtb[:, 2] / 2
+        gy1 = gtb[:, 1] - gtb[:, 3] / 2
+        gx2 = gtb[:, 0] + gtb[:, 2] / 2
+        gy2 = gtb[:, 1] + gtb[:, 3] / 2
+        best = np.zeros((na, h, w), "float32")
+        for m in range(gtb.shape[0]):
+            iw = np.clip(np.minimum(px2, gx2[m])
+                         - np.maximum(px1, gx1[m]), 0, None)
+            ih = np.clip(np.minimum(py2, gy2[m])
+                         - np.maximum(py1, gy1[m]), 0, None)
+            inter = iw * ih
+            union = pw_[i] * ph_[i] + gtb[m, 2] * gtb[m, 3] - inter
+            best = np.maximum(best, inter / np.maximum(union, 1e-10))
+        ignore[i] = (best > ignore_thresh).astype("float32")
+    obj_t = paddle.to_tensor(tobj)
+    # positives always count; negatives only where not ignored
+    obj_w = paddle.to_tensor(
+        tobj + (1.0 - tobj) * (1.0 - ignore))
+    if gt_score is not None:
+        # per-box confidence weights scale the positive cells
+        gs = np.asarray(gt_score.numpy() if hasattr(gt_score, "numpy")
+                        else gt_score, "float32")
+        wpos = np.ones_like(tobj)
+        for i in range(n):
+            for bx, sc_, cl in zip(gb[i], gs[i], gl[i]):
+                cx, cy, bw, bh = bx
+                if bw <= 0 or bh <= 0:
+                    continue
+                gx, gy = int(cx * w), int(cy * h)
+                if 0 <= gx < w and 0 <= gy < h:
+                    wpos[i, :, gy, gx] = np.where(
+                        tobj[i, :, gy, gx] > 0, sc_, 1.0)
+        obj_w = obj_w * paddle.to_tensor(wpos)
+    box_nchw = paddle.to_tensor(tbox).transpose([0, 1, 4, 2, 3])
+    mask = obj_t.unsqueeze(2)
+    axes = [1, 2, 3]
+    loss_obj = (F.binary_cross_entropy_with_logits(
+        pobj, obj_t, reduction="none") * obj_w).sum(axis=axes)
+    loss_xy = (F.binary_cross_entropy_with_logits(
+        pxy, box_nchw[:, :, 0:2], reduction="none") * mask
+    ).sum(axis=[1, 2, 3, 4])
+    loss_wh = (((pwh - box_nchw[:, :, 2:4]) ** 2) * mask
+               ).sum(axis=[1, 2, 3, 4])
+    smooth = 1.0 / class_num if use_label_smooth else 0.0
+    cls_oh = F.one_hot(paddle.to_tensor(tcls), class_num
+                       ).transpose([0, 1, 4, 2, 3])
+    cls_t = cls_oh * (1.0 - smooth) + smooth * (1.0 / class_num)
+    loss_cls = (F.binary_cross_entropy_with_logits(
+        pcls, cls_t, reduction="none") * mask).sum(axis=[1, 2, 3, 4])
+    return loss_obj + loss_xy + loss_wh + loss_cls

@@ -602,3 +602,259 @@ def multiclass_nms(bboxes, scores, score_threshold=0.05, nms_top_k=400,
     return (Tensor(jnp.asarray(dets), _internal=True),
             Tensor(jnp.asarray(picks), _internal=True),
             Tensor(jnp.asarray(per_img, jnp.int32), _internal=True))
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """vision/ops.py:1175 RoIPool (max pooling over quantized RoI bins —
+    the pre-RoIAlign detector head)."""
+    xv = _unwrap(x)
+    bx = _unwrap(boxes)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    n, c, h, w = xv.shape
+    bn = np.asarray(_unwrap(boxes_num))
+    img_of_box = np.repeat(np.arange(len(bn)), bn)
+    outs = []
+    bx_np = np.asarray(bx)
+    for bi in range(bx_np.shape[0]):
+        img = int(img_of_box[bi]) if len(img_of_box) else 0
+        x1, y1, x2, y2 = [v * spatial_scale for v in bx_np[bi]]
+        x1, y1 = int(np.round(x1)), int(np.round(y1))
+        x2, y2 = int(np.round(x2)), int(np.round(y2))
+        rh = max(y2 - y1 + 1, 1)
+        rw = max(x2 - x1 + 1, 1)
+        bins = []
+        for i in range(ph):
+            for j in range(pw):
+                ys = y1 + int(np.floor(i * rh / ph))
+                ye = y1 + int(np.ceil((i + 1) * rh / ph))
+                xs = x1 + int(np.floor(j * rw / pw))
+                xe = x1 + int(np.ceil((j + 1) * rw / pw))
+                ys, ye = np.clip([ys, ye], 0, h)
+                xs, xe = np.clip([xs, xe], 0, w)
+                if ye <= ys or xe <= xs:
+                    bins.append(jnp.zeros((c,), xv.dtype))
+                else:
+                    bins.append(jnp.max(xv[img, :, ys:ye, xs:xe],
+                                        axis=(1, 2)))
+        outs.append(jnp.stack(bins, axis=1).reshape(c, ph, pw))
+    out = jnp.stack(outs) if outs else jnp.zeros((0, c, ph, pw), xv.dtype)
+    return Tensor(out, _internal=True)
+
+
+class RoIPool(Layer):
+    """vision/ops.py RoIPool layer form."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self._output_size,
+                        self._spatial_scale)
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=200, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True,
+               return_index=False, return_rois_num=True, name=None):
+    """vision/ops.py:1819 Matrix NMS (SOLOv2): soft suppression via the
+    decay matrix min-IoU formulation — parallel, no sequential greedy
+    loop, so it maps to dense TPU math directly."""
+    bx = np.asarray(_unwrap(bboxes))   # [N, M, 4]
+    sc = np.asarray(_unwrap(scores))   # [N, C, M]
+    all_out, all_idx, rois_num = [], [], []
+    n, cnum, m = sc.shape
+    for b in range(n):
+        dets, idxs = [], []
+        for c in range(cnum):
+            if c == background_label:
+                continue
+            s = sc[b, c]
+            keep = np.where(s > score_threshold)[0]
+            if keep.size == 0:
+                continue
+            order = keep[np.argsort(-s[keep])][:nms_top_k]
+            boxes_c = bx[b, order]
+            scores_c = s[order]
+            # pairwise IoU of the kept, score-sorted boxes
+            x1 = np.maximum(boxes_c[:, None, 0], boxes_c[None, :, 0])
+            y1 = np.maximum(boxes_c[:, None, 1], boxes_c[None, :, 1])
+            x2 = np.minimum(boxes_c[:, None, 2], boxes_c[None, :, 2])
+            y2 = np.minimum(boxes_c[:, None, 3], boxes_c[None, :, 3])
+            ext = 0.0 if normalized else 1.0
+            iw = np.clip(x2 - x1 + ext, 0, None)
+            ih = np.clip(y2 - y1 + ext, 0, None)
+            inter = iw * ih
+            area = ((boxes_c[:, 2] - boxes_c[:, 0] + ext)
+                    * (boxes_c[:, 3] - boxes_c[:, 1] + ext))
+            iou = inter / np.maximum(area[:, None] + area[None, :] - inter,
+                                     1e-10)
+            iou = np.triu(iou, k=1)
+            # decay: for each box j, over higher-scored i
+            comp = iou.max(axis=0)      # max IoU of each box vs any higher
+            # decay_ij = f(iou_ij) / f(comp_i): the suppressor row i is
+            # itself discounted by ITS best suppressor (comp along i)
+            if use_gaussian:
+                decay = np.exp(-(iou ** 2 - comp[:, None] ** 2)
+                               / gaussian_sigma)
+            else:
+                decay = (1 - iou) / np.maximum(1 - comp[:, None], 1e-10)
+            decay = np.where(np.triu(np.ones_like(iou), k=1) > 0, decay,
+                             np.inf).min(axis=0)
+            decay[0] = 1.0
+            new_scores = scores_c * decay
+            ok = new_scores > post_threshold
+            for t in np.where(ok)[0]:
+                dets.append([c, new_scores[t], *boxes_c[t]])
+                idxs.append(b * m + order[t])
+        dets = np.asarray(dets, np.float32).reshape(-1, 6)
+        idxs = np.asarray(idxs, np.int64)
+        if dets.shape[0] > keep_top_k:
+            top = np.argsort(-dets[:, 1])[:keep_top_k]
+            dets, idxs = dets[top], idxs[top]
+        all_out.append(dets)
+        all_idx.append(idxs)
+        rois_num.append(dets.shape[0])
+    out = Tensor(np.concatenate(all_out) if all_out else
+                 np.zeros((0, 6), np.float32))
+    ret = [out]
+    if return_rois_num:
+        ret.append(Tensor(np.asarray(rois_num, np.int32)))
+    if return_index:
+        ret.append(Tensor(np.concatenate(all_idx) if all_idx else
+                          np.zeros((0,), np.int64)))
+    return tuple(ret) if len(ret) > 1 else out
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None, name=None):
+    """vision/ops.py:836: route each RoI to its FPN level by
+    sqrt(area)/refer_scale (the FPN paper's assignment)."""
+    rois = np.asarray(_unwrap(fpn_rois))
+    off = 1.0 if pixel_offset else 0.0
+    ws = rois[:, 2] - rois[:, 0] + off
+    hs = rois[:, 3] - rois[:, 1] + off
+    scale = np.sqrt(np.clip(ws * hs, 0, None))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    n_levels = max_level - min_level + 1
+    outs, out_nums, order = [], [], []
+    for L in range(min_level, min_level + n_levels):
+        idx = np.where(lvl == L)[0]
+        outs.append(Tensor(rois[idx].astype(rois.dtype)))
+        order.append(idx)
+        if rois_num is not None:
+            rn = np.asarray(_unwrap(rois_num))
+            img_of = np.repeat(np.arange(len(rn)), rn)
+            out_nums.append(Tensor(np.bincount(
+                img_of[idx], minlength=len(rn)).astype(np.int32)))
+    restore = np.argsort(np.concatenate(order)) if order else \
+        np.zeros((0,), np.int64)
+    if rois_num is not None:
+        return outs, Tensor(restore.astype(np.int32)), out_nums
+    return outs, Tensor(restore.astype(np.int32))
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False,
+                       name=None):
+    """vision/ops.py:1668 RPN proposal generation: decode anchors with
+    deltas, clip, filter small, NMS per image."""
+    sc = np.asarray(_unwrap(scores))          # [N, A, H, W]
+    bd = np.asarray(_unwrap(bbox_deltas))     # [N, 4A, H, W]
+    im = np.asarray(_unwrap(img_size))        # [N, 2]
+    an = np.asarray(_unwrap(anchors)).reshape(-1, 4)   # [H*W*A, 4]
+    va = np.asarray(_unwrap(variances)).reshape(-1, 4)
+    n, a, h, w = sc.shape
+    off = 1.0 if pixel_offset else 0.0
+    rois_out, num_out, scores_out = [], [], []
+    for i in range(n):
+        s = sc[i].transpose(1, 2, 0).reshape(-1)
+        d = bd[i].reshape(a, 4, h, w).transpose(2, 3, 0, 1).reshape(-1, 4)
+        order = np.argsort(-s)[:pre_nms_top_n]
+        s, d, anc, var = s[order], d[order], an[order], va[order]
+        aw = anc[:, 2] - anc[:, 0] + off
+        ah = anc[:, 3] - anc[:, 1] + off
+        acx = anc[:, 0] + aw * 0.5
+        acy = anc[:, 1] + ah * 0.5
+        cx = var[:, 0] * d[:, 0] * aw + acx
+        cy = var[:, 1] * d[:, 1] * ah + acy
+        bw = aw * np.exp(np.minimum(var[:, 2] * d[:, 2], 10.0))
+        bh = ah * np.exp(np.minimum(var[:, 3] * d[:, 3], 10.0))
+        props = np.stack([cx - bw * 0.5, cy - bh * 0.5,
+                          cx + bw * 0.5 - off, cy + bh * 0.5 - off], 1)
+        H, W = im[i]
+        props[:, 0::2] = np.clip(props[:, 0::2], 0, W - off)
+        props[:, 1::2] = np.clip(props[:, 1::2], 0, H - off)
+        keep = np.where((props[:, 2] - props[:, 0] + off >= min_size)
+                        & (props[:, 3] - props[:, 1] + off >= min_size))[0]
+        props, s = props[keep], s[keep]
+        sel = np.asarray(nms(Tensor(props.astype(np.float32)),
+                             iou_threshold=nms_thresh,
+                             scores=Tensor(s.astype(np.float32)),
+                             top_k=post_nms_top_n).numpy())
+        rois_out.append(props[sel])
+        scores_out.append(s[sel].reshape(-1, 1))
+        num_out.append(len(sel))
+    rois = Tensor(np.concatenate(rois_out).astype(np.float32))
+    rscores = Tensor(np.concatenate(scores_out).astype(np.float32))
+    if return_rois_num:
+        return rois, rscores, Tensor(np.asarray(num_out, np.int32))
+    return rois, rscores
+
+
+def read_file(filename, name=None):
+    """vision/ops.py:960: file bytes as a uint8 tensor."""
+    with open(filename, "rb") as f:
+        data = f.read()
+    return Tensor(np.frombuffer(data, np.uint8).copy())
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """vision/ops.py:1006: decode a JPEG byte tensor to CHW uint8.  The
+    reference uses nvjpeg; here PIL/cv2 decode (loud error when neither
+    is installed — no silent wrong pixels)."""
+    data = bytes(np.asarray(_unwrap(x)).astype(np.uint8).tobytes())
+    import io as _io
+    try:
+        from PIL import Image
+        img = Image.open(_io.BytesIO(data))
+        if mode == "gray":
+            img = img.convert("L")
+        elif mode in ("rgb", "unchanged"):
+            img = img.convert("RGB") if mode == "rgb" else img
+        arr = np.asarray(img)
+    except ImportError:
+        try:
+            import cv2
+            flag = {"gray": cv2.IMREAD_GRAYSCALE,
+                    "rgb": cv2.IMREAD_COLOR}.get(mode, cv2.IMREAD_UNCHANGED)
+            arr = cv2.imdecode(np.frombuffer(data, np.uint8), flag)
+            if arr.ndim == 3:
+                arr = arr[..., ::-1]   # cv2 decodes BGR; match PIL's RGB
+        except ImportError as e:
+            raise ImportError(
+                "decode_jpeg needs PIL or cv2 installed") from e
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(np.ascontiguousarray(arr))
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """vision/ops.py yolo_loss — delegates to the YOLOv3Loss layer math
+    (vision/models/yolo.py), which implements the yolov3_loss_op
+    assignment + BCE/L1 terms for ONE detection head."""
+    from .models.yolo import yolo_head_loss
+    return yolo_head_loss(x, gt_box, gt_label, anchors, anchor_mask,
+                          class_num, ignore_thresh, downsample_ratio,
+                          gt_score, use_label_smooth, scale_x_y)

@@ -216,3 +216,127 @@ def adjust_hue(img, hue_factor):
         np.choose(i, [t, v, v, q, p, p]),
         np.choose(i, [p, p, t, v, v, q])], axis=-1)
     return np.clip(rgb * 255.0, 0, 255).astype(_to_numpy(img).dtype)
+
+
+def _inverse_warp(arr, inv_fn, oh, ow, interpolation, fill):
+    """Sample arr (HWC numpy) at source coords given by inv_fn(yy, xx) ->
+    (ys, xs) — the shared inverse-mapping core of rotate/affine/
+    perspective."""
+    h, w = arr.shape[:2]
+    yy, xx = np.meshgrid(np.arange(oh), np.arange(ow), indexing="ij")
+    ys, xs = inv_fn(yy.astype("float64"), xx.astype("float64"))
+    out = np.full((oh, ow, arr.shape[2]), fill, dtype=arr.dtype)
+    if interpolation == "bilinear":
+        y0 = np.floor(ys).astype(int)
+        x0 = np.floor(xs).astype(int)
+        wy = (ys - y0)[..., None]
+        wx = (xs - x0)[..., None]
+
+        def at(yi, xi):
+            inb = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+            v = arr[np.clip(yi, 0, h - 1), np.clip(xi, 0, w - 1)].astype(
+                "float64")
+            return np.where(inb[..., None], v, float(fill))
+
+        res = (at(y0, x0) * (1 - wy) * (1 - wx)
+               + at(y0, x0 + 1) * (1 - wy) * wx
+               + at(y0 + 1, x0) * wy * (1 - wx)
+               + at(y0 + 1, x0 + 1) * wy * wx)
+        if arr.dtype == np.uint8:
+            res = np.clip(np.round(res), 0, 255)
+        out = res.astype(arr.dtype)
+    else:
+        yi = np.round(ys).astype(int)
+        xi = np.round(xs).astype(int)
+        valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+        out[valid] = arr[yi[valid], xi[valid]]
+    return out
+
+
+def affine(img, angle, translate, scale, shear, interpolation="nearest",
+           fill=0, center=None):
+    """transforms.functional.affine: rotation+translate+scale+shear about
+    `center` (default image center), inverse-warp sampled."""
+    arr = _to_numpy(img)
+    squeeze = arr.ndim == 2
+    if squeeze:
+        arr = arr[:, :, None]
+    h, w = arr.shape[:2]
+    cy, cx = ((h - 1) / 2.0, (w - 1) / 2.0) if center is None else \
+        (center[1], center[0])
+    rot = np.deg2rad(angle)
+    sx, sy = [np.deg2rad(s) for s in
+              (shear if isinstance(shear, (list, tuple)) else (shear, 0.0))]
+    # forward matrix M = T(center+translate) R(rot) Shear S(scale) T(-center)
+    a = np.cos(rot - sy) / np.cos(sy)
+    b = -np.cos(rot - sy) * np.tan(sx) / np.cos(sy) - np.sin(rot)
+    c = np.sin(rot - sy) / np.cos(sy)
+    d = -np.sin(rot - sy) * np.tan(sx) / np.cos(sy) + np.cos(rot)
+    M = np.array([[d, -b], [-c, a]]) / (a * d - b * c) / scale  # inverse
+    ty, tx = translate[1], translate[0]
+
+    def inv(yy, xx):
+        dy = yy - cy - ty
+        dx = xx - cx - tx
+        ys = M[0, 0] * dy + M[0, 1] * dx + cy
+        xs = M[1, 0] * dy + M[1, 1] * dx + cx
+        return ys, xs
+
+    out = _inverse_warp(arr, inv, h, w, interpolation, fill)
+    return out[:, :, 0] if squeeze else out
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest",
+                fill=0):
+    """transforms.functional.perspective: maps the quad `startpoints` to
+    `endpoints` (4 [x, y] pairs) and warps accordingly."""
+    arr = _to_numpy(img)
+    squeeze = arr.ndim == 2
+    if squeeze:
+        arr = arr[:, :, None]
+    h, w = arr.shape[:2]
+    # solve the 8-dof homography taking endpoints -> startpoints (inverse
+    # map, so output pixels sample from the source quad)
+    A, bvec = [], []
+    for (dx, dy), (sx_, sy_) in zip(endpoints, startpoints):
+        A.append([dx, dy, 1, 0, 0, 0, -sx_ * dx, -sx_ * dy])
+        bvec.append(sx_)
+        A.append([0, 0, 0, dx, dy, 1, -sy_ * dx, -sy_ * dy])
+        bvec.append(sy_)
+    coef = np.linalg.solve(np.asarray(A, "float64"),
+                           np.asarray(bvec, "float64"))
+    Hm = np.append(coef, 1.0).reshape(3, 3)
+
+    def inv(yy, xx):
+        den = Hm[2, 0] * xx + Hm[2, 1] * yy + Hm[2, 2]
+        xs = (Hm[0, 0] * xx + Hm[0, 1] * yy + Hm[0, 2]) / den
+        ys = (Hm[1, 0] * xx + Hm[1, 1] * yy + Hm[1, 2]) / den
+        return ys, xs
+
+    out = _inverse_warp(arr, inv, h, w, interpolation, fill)
+    return out[:, :, 0] if squeeze else out
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    """transforms.functional.erase: overwrite the [i:i+h, j:j+w] patch
+    with value(s) v.  Accepts HWC numpy/PIL or CHW Tensor like the
+    reference."""
+    from ...core.tensor import Tensor as _T
+    if isinstance(img, _T):
+        import jax.numpy as jnp
+        val = img._value
+        v_j = jnp.asarray(v, val.dtype)
+        if v_j.ndim == 1:      # per-channel fill on the CHW layout
+            v_j = v_j.reshape(-1, 1, 1)
+        patch = jnp.broadcast_to(v_j, (val.shape[0], h, w))
+        out = val.at[:, i:i + h, j:j + w].set(patch)
+        if inplace:
+            img._replace_(out, None)
+            return img
+        return _T(out, _internal=True)
+    arr = _to_numpy(img).copy()
+    v_arr = np.asarray(v, arr.dtype)
+    if v_arr.ndim == 1:       # per-channel fill
+        v_arr = v_arr.reshape(1, 1, -1)
+    arr[i:i + h, j:j + w] = v_arr   # scalar / [C] / [h, w, C] all broadcast
+    return arr

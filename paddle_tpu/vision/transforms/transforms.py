@@ -282,3 +282,114 @@ class ColorJitter(BaseTransform):
         for t in order:
             img = t(img)
         return img
+
+
+class RandomAffine(BaseTransform):
+    """transforms.RandomAffine: random rotation/translate/scale/shear
+    drawn per call, applied via functional.affine."""
+
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        super().__init__(keys)
+        self.degrees = (-degrees, degrees) if np.isscalar(degrees) \
+            else tuple(degrees)
+        self.translate = translate
+        self.scale = scale
+        self.shear = shear
+        self.interpolation = interpolation
+        self.fill = fill
+        self.center = center
+
+    def _apply_image(self, img):
+        angle = np.random.uniform(*self.degrees)
+        h, w = F._to_numpy(img).shape[:2]
+        if self.translate is not None:
+            tx = np.random.uniform(-self.translate[0], self.translate[0]) * w
+            ty = np.random.uniform(-self.translate[1], self.translate[1]) * h
+        else:
+            tx = ty = 0.0
+        scale = np.random.uniform(*self.scale) if self.scale else 1.0
+        if self.shear is None:
+            shear = (0.0, 0.0)
+        elif np.isscalar(self.shear):
+            shear = (np.random.uniform(-self.shear, self.shear), 0.0)
+        else:
+            sh = list(self.shear) + [0.0] * (4 - len(list(self.shear)))
+            shear = (np.random.uniform(sh[0], sh[1]),
+                     np.random.uniform(sh[2], sh[3]))
+        return F.affine(img, angle, (tx, ty), scale, shear,
+                         self.interpolation, self.fill, self.center)
+
+
+class RandomPerspective(BaseTransform):
+    """transforms.RandomPerspective: with probability `prob`, move each
+    corner inward by up to distortion_scale of the half-extent."""
+
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.interpolation = interpolation
+        self.fill = fill
+
+    def _apply_image(self, img):
+        if np.random.rand() >= self.prob:
+            return img
+        h, w = F._to_numpy(img).shape[:2]
+        dx = int(self.distortion_scale * w / 2)
+        dy = int(self.distortion_scale * h / 2)
+        start = [[0, 0], [w - 1, 0], [w - 1, h - 1], [0, h - 1]]
+        rnd = lambda a: int(np.random.randint(0, a + 1)) if a > 0 else 0
+        end = [[rnd(dx), rnd(dy)],
+               [w - 1 - rnd(dx), rnd(dy)],
+               [w - 1 - rnd(dx), h - 1 - rnd(dy)],
+               [rnd(dx), h - 1 - rnd(dy)]]
+        return F.perspective(img, start, end, self.interpolation,
+                              self.fill)
+
+
+class RandomErasing(BaseTransform):
+    """transforms.RandomErasing: erase a random patch with `value` (or
+    random noise when value == "random")."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        super().__init__(keys)
+        if not (0 <= prob <= 1):
+            raise ValueError("prob should be in [0, 1]")
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+        self.inplace = inplace
+
+    def _apply_image(self, img):
+        if np.random.rand() >= self.prob:
+            return img
+        arr_like = F._to_numpy(img) if not hasattr(img, "_value") else None
+        if arr_like is not None:
+            h, w, c = arr_like.shape if arr_like.ndim == 3 else (
+                *arr_like.shape, 1)
+        else:
+            c, h, w = img.shape
+        area = h * w
+        for _ in range(10):
+            target = np.random.uniform(*self.scale) * area
+            aspect = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                              np.log(self.ratio[1])))
+            eh = int(round(np.sqrt(target * aspect)))
+            ew = int(round(np.sqrt(target / aspect)))
+            if eh < h and ew < w:
+                i = np.random.randint(0, h - eh + 1)
+                j = np.random.randint(0, w - ew + 1)
+                if self.value == "random":
+                    v = np.random.standard_normal((eh, ew, c) if
+                                                  arr_like is not None
+                                                  else (c, eh, ew))
+                    if arr_like is not None and arr_like.dtype == np.uint8:
+                        v = np.clip(v * 64 + 128, 0, 255)
+                else:
+                    v = self.value
+                return F.erase(img, i, j, eh, ew, v, self.inplace)
+        return img

@@ -287,6 +287,7 @@ spec("viterbi_decode", lambda: (F(2, 5, 4), F(4, 4)), grad=False)
 spec("edit_distance", lambda: (I64(2, 5, hi=4), I64(2, 6, hi=4)),
      grad=False)
 spec("lu", lambda: (PSD(4),), grad=False)
+spec("cond", lambda: (PSD(4),), grad=False)
 spec("lu_unpack",
      lambda: (F(4, 4), np.array([1, 2, 3, 4], np.int32)), grad=False)
 spec("affine_grid", lambda: (F(2, 2, 3),), {"out_shape": [2, 1, 4, 5]})

@@ -1,0 +1,240 @@
+"""vision.ops / transforms / misc long tail (reference vision/ops.py:
+roi_pool:1175, matrix_nms:1819, distribute_fpn_proposals:836,
+generate_proposals:1668, yolo_loss, read_file:960; transforms
+RandomAffine/RandomPerspective/RandomErasing + functional
+affine/perspective/erase)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as vops
+import paddle_tpu.vision.transforms as T
+
+
+def test_roi_pool_matches_manual_max():
+    x = paddle.to_tensor(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    boxes = paddle.to_tensor(np.array([[0, 0, 3, 3]], np.float32))
+    bn = paddle.to_tensor(np.array([1], np.int32))
+    out = vops.roi_pool(x, boxes, bn, 2)
+    np.testing.assert_allclose(out.numpy()[0, 0],
+                               [[5.0, 7.0], [13.0, 15.0]])
+    layer = vops.RoIPool(2)
+    np.testing.assert_allclose(layer(x, boxes, bn).numpy(), out.numpy())
+
+
+def test_matrix_nms_suppresses_duplicates():
+    # two near-identical boxes + one distinct: the duplicate's score decays
+    bb = np.array([[[0, 0, 10, 10], [0, 0, 10.5, 10.5],
+                    [20, 20, 30, 30]]], np.float32)
+    sc = np.array([[[0.9, 0.8, 0.7]]], np.float32)
+    out, num = vops.matrix_nms(paddle.to_tensor(bb), paddle.to_tensor(sc),
+                               score_threshold=0.1, background_label=-1)
+    o = out.numpy()
+    assert int(num.numpy()[0]) == 3
+    top = o[np.argsort(-o[:, 1])]
+    assert top[0, 1] == pytest.approx(0.9)       # best box untouched
+    # the overlapping second box decays well below the distinct third's
+    decayed = o[np.isclose(o[:, 2:].sum(1), np.array([0+0+10.5+10.5]))]
+    assert decayed[0, 1] < 0.3
+
+
+def test_distribute_fpn_proposals_routes_by_scale():
+    rois = np.array([[0, 0, 16, 16],      # tiny -> min level
+                     [0, 0, 224, 224],    # refer scale -> refer level
+                     [0, 0, 900, 900]],   # huge -> max level
+                    np.float32)
+    outs, restore = vops.distribute_fpn_proposals(
+        paddle.to_tensor(rois), 2, 5, 4, 224)
+    sizes = [o.shape[0] for o in outs]
+    assert sizes == [1, 0, 1, 1]
+    assert sorted(restore.numpy().tolist()) == [0, 1, 2]
+
+
+def test_generate_proposals_and_yolo_loss():
+    rng = np.random.RandomState(0)
+    scores = paddle.to_tensor(rng.rand(1, 3, 4, 4).astype(np.float32))
+    deltas = paddle.to_tensor(
+        rng.standard_normal((1, 12, 4, 4)).astype(np.float32) * 0.1)
+    img = paddle.to_tensor(np.array([[32.0, 32.0]], np.float32))
+    anch = paddle.to_tensor(
+        (rng.rand(48, 4) * 16 + np.array([0, 0, 8, 8])).astype(np.float32))
+    var = paddle.to_tensor(np.ones((48, 4), np.float32))
+    rois, rscores, num = vops.generate_proposals(
+        scores, deltas, img, anch, var, post_nms_top_n=5,
+        return_rois_num=True)
+    assert rois.shape[0] == int(num.numpy()[0]) <= 5
+    r = rois.numpy()
+    assert (r[:, 0] >= 0).all() and (r[:, 2] <= 32).all()
+
+    x = paddle.to_tensor(rng.standard_normal(
+        (2, 3 * 9, 4, 4)).astype(np.float32))
+    gtb = paddle.to_tensor(np.array(
+        [[[0.5, 0.5, 0.3, 0.4]], [[0.2, 0.3, 0.1, 0.2]]], np.float32))
+    gtl = paddle.to_tensor(np.array([[1], [2]], np.int64))
+    loss = vops.yolo_loss(x, gtb, gtl, anchors=[10, 13, 16, 30, 33, 23],
+                          anchor_mask=[0, 1, 2], class_num=4,
+                          ignore_thresh=0.7, downsample_ratio=8)
+    assert loss.shape[0] == 2 and np.isfinite(loss.numpy()).all()
+    loss.sum().backward()
+
+
+def test_read_file_roundtrip(tmp_path):
+    p = tmp_path / "blob.bin"
+    p.write_bytes(bytes(range(10)))
+    out = vops.read_file(str(p))
+    assert out.numpy().tolist() == list(range(10))
+
+
+def test_transforms_affine_perspective_erase():
+    img = (np.random.RandomState(0).rand(16, 16, 3) * 255).astype(np.uint8)
+    from paddle_tpu.vision.transforms import functional as F
+    same = F.affine(img, 0.0, (0, 0), 1.0, 0.0)
+    np.testing.assert_array_equal(same, img)
+    # identity perspective
+    quad = [[0, 0], [15, 0], [15, 15], [0, 15]]
+    np.testing.assert_array_equal(F.perspective(img, quad, quad), img)
+    erased = F.erase(img, 2, 3, 4, 5, 7)
+    assert (erased[2:6, 3:8] == 7).all()
+    assert (erased[:2] == img[:2]).all()
+    # tensor CHW path
+    t = paddle.to_tensor(np.zeros((3, 8, 8), np.float32))
+    te = F.erase(t, 1, 1, 2, 2, 5.0)
+    assert float(te.numpy()[:, 1:3, 1:3].min()) == 5.0
+    np.random.seed(0)
+    out = T.RandomAffine(25, translate=(0.2, 0.2), scale=(0.7, 1.3),
+                         shear=15)(img)
+    assert out.shape == img.shape
+    out = T.RandomPerspective(prob=1.0)(img)
+    assert out.shape == img.shape
+    out = T.RandomErasing(prob=1.0, value="random")(img)
+    assert out.shape == img.shape and (out != img).any()
+
+
+def test_linalg_cond_and_fft_hfft2():
+    a = paddle.to_tensor(np.diag([4.0, 1.0]).astype(np.float32))
+    assert float(paddle.linalg.cond(a)) == pytest.approx(4.0)
+    assert float(paddle.linalg.cond(a, "fro")) == pytest.approx(
+        np.sqrt(17) * np.sqrt(1 / 16 + 1), rel=1e-5)
+    z = paddle.to_tensor(np.random.RandomState(0).rand(4, 3)
+                         .astype(np.complex64))
+    assert list(paddle.fft.hfft2(z).shape) == [4, 4]
+
+
+def test_distributed_p2p_surface():
+    import paddle_tpu.distributed as dist
+    with pytest.raises(RuntimeError):
+        dist.P2POp(lambda: None, None, 0)
+    assert dist.ParallelMode.SHARDING_PARALLEL == 3
+
+
+def test_utils_and_dlpack():
+    paddle.utils.require_version("0.0.1")
+    with pytest.raises(Exception):
+        paddle.utils.require_version("99.0")
+    n1 = paddle.utils.unique_name.generate("fc")
+    n2 = paddle.utils.unique_name.generate("fc")
+    assert n1 != n2
+    with paddle.utils.unique_name.guard("wn_"):
+        assert paddle.utils.unique_name.generate("fc").startswith("wn_")
+    t = paddle.to_tensor(np.arange(4.0, dtype=np.float32))
+    back = paddle.utils.dlpack.from_dlpack(t._value)
+    np.testing.assert_array_equal(back.numpy(), t.numpy())
+
+    from paddle_tpu.utils.deprecated import deprecated
+
+    @deprecated(since="2.0", update_to="paddle.new", level=1)
+    def old():
+        return 1
+
+    with pytest.warns(DeprecationWarning):
+        assert old() == 1
+
+
+def test_distribution_independent():
+    from paddle_tpu.distribution import Independent, Normal
+    base = Normal(paddle.to_tensor(np.zeros(3, np.float32)),
+                  paddle.to_tensor(np.ones(3, np.float32)))
+    ind = Independent(base, 1)
+    lp = ind.log_prob(paddle.to_tensor(np.zeros(3, np.float32)))
+    assert lp.shape == [] or lp.shape == [1] or lp.ndim == 0
+    base_lp = base.log_prob(paddle.to_tensor(np.zeros(3, np.float32)))
+    np.testing.assert_allclose(float(lp), float(base_lp.numpy().sum()),
+                               rtol=1e-5)
+
+
+def test_yolo_ignore_thresh_excludes_high_iou_negatives():
+    rng = np.random.RandomState(0)
+    x = np.zeros((1, 3 * 9, 4, 4), np.float32)
+    gtb = paddle.to_tensor(np.array([[[0.5, 0.5, 0.5, 0.5]]], np.float32))
+    gtl = paddle.to_tensor(np.array([[1]], np.int64))
+    kw = dict(anchors=[10, 13, 16, 30, 33, 23], anchor_mask=[0, 1, 2],
+              class_num=4, downsample_ratio=8)
+    l_strict = float(vops.yolo_loss(paddle.to_tensor(x), gtb, gtl,
+                                    ignore_thresh=0.99, **kw).sum())
+    l_loose = float(vops.yolo_loss(paddle.to_tensor(x), gtb, gtl,
+                                   ignore_thresh=0.0, **kw).sum())
+    # thresh 0: every positive-IoU anchor is excluded from the negative
+    # loss -> strictly smaller objective than thresh ~1 (nothing excluded)
+    assert l_loose < l_strict
+    # gt_score scales the positive term
+    l_half = float(vops.yolo_loss(
+        paddle.to_tensor(x), gtb, gtl, ignore_thresh=0.99,
+        gt_score=paddle.to_tensor(np.array([[0.0]], np.float32)), **kw
+    ).sum())
+    assert l_half < l_strict
+
+
+def test_saved_tensors_hooks_pack_unpack():
+    calls = {"pack": 0, "unpack": 0}
+
+    def pack(v):
+        calls["pack"] += 1
+        return np.asarray(v)     # "offload": device -> host numpy
+
+    def unpack(v):
+        calls["unpack"] += 1
+        return v
+
+    x = paddle.to_tensor(np.random.rand(4, 4).astype(np.float32),
+                         stop_gradient=False)
+    with paddle.autograd.saved_tensors_hooks(pack, unpack):
+        y = (x.tanh() * x).sum()
+    y.backward()
+    assert calls["pack"] > 0 and calls["unpack"] > 0
+    assert x.grad is not None
+    # outside the context the tape must not pack
+    n = calls["pack"]
+    x2 = paddle.to_tensor(np.random.rand(2, 2).astype(np.float32),
+                          stop_gradient=False)
+    (x2 * x2).sum().backward()
+    assert calls["pack"] == n
+
+
+def test_beam_states_follow_reordering():
+    from paddle_tpu.nn.layer.extra import _reorder_states
+    b, k = 2, 3
+    state = paddle.to_tensor(
+        np.arange(b * k * 2, dtype=np.float32).reshape(b * k, 2))
+    src = np.array([[2, 0, 1], [1, 1, 0]])
+    out = _reorder_states(state, src, b, k)
+    ref = state.numpy().reshape(b, k, 2)
+    expect = np.stack([ref[0][[2, 0, 1]], ref[1][[1, 1, 0]]]
+                      ).reshape(b * k, 2)
+    np.testing.assert_array_equal(out.numpy(), expect)
+
+
+def test_vsplit_negative_index_and_download_tar(tmp_path):
+    x = paddle.to_tensor(np.arange(10, dtype=np.float32).reshape(5, 2))
+    parts = paddle.vsplit(x, [-2])
+    assert [p.shape[0] for p in parts] == [3, 2]
+    import tarfile
+    src = tmp_path / "inner"
+    src.mkdir()
+    (src / "f.txt").write_text("hi")
+    tarp = tmp_path / "a.tar"
+    with tarfile.open(tarp, "w") as tf:
+        tf.add(src, arcname="inner")
+    out = paddle.utils.download.get_path_from_url(str(tarp),
+                                                  str(tmp_path / "dst"))
+    import os
+    assert os.path.isdir(out)
