@@ -82,6 +82,24 @@ def _wrap_outputs(out, node):
 
 def apply_op(fn, name, args, kwargs):
     leaves, treedef = jtu.tree_flatten((args, kwargs), is_leaf=_is_tensor)
+    # dual-mode dispatch (reference tensor APIs append ops in static
+    # mode): a static-graph Variable anywhere defers this op onto the
+    # Program's DAG instead of executing eagerly (static/graph.py)
+    if any(type(lv).__name__ == "Variable"
+           and getattr(lv, "kind", None) in ("feed", "op", "param", "const")
+           for lv in leaves):
+        from ..static import graph as _sgraph
+        gpos = [i for i, lv in enumerate(leaves)
+                if isinstance(lv, _sgraph.Variable)]
+
+        def deferred(*tensors):
+            lv2 = list(leaves)
+            for i, t in zip(gpos, tensors):
+                lv2[i] = t
+            a2, k2 = jtu.tree_unflatten(treedef, lv2)
+            return apply_op(fn, name, a2, k2)
+
+        return _sgraph.op_var(name, deferred, [leaves[i] for i in gpos])
     tensor_pos = [i for i, l in enumerate(leaves) if _is_tensor(l)]
     raw = list(leaves)
     for i in tensor_pos:

@@ -171,6 +171,16 @@ class Optimizer:
     clear_gradients = clear_grad
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        from ..static.graph import Variable as _GraphVar
+        if isinstance(loss, _GraphVar):
+            # static-graph mode (reference: append backward + opt ops to
+            # the Program): record the train op; Executor.run evaluates
+            # the loss eagerly, backprops and steps over the program's
+            # persistable parameters
+            from .. import static as _static
+            prog = loss.program or _static.default_main_program()
+            prog._train_op = (loss, self)
+            return None, None
         loss.backward()
         self.step()
         self.clear_grad()

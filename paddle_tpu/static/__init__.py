@@ -67,7 +67,17 @@ class Program:
 
     # parity no-ops
     def all_parameters(self):
-        return list(self._layer.parameters()) if self._layer else []
+        from ..nn.layer_base import Parameter
+        out = list(self._layer.parameters()) if self._layer else []
+        for v in self.__dict__.get("_graph_params", {}).values():
+            if isinstance(v, Parameter):
+                out.append(v)
+            elif isinstance(v, dict):
+                out.extend(p for p in v.values()
+                           if isinstance(p, Parameter))
+            elif hasattr(v, "parameters"):
+                out.extend(v.parameters())
+        return out
 
 
 _default_main = Program()
@@ -106,12 +116,17 @@ def program_guard(main_program, startup_program=None):
 
 
 def data(name, shape, dtype="float32", lod_level=0):
-    """paddle.static.data parity: returns the InputSpec placeholder and
-    registers it on the current default program."""
+    """paddle.static.data parity: a graph feed Variable registered on the
+    current default program (append-op builders and operator overloads
+    consume it; Executor.run binds the feed dict — static/graph.py)."""
+    from .graph import feed_var
     spec = InputSpec(shape, dtype, name)
     _default_main._input_specs.append(spec)
     _default_main._feed_names.append(name)
-    return spec
+    var = feed_var(name, [s if s is not None and s != -1 else None
+                          for s in shape], dtype, _default_main)
+    var.spec = spec
+    return var
 
 
 def build_program(fn, input_specs) -> Program:
@@ -162,6 +177,32 @@ class Executor:
         inner = program._program if isinstance(program, CompiledProgram) \
             else program
         feed = feed or {}
+        # deferred-graph path (static/graph.py): graph fetches and/or a
+        # minimize()-registered train op
+        from .graph import Variable as _GVar
+        from .graph import evaluate_vars as _geval
+        has_graph_fetch = bool(fetch_list) and any(
+            isinstance(f, _GVar) for f in fetch_list)
+        train_op = inner.__dict__.get("_train_op")
+        if has_graph_fetch or train_op is not None:
+            feed_t = {k: v if isinstance(v, Tensor)
+                      else Tensor(np.asarray(v)) for k, v in feed.items()}
+            memo: dict = {}
+            if train_op is not None:
+                loss_var, opt = train_op
+                [loss] = _geval([loss_var], feed_t, memo)
+                loss.backward()
+                if not opt._parameters:
+                    opt._parameters = inner.all_parameters()
+                opt.step()
+                opt.clear_grad()
+            outs = _geval(list(fetch_list or []), feed_t, memo)
+            if return_numpy:
+                outs = [np.asarray(o._value if isinstance(o, Tensor)
+                                   else o) for o in outs]
+            return outs
+        if inner._fn is None and not feed and not fetch_list:
+            return []   # e.g. exe.run(startup_program): init is eager here
         vals = []
         for i, name in enumerate(inner._feed_names):
             if name in feed:
@@ -250,3 +291,416 @@ from .. import amp  # noqa: E402,F401
 # append-op builders raise with guidance) — bind it here so
 # `paddle.static.nn` and `from paddle_tpu.static import nn` agree
 from . import nn  # noqa: E402,F401
+
+
+# -- legacy static namespace (reference static/__init__.py __all__) ----------
+
+from .graph import Variable  # noqa: E402,F401  (framework.py Variable analog)
+
+
+class Scope:
+    """Name -> value map (framework Scope); the eager world IS the scope,
+    this object provides the lookup API over the default program's
+    parameters."""
+
+    def __init__(self):
+        self._vars = {}
+
+    def var(self, name):
+        return self._vars.setdefault(name, None)
+
+    def find_var(self, name):
+        params = {p.name: p for p in _default_main.all_parameters()}
+        return params.get(name, self._vars.get(name))
+
+
+_global_scope = Scope()
+
+
+def global_scope():
+    return _global_scope
+
+
+def scope_guard(scope):
+    import contextlib
+
+    @contextlib.contextmanager
+    def guard():
+        global _global_scope
+        prev = _global_scope
+        _global_scope = scope
+        try:
+            yield
+        finally:
+            _global_scope = prev
+
+    return guard()
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    """Debug print op: identity that prints at evaluation time."""
+    from .graph import Variable as _GV, op_var
+
+    def apply(t):
+        v = t.numpy() if hasattr(t, "numpy") else t
+        print(f"{message or ''} {getattr(input, 'name', '')} "
+              f"shape={getattr(v, 'shape', None)}\n{v}")
+        return t
+
+    if isinstance(input, _GV):
+        return op_var("print", apply, [input])
+    return apply(input)
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    import jax.numpy as jnp
+    from ..nn.layer_base import Parameter
+    from ..core.dtype import convert_dtype
+    p = Parameter(jnp.full(tuple(shape), value,
+                           dtype=convert_dtype(dtype)), name=name)
+    store = _default_main.__dict__.setdefault("_graph_params", {})
+    store[name or f"global_var_{len(store)}"] = p
+    return p
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from ..ops.compat_surface import create_parameter as _cp
+    p = _cp(shape, dtype, name=name, attr=attr, is_bias=is_bias,
+            default_initializer=default_initializer)
+    store = _default_main.__dict__.setdefault("_graph_params", {})
+    store[name or f"parameter_{len(store)}"] = p
+    return p
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """metric_op.py accuracy over graph vars or eager tensors."""
+    from .graph import Variable as _GV, op_var
+
+    def apply(pred, lab):
+        from ..metric import accuracy as _acc
+        return _acc(pred, lab, k=k)
+
+    if isinstance(input, _GV) or isinstance(label, _GV):
+        return op_var("accuracy", apply, [input, label])
+    return apply(input, label)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    from .graph import Variable as _GV, op_var
+
+    def apply(pred, lab):
+        from ..metric import Auc
+        m = Auc(curve=curve, num_thresholds=num_thresholds)
+        m.update(pred, lab)
+        import numpy as _np
+        from ..core.tensor import Tensor as _T
+        return _T(_np.asarray(m.accumulate(), _np.float32))
+
+    if isinstance(input, _GV) or isinstance(label, _GV):
+        return op_var("auc", apply, [input, label])
+    return apply(input, label)
+
+
+def cpu_places(device_count=None):
+    from ..core import CPUPlace
+    import os
+    n = device_count or int(os.environ.get("CPU_NUM", 1))
+    return [CPUPlace()] * n
+
+
+def cuda_places(device_ids=None):
+    from ..core import CPUPlace
+    import jax
+    devs = jax.devices()
+    ids = device_ids if device_ids is not None else range(len(devs))
+    return [CPUPlace() for _ in ids]  # accelerator places are device/ API
+
+
+def xpu_places(device_ids=None):
+    return cuda_places(device_ids)
+
+
+def npu_places(device_ids=None):
+    return cuda_places(device_ids)
+
+
+def mlu_places(device_ids=None):
+    return cuda_places(device_ids)
+
+
+def ipu_places(device_ids=None):
+    return cuda_places(device_ids)
+
+
+def device_guard(device=None):
+    import contextlib
+
+    @contextlib.contextmanager
+    def guard():
+        yield
+
+    return guard()
+
+
+def name_scope(prefix=None):
+    from ..utils.unique_name import guard
+    return guard((prefix or "") + "/")
+
+
+class BuildStrategy:
+    """Attribute bag (reference core.BuildStrategy): toggles consumed by
+    the reference's graph passes; XLA owns those decisions here, the
+    attributes are recorded for inspection."""
+
+    def __init__(self):
+        self.enable_inplace = True
+        self.memory_optimize = True
+        self.fuse_all_optimizer_ops = False
+        self.fuse_elewise_add_act_ops = False
+        self.fuse_bn_act_ops = False
+        self.reduce_strategy = 0
+        self.gradient_scale_strategy = 0
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 100
+        self.use_thread_pool = False
+
+
+class ParallelExecutor:
+    """Legacy multi-device executor facade (parallel_executor.py): wraps
+    Executor — data parallelism is GSPMD's job in this framework."""
+
+    def __init__(self, use_cuda=False, loss_name=None, main_program=None,
+                 build_strategy=None, exec_strategy=None, scope=None,
+                 share_vars_from=None):
+        self._exe = Executor()
+        self._program = main_program or _default_main
+
+    def run(self, fetch_list=None, feed=None, return_numpy=True):
+        return self._exe.run(self._program, feed=feed,
+                             fetch_list=fetch_list,
+                             return_numpy=return_numpy)
+
+
+class WeightNormParamAttr:
+    """ParamAttr requesting weight-normalized parameterization
+    (reference param_attr.py WeightNormParamAttr): consumed by
+    nn.utils.weight_norm on the layer that owns the parameter."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip
+
+
+class ExponentialMovingAverage:
+    """EMA of trainable parameters (reference static/__init__
+    ExponentialMovingAverage): update() folds current weights in;
+    apply() swaps EMA weights into the model (restore() undoes)."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        import contextlib
+        self._decay = decay
+        self._ema: dict = {}
+        self._backup: dict = {}
+        self._step = 0
+        self._contextlib = contextlib
+
+    def update(self, parameters=None):
+        import jax.numpy as jnp
+        params = parameters or _default_main.all_parameters()
+        self._step += 1
+        for p in params:
+            prev = self._ema.get(id(p))
+            v = p._value
+            self._ema[id(p)] = v if prev is None else \
+                self._decay * prev + (1 - self._decay) * v
+
+    def apply(self, executor=None, need_restore=True):
+        params = _default_main.all_parameters()
+        for p in params:
+            if id(p) in self._ema:
+                self._backup[id(p)] = p._value
+                p._replace_(self._ema[id(p)], None)
+        ctx = self._contextlib
+
+        @ctx.contextmanager
+        def guard():
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore()
+
+        return guard()
+
+    def restore(self, executor=None):
+        params = _default_main.all_parameters()
+        for p in params:
+            if id(p) in self._backup:
+                p._replace_(self._backup.pop(id(p)), None)
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """Record the backward intent on the loss's program (reference
+    backward.py append_backward); Executor.run + minimize() drive the
+    actual eager backprop.  Returns [] (param_grads are materialized at
+    run time here, not as graph vars)."""
+    from .graph import Variable as _GV
+    if isinstance(loss, _GV):
+        prog = loss.program or _default_main
+        prog.__dict__.setdefault("_backward_requested", True)
+    return []
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    from ..core.autograd import grad as _grad
+    return _grad(targets, inputs, grad_outputs=target_gradients)
+
+
+def set_program_state(program, state_dict):
+    for p in program.all_parameters():
+        if p.name in state_dict:
+            import numpy as _np
+            p._replace_(_np.asarray(state_dict[p.name]), None)
+
+
+def load_program_state(model_path, var_list=None):
+    from ..framework.io import load
+    return load(model_path)
+
+
+def normalize_program(program, feed_vars, fetch_vars):
+    return program
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    """CTR eval bundle (reference static/__init__): returns (auc, batch
+    metrics) over graph vars."""
+    return auc(input, label)
+
+
+class IpuStrategy:
+    def __init__(self):
+        raise NotImplementedError(
+            "no IPU support in a TPU build (reference IpuStrategy wraps "
+            "popart); use the jax/XLA path")
+
+
+class IpuCompiledProgram:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "no IPU support in a TPU build; use CompiledProgram")
+
+
+from ..batch import batch  # noqa: E402,F401
+
+
+def py_func(func, x, out, backward_func=None,
+            skip_vars_in_backward_input=None):
+    from .nn import py_func as _pf
+    return _pf(func, x, out, backward_func, skip_vars_in_backward_input)
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    """Legacy LR helper: lr * decay_rate^(t/decay_steps), floored per
+    plateau when staircase (layers/learning_rate_scheduler.py)."""
+    from ..optimizer.lr import LambdaDecay
+
+    def factor(t):
+        e = t // decay_steps if staircase else t / decay_steps
+        return decay_rate ** e
+
+    return LambdaDecay(learning_rate, factor)
+
+
+def save(program, model_path, protocol=4, **configs):
+    """static.save: persist the program's parameters (io.py:save)."""
+    from ..framework.io import save as _save
+    _save({p.name: p for p in program.all_parameters()},
+          model_path if model_path.endswith(".pdparams")
+          else model_path + ".pdparams")
+
+
+def load(program, model_path, executor=None, var_list=None):
+    from ..framework.io import load as _load
+    state = _load(model_path if model_path.endswith(".pdparams")
+                  else model_path + ".pdparams")
+    for p in program.all_parameters():
+        if p.name in state:
+            v = state[p.name]
+            p._replace_(np.asarray(v.numpy() if hasattr(v, "numpy")
+                                   else v), None)
+
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    save(main_program or _default_main, dirname)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    load(main_program or _default_main, dirname)
+
+
+def serialize_program(feed_vars, fetch_vars, **kwargs):
+    import pickle
+    return pickle.dumps({"feeds": [v.name for v in feed_vars],
+                         "fetches": [v.name for v in fetch_vars]})
+
+
+def deserialize_program(data):
+    import pickle
+    return pickle.loads(data)
+
+
+def serialize_persistables(feed_vars, fetch_vars, executor=None, **kwargs):
+    import pickle
+    params = _default_main.all_parameters()
+    return pickle.dumps({p.name: np.asarray(p.numpy()) for p in params})
+
+
+def deserialize_persistables(program, data, executor=None):
+    import pickle
+    state = pickle.loads(data)
+    for p in program.all_parameters():
+        if p.name in state:
+            p._replace_(state[p.name], None)
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def ipu_shard_guard(index=-1, stage=-1):
+    raise NotImplementedError("no IPU support in a TPU build")
+
+
+def set_ipu_shard(call_func, index=-1, stage=-1):
+    raise NotImplementedError("no IPU support in a TPU build")
+
+
+from ..incubate import asp as sparsity  # noqa: E402,F401

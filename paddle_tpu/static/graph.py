@@ -1,0 +1,157 @@
+"""Static-graph construction layer — the reference's Program/Variable
+append-op workflow (python/paddle/fluid/framework.py Program/Variable,
+static/nn/* builders) reproduced as a DEFERRED-EVALUATION DAG.
+
+Design: `static.data` and every builder return a `Variable` node holding
+a closure over framework ops.  `Executor.run` evaluates fetched nodes
+with the feed dict bound — the evaluation executes ordinary EAGER ops on
+real Parameters, so autograd, optimizers and `minimize` work unchanged:
+"appending backward" is simply recording (loss, optimizer) on the
+Program and calling `.backward()` on the eagerly evaluated loss.  The
+builders register their Parameters on the current default Program
+(keyed by unique name), so re-running the program reuses — not
+re-initializes — the weights, which is the semantic point of the
+reference's persistable Program parameters.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["Variable", "feed_var", "op_var", "constant_var",
+           "evaluate_vars"]
+
+
+class Variable:
+    """A node of the deferred graph (reference framework.py Variable)."""
+
+    def __init__(self, kind: str, name: str, shape, dtype,
+                 op: Optional[Callable] = None,
+                 inputs: Sequence["Variable"] = (),
+                 program=None):
+        self.kind = kind            # feed | op | param | const
+        self.name = name
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.op = op
+        self.inputs = list(inputs)
+        self.program = program
+        self.persistable = kind in ("param", "const")
+        self.stop_gradient = False
+
+    # -- operator sugar: each overload defers an eager op ------------------
+    def _binop(self, other, fn, rname):
+        from ..core.tensor import Tensor
+
+        def apply(a, b):
+            return fn(a, b)
+
+        other_v = other if isinstance(other, Variable) \
+            else constant_var(other)
+        return op_var(rname, apply, [self, other_v], program=self.program,
+                      shape=self.shape, dtype=self.dtype)
+
+    def __add__(self, o):
+        return self._binop(o, lambda a, b: a + b, "add")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binop(o, lambda a, b: a - b, "sub")
+
+    def __mul__(self, o):
+        return self._binop(o, lambda a, b: a * b, "mul")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binop(o, lambda a, b: a / b, "div")
+
+    def __matmul__(self, o):
+        return self._binop(o, lambda a, b: a.matmul(b), "matmul")
+
+    def __pow__(self, o):
+        return self._binop(o, lambda a, b: a ** b, "pow")
+
+    def __rsub__(self, o):
+        return self._binop(o, lambda a, b: b - a, "rsub")
+
+    def __rtruediv__(self, o):
+        return self._binop(o, lambda a, b: b / a, "rdiv")
+
+    def __rpow__(self, o):
+        return self._binop(o, lambda a, b: b ** a, "rpow")
+
+    def __neg__(self):
+        return op_var("neg", lambda a: -a, [self], program=self.program,
+                      shape=self.shape, dtype=self.dtype)
+
+    def __getitem__(self, item):
+        return op_var("slice", lambda a: a[item], [self],
+                      program=self.program)
+
+    def __repr__(self):
+        return (f"Variable(name={self.name!r}, kind={self.kind}, "
+                f"shape={self.shape}, dtype={self.dtype})")
+
+
+def feed_var(name, shape, dtype, program) -> Variable:
+    return Variable("feed", name, shape, dtype, program=program)
+
+
+def constant_var(value) -> Variable:
+    v = Variable("const", f"const_{id(value)}", getattr(value, "shape", ()),
+                 getattr(value, "dtype", None))
+    v.value = value
+    return v
+
+
+def op_var(name, fn, inputs, program=None, shape=None,
+           dtype=None) -> Variable:
+    from ..utils import unique_name
+    prog = program
+    for i in inputs:
+        prog = prog or getattr(i, "program", None)
+    return Variable("op", unique_name.generate(name), shape, dtype,
+                    op=fn, inputs=inputs, program=prog)
+
+
+def evaluate_vars(fetch: Sequence[Variable], feeds: Dict[str, Any],
+                  memo: Optional[dict] = None) -> List[Any]:
+    """Evaluate graph nodes with the feed dict bound; returns eager
+    Tensors (real autograd tape attached)."""
+    from ..core.tensor import Tensor
+
+    memo = {} if memo is None else memo
+
+    def ev(v):
+        if not isinstance(v, Variable):
+            return v
+        if id(v) in memo:
+            return memo[id(v)]
+        if v.kind == "feed":
+            if v.name not in feeds:
+                raise KeyError(
+                    f"feed for {v.name!r} missing; got {sorted(feeds)}")
+            out = feeds[v.name]
+            out = out if isinstance(out, Tensor) else Tensor(
+                np.asarray(out))
+        elif v.kind == "const":
+            out = v.value if isinstance(v.value, Tensor) else Tensor(
+                np.asarray(v.value))
+        elif v.kind == "param":
+            out = v.param     # the live Parameter object
+        else:
+            out = v.op(*[ev(i) for i in v.inputs])
+            # a branch fn (cond/case) may BUILD graph nodes: evaluate
+            # them in the same feed context
+            while isinstance(out, Variable):
+                out = ev(out)
+            if isinstance(out, (tuple, list)):
+                out = type(out)(ev(o) if isinstance(o, Variable) else o
+                                for o in out)
+        memo[id(v)] = out
+        return out
+
+    return [ev(v) for v in fetch]

@@ -1,22 +1,436 @@
-"""paddle.static.nn facade — the few builders with framework-level
-mechanisms behind them.
+"""paddle.static.nn — append-op builders over the deferred graph
+(static/graph.py).
 
-Reference: python/paddle/static/nn/__init__.py exposes append-op builders
-(fc, conv2d, ...); those are intentionally not reproduced (SURVEY §7:
-build models with paddle.nn under to_static/Program tracing instead).
-What IS here:
+Reference: python/paddle/static/nn/__init__.py (fc, conv2d, batch_norm,
+embedding, ...).  Each builder creates its Parameters ONCE on the
+current default Program (persistable, reused across Executor.run calls
+— the semantic contract of static-graph parameters) and returns a
+Variable whose evaluation runs the ordinary eager functional, so
+autograd/minimize work through the same tape as dygraph.
 
-* `sparse_embedding` — the PS-backed lookup (reference static.nn.
-  sparse_embedding -> distributed_lookup_table op, pscore/
-  distributed_lookup_table_op.cc), routed to distributed.ps.
-* `embedding`, `fc` — thin functional conveniences over paddle.nn layers
-  for scripts ported from static-graph recipes.
-"""
+`sparse_embedding` stays PS-backed (distributed_lookup_table analog)."""
 from __future__ import annotations
 
-from typing import Optional
+import numpy as np
 
-__all__ = ["sparse_embedding", "embedding", "fc"]
+from .graph import Variable, op_var
+
+__all__ = ["sparse_embedding", "embedding", "fc", "conv2d",
+           "conv2d_transpose", "conv3d", "conv3d_transpose", "batch_norm",
+           "layer_norm", "group_norm", "instance_norm", "data_norm",
+           "deform_conv2d", "bilinear_tensor_product", "prelu",
+           "spectral_norm", "crf_decoding", "cond", "case", "switch_case",
+           "while_loop", "py_func", "continuous_value_model", "StaticRNN",
+           "multi_box_head", "sequence_concat", "create_parameter"]
+
+
+def _prog(*vars_):
+    from . import default_main_program
+    for v in vars_:
+        if isinstance(v, Variable) and v.program is not None:
+            return v.program
+    return default_main_program()
+
+
+def _scoped_params(prog, opname, factory):
+    """Create-once Program parameters (reference: persistable Variables
+    on the Program's global block)."""
+    store = prog.__dict__.setdefault("_graph_params", {})
+    counts = prog.__dict__.setdefault("_graph_param_counts", {})
+    n = counts.get(opname, 0)
+    counts[opname] = n + 1
+    key = f"{opname}_{n}"
+    if key not in store:
+        store[key] = factory()
+    return store[key]
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    """static/nn/common.py fc: flatten trailing dims, x @ W + b, optional
+    activation."""
+    from .. import nn
+    prog = _prog(x)
+    in_features = int(np.prod(x.shape[num_flatten_dims:])) \
+        if x.shape is not None else None
+    if in_features is None:
+        raise ValueError("fc needs a known input shape (static.data)")
+    layer = _scoped_params(prog, name or "fc", lambda: nn.Linear(
+        in_features, size, weight_attr=weight_attr, bias_attr=bias_attr))
+
+    def apply(t):
+        flat = t.reshape(list(t.shape[:num_flatten_dims]) + [-1])
+        out = layer(flat)
+        if activation:
+            import paddle_tpu.nn.functional as F
+            out = getattr(F, activation)(out)
+        return out
+
+    out_shape = list(x.shape[:num_flatten_dims]) + [size]
+    return op_var("fc", apply, [x], program=prog, shape=out_shape,
+                  dtype=x.dtype)
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32"):
+    from .. import nn
+    prog = _prog(input)
+    layer = _scoped_params(prog, "embedding", lambda: nn.Embedding(
+        int(size[0]), int(size[1]), padding_idx=padding_idx,
+        sparse=is_sparse, weight_attr=param_attr))
+    out_shape = (list(input.shape) + [int(size[1])]) \
+        if input.shape is not None else None
+    return op_var("embedding", lambda t: layer(t), [input], program=prog,
+                  shape=out_shape, dtype=dtype)
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           act=None, data_format="NCHW", name=None):
+    from .. import nn
+    prog = _prog(input)
+    cin = input.shape[1] if data_format == "NCHW" else input.shape[-1]
+    layer = _scoped_params(prog, name or "conv2d", lambda: nn.Conv2D(
+        int(cin), num_filters, filter_size, stride=stride, padding=padding,
+        dilation=dilation, groups=groups, weight_attr=param_attr,
+        bias_attr=bias_attr, data_format=data_format))
+
+    def apply(t):
+        out = layer(t)
+        if act:
+            import paddle_tpu.nn.functional as F
+            out = getattr(F, act)(out)
+        return out
+
+    def _sp(v, i):
+        k = filter_size if isinstance(filter_size, int) else filter_size[i]
+        st = stride if isinstance(stride, int) else stride[i]
+        pd = padding if isinstance(padding, int) else padding[i]
+        return None if v is None else (v + 2 * pd - k) // st + 1
+
+    if data_format == "NCHW" and input.shape is not None:
+        out_shape = [input.shape[0], num_filters,
+                     _sp(input.shape[2], 0), _sp(input.shape[3], 1)]
+    else:
+        out_shape = None
+    return op_var("conv2d", apply, [input], program=prog,
+                  shape=out_shape, dtype=input.dtype)
+
+
+def _conv_nd_builder(opname, layer_cls, channel_axis=1):
+    def build(input, num_filters, filter_size, stride=1, padding=0,
+              dilation=1, groups=1, param_attr=None, bias_attr=None,
+              act=None, data_format=None, output_size=None, name=None):
+        from .. import nn
+        prog = _prog(input)
+        cin = input.shape[channel_axis]
+        kwargs = dict(stride=stride, padding=padding, dilation=dilation,
+                      groups=groups, weight_attr=param_attr,
+                      bias_attr=bias_attr)
+        cls = getattr(nn, layer_cls)
+        layer = _scoped_params(prog, name or opname, lambda: cls(
+            int(cin), num_filters, filter_size, **kwargs))
+
+        def apply(t):
+            out = layer(t)
+            if act:
+                import paddle_tpu.nn.functional as F
+                out = getattr(F, act)(out)
+            return out
+
+        return op_var(opname, apply, [input], program=prog)
+    return build
+
+
+conv2d_transpose = _conv_nd_builder("conv2d_transpose", "Conv2DTranspose")
+conv3d = _conv_nd_builder("conv3d", "Conv3D")
+conv3d_transpose = _conv_nd_builder("conv3d_transpose", "Conv3DTranspose")
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               name=None, **kw):
+    from .. import nn
+    prog = _prog(input)
+    ch = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    layer = _scoped_params(prog, name or "batch_norm",
+                           lambda: nn.BatchNorm2D(
+                               int(ch), momentum=momentum, epsilon=epsilon,
+                               weight_attr=param_attr, bias_attr=bias_attr,
+                               data_format=data_layout))
+
+    def apply(t):
+        if is_test:
+            layer.eval()
+        out = layer(t)
+        if act:
+            import paddle_tpu.nn.functional as F
+            out = getattr(F, act)(out)
+        return out
+
+    return op_var("batch_norm", apply, [input], program=prog,
+                  shape=input.shape, dtype=input.dtype)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    from .. import nn
+    prog = _prog(input)
+    norm_shape = [int(s) for s in input.shape[begin_norm_axis:]]
+    layer = _scoped_params(prog, name or "layer_norm", lambda: nn.LayerNorm(
+        norm_shape, epsilon=epsilon,
+        weight_attr=param_attr if scale else False,
+        bias_attr=bias_attr if shift else False))
+    return op_var("layer_norm", lambda t: layer(t), [input],
+                  program=prog, shape=input.shape, dtype=input.dtype)
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None,
+               bias_attr=None, act=None, data_layout="NCHW", name=None):
+    from .. import nn
+    prog = _prog(input)
+    ch = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    layer = _scoped_params(prog, name or "group_norm", lambda: nn.GroupNorm(
+        groups, int(ch), epsilon=epsilon, weight_attr=param_attr,
+        bias_attr=bias_attr, data_format=data_layout))
+    return op_var("group_norm", lambda t: layer(t), [input], program=prog)
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    from .. import nn
+    prog = _prog(input)
+    layer = _scoped_params(prog, name or "instance_norm",
+                           lambda: nn.InstanceNorm2D(
+                               int(input.shape[1]), epsilon=epsilon,
+                               weight_attr=param_attr,
+                               bias_attr=bias_attr))
+    return op_var("instance_norm", lambda t: layer(t), [input],
+                  program=prog)
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=True, slot_dim=-1,
+              sync_stats=False, summary_decay_rate=0.9999999,
+              enable_scale_and_shift=False):
+    """static/nn/common.py data_norm (CTR models): normalize by
+    accumulated batch statistics WITHOUT learnable gamma/beta unless
+    enable_scale_and_shift."""
+    from ..core.tensor import Tensor
+    prog = _prog(input)
+    ch = int(input.shape[-1] if data_layout != "NCHW" or
+             len(input.shape) == 2 else input.shape[1])
+
+    def make_state():
+        import jax.numpy as jnp
+        from ..nn.layer_base import Parameter
+        state = {
+            "batch_size": Parameter(jnp.full((ch,), 1e4)),
+            "batch_sum": Parameter(jnp.zeros((ch,))),
+            "batch_square_sum": Parameter(jnp.full((ch,), 1e4)),
+        }
+        if enable_scale_and_shift:
+            state["scale_w"] = Parameter(jnp.ones((ch,)))
+            state["bias"] = Parameter(jnp.zeros((ch,)))
+        return state
+
+    state = _scoped_params(prog, name or "data_norm", make_state)
+
+    def apply(t):
+        mean = state["batch_sum"] / state["batch_size"]
+        scale = (state["batch_size"] / state["batch_square_sum"]).sqrt()
+        out = (t - mean) * scale
+        if enable_scale_and_shift:
+            out = out * state["scale_w"] + state["bias"]
+        return out
+
+    return op_var("data_norm", apply, [input], program=prog)
+
+
+def deform_conv2d(x, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, param_attr=None, bias_attr=None,
+                  name=None):
+    from ..vision.ops import DeformConv2D
+    prog = _prog(x, offset)
+    layer = _scoped_params(prog, name or "deform_conv2d",
+                           lambda: DeformConv2D(
+                               int(x.shape[1]), num_filters, filter_size,
+                               stride=stride, padding=padding,
+                               dilation=dilation,
+                               deformable_groups=deformable_groups,
+                               groups=groups, weight_attr=param_attr,
+                               bias_attr=bias_attr))
+    return op_var("deform_conv2d", lambda t, o, m: layer(t, o, m),
+                  [x, offset, mask], program=prog)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    from .. import nn
+    prog = _prog(x, y)
+    layer = _scoped_params(prog, name or "bilinear", lambda: nn.Bilinear(
+        int(x.shape[-1]), int(y.shape[-1]), size,
+        weight_attr=param_attr, bias_attr=bias_attr))
+    return op_var("bilinear_tensor_product",
+                  lambda a, b: layer(a, b), [x, y], program=prog)
+
+
+def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
+    from .. import nn
+    prog = _prog(x)
+    num = 1 if mode == "all" else int(
+        x.shape[1] if data_format == "NCHW" else x.shape[-1])
+    layer = _scoped_params(prog, name or "prelu",
+                           lambda: nn.PReLU(num_parameters=num,
+                                            weight_attr=param_attr))
+    return op_var("prelu", lambda t: layer(t), [x], program=prog)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    return op_var("spectral_norm",
+                  lambda w: _spectral_apply(w, dim, power_iters, eps),
+                  [weight])
+
+
+def _spectral_apply(w, dim, power_iters, eps):
+    from ..nn.functional.norm import spectral_norm as sn
+    import jax.numpy as jnp
+    from ..core.tensor import Tensor
+    mat = w.transpose([dim] + [i for i in range(w.ndim) if i != dim]) \
+        .reshape([w.shape[dim], -1])
+    u = Tensor(jnp.ones((mat.shape[0],), mat._value.dtype))
+    v = Tensor(jnp.ones((mat.shape[1],), mat._value.dtype))
+    return sn(w, u, v, dim=dim, power_iters=power_iters, eps=eps)
+
+
+def crf_decoding(input, param_attr=None, length=None, label=None,
+                 transition=None, name=None):
+    """static/nn crf_decoding → viterbi decode over the learned (or
+    provided) transition matrix."""
+    prog = _prog(input)
+    c = int(input.shape[-1])
+    if transition is None:
+        from ..nn.layer_base import Parameter
+        import jax.numpy as jnp
+        transition = _scoped_params(
+            prog, name or "crf_transition",
+            lambda: Parameter(jnp.zeros((c + 2, c))))
+
+    def apply(t, *rest):
+        from ..ops.extended import viterbi_decode
+        lens = rest[0] if rest else None
+        _, path = viterbi_decode(t, transition, lens,
+                                 include_bos_eos_tag=True)
+        return path
+
+    ins = [input] + ([length] if length is not None else [])
+    return op_var("crf_decoding", apply, ins, program=prog)
+
+
+# -- control flow (evaluation is eager python, so these are direct) ----------
+
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    def apply(p):
+        return true_fn() if bool(p.numpy() if hasattr(p, "numpy") else p) \
+            else (false_fn() if false_fn else None)
+
+    if isinstance(pred, Variable):
+        return op_var("cond", apply, [pred])
+    return apply(pred)
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    def apply(*preds):
+        for p, (pv, fn) in zip(preds, pred_fn_pairs):
+            if bool(p.numpy() if hasattr(p, "numpy") else p):
+                return fn()
+        if default is not None:
+            return default()
+        raise ValueError("no branch matched and no default given")
+
+    return op_var("case", apply, [p for p, _ in pred_fn_pairs])
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    def apply(i):
+        idx = int(i.numpy() if hasattr(i, "numpy") else i)
+        table = dict(branch_fns) if not isinstance(branch_fns, dict) \
+            else branch_fns
+        if idx in table:
+            return table[idx]()
+        if default is not None:
+            return default()
+        raise ValueError(f"branch {idx} not found, no default")
+
+    return op_var("switch_case", apply, [branch_index])
+
+
+def while_loop(cond_fn, body, loop_vars, is_test=False, name=None):
+    def apply(*vars_):
+        vals = list(vars_)
+        while True:
+            c = cond_fn(*vals)
+            if not bool(c.numpy() if hasattr(c, "numpy") else c):
+                break
+            out = body(*vals)
+            vals = list(out) if isinstance(out, (list, tuple)) else [out]
+        return tuple(vals) if len(vals) > 1 else vals[0]
+
+    return op_var("while_loop", apply, list(loop_vars))
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    return op_var("py_func", lambda *ts: func(*ts), list(xs))
+
+
+def continuous_value_model(input, cvm, use_cvm=True):
+    """static/nn common.py continuous_value_model (CTR): keep or strip the
+    leading show/click columns."""
+    def apply(t, c):
+        return t if use_cvm else t[:, 2:]
+
+    return op_var("cvm", apply, [input, cvm])
+
+
+def sequence_concat(input, name=None):
+    def apply(*ts):
+        import paddle_tpu as paddle
+        return paddle.concat(list(ts), axis=0)
+
+    return op_var("sequence_concat", apply, list(input))
+
+
+class StaticRNN:
+    """Minimal StaticRNN (reference static/nn/control_flow.py): step-wise
+    recurrence unrolled at evaluation time."""
+
+    def __init__(self, name=None):
+        self._steps = []
+        raise NotImplementedError(
+            "StaticRNN's step_input/memory protocol is not reproduced — "
+            "use paddle_tpu.nn.RNN / LSTM / GRU (same recurrence, "
+            "lax.scan-backed) or while_loop above")
+
+
+def multi_box_head(*args, **kwargs):
+    raise NotImplementedError(
+        "multi_box_head (SSD prior-box head macro) is not reproduced — "
+        "compose vision.ops.prior_box + conv2d heads directly (see "
+        "vision/ops.py prior_box, the underlying op it wraps)")
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    # same registration semantics as static.create_parameter: the param
+    # must be visible to Program.all_parameters()/save
+    from . import create_parameter as _cp
+    return _cp(shape, dtype, name=name, attr=attr, is_bias=is_bias,
+               default_initializer=default_initializer)
 
 
 def sparse_embedding(input, size, padding_idx=None, is_test=False,
@@ -33,19 +447,104 @@ def sparse_embedding(input, size, padding_idx=None, is_test=False,
     return layer(input)
 
 
-def embedding(input, size, is_sparse=False, padding_idx=None,
-              param_attr=None, dtype: str = "float32"):
-    raise NotImplementedError(
-        "static.nn append-op builders are not reproduced: a per-call layer "
-        "would re-initialize its weights every step (no persistable Program "
-        "parameters here). Build models with paddle_tpu.nn.Embedding and "
-        "trace via build_program/to_static (SURVEY §7).")
+def _no_lod(name, hint):
+    def fn(*a, **k):
+        raise NotImplementedError(
+            f"static.nn.{name} operates on LoD (ragged level-of-detail) "
+            f"sequence tensors, a fluid-era layout this framework does "
+            f"not reproduce — {hint}")
+    fn.__name__ = name
+    return fn
 
 
-def fc(x, size: int, num_flatten_dims: int = 1,
-       activation: Optional[str] = None, name: Optional[str] = None):
-    raise NotImplementedError(
-        "static.nn append-op builders are not reproduced: a per-call layer "
-        "would re-initialize its weights every step (no persistable Program "
-        "parameters here). Build models with paddle_tpu.nn.Linear and "
-        "trace via build_program/to_static (SURVEY §7).")
+# LoD sequence family: the reference's ragged-batch ops.  Dense
+# equivalents exist throughout paddle_tpu (pad + mask is the TPU-native
+# form); the entry points exist so ported scripts fail with guidance,
+# not AttributeError.
+sequence_conv = _no_lod("sequence_conv", "use nn.Conv1D over padded batches")
+sequence_softmax = _no_lod("sequence_softmax",
+                           "use F.softmax with a length mask")
+sequence_pool = _no_lod("sequence_pool",
+                        "use masked mean/max over padded batches")
+sequence_first_step = _no_lod("sequence_first_step", "index step 0")
+sequence_last_step = _no_lod("sequence_last_step",
+                             "gather at lengths-1 indices")
+sequence_slice = _no_lod("sequence_slice", "use paddle.slice")
+sequence_expand = _no_lod("sequence_expand", "use repeat_interleave")
+sequence_expand_as = _no_lod("sequence_expand_as", "use broadcast_to")
+sequence_pad = _no_lod("sequence_pad", "batches are already dense here")
+sequence_unpad = _no_lod("sequence_unpad", "slice by sequence_length")
+sequence_reshape = _no_lod("sequence_reshape", "use paddle.reshape")
+sequence_reverse = _no_lod("sequence_reverse",
+                           "use paddle.flip over the time axis")
+sequence_scatter = _no_lod("sequence_scatter", "use paddle.scatter")
+sequence_enumerate = _no_lod("sequence_enumerate",
+                             "use unfold over the id tensor")
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=None, name=None,
+        sampler="uniform", custom_dist=None, seed=0, is_sparse=False):
+    """static/nn nce (noise-contrastive estimation head): sampled-softmax
+    style BCE against `num_neg_samples` uniform negatives."""
+    import numpy as np
+    from .. import nn
+    prog = _prog(input, label)
+    dim = int(input.shape[-1])
+    k = num_neg_samples or 5
+    store = _scoped_params(prog, name or "nce", lambda: nn.Linear(
+        dim, num_total_classes))
+
+    step_cell = {"n": 0}
+
+    def apply(t, lab):
+        import paddle_tpu as paddle
+        import paddle_tpu.nn.functional as F
+        logits = store(t)                           # [N, C]
+        n = t.shape[0]
+        # fresh noise per training step (a fixed RandomState would replay
+        # the same negatives every run, degenerating the NCE estimator)
+        rng = np.random.RandomState(
+            (seed if seed is not None else 0) * 1000003 + step_cell["n"])
+        step_cell["n"] += 1
+        neg = paddle.to_tensor(rng.randint(
+            0, num_total_classes, (n, k)).astype(np.int64))
+        pos_logit = paddle.take_along_axis(logits, lab.reshape([n, 1]), 1)
+        neg_logit = paddle.take_along_axis(logits, neg, 1)
+        pos_loss = F.binary_cross_entropy_with_logits(
+            pos_logit, paddle.ones_like(pos_logit), reduction="none")
+        neg_loss = F.binary_cross_entropy_with_logits(
+            neg_logit, paddle.zeros_like(neg_logit), reduction="none")
+        return (pos_loss.sum(axis=1) + neg_loss.sum(axis=1)).reshape(
+            [n, 1])
+
+    return op_var("nce", apply, [input, label], program=prog)
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """static/nn row_conv (lookahead convolution for streaming ASR):
+    y[t] = sum_{i=0..D} x[t+i] * W[i] per channel."""
+    from ..nn.layer_base import Parameter
+    import jax.numpy as jnp
+    prog = _prog(input)
+    d = future_context_size
+    ch = int(input.shape[-1])
+    w = _scoped_params(prog, "row_conv", lambda: Parameter(
+        jnp.full((d + 1, ch), 1.0 / (d + 1))))
+
+    def apply(t):
+        import paddle_tpu as paddle
+        T = t.shape[1]
+        acc = None
+        for i in range(d + 1):
+            sl = t[:, i:T]
+            pad = paddle.zeros_like(t[:, :i])
+            shifted = paddle.concat([sl, pad], axis=1)
+            term = shifted * w[i]
+            acc = term if acc is None else acc + term
+        if act:
+            import paddle_tpu.nn.functional as F
+            acc = getattr(F, act)(acc)
+        return acc
+
+    return op_var("row_conv", apply, [input], program=prog)

@@ -81,7 +81,23 @@ SURFACE = {
                                    "FakeData"],
     "paddle_tpu.distributed.fleet.utils": ["HybridParallelInferenceHelper",
                                            "recompute"],
-    "paddle_tpu.static.nn": ["sparse_embedding"],
+    "paddle_tpu.static.nn": ["sparse_embedding", "fc", "conv2d",
+                             "batch_norm", "layer_norm", "embedding",
+                             "group_norm", "instance_norm", "data_norm",
+                             "conv2d_transpose", "conv3d", "cond", "case",
+                             "switch_case", "while_loop", "py_func",
+                             "bilinear_tensor_product", "prelu",
+                             "crf_decoding", "deform_conv2d",
+                             "spectral_norm", "continuous_value_model"],
+    "paddle_tpu.static": ["Variable", "Scope", "global_scope", "Print",
+                          "create_global_var", "create_parameter",
+                          "accuracy", "auc", "cpu_places",
+                          "ExponentialMovingAverage", "BuildStrategy",
+                          "ExecutionStrategy", "ParallelExecutor",
+                          "WeightNormParamAttr", "append_backward",
+                          "gradients", "set_program_state",
+                          "load_program_state", "name_scope",
+                          "device_guard", "normalize_program"],
     # dy2static transpiler
     "paddle_tpu.jit.dy2static": ["convert_to_static", "convert_ifelse",
                                  "convert_while_loop", "convert_logical_and"],
