@@ -53,3 +53,40 @@ class ParallelMode:
 
 from . import launch  # noqa: E402,F401
 from .fleet import utils  # noqa: E402,F401
+
+
+from .entry_attr import (CountFilterEntry, EntryAttr,  # noqa: E402,F401
+                         ProbabilityEntry, ShowClickEntry)
+
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """Gloo CPU-barrier bootstrap (reference parallel.py gloo_*): the
+    TCPStore rendezvous plays gloo's role here."""
+    from .store import TCPStore
+    host, port = server_endpoint.rsplit(":", 1)
+    global _gloo_store
+    _gloo_store = TCPStore(host, int(port), is_master=(rank_id == 0),
+                           world_size=rank_num)
+    _gloo_store.add("gloo_init", 1)
+
+
+def gloo_barrier():
+    if "_gloo_store" not in globals() or _gloo_store is None:
+        raise RuntimeError("call gloo_init_parallel_env first")
+    _gloo_store.add("gloo_barrier", 1)
+
+
+def gloo_release():
+    global _gloo_store
+    _gloo_store = None
+
+
+class BoxPSDataset:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "BoxPS (Baidu GPU parameter server hardware) is not part of a "
+            "TPU build — use distributed.InMemoryDataset with the ps "
+            "package (SURVEY §2.4.12 sanctions this drop)")
+
+
+from . import cloud_utils  # noqa: E402,F401

@@ -1,0 +1,23 @@
+"""paddle.distributed.cloud_utils — cluster-from-environment helpers
+(reference distributed/cloud_utils.py: get_cluster_and_pod reading
+PADDLE_* env)."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_cluster_and_pod", "use_paddlecloud"]
+
+
+def use_paddlecloud() -> bool:
+    return all(k in os.environ for k in
+               ("PADDLE_TRAINERS_NUM", "PADDLE_TRAINER_ENDPOINTS",
+                "PADDLE_CURRENT_ENDPOINT", "PADDLE_TRAINER_ID"))
+
+
+def get_cluster_and_pod(args=None):
+    """Returns (endpoint list, current endpoint, trainer id) derived from
+    the PADDLE_* env — the subset launch/controllers.py consumes."""
+    eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+    cur = os.environ.get("PADDLE_CURRENT_ENDPOINT", eps[0] if eps else "")
+    tid = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+    return [e for e in eps if e], cur, tid

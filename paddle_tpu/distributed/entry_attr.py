@@ -1,0 +1,64 @@
+"""Sparse-table entry policies (reference distributed/entry_attr.py:
+ProbabilityEntry:59, CountFilterEntry:100, ShowClickEntry:142) — passed
+as `entry=` to static.nn.sparse_embedding to control when a PS sparse
+table creates/retains a row."""
+from __future__ import annotations
+
+__all__ = ["EntryAttr", "ProbabilityEntry", "CountFilterEntry",
+           "ShowClickEntry"]
+
+
+class EntryAttr:
+    def __init__(self):
+        self._name = None
+
+    def _to_attr(self) -> str:
+        raise NotImplementedError("EntryAttr is base class")
+
+
+class ProbabilityEntry(EntryAttr):
+    """Create a new row with the given probability (CTR feature
+    sub-sampling)."""
+
+    def __init__(self, probability):
+        super().__init__()
+        if not isinstance(probability, float) or probability <= 0 \
+                or probability > 1:
+            raise ValueError("probability must be a float in (0, 1]")
+        self._name = "probability_entry"
+        self._probability = probability
+
+    def _to_attr(self):
+        return ":".join([self._name, str(self._probability)])
+
+
+class CountFilterEntry(EntryAttr):
+    """Create a row only after a feature id has been seen `count`
+    times."""
+
+    def __init__(self, count):
+        super().__init__()
+        if not isinstance(count, int) or count < 0:
+            raise ValueError("count must be a non-negative integer")
+        self._name = "count_filter_entry"
+        self._count = count
+
+    def _to_attr(self):
+        return ":".join([self._name, str(self._count)])
+
+
+class ShowClickEntry(EntryAttr):
+    """Attach show/click statistic columns (by input-var name) to each
+    row for CTR decay policies."""
+
+    def __init__(self, show_name, click_name):
+        super().__init__()
+        if not isinstance(show_name, str) or not isinstance(click_name,
+                                                            str):
+            raise ValueError("show_name/click_name must be str")
+        self._name = "show_click_entry"
+        self._show_name = show_name
+        self._click_name = click_name
+
+    def _to_attr(self):
+        return ":".join([self._name, self._show_name, self._click_name])

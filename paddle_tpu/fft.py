@@ -104,3 +104,5 @@ def fftshift(x, axes=None, name=None):
 def ifftshift(x, axes=None, name=None):
     return apply_op(lambda v: jnp.fft.ifftshift(v, axes=axes), "ifftshift",
                     (x,), {})
+
+from .ops.compat_surface import is_complex  # noqa: E402,F401

@@ -91,3 +91,5 @@ def istft(x, n_fft, hop_length=None, win_length=None, window=None,
         return out[0] if squeeze else out
 
     return apply_op(raw, "istft", (x,), {})
+
+from .ops.compat_surface import is_complex  # noqa: E402,F401

@@ -49,3 +49,28 @@ def run_check():
     loss.backward()
     opt.step()
     print("PaddlePaddle(TPU) is installed successfully!")
+
+
+# legacy profiler facade (reference utils/profiler.py wraps the core
+# profiler; ours lives in paddle_tpu.profiler)
+from ..profiler import Profiler  # noqa: E402,F401
+
+
+class ProfilerOptions:
+    def __init__(self, options=None):
+        self.options = options or {}
+
+
+def get_profiler():
+    return Profiler
+
+
+class OpLastCheckpointChecker:
+    """Reference utils/op_version checker: queries op version
+    compatibility; every op here is current by construction."""
+
+    def check(self, op_name, *a, **k):
+        return True
+
+
+from ..dataset import image as image_util  # noqa: E402,F401

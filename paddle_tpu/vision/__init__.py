@@ -30,3 +30,13 @@ def get_image_backend():
 
 
 _image_backend = "pil"
+
+
+def image_load(path, backend=None):
+    """vision/image.py image_load: decode via the configured backend."""
+    from .datasets.folder import default_loader
+    try:
+        return default_loader(path)
+    except Exception:
+        from ..dataset.image import load_image
+        return load_image(path)

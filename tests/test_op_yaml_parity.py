@@ -59,8 +59,7 @@ ALIASES = {
     "huber_loss": "nn.functional.smooth_l1_loss",
     "kldiv_loss": "nn.functional.kl_div",
     "logsigmoid": "log_sigmoid",
-    "margin_cross_entropy": (
-        "distributed.fleet.layers.mpu.ParallelCrossEntropy"),
+    "margin_cross_entropy": "nn.functional.margin_cross_entropy",
     "matrix_rank_tol": "linalg.matrix_rank",
     "max_pool2d_with_index": "nn.functional.max_pool2d",   # return_mask=True
     "max_pool3d_with_index": "nn.functional.max_pool3d",
@@ -93,7 +92,14 @@ ALIASES = {
     "warpctc": "nn.functional.ctc_loss",
     "where_index": "nonzero",
     "yolo_box": "vision.ops.yolo_box",
-    "yolov3_loss": "vision.models.YOLOv3Loss",
+    "yolov3_loss": "vision.ops.yolo_loss",
+    "matrix_nms": "vision.ops.matrix_nms",
+    "distribute_fpn_proposals": "vision.ops.distribute_fpn_proposals",
+    "generate_proposals_v2": "vision.ops.generate_proposals",
+    "roi_pool": "vision.ops.roi_pool",
+    "unpool3d": "nn.functional.max_unpool3d",
+    "decode_jpeg": "vision.ops.decode_jpeg",
+    "hierarchical_sigmoid": "nn.functional.hsigmoid_loss",
 }
 
 # capability exists structurally — not as a named op
@@ -126,23 +132,7 @@ SUBSUMED = {
 }
 
 # deliberately not carried (reason on record; see docs/DESIGN_DECISIONS.md)
-DROPPED = {
-    "matrix_nms": "PP-YOLOv2-era NMS variant; vision.ops.nms covers the "
-                  "predictor path",
-    "distribute_fpn_proposals": "FasterRCNN FPN routing, out of the "
-                                "supported detector families",
-    "generate_proposals_v2": "RPN proposal stage, same scope decision",
-    "roi_pool": "quantized RoI pooling superseded by roi_align (provided)",
-    "unpool3d": "3-D max-unpool; 2-D provided (max_unpool2d), 3-D had no "
-                "consumer in the supported model zoo",
-    "decode_jpeg": "device-side JPEG decode is CUDA-specific (nvJPEG); "
-                   "image IO is host-side in vision.datasets/transforms",
-    "hierarchical_sigmoid": "legacy tree-softmax for rec-sys; the PS "
-                            "sparse-table + tree-index (TDM) path covers "
-                            "that workload family",
-    "thresholded_relu": "niche activation with no consumer in the zoo; "
-                        "one jnp.where if needed",
-}
+DROPPED = {}
 
 
 def _ref_ops():
