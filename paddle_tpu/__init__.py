@@ -144,6 +144,11 @@ class LazyGuard:  # noqa: F811
     def __exit__(self, *exc):
         return self._cm.__exit__(*exc)
 
+from .core.tensor_methods import install_tensor_methods as _itm  # noqa: E402
+
+_itm()
+del _itm
+
 __version__ = "0.1.0"
 
 # `paddle.disable_static()/enable_static()` parity: this framework is always

@@ -1,0 +1,57 @@
+"""Install the remaining reference Tensor methods.
+
+The reference patches ~225 functions onto Tensor
+(python/paddle/tensor/__init__.py tensor_method_func +
+monkey_patch_math_varbase); defop's tensor_method covers most here, and
+this module binds the long tail whose functions already exist at the
+paddle_tpu top level (tensor-first signatures, so plain attribute
+binding gives the method form), plus the in-place `*_` variants."""
+from __future__ import annotations
+
+__all__ = ["install_tensor_methods"]
+
+_BIND = [
+    "add_n", "addmm", "as_complex", "as_real", "broadcast_shape",
+    "broadcast_tensors", "bucketize", "cholesky_solve", "chunk", "concat",
+    "cond", "diff", "eig", "eigvals", "eigvalsh", "expand_as",
+    "floor_mod", "gcd", "heaviside", "histogram", "is_complex",
+    "is_empty", "is_floating_point", "is_integer", "is_tensor", "lcm",
+    "logcumsumexp", "logit", "lstsq", "lu", "lu_unpack", "multi_dot",
+    "nanquantile", "qr", "rank", "reshape_", "reverse", "scatter_",
+    "scatter_nd", "shard_index", "slice", "solve", "split", "squeeze_",
+    "stack", "strided_slice", "take", "tensordot", "triangular_solve",
+    "unbind", "unsqueeze_", "unstack", "vsplit", "where",
+]
+
+_INPLACE = {  # method name -> out-of-place function
+    "erfinv_": "erfinv",
+    "flatten_": "flatten",
+    "lerp_": "lerp",
+    "put_along_axis_": "put_along_axis",
+}
+
+
+def install_tensor_methods():
+    import paddle_tpu as paddle
+    from .tensor import Tensor
+
+    for name in _BIND:
+        fn = getattr(paddle, name, None)
+        if fn is None:
+            fn = getattr(paddle.linalg, name, None)
+        if fn is not None and not hasattr(Tensor, name):
+            setattr(Tensor, name, fn)
+
+    def make_inplace(base_name):
+        def method(self, *args, **kwargs):
+            out = getattr(paddle, base_name)(self, *args, **kwargs)
+            self._replace_(out._value if hasattr(out, "_value") else out,
+                           None)
+            return self
+
+        method.__name__ = base_name + "_"
+        return method
+
+    for mname, base in _INPLACE.items():
+        if not hasattr(Tensor, mname):
+            setattr(Tensor, mname, make_inplace(base))

@@ -303,3 +303,19 @@ def test_top_level_parity_vs_reference_init():
     missing = {n for n in names
                if not n.startswith("_") and not hasattr(paddle, n)}
     assert missing <= allowed_absent, sorted(missing - allowed_absent)
+
+
+def test_tensor_method_parity_vs_reference():
+    """Every function the reference patches onto Tensor
+    (tensor/__init__.py tensor_method_func) is a method here too."""
+    import os
+    import re
+    ref = "/root/reference/python/paddle/tensor/__init__.py"
+    if not os.path.exists(ref):
+        pytest.skip("reference tree not present")
+    m = re.search(r"tensor_method_func = \[(.*?)\]", open(ref).read(),
+                  re.S)
+    names = set(re.findall(r"'(\w+)'", m.group(1)))
+    from paddle_tpu.core.tensor import Tensor
+    missing = sorted(n for n in names if not hasattr(Tensor, n))
+    assert not missing, missing
