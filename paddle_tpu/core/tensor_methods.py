@@ -42,16 +42,12 @@ def install_tensor_methods():
         if fn is not None and not hasattr(Tensor, name):
             setattr(Tensor, name, fn)
 
-    def make_inplace(base_name):
-        def method(self, *args, **kwargs):
-            out = getattr(paddle, base_name)(self, *args, **kwargs)
-            self._replace_(out._value if hasattr(out, "_value") else out,
-                           None)
-            return self
-
-        method.__name__ = base_name + "_"
-        return method
+    # ONE in-place pattern for the whole codebase: ops/math._make_inplace
+    # keeps the autograd tape alive (grad node + slot carried into the
+    # replaced buffer, stop_gradient propagated) — a bare _replace_(None)
+    # would silently sever gradients
+    from ..ops.math import _make_inplace
 
     for mname, base in _INPLACE.items():
         if not hasattr(Tensor, mname):
-            setattr(Tensor, mname, make_inplace(base))
+            _make_inplace(getattr(paddle, base), mname)

@@ -319,3 +319,16 @@ def test_tensor_method_parity_vs_reference():
     from paddle_tpu.core.tensor import Tensor
     missing = sorted(n for n in names if not hasattr(Tensor, n))
     assert not missing, missing
+
+
+def test_inplace_tensor_methods_keep_autograd():
+    """Regression (review finding): the installed *_ in-place methods must
+    carry the autograd tape through the buffer replacement."""
+    import numpy as np
+    import paddle_tpu as paddle
+    x = paddle.to_tensor(np.array([0.3], np.float32), stop_gradient=False)
+    y = x * 2.0
+    y.erfinv_()
+    (y * 1.0).sum().backward()
+    assert x.grad is not None
+    assert np.isfinite(x.grad.numpy()).all()
