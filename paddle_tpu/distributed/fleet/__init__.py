@@ -204,3 +204,37 @@ class UtilBase:
 
 
 util = UtilBase()
+
+
+# -- reference-name long tail ------------------------------------------------
+
+from . import data_generator  # noqa: E402,F401
+from .data_generator import (MultiSlotDataGenerator,  # noqa: E402,F401
+                             MultiSlotStringDataGenerator)
+from .dataset import FileInstantDataset  # noqa: E402,F401
+
+
+class Fleet:
+    """The reference exports the Fleet CLASS alongside the module-level
+    singleton API (fleet/fleet.py:126); this module IS the singleton, so
+    the class view simply exposes the same callables."""
+
+    def __getattr__(self, name):
+        import sys
+        return getattr(sys.modules[__name__], name)
+
+
+def distributed_scaler(scaler):
+    """fleet/scaler.py:26 — hybrid-parallel-aware GradScaler: under GSPMD
+    the jitted step computes found_inf over the GLOBAL (sharded) grads by
+    construction, so the cross-rank inf-allreduce the reference patches
+    in is already the default; the scaler passes through unchanged."""
+    return scaler
+
+
+class BoxPSDataset:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "BoxPS (Baidu GPU parameter-server hardware) is not part of a "
+            "TPU build — use InMemoryDataset + the ps package (SURVEY "
+            "§2.4.12 sanctions this drop)")

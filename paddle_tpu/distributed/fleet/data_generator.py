@@ -1,0 +1,111 @@
+"""fleet.data_generator — parity with
+distributed/fleet/data_generator/data_generator.py (DataGenerator:21,
+MultiSlotStringDataGenerator:239, MultiSlotDataGenerator:284): user
+subclasses implement `generate_sample`; `run_from_stdin`/`run_from_memory`
+emit the slot-formatted text lines the InMemory/Queue datasets parse."""
+from __future__ import annotations
+
+import sys
+
+__all__ = ["DataGenerator", "MultiSlotDataGenerator",
+           "MultiSlotStringDataGenerator"]
+
+
+class DataGenerator:
+    def __init__(self):
+        self._proto_info = None
+        self.batch_size_ = 32
+
+    def set_batch(self, batch_size):
+        self.batch_size_ = batch_size
+
+    def generate_sample(self, line):
+        """Override: return a generator yielding [(slot_name, values)]."""
+        raise NotImplementedError(
+            "please rewrite this function to return a list of "
+            "(name, value-list) pairs")
+
+    def generate_batch(self, samples):
+        def local_iter():
+            for sample in samples:
+                yield sample
+
+        return local_iter
+
+    def _gen_str(self, line):
+        raise NotImplementedError
+
+    def run_from_stdin(self):
+        batch_samples = []
+        for line in sys.stdin:
+            line_iter = self.generate_sample(line)
+            for user_parsed_line in line_iter():
+                if user_parsed_line is None:
+                    continue
+                batch_samples.append(user_parsed_line)
+                if len(batch_samples) == self.batch_size_:
+                    batch_iter = self.generate_batch(batch_samples)
+                    for sample in batch_iter():
+                        sys.stdout.write(self._gen_str(sample))
+                    batch_samples = []
+        if batch_samples:
+            batch_iter = self.generate_batch(batch_samples)
+            for sample in batch_iter():
+                sys.stdout.write(self._gen_str(sample))
+
+    def run_from_memory(self, lines=None):
+        """Feed from an iterable instead of stdin; returns the formatted
+        lines (the dataset loaders consume them directly)."""
+        out = []
+        batch_samples = []
+        for line in (lines or []):
+            for user_parsed_line in self.generate_sample(line)():
+                if user_parsed_line is None:
+                    continue
+                batch_samples.append(user_parsed_line)
+                if len(batch_samples) == self.batch_size_:
+                    for sample in self.generate_batch(batch_samples)():
+                        out.append(self._gen_str(sample))
+                    batch_samples = []
+        if batch_samples:
+            for sample in self.generate_batch(batch_samples)():
+                out.append(self._gen_str(sample))
+        return out
+
+
+class MultiSlotStringDataGenerator(DataGenerator):
+    def _gen_str(self, line):
+        """[(name, [str...])] -> 'len v v ... len v v ...\\n' (the
+        reference's slot wire format)."""
+        output = ""
+        for index, item in enumerate(line):
+            name, elements = item
+            if output:
+                output += " "
+            out_str = [str(len(elements))] + [str(x) for x in elements]
+            output += " ".join(out_str)
+        return output + "\n"
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    def _gen_str(self, line):
+        output = ""
+        if self._proto_info is None:
+            self._proto_info = []
+            for item in line:
+                name, elements = item
+                self._proto_info.append((name, "uint64"))
+                if output:
+                    output += " "
+                output += str(len(elements))
+                for x in elements:
+                    output += " " + str(x)
+        else:
+            for index, item in enumerate(line):
+                name, elements = item
+                if output:
+                    output += " "
+                output += str(len(elements))
+                for x in elements:
+                    output += " " + str(x)
+        return output + "\n"

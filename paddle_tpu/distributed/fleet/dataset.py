@@ -291,3 +291,14 @@ class QueueDataset(DatasetBase):
             # error raised above or the consumer broke out of iteration:
             # release blocked readers so threads/files/pipes are reclaimed
             stop.set()
+
+
+class FileInstantDataset(QueueDataset):
+    """dataset.py FileInstantDataset parity: the streaming QueueDataset
+    contract with instant (non-shuffling, file-order) consumption — which
+    is exactly how QueueDataset here already reads; the distinct class
+    records the mode for recipes that select it by name."""
+
+    def __init__(self):
+        super().__init__()
+        self.mode = "file_instant"

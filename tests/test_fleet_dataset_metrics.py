@@ -185,3 +185,31 @@ def test_auc_matches_sklearn_style_reference():
 def test_auc_degenerate_cases():
     assert fmetrics.auc(np.zeros(16), np.ones(16)) == 0.5
     assert fmetrics.auc(np.ones(16), np.zeros(16)) == 0.5
+
+
+def test_data_generator_slot_format_and_file_instant():
+    from paddle_tpu.distributed import fleet
+
+    class G(fleet.MultiSlotStringDataGenerator):
+        def generate_sample(self, line):
+            def gen():
+                yield [("words", line.split()), ("label", ["1"])]
+            return gen
+
+    out = G().run_from_memory(["a b c", "d e"])
+    assert out[0] == "3 a b c 1 1\n"
+    assert out[1] == "2 d e 1 1\n"
+
+    class GI(fleet.MultiSlotDataGenerator):
+        def generate_sample(self, line):
+            def gen():
+                yield [("ids", [int(t) for t in line.split()])]
+            return gen
+
+    out = GI().run_from_memory(["7 8"])
+    assert out[0] == "2 7 8\n"
+    ds = fleet.FileInstantDataset()
+    assert ds.mode == "file_instant"
+    assert fleet.distributed_scaler("scaler") == "scaler"
+    # the Fleet class view exposes the module singleton API
+    assert fleet.Fleet().init is fleet.init
