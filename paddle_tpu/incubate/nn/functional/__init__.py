@@ -1,20 +1,18 @@
 """paddle.incubate.nn.functional parity: functional forms of the fused ops
 (incubate/nn/functional/fused_transformer.py: fused_multi_head_attention
 :371, fused_multi_transformer:661; fused_matmul_bias.py:21,80).  Each is
-the reference kernel's pseudo-code composed from jnp ops — XLA fuses the
-epilogues; the attention core rides the flash kernel via
-scaled_dot_product_attention."""
+the reference kernel's pseudo-code composed from taped Tensor ops — XLA
+fuses the epilogues so gradients flow to every input, and the attention
+core rides the flash kernel via scaled_dot_product_attention."""
 from __future__ import annotations
-
-import jax.numpy as jnp
 
 from ....core.tensor import Tensor
 from ....nn import functional as _F
 from ....nn.functional.attention import scaled_dot_product_attention
 
 
-def _val(x):
-    return x._value if isinstance(x, Tensor) else x
+def _as_tensor(x):
+    return x if isinstance(x, Tensor) else Tensor(x, _internal=True)
 
 
 def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
@@ -45,47 +43,47 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
                                add_residual=True, name=None):
     """fused_transformer.py:371 — self-attention with the reference's
     fused-op semantics: qkv_weight [3, nh, hd, e], qkv_bias [3, nh, hd];
-    returns out (and the updated cache_kv when one is passed)."""
-    xv = _val(x)
-    qkv_w = _val(qkv_weight)
-    residual = xv
-    h = xv
+    returns out (and the updated cache_kv when one is passed).
+
+    Composed entirely from taped Tensor ops so gradients flow to x and
+    every weight (the reference op is differentiable; round-3 advice
+    found the jnp-composed version severed the tape)."""
+    from .... import ops as _ops
+    x = _as_tensor(x)
+    qkv_weight = _as_tensor(qkv_weight)
+    residual = x
+    h = x
     if pre_layer_norm:
-        h = _val(_F.layer_norm(Tensor(xv, _internal=True), xv.shape[-1:],
-                               weight=pre_ln_scale, bias=pre_ln_bias,
-                               epsilon=pre_ln_epsilon))
-    three, nh, hd, e = qkv_w.shape
-    qkv = jnp.einsum("bse,thde->bsthd", h, qkv_w)
+        h = _F.layer_norm(x, x.shape[-1:], weight=pre_ln_scale,
+                          bias=pre_ln_bias, epsilon=pre_ln_epsilon)
+    three, nh, hd, e = tuple(qkv_weight.shape)
+    qkv = _ops.einsum("bse,thde->bsthd", h, qkv_weight)
     if qkv_bias is not None:
-        qkv = qkv + _val(qkv_bias)[None, None]
+        qkv = qkv + _as_tensor(qkv_bias)   # [3,nh,hd] broadcasts over [b,s,·]
     q, k, v = (qkv[:, :, i] for i in range(3))          # [b, s, nh, hd]
     if cache_kv is not None:
-        ckv = _val(cache_kv)                             # [2, b, nh, t, hd]
-        k = jnp.concatenate([jnp.moveaxis(ckv[0], 2, 1), k], axis=1)
-        v = jnp.concatenate([jnp.moveaxis(ckv[1], 2, 1), v], axis=1)
+        cache_kv = _as_tensor(cache_kv)                  # [2, b, nh, t, hd]
+        k = _ops.concat([_ops.transpose(cache_kv[0], [0, 2, 1, 3]), k],
+                        axis=1)
+        v = _ops.concat([_ops.transpose(cache_kv[1], [0, 2, 1, 3]), v],
+                        axis=1)
     del e  # embed dim only documents the qkv_weight layout
-    out = _val(scaled_dot_product_attention(
-        Tensor(q, _internal=True), Tensor(k, _internal=True),
-        Tensor(v, _internal=True),
-        attn_mask=attn_mask, dropout_p=attn_dropout_rate,
-        training=training))                              # [b, s, nh, hd]
-    out = out.reshape(out.shape[0], out.shape[1], nh * hd)
-    out = _val(_F.linear(Tensor(out, _internal=True), linear_weight,
-                         linear_bias))
-    out = _val(_F.dropout(Tensor(out, _internal=True), p=dropout_rate,
-                          training=training, mode=mode))
+    out = scaled_dot_product_attention(
+        q, k, v, attn_mask=attn_mask, dropout_p=attn_dropout_rate,
+        training=training)                               # [b, s, nh, hd]
+    out = out.reshape([out.shape[0], out.shape[1], nh * hd])
+    out = _F.linear(out, linear_weight, linear_bias)
+    out = _F.dropout(out, p=dropout_rate, training=training, mode=mode)
     if add_residual:
         out = residual + out
     if not pre_layer_norm:
-        out = _val(_F.layer_norm(Tensor(out, _internal=True),
-                                 out.shape[-1:], weight=ln_scale,
-                                 bias=ln_bias, epsilon=ln_epsilon))
-    result = Tensor(out, _internal=True)
+        out = _F.layer_norm(out, out.shape[-1:], weight=ln_scale,
+                            bias=ln_bias, epsilon=ln_epsilon)
     if cache_kv is not None:
-        new_cache = jnp.stack([jnp.moveaxis(k, 1, 2),
-                               jnp.moveaxis(v, 1, 2)])
-        return result, Tensor(new_cache, _internal=True)
-    return result
+        new_cache = _ops.stack([_ops.transpose(k, [0, 2, 1, 3]),
+                                _ops.transpose(v, [0, 2, 1, 3])])
+        return out, new_cache
+    return out
 
 
 def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights,
@@ -101,27 +99,28 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights,
     call (per-layer weight LISTS, optional KV caches for generation).
     qkv_weights[i]: [3, nh, hd, e] when trans_qkvw (the reference
     default)."""
+    from .... import ops as _ops
     out = x
     new_caches = [] if cache_kvs is not None else None
     n = len(qkv_weights)
     for i in range(n):
-        qw = _val(qkv_weights[i])
+        qw = _as_tensor(qkv_weights[i])
         if not trans_qkvw:                 # [e, 3, nh, hd] -> [3, nh, hd, e]
-            qw = jnp.moveaxis(qw, 0, -1)
+            qw = _ops.transpose(qw, [1, 2, 3, 0])
         cache_i = None
         if cache_kvs is not None:
-            cache_i = cache_kvs[i]
+            cache_i = _as_tensor(cache_kvs[i])
             if time_step is not None:
                 # reference decode contract: a FIXED-size cache
                 # [2, b, nh, max_len, hd] whose valid prefix is
                 # time_step — attending over the unwritten tail would
                 # softmax against garbage keys
                 t = int(time_step)
-                cache_i = Tensor(_val(cache_i)[:, :, :, :t], _internal=True)
+                cache_i = cache_i[:, :, :, :t]
         ln_s = ln_scales[i] if ln_scales else None
         ln_b = ln_biases[i] if ln_biases else None
         attn = fused_multi_head_attention(
-            out, Tensor(qw, _internal=True), linear_weights[i],
+            out, qw, linear_weights[i],
             pre_layer_norm=pre_layer_norm,
             # pre-LN consumes ln as the PRE norm; post-LN as the POST one
             pre_ln_scale=ln_s if pre_layer_norm else None,

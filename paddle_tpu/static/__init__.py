@@ -121,8 +121,22 @@ def data(name, shape, dtype="float32", lod_level=0):
     consume it; Executor.run binds the feed dict — static/graph.py)."""
     from .graph import feed_var
     spec = InputSpec(shape, dtype, name)
-    _default_main._input_specs.append(spec)
-    _default_main._feed_names.append(name)
+    if name in _default_main._feed_names:
+        # re-declaring an existing input = the same construction script is
+        # being re-run against this Program (notebook re-run): restart the
+        # per-opname counters so builders reuse fc_0/fc_1... (create-once
+        # persistable contract) instead of minting fresh parameters.
+        # Reset at most once per rebuild — on the FIRST feed name only —
+        # so scripts interleaving data() and builders don't reset mid-pass.
+        # Incremental builds (a second guard block adding NEW inputs/layers)
+        # never re-declare a name, so their counters keep advancing.
+        if name == _default_main._feed_names[0]:
+            _default_main.__dict__["_graph_param_counts"] = {}
+        i = _default_main._feed_names.index(name)
+        _default_main._input_specs[i] = spec
+    else:
+        _default_main._input_specs.append(spec)
+        _default_main._feed_names.append(name)
     var = feed_var(name, [s if s is not None and s != -1 else None
                           for s in shape], dtype, _default_main)
     var.spec = spec
@@ -188,15 +202,20 @@ class Executor:
             feed_t = {k: v if isinstance(v, Tensor)
                       else Tensor(np.asarray(v)) for k, v in feed.items()}
             memo: dict = {}
+            # reference program order: ALL forward ops run before the
+            # optimizer update, so fetches read pre-update activations —
+            # evaluate loss AND fetches first, then backward + step
+            loss = None
             if train_op is not None:
                 loss_var, opt = train_op
                 [loss] = _geval([loss_var], feed_t, memo)
+            outs = _geval(list(fetch_list or []), feed_t, memo)
+            if train_op is not None:
                 loss.backward()
                 if not opt._parameters:
                     opt._parameters = inner.all_parameters()
                 opt.step()
                 opt.clear_grad()
-            outs = _geval(list(fetch_list or []), feed_t, memo)
             if return_numpy:
                 outs = [np.asarray(o._value if isinstance(o, Tensor)
                                    else o) for o in outs]

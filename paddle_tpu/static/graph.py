@@ -120,38 +120,76 @@ def op_var(name, fn, inputs, program=None, shape=None,
 def evaluate_vars(fetch: Sequence[Variable], feeds: Dict[str, Any],
                   memo: Optional[dict] = None) -> List[Any]:
     """Evaluate graph nodes with the feed dict bound; returns eager
-    Tensors (real autograd tape attached)."""
+    Tensors (real autograd tape attached).
+
+    Iterative post-order over Variable.inputs (explicit worklist) — a
+    >1000-op sequential chain must not hit Python's recursion limit at
+    Executor.run time."""
     from ..core.tensor import Tensor
 
     memo = {} if memo is None else memo
 
-    def ev(v):
-        if not isinstance(v, Variable):
-            return v
-        if id(v) in memo:
-            return memo[id(v)]
+    def leaf_value(v):
         if v.kind == "feed":
             if v.name not in feeds:
                 raise KeyError(
                     f"feed for {v.name!r} missing; got {sorted(feeds)}")
             out = feeds[v.name]
-            out = out if isinstance(out, Tensor) else Tensor(
+            return out if isinstance(out, Tensor) else Tensor(
                 np.asarray(out))
-        elif v.kind == "const":
-            out = v.value if isinstance(v.value, Tensor) else Tensor(
+        if v.kind == "const":
+            return v.value if isinstance(v.value, Tensor) else Tensor(
                 np.asarray(v.value))
-        elif v.kind == "param":
-            out = v.param     # the live Parameter object
-        else:
-            out = v.op(*[ev(i) for i in v.inputs])
-            # a branch fn (cond/case) may BUILD graph nodes: evaluate
-            # them in the same feed context
-            while isinstance(out, Variable):
-                out = ev(out)
-            if isinstance(out, (tuple, list)):
-                out = type(out)(ev(o) if isinstance(o, Variable) else o
-                                for o in out)
-        memo[id(v)] = out
-        return out
+        return v.param        # "param": the live Parameter object
 
-    return [ev(v) for v in fetch]
+    # raw op results whose Variable components still need evaluation —
+    # a branch fn (cond/case) may BUILD graph nodes mid-run, and those
+    # must be evaluated in the same feed context without re-running the op
+    pending: Dict[int, Any] = {}
+
+    def drive(root):
+        if not isinstance(root, Variable):
+            return root
+        stack = [root]
+        while stack:
+            v = stack[-1]
+            if not isinstance(v, Variable) or id(v) in memo:
+                stack.pop()
+                continue
+            if v.kind != "op":
+                memo[id(v)] = leaf_value(v)
+                stack.pop()
+                continue
+            if id(v) in pending:
+                out = pending[id(v)]
+                if isinstance(out, Variable):       # result chain
+                    if id(out) in memo:
+                        pending[id(v)] = memo[id(out)]
+                    else:
+                        stack.append(out)
+                    continue
+                if isinstance(out, (tuple, list)):
+                    todo = [o for o in out if isinstance(o, Variable)
+                            and id(o) not in memo]
+                    if todo:
+                        stack.extend(reversed(todo))  # keep l-to-r op order
+                        continue
+                    out = type(out)(memo[id(o)] if isinstance(o, Variable)
+                                    else o for o in out)
+                memo[id(v)] = out
+                del pending[id(v)]
+                stack.pop()
+                continue
+            todo = [i for i in v.inputs if isinstance(i, Variable)
+                    and id(i) not in memo]
+            if todo:
+                # reversed so the leftmost input pops (and so executes)
+                # first — matching the recursive walk's side-effect and
+                # RNG-draw order
+                stack.extend(reversed(todo))
+                continue
+            pending[id(v)] = v.op(*[memo[id(i)] if isinstance(i, Variable)
+                                    else i for i in v.inputs])
+        return memo[id(root)]
+
+    return [drive(v) for v in fetch]

@@ -1,6 +1,12 @@
 """paddle.utils.download — get_path_from_url parity (utils/download.py).
 This build has no network egress: the helper resolves/extracts LOCAL
-archives and errors with instructions for remote URLs."""
+archives and errors with instructions for remote URLs.
+
+Safety parity with the reference (utils/download.py _md5check /
+_decompress): the md5sum argument is verified before the archive is
+trusted, and archive members whose resolved path escapes root_dir
+(``../`` or absolute names) are rejected before extraction.
+"""
 from __future__ import annotations
 
 import os
@@ -9,6 +15,26 @@ import tarfile
 import zipfile
 
 __all__ = ["get_weights_path_from_url", "get_path_from_url"]
+
+
+def _md5check(fname, md5sum):
+    if md5sum is None:
+        return
+    from ..dataset.common import md5file
+    got = md5file(fname)
+    if got != md5sum:
+        raise IOError(
+            f"md5 mismatch for {fname}: expected {md5sum}, got {got}")
+
+
+def _check_members(names, root_dir):
+    root = os.path.realpath(root_dir)
+    for name in names:
+        dest = os.path.realpath(os.path.join(root_dir, name))
+        if not (dest == root or dest.startswith(root + os.sep)):
+            raise IOError(
+                f"archive member {name!r} escapes extraction root "
+                f"{root_dir!r}; refusing to extract")
 
 
 def get_path_from_url(url, root_dir, md5sum=None, check_exist=True,
@@ -20,14 +46,35 @@ def get_path_from_url(url, root_dir, md5sum=None, check_exist=True,
         raise IOError(
             f"no network egress: place {os.path.basename(url)} under "
             f"{root_dir} (from {url}) and retry")
+    _md5check(fname, md5sum)
     if decompress and tarfile.is_tarfile(fname):
         with tarfile.open(fname) as tf:
             names = tf.getnames()
-            tf.extractall(root_dir)
+            _check_members(names, root_dir)
+            for m in tf.getmembers():
+                # internal relative links are fine (pkg/latest -> v1.0);
+                # only targets resolving outside root are refused
+                if m.issym() or m.islnk():
+                    if m.issym():
+                        resolved = os.path.normpath(os.path.join(
+                            os.path.dirname(m.name), m.linkname))
+                    else:            # hardlink target is archive-root-relative
+                        resolved = os.path.normpath(m.linkname)
+                    if os.path.isabs(m.linkname) or resolved == ".." \
+                            or resolved.startswith(".." + os.sep):
+                        raise IOError(
+                            f"archive member {m.name!r} links to "
+                            f"{m.linkname!r} outside the extraction root; "
+                            f"refusing")
+            try:
+                tf.extractall(root_dir, filter="data")
+            except TypeError:        # Python < 3.12: no filter kwarg
+                tf.extractall(root_dir)
         return os.path.join(root_dir, names[0].split("/")[0])
     if decompress and zipfile.is_zipfile(fname):
         with zipfile.ZipFile(fname) as zf:
             names = zf.namelist()
+            _check_members(names, root_dir)
             zf.extractall(root_dir)
         return os.path.join(root_dir, names[0].split("/")[0])
     return fname

@@ -213,3 +213,42 @@ def test_static_nn_create_parameter_registers():
     with static.program_guard(main):
         w = static.nn.create_parameter([3], "float32", name="w0")
     assert any(p is w for p in main.all_parameters())
+
+
+def test_deep_op_chain_no_recursion_error():
+    """ADVICE r3: a >1000-op sequential chain must evaluate iteratively
+    (static/graph.py evaluate_vars worklist), not recurse per edge."""
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data(name="X", shape=[None, 4], dtype="float32")
+        h = x
+        for _ in range(1500):
+            h = h + 1.0
+    exe = static.Executor()
+    out, = exe.run(main, feed={"X": np.zeros((2, 4), np.float32)},
+                   fetch_list=[h])
+    np.testing.assert_allclose(out, np.full((2, 4), 1500.0), rtol=1e-6)
+
+
+def test_program_guard_rebuild_reuses_parameters():
+    """ADVICE r3: re-running the same construction script against the
+    same Program must reuse fc_0/fc_1 (create-once persistable contract),
+    not mint fc_2/fc_3 with fresh weights."""
+    main = static.Program()
+
+    def build():
+        with static.program_guard(main):
+            x = static.data(name="X", shape=[None, 4], dtype="float32")
+            h = static.nn.fc(x, 8)
+            return static.nn.fc(h, 2)
+
+    p1 = build()
+    n_params = len(main.all_parameters())
+    params_before = {id(p) for p in main.all_parameters()}
+    p2 = build()
+    assert len(main.all_parameters()) == n_params
+    assert {id(p) for p in main.all_parameters()} == params_before
+    exe = static.Executor()
+    xb = np.random.RandomState(0).standard_normal((3, 4)).astype(np.float32)
+    o1, o2 = exe.run(main, feed={"X": xb}, fetch_list=[p1, p2])
+    np.testing.assert_allclose(o1, o2, rtol=1e-6)

@@ -238,3 +238,36 @@ def test_vsplit_negative_index_and_download_tar(tmp_path):
                                                   str(tmp_path / "dst"))
     import os
     assert os.path.isdir(out)
+
+
+def test_download_rejects_escaping_members_and_checks_md5(tmp_path):
+    """ADVICE r3: get_path_from_url must verify md5sum and refuse archive
+    members that resolve outside root_dir (reference _md5check/_decompress)."""
+    import hashlib
+    import tarfile as tarmod
+    from paddle_tpu.utils.download import get_path_from_url
+
+    root = tmp_path / "root"
+    root.mkdir()
+    inner = tmp_path / "payload"
+    inner.mkdir()
+    (inner / "a.txt").write_text("ok")
+    good = tmp_path / "good.tar"
+    with tarmod.open(good, "w") as tf:
+        tf.add(inner / "a.txt", arcname="pkg/a.txt")
+    out = get_path_from_url(str(good), str(root))
+    assert out.endswith("pkg")
+
+    # wrong md5 -> refused before extraction
+    with pytest.raises(IOError, match="md5 mismatch"):
+        get_path_from_url(str(good), str(root), md5sum="0" * 32)
+    # right md5 -> accepted
+    digest = hashlib.md5(good.read_bytes()).hexdigest()
+    assert get_path_from_url(str(good), str(root), md5sum=digest)
+
+    evil = tmp_path / "evil.tar"
+    with tarmod.open(evil, "w") as tf:
+        tf.add(inner / "a.txt", arcname="../escape.txt")
+    with pytest.raises(IOError, match="escapes"):
+        get_path_from_url(str(evil), str(root / "sub2"))
+    assert not (tmp_path / "escape.txt").exists()
