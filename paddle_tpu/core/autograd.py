@@ -77,14 +77,20 @@ class GradNode:
     """
 
     __slots__ = ("seq", "vjp_fn", "inputs", "n_outputs", "out_avals", "name",
-                 "_packed")
+                 "_packed", "closure")
 
-    def __init__(self, vjp_fn, inputs, n_outputs, out_avals, name=""):
+    def __init__(self, vjp_fn, inputs, n_outputs, out_avals, name="",
+                 closure=None):
         self.seq = next(_node_counter)
         self.inputs = inputs          # list[Tensor] (only those requiring grad)
         self.n_outputs = n_outputs
         self.out_avals = out_avals    # list[(shape, dtype)] for zero cotangents
         self.name = name
+        # the op's pure fn of its differentiable primals — double backward
+        # (create_graph=True) re-runs jax.vjp over it THROUGH apply_op so
+        # the grad computation itself lands on the tape (reference
+        # dygraph/base.py:432-465 grad(create_graph=True))
+        self.closure = closure
         self._packed = None
         hooks = _saved_tensor_hooks
         if hooks is not None:
@@ -118,6 +124,7 @@ class GradNode:
     def release(self):
         self.vjp_fn = None
         self._packed = None
+        self.closure = None   # drop captured raw inputs with the residuals
 
 
 def _zero_cotangent(shape, dtype):
@@ -129,20 +136,27 @@ def _zero_cotangent(shape, dtype):
 
 def backward(tensors: Sequence[Any], grad_tensors: Sequence[Any] | None = None,
              retain_graph: bool = False, sink: dict | None = None,
-             capture: set | None = None):
+             capture: set | None = None, create_graph: bool = False):
     """Run the backward pass from `tensors` (≈ egr::Backward, backward.cc:105).
 
     sink/capture serve paddle.grad: with `sink` given, gradients are collected
     into ``sink[id(tensor)]`` for leaves and for tensors whose id is in
     `capture`, and NO Tensor.grad is mutated anywhere in the graph.
+
+    create_graph: run every VJP through apply_op so the backward pass is
+    itself recorded on the tape — gradients come back as differentiable
+    Tensors wired to the cotangents AND the original primals (double
+    backward; reference dygraph/base.py:432-465).
     """
     from .tensor import Tensor  # circular: Tensor imports nothing from here at module top
 
     tensors = list(tensors)
     if grad_tensors is None:
         grad_tensors = [None] * len(tensors)
+    retain_graph = retain_graph or create_graph
 
     # grads keyed per-(node, output-slot), plus leaf accumulation on the Tensor.
+    # With create_graph the values are taped Tensors; otherwise raw jnp arrays.
     out_grads: dict[tuple[int, int], Any] = {}
     node_by_id: dict[int, GradNode] = {}
 
@@ -159,8 +173,11 @@ def backward(tensors: Sequence[Any], grad_tensors: Sequence[Any] | None = None,
                     "grad can be implicitly created only for scalar outputs; "
                     f"got shape {tuple(t.shape)}")
             g = jnp.ones_like(t._value)
+        elif isinstance(g, Tensor):
+            # create_graph: a taped grad_tensors seed must stay on the tape
+            g = g if create_graph else g._value
         else:
-            g = g._value if isinstance(g, Tensor) else jnp.asarray(g)
+            g = jnp.asarray(g)
         captured = capture is not None and id(t) in capture
         if captured:
             _sink_add(t, g)
@@ -196,6 +213,16 @@ def backward(tensors: Sequence[Any], grad_tensors: Sequence[Any] | None = None,
             return
         if g.dtype != t._value.dtype:
             g = g.astype(t._value.dtype)
+        if isinstance(g, Tensor):
+            # create_graph path: keep the taped Tensor as .grad so further
+            # differentiation through param.grad works
+            if t._grad is None:
+                t._grad = g
+            else:
+                prev = t._grad.to_dense() if isinstance(
+                    t._grad, SelectedRows) else t._grad
+                t._grad = prev + g
+            return
         if t._grad is None:
             t._grad = Tensor(g, stop_gradient=True)
         elif isinstance(t._grad, SelectedRows):
@@ -239,7 +266,36 @@ def backward(tensors: Sequence[Any], grad_tensors: Sequence[Any] | None = None,
         if not has_any:
             continue
         ct = cts[0] if node.n_outputs == 1 else tuple(cts)
-        in_grads = node._materialized_vjp()(ct)
+        if create_graph and node.closure is None:
+            # a node without a pure closure (PyLayer, SelectedRows lookup)
+            # cannot be re-linearized: raising beats silently returning
+            # first-order-only grads (wrong Hessians)
+            raise NotImplementedError(
+                f"create_graph=True through op {node.name!r} is not "
+                f"supported: its backward is not a pure traced closure "
+                f"(PyLayer/sparse path). Express it with regular tensor "
+                f"ops to differentiate twice.")
+        if create_graph and node.closure is not None:
+            # Tape the grad computation: grad = vjp(closure, primals)(ct) is a
+            # pure jnp function of (ct, primals), so running it through
+            # apply_op records a second-order-differentiable op whose edges
+            # reach the cotangents and the original inputs.
+            from .op import apply_op
+            node_closure = node.closure
+
+            def _grad_fn(ct_, *primals, _f=node_closure):
+                res = jax.vjp(_f, *primals)[1](ct_)
+                # unpack 1-tuples: a plain tuple output makes the recorded
+                # node's own vjp expect a tuple cotangent, but the walk
+                # hands single-output nodes a bare array
+                return res[0] if len(res) == 1 else res
+
+            in_grads = apply_op(_grad_fn, node.name + "_grad",
+                                (ct, *node.inputs), {})
+            if not isinstance(in_grads, (tuple, list)):
+                in_grads = (in_grads,)
+        else:
+            in_grads = node._materialized_vjp()(ct)
         if not retain_graph:
             node.release()
         for inp, g in zip(node.inputs, in_grads):
@@ -256,24 +312,24 @@ def backward(tensors: Sequence[Any], grad_tensors: Sequence[Any] | None = None,
 
 def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=False,
          allow_unused=False):
-    """paddle.grad — functional gradient of eager outputs w.r.t. inputs.
+    """paddle.grad — functional gradient of eager outputs w.r.t. inputs
+    (reference dygraph/base.py:432-465).
 
-    Implemented by running :func:`backward` on a detached view of leaf grads.
-    create_graph (double backward) is served by the functional `jax.grad` path
-    instead and rejected here.
+    Implemented by running :func:`backward` with a sink dict so no .grad is
+    mutated.  With create_graph=True the backward pass itself is recorded on
+    the tape (each VJP re-run through apply_op), so the returned grads are
+    differentiable — grad-of-grad / gradient penalties work in eager mode.
     """
     from .tensor import Tensor
 
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True in eager mode is not supported; use the functional "
-            "API (paddle_tpu.incubate.autograd or jax.grad over a pure function)")
     outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
     inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
-    from .tensor import Tensor
+    if retain_graph is None:
+        retain_graph = create_graph
     sink: dict[int, Any] = {}
     backward(outputs, grad_outputs, retain_graph=bool(retain_graph),
-             sink=sink, capture={id(t) for t in inputs})
+             sink=sink, capture={id(t) for t in inputs},
+             create_graph=create_graph)
     result = []
     for t in inputs:
         g = sink.get(id(t))
@@ -281,6 +337,10 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=Fal
             raise RuntimeError(
                 "one of the inputs has no gradient; pass allow_unused=True "
                 "to get None for it")
-        result.append(None if g is None else Tensor(g, stop_gradient=True,
-                                                    _internal=True))
+        if g is None:
+            result.append(None)
+        elif isinstance(g, Tensor):
+            result.append(g)          # taped (create_graph path)
+        else:
+            result.append(Tensor(g, stop_gradient=True, _internal=True))
     return result

@@ -158,7 +158,8 @@ def apply_op(fn, name, args, kwargs):
     outs_flat = list(out) if isinstance(out, (tuple, list)) else [out]
     avals = [(v.shape, v.dtype) for v in outs_flat]
     node = autograd.GradNode(
-        vjp_fn, [leaves[p] for p in diff_pos], len(outs_flat), avals, name=name)
+        vjp_fn, [leaves[p] for p in diff_pos], len(outs_flat), avals,
+        name=name, closure=closure)
     if CHECK_NAN_INF:
         _scan_nan_inf(name, out)
     return _wrap_outputs(out, node)

@@ -37,7 +37,10 @@ _DEFAULTS = {
                          "optimize_cast": False, "stage": 1},
     "pipeline_configs": {"micro_batch_size": 1, "accumulate_steps": 1,
                          "schedule_mode": "1F1B", "p2p_cache_shape": True,
-                         "enable_partial_send_recv": True},
+                         "enable_partial_send_recv": True,
+                         # TPU extension: per-tick remat in the GPipe scan
+                         # (None = auto: on when num_virtual > 1)
+                         "remat": None},
     "hybrid_configs": {"dp_degree": -1, "mp_degree": 1, "pp_degree": 1,
                        "sharding_degree": 1, "sep_degree": 1,
                        "order": ["dp", "pp", "sharding", "mp"]},

@@ -65,11 +65,19 @@ class PipelineParallel(MetaParallelBase):
                     pre, blocks, post = decompose_pipeline_layer(self._layers)
                     num_virtual = getattr(
                         self._layers, "_num_virtual_pipeline_stages", 1) or 1
+                    cfg = (self._strategy.pipeline_configs
+                           if self._strategy is not None else {})
                     self._train_step = GPipeTrainStep(
                         pre, blocks, post, loss_fn, opt,
                         num_micro=max(2, self.accumulate_steps),
                         num_virtual=num_virtual,
-                        schedule=self.schedule_mode)
+                        schedule=self.schedule_mode,
+                        # virtual stages default to per-tick remat: equal
+                        # bubble to true interleaved 1F1B at lower memory
+                        # (docs/PERF.md "interleaved 1F1B accounting")
+                        remat=(num_virtual > 1
+                               if cfg.get("remat") is None
+                               else cfg["remat"]))
                 except ValueError as e:
                     # decompose_pipeline_layer raises for non-uniform/shared
                     # stages; GPipeTrainStep for divisibility/mesh mismatch —

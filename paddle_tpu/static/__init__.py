@@ -121,22 +121,27 @@ def data(name, shape, dtype="float32", lod_level=0):
     consume it; Executor.run binds the feed dict — static/graph.py)."""
     from .graph import feed_var
     spec = InputSpec(shape, dtype, name)
+    counts = _default_main.__dict__.setdefault("_graph_param_counts", {})
+    decl_tick = _default_main.__dict__.setdefault("_feed_decl_tick", {})
+    tick = sum(counts.values())       # builder calls so far this pass
     if name in _default_main._feed_names:
-        # re-declaring an existing input = the same construction script is
-        # being re-run against this Program (notebook re-run): restart the
-        # per-opname counters so builders reuse fc_0/fc_1... (create-once
-        # persistable contract) instead of minting fresh parameters.
-        # Reset at most once per rebuild — on the FIRST feed name only —
-        # so scripts interleaving data() and builders don't reset mid-pass.
-        # Incremental builds (a second guard block adding NEW inputs/layers)
-        # never re-declare a name, so their counters keep advancing.
-        if name == _default_main._feed_names[0]:
-            _default_main.__dict__["_graph_param_counts"] = {}
+        # re-declaring an existing input AFTER builders have run = the same
+        # construction script is being re-run against this Program
+        # (notebook re-run): restart the per-opname counters so builders
+        # reuse fc_0/fc_1... (create-once persistable contract) instead of
+        # minting fresh parameters.  A back-to-back re-declare with no
+        # builders in between (shape refinement) does NOT reset, and later
+        # names of the same rerun see a fresh tick so the reset fires at
+        # most once per pass.  Incremental builds (a second guard block
+        # adding NEW inputs/layers) never re-declare a name.
+        if tick > decl_tick.get(name, 0):
+            counts.clear()
         i = _default_main._feed_names.index(name)
         _default_main._input_specs[i] = spec
     else:
         _default_main._input_specs.append(spec)
         _default_main._feed_names.append(name)
+    decl_tick[name] = sum(counts.values())
     var = feed_var(name, [s if s is not None and s != -1 else None
                           for s in shape], dtype, _default_main)
     var.spec = spec
