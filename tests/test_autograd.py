@@ -256,3 +256,18 @@ def test_gradient_penalty_trains():
         opt.clear_grad()
         losses.append(float(loss))
     assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_create_graph_mixed_seed_accumulation():
+    """Round-4 review: accumulating a raw jnp seed with a taped cotangent
+    must keep the tape (raw + Tensor coerces to a constant).  y1=x^2,
+    y2=y1^2: d2/dx2 (y1+y2) = 2 + 12x^2 = 29 at x=1.5."""
+    x = paddle.to_tensor(np.array([1.5], np.float32))
+    x.stop_gradient = False
+    y1 = (x ** 2).sum()
+    y2 = y1 * y1
+    (g,) = paddle.grad([y1, y2], [x], create_graph=True)
+    np.testing.assert_allclose(g.numpy(), [2 * 1.5 + 4 * 1.5 ** 3],
+                               rtol=1e-6)
+    (gg,) = paddle.grad(g.sum(), x)
+    np.testing.assert_allclose(gg.numpy(), [29.0], rtol=1e-6)
