@@ -55,3 +55,22 @@ def _isolate_global_state():
     from paddle_tpu.kernels import ln_matmul as _lnmm
     _ln._MODE = "off"
     _lnmm._ENABLED = False
+
+
+def pytest_collection_modifyitems(config, items):
+    """Two-tier suite (round-3 verdict Weak #6: the monolithic suite had
+    outgrown any review budget).  tests/slow_tests.txt lists the tests whose
+    measured call time on the 8-device CPU mesh is >=2s; they get
+    @pytest.mark.slow so `pytest -m "not slow"` is a fast smoke gate.
+    Regenerate the list with tools/retier_tests.py."""
+    import pathlib
+
+    listing = pathlib.Path(__file__).with_name("slow_tests.txt")
+    if not listing.exists():
+        return
+    slow_bases = {line.strip() for line in listing.read_text().splitlines()
+                  if line.strip() and not line.startswith("#")}
+    for item in items:
+        base = item.nodeid.split("[")[0]
+        if base in slow_bases:
+            item.add_marker(pytest.mark.slow)
