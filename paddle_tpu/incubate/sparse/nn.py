@@ -1,15 +1,15 @@
 """paddle.incubate.sparse.nn — sparse layers (reference:
 incubate/sparse/nn/__init__.py: ReLU, ReLU6, LeakyReLU, Softmax over the
-sparse functional ops; the 3-D sparse convs (Conv3D/SubmConv3D/MaxPool3D)
-are backed by cuSPARSE gather-scatter kernels in the reference and are
-not ported — jax.experimental.sparse has no submanifold conv; an import
-error here would be dishonest, absence is)."""
+sparse functional ops; Conv3D/SubmConv3D/MaxPool3D over the round-4
+host-rulebook + device-segment-op kernels in paddle_tpu.sparse)."""
 from __future__ import annotations
 
 from ... import sparse as _sp
 from ...nn.layer_base import Layer
+from ...sparse import Conv3D, MaxPool3D, SubmConv3D  # noqa: F401
 
-__all__ = ["ReLU", "ReLU6", "LeakyReLU", "Softmax"]
+__all__ = ["ReLU", "ReLU6", "LeakyReLU", "Softmax",
+           "Conv3D", "SubmConv3D", "MaxPool3D"]
 
 
 class ReLU(Layer):

@@ -5,30 +5,57 @@ sparse_csr_tensor.h analogs) over jax.experimental.sparse BCOO.
 The reference keeps a dedicated sparse kernel tree (phi/kernels/sparse/, 29
 files); XLA's sparse support is BCOO-based, so COO is the native layout here
 and CSR is a view-style wrapper that converts through COO.
+
+Autograd design: sparse VALUES ride the eager tape.  Every op's value
+compute runs through ``apply_op`` with the (concrete, host-side) index
+structure closed over as static data, and each sparse tensor keeps a taped
+``Tensor`` view of its values — so dense↔sparse compositions
+(Conv3D → relu → pooling → dense head) backprop to weights and inputs just
+like the reference's sparse grad kernels.
 """
 from __future__ import annotations
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax.experimental import sparse as jsparse
 
 from ..core.tensor import Tensor
 
 __all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
            "SparseCsrTensor", "add", "multiply", "matmul", "masked_matmul",
-           "relu", "transpose", "is_same_shape"]
+           "relu", "transpose", "is_same_shape",
+           "conv3d", "subm_conv3d", "max_pool3d", "fused_attention",
+           "to_dense", "to_sparse_coo", "to_sparse_csr", "values",
+           "coalesce", "full_like", "acos", "acosh"]
 
 
 def _val(x):
     return x._value if isinstance(x, Tensor) else jnp.asarray(x)
 
 
-class SparseCooTensor:
-    """COO sparse tensor (dense_tensor.h's SparseCooTensor analog)."""
+def _apply(fn, name, args):
+    from ..core.op import apply_op
+    return apply_op(fn, name, args, {})
 
-    def __init__(self, bcoo: jsparse.BCOO):
+
+class SparseCooTensor:
+    """COO sparse tensor (dense_tensor.h's SparseCooTensor analog).
+
+    ``_vt`` is the taped Tensor view of the stored values; ``_bcoo`` mirrors
+    it for jsparse interop (same underlying buffer).
+    """
+
+    def __init__(self, bcoo: jsparse.BCOO, values_t: Tensor | None = None):
         self._bcoo = bcoo
+        self._vt = values_t
+
+    @classmethod
+    def _make(cls, values_t: Tensor, indices, shape):
+        bcoo = jsparse.BCOO((values_t._value, jnp.asarray(indices)),
+                            shape=tuple(shape))
+        return cls(bcoo, values_t)
 
     # -- paddle surface ------------------------------------------------------
     @property
@@ -43,19 +70,35 @@ class SparseCooTensor:
         return Tensor(self._bcoo.indices.T, _internal=True)  # [ndim, nnz]
 
     def values(self) -> Tensor:
-        return Tensor(self._bcoo.data, _internal=True)
+        if self._vt is None:
+            self._vt = Tensor(self._bcoo.data, _internal=True)
+        return self._vt
 
     def nnz(self) -> int:
         return int(self._bcoo.nse)
 
     def to_dense(self) -> Tensor:
-        return Tensor(self._bcoo.todense(), _internal=True)
+        idx = self._bcoo.indices
+        shape = self._bcoo.shape
+        nsp = idx.shape[1]
+
+        def scatter(v):
+            dense = jnp.zeros(shape, v.dtype)
+            return dense.at[tuple(idx[:, d] for d in range(nsp))].add(v)
+
+        return _apply(scatter, "sparse_to_dense", (self.values(),))
 
     def to_sparse_csr(self) -> "SparseCsrTensor":
         return SparseCsrTensor.from_coo(self)
 
     def coalesce(self) -> "SparseCooTensor":
-        return SparseCooTensor(self._bcoo.sum_duplicates())
+        idx = np.asarray(self._bcoo.indices)
+        uniq, inv = np.unique(idx, axis=0, return_inverse=True)
+        inv_j, n = jnp.asarray(inv), len(uniq)
+        out_t = _apply(
+            lambda v: jax.ops.segment_sum(v, inv_j, num_segments=n),
+            "sparse_coalesce", (self.values(),))
+        return SparseCooTensor._make(out_t, uniq, self._bcoo.shape)
 
     def __repr__(self):
         return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz()}, "
@@ -68,7 +111,8 @@ class SparseCsrTensor:
     def __init__(self, crows, cols, values, shape):
         self._crows = jnp.asarray(_val(crows), jnp.int64)
         self._cols = jnp.asarray(_val(cols), jnp.int64)
-        self._values = _val(values)
+        self._vt = values if isinstance(values, Tensor) else \
+            Tensor(jnp.asarray(_val(values)), _internal=True)
         self._shape = tuple(int(s) for s in shape)
 
     @classmethod
@@ -78,17 +122,16 @@ class SparseCsrTensor:
                 f"CSR conversion supports 2-D tensors, got shape "
                 f"{coo.shape}; keep batched sparse data in COO")
         coo = coo.coalesce()
+        # coalesce's np.unique(axis=0) already lexsorts indices in
+        # (row, col) order — no reorder gather needed
         idx = np.asarray(coo._bcoo.indices)
-        vals = coo._bcoo.data
         rows, cols = idx[:, 0], idx[:, 1]
-        order = np.lexsort((cols, rows))
-        rows, cols = rows[order], cols[order]
-        vals = vals[jnp.asarray(order)]
+        vals_t = coo.values()
         n_rows = coo.shape[0]
         crows = np.zeros(n_rows + 1, np.int64)
         np.add.at(crows, rows + 1, 1)
         crows = np.cumsum(crows)
-        return cls(crows, cols, vals, coo.shape)
+        return cls(crows, cols, vals_t, coo.shape)
 
     @property
     def shape(self):
@@ -96,7 +139,7 @@ class SparseCsrTensor:
 
     @property
     def dtype(self):
-        return self._values.dtype
+        return self._vt.dtype
 
     def crows(self) -> Tensor:
         return Tensor(self._crows, _internal=True)
@@ -105,7 +148,7 @@ class SparseCsrTensor:
         return Tensor(self._cols, _internal=True)
 
     def values(self) -> Tensor:
-        return Tensor(self._values, _internal=True)
+        return self._vt
 
     def nnz(self) -> int:
         return int(self._cols.shape[0])
@@ -113,10 +156,8 @@ class SparseCsrTensor:
     def to_sparse_coo(self, sparse_dim=2) -> SparseCooTensor:
         crows = np.asarray(self._crows)
         rows = np.repeat(np.arange(len(crows) - 1), np.diff(crows))
-        idx = jnp.stack([jnp.asarray(rows),
-                         jnp.asarray(self._cols)], axis=1)
-        bcoo = jsparse.BCOO((self._values, idx), shape=self._shape)
-        return SparseCooTensor(bcoo)
+        idx = np.stack([rows, np.asarray(self._cols)], axis=1)
+        return SparseCooTensor._make(self._vt, idx, self._shape)
 
     def to_dense(self) -> Tensor:
         return self.to_sparse_coo().to_dense()
@@ -131,20 +172,21 @@ class SparseCsrTensor:
 def sparse_coo_tensor(indices, values, shape=None, dtype=None,
                       place=None, stop_gradient=True):
     idx = jnp.asarray(_val(indices), jnp.int64)
-    vals = _val(values)
+    vals = values if isinstance(values, Tensor) else \
+        Tensor(jnp.asarray(_val(values)), _internal=True)
     if dtype is not None:
         vals = vals.astype(dtype)
     if idx.ndim != 2:
         raise ValueError("indices must be [sparse_dim, nnz]")
     if shape is None:
         shape = tuple(int(i) for i in np.asarray(idx.max(axis=1)) + 1)
-    bcoo = jsparse.BCOO((vals, idx.T), shape=tuple(shape))
-    return SparseCooTensor(bcoo)
+    return SparseCooTensor._make(vals, idx.T, tuple(shape))
 
 
 def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
                       stop_gradient=True):
-    vals = _val(values)
+    vals = values if isinstance(values, Tensor) else \
+        Tensor(jnp.asarray(_val(values)), _internal=True)
     if dtype is not None:
         vals = vals.astype(dtype)
     return SparseCsrTensor(crows, cols, vals, shape)
@@ -154,7 +196,7 @@ def is_same_shape(x, y) -> bool:
     return list(x.shape) == list(y.shape)
 
 
-# -- ops (phi/kernels/sparse parity subset) ----------------------------------
+# -- ops (phi/kernels/sparse parity) -----------------------------------------
 
 def _coerce_coo(x):
     if isinstance(x, SparseCsrTensor):
@@ -165,78 +207,82 @@ def _coerce_coo(x):
 def add(x, y, name=None):
     x, y = _coerce_coo(x), _coerce_coo(y)
     if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
-        idx = jnp.concatenate([x._bcoo.indices, y._bcoo.indices], axis=0)
-        data = jnp.concatenate([x._bcoo.data, y._bcoo.data], axis=0)
-        out = jsparse.BCOO((data, idx), shape=x._bcoo.shape).sum_duplicates()
-        return SparseCooTensor(out)
-    dense = _val(y if isinstance(x, SparseCooTensor) else x)
+        idx = np.concatenate([np.asarray(x._bcoo.indices),
+                              np.asarray(y._bcoo.indices)], axis=0)
+        uniq, inv = np.unique(idx, axis=0, return_inverse=True)
+        inv_j, n = jnp.asarray(inv), len(uniq)
+        out_t = _apply(
+            lambda a, b: jax.ops.segment_sum(
+                jnp.concatenate([a, b], axis=0), inv_j, num_segments=n),
+            "sparse_add", (x.values(), y.values()))
+        return SparseCooTensor._make(out_t, uniq, x._bcoo.shape)
+    dense = y if isinstance(x, SparseCooTensor) else x
     sp = x if isinstance(x, SparseCooTensor) else y
-    return Tensor(sp._bcoo.todense() + dense, _internal=True)
+    dense = dense if isinstance(dense, Tensor) else \
+        Tensor(jnp.asarray(_val(dense)), _internal=True)
+    return sp.to_dense() + dense
 
 
 def multiply(x, y, name=None):
     x = _coerce_coo(x)
     if isinstance(y, (SparseCooTensor, SparseCsrTensor)):
         y = _coerce_coo(y).to_dense()
-    yv = _val(y)
-    # elementwise multiply only touches stored values
-    gathered = yv[tuple(x._bcoo.indices[:, d]
-                        for d in range(x._bcoo.indices.shape[1]))] \
-        if yv.ndim else yv
-    return SparseCooTensor(jsparse.BCOO((x._bcoo.data * gathered,
-                                         x._bcoo.indices),
-                                        shape=x._bcoo.shape))
+    y = y if isinstance(y, Tensor) else \
+        Tensor(jnp.asarray(_val(y)), _internal=True)
+    idx = x._bcoo.indices
+    nsp = idx.shape[1]
+
+    def mul(v, d):
+        gathered = d[tuple(idx[:, k] for k in range(nsp))] if d.ndim else d
+        return v * gathered
+
+    out_t = _apply(mul, "sparse_multiply", (x.values(), y))
+    return SparseCooTensor._make(out_t, idx, x._bcoo.shape)
 
 
 def matmul(x, y, name=None):
     """sparse @ dense → dense (phi sparse matmul kernels)."""
     x = _coerce_coo(x)
-    yv = _val(y)
-    out = x._bcoo @ yv
-    return Tensor(out, _internal=True)
+    idx, shape = x._bcoo.indices, x._bcoo.shape
+    y = y if isinstance(y, Tensor) else \
+        Tensor(jnp.asarray(_val(y)), _internal=True)
+    return _apply(
+        lambda v, d: jsparse.BCOO((v, idx), shape=shape) @ d,
+        "sparse_matmul", (x.values(), y))
 
 
 def masked_matmul(x, y, mask, name=None):
     """dense @ dense sampled at mask's sparsity (SDDMM)."""
-    xv, yv = _val(x), _val(y)
     mask = _coerce_coo(mask)
     idx = mask._bcoo.indices
     rows, cols = idx[:, 0], idx[:, 1]
-    vals = jnp.einsum("nk,nk->n", xv[rows, :], yv[:, cols].T)
-    return SparseCooTensor(jsparse.BCOO((vals, idx), shape=mask._bcoo.shape))
-
-
-def relu(x, name=None):
-    x = _coerce_coo(x)
-    return SparseCooTensor(jsparse.BCOO((jnp.maximum(x._bcoo.data, 0),
-                                         x._bcoo.indices),
-                                        shape=x._bcoo.shape))
+    x = x if isinstance(x, Tensor) else \
+        Tensor(jnp.asarray(_val(x)), _internal=True)
+    y = y if isinstance(y, Tensor) else \
+        Tensor(jnp.asarray(_val(y)), _internal=True)
+    out_t = _apply(
+        lambda a, b: jnp.einsum("nk,nk->n", a[rows, :], b[:, cols].T),
+        "sparse_masked_matmul", (x, y))
+    return SparseCooTensor._make(out_t, idx, mask._bcoo.shape)
 
 
 def transpose(x, perm, name=None):
     x = _coerce_coo(x)
-    idx = x._bcoo.indices[:, jnp.asarray(perm)]
+    idx = np.asarray(x._bcoo.indices)[:, list(perm)]
     shape = tuple(x._bcoo.shape[p] for p in perm)
-    return SparseCooTensor(jsparse.BCOO((x._bcoo.data, idx), shape=shape))
-
-
-class nn:
-    """paddle.sparse.nn subset: ReLU layer."""
-
-    class ReLU:
-        def __call__(self, x):
-            return relu(x)
+    return SparseCooTensor._make(x.values(), idx, shape)
 
 
 # -- value-wise unary family (sparse_ops.yaml: abs/sin/.../sqrt applied to
 # stored values only, zero-preserving by construction) ------------------------
 
-def _valuewise(fn):
+def _valuewise(fn, opname=None):
+    op_label = opname or f"sparse_{getattr(fn, '__name__', 'valuewise')}"
+
     def op(x, name=None):
         x = _coerce_coo(x)
-        return SparseCooTensor(jsparse.BCOO((fn(x._bcoo.data),
-                                             x._bcoo.indices),
-                                            shape=x._bcoo.shape))
+        out_t = _apply(fn, op_label, (x.values(),))
+        return SparseCooTensor._make(out_t, x._bcoo.indices, x._bcoo.shape)
     return op
 
 
@@ -249,64 +295,72 @@ tan = _valuewise(jnp.tan)
 tanh = _valuewise(jnp.tanh)
 atan = _valuewise(jnp.arctan)
 atanh = _valuewise(jnp.arctanh)
+acos = _valuewise(jnp.arccos)
+acosh = _valuewise(jnp.arccosh)
 sqrt = _valuewise(jnp.sqrt)
 square = _valuewise(jnp.square)
 log1p = _valuewise(jnp.log1p)
 expm1 = _valuewise(jnp.expm1)
-relu6 = _valuewise(lambda v: jnp.clip(v, 0, 6))
+relu = _valuewise(lambda v: jnp.maximum(v, 0), "sparse_relu")
+relu6 = _valuewise(lambda v: jnp.clip(v, 0, 6), "sparse_relu6")
 
 
 def leaky_relu(x, negative_slope=0.01, name=None):
-    return _valuewise(lambda v: jnp.where(v > 0, v,
-                                          negative_slope * v))(x)
+    return _valuewise(lambda v: jnp.where(v > 0, v, negative_slope * v),
+                      "sparse_leaky_relu")(x)
 
 
 def pow(x, factor, name=None):  # noqa: A001
-    return _valuewise(lambda v: v ** factor)(x)
+    return _valuewise(lambda v: v ** factor, "sparse_pow")(x)
 
 
 def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, name=None):
     # bias on a sparse tensor only touches stored values (yaml scale op)
     return _valuewise(lambda v: v * scale + bias if bias_after_scale
-                      else (v + bias) * scale)(x)
+                      else (v + bias) * scale, "sparse_scale")(x)
 
 
 def cast(x, index_dtype=None, value_dtype=None, name=None):
     x = _coerce_coo(x)
     idx = x._bcoo.indices.astype(index_dtype) if index_dtype else \
         x._bcoo.indices
-    data = x._bcoo.data.astype(value_dtype) if value_dtype else x._bcoo.data
-    return SparseCooTensor(jsparse.BCOO((data, idx), shape=x._bcoo.shape))
+    vals = x.values()
+    if value_dtype:
+        vals = vals.astype(value_dtype)
+    return SparseCooTensor._make(vals, idx, x._bcoo.shape)
 
 
 def subtract(x, y, name=None):
-    return add(x, scale(_coerce_coo(y), -1.0)
-               if isinstance(y, (SparseCooTensor, SparseCsrTensor))
-               else Tensor(-_val(y), _internal=True))
+    if isinstance(y, (SparseCooTensor, SparseCsrTensor)):
+        return add(x, scale(_coerce_coo(y), -1.0))
+    y = y if isinstance(y, Tensor) else \
+        Tensor(jnp.asarray(_val(y)), _internal=True)
+    return add(x, -y)
 
 
 def divide(x, y, name=None):
     if isinstance(y, (SparseCooTensor, SparseCsrTensor)):
         raise ValueError("sparse/sparse divide is undefined off the "
                          "intersection; densify first")
-    return multiply(x, Tensor(1.0 / _val(y), _internal=True))
+    y = y if isinstance(y, Tensor) else \
+        Tensor(jnp.asarray(_val(y)), _internal=True)
+    return multiply(x, 1.0 / y)
 
 
 def divide_scalar(x, scalar, name=None):
-    return _valuewise(lambda v: v / scalar)(x)
+    return _valuewise(lambda v: v / scalar, "sparse_divide_scalar")(x)
 
 
 def mv(x, vec, name=None):
     """sparse matrix @ dense vector (sparse_ops.yaml mv)."""
-    x = _coerce_coo(x)
-    return Tensor(x._bcoo @ _val(vec), _internal=True)
+    return matmul(x, vec)
 
 
 def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):  # noqa: A002
     """beta*input + alpha*(sparse x @ dense y)."""
-    x = _coerce_coo(x)
-    return Tensor(beta * _val(input) + alpha * (x._bcoo @ _val(y)),
-                  _internal=True)
+    input = input if isinstance(input, Tensor) else \
+        Tensor(jnp.asarray(_val(input)), _internal=True)
+    return beta * input + alpha * matmul(x, y)
 
 
 def softmax(x, axis=-1, name=None):
@@ -317,14 +371,327 @@ def softmax(x, axis=-1, name=None):
         raise ValueError("sparse softmax supports the last axis only")
     csr = SparseCsrTensor.from_coo(_coerce_coo(x)) \
         if isinstance(x, SparseCooTensor) else x
-    import numpy as _np
-    crows = _np.asarray(csr._crows)
-    counts = _np.diff(crows)
-    row_ids = jnp.asarray(_np.repeat(_np.arange(len(counts)), counts))
-    vals = csr._values
+    crows = np.asarray(csr._crows)
+    counts = np.diff(crows)
+    row_ids = jnp.asarray(np.repeat(np.arange(len(counts)), counts))
     nrows = len(counts)
-    row_max = jax.ops.segment_max(vals, row_ids, num_segments=nrows)
-    e = jnp.exp(vals - row_max[row_ids])
-    row_sum = jax.ops.segment_sum(e, row_ids, num_segments=nrows)
-    out = e / row_sum[row_ids]
-    return SparseCsrTensor(csr._crows, csr._cols, out, csr.shape)
+
+    def smax(v):
+        row_max = jax.ops.segment_max(v, row_ids, num_segments=nrows)
+        e = jnp.exp(v - row_max[row_ids])
+        row_sum = jax.ops.segment_sum(e, row_ids, num_segments=nrows)
+        return e / row_sum[row_ids]
+
+    out_t = _apply(smax, "sparse_softmax", (csr.values(),))
+    return SparseCsrTensor(csr._crows, csr._cols, out_t, csr.shape)
+
+
+def to_dense(x, name=None):
+    return x.to_dense()
+
+
+def to_sparse_coo(x, sparse_dim=2, name=None):
+    if isinstance(x, SparseCsrTensor):
+        return x.to_sparse_coo(sparse_dim)
+    if isinstance(x, SparseCooTensor):
+        return x
+    xv = _val(x)
+    idx = np.argwhere(np.asarray(xv) != 0)
+    x_t = x if isinstance(x, Tensor) else Tensor(xv, _internal=True)
+    vals_t = _apply(
+        lambda d: d[tuple(jnp.asarray(idx[:, k]) for k in range(idx.shape[1]))],
+        "sparse_from_dense", (x_t,))
+    return SparseCooTensor._make(vals_t, idx, xv.shape)
+
+
+def to_sparse_csr(x, name=None):
+    if isinstance(x, SparseCooTensor):
+        return x.to_sparse_csr()
+    if isinstance(x, SparseCsrTensor):
+        return x
+    return to_sparse_coo(x).to_sparse_csr()
+
+
+def values(x, name=None):
+    return x.values()
+
+
+def coalesce(x, name=None):
+    return _coerce_coo(x).coalesce()
+
+
+def full_like(x, value, dtype=None, name=None):
+    """coo_full_like/csr_full_like: same sparsity, constant stored values."""
+    if isinstance(x, SparseCsrTensor):
+        vals = jnp.full((x.nnz(),), value, dtype or x._vt._value.dtype)
+        return SparseCsrTensor(x._crows, x._cols, vals, x.shape)
+    x = _coerce_coo(x)
+    vals = Tensor(jnp.full(x._bcoo.data.shape, value,
+                           dtype or x._bcoo.data.dtype), _internal=True)
+    return SparseCooTensor._make(vals, x._bcoo.indices, x._bcoo.shape)
+
+
+# -- sparse 3-D conv / pooling (sparse_ops.yaml conv3d:83, maxpool:349) ------
+#
+# The reference builds a gather-scatter "rulebook" on device
+# (phi/kernels/sparse/gpu/conv.cu).  Eager sparse indices here are concrete
+# host data, so the rulebook is built VECTORIZED on host (per-offset numpy
+# candidate generation + one np.unique / sorted-match), memoized per
+# (sparsity pattern, geometry), and the VALUE compute — the FLOPs — runs
+# as one gather+einsum+segment_sum per call through apply_op, which keeps
+# dense `kernel` (and the sparse input values) on the autograd tape.
+
+def _to3(v):
+    return (v, v, v) if isinstance(v, (int, np.integer)) else tuple(v)
+
+
+_RULEBOOK_CACHE: dict = {}
+
+
+def _match_rows(table, queries):
+    """For each query row, index into `table` (or -1).  Both [n, k] int."""
+    if len(table) == 0 or len(queries) == 0:
+        return np.full(len(queries), -1, np.int64)
+    dt = np.dtype((np.void, table.dtype.itemsize * table.shape[1]))
+    t = np.ascontiguousarray(table).view(dt).ravel()
+    q = np.ascontiguousarray(queries).view(dt).ravel()
+    order = np.argsort(t)
+    pos = np.searchsorted(t[order], q)
+    pos = np.clip(pos, 0, len(t) - 1)
+    hit = t[order[pos]] == q
+    return np.where(hit, order[pos], -1)
+
+
+def _build_rulebook(idx, spatial, ksize, pads, dils, strs, subm):
+    """idx: [nnz, 4] (batch, z, y, x) host ints.  Returns (pairs_in,
+    pairs_out, pairs_off, out_idx, out_spatial)."""
+    key = (idx.tobytes(), idx.shape, tuple(spatial), tuple(ksize),
+           tuple(pads), tuple(dils), tuple(strs), bool(subm))
+    hit = _RULEBOOK_CACHE.get(key)
+    if hit is not None:
+        return hit
+    idx = np.asarray(idx)
+    pads_a, dils_a, strs_a = map(np.asarray, (pads, dils, strs))
+    if subm:
+        out_spatial = tuple(spatial)
+    else:
+        out_spatial = tuple(
+            (spatial[d] + 2 * pads_a[d] - dils_a[d] * (ksize[d] - 1) - 1)
+            // strs_a[d] + 1 for d in range(3))
+    cand_in, cand_coord, cand_off = [], [], []
+    oid = 0
+    for oz in range(ksize[0]):
+        for oy in range(ksize[1]):
+            for ox in range(ksize[2]):
+                off = np.array([oz, oy, ox])
+                num = idx[:, 1:] + pads_a - off * dils_a
+                ok = (num % strs_a == 0).all(axis=1)
+                out_sp = num // strs_a
+                ok &= (out_sp >= 0).all(axis=1)
+                ok &= (out_sp < np.asarray(out_spatial)).all(axis=1)
+                ii = np.nonzero(ok)[0]
+                cand_in.append(ii)
+                cand_coord.append(
+                    np.concatenate([idx[ii, :1], out_sp[ii]], axis=1))
+                cand_off.append(np.full(len(ii), oid, np.int64))
+                oid += 1
+    pin = np.concatenate(cand_in) if cand_in else np.zeros(0, np.int64)
+    coords = np.concatenate(cand_coord) if cand_coord else \
+        np.zeros((0, 4), np.int64)
+    poff = np.concatenate(cand_off) if cand_off else np.zeros(0, np.int64)
+    if subm:
+        pout = _match_rows(idx, coords)
+        keep = pout >= 0
+        pin, pout, poff = pin[keep], pout[keep], poff[keep]
+        out_idx = idx
+    elif len(coords):
+        out_idx, pout = np.unique(coords, axis=0, return_inverse=True)
+    else:
+        out_idx = np.zeros((0, 4), np.int64)
+        pout = np.zeros(0, np.int64)
+    result = (pin.astype(np.int64), np.asarray(pout, np.int64).ravel(),
+              poff, np.asarray(out_idx, np.int64).reshape(-1, 4),
+              out_spatial)
+    if len(_RULEBOOK_CACHE) > 64:
+        _RULEBOOK_CACHE.clear()
+    _RULEBOOK_CACHE[key] = result
+    return result
+
+
+def _check_conv_args(data_format, groups=1, ceil_mode=False):
+    if data_format != "NDHWC":
+        raise NotImplementedError(
+            f"sparse conv/pool supports data_format='NDHWC' only "
+            f"(got {data_format!r}); permute with sparse.transpose")
+    if groups != 1:
+        raise NotImplementedError("sparse conv3d groups>1")
+    if ceil_mode:
+        raise NotImplementedError("sparse max_pool3d ceil_mode=True")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NDHWC", name=None, subm=False):
+    """Sparse 3-D convolution over COO input [N, D, H, W, C]
+    (sparse_ops.yaml conv3d:83; kernels phi/kernels/sparse/conv.h).
+    `subm=True` is the submanifold variant (output sparsity == input
+    sparsity).  Rulebook on host, value compute through apply_op so
+    input-value, `weight` and `bias` gradients all flow."""
+    _check_conv_args(data_format, groups)
+    x = _coerce_coo(x)
+    kshape = tuple(int(s) for s in (_val(weight)).shape)  # [kd,kh,kw,Ci,Co]
+    kd, kh, kw, ci, co = kshape
+    pin, pout, poff, out_idx, out_spatial = _build_rulebook(
+        np.asarray(x._bcoo.indices), tuple(x.shape[1:4]), (kd, kh, kw),
+        _to3(padding), _to3(dilation), _to3(stride), subm)
+    n_out = len(out_idx)
+    pin_j, pout_j, poff_j = map(jnp.asarray, (pin, pout, poff))
+    weight = weight if isinstance(weight, Tensor) else \
+        Tensor(jnp.asarray(_val(weight)), _internal=True)
+
+    def compute(vals, w, b):
+        w2 = w.reshape(kd * kh * kw, ci, co)
+        contrib = jnp.einsum("pi,pio->po", vals[pin_j], w2[poff_j])
+        out = jax.ops.segment_sum(contrib, pout_j, num_segments=n_out)
+        if b is not None:
+            out = out + b
+        return out
+
+    out_t = _apply(compute, "sparse_conv3d", (x.values(), weight, bias))
+    shape = (x.shape[0], *out_spatial, co)
+    return SparseCooTensor._make(out_t, out_idx, shape)
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NDHWC", name=None):
+    return conv3d(x, weight, bias, stride, padding, dilation, groups,
+                  data_format, name, subm=True)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               data_format="NDHWC", name=None):
+    """Sparse max pooling over COO input (sparse_ops.yaml maxpool:349;
+    phi/kernels/sparse/pool.h): max over each output site's contributing
+    input sites, per channel — implicit zeros never participate."""
+    _check_conv_args(data_format, ceil_mode=ceil_mode)
+    x = _coerce_coo(x)
+    ks = _to3(kernel_size)
+    st = _to3(stride if stride is not None else kernel_size)
+    pin, pout, poff, out_idx, out_spatial = _build_rulebook(
+        np.asarray(x._bcoo.indices), tuple(x.shape[1:4]), ks,
+        _to3(padding), (1, 1, 1), st, subm=False)
+    n_out = len(out_idx)
+    pin_j, pout_j = jnp.asarray(pin), jnp.asarray(pout)
+    out_t = _apply(
+        lambda v: jax.ops.segment_max(v[pin_j], pout_j, num_segments=n_out),
+        "sparse_max_pool3d", (x.values(),))
+    shape = (x.shape[0], *out_spatial, x.shape[-1])
+    return SparseCooTensor._make(out_t, out_idx, shape)
+
+
+def fused_attention(query, key, value, sparse_mask, key_padding_mask=None,
+                    attn_mask=None, name=None):
+    """sparse_ops.yaml fused_attention:319 (fused_attention_csr kernel):
+    scores computed ONLY at sparse_mask's nonzero positions (SDDMM), sparse
+    row softmax, then SpMM with value.  q/k/v: [B, nh, M, hd] dense;
+    sparse_mask: [B*nh, M, M] sparse COO, or a 2-D [M, M] mask broadcast
+    over every batch-head.  Returns dense out [B, nh, M, hd].  Mask indices
+    are static; the value compute runs through apply_op so q/k/v gradients
+    flow."""
+    qv = _val(query)
+    b, nh, m, hd = qv.shape
+    mask = sparse_mask
+    if isinstance(mask, SparseCsrTensor):
+        mask = mask.to_sparse_coo()
+    midx = np.asarray(mask._bcoo.indices)
+    if midx.shape[1] == 2:
+        # 2-D [M, M] mask: broadcast the same pattern to every batch-head
+        nnz = len(midx)
+        midx = np.concatenate([
+            np.repeat(np.arange(b * nh), nnz)[:, None],
+            np.tile(midx, (b * nh, 1))], axis=1)
+    bh_np, row_np, col_np = midx[:, 0], midx[:, 1], midx[:, 2]
+    seg_np = bh_np * m + row_np
+    bh, row, col, seg = map(jnp.asarray, (bh_np, row_np, col_np, seg_np))
+    nseg = b * nh * m
+
+    def compute(q, k, v, kpm, am):
+        qf = q.reshape(b * nh, m, hd)
+        kf = k.reshape(b * nh, m, hd)
+        vf = v.reshape(b * nh, m, hd)
+        scores = jnp.einsum("ph,ph->p", qf[bh, row], kf[bh, col]) \
+            / jnp.sqrt(jnp.asarray(hd, qf.dtype))
+        if kpm is not None:   # [B, M] additive mask keyed by key position
+            scores = scores + kpm.reshape(b, m)[bh // nh, col]
+        if am is not None:    # [M, M] additive
+            scores = scores + am[row, col]
+        smax = jax.ops.segment_max(scores, seg, num_segments=nseg)
+        e = jnp.exp(scores - smax[seg])
+        ssum = jax.ops.segment_sum(e, seg, num_segments=nseg)
+        p = e / jnp.maximum(ssum[seg], 1e-38)
+        out = jax.ops.segment_sum(p[:, None] * vf[bh, col], seg,
+                                  num_segments=nseg)
+        return out.reshape(b, nh, m, hd)
+
+    return _apply(compute, "sparse_fused_attention",
+                  (query, key, value, key_padding_mask, attn_mask))
+
+
+# -- paddle.sparse.nn --------------------------------------------------------
+
+from ..nn.layer_base import Layer as _Layer  # noqa: E402
+
+
+class Conv3D(_Layer):
+    """paddle.sparse.nn.Conv3D (reference incubate/sparse/nn/layer/conv.py):
+    kernel [kd, kh, kw, Ci, Co] parameter over sparse NDHWC input."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, subm=False,
+                 data_format="NDHWC", weight_attr=None, bias_attr=None):
+        super().__init__()
+        kd, kh, kw = _to3(kernel_size)
+        self.weight = self.create_parameter(
+            [kd, kh, kw, in_channels, out_channels], attr=weight_attr)
+        self.bias = self.create_parameter([out_channels], attr=bias_attr,
+                                          is_bias=True)
+        self._args = (stride, padding, dilation, groups, subm, data_format)
+
+    def forward(self, x):
+        stride, padding, dilation, groups, subm, fmt = self._args
+        return conv3d(x, self.weight, self.bias, stride, padding,
+                      dilation, groups, data_format=fmt, subm=subm)
+
+
+class SubmConv3D(Conv3D):
+    def __init__(self, *args, **kwargs):
+        kwargs["subm"] = True
+        super().__init__(*args, **kwargs)
+
+
+class MaxPool3D:
+    def __init__(self, kernel_size, stride=None, padding=0):
+        self._args = (kernel_size, stride, padding)
+
+    def __call__(self, x):
+        return max_pool3d(x, *self._args)
+
+
+class _ReLULayer:
+    def __call__(self, x):
+        return relu(x)
+
+
+class nn:
+    """paddle.sparse.nn subset."""
+    ReLU = _ReLULayer
+    Conv3D = Conv3D
+    SubmConv3D = SubmConv3D
+    MaxPool3D = MaxPool3D
+    functional = type("functional", (), {
+        "relu": staticmethod(relu),
+        "conv3d": staticmethod(conv3d),
+        "subm_conv3d": staticmethod(subm_conv3d),
+        "max_pool3d": staticmethod(max_pool3d),
+        "attention": staticmethod(fused_attention),
+        "softmax": staticmethod(softmax),
+    })

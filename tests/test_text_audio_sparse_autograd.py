@@ -271,3 +271,226 @@ def test_strings_ops():
     e = strings.empty((2, 2))
     assert e.shape == (2, 2) and e.tolist() == [["", ""], ["", ""]]
     assert strings.empty_like(st).shape == st.shape
+
+
+def test_sparse_conv3d_matches_dense():
+    """sparse_ops.yaml conv3d:83 — gather/scatter rulebook conv equals a
+    dense lax conv on the densified input at every output coordinate."""
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu.sparse as sparse
+
+    rng = np.random.RandomState(0)
+    N, D, H, W, C, CO = 2, 5, 6, 4, 3, 7
+    nnz = 25
+    coords = np.unique(
+        np.stack([rng.randint(0, N, nnz), rng.randint(0, D, nnz),
+                  rng.randint(0, H, nnz), rng.randint(0, W, nnz)], 1), axis=0)
+    vals = rng.standard_normal((len(coords), C)).astype(np.float32)
+    x = sparse.sparse_coo_tensor(coords.T, vals, shape=[N, D, H, W, C])
+    w = paddle.to_tensor(
+        rng.standard_normal((3, 3, 3, C, CO)).astype(np.float32) * 0.3)
+    b = paddle.to_tensor(rng.standard_normal(CO).astype(np.float32))
+
+    out = sparse.conv3d(x, w, b, stride=1, padding=1)
+    dense_in = np.asarray(x.to_dense().numpy())
+    ref = jax.lax.conv_general_dilated(
+        jnp.asarray(dense_in), jnp.asarray(w.numpy()),
+        window_strides=(1, 1, 1), padding=[(1, 1)] * 3,
+        dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+    ref = np.asarray(ref) + b.numpy()
+    out_idx = np.asarray(out._bcoo.indices)
+    out_vals = np.asarray(out._bcoo.data)
+    for row, v in zip(out_idx, out_vals):
+        np.testing.assert_allclose(
+            v, ref[row[0], row[1], row[2], row[3]], rtol=1e-4, atol=1e-5)
+    # every nonzero of the dense conv appears in the sparse output's sites
+    # reachable from inputs; bias makes absent sites differ by exactly b
+
+    # kernel gradients flow (the value compute rides apply_op)
+    w2 = paddle.to_tensor(
+        rng.standard_normal((3, 3, 3, C, CO)).astype(np.float32) * 0.3)
+    w2.stop_gradient = False
+    out2 = sparse.conv3d(x, w2, None, padding=1)
+    # the PUBLIC surface keeps the tape: relu(conv).values() must backprop
+    sparse.relu(out2).values().sum().backward()
+    assert w2.grad is not None
+    assert float(np.abs(w2.grad.numpy()).sum()) > 0
+
+
+def test_sparse_subm_conv3d_preserves_sparsity():
+    import paddle_tpu.sparse as sparse
+
+    rng = np.random.RandomState(1)
+    coords = np.unique(np.stack([np.zeros(10, int),
+                                 rng.randint(0, 4, 10),
+                                 rng.randint(0, 4, 10),
+                                 rng.randint(0, 4, 10)], 1), axis=0)
+    vals = rng.standard_normal((len(coords), 2)).astype(np.float32)
+    x = sparse.sparse_coo_tensor(coords.T, vals, shape=[1, 4, 4, 4, 2])
+    w = paddle.to_tensor(rng.standard_normal((3, 3, 3, 2, 5)).astype(np.float32))
+    out = sparse.subm_conv3d(x, w, padding=1)
+    assert sorted(map(tuple, np.asarray(out._bcoo.indices))) == \
+        sorted(map(tuple, coords))
+    assert out.shape == [1, 4, 4, 4, 5]
+
+    layer = sparse.nn.SubmConv3D(2, 5, 3, padding=1)
+    out2 = layer(x)
+    assert out2.shape == [1, 4, 4, 4, 5]
+
+
+def test_sparse_max_pool3d_matches_dense_over_present_sites():
+    """sparse maxpool maxes only over PRESENT inputs (implicit zeros never
+    participate) — equals dense maxpool with -inf at absent positions."""
+    import paddle_tpu.sparse as sparse
+
+    rng = np.random.RandomState(2)
+    coords = np.unique(np.stack([np.zeros(14, int),
+                                 rng.randint(0, 4, 14),
+                                 rng.randint(0, 6, 14),
+                                 rng.randint(0, 6, 14)], 1), axis=0)
+    vals = rng.standard_normal((len(coords), 3)).astype(np.float32)
+    x = sparse.sparse_coo_tensor(coords.T, vals, shape=[1, 4, 6, 6, 3])
+    out = sparse.max_pool3d(x, kernel_size=2, stride=2)
+    assert out.shape == [1, 2, 3, 3, 3]
+
+    dense = np.full((1, 4, 6, 6, 3), -np.inf, np.float32)
+    for c, v in zip(coords, vals):
+        dense[tuple(c)] = v
+    for row, v in zip(np.asarray(out._bcoo.indices),
+                      np.asarray(out._bcoo.data)):
+        n, z, y, xx = row
+        window = dense[n, 2*z:2*z+2, 2*y:2*y+2, 2*xx:2*xx+2]
+        np.testing.assert_allclose(v, window.reshape(-1, 3).max(axis=0),
+                                   rtol=1e-6)
+
+
+def test_sparse_fused_attention_matches_dense_and_grads():
+    """sparse_ops.yaml fused_attention:319: scores at mask nonzeros only ==
+    dense attention with -inf off-mask; q/k/v gradients flow."""
+    import paddle_tpu.sparse as sparse
+
+    rng = np.random.RandomState(3)
+    B, NH, M, HD = 2, 2, 6, 4
+    q = paddle.to_tensor(rng.standard_normal((B, NH, M, HD)).astype(np.float32))
+    k = paddle.to_tensor(rng.standard_normal((B, NH, M, HD)).astype(np.float32))
+    v = paddle.to_tensor(rng.standard_normal((B, NH, M, HD)).astype(np.float32))
+    for t in (q, k, v):
+        t.stop_gradient = False
+    # random mask with every row non-empty (diagonal guaranteed)
+    mask_d = (rng.uniform(size=(B * NH, M, M)) < 0.4)
+    mask_d |= np.eye(M, dtype=bool)[None]
+    idx = np.argwhere(mask_d)       # [nnz, 3]
+    m = sparse.sparse_coo_tensor(idx.T, np.ones(len(idx), np.float32),
+                                 shape=[B * NH, M, M])
+    out = sparse.fused_attention(q, k, v, m)
+    assert list(out.shape) == [B, NH, M, HD]
+
+    qf = q.numpy().reshape(B * NH, M, HD)
+    kf = k.numpy().reshape(B * NH, M, HD)
+    vf = v.numpy().reshape(B * NH, M, HD)
+    scores = qf @ kf.transpose(0, 2, 1) / np.sqrt(HD)
+    scores = np.where(mask_d, scores, -np.inf)
+    p = np.exp(scores - scores.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    ref = (p @ vf).reshape(B, NH, M, HD)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+    out.sum().backward()
+    for name, t in (("q", q), ("k", k), ("v", v)):
+        assert t.grad is not None, name
+        assert float(np.abs(t.grad.numpy()).sum()) > 0, name
+
+
+def test_sparse_misc_ops_round4():
+    import paddle_tpu.sparse as sparse
+
+    s = sparse.sparse_coo_tensor(np.array([[0, 1], [1, 0]]),
+                                 np.array([0.5, -0.25], np.float32),
+                                 shape=[2, 2])
+    fl = sparse.full_like(s, 3.0)
+    assert np.allclose(np.asarray(fl.values().numpy()), [3.0, 3.0])
+    assert np.allclose(sparse.acos(s).values().numpy(),
+                       np.arccos([0.5, -0.25]), rtol=1e-6)
+    d = sparse.to_dense(s)
+    assert d.shape == [2, 2]
+    coo = sparse.to_sparse_coo(d)
+    assert coo.nnz() == 2
+    csr = sparse.to_sparse_csr(s)
+    assert sparse.values(csr).shape[0] == 2
+    assert sparse.coalesce(s).nnz() == 2
+
+
+def test_sparse_public_surface_keeps_tape_and_handles_empty():
+    """Round-4 review: gradients must flow through the PUBLIC sparse
+    surface (values/relu/max_pool3d/to_dense compositions), and empty
+    inputs (nnz=0, a normal sparse-workload occurrence) must produce empty
+    sparse outputs instead of crashing."""
+    import paddle_tpu.sparse as sparse
+
+    rng = np.random.RandomState(5)
+    coords = np.unique(np.stack([np.zeros(12, int),
+                                 rng.randint(0, 4, 12),
+                                 rng.randint(0, 4, 12),
+                                 rng.randint(0, 4, 12)], 1), axis=0)
+    vals = rng.standard_normal((len(coords), 2)).astype(np.float32)
+    x = sparse.sparse_coo_tensor(coords.T, vals, shape=[1, 4, 4, 4, 2])
+    w = paddle.to_tensor(
+        rng.standard_normal((3, 3, 3, 2, 4)).astype(np.float32) * 0.3)
+    w.stop_gradient = False
+
+    # conv -> relu -> pool -> to_dense -> scalar: full public chain
+    out = sparse.conv3d(x, w, padding=1)
+    pooled = sparse.max_pool3d(sparse.relu(out), kernel_size=2, stride=2)
+    loss = pooled.to_dense().sum()
+    loss.backward()
+    assert w.grad is not None
+    assert float(np.abs(w.grad.numpy()).sum()) > 0
+
+    # sparse input VALUES get gradients too
+    xv = paddle.to_tensor(vals)
+    xv.stop_gradient = False
+    x2 = sparse.sparse_coo_tensor(coords.T, xv, shape=[1, 4, 4, 4, 2])
+    sparse.conv3d(x2, w, padding=1).values().sum().backward()
+    assert xv.grad is not None
+
+    # empty input: empty output, correct shapes, no crash
+    empty = sparse.sparse_coo_tensor(np.zeros((4, 0), np.int64),
+                                     np.zeros((0, 2), np.float32),
+                                     shape=[1, 4, 4, 4, 2])
+    eo = sparse.conv3d(empty, w, padding=1)
+    assert eo.nnz() == 0 and eo.shape == [1, 4, 4, 4, 4]
+    ep = sparse.max_pool3d(empty, 2, 2)
+    assert ep.nnz() == 0
+
+    # unsupported layouts raise instead of silently mis-indexing
+    import pytest as _pytest
+    with _pytest.raises(NotImplementedError):
+        sparse.conv3d(x, w, data_format="NCDHW")
+    with _pytest.raises(NotImplementedError):
+        sparse.max_pool3d(x, 2, ceil_mode=True)
+
+
+def test_sparse_fused_attention_2d_mask_broadcasts():
+    """Round-4 review: a 2-D [M, M] mask must broadcast over every
+    batch-head, not silently zero heads beyond the first."""
+    import paddle_tpu.sparse as sparse
+
+    rng = np.random.RandomState(6)
+    B, NH, M, HD = 2, 2, 4, 3
+    q = paddle.to_tensor(rng.standard_normal((B, NH, M, HD)).astype(np.float32))
+    k = paddle.to_tensor(rng.standard_normal((B, NH, M, HD)).astype(np.float32))
+    v = paddle.to_tensor(rng.standard_normal((B, NH, M, HD)).astype(np.float32))
+    mask2d = np.tril(np.ones((M, M), bool))           # causal
+    idx2 = np.argwhere(mask2d)
+    m2 = sparse.sparse_coo_tensor(idx2.T, np.ones(len(idx2), np.float32),
+                                  shape=[M, M])
+    out2 = sparse.fused_attention(q, k, v, m2)
+    # equivalent 3-D mask, explicit per batch-head
+    mask3d = np.broadcast_to(mask2d, (B * NH, M, M))
+    idx3 = np.argwhere(mask3d)
+    m3 = sparse.sparse_coo_tensor(idx3.T, np.ones(len(idx3), np.float32),
+                                  shape=[B * NH, M, M])
+    out3 = sparse.fused_attention(q, k, v, m3)
+    np.testing.assert_allclose(out2.numpy(), out3.numpy(), rtol=1e-5)
+    assert float(np.abs(out2.numpy()[:, 1:]).sum()) > 0  # heads 1+ nonzero

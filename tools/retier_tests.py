@@ -23,7 +23,8 @@ CUTOFF_S = 2.0
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def run_suite(outdir: str) -> None:
+def run_suite(outdir: str) -> list[str]:
+    timed_out = []
     for f in sorted(glob.glob(os.path.join(REPO, "tests", "test_*.py"))):
         base = os.path.basename(f)[:-3]
         log = os.path.join(outdir, base + ".log")
@@ -36,10 +37,15 @@ def run_suite(outdir: str) -> None:
                     cwd=REPO, stdout=fh, stderr=subprocess.STDOUT,
                     timeout=1800, check=False)
             except subprocess.TimeoutExpired:
-                # a hung file must not sink the whole measurement; its
-                # partial log still contributes whatever durations printed
-                print(base, "TIMED OUT (>1800s)", file=sys.stderr)
+                # pytest prints --durations only at session end, so a
+                # killed file contributes NO timings: remember it and keep
+                # its previous slow entries instead of silently re-tiering
+                # its (clearly slow) tests into the smoke gate
+                timed_out.append("tests/" + base + ".py")
+                print(base, "TIMED OUT (>1800s); keeping previous tier",
+                      file=sys.stderr)
         print(base, "done", file=sys.stderr)
+    return timed_out
 
 
 def collect(outdir: str):
@@ -53,13 +59,22 @@ def collect(outdir: str):
 
 
 def main():
+    timed_out: list[str] = []
     if "--from-logs" in sys.argv:
         outdir = sys.argv[sys.argv.index("--from-logs") + 1]
     else:
         outdir = tempfile.mkdtemp(prefix="retier_")
-        run_suite(outdir)
+        timed_out = run_suite(outdir)
     entries = collect(outdir)
-    bases = sorted({n.split("[")[0] for t, n in entries if t >= CUTOFF_S})
+    bases = {n.split("[")[0] for t, n in entries if t >= CUTOFF_S}
+    listing_prev = os.path.join(REPO, "tests", "slow_tests.txt")
+    if timed_out and os.path.exists(listing_prev):
+        for line in open(listing_prev):
+            line = line.strip()
+            if line and not line.startswith("#") and \
+                    any(line.startswith(f + "::") for f in timed_out):
+                bases.add(line)
+    bases = sorted(bases)
     listing = os.path.join(REPO, "tests", "slow_tests.txt")
     with open(listing, "w") as f:
         f.write("# Tests marked @slow by measured duration (>=2s call time "
