@@ -22,15 +22,21 @@ from ..core.tensor import Tensor
 from ..nn.layer_base import Layer
 
 __all__ = ["QuantConfig", "QAT", "PTQ", "FakeQuanterWithAbsMaxObserver",
-           "quant_dequant", "QuantedLinear", "QuantedConv2D"]
+           "HistObserver", "cal_kl_threshold", "quant_dequant",
+           "QuantedLinear", "QuantedConv2D"]
 
 
 # -- fake quant with straight-through estimator ------------------------------
 
-@jax.custom_vjp
-def _fake_quant(x, scale, qmax):
+def _qdq(x, scale, qmax):
+    """The one quantize-dequantize formula (scalar or per-channel scale)."""
     q = jnp.clip(jnp.round(x / scale * qmax), -qmax, qmax)
     return q * scale / qmax
+
+
+@jax.custom_vjp
+def _fake_quant(x, scale, qmax):
+    return _qdq(x, scale, qmax)
 
 
 def _fq_fwd(x, scale, qmax):
@@ -94,21 +100,25 @@ class _QuantedWrapper(Layer):
     """Wraps a Linear/Conv2D: fake-quant activation + weight, then run the
     original layer with the quantized weight."""
 
-    def __init__(self, inner, a_quanter=None, w_bits=8):
+    def __init__(self, inner, a_quanter=None, w_bits=8, w_per_channel=False):
         super().__init__()
         self.inner = inner
         self.activation_quanter = a_quanter
         self.w_bits = w_bits
+        self.w_per_channel = w_per_channel
 
     def _wq(self):
         w = self.inner.weight
         qmax = float(2 ** (self.w_bits - 1) - 1)
+        per_channel = self.w_per_channel
+        axis = _channel_axis(self.inner)
 
-        def raw(wv):
-            s = jnp.maximum(jnp.max(jnp.abs(wv)), 1e-8)
-            return _fake_quant(wv, s, qmax)
+        # STE at the wrapper level: quantization is identity for grads
+        def raw_ste(wv):
+            s = _weight_scales(wv, per_channel, axis)
+            return wv + jax.lax.stop_gradient(_qdq(wv, s, qmax) - wv)
 
-        return apply_op(raw, "weight_quantize", (w,), {})
+        return apply_op(raw_ste, "weight_quantize", (w,), {})
 
     def forward(self, x):
         if self.activation_quanter is not None:
@@ -136,14 +146,18 @@ class QuantConfig:
     weight quanter factories)."""
 
     def __init__(self, activation=None, weight=None, activation_bits=8,
-                 weight_bits=8):
+                 weight_bits=8, weight_quantize_type="abs_max"):
         self.activation = activation
         if weight is not None:
             raise NotImplementedError(
                 "custom weight quanters are not supported; weights use "
                 "abs-max fake quant at weight_bits precision")
+        if weight_quantize_type not in ("abs_max", "channel_wise_abs_max"):
+            raise ValueError(
+                f"unknown weight_quantize_type {weight_quantize_type!r}")
         self.activation_bits = activation_bits
         self.weight_bits = weight_bits
+        self.weight_quantize_type = weight_quantize_type
 
     def add_layer_config(self, *a, **kw):
         pass  # per-layer overrides not needed for the subset
@@ -189,7 +203,9 @@ class QAT:
         def factory(sub):
             cls = QuantedLinear if isinstance(sub, Linear) else QuantedConv2D
             return cls(sub, self.config._make_act_quanter(),
-                       w_bits=self.config.weight_bits)
+                       w_bits=self.config.weight_bits,
+                       w_per_channel=(self.config.weight_quantize_type ==
+                                      "channel_wise_abs_max"))
 
         return _swap_layers(model, factory)
 
@@ -202,22 +218,202 @@ class QAT:
             import copy
             model = copy.deepcopy(model)
         for layer in model.sublayers(include_self=True):
+            if isinstance(layer, HistObserver):
+                layer.finalize()      # histogram -> calibrated threshold
             if isinstance(layer, FakeQuanterWithAbsMaxObserver):
                 layer.observing = False
             if isinstance(layer, _QuantedWrapper):
                 qmax = float(2 ** (layer.w_bits - 1) - 1)
                 wv = layer.inner.weight._value
-                s = jnp.maximum(jnp.max(jnp.abs(wv)), 1e-8)
-                layer.inner.weight._replace_(
-                    jnp.clip(jnp.round(wv / s * qmax), -qmax, qmax) *
-                    s / qmax, None)
+                s = _weight_scales(wv, layer.w_per_channel,
+                                   _channel_axis(layer.inner))
+                layer.inner.weight._replace_(_qdq(wv, s, qmax), None)
         return model
 
 
 class PTQ(QAT):
     """Post-training quantization: quantize(), run calibration batches (any
-    train/eval mode — observers watch until convert), then convert()."""
+    train/eval mode — observers watch until convert), then convert().
 
-    # observers are `observing` from construction regardless of train/eval
-    # mode, so plain QAT.quantize already yields a calibratable PTQ model
-    pass
+    `algo` selects the activation calibrator (reference
+    post_training_quantization.py): 'kl' (default; cal_kl_threshold),
+    'hist' (percentile), 'mse', 'avg', 'abs_max'.  `weight_quantize_type`
+    'channel_wise_abs_max' enables per-output-channel weight scales."""
+
+    _DEFAULT_CAL = ("kl", 2048, 0.99999, "channel_wise_abs_max")
+
+    def __init__(self, config: QuantConfig | None = None, algo="kl",
+                 bins=2048, percent=0.99999,
+                 weight_quantize_type="channel_wise_abs_max"):
+        if config is not None:
+            if (algo, bins, percent, weight_quantize_type) != \
+                    self._DEFAULT_CAL:
+                raise ValueError(
+                    "pass EITHER an explicit QuantConfig or calibration "
+                    "kwargs (algo/bins/percent/weight_quantize_type), not "
+                    "both — the config would silently win")
+        else:
+            act = None if algo == "abs_max" else HistObserver(
+                algo=algo, bins=bins, percent=percent)
+            config = QuantConfig(
+                activation=act, weight_quantize_type=weight_quantize_type)
+        super().__init__(config)
+
+
+# -- PTQ calibration depth (round-4; reference slim/quantization:
+# post_training_quantization.py algos {KL, hist, mse, avg, abs_max} +
+# cal_kl_threshold.py, channel-wise weight quantization) ----------------------
+
+def cal_kl_threshold(hist, bin_width, bits=8):
+    """TensorRT-style KL calibration (reference cal_kl_threshold.py:75):
+    pick the clip threshold whose 2^(bits-1)-1-level quantized distribution
+    has minimum KL divergence from the clipped reference distribution.
+    `hist` bins |x| from 0 with width `bin_width`; returns the threshold."""
+    hist = np.asarray(hist, np.float64)
+    nbins = len(hist)
+    levels = 2 ** (bits - 1) - 1
+    # search from `levels` bins upward (TensorRT's original start): the
+    # reference starts at nbins/2, which can never clip below half the
+    # histogram range and so fails exactly when outliers inflate the range
+    csum = np.concatenate([[0.0], np.cumsum(hist)])
+    nzsum = np.concatenate([[0], np.cumsum(hist > 0)])
+    total = csum[-1]
+    best_i, best_kl = nbins, np.inf
+    for i in range(levels, nbins + 1):
+        tail = total - csum[i]
+        if hist[i - 1] == 0 and tail == 0:
+            continue
+        p = hist[:i].copy()
+        p[i - 1] += tail                    # fold outliers into the edge
+        # quantize the first i bins down to `levels` merged bins, then
+        # expand back, spreading each merged mass over its NONZERO source
+        # bins (all vectorized: cumsum differences + searchsorted)
+        edges = (np.arange(levels + 1) * i) // levels   # strictly increasing
+        merged = csum[edges[1:]] - csum[edges[:-1]]
+        nnz_per = (nzsum[edges[1:]] - nzsum[edges[:-1]]).astype(np.float64)
+        k_of_j = np.searchsorted(edges, np.arange(i), side="right") - 1
+        fill = np.divide(merged[k_of_j], nnz_per[k_of_j],
+                         out=np.zeros(i), where=nnz_per[k_of_j] > 0)
+        q = np.where(hist[:i] > 0, fill, 0.0)
+        psum, qsum = p.sum(), q.sum()
+        if psum == 0 or qsum == 0:
+            continue
+        p /= psum
+        q /= qsum
+        mask = (p > 0) & (q > 0)
+        kl = float(np.sum(p[mask] * np.log(p[mask] / q[mask])))
+        if kl < best_kl:
+            best_kl, best_i = kl, i
+    return best_i * bin_width
+
+
+class HistObserver(FakeQuanterWithAbsMaxObserver):
+    """Histogram-calibrated activation observer
+    (reference post_training_quantization.py algo= 'KL' | 'hist' | 'mse' |
+    'avg' | 'abs_max').  Accumulates an adaptive-range histogram of |x|
+    over calibration batches; ``finalize()`` (called by convert()) turns it
+    into the clip threshold:
+
+    * kl    — min-KL threshold (cal_kl_threshold)
+    * hist  — `percent` quantile of the histogram mass (reference 'hist')
+    * mse   — threshold minimizing simulated-quant MSE over the histogram
+    * avg   — mean of the per-batch abs-max values
+    * abs_max — global abs-max (same as the base observer)
+    """
+
+    def __init__(self, algo="kl", bins=2048, percent=0.99999, bit_length=8,
+                 name=None):
+        super().__init__(bit_length=bit_length)
+        if algo not in ("kl", "hist", "mse", "avg", "abs_max"):
+            raise ValueError(f"unknown PTQ algo {algo!r}")
+        self.algo = algo
+        self.bins = int(bins)
+        self.percent = float(percent)
+        self._hist = np.zeros(self.bins, np.float64)
+        self._range = 0.0
+        self._batch_maxes: list[float] = []
+        self._finalized = False
+
+    def _observe(self, av):
+        cur = float(av.max()) if av.size else 0.0
+        self._batch_maxes.append(cur)
+        if cur == 0.0:
+            return
+        if cur > self._range:
+            # grow the range: fold existing counts into coarser bins
+            if self._range > 0.0:
+                factor = int(np.ceil(cur / self._range))
+                folded = np.zeros(self.bins, np.float64)
+                idx = np.arange(self.bins) // factor
+                np.add.at(folded, idx, self._hist)
+                self._hist = folded
+                self._range *= factor
+            else:
+                self._range = cur
+        h, _ = np.histogram(av, bins=self.bins, range=(0.0, self._range))
+        self._hist += h
+        # running abs-max keeps fake-quant sane DURING calibration
+        self.scale._replace_(
+            jnp.asarray(max(float(np.asarray(self.scale._value))
+                            if self._seen else 0.0, cur), jnp.float32), None)
+        self._seen = True
+
+    def forward(self, x):
+        if self.observing:
+            if isinstance(x._value, jax.core.Tracer):
+                if not self._seen:
+                    import warnings
+                    warnings.warn(
+                        "quant observer ran only under jit: calibration "
+                        "needs eager forwards (scale stays at init)")
+            else:
+                self._observe(np.abs(np.asarray(x._value)).ravel())
+        return quant_dequant(x, self.scale, bits=self.bit_length)
+
+    def finalize(self):
+        """Compute the calibrated threshold and write it into `scale`."""
+        if self._finalized or not self._batch_maxes:
+            return
+        bw = self._range / self.bins if self._range else 1.0
+        if self.algo == "kl":
+            t = cal_kl_threshold(self._hist, bw, self.bit_length)
+        elif self.algo == "hist":
+            c = np.cumsum(self._hist)
+            total = c[-1] if c[-1] > 0 else 1.0
+            t = (np.searchsorted(c, self.percent * total) + 1) * bw
+        elif self.algo == "mse":
+            qmax = 2 ** (self.bit_length - 1) - 1
+            centers = (np.arange(self.bins) + 0.5) * bw
+            best_t, best_mse = self._range, np.inf
+            for i in range(max(1, self.bins // 256), self.bins + 1,
+                           max(1, self.bins // 256)):
+                t_c = i * bw
+                # quantize-with-clip: centers beyond t_c saturate at t_c,
+                # so the clipping error is part of the same expression
+                q = np.clip(np.round(centers / t_c * qmax), -qmax, qmax) \
+                    * t_c / qmax
+                mse = float(np.sum(self._hist * (q - centers) ** 2))
+                if mse < best_mse:
+                    best_mse, best_t = mse, t_c
+            t = best_t
+        elif self.algo == "avg":
+            t = float(np.mean(self._batch_maxes))
+        else:                     # abs_max
+            t = float(np.max(self._batch_maxes))
+        self.scale._replace_(jnp.asarray(max(t, 1e-8), jnp.float32), None)
+        self._finalized = True
+
+
+def _channel_axis(layer):
+    from ..nn.layer.common import Linear
+    return 1 if isinstance(layer, Linear) else 0   # conv: [out, in, kh, kw]
+
+
+def _weight_scales(wv, per_channel, axis):
+    if not per_channel:
+        return jnp.maximum(jnp.max(jnp.abs(wv)), 1e-8)
+    red = tuple(d for d in range(wv.ndim) if d != axis)
+    s = jnp.maximum(jnp.max(jnp.abs(wv), axis=red), 1e-8)
+    shape = [1] * wv.ndim
+    shape[axis] = -1
+    return s.reshape(shape)

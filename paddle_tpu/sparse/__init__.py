@@ -390,16 +390,27 @@ def to_dense(x, name=None):
     return x.to_dense()
 
 
-def to_sparse_coo(x, sparse_dim=2, name=None):
+def to_sparse_coo(x, sparse_dim=None, name=None):
+    """Dense → COO.  `sparse_dim` keeps only the leading sparse_dim axes
+    sparse; trailing axes stay dense blocks (the reference's hybrid COO,
+    e.g. [nnz, C] values for a [N, D, H, W, C] voxel grid)."""
     if isinstance(x, SparseCsrTensor):
-        return x.to_sparse_coo(sparse_dim)
+        return x.to_sparse_coo(sparse_dim or 2)
     if isinstance(x, SparseCooTensor):
         return x
     xv = _val(x)
-    idx = np.argwhere(np.asarray(xv) != 0)
+    nd = xv.ndim
+    sd = nd if sparse_dim is None else int(sparse_dim)
+    if not 1 <= sd <= nd:
+        raise ValueError(f"sparse_dim must be in [1, {nd}], got {sd}")
+    arr = np.asarray(xv)
+    nonzero = arr != 0
+    if sd < nd:       # a site is stored if ANY of its dense block is nonzero
+        nonzero = nonzero.any(axis=tuple(range(sd, nd)))
+    idx = np.argwhere(nonzero)
     x_t = x if isinstance(x, Tensor) else Tensor(xv, _internal=True)
     vals_t = _apply(
-        lambda d: d[tuple(jnp.asarray(idx[:, k]) for k in range(idx.shape[1]))],
+        lambda d: d[tuple(jnp.asarray(idx[:, k]) for k in range(sd))],
         "sparse_from_dense", (x_t,))
     return SparseCooTensor._make(vals_t, idx, xv.shape)
 
