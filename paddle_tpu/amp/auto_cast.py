@@ -16,7 +16,8 @@ from ..core.dtype import to_jax
 # reference white/black lists (amp/auto_cast.py WHITE_LIST/BLACK_LIST)
 white_list = {"matmul", "mm", "bmm", "mv", "conv1d", "conv2d", "conv3d",
               "conv1d_transpose", "conv2d_transpose", "conv3d_transpose",
-              "linear", "einsum", "attention", "scaled_dot_product_attention"}
+              "linear", "einsum", "attention", "scaled_dot_product_attention",
+              "resnet_stem_s2d", "sparse_conv3d", "sparse_fused_attention"}
 black_list = {"exp", "square", "log", "mean", "sum", "cos_sim", "softmax",
               "log_softmax", "softmax_with_cross_entropy", "sigmoid_cross_entropy_with_logits",
               "cross_entropy", "fused_nll_loss", "layer_norm", "batch_norm",

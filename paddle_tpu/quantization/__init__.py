@@ -87,12 +87,14 @@ class FakeQuanterWithAbsMaxObserver(Layer):
                         "quant observer ran only under jit: calibration "
                         "needs eager forwards (scale stays at init)")
             else:
-                self._observe(np.abs(np.asarray(x._value)).ravel())
+                self._observe_value(x._value)
         return quant_dequant(x, self.scale, bits=self.bit_length)
 
-    def _observe(self, av):
-        """EMA of batch abs-maxes; subclasses override for calibration."""
-        cur = float(av.max()) if av.size else 0.0
+    def _observe_value(self, xv):
+        """EMA of batch abs-maxes.  Device-side reduce: only a SCALAR
+        crosses to host per observed forward.  Subclasses that need the
+        full distribution (HistObserver) override this."""
+        cur = float(jnp.max(jnp.abs(xv))) if xv.size else 0.0
         old = float(np.asarray(self.scale._value))
         new = cur if not self._seen else \
             self.moving_rate * old + (1 - self.moving_rate) * cur
@@ -340,6 +342,10 @@ class HistObserver(FakeQuanterWithAbsMaxObserver):
         self._batch_maxes: list[float] = []
         self._finalized = False
 
+    def _observe_value(self, xv):
+        # histogram calibration needs the full |x| distribution on host
+        self._observe(np.abs(np.asarray(xv)).ravel())
+
     def _observe(self, av):
         cur = float(av.max()) if av.size else 0.0
         self._batch_maxes.append(cur)
@@ -364,7 +370,7 @@ class HistObserver(FakeQuanterWithAbsMaxObserver):
                             if self._seen else 0.0, cur), jnp.float32), None)
         self._seen = True
 
-    # forward comes from the base class; only _observe differs
+    # forward comes from the base class; only the observe hook differs
 
     def finalize(self):
         """Compute the calibrated threshold and write it into `scale`."""

@@ -80,8 +80,15 @@ class ResNet(nn.Layer):
              152: (BottleneckBlock, [3, 8, 36, 3])}
 
     def __init__(self, block=None, depth=50, width=64, num_classes=1000,
-                 with_pool=True, groups=1):
+                 with_pool=True, groups=1, stem_s2d=False):
         super().__init__()
+        # stem_s2d: run conv1 as a space-to-depth transform — input packed
+        # 2x2 into channels ([B,3,H,W] -> [B,12,H/2,W/2]) and the 7x7/s2
+        # kernel rearranged into an EXACTLY equivalent 4x4/s1 kernel over
+        # 12 channels (MLPerf TPU ResNet trick: 4x the MXU lane occupancy
+        # of the C=3 stem).  Same parameters, bitwise-same math modulo
+        # reassociation; A/B'd on device in docs/PERF.md.
+        self.stem_s2d = bool(stem_s2d)
         if block is None:
             block, layers = self._spec[depth]
         else:
@@ -122,8 +129,40 @@ class ResNet(nn.Layer):
                                 base_width=self.base_width))
         return nn.Sequential(*layers)
 
+    def _stem_s2d(self, x):
+        """conv1 via space-to-depth: exact 7x7/s2 equivalence as a 4x4/s1
+        conv on 2x2-packed input (kernel left-padded one row/col so the
+        stride-2 taps align with the 2x2 packing)."""
+        from ...core.op import apply_op
+
+        w = self.conv1.weight      # [64, 3, 7, 7]
+
+        def raw(xv, wv):
+            import jax.numpy as jnp
+            from jax import lax
+            b, c, h, wd = xv.shape
+            xp = xv.reshape(b, c, h // 2, 2, wd // 2, 2)
+            xp = xp.transpose(0, 1, 3, 5, 2, 4).reshape(
+                b, c * 4, h // 2, wd // 2)          # channel = (c, r, s)
+            k8 = jnp.pad(wv, ((0, 0), (0, 0), (1, 0), (1, 0)))
+            o, ci, _, _ = wv.shape
+            # K'[o, (c,r,s), a, b] = K8[o, c, 2a+r, 2b+s]
+            kp = k8.reshape(o, ci, 4, 2, 4, 2).transpose(0, 1, 3, 5, 2, 4) \
+                .reshape(o, ci * 4, 4, 4)
+            return lax.conv_general_dilated(
+                xp, kp, window_strides=(1, 1),
+                padding=((2, 1), (2, 1)),
+                dimension_numbers=("NCHW", "OIHW", "NCHW"))
+
+        return apply_op(raw, "resnet_stem_s2d", (x, w), {})
+
     def forward(self, x):
-        x = self.relu(self.bn1(self.conv1(x)))
+        if self.stem_s2d and x.shape[-1] % 2 == 0 and x.shape[-2] % 2 == 0:
+            x = self.relu(self.bn1(self._stem_s2d(x)))
+        else:
+            # odd H/W can't 2x2-pack; the plain stem handles it (identical
+            # function either way)
+            x = self.relu(self.bn1(self.conv1(x)))
         x = self.maxpool(x)
         x = self.layer1(x)
         x = self.layer2(x)

@@ -25,6 +25,7 @@ import pytest
 import paddle_tpu as paddle
 from paddle_tpu.core.op import OP_REGISTRY
 
+from test_op_registry_sweep import SKIP as REGISTRY_SKIP
 from test_op_registry_sweep import SPECS
 
 # ops whose sampled inputs sit too close to a kink / branch point for
@@ -96,7 +97,8 @@ def _scalar_loss(out, proj):
 def _numeric_grad_once(op_name):
     op = OP_REGISTRY[op_name]
     raw_args, kwargs = _materialize(op_name)
-    proj = [np.random.RandomState(abs(hash(op_name)) % 2**31)
+    import zlib
+    proj = [np.random.RandomState(zlib.crc32(op_name.encode()))
             .uniform(0.5, 1.5, 64)]
 
     out, args = _call(op, raw_args, kwargs, grad=True)
@@ -195,7 +197,14 @@ def test_dtype_bf16_forward(op_name):
 def _dtype_bf16_once(op_name):
     op = OP_REGISTRY[op_name]
     raw_args, kwargs = _materialize(op_name)
-    if not any(_is_float_arr(v) for v in raw_args):
+
+    def has_float(v):
+        if _is_float_arr(v):
+            return True
+        return isinstance(v, (list, tuple)) and \
+            any(_is_float_arr(e) for e in v)
+
+    if not any(has_float(v) for v in raw_args):
         pytest.skip("no float inputs to cast")
     f32_out, _ = _call(op, raw_args, kwargs)
     bf16_args = [v.astype(np.float32) if _is_float_arr(v) else v
@@ -260,6 +269,7 @@ def test_numeric_sweep_coverage_report():
           f"{skipped_diff}")
     print(f"applicable-contract coverage: {covered}/{total} "
           f"= {covered / total:.1%}")
-    assert specd == total, "registry op without a spec (sweep must be total)"
+    assert specd + len(set(REGISTRY_SKIP) & set(OP_REGISTRY)) == total, \
+        "registry op without a spec or SKIP reason (sweep must be total)"
     assert covered / total > 0.80, f"coverage {covered / total:.1%} <= 80%"
     assert numeric_grad / total > 0.55, "numeric-grad share regressed"

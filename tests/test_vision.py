@@ -289,3 +289,27 @@ def test_max_pool_return_mask_roundtrip():
                                   axis=2)
     np.testing.assert_allclose(gathered.reshape(pooled.shape),
                                pooled.numpy())
+
+
+def test_resnet_stem_s2d_equivalence():
+    """stem_s2d (space-to-depth conv1; docs/PERF.md round-4) computes the
+    SAME function: stem-level near-exact, model-level to fp32
+    reassociation tolerance, and conv1 grads flow through the packed
+    path."""
+    from paddle_tpu.vision.models import ResNet
+
+    paddle.seed(0)
+    m1 = ResNet(depth=50)
+    paddle.seed(0)
+    m2 = ResNet(depth=50, stem_s2d=True)
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .standard_normal((2, 3, 64, 64)).astype("float32"))
+    a = m1.conv1(x).numpy()
+    b = m2._stem_s2d(x).numpy()
+    np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5)
+    o1 = m1(x).numpy()
+    o2 = m2(x).numpy()
+    np.testing.assert_allclose(o1, o2, rtol=5e-3, atol=1e-3)
+    m2.train()
+    m2(x).sum().backward()
+    assert m2.conv1.weight.grad is not None
