@@ -22,6 +22,7 @@ from ..core.tensor import Tensor
 from ..nn.layer_base import Layer
 
 __all__ = ["QuantConfig", "QAT", "PTQ", "FakeQuanterWithAbsMaxObserver",
+           "adaround_weight",
            "HistObserver", "cal_kl_threshold", "quant_dequant",
            "QuantedLinear", "QuantedConv2D"]
 
@@ -112,6 +113,9 @@ class _QuantedWrapper(Layer):
         self.activation_quanter = a_quanter
         self.w_bits = w_bits
         self.w_per_channel = w_per_channel
+        # set to [] by PTQ(weight_rounding="adaround"): calibration inputs
+        # stashed for the convert()-time rounding optimization
+        self._stash = None
 
     def _wq(self):
         w = self.inner.weight
@@ -127,6 +131,12 @@ class _QuantedWrapper(Layer):
         return apply_op(raw_ste, "weight_quantize", (w,), {})
 
     def forward(self, x):
+        if self._stash is not None and len(self._stash) < 4 and \
+                not isinstance(x._value, jax.core.Tracer):
+            # PRE-quant input: adaround re-applies the activation quanter
+            # at convert() time, when its scale is FINALIZED — the interim
+            # running scale here would mis-train the rounding
+            self._stash.append(x.detach())
         if self.activation_quanter is not None:
             x = self.activation_quanter(x)
         w = self._wq()
@@ -223,12 +233,22 @@ class QAT:
         if not inplace:
             import copy
             model = copy.deepcopy(model)
+        # pass 1: finalize + freeze every observer FIRST, so the learned
+        # rounding below sees the final activation scales, not the interim
+        # running abs-max used during calibration
         for layer in model.sublayers(include_self=True):
             if isinstance(layer, HistObserver):
                 layer.finalize()      # histogram -> calibrated threshold
             if isinstance(layer, FakeQuanterWithAbsMaxObserver):
                 layer.observing = False
+        for layer in model.sublayers(include_self=True):
             if isinstance(layer, _QuantedWrapper):
+                stash, layer._stash = layer._stash, None  # stop stashing
+                if stash:
+                    # learned rounding on stashed calibration inputs
+                    layer.inner.weight._replace_(
+                        adaround_weight(layer, stash), None)
+                    continue
                 qmax = float(2 ** (layer.w_bits - 1) - 1)
                 wv = layer.inner.weight._value
                 s = _weight_scales(wv, layer.w_per_channel,
@@ -250,7 +270,12 @@ class PTQ(QAT):
 
     def __init__(self, config: QuantConfig | None = None, algo="kl",
                  bins=2048, percent=0.99999,
-                 weight_quantize_type="channel_wise_abs_max"):
+                 weight_quantize_type="channel_wise_abs_max",
+                 weight_rounding="nearest"):
+        if weight_rounding not in ("nearest", "adaround"):
+            raise ValueError(
+                f"unknown weight_rounding {weight_rounding!r}")
+        self.weight_rounding = weight_rounding
         if config is not None:
             if (algo, bins, percent, weight_quantize_type) != \
                     self._DEFAULT_CAL:
@@ -266,6 +291,14 @@ class PTQ(QAT):
             config = QuantConfig(
                 activation=act, weight_quantize_type=weight_quantize_type)
         super().__init__(config)
+
+    def quantize(self, model, inplace=True):
+        model = super().quantize(model, inplace=inplace)
+        if self.weight_rounding == "adaround":
+            for layer in model.sublayers(include_self=True):
+                if isinstance(layer, _QuantedWrapper):
+                    layer._stash = []
+        return model
 
 
 # -- PTQ calibration depth (round-4; reference slim/quantization:
@@ -419,3 +452,73 @@ def _weight_scales(wv, per_channel, axis):
     shape = [1] * wv.ndim
     shape[axis] = -1
     return s.reshape(shape)
+
+
+# -- AdaRound (reference slim/quantization/adaround.py): learned weight
+# rounding — optimize a per-element soft rounding mask so the QUANTIZED
+# layer's outputs match the float layer on calibration data, instead of
+# rounding to nearest ---------------------------------------------------------
+
+_ADAROUND_GAMMA, _ADAROUND_ZETA = -0.1, 1.1
+
+
+def _soft_round(alpha):
+    z, g = _ADAROUND_ZETA, _ADAROUND_GAMMA
+    return jnp.clip(jax.nn.sigmoid(alpha) * (z - g) + g, 0.0, 1.0)
+
+
+def adaround_weight(wrapper, inputs, iters=200, reg=0.01, lr=1e-2,
+                    warm_start=0.2, beta_range=(20.0, 2.0)):
+    """Optimize the rounding of `wrapper.inner.weight` on calibration
+    `inputs` (list of Tensors) and return the adarounded weight values.
+
+    Loss = ||layer(x; W_q) - layer(x; W)||^2 + reg * sum(1 - |2h-1|^beta)
+    with h the rectified-sigmoid mask, beta annealed high->low and the
+    regularizer off during the warm-start fraction (reference
+    AdaRoundLoss.compute_round_loss / compute_beta)."""
+    import paddle_tpu as paddle
+
+    inner = wrapper.inner
+    w = inner.weight._value
+    qmax = float(2 ** (wrapper.w_bits - 1) - 1)
+    s = _weight_scales(w, wrapper.w_per_channel, _channel_axis(inner)) / qmax
+    floor_w = jnp.floor(w / s)
+    rest = w / s - floor_w                       # in [0, 1)
+    z, g = _ADAROUND_ZETA, _ADAROUND_GAMMA
+    # init so _soft_round(alpha) == rest
+    p = jnp.clip((rest - g) / (z - g), 1e-4, 1 - 1e-4)
+    alpha = Tensor(jnp.log(p / (1 - p)), _internal=True)
+    alpha.stop_gradient = False
+    from ..optimizer import Adam
+    opt = Adam(learning_rate=lr, parameters=[alpha])
+
+    from ..core.autograd import no_grad
+    if wrapper.activation_quanter is not None:
+        # stashed inputs are PRE-quant; quantize with the FINAL scale
+        with no_grad():
+            inputs = [wrapper.activation_quanter(x).detach() for x in inputs]
+    floor_t = Tensor(floor_w, _internal=True)
+    s_t = Tensor(s, _internal=True)
+    with no_grad():
+        fp_outs = [wrapper._call_with_weight(x, inner.weight).detach()
+                   for x in inputs]
+    for it in range(iters):
+        frac = it / max(iters - 1, 1)
+        h = (paddle.nn.functional.sigmoid(alpha) * (z - g) + g).clip(0.0, 1.0)
+        wq = (floor_t + h).clip(-qmax, qmax) * s_t
+        recon = None
+        for x, fp in zip(inputs, fp_outs):
+            d = ((wrapper._call_with_weight(x, wq) - fp) ** 2).mean()
+            recon = d if recon is None else recon + d
+        loss = recon
+        if frac >= warm_start:
+            b_hi, b_lo = beta_range
+            t = (frac - warm_start) / max(1 - warm_start, 1e-9)
+            beta = b_lo + 0.5 * (b_hi - b_lo) * (1 + np.cos(t * np.pi))
+            round_loss = (1.0 - ((2 * h - 1).abs() ** beta)).sum()
+            loss = loss + reg * round_loss
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    h_final = np.asarray(_soft_round(alpha._value)) >= 0.5
+    return jnp.clip(floor_w + h_final, -qmax, qmax) * s

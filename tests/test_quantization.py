@@ -220,3 +220,46 @@ def test_ptq_resnet50_within_1pct_top1():
         [np.asarray(qmodel(x).numpy()).argmax(-1) for x in imgs])
     agreement = float((q_top1 == fp32_top1).mean())
     assert agreement >= 0.99, agreement
+
+
+def test_adaround_beats_nearest_rounding():
+    """AdaRound (reference slim/adaround.py): learned rounding must reduce
+    the quantized layer's output error vs round-to-nearest on calibration
+    data, and the weights still land on the int8 grid."""
+    from paddle_tpu.quantization import PTQ
+
+    rng = np.random.RandomState(0)
+    paddle.seed(0)
+    net_fp = paddle.nn.Sequential(paddle.nn.Linear(16, 16))
+    # mid-grid weights make nearest rounding maximally ambiguous
+    import jax.numpy as jnp
+    w = rng.standard_normal((16, 16)).astype(np.float32)
+    s = np.abs(w).max(axis=0, keepdims=True) / 127.0
+    w_mid = (np.floor(w / s) + 0.5 + 0.1 * rng.uniform(-1, 1, w.shape)) * s
+    net_fp[0].weight._replace_(jnp.asarray(w_mid.astype(np.float32)), None)
+    xs = [paddle.to_tensor(rng.standard_normal((8, 16)).astype(np.float32))
+          for _ in range(3)]
+    fp_out = [np.asarray(net_fp(x).numpy()) for x in xs]
+
+    def ptq_error(rounding):
+        import copy
+        net = copy.deepcopy(net_fp)
+        ptq = PTQ(algo="abs_max", weight_rounding=rounding)
+        q = ptq.quantize(net, inplace=True)
+        for x in xs:
+            q(x)
+        ptq.convert(q, inplace=True)
+        err = sum(float(np.mean((np.asarray(q(x).numpy()) - f) ** 2))
+                  for x, f in zip(xs, fp_out))
+        wq = np.asarray(q[0].inner.weight.numpy())
+        # grid check against the PRE-quant scale (adaround may round a
+        # column's extreme entry inward, so re-deriving the scale from wq
+        # would be fragile)
+        s_pre = np.abs(w_mid).max(axis=0, keepdims=True) / 127.0
+        grid = wq / s_pre
+        assert np.allclose(grid, np.round(grid), atol=2e-3), rounding
+        return err
+
+    e_nearest = ptq_error("nearest")
+    e_ada = ptq_error("adaround")
+    assert e_ada < e_nearest * 0.9, (e_nearest, e_ada)
