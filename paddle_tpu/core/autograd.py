@@ -228,9 +228,12 @@ def backward(tensors: Sequence[Any], grad_tensors: Sequence[Any] | None = None,
             if t._grad is None:
                 t._grad = g
             else:
-                prev = t._grad.to_dense() if isinstance(
-                    t._grad, SelectedRows) else t._grad
-                t._grad = prev + g
+                # to_dense() yields a raw jnp array; wrap it so _acc keeps
+                # the taped g on the left (raw + Tensor would constant-fold
+                # g through __jax_array__) and .grad stays a Tensor
+                prev = Tensor(t._grad.to_dense(), stop_gradient=True) \
+                    if isinstance(t._grad, SelectedRows) else t._grad
+                t._grad = _acc(prev, g)
             return
         if t._grad is None:
             t._grad = Tensor(g, stop_gradient=True)
@@ -239,85 +242,99 @@ def backward(tensors: Sequence[Any], grad_tensors: Sequence[Any] | None = None,
         else:
             t._grad = Tensor(t._grad._value + g, stop_gradient=True)
 
-    for t, g in zip(tensors, grad_tensors):
-        seed_grad(t, g)
+    def _walk():
+        for t, g in zip(tensors, grad_tensors):
+            seed_grad(t, g)
 
-    # Discover the reachable subgraph.
-    frontier = list(node_by_id.values())
-    seen = set(node_by_id)
-    while frontier:
-        node = frontier.pop()
-        for inp in node.inputs:
-            parent = inp._grad_node
-            if parent is not None and id(parent) not in seen:
-                seen.add(id(parent))
-                node_by_id[id(parent)] = parent
-                frontier.append(parent)
+        # Discover the reachable subgraph.
+        frontier = list(node_by_id.values())
+        seen = set(node_by_id)
+        while frontier:
+            node = frontier.pop()
+            for inp in node.inputs:
+                parent = inp._grad_node
+                if parent is not None and id(parent) not in seen:
+                    seen.add(id(parent))
+                    node_by_id[id(parent)] = parent
+                    frontier.append(parent)
 
-    # Reverse execution order == topological order for an eager tape.
-    order = sorted(node_by_id.values(), key=lambda n: n.seq, reverse=True)
+        # Reverse execution order == topological order for an eager tape.
+        order = sorted(node_by_id.values(), key=lambda n: n.seq, reverse=True)
 
-    for node in order:
-        if node.released():
-            raise RuntimeError(
-                "trying to backward through the graph a second time; "
-                "pass retain_graph=True to Tensor.backward() if needed")
-        cts = []
-        has_any = False
-        for slot in range(node.n_outputs):
-            g = out_grads.pop((id(node), slot), None)
-            if g is None:
-                shape, dtype = node.out_avals[slot]
-                g = _zero_cotangent(shape, dtype)
+        for node in order:
+            if node.released():
+                raise RuntimeError(
+                    "trying to backward through the graph a second time; "
+                    "pass retain_graph=True to Tensor.backward() if needed")
+            cts = []
+            has_any = False
+            for slot in range(node.n_outputs):
+                g = out_grads.pop((id(node), slot), None)
+                if g is None:
+                    shape, dtype = node.out_avals[slot]
+                    g = _zero_cotangent(shape, dtype)
+                else:
+                    has_any = True
+                cts.append(g)
+            if not has_any:
+                continue
+            ct = cts[0] if node.n_outputs == 1 else tuple(cts)
+            if create_graph and node.closure is None:
+                # a node without a pure closure (PyLayer, SelectedRows lookup)
+                # cannot be re-linearized: raising beats silently returning
+                # first-order-only grads (wrong Hessians)
+                raise NotImplementedError(
+                    f"create_graph=True through op {node.name!r} is not "
+                    f"supported: its backward is not a pure traced closure "
+                    f"(PyLayer/sparse path). Express it with regular tensor "
+                    f"ops to differentiate twice.")
+            if create_graph and node.closure is not None:
+                # Tape the grad computation: grad = vjp(closure, primals)(ct) is a
+                # pure jnp function of (ct, primals), so running it through
+                # apply_op records a second-order-differentiable op whose edges
+                # reach the cotangents and the original inputs.
+                from .op import apply_op
+                node_closure = node.closure
+
+                def _grad_fn(ct_, *primals, _f=node_closure):
+                    res = jax.vjp(_f, *primals)[1](ct_)
+                    # unpack 1-tuples: a plain tuple output makes the recorded
+                    # node's own vjp expect a tuple cotangent, but the walk
+                    # hands single-output nodes a bare array
+                    return res[0] if len(res) == 1 else res
+
+                in_grads = apply_op(_grad_fn, node.name + "_grad",
+                                    (ct, *node.inputs), {})
+                if not isinstance(in_grads, (tuple, list)):
+                    in_grads = (in_grads,)
             else:
-                has_any = True
-            cts.append(g)
-        if not has_any:
-            continue
-        ct = cts[0] if node.n_outputs == 1 else tuple(cts)
-        if create_graph and node.closure is None:
-            # a node without a pure closure (PyLayer, SelectedRows lookup)
-            # cannot be re-linearized: raising beats silently returning
-            # first-order-only grads (wrong Hessians)
-            raise NotImplementedError(
-                f"create_graph=True through op {node.name!r} is not "
-                f"supported: its backward is not a pure traced closure "
-                f"(PyLayer/sparse path). Express it with regular tensor "
-                f"ops to differentiate twice.")
-        if create_graph and node.closure is not None:
-            # Tape the grad computation: grad = vjp(closure, primals)(ct) is a
-            # pure jnp function of (ct, primals), so running it through
-            # apply_op records a second-order-differentiable op whose edges
-            # reach the cotangents and the original inputs.
-            from .op import apply_op
-            node_closure = node.closure
+                in_grads = node._materialized_vjp()(ct)
+            if not retain_graph:
+                node.release()
+            for inp, g in zip(node.inputs, in_grads):
+                captured = capture is not None and id(inp) in capture
+                if captured:
+                    _sink_add(inp, g)
+                if inp._grad_node is None:
+                    if not captured:
+                        _accumulate_leaf(inp, g)
+                else:
+                    key = (id(inp._grad_node), inp._grad_slot)
+                    out_grads[key] = g if key not in out_grads else \
+                        _acc(out_grads[key], g)
 
-            def _grad_fn(ct_, *primals, _f=node_closure):
-                res = jax.vjp(_f, *primals)[1](ct_)
-                # unpack 1-tuples: a plain tuple output makes the recorded
-                # node's own vjp expect a tuple cotangent, but the walk
-                # hands single-output nodes a bare array
-                return res[0] if len(res) == 1 else res
-
-            in_grads = apply_op(_grad_fn, node.name + "_grad",
-                                (ct, *node.inputs), {})
-            if not isinstance(in_grads, (tuple, list)):
-                in_grads = (in_grads,)
-        else:
-            in_grads = node._materialized_vjp()(ct)
-        if not retain_graph:
-            node.release()
-        for inp, g in zip(node.inputs, in_grads):
-            captured = capture is not None and id(inp) in capture
-            if captured:
-                _sink_add(inp, g)
-            if inp._grad_node is None:
-                if not captured:
-                    _accumulate_leaf(inp, g)
-            else:
-                key = (id(inp._grad_node), inp._grad_slot)
-                out_grads[key] = g if key not in out_grads else \
-                    _acc(out_grads[key], g)
+    # create_graph: the whole pass — VJP replays AND cotangent accumulation
+    # (Tensor adds when a primal fans out) — must tape with grad mode ON and
+    # autocast OFF.  A surrounding no_grad would record nothing (silently
+    # stop_gradient grads despite create_graph=True); a surrounding
+    # auto_cast(O2) would cast replayed '<op>_grad' ops and grad
+    # accumulations to bf16, diverging from the original-dtype vjp path.
+    with contextlib.ExitStack() as guards:
+        if create_graph:
+            from ..amp.auto_cast import auto_cast
+            guards.enter_context(enable_grad())
+            guards.enter_context(auto_cast(enable=False))
+        _walk()
 
 
 def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=False,

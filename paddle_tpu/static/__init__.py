@@ -130,12 +130,26 @@ def data(name, shape, dtype="float32", lod_level=0):
         # (notebook re-run): restart the per-opname counters so builders
         # reuse fc_0/fc_1... (create-once persistable contract) instead of
         # minting fresh parameters.  A back-to-back re-declare with no
-        # builders in between (shape refinement) does NOT reset, and later
-        # names of the same rerun see a fresh tick so the reset fires at
-        # most once per pass.  Incremental builds (a second guard block
-        # adding NEW inputs/layers) never re-declare a name.
+        # builders since this name's last declare (tick == decl_tick:
+        # shape refinement) is NOT a rerun signal and is skipped entirely.
+        # Incremental builds (a second guard block adding NEW inputs/
+        # layers) never re-declare a name.  `redecl` tracks names
+        # rerun-re-declared in the current pass so the reset fires exactly
+        # once per pass — a later feed of the SAME pass (whose decl_tick
+        # went stale because the rerun inserted builders before it) must
+        # not reset again and alias two distinct builders onto one layer.
         if tick > decl_tick.get(name, 0):
-            counts.clear()
+            redecl = _default_main.__dict__.setdefault(
+                "_redecl_this_pass", set())
+            if name in redecl:
+                redecl.clear()    # same name again → a new pass began
+            if not redecl:
+                counts.clear()
+                # every stored builder param is now up for reuse by the
+                # rerun; _scoped_params shape-checks each on first reuse
+                _default_main.__dict__["_graph_params_stale"] = set(
+                    _default_main.__dict__.get("_graph_params", {}))
+            redecl.add(name)
         i = _default_main._feed_names.index(name)
         _default_main._input_specs[i] = spec
     else:

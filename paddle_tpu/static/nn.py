@@ -32,6 +32,20 @@ def _prog(*vars_):
     return default_main_program()
 
 
+def _param_shapes(obj):
+    """Flatten the parameter shapes out of whatever a builder factory made
+    (nn.Layer, Parameter/Tensor, or containers of those)."""
+    if hasattr(obj, "parameters") and callable(getattr(obj, "parameters")):
+        return [tuple(p.shape) for p in obj.parameters()]
+    if hasattr(obj, "shape") and not isinstance(obj, (str, bytes)):
+        return [tuple(obj.shape)]
+    if isinstance(obj, dict):
+        return [s for v in obj.values() for s in _param_shapes(v)]
+    if isinstance(obj, (list, tuple)):
+        return [s for v in obj for s in _param_shapes(v)]
+    return []
+
+
 def _scoped_params(prog, opname, factory):
     """Create-once Program parameters (reference: persistable Variables
     on the Program's global block)."""
@@ -42,6 +56,25 @@ def _scoped_params(prog, opname, factory):
     key = f"{opname}_{n}"
     if key not in store:
         store[key] = factory()
+    elif key in prog.__dict__.get("_graph_params_stale", ()):
+        # Notebook-rerun reuse (static.data reset the counters): confirm the
+        # rerun's builder wants the same parameter shapes before aliasing it
+        # onto the stored layer — a changed script must error, not silently
+        # train someone else's weights.  The probe layer is discarded; RNG
+        # state is restored so rerun reproducibility is unaffected.
+        from ..core.random import get_rng_state, set_rng_state
+        saved = get_rng_state()
+        try:
+            probe = factory()
+        finally:
+            set_rng_state(saved)
+        old_s, new_s = _param_shapes(store[key]), _param_shapes(probe)
+        if old_s != new_s:
+            raise ValueError(
+                f"program rerun re-declares builder {key!r} with different "
+                f"parameter shapes {new_s} (stored: {old_s}); use a fresh "
+                f"Program (static.Program()) to change the architecture")
+        prog.__dict__["_graph_params_stale"].discard(key)
     return store[key]
 
 

@@ -54,6 +54,11 @@ def get_path_from_url(url, root_dir, md5sum=None, check_exist=True,
             for m in tf.getmembers():
                 # internal relative links are fine (pkg/latest -> v1.0);
                 # only targets resolving outside root are refused
+                if m.isdev():     # CHR/BLK devices and FIFOs
+                    # match the 3.12+ filter='data' policy on older Pythons
+                    raise IOError(
+                        f"archive member {m.name!r} is a special file "
+                        f"(device/FIFO); refusing")
                 if m.issym() or m.islnk():
                     if m.issym():
                         resolved = os.path.normpath(os.path.join(

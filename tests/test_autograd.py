@@ -271,3 +271,71 @@ def test_create_graph_mixed_seed_accumulation():
                                rtol=1e-6)
     (gg,) = paddle.grad(g.sum(), x)
     np.testing.assert_allclose(gg.numpy(), [29.0], rtol=1e-6)
+
+
+def test_create_graph_inside_no_grad_scope():
+    """Round-5 advisor: paddle.grad(create_graph=True) inside a no_grad
+    scope must still return differentiable grads — the VJP replay runs with
+    grad mode forced on (previously it silently recorded nothing)."""
+    x = paddle.to_tensor(np.array([1.5], np.float32))
+    x.stop_gradient = False
+    y = (x ** 3).sum()
+    with paddle.no_grad():
+        (g,) = paddle.grad(y, x, create_graph=True)
+    np.testing.assert_allclose(g.numpy(), [3 * 1.5 ** 2], rtol=1e-6)
+    (gg,) = paddle.grad(g.sum(), x)
+    np.testing.assert_allclose(gg.numpy(), [6 * 1.5], rtol=1e-6)
+
+
+def test_create_graph_under_autocast_matches_fp32():
+    """Round-5 advisor: an active auto_cast(level='O2') scope must not cast
+    the replayed '<op>_grad' ops — first/second-order grads must be
+    bit-identical to the no-autocast path."""
+    a = np.random.RandomState(3).randn(4, 4).astype(np.float32)
+
+    def run(inside_amp):
+        x = paddle.to_tensor(a)
+        x.stop_gradient = False
+        y = (paddle.matmul(x, x) ** 2).sum()
+        if inside_amp:
+            with paddle.amp.auto_cast(level="O2"):
+                (g,) = paddle.grad(y, x, create_graph=True)
+                (gg,) = paddle.grad(g.sum(), x)
+        else:
+            (g,) = paddle.grad(y, x, create_graph=True)
+            (gg,) = paddle.grad(g.sum(), x)
+        return g.numpy(), gg.numpy()
+
+    g0, gg0 = run(False)
+    g1, gg1 = run(True)
+    assert g1.dtype == np.float32 and gg1.dtype == np.float32
+    np.testing.assert_array_equal(g0, g1)
+    np.testing.assert_array_equal(gg0, gg1)
+
+
+def test_selected_rows_then_taped_grad_accumulation():
+    """Round-5 advisor: accumulating a taped (create_graph) grad onto an
+    existing SelectedRows .grad must produce a Tensor that keeps the tape
+    (to_dense() returns a raw array; raw + Tensor would constant-fold)."""
+    import paddle_tpu.nn as nn
+    from paddle_tpu.core.selected_rows import SelectedRows
+    from paddle_tpu.core.tensor import Tensor
+
+    paddle.seed(0)
+    emb = nn.Embedding(6, 3, sparse=True)
+    w = emb.weight
+    ids = paddle.to_tensor(np.array([1, 4], "int64"))
+    (emb(ids) ** 2).sum().backward()
+    assert isinstance(w.grad, SelectedRows)
+    prev_dense = np.asarray(w.grad.to_dense()).copy()
+
+    from paddle_tpu.core.autograd import backward as core_backward
+    loss2 = (w ** 2).sum()
+    core_backward([loss2], create_graph=True)
+    assert isinstance(w._grad, Tensor)
+    np.testing.assert_allclose(w._grad.numpy(), prev_dense + 2 * w.numpy(),
+                               rtol=1e-6)
+    # the second loss's contribution must still be differentiable
+    (gg,) = paddle.grad(w._grad.sum(), w)
+    np.testing.assert_allclose(gg.numpy(), np.full_like(prev_dense, 2.0),
+                               rtol=1e-6)

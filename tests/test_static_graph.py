@@ -252,3 +252,92 @@ def test_program_guard_rebuild_reuses_parameters():
     xb = np.random.RandomState(0).standard_normal((3, 4)).astype(np.float32)
     o1, o2 = exe.run(main, feed={"X": xb}, fetch_list=[p1, p2])
     np.testing.assert_allclose(o1, o2, rtol=1e-6)
+
+
+def test_program_rerun_with_changed_shapes_raises():
+    """ADVICE r5: a rerun that re-declares a feed and then builds layers
+    with DIFFERENT parameter shapes must raise, not silently alias the new
+    layers onto the stored fc_0/fc_1 weights."""
+    main = static.Program()
+
+    def build(width):
+        with static.program_guard(main):
+            x = static.data(name="X", shape=[None, 4], dtype="float32")
+            return static.nn.fc(x, width)
+
+    build(8)
+    build(8)          # same script rerun: fine, reuses fc_0
+    with pytest.raises(ValueError, match="different\\s+parameter shapes"):
+        build(16)     # changed architecture: must error
+
+
+def test_program_rerun_shape_check_preserves_rng():
+    """The reuse shape-probe must not consume framework RNG draws — params
+    created after a PROBED rerun must match those from a run that never
+    probed (same number of draws either way)."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+
+    def run(rerun):
+        paddle.seed(7)
+        main = static.Program()
+
+        def build():
+            with static.program_guard(main):
+                x = static.data(name="X", shape=[None, 4], dtype="float32")
+                return static.nn.fc(x, 8)
+
+        build()
+        if rerun:
+            build()   # triggers the reuse shape-probe (an extra factory())
+        tail = nn.Linear(8, 3)   # fresh params drawn after the (no-)probe
+        return tail.weight.numpy()
+
+    np.testing.assert_array_equal(run(rerun=False), run(rerun=True))
+
+
+def test_program_rerun_inserted_builder_single_reset():
+    """Code-review r5: a rerun that INSERTS a builder before a later feed
+    must not fire the counter reset twice in one pass — the second reset
+    would alias two distinct builders of the same pass onto one layer."""
+    main = static.Program()
+
+    def build(extra):
+        with static.program_guard(main):
+            x = static.data(name="X", shape=[None, 4], dtype="float32")
+            h1 = static.nn.fc(x, 8)
+            h2 = static.nn.fc(h1, 8) if extra else None
+            y = static.data(name="Y", shape=[None, 8], dtype="float32")
+            h3 = static.nn.fc(y, 8)
+            return h2, h3
+
+    build(extra=False)
+    h2, h3 = build(extra=True)   # inserted fc before the Y re-declare
+    store = main.__dict__["_graph_params"]
+    # three distinct fc layers must exist; the inserted fc and the post-Y fc
+    # must NOT share weights
+    assert {"fc_0", "fc_1", "fc_2"} <= set(store)
+    assert store["fc_1"] is not store["fc_2"]
+
+
+def test_program_rerun_with_shape_refinement_stays_stable():
+    """Code-review r5: a pass containing a back-to-back shape refinement of
+    a later feed must rerun byte-identically forever — the refinement is not
+    a pass boundary and must not desync the one-reset-per-pass tracking."""
+    main = static.Program()
+
+    def build():
+        with static.program_guard(main):
+            x = static.data(name="X", shape=[None, 4], dtype="float32")
+            h = static.nn.fc(x, 8)
+            y = static.data(name="Y", shape=[None, 8], dtype="float32")
+            y = static.data(name="Y", shape=[None, 8], dtype="float32")
+            return static.nn.fc(y, 8)
+
+    build()
+    store_after_1 = dict(main.__dict__["_graph_params"])
+    build()
+    build()          # third rerun previously desynced and raised/aliased
+    store = main.__dict__["_graph_params"]
+    assert set(store) == set(store_after_1) == {"fc_0", "fc_1"}
+    assert all(store[k] is store_after_1[k] for k in store)

@@ -271,3 +271,20 @@ def test_download_rejects_escaping_members_and_checks_md5(tmp_path):
     with pytest.raises(IOError, match="escapes"):
         get_path_from_url(str(evil), str(root / "sub2"))
     assert not (tmp_path / "escape.txt").exists()
+
+
+def test_download_rejects_special_members(tmp_path):
+    """ADVICE r5: the pre-3.12 extractall fallback must refuse device/FIFO
+    members like the 3.12+ filter='data' path does."""
+    import tarfile as tarmod
+    from paddle_tpu.utils.download import get_path_from_url
+
+    evil = tmp_path / "fifo.tar"
+    with tarmod.open(evil, "w") as tf:
+        info = tarmod.TarInfo("pkg/pipe")
+        info.type = tarmod.FIFOTYPE
+        tf.addfile(info)
+    with pytest.raises((IOError, tarmod.ExtractError, tarmod.TarError)):
+        get_path_from_url(str(evil), str(tmp_path / "dst"))
+    import os
+    assert not os.path.exists(tmp_path / "dst" / "pkg" / "pipe")
