@@ -77,10 +77,10 @@ class GradNode:
     """
 
     __slots__ = ("seq", "vjp_fn", "inputs", "n_outputs", "out_avals", "name",
-                 "_packed", "closure")
+                 "_packed", "closure", "taped_vjp")
 
     def __init__(self, vjp_fn, inputs, n_outputs, out_avals, name="",
-                 closure=None):
+                 closure=None, taped_vjp=None):
         self.seq = next(_node_counter)
         self.inputs = inputs          # list[Tensor] (only those requiring grad)
         self.n_outputs = n_outputs
@@ -91,6 +91,10 @@ class GradNode:
         # the grad computation itself lands on the tape (reference
         # dygraph/base.py:432-465 grad(create_graph=True))
         self.closure = closure
+        # create_graph fallback for nodes whose backward is arbitrary Python
+        # built from taped ops (PyLayer): called with Tensor cotangents
+        # under grad mode so the user's backward records onto the tape
+        self.taped_vjp = taped_vjp
         self._packed = None
         hooks = _saved_tensor_hooks
         if hooks is not None:
@@ -125,6 +129,7 @@ class GradNode:
         self.vjp_fn = None
         self._packed = None
         self.closure = None   # drop captured raw inputs with the residuals
+        self.taped_vjp = None
 
 
 def _zero_cotangent(shape, dtype):
@@ -279,16 +284,35 @@ def backward(tensors: Sequence[Any], grad_tensors: Sequence[Any] | None = None,
             if not has_any:
                 continue
             ct = cts[0] if node.n_outputs == 1 else tuple(cts)
-            if create_graph and node.closure is None:
-                # a node without a pure closure (PyLayer, SelectedRows lookup)
-                # cannot be re-linearized: raising beats silently returning
-                # first-order-only grads (wrong Hessians)
+            if create_graph and node.closure is None \
+                    and node.taped_vjp is None:
+                # a node with neither a pure closure nor a tape-able user
+                # backward (SelectedRows lookup) cannot be re-linearized:
+                # raising beats silently returning first-order-only grads
+                # (wrong Hessians)
                 raise NotImplementedError(
                     f"create_graph=True through op {node.name!r} is not "
                     f"supported: its backward is not a pure traced closure "
-                    f"(PyLayer/sparse path). Express it with regular tensor "
-                    f"ops to differentiate twice.")
-            if create_graph and node.closure is not None:
+                    f"(sparse/SelectedRows path). Express it with regular "
+                    f"tensor ops to differentiate twice.")
+            if create_graph and node.closure is None:
+                # PyLayer: run the USER's backward under the tape with
+                # Tensor cotangents — every taped op it executes records a
+                # GradNode, so the returned grads are differentiable
+                # through both the cotangents and the saved tensors
+                # (reference: codegen'd differentiable grad nodes,
+                # eager/backward.cc:105 over generated grad ops).
+                cts_t = []
+                for slot, c in enumerate(cts):
+                    if not isinstance(c, Tensor):
+                        if getattr(c, "dtype", None) == jax.dtypes.float0:
+                            shape, dtype = node.out_avals[slot]
+                            c = jnp.zeros(shape, dtype)
+                        c = Tensor(jnp.asarray(c), stop_gradient=True,
+                                   _internal=True)
+                    cts_t.append(c)
+                in_grads = node.taped_vjp(tuple(cts_t))
+            elif create_graph:
                 # Tape the grad computation: grad = vjp(closure, primals)(ct) is a
                 # pure jnp function of (ct, primals), so running it through
                 # apply_op records a second-order-differentiable op whose edges

@@ -58,24 +58,42 @@ class PyLayer(metaclass=PyLayerMeta):
 
             diff_mask = [not t.stop_gradient for t in inputs]
 
+            def _normalize(gin):
+                gin = (gin,) if isinstance(gin, Tensor) or gin is None \
+                    else tuple(gin)
+                if len(gin) == len(inputs):
+                    # one grad per tensor input: select the differentiable ones
+                    gin = [g for g, m in zip(gin, diff_mask) if m]
+                return gin
+
             def vjp_fn(cts):
                 cts = (cts,) if len(outs) == 1 else cts
                 ct_tensors = tuple(Tensor(jnp.asarray(c), _internal=True)
                                    for c in cts)
                 with autograd.no_grad():
-                    gin = cls.backward(ctx, *ct_tensors)
-                gin = (gin,) if isinstance(gin, Tensor) or gin is None else tuple(gin)
-                if len(gin) == len(inputs):
-                    # one grad per tensor input: select the differentiable ones
-                    gin = [g for g, m in zip(gin, diff_mask) if m]
+                    gin = _normalize(cls.backward(ctx, *ct_tensors))
                 out_grads = []
                 for g, t in zip(gin, diff_inputs):
                     out_grads.append(jnp.zeros_like(t._value) if g is None
                                      else g._value)
                 return out_grads
 
+            def taped_vjp(ct_tensors):
+                # create_graph path: grad mode is ON (backward()'s guard), so
+                # every taped op in the user's backward records — the
+                # returned grads are differentiable through the cotangents
+                # AND the tensors the user saved in ctx
+                gin = _normalize(cls.backward(ctx, *ct_tensors))
+                out_grads = []
+                for g, t in zip(gin, diff_inputs):
+                    if g is None:
+                        g = Tensor(jnp.zeros_like(t._value),
+                                   stop_gradient=True, _internal=True)
+                    out_grads.append(g)
+                return out_grads
+
             node = autograd.GradNode(vjp_fn, diff_inputs, len(outs), avals,
-                                     name=cls.__name__)
+                                     name=cls.__name__, taped_vjp=taped_vjp)
             for i, o in enumerate(outs):
                 o._grad_node = node
                 o._grad_slot = i

@@ -1,0 +1,103 @@
+"""paddle.onnx parity (reference: python/paddle/onnx/export.py).
+
+The reference is a thin wrapper over the external paddle2onnx converter.
+This build ships its own converter: the layer's inference forward is traced
+to a jaxpr and translated op-by-op into a real ONNX ModelProto (opset 13)
+with a hand-rolled protobuf writer — no external deps.  Models whose
+forward stays inside the supported primitive set (matmul/conv/pool/
+elementwise/normalization — see convert.py) produce a loadable `.onnx`
+file; anything else raises UnsupportedPrimitive naming the offending op.
+
+Validation story (no onnxruntime in the image): onnx/proto.py parses the
+emitted bytes back (structural round-trip) and onnx/runtime.py executes the
+parsed graph with numpy so tests compare ONNX semantics against the source
+model's forward.  jit.save (StableHLO) remains the native serving format.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .convert import UnsupportedPrimitive, convert_jaxpr  # noqa: F401
+from . import proto, runtime  # noqa: F401
+
+
+def export(layer, path, input_spec=None, opset_version=13, **configs):
+    """Export `layer` for serving.
+
+    ``path`` ending in ``.onnx`` writes a real ONNX protobuf (static shapes
+    required — give concrete dims in input_spec).  Any other path keeps the
+    native route: StableHLO via jit.save (`.pdmodel`, loadable by
+    paddle.jit.load and the inference Predictor)."""
+    from .. import jit
+
+    if not str(path).endswith(".onnx"):
+        jit.save(layer, str(path), input_spec=input_spec)
+        return str(path) + ".pdmodel"
+
+    if opset_version != 13:
+        raise ValueError(
+            f"this exporter emits opset 13 only (ReduceSum axes-as-input "
+            f"node forms); got opset_version={opset_version}")
+
+    import jax
+
+    from ..core.tensor import Tensor
+    from ..jit import _resolve_specs, _strip
+    from ..jit import StaticFunction
+    from ..nn.functional_call import _swapped_state, state_values
+    from ..nn.layer_base import Layer
+
+    if not isinstance(layer, Layer):
+        raise TypeError("onnx.export expects a Layer")
+    input_spec = _resolve_specs(layer, input_spec)
+    shapes = []
+    for s in input_spec:
+        shape = tuple(s.shape)
+        if any(d is None or (isinstance(d, int) and d < 0) for d in shape):
+            raise ValueError(
+                f"onnx export needs concrete input shapes; got {shape} — "
+                f"pass input_spec with all dims fixed (dynamic batch is a "
+                f"jit.save/StableHLO feature)")
+        shapes.append((shape, np.dtype(str(s.dtype))))
+
+    values = state_values(layer)
+    const_items = sorted(values.items())
+    const_names = [k for k, _ in const_items]
+    const_vals = [v for _, v in const_items]
+    fwd = layer.forward
+    if isinstance(fwd, StaticFunction):
+        fwd = fwd._fn
+
+    from ..core.autograd import no_grad
+
+    def fn(*args):
+        ts = tuple(Tensor(a, _internal=True) for a in args)
+        # inference export: no tape — some primitives (reduce_window) fail
+        # the eager-vjp linearization under abstract tracing
+        with no_grad(), _swapped_state(layer,
+                                       dict(zip(const_names, const_vals))):
+            out = fwd(*ts)
+        return _strip(out)
+
+    was_training = layer.training
+    if was_training:
+        layer.eval()      # export inference behavior (dropout off, BN stats)
+    try:
+        closed = jax.make_jaxpr(fn)(
+            *[jax.ShapeDtypeStruct(sh, dt) for sh, dt in shapes])
+    finally:
+        if was_training:
+            layer.train()
+
+    # consts the tracer actually captured are a subset of the state dict;
+    # match them back to parameter names by identity where possible
+    name_by_id = {id(v): k for k, v in zip(const_names, const_vals)}
+    names = [name_by_id.get(id(c)) for c in closed.consts]
+
+    model_bytes = convert_jaxpr(
+        closed, input_names=[f"input_{i}" for i in range(len(shapes))],
+        const_names=names,
+        graph_name=type(layer).__name__)
+    with open(path, "wb") as f:
+        f.write(model_bytes)
+    return str(path)

@@ -1,0 +1,432 @@
+"""jaxpr → ONNX GraphProto.
+
+The reference exports models through the external paddle2onnx converter
+(python/paddle/onnx/export.py → paddle2onnx.export over a translated
+Program).  This build has no Program→ONNX translator to borrow, but it has
+something better suited: the model's traced jaxpr.  The exporter walks the
+jaxpr equations and emits one or more ONNX nodes per lax primitive,
+recursing through call-like primitives (pjit / custom_vjp / remat), so any
+model whose inference forward lowers to the supported primitive set exports
+— the same coverage contract paddle2onnx has via its op mappers.
+
+Opset 13 is targeted (ReduceSum takes axes as an input there; ReduceMax
+still uses the attribute form).
+"""
+from __future__ import annotations
+
+import itertools
+
+import numpy as np
+
+from . import proto
+
+
+class UnsupportedPrimitive(NotImplementedError):
+    pass
+
+
+class _Builder:
+    def __init__(self):
+        self.nodes: list[bytes] = []
+        self.initializers: list[bytes] = []
+        self._init_names: set[str] = set()
+        self._counter = itertools.count()
+
+    def name(self, hint="t"):
+        return f"{hint}_{next(self._counter)}"
+
+    def add_node(self, op, inputs, outputs, attrs=b""):
+        self.nodes.append(proto.node(op, inputs, outputs,
+                                     name=self.name(op.lower()), attrs=attrs))
+
+    def add_initializer(self, arr, hint="const"):
+        nm = self.name(hint)
+        self.initializers.append(proto.tensor_proto(nm, np.asarray(arr)))
+        self._init_names.add(nm)
+        return nm
+
+    def emit(self, op, inputs, attrs=b"", n_out=1, hint=None):
+        outs = [self.name(hint or op.lower()) for _ in range(n_out)]
+        self.add_node(op, inputs, outs, attrs)
+        return outs[0] if n_out == 1 else outs
+
+
+def _ints_attr(name, vals):
+    return proto.attribute(name, [int(v) for v in vals])
+
+
+def _axes_attrs(axes, keepdims=0):
+    return _ints_attr("axes", axes) + proto.attribute("keepdims", keepdims)
+
+
+# -- primitive handlers -------------------------------------------------------
+# each: handler(builder, eqn, in_names:list[str], avals_in) -> list[str]
+
+_SIMPLE = {
+    "add": "Add", "sub": "Sub", "mul": "Mul", "div": "Div",
+    "max": "Max", "min": "Min", "pow": "Pow",
+    "neg": "Neg", "exp": "Exp", "log": "Log", "tanh": "Tanh",
+    "logistic": "Sigmoid", "sqrt": "Sqrt", "abs": "Abs", "sign": "Sign",
+    "floor": "Floor", "ceil": "Ceil", "round": "Round", "erf": "Erf",
+    "sin": "Sin", "cos": "Cos", "tan": "Tan", "asin": "Asin",
+    "acos": "Acos", "atan": "Atan", "sinh": "Sinh", "cosh": "Cosh",
+    "and": "And", "or": "Or", "not": "Not", "xor": "Xor",
+    "stop_gradient": "Identity", "copy": "Identity",
+    "device_put": "Identity",
+}
+
+_COMPARE = {"eq": ("Equal", False), "lt": ("Less", False),
+            "le": ("LessOrEqual", False), "gt": ("Greater", False),
+            "ge": ("GreaterOrEqual", False), "ne": ("Equal", True)}
+
+
+def _dot_general(b, eqn, ins, avals):
+    ((lc, rc), (lb, rb)) = eqn.params["dimension_numbers"]
+    lhs, rhs = avals
+    lr, rr = len(lhs.shape), len(rhs.shape)
+    # plain / batched matmul: contract lhs last dim with rhs dim b+0,
+    # batch dims leading and aligned — ONNX MatMul's numpy semantics
+    if (list(lb) == list(range(len(lb))) and list(rb) == list(range(len(rb)))
+            and list(lc) == [lr - 1] and list(rc) == [len(rb)]
+            and lr >= 2 and rr >= 2):
+        return [b.emit("MatMul", ins)]
+    # anything else: Einsum with an equation derived from the dim numbers
+    letters = itertools.cycle("abcdefghijklmnopqrstuvwxyz")
+    lhs_l = [next(letters) for _ in range(lr)]
+    rhs_l = [None] * rr
+    for i, j in zip(lb, rb):
+        rhs_l[j] = lhs_l[i]
+    for i, j in zip(lc, rc):
+        rhs_l[j] = lhs_l[i]
+    for j in range(rr):
+        if rhs_l[j] is None:
+            rhs_l[j] = next(letters)
+    out_l = [lhs_l[i] for i in lb] + \
+        [lhs_l[i] for i in range(lr) if i not in set(lb) | set(lc)] + \
+        [rhs_l[j] for j in range(rr) if j not in set(rb) | set(rc)]
+    eq = f"{''.join(lhs_l)},{''.join(rhs_l)}->{''.join(out_l)}"
+    return [b.emit("Einsum", ins, proto.attribute("equation", eq))]
+
+
+def _broadcast_in_dim(b, eqn, ins, avals):
+    shape = [int(d) for d in eqn.params["shape"]]
+    bcast = list(eqn.params["broadcast_dimensions"])
+    interm = [1] * len(shape)
+    for src, dst in enumerate(bcast):
+        interm[dst] = int(avals[0].shape[src])
+    cur = ins[0]
+    if list(avals[0].shape) != interm:
+        shp = b.add_initializer(np.asarray(interm, np.int64), "shape")
+        cur = b.emit("Reshape", [cur, shp])
+    if interm != shape:
+        tgt = b.add_initializer(np.asarray(shape, np.int64), "shape")
+        cur = b.emit("Expand", [cur, tgt])
+    elif cur is ins[0] and list(avals[0].shape) == interm:
+        cur = b.emit("Identity", [cur])
+    return [cur]
+
+
+def _conv(b, eqn, ins, avals):
+    p = eqn.params
+    dn = p["dimension_numbers"]
+    nd = len(avals[0].shape) - 2
+    if dn.lhs_spec != tuple(range(nd + 2)) or \
+            dn.rhs_spec != tuple(range(nd + 2)) or \
+            dn.out_spec != tuple(range(nd + 2)):
+        raise UnsupportedPrimitive(
+            "conv_general_dilated: only NCHW/OIHW layouts export to ONNX "
+            f"(got {dn})")
+    if any(d != 1 for d in p["lhs_dilation"]):
+        raise UnsupportedPrimitive(
+            "conv_general_dilated with lhs_dilation (transposed conv) is "
+            "not exported; use a ConvTranspose-free forward")
+    if p.get("batch_group_count", 1) != 1:
+        raise UnsupportedPrimitive("conv batch_group_count != 1")
+    pads = [lo for lo, _ in p["padding"]] + [hi for _, hi in p["padding"]]
+    attrs = _ints_attr("strides", p["window_strides"])
+    attrs += _ints_attr("pads", pads)
+    attrs += _ints_attr("dilations", p["rhs_dilation"])
+    attrs += proto.attribute("group", int(p.get("feature_group_count", 1)))
+    return [b.emit("Conv", ins, attrs)]
+
+
+def _reduce_window(b, eqn, ins, avals, kind):
+    p = eqn.params
+    wd = list(p["window_dimensions"])
+    ws = list(p["window_strides"])
+    pad = list(p["padding"])
+    if len(wd) < 3 or any(d != 1 for d in wd[:2]) or \
+            any(s != 1 for s in ws[:2]) or any(pad[i] != (0, 0)
+                                               for i in range(2)):
+        raise UnsupportedPrimitive(
+            f"reduce_window over non-spatial dims ({wd}) has no ONNX pool")
+    if any(d != 1 for d in p.get("window_dilation", [1] * len(wd))) or \
+            any(d != 1 for d in p.get("base_dilation", [1] * len(wd))):
+        raise UnsupportedPrimitive("dilated reduce_window")
+    kshape = wd[2:]
+    pads = [lo for lo, _ in pad[2:]] + [hi for _, hi in pad[2:]]
+    attrs = _ints_attr("kernel_shape", kshape)
+    attrs += _ints_attr("strides", ws[2:])
+    attrs += _ints_attr("pads", pads)
+    if kind == "max":
+        return [b.emit("MaxPool", ins, attrs)]
+    # reduce_window_sum == AveragePool(count_include_pad=1) * window_size
+    attrs += proto.attribute("count_include_pad", 1)
+    avg = b.emit("AveragePool", ins, attrs)
+    scale = b.add_initializer(
+        np.asarray(float(np.prod(kshape)),
+                   np.dtype(str(avals[0].dtype))), "winsize")
+    return [b.emit("Mul", [avg, scale])]
+
+
+def _pad(b, eqn, ins, avals):
+    cfg = eqn.params["padding_config"]
+    if any(interior != 0 for _, _, interior in cfg):
+        raise UnsupportedPrimitive("interior pad has no ONNX equivalent")
+    if any(lo < 0 or hi < 0 for lo, hi, _ in cfg):
+        raise UnsupportedPrimitive("negative pad (slice) not exported")
+    pads = [lo for lo, _, _ in cfg] + [hi for _, hi, _ in cfg]
+    pads_init = b.add_initializer(np.asarray(pads, np.int64), "pads")
+    return [b.emit("Pad", [ins[0], pads_init, ins[1]])]
+
+
+def _reduce(b, eqn, ins, avals, onnx_op, axes_as_input):
+    axes = [int(a) for a in eqn.params["axes"]]
+    if axes_as_input:                       # opset-13 ReduceSum form
+        ax = b.add_initializer(np.asarray(axes, np.int64), "axes")
+        return [b.emit(onnx_op, [ins[0], ax],
+                       proto.attribute("keepdims", 0))]
+    return [b.emit(onnx_op, ins, _axes_attrs(axes))]
+
+
+def convert_jaxpr(closed_jaxpr, input_names, const_names=None,
+                  graph_name="paddle_tpu_graph", output_names=None):
+    """ClosedJaxpr → serialized ONNX ModelProto bytes.
+
+    input_names name the jaxpr's invars (ONNX graph inputs); consts become
+    initializers (const_names may give them stable names, e.g. parameter
+    state-dict keys).
+    """
+    from jax._src import core as jcore
+
+    b = _Builder()
+    jaxpr = closed_jaxpr.jaxpr
+    env: dict = {}
+
+    def read(atom, hint="lit"):
+        if isinstance(atom, jcore.Literal):
+            val = np.asarray(atom.val)
+            if val.dtype == np.float64:
+                val = val.astype(np.float32)
+            if val.dtype == np.int64 and atom.aval.weak_type:
+                val = val.astype(np.int32)
+            return b.add_initializer(val, hint)
+        return env[atom]
+
+    for i, v in enumerate(jaxpr.invars):
+        env[v] = input_names[i]
+    for i, (cv, cval) in enumerate(zip(jaxpr.constvars, closed_jaxpr.consts)):
+        nm = (const_names[i] if const_names and i < len(const_names)
+              else None) or b.name("param")
+        arr = np.asarray(cval)
+        if arr.dtype not in proto.DTYPE_TO_ONNX:
+            raise UnsupportedPrimitive(
+                f"onnx export: parameter dtype {arr.dtype} (cast the model "
+                f"to float32/float16 first)")
+        b.initializers.append(proto.tensor_proto(nm, arr))
+        b._init_names.add(nm)
+        env[cv] = nm
+
+    def walk(jaxpr_inner, consts_env):
+        for eqn in jaxpr_inner.eqns:
+            _emit_eqn(eqn)
+
+    def _emit_eqn(eqn):
+        prim = str(eqn.primitive)
+        ins = [read(a) for a in eqn.invars]
+        avals = [a.aval for a in eqn.invars]
+
+        # call-like primitives: inline the inner jaxpr
+        inner = None
+        if prim in ("pjit", "closed_call", "core_call", "remat",
+                    "checkpoint", "custom_jvp_call", "custom_vjp_call",
+                    "custom_vjp_call_jaxpr", "jit"):
+            inner = (eqn.params.get("jaxpr") or eqn.params.get("call_jaxpr")
+                     or eqn.params.get("fun_jaxpr"))
+        if inner is not None:
+            ij = inner.jaxpr if hasattr(inner, "jaxpr") else inner
+            iconsts = getattr(inner, "consts", [])
+            for cv, cval in zip(ij.constvars, iconsts):
+                env[cv] = b.add_initializer(np.asarray(cval), "param")
+            # custom_vjp/jvp pass extra non-array args first sometimes;
+            # align by trailing invars
+            use_ins = ins[len(ins) - len(ij.invars):]
+            for v, nm in zip(ij.invars, use_ins):
+                env[v] = nm
+            walk(ij, None)
+            for outer_v, inner_v in zip(eqn.outvars, ij.outvars):
+                env[outer_v] = read(inner_v)
+            return
+
+        outs = None
+        if prim in _SIMPLE:
+            outs = [b.emit(_SIMPLE[prim], ins)]
+        elif prim in _COMPARE:
+            op, negate = _COMPARE[prim]
+            o = b.emit(op, ins)
+            outs = [b.emit("Not", [o])] if negate else [o]
+        elif prim == "dot_general":
+            outs = _dot_general(b, eqn, ins, avals)
+        elif prim == "broadcast_in_dim":
+            outs = _broadcast_in_dim(b, eqn, ins, avals)
+        elif prim == "reshape":
+            shp = b.add_initializer(
+                np.asarray([int(d) for d in eqn.params["new_sizes"]],
+                           np.int64), "shape")
+            outs = [b.emit("Reshape", [ins[0], shp])]
+        elif prim == "transpose":
+            outs = [b.emit("Transpose", ins,
+                           _ints_attr("perm", eqn.params["permutation"]))]
+        elif prim == "convert_element_type":
+            dt = np.dtype(eqn.params["new_dtype"])
+            outs = [b.emit("Cast", ins,
+                           proto.attribute("to",
+                                           proto.DTYPE_TO_ONNX[dt]))]
+        elif prim == "select_n":
+            if len(ins) != 3:
+                raise UnsupportedPrimitive(f"select_n with {len(ins)} cases")
+            # select_n(pred, on_false, on_true) → Where(pred, on_true, on_false)
+            outs = [b.emit("Where", [ins[0], ins[2], ins[1]])]
+        elif prim == "reduce_sum":
+            outs = _reduce(b, eqn, ins, avals, "ReduceSum", True)
+        elif prim == "reduce_max":
+            outs = _reduce(b, eqn, ins, avals, "ReduceMax", False)
+        elif prim == "reduce_min":
+            outs = _reduce(b, eqn, ins, avals, "ReduceMin", False)
+        elif prim == "reduce_prod":
+            outs = _reduce(b, eqn, ins, avals, "ReduceProd", False)
+        elif prim == "argmax":
+            axes = eqn.params["axes"]
+            a = b.emit("ArgMax", [ins[0]],
+                       proto.attribute("axis", int(axes[0])) +
+                       proto.attribute("keepdims", 0))
+            dt = np.dtype(eqn.params["index_dtype"])
+            outs = [b.emit("Cast", [a],
+                           proto.attribute("to", proto.DTYPE_TO_ONNX[dt]))]
+        elif prim == "concatenate":
+            outs = [b.emit("Concat", ins,
+                           proto.attribute("axis",
+                                           int(eqn.params["dimension"])))]
+        elif prim == "slice":
+            p = eqn.params
+            starts = b.add_initializer(
+                np.asarray(p["start_indices"], np.int64), "starts")
+            ends = b.add_initializer(
+                np.asarray(p["limit_indices"], np.int64), "ends")
+            axes_i = b.add_initializer(
+                np.asarray(range(len(p["start_indices"])), np.int64), "axes")
+            steps = b.add_initializer(
+                np.asarray(p["strides"] or [1] * len(p["start_indices"]),
+                           np.int64), "steps")
+            outs = [b.emit("Slice", [ins[0], starts, ends, axes_i, steps])]
+        elif prim == "rev":
+            # lax.rev == Slice with step -1 on the reversed dims
+            dims = list(eqn.params["dimensions"])
+            shape = avals[0].shape
+            starts = b.add_initializer(
+                np.asarray([int(shape[d]) - 1 for d in dims], np.int64),
+                "starts")
+            ends = b.add_initializer(
+                np.asarray([-(int(shape[d]) + 1) for d in dims], np.int64),
+                "ends")
+            axes_i = b.add_initializer(np.asarray(dims, np.int64), "axes")
+            steps = b.add_initializer(
+                np.asarray([-1] * len(dims), np.int64), "steps")
+            outs = [b.emit("Slice", [ins[0], starts, ends, axes_i, steps])]
+        elif prim == "rem":
+            # lax.rem is truncated remainder (sign of dividend) == fmod;
+            # ONNX Mod defaults to Python-style modulo and requires fmod=1
+            # for floats
+            outs = [b.emit("Mod", ins, proto.attribute("fmod", 1))]
+        elif prim == "rsqrt":
+            s = b.emit("Sqrt", ins)
+            outs = [b.emit("Reciprocal", [s])]
+        elif prim == "square":
+            outs = [b.emit("Mul", [ins[0], ins[0]])]
+        elif prim == "erfc":
+            e = b.emit("Erf", ins)
+            one = b.add_initializer(
+                np.asarray(1.0, np.dtype(str(avals[0].dtype))), "one")
+            outs = [b.emit("Sub", [one, e])]
+        elif prim == "log1p":
+            one = b.add_initializer(
+                np.asarray(1.0, np.dtype(str(avals[0].dtype))), "one")
+            s = b.emit("Add", [ins[0], one])
+            outs = [b.emit("Log", [s])]
+        elif prim == "expm1":
+            e = b.emit("Exp", ins)
+            one = b.add_initializer(
+                np.asarray(1.0, np.dtype(str(avals[0].dtype))), "one")
+            outs = [b.emit("Sub", [e, one])]
+        elif prim == "integer_pow":
+            y = b.add_initializer(
+                np.asarray(float(eqn.params["y"]),
+                           np.dtype(str(avals[0].dtype))), "exponent")
+            outs = [b.emit("Pow", [ins[0], y])]
+        elif prim == "conv_general_dilated":
+            outs = _conv(b, eqn, ins, avals)
+        elif prim == "reduce_window_max":
+            outs = _reduce_window(b, eqn, ins, avals, "max")
+        elif prim == "reduce_window_sum":
+            outs = _reduce_window(b, eqn, ins, avals, "sum")
+        elif prim == "reduce_window":
+            # generic form: (operand, init) + a reducer jaxpr; only a
+            # single max/add reducer maps to an ONNX pool
+            red = eqn.params["jaxpr"]
+            red = red.jaxpr if hasattr(red, "jaxpr") else red
+            kind = (str(red.eqns[0].primitive)
+                    if len(red.eqns) == 1 else None)
+            if kind not in ("max", "add"):
+                raise UnsupportedPrimitive(
+                    f"reduce_window with reducer {kind!r}")
+            outs = _reduce_window(b, eqn, ins[:1], avals[:1],
+                                  "max" if kind == "max" else "sum")
+        elif prim == "pad":
+            outs = _pad(b, eqn, ins, avals)
+        elif prim == "iota":
+            arr = np.reshape(
+                np.arange(eqn.params["shape"][eqn.params["dimension"]],
+                          dtype=np.dtype(eqn.params["dtype"])),
+                [-1 if i == eqn.params["dimension"] else 1
+                 for i in range(len(eqn.params["shape"]))])
+            arr = np.broadcast_to(arr, eqn.params["shape"]).copy()
+            outs = [b.emit("Identity",
+                           [b.add_initializer(arr, "iota")])]
+        else:
+            raise UnsupportedPrimitive(
+                f"onnx export: primitive {prim!r} has no ONNX mapping; "
+                f"supported set: {sorted(_SIMPLE) + ['dot_general', 'conv', 'pool', 'reduce', 'reshape', 'transpose', 'select_n', '...']}")
+        for v, nm in zip(eqn.outvars, outs):
+            env[v] = nm
+
+    walk(jaxpr, None)
+
+    inputs_vi = [proto.value_info(input_names[i], np.dtype(v.aval.dtype),
+                                  [int(d) for d in v.aval.shape])
+                 for i, v in enumerate(jaxpr.invars)]
+    out_names = []
+    outputs_vi = []
+    for i, v in enumerate(jaxpr.outvars):
+        nm = read(v, "out")
+        want = (output_names[i] if output_names and i < len(output_names)
+                else f"output_{i}")
+        # always re-alias through Identity so graph outputs have stable
+        # names even when the outvar is an input/initializer/literal
+        b.add_node("Identity", [nm], [want])
+        out_names.append(want)
+        outputs_vi.append(proto.value_info(
+            want, np.dtype(v.aval.dtype), [int(d) for d in v.aval.shape]))
+
+    g = proto.graph(b.nodes, graph_name, b.initializers, inputs_vi,
+                    outputs_vi)
+    return proto.model(g)

@@ -1,0 +1,271 @@
+"""Minimal ONNX protobuf wire-format writer/reader — no external deps.
+
+The reference's paddle.onnx.export delegates to the external paddle2onnx
+package (python/paddle/onnx/export.py); this build instead serializes the
+ModelProto directly.  Only the message fields the exporter emits are
+implemented, against the onnx.proto3 field numbers (ONNX IR v8 / opset 13).
+
+Wire format recap (developers.google.com/protocol-buffers/docs/encoding):
+tag = (field_number << 3) | wire_type; wire types used here are 0 (varint)
+and 2 (length-delimited).  Floats/doubles ride in raw_data bytes, so wire
+type 5/1 is never needed by the writer; the reader still decodes them for
+round-trip completeness.
+"""
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+# -- onnx.TensorProto.DataType enum (onnx/onnx.proto3) ------------------------
+DTYPE_TO_ONNX = {
+    np.dtype(np.float32): 1, np.dtype(np.uint8): 2, np.dtype(np.int8): 3,
+    np.dtype(np.uint16): 4, np.dtype(np.int16): 5, np.dtype(np.int32): 6,
+    np.dtype(np.int64): 7, np.dtype(np.bool_): 9, np.dtype(np.float16): 10,
+    np.dtype(np.float64): 11, np.dtype(np.uint32): 12,
+    np.dtype(np.uint64): 13,
+}
+ONNX_TO_DTYPE = {v: k for k, v in DTYPE_TO_ONNX.items()}
+BFLOAT16_ONNX = 16
+
+
+# -- writer -------------------------------------------------------------------
+
+def _varint(n: int) -> bytes:
+    if n < 0:                      # proto3 int64: 10-byte two's complement
+        n += 1 << 64
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def field_varint(num: int, value: int) -> bytes:
+    return _varint(num << 3) + _varint(value)
+
+
+def field_bytes(num: int, payload: bytes) -> bytes:
+    return _varint((num << 3) | 2) + _varint(len(payload)) + payload
+
+
+def field_string(num: int, s: str) -> bytes:
+    return field_bytes(num, s.encode("utf-8"))
+
+
+def tensor_proto(name: str, arr: np.ndarray) -> bytes:
+    """TensorProto: dims=1, data_type=2, name=8, raw_data=9."""
+    arr = np.ascontiguousarray(arr)
+    if arr.dtype not in DTYPE_TO_ONNX:
+        raise NotImplementedError(f"onnx export: dtype {arr.dtype}")
+    out = b"".join(field_varint(1, int(d)) for d in arr.shape)
+    out += field_varint(2, DTYPE_TO_ONNX[arr.dtype])
+    out += field_string(8, name)
+    out += field_bytes(9, arr.tobytes())
+    return out
+
+
+def _tensor_shape(shape) -> bytes:
+    """TensorShapeProto: dim=1 (Dim: dim_value=1, dim_param=2)."""
+    dims = b""
+    for d in shape:
+        if isinstance(d, int):
+            dims += field_bytes(1, field_varint(1, d))
+        else:                      # symbolic dim name
+            dims += field_bytes(1, field_string(2, str(d)))
+    return dims
+
+
+def value_info(name: str, dtype: np.dtype, shape) -> bytes:
+    """ValueInfoProto: name=1, type=2; TypeProto.tensor_type=1
+    (elem_type=1, shape=2)."""
+    tt = field_varint(1, DTYPE_TO_ONNX[np.dtype(dtype)])
+    tt += field_bytes(2, _tensor_shape(shape))
+    return field_string(1, name) + field_bytes(2, field_bytes(1, tt))
+
+
+# AttributeProto.AttributeType enum values
+_ATTR_FLOAT, _ATTR_INT, _ATTR_STRING, _ATTR_TENSOR = 1, 2, 3, 4
+_ATTR_FLOATS, _ATTR_INTS, _ATTR_STRINGS = 6, 7, 8
+
+
+def attribute(name: str, value) -> bytes:
+    """One NodeProto attribute, returned already wrapped as NodeProto
+    field 5 so handlers can concatenate attributes freely.
+    AttributeProto: name=1, f=2, i=3, s=4, t=5, floats=7, ints=8,
+    strings=9, type=20."""
+    out = field_string(1, name)
+    if isinstance(value, bool):
+        out += field_varint(3, int(value)) + field_varint(20, _ATTR_INT)
+    elif isinstance(value, int):
+        out += field_varint(3, value) + field_varint(20, _ATTR_INT)
+    elif isinstance(value, float):
+        out += _varint((2 << 3) | 5) + struct.pack("<f", value)
+        out += field_varint(20, _ATTR_FLOAT)
+    elif isinstance(value, str):
+        out += field_bytes(4, value.encode()) + field_varint(20, _ATTR_STRING)
+    elif isinstance(value, np.ndarray):
+        out += field_bytes(5, tensor_proto("", value))
+        out += field_varint(20, _ATTR_TENSOR)
+    elif isinstance(value, (list, tuple)) and all(
+            isinstance(v, int) for v in value):
+        for v in value:
+            out += field_varint(8, v)
+        out += field_varint(20, _ATTR_INTS)
+    else:
+        raise NotImplementedError(f"onnx attribute {name}={value!r}")
+    return field_bytes(5, out)
+
+
+def node(op_type: str, inputs, outputs, name: str = "",
+         attrs: bytes = b"") -> bytes:
+    """NodeProto: input=1, output=2, name=3, op_type=4, attribute=5."""
+    out = b"".join(field_string(1, i) for i in inputs)
+    out += b"".join(field_string(2, o) for o in outputs)
+    if name:
+        out += field_string(3, name)
+    out += field_string(4, op_type)
+    out += attrs
+    return out
+
+
+def graph(nodes, name, initializers, inputs, outputs) -> bytes:
+    """GraphProto: node=1, name=2, initializer=5, input=11, output=12."""
+    out = b"".join(field_bytes(1, n) for n in nodes)
+    out += field_string(2, name)
+    out += b"".join(field_bytes(5, t) for t in initializers)
+    out += b"".join(field_bytes(11, i) for i in inputs)
+    out += b"".join(field_bytes(12, o) for o in outputs)
+    return out
+
+
+def model(graph_bytes: bytes, opset: int = 13,
+          producer: str = "paddle_tpu") -> bytes:
+    """ModelProto: ir_version=1, producer_name=2, graph=7, opset_import=8
+    (OperatorSetIdProto: domain=1, version=2)."""
+    out = field_varint(1, 8)                        # IR version 8
+    out += field_string(2, producer)
+    out += field_bytes(7, graph_bytes)
+    out += field_bytes(8, field_string(1, "") + field_varint(2, opset))
+    return out
+
+
+# -- reader (round-trip validation; generic field walker) ---------------------
+
+def _read_varint(buf: bytes, pos: int):
+    result = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def parse(buf: bytes):
+    """Decode one message into {field_number: [values]}; length-delimited
+    payloads stay raw bytes (caller re-parses known submessages)."""
+    out: dict[int, list] = {}
+    pos = 0
+    while pos < len(buf):
+        tag, pos = _read_varint(buf, pos)
+        num, wt = tag >> 3, tag & 7
+        if wt == 0:
+            val, pos = _read_varint(buf, pos)
+        elif wt == 2:
+            ln, pos = _read_varint(buf, pos)
+            val = buf[pos:pos + ln]
+            pos += ln
+        elif wt == 5:
+            val = struct.unpack("<f", buf[pos:pos + 4])[0]
+            pos += 4
+        elif wt == 1:
+            val = struct.unpack("<d", buf[pos:pos + 8])[0]
+            pos += 8
+        else:
+            raise ValueError(f"unsupported wire type {wt}")
+        out.setdefault(num, []).append(val)
+    return out
+
+
+def parse_tensor(buf: bytes):
+    """TensorProto bytes → (name, ndarray)."""
+    f = parse(buf)
+    dims = [int(d) for d in f.get(1, [])]
+    dt = ONNX_TO_DTYPE[f[2][0]]
+    name = f.get(8, [b""])[0].decode()
+    arr = np.frombuffer(f[9][0], dtype=dt).reshape(dims) if 9 in f else \
+        np.zeros(dims, dt)
+    return name, arr
+
+
+def parse_attribute(buf: bytes):
+    """AttributeProto bytes → (name, python value)."""
+    f = parse(buf)
+    name = f[1][0].decode()
+    atype = f.get(20, [0])[0]
+    if atype == _ATTR_INT:
+        return name, int(f[3][0]) - ((1 << 64) if f[3][0] >> 63 else 0)
+    if atype == _ATTR_FLOAT:
+        return name, float(f[2][0])
+    if atype == _ATTR_STRING:
+        return name, f[4][0].decode()
+    if atype == _ATTR_TENSOR:
+        return name, parse_tensor(f[5][0])[1]
+    if atype == _ATTR_INTS:
+        return name, [int(v) - ((1 << 64) if v >> 63 else 0)
+                      for v in f.get(8, [])]
+    raise NotImplementedError(f"attribute type {atype}")
+
+
+def parse_value_info(buf: bytes):
+    """ValueInfoProto bytes → (name, dtype, shape list[int|str])."""
+    f = parse(buf)
+    name = f[1][0].decode()
+    tt = parse(parse(f[2][0])[1][0])
+    elem = ONNX_TO_DTYPE[tt[1][0]]
+    shape = []
+    if 2 in tt:
+        for dim_buf in parse(tt[2][0]).get(1, []):
+            d = parse(dim_buf)
+            shape.append(int(d[1][0]) if 1 in d else d[2][0].decode())
+    return name, elem, shape
+
+
+def parse_node(buf: bytes):
+    """NodeProto bytes → dict(op_type, inputs, outputs, name, attrs)."""
+    f = parse(buf)
+    return {
+        "op_type": f[4][0].decode(),
+        "inputs": [b.decode() for b in f.get(1, [])],
+        "outputs": [b.decode() for b in f.get(2, [])],
+        "name": f.get(3, [b""])[0].decode(),
+        "attrs": dict(parse_attribute(a) for a in f.get(5, [])),
+    }
+
+
+def parse_model(buf: bytes):
+    """ModelProto bytes → dict with ir_version, opset, graph dict."""
+    f = parse(buf)
+    g = parse(f[7][0])
+    opsets = []
+    for o in f.get(8, []):
+        of = parse(o)
+        opsets.append((of.get(1, [b""])[0].decode(), int(of[2][0])))
+    return {
+        "ir_version": int(f[1][0]),
+        "producer": f.get(2, [b""])[0].decode(),
+        "opsets": opsets,
+        "graph": {
+            "name": g.get(2, [b""])[0].decode(),
+            "nodes": [parse_node(n) for n in g.get(1, [])],
+            "initializers": dict(parse_tensor(t) for t in g.get(5, [])),
+            "inputs": [parse_value_info(v) for v in g.get(11, [])],
+            "outputs": [parse_value_info(v) for v in g.get(12, [])],
+        },
+    }

@@ -1,0 +1,207 @@
+"""Reference evaluator for exported ONNX graphs — numpy only.
+
+No ONNX runtime ships in this build, so exported models are validated by
+executing the parsed GraphProto with numpy and comparing against the source
+model's own forward.  Covers exactly the op set convert.py emits; it is a
+test/verification tool, not a serving engine (serve via the inference
+Predictor over jit.save artifacts)."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from . import proto
+
+_erf = np.vectorize(math.erf, otypes=[np.float64])
+
+
+def _pool_view(x, kshape, strides, pads, fill):
+    """Sliding windows over the trailing spatial dims of NC(H)W input →
+    array of shape (*x_nc, *out_spatial, *kshape)."""
+    nd = len(kshape)
+    pad_width = [(0, 0)] * (x.ndim - nd) + [(lo, hi) for lo, hi in pads]
+    xp = np.pad(x, pad_width, constant_values=fill)
+    from numpy.lib.stride_tricks import sliding_window_view
+    win = sliding_window_view(xp, kshape, axis=tuple(range(x.ndim - nd,
+                                                           x.ndim)))
+    idx = (slice(None),) * (x.ndim - nd) + tuple(
+        slice(None, None, s) for s in strides)
+    return win[idx]
+
+
+def _conv(x, w, strides, pads, dilations, group):
+    n, cin, *spatial = x.shape
+    cout, cin_g, *kshape = w.shape
+    nd = len(kshape)
+    x = np.pad(x, [(0, 0), (0, 0)] + [(lo, hi) for lo, hi in
+                                      zip(pads[:nd], pads[nd:])])
+    out_sp = [(x.shape[2 + i] - (kshape[i] - 1) * dilations[i] - 1)
+              // strides[i] + 1 for i in range(nd)]
+    out = np.zeros((n, cout) + tuple(out_sp), np.result_type(x, w))
+    cpg_out = cout // group
+    for g in range(group):
+        xs = x[:, g * cin_g:(g + 1) * cin_g]
+        wsl = w[g * cpg_out:(g + 1) * cpg_out]
+        for kidx in np.ndindex(*kshape):
+            sl = (slice(None), slice(None)) + tuple(
+                slice(kidx[i] * dilations[i],
+                      kidx[i] * dilations[i] + out_sp[i] * strides[i],
+                      strides[i]) for i in range(nd))
+            patch = xs[sl]                      # n, cin_g, *out_sp
+            wk = wsl[(slice(None), slice(None)) + kidx]   # cpg_out, cin_g
+            out[:, g * cpg_out:(g + 1) * cpg_out] += np.einsum(
+                "nc...,oc->no...", patch, wk)
+    return out
+
+
+def run(model_bytes: bytes, feeds: dict[str, np.ndarray]):
+    """Execute a serialized ModelProto on numpy inputs; returns the list of
+    graph outputs in declaration order."""
+    m = proto.parse_model(model_bytes)
+    g = m["graph"]
+    env: dict[str, np.ndarray] = dict(g["initializers"])
+    for name, dtype, shape in g["inputs"]:
+        if name not in feeds:
+            raise KeyError(f"missing graph input {name!r}")
+        env[name] = np.asarray(feeds[name], dtype)
+
+    for nd in g["nodes"]:
+        op = nd["op_type"]
+        a = nd["attrs"]
+        x = [env[i] for i in nd["inputs"] if i]
+        out = None
+        if op == "Identity":
+            out = x[0]
+        elif op in ("Add", "Sub", "Mul", "Div", "Pow", "Mod"):
+            fn = {"Add": np.add, "Sub": np.subtract, "Mul": np.multiply,
+                  "Div": np.divide, "Pow": np.power, "Mod": np.fmod}[op]
+            if op == "Div" and np.issubdtype(x[0].dtype, np.integer):
+                out = (x[0] // x[1]).astype(x[0].dtype)
+            else:
+                out = fn(x[0], x[1]).astype(
+                    np.result_type(x[0], x[1]), copy=False)
+        elif op in ("Max", "Min"):
+            fn = np.maximum if op == "Max" else np.minimum
+            out = x[0]
+            for other in x[1:]:
+                out = fn(out, other)
+        elif op in ("Neg", "Exp", "Log", "Tanh", "Sqrt", "Abs", "Sign",
+                    "Floor", "Ceil", "Round", "Sin", "Cos", "Tan", "Asin",
+                    "Acos", "Atan", "Sinh", "Cosh", "Reciprocal"):
+            fn = {"Neg": np.negative, "Exp": np.exp, "Log": np.log,
+                  "Tanh": np.tanh, "Sqrt": np.sqrt, "Abs": np.abs,
+                  "Sign": np.sign, "Floor": np.floor, "Ceil": np.ceil,
+                  "Round": np.round, "Sin": np.sin, "Cos": np.cos,
+                  "Tan": np.tan, "Asin": np.arcsin, "Acos": np.arccos,
+                  "Atan": np.arctan, "Sinh": np.sinh, "Cosh": np.cosh,
+                  "Reciprocal": np.reciprocal}[op]
+            out = fn(x[0]).astype(x[0].dtype, copy=False)
+        elif op == "Sigmoid":
+            out = (1.0 / (1.0 + np.exp(-x[0].astype(np.float64)))).astype(
+                x[0].dtype)
+        elif op == "Erf":
+            out = _erf(x[0].astype(np.float64)).astype(x[0].dtype)
+        elif op in ("And", "Or", "Xor"):
+            fn = {"And": np.logical_and, "Or": np.logical_or,
+                  "Xor": np.logical_xor}[op]
+            out = fn(x[0], x[1])
+        elif op == "Not":
+            out = np.logical_not(x[0])
+        elif op in ("Equal", "Less", "LessOrEqual", "Greater",
+                    "GreaterOrEqual"):
+            fn = {"Equal": np.equal, "Less": np.less,
+                  "LessOrEqual": np.less_equal, "Greater": np.greater,
+                  "GreaterOrEqual": np.greater_equal}[op]
+            out = fn(x[0], x[1])
+        elif op == "Where":
+            out = np.where(x[0], x[1], x[2])
+        elif op == "MatMul":
+            out = np.matmul(x[0], x[1])
+        elif op == "Einsum":
+            out = np.einsum(a["equation"], *x)
+        elif op == "Reshape":
+            out = x[0].reshape([int(d) for d in x[1]])
+        elif op == "Expand":
+            out = np.broadcast_to(x[0], [int(d) for d in x[1]]).copy()
+        elif op == "Transpose":
+            out = np.transpose(x[0], a.get("perm"))
+        elif op == "Cast":
+            out = x[0].astype(proto.ONNX_TO_DTYPE[a["to"]])
+        elif op == "Concat":
+            out = np.concatenate(x, axis=a["axis"])
+        elif op == "Slice":
+            starts, ends, axes, steps = (x[1], x[2],
+                                         x[3] if len(x) > 3 else None,
+                                         x[4] if len(x) > 4 else None)
+            axes = axes if axes is not None else np.arange(len(starts))
+            steps = steps if steps is not None else np.ones(len(starts),
+                                                            np.int64)
+            sl = [slice(None)] * x[0].ndim
+            for s, e, ax, st in zip(starts, ends, axes, steps):
+                s, e, st = int(s), int(e), int(st)
+                dim = x[0].shape[int(ax)]
+                if st > 0:
+                    e = min(e, dim)
+                else:
+                    e = None if e < -dim else e
+                sl[int(ax)] = slice(s, e, st)
+            out = x[0][tuple(sl)]
+        elif op == "ReduceSum":
+            axes = tuple(int(v) for v in x[1]) if len(x) > 1 else None
+            out = x[0].sum(axis=axes, keepdims=bool(a.get("keepdims", 1)),
+                           dtype=x[0].dtype)
+        elif op in ("ReduceMax", "ReduceMin", "ReduceProd", "ReduceMean"):
+            fn = {"ReduceMax": np.max, "ReduceMin": np.min,
+                  "ReduceProd": np.prod, "ReduceMean": np.mean}[op]
+            axes = tuple(a["axes"]) if "axes" in a else None
+            out = fn(x[0], axis=axes,
+                     keepdims=bool(a.get("keepdims", 1))).astype(x[0].dtype)
+        elif op == "ArgMax":
+            out = np.argmax(x[0], axis=a.get("axis", 0))
+            if a.get("keepdims", 1):
+                out = np.expand_dims(out, a.get("axis", 0))
+            out = out.astype(np.int64)
+        elif op == "Conv":
+            kshape = a["kernel_shape"] if "kernel_shape" in a else \
+                list(x[1].shape[2:])
+            nd2 = len(kshape)
+            out = _conv(x[0], x[1],
+                        a.get("strides", [1] * nd2),
+                        a.get("pads", [0] * 2 * nd2),
+                        a.get("dilations", [1] * nd2),
+                        a.get("group", 1))
+            if len(x) > 2:      # bias
+                out = out + x[2].reshape((1, -1) + (1,) * nd2)
+            out = out.astype(x[0].dtype, copy=False)
+        elif op == "MaxPool":
+            k = a["kernel_shape"]
+            nd2 = len(k)
+            pads = a.get("pads", [0] * 2 * nd2)
+            win = _pool_view(x[0], k, a.get("strides", [1] * nd2),
+                             list(zip(pads[:nd2], pads[nd2:])),
+                             -np.inf if np.issubdtype(
+                                 x[0].dtype, np.floating)
+                             else np.iinfo(x[0].dtype).min)
+            out = win.max(axis=tuple(range(-nd2, 0))).astype(x[0].dtype)
+        elif op == "AveragePool":
+            k = a["kernel_shape"]
+            nd2 = len(k)
+            pads = a.get("pads", [0] * 2 * nd2)
+            if not a.get("count_include_pad", 0) and any(pads):
+                raise NotImplementedError(
+                    "AveragePool count_include_pad=0 with padding")
+            win = _pool_view(x[0], k, a.get("strides", [1] * nd2),
+                             list(zip(pads[:nd2], pads[nd2:])), 0)
+            out = win.mean(axis=tuple(range(-nd2, 0))).astype(x[0].dtype)
+        elif op == "Pad":
+            pads = [int(v) for v in x[1]]
+            nd2 = x[0].ndim
+            cval = x[2] if len(x) > 2 else 0
+            out = np.pad(x[0], list(zip(pads[:nd2], pads[nd2:])),
+                         constant_values=cval)
+        else:
+            raise NotImplementedError(f"onnx runtime: op {op!r}")
+        for o_name in nd["outputs"]:
+            env[o_name] = out
+    return [env[name] for name, _, _ in g["outputs"]]

@@ -339,3 +339,78 @@ def test_selected_rows_then_taped_grad_accumulation():
     (gg,) = paddle.grad(w._grad.sum(), w)
     np.testing.assert_allclose(gg.numpy(), np.full_like(prev_dense, 2.0),
                                rtol=1e-6)
+
+
+def test_pylayer_double_backward_matches_closed_form():
+    """Round-5 verdict ask #8: create_graph through a PyLayer whose user
+    backward is built from taped ops (reference: codegen'd differentiable
+    grad nodes, eager/backward.cc:105)."""
+    from paddle_tpu.autograd import PyLayer
+
+    class Cube(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x ** 3
+
+        @staticmethod
+        def backward(ctx, g):
+            (x,) = ctx.saved_tensor()
+            return 3.0 * x ** 2 * g
+
+    x = paddle.to_tensor(np.array([0.7, -1.3, 2.1], np.float32))
+    x.stop_gradient = False
+    y = Cube.apply(x).sum()
+    (gx,) = paddle.grad(y, x, create_graph=True)
+    np.testing.assert_allclose(gx.numpy(), 3 * x.numpy() ** 2, rtol=1e-6)
+    # d/dx (gx**2).sum() = 2*(3x^2)*(6x) = 36 x^3
+    penalty = (gx ** 2).sum()
+    (gp,) = paddle.grad(penalty, x)
+    np.testing.assert_allclose(gp.numpy(), 36 * x.numpy() ** 3, rtol=1e-5)
+
+
+def test_pylayer_gradient_penalty_matches_finite_differences():
+    """Gradient penalty through a custom PyLayer activation inside a small
+    net — the full WGAN-GP pattern — checked against finite differences."""
+    from paddle_tpu.autograd import PyLayer
+
+    class SoftAbs(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return (x ** 2 + 1e-2) ** 0.5
+
+        @staticmethod
+        def backward(ctx, g):
+            (x,) = ctx.saved_tensor()
+            return g * x * (x ** 2 + 1e-2) ** -0.5
+
+    w = np.random.RandomState(5).randn(3, 3).astype(np.float64)
+
+    def penalty(w_np):
+        wt = paddle.to_tensor(w_np.astype(np.float64))
+        wt.stop_gradient = False
+        x = paddle.to_tensor(
+            np.random.RandomState(1).randn(4, 3).astype(np.float64))
+        out = SoftAbs.apply(paddle.matmul(x, wt)).sum()
+        (gw,) = paddle.grad(out, wt, create_graph=True)
+        return (gw ** 2).sum()
+
+    loss = penalty(w)
+    wt = paddle.to_tensor(w)
+    wt.stop_gradient = False
+    x = paddle.to_tensor(
+        np.random.RandomState(1).randn(4, 3).astype(np.float64))
+    out = SoftAbs.apply(paddle.matmul(x, wt)).sum()
+    (gw,) = paddle.grad(out, wt, create_graph=True)
+    (gp,) = paddle.grad((gw ** 2).sum(), wt)
+
+    eps = 1e-6
+    fd = np.zeros_like(w)
+    for i in range(3):
+        for j in range(3):
+            wp, wm = w.copy(), w.copy()
+            wp[i, j] += eps
+            wm[i, j] -= eps
+            fd[i, j] = (float(penalty(wp)) - float(penalty(wm))) / (2 * eps)
+    np.testing.assert_allclose(gp.numpy(), fd, rtol=1e-4, atol=1e-6)

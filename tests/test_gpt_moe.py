@@ -99,3 +99,16 @@ def test_gpt_moe_compiled_spmd_step():
     losses = [float(step(ids[:, :-1], ids[:, 1:])) for _ in range(4)]
     assert all(np.isfinite(l) for l in losses)
     assert losses[-1] < losses[0]
+
+
+def test_gpt_moe_mlp_smoke():
+    """Smoke tier (r5 guard): MoE layer construction — expert count and
+    gate validation — without a compiled forward."""
+    from paddle_tpu.models import gpt_config
+    paddle.seed(0)
+    cfg = gpt_config("gpt-tiny", moe_num_experts=4)
+    mlp = GPTMoEMLP(cfg)
+    assert len(mlp.moe.experts) == 4
+    with pytest.raises(ValueError, match="moe_top_k"):
+        GPTMoEMLP(gpt_config("gpt-tiny", moe_num_experts=4,
+                             moe_gate="switch", moe_top_k=3))

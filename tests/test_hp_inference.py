@@ -68,3 +68,19 @@ def test_model_mode_restored(tiny_gpt):
     helper.generate(np.array([[1]], np.int64), max_new_tokens=1)
     assert model.training
     model.eval()
+
+
+def test_sample_helper_smoke():
+    """Smoke tier (r5 guard): the numpy sampling kernel — greedy argmax at
+    temperature 0 and top-k masking — without building a model."""
+    logits = np.array([[0.1, 3.0, 0.2, 2.9], [5.0, 0.0, 0.0, 0.0]],
+                      np.float32)
+    rng = np.random.RandomState(0)
+    greedy = HybridParallelInferenceHelper._sample(logits, 0.0, 0, rng)
+    np.testing.assert_array_equal(greedy, [1, 0])
+    # top_k=2 masks everything but the two best logits per row
+    for _ in range(20):
+        s = HybridParallelInferenceHelper._sample(logits, 1.0, 2, rng)
+        assert s[0] in (1, 3)
+    s = HybridParallelInferenceHelper._sample(logits, 1.0, 1, rng)
+    np.testing.assert_array_equal(s, [1, 0])

@@ -359,3 +359,31 @@ print(f"RANK{rank} DONE loss={float(final.numpy()):.6f}", flush=True)
     import re
     resumed = [int(m) for m in re.findall(r"RESUMED at (\d+)", logs)]
     assert all(r >= 3 for r in resumed), resumed
+
+
+def test_single_process_env_contract_smoke():
+    """Smoke tier (r5 guard): the worker env contract in-process at world
+    size 1 — init_parallel_env + fleet dp mesh + one jitted train step —
+    without spawning subprocesses."""
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed import fleet
+
+    dist.init_parallel_env()
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": 2, "mp_degree": 1, "pp_degree": 1,
+                        "sharding_degree": 1}
+    fleet.init(is_collective=True, strategy=s)
+    mesh = fleet.get_hybrid_communicate_group().get_mesh()
+    assert mesh is not None and mesh.shape["dp"] == 2
+    paddle.seed(0)
+    net = nn.Linear(4, 2)
+    opt = paddle.optimizer.SGD(parameters=net.parameters(),
+                               learning_rate=0.1)
+    step = dist.make_train_step(net, opt, loss_fn=nn.MSELoss(), mesh=mesh)
+    rng = np.random.RandomState(0)
+    x = rng.standard_normal((4, 4)).astype("float32")
+    y = rng.standard_normal((4, 2)).astype("float32")
+    losses = [float(step(x, y)) for _ in range(3)]
+    assert losses[-1] < losses[0]

@@ -1,0 +1,112 @@
+"""paddle.onnx.export — real ONNX protobuf emission (round-5 verdict ask
+#7).  Reference surface: python/paddle/onnx/export.py (a paddle2onnx
+wrapper); here the exporter is in-tree (jaxpr → opset-13 ModelProto, no
+external deps) and validated two ways: structural round-trip through the
+wire-format parser and numeric execution of the parsed graph with the
+numpy reference evaluator."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.onnx import UnsupportedPrimitive, export, proto, runtime
+from paddle_tpu.static import InputSpec
+
+
+def _roundtrip(model, spec, path, rtol=1e-5, atol=1e-6):
+    model.eval()
+    p = export(model, str(path), input_spec=[spec])
+    raw = open(p, "rb").read()
+    rng = np.random.RandomState(0)
+    x = rng.standard_normal(spec.shape).astype(str(spec.dtype))
+    (got,) = runtime.run(raw, {"input_0": x})
+    want = model(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(got, want, rtol=rtol, atol=atol)
+    return raw
+
+
+def test_mlp_export_structure_and_numerics(tmp_path):
+    paddle.seed(0)
+    m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 3),
+                      nn.Softmax())
+    raw = _roundtrip(m, InputSpec([2, 4], "float32"),
+                     tmp_path / "mlp.onnx")
+    parsed = proto.parse_model(raw)
+    assert parsed["ir_version"] == 8
+    assert parsed["opsets"] == [("", 13)]
+    g = parsed["graph"]
+    assert [n for n, _, _ in g["inputs"]] == ["input_0"]
+    assert [n for n, _, _ in g["outputs"]] == ["output_0"]
+    # the Linear parameters ride as named initializers
+    weight_inits = [k for k in g["initializers"] if "weight" in k]
+    assert len(weight_inits) == 2, sorted(g["initializers"])
+    ops = {n["op_type"] for n in g["nodes"]}
+    assert {"MatMul", "Add"} <= ops
+    # every node input resolves (no dangling names)
+    known = set(g["initializers"]) | {n for n, _, _ in g["inputs"]}
+    for node in g["nodes"]:
+        for i in node["inputs"]:
+            assert i in known, (i, node)
+        known.update(node["outputs"])
+
+
+def test_convnet_export_numerics(tmp_path):
+    paddle.seed(1)
+    m = nn.Sequential(nn.Conv2D(3, 8, 3, padding=1), nn.BatchNorm2D(8),
+                      nn.ReLU(), nn.MaxPool2D(2), nn.Flatten(),
+                      nn.Linear(8 * 16, 10))
+    raw = _roundtrip(m, InputSpec([2, 3, 8, 8], "float32"),
+                     tmp_path / "conv.onnx")
+    ops = {n["op_type"]
+           for n in proto.parse_model(raw)["graph"]["nodes"]}
+    assert {"Conv", "MaxPool"} <= ops
+
+
+def test_grouped_conv_avgpool_export(tmp_path):
+    paddle.seed(4)
+    m = nn.Sequential(nn.Conv2D(4, 8, 3, groups=2), nn.AvgPool2D(2),
+                      nn.Flatten(), nn.Linear(8 * 9, 5))
+    _roundtrip(m, InputSpec([1, 4, 8, 8], "float32"),
+               tmp_path / "g.onnx")
+
+
+def test_transformer_encoder_export(tmp_path):
+    paddle.seed(2)
+    m = nn.TransformerEncoderLayer(d_model=16, nhead=2,
+                                   dim_feedforward=32, dropout=0.0)
+    _roundtrip(m, InputSpec([2, 6, 16], "float32"),
+               tmp_path / "enc.onnx", rtol=1e-4, atol=1e-5)
+
+
+def test_unsupported_primitive_raises(tmp_path):
+    class TopK(nn.Layer):
+        def forward(self, x):
+            vals, _ = paddle.topk(x, 2)
+            return vals
+
+    with pytest.raises(NotImplementedError):
+        export(TopK(), str(tmp_path / "t.onnx"),
+               input_spec=[InputSpec([2, 5], "float32")])
+
+
+def test_dynamic_dims_rejected(tmp_path):
+    m = nn.Linear(4, 2)
+    with pytest.raises(ValueError, match="concrete input shapes"):
+        export(m, str(tmp_path / "d.onnx"),
+               input_spec=[InputSpec([None, 4], "float32")])
+
+
+def test_non_onnx_path_routes_to_jit_save(tmp_path):
+    m = nn.Linear(4, 2)
+    out = export(m, str(tmp_path / "native"),
+                 input_spec=[InputSpec([3, 4], "float32")])
+    assert out.endswith(".pdmodel")
+    import os
+    assert os.path.exists(out)
+    loaded = paddle.jit.load(str(tmp_path / "native"))
+    x = np.random.RandomState(0).standard_normal((3, 4)).astype("float32")
+    m.eval()
+    got = loaded(x)
+    got = got.numpy() if hasattr(got, "numpy") else np.asarray(got)
+    np.testing.assert_allclose(got, m(paddle.to_tensor(x)).numpy(),
+                               rtol=1e-5)

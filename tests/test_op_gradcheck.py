@@ -156,3 +156,9 @@ def _numeric_grad_body(op_name):
                     err_msg=f"{op_name} arg{ai}[{idx}] (reproduced twice)")
             checked += 1
     assert checked > 0, f"{op_name}: nothing checked"
+
+
+def test_numeric_grad_smoke():
+    """Smoke tier (r5 guard): one cheap op through the same coordinate
+    prober the parametrized sweep uses."""
+    test_numeric_grad("tanh")

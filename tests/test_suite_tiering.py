@@ -1,0 +1,59 @@
+"""Smoke-tier coverage guard (round-5 verdict ask #9): the two-tier suite
+(conftest.pytest_collection_modifyitems + tests/slow_tests.txt) must keep at
+least one smoke-tier test per file, so subsystem coverage can't silently
+migrate entirely into the CI-only slow tier as tests get re-tiered by
+tools/retier_tests.py."""
+import ast
+import pathlib
+
+TESTS_DIR = pathlib.Path(__file__).parent
+
+# Files allowed to have zero smoke-tier tests.  Keep this empty: if a
+# retier run empties a file's smoke tier, add a cheap *_smoke test to the
+# file instead of listing it here.
+NO_SMOKE_EXCEPTIONS: set[str] = set()
+
+
+def _slow_bases():
+    listing = TESTS_DIR / "slow_tests.txt"
+    return {line.strip() for line in listing.read_text().splitlines()
+            if line.strip() and not line.startswith("#")}
+
+
+def _test_functions(path):
+    tree = ast.parse(path.read_text())
+    names = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name.startswith("test"):
+            names.append(node.name)
+    return names
+
+
+def test_every_file_keeps_smoke_coverage():
+    slow = _slow_bases()
+    offenders = []
+    for f in sorted(TESTS_DIR.glob("test_*.py")):
+        fast = [fn for fn in _test_functions(f)
+                if f"tests/{f.name}::{fn}" not in slow]
+        if not fast and f.name not in NO_SMOKE_EXCEPTIONS:
+            offenders.append(f.name)
+    assert not offenders, (
+        f"files with no smoke-tier test (every test is in slow_tests.txt): "
+        f"{offenders} — add a cheap *_smoke test or list a justified "
+        f"exception in NO_SMOKE_EXCEPTIONS")
+
+
+def test_slow_list_entries_exist():
+    """Entries in slow_tests.txt must point at real tests — a stale entry
+    would silently fail to mark anything (and the test it named may have
+    been renamed into the smoke tier unintentionally)."""
+    by_file = {}
+    for f in TESTS_DIR.glob("test_*.py"):
+        by_file[f"tests/{f.name}"] = set(_test_functions(f))
+    stale = []
+    for base in _slow_bases():
+        fname, _, func = base.partition("::")
+        if func not in by_file.get(fname, set()):
+            stale.append(base)
+    assert not stale, f"stale slow_tests.txt entries: {stale}"
