@@ -277,8 +277,8 @@ class GPipeTrainStep:
         mesh, axis = self.mesh, self.pipe_axis
         pipeline = self._make_pipeline_fn(num_micro)
         compute_dtype = self.compute_dtype
-        data_axes = tuple(a for a in ("dp", "sharding")
-                          if a in mesh.axis_names and mesh.shape[a] > 1)
+        from .spmd import _data_axes
+        data_axes = _data_axes(mesh)
         batch_axis = data_axes if data_axes else None
         blk_specs = {k: self._specs["blocks"][k]
                      for k in set(self.params["blocks"]) |
@@ -464,9 +464,8 @@ class GPipeTrainStep:
 
     def __call__(self, *batch):
         vals = []
-        data_axes = tuple(a for a in ("dp", "sharding")
-                          if a in self.mesh.axis_names and
-                          self.mesh.shape[a] > 1)
+        from .spmd import _data_axes
+        data_axes = _data_axes(self.mesh)
         for b in batch:
             v = b._value if isinstance(b, Tensor) else jnp.asarray(b)
             vals.append(jax.device_put(

@@ -34,9 +34,26 @@ from ..nn.functional_call import functional_call, state_values, trainable_mask
 from . import mesh as mesh_mod
 
 
+def _grad_barrier():
+    """Optional optimization_barrier between backward and optimizer update
+    (PT_GRAD_BARRIER = pre_cast | post_cast).  Measured lever for the
+    vision frontier: XLA fuses conv weight-grads with the f32 cast and the
+    momentum update into single kOutput convolution fusions whose emitter
+    choice is poor for 1x1 kernels (docs/PERF.md round-5 ResNet section);
+    the barrier forces the wgrad and the update to schedule separately —
+    the cuDNN property (independent dgrad/wgrad algo choice) the reference
+    gets from conv_grad_kernel.cu."""
+    import os
+    return os.environ.get("PT_GRAD_BARRIER", "")
+
+
 def _data_axes(mesh) -> tuple:
+    # "dcn" is the cross-slice outer axis of build_hybrid_mesh — data
+    # parallelism rides DCN between slices while mp/pp stay on ICI inside
+    # one slice (the reference's ProcessGroupHeter inner/inter split,
+    # ProcessGroupHeter.h:128-134)
     axes = []
-    for name in ("dp", "sharding"):
+    for name in ("dcn", "dp", "sharding"):
         if mesh is not None and name in mesh.axis_names and \
                 mesh.shape.get(name, 1) > 1:
             axes.append(name)
@@ -549,8 +566,17 @@ class ShardedTrainStep:
                 (loss, new_buf), grads = vag(params_model,
                                              state_tree["buffers"],
                                              key, batch)
+            if _grad_barrier() == "pre_cast":
+                # split point A: the weight-grad convolutions emit in the
+                # compute dtype with no fused f32 epilogue; the f32 cast
+                # joins the (element-wise) optimizer fusion instead
+                grads = jax.lax.optimization_barrier(grads)
             grads = {k: g.astype(params_model[k].dtype)
                      for k, g in grads.items()}
+            if _grad_barrier() == "post_cast":
+                # split point B: wgrad+cast emit together, the optimizer
+                # update is scheduled as a separate computation
+                grads = jax.lax.optimization_barrier(grads)
             if flat_segs:
                 grads = flatten_grads(grads)
             if zero_grad_constraint:

@@ -129,7 +129,7 @@ def _init_attr(std: float) -> ParamAttr:
 def _activation_spec() -> P:
     """Batch over the data axes, sequence over 'sep' (context parallelism —
     _constrain drops whichever axes the live mesh lacks)."""
-    return P(("dp", "sharding"), "sep", None)
+    return P(("dcn", "dp", "sharding"), "sep", None)
 
 
 # fused-qkv column layout versions: 1 = role-major [3, nh, hd] (round-1 /
@@ -521,7 +521,7 @@ class GPTForPretraining(Layer):
     def lm_head(self, hidden_states):
         w = self.gpt.embeddings.word_embeddings.weight
         logits = matmul(hidden_states, w, transpose_y=True)
-        return _constrain(logits, P(("dp", "sharding"), None, "mp"))
+        return _constrain(logits, P(("dcn", "dp", "sharding"), None, "mp"))
 
 
 class GPTPretrainingCriterion(Layer):
@@ -567,7 +567,7 @@ class GPTHeadPipe(Layer):
 
     def forward(self, x):
         logits = self.lm_head(self.final_norm(x))
-        return _constrain(logits, P(("dp", "sharding"), None, "mp"))
+        return _constrain(logits, P(("dcn", "dp", "sharding"), None, "mp"))
 
 
 def gpt_pipeline_descs(config: GPTConfig):

@@ -60,7 +60,7 @@ def _flash_ok(q) -> bool:
     if not _USE_FLASH or q.shape[1] < 128:
         return False
     from ...distributed import mesh as mesh_mod
-    if any(mesh_mod.axis_bound(a) for a in ("mp", "dp", "sharding", "sep")):
+    if any(mesh_mod.axis_bound(a) for a in ("mp", "dcn", "dp", "sharding", "sep")):
         return False  # explicit shard_map mode: local shards, ref math
     mesh = mesh_mod.get_global_mesh()
     if mesh is not None and mesh.shape.get("sep", 1) > 1:
@@ -82,12 +82,12 @@ def _flash_spmd(q, k, v, causal, scale):
     from ...kernels.flash_attention import flash_attention_bthd
 
     mesh = mesh_mod.get_global_mesh()
-    live = [a for a in ("dp", "sharding", "mp")
+    live = [a for a in ("dcn", "dp", "sharding", "mp")
             if mesh is not None and a in mesh.axis_names and
             mesh.shape.get(a, 1) > 1]
     if not live:
         return flash_attention_bthd(q, k, v, causal=causal, scale=scale)
-    batch = tuple(a for a in ("dp", "sharding") if a in live)
+    batch = tuple(a for a in ("dcn", "dp", "sharding") if a in live)
     heads = "mp" if "mp" in live else None
     n_batch = 1
     for a in batch:
@@ -162,12 +162,12 @@ def _fused_flash_spmd(qkv, causal, scale):
                              (0, 2, 1, 3)).reshape(bl, tl, nhl * hdl)
 
     mesh = mesh_mod.get_global_mesh()
-    live = [a for a in ("dp", "sharding", "mp")
+    live = [a for a in ("dcn", "dp", "sharding", "mp")
             if mesh is not None and a in mesh.axis_names and
             mesh.shape.get(a, 1) > 1]
     if not live:
         return local(qkv)
-    batch = tuple(a for a in ("dp", "sharding") if a in live)
+    batch = tuple(a for a in ("dcn", "dp", "sharding") if a in live)
     heads = "mp" if "mp" in live else None
     n_batch = 1
     for a in batch:

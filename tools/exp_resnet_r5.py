@@ -1,0 +1,43 @@
+"""Round-5 ResNet frontier A/B: grad barrier x pointwise-as-dot.
+xplane device time per step, batch 64 (the profile configuration)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import importlib.util
+
+spec = importlib.util.spec_from_file_location(
+    "pm", os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "profile_model.py"))
+pm = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(pm)
+
+
+def run(tag, barrier, as_dot):
+    os.environ["PT_GRAD_BARRIER"] = barrier
+    from paddle_tpu.nn.functional.conv import pointwise_as_dot
+    pointwise_as_dot(as_dot)
+    step, args = pm._build_resnet()
+    outdir = pm.profile(step, args, steps=5)
+    import collections, glob, jax
+    paths = glob.glob(os.path.join(outdir, "**", "*.xplane.pb"), recursive=True)
+    data = jax.profiler.ProfileData.from_file(paths[-1])
+    plane = next(p for p in data.planes if "TPU" in p.name)
+    total = 0.0
+    for line in plane.lines:
+        if line.name == "XLA Ops":
+            total += sum(e.duration_ns for e in line.events) / 1e6
+    print(f"{tag}: {total / 5:.3f} ms/step", flush=True)
+    return total / 5
+
+
+if __name__ == "__main__":
+    which = sys.argv[1:] or ["base", "pre", "post", "dot", "dot_pre"]
+    cfgs = {
+        "base": ("", False), "pre": ("pre_cast", False),
+        "post": ("post_cast", False), "dot": ("", True),
+        "dot_pre": ("pre_cast", True),
+    }
+    for w in which:
+        b, d = cfgs[w]
+        run(w, b, d)

@@ -1,0 +1,125 @@
+"""Pipeline-schedule memory probe (round-5 verdict ask #6).
+
+Measures XLA `memory_analysis().temp_size_in_bytes` of the compiled
+GPipeTrainStep across schedules at growing micro-batch counts M — the
+grad-accumulation regime (FleetX 6.7B uses M >> S) where true 1F1B's
+<=S-deep activation stash (reference pipeline_parallel.py:108,491) could
+beat the one-program circular schedule's remat residency (V*M x 1 input
+act, docs/PERF.md "Interleaved 1F1B accounting").
+
+Run from the repo root:
+    python tools/pp_mem_probe.py [--ms 16,32,64]
+
+Prints a markdown table (pasted into docs/PERF.md) with, per M:
+  gpipe G=1 / +remat / 1f1b C=S / C=S+remat temp bytes, plus the analytic
+  true-1F1B stash bound S*(1+k) acts for comparison.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.distributed as dist  # noqa: E402
+import paddle_tpu.nn as nn  # noqa: E402
+from paddle_tpu.distributed.pipeline import GPipeTrainStep  # noqa: E402
+
+H, T, N_BLOCKS, K = 64, 16, 8, 4          # FFN expansion k=4 transformer-ish
+S = 4                                     # pipe stages
+
+
+class Block(nn.Layer):
+    def __init__(self, h=H):
+        super().__init__()
+        self.fc1 = nn.Linear(h, K * h)
+        self.fc2 = nn.Linear(K * h, h)
+        self.norm = nn.LayerNorm(h)
+
+    def forward(self, x):
+        return x + self.fc2(nn.functional.gelu(self.fc1(self.norm(x))))
+
+
+def build(mesh, m, schedule, chunk=None, remat=False):
+    paddle.seed(0)
+    pre = nn.Sequential(nn.Linear(8, H))
+    blocks = [Block() for _ in range(N_BLOCKS)]
+    post = nn.Sequential(nn.LayerNorm(H), nn.Linear(H, 4))
+    opt = paddle.optimizer.SGD(
+        parameters=(pre.parameters() +
+                    [p for bl in blocks for p in bl.parameters()] +
+                    post.parameters()), learning_rate=1e-2)
+    return GPipeTrainStep(pre, blocks, post, nn.MSELoss(), opt, mesh=mesh,
+                          num_micro=m, schedule=schedule, chunk_micro=chunk,
+                          remat=remat)
+
+
+def temp_bytes(step, x, y):
+    b = x.shape[0]
+    fn = step._build(*step._pick_schedule(b))
+    lowered = fn.lower(step.params, step.slots, step.step_count,
+                       jnp.float32(1e-2), jax.random.key(0),
+                       (jnp.asarray(x), jnp.asarray(y)))
+    return lowered.compile().memory_analysis().temp_size_in_bytes
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ms", default="16,32,64")
+    ap.add_argument("--micro", type=int, default=2,
+                    help="per-micro batch rows")
+    args = ap.parse_args()
+
+    mesh = dist.build_mesh([1, S], ["dp", "pipe"])
+    dist.set_global_mesh(mesh)
+    rng = np.random.default_rng(0)
+
+    act_bytes = args.micro * T * H * 4          # one input activation
+    print(f"# S={S}, {N_BLOCKS} blocks h={H} k={K}, micro rows="
+          f"{args.micro}, seq={T}; act={act_bytes/1024:.1f} KB")
+    print("| M | gpipe G=1 | +remat | 1f1b C=S | C=S +remat | "
+          "true-1F1B stash bound |")
+    print("|---|---|---|---|---|---|")
+    for m in [int(v) for v in args.ms.split(",")]:
+        b = args.micro * m
+        x = rng.standard_normal((b, T, 8)).astype("float32")
+        y = rng.standard_normal((b, T, 4)).astype("float32")
+        row = []
+        for sched, chunk, remat in (("gpipe", None, False),
+                                    ("gpipe", None, True),
+                                    ("1f1b", S, False),
+                                    ("1f1b", S, True)):
+            mb = temp_bytes(build(mesh, m, sched, chunk, remat), x, y)
+            row.append(f"{mb/2**20:.2f} MB")
+        bound = S * (1 + K) * act_bytes
+        print(f"| {m} | " + " | ".join(row) +
+              f" | {bound/2**20:.2f} MB ({S}x{1+K} acts) |")
+
+    # numerics guard: remat/chunk variants must train identically
+    m = 16
+    b = args.micro * m
+    x = rng.standard_normal((b, T, 8)).astype("float32")
+    y = rng.standard_normal((b, T, 4)).astype("float32")
+    ref = None
+    for sched, chunk, remat in (("gpipe", None, False),
+                                ("gpipe", None, True),
+                                ("1f1b", S, True)):
+        st = build(mesh, m, sched, chunk, remat)
+        losses = [float(st(x, y)) for _ in range(3)]
+        if ref is None:
+            ref = losses
+        else:
+            np.testing.assert_allclose(losses, ref, rtol=2e-4, atol=1e-5)
+    print("# numerics: gpipe == gpipe+remat == 1f1b+remat (3 steps)")
+
+
+if __name__ == "__main__":
+    main()
