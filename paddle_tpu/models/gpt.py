@@ -249,18 +249,61 @@ class GPTSelfAttention(Layer):
                 training=self.training)
         else:
             q, k, v = (qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2])
-            if cache is not None:
-                from ..ops.manipulation import concat
-                k = concat([cache[0], k], axis=1)
-                v = concat([cache[1], v], axis=1)
-            out = F.scaled_dot_product_attention(
-                q, k, v, dropout_p=self.attn_dropout_prob,
-                is_causal=True, training=self.training)
+            new_cache = None
+            if cache is not None and len(cache) == 3:
+                # STATIC cache (k_buf [B,L,nh,hd], v_buf, length): write the
+                # new keys/values in place at `length` and attend over the
+                # fixed-shape buffer under an explicit validity mask — every
+                # decode step is ONE compiled program with donated buffers
+                # (the AnalysisPredictor zero-copy run analog,
+                # analysis_predictor.cc:1618), instead of a concat that
+                # gives each position its own XLA shape
+                import jax.numpy as jnp
+
+                from ..core.tensor import Tensor as _T
+                k_buf, v_buf, pos0 = cache
+                k_raw = k_buf._value if isinstance(k_buf, _T) else k_buf
+                v_raw = v_buf._value if isinstance(v_buf, _T) else v_buf
+                start = jnp.asarray(pos0, jnp.int32)
+                z = jnp.zeros((), jnp.int32)
+                k_raw = jax.lax.dynamic_update_slice(
+                    k_raw, k._value.astype(k_raw.dtype), (z, start, z, z))
+                v_raw = jax.lax.dynamic_update_slice(
+                    v_raw, v._value.astype(v_raw.dtype), (z, start, z, z))
+                if isinstance(pos0, int) and pos0 == 0:
+                    # static prefill (helper builds the cache inside the
+                    # prefill jit with a PYTHON-int length 0): no past to
+                    # attend over, so the prompt keeps the causal
+                    # flash-attention path instead of dense masked
+                    # attention over the zero-padded buffer
+                    out = F.scaled_dot_product_attention(
+                        q, k, v, dropout_p=0.0, is_causal=True,
+                        training=False)
+                else:
+                    max_len = k_raw.shape[1]
+                    qpos = start + jnp.arange(t)
+                    mask = (jnp.arange(max_len)[None, :] <=
+                            qpos[:, None])        # [t, L] causal + validity
+                    out = F.scaled_dot_product_attention(
+                        q, _T(k_raw, _internal=True),
+                        _T(v_raw, _internal=True),
+                        attn_mask=_T(mask[None, None], _internal=True),
+                        dropout_p=0.0, is_causal=False, training=False)
+                new_cache = (_T(k_raw, _internal=True),
+                             _T(v_raw, _internal=True), start + t)
+            else:
+                if cache is not None:
+                    from ..ops.manipulation import concat
+                    k = concat([cache[0], k], axis=1)
+                    v = concat([cache[1], v], axis=1)
+                out = F.scaled_dot_product_attention(
+                    q, k, v, dropout_p=self.attn_dropout_prob,
+                    is_causal=True, training=self.training)
             out = out.reshape([b, t, nh * self.head_dim])
         out = _constrain(out, P(_U, _U, "mp"))
         out = self.out_proj(out)
         if use_cache:
-            return out, (k, v)
+            return out, (new_cache if new_cache is not None else (k, v))
         return out
 
 
@@ -414,10 +457,22 @@ class GPTModel(Layer):
             caches = [None] * len(self.layers)
         if position_ids is None and use_cache and caches[0] is not None:
             # incremental decode: offset positions by the cached key length
-            from ..ops.creation import arange
-            past, t = caches[0][0].shape[1], input_ids.shape[1]
-            position_ids = arange(past, past + t,
-                                  dtype="int64").reshape([1, t])
+            t = input_ids.shape[1]
+            if len(caches[0]) == 3:
+                # static cache (k_buf, v_buf, length): position base may be
+                # a python int (static prefill) or a traced scalar (step)
+                import jax.numpy as jnp
+
+                from ..core.tensor import Tensor as _T
+                past = caches[0][2]
+                pos = (jnp.asarray(past, jnp.int64) +
+                       jnp.arange(t, dtype=jnp.int64)).reshape(1, t)
+                position_ids = _T(pos, _internal=True)
+            else:
+                from ..ops.creation import arange
+                past = caches[0][0].shape[1]
+                position_ids = arange(past, past + t,
+                                      dtype="int64").reshape([1, t])
         x = self.embeddings(input_ids, position_ids)
         x = _constrain(x, _activation_spec())
         new_caches = [] if use_cache else None

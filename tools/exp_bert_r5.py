@@ -1,0 +1,62 @@
+"""Round-5 BERT frontier: batch sweep + bf16-state A/B (chip, wall-clock
+like bench.py's metric)."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+
+
+def build(batch, seq=512, bf16_state=False):
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.models import (BertPretrainingCriterion, bert_config,
+                                   build_bert)
+
+    cfg = bert_config("bert-base-uncased", hidden_dropout_prob=0.0,
+                      attention_dropout_prob=0.0)
+    paddle.seed(0)
+    model = build_bert(cfg)
+    if bf16_state:
+        model.to(dtype="bfloat16")
+    crit = BertPretrainingCriterion()
+
+    def loss_fn(out, labels, nsp_labels):
+        mlm, nsp = out
+        return crit(mlm, nsp, labels, nsp_labels)
+
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+    step = dist.make_train_step(
+        model, opt, loss_fn=loss_fn, num_labels=2,
+        compute_dtype=None if bf16_state else "bfloat16")
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int64)
+    labels = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int64)
+    nsp = rng.randint(0, 2, (batch,)).astype(np.int64)
+    return step, (ids, labels, nsp)
+
+
+def run(tag, batch, bf16_state=False, steps=10):
+    import jax
+    step, args = build(batch, bf16_state=bf16_state)
+    loss = step(*args)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(*args)
+    lv = float(loss)
+    dt = time.perf_counter() - t0
+    tps = batch * 512 * steps / dt
+    mfu = tps * 6 * 110e6 / 197e12
+    print(f"{tag}: batch={batch} {tps:,.0f} tok/s mfu={mfu:.3f} "
+          f"loss={lv:.4f}", flush=True)
+
+
+if __name__ == "__main__":
+    for a in sys.argv[1:]:
+        if a.startswith("b"):
+            run(a, int(a[1:]))
+        elif a.startswith("s"):   # bf16 state
+            run(a, int(a[1:]), bf16_state=True)

@@ -31,6 +31,40 @@ def run(tag, barrier, as_dot):
     return total / 5
 
 
+def run_bf16_state(tag="bf16_state"):
+    """GPT-1.3B recipe applied to vision: params/slots in bf16 (no f32
+    masters), measuring what the f32 optimizer state costs per step."""
+    os.environ["PT_GRAD_BARRIER"] = ""
+    from paddle_tpu.nn.functional.conv import pointwise_as_dot
+    pointwise_as_dot(False)
+    import jax.numpy as jnp
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    import paddle_tpu.nn as nn
+    from paddle_tpu.vision.models import resnet50
+
+    paddle.seed(0)
+    model = resnet50(num_classes=1000)
+    model.to(dtype="bfloat16")
+    crit = nn.CrossEntropyLoss()
+    opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                    parameters=model.parameters(),
+                                    weight_decay=1e-4)
+    step = dist.make_train_step(model, opt, loss_fn=crit)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.standard_normal((64, 3, 224, 224)).astype(np.float32))
+    y = jnp.asarray(rng.randint(0, 1000, (64,)).astype(np.int64))
+    outdir = pm.profile(step, (x, y), steps=5)
+    import collections, glob, jax
+    paths = glob.glob(os.path.join(outdir, "**", "*.xplane.pb"), recursive=True)
+    data = jax.profiler.ProfileData.from_file(paths[-1])
+    plane = next(p for p in data.planes if "TPU" in p.name)
+    total = sum(sum(e.duration_ns for e in line.events)
+                for line in plane.lines if line.name == "XLA Ops") / 1e6
+    print(f"{tag}: {total / 5:.3f} ms/step", flush=True)
+
+
 if __name__ == "__main__":
     which = sys.argv[1:] or ["base", "pre", "post", "dot", "dot_pre"]
     cfgs = {
@@ -39,5 +73,8 @@ if __name__ == "__main__":
         "dot_pre": ("pre_cast", True),
     }
     for w in which:
+        if w == "bf16_state":
+            run_bf16_state()
+            continue
         b, d = cfgs[w]
         run(w, b, d)

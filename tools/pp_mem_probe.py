@@ -31,7 +31,8 @@ import numpy as np  # noqa: E402
 import paddle_tpu as paddle  # noqa: E402
 import paddle_tpu.distributed as dist  # noqa: E402
 import paddle_tpu.nn as nn  # noqa: E402
-from paddle_tpu.distributed.pipeline import GPipeTrainStep  # noqa: E402
+from paddle_tpu.distributed.pipeline import (  # noqa: E402
+    GPipeTrainStep, Stash1F1BTrainStep)
 
 H, T, N_BLOCKS, K = 64, 16, 8, 4          # FFN expansion k=4 transformer-ish
 S = 4                                     # pipe stages
@@ -57,6 +58,9 @@ def build(mesh, m, schedule, chunk=None, remat=False):
         parameters=(pre.parameters() +
                     [p for bl in blocks for p in bl.parameters()] +
                     post.parameters()), learning_rate=1e-2)
+    if schedule == "stash":
+        return Stash1F1BTrainStep(pre, blocks, post, nn.MSELoss(), opt,
+                                  mesh=mesh, num_micro=m)
     return GPipeTrainStep(pre, blocks, post, nn.MSELoss(), opt, mesh=mesh,
                           num_micro=m, schedule=schedule, chunk_micro=chunk,
                           remat=remat)
@@ -86,8 +90,8 @@ def main():
     print(f"# S={S}, {N_BLOCKS} blocks h={H} k={K}, micro rows="
           f"{args.micro}, seq={T}; act={act_bytes/1024:.1f} KB")
     print("| M | gpipe G=1 | +remat | 1f1b C=S | C=S +remat | "
-          "true-1F1B stash bound |")
-    print("|---|---|---|---|---|---|")
+          "1F1B stash | stash bound |")
+    print("|---|---|---|---|---|---|---|")
     for m in [int(v) for v in args.ms.split(",")]:
         b = args.micro * m
         x = rng.standard_normal((b, T, 8)).astype("float32")
@@ -96,12 +100,13 @@ def main():
         for sched, chunk, remat in (("gpipe", None, False),
                                     ("gpipe", None, True),
                                     ("1f1b", S, False),
-                                    ("1f1b", S, True)):
+                                    ("1f1b", S, True),
+                                    ("stash", None, False)):
             mb = temp_bytes(build(mesh, m, sched, chunk, remat), x, y)
             row.append(f"{mb/2**20:.2f} MB")
-        bound = S * (1 + K) * act_bytes
+        bound = (2 * S - 1) * (1 + K) * act_bytes
         print(f"| {m} | " + " | ".join(row) +
-              f" | {bound/2**20:.2f} MB ({S}x{1+K} acts) |")
+              f" | {bound/2**20:.2f} MB ({2*S-1}x{1+K} acts) |")
 
     # numerics guard: remat/chunk variants must train identically
     m = 16

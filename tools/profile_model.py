@@ -65,6 +65,33 @@ def _build_gpt(batch=16, seq=1024):
     return step, (ids[:, :-1], ids[:, 1:])
 
 
+def _build_bert(batch=16, seq=512):
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.models import (BertPretrainingCriterion, bert_config,
+                                   build_bert)
+
+    cfg = bert_config("bert-base-uncased", hidden_dropout_prob=0.0,
+                      attention_dropout_prob=0.0)
+    paddle.seed(0)
+    model = build_bert(cfg)
+    crit = BertPretrainingCriterion()
+
+    def loss_fn(out, labels, nsp_labels):
+        mlm, nsp = out
+        return crit(mlm, nsp, labels, nsp_labels)
+
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+    step = dist.make_train_step(model, opt, loss_fn=loss_fn, num_labels=2,
+                                compute_dtype="bfloat16")
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int64)
+    labels = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int64)
+    nsp = rng.randint(0, 2, (batch,)).astype(np.int64)
+    return step, (ids, labels, nsp)
+
+
 def profile(step, args, steps=5, outdir=None):
     import jax
 
@@ -132,6 +159,8 @@ if __name__ == "__main__":
         step, args = _build_resnet(data_format=fmt)
     elif which == "gpt":
         step, args = _build_gpt()
+    elif which == "bert":
+        step, args = _build_bert()
     else:
         raise SystemExit(f"unknown model {which}")
     t0 = time.perf_counter()
