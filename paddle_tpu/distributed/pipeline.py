@@ -673,11 +673,28 @@ class Stash1F1BTrainStep(GPipeTrainStep):
                 slot_f = jnp.mod(t, D)
                 ring = [jax.lax.dynamic_update_index_in_dim(r, lv, slot_f, 0)
                         for r, lv in zip(ring, leaves)]
-                # -- last stage: loss + cotangent seed, same tick as its F
+                # -- last stage: loss + cotangent seed, same tick as its F.
+                # Gated by a RUNTIME conditional on the stage index so the
+                # other S-1 stages skip the post head + loss forward/vjp
+                # entirely (for an LM pipeline that is the vocab matmul —
+                # the most expensive non-block op; a where-mask would still
+                # execute it everywhere).
                 lb = lab[jnp.clip(m_f, 0, M - 1)]
-                loss_t, lvjp = jax.vjp(
-                    lambda yy, pv: post_loss(yy, pv, lb), y, post_params)
-                dy_last, dpost = lvjp(jnp.asarray(1.0 / M, jnp.float32))
+
+                def _loss_seed(operands):
+                    yy, pv = operands
+                    loss_t, lvjp = jax.vjp(
+                        lambda y2, p2: post_loss(y2, p2, lb), yy, pv)
+                    dy, dpost = lvjp(jnp.asarray(1.0 / M, jnp.float32))
+                    return loss_t, dy, dpost
+
+                def _loss_zeros(operands):
+                    yy, pv = operands
+                    return (jnp.zeros((), jnp.float32), jnp.zeros_like(yy),
+                            jtu.tree_map(jnp.zeros_like, pv))
+
+                loss_t, dy_last, dpost = jax.lax.cond(
+                    s == S - 1, _loss_seed, _loss_zeros, (y, post_params))
                 ok_last = (s == S - 1) & (m_f >= 0) & (m_f < M)
                 lsum = lsum + jnp.where(ok_last, loss_t / M, 0.0)
                 pacc = jtu.tree_map(
