@@ -85,6 +85,14 @@ class GPTConfig:
     moe_capacity_factor: float = 1.2
     moe_aux_loss_weight: float = 0.01
     moe_gate: str = "gshard"
+    # fused LM-head + cross-entropy: the [B,T,V] logits never materialize
+    # (chunked online-logsumexp, F.fused_linear_nll_loss).  Applies ONLY
+    # to the TRAINING forward (model.training and single mp) — there
+    # forward returns FusedHeadOutput(hidden, tied_weight) for the
+    # criterion; eval/decode forwards always return logits.  Measured
+    # −10% on gpt2-small/v5e (docs/PERF.md round-5 dead ends): opt-in for
+    # large-vocab / HBM-constrained regimes, default off.
+    fuse_head_loss: bool = False
 
     def __post_init__(self):
         if not self.intermediate_size:
@@ -555,6 +563,14 @@ class GPTModel(Layer):
         return total
 
 
+class FusedHeadOutput(tuple):
+    """(hidden, head_weight) marker the pretraining criterion consumes via
+    F.fused_linear_nll_loss — produced when config.fuse_head_loss."""
+
+    def __new__(cls, hidden, weight):
+        return super().__new__(cls, (hidden, weight))
+
+
 class GPTForPretraining(Layer):
     """LM head tied to the (vocab-parallel) word embedding — logits are
     vocab-sharded over 'mp', consumed by ParallelCrossEntropy without ever
@@ -571,7 +587,21 @@ class GPTForPretraining(Layer):
             x, new_caches = self.gpt(input_ids, position_ids, caches=caches,
                                      use_cache=True)
             return self.lm_head(x), new_caches
-        return self.lm_head(self.gpt(input_ids, position_ids))
+        x = self.gpt(input_ids, position_ids)
+        if self.gpt.config.fuse_head_loss and self.training \
+                and max(_mp_info()[0], 1) == 1:
+            # hand the criterion (hidden, tied weight) instead of logits so
+            # the head matmul fuses into the chunked CE (the [B,T,V]
+            # tensor never exists); under mp the vocab-parallel
+            # ParallelCrossEntropy path already avoids the gather.
+            # The weight's traced VALUE is captured into a fresh Tensor:
+            # functional_call's state swap restores the parameter object
+            # in place on exit, so returning the param itself would hand
+            # the criterion the CONCRETE weights (constant under jax.grad
+            # — the tied head grad would silently vanish).
+            w = self.gpt.embeddings.word_embeddings.weight
+            return FusedHeadOutput(x, Tensor(w._value, _internal=True))
+        return self.lm_head(x)
 
     def lm_head(self, hidden_states):
         w = self.gpt.embeddings.word_embeddings.weight
@@ -591,7 +621,11 @@ class GPTPretrainingCriterion(Layer):
                               if self.mp else None)
 
     def forward(self, prediction_scores, masked_lm_labels, loss_mask=None):
-        if self.parallel_loss is not None:
+        if isinstance(prediction_scores, FusedHeadOutput):
+            hidden, w = prediction_scores
+            loss = F.fused_linear_nll_loss(hidden, w, masked_lm_labels,
+                                           ignore_index=self.ignore_index)
+        elif self.parallel_loss is not None:
             loss = self.parallel_loss(prediction_scores, masked_lm_labels)
         else:
             loss = F.fused_nll_loss(prediction_scores, masked_lm_labels,

@@ -1,6 +1,8 @@
 """Loss functionals (reference: python/paddle/nn/functional/loss.py)."""
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
@@ -14,6 +16,122 @@ def _reduce(val, reduction):
     if reduction == "sum":
         return jnp.sum(val)
     return val
+
+
+def _flcel_chunks(w, chunk):
+    """Pad [V, H] to a whole number of `chunk` rows → ([n, chunk, H], V)."""
+    V = w.shape[0]
+    n = -(-V // chunk)
+    pad = n * chunk - V
+    if pad:
+        w = jnp.pad(w, ((0, pad), (0, 0)))
+    return w.reshape(n, chunk, w.shape[-1]), V
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _fused_linear_nll(h, w, labels, ignore_index, chunk):
+    nll, _ = _flcel_fwd_impl(h, w, labels, ignore_index, chunk)
+    return nll
+
+
+def _flcel_fwd_impl(h, w, labels, ignore_index, chunk):
+    """Online-logsumexp over vocab chunks: the [N, V] logits tensor never
+    materializes (the whole point — at GPT/BERT scale it is GBs of HBM
+    traffic per pass; docs/PERF.md round-5 BERT section)."""
+    wc, V = _flcel_chunks(w, chunk)
+    n_chunks = wc.shape[0]
+    N = h.shape[0]
+    valid = labels != ignore_index
+    safe_lab = jnp.where(valid, labels, 0)
+
+    def body(carry, inp):
+        m, s, tgt = carry
+        ci, w_c = inp
+        c0 = ci * chunk
+        logits = jax.lax.dot_general(
+            h, w_c, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [N, chunk] f32
+        col_ok = (c0 + jnp.arange(chunk)) < V
+        logits = jnp.where(col_ok[None, :], logits, -jnp.inf)
+        m_new = jnp.maximum(m, logits.max(axis=-1))
+        s = s * jnp.exp(m - m_new) + jnp.exp(
+            logits - m_new[:, None]).sum(axis=-1)
+        off = safe_lab - c0
+        in_c = (off >= 0) & (off < chunk)
+        picked = jnp.take_along_axis(
+            logits, jnp.clip(off, 0, chunk - 1)[:, None], axis=-1)[:, 0]
+        tgt = jnp.where(in_c, picked, tgt)
+        return (m_new, s, tgt), None
+
+    m0 = jnp.full((N,), -jnp.inf, jnp.float32)
+    (m, s, tgt), _ = jax.lax.scan(
+        body, (m0, jnp.zeros((N,), jnp.float32),
+               jnp.zeros((N,), jnp.float32)),
+        (jnp.arange(n_chunks), wc))
+    lse = m + jnp.log(s)
+    nll = jnp.where(valid, lse - tgt, 0.0)
+    return nll, lse
+
+
+def _flcel_fwd(h, w, labels, ignore_index, chunk):
+    nll, lse = _flcel_fwd_impl(h, w, labels, ignore_index, chunk)
+    return nll, (h, w, labels, lse)
+
+
+def _flcel_bwd(ignore_index, chunk, res, g):
+    h, w, labels, lse = res
+    wc, V = _flcel_chunks(w, chunk)
+    n_chunks = wc.shape[0]
+    valid = labels != ignore_index
+    gv = jnp.where(valid, g, 0.0).astype(jnp.float32)
+    safe_lab = jnp.where(valid, labels, 0)
+
+    def body(dh, inp):
+        ci, w_c = inp
+        c0 = ci * chunk
+        logits = jax.lax.dot_general(
+            h, w_c, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        col_ok = (c0 + jnp.arange(chunk)) < V
+        logits = jnp.where(col_ok[None, :], logits, -jnp.inf)
+        p = jnp.exp(logits - lse[:, None])               # softmax chunk
+        off = safe_lab - c0
+        onehot = ((off[:, None] == jnp.arange(chunk)[None, :]) &
+                  valid[:, None])
+        gl = (p - onehot) * gv[:, None]                  # dlogits [N, chunk]
+        gl = jnp.where(col_ok[None, :], gl, 0.0).astype(h.dtype)
+        dh = dh + gl @ w_c.astype(h.dtype)
+        dw_c = jax.lax.dot_general(
+            gl, h, (((0,), (0,)), ((), ())))             # [chunk, H]
+        return dh, dw_c
+
+    dh0 = jnp.zeros_like(h)
+    dh, dw = jax.lax.scan(body, dh0, (jnp.arange(n_chunks), wc))
+    dw = dw.reshape(n_chunks * chunk, -1)[:w.shape[0]].astype(w.dtype)
+    return dh, dw, None
+
+
+_fused_linear_nll.defvjp(_flcel_fwd, _flcel_bwd)
+
+
+def fused_linear_nll_loss(hidden, weight, labels, ignore_index=-100,
+                          transpose_weight=True, chunk_size=8192):
+    """Fused LM-head + NLL over vocab chunks (round 5): computes
+    nll = logsumexp(h @ Wᵀ) - (h @ Wᵀ)[label] WITHOUT materializing the
+    [.., V] logits — online logsumexp forward, chunked softmax-recompute
+    backward (one extra head matmul, the standard remat trade for ~5
+    full passes of [N, V] HBM traffic).  `weight` is [V, H] when
+    transpose_weight (the tied-embedding convention) else [H, V]."""
+    def raw(h, w, lb):
+        if not transpose_weight:
+            w = w.T
+        shape = h.shape[:-1]
+        nll = _fused_linear_nll(h.reshape(-1, h.shape[-1]), w,
+                                lb.reshape(-1), ignore_index, chunk_size)
+        return nll.reshape(shape)
+
+    return apply_op(raw, "fused_linear_nll_loss",
+                    (hidden, weight, labels), {})
 
 
 def fused_nll_loss(logits, labels, ignore_index=-100):

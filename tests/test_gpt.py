@@ -302,3 +302,54 @@ def test_gpt_fused_ln_proj_matches():
         enable_ln_matmul(False)
         fa._INTERPRET = False
     assert all(abs(a - b) < 5e-4 for a, b in zip(base, fused)), (base, fused)
+
+
+def test_fuse_head_loss_training_parity():
+    """Round-5: config.fuse_head_loss routes the criterion through
+    F.fused_linear_nll_loss (chunked online-logsumexp head+CE, no [B,T,V]
+    logits) — training must match the unfused path step for step,
+    including the tied-embedding weight grad (the head contribution must
+    not vanish when the state swap restores params in place)."""
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.models import GPTPretrainingCriterion
+
+    def run(fused):
+        cfg = gpt_config("gpt-tiny", hidden_dropout_prob=0.0,
+                         attention_dropout_prob=0.0, fuse_head_loss=fused)
+        paddle.seed(0)
+        model = build_gpt(cfg)
+        crit = GPTPretrainingCriterion()
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        step = dist.make_train_step(model, opt, loss_fn=crit)
+        ids = np.random.RandomState(0).randint(
+            0, cfg.vocab_size, (4, 33)).astype(np.int64)
+        return [float(step(ids[:, :-1], ids[:, 1:])) for _ in range(4)]
+
+    np.testing.assert_allclose(run(True), run(False), rtol=2e-5, atol=1e-6)
+
+
+def test_fused_linear_nll_loss_matches_unfused():
+    """F.fused_linear_nll_loss == matmul + fused_nll_loss to fp32 epsilon,
+    values and both grads, across chunking regimes (chunk > V pads)."""
+    import paddle_tpu.nn.functional as F
+
+    rng = np.random.RandomState(0)
+    for N, H, V, chunk in [(37, 16, 1000, 256), (20, 8, 100, 8192)]:
+        h = paddle.to_tensor(rng.randn(N, H).astype(np.float32))
+        h.stop_gradient = False
+        w = paddle.to_tensor((rng.randn(V, H) * 0.1).astype(np.float32))
+        w.stop_gradient = False
+        lab = rng.randint(0, V, (N,))
+        lab[::7] = -100
+        labt = paddle.to_tensor(lab.astype(np.int64))
+        nll_f = F.fused_linear_nll_loss(h, w, labt, chunk_size=chunk)
+        nll_r = F.fused_nll_loss(paddle.matmul(h, w, transpose_y=True),
+                                 labt)
+        np.testing.assert_allclose(nll_f.numpy(), nll_r.numpy(),
+                                   rtol=1e-5, atol=1e-6)
+        gf = paddle.grad(nll_f.mean(), [h, w], retain_graph=True)
+        gr = paddle.grad(nll_r.mean(), [h, w], retain_graph=True)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(a.numpy(), b.numpy(),
+                                       rtol=1e-5, atol=1e-7)

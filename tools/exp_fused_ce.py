@@ -1,0 +1,47 @@
+"""Round-5: fused LM-head + chunked CE A/B on the chip (gpt2-small shapes)."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+
+
+def run(tag, fused, name="gpt2-small-en", batch=16, seq=1024, steps=10):
+    import jax
+
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.models import (GPTPretrainingCriterion, build_gpt,
+                                   gpt_config, gpt_train_flops_per_token)
+
+    cfg = gpt_config(name, max_position_embeddings=seq,
+                     hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
+                     fuse_head_loss=fused)
+    paddle.seed(0)
+    model = build_gpt(cfg)
+    crit = GPTPretrainingCriterion()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters(),
+                                 weight_decay=0.01)
+    step = dist.make_train_step(model, opt, loss_fn=crit,
+                                compute_dtype="bfloat16")
+    ids = np.random.RandomState(0).randint(0, cfg.vocab_size,
+                                           (batch, seq + 1)).astype(np.int64)
+    x, y = ids[:, :-1], ids[:, 1:]
+    loss = step(x, y)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(x, y)
+    lv = float(loss)
+    dt = time.perf_counter() - t0
+    tps = batch * seq * steps / dt
+    mfu = tps * gpt_train_flops_per_token(cfg, seq) / 197e12
+    print(f"{tag}: {tps:,.0f} tok/s mfu={mfu:.3f} loss={lv:.4f}",
+          flush=True)
+
+
+if __name__ == "__main__":
+    for a in sys.argv[1:]:
+        run(a, fused=a.startswith("fused"))
