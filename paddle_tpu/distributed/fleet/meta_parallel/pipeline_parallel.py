@@ -59,25 +59,58 @@ class PipelineParallel(MetaParallelBase):
                 # one-GSPMD-program path ONLY when the stages aren't uniform
                 # enough for the explicit schedule (decompose raises
                 # ValueError for those documented cases) — and says so.
-                from ...pipeline import (GPipeTrainStep,
+                from ...pipeline import (GPipeTrainStep, Stash1F1BTrainStep,
                                          decompose_pipeline_layer)
+                mode = self.schedule_mode.lower().replace("-", "_")
+                stash = mode in ("1f1b_stash", "stash")
+                if stash and loss_fn is None:
+                    # checked BEFORE the try: inside it the fallback
+                    # handler would swallow this into a silent GSPMD
+                    # degrade — a config error must stay an error
+                    raise ValueError(
+                        "schedule_mode=1F1B-stash computes the loss in "
+                        "the last pipeline stage; the PipelineLayer needs "
+                        "a loss_fn")
                 try:
                     pre, blocks, post = decompose_pipeline_layer(self._layers)
                     num_virtual = getattr(
                         self._layers, "_num_virtual_pipeline_stages", 1) or 1
                     cfg = (self._strategy.pipeline_configs
                            if self._strategy is not None else {})
-                    self._train_step = GPipeTrainStep(
-                        pre, blocks, post, loss_fn, opt,
-                        num_micro=max(2, self.accumulate_steps),
-                        num_virtual=num_virtual,
-                        schedule=self.schedule_mode,
-                        # virtual stages default to per-tick remat: equal
-                        # bubble to true interleaved 1F1B at lower memory
-                        # (docs/PERF.md "interleaved 1F1B accounting")
-                        remat=(num_virtual > 1
-                               if cfg.get("remat") is None
-                               else cfg["remat"]))
+                    if stash:
+                        # true 1F1B: M-independent residual-ring stash,
+                        # loss in the last stage, no recompute — the
+                        # grad-accumulation (M >> S) schedule
+                        # (docs/PERF.md round-5 measurement)
+                        import warnings as _w
+                        if num_virtual > 1:
+                            _w.warn(
+                                "schedule_mode=1F1B-stash runs contiguous "
+                                f"stages (V=1); num_virtual_pipeline_"
+                                f"stages={num_virtual} is ignored",
+                                RuntimeWarning, stacklevel=3)
+                        if cfg.get("remat"):
+                            _w.warn(
+                                "schedule_mode=1F1B-stash stores full "
+                                "residuals in its ring (no recompute); "
+                                "pipeline_configs['remat'] is ignored",
+                                RuntimeWarning, stacklevel=3)
+                        self._train_step = Stash1F1BTrainStep(
+                            pre, blocks, post, loss_fn, opt,
+                            num_micro=max(2, self.accumulate_steps))
+                    else:
+                        self._train_step = GPipeTrainStep(
+                            pre, blocks, post, loss_fn, opt,
+                            num_micro=max(2, self.accumulate_steps),
+                            num_virtual=num_virtual,
+                            schedule=self.schedule_mode,
+                            # virtual stages default to per-tick remat:
+                            # equal bubble to true interleaved 1F1B at
+                            # lower memory (docs/PERF.md "interleaved 1F1B
+                            # accounting")
+                            remat=(num_virtual > 1
+                                   if cfg.get("remat") is None
+                                   else cfg["remat"]))
                 except ValueError as e:
                     # decompose_pipeline_layer raises for non-uniform/shared
                     # stages; GPipeTrainStep for divisibility/mesh mismatch —

@@ -658,3 +658,32 @@ def test_stash_1f1b_gpt_blocks_with_int_buffer():
     y = rng.randint(0, 128, (8, 16)).astype(np.int64)
     losses = [float(step(ids, y)) for _ in range(3)]
     assert losses[-1] < losses[0], losses
+
+
+def test_fleet_schedule_mode_stash():
+    """strategy.pipeline_configs schedule_mode='1F1B-stash' selects the
+    round-5 true-1F1B stash schedule through the fleet surface and trains."""
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet import LayerDesc, PipelineLayer
+    from paddle_tpu.distributed.pipeline import Stash1F1BTrainStep
+
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": 2, "mp_degree": 1, "pp_degree": 4,
+                        "sharding_degree": 1}
+    s.pipeline_configs = {"accumulate_steps": 4,
+                          "schedule_mode": "1F1B-stash"}
+    fleet.init(is_collective=True, strategy=s)
+
+    paddle.seed(2)
+    descs = [LayerDesc(nn.Linear, 8, 16)] + \
+        [LayerDesc(Block, 16) for _ in range(4)] + \
+        [LayerDesc(nn.Linear, 16, 4)]
+    pl = PipelineLayer(descs, loss_fn=nn.MSELoss())
+    model = fleet.distributed_model(pl)
+    opt = fleet.distributed_optimizer(paddle.optimizer.Adam(
+        parameters=pl.parameters(), learning_rate=1e-2))
+    x, y = _data()
+    losses = [float(model.train_batch((x, y), opt).numpy())
+              for _ in range(3)]
+    assert isinstance(model._train_step, Stash1F1BTrainStep)
+    assert losses[-1] < losses[0] and all(np.isfinite(losses))

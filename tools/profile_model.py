@@ -92,6 +92,31 @@ def _build_bert(batch=16, seq=512):
     return step, (ids, labels, nsp)
 
 
+def _build_ppyoloe(batch=8, size=640):
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.vision.models import PPYOLOE, PPYOLOELoss
+
+    paddle.seed(0)
+    model = PPYOLOE(num_classes=80)
+    loss_fn = PPYOLOELoss(model)
+    opt = paddle.optimizer.Momentum(learning_rate=0.01, momentum=0.9,
+                                    parameters=model.parameters(),
+                                    weight_decay=5e-4)
+    step = dist.make_train_step(model, opt, loss_fn=loss_fn, num_labels=2,
+                                compute_dtype="bfloat16")
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(
+        rng.standard_normal((batch, 3, size, size)).astype(np.float32))
+    gtb = jnp.asarray(np.stack([np.array([[4, 4, 300, 300],
+                                          [64, 32, 400, 500]],
+                                         "float32")] * batch))
+    gtl = jnp.asarray(np.stack([np.array([1, 3], "int64")] * batch))
+    return step, (x, gtb, gtl)
+
+
 def profile(step, args, steps=5, outdir=None):
     import jax
 
@@ -161,6 +186,8 @@ if __name__ == "__main__":
         step, args = _build_gpt()
     elif which == "bert":
         step, args = _build_bert()
+    elif which == "ppyoloe":
+        step, args = _build_ppyoloe()
     else:
         raise SystemExit(f"unknown model {which}")
     t0 = time.perf_counter()
