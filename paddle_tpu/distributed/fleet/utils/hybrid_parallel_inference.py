@@ -67,6 +67,27 @@ class HybridParallelInferenceHelper:
                 return [(k._value, v._value) for k, v in caches]
             return jax.eval_shape(f, values, ids)
 
+        def _cached_forward(ids_t, caches_t):
+            """(last-position logits, new caches) under swapped state.
+
+            When the model exposes its trunk + head separately (the
+            GPTForPretraining shape: `.gpt` + `.lm_head`), the head runs
+            on ONLY the last position.  Measured xplane note: XLA's DCE
+            already propagates the logits[:, -1] slice through the vocab
+            matmul (device time unchanged by this restructuring) — doing
+            it explicitly makes the property a guarantee of this code
+            rather than of the compiler's slice-through-dot rewrite."""
+            inner = getattr(model, "gpt", None)
+            head = getattr(model, "lm_head", None)
+            if inner is not None and callable(head):
+                x, new_caches = inner(ids_t, caches=caches_t,
+                                      use_cache=True)
+                logits = head(x[:, -1:])
+            else:
+                logits, new_caches = model(ids_t, caches=caches_t,
+                                           use_cache=True)
+            return logits._value[:, -1], new_caches
+
         def prefill(values, ids, total_len):
             # the static caches are BUILT inside this jit with a PYTHON-int
             # length 0, so the model statically knows there is no past and
@@ -80,20 +101,20 @@ class HybridParallelInferenceHelper:
                                           v.dtype), _internal=True), 0)
                         for k, v in kv]
             with _swapped_state(model, values):
-                logits, new_caches = model(Tensor(ids, _internal=True),
-                                           caches=caches_t, use_cache=True)
-            return logits._value[:, -1], [
-                (k._value, v._value, ln) for k, v, ln in new_caches]
+                last, new_caches = _cached_forward(
+                    Tensor(ids, _internal=True), caches_t)
+            return last, [(k._value, v._value, ln)
+                          for k, v, ln in new_caches]
 
         def step(values, ids, caches):
             caches_t = [(Tensor(k, _internal=True),
                          Tensor(v, _internal=True), ln)
                         for k, v, ln in caches]
             with _swapped_state(model, values):
-                logits, new_caches = model(Tensor(ids, _internal=True),
-                                           caches=caches_t, use_cache=True)
-            return logits._value[:, -1], [
-                (k._value, v._value, ln) for k, v, ln in new_caches]
+                last, new_caches = _cached_forward(
+                    Tensor(ids, _internal=True), caches_t)
+            return last, [(k._value, v._value, ln)
+                          for k, v, ln in new_caches]
 
         # greedy decode runs ON DEVICE as one lax.scan over tokens (the
         # static cache rides the carry at fixed shapes), so a whole
