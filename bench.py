@@ -435,6 +435,14 @@ def bench_gpt_decode():
     t_one = timed(1)
     dts = [a - b for a, b in zip(sorted(t_full), sorted(t_one))]
     dt = float(np.median(dts))
+    # prefill noise can swamp the decode delta on fast/tiny runs and push
+    # the median to <= 0; clamp so the reported JSON can't carry a
+    # divide-by-zero or negative tokens/s
+    eps = 1e-9
+    if dt < eps:
+        print(f"# gpt-decode: decode delta {dt:.3e}s <= 0 (prefill noise "
+              f"dominates); clamping to {eps}", file=sys.stderr)
+        dt = eps
     noise = round(100 * (max(dts) - min(dts)) / dt, 2)
     tps = batch * (new - 1) / dt
     ms_tok = dt / (new - 1) * 1000
@@ -478,8 +486,55 @@ _LEGS = [
 ]
 
 
+def _telemetry_block():
+    """Per-leg telemetry summary from the observability registry (the
+    registry is reset before each leg, so these are per-leg deltas):
+    compile counts + retrace warnings from the sentinel, op-dispatch
+    totals, step-latency stats, peak device memory.  Appended under a new
+    'telemetry' key — the existing metric schema fields are untouched."""
+    from paddle_tpu import observability as obs
+    from paddle_tpu.observability import dispatch, retrace, steps
+    reg = obs.registry()
+    block = {
+        "compiles": {}, "retraces": int(retrace.retrace_warning_count()),
+        "op_dispatch_total": 0, "op_dispatch_eager": 0,
+        "op_dispatch_traced": 0,
+    }
+    c = reg.get(retrace.JIT_COMPILE_TOTAL)
+    if c is not None:
+        for labels, v in c.series():
+            block["compiles"][labels.get("fn", "?")] = int(v)
+    d = reg.get(dispatch.OP_DISPATCH_TOTAL)
+    if d is not None:
+        for labels, v in d.series():
+            block["op_dispatch_total"] += int(v)
+            mode = labels.get("mode")
+            if mode in ("eager", "traced"):
+                block[f"op_dispatch_{mode}"] += int(v)
+    h = reg.get(steps.STEP_LATENCY)
+    if h is not None:
+        for labels, _ in h.series():
+            snap = h.snapshot(labels)
+            if snap["count"]:
+                block.setdefault("step_latency", {})[
+                    labels.get("fn", "?")] = {
+                    "count": snap["count"],
+                    "mean_ms": round(1e3 * snap["sum"] / snap["count"], 3)}
+    steps.record_memory_stats()  # refresh the gauges at leg end
+    g = reg.get(steps.MEMORY_GAUGE)
+    if g is not None:
+        peak = g.value(labels={"stat": "peak_bytes_in_use"})
+        if peak:
+            block["peak_memory_bytes"] = int(peak)
+    return block
+
+
 def main():
     flagship_only = "--flagship-only" in sys.argv
+    telemetry = "--telemetry" in sys.argv
+    if telemetry:
+        from paddle_tpu import observability as obs
+        obs.enable(True)
     # default covers the measured sum of all six legs + headroom;
     # a tighter driver can export BENCH_BUDGET_S to shed trailing legs
     budget = float(os.environ.get("BENCH_BUDGET_S", "700"))
@@ -495,11 +550,19 @@ def main():
             continue
         try:
             _reset_parallel_state()
+            if telemetry:
+                from paddle_tpu import observability as obs
+                obs.registry().reset()  # per-leg deltas
             legs[key] = fn()
         except Exception as e:  # a failing leg must not kill the bench
             traceback.print_exc(file=sys.stderr)
             legs[key] = {"error": f"{type(e).__name__}: {e}"}
         finally:
+            if telemetry:
+                try:
+                    legs[key]["telemetry"] = _telemetry_block()
+                except Exception:
+                    traceback.print_exc(file=sys.stderr)
             _reset_parallel_state()
             import gc
             import jax

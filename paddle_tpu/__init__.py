@@ -70,6 +70,7 @@ import importlib as _importlib  # noqa: E402
 
 linalg = _importlib.import_module(".linalg", __name__)
 from . import onnx  # noqa: E402,F401
+from . import observability  # noqa: E402,F401
 from . import version  # noqa: E402,F401
 
 

@@ -32,6 +32,10 @@ OP_REGISTRY: dict[str, Callable] = {}
 # framework/details/nan_inf_utils_detail.cc:341 CheckVarHasNanOrInf
 CHECK_NAN_INF = False
 
+# op-dispatch telemetry (paddle_tpu.observability): synced by
+# observability.enable(); apply_op pays one boolean check per call when off
+TELEMETRY = False
+
 
 def _scan_nan_inf(name, out):
     import jax
@@ -81,6 +85,19 @@ def _wrap_outputs(out, node):
 
 
 def apply_op(fn, name, args, kwargs):
+    if not TELEMETRY:
+        return _apply_op(fn, name, args, kwargs)
+    import time as _time
+
+    from ..observability import dispatch as _dispatch
+    t0 = _time.perf_counter()
+    try:
+        return _apply_op(fn, name, args, kwargs)
+    finally:
+        _dispatch.record(name, _time.perf_counter() - t0)
+
+
+def _apply_op(fn, name, args, kwargs):
     leaves, treedef = jtu.tree_flatten((args, kwargs), is_leaf=_is_tensor)
     # dual-mode dispatch (reference tensor APIs append ops in static
     # mode): a static-graph Variable anywhere defers this op onto the

@@ -20,6 +20,7 @@ annotate shardings, let XLA insert collectives.
 """
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 from typing import Any, Callable
 
@@ -638,7 +639,13 @@ class ShardedTrainStep:
             return new_state, loss
 
         self._raw_step = step_fn
-        return jax.jit(step_fn, donate_argnums=self._donate_argnums())
+        # retrace sentinel (paddle_tpu.observability): books every distinct
+        # abstract signature this step compiles for and warns on recompile
+        # storms; a pure pass-through (one bool check) when telemetry is off
+        from ..observability import instrument_jit
+        return instrument_jit(
+            jax.jit(step_fn, donate_argnums=self._donate_argnums()),
+            name="spmd_train_step")
 
     def aot_compile(self, *batch_structs):
         """AOT-compile the step from batch ShapeDtypeStructs (abstract mode:
@@ -677,6 +684,8 @@ class ShardedTrainStep:
         return core, tree["slots"]
 
     def __call__(self, *batch):
+        from ..core.op import TELEMETRY
+        t0 = time.perf_counter() if TELEMETRY else 0.0
         batch = self.shard_batch(*batch)
         if self._jitted is None:
             self._jitted = self._build(len(batch))
@@ -685,6 +694,13 @@ class ShardedTrainStep:
         new_tree, loss = self._jitted(core, slots, lr, batch)
         self.state = TrainState(**new_tree)
         self.optimizer._step_count += 1
+        if TELEMETRY:
+            from ..observability import steps as _steps
+            n = batch[0].shape[0] if batch and getattr(
+                batch[0], "ndim", 0) else None
+            _steps.record_step(time.perf_counter() - t0, examples=n,
+                               fn="train_step")
+            _steps.record_memory_stats()
         return Tensor(loss, _internal=True)
 
     def run_steps(self, *stacked):
@@ -725,8 +741,10 @@ class ShardedTrainStep:
                 out["slots"] = slots_f
                 return out, losses
 
-            self._jitted_multi = jax.jit(
-                multi_fn, donate_argnums=self._donate_argnums())
+            from ..observability import instrument_jit
+            self._jitted_multi = instrument_jit(
+                jax.jit(multi_fn, donate_argnums=self._donate_argnums()),
+                name="spmd_train_step_multi")
         # per-step learning rates: schedules keyed on the optimizer step
         # count must see the same sequence K single-step calls would
         opt = self.optimizer
@@ -738,9 +756,19 @@ class ShardedTrainStep:
         opt._step_count = saved_count
         lrs = jnp.asarray(lrs, jnp.float32)
         core, slots = self._split_tree()
+        from ..core.op import TELEMETRY
+        t0 = time.perf_counter() if TELEMETRY else 0.0
         new_tree, losses = self._jitted_multi(core, slots, lrs, tuple(vals))
         self.state = TrainState(**new_tree)
         self.optimizer._step_count += k
+        if TELEMETRY:
+            from ..observability import steps as _steps
+            n = vals[0].shape[1] if getattr(vals[0], "ndim", 0) > 1 else None
+            dt = time.perf_counter() - t0
+            # one dispatch covers k steps: amortize so per-step series stay
+            # comparable with the single-step path
+            _steps.record_step(dt / k, examples=n, fn="train_step_multi")
+            _steps.record_memory_stats()
         return Tensor(losses, _internal=True)
 
     def sync_to_model(self):

@@ -9,6 +9,9 @@ ONLY — XLA owns those concerns.  Behavioral flags that are wired:
   FLAGS_check_nan_inf  — per-op output NaN/Inf scan in the eager op layer
                          (nan_inf_utils_detail.cc:341 parity; jax pairs it
                          with jax_debug_nans for in-jit checks)
+  FLAGS_telemetry      — paddle_tpu.observability: op-dispatch counters,
+                         retrace sentinel, step metrics (also enabled by
+                         the PADDLE_TPU_TELEMETRY=1 env var)
 """
 from __future__ import annotations
 
@@ -16,6 +19,7 @@ import os
 
 _FLAGS: dict[str, object] = {
     "FLAGS_check_nan_inf": False,
+    "FLAGS_telemetry": False,
     "FLAGS_cudnn_deterministic": False,
     "FLAGS_allocator_strategy": "auto_growth",
     "FLAGS_fraction_of_gpu_memory_to_use": 0.92,
@@ -48,6 +52,8 @@ def _bootstrap_from_env():
             _FLAGS[key] = _coerce(_FLAGS[key], os.environ[key])
     if _FLAGS["FLAGS_check_nan_inf"]:
         _sync_check_nan_inf()
+    if _FLAGS["FLAGS_telemetry"]:
+        _sync_telemetry()
 
 
 def set_flags(flags: dict):
@@ -59,6 +65,8 @@ def set_flags(flags: dict):
         _FLAGS[key] = _coerce(_FLAGS[key], v)
         if key == "FLAGS_check_nan_inf":
             _sync_check_nan_inf()
+        if key == "FLAGS_telemetry":
+            _sync_telemetry()
 
 
 def get_flags(flags):
@@ -76,6 +84,11 @@ def get_flags(flags):
 def _sync_check_nan_inf():
     from .core import op as op_mod
     op_mod.CHECK_NAN_INF = bool(_FLAGS["FLAGS_check_nan_inf"])
+
+
+def _sync_telemetry():
+    from . import observability
+    observability.enable(bool(_FLAGS["FLAGS_telemetry"]))
 
 
 _bootstrap_from_env()

@@ -21,6 +21,10 @@ def config_callbacks(callbacks=None, model=None, batch_size=None, epochs=None,
         cbks = [ProgBarLogger(log_freq, verbose=verbose)] + list(cbks)
     if not any(isinstance(k, LRScheduler) for k in cbks):
         cbks = [LRScheduler()] + list(cbks)
+    from .. import observability as _obs
+    if _obs.enabled() and \
+            not any(isinstance(k, TelemetryCallback) for k in cbks):
+        cbks = list(cbks) + [TelemetryCallback()]
     if save_dir and not any(isinstance(k, ModelCheckpoint) for k in cbks):
         cbks = list(cbks) + [ModelCheckpoint(save_freq, save_dir)]
     cbk_list = CallbackList(cbks)
@@ -108,6 +112,43 @@ class Callback:
 
     def on_predict_batch_end(self, step, logs=None):
         pass
+
+
+class TelemetryCallback(Callback):
+    """Feeds paddle_tpu.observability step metrics from the hapi fit loop:
+    per-batch latency + examples/s (`paddle_tpu_step_latency_seconds{fn=
+    hapi_train_batch}`), per-epoch device-memory gauges.  Auto-inserted by
+    config_callbacks when telemetry is enabled; inert (records nothing)
+    when it is off."""
+
+    def __init__(self, fn: str = "hapi_train_batch"):
+        super().__init__()
+        self.fn = fn
+        self._t0 = None
+
+    @staticmethod
+    def _obs():
+        from .. import observability
+        return observability
+
+    def on_train_batch_begin(self, step, logs=None):
+        if self._obs().enabled():
+            import time
+            self._t0 = time.perf_counter()
+
+    def on_train_batch_end(self, step, logs=None):
+        obs = self._obs()
+        if not obs.enabled() or self._t0 is None:
+            return
+        import time
+        dt = time.perf_counter() - self._t0
+        self._t0 = None
+        bs = (logs or {}).get("batch_size") or self.params.get("batch_size")
+        obs.steps.record_step(dt, examples=bs, fn=self.fn)
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self._obs().enabled():
+            self._obs().steps.record_memory_stats()
 
 
 class ProgBarLogger(Callback):

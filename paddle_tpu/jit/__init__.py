@@ -115,7 +115,8 @@ class StaticFunction:
             return self._fn(*args, **kwargs)
         vals = [_as_value(a) for a in args]
         key = tuple((tuple(v.shape), str(v.dtype)) for v in vals)
-        if key not in self._cache:
+        miss = key not in self._cache
+        if miss:
             self._cache[key] = jax.jit(self._make_callable())
         jitted = self._cache[key]
         entries = dict(self._layer.state_dict()) if self._layer is not None \
@@ -124,11 +125,24 @@ class StaticFunction:
         # run through apply_op so the eager tape sees the compiled call:
         # grads flow to inputs AND to the layer's parameters (the dict's
         # Tensor leaves), with jax.vjp differentiating through the jit
-        from ..core.op import apply_op
+        from ..core.op import TELEMETRY, apply_op
 
         def raw(values, *vv):
             return jitted(values, *vv)
 
+        if TELEMETRY and miss:
+            # retrace sentinel: each cache miss is one trace+compile of this
+            # to_static function; the cache size is its live signature count
+            import time as _time
+
+            from ..observability import retrace as _retrace
+            t0 = _time.perf_counter()
+            out = apply_op(raw, "to_static", (entries, *args), {})
+            fname = getattr(self._function, "__name__", None) or "forward"
+            _retrace.record_compile(f"to_static:{fname}", key,
+                                    _time.perf_counter() - t0,
+                                    len(self._cache))
+            return out
         return apply_op(raw, "to_static", (entries, *args), {})
 
     @property

@@ -594,13 +594,18 @@ class GPTForPretraining(Layer):
             # the head matmul fuses into the chunked CE (the [B,T,V]
             # tensor never exists); under mp the vocab-parallel
             # ParallelCrossEntropy path already avoids the gather.
-            # The weight's traced VALUE is captured into a fresh Tensor:
-            # functional_call's state swap restores the parameter object
-            # in place on exit, so returning the param itself would hand
-            # the criterion the CONCRETE weights (constant under jax.grad
-            # — the tied head grad would silently vanish).
+            # Traced (functional_call) path: the weight's traced VALUE is
+            # captured into a fresh Tensor — the state swap restores the
+            # parameter object in place on exit, so returning the param
+            # itself would hand the criterion the CONCRETE weights
+            # (constant under jax.grad — the tied head grad would
+            # silently vanish).  Eager path: the detached copy is the bug
+            # — loss.backward() would never reach the tied table — so the
+            # parameter itself rides on the tape.
             w = self.gpt.embeddings.word_embeddings.weight
-            return FusedHeadOutput(x, Tensor(w._value, _internal=True))
+            if isinstance(w._value, jax.core.Tracer):
+                return FusedHeadOutput(x, Tensor(w._value, _internal=True))
+            return FusedHeadOutput(x, w)
         return self.lm_head(x)
 
     def lm_head(self, hidden_states):

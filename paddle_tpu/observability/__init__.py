@@ -1,0 +1,81 @@
+"""paddle_tpu.observability — process-wide runtime telemetry.
+
+One registry (counters / gauges / histograms with labels) plus three
+instrumentation layers wired into the framework's hot paths:
+
+* op-dispatch telemetry in the ``@defop`` hub (``core/op.py``): per-op call
+  counts, eager-vs-traced split, cumulative host time;
+* the retrace sentinel around the jit entry points (``distributed/spmd.py``
+  train steps, ``jit.to_static``): compile counts, compile wall-time,
+  abstract-signature keys, and a structured warning on recompile storms;
+* step-level training metrics (step latency, examples/s, device memory
+  gauges) from the SPMD step and the hapi ``TelemetryCallback``.
+
+Everything is OFF by default and costs one boolean check per op when off.
+Enable with ``PADDLE_TPU_TELEMETRY=1``, ``paddle_tpu.set_flags({"FLAGS_
+telemetry": True})`` or :func:`enable`.  Export with :func:`dump` (JSON),
+:func:`to_prometheus_text`, or let ``profiler.export_chrome_tracing`` merge
+counter samples into its host-span timeline.  ``python bench.py
+--telemetry`` appends a per-leg telemetry block to the bench JSON.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+from .metrics import (  # noqa: F401
+    Counter, Gauge, Histogram, MetricsRegistry)
+
+_REGISTRY = MetricsRegistry()
+_ENABLED = False
+
+
+def registry() -> MetricsRegistry:
+    """The process-wide registry (always usable, even when disabled)."""
+    return _REGISTRY
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def enable(on: bool = True):
+    """Flip telemetry globally; syncs the op-layer fast-path flag."""
+    global _ENABLED
+    _ENABLED = bool(on)
+    _REGISTRY.sampling = _ENABLED
+    from ..core import op as op_mod
+    op_mod.TELEMETRY = _ENABLED
+
+
+def disable():
+    enable(False)
+
+
+def dump() -> dict:
+    return _REGISTRY.dump()
+
+
+def dump_json() -> str:
+    return json.dumps(_REGISTRY.dump(), sort_keys=True)
+
+
+def to_prometheus_text() -> str:
+    return _REGISTRY.to_prometheus_text()
+
+
+def _bootstrap_from_env():
+    v = os.environ.get("PADDLE_TPU_TELEMETRY", "")
+    if v.lower() in ("1", "true", "yes", "on"):
+        enable(True)
+
+
+# imported AFTER registry()/enable() exist: both modules pull `registry`
+# from this package at import time
+from . import dispatch  # noqa: E402,F401
+from . import retrace  # noqa: E402,F401
+from . import steps  # noqa: E402,F401
+from .retrace import (  # noqa: E402,F401
+    get_retrace_threshold, instrument_jit, set_retrace_threshold)
+
+_bootstrap_from_env()

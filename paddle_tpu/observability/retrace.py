@@ -1,0 +1,134 @@
+"""Retrace sentinel — catches shape-driven recompile storms.
+
+``jax.jit`` silently retraces (and XLA recompiles) whenever a call arrives
+with a new abstract signature.  On TPU that is the classic silent perf
+killer: a stray python int in the batch path or a ragged final batch turns
+every step into a multi-second compile while the throughput chart quietly
+collapses.  The sentinel wraps the framework's jit entry points
+(`distributed/spmd.py` train steps, `jit.to_static` caches), records every
+distinct abstract signature and its compile wall-time, and logs ONE
+structured warning per threshold crossing when the same entry point
+recompiles more than N times.
+
+The signature key is the tree of (shape, dtype) of the flattened call args
+— exactly the part of jax's cache key a user can influence from the data
+path.  Compile wall-time is measured around the first call with a new
+signature, so it includes trace + lower + backend compile (the end-to-end
+latency a training loop actually observes).
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+
+from . import registry
+
+logger = logging.getLogger("paddle_tpu.observability")
+
+JIT_COMPILE_TOTAL = "paddle_tpu_jit_compile_total"
+JIT_COMPILE_SECONDS = "paddle_tpu_jit_compile_seconds"
+JIT_RETRACE_WARNINGS = "paddle_tpu_jit_retrace_warnings_total"
+
+# warn when one entry point compiles MORE than this many times
+_DEFAULT_THRESHOLD = int(os.environ.get("PADDLE_TPU_RETRACE_WARN", "5"))
+_threshold = [_DEFAULT_THRESHOLD]
+
+
+def set_retrace_threshold(n: int):
+    _threshold[0] = int(n)
+
+
+def get_retrace_threshold() -> int:
+    return _threshold[0]
+
+
+def _abstract_signature(args, kwargs=None) -> tuple:
+    import jax.tree_util as jtu
+    leaves, treedef = jtu.tree_flatten((args, kwargs or {}))
+    sig = []
+    for lv in leaves:
+        shape = getattr(lv, "shape", None)
+        dtype = getattr(lv, "dtype", None)
+        if shape is None and dtype is None:
+            sig.append(repr(lv))  # static python leaf
+        else:
+            sig.append((tuple(shape) if shape is not None else None,
+                        str(dtype)))
+    return (str(treedef), tuple(sig))
+
+
+def record_compile(name: str, key, seconds: float, n_compiles: int):
+    """Book one (re)compile of jit entry point `name`; warn on storms."""
+    reg = registry()
+    reg.counter(JIT_COMPILE_TOTAL,
+                "jit trace+compile events per entry point").inc(
+        1.0, labels={"fn": name})
+    reg.histogram(JIT_COMPILE_SECONDS,
+                  "end-to-end compile wall-time (trace+lower+compile)"
+                  ).observe(seconds, labels={"fn": name})
+    if n_compiles > _threshold[0]:
+        reg.counter(JIT_RETRACE_WARNINGS,
+                    "retrace-storm warnings emitted").inc(
+            1.0, labels={"fn": name})
+        logger.warning(
+            "paddle_tpu retrace sentinel: %s",
+            json.dumps({"event": "retrace_storm", "fn": name,
+                        "compiles": n_compiles,
+                        "threshold": _threshold[0],
+                        "last_signature": str(key)[:512],
+                        "hint": "same step function keeps recompiling — "
+                                "check for shape-polymorphic inputs "
+                                "(ragged final batch, python scalars in "
+                                "the data path)"}))
+
+
+class InstrumentedJit:
+    """Pass-through wrapper over a ``jax.jit``-ed callable that books
+    compiles per distinct abstract signature.  When telemetry is off the
+    per-call cost is one boolean check; attribute access (``.lower``,
+    ``.trace``...) delegates to the wrapped function so AOT paths keep
+    working."""
+
+    def __init__(self, fn, name: str):
+        self._fn = fn
+        self._name = name
+        self._signatures: set = set()
+
+    def __call__(self, *args, **kwargs):
+        from ..core import op as op_mod
+        if not op_mod.TELEMETRY:
+            return self._fn(*args, **kwargs)
+        key = _abstract_signature(args, kwargs)
+        if key in self._signatures:
+            return self._fn(*args, **kwargs)
+        # new abstract signature → jax will trace + compile inside this call
+        t0 = time.perf_counter()
+        out = self._fn(*args, **kwargs)
+        dt = time.perf_counter() - t0
+        self._signatures.add(key)
+        record_compile(self._name, key, dt, len(self._signatures))
+        return out
+
+    def __getattr__(self, item):
+        return getattr(self._fn, item)
+
+
+def instrument_jit(fn, name: str) -> InstrumentedJit:
+    return InstrumentedJit(fn, name)
+
+
+def compile_count(name: str | None = None) -> float:
+    """Total recorded compiles (optionally for one entry point)."""
+    c = registry().get(JIT_COMPILE_TOTAL)
+    if c is None:
+        return 0.0
+    if name is None:
+        return c.total()
+    return c.value(labels={"fn": name})
+
+
+def retrace_warning_count() -> float:
+    c = registry().get(JIT_RETRACE_WARNINGS)
+    return c.total() if c is not None else 0.0

@@ -1,0 +1,55 @@
+"""Step-level training metrics — latency, throughput, device memory.
+
+Fed by the SPMD train step (`distributed/spmd.py`), the hapi
+``TelemetryCallback`` and anything else that owns a step boundary.  Host
+latency on an async backend measures dispatch, not device time — but a
+dispatch-bound loop is exactly the pathology worth seeing, and on a
+steady-state synced loop the two converge.
+"""
+from __future__ import annotations
+
+from . import registry
+
+STEP_LATENCY = "paddle_tpu_step_latency_seconds"
+STEPS_TOTAL = "paddle_tpu_steps_total"
+EXAMPLES_TOTAL = "paddle_tpu_examples_total"
+EXAMPLES_PER_SEC = "paddle_tpu_examples_per_sec"
+MEMORY_GAUGE = "paddle_tpu_device_memory_bytes"
+
+
+def record_step(seconds: float, examples: int | None = None,
+                fn: str = "train_step"):
+    reg = registry()
+    labels = {"fn": fn}
+    reg.histogram(STEP_LATENCY, "host wall-time per train step").observe(
+        seconds, labels=labels)
+    reg.counter(STEPS_TOTAL, "train steps dispatched").inc(1.0, labels=labels)
+    if examples is not None:
+        reg.counter(EXAMPLES_TOTAL, "examples consumed").inc(
+            float(examples), labels=labels)
+        if seconds > 0:
+            reg.gauge(EXAMPLES_PER_SEC,
+                      "instantaneous examples/s of the last step").set(
+                examples / seconds, labels=labels)
+
+
+def record_memory_stats():
+    """Snapshot ``device.memory_stats()`` gauges where the backend reports
+    them (PJRT on CPU returns nothing — the gauges simply stay absent)."""
+    try:
+        from ..device.tpu import memory_stats
+        stats = memory_stats(0)
+    except Exception:
+        return
+    if not stats:
+        return
+    g = registry().gauge(MEMORY_GAUGE, "PJRT allocator stats, device 0")
+    for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+                "largest_alloc_size"):
+        if key in stats:
+            g.set(float(stats[key]), labels={"stat": key})
+
+
+def step_latency_count(fn: str = "train_step") -> int:
+    h = registry().get(STEP_LATENCY)
+    return h.count(labels={"fn": fn}) if h is not None else 0

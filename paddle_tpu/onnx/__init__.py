@@ -34,9 +34,19 @@ def export(layer, path, input_spec=None, opset_version=13, **configs):
         jit.save(layer, str(path), input_spec=input_spec)
         return str(path) + ".pdmodel"
 
-    if opset_version != 13:
+    if opset_version == 9:
+        # the reference paddle2onnx default; its node forms are a strict
+        # subset of what 13 accepts here, so upgrade instead of raising
+        import warnings
+
+        warnings.warn(
+            "onnx.export: opset_version=9 (the reference default) is "
+            "emitted as opset 13 (this exporter's ReduceSum axes-as-input "
+            "node forms need >= 13)")
+        opset_version = 13
+    elif opset_version < 13:
         raise ValueError(
-            f"this exporter emits opset 13 only (ReduceSum axes-as-input "
+            f"this exporter emits opset >= 13 (ReduceSum axes-as-input "
             f"node forms); got opset_version={opset_version}")
 
     import jax
@@ -97,7 +107,7 @@ def export(layer, path, input_spec=None, opset_version=13, **configs):
     model_bytes = convert_jaxpr(
         closed, input_names=[f"input_{i}" for i in range(len(shapes))],
         const_names=names,
-        graph_name=type(layer).__name__)
+        graph_name=type(layer).__name__, opset=opset_version)
     with open(path, "wb") as f:
         f.write(model_bytes)
     return str(path)

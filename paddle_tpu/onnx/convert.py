@@ -200,12 +200,14 @@ def _reduce(b, eqn, ins, avals, onnx_op, axes_as_input):
 
 
 def convert_jaxpr(closed_jaxpr, input_names, const_names=None,
-                  graph_name="paddle_tpu_graph", output_names=None):
+                  graph_name="paddle_tpu_graph", output_names=None,
+                  opset=13):
     """ClosedJaxpr → serialized ONNX ModelProto bytes.
 
     input_names name the jaxpr's invars (ONNX graph inputs); consts become
     initializers (const_names may give them stable names, e.g. parameter
-    state-dict keys).
+    state-dict keys).  `opset` is declared in the emitted opset_import (the
+    node forms written here are opset-13 ones, valid in every later opset).
     """
     from jax._src import core as jcore
 
@@ -213,15 +215,30 @@ def convert_jaxpr(closed_jaxpr, input_names, const_names=None,
     jaxpr = closed_jaxpr.jaxpr
     env: dict = {}
 
-    def read(atom, hint="lit"):
+    def read(atom, hint="lit", peer_dtype=None):
         if isinstance(atom, jcore.Literal):
             val = np.asarray(atom.val)
             if val.dtype == np.float64:
                 val = val.astype(np.float32)
             if val.dtype == np.int64 and atom.aval.weak_type:
-                val = val.astype(np.int32)
+                # weak-typed python int literal: follow the peer operand's
+                # integer dtype (strict ONNX runtimes reject mixed-dtype
+                # binary nodes — an int64 peer must see an int64 literal);
+                # int32 only when no integer peer pins it wider
+                if peer_dtype is not None and \
+                        np.issubdtype(peer_dtype, np.integer):
+                    val = val.astype(peer_dtype)
+                else:
+                    val = val.astype(np.int32)
             return b.add_initializer(val, hint)
         return env[atom]
+
+    def _peer_dtype(invars, i):
+        """dtype of the first non-literal sibling operand (binary-op peer)."""
+        for j, a in enumerate(invars):
+            if j != i and not isinstance(a, jcore.Literal):
+                return np.dtype(a.aval.dtype)
+        return None
 
     for i, v in enumerate(jaxpr.invars):
         env[v] = input_names[i]
@@ -243,7 +260,8 @@ def convert_jaxpr(closed_jaxpr, input_names, const_names=None,
 
     def _emit_eqn(eqn):
         prim = str(eqn.primitive)
-        ins = [read(a) for a in eqn.invars]
+        ins = [read(a, peer_dtype=_peer_dtype(eqn.invars, i))
+               for i, a in enumerate(eqn.invars)]
         avals = [a.aval for a in eqn.invars]
 
         # call-like primitives: inline the inner jaxpr
@@ -429,4 +447,4 @@ def convert_jaxpr(closed_jaxpr, input_names, const_names=None,
 
     g = proto.graph(b.nodes, graph_name, b.initializers, inputs_vi,
                     outputs_vi)
-    return proto.model(g)
+    return proto.model(g, opset=opset)

@@ -137,6 +137,32 @@ def load_profiler_result(filename: str):
         return json.load(f)
 
 
+def _telemetry_counter_events() -> list[dict]:
+    """observability counter samples as chrome-trace 'C' events, so metric
+    series land on the same timeline as the host RecordEvent spans (and
+    jax.profiler's xplane, when the tensorboard trace is loaded alongside).
+    Label sets fold into the track name (``name{op=add,mode=eager}``) —
+    each labeled series gets its own counter track."""
+    try:
+        from .. import observability as obs
+    except Exception:  # pragma: no cover
+        return []
+    samples = obs.registry().samples()
+    if not samples:
+        return []
+    pid = os.getpid()
+    events = []
+    for s in samples:
+        name = s["name"]
+        if s["labels"]:
+            inner = ",".join(f"{k}={v}" for k, v in sorted(
+                s["labels"].items()))
+            name = f"{name}{{{inner}}}"
+        events.append({"name": name, "ph": "C", "ts": s["ts"], "pid": pid,
+                       "cat": "telemetry", "args": {"value": s["value"]}})
+    return events
+
+
 class Profiler:
     """profiler.py:310 parity."""
 
@@ -247,6 +273,7 @@ class Profiler:
         events = [{"name": e["name"], "ph": "X", "ts": e["ts"],
                    "dur": e["dur"], "pid": os.getpid(), "tid": e["tid"],
                    "cat": "host"} for e in self._events]
+        events += _telemetry_counter_events()
         with open(path, "w") as f:
             json.dump({"traceEvents": events,
                        "displayTimeUnit": "ms"}, f)

@@ -55,6 +55,10 @@ def _isolate_global_state():
     from paddle_tpu.kernels import ln_matmul as _lnmm
     _ln._MODE = "off"
     _lnmm._ENABLED = False
+    from paddle_tpu import observability as _obs
+    if _obs.enabled():
+        _obs.disable()
+        _obs.registry().reset()
 
 
 def pytest_collection_modifyitems(config, items):

@@ -329,6 +329,35 @@ def test_fuse_head_loss_training_parity():
     np.testing.assert_allclose(run(True), run(False), rtol=2e-5, atol=1e-6)
 
 
+def test_fuse_head_loss_eager_tied_grad():
+    """Eager-mode regression: under plain model.train() + loss.backward()
+    the fused head must route the tied embedding PARAMETER to the criterion
+    (a detached value copy silently drops the LM-head grad contribution);
+    the traced parity path above keeps its value-capture semantics."""
+    from paddle_tpu.models import GPTPretrainingCriterion
+
+    def eager_grad(fused):
+        cfg = gpt_config("gpt-tiny", hidden_dropout_prob=0.0,
+                         attention_dropout_prob=0.0, fuse_head_loss=fused)
+        paddle.seed(0)
+        m = build_gpt(cfg)
+        m.train()
+        crit = GPTPretrainingCriterion()
+        ids = np.random.RandomState(0).randint(
+            0, cfg.vocab_size, (2, 17)).astype(np.int64)
+        loss = crit(m(paddle.to_tensor(ids[:, :-1])),
+                    paddle.to_tensor(ids[:, 1:]))
+        loss.backward()
+        w = m.gpt.embeddings.word_embeddings.weight
+        return float(loss), w.grad
+
+    loss_f, gf = eager_grad(True)
+    loss_u, gu = eager_grad(False)
+    assert gf is not None, "fused eager path dropped the tied-weight grad"
+    np.testing.assert_allclose(loss_f, loss_u, rtol=1e-6)
+    np.testing.assert_allclose(gf.numpy(), gu.numpy(), rtol=2e-5, atol=1e-6)
+
+
 def test_fused_linear_nll_loss_matches_unfused():
     """F.fused_linear_nll_loss == matmul + fused_nll_loss to fp32 epsilon,
     values and both grads, across chunking regimes (chunk > V pads)."""

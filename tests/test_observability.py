@@ -1,0 +1,260 @@
+"""paddle_tpu.observability — registry semantics, op-dispatch telemetry,
+the retrace sentinel, step metrics, and the export paths (prometheus/JSON
+dump, chrome-trace merge).  The subsystem must be free when disabled: the
+apply_op hook is a single boolean check and records nothing."""
+import json
+import logging
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import observability as obs
+from paddle_tpu.observability import (Counter, Gauge, Histogram,
+                                      MetricsRegistry, dispatch, retrace,
+                                      steps)
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    """Telemetry off + empty registry around every test in this module."""
+    obs.disable()
+    obs.registry().reset()
+    retrace.set_retrace_threshold(retrace._DEFAULT_THRESHOLD)
+    yield
+    obs.disable()
+    obs.registry().reset()
+    retrace.set_retrace_threshold(retrace._DEFAULT_THRESHOLD)
+
+
+# -- registry semantics ------------------------------------------------------
+
+def test_counter_gauge_histogram_semantics():
+    reg = MetricsRegistry()
+    c = reg.counter("c_total", "a counter")
+    c.inc()
+    c.inc(2.5)
+    assert c.value() == 3.5
+    c.inc(1, labels={"op": "add"})
+    c.inc(2, labels={"op": "mul"})
+    assert c.value(labels={"op": "add"}) == 1
+    assert c.total() == 6.5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+    g = reg.gauge("g")
+    g.set(7, labels={"dev": "0"})
+    g.inc(3, labels={"dev": "0"})
+    g.dec(5, labels={"dev": "0"})
+    assert g.value(labels={"dev": "0"}) == 5
+
+    h = reg.histogram("h_seconds", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 5.0, 50.0):
+        h.observe(v)
+    snap = h.snapshot()
+    assert snap["count"] == 4
+    assert snap["sum"] == pytest.approx(55.55)
+    # cumulative (prometheus convention): 1 obs <= 0.1, 2 <= 1.0, 3 <= 10
+    assert snap["buckets"] == {"0.1": 1, "1.0": 2, "10.0": 3}
+
+    # re-registration returns the same family; kind mismatch raises
+    assert reg.counter("c_total") is c
+    with pytest.raises(ValueError):
+        reg.gauge("c_total")
+
+
+def test_label_order_is_canonical():
+    reg = MetricsRegistry()
+    c = reg.counter("c")
+    c.inc(labels={"a": 1, "b": 2})
+    c.inc(labels={"b": 2, "a": 1})  # same series, different dict order
+    assert c.value(labels={"a": 1, "b": 2}) == 2
+
+
+def test_dump_and_prometheus_round_trip():
+    reg = MetricsRegistry()
+    reg.counter("req_total", "requests").inc(4, labels={"code": "200"})
+    reg.gauge("mem_bytes").set(1024)
+    reg.histogram("lat_seconds", buckets=(0.1, 1.0)).observe(0.5)
+
+    dumped = json.loads(json.dumps(reg.dump()))  # JSON round-trip
+    assert dumped["counters"]["req_total"] == [
+        {"labels": {"code": "200"}, "value": 4.0}]
+    assert dumped["gauges"]["mem_bytes"][0]["value"] == 1024.0
+    hist = dumped["histograms"]["lat_seconds"][0]
+    assert hist["count"] == 1 and hist["buckets"]["1.0"] == 1
+
+    text = reg.to_prometheus_text()
+    assert '# TYPE req_total counter' in text
+    assert 'req_total{code="200"} 4.0' in text
+    assert '# TYPE lat_seconds histogram' in text
+    assert 'lat_seconds_bucket{le="+Inf"} 1' in text
+    assert 'lat_seconds_count 1' in text
+    # cumulative bucket counts: le=1.0 includes the le=0.1 bucket
+    assert 'lat_seconds_bucket{le="0.1"} 0' in text
+    assert 'lat_seconds_bucket{le="1.0"} 1' in text
+
+
+# -- op-dispatch telemetry ---------------------------------------------------
+
+def test_op_dispatch_counters_after_eager_ops():
+    obs.enable(True)
+    a = paddle.to_tensor(np.ones((4, 4), np.float32))
+    b = a + a
+    c = paddle.matmul(a, b)
+    c.sum()
+    counts = dispatch.dispatch_counts(mode="eager")
+    assert counts.get("add", 0) >= 1
+    assert counts.get("matmul", 0) >= 1
+    assert counts.get("sum", 0) >= 1
+    host = obs.registry().get(dispatch.OP_HOST_SECONDS)
+    assert host.value(labels={"op": "matmul"}) > 0
+
+
+def test_disabled_hook_is_noop(monkeypatch):
+    """With telemetry off, apply_op must not even reach the recording
+    path — the fast-path boolean short-circuits before any import."""
+    def boom(*a, **k):
+        raise AssertionError("dispatch.record called with telemetry off")
+
+    monkeypatch.setattr(dispatch, "record", boom)
+    a = paddle.to_tensor(np.ones((2, 2), np.float32))
+    (a * a).sum()  # would raise through the finally if the hook ran
+    assert obs.registry().dump()["counters"] == {}
+
+
+def test_enable_env_bootstrap(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_TELEMETRY", "1")
+    obs._bootstrap_from_env()
+    assert obs.enabled()
+    from paddle_tpu.core import op as op_mod
+    assert op_mod.TELEMETRY is True
+
+
+def test_flags_wire_telemetry():
+    paddle.set_flags({"FLAGS_telemetry": True})
+    assert obs.enabled()
+    paddle.set_flags({"FLAGS_telemetry": False})
+    assert not obs.enabled()
+    assert paddle.get_flags("FLAGS_telemetry") == {"FLAGS_telemetry": False}
+
+
+# -- retrace sentinel --------------------------------------------------------
+
+def test_retrace_sentinel_fires_on_shape_polymorphic_jit(caplog):
+    import jax
+    import jax.numpy as jnp
+
+    obs.enable(True)
+    retrace.set_retrace_threshold(2)
+    f = obs.instrument_jit(jax.jit(lambda x: x * 2.0), name="poly_fn")
+    with caplog.at_level(logging.WARNING, "paddle_tpu.observability"):
+        for n in range(1, 5):  # 4 distinct shapes -> 4 compiles
+            f(jnp.ones((n,), jnp.float32))
+        for _ in range(3):     # stable shape -> no new compiles
+            f(jnp.ones((2,), jnp.float32))
+    assert retrace.compile_count("poly_fn") == 4
+    assert retrace.retrace_warning_count() == 2  # compiles 3 and 4
+    storm = [r for r in caplog.records if "retrace_storm" in r.getMessage()]
+    assert len(storm) == 2
+    payload = json.loads(storm[-1].getMessage().split("sentinel: ", 1)[1])
+    assert payload["fn"] == "poly_fn" and payload["compiles"] == 4
+
+
+def test_train_step_compiles_once_and_counts_steps(caplog):
+    """Acceptance: a 3-step GPT-small CPU train loop records exactly ONE
+    compile for the train step (zero steady-state retraces), nonzero
+    op-dispatch counters, and one step-latency sample per step."""
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.models import (GPTPretrainingCriterion, build_gpt,
+                                   gpt_config)
+
+    obs.enable(True)
+    cfg = gpt_config("gpt-tiny", hidden_dropout_prob=0.0,
+                     attention_dropout_prob=0.0)
+    paddle.seed(0)
+    model = build_gpt(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+    step = dist.make_train_step(model, opt,
+                                loss_fn=GPTPretrainingCriterion())
+    ids = np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (2, 33)).astype(np.int64)
+    with caplog.at_level(logging.WARNING, "paddle_tpu.observability"):
+        for _ in range(3):
+            loss = step(ids[:, :-1], ids[:, 1:])
+    assert np.isfinite(float(loss))
+    assert retrace.compile_count("spmd_train_step") == 1
+    assert retrace.retrace_warning_count() == 0
+    assert not [r for r in caplog.records
+                if "retrace_storm" in r.getMessage()]
+    assert steps.step_latency_count("train_step") == 3
+    # examples/s: 3 steps x batch 2
+    ex = obs.registry().get(steps.EXAMPLES_TOTAL)
+    assert ex.value(labels={"fn": "train_step"}) == 6
+    # the traced forward/backward ops were counted under mode=traced
+    assert sum(dispatch.dispatch_counts(mode="traced").values()) > 0
+    # an eager op on the loss lands on the other side of the split
+    (loss + 1.0).numpy()
+    assert sum(dispatch.dispatch_counts(mode="eager").values()) > 0
+
+
+def test_to_static_cache_miss_records_compile():
+    obs.enable(True)
+
+    @paddle.jit.to_static
+    def f(x):
+        return x * 2 + 1
+
+    f(paddle.to_tensor(np.ones((3,), np.float32)))
+    f(paddle.to_tensor(np.ones((3,), np.float32)))  # hit: no new compile
+    f(paddle.to_tensor(np.ones((5,), np.float32)))  # miss
+    assert retrace.compile_count("to_static:f") == 2
+
+
+# -- step metrics ------------------------------------------------------------
+
+def test_record_step_and_hapi_callback():
+    obs.enable(True)
+    steps.record_step(0.25, examples=8, fn="unit")
+    assert steps.step_latency_count("unit") == 1
+    g = obs.registry().get(steps.EXAMPLES_PER_SEC)
+    assert g.value(labels={"fn": "unit"}) == pytest.approx(32.0)
+
+    from paddle_tpu.hapi.callbacks import TelemetryCallback, config_callbacks
+    cbks = config_callbacks(verbose=0, model=None)
+    assert any(isinstance(c, TelemetryCallback) for c in cbks.callbacks)
+    cb = TelemetryCallback()
+    cb.set_params({"batch_size": 4})
+    cb.on_train_batch_begin(0, {})
+    cb.on_train_batch_end(0, {})
+    assert steps.step_latency_count("hapi_train_batch") == 1
+
+    obs.disable()
+    cbks = config_callbacks(verbose=0, model=None)
+    assert not any(isinstance(c, TelemetryCallback) for c in cbks.callbacks)
+
+
+# -- chrome-trace merge ------------------------------------------------------
+
+def test_chrome_trace_has_spans_and_counter_samples(tmp_path):
+    import paddle_tpu.profiler as profiler
+
+    obs.enable(True)
+    prof = profiler.Profiler(targets=[profiler.ProfilerTarget.CPU])
+    prof.start()
+    with profiler.RecordEvent("unit_span"):
+        a = paddle.to_tensor(np.ones((2, 2), np.float32))
+        (a + a).sum()
+    prof.stop()
+    path = tmp_path / "trace.json"
+    prof._export_chrome(str(path))
+    data = json.load(open(path))
+    events = data["traceEvents"]
+    spans = [e for e in events if e["ph"] == "X"]
+    counters = [e for e in events if e["ph"] == "C"]
+    assert any(e["name"] == "unit_span" for e in spans)
+    assert counters, "no counter samples merged into the chrome trace"
+    assert all("value" in e["args"] for e in counters)
+    # labeled series fold into the track name
+    assert any("op=" in e["name"] for e in counters)

@@ -110,3 +110,48 @@ def test_non_onnx_path_routes_to_jit_save(tmp_path):
     got = got.numpy() if hasattr(got, "numpy") else np.asarray(got)
     np.testing.assert_allclose(got, m(paddle.to_tensor(x)).numpy(),
                                rtol=1e-5)
+
+
+def test_opset_9_maps_to_13_with_warning(tmp_path):
+    """The reference paddle2onnx default (opset 9) must not hard-fail:
+    it upgrades to 13 with a warning; anything in [10, 12] still raises,
+    and later opsets are declared as requested."""
+    paddle.seed(0)
+    m = nn.Linear(4, 2)
+    m.eval()
+    with pytest.warns(UserWarning, match="opset"):
+        p = export(m, str(tmp_path / "o9.onnx"),
+                   input_spec=[InputSpec([2, 4], "float32")],
+                   opset_version=9)
+    assert proto.parse_model(open(p, "rb").read())["opsets"] == [("", 13)]
+
+    p = export(m, str(tmp_path / "o17.onnx"),
+               input_spec=[InputSpec([2, 4], "float32")], opset_version=17)
+    assert proto.parse_model(open(p, "rb").read())["opsets"] == [("", 17)]
+
+    with pytest.raises(ValueError, match="opset"):
+        export(m, str(tmp_path / "o11.onnx"),
+               input_spec=[InputSpec([2, 4], "float32")], opset_version=11)
+
+
+def test_int64_peer_literal_keeps_dtype(tmp_path):
+    """Weak-typed python-int literals take the PEER operand's integer dtype
+    (strict ONNX runtimes reject mixed-dtype binary nodes): an int64 input
+    must see an int64 literal initializer, and the round-trip output stays
+    int64."""
+    class AddOne(nn.Layer):
+        def forward(self, x):
+            return x + 1
+
+    m = AddOne()
+    m.eval()
+    p = export(m, str(tmp_path / "i64.onnx"),
+               input_spec=[InputSpec([3], "int64")])
+    raw = open(p, "rb").read()
+    x = np.arange(3, dtype=np.int64)
+    (got,) = runtime.run(raw, {"input_0": x})
+    assert got.dtype == np.int64
+    np.testing.assert_array_equal(got, x + 1)
+    g = proto.parse_model(raw)["graph"]
+    lits = [v for k, v in g["initializers"].items() if k.startswith("lit")]
+    assert lits and all(v.dtype == np.int64 for v in lits), g["initializers"]
