@@ -50,10 +50,15 @@ def _scan_nan_inf(name, out):
         if hasattr(v, "dtype") and jnp.issubdtype(v.dtype, jnp.floating):
             bad = ~jnp.isfinite(v)
             if bool(bad.any()):
+                n_nan, n_inf = int(jnp.isnan(v).sum()), int(jnp.isinf(v).sum())
+                # error path only (never per-op): the crash dump's flight
+                # tail carries the op provenance of the first bad value
+                from ..observability import flight
+                flight.record("nan_inf", name, output=i, nan=n_nan,
+                              inf=n_inf, shape=str(tuple(v.shape)))
                 raise RuntimeError(
                     f"Operator {name} output {i} contains "
-                    f"{int(jnp.isnan(v).sum())} NaN and "
-                    f"{int(jnp.isinf(v).sum())} Inf values "
+                    f"{n_nan} NaN and {n_inf} Inf values "
                     f"(FLAGS_check_nan_inf is set)")
 
 

@@ -156,6 +156,56 @@ def _in_trace(g: Group) -> bool:
     return g.axis_name is not None and mesh_mod.axis_bound(g.axis_name)
 
 
+def _span(op_name: str, g: Group, value=None):
+    """Flight-recorder span for one collective call: op, mesh axis, group
+    size, payload bytes, eager-vs-in-trace mode.  Always on (collectives
+    are per-step, not per-op); in-trace calls record once per compile —
+    exactly the provenance a hung-allreduce crash dump needs."""
+    from ..observability import trace as trace_mod
+    attrs = {"axis": g.axis_name or "", "nranks": g.nranks,
+             "mode": "trace" if _in_trace(g) else "eager"}
+    count = 1
+    if isinstance(value, (list, tuple)):
+        count, value = len(value), (value[0] if value else None)
+    v = value._value if isinstance(value, Tensor) else value
+    shape = getattr(v, "shape", None)
+    dtype = getattr(v, "dtype", None)
+    if shape is not None and dtype is not None:
+        try:
+            attrs["bytes"] = (int(np.prod(shape)) *
+                              np.dtype(dtype).itemsize * count)
+        except Exception:  # exotic dtypes: the span still records
+            pass
+    return trace_mod.span(f"collective.{op_name}", attrs)
+
+
+def _instrumented(value_param: str | None):
+    """Wrap a collective in a flight-recorder span; `value_param` names
+    the payload argument (shape/dtype → bytes attr).  Resolved by
+    signature position once at decoration time so the per-call cost is a
+    couple of dict lookups on top of the span itself."""
+    import functools
+    import inspect
+
+    def deco(fn):
+        params = list(inspect.signature(fn).parameters)
+        gi = params.index("group")
+        vi = params.index(value_param) if value_param else None
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            group = kwargs.get("group", args[gi] if gi < len(args) else None)
+            value = None
+            if vi is not None:
+                value = kwargs.get(value_param,
+                                   args[vi] if vi < len(args) else None)
+            with _span(fn.__name__, _group(group), value):
+                return fn(*args, **kwargs)
+        wrapper.__wrapped__ = fn
+        return wrapper
+    return deco
+
+
 def _sharded_axis_exec(fn, value, g: Group):
     """Run `fn` (written against a bound axis) for real via shard_map when the
     eager value is sharded along the group's mesh axis."""
@@ -176,6 +226,7 @@ def _sharded_axis_exec(fn, value, g: Group):
 
 # -- core collectives --------------------------------------------------------
 
+@_instrumented("tensor")
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
                use_calc_stream=None):
     """collective.py:751 parity; in-place on `tensor` like the reference."""
@@ -218,6 +269,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
     return _rewrap(tensor, out)
 
 
+@_instrumented("tensor")
 def all_gather(tensor_list, tensor, group=None, sync_op=True,
                use_calc_stream=None):
     """collective.py:956 parity: appends nranks tensors to tensor_list.
@@ -237,6 +289,7 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True,
     return tensor_list
 
 
+@_instrumented("value")
 def all_gather_concat(value, group=None, axis=0):
     """Functional all-gather along `axis` (the shape used by mp layers)."""
     g = _group(group)
@@ -248,6 +301,7 @@ def all_gather_concat(value, group=None, axis=0):
     return jnp.concatenate([v] * g.nranks, axis=axis)
 
 
+@_instrumented("tensor")
 def broadcast(tensor, src=0, group=None, sync_op=True, use_calc_stream=None):
     """collective.py parity.  In-trace this selects src's shard on every rank."""
     g = _group(group)
@@ -269,6 +323,7 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True,
     return all_reduce(tensor, op=op, group=_group(group))
 
 
+@_instrumented("tensor_or_tensor_list")
 def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None,
                    sync_op=True, use_calc_stream=None):
     """collective.py:1813 parity: reduce then scatter chunks across ranks."""
@@ -291,6 +346,7 @@ def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None,
     return _rewrap(tensor, out)
 
 
+@_instrumented("in_tensor_list")
 def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True,
                use_calc_stream=None):
     """collective.py:1239 parity."""
@@ -311,6 +367,7 @@ def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True,
     return res
 
 
+@_instrumented("in_value")
 def all_to_all_single(out_value, in_value, out_split_sizes=None,
                       in_split_sizes=None, group=None, sync_op=True):
     g = _group(group)
@@ -323,6 +380,7 @@ def all_to_all_single(out_value, in_value, out_split_sizes=None,
     return _rewrap(out_value, out) if out_value is not None else out
 
 
+@_instrumented("tensor")
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     g = _group(group)
     if _in_trace(g):
@@ -336,6 +394,7 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     return tensor
 
 
+@_instrumented("tensor")
 def send(tensor, dst=0, group=None, sync_op=True, use_calc_stream=None):
     """P2P send (collective.py send/recv).  Only meaningful in-program: the
     pipeline runtime lowers send/recv pairs to ppermute (SURVEY §7: PP via

@@ -40,7 +40,13 @@ _DEFAULTS = {
                          "enable_partial_send_recv": True,
                          # TPU extension: per-tick remat in the GPipe scan
                          # (None = auto: on when num_virtual > 1)
-                         "remat": None},
+                         "remat": None,
+                         # TPU extension: accept the one-program GSPMD
+                         # degrade (no micro-batch pipelining) when the
+                         # explicit schedule can't apply; with an explicit
+                         # schedule_mode the degrade RAISES unless this
+                         # escape hatch is set
+                         "allow_spmd_fallback": False},
     "hybrid_configs": {"dp_degree": -1, "mp_degree": 1, "pp_degree": 1,
                        "sharding_degree": 1, "sep_degree": 1,
                        "order": ["dp", "pp", "sharding", "mp"]},
@@ -99,6 +105,11 @@ class DistributedStrategy:
                         f"unknown key(s) {sorted(unknown)} for {name}; "
                         f"valid keys: {sorted(base)}")
             base.update(value)
+            # remember which keys the USER set (vs defaults): config
+            # consumers distinguish "asked for schedule X" from "took the
+            # default" (pipeline_parallel.py's degrade-to-GSPMD policy)
+            self.__dict__.setdefault("_explicit_config_keys", {}).setdefault(
+                name, set()).update(value)
             return
         if name in _FLAGS:
             object.__setattr__(self, "_" + name, value)

@@ -32,6 +32,14 @@ class PipelineParallel(MetaParallelBase):
         self.micro_batch_size = cfg.get("micro_batch_size", 1)
         self.accumulate_steps = cfg.get("accumulate_steps", 1)
         self.schedule_mode = cfg.get("schedule_mode", "1F1B")
+        # schedule_mode set by the USER (not the strategy default): a
+        # degrade to the unpipelined GSPMD path is then an error, not a
+        # warning (round-5 verdict #8), unless allow_spmd_fallback opts in
+        self._explicit_schedule = "schedule_mode" in getattr(
+            strategy, "_explicit_config_keys", {}).get("pipeline_configs",
+                                                       set())
+        self._allow_spmd_fallback = bool(cfg.get("allow_spmd_fallback",
+                                                 False))
         self.num_stages = hcg.get_pipe_parallel_world_size() if hcg else 1
         self.stage_id = hcg.get_stage_id() if hcg else 0
         self._train_step = None
@@ -115,7 +123,26 @@ class PipelineParallel(MetaParallelBase):
                     # decompose_pipeline_layer raises for non-uniform/shared
                     # stages; GPipeTrainStep for divisibility/mesh mismatch —
                     # both are documented "can't explicit-pipeline" cases
+                    from ....observability import flight
+                    if self._explicit_schedule and \
+                            not self._allow_spmd_fallback:
+                        # the user asked for a specific schedule: losing
+                        # micro-batch pipelining is a config error, not a
+                        # performance footnote
+                        raise RuntimeError(
+                            f"pipeline degree {self.num_stages} with "
+                            f"explicit schedule_mode="
+                            f"{self.schedule_mode!r} cannot run the "
+                            f"explicit pipeline schedule ({e}); set "
+                            f"pipeline_configs['allow_spmd_fallback']="
+                            f"True to accept the one-program GSPMD "
+                            f"degrade WITHOUT micro-batch pipelining"
+                        ) from e
                     import warnings
+                    flight.record("pipeline", "spmd_fallback",
+                                  stages=self.num_stages,
+                                  schedule=self.schedule_mode,
+                                  reason=str(e)[:256])
                     warnings.warn(
                         f"pipeline degree {self.num_stages} requested but "
                         f"the explicit pipeline schedule can't apply "

@@ -685,13 +685,26 @@ class ShardedTrainStep:
 
     def __call__(self, *batch):
         from ..core.op import TELEMETRY
+        from ..observability import trace as _trace
+        from ..observability import watchdog as _watchdog
         t0 = time.perf_counter() if TELEMETRY else 0.0
-        batch = self.shard_batch(*batch)
-        if self._jitted is None:
-            self._jitted = self._build(len(batch))
-        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
-        core, slots = self._split_tree()
-        new_tree, loss = self._jitted(core, slots, lr, batch)
+        # always-on step span: the flight recorder shows the in-flight
+        # step when the process crashes or hangs mid-dispatch.  The
+        # watchdog (opt-in, PADDLE_TPU_STEP_TIMEOUT_S) dumps the same
+        # bundle if this step outlives its deadline.
+        step_no = int(self.optimizer._step_count) + 1
+        with _trace.span("train_step", fn="spmd_train_step", step=step_no):
+            armed = _watchdog.arm("spmd_train_step")
+            try:
+                batch = self.shard_batch(*batch)
+                if self._jitted is None:
+                    self._jitted = self._build(len(batch))
+                lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+                core, slots = self._split_tree()
+                new_tree, loss = self._jitted(core, slots, lr, batch)
+            finally:
+                if armed:
+                    _watchdog.disarm()
         self.state = TrainState(**new_tree)
         self.optimizer._step_count += 1
         if TELEMETRY:
@@ -757,8 +770,18 @@ class ShardedTrainStep:
         lrs = jnp.asarray(lrs, jnp.float32)
         core, slots = self._split_tree()
         from ..core.op import TELEMETRY
+        from ..observability import trace as _trace
+        from ..observability import watchdog as _watchdog
         t0 = time.perf_counter() if TELEMETRY else 0.0
-        new_tree, losses = self._jitted_multi(core, slots, lrs, tuple(vals))
+        with _trace.span("train_step", fn="spmd_train_step_multi",
+                         steps=k, step=saved_count + 1):
+            armed = _watchdog.arm("spmd_train_step_multi")
+            try:
+                new_tree, losses = self._jitted_multi(core, slots, lrs,
+                                                      tuple(vals))
+            finally:
+                if armed:
+                    _watchdog.disarm()
         self.state = TrainState(**new_tree)
         self.optimizer._step_count += k
         if TELEMETRY:

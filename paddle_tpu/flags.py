@@ -12,6 +12,11 @@ ONLY — XLA owns those concerns.  Behavioral flags that are wired:
   FLAGS_telemetry      — paddle_tpu.observability: op-dispatch counters,
                          retrace sentinel, step metrics (also enabled by
                          the PADDLE_TPU_TELEMETRY=1 env var)
+
+Every set_flags() change is also recorded into the always-on flight
+recorder (observability/flight.py), so a crash dump names the behavioral
+flags (and, via core/op.py, the op that tripped FLAGS_check_nan_inf) that
+were live when the process died.
 """
 from __future__ import annotations
 
@@ -63,6 +68,11 @@ def set_flags(flags: dict):
         if key not in _FLAGS:
             raise ValueError(f"unknown flag {k!r}")
         _FLAGS[key] = _coerce(_FLAGS[key], v)
+        try:  # config provenance for crash dumps; never a set_flags failure
+            from .observability import flight
+            flight.record("flag", key, value=str(_FLAGS[key]))
+        except Exception:
+            pass
         if key == "FLAGS_check_nan_inf":
             _sync_check_nan_inf()
         if key == "FLAGS_telemetry":

@@ -67,7 +67,18 @@ def _unflatten(flat):
 def save_sharded(state: dict, dirname: str) -> None:
     """Write `state` (possibly nested state_dict) as per-tensor .npy files +
     manifest.  Atomic: writes into `<dir>.tmp` then renames."""
+    from ..observability import trace as _trace
+    with _trace.span("checkpoint.save", dir=dirname) as _sp:
+        _save_sharded(state, dirname, _sp)
+
+
+def _save_sharded(state: dict, dirname: str, _sp=None) -> None:
     flat = _flatten(_to_numpy_tree(state))
+    if _sp is not None:
+        _sp.attrs["leaves"] = len(flat)
+        _sp.attrs["bytes"] = int(sum(
+            v.nbytes for v in flat.values()
+            if isinstance(v, np.ndarray) and v.dtype != object))
     tmp = dirname + ".tmp"
     if os.path.exists(tmp):
         shutil.rmtree(tmp)
@@ -104,14 +115,17 @@ def save_sharded(state: dict, dirname: str) -> None:
 
 
 def load_sharded(dirname: str, return_numpy: bool = False) -> dict:
-    with open(os.path.join(dirname, _MANIFEST)) as f:
-        meta_all = json.load(f)
-    flat = {}
-    for key, meta in meta_all["tensors"].items():
-        arr = np.load(os.path.join(dirname, meta["file"]))
-        flat[key] = arr if return_numpy else Tensor(arr)
-    flat.update(meta_all.get("scalars", {}))
-    return _unflatten(flat)
+    from ..observability import trace as _trace
+    with _trace.span("checkpoint.load", dir=dirname) as sp:
+        with open(os.path.join(dirname, _MANIFEST)) as f:
+            meta_all = json.load(f)
+        flat = {}
+        for key, meta in meta_all["tensors"].items():
+            arr = np.load(os.path.join(dirname, meta["file"]))
+            flat[key] = arr if return_numpy else Tensor(arr)
+        flat.update(meta_all.get("scalars", {}))
+        sp.attrs["leaves"] = len(flat)
+        return _unflatten(flat)
 
 
 class AsyncCheckpointSaver:
@@ -140,20 +154,28 @@ class AsyncCheckpointSaver:
         return os.path.join(self.base_dir, f"step_{step}")
 
     def save(self, state: dict, step: int, blocking: bool = False):
+        from ..observability import trace as _trace
         self.wait()  # one outstanding write at a time
-        snapshot = _flatten(_to_numpy_tree(state))
+        # snapshot blocks the caller (device → host copies); the write
+        # phase runs in the worker thread — two separate spans so a
+        # stalled train loop and a stalled disk are distinguishable
+        with _trace.span("checkpoint.snapshot", step=step):
+            snapshot = _flatten(_to_numpy_tree(state))
 
         def work():
             try:
-                if self._remote:
-                    import tempfile
-                    with tempfile.TemporaryDirectory() as tmp:
-                        local = os.path.join(tmp, f"step_{step}")
-                        save_sharded(_unflatten(snapshot), local)
-                        self._fs.upload(local, self._step_dir(step))
-                else:
-                    save_sharded(_unflatten(snapshot), self._step_dir(step))
-                self._prune()
+                with _trace.span("checkpoint.async_write", step=step,
+                                 remote=self._remote):
+                    if self._remote:
+                        import tempfile
+                        with tempfile.TemporaryDirectory() as tmp:
+                            local = os.path.join(tmp, f"step_{step}")
+                            save_sharded(_unflatten(snapshot), local)
+                            self._fs.upload(local, self._step_dir(step))
+                    else:
+                        save_sharded(_unflatten(snapshot),
+                                     self._step_dir(step))
+                    self._prune()
             except BaseException as e:  # noqa: BLE001
                 self._error = e
 

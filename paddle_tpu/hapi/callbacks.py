@@ -125,6 +125,7 @@ class TelemetryCallback(Callback):
         super().__init__()
         self.fn = fn
         self._t0 = None
+        self._span = None
 
     @staticmethod
     def _obs():
@@ -134,10 +135,20 @@ class TelemetryCallback(Callback):
     def on_train_batch_begin(self, step, logs=None):
         if self._obs().enabled():
             import time
+
+            # batch span: the hapi fit loop shows up on the chrome-trace
+            # timeline (and in the flight record) next to the compiled
+            # step's own train_step spans
+            self._span = self._obs().trace.span("hapi.train_batch",
+                                                step=step)
+            self._span.__enter__()
             self._t0 = time.perf_counter()
 
     def on_train_batch_end(self, step, logs=None):
         obs = self._obs()
+        if self._span is not None:
+            self._span.__exit__(None, None, None)
+            self._span = None
         if not obs.enabled() or self._t0 is None:
             return
         import time

@@ -70,10 +70,15 @@ def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_id,
     np.random.seed((seed + worker_id) % (2 ** 32))
     if worker_init_fn is not None:
         worker_init_fn(worker_id)
+    # flight-recorder spans live in THIS process's ring (fork copy): a
+    # worker crash dump shows whether it died starving (get wait) or
+    # blocked on a full ring (put wait)
+    from ..observability import trace as _trace
     is_iterable = isinstance(dataset, IterableDataset)
     it = iter(dataset) if is_iterable else None
     while True:
-        task = index_queue.get()
+        with _trace.span("dataloader.worker_get", worker=worker_id):
+            task = index_queue.get()
         if task is None:
             break
         batch_id, indices = task
@@ -85,7 +90,10 @@ def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_id,
                     continue
             else:
                 samples = [dataset[i] for i in indices]
-            data_queue.put((batch_id, None, collate_fn(samples)))
+            batch = collate_fn(samples)
+            with _trace.span("dataloader.worker_put", worker=worker_id,
+                             batch_id=batch_id):
+                data_queue.put((batch_id, None, batch))
         except BrokenPipeError:  # shm ring closed by parent shutdown
             break
         except Exception:  # noqa: BLE001
@@ -217,7 +225,15 @@ class _MultiProcessIter:
             if self._recv_idx in self._reorder:
                 err, batch = self._reorder.pop(self._recv_idx)
             else:
-                bid, err, batch = self.data_queue.get()
+                # span = time the train loop starved on the workers;
+                # `outstanding` is the dispatched-not-yet-received queue
+                # depth the flight record needs to tell "workers slow"
+                # from "queue sized wrong"
+                from ..observability import trace as _trace
+                with _trace.span("dataloader.get", batch_id=self._recv_idx,
+                                 outstanding=self._outstanding,
+                                 reordered=len(self._reorder)):
+                    bid, err, batch = self.data_queue.get()
                 if bid != self._recv_idx:
                     self._reorder[bid] = (err, batch)
                     continue

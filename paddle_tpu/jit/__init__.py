@@ -130,18 +130,24 @@ class StaticFunction:
         def raw(values, *vv):
             return jitted(values, *vv)
 
-        if TELEMETRY and miss:
-            # retrace sentinel: each cache miss is one trace+compile of this
-            # to_static function; the cache size is its live signature count
+        if miss:
+            # each cache miss is one trace+compile of this to_static
+            # function: a compile span always lands in the flight record;
+            # the retrace sentinel (cache size = live signature count)
+            # additionally books metrics when telemetry is on
             import time as _time
 
             from ..observability import retrace as _retrace
-            t0 = _time.perf_counter()
-            out = apply_op(raw, "to_static", (entries, *args), {})
+            from ..observability import trace as _trace
             fname = getattr(self._function, "__name__", None) or "forward"
-            _retrace.record_compile(f"to_static:{fname}", key,
-                                    _time.perf_counter() - t0,
-                                    len(self._cache))
+            t0 = _time.perf_counter()
+            with _trace.span("compile", fn=f"to_static:{fname}",
+                             n_compiles=len(self._cache)):
+                out = apply_op(raw, "to_static", (entries, *args), {})
+            if TELEMETRY:
+                _retrace.record_compile(f"to_static:{fname}", key,
+                                        _time.perf_counter() - t0,
+                                        len(self._cache))
             return out
         return apply_op(raw, "to_static", (entries, *args), {})
 

@@ -1,22 +1,29 @@
 """paddle_tpu.observability — process-wide runtime telemetry.
 
-One registry (counters / gauges / histograms with labels) plus three
-instrumentation layers wired into the framework's hot paths:
+Two layers with different duty cycles:
 
-* op-dispatch telemetry in the ``@defop`` hub (``core/op.py``): per-op call
-  counts, eager-vs-traced split, cumulative host time;
-* the retrace sentinel around the jit entry points (``distributed/spmd.py``
-  train steps, ``jit.to_static``): compile counts, compile wall-time,
-  abstract-signature keys, and a structured warning on recompile storms;
-* step-level training metrics (step latency, examples/s, device memory
-  gauges) from the SPMD step and the hapi ``TelemetryCallback``.
+**Metrics (off by default).**  One registry (counters / gauges /
+histograms with labels) fed by three instrumentation layers: op-dispatch
+telemetry in the ``@defop`` hub (``core/op.py``), the retrace sentinel
+around the jit entry points (``distributed/spmd.py`` train steps,
+``jit.to_static``), and step-level training metrics (step latency,
+examples/s, device memory gauges; hapi ``TelemetryCallback``).  Costs one
+boolean check per op when off.  Enable with ``PADDLE_TPU_TELEMETRY=1``,
+``paddle_tpu.set_flags({"FLAGS_telemetry": True})`` or :func:`enable`.
+Export with :func:`dump` (JSON), :func:`to_prometheus_text`, or let
+``profiler.export_chrome_tracing`` merge counter samples into its
+host-span timeline.  ``python bench.py --telemetry`` appends a per-leg
+telemetry block to the bench JSON.
 
-Everything is OFF by default and costs one boolean check per op when off.
-Enable with ``PADDLE_TPU_TELEMETRY=1``, ``paddle_tpu.set_flags({"FLAGS_
-telemetry": True})`` or :func:`enable`.  Export with :func:`dump` (JSON),
-:func:`to_prometheus_text`, or let ``profiler.export_chrome_tracing`` merge
-counter samples into its host-span timeline.  ``python bench.py
---telemetry`` appends a per-leg telemetry block to the bench JSON.
+**Timeline (always on).**  :mod:`trace` spans (``span("compile", ...)``
+context manager/decorator with thread-local nesting), the :mod:`flight`
+recorder (a bounded ring of structured events fed by span open/close plus
+one-shot events from compiles, collectives, dataloader waits, checkpoint
+phases, flag changes and NaN/Inf hits), and :mod:`watchdog` crash/hang
+diagnostics (excepthook + SIGTERM/SIGINT dump of the flight tail and
+all-thread stacks; opt-in step deadline via ``PADDLE_TPU_STEP_TIMEOUT_S``).
+These run from import because their cost is per-span, never per-op — the
+crash that matters never reproduces under a profiler.
 """
 from __future__ import annotations
 
@@ -77,5 +84,11 @@ from . import retrace  # noqa: E402,F401
 from . import steps  # noqa: E402,F401
 from .retrace import (  # noqa: E402,F401
     get_retrace_threshold, instrument_jit, set_retrace_threshold)
+# the always-on timeline layer (no registry dependency)
+from . import flight  # noqa: E402,F401
+from . import trace  # noqa: E402,F401
+from . import watchdog  # noqa: E402,F401
+from .trace import span  # noqa: E402,F401
 
 _bootstrap_from_env()
+watchdog._bootstrap_from_env()

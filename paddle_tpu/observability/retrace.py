@@ -23,7 +23,8 @@ import logging
 import os
 import time
 
-from . import registry
+from . import flight, registry
+from . import trace as trace_mod
 
 logger = logging.getLogger("paddle_tpu.observability")
 
@@ -72,6 +73,8 @@ def record_compile(name: str, key, seconds: float, n_compiles: int):
         reg.counter(JIT_RETRACE_WARNINGS,
                     "retrace-storm warnings emitted").inc(
             1.0, labels={"fn": name})
+        flight.record("retrace_storm", name, compiles=n_compiles,
+                      threshold=_threshold[0])
         logger.warning(
             "paddle_tpu retrace sentinel: %s",
             json.dumps({"event": "retrace_storm", "fn": name,
@@ -86,10 +89,12 @@ def record_compile(name: str, key, seconds: float, n_compiles: int):
 
 class InstrumentedJit:
     """Pass-through wrapper over a ``jax.jit``-ed callable that books
-    compiles per distinct abstract signature.  When telemetry is off the
-    per-call cost is one boolean check; attribute access (``.lower``,
-    ``.trace``...) delegates to the wrapped function so AOT paths keep
-    working."""
+    compiles per distinct abstract signature.  Signature tracking is
+    always on (one tree-flatten per *step* call — per-step, never per-op)
+    so compile begin/end lands in the flight recorder even with telemetry
+    off; the metrics registry is only touched when telemetry is on.
+    Attribute access (``.lower``, ``.trace``...) delegates to the wrapped
+    function so AOT paths keep working."""
 
     def __init__(self, fn, name: str):
         self._fn = fn
@@ -97,18 +102,23 @@ class InstrumentedJit:
         self._signatures: set = set()
 
     def __call__(self, *args, **kwargs):
-        from ..core import op as op_mod
-        if not op_mod.TELEMETRY:
-            return self._fn(*args, **kwargs)
         key = _abstract_signature(args, kwargs)
         if key in self._signatures:
             return self._fn(*args, **kwargs)
-        # new abstract signature → jax will trace + compile inside this call
+        # new abstract signature → jax will trace + compile inside this
+        # call; the span books compile begin/end (with the signature key)
+        # into the flight record — a hang inside XLA leaves an open
+        # "compile" span for the crash dump to show
+        n = len(self._signatures) + 1
         t0 = time.perf_counter()
-        out = self._fn(*args, **kwargs)
+        with trace_mod.span("compile", fn=self._name, n_compiles=n,
+                            signature=str(key)[:256]):
+            out = self._fn(*args, **kwargs)
         dt = time.perf_counter() - t0
         self._signatures.add(key)
-        record_compile(self._name, key, dt, len(self._signatures))
+        from ..core import op as op_mod
+        if op_mod.TELEMETRY:
+            record_compile(self._name, key, dt, len(self._signatures))
         return out
 
     def __getattr__(self, item):

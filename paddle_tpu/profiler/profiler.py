@@ -137,6 +137,18 @@ def load_profiler_result(filename: str):
         return json.load(f)
 
 
+def _observability_span_events() -> list[dict]:
+    """Completed observability spans (trace.py ring) as chrome 'X' events
+    (``"cat": "span"``): compile, collective, dataloader, checkpoint and
+    train-step regions land on the same timeline as the host RecordEvent
+    spans — the span ts base is the same perf_counter clock."""
+    try:
+        from ..observability import trace as obs_trace
+    except Exception:  # pragma: no cover
+        return []
+    return obs_trace.chrome_events()
+
+
 def _telemetry_counter_events() -> list[dict]:
     """observability counter samples as chrome-trace 'C' events, so metric
     series land on the same timeline as the host RecordEvent spans (and
@@ -273,6 +285,7 @@ class Profiler:
         events = [{"name": e["name"], "ph": "X", "ts": e["ts"],
                    "dur": e["dur"], "pid": os.getpid(), "tid": e["tid"],
                    "cat": "host"} for e in self._events]
+        events += _observability_span_events()
         events += _telemetry_counter_events()
         with open(path, "w") as f:
             json.dump({"traceEvents": events,

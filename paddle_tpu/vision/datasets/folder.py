@@ -29,27 +29,34 @@ def has_valid_extension(filename, extensions):
     """True when `filename` ends with one of `extensions` (case-folded)."""
     assert isinstance(extensions, (list, tuple)), \
         "`extensions` must be list or tuple."
-    return filename.lower().endswith(tuple(x.lower() for x in extensions))
+    lowered = filename.lower()
+    return any(lowered.endswith(str(ext).lower()) for ext in extensions)
+
+
+def _walk_files(base):
+    """Every file under `base` in the deterministic (sorted dirs, sorted
+    names, symlinks followed) order the folder datasets contract fixes."""
+    for root, _, fnames in sorted(os.walk(base, followlinks=True)):
+        for fname in sorted(fnames):
+            yield os.path.join(root, fname)
 
 
 def make_dataset(dir, class_to_idx, extensions, is_valid_file=None):  # noqa: A002
     """Walk `dir/<class>/**` collecting (path, class_index) pairs in sorted
-    order (folder.py make_dataset contract)."""
-    images = []
-    dir = os.path.expanduser(dir)  # noqa: A001
+    order (folder.py make_dataset contract).  `extensions`, when given,
+    replaces `is_valid_file` with the extension predicate."""
+    base = os.path.expanduser(dir)
     if extensions is not None:
-        def is_valid_file(x):  # noqa: F811
-            return has_valid_extension(x, extensions)
-    for target in sorted(class_to_idx.keys()):
-        d = os.path.join(dir, target)
-        if not os.path.isdir(d):
+        def is_valid_file(path):  # noqa: F811
+            return has_valid_extension(path, extensions)
+    samples = []
+    for target, idx in sorted(class_to_idx.items()):
+        class_dir = os.path.join(base, target)
+        if not os.path.isdir(class_dir):
             continue
-        for root, _, fnames in sorted(os.walk(d, followlinks=True)):
-            for fname in sorted(fnames):
-                path = os.path.join(root, fname)
-                if is_valid_file(path):
-                    images.append((path, class_to_idx[target]))
-    return images
+        samples.extend((path, idx) for path in _walk_files(class_dir)
+                       if is_valid_file(path))
+    return samples
 
 
 def pil_loader(path):
@@ -133,15 +140,10 @@ class ImageFolder(Dataset):
         if extensions is None and is_valid_file is None:
             extensions = IMG_EXTENSIONS
         if is_valid_file is None:
-            def is_valid_file(x):
-                return has_valid_extension(x, extensions)
-        samples = []
-        for walk_root, _, fnames in sorted(
-                os.walk(os.path.expanduser(root), followlinks=True)):
-            for fname in sorted(fnames):
-                f = os.path.join(walk_root, fname)
-                if is_valid_file(f):
-                    samples.append(f)
+            def is_valid_file(path):
+                return has_valid_extension(path, extensions)
+        samples = [path for path in _walk_files(os.path.expanduser(root))
+                   if is_valid_file(path)]
         if len(samples) == 0:
             raise RuntimeError(
                 f"Found 0 files in subfolders of: {root}\n"

@@ -1,0 +1,49 @@
+"""Telemetry-on smoke lane: run a small tier-1 subset with every
+observability layer forced ON so the instrumented paths can't silently rot
+(ISSUE 2 satellite; the tier-1 gate itself runs telemetry-off).
+
+    python tools/telemetry_smoke.py            # default subset
+    python tools/telemetry_smoke.py tests/test_io.py   # explicit subset
+
+Forces PADDLE_TPU_TELEMETRY=1 (metrics registry + op-dispatch hook +
+retrace sentinel + step metrics live) on top of the always-on span/flight
+layer, and a 60 s step watchdog so the watchdog arm/disarm path in the
+SPMD step executes on every train-step test.  Exit code is pytest's.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+# the subset exercises every instrumented subsystem: op dispatch + spans +
+# chrome merge (observability), dataloader waits (io), to_static compiles
+# (jit), checkpoint phases, the SPMD step + collectives (distributed)
+DEFAULT_SUBSET = [
+    "tests/test_observability.py",
+    "tests/test_io.py",
+    "tests/test_jit_static.py",
+    "tests/test_checkpoint.py",
+    "tests/test_distributed.py",
+]
+
+
+def main() -> int:
+    targets = sys.argv[1:] or DEFAULT_SUBSET
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PADDLE_TPU_TELEMETRY": "1",
+        "PADDLE_TPU_STEP_TIMEOUT_S": env.get(
+            "PADDLE_TPU_STEP_TIMEOUT_S", "60"),
+    })
+    cmd = [sys.executable, "-m", "pytest", "-q", "-m", "not slow",
+           "-p", "no:cacheprovider", *targets]
+    print("telemetry smoke lane:", " ".join(cmd), file=sys.stderr)
+    return subprocess.call(cmd, env=env,
+                           cwd=os.path.dirname(os.path.dirname(
+                               os.path.abspath(__file__))))
+
+
+if __name__ == "__main__":
+    sys.exit(main())
