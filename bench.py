@@ -467,6 +467,105 @@ def bench_gpt_decode():
     }
 
 
+def bench_serving():
+    """Continuous-batching serving leg (ISSUE 3): synthetic Poisson
+    arrivals through serving.Engine — many concurrent requests share one
+    compiled prefill and ONE compiled decode step over a fixed slot pool.
+    Reports request throughput, token throughput, p50/p99 time-to-first-
+    token and per-token latency; asserts the continuous-batching
+    invariants (all requests complete, slots recycled, decode never
+    retraces after warmup)."""
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import build_gpt, gpt_config
+    from paddle_tpu.serving import Engine
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    if on_tpu:
+        name, slots, max_len, n_req, new = "gpt2-small-en", 8, 640, 24, 32
+        p_lo, p_hi, rate = 32, 128, 50.0
+    else:  # CI/CPU: tiny shapes, same code path (>=16 concurrent requests)
+        name, slots, max_len, n_req, new = "gpt-tiny", 4, 64, 16, 8
+        p_lo, p_hi, rate = 4, 12, 50.0
+
+    cfg = gpt_config(name, max_position_embeddings=max(max_len, 128),
+                     hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+    paddle.seed(0)
+    model = build_gpt(cfg)
+    model.eval()
+    engine = Engine(model, max_slots=slots, max_len=max_len,
+                    max_queue=2 * n_req)
+    try:
+        rs = np.random.RandomState(0)
+        prompts = [rs.randint(0, cfg.vocab_size,
+                              rs.randint(p_lo, p_hi + 1)).astype(np.int64)
+                   for _ in range(n_req)]
+        # warmup: compile the decode step and both pow2 prompt buckets
+        for plen in {len(min(prompts, key=len)), len(max(prompts, key=len))}:
+            engine.submit(prompts[0][:plen] if plen <= len(prompts[0])
+                          else rs.randint(0, cfg.vocab_size,
+                                          plen).astype(np.int64),
+                          max_new_tokens=2).result(timeout=600)
+        warm_decode = engine.compile_stats()["decode_compiles"]
+
+        t0 = time.perf_counter()
+        handles = []
+        for p in prompts:  # Poisson arrivals: exp-distributed gaps
+            handles.append(engine.submit(p, max_new_tokens=new))
+            time.sleep(min(rs.exponential(1.0 / rate), 0.25))
+        for h in handles:
+            h.result(timeout=600)
+        wall = time.perf_counter() - t0
+        st = engine.stats()
+        decode_compiles = engine.compile_stats()["decode_compiles"]
+    finally:
+        engine.shutdown()
+
+    if st["completed"] < n_req:
+        raise RuntimeError(f"serving leg: only {st['completed']}/{n_req} "
+                           f"requests completed: {st}")
+    if st["slot_reuses"] <= 0:
+        raise RuntimeError(f"serving leg: no slot reuse across {n_req} "
+                           f"requests over {slots} slots: {st}")
+    if decode_compiles != warm_decode:
+        raise RuntimeError(
+            f"serving leg: decode retraced after warmup "
+            f"({warm_decode} -> {decode_compiles} signatures)")
+    total_tokens = sum(len(h.generated) for h in handles)
+    ttfts = np.array([h.ttft_s for h in handles])
+    toks = np.array([t for h in handles for t in h.token_latencies_s])
+    tok_p50 = float(np.percentile(toks, 50))
+    noise = round(100 * (float(np.percentile(toks, 90)) -
+                         float(np.percentile(toks, 10))) / tok_p50, 2) \
+        if tok_p50 else 0.0
+    tps = total_tokens / wall
+    print(f"# serving device={dev.device_kind} slots={slots} "
+          f"requests={n_req} {tps:,.0f} tok/s "
+          f"ttft p50={np.percentile(ttfts, 50) * 1e3:.1f}ms "
+          f"p99={np.percentile(ttfts, 99) * 1e3:.1f}ms "
+          f"token p50={tok_p50 * 1e3:.2f}ms "
+          f"reuses={st['slot_reuses']}", file=sys.stderr)
+    return {
+        "metric": "serving_tokens_per_sec_per_chip",
+        "value": round(tps, 1),
+        "unit": "tokens/s/chip",
+        "noise_pct": noise,
+        "vs_baseline": 0.0,
+        "requests": n_req,
+        "requests_per_sec": round(n_req / wall, 2),
+        "max_slots": slots,
+        "slot_reuses": int(st["slot_reuses"]),
+        "decode_steps": int(st["decode_steps"]),
+        "decode_compiles": int(decode_compiles),
+        "ttft_ms": {"p50": round(float(np.percentile(ttfts, 50)) * 1e3, 2),
+                    "p99": round(float(np.percentile(ttfts, 99)) * 1e3, 2)},
+        "token_ms": {"p50": round(tok_p50 * 1e3, 3),
+                     "p99": round(float(np.percentile(toks, 99)) * 1e3, 3)},
+    }
+
+
 # Flagship first (its number is the driver-parsed top level); then
 # PP-YOLOE (the leg the round-4 budget dropped — it must land before the
 # expensive 1.3B compile); then the north-star 1.3B leg; then the smaller
@@ -483,6 +582,7 @@ _LEGS = [
     ("resnet50", bench_resnet50, 115),
     ("bert_base", bench_bert, 85),
     ("gpt_decode", bench_gpt_decode, 110),
+    ("serving", bench_serving, 60),
 ]
 
 
@@ -596,9 +696,9 @@ def main():
             "error": "backend_unavailable", "detail": probe_err,
             "flight_tail": _flight_tail()}))
         return
-    # default covers the measured sum of all six legs + headroom;
+    # default covers the measured sum of all seven legs + headroom;
     # a tighter driver can export BENCH_BUDGET_S to shed trailing legs
-    budget = float(os.environ.get("BENCH_BUDGET_S", "700"))
+    budget = float(os.environ.get("BENCH_BUDGET_S", "760"))
     start = time.perf_counter()
     legs = {}
     for key, fn, est in _LEGS:

@@ -44,6 +44,7 @@ from . import hapi  # noqa: E402,F401
 from . import jit  # noqa: E402,F401
 from . import static  # noqa: E402,F401
 from . import inference  # noqa: E402,F401
+from . import serving  # noqa: E402,F401
 from . import profiler  # noqa: E402,F401
 from . import distribution  # noqa: E402,F401
 from .flags import get_flags, set_flags  # noqa: E402,F401

@@ -15,7 +15,14 @@ import os
 import numpy as np
 
 __all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
-           "PlaceType"]
+           "PlaceType", "Engine", "RequestHandle", "SlotPool",
+           "QueueFullError", "DeadlineExceededError", "EngineClosedError"]
+
+# the continuous-batching serving engine lives in paddle_tpu.serving;
+# re-exported here because `paddle.inference` is where reference users look
+from ..serving import (  # noqa: F401
+    DeadlineExceededError, Engine, EngineClosedError, QueueFullError,
+    RequestHandle, SlotPool)
 
 
 class PrecisionType:

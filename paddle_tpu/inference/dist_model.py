@@ -14,6 +14,7 @@ NCCL handles in-stage collectives and the message bus handles stage p2p.
 """
 from __future__ import annotations
 
+import os
 from typing import Callable, List, Optional, Sequence
 
 import numpy as np
@@ -21,6 +22,11 @@ import numpy as np
 from ..distributed.fleet_executor import FleetExecutor
 
 __all__ = ["DistModelConfig", "DistModel"]
+
+# bounded wait for one Run(): a dead stage must become a named error, not a
+# silent hang of the caller
+DEFAULT_RUN_TIMEOUT_S = float(
+    os.environ.get("PADDLE_TPU_DIST_MODEL_TIMEOUT_S", "300"))
 
 
 class DistModelConfig:
@@ -76,10 +82,31 @@ class DistModel:
     def _feed(self, micro_idx: int):
         return self._feeds[micro_idx]
 
-    def run(self, feeds) -> List:
+    def _stage_labels(self) -> dict:
+        """task_id -> "source|stageN|sink(rankR)" for timeout diagnostics
+        (from_stages builds nodes in source, stage0..k, sink order)."""
+        labels, idx = {}, 0
+        for node in self._fe.graph.nodes.values():
+            if node.node_type == "Source":
+                name = "source"
+            elif node.node_type == "Sink":
+                name = "sink"
+            else:
+                name = f"stage{idx}"
+                idx += 1
+            labels[node.task_id] = f"{name}(rank{node.rank})"
+        return labels
+
+    def run(self, feeds, timeout_s: Optional[float] = None) -> List:
         """dist_model.cc Run(): split `feeds` into num_micro_batches along
         axis 0, pipeline them, return the concatenated fetches (on the rank
-        hosting the sink; other ranks return [])."""
+        hosting the sink; other ranks return []).
+
+        The wait is BOUNDED (`timeout_s`, default
+        PADDLE_TPU_DIST_MODEL_TIMEOUT_S or 300 s): a dead/slow stage raises
+        a TimeoutError naming the still-pending stage(s) and rank(s), with
+        a flight-recorder event for the crash/hang dump, instead of hanging
+        the caller silently."""
         n = self.config.num_micro_batches
         if isinstance(feeds, (list, tuple)):
             shards = [np.array_split(np.asarray(f), n) for f in feeds]
@@ -89,8 +116,26 @@ class DistModel:
                 self._feeds = [f[0] for f in self._feeds]
         else:
             self._feeds = list(np.array_split(np.asarray(feeds), n))
-        outs = self._fe.run()
-        return outs
+        if timeout_s is None:
+            timeout_s = DEFAULT_RUN_TIMEOUT_S
+        try:
+            return self._fe.run(timeout=timeout_s)
+        except TimeoutError:
+            labels = self._stage_labels()
+            pending = sorted(getattr(self._fe.carrier, "_pending", ()))
+            stuck = ", ".join(labels.get(t, f"task{t}") for t in pending) \
+                or "unknown"
+            from ..observability import flight
+            flight.record("dist_model", "stage_timeout",
+                          timeout_s=float(timeout_s), pending=stuck,
+                          local_rank=self.config.local_rank,
+                          nranks=self.config.nranks)
+            raise TimeoutError(
+                f"DistModel.run: rank {self.config.local_rank} saw no "
+                f"completion after {timeout_s:.1f}s; pending: {stuck} — a "
+                f"dead or wedged stage blocks the whole pipeline (the "
+                f"executor is now poisoned; build a new DistModel or raise "
+                f"timeout_s)") from None
 
     def shutdown(self) -> None:
         self._fe.shutdown()

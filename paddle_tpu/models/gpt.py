@@ -273,6 +273,37 @@ class GPTSelfAttention(Layer):
                 k_raw = k_buf._value if isinstance(k_buf, _T) else k_buf
                 v_raw = v_buf._value if isinstance(v_buf, _T) else v_buf
                 start = jnp.asarray(pos0, jnp.int32)
+                if start.ndim == 1:
+                    # PER-SLOT lengths (continuous batching, serving.Engine):
+                    # `pos0` is a [B] vector — every row owns a slot in a
+                    # shared pool and sits at its own position, so the new
+                    # keys/values scatter to per-row offsets and attention
+                    # runs under a per-row validity mask.  Rows whose write
+                    # would fall off the buffer end (an inactive slot parked
+                    # at max_len) are dropped by the scatter, never clipped
+                    # onto a live row.
+                    rows = jnp.arange(k_raw.shape[0])[:, None]
+                    cols = start[:, None] + jnp.arange(t)[None, :]
+                    k_raw = k_raw.at[rows, cols].set(
+                        k._value.astype(k_raw.dtype), mode="drop")
+                    v_raw = v_raw.at[rows, cols].set(
+                        v._value.astype(v_raw.dtype), mode="drop")
+                    max_len = k_raw.shape[1]
+                    mask = (jnp.arange(max_len)[None, None, :] <=
+                            cols[:, :, None])  # [B, t, L] causal + validity
+                    out = F.scaled_dot_product_attention(
+                        q, _T(k_raw, _internal=True),
+                        _T(v_raw, _internal=True),
+                        attn_mask=_T(mask[:, None], _internal=True),
+                        dropout_p=0.0, is_causal=False, training=False)
+                    out = out.reshape([b, t, nh * self.head_dim])
+                    out = _constrain(out, P(_U, _U, "mp"))
+                    out = self.out_proj(out)
+                    new_cache = (_T(k_raw, _internal=True),
+                                 _T(v_raw, _internal=True), start + t)
+                    if use_cache:
+                        return out, new_cache
+                    return out
                 z = jnp.zeros((), jnp.int32)
                 k_raw = jax.lax.dynamic_update_slice(
                     k_raw, k._value.astype(k_raw.dtype), (z, start, z, z))
@@ -301,6 +332,12 @@ class GPTSelfAttention(Layer):
                              _T(v_raw, _internal=True), start + t)
             else:
                 if cache is not None:
+                    # growing-concat cache: every decode step has a new
+                    # key length, so a jitted caller retraces per token —
+                    # the sentinel points at the static path once
+                    from ..observability.retrace import (
+                        note_dynamic_cache_growth)
+                    note_dynamic_cache_growth("models.gpt.GPTSelfAttention")
                     from ..ops.manipulation import concat
                     k = concat([cache[0], k], axis=1)
                     v = concat([cache[1], v], axis=1)
@@ -472,9 +509,13 @@ class GPTModel(Layer):
                 import jax.numpy as jnp
 
                 from ..core.tensor import Tensor as _T
-                past = caches[0][2]
-                pos = (jnp.asarray(past, jnp.int64) +
-                       jnp.arange(t, dtype=jnp.int64)).reshape(1, t)
+                past = jnp.asarray(caches[0][2], jnp.int64)
+                if past.ndim == 1:
+                    # per-slot lengths: each row decodes at its own position
+                    pos = past[:, None] + jnp.arange(t, dtype=jnp.int64)
+                else:
+                    pos = (past +
+                           jnp.arange(t, dtype=jnp.int64)).reshape(1, t)
                 position_ids = _T(pos, _internal=True)
             else:
                 from ..ops.creation import arange
@@ -612,6 +653,41 @@ class GPTForPretraining(Layer):
         w = self.gpt.embeddings.word_embeddings.weight
         logits = matmul(hidden_states, w, transpose_y=True)
         return _constrain(logits, P(("dcn", "dp", "sharding"), None, "mp"))
+
+    def generate(self, input_ids, max_new_tokens: int = 32,
+                 eos_token_id=None, temperature: float = 0.0, top_k: int = 0,
+                 seed: int = 0, max_slots: int = 8,
+                 timeout_s: float = 600.0) -> np.ndarray:
+        """Batch generation built on the continuous-batching serving engine
+        (paddle_tpu.serving.Engine): each row becomes one request over a
+        shared slot pool, so generation and the serving path are the SAME
+        code.  Returns [batch, prompt + longest] ids; rows that stopped at
+        `eos_token_id` are right-padded with it (0 when no eos is set)."""
+        from ..serving import Engine
+
+        ids = np.asarray(input_ids._value if isinstance(input_ids, Tensor)
+                         else input_ids).astype(np.int64)
+        if ids.ndim == 1:
+            ids = ids[None]
+        b, t = ids.shape
+        engine = Engine(self, max_slots=min(int(max_slots), b),
+                        max_len=t + int(max_new_tokens))
+        try:
+            handles = [engine.submit(row, max_new_tokens=max_new_tokens,
+                                     eos_token_id=eos_token_id,
+                                     temperature=temperature, top_k=top_k,
+                                     seed=seed + i)
+                       for i, row in enumerate(ids)]
+            gen = [h.result(timeout=timeout_s) for h in handles]
+        finally:
+            engine.shutdown()
+        width = max(len(g) for g in gen)
+        pad = 0 if eos_token_id is None else int(eos_token_id)
+        out = np.full((b, t + width), pad, np.int64)
+        out[:, :t] = ids
+        for i, g in enumerate(gen):
+            out[i, t:t + len(g)] = g
+        return out
 
 
 class GPTPretrainingCriterion(Layer):

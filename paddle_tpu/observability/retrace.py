@@ -31,6 +31,7 @@ logger = logging.getLogger("paddle_tpu.observability")
 JIT_COMPILE_TOTAL = "paddle_tpu_jit_compile_total"
 JIT_COMPILE_SECONDS = "paddle_tpu_jit_compile_seconds"
 JIT_RETRACE_WARNINGS = "paddle_tpu_jit_retrace_warnings_total"
+DYNAMIC_CACHE_WARNINGS = "paddle_tpu_dynamic_cache_warnings_total"
 
 # warn when one entry point compiles MORE than this many times
 _DEFAULT_THRESHOLD = int(os.environ.get("PADDLE_TPU_RETRACE_WARN", "5"))
@@ -85,6 +86,41 @@ def record_compile(name: str, key, seconds: float, n_compiles: int):
                                 "check for shape-polymorphic inputs "
                                 "(ragged final batch, python scalars in "
                                 "the data path)"}))
+
+
+_STATIC_CACHE_HINT = (
+    "a growing-concat KV cache changes the key length every decode step, "
+    "so a jitted decode retraces per token; use the STATIC cache path — "
+    "caches of (k_buf, v_buf, length) fixed-shape buffers, as built by "
+    "paddle_tpu.serving.Engine or "
+    "fleet.utils.HybridParallelInferenceHelper")
+_dynamic_cache_warned: set = set()
+
+
+def note_dynamic_cache_growth(site: str):
+    """One-shot structured warning for the growing-concat KV-cache shape
+    pattern: emitted the first time `site` is seen appending to a cache,
+    into the flight recorder always and the metrics registry when telemetry
+    is on.  The hint names the static-cache path to switch to."""
+    if site in _dynamic_cache_warned:
+        return
+    _dynamic_cache_warned.add(site)
+    flight.record("dynamic_kv_cache", site, hint=_STATIC_CACHE_HINT)
+    logger.warning(
+        "paddle_tpu retrace sentinel: %s",
+        json.dumps({"event": "dynamic_kv_cache_growth", "site": site,
+                    "hint": _STATIC_CACHE_HINT}))
+    from ..core import op as op_mod
+    if op_mod.TELEMETRY:
+        registry().counter(
+            DYNAMIC_CACHE_WARNINGS,
+            "growing-concat KV-cache warnings emitted").inc(
+            1.0, labels={"site": site})
+
+
+def reset_dynamic_cache_warnings():
+    """Re-arm the one-shot (tests)."""
+    _dynamic_cache_warned.clear()
 
 
 class InstrumentedJit:

@@ -1,0 +1,28 @@
+"""paddle_tpu.serving — continuous-batching inference over static KV slots.
+
+The bridge between "fast compiled decode step" (models/gpt.py static
+cache) and "serves traffic" (ROADMAP north star): a request-level engine
+where many concurrent generations share ONE compiled prefill and ONE
+compiled decode program over a fixed slot pool.
+
+    from paddle_tpu.serving import Engine
+
+    engine = Engine(model, max_slots=8, max_len=512)
+    handle = engine.submit(prompt_ids, max_new_tokens=64,
+                           stream=print_token)
+    tokens = handle.result(timeout=60)     # or handle.cancel()
+    engine.shutdown()
+
+See docs/serving.md for the architecture, tuning and telemetry fields.
+"""
+from .engine import (  # noqa: F401
+    DeadlineExceededError,
+    Engine,
+    EngineClosedError,
+    QueueFullError,
+    RequestHandle,
+)
+from .slot_pool import SlotPool  # noqa: F401
+
+__all__ = ["Engine", "RequestHandle", "SlotPool", "QueueFullError",
+           "DeadlineExceededError", "EngineClosedError"]

@@ -1,0 +1,693 @@
+"""Continuous-batching serving engine over the static KV-cache decode path.
+
+The GPT flagship already has the fast half of a serving stack: a
+single-program decode step with donated fixed-shape cache buffers
+(models/gpt.py static cache; the AnalysisPredictor zero-copy run analog).
+What it lacked is the request level — this module adds it, in the shape
+production LLM servers (vLLM/Orca-style continuous batching) converged on:
+
+* a **slot pool**: ONE set of ``[max_slots+1, max_len, heads, head_dim]``
+  per-layer cache buffers; each in-flight request owns a slot row, freed on
+  completion and recycled for the next request (SlotPool).  Row max_slots
+  is a scratch slot that absorbs prefill padding writes.
+* a **scheduler loop** (daemon thread): each iteration sweeps
+  cancellations/deadlines, admits queued requests into free slots with ONE
+  batched prefill (prompts padded to a power-of-two bucket, so compile
+  count stays logarithmic), then runs ONE batched decode step for ALL
+  active slots — fixed shapes, so after the first iteration the decode is
+  a single compiled program forever, regardless of request churn
+  (asserted via the retrace sentinel's signature count).
+* a **request/response API**: ``submit() -> RequestHandle`` (Future-style:
+  ``result`` / ``done`` / ``cancel`` / ``exception``), per-token streaming
+  callbacks, a bounded admission queue that rejects with
+  :class:`QueueFullError` when full (backpressure), and per-request
+  deadlines.
+* **observability**: spans + flight events for admit/prefill/decode/evict,
+  gauges for active slots and queue depth, histograms for time-to-first-
+  token and per-token latency — all through the paddle_tpu.observability
+  registry, live from request one.
+
+Per-slot cache positions ride the models' static-cache protocol with a
+VECTOR length: ``caches = [(k_buf, v_buf, lengths[B])]`` makes each row
+write its new keys at its own offset and attend under a per-row validity
+mask (models/gpt.py per-slot branch).
+
+Thread-safety: the engine runs the model from its scheduler thread via the
+functional state swap; do not run the same model's eager forward
+concurrently with in-flight requests.
+"""
+from __future__ import annotations
+
+import atexit
+import itertools
+import threading
+import time
+import weakref
+from collections import deque
+from concurrent.futures import CancelledError
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..observability import flight, registry, span
+from ..observability.retrace import instrument_jit
+from .slot_pool import SlotPool
+
+__all__ = ["Engine", "RequestHandle", "QueueFullError",
+           "DeadlineExceededError", "EngineClosedError"]
+
+# -- metric names (paddle_tpu.observability registry) -------------------------
+SERVING_ACTIVE_SLOTS = "paddle_tpu_serving_active_slots"
+SERVING_QUEUE_DEPTH = "paddle_tpu_serving_queue_depth"
+SERVING_REQUESTS = "paddle_tpu_serving_requests_total"
+SERVING_TOKENS = "paddle_tpu_serving_tokens_total"
+SERVING_TTFT = "paddle_tpu_serving_ttft_seconds"
+SERVING_TOKEN_LATENCY = "paddle_tpu_serving_token_seconds"
+SERVING_BATCH_SECONDS = "paddle_tpu_serving_batch_seconds"
+
+
+class QueueFullError(RuntimeError):
+    """Admission queue is at capacity — backpressure; retry later."""
+
+
+class DeadlineExceededError(TimeoutError):
+    """The request's deadline passed before it finished."""
+
+
+class EngineClosedError(RuntimeError):
+    """The engine was shut down with this request still in flight."""
+
+
+_ids = itertools.count(1)
+
+
+class RequestHandle:
+    """Future-style handle for one submitted request.
+
+    ``result(timeout)`` blocks for the generated token ids (raises the
+    request's error instead — CancelledError / DeadlineExceededError /
+    EngineClosedError).  ``tokens`` is the stream-so-far; ``ttft_s`` and
+    ``token_latencies_s`` carry the latency telemetry the serving bench
+    aggregates into p50/p99.
+    """
+
+    def __init__(self, engine, prompt, max_new_tokens, eos_token_id,
+                 temperature, top_k, seed, deadline_s, stream):
+        self.request_id = next(_ids)
+        self.prompt = prompt
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_token_id = eos_token_id
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self._rng = np.random.RandomState(seed)
+        self._stream = stream
+        self._engine = engine
+        self._state = "queued"            # queued|active|done
+        self._cancel_requested = False
+        self._done = threading.Event()
+        self._error: Optional[BaseException] = None
+        self._tokens: list[int] = []
+        self.slot: Optional[int] = None
+        now = time.perf_counter()
+        self.t_submit = now
+        self.t_admit: Optional[float] = None
+        self._t_last_token = now
+        self.ttft_s: Optional[float] = None
+        self.token_latencies_s: list[float] = []
+        self.deadline = None if deadline_s is None else now + float(deadline_s)
+
+    # -- future surface ------------------------------------------------------
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def cancel(self) -> bool:
+        """Request cancellation; returns False if already finished.  A
+        queued request is failed immediately; an active one is evicted on
+        the scheduler's next sweep."""
+        return self._engine._request_cancel(self)
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"request {self.request_id} not finished within {timeout}s")
+        if self._error is not None:
+            raise self._error
+        return np.asarray(self._tokens, np.int64)
+
+    def exception(self, timeout: Optional[float] = None):
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"request {self.request_id} not finished within {timeout}s")
+        return self._error
+
+    @property
+    def tokens(self) -> list[int]:
+        """Generated token ids so far (streaming view)."""
+        return list(self._tokens)
+
+    @property
+    def generated(self) -> list[int]:
+        return list(self._tokens)
+
+    def text(self) -> str:
+        """Decode the generated tokens (requires the engine's tokenizer)."""
+        tok = self._engine.tokenizer
+        if tok is None:
+            raise ValueError("engine has no tokenizer")
+        return tok.decode(self.tokens)
+
+    # -- engine internals ----------------------------------------------------
+    def _finish(self, error: Optional[BaseException] = None):
+        self._state = "done"
+        self._error = error
+        self._done.set()
+
+    def _emit(self, token: int):
+        self._tokens.append(int(token))
+        if self._stream is not None:
+            try:
+                self._stream(int(token))
+            except Exception:
+                pass  # a broken stream consumer must not kill the batch
+
+    def __repr__(self):
+        return (f"RequestHandle(id={self.request_id}, state={self._state}, "
+                f"slot={self.slot}, tokens={len(self._tokens)})")
+
+
+def _sample_row(logits_row: np.ndarray, temperature: float, top_k: int,
+                rng) -> int:
+    """Sample one token from one row of last-position logits (host side —
+    per-request temperature/top_k/rng; greedy at temperature 0)."""
+    logits = np.asarray(logits_row, np.float32)
+    if temperature == 0.0:
+        return int(logits.argmax())
+    logits = logits / max(temperature, 1e-6)
+    if top_k:
+        kth = np.partition(logits, -top_k)[-top_k]
+        logits = np.where(logits < kth, -1e30, logits)
+    logits = logits - logits.max()
+    p = np.exp(logits)
+    p /= p.sum()
+    return int(rng.choice(len(p), p=p))
+
+
+def _bucket(n: int, lo: int, hi: int) -> int:
+    """Smallest power of two >= n, clamped to [lo, hi] — prompt padding
+    buckets keep the prefill compile count logarithmic in max_len."""
+    b = lo
+    while b < n:
+        b *= 2
+    return min(b, hi)
+
+
+class Engine:
+    """Continuous-batching inference engine over a cached decoder model.
+
+    Args:
+        model: a Layer with the GPT-style cached forward
+            ``model(ids, caches=..., use_cache=True) -> (logits, caches)``
+            (e.g. ``GPTForPretraining``); when it exposes ``.gpt`` +
+            ``.lm_head`` the head runs only on the last position.
+        tokenizer: optional — lets ``submit`` accept strings (``encode``)
+            and handles expose ``text()`` (``decode``).
+        max_slots: concurrent requests sharing the batched decode step.
+        max_len: per-slot cache length; every request needs
+            ``len(prompt) + max_new_tokens <= max_len``.
+        max_queue: admission-queue bound; submits beyond it raise
+            :class:`QueueFullError` (default ``2 * max_slots``).
+        prefill_batch: new slots admitted per batched prefill call
+            (default ``min(4, max_slots)``).
+        eos_token_id: default end-of-sequence id for requests.
+        auto_start: start the scheduler thread on first submit (tests set
+            False to stage a queue deterministically, then call start()).
+    """
+
+    def __init__(self, model, tokenizer=None, max_slots: int = 8,
+                 max_len: int = 256, max_queue: Optional[int] = None,
+                 prefill_batch: Optional[int] = None, eos_token_id=None,
+                 auto_start: bool = True):
+        self.model = model
+        self.tokenizer = tokenizer
+        self.max_slots = int(max_slots)
+        self.max_len = int(max_len)
+        if self.max_slots < 1 or self.max_len < 2:
+            raise ValueError("need max_slots >= 1 and max_len >= 2")
+        cfg = getattr(getattr(model, "gpt", model), "config", None)
+        limit = getattr(cfg, "max_position_embeddings", None)
+        if limit is not None and self.max_len > int(limit):
+            raise ValueError(
+                f"max_len={self.max_len} exceeds the model's "
+                f"max_position_embeddings={limit}")
+        self.max_queue = (2 * self.max_slots if max_queue is None
+                          else int(max_queue))
+        self.prefill_batch = (min(4, self.max_slots) if prefill_batch is None
+                              else max(1, min(int(prefill_batch),
+                                              self.max_slots)))
+        self.eos_token_id = eos_token_id
+        self._auto_start = bool(auto_start)
+
+        self._pool = SlotPool(self.max_slots)
+        self._queue: deque = deque()
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+        self._built = False
+        self._values = None
+        self._kpools = self._vpools = None
+        n_rows = self.max_slots + 1           # + scratch row
+        self._ids = np.zeros((n_rows, 1), np.int64)
+        self._lengths = np.zeros(n_rows, np.int32)
+        self._active = np.zeros(n_rows, bool)
+        self._counts = {"submitted": 0, "completed": 0, "rejected": 0,
+                        "cancelled": 0, "deadline_expired": 0, "failed": 0,
+                        "decode_steps": 0, "prefill_batches": 0,
+                        "tokens": 0}
+        self._was_training = model.training
+        model.eval()
+        # interpreter exit with a live scheduler thread mid-XLA-call
+        # aborts the process; the weakref keeps the hook from pinning the
+        # engine alive
+        ref = weakref.ref(self)
+        atexit.register(lambda: (lambda e: e and e.shutdown())(ref()))
+
+    # -- request API ---------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: int = 16, eos_token_id=...,
+               temperature: float = 0.0, top_k: int = 0, seed: int = 0,
+               deadline_s: Optional[float] = None,
+               stream: Optional[Callable[[int], None]] = None
+               ) -> RequestHandle:
+        """Queue one request; returns a Future-style handle.  Raises
+        :class:`QueueFullError` when the bounded admission queue is at
+        capacity (backpressure: the caller sheds load or retries) and
+        ValueError when the request cannot fit a slot."""
+        if self._stop:
+            raise EngineClosedError("engine is shut down")
+        if isinstance(prompt, str):
+            if self.tokenizer is None:
+                raise ValueError("string prompt needs a tokenizer")
+            prompt = self.tokenizer.encode(prompt)
+        ids = np.asarray(
+            prompt._value if isinstance(prompt, Tensor) else prompt
+        ).astype(np.int64).reshape(-1)
+        if ids.size < 1:
+            raise ValueError("empty prompt")
+        if int(max_new_tokens) < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if ids.size + int(max_new_tokens) > self.max_len:
+            raise ValueError(
+                f"prompt ({ids.size}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds max_len={self.max_len}")
+        eos = self.eos_token_id if eos_token_id is ... else eos_token_id
+        req = RequestHandle(self, ids, max_new_tokens, eos, temperature,
+                            top_k, seed, deadline_s, stream)
+        with self._lock:
+            if len(self._queue) >= self.max_queue:
+                self._counts["rejected"] += 1
+                self._gauges_locked()
+                flight.record("serving", "reject", request=req.request_id,
+                              queue_depth=len(self._queue),
+                              max_queue=self.max_queue)
+                registry().counter(
+                    SERVING_REQUESTS, "serving requests by outcome").inc(
+                    1.0, labels={"outcome": "rejected"})
+                raise QueueFullError(
+                    f"admission queue full ({self.max_queue}); retry later")
+            self._queue.append(req)
+            self._counts["submitted"] += 1
+            self._gauges_locked()
+        registry().counter(SERVING_REQUESTS,
+                           "serving requests by outcome").inc(
+            1.0, labels={"outcome": "submitted"})
+        if self._auto_start:
+            self.start()
+        self._wake.set()
+        return req
+
+    def start(self):
+        """Start the scheduler thread (idempotent)."""
+        if self._stop:
+            raise EngineClosedError("engine is shut down")
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._loop, name="paddle-tpu-serving", daemon=True)
+            self._thread.start()
+
+    def join(self, timeout: Optional[float] = None) -> bool:
+        """Block until queue and slots are empty; False on timeout."""
+        deadline = None if timeout is None else time.perf_counter() + timeout
+        while True:
+            with self._lock:
+                idle = not self._queue and self._pool.n_active == 0
+            if idle:
+                return True
+            if deadline is not None and time.perf_counter() > deadline:
+                return False
+            time.sleep(0.005)
+
+    def shutdown(self):
+        """Stop the scheduler; in-flight and queued requests fail with
+        EngineClosedError.  Restores the model's train/eval mode."""
+        if self._stop:
+            return
+        self._stop = True
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+        err = EngineClosedError("engine shut down")
+        with self._lock:
+            pending = list(self._queue) + list(self._pool.active().values())
+            self._queue.clear()
+            for slot in list(self._pool.active()):
+                self._pool.free(slot)
+            self._active[:] = False
+            self._gauges_locked()
+        for req in pending:
+            req._finish(err)
+        if self._was_training:
+            self.model.train()
+
+    close = shutdown
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+        return False
+
+    # -- introspection -------------------------------------------------------
+    def stats(self) -> dict:
+        with self._lock:
+            out = dict(self._counts)
+            out["active_slots"] = self._pool.n_active
+            out["queue_depth"] = len(self._queue)
+            out["slot_allocs"] = self._pool.alloc_total
+            out["slot_reuses"] = self._pool.reuse_total
+        out.update(self.compile_stats())
+        return out
+
+    def compile_stats(self) -> dict:
+        """Distinct jit signatures per entry point (retrace sentinel
+        counters; decode must stay at 1 — THE continuous-batching
+        invariant)."""
+        pf = getattr(self, "_prefill_fn", None)
+        dc = getattr(self, "_decode_fn", None)
+        return {
+            "prefill_compiles": len(pf._signatures) if pf is not None else 0,
+            "decode_compiles": len(dc._signatures) if dc is not None else 0,
+        }
+
+    # -- jitted pieces -------------------------------------------------------
+    def _build(self):
+        import jax
+        import jax.numpy as jnp
+
+        from ..nn.functional_call import _swapped_state, state_values
+
+        model = self.model
+        n_rows, L = self.max_slots + 1, self.max_len
+        self._values = state_values(model)
+
+        def _kv_struct():
+            def f(vals, ii):
+                with _swapped_state(model, vals):
+                    _, caches = model(Tensor(ii, _internal=True),
+                                      use_cache=True)
+                return [(k._value, v._value) for k, v in caches]
+            return jax.eval_shape(f, self._values,
+                                  jnp.zeros((1, 1), jnp.int64))
+
+        kv = _kv_struct()
+        self._kpools = [jnp.zeros((n_rows, L) + tuple(k.shape[2:]), k.dtype)
+                        for k, _ in kv]
+        self._vpools = [jnp.zeros((n_rows, L) + tuple(v.shape[2:]), v.dtype)
+                        for _, v in kv]
+
+        def _fwd_last(ids_t, caches_t, gather_idx=None):
+            """(per-row logits at the last real position, new caches); when
+            the model exposes trunk + head, the vocab matmul runs on ONLY
+            the gathered positions."""
+            inner = getattr(model, "gpt", None)
+            head = getattr(model, "lm_head", None)
+            if inner is not None and callable(head):
+                x, new_caches = inner(ids_t, caches=caches_t, use_cache=True)
+                h = x._value
+                h_last = (h[:, -1] if gather_idx is None
+                          else h[jnp.arange(h.shape[0]), gather_idx])
+                logits = head(Tensor(h_last[:, None],
+                                     _internal=True))._value[:, 0]
+            else:
+                lg, new_caches = model(ids_t, caches=caches_t,
+                                       use_cache=True)
+                lg = lg._value
+                logits = (lg[:, -1] if gather_idx is None
+                          else lg[jnp.arange(lg.shape[0]), gather_idx])
+            return logits, new_caches
+
+        def prefill(values, ids, kpools, vpools, slot_idx, prompt_lens):
+            # the per-request caches are BUILT inside this jit with a
+            # python-int length 0 (static prefill: the prompt keeps the
+            # causal flash path), then the filled rows scatter into the
+            # pool at each request's slot; padding rows target the scratch
+            # slot
+            n = ids.shape[0]
+            caches_t = [
+                (Tensor(jnp.zeros((n, L) + tuple(kp.shape[2:]), kp.dtype),
+                        _internal=True),
+                 Tensor(jnp.zeros((n, L) + tuple(vp.shape[2:]), vp.dtype),
+                        _internal=True), 0)
+                for kp, vp in zip(kpools, vpools)]
+            with _swapped_state(model, values):
+                logits, new_caches = _fwd_last(
+                    Tensor(ids, _internal=True), caches_t,
+                    gather_idx=prompt_lens - 1)
+            kpools = [kp.at[slot_idx].set(c[0]._value)
+                      for kp, c in zip(kpools, new_caches)]
+            vpools = [vp.at[slot_idx].set(c[1]._value)
+                      for vp, c in zip(vpools, new_caches)]
+            return logits, kpools, vpools
+
+        def decode(values, ids, kpools, vpools, lengths, active):
+            # ONE batched step over every slot row (+ scratch): vector
+            # lengths route the per-slot static-cache branch; inactive
+            # rows compute garbage that is never read and their lengths
+            # stay put
+            caches_t = [(Tensor(kp, _internal=True),
+                         Tensor(vp, _internal=True), lengths)
+                        for kp, vp in zip(kpools, vpools)]
+            with _swapped_state(model, values):
+                logits, new_caches = _fwd_last(
+                    Tensor(ids, _internal=True), caches_t)
+            kpools = [c[0]._value for c in new_caches]
+            vpools = [c[1]._value for c in new_caches]
+            new_lengths = jnp.where(active, lengths + 1, lengths)
+            return logits, kpools, vpools, new_lengths
+
+        # cache pools are donated: prefill/decode update HBM in place (no
+        # donation on CPU — it only warns there)
+        donate = (2, 3) if jax.default_backend() != "cpu" else ()
+        self._prefill_fn = instrument_jit(
+            jax.jit(prefill, donate_argnums=donate), "serving.prefill")
+        self._decode_fn = instrument_jit(
+            jax.jit(decode, donate_argnums=donate), "serving.decode")
+        self._built = True
+
+    # -- scheduler loop ------------------------------------------------------
+    def _loop(self):
+        while not self._stop:
+            try:
+                did = self._step_once()
+            except Exception as e:  # noqa: BLE001 — fail loudly, not hang
+                flight.record("serving", "scheduler_error",
+                              error=f"{type(e).__name__}: {e}")
+                with self._lock:
+                    pending = (list(self._queue) +
+                               list(self._pool.active().values()))
+                    self._queue.clear()
+                    for slot in list(self._pool.active()):
+                        self._pool.free(slot)
+                    self._active[:] = False
+                    self._counts["failed"] += len(pending)
+                for req in pending:
+                    req._finish(e)
+                raise
+            if not did:
+                self._wake.wait(0.02)
+                self._wake.clear()
+
+    def _step_once(self) -> bool:
+        """One scheduler iteration: sweep, admit (batched prefill), one
+        batched decode step.  Returns whether any work happened."""
+        self._sweep()
+        did = self._admit()
+        did = self._decode_step() or did
+        return did
+
+    def _sweep(self):
+        """Evict cancelled / past-deadline requests (queued and active)."""
+        now = time.perf_counter()
+        to_finish = []
+        with self._lock:
+            for req in list(self._queue):
+                if req._cancel_requested or (req.deadline is not None and
+                                             now > req.deadline):
+                    self._queue.remove(req)
+                    outcome = ("cancelled" if req._cancel_requested
+                               else "deadline_expired")
+                    self._evicted_counters_locked(req, outcome)
+                    to_finish.append((req, outcome))
+            for slot, req in self._pool.active().items():
+                if req._cancel_requested or (req.deadline is not None and
+                                             now > req.deadline):
+                    outcome = ("cancelled" if req._cancel_requested
+                               else "deadline_expired")
+                    self._evict_locked(req, outcome)
+                    to_finish.append((req, outcome))
+            self._gauges_locked()
+        for req, outcome in to_finish:
+            err = (CancelledError() if outcome == "cancelled" else
+                   DeadlineExceededError(
+                       f"request {req.request_id} missed its deadline"))
+            req._finish(err)
+
+    def _request_cancel(self, req: RequestHandle) -> bool:
+        if req.done():
+            return False
+        req._cancel_requested = True
+        with self._lock:
+            if req in self._queue:       # not yet admitted: fail right away
+                self._queue.remove(req)
+                self._evicted_counters_locked(req, "cancelled")
+                self._gauges_locked()
+                req._finish(CancelledError())
+                return True
+        self._wake.set()                 # active: next sweep evicts
+        return True
+
+    def _admit(self) -> bool:
+        with self._lock:
+            n = min(self._pool.n_free, self.prefill_batch, len(self._queue))
+            batch = [self._queue.popleft() for _ in range(n)]
+            for req in batch:
+                req.slot = self._pool.alloc(req)
+                req._state = "active"
+                req.t_admit = time.perf_counter()
+            self._gauges_locked()
+        if not batch:
+            return False
+        if not self._built:
+            with span("serving.build"):
+                self._build()
+
+        import jax.numpy as jnp
+        bucket = _bucket(max(r.prompt.size for r in batch),
+                         min(8, self.max_len), self.max_len)
+        ids = np.zeros((self.prefill_batch, bucket), np.int64)
+        slot_idx = np.full(self.prefill_batch, self.max_slots, np.int32)
+        plens = np.ones(self.prefill_batch, np.int32)
+        for i, req in enumerate(batch):
+            ids[i, :req.prompt.size] = req.prompt
+            slot_idx[i] = req.slot
+            plens[i] = req.prompt.size
+            flight.record("serving", "admit", request=req.request_id,
+                          slot=req.slot, prompt_len=int(req.prompt.size),
+                          queue_wait_ms=round(
+                              1e3 * (req.t_admit - req.t_submit), 3))
+        t0 = time.perf_counter()
+        with span("serving.prefill", n=len(batch), bucket=bucket):
+            logits, self._kpools, self._vpools = self._prefill_fn(
+                self._values, jnp.asarray(ids), self._kpools, self._vpools,
+                jnp.asarray(slot_idx), jnp.asarray(plens))
+            logits = np.asarray(logits)
+        dt = time.perf_counter() - t0
+        self._counts["prefill_batches"] += 1
+        registry().histogram(SERVING_BATCH_SECONDS,
+                             "prefill/decode batch wall time").observe(
+            dt, labels={"phase": "prefill"})
+        now = time.perf_counter()
+        for i, req in enumerate(batch):
+            req.ttft_s = now - req.t_submit
+            req._t_last_token = now
+            registry().histogram(SERVING_TTFT,
+                                 "time to first token").observe(req.ttft_s)
+            self._emit_token(req, logits[i], first=True)
+        with self._lock:
+            self._gauges_locked()
+        return True
+
+    def _decode_step(self) -> bool:
+        with self._lock:
+            active = self._pool.active()
+        if not active:
+            return False
+        import jax.numpy as jnp
+        t0 = time.perf_counter()
+        with span("serving.decode", active=len(active)):
+            logits, self._kpools, self._vpools, _ = self._decode_fn(
+                self._values, jnp.asarray(self._ids), self._kpools,
+                self._vpools, jnp.asarray(self._lengths),
+                jnp.asarray(self._active))
+            logits = np.asarray(logits)
+        dt = time.perf_counter() - t0
+        self._counts["decode_steps"] += 1
+        registry().histogram(SERVING_BATCH_SECONDS,
+                             "prefill/decode batch wall time").observe(
+            dt, labels={"phase": "decode"})
+        now = time.perf_counter()
+        for slot, req in active.items():
+            self._lengths[slot] += 1
+            lat = now - req._t_last_token
+            req._t_last_token = now
+            req.token_latencies_s.append(lat)
+            registry().histogram(SERVING_TOKEN_LATENCY,
+                                 "per-token decode latency").observe(lat)
+            self._emit_token(req, logits[slot], first=False)
+        with self._lock:
+            self._gauges_locked()
+        return True
+
+    def _emit_token(self, req: RequestHandle, logits_row, first: bool):
+        """Sample, stream, and either park the token as the slot's next
+        decode input or complete + evict the request."""
+        token = _sample_row(logits_row, req.temperature, req.top_k, req._rng)
+        req._emit(token)
+        self._counts["tokens"] += 1
+        registry().counter(SERVING_TOKENS, "tokens generated").inc(1.0)
+        finished = (len(req._tokens) >= req.max_new_tokens or
+                    (req.eos_token_id is not None and
+                     token == req.eos_token_id))
+        slot = req.slot
+        if first:
+            self._lengths[slot] = req.prompt.size
+        if finished:
+            with self._lock:
+                self._evict_locked(req, "completed")
+            req._finish(None)
+        else:
+            self._ids[slot, 0] = token
+            self._active[slot] = True
+
+    def _evict_locked(self, req: RequestHandle, outcome: str):
+        self._pool.free(req.slot)
+        self._active[req.slot] = False
+        self._evicted_counters_locked(req, outcome)
+
+    def _evicted_counters_locked(self, req: RequestHandle, outcome: str):
+        self._counts[outcome] = self._counts.get(outcome, 0) + 1
+        flight.record("serving", "evict", request=req.request_id,
+                      slot=-1 if req.slot is None else req.slot,
+                      outcome=outcome, tokens=len(req._tokens))
+        registry().counter(SERVING_REQUESTS,
+                           "serving requests by outcome").inc(
+            1.0, labels={"outcome": outcome})
+
+    def _gauges_locked(self):
+        reg = registry()
+        reg.gauge(SERVING_ACTIVE_SLOTS,
+                  "slots currently owned by requests").set(
+            float(self._pool.n_active))
+        reg.gauge(SERVING_QUEUE_DEPTH, "queued, unadmitted requests").set(
+            float(len(self._queue)))

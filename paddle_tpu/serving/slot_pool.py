@@ -1,0 +1,75 @@
+"""SlotPool — host-side bookkeeping for the fixed KV-cache slot pool.
+
+The engine's device state is a set of fixed ``[max_slots(+1), max_len, ...]``
+cache buffers; this class owns the *index* side of that arrangement: which
+slot belongs to which request, which are free, and how often slots get
+reused across requests (the continuous-batching property — one compiled
+decode program serves a stream of requests because slots are recycled, not
+reallocated).  Purely host-side and engine-lock-protected by the caller; no
+device arrays live here.
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Dict, Optional
+
+__all__ = ["SlotPool"]
+
+
+class SlotPool:
+    """Fixed pool of `max_slots` KV-cache slots with alloc/free/reuse
+    accounting.  ``alloc`` returns None when exhausted (the engine leaves
+    the request queued); ``free`` returns the evicted owner."""
+
+    def __init__(self, max_slots: int):
+        if int(max_slots) < 1:
+            raise ValueError(f"max_slots must be >= 1, got {max_slots}")
+        self.max_slots = int(max_slots)
+        self._free: deque = deque(range(self.max_slots))
+        self._owner: Dict[int, Any] = {}
+        self._ever_used: set = set()
+        self.alloc_total = 0
+        self.reuse_total = 0
+
+    def alloc(self, owner: Any) -> Optional[int]:
+        """Claim the lowest free slot for `owner`; None when the pool is
+        full (admission must wait for an eviction)."""
+        if not self._free:
+            return None
+        slot = self._free.popleft()
+        self._owner[slot] = owner
+        self.alloc_total += 1
+        if slot in self._ever_used:
+            self.reuse_total += 1
+        self._ever_used.add(slot)
+        return slot
+
+    def free(self, slot: int) -> Any:
+        """Evict `slot` back to the free list; returns its owner.  Raises
+        KeyError on a slot that is not allocated (double-free guard)."""
+        owner = self._owner.pop(slot)  # KeyError: not allocated
+        self._free.append(slot)
+        return owner
+
+    def owner(self, slot: int) -> Any:
+        return self._owner[slot]
+
+    def active(self) -> Dict[int, Any]:
+        """{slot: owner} snapshot of the allocated slots."""
+        return dict(self._owner)
+
+    @property
+    def n_active(self) -> int:
+        return len(self._owner)
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    def __len__(self) -> int:
+        return self.n_active
+
+    def __repr__(self):
+        return (f"SlotPool(max_slots={self.max_slots}, "
+                f"active={self.n_active}, allocs={self.alloc_total}, "
+                f"reuses={self.reuse_total})")

@@ -36,7 +36,10 @@ SURFACE = {
                           "CompiledProgram", "save_inference_model",
                           "load_inference_model"],
     "paddle_tpu.jit": ["to_static", "save", "load", "TranslatedLayer"],
-    "paddle_tpu.inference": ["Config", "Predictor", "create_predictor"],
+    "paddle_tpu.inference": ["Config", "Predictor", "create_predictor",
+                             "Engine"],
+    "paddle_tpu.serving": ["Engine", "RequestHandle", "SlotPool",
+                           "QueueFullError", "DeadlineExceededError"],
     # distributed stack
     "paddle_tpu.distributed": ["init_parallel_env", "all_reduce", "all_gather",
                                "all_to_all", "reduce_scatter", "new_group",

@@ -156,6 +156,32 @@ def test_dist_model_single_rank_micro_batching():
     np.testing.assert_allclose(got, x @ np.asarray(w), rtol=1e-5)
 
 
+def test_dist_model_run_timeout_names_stage():
+    """A dead/slow stage must surface as a bounded-wait TimeoutError that
+    NAMES the pending stage and rank (plus a flight event for the hang
+    dump) instead of hanging the caller silently."""
+    import time as _time
+
+    import pytest
+    from paddle_tpu.observability import flight
+
+    def stuck(x):
+        _time.sleep(0.7)
+        return x
+
+    cfg = DistModelConfig(num_micro_batches=1)
+    dm = DistModel(cfg, stages=[lambda x: x + 1, stuck])
+    before = len(flight.events("dist_model"))
+    with pytest.raises(TimeoutError, match=r"stage1\(rank0\)"):
+        dm.run(np.zeros((2, 2), np.float32), timeout_s=0.15)
+    evs = flight.events("dist_model")
+    assert len(evs) == before + 1
+    assert evs[-1]["name"] == "stage_timeout"
+    assert "stage1" in evs[-1]["attrs"]["pending"]
+    _time.sleep(0.8)          # let the wedged stage drain before teardown
+    dm.shutdown()
+
+
 def test_framing_rejects_hostile_pickle_and_oversized_frames():
     """The RPC planes must not deserialize arbitrary objects (the reference
     transport is brpc/protobuf, interceptor_message.proto, which can't) and

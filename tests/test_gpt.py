@@ -62,6 +62,119 @@ def test_gpt_incremental_decode_matches_full():
     np.testing.assert_allclose(inc, full, rtol=2e-4, atol=2e-4)
 
 
+def _static_caches(cfg, b, max_len):
+    """Fresh fixed-shape KV buffers with a python-int length 0 — the static
+    prefill form (the helper/engine build these inside their jits)."""
+    nh = cfg.num_attention_heads
+    hd = cfg.hidden_size // nh
+    return [(paddle.to_tensor(np.zeros((b, max_len, nh, hd), np.float32)),
+             paddle.to_tensor(np.zeros((b, max_len, nh, hd), np.float32)),
+             0)
+            for _ in range(cfg.num_layers)]
+
+
+def test_gpt_static_cache_prefill_decode_matches_full():
+    """STATIC-cache decoding (fixed buffers + in-place writes + validity
+    mask) must equal the full forward logits at every position — batch 1
+    and batch > 1; the dynamic growing-concat cache must agree too."""
+    cfg = gpt_config("gpt-tiny", hidden_dropout_prob=0.0,
+                     attention_dropout_prob=0.0)
+    paddle.seed(3)
+    model = build_gpt(cfg)
+    model.eval()
+    for b in (1, 2):
+        x, _ = _batch(np.random.RandomState(9 + b), b=b, t=8)
+        full = model(paddle.to_tensor(x)).numpy()
+
+        caches = _static_caches(cfg, b, max_len=16)
+        logits, caches = model(paddle.to_tensor(x[:, :4]), caches=caches)
+        outs = [logits.numpy()]
+        for i in range(4, 8):
+            logits, caches = model(paddle.to_tensor(x[:, i:i + 1]),
+                                   caches=caches)
+            outs.append(logits.numpy())
+        np.testing.assert_allclose(np.concatenate(outs, axis=1), full,
+                                   rtol=2e-4, atol=2e-4)
+
+        # dynamic growing-concat cache, same batch (the b=1 case is also
+        # covered by test_gpt_incremental_decode_matches_full)
+        logits, dyn = model(paddle.to_tensor(x[:, :4]), use_cache=True)
+        outs = [logits.numpy()]
+        for i in range(4, 8):
+            logits, dyn = model(paddle.to_tensor(x[:, i:i + 1]), caches=dyn)
+            outs.append(logits.numpy())
+        np.testing.assert_allclose(np.concatenate(outs, axis=1), full,
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_gpt_slot_cache_padded_decode_matches_full():
+    """PER-SLOT (vector-length) static cache — the serving engine's
+    continuous-batching form: rows at DIFFERENT positions in one padded
+    batch must each reproduce their own unpadded full-forward logits."""
+    cfg = gpt_config("gpt-tiny", hidden_dropout_prob=0.0,
+                     attention_dropout_prob=0.0)
+    paddle.seed(5)
+    model = build_gpt(cfg)
+    model.eval()
+    import jax.numpy as jnp
+
+    rs = np.random.RandomState(11)
+    rows = [rs.randint(0, cfg.vocab_size, 8).astype(np.int64)
+            for _ in range(2)]
+    plens = [3, 5]                       # ragged prompts, padded to 5
+    fulls = [model(paddle.to_tensor(r[None])).numpy() for r in rows]
+
+    prompt = np.zeros((2, max(plens)), np.int64)
+    for i, (r, pl) in enumerate(zip(rows, plens)):
+        prompt[i, :pl] = r[:pl]
+    caches = _static_caches(cfg, 2, max_len=16)
+    logits, caches = model(paddle.to_tensor(prompt), caches=caches)
+    lp = logits.numpy()
+    for i, pl in enumerate(plens):       # per-row last REAL position
+        np.testing.assert_allclose(lp[i, pl - 1], fulls[i][0, pl - 1],
+                                   rtol=2e-4, atol=2e-4)
+
+    # switch the shared scalar length for a per-row vector and decode 3
+    # steps: each row advances from its own position
+    lengths = jnp.asarray(np.array(plens, np.int32))
+    caches = [(k, v, lengths) for k, v, _ in caches]
+    for j in range(3):
+        step_ids = np.array([[rows[0][plens[0] + j]],
+                             [rows[1][plens[1] + j]]], np.int64)
+        logits, caches = model(paddle.to_tensor(step_ids), caches=caches)
+        lj = logits.numpy()
+        for i, pl in enumerate(plens):
+            np.testing.assert_allclose(
+                lj[i, 0], fulls[i][0, pl + j], rtol=2e-4, atol=2e-4,
+                err_msg=f"row {i} step {j}")
+        # the model returns lengths + t: the per-row positions advanced
+        got_len = np.asarray(caches[0][2])
+        np.testing.assert_array_equal(got_len,
+                                      np.array(plens) + j + 1)
+
+
+def test_dynamic_cache_growth_warns_once():
+    """The growing-concat cache path emits ONE structured flight event
+    naming the static-cache alternative, however many steps run."""
+    from paddle_tpu.observability import flight, retrace
+
+    cfg = gpt_config("gpt-tiny", hidden_dropout_prob=0.0,
+                     attention_dropout_prob=0.0)
+    paddle.seed(1)
+    model = build_gpt(cfg)
+    model.eval()
+    retrace.reset_dynamic_cache_warnings()
+    before = len(flight.events("dynamic_kv_cache"))
+    x, _ = _batch(np.random.RandomState(2), b=1, t=8)
+    _, caches = model(paddle.to_tensor(x[:, :4]), use_cache=True)
+    for i in range(4, 7):
+        _, caches = model(paddle.to_tensor(x[:, i:i + 1]), caches=caches)
+    evs = flight.events("dynamic_kv_cache")
+    assert len(evs) == before + 1
+    assert "static" in evs[-1]["attrs"]["hint"].lower()
+    assert "serving" in evs[-1]["attrs"]["hint"]
+
+
 def test_gpt_train_step_loss_decreases():
     paddle.seed(0)
     model = build_gpt("gpt-tiny", hidden_dropout_prob=0.0,

@@ -25,6 +25,7 @@ DEFAULT_SUBSET = [
     "tests/test_jit_static.py",
     "tests/test_checkpoint.py",
     "tests/test_distributed.py",
+    "tests/test_serving.py",
 ]
 
 
