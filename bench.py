@@ -79,6 +79,75 @@ def _timed_rate(step_once, units_per_step, steps, reps=3):
     return value, round(100 * noise, 2), loss
 
 
+def _loss_series(losses):
+    """One host sync for a list of step losses (scalars or [K] stacks)."""
+    out = []
+    for l in losses:
+        a = np.asarray(l.numpy() if hasattr(l, "numpy") else l)
+        out.extend(np.ravel(a).astype(np.float64).tolist())
+    return out
+
+
+def _input_overlap_block(step, batches, stacked=False, parity_make=None):
+    """Input-overlap probe (ISSUE 4): drive a train step over host-side
+    numpy batches twice — synchronous inline transfers vs DevicePrefetcher
+    — and report each path's host-wait fraction (time the loop spent
+    obtaining a device-ready batch / loop wall time).  On an accelerator
+    the prefetched path must wait less (the transfer overlaps compute);
+    on CPU timings are noise, so the fallback assertion is bit-identical
+    loss parity between the two paths on fresh models (`parity_make`)."""
+    import jax
+
+    from paddle_tpu.io.prefetch import DevicePrefetcher
+
+    on_tpu = jax.devices()[0].platform != "cpu"
+    call = (lambda s, xs: s.run_steps(*xs)) if stacked \
+        else (lambda s, xs: s(*xs))
+
+    def run(s, prefetch):
+        # warmup outside the timed window (compile / allocator settle);
+        # both paths run it, so parity series stay aligned
+        warm = tuple(jax.device_put(np.asarray(a)) for a in batches[0])
+        _loss_series([call(s, warm)])
+        wait, losses, stalls = 0.0, [], 0
+        t_loop = time.perf_counter()
+        if prefetch:
+            pf = DevicePrefetcher(batches, depth=2, mesh=s.mesh,
+                                  stacked=stacked, name="bench")
+            for xs in pf:
+                losses.append(call(s, xs))
+            wait = pf.stats()["wait_seconds"]
+            stalls = pf.stats()["stalls"]
+        else:
+            for b in batches:
+                t0 = time.perf_counter()
+                xs = tuple(jax.device_put(np.asarray(a)) for a in b)
+                wait += time.perf_counter() - t0
+                losses.append(call(s, xs))
+        series = _loss_series(losses)  # the sync point closing the window
+        wall = time.perf_counter() - t_loop
+        return (wait / wall if wall > 0 else 0.0), series, stalls
+
+    sync_frac, _, _ = run(step, prefetch=False)
+    pf_frac, _, stalls = run(step, prefetch=True)
+    block = {"steps": len(batches),
+             "host_wait_frac_sync": round(sync_frac, 4),
+             "host_wait_frac_prefetch": round(pf_frac, 4),
+             "prefetch_stalls": int(stalls)}
+    if on_tpu and pf_frac >= sync_frac:
+        raise RuntimeError(
+            f"input overlap regressed: prefetch host-wait frac {pf_frac:.4f}"
+            f" >= sync {sync_frac:.4f}")
+    if parity_make is not None and not on_tpu:
+        _, s_sync, _ = run(parity_make(), prefetch=False)
+        _, s_pf, _ = run(parity_make(), prefetch=True)
+        if s_sync != s_pf:
+            raise RuntimeError(
+                f"prefetch loss parity broke: {s_sync} vs {s_pf}")
+        block["loss_parity"] = True
+    return block
+
+
 def bench_gpt_small():
     """Flagship: GPT-2-small pretraining step (125M; comparable to the
     round-1..3 flagship numbers)."""
@@ -99,14 +168,18 @@ def bench_gpt_small():
 
     cfg = gpt_config(name, max_position_embeddings=max(seq, 1024),
                      hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
-    paddle.seed(0)
-    model = build_gpt(cfg)
-    crit = GPTPretrainingCriterion()
-    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
-                                 parameters=model.parameters(),
-                                 weight_decay=0.01)
-    step = dist.make_train_step(model, opt, loss_fn=crit,
-                                compute_dtype="bfloat16" if on_tpu else None)
+
+    def make_step():
+        paddle.seed(0)
+        m = build_gpt(cfg)
+        o = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                   parameters=m.parameters(),
+                                   weight_decay=0.01)
+        return dist.make_train_step(
+            m, o, loss_fn=GPTPretrainingCriterion(),
+            compute_dtype="bfloat16" if on_tpu else None)
+
+    step = make_step()
     ids = np.random.RandomState(0).randint(
         0, cfg.vocab_size, size=(batch, seq + 1)).astype(np.int64)
     x, y = ids[:, :-1], ids[:, 1:]
@@ -119,11 +192,15 @@ def bench_gpt_small():
     mfu = tokens_per_sec * flops_tok / _peak_flops(dev) if on_tpu else 0.0
     print(f"# device={dev.device_kind} loss={float(loss):.4f} "
           f"mfu={mfu:.3f} steps={steps} noise={noise}%", file=sys.stderr)
+    overlap = _input_overlap_block(
+        step, [(x, y)] * (8 if on_tpu else 3),
+        parity_make=None if on_tpu else make_step)
     return {
         "metric": f"gpt_{name}_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s/chip",
         "noise_pct": noise,
+        "input_overlap": overlap,
         "vs_baseline": round(mfu / 0.35, 4) if on_tpu else 0.0,
     }
 
@@ -159,20 +236,23 @@ def bench_gpt_1p3b():
     cfg = gpt_config(name, max_position_embeddings=max(seq, 1024),
                      hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
                      scan_layers=True, use_recompute=True)
-    paddle.seed(0)
-    if on_tpu:
-        paddle.set_default_dtype("bfloat16")
-    try:
-        model = build_gpt(cfg)
-    finally:
-        paddle.set_default_dtype("float32")
-    crit = GPTPretrainingCriterion()
-    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
-                                 parameters=model.parameters(),
-                                 weight_decay=0.01)
-    step = dist.make_train_step(
-        model, opt, loss_fn=crit,
-        compute_dtype="bfloat16" if on_tpu else None)
+
+    def make_step():
+        paddle.seed(0)
+        if on_tpu:
+            paddle.set_default_dtype("bfloat16")
+        try:
+            m = build_gpt(cfg)
+        finally:
+            paddle.set_default_dtype("float32")
+        o = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                   parameters=m.parameters(),
+                                   weight_decay=0.01)
+        return m, dist.make_train_step(
+            m, o, loss_fn=GPTPretrainingCriterion(),
+            compute_dtype="bfloat16" if on_tpu else None)
+
+    model, step = make_step()
     if on_tpu:
         # free the eager weight copies: 2.6 GiB of headroom the 1.3B
         # single-chip budget needs (params 2.6 + slots 5.2 + grads 2.6)
@@ -189,11 +269,17 @@ def bench_gpt_1p3b():
     mfu = tps * flops_tok / _peak_flops(dev) if on_tpu else 0.0
     print(f"# gpt-1.3B device={dev.device_kind} loss={float(loss):.4f} "
           f"mfu={mfu:.3f} noise={noise}%", file=sys.stderr)
+    # overlap probe reuses the live step (no second 1.3B model on TPU);
+    # parity on the CPU fallback only, where the model is gpt-tiny
+    overlap = _input_overlap_block(
+        step, [(x, y)] * (4 if on_tpu else 3),
+        parity_make=None if on_tpu else (lambda: make_step()[1]))
     return {
         "noise_pct": noise,
         "metric": f"gpt_{name}_tokens_per_sec_per_chip",
         "value": round(tps, 1),
         "unit": "tokens/s/chip",
+        "input_overlap": overlap,
         "vs_baseline": round(mfu / 0.35, 4) if on_tpu else 0.0,
     }
 
@@ -214,17 +300,19 @@ def bench_resnet50():
     batch, steps = (128, 10) if on_tpu else (2, 2)
     size = 224 if on_tpu else 32
 
-    paddle.seed(0)
-    # stem_s2d: space-to-depth stem, +1.4% end-to-end measured (2541 ->
-    # 2577 img/s; exact-equivalent math, docs/PERF.md round-4 A/B)
-    model = resnet50(num_classes=1000, stem_s2d=on_tpu)
-    crit = nn.CrossEntropyLoss()
-    opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
-                                    parameters=model.parameters(),
-                                    weight_decay=1e-4)
-    step = dist.make_train_step(
-        model, opt, loss_fn=crit,
-        compute_dtype="bfloat16" if on_tpu else None)
+    def make_step():
+        paddle.seed(0)
+        # stem_s2d: space-to-depth stem, +1.4% end-to-end measured (2541 ->
+        # 2577 img/s; exact-equivalent math, docs/PERF.md round-4 A/B)
+        m = resnet50(num_classes=1000, stem_s2d=on_tpu)
+        o = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                      parameters=m.parameters(),
+                                      weight_decay=1e-4)
+        return dist.make_train_step(
+            m, o, loss_fn=nn.CrossEntropyLoss(),
+            compute_dtype="bfloat16" if on_tpu else None)
+
+    step = make_step()
     rng = np.random.RandomState(0)
     # device-resident batch: a real input pipeline overlaps H2D with
     # compute; through the remote tunnel an un-overlapped 38 MB image batch
@@ -266,11 +354,22 @@ def bench_resnet50():
     mfu = ips * 3 * 3.8e9 / _peak_flops(dev) if on_tpu else 0.0
     print(f"# resnet50 device={dev.device_kind} loss={float(loss):.4f} "
           f"mfu={mfu:.3f} batch={batch} noise={noise}%", file=sys.stderr)
+    # overlap probe: host-side [K,B,...] stacks (38 MB/batch images are
+    # exactly the payload the prefetcher exists for) through the SAME
+    # compiled run_steps signature — sync inline puts vs prefetched
+    x_np = rng.standard_normal((batch, 3, size, size)).astype(np.float32)
+    y_np = rng.randint(0, 1000, (batch,)).astype(np.int64)
+    stack = (np.broadcast_to(x_np[None], (steps,) + x_np.shape),
+             np.broadcast_to(y_np[None], (steps,) + y_np.shape))
+    overlap = _input_overlap_block(
+        step, [stack] * (3 if on_tpu else 2), stacked=True,
+        parity_make=None if on_tpu else make_step)
     return {
         "metric": "resnet50_images_per_sec_per_chip",
         "value": round(ips, 1),
         "noise_pct": noise,
         "unit": "images/s/chip",
+        "input_overlap": overlap,
         "vs_baseline": round(mfu / 0.35, 4) if on_tpu else 0.0,
     }
 
@@ -671,6 +770,12 @@ def _telemetry_block():
                     labels.get("fn", "?")] = {
                     "count": snap["count"],
                     "mean_ms": round(1e3 * snap["sum"] / snap["count"], 3)}
+    c = reg.get(steps.HOST_INPUT_WAIT)
+    if c is not None:
+        block["host_input_wait_s"] = round(c.total(), 4)
+    c = reg.get(steps.PIPELINE_STALLS)
+    if c is not None:
+        block["pipeline_stalls"] = int(c.total())
     steps.record_memory_stats()  # refresh the gauges at leg end
     g = reg.get(steps.MEMORY_GAUGE)
     if g is not None:

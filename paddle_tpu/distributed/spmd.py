@@ -409,13 +409,30 @@ class ShardedTrainStep:
                           jax.device_put(st.rng, repl)
                           if repl.is_fully_addressable else st.rng)
 
+    @staticmethod
+    def _resident(v, sharding) -> bool:
+        """True when `v` is already a device array carrying `sharding` —
+        the DevicePrefetcher hand-off.  Skipping the put keeps the batch
+        transfer off the step's critical path and (same shape/dtype/
+        sharding) leaves the jitted signature unchanged."""
+        if not isinstance(v, jax.Array):
+            return False
+        try:
+            return v.sharding == sharding
+        except Exception:  # noqa: BLE001 — deleted/donated buffer
+            return False
+
     def shard_batch(self, *batch):
         out = []
         for b in batch:
-            v = b._value if isinstance(b, Tensor) else jnp.asarray(b)
+            v = b._value if isinstance(b, Tensor) else b
             if self.mesh is not None:
-                v = mesh_mod.put_global(
-                    v, NamedSharding(self.mesh, batch_spec(self.mesh, v.ndim)))
+                sh = NamedSharding(self.mesh,
+                                   batch_spec(self.mesh, np.ndim(v)))
+                if not self._resident(v, sh):
+                    v = mesh_mod.put_global(v, sh)
+            elif not isinstance(v, jax.Array):
+                v = jnp.asarray(v)
             out.append(v)
         return tuple(out)
 
@@ -729,11 +746,17 @@ class ShardedTrainStep:
         k = int(stacked[0].shape[0])
         vals = []
         for b in stacked:
-            v = b._value if isinstance(b, Tensor) else jnp.asarray(b)
+            v = b._value if isinstance(b, Tensor) else b
             if self.mesh is not None:
-                spec = batch_spec(self.mesh, v.ndim - 1)
-                v = mesh_mod.put_global(v, NamedSharding(
-                    self.mesh, P(None, *tuple(spec))))
+                # replicated leading K dim, data axes on dim 1; prefetched
+                # stacks (DevicePrefetcher(stacked=True)) already carry
+                # this sharding and skip the re-transfer
+                spec = batch_spec(self.mesh, np.ndim(v) - 1)
+                sh = NamedSharding(self.mesh, P(None, *tuple(spec)))
+                if not self._resident(v, sh):
+                    v = mesh_mod.put_global(v, sh)
+            elif not isinstance(v, jax.Array):
+                v = jnp.asarray(v)
             vals.append(v)
         if self._jitted is None:
             self._jitted = self._build(len(vals))
@@ -759,14 +782,18 @@ class ShardedTrainStep:
                 jax.jit(multi_fn, donate_argnums=self._donate_argnums()),
                 name="spmd_train_step_multi")
         # per-step learning rates: schedules keyed on the optimizer step
-        # count must see the same sequence K single-step calls would
+        # count must see the same sequence K single-step calls would.
+        # restore under finally — a schedule that raises mid-sweep must not
+        # leave the optimizer's step count pointing into the sweep
         opt = self.optimizer
         saved_count = opt._step_count
         lrs = []
-        for i in range(k):
-            opt._step_count = saved_count + i
-            lrs.append(float(opt.get_lr()))
-        opt._step_count = saved_count
+        try:
+            for i in range(k):
+                opt._step_count = saved_count + i
+                lrs.append(float(opt.get_lr()))
+        finally:
+            opt._step_count = saved_count
         lrs = jnp.asarray(lrs, jnp.float32)
         core, slots = self._split_tree()
         from ..core.op import TELEMETRY

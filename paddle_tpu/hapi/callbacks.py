@@ -12,6 +12,16 @@ import numpy as np
 from .progressbar import ProgressBar
 
 
+def _scalar(v):
+    """First scalar of a logs value.  Plain numbers pass through; lists,
+    arrays and deferred DeviceLossList losses (anything array-convertible)
+    fetch here — the ONE place the dispatch-ahead loss path syncs, so a
+    callback that never reads a loss never forces it to host."""
+    if isinstance(v, numbers.Number):
+        return v
+    return float(np.ravel(np.asarray(v))[0])
+
+
 def config_callbacks(callbacks=None, model=None, batch_size=None, epochs=None,
                      steps=None, log_freq=2, verbose=2, save_freq=1,
                      save_dir=None, metrics=None, mode="train"):
@@ -188,10 +198,7 @@ class ProgBarLogger(Callback):
         values = []
         for k in getattr(self, "train_metrics", ["loss"]):
             if k in (logs or {}):
-                v = logs[k]
-                if isinstance(v, (list, tuple, np.ndarray)):
-                    v = float(np.ravel(v)[0])
-                values.append((k, v))
+                values.append((k, _scalar(logs[k])))
         return values
 
     def on_train_batch_end(self, step, logs=None):
@@ -308,9 +315,7 @@ class EarlyStopping(Callback):
             warnings.warn(f"Monitor of EarlyStopping should be loss or metric "
                           f"name; {self.monitor} missing in eval logs")
             return
-        current = logs[self.monitor]
-        if isinstance(current, (list, tuple, np.ndarray)):
-            current = float(np.ravel(current)[0])
+        current = _scalar(logs[self.monitor])
         if self.monitor_op(current - self.min_delta, self.best_value):
             self.best_value = current
             self.wait_epoch = 0
@@ -364,9 +369,7 @@ class ReduceLROnPlateau(Callback):
         if logs is None or self.monitor not in logs:
             warnings.warn(f"Monitor {self.monitor} missing in eval logs")
             return
-        current = logs[self.monitor]
-        if isinstance(current, (list, tuple, np.ndarray)):
-            current = float(np.ravel(current)[0])
+        current = _scalar(logs[self.monitor])
         if self.cooldown_counter > 0:
             self.cooldown_counter -= 1
             self.wait = 0
@@ -413,12 +416,14 @@ class VisualDL(Callback):
             self._file = open(os.path.join(self.log_dir, "scalars.jsonl"),
                               "a", buffering=1)
         for k, v in (values or {}).items():
-            if isinstance(v, (list, tuple, np.ndarray)):
-                v = float(np.ravel(v)[0])
-            if isinstance(v, numbers.Number):
-                self._file.write(json.dumps({"tag": f"{tag}/{k}",
-                                             "value": float(v),
-                                             "step": int(step)}) + "\n")
+            if not isinstance(v, numbers.Number):
+                try:
+                    v = _scalar(v)
+                except (TypeError, ValueError):
+                    continue
+            self._file.write(json.dumps({"tag": f"{tag}/{k}",
+                                         "value": float(v),
+                                         "step": int(step)}) + "\n")
 
     def on_train_end(self, logs=None):
         if self._file is not None:

@@ -32,6 +32,59 @@ def _to_tensor(x):
     return Tensor(jnp.asarray(np.asarray(x)), _internal=True)
 
 
+class DeviceLossList:
+    """Per-batch losses kept as device arrays — the dispatch-ahead loss
+    path (ISSUE 4).  ``train_batch``/``_eval_batch_impl`` used to force a
+    host sync per loss element (``float(np.asarray(l.numpy()).ravel()[0])``
+    each); this list defers the fetch entirely and gathers the WHOLE list
+    with one ``jax.device_get`` the first time a consumer needs floats
+    (``float()``, indexing, iteration, ``np.asarray``).  A fit loop whose
+    callbacks only read losses at ``log_freq``/epoch end therefore
+    dispatches K steps ahead of the device instead of round-tripping each
+    one."""
+
+    __slots__ = ("_arrays", "_host")
+
+    def __init__(self, arrays):
+        self._arrays = list(arrays)
+        self._host = None
+
+    @property
+    def fetched(self) -> bool:
+        return self._host is not None
+
+    def _fetch(self):
+        if self._host is None:
+            import jax
+            vals = jax.device_get(self._arrays)
+            self._host = [float(np.ravel(np.asarray(v))[0]) for v in vals]
+        return self._host
+
+    def __len__(self):
+        return len(self._arrays)
+
+    def __bool__(self):
+        return bool(self._arrays)
+
+    def __iter__(self):
+        return iter(self._fetch())
+
+    def __getitem__(self, i):
+        return self._fetch()[i]
+
+    def __float__(self):
+        return float(self._fetch()[0])
+
+    def __array__(self, dtype=None, copy=None):
+        a = np.asarray(self._fetch())
+        return a if dtype is None else a.astype(dtype)
+
+    def __repr__(self):
+        if self._host is None:
+            return f"DeviceLossList(<{len(self._arrays)} unfetched>)"
+        return repr(self._host)
+
+
 class Model:
     def __init__(self, network, inputs=None, labels=None):
         self.network = network
@@ -74,8 +127,9 @@ class Model:
         for m in self._metrics:
             m.update(*_to_list(m.compute(*(outs + labels))))
             metrics.append(m.accumulate())
-        out_loss = [float(np.asarray(l.numpy()).ravel()[0])
-                    for l in loss_list]
+        # losses stay on device; one gather when a consumer reads them
+        out_loss = DeviceLossList(
+            [l._value if isinstance(l, Tensor) else l for l in loss_list])
         return (out_loss, metrics) if metrics else out_loss
 
     @no_grad()
@@ -90,8 +144,9 @@ class Model:
         loss_list = []
         if self._loss:
             losses = self._loss(*(outs + labels))
-            loss_list = [float(np.asarray(l.numpy()).ravel()[0])
-                         for l in _to_list(losses)]
+            loss_list = DeviceLossList(
+                [l._value if isinstance(l, Tensor) else l
+                 for l in _to_list(losses)])
         metrics = []
         for m in self._metrics:
             m.update(*_to_list(m.compute(*(outs + labels))))
@@ -113,13 +168,19 @@ class Model:
 
     # -- loops ---------------------------------------------------------------
     def _loader(self, data, batch_size, shuffle, num_workers,
-                drop_last=False):
-        if isinstance(data, DataLoader):
+                drop_last=False, prefetch=False, prefetch_depth=2):
+        from ..io.prefetch import DevicePrefetcher
+        if isinstance(data, DevicePrefetcher):
             return data
         if data is None:
             return None
-        return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
-                          num_workers=num_workers, drop_last=drop_last)
+        loader = data if isinstance(data, DataLoader) else DataLoader(
+            data, batch_size=batch_size, shuffle=shuffle,
+            num_workers=num_workers, drop_last=drop_last)
+        if prefetch:
+            return DevicePrefetcher(loader, depth=prefetch_depth,
+                                    name="hapi_fit")
+        return loader
 
     @staticmethod
     def _split_batch(batch):
@@ -131,11 +192,19 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
-            accumulate_grad_batches=1, num_iters=None):
-        """model.py fit parity: epoch/step loops with the callback protocol."""
+            accumulate_grad_batches=1, num_iters=None, prefetch=False,
+            prefetch_depth=2):
+        """model.py fit parity: epoch/step loops with the callback protocol.
+
+        `prefetch=True` routes the train loader through a DevicePrefetcher
+        (`prefetch_depth` batches kept device-resident ahead of the loop);
+        combined with the deferred DeviceLossList losses the loop dispatches
+        ahead of the device instead of syncing per batch.  A pre-built
+        DevicePrefetcher may also be passed directly as `train_data`."""
         assert train_data is not None, "train_data must be given!"
         loader = self._loader(train_data, batch_size, shuffle, num_workers,
-                              drop_last=drop_last)
+                              drop_last=drop_last, prefetch=prefetch,
+                              prefetch_depth=prefetch_depth)
         eval_loader = self._loader(eval_data, batch_size, False, num_workers)
         steps = len(loader) if hasattr(loader, "__len__") else None
         cbks = callbacks_mod.config_callbacks(
@@ -207,8 +276,11 @@ class Model:
         return logs
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
-                 num_workers=0, callbacks=None, num_iters=None):
-        loader = self._loader(eval_data, batch_size, False, num_workers)
+                 num_workers=0, callbacks=None, num_iters=None,
+                 prefetch=False, prefetch_depth=2):
+        loader = self._loader(eval_data, batch_size, False, num_workers,
+                              prefetch=prefetch,
+                              prefetch_depth=prefetch_depth)
         cbks = callbacks_mod.config_callbacks(
             callbacks, model=self, log_freq=log_freq, verbose=verbose,
             metrics=self._metrics, mode="eval")
@@ -226,7 +298,8 @@ class Model:
         cbks.on_eval_end(logs)
         result = {}
         if "loss" in logs:
-            result["loss"] = logs["loss"]
+            # materialize here (one gather): evaluate() returns plain floats
+            result["loss"] = [float(v) for v in logs["loss"]]
         for m in self._metrics:
             name = m.name()
             result[name if not isinstance(name, list) else name[0]] = \

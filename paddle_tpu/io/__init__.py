@@ -8,3 +8,4 @@ from .sampler import (  # noqa: F401
     BatchSampler, DistributedBatchSampler,
 )
 from .dataloader import DataLoader, get_worker_info, default_collate_fn  # noqa: F401
+from .prefetch import DevicePrefetcher  # noqa: F401

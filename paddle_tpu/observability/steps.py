@@ -15,6 +15,11 @@ STEPS_TOTAL = "paddle_tpu_steps_total"
 EXAMPLES_TOTAL = "paddle_tpu_examples_total"
 EXAMPLES_PER_SEC = "paddle_tpu_examples_per_sec"
 MEMORY_GAUGE = "paddle_tpu_device_memory_bytes"
+# input-pipeline metrics (io/prefetch.py DevicePrefetcher)
+HOST_INPUT_WAIT = "paddle_tpu_host_input_wait_seconds_total"
+PREFETCH_DEPTH = "paddle_tpu_prefetch_buffer_depth"
+PREFETCH_BATCHES = "paddle_tpu_prefetch_batches_total"
+PIPELINE_STALLS = "paddle_tpu_pipeline_stalls_total"
 
 
 def record_step(seconds: float, examples: int | None = None,
@@ -48,6 +53,35 @@ def record_memory_stats():
                 "largest_alloc_size"):
         if key in stats:
             g.set(float(stats[key]), labels={"stat": key})
+
+
+def record_input_wait(seconds: float, fn: str = "prefetch"):
+    """Time the train loop spent blocked waiting for the next device-ready
+    batch (DevicePrefetcher found its buffer empty)."""
+    registry().counter(
+        HOST_INPUT_WAIT,
+        "train-loop wall-time blocked on host input").inc(
+        max(0.0, float(seconds)), labels={"fn": fn})
+
+
+def set_prefetch_depth(depth: int, fn: str = "prefetch"):
+    registry().gauge(
+        PREFETCH_DEPTH,
+        "DevicePrefetcher buffer occupancy (device-resident batches)").set(
+        float(depth), labels={"fn": fn})
+
+
+def record_prefetch_batch(fn: str = "prefetch"):
+    registry().counter(
+        PREFETCH_BATCHES,
+        "batches delivered by DevicePrefetcher").inc(1.0, labels={"fn": fn})
+
+
+def record_pipeline_stall(fn: str = "prefetch"):
+    registry().counter(
+        PIPELINE_STALLS,
+        "warm-buffer underruns (device waited on host input)").inc(
+        1.0, labels={"fn": fn})
 
 
 def step_latency_count(fn: str = "train_step") -> int:

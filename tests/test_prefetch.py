@@ -1,0 +1,330 @@
+"""Overlapped input pipeline tests (ISSUE 4): DevicePrefetcher contracts
+(ordering, bounded buffer, error/shutdown paths, mesh placement), the
+dispatch-ahead DeviceLossList loss path, and the no-new-signature /
+no-re-transfer hand-off into the SPMD train step."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+from paddle_tpu.hapi import Model
+from paddle_tpu.hapi.callbacks import Callback
+from paddle_tpu.hapi.model import DeviceLossList
+from paddle_tpu.io import DataLoader, DevicePrefetcher
+from paddle_tpu.io.dataset import Dataset
+
+
+class RangeDataset(Dataset):
+    def __init__(self, n):
+        self.n = n
+
+    def __getitem__(self, i):
+        return np.full((4,), i, np.float32), np.int64(i % 3)
+
+    def __len__(self):
+        return self.n
+
+
+def _loader(n=12, batch_size=4):
+    return DataLoader(RangeDataset(n), batch_size=batch_size, shuffle=False)
+
+
+# -- iterator contracts -------------------------------------------------------
+
+def test_ordering_parity_with_unwrapped_loader():
+    loader = _loader(20)
+    pf = DevicePrefetcher(loader, depth=2)
+    got = [(x.numpy().copy(), y.numpy().copy()) for x, y in pf]
+    ref = [(x.numpy(), y.numpy()) for x, y in loader]
+    assert len(got) == len(ref) == 5
+    for (gx, gy), (rx, ry) in zip(got, ref):
+        np.testing.assert_array_equal(gx, rx)
+        np.testing.assert_array_equal(gy, ry)
+    assert pf.stats()["batches"] == 5
+
+
+def test_reiterable_fresh_epochs():
+    pf = DevicePrefetcher(_loader(8), depth=2)
+    for _ in range(2):  # epoch loop: each iter() restarts the producer
+        assert sum(1 for _ in pf) == 2
+    assert len(pf) == 2
+
+
+def test_bounded_buffer_never_runs_ahead():
+    pulled = [0]
+
+    def src():
+        for i in range(16):
+            pulled[0] += 1
+            yield (np.full((2,), i, np.float32),)
+
+    depth = 2
+    pf = DevicePrefetcher(src(), depth=depth)
+    got = 0
+    for _ in pf:
+        got += 1
+        time.sleep(0.01)  # let the producer saturate the buffer
+        # buffer holds <= depth batches; the producer at most one more
+        assert pulled[0] <= got + depth + 1, (pulled[0], got)
+    assert got == 16
+
+
+def test_producer_exception_propagates_in_order():
+    def src():
+        yield (np.zeros((2,), np.float32),)
+        raise ValueError("boom at batch 1")
+
+    it = iter(DevicePrefetcher(src(), depth=2))
+    next(it)
+    with pytest.raises(ValueError, match="boom at batch 1"):
+        next(it)
+    # the failed iterator stays closed
+    with pytest.raises(StopIteration):
+        next(it)
+
+
+def test_early_exit_shuts_down_producer_thread():
+    pf = DevicePrefetcher(_loader(400, batch_size=1), depth=2,
+                          name="earlyexit")
+    it = iter(pf)
+    next(it)
+    it.close()
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        alive = [t for t in threading.enumerate()
+                 if t.name.startswith("prefetch-earlyexit") and t.is_alive()]
+        if not alive:
+            break
+        time.sleep(0.02)
+    assert not alive, f"leaked producer threads: {alive}"
+
+
+def test_mesh_sharded_placement():
+    from jax.sharding import NamedSharding
+
+    from paddle_tpu.distributed.spmd import batch_spec
+    mesh = dist.build_mesh([8], ["dp"])
+    pf = DevicePrefetcher(_loader(16, batch_size=8), depth=2, mesh=mesh)
+    x, y = next(iter(pf))
+    for t in (x, y):
+        arr = t._value
+        assert arr.sharding == NamedSharding(
+            mesh, batch_spec(mesh, arr.ndim)), arr.sharding
+    pf.close()
+
+
+# -- hand-off into the SPMD step ---------------------------------------------
+
+def test_prefetched_batch_no_retransfer_no_new_signature():
+    """A warm step fed prefetched device batches must neither re-transfer
+    (shard_batch returns the same array object) nor add a jit signature
+    (the retrace sentinel's book stays at 1)."""
+    mesh = dist.build_mesh([8], ["dp"])
+    dist.set_global_mesh(mesh)
+    paddle.seed(3)
+    model = nn.Linear(4, 3)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    step = dist.make_train_step(model, opt, loss_fn=nn.MSELoss(), mesh=mesh)
+    x = np.random.RandomState(0).randn(8, 4).astype(np.float32)
+    y = np.random.RandomState(1).randn(8, 3).astype(np.float32)
+    step(x, y)  # warm on host batches
+    assert len(step._jitted._signatures) == 1
+
+    pf = DevicePrefetcher([(x, y)] * 3, depth=2, mesh=mesh)
+    for bx, by in pf:
+        sb = step.shard_batch(bx, by)
+        assert sb[0] is bx._value and sb[1] is by._value
+        step(bx, by)
+    assert len(step._jitted._signatures) == 1
+
+
+def test_prefetched_stack_feeds_run_steps():
+    paddle.seed(4)
+    model = nn.Linear(4, 2)
+    opt = paddle.optimizer.SGD(learning_rate=0.05,
+                               parameters=model.parameters())
+    step = dist.make_train_step(model, opt, loss_fn=nn.MSELoss())
+    rs = np.random.RandomState(0)
+    xs = rs.randn(3, 8, 4).astype(np.float32)
+    ys = rs.randn(3, 8, 2).astype(np.float32)
+    ref = step.run_steps(xs, ys)  # warm + reference dispatch
+    pf = DevicePrefetcher([(xs, ys)], depth=1, stacked=True)
+    (px, py), = list(pf)
+    out = step.run_steps(px, py)
+    assert out.shape == [3]
+    assert np.isfinite(np.asarray(out.numpy())).all()
+    assert np.isfinite(np.asarray(ref.numpy())).all()
+
+
+def test_run_steps_restores_step_count_on_schedule_error(monkeypatch):
+    paddle.seed(5)
+    model = nn.Linear(4, 2)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    step = dist.make_train_step(model, opt, loss_fn=nn.MSELoss())
+    calls = {"n": 0}
+    orig = opt.get_lr
+
+    def flaky_lr():
+        calls["n"] += 1
+        if calls["n"] >= 2:
+            raise RuntimeError("schedule boom")
+        return orig()
+
+    monkeypatch.setattr(opt, "get_lr", flaky_lr)
+    saved = opt._step_count
+    rs = np.random.RandomState(0)
+    with pytest.raises(RuntimeError, match="schedule boom"):
+        step.run_steps(rs.randn(3, 8, 4).astype(np.float32),
+                       rs.randn(3, 8, 2).astype(np.float32))
+    assert opt._step_count == saved
+
+
+# -- dispatch-ahead loss path -------------------------------------------------
+
+def test_device_loss_list_is_lazy_and_list_like():
+    dl = DeviceLossList([jnp.asarray(1.5), jnp.asarray(2.5)])
+    assert not dl.fetched
+    assert len(dl) == 2 and bool(dl)
+    assert not dl.fetched  # len/bool never force a fetch
+    assert dl[0] == 1.5 and dl.fetched
+    assert float(dl) == 1.5
+    assert list(dl) == [1.5, 2.5]
+    np.testing.assert_allclose(np.asarray(dl), [1.5, 2.5])
+    np.testing.assert_allclose(np.ravel(dl), [1.5, 2.5])
+
+
+def _hapi_model():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Flatten(), nn.Linear(4, 8), nn.ReLU(),
+                        nn.Linear(8, 3))
+    model = Model(net)
+    model.prepare(optimizer=paddle.optimizer.Adam(
+        parameters=model.parameters(), learning_rate=1e-3),
+        loss=nn.CrossEntropyLoss())
+    return model
+
+
+def test_train_batch_returns_deferred_losses():
+    model = _hapi_model()
+    x = np.random.RandomState(0).randn(8, 4).astype(np.float32)
+    y = np.random.RandomState(1).randint(0, 3, (8,)).astype(np.int64)
+    res = model.train_batch([x], [y])
+    assert isinstance(res, DeviceLossList)
+    assert not res.fetched
+    first = [float(v) for v in res]
+    for _ in range(10):
+        res = model.train_batch([x], [y])
+    assert [float(v) for v in res][0] < first[0]
+
+
+def test_eval_batch_deferred_losses():
+    model = _hapi_model()
+    x = np.random.RandomState(0).randn(8, 4).astype(np.float32)
+    y = np.random.RandomState(1).randint(0, 3, (8,)).astype(np.int64)
+    loss = model.eval_batch([x], [y])
+    assert isinstance(loss, DeviceLossList) and not loss.fetched
+    assert np.isfinite(float(loss))
+
+
+def test_fit_prefetch_loss_series_bit_identical():
+    """Acceptance: prefetch + windowed loss fetch matches the synchronous
+    path's loss series exactly."""
+    def run(prefetch):
+        paddle.seed(0)
+        net = nn.Sequential(nn.Flatten(), nn.Linear(4, 8), nn.ReLU(),
+                            nn.Linear(8, 3))
+        model = Model(net)
+        model.prepare(optimizer=paddle.optimizer.Adam(
+            parameters=model.parameters(), learning_rate=1e-3),
+            loss=nn.CrossEntropyLoss())
+        series = []
+
+        class Rec(Callback):
+            def on_train_batch_end(self, step, logs=None):
+                series.append(float(np.ravel(np.asarray(logs["loss"]))[0]))
+
+        model.fit(RangeDataset(16), epochs=2, batch_size=4, verbose=0,
+                  shuffle=False, prefetch=prefetch, callbacks=[Rec()])
+        return series
+
+    sync = run(False)
+    pre = run(True)
+    assert len(sync) == 8
+    assert sync == pre, (sync, pre)
+
+
+def test_fit_accepts_prebuilt_prefetcher_and_evaluate_prefetch():
+    model = _hapi_model()
+    pf = DevicePrefetcher(_loader(16), depth=2)
+    model.fit(pf, epochs=1, verbose=0)
+    res = model.evaluate(RangeDataset(8), batch_size=4, verbose=0,
+                         prefetch=True)
+    assert "loss" in res and isinstance(res["loss"][0], float)
+
+
+# -- telemetry ---------------------------------------------------------------
+
+def test_prefetch_metrics_and_stall_flight_event():
+    from paddle_tpu import observability as obs
+    from paddle_tpu.observability import flight
+    from paddle_tpu.observability import steps as steps_mod
+
+    def slow_src():
+        for i in range(4):
+            time.sleep(0.05)
+            yield (np.full((2,), i, np.float32),)
+
+    obs.enable(True)
+    try:
+        flight.clear()
+        pf = DevicePrefetcher(slow_src(), depth=2, name="stall_probe")
+        assert len(list(pf)) == 4
+        st = pf.stats()
+        assert st["wait_seconds"] > 0
+        assert st["stalls"] >= 1  # producer slower than consumer
+        reg = obs.registry()
+        wait = reg.get(steps_mod.HOST_INPUT_WAIT)
+        assert wait is not None and wait.total() > 0
+        assert reg.get(steps_mod.PREFETCH_DEPTH) is not None
+        batches = reg.get(steps_mod.PREFETCH_BATCHES)
+        assert batches.value(labels={"fn": "stall_probe"}) == 4
+        stalls = reg.get(steps_mod.PIPELINE_STALLS)
+        assert stalls.total() >= 1
+        evs = flight.events("pipeline_stall")
+        assert evs and evs[0]["name"] == "stall_probe"
+        assert evs[0]["attrs"]["waited_ms"] > 0
+    finally:
+        obs.disable()
+        obs.registry().reset()
+
+
+def test_warm_buffer_records_no_stall():
+    from paddle_tpu.observability import flight
+
+    def fast_src():
+        for i in range(6):
+            yield (np.full((2,), i, np.float32),)
+
+    flight.clear()
+    pf = DevicePrefetcher(fast_src(), depth=2, name="warm_probe")
+    it = iter(pf)
+    first = next(it)  # cold first batch: wait, but NOT a stall
+    time.sleep(0.1)   # producer fills the buffer
+    rest = []
+    for b in it:
+        rest.append(b)
+        time.sleep(0.02)  # consumer strictly slower → buffer stays warm
+    assert len(rest) == 5
+    assert pf.stats()["stalls"] == 0
+    assert not [e for e in flight.events("pipeline_stall")
+                if e["name"] == "warm_probe"]
+    assert first is not None

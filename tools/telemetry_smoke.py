@@ -2,13 +2,17 @@
 observability layer forced ON so the instrumented paths can't silently rot
 (ISSUE 2 satellite; the tier-1 gate itself runs telemetry-off).
 
-    python tools/telemetry_smoke.py            # default subset
-    python tools/telemetry_smoke.py tests/test_io.py   # explicit subset
+    python tools/telemetry_smoke.py            # default subset + prefetch lane
+    python tools/telemetry_smoke.py tests/test_io.py   # explicit subset only
 
 Forces PADDLE_TPU_TELEMETRY=1 (metrics registry + op-dispatch hook +
 retrace sentinel + step metrics live) on top of the always-on span/flight
 layer, and a 60 s step watchdog so the watchdog arm/disarm path in the
-SPMD step executes on every train-step test.  Exit code is pytest's.
+SPMD step executes on every train-step test.  With the default subset it
+additionally runs the prefetch-on training lane (ISSUE 4 satellite): a
+tiny hapi fit through DevicePrefetcher that must complete AND export the
+input-pipeline metrics (host_input_wait counter, buffer-occupancy gauge).
+Exit code is pytest's, or 1 if the prefetch lane fails.
 """
 from __future__ import annotations
 
@@ -17,19 +21,69 @@ import subprocess
 import sys
 
 # the subset exercises every instrumented subsystem: op dispatch + spans +
-# chrome merge (observability), dataloader waits (io), to_static compiles
-# (jit), checkpoint phases, the SPMD step + collectives (distributed)
+# chrome merge (observability), dataloader waits + prefetch (io), to_static
+# compiles (jit), checkpoint phases, the SPMD step + collectives
+# (distributed)
 DEFAULT_SUBSET = [
     "tests/test_observability.py",
     "tests/test_io.py",
+    "tests/test_prefetch.py",
     "tests/test_jit_static.py",
     "tests/test_checkpoint.py",
     "tests/test_distributed.py",
     "tests/test_serving.py",
 ]
 
+# prefetch-on training lane: fit a tiny model THROUGH DevicePrefetcher with
+# telemetry live and assert the input-pipeline series were exported.  Runs
+# in its own interpreter so the env-var bootstrap path is what's exercised.
+PREFETCH_LANE = r"""
+import numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import observability as obs
+from paddle_tpu.hapi import Model
+from paddle_tpu.io.dataset import Dataset
+from paddle_tpu.observability import steps as steps_mod
+
+assert obs.enabled(), "PADDLE_TPU_TELEMETRY=1 must bootstrap telemetry"
+
+
+class DS(Dataset):
+    def __getitem__(self, i):
+        rs = np.random.RandomState(i)
+        return rs.randn(4).astype("float32"), np.int64(i % 3)
+
+    def __len__(self):
+        return 16
+
+
+paddle.seed(0)
+net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 3))
+model = Model(net)
+model.prepare(optimizer=paddle.optimizer.Adam(
+    parameters=model.parameters(), learning_rate=1e-3),
+    loss=nn.CrossEntropyLoss())
+model.fit(DS(), epochs=1, batch_size=4, verbose=0, shuffle=False,
+          prefetch=True)
+
+d = obs.dump()
+assert steps_mod.HOST_INPUT_WAIT in d["counters"], \
+    f"host input wait counter missing: {sorted(d['counters'])}"
+assert steps_mod.PREFETCH_DEPTH in d["gauges"], \
+    f"prefetch buffer-occupancy gauge missing: {sorted(d['gauges'])}"
+assert steps_mod.PREFETCH_BATCHES in d["counters"], \
+    f"prefetch batches counter missing: {sorted(d['counters'])}"
+text = obs.to_prometheus_text()
+assert steps_mod.HOST_INPUT_WAIT in text and steps_mod.PREFETCH_DEPTH in text
+print("prefetch lane ok:", {k: d["counters"][k]
+                            for k in (steps_mod.HOST_INPUT_WAIT,
+                                      steps_mod.PREFETCH_BATCHES)})
+"""
+
 
 def main() -> int:
+    explicit = bool(sys.argv[1:])
     targets = sys.argv[1:] or DEFAULT_SUBSET
     env = dict(os.environ)
     env.update({
@@ -38,12 +92,19 @@ def main() -> int:
         "PADDLE_TPU_STEP_TIMEOUT_S": env.get(
             "PADDLE_TPU_STEP_TIMEOUT_S", "60"),
     })
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     cmd = [sys.executable, "-m", "pytest", "-q", "-m", "not slow",
            "-p", "no:cacheprovider", *targets]
     print("telemetry smoke lane:", " ".join(cmd), file=sys.stderr)
-    return subprocess.call(cmd, env=env,
-                           cwd=os.path.dirname(os.path.dirname(
-                               os.path.abspath(__file__))))
+    rc = subprocess.call(cmd, env=env, cwd=root)
+    if not explicit:
+        print("telemetry smoke: prefetch-on training lane", file=sys.stderr)
+        lane_rc = subprocess.call([sys.executable, "-c", PREFETCH_LANE],
+                                  env=env, cwd=root)
+        if lane_rc != 0:
+            print("prefetch lane FAILED", file=sys.stderr)
+        rc = rc or lane_rc
+    return rc
 
 
 if __name__ == "__main__":
