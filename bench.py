@@ -148,6 +148,46 @@ def _input_overlap_block(step, batches, stacked=False, parity_make=None):
     return block
 
 
+def _checkpoint_block(step, batch, on_tpu):
+    """Checkpoint-overhead probe (ISSUE 5): host snapshot, async sharded
+    write (CRC + COMMITTED marker), validated restore — the costs the
+    preemption-safe training path adds per checkpoint — plus the CPU
+    resume-parity gate: load_state_dict must reproduce the next steps'
+    losses bit-identically without adding a jit signature."""
+    import tempfile
+
+    from paddle_tpu.framework.checkpoint import AsyncCheckpointSaver
+
+    block = {}
+    with tempfile.TemporaryDirectory() as d:
+        saver = AsyncCheckpointSaver(d, keep_last=2)
+        t0 = time.perf_counter()
+        state = step.state_dict()
+        block["snapshot_ms"] = round(1e3 * (time.perf_counter() - t0), 2)
+        t0 = time.perf_counter()
+        saver.save(state, step=int(step.optimizer._step_count))
+        saver.wait()
+        block["async_write_ms"] = round(1e3 * (time.perf_counter() - t0), 2)
+        t0 = time.perf_counter()
+        _, restored = saver.restore_latest_valid()
+        block["restore_ms"] = round(1e3 * (time.perf_counter() - t0), 2)
+        parity = None
+        if not on_tpu:
+            sigs_before = len(step._jitted._signatures)
+            tail_a = _loss_series([step(*batch) for _ in range(2)])
+            step.load_state_dict(restored)
+            tail_b = _loss_series([step(*batch) for _ in range(2)])
+            parity = (tail_a == tail_b and
+                      len(step._jitted._signatures) == sigs_before)
+            if not parity:
+                raise RuntimeError(
+                    f"checkpoint resume parity broke: {tail_a} vs {tail_b} "
+                    f"(signatures {sigs_before} -> "
+                    f"{len(step._jitted._signatures)})")
+        block["resume_parity"] = parity
+    return block
+
+
 def bench_gpt_small():
     """Flagship: GPT-2-small pretraining step (125M; comparable to the
     round-1..3 flagship numbers)."""
@@ -195,12 +235,14 @@ def bench_gpt_small():
     overlap = _input_overlap_block(
         step, [(x, y)] * (8 if on_tpu else 3),
         parity_make=None if on_tpu else make_step)
+    ckpt = _checkpoint_block(step, (x, y), on_tpu)
     return {
         "metric": f"gpt_{name}_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s/chip",
         "noise_pct": noise,
         "input_overlap": overlap,
+        "checkpoint": ckpt,
         "vs_baseline": round(mfu / 0.35, 4) if on_tpu else 0.0,
     }
 

@@ -193,6 +193,7 @@ class ShardedTrainStep:
         self._slot_specs = self._infer_slot_specs()
 
         self.abstract = bool(abstract)
+        self._saver = None  # attach_saver(): preemption checkpoint target
         self.param_names = [k for k, m in self._tmask.items() if m]
         self._flat_segs, self._flat_len = None, {}
         self._fuse_optimizer = fuse_optimizer
@@ -731,6 +732,7 @@ class ShardedTrainStep:
             _steps.record_step(time.perf_counter() - t0, examples=n,
                                fn="train_step")
             _steps.record_memory_stats()
+        self._maybe_emergency_save()
         return Tensor(loss, _internal=True)
 
     def run_steps(self, *stacked):
@@ -819,7 +821,71 @@ class ShardedTrainStep:
             # comparable with the single-step path
             _steps.record_step(dt / k, examples=n, fn="train_step_multi")
             _steps.record_memory_stats()
+        self._maybe_emergency_save()
         return Tensor(losses, _internal=True)
+
+    # -- checkpoint / preemption ---------------------------------------------
+    def state_dict(self) -> dict:
+        """Host snapshot of the full train state (params, slots, buffers,
+        step, RNG key) + the optimizer step count — everything a fresh
+        process needs to continue bit-identically.  The tree round-trips
+        through ``framework.checkpoint.save_sharded``."""
+        import jax
+        tree = self.state.tree()
+        host = jax.device_get({"params": tree["params"],
+                               "slots": tree["slots"],
+                               "buffers": tree["buffers"]})
+        host["step"] = np.asarray(jax.device_get(tree["step"]))
+        host["rng_key"] = np.asarray(
+            jax.device_get(jax.random.key_data(tree["rng"])))
+        host["opt_step_count"] = np.asarray(self.optimizer._step_count,
+                                            np.int64)
+        return host
+
+    def load_state_dict(self, state: dict):
+        """Restore a :meth:`state_dict` snapshot (possibly loaded through
+        ``load_sharded``, i.e. leaves may be Tensors).  Every array keeps
+        its existing shape/dtype/sharding, so the already-compiled step
+        keeps its ONE jit signature — resume never pays a retrace."""
+        def as_np(v):
+            return np.asarray(v.numpy() if isinstance(v, Tensor) else v)
+
+        cur = self.state
+        params = {k: jnp.asarray(as_np(state["params"][k]), v.dtype)
+                  for k, v in cur.params.items()}
+        slots = {k: {s: jnp.asarray(as_np(state["slots"][k][s]), v.dtype)
+                     for s, v in d.items()}
+                 for k, d in cur.slots.items()}
+        buffers = {k: jnp.asarray(as_np(state["buffers"][k]), v.dtype)
+                   for k, v in cur.buffers.items()}
+        step = jnp.asarray(int(as_np(state["step"])), jnp.int32)
+        rng = jax.random.wrap_key_data(
+            jnp.asarray(as_np(state["rng_key"]), jnp.uint32))
+        self.state = TrainState(params, slots, buffers, step, rng)
+        if self.mesh is not None:
+            self.state = self._shard_state(self.state)
+        self.optimizer._step_count = int(as_np(state["opt_step_count"]))
+
+    def attach_saver(self, saver):
+        """Attach an AsyncCheckpointSaver as the emergency-checkpoint
+        target: when a preemption is requested (SIGTERM under
+        ``framework.preemption.guard``), the next step boundary writes a
+        blocking checkpoint and raises TrainingPreempted."""
+        self._saver = saver
+        return self
+
+    def _maybe_emergency_save(self):
+        if self._saver is None:
+            return
+        from ..framework import preemption
+        if not preemption.requested():
+            return
+        from ..observability import trace as _trace
+        step_no = int(self.optimizer._step_count)
+        with _trace.span("checkpoint.emergency", step=step_no):
+            self._saver.save(self.state_dict(), step=step_no, blocking=True)
+        preemption.mark_saved(step_no)
+        raise preemption.TrainingPreempted(step_no)
 
     def sync_to_model(self):
         """Write compiled-state values back into the eager Layer.  Values are

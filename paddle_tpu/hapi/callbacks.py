@@ -247,6 +247,128 @@ class ModelCheckpoint(Callback):
             self.model.save(os.path.join(self.save_dir, "final"))
 
 
+def restore_checkpoint_state(model, state) -> dict:
+    """Apply a checkpoint tree (as written by CheckpointCallback) to a
+    hapi Model: weights, optimizer state (scheduler scalars coerced back
+    from their 0-d round-trip form), and the global RNG.  Returns the
+    ``train`` block as python scalars (rng_key stays an array)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..core import random as random_mod
+
+    def as_int(v):
+        return int(np.ravel(np.asarray(
+            v.numpy() if hasattr(v, "numpy") else v))[0])
+
+    model.network.set_state_dict(state["model"])
+    if model._optimizer is not None and "optimizer" in state:
+        opt_state = dict(state["optimizer"])
+        lrs = opt_state.get("LR_Scheduler")
+        if isinstance(lrs, dict):
+            opt_state["LR_Scheduler"] = {
+                k: (np.ravel(np.asarray(
+                    v.numpy() if hasattr(v, "numpy") else v))[0].item()
+                    if not isinstance(v, (numbers.Number, str)) else v)
+                for k, v in lrs.items()}
+        model._optimizer.set_state_dict(opt_state)
+    train = state.get("train", {})
+    if "rng_key" in train:
+        raw = train["rng_key"]
+        raw = raw.numpy() if hasattr(raw, "numpy") else raw
+        key = jax.random.wrap_key_data(
+            jnp.asarray(np.asarray(raw), jnp.uint32))
+        random_mod.set_rng_state((key, as_int(train.get("rng_counter", 0))))
+    return {k: (as_int(v) if k != "rng_key" else v)
+            for k, v in train.items()}
+
+
+class CheckpointCallback(Callback):
+    """Validated-checkpoint save/resume for the fit loop (ISSUE 5).
+
+    Writes sharded, CRC-validated, COMMITTED-marked checkpoints through
+    :class:`~paddle_tpu.framework.checkpoint.AsyncCheckpointSaver`:
+    model weights + optimizer state + a ``train`` scalar block (epoch,
+    step-in-epoch, optimizer step count, RNG key/counter, dataloader
+    epoch seed) — everything ``Model.fit(resume=...)`` needs to continue
+    a killed run bit-identically.
+
+    Saves every ``save_freq`` epochs, optionally every ``every_n_steps``
+    batches (async: the fit loop never blocks on disk), and — the
+    preemption path — a *blocking* emergency save at the first step
+    boundary after ``framework.preemption`` flags a SIGTERM, after which
+    ``model.stop_training`` ends the run cleanly.
+    """
+
+    def __init__(self, save_dir, save_freq=1, every_n_steps=None,
+                 keep_last=3, fs=None, data_seed=0):
+        super().__init__()
+        from ..framework.checkpoint import AsyncCheckpointSaver
+        self.saver = AsyncCheckpointSaver(save_dir, keep_last=keep_last,
+                                          fs=fs)
+        self.save_freq = save_freq
+        self.every_n_steps = every_n_steps
+        self.data_seed = int(data_seed)
+        self.preempted = False
+        self._epoch = 0
+        self._global_step = 0
+
+    # -- state assembly ------------------------------------------------------
+    def _train_block(self, epoch, step_in_epoch):
+        import jax
+
+        from ..core import random as random_mod
+        key, counter = random_mod.get_rng_state()
+        return {"epoch": int(epoch), "step_in_epoch": int(step_in_epoch),
+                "opt_step_count": int(getattr(
+                    self.model._optimizer, "_step_count", 0)),
+                "rng_key": np.asarray(jax.random.key_data(key)),
+                "rng_counter": int(counter),
+                "data_seed": self.data_seed}
+
+    def _save(self, epoch, step_in_epoch, blocking=False):
+        state = {"model": self.model.network.state_dict(),
+                 "train": self._train_block(epoch, step_in_epoch)}
+        if self.model._optimizer is not None:
+            state["optimizer"] = self.model._optimizer.state_dict()
+        self.saver.save(state, step=self._global_step, blocking=blocking)
+
+    def restore_into(self, state):
+        """Apply a loaded checkpoint tree to the model; returns the
+        ``train`` scalar block (``Model.fit`` consumes epoch/step/rng)."""
+        train = restore_checkpoint_state(self.model, state)
+        if "data_seed" in train:
+            self.data_seed = int(train["data_seed"])
+        self._global_step = int(train.get("opt_step_count", 0))
+        return train
+
+    # -- hooks ---------------------------------------------------------------
+    def on_epoch_begin(self, epoch, logs=None):
+        self._epoch = epoch
+
+    def on_train_batch_end(self, step, logs=None):
+        from ..framework import preemption
+        from ..testing import faults
+        self._global_step += 1
+        faults.fault_point("train.step", step=self._global_step)
+        if preemption.requested():
+            self._save(self._epoch, step + 1, blocking=True)
+            preemption.mark_saved(self._global_step)
+            self.preempted = True
+            self.model.stop_training = True
+            return
+        if self.every_n_steps and self._global_step % self.every_n_steps == 0:
+            self._save(self._epoch, step + 1)
+
+    def on_epoch_end(self, epoch, logs=None):
+        if not self.preempted and (epoch + 1) % self.save_freq == 0:
+            # epoch done: resume point is the NEXT epoch at step 0
+            self._save(epoch + 1, 0)
+
+    def on_train_end(self, logs=None):
+        self.saver.wait()
+
+
 class LRScheduler(Callback):
     """hapi/callbacks.py:599: step the optimizer's LRScheduler."""
 

@@ -193,14 +193,23 @@ class Model:
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
             accumulate_grad_batches=1, num_iters=None, prefetch=False,
-            prefetch_depth=2):
+            prefetch_depth=2, resume=None):
         """model.py fit parity: epoch/step loops with the callback protocol.
 
         `prefetch=True` routes the train loader through a DevicePrefetcher
         (`prefetch_depth` batches kept device-resident ahead of the loop);
         combined with the deferred DeviceLossList losses the loop dispatches
         ahead of the device instead of syncing per batch.  A pre-built
-        DevicePrefetcher may also be passed directly as `train_data`."""
+        DevicePrefetcher may also be passed directly as `train_data`.
+
+        `resume="auto"` restores the latest valid checkpoint written by a
+        :class:`~paddle_tpu.hapi.callbacks.CheckpointCallback` (which must
+        be in `callbacks`) and continues from the recorded epoch/step with
+        the saved optimizer state and RNG — bit-identical to the
+        uninterrupted run; `resume=<path>` loads an explicit checkpoint
+        step dir (or walks a checkpoint base dir).  While fitting, SIGTERM
+        /SIGINT request an emergency checkpoint at the next step boundary
+        (framework.preemption) instead of killing the run."""
         assert train_data is not None, "train_data must be given!"
         loader = self._loader(train_data, batch_size, shuffle, num_workers,
                               drop_last=drop_last, prefetch=prefetch,
@@ -211,38 +220,82 @@ class Model:
             callbacks, model=self, epochs=epochs, steps=steps,
             log_freq=log_freq, verbose=verbose, save_freq=save_freq,
             save_dir=save_dir, metrics=self._metrics)
+        ckpt_cb = next((c for c in cbks.callbacks if isinstance(
+            c, callbacks_mod.CheckpointCallback)), None)
+        start_epoch = start_step = 0
+        if resume:
+            start_epoch, start_step = self._restore_for_resume(
+                resume, ckpt_cb)
 
+        from ..framework import preemption
         self.stop_training = False
         cbks.on_train_begin({})
-        for epoch in range(epochs):
-            if self.stop_training:
-                break
-            cbks.on_epoch_begin(epoch, {})
-            for m in self._metrics:
-                m.reset()
-            logs = {}
-            pending_update = False
-            for step, batch in enumerate(loader):
-                cbks.on_train_batch_begin(step, {})
-                ins, lbs = self._split_batch(batch)
-                update = (step + 1) % accumulate_grad_batches == 0
-                res = self.train_batch(ins, lbs, update=update)
-                pending_update = not update
-                logs = self._pack_logs(res)
-                cbks.on_train_batch_end(step, logs)
-                if num_iters is not None and step + 1 >= num_iters:
+        with preemption.guard():
+            for epoch in range(start_epoch, epochs):
+                if self.stop_training:
                     break
-            if pending_update and self._optimizer is not None:
-                # flush a trailing partial accumulation group so grads never
-                # leak across epochs
-                self._optimizer.step()
-                self._optimizer.clear_grad()
-            cbks.on_epoch_end(epoch, logs)
+                if ckpt_cb is not None:
+                    # deterministic per-epoch shuffle: a resumed run must
+                    # draw the SAME permutation this epoch saw originally
+                    np.random.seed((ckpt_cb.data_seed + epoch) % (2 ** 32))
+                cbks.on_epoch_begin(epoch, {})
+                for m in self._metrics:
+                    m.reset()
+                logs = {}
+                pending_update = False
+                skip = start_step if epoch == start_epoch else 0
+                for step, batch in enumerate(loader):
+                    if step < skip:
+                        continue  # replayed prefix of a resumed epoch
+                    cbks.on_train_batch_begin(step, {})
+                    ins, lbs = self._split_batch(batch)
+                    update = (step + 1) % accumulate_grad_batches == 0
+                    res = self.train_batch(ins, lbs, update=update)
+                    pending_update = not update
+                    logs = self._pack_logs(res)
+                    cbks.on_train_batch_end(step, logs)
+                    if self.stop_training:
+                        break  # preempted: checkpoint already on disk
+                    if num_iters is not None and step + 1 >= num_iters:
+                        break
+                if self.stop_training:
+                    break
+                if pending_update and self._optimizer is not None:
+                    # flush a trailing partial accumulation group so grads
+                    # never leak across epochs
+                    self._optimizer.step()
+                    self._optimizer.clear_grad()
+                cbks.on_epoch_end(epoch, logs)
 
-            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
-                eval_logs = self._run_eval(eval_loader, cbks)
-                cbks.on_eval_end(eval_logs)
+                if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                    eval_logs = self._run_eval(eval_loader, cbks)
+                    cbks.on_eval_end(eval_logs)
         cbks.on_train_end({})
+
+    def _restore_for_resume(self, resume, ckpt_cb):
+        """Resolve `resume` ("auto" | checkpoint dir) to a restored state;
+        returns (start_epoch, start_step_in_epoch)."""
+        from ..framework.checkpoint import (AsyncCheckpointSaver, _MANIFEST,
+                                            load_sharded)
+        if resume == "auto":
+            if ckpt_cb is None:
+                raise ValueError(
+                    "fit(resume='auto') needs a CheckpointCallback in "
+                    "callbacks= (it owns the checkpoint directory)")
+            _, state = ckpt_cb.saver.restore_latest_valid()
+            if state is None:
+                return 0, 0  # nothing saved yet: fresh start
+        elif os.path.isfile(os.path.join(str(resume), _MANIFEST)):
+            state = load_sharded(str(resume))
+        else:
+            _, state = AsyncCheckpointSaver(
+                str(resume)).restore_latest_valid()
+            if state is None:
+                raise FileNotFoundError(
+                    f"no valid checkpoint under {resume!r}")
+        train = (ckpt_cb.restore_into(state) if ckpt_cb is not None
+                 else callbacks_mod.restore_checkpoint_state(self, state))
+        return int(train.get("epoch", 0)), int(train.get("step_in_epoch", 0))
 
     def _pack_logs(self, res):
         logs = {}

@@ -39,9 +39,11 @@ class TrainEpochRange:
         self._registered = []  # (obj with state_dict/set_state_dict, tag)
         self._start_epoch = 0
         self._restored_state = None
-        last = self._saver.latest_step()
+        # restore_latest_valid: a corrupt/torn newest epoch falls back to
+        # the previous committed one instead of failing the relaunch
+        last, state = self._saver.restore_latest_valid()
         if last is not None:
-            self._restored_state = self._saver.restore(last)
+            self._restored_state = state
             self._start_epoch = last + 1
 
     # -- registration (reference: exe/program snapshot; here state_dicts) ----

@@ -19,10 +19,11 @@ from .engine import (  # noqa: F401
     DeadlineExceededError,
     Engine,
     EngineClosedError,
+    EngineDeadError,
     QueueFullError,
     RequestHandle,
 )
 from .slot_pool import SlotPool  # noqa: F401
 
 __all__ = ["Engine", "RequestHandle", "SlotPool", "QueueFullError",
-           "DeadlineExceededError", "EngineClosedError"]
+           "DeadlineExceededError", "EngineClosedError", "EngineDeadError"]

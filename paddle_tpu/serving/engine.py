@@ -55,7 +55,7 @@ from ..observability.retrace import instrument_jit
 from .slot_pool import SlotPool
 
 __all__ = ["Engine", "RequestHandle", "QueueFullError",
-           "DeadlineExceededError", "EngineClosedError"]
+           "DeadlineExceededError", "EngineClosedError", "EngineDeadError"]
 
 # -- metric names (paddle_tpu.observability registry) -------------------------
 SERVING_ACTIVE_SLOTS = "paddle_tpu_serving_active_slots"
@@ -77,6 +77,17 @@ class DeadlineExceededError(TimeoutError):
 
 class EngineClosedError(RuntimeError):
     """The engine was shut down with this request still in flight."""
+
+
+class EngineDeadError(RuntimeError):
+    """The scheduler thread crashed: the engine is permanently dead and
+    rejects new work, naming the original exception — restarting the loop
+    over an already-failed pool would serve garbage."""
+
+    def __init__(self, cause: BaseException):
+        super().__init__(
+            f"serving scheduler died: {type(cause).__name__}: {cause}")
+        self.cause = cause
 
 
 _ids = itertools.count(1)
@@ -253,6 +264,7 @@ class Engine:
         self._lock = threading.Lock()
         self._wake = threading.Event()
         self._stop = False
+        self._dead: Optional[BaseException] = None
         self._thread: Optional[threading.Thread] = None
         self._built = False
         self._values = None
@@ -283,6 +295,8 @@ class Engine:
         :class:`QueueFullError` when the bounded admission queue is at
         capacity (backpressure: the caller sheds load or retries) and
         ValueError when the request cannot fit a slot."""
+        if self._dead is not None:
+            raise EngineDeadError(self._dead) from self._dead
         if self._stop:
             raise EngineClosedError("engine is shut down")
         if isinstance(prompt, str):
@@ -328,6 +342,8 @@ class Engine:
 
     def start(self):
         """Start the scheduler thread (idempotent)."""
+        if self._dead is not None:
+            raise EngineDeadError(self._dead) from self._dead
         if self._stop:
             raise EngineClosedError("engine is shut down")
         if self._thread is None or not self._thread.is_alive():
@@ -501,6 +517,10 @@ class Engine:
             try:
                 did = self._step_once()
             except Exception as e:  # noqa: BLE001 — fail loudly, not hang
+                # mark the engine DEAD before failing the in-flight work:
+                # a later submit() must not restart the loop over an
+                # already-failed pool (it raises EngineDeadError instead)
+                self._dead = e
                 flight.record("serving", "scheduler_error",
                               error=f"{type(e).__name__}: {e}")
                 with self._lock:
@@ -521,10 +541,29 @@ class Engine:
     def _step_once(self) -> bool:
         """One scheduler iteration: sweep, admit (batched prefill), one
         batched decode step.  Returns whether any work happened."""
+        from ..testing import faults
+        faults.fault_point("serving.scheduler")
         self._sweep()
         did = self._admit()
         did = self._decode_step() or did
         return did
+
+    def health(self) -> dict:
+        """Liveness snapshot: ``alive`` is True only while the engine can
+        still take and make progress on requests."""
+        with self._lock:
+            active, depth = self._pool.n_active, len(self._queue)
+        return {
+            "alive": self._dead is None and not self._stop,
+            "dead": self._dead is not None,
+            "error": (None if self._dead is None
+                      else f"{type(self._dead).__name__}: {self._dead}"),
+            "stopped": self._stop,
+            "scheduler_running": (self._thread is not None and
+                                  self._thread.is_alive()),
+            "active_slots": active,
+            "queue_depth": depth,
+        }
 
     def _sweep(self):
         """Evict cancelled / past-deadline requests (queued and active)."""

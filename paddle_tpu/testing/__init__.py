@@ -1,0 +1,11 @@
+"""paddle_tpu.testing — fault injection and chaos-test helpers.
+
+The production modules call :func:`paddle_tpu.testing.faults.fault_point`
+at their crash-critical seams (checkpoint writes, remote uploads, the
+serving scheduler, the train loop); tests and the chaos smoke lane arm
+faults there to prove kill-and-resume is a working path, not a hope.
+"""
+from . import faults  # noqa: F401
+from .faults import FaultInjected, fault_point, inject  # noqa: F401
+
+__all__ = ["faults", "FaultInjected", "fault_point", "inject"]

@@ -1,0 +1,178 @@
+"""Fault-injection harness — named fault points threaded through the
+crash-critical seams of the stack.
+
+Production code marks a seam with ``fault_point("checkpoint.write",
+path=...)``; nothing happens unless a fault is armed for that name, so the
+call is a dict lookup on the hot path and free in normal operation.  Tests
+(and the chaos smoke lane) arm faults either programmatically::
+
+    with faults.inject("checkpoint.write", mode="raise"):
+        saver.save(state, step=2, blocking=True)   # raises FaultInjected
+
+or from the environment for subprocess harnesses::
+
+    PADDLE_TPU_FAULTS="train.step:kill:after=5,fs.upload:raise"
+
+Modes
+-----
+* ``raise`` — raise :class:`FaultInjected` (default once; ``times=N`` for
+  N hits, ``times=None`` forever).  A raise inside a checkpoint write
+  leaves the same on-disk state as a crash at that instruction, so the
+  crash-matrix tests run in-process.
+* ``torn``  — truncate the file passed as ``path=`` to half its size,
+  then raise: a torn write, the classic power-loss artifact.
+* ``delay`` — sleep ``seconds`` (contention/slow-disk simulation).
+* ``kill``  — ``os._exit(exit_code)``: a hard preemption with no cleanup,
+  for subprocess tests and the chaos smoke lane.
+
+``after=K`` skips the first K hits (kill-at-step-K); hit counts are
+tracked per name for assertions via :func:`hits` (counted whenever the
+point is crossed while any fault is armed, matched or not).
+
+Every triggered fault lands in the flight recorder (``kind="fault"``) so
+a chaos run's crash dump shows what was injected where.
+
+Fault points in the tree (see docs/robustness.md for the catalogue):
+``checkpoint.write``, ``checkpoint.manifest``, ``checkpoint.commit``,
+``checkpoint.promote``, ``checkpoint.upload``,
+``checkpoint.upload_commit``, ``fs.upload``, ``fs.download``,
+``serving.scheduler``, ``train.step``.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+
+__all__ = ["FaultInjected", "fault_point", "inject", "arm", "disarm",
+           "reset", "hits", "armed"]
+
+
+class FaultInjected(RuntimeError):
+    """Raised by an armed ``raise``/``torn`` fault point."""
+
+    def __init__(self, name: str, mode: str = "raise"):
+        super().__init__(f"injected fault at {name!r} (mode={mode})")
+        self.point = name
+        self.mode = mode
+
+
+class _Fault:
+    __slots__ = ("name", "mode", "times", "after", "seconds", "exit_code",
+                 "exc", "triggered")
+
+    def __init__(self, name, mode="raise", times=1, after=0, seconds=0.05,
+                 exit_code=43, exc=None):
+        if mode not in ("raise", "torn", "delay", "kill"):
+            raise ValueError(f"unknown fault mode {mode!r}")
+        self.name = name
+        self.mode = mode
+        self.times = None if times is None else int(times)
+        self.after = int(after)
+        self.seconds = float(seconds)
+        self.exit_code = int(exit_code)
+        self.exc = exc
+        self.triggered = 0
+
+
+_lock = threading.Lock()
+_faults: dict[str, _Fault] = {}
+_hits: dict[str, int] = {}
+
+
+def armed() -> bool:
+    return bool(_faults)
+
+
+def arm(name: str, mode: str = "raise", **kw) -> _Fault:
+    """Arm one fault; replaces any previous fault on the same name."""
+    f = _Fault(name, mode, **kw)
+    with _lock:
+        _faults[name] = f
+    return f
+
+
+def disarm(name: str):
+    with _lock:
+        _faults.pop(name, None)
+
+
+def reset():
+    """Disarm everything and zero the hit counters (test teardown)."""
+    with _lock:
+        _faults.clear()
+        _hits.clear()
+
+
+def hits(name: str) -> int:
+    """How many times `name` was crossed while any fault was armed."""
+    with _lock:
+        return _hits.get(name, 0)
+
+
+@contextlib.contextmanager
+def inject(name: str, mode: str = "raise", **kw):
+    """Arm a fault for the scope: ``with inject("fs.upload", times=1): ...``"""
+    f = arm(name, mode, **kw)
+    try:
+        yield f
+    finally:
+        disarm(name)
+
+
+def _torn(path: str | None):
+    if path and os.path.isfile(path):
+        size = os.path.getsize(path)
+        with open(path, "r+b") as fh:
+            fh.truncate(max(1, size // 2))
+
+
+def fault_point(name: str, path: str | None = None, **ctx):
+    """Crash-critical seam marker.  A dict lookup when nothing is armed."""
+    if not _faults:
+        return
+    with _lock:
+        _hits[name] = _hits.get(name, 0) + 1
+        f = _faults.get(name)
+        if f is None:
+            return
+        f.triggered += 1
+        if f.triggered <= f.after:
+            return
+        if f.times is not None and f.triggered - f.after > f.times:
+            return
+        mode = f.mode
+    from ..observability import flight
+    flight.record("fault", name, mode=mode, hit=f.triggered,
+                  **{k: v for k, v in ctx.items()
+                     if isinstance(v, (str, int, float, bool))})
+    if mode == "delay":
+        time.sleep(f.seconds)
+        return
+    if mode == "kill":
+        os._exit(f.exit_code)
+    if mode == "torn":
+        _torn(path)
+    if f.exc is not None:
+        raise f.exc
+    raise FaultInjected(name, mode)
+
+
+def _load_env(spec: str | None = None):
+    """Arm faults from ``PADDLE_TPU_FAULTS``: comma-separated entries of
+    ``name[:mode[:key=val]...]`` — e.g. ``train.step:kill:after=5``."""
+    spec = spec if spec is not None else os.environ.get(
+        "PADDLE_TPU_FAULTS", "")
+    for entry in filter(None, (e.strip() for e in spec.split(","))):
+        parts = entry.split(":")
+        name, mode = parts[0], (parts[1] if len(parts) > 1 else "raise")
+        kw: dict = {}
+        for field in parts[2:]:
+            k, _, v = field.partition("=")
+            kw[k] = None if v == "none" else (
+                float(v) if k == "seconds" else int(v))
+        arm(name, mode, **kw)
+
+
+_load_env()

@@ -3,8 +3,10 @@ deprecated/dlpack/download/unique_name helpers)."""
 from . import cpp_extension  # noqa: F401
 from . import dlpack  # noqa: F401
 from . import download  # noqa: F401
+from . import retry  # noqa: F401
 from . import unique_name  # noqa: F401
 from .deprecated import deprecated  # noqa: F401
+from .retry import retry_call, retryable  # noqa: F401
 from .native_build import build_native_lib, get_build_directory  # noqa: F401
 
 

@@ -228,3 +228,32 @@ def test_serving_soak(tiny_gpt):
     assert st["decode_compiles"] == 1
     assert st["active_slots"] == 0 and st["queue_depth"] == 0
     assert st["slot_reuses"] >= 36
+
+
+def test_engine_dead_after_scheduler_crash(tiny_gpt):
+    """ISSUE 5 satellite: a scheduler crash marks the engine DEAD — a
+    later submit() must NOT restart the loop over the failed pool; it
+    raises EngineDeadError naming the original exception."""
+    from paddle_tpu.serving import EngineDeadError
+    from paddle_tpu.testing import faults
+
+    model, _ = tiny_gpt
+    eng = Engine(model, max_slots=2, max_len=32)
+    assert eng.health()["alive"]
+    faults.arm("serving.scheduler", exc=RuntimeError("pool exploded"),
+               times=None)
+    try:
+        h = eng.submit(np.array([1, 2, 3], np.int64), max_new_tokens=2)
+        err = h.exception(timeout=60)
+        assert isinstance(err, RuntimeError) and "pool exploded" in str(err)
+        health = eng.health()
+        assert not health["alive"] and health["dead"]
+        assert "pool exploded" in health["error"]
+        with pytest.raises(EngineDeadError, match="pool exploded"):
+            eng.submit(np.array([4, 5], np.int64), max_new_tokens=2)
+        with pytest.raises(EngineDeadError):
+            eng.start()
+        assert eng.stats()["failed"] >= 1
+    finally:
+        faults.reset()
+        eng.shutdown()

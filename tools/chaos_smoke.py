@@ -1,0 +1,168 @@
+"""Chaos smoke lane: kill-and-resume must be a WORKING path, end to end,
+in real processes (ISSUE 5 satellite; same contract as telemetry_smoke.py —
+the lane runs even when the pytest subset has pre-existing failures).
+
+    python tools/chaos_smoke.py              # subset + chaos lane
+    python tools/chaos_smoke.py tests/x.py   # explicit subset only
+
+The lane runs three telemetry-on subprocesses over one checkpoint dir:
+
+1. **ref** — an uninterrupted 2-epoch hapi fit; writes its per-batch loss
+   series.
+2. **interrupt** — the same fit, but the process SIGTERMs *itself*
+   mid-epoch; the preemption hook converts the signal into an emergency
+   checkpoint at the next step boundary and the fit stops cleanly.
+3. **resume** — a fresh process runs ``fit(resume="auto")`` and finishes
+   the run.
+
+The parent asserts completion and that ``interrupt + resume`` losses are
+bit-identical to ``ref`` — the acceptance criterion for preemption-safe
+training on CPU.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+DEFAULT_SUBSET = [
+    "tests/test_robustness.py",
+    "tests/test_checkpoint.py",
+]
+
+CHILD = r"""
+import json
+import os
+import signal
+import sys
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.hapi import Model
+from paddle_tpu.hapi.callbacks import Callback, CheckpointCallback
+
+mode, ckpt_dir, out_path = sys.argv[1], sys.argv[2], sys.argv[3]
+
+
+class DS(paddle.io.Dataset):
+    def __getitem__(self, i):
+        rs = np.random.RandomState(i)
+        return rs.randn(4).astype("float32"), rs.randn(2).astype("float32")
+
+    def __len__(self):
+        return 16
+
+
+class Recorder(Callback):
+    def __init__(self):
+        super().__init__()
+        self.losses = []
+
+    def on_train_batch_end(self, step, logs=None):
+        self.losses.append(float(logs["loss"]))
+
+
+class SigtermSelf(Callback):
+    # SIGTERM this process mid-epoch (batch 6 of 8 = epoch 1, step 1)
+
+    def __init__(self, at=6):
+        super().__init__()
+        self.at = at
+        self.n = 0
+
+    def on_train_batch_begin(self, step, logs=None):
+        self.n += 1
+        if self.n == self.at:
+            os.kill(os.getpid(), signal.SIGTERM)
+
+
+paddle.seed(0)
+net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+model = Model(net)
+model.prepare(optimizer=paddle.optimizer.Adam(
+    parameters=model.parameters(), learning_rate=1e-2), loss=nn.MSELoss())
+
+rec = Recorder()
+ckpt = CheckpointCallback(ckpt_dir, data_seed=5)
+cbs = [rec, ckpt]
+resume = None
+if mode == "interrupt":
+    cbs.append(SigtermSelf())
+elif mode == "resume":
+    resume = "auto"
+
+model.fit(DS(), epochs=2, batch_size=4, verbose=0, shuffle=True,
+          callbacks=cbs, resume=resume)
+
+if mode == "interrupt":
+    assert ckpt.preempted, "SIGTERM did not convert into a preemption"
+    assert ckpt.saver.steps(), "no emergency checkpoint committed"
+if mode == "resume":
+    from paddle_tpu import observability as obs
+    assert obs.enabled(), "PADDLE_TPU_TELEMETRY=1 must bootstrap telemetry"
+
+with open(out_path, "w") as f:
+    json.dump(rec.losses, f)
+print(f"chaos child [{mode}]: {len(rec.losses)} batches", file=sys.stderr)
+"""
+
+
+def _run_child(mode: str, ckpt_dir: str, out: str, env, root) -> int:
+    return subprocess.call(
+        [sys.executable, "-c", CHILD, mode, ckpt_dir, out],
+        env=env, cwd=root)
+
+
+def chaos_lane(env, root) -> int:
+    with tempfile.TemporaryDirectory() as tmp:
+        ref, p1, p2 = (os.path.join(tmp, n) for n in
+                       ("ref.json", "part1.json", "part2.json"))
+        if _run_child("ref", os.path.join(tmp, "ck_ref"), ref, env, root):
+            print("chaos lane: ref run FAILED", file=sys.stderr)
+            return 1
+        ck = os.path.join(tmp, "ck")
+        if _run_child("interrupt", ck, p1, env, root):
+            print("chaos lane: interrupted run FAILED", file=sys.stderr)
+            return 1
+        if _run_child("resume", ck, p2, env, root):
+            print("chaos lane: resume run FAILED", file=sys.stderr)
+            return 1
+        losses_ref = json.load(open(ref))
+        losses_got = json.load(open(p1)) + json.load(open(p2))
+        if losses_got != losses_ref:
+            print("chaos lane: PARITY BROKE —\n"
+                  f"  ref    = {losses_ref}\n"
+                  f"  resume = {losses_got}", file=sys.stderr)
+            return 1
+        print(f"chaos lane ok: {len(json.load(open(p1)))} batches before "
+              f"SIGTERM + {len(json.load(open(p2)))} after resume == "
+              f"{len(losses_ref)} uninterrupted, bit-identical",
+              file=sys.stderr)
+        return 0
+
+
+def main() -> int:
+    explicit = bool(sys.argv[1:])
+    targets = sys.argv[1:] or DEFAULT_SUBSET
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "PADDLE_TPU_TELEMETRY": "1"})
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cmd = [sys.executable, "-m", "pytest", "-q", "-m", "not slow",
+           "-p", "no:cacheprovider", *targets]
+    print("chaos smoke subset:", " ".join(cmd), file=sys.stderr)
+    rc = subprocess.call(cmd, env=env, cwd=root)
+    if not explicit:
+        print("chaos smoke: SIGTERM/resume lane", file=sys.stderr)
+        lane_rc = chaos_lane(env, root)
+        if lane_rc != 0:
+            print("chaos lane FAILED", file=sys.stderr)
+        rc = rc or lane_rc
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

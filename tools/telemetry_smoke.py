@@ -32,6 +32,7 @@ DEFAULT_SUBSET = [
     "tests/test_checkpoint.py",
     "tests/test_distributed.py",
     "tests/test_serving.py",
+    "tests/test_robustness.py",
 ]
 
 # prefetch-on training lane: fit a tiny model THROUGH DevicePrefetcher with
