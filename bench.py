@@ -21,6 +21,17 @@ import traceback
 
 import numpy as np
 
+# CPU smoke runs get 2 simulated host devices so the cross-dp elastic
+# resume gate can build a real dp=2 mesh (must land before the backend
+# initializes; hardware runs don't set JAX_PLATFORMS=cpu and are
+# untouched)
+if os.environ.get("JAX_PLATFORMS", "").lower().startswith("cpu") and \
+        "xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") +
+        " --xla_force_host_platform_device_count=2").strip()
+
 # persistent compile cache: repeated bench runs (and the driver's final
 # run on this host) skip the 40-150s per-leg XLA compiles
 try:
@@ -148,13 +159,22 @@ def _input_overlap_block(step, batches, stacked=False, parity_make=None):
     return block
 
 
-def _checkpoint_block(step, batch, on_tpu):
+def _checkpoint_block(step, batch, on_tpu, make_step=None):
     """Checkpoint-overhead probe (ISSUE 5): host snapshot, async sharded
     write (CRC + COMMITTED marker), validated restore — the costs the
     preemption-safe training path adds per checkpoint — plus the CPU
     resume-parity gate: load_state_dict must reproduce the next steps'
-    losses bit-identically without adding a jit signature."""
+    losses bit-identically without adding a jit signature.
+
+    Elastic additions (ISSUE 6): `restore_reshard_ms` times the
+    load-with-relayout path (read + CRC on stored bytes + per-leaf
+    placement onto a target mesh), and — CPU with >=2 devices and a
+    `make_step(mesh=...)` factory — a cross-dp resume-parity gate: the
+    same checkpoint restored onto a dp=2 mesh must reproduce the next
+    steps' losses to tolerance with ZERO new jit signatures."""
     import tempfile
+
+    import numpy as _np
 
     from paddle_tpu.framework.checkpoint import AsyncCheckpointSaver
 
@@ -171,7 +191,20 @@ def _checkpoint_block(step, batch, on_tpu):
         t0 = time.perf_counter()
         _, restored = saver.restore_latest_valid()
         block["restore_ms"] = round(1e3 * (time.perf_counter() - t0), 2)
+        # elastic restore timing: relayout every leaf onto a mesh (the
+        # step's own, or a 1-device mesh when the step runs mesh-free)
+        import jax as _jax
+
+        import paddle_tpu.distributed as _dist
+        resh_mesh = step.mesh if step.mesh is not None else \
+            _dist.build_mesh([1], ["dp"], devices=_jax.devices()[:1])
+        t0 = time.perf_counter()
+        saver.restore(target_mesh=resh_mesh,
+                      target_specs=step.elastic_specs())
+        block["restore_reshard_ms"] = round(
+            1e3 * (time.perf_counter() - t0), 2)
         parity = None
+        tail_b = None
         if not on_tpu:
             sigs_before = len(step._jitted._signatures)
             tail_a = _loss_series([step(*batch) for _ in range(2)])
@@ -185,6 +218,29 @@ def _checkpoint_block(step, batch, on_tpu):
                     f"(signatures {sigs_before} -> "
                     f"{len(step._jitted._signatures)})")
         block["resume_parity"] = parity
+        # cross-dp elastic resume gate: restore the SAME checkpoint onto
+        # a dp=2 mesh and require the loss tail to match (cross-dp
+        # reduction order differs by ~1 ulp on CPU, hence tolerance — the
+        # relayout itself is byte-lossless, asserted in tests)
+        cross = None
+        cpu_devs = len([dev for dev in _jax.devices()
+                        if dev.platform == "cpu"])
+        if not on_tpu and make_step is not None and cpu_devs >= 2:
+            mesh2 = _dist.build_mesh([2], ["dp"])
+            step2 = make_step(mesh=mesh2)
+            _loss_series([step2(*batch)])  # compile BEFORE the restore
+            sigs = len(step2._jitted._signatures)
+            step2.load_state_dict(restored)
+            tail_c = _loss_series([step2(*batch) for _ in range(2)])
+            cross = (len(step2._jitted._signatures) == sigs and
+                     bool(_np.allclose(tail_c, tail_b,
+                                       rtol=1e-4, atol=1e-6)))
+            if not cross:
+                raise RuntimeError(
+                    f"cross-dp elastic resume parity broke: {tail_b} vs "
+                    f"{tail_c} (signatures {sigs} -> "
+                    f"{len(step2._jitted._signatures)})")
+        block["cross_dp_resume_parity"] = cross
     return block
 
 
@@ -209,14 +265,14 @@ def bench_gpt_small():
     cfg = gpt_config(name, max_position_embeddings=max(seq, 1024),
                      hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
 
-    def make_step():
+    def make_step(mesh=None):
         paddle.seed(0)
         m = build_gpt(cfg)
         o = paddle.optimizer.AdamW(learning_rate=1e-4,
                                    parameters=m.parameters(),
                                    weight_decay=0.01)
         return dist.make_train_step(
-            m, o, loss_fn=GPTPretrainingCriterion(),
+            m, o, loss_fn=GPTPretrainingCriterion(), mesh=mesh,
             compute_dtype="bfloat16" if on_tpu else None)
 
     step = make_step()
@@ -235,7 +291,8 @@ def bench_gpt_small():
     overlap = _input_overlap_block(
         step, [(x, y)] * (8 if on_tpu else 3),
         parity_make=None if on_tpu else make_step)
-    ckpt = _checkpoint_block(step, (x, y), on_tpu)
+    ckpt = _checkpoint_block(step, (x, y), on_tpu,
+                             make_step=None if on_tpu else make_step)
     return {
         "metric": f"gpt_{name}_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),

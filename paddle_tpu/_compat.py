@@ -1,0 +1,85 @@
+"""Compatibility shims over drifting jax APIs.
+
+The repo pins no jax version (the container bakes one in), and two public
+surfaces have moved across the releases this codebase meets in the wild:
+
+* ``shard_map`` graduated from ``jax.experimental.shard_map.shard_map``
+  to top-level ``jax.shard_map``; older jaxlibs only have the former,
+  newer ones deprecate (then remove) the experimental path.
+* ``Compiled.cost_analysis()`` returned a one-element ``list`` of dicts
+  for years before flattening to a plain ``dict``.
+
+Every in-tree caller (and the test suite) routes through this module so
+the drift is absorbed in ONE place instead of 20 call sites.
+"""
+from __future__ import annotations
+
+import jax
+
+__all__ = ["shard_map", "cost_analysis", "bound_axis_size"]
+
+
+def _resolve_shard_map():
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm, True
+    from jax.experimental.shard_map import shard_map as sm  # noqa: PLC0415
+    return sm, False
+
+
+_SHARD_MAP, _SHARD_MAP_IS_TOPLEVEL = _resolve_shard_map()
+
+
+def shard_map(f, mesh=None, in_specs=None, out_specs=None, **kwargs):
+    """``jax.shard_map`` with the historical keyword signature
+    (``mesh=``, ``in_specs=``, ``out_specs=``), resolved against whichever
+    spelling this jax provides.
+
+    The replication-check kwarg also drifted (``check_rep`` →
+    ``check_vma``); either name is accepted here and translated to the one
+    the resolved implementation understands.
+    """
+    check = kwargs.pop("check_vma", kwargs.pop("check_rep", None))
+    if _SHARD_MAP_IS_TOPLEVEL:
+        if check is not None:
+            kwargs["check_vma"] = check
+    else:
+        # the legacy checker can't infer replication through several
+        # collectives the modern one handles (psum_scatter, gathers…);
+        # callers written against the modern default would spuriously
+        # fail it, so it is off unless explicitly requested
+        kwargs["check_rep"] = bool(check) if check is not None else False
+    return _SHARD_MAP(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      **kwargs)
+
+
+def bound_axis_size(name) -> int | None:
+    """Size of SPMD axis `name` when it is bound in the current trace
+    (i.e. inside shard_map over a mesh that has the axis), else None.
+
+    ``jax.lax.axis_size`` only exists on newer jax; older releases expose
+    the same information through ``jax.core.axis_frame``.
+    """
+    if name is None:
+        return None
+    size_fn = getattr(jax.lax, "axis_size", None)
+    if size_fn is not None:
+        try:
+            return int(size_fn(name))
+        except Exception:  # noqa: BLE001 — unbound axis, any spelling
+            return None
+    try:
+        frame = jax.core.axis_frame(name)
+        # an int on some releases, a frame object with .size on others
+        return int(getattr(frame, "size", frame))
+    except Exception:  # noqa: BLE001 — unbound axis / API moved again
+        return None
+
+
+def cost_analysis(compiled) -> dict:
+    """``compiled.cost_analysis()`` as a dict on every jax version (older
+    releases wrap the per-computation dict in a list)."""
+    cost = compiled.cost_analysis()
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    return dict(cost or {})

@@ -67,15 +67,8 @@ def get_global_mesh() -> Mesh | None:
 def axis_bound(name) -> bool:
     """True when `name` is a bound SPMD axis in the current trace (i.e. we are
     inside shard_map over a mesh that has this axis)."""
-    if name is None:
-        return False
-    try:
-        jax.lax.axis_size(name)
-        return True
-    except (NameError, KeyError, ValueError, TypeError):
-        return False
-    except Exception:
-        return False
+    from .._compat import bound_axis_size
+    return bound_axis_size(name) is not None
 
 
 def put_global(value, sharding):

@@ -825,45 +825,234 @@ class ShardedTrainStep:
         return Tensor(losses, _internal=True)
 
     # -- checkpoint / preemption ---------------------------------------------
+    def _flat_names(self) -> set:
+        return {k for segs in (self._flat_segs or {}).values()
+                for (k, _, _, _) in segs}
+
+    def _unpack_flat_tree(self, tree: dict) -> dict:
+        """Host copy of a ``{key: array}`` tree with the fused flat
+        buffers sliced back into NAMED per-param arrays — the canonical,
+        topology-independent checkpoint layout.  Slicing + later
+        re-concatenation is byte-lossless: the alignment gaps are zeros at
+        init and every element-wise optimizer keeps them zero (zero grad,
+        zero moments → zero update)."""
+        named = {k: v for k, v in tree.items()
+                 if not k.startswith("__flat_")}
+        for dt, segs in (self._flat_segs or {}).items():
+            buf = np.asarray(tree[self._flat_key(dt)])
+            for k, off, size, shape in segs:
+                named[k] = buf[off:off + size].reshape(shape)
+        return named
+
+    def _pack_flat_tree(self, named: dict) -> dict:
+        """Inverse of :meth:`_unpack_flat_tree`: named host arrays back
+        into the fused flat buffers this step's layout wants (alignment
+        gaps zero-filled)."""
+        flat_names = self._flat_names()
+        out = {k: v for k, v in named.items() if k not in flat_names}
+        for dt, segs in self._flat_segs.items():
+            buf = np.zeros((self._flat_len[dt],), np.dtype(dt))
+            for k, off, size, shape in segs:
+                buf[off:off + size] = np.asarray(named[k]).reshape(-1)
+            out[self._flat_key(dt)] = buf
+        return out
+
     def state_dict(self) -> dict:
         """Host snapshot of the full train state (params, slots, buffers,
         step, RNG key) + the optimizer step count — everything a fresh
         process needs to continue bit-identically.  The tree round-trips
-        through ``framework.checkpoint.save_sharded``."""
+        through ``framework.checkpoint.save_sharded``.
+
+        The layout is CANONICAL — named per-param leaves at their global
+        shapes, regardless of this step's mesh or fused-flat-store layout
+        — so the same checkpoint restores onto any topology (elastic
+        resume, serving replicas at a different mp degree...).  A ``meta``
+        block records the source topology for diagnostics."""
         import jax
+
+        from ..framework.checkpoint import mesh_axes_of
         tree = self.state.tree()
         host = jax.device_get({"params": tree["params"],
                                "slots": tree["slots"],
                                "buffers": tree["buffers"]})
+        if self._flat_segs:
+            host["params"] = self._unpack_flat_tree(host["params"])
+            slots = {k: d for k, d in host["slots"].items()
+                     if not k.startswith("__flat_")}
+            for dt, segs in self._flat_segs.items():
+                per_slot = host["slots"][self._flat_key(dt)]
+                for s, buf in per_slot.items():
+                    buf = np.asarray(buf)
+                    if buf.shape != (self._flat_len[dt],):
+                        raise ValueError(
+                            f"optimizer slot {s!r} of the fused flat store "
+                            f"has shape {buf.shape}; cannot split it into "
+                            "per-param leaves for the canonical checkpoint")
+                    for k, off, size, shape in segs:
+                        slots.setdefault(k, {})[s] = \
+                            buf[off:off + size].reshape(shape)
+            host["slots"] = slots
         host["step"] = np.asarray(jax.device_get(tree["step"]))
         host["rng_key"] = np.asarray(
             jax.device_get(jax.random.key_data(tree["rng"])))
         host["opt_step_count"] = np.asarray(self.optimizer._step_count,
                                             np.int64)
+        host["meta"] = {"format": "train_state_v2",
+                        "mesh": {k: int(v) for k, v in
+                                 mesh_axes_of(self.mesh).items()}}
         return host
+
+    def elastic_specs(self):
+        """``(key, shape) -> PartitionSpec`` over canonical checkpoint
+        keys (``params/<name>``, ``slots/<name>/<slot>``, ...) — feed it
+        to ``load_sharded(..., target_mesh=step.mesh,
+        target_specs=step.elastic_specs())`` to stream a checkpoint
+        directly into this step's layout."""
+        from jax.sharding import PartitionSpec as _P
+
+        def spec_of(key, shape):
+            if self.mesh is None:
+                # a mesh-free step holds everything replicated; its raw
+                # mpu tags were never cleaned against a mesh
+                return _P()
+            parts = key.split("/")
+            if parts[0] == "params" and len(parts) >= 2:
+                name = "/".join(parts[1:])
+                spec = self._specs.get(name)
+            elif parts[0] == "slots" and len(parts) >= 3:
+                name = "/".join(parts[1:-1])
+                spec = self._slot_specs.get(name)
+                if name in self._entries and \
+                        tuple(shape) != tuple(self._entries[name].shape):
+                    spec = _P()
+            else:
+                spec = _P()
+            return spec if spec is not None else _P()
+        return spec_of
+
+    def _canonical_source(self, state: dict, section: str) -> dict:
+        """Normalize one checkpoint section to named leaves.  Fused-flat
+        sources are only decodable with this step's own segment table
+        (same process / same packing); a foreign flat checkpoint predates
+        the canonical format and cannot be resharded."""
+        from ..framework.checkpoint import ElasticReshardError, mesh_axes_of
+        tree = state.get(section, {})
+        flat_keys = [k for k in tree if k.startswith("__flat_")]
+        if not flat_keys:
+            return tree
+        if self._flat_segs and all(
+                self._flat_key(dt) in tree for dt in self._flat_segs):
+            return tree  # same-layout legacy snapshot: restore directly
+        raise ElasticReshardError(
+            f"checkpoint {section!r} holds fused flat leaves {flat_keys} "
+            "written by an incompatible (pre-canonical) layout; it cannot "
+            "be restored onto this topology "
+            f"{mesh_axes_of(self.mesh) or '(no mesh)'}",
+            leaf=flat_keys[0], mesh_axes=mesh_axes_of(self.mesh))
 
     def load_state_dict(self, state: dict):
         """Restore a :meth:`state_dict` snapshot (possibly loaded through
-        ``load_sharded``, i.e. leaves may be Tensors).  Every array keeps
-        its existing shape/dtype/sharding, so the already-compiled step
-        keeps its ONE jit signature — resume never pays a retrace."""
+        ``load_sharded``, i.e. leaves may be Tensors) — from THIS topology
+        or any other.  Stored leaves are global (canonical named) arrays,
+        so a cross-mesh restore is a pure relayout: every target array
+        keeps the shape/dtype/sharding the step compiled with, and resume
+        adds ZERO jit signatures on the target mesh.
+
+        Raises :class:`~paddle_tpu.framework.checkpoint.ElasticReshardError`
+        naming the leaf and both topologies when the state tree does not
+        match (missing leaf, global-shape mismatch); the failure leaves
+        the current train state AND the checkpoint untouched."""
+        from ..framework.checkpoint import ElasticReshardError, mesh_axes_of
+        from ..testing import faults
+
         def as_np(v):
             return np.asarray(v.numpy() if isinstance(v, Tensor) else v)
 
+        meta = state.get("meta", {})
+        src_axes = {k: int(as_np(v)) for k, v in
+                    dict(meta.get("mesh", {})).items()}
+        tgt_axes = mesh_axes_of(self.mesh)
+
+        def expect(tree, key, like, section):
+            if key not in tree:
+                raise ElasticReshardError(
+                    f"elastic restore: {section} leaf {key!r} is missing "
+                    f"from the checkpoint (source mesh {src_axes or None}, "
+                    f"target mesh {tgt_axes or None})", leaf=key,
+                    mesh_axes=tgt_axes)
+            arr = as_np(tree[key])
+            if tuple(arr.shape) != tuple(np.shape(like)):
+                raise ElasticReshardError(
+                    f"elastic restore: {section} leaf {key!r} has global "
+                    f"shape {tuple(arr.shape)} but this step needs "
+                    f"{tuple(np.shape(like))} (source mesh "
+                    f"{src_axes or None}, target mesh {tgt_axes or None})",
+                    leaf=key, mesh_axes=tgt_axes)
+            return arr
+
         cur = self.state
-        params = {k: jnp.asarray(as_np(state["params"][k]), v.dtype)
+        src_params = self._canonical_source(state, "params")
+        src_slots = self._canonical_source(state, "slots")
+        src_buffers = state.get("buffers", {})
+        legacy_flat = any(k.startswith("__flat_") for k in src_params)
+
+        if self._flat_segs and not legacy_flat:
+            # target uses the fused flat store: validate against the NAMED
+            # entry shapes, then re-pack into this step's flat layout
+            named = {k: expect(src_params, k, self._entries[k]._value,
+                               "params")
+                     for k in self.param_names}
+            params_np = self._pack_flat_tree(named)
+            flat_names = self._flat_names()
+            slot_names = {s for d in cur.slots.values() for s in d}
+            slot_named = {k: {s: expect(src_slots.get(k, {}), s,
+                                        self._entries[k]._value,
+                                        f"slots/{k}")
+                              for s in slot_names}
+                          for k in flat_names}
+            slots_np = {}
+            for fk, d in cur.slots.items():
+                if fk.startswith("__flat_"):
+                    dt = fk[len("__flat_"):]
+                    for s, v in d.items():
+                        buf = np.zeros((self._flat_len[dt],), np.dtype(dt))
+                        for k, off, size, shape in self._flat_segs[dt]:
+                            buf[off:off + size] = \
+                                np.asarray(slot_named[k][s]).reshape(-1)
+                        slots_np.setdefault(fk, {})[s] = buf
+                else:
+                    slots_np[fk] = {s: expect(src_slots.get(fk, {}), s, v,
+                                              f"slots/{fk}")
+                                    for s, v in d.items()}
+        else:
+            params_np = {k: expect(src_params, k, v, "params")
+                         for k, v in cur.params.items()}
+            slots_np = {k: {s: expect(src_slots.get(k, {}), s, v,
+                                      f"slots/{k}")
+                            for s, v in d.items()}
+                        for k, d in cur.slots.items()}
+
+        buffers_np = {k: expect(src_buffers, k, v, "buffers")
+                      for k, v in cur.buffers.items()}
+
+        faults.fault_point("restore.relayout", mesh=str(tgt_axes or None))
+        params = {k: jnp.asarray(params_np[k], v.dtype)
                   for k, v in cur.params.items()}
-        slots = {k: {s: jnp.asarray(as_np(state["slots"][k][s]), v.dtype)
+        slots = {k: {s: jnp.asarray(slots_np[k][s], v.dtype)
                      for s, v in d.items()}
                  for k, d in cur.slots.items()}
-        buffers = {k: jnp.asarray(as_np(state["buffers"][k]), v.dtype)
+        buffers = {k: jnp.asarray(buffers_np[k], v.dtype)
                    for k, v in cur.buffers.items()}
         step = jnp.asarray(int(as_np(state["step"])), jnp.int32)
+        faults.fault_point("restore.rng")
         rng = jax.random.wrap_key_data(
             jnp.asarray(as_np(state["rng_key"]), jnp.uint32))
-        self.state = TrainState(params, slots, buffers, step, rng)
+        new_state = TrainState(params, slots, buffers, step, rng)
         if self.mesh is not None:
-            self.state = self._shard_state(self.state)
+            new_state = self._shard_state(new_state)
+        # commit point: nothing above mutated self — a failed elastic
+        # restore leaves the running state exactly as it was
+        self.state = new_state
         self.optimizer._step_count = int(as_np(state["opt_step_count"]))
 
     def attach_saver(self, saver):
@@ -884,7 +1073,8 @@ class ShardedTrainStep:
         step_no = int(self.optimizer._step_count)
         with _trace.span("checkpoint.emergency", step=step_no):
             self._saver.save(self.state_dict(), step=step_no, blocking=True)
-        preemption.mark_saved(step_no)
+        from ..framework.checkpoint import mesh_axes_of
+        preemption.mark_saved(step_no, topology=mesh_axes_of(self.mesh))
         raise preemption.TrainingPreempted(step_no)
 
     def sync_to_model(self):

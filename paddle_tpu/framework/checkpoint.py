@@ -60,6 +60,42 @@ class CheckpointCorruptError(RuntimeError):
         self.leaf = leaf
 
 
+class ElasticReshardError(RuntimeError):
+    """An elastic (cross-topology) restore could not lay a stored leaf out
+    on the target mesh — shape not divisible by the requested axes, a spec
+    naming an axis the mesh doesn't have, or a source/target state-tree
+    mismatch.  The checkpoint itself is NOT corrupt: callers must never
+    quarantine or otherwise mutate the checkpoint dir on this error."""
+
+    def __init__(self, msg: str, leaf: str | None = None,
+                 spec=None, mesh_axes: dict | None = None):
+        super().__init__(msg)
+        self.leaf = leaf
+        self.spec = spec
+        self.mesh_axes = dict(mesh_axes or {})
+
+
+class ElasticResumeError(RuntimeError):
+    """A world-size-aware resume could not map the checkpoint's global
+    sample offset onto the new topology (offset not divisible by the new
+    global batch).  The checkpoint is intact — pick a compatible
+    batch-size x dp-world product, or resume on the original topology."""
+
+    def __init__(self, msg: str, samples: int | None = None,
+                 global_batch_size: int | None = None):
+        super().__init__(msg)
+        self.samples = samples
+        self.global_batch_size = global_batch_size
+
+
+def mesh_axes_of(mesh) -> dict:
+    """``{axis_name: size}`` of a Mesh — the topology fingerprint stored
+    in train-state checkpoints and quoted by elastic-restore errors."""
+    if mesh is None:
+        return {}
+    return {str(a): int(mesh.shape[a]) for a in mesh.axis_names}
+
+
 def _to_numpy_tree(state):
     out = {}
     for k, v in state.items():
@@ -73,7 +109,7 @@ def _to_numpy_tree(state):
             arr = np.asarray(v)
             # non-numeric leaves (strings, python objects) stay as-is and go
             # into the manifest as JSON
-            out[k] = arr if arr.dtype != object else v
+            out[k] = arr if arr.dtype.kind not in "USO" else v
     return out
 
 
@@ -199,13 +235,71 @@ def _save_sharded(state: dict, dirname: str, _sp=None) -> None:
         shutil.rmtree(old, ignore_errors=True)
 
 
+def _validate_reshard_spec(key, shape, spec, mesh):
+    """Raise :class:`ElasticReshardError` when `spec` cannot lay an array
+    of `shape` out over `mesh` — the typed error names the leaf AND the
+    leaf/mesh mismatch so a mis-targeted elastic restore is diagnosable
+    without reading shard dumps."""
+    axes = mesh_axes_of(mesh)
+    entries = list(spec) if spec is not None else []
+    if len(entries) > len(shape):
+        raise ElasticReshardError(
+            f"elastic restore: leaf {key!r} of shape {tuple(shape)} got "
+            f"spec {spec} with more entries than dims", leaf=key, spec=spec,
+            mesh_axes=axes)
+    for dim, entry in enumerate(entries):
+        names = entry if isinstance(entry, tuple) else (entry,)
+        factor = 1
+        for name in names:
+            if name is None:
+                continue
+            if name not in axes:
+                raise ElasticReshardError(
+                    f"elastic restore: leaf {key!r} spec {spec} names mesh "
+                    f"axis {name!r} but the target mesh only has "
+                    f"{axes}", leaf=key, spec=spec, mesh_axes=axes)
+            factor *= axes[name]
+        if factor > 1 and shape[dim] % factor:
+            raise ElasticReshardError(
+                f"elastic restore: leaf {key!r} dim {dim} of size "
+                f"{shape[dim]} is not divisible by mesh axes "
+                f"{[n for n in names if n]} (x{factor}) on target mesh "
+                f"{axes}", leaf=key, spec=spec, mesh_axes=axes)
+
+
+def _relayout(key, arr, spec, mesh):
+    """Host array -> device array laid out as `spec` over `mesh` (the
+    host-side gather/reslice of an elastic restore: stored bytes are the
+    GLOBAL array, so any target layout is a pure placement)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from ..distributed import mesh as mesh_mod
+    spec = spec if spec is not None else PartitionSpec()
+    _validate_reshard_spec(key, arr.shape, spec, mesh)
+    faults.fault_point("restore.relayout", leaf=key)
+    return mesh_mod.put_global(arr, NamedSharding(mesh, spec))
+
+
 def load_sharded(dirname: str, return_numpy: bool = False,
-                 verify: bool = True) -> dict:
+                 verify: bool = True, target_mesh=None,
+                 target_specs=None) -> dict:
     """Load a sharded checkpoint; with `verify` (default) requires the
     COMMITTED marker and checks every leaf's CRC32, raising
-    :class:`CheckpointCorruptError` naming the offending leaf."""
+    :class:`CheckpointCorruptError` naming the offending leaf.
+
+    Elastic path: with `target_mesh`, every array leaf is re-laid-out onto
+    that mesh after validation — `target_specs` maps flattened keys (e.g.
+    ``"params/linear_0.w_0"``) to PartitionSpecs (or is a callable
+    ``(key, shape) -> spec``); unmapped leaves are replicated.  CRC
+    verification always runs on the STORED bytes before any relayout, and
+    a relayout failure (:class:`ElasticReshardError`) leaves the
+    checkpoint dir untouched."""
     from ..observability import trace as _trace
-    with _trace.span("checkpoint.load", dir=dirname) as sp:
+    if target_mesh is not None and return_numpy:
+        raise ValueError("return_numpy=True and target_mesh are exclusive "
+                         "(a relayout result is a device array)")
+    with _trace.span("checkpoint.load", dir=dirname,
+                     elastic=target_mesh is not None) as sp:
         mpath = os.path.join(dirname, _MANIFEST)
         if not os.path.isfile(mpath):
             raise CheckpointCorruptError(
@@ -221,9 +315,11 @@ def load_sharded(dirname: str, return_numpy: bool = False,
             raise CheckpointCorruptError(
                 f"checkpoint {dirname!r} manifest unreadable: {e}",
                 dirname=dirname)
-        flat = {}
+        # phase 1 — read + CRC-verify every leaf from the stored bytes
+        arrays = {}
         for key, meta in meta_all["tensors"].items():
             fpath = os.path.join(dirname, meta["file"])
+            faults.fault_point("restore.read", path=fpath, leaf=key)
             try:
                 arr = np.load(fpath)
             except (OSError, ValueError, EOFError) as e:
@@ -234,7 +330,24 @@ def load_sharded(dirname: str, return_numpy: bool = False,
                 raise CheckpointCorruptError(
                     f"checkpoint leaf {key!r} failed CRC32 validation "
                     f"({meta['file']})", dirname=dirname, leaf=key)
-            flat[key] = arr if return_numpy else Tensor(arr)
+            arrays[key] = arr
+        # phase 2 — optional relayout onto the target mesh (validation
+        # first for every leaf, so a mismatch raises before any device
+        # placement happens)
+        flat = {}
+        if target_mesh is not None:
+            if callable(target_specs):
+                spec_of = target_specs
+            else:
+                specs = dict(target_specs or {})
+                spec_of = lambda key, shape: specs.get(key)  # noqa: E731
+            for key, arr in arrays.items():
+                flat[key] = Tensor(
+                    _relayout(key, arr, spec_of(key, arr.shape),
+                              target_mesh), _internal=True)
+        else:
+            for key, arr in arrays.items():
+                flat[key] = arr if return_numpy else Tensor(arr)
         flat.update(meta_all.get("scalars", {}))
         sp.attrs["leaves"] = len(flat)
         return _unflatten(flat)
@@ -377,7 +490,8 @@ class AsyncCheckpointSaver:
         steps = self.steps()
         return steps[-1] if steps else None
 
-    def restore(self, step=None, return_numpy=False):
+    def restore(self, step=None, return_numpy=False, target_mesh=None,
+                target_specs=None):
         step = step if step is not None else self.latest_step()
         if step is None:
             return None
@@ -387,18 +501,33 @@ class AsyncCheckpointSaver:
                 local = os.path.join(tmp, f"step_{step}")
                 self._retry(self._fs.download, self._step_dir(step), local,
                             name="fs.download")
-                return load_sharded(local, return_numpy)
-        return load_sharded(self._step_dir(step), return_numpy)
+                return load_sharded(local, return_numpy,
+                                    target_mesh=target_mesh,
+                                    target_specs=target_specs)
+        return load_sharded(self._step_dir(step), return_numpy,
+                            target_mesh=target_mesh,
+                            target_specs=target_specs)
 
-    def restore_latest_valid(self, return_numpy=False):
+    def restore_latest_valid(self, return_numpy=False, target_mesh=None,
+                             target_specs=None):
         """Walk backward from the newest committed step past anything that
         fails validation, quarantining bad dirs (``<dir>.corrupt``) with a
         flight event.  Returns ``(step, state)`` or ``(None, None)`` when
-        no valid checkpoint exists."""
+        no valid checkpoint exists.
+
+        Elastic failures are different: an :class:`ElasticReshardError`
+        (or an injected restore fault) means the CHECKPOINT is fine and
+        the restore request is wrong — it re-raises immediately and never
+        quarantines, so a failed elastic restore leaves the checkpoint dir
+        untouched."""
         from ..observability import flight, registry
         for step in reversed(self.steps()):
             try:
-                return step, self.restore(step, return_numpy)
+                return step, self.restore(step, return_numpy,
+                                          target_mesh=target_mesh,
+                                          target_specs=target_specs)
+            except (ElasticReshardError, faults.FaultInjected):
+                raise  # not a corrupt dir: never quarantine
             except Exception as e:  # noqa: BLE001 — any broken dir: skip it
                 flight.record("checkpoint", "quarantine", step=int(step),
                               dir=self._step_dir(step),

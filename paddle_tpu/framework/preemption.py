@@ -69,12 +69,18 @@ def clear():
     _last_saved_step = None
 
 
-def mark_saved(step: int):
+def mark_saved(step: int, topology: dict | None = None):
     """Train loops call this right after the emergency checkpoint commits
-    (flight event + bookkeeping for tests/operators)."""
+    (flight event + bookkeeping for tests/operators).  `topology` is the
+    writer's mesh axes (``{"dp": 2, "mp": 4}``) — recorded so a resume on
+    a DIFFERENT mesh (elastic restart) can be traced back to the topology
+    that wrote the emergency checkpoint."""
     global _last_saved_step
     _last_saved_step = int(step)
-    flight.record("preemption", "emergency_checkpoint", step=int(step))
+    attrs = {"step": int(step)}
+    if topology:
+        attrs["topology"] = str(topology)
+    flight.record("preemption", "emergency_checkpoint", **attrs)
 
 
 def last_saved_step() -> int | None:

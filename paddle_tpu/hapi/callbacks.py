@@ -274,6 +274,8 @@ def restore_checkpoint_state(model, state) -> dict:
         model._optimizer.set_state_dict(opt_state)
     train = state.get("train", {})
     if "rng_key" in train:
+        from ..testing import faults
+        faults.fault_point("restore.rng")
         raw = train["rng_key"]
         raw = raw.numpy() if hasattr(raw, "numpy") else raw
         key = jax.random.wrap_key_data(
@@ -298,10 +300,19 @@ class CheckpointCallback(Callback):
     preemption path — a *blocking* emergency save at the first step
     boundary after ``framework.preemption`` flags a SIGTERM, after which
     ``model.stop_training`` ends the run cleanly.
+
+    World-size awareness (elastic resume, ISSUE 6): ``dp_world_size`` is
+    the data-parallel replica count this rank trains in (default: the
+    launcher env / jax process count).  The ``train`` block then records
+    the GLOBAL sample offset of the epoch (``samples_in_epoch`` =
+    steps x per-rank batch x dp world) instead of only the per-rank step
+    index, so ``Model.fit(resume=...)`` on a DIFFERENT topology can
+    recompute the skip prefix in its own step units and preserve the
+    global sample order.
     """
 
     def __init__(self, save_dir, save_freq=1, every_n_steps=None,
-                 keep_last=3, fs=None, data_seed=0):
+                 keep_last=3, fs=None, data_seed=0, dp_world_size=None):
         super().__init__()
         from ..framework.checkpoint import AsyncCheckpointSaver
         self.saver = AsyncCheckpointSaver(save_dir, keep_last=keep_last,
@@ -309,6 +320,10 @@ class CheckpointCallback(Callback):
         self.save_freq = save_freq
         self.every_n_steps = every_n_steps
         self.data_seed = int(data_seed)
+        if dp_world_size is None:
+            from ..parallel import env as dist_env
+            dp_world_size = max(1, dist_env.get_world_size())
+        self.dp_world_size = int(dp_world_size)
         self.preempted = False
         self._epoch = 0
         self._global_step = 0
@@ -319,12 +334,21 @@ class CheckpointCallback(Callback):
 
         from ..core import random as random_mod
         key, counter = random_mod.get_rng_state()
-        return {"epoch": int(epoch), "step_in_epoch": int(step_in_epoch),
-                "opt_step_count": int(getattr(
-                    self.model._optimizer, "_step_count", 0)),
-                "rng_key": np.asarray(jax.random.key_data(key)),
-                "rng_counter": int(counter),
-                "data_seed": self.data_seed}
+        block = {"epoch": int(epoch), "step_in_epoch": int(step_in_epoch),
+                 "opt_step_count": int(getattr(
+                     self.model._optimizer, "_step_count", 0)),
+                 "rng_key": np.asarray(jax.random.key_data(key)),
+                 "rng_counter": int(counter),
+                 "data_seed": self.data_seed,
+                 "dp_world_size": self.dp_world_size}
+        per_rank_bs = self.params.get("batch_size")
+        if per_rank_bs:
+            # global offsets, not per-rank steps: the resume topology may
+            # run a different dp world size / per-rank batch
+            gbs = int(per_rank_bs) * self.dp_world_size
+            block["global_batch_size"] = gbs
+            block["samples_in_epoch"] = int(step_in_epoch) * gbs
+        return block
 
     def _save(self, epoch, step_in_epoch, blocking=False):
         state = {"model": self.model.network.state_dict(),

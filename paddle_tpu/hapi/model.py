@@ -216,8 +216,14 @@ class Model:
                               prefetch_depth=prefetch_depth)
         eval_loader = self._loader(eval_data, batch_size, False, num_workers)
         steps = len(loader) if hasattr(loader, "__len__") else None
+        # per-rank batch size (world-size-aware checkpoints record global
+        # sample offsets = steps x this x dp world); a pre-built loader
+        # (or the one inside a DevicePrefetcher) carries its own
+        per_rank_bs = getattr(loader, "batch_size", None) or getattr(
+            getattr(loader, "data", None), "batch_size", None)
         cbks = callbacks_mod.config_callbacks(
             callbacks, model=self, epochs=epochs, steps=steps,
+            batch_size=per_rank_bs,
             log_freq=log_freq, verbose=verbose, save_freq=save_freq,
             save_dir=save_dir, metrics=self._metrics)
         ckpt_cb = next((c for c in cbks.callbacks if isinstance(
@@ -225,7 +231,7 @@ class Model:
         start_epoch = start_step = 0
         if resume:
             start_epoch, start_step = self._restore_for_resume(
-                resume, ckpt_cb)
+                resume, ckpt_cb, per_rank_bs)
 
         from ..framework import preemption
         self.stop_training = False
@@ -272,11 +278,21 @@ class Model:
                     cbks.on_eval_end(eval_logs)
         cbks.on_train_end({})
 
-    def _restore_for_resume(self, resume, ckpt_cb):
+    def _restore_for_resume(self, resume, ckpt_cb, per_rank_bs=None):
         """Resolve `resume` ("auto" | checkpoint dir) to a restored state;
-        returns (start_epoch, start_step_in_epoch)."""
+        returns (start_epoch, start_step_in_epoch).
+
+        World-size-aware (elastic) resume: when the checkpoint carries a
+        global sample offset (``samples_in_epoch``) and this run's global
+        batch (per-rank batch x the CheckpointCallback's ``dp_world_size``)
+        is known, the skip prefix is recomputed in the NEW topology's step
+        units — the epoch permutation is drawn dataset-level from
+        ``data_seed + epoch``, so the global sample order is preserved
+        across a dp world-size change.  A sample offset the new global
+        batch cannot hit raises :class:`ElasticResumeError` instead of
+        silently replaying from a misaligned sample."""
         from ..framework.checkpoint import (AsyncCheckpointSaver, _MANIFEST,
-                                            load_sharded)
+                                            ElasticResumeError, load_sharded)
         if resume == "auto":
             if ckpt_cb is None:
                 raise ValueError(
@@ -295,7 +311,23 @@ class Model:
                     f"no valid checkpoint under {resume!r}")
         train = (ckpt_cb.restore_into(state) if ckpt_cb is not None
                  else callbacks_mod.restore_checkpoint_state(self, state))
-        return int(train.get("epoch", 0)), int(train.get("step_in_epoch", 0))
+        start_epoch = int(train.get("epoch", 0))
+        start_step = int(train.get("step_in_epoch", 0))
+        samples = train.get("samples_in_epoch")
+        if samples is not None and ckpt_cb is not None and per_rank_bs:
+            new_global = int(per_rank_bs) * ckpt_cb.dp_world_size
+            samples = int(samples)
+            if samples % new_global:
+                raise ElasticResumeError(
+                    f"elastic resume: checkpoint stopped at global sample "
+                    f"offset {samples} of the epoch (written at global "
+                    f"batch {train.get('global_batch_size')}, dp world "
+                    f"{train.get('dp_world_size')}), which this topology's "
+                    f"global batch {new_global} (= {per_rank_bs} x dp "
+                    f"world {ckpt_cb.dp_world_size}) cannot reach",
+                    samples=samples, global_batch_size=new_global)
+            start_step = samples // new_global
+        return start_epoch, start_step
 
     def _pack_logs(self, res):
         logs = {}

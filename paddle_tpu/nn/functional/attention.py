@@ -99,8 +99,9 @@ def _flash_spmd(q, k, v, causal, scale):
     def local(qv, kv, vv):
         return flash_attention_bthd(qv, kv, vv, causal=causal, scale=scale)
 
-    return jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec, check_vma=False)(q, k, v)
+    from ..._compat import shard_map
+    return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, check_vma=False)(q, k, v)
 
 
 @defop
@@ -177,8 +178,9 @@ def _fused_flash_spmd(qkv, causal, scale):
     import jax
     in_spec = P(batch if batch else None, None, heads, None, None)
     out_spec = P(batch if batch else None, None, heads)
-    return jax.shard_map(local, mesh=mesh, in_specs=(in_spec,),
-                         out_specs=out_spec, check_vma=False)(qkv)
+    from ..._compat import shard_map
+    return shard_map(local, mesh=mesh, in_specs=(in_spec,),
+                     out_specs=out_spec, check_vma=False)(qkv)
 
 
 @defop

@@ -36,7 +36,13 @@ Fault points in the tree (see docs/robustness.md for the catalogue):
 ``checkpoint.write``, ``checkpoint.manifest``, ``checkpoint.commit``,
 ``checkpoint.promote``, ``checkpoint.upload``,
 ``checkpoint.upload_commit``, ``fs.upload``, ``fs.download``,
-``serving.scheduler``, ``train.step``.
+``serving.scheduler``, ``train.step``, and — the elastic-restore path
+(ISSUE 6) — ``restore.read`` (per-leaf checkpoint read, before CRC),
+``restore.relayout`` (before a leaf/state is laid out on the target
+mesh), ``restore.rng`` (RNG-key restore).  A fault anywhere along the
+restore path must leave BOTH the checkpoint dir and the running train
+state untouched — asserted by the elastic crash matrix in
+tests/test_elastic.py.
 """
 from __future__ import annotations
 

@@ -12,6 +12,7 @@ from jax.sharding import PartitionSpec as P
 import paddle_tpu as paddle
 import paddle_tpu.distributed as dist
 from paddle_tpu.distributed import fleet
+from paddle_tpu._compat import shard_map
 
 
 @pytest.fixture(autouse=True)
@@ -39,7 +40,7 @@ def test_all_reduce_in_program():
         t = paddle.to_tensor(x)
         return dist.all_reduce(t, group=g)._value
 
-    out = jax.shard_map(f, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))(data)
+    out = shard_map(f, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))(data)
     np.testing.assert_allclose(np.asarray(out)[0], np.full(4, sum(range(8))))
 
 
@@ -52,7 +53,7 @@ def test_all_reduce_max_in_program():
         return dist.all_reduce(paddle.to_tensor(x), op=dist.ReduceOp.MAX,
                                group=g)._value
 
-    out = jax.shard_map(f, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))(data)
+    out = shard_map(f, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))(data)
     assert np.asarray(out).max() == 7.0 and np.asarray(out).min() == 7.0
 
 
@@ -64,7 +65,7 @@ def test_all_gather_and_reduce_scatter():
     def gather(x):
         return dist.all_gather_concat(x, group=g, axis=0)
 
-    out = jax.shard_map(gather, mesh=mesh, in_specs=P("dp"),
+    out = shard_map(gather, mesh=mesh, in_specs=P("dp"),
                         out_specs=P("dp"))(data)
     # every rank's output is the full 8x2 → global stacked 64x2
     assert out.shape == (64, 2)
@@ -74,7 +75,7 @@ def test_all_gather_and_reduce_scatter():
         t = paddle.to_tensor(jnp.zeros((1, 2)))
         return dist.reduce_scatter(t, paddle.to_tensor(x), group=g)._value
 
-    out = jax.shard_map(rs, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))(
+    out = shard_map(rs, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))(
         jnp.ones((64, 2)))
     np.testing.assert_allclose(np.asarray(out), np.full((8, 2), 8.0))
 
@@ -87,7 +88,7 @@ def test_broadcast_in_program():
     def f(x):
         return dist.broadcast(paddle.to_tensor(x), src=3, group=g)._value
 
-    out = jax.shard_map(f, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))(data)
+    out = shard_map(f, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))(data)
     np.testing.assert_allclose(np.asarray(out), np.full((8, 1), 3.0))
 
 
@@ -100,7 +101,7 @@ def test_p2p_shift_ring():
         perm = [(i, (i + 1) % 8) for i in range(8)]
         return dist.p2p_shift(x, g, perm)
 
-    out = jax.shard_map(f, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))(data)
+    out = shard_map(f, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))(data)
     np.testing.assert_allclose(np.asarray(out)[:, 0],
                                np.roll(np.arange(8.0), 1))
 
@@ -175,7 +176,7 @@ def test_column_row_parallel_matches_dense():
         z = row(y)
         return z._value
 
-    out = jax.shard_map(
+    out = shard_map(
         f, mesh=mesh,
         in_specs=(P(None, "mp"), P("mp"), P("mp", None), P(None)),
         out_specs=P(None))(col.weight._value, col.bias._value,
@@ -197,7 +198,7 @@ def test_vocab_parallel_embedding():
         emb.weight._value = w
         return emb(paddle.to_tensor(i))._value
 
-    out = jax.shard_map(f, mesh=mesh, in_specs=(P("mp", None), P(None)),
+    out = shard_map(f, mesh=mesh, in_specs=(P("mp", None), P(None)),
                         out_specs=P(None))(emb.weight._value, idx)
     np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-6)
 
@@ -221,7 +222,7 @@ def test_parallel_cross_entropy():
     def f(lg, lb):
         return ce(paddle.to_tensor(lg), paddle.to_tensor(lb))._value
 
-    out = jax.shard_map(f, mesh=mesh, in_specs=(P(None, "mp"), P(None)),
+    out = shard_map(f, mesh=mesh, in_specs=(P(None, "mp"), P(None)),
                         out_specs=P(None))(logits, label)
     np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-5)
 
@@ -241,7 +242,7 @@ def test_tp_grad_pairing():
         return jax.grad(inner)(x)
 
     x = jnp.ones((8, 2))
-    out = jax.shard_map(f, mesh=mesh, in_specs=P("mp"), out_specs=P("mp"))(x)
+    out = shard_map(f, mesh=mesh, in_specs=P("mp"), out_specs=P("mp"))(x)
     # y = psum(x) = 8 per element (2 cols * ... wait per-element psum of ones=8)
     # d/dx sum(y^2) with bwd=identity → 2*y = 16
     np.testing.assert_allclose(np.asarray(out), np.full((8, 2), 16.0))
@@ -265,7 +266,7 @@ def test_rng_tracker_diverges_across_mp():
                     __import__("paddle_tpu").core.random.next_key(), (4,)))
         return x + noise._value
 
-    out = jax.shard_map(f, mesh=mesh, in_specs=P("mp"), out_specs=P("mp"))(
+    out = shard_map(f, mesh=mesh, in_specs=P("mp"), out_specs=P("mp"))(
         jnp.zeros((8, 4)))
     arr = np.asarray(out)
     # each mp shard drew from a rank-folded key → rows differ

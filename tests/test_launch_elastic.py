@@ -15,6 +15,7 @@ from paddle_tpu.distributed.fleet.elastic import (ElasticLevel,
                                                   ElasticManager,
                                                   ElasticStatus)
 from paddle_tpu.distributed.fleet.elastic.manager import _parse_np
+from paddle_tpu._compat import shard_map
 
 
 # -- TCPStore (native C++) ---------------------------------------------------
@@ -256,7 +257,7 @@ def test_stream_collectives_alias():
         return stream.all_reduce(paddle.to_tensor(x), group=g,
                                  use_calc_stream=True)._value
 
-    out = jax.shard_map(f, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))(data)
+    out = shard_map(f, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))(data)
     np.testing.assert_allclose(np.asarray(out), np.full((8, 1), 28.0))
     dist.collective.destroy_process_group()
     dist.set_global_mesh(None)

@@ -15,6 +15,7 @@ from paddle_tpu.incubate.distributed.models import moe
 from paddle_tpu.incubate.distributed.models.moe import (
     ClipGradForMOEByGlobalNorm, MoELayer, NaiveGate, SwitchGate, GShardGate,
     _limit_by_capacity, _number_count, _prune_gate_by_capacity)
+from paddle_tpu._compat import shard_map
 
 
 @pytest.fixture(autouse=True)
@@ -168,7 +169,7 @@ def test_moe_expert_parallel_identity_roundtrip():
         local.gate.gate.bias._replace_(gate_b, None)
         return local(paddle.to_tensor(x))._value
 
-    out = jax.shard_map(run, mesh=mesh, in_specs=P("ep"), out_specs=P("ep"),
+    out = shard_map(run, mesh=mesh, in_specs=P("ep"), out_specs=P("ep"),
                         check_vma=False)(jnp.asarray(x_np))
     np.testing.assert_allclose(np.asarray(out), x_np, rtol=1e-5, atol=1e-5)
 

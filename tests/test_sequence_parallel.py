@@ -11,6 +11,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from paddle_tpu.kernels.ring_attention import (
     ring_attention, ulysses_attention, _dense_attention)
+from paddle_tpu._compat import shard_map
 
 
 def _mesh(n=4):
@@ -32,7 +33,7 @@ def test_ring_attention_matches_dense(causal):
     def f(qs, ks, vs):
         return ring_attention(qs, ks, vs, axis_name="sep", causal=causal)
 
-    out = jax.jit(jax.shard_map(f, mesh=mesh, in_specs=(spec,) * 3,
+    out = jax.jit(shard_map(f, mesh=mesh, in_specs=(spec,) * 3,
                                 out_specs=spec))(q, k, v)
     ref = _dense_attention(q, k, v, causal, 1.0 / np.sqrt(q.shape[-1]))
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
@@ -48,7 +49,7 @@ def test_ulysses_matches_dense(causal):
     def f(qs, ks, vs):
         return ulysses_attention(qs, ks, vs, axis_name="sep", causal=causal)
 
-    out = jax.jit(jax.shard_map(f, mesh=mesh, in_specs=(spec,) * 3,
+    out = jax.jit(shard_map(f, mesh=mesh, in_specs=(spec,) * 3,
                                 out_specs=spec))(q, k, v)
     ref = _dense_attention(q, k, v, causal, 1.0 / np.sqrt(q.shape[-1]))
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
@@ -62,7 +63,7 @@ def test_ring_attention_grads_match_dense():
     scale = 1.0 / np.sqrt(q.shape[-1])
 
     def ring_loss(qs, ks, vs):
-        f = jax.shard_map(
+        f = shard_map(
             lambda a, b, c: ring_attention(a, b, c, axis_name="sep",
                                            causal=True),
             mesh=mesh, in_specs=(spec,) * 3, out_specs=spec)
@@ -95,7 +96,7 @@ def test_sdpa_routes_to_ring_under_sep():
             Tensor(vs, _internal=True), is_causal=True)
         return out._value if isinstance(out, Tensor) else out
 
-    out = jax.jit(jax.shard_map(f, mesh=mesh, in_specs=(spec,) * 3,
+    out = jax.jit(shard_map(f, mesh=mesh, in_specs=(spec,) * 3,
                                 out_specs=spec))(q, k, v)
     ref = _dense_attention(q, k, v, True, 1.0 / np.sqrt(q.shape[-1]))
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),

@@ -18,6 +18,13 @@ The lane runs three telemetry-on subprocesses over one checkpoint dir:
 The parent asserts completion and that ``interrupt + resume`` losses are
 bit-identical to ``ref`` — the acceptance criterion for preemption-safe
 training on CPU.
+
+A second, ELASTIC lane (ISSUE 6) runs the SPMD path across a topology
+change: a dp=2 process (2 simulated CPU devices via XLA_FLAGS) SIGTERMs
+itself mid-run, and a dp=1 process with a different device count resumes
+the same checkpoint through the elastic restore path — pre-kill losses
+bit-identical to the dp=2 reference, post-resume losses matching it to
+tolerance, zero new jit signatures on the target mesh.
 """
 from __future__ import annotations
 
@@ -30,6 +37,7 @@ import tempfile
 DEFAULT_SUBSET = [
     "tests/test_robustness.py",
     "tests/test_checkpoint.py",
+    "tests/test_elastic.py",
 ]
 
 CHILD = r"""
@@ -111,9 +119,81 @@ print(f"chaos child [{mode}]: {len(rec.losses)} batches", file=sys.stderr)
 """
 
 
+CHILD_MESH = r"""
+import json
+import os
+import signal
+import sys
+import time
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.distributed as dist
+from paddle_tpu.framework import preemption
+from paddle_tpu.framework.checkpoint import AsyncCheckpointSaver
+
+mode, ckpt_dir, out_path = sys.argv[1], sys.argv[2], sys.argv[3]
+dp = int(os.environ.get("CHAOS_MESH_DP", "1"))
+
+paddle.seed(0)
+net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+opt = paddle.optimizer.Adam(parameters=net.parameters(), learning_rate=1e-2)
+mesh = dist.build_mesh([dp], ["dp"]) if dp > 1 else None
+step = dist.make_train_step(net, opt, loss_fn=nn.MSELoss(), mesh=mesh)
+saver = AsyncCheckpointSaver(ckpt_dir)
+step.attach_saver(saver)
+
+rs = np.random.RandomState(0)
+batches = [(rs.randn(4, 4).astype("float32"),
+            rs.randn(4, 2).astype("float32")) for _ in range(8)]
+
+start = 0
+if mode == "mesh-resume":
+    # elastic restore: the checkpoint was written on a DIFFERENT mesh
+    st, snap = saver.restore_latest_valid()
+    assert snap is not None, "no checkpoint to resume from"
+    step.load_state_dict(snap)
+    start = step.optimizer._step_count
+
+losses = []
+with preemption.guard():
+    for i in range(start, len(batches)):
+        if mode == "mesh-interrupt" and i == 4:
+            os.kill(os.getpid(), signal.SIGTERM)  # a REAL preemption
+            for _ in range(400):
+                if preemption.requested():
+                    break
+                time.sleep(0.005)
+            assert preemption.requested(), "SIGTERM was not converted"
+        try:
+            losses.append(float(step(*batches[i])))
+        except preemption.TrainingPreempted:
+            break
+
+if mode == "mesh-interrupt":
+    assert saver.steps(), "no emergency checkpoint committed"
+if mode == "mesh-resume":
+    from paddle_tpu import observability as obs
+    assert obs.enabled(), "PADDLE_TPU_TELEMETRY=1 must bootstrap telemetry"
+    assert len(step._jitted._signatures) == 1, "elastic resume retraced"
+
+with open(out_path, "w") as f:
+    json.dump({"losses": losses, "start": start, "dp": dp}, f)
+print(f"chaos mesh child [{mode} dp={dp}]: steps {start}..."
+      f"{start + len(losses) - 1}", file=sys.stderr)
+"""
+
+
 def _run_child(mode: str, ckpt_dir: str, out: str, env, root) -> int:
+    src = CHILD_MESH if mode.startswith("mesh-") else CHILD
     return subprocess.call(
-        [sys.executable, "-c", CHILD, mode, ckpt_dir, out],
+        [sys.executable, "-c", src, mode, ckpt_dir, out],
         env=env, cwd=root)
 
 
@@ -145,6 +225,67 @@ def chaos_lane(env, root) -> int:
         return 0
 
 
+def _mesh_env(env, dp: int):
+    """Child env simulating a dp-sized CPU mesh (elastic lane: each child
+    gets its OWN device count, so mesh A and mesh B are real topologies in
+    real processes)."""
+    e = dict(env)
+    flags = e.get("XLA_FLAGS", "")
+    flags = " ".join(f for f in flags.split()
+                     if "xla_force_host_platform_device_count" not in f)
+    e["XLA_FLAGS"] = (flags +
+                      f" --xla_force_host_platform_device_count={max(dp, 1)}"
+                      ).strip()
+    e["CHAOS_MESH_DP"] = str(dp)
+    return e
+
+
+def mesh_lane(env, root) -> int:
+    """Elastic mesh-A -> mesh-B lane (ISSUE 6): train on dp=2, SIGTERM
+    the process, resume the SAME checkpoint on dp=1 in a fresh process
+    with a different device count.  Asserts the pre-kill prefix is
+    bit-identical to the uninterrupted dp=2 reference and the post-resume
+    tail matches it to tolerance (cross-dp reduction order differs by
+    ~1 ulp on CPU — the relayout itself is byte-lossless, which
+    tests/test_elastic.py asserts bitwise)."""
+    with tempfile.TemporaryDirectory() as tmp:
+        ref, p1, p2 = (os.path.join(tmp, n) for n in
+                       ("mref.json", "mpart1.json", "mpart2.json"))
+        if _run_child("mesh-ref", os.path.join(tmp, "ck_ref"), ref,
+                      _mesh_env(env, 2), root):
+            print("mesh lane: dp=2 reference run FAILED", file=sys.stderr)
+            return 1
+        ck = os.path.join(tmp, "ck")
+        if _run_child("mesh-interrupt", ck, p1, _mesh_env(env, 2), root):
+            print("mesh lane: interrupted dp=2 run FAILED", file=sys.stderr)
+            return 1
+        if _run_child("mesh-resume", ck, p2, _mesh_env(env, 1), root):
+            print("mesh lane: dp=1 elastic resume FAILED", file=sys.stderr)
+            return 1
+        r, a, b = (json.load(open(p)) for p in (ref, p1, p2))
+        losses_ref, pre, post = r["losses"], a["losses"], b["losses"]
+        # the interrupted step's own loss is consumed by TrainingPreempted,
+        # so the series is ref[:4] + (one trained-but-unreported step) +
+        # the resumed tail
+        ok = (pre == losses_ref[:len(pre)] and
+              b["start"] == len(pre) + 1 and
+              len(pre) + 1 + len(post) == len(losses_ref))
+        import math
+        tail_ref = losses_ref[b["start"]:]
+        ok = ok and all(math.isclose(x, y, rel_tol=1e-4, abs_tol=1e-6)
+                        for x, y in zip(post, tail_ref))
+        if not ok:
+            print("mesh lane: ELASTIC PARITY BROKE —\n"
+                  f"  ref(dp2)        = {losses_ref}\n"
+                  f"  pre-kill(dp2)   = {pre}\n"
+                  f"  resumed(dp1)    = {post}", file=sys.stderr)
+            return 1
+        print(f"mesh lane ok: {len(pre)} dp=2 steps bit-identical, SIGTERM, "
+              f"{len(post)} dp=1 steps after elastic resume match the dp=2 "
+              "reference", file=sys.stderr)
+        return 0
+
+
 def main() -> int:
     explicit = bool(sys.argv[1:])
     targets = sys.argv[1:] or DEFAULT_SUBSET
@@ -161,6 +302,11 @@ def main() -> int:
         if lane_rc != 0:
             print("chaos lane FAILED", file=sys.stderr)
         rc = rc or lane_rc
+        print("chaos smoke: elastic mesh-A->mesh-B lane", file=sys.stderr)
+        mesh_rc = mesh_lane(env, root)
+        if mesh_rc != 0:
+            print("mesh lane FAILED", file=sys.stderr)
+        rc = rc or mesh_rc
     return rc
 
 
