@@ -1,0 +1,50 @@
+"""tpu-lint — AST-based static analysis for the TPU hazard classes this
+repo has paid to learn at runtime (ISSUE 7).
+
+Four checkers over a shared resolution layer (imports, decorators,
+scopes, a best-effort call graph):
+
+* **trace-hygiene** — host syncs and python control flow inside
+  jit-reachable code (the recompile/roundtrip killers the retrace
+  sentinel and DeviceLossList catch only after the fact);
+* **retrace** — signature hazards at ``jax.jit``/``shard_map`` entry
+  points (jit-in-loop, mutable defaults, unhashable statics,
+  data-dependent shapes);
+* **concurrency** — class attributes shared between a
+  ``threading.Thread`` target and its callers without a lock, and
+  non-async-signal-safe work in ``signal.signal`` handlers;
+* **faults** — every declared ``fault_point`` seam must appear in the
+  crash-matrix tests and in ``faults.CATALOGUE``.
+
+Violations are structured :class:`Finding`s gated by a ratchet baseline
+(``tools/tpu_lint_baseline.json``): pre-existing findings are frozen,
+new ones fail CI, the baseline may only shrink.  Suppress a justified
+finding in place with ``# tpu-lint: ok(rule)``.
+
+This package is stdlib-only (no jax, no paddle_tpu imports) so the CLI
+(``tools/tpu_lint.py``) can run it anywhere, fast.
+"""
+from __future__ import annotations
+
+from . import baseline
+from .checkers import checker_by_name, default_checkers
+from .core import Checker, Finding, Project, run
+from .module import FuncInfo, ModuleInfo
+
+__all__ = ["Finding", "Checker", "Project", "run", "ModuleInfo",
+           "FuncInfo", "baseline", "default_checkers", "checker_by_name",
+           "analyze"]
+
+
+def analyze(roots, tests_root=None, checkers=None):
+    """One-call API: parse `roots`, run the checkers, return
+    (findings, suppressed, project)."""
+    project = Project()
+    for root in ([roots] if isinstance(roots, str) else roots):
+        project.add_root(root)
+    if tests_root:
+        project.add_tests_root(tests_root)
+    findings, suppressed = run(project,
+                               default_checkers() if checkers is None
+                               else checkers)
+    return findings, suppressed, project

@@ -1,0 +1,94 @@
+"""Ratchet baseline — freeze pre-existing findings, fail on new ones,
+only ever shrink.
+
+The baseline is a checked-in JSON multiset of finding fingerprints
+(rule + path + symbol + message — no line numbers, so unrelated edits
+don't invalidate it).  ``compare`` splits current findings into *new*
+(not in the baseline -> gate failure) and reports *fixed* entries
+(in the baseline, no longer found -> the baseline may shrink).
+``update`` enforces the ratchet direction: it refuses to write a
+baseline that grows unless explicitly forced (initial generation).
+"""
+from __future__ import annotations
+
+import json
+import os
+from collections import Counter
+
+from .core import Finding
+
+SCHEMA = "tpu_lint.baseline.v1"
+
+
+def _counter(findings) -> Counter:
+    return Counter(f.fingerprint() for f in findings)
+
+
+def load(path: str) -> dict:
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    if data.get("schema") != SCHEMA:
+        raise ValueError(f"{path}: not a {SCHEMA} file")
+    return data
+
+
+def baseline_counter(data: dict) -> Counter:
+    c: Counter = Counter()
+    for e in data.get("findings", []):
+        c[e["fingerprint"]] += int(e.get("count", 1))
+    return c
+
+
+def compare(findings: list[Finding], data: dict
+            ) -> tuple[list[Finding], list[str]]:
+    """-> (new findings not covered by the baseline, fixed fingerprints
+    present in the baseline but no longer found)."""
+    allowed = baseline_counter(data)
+    seen: Counter = Counter()
+    new = []
+    for f in sorted(findings, key=Finding.sort_key):
+        fp = f.fingerprint()
+        seen[fp] += 1
+        if seen[fp] > allowed.get(fp, 0):
+            new.append(f)
+    fixed = []
+    for fp, n in sorted(allowed.items()):
+        if seen.get(fp, 0) < n:
+            fixed.append(fp)
+    return new, fixed
+
+
+def render(findings: list[Finding]) -> dict:
+    cur = _counter(findings)
+    by_fp = {}
+    for f in findings:
+        by_fp.setdefault(f.fingerprint(), f)
+    entries = []
+    for fp in sorted(cur):
+        f = by_fp[fp]
+        entries.append({"fingerprint": fp, "rule": f.rule, "path": f.path,
+                        "symbol": f.symbol, "message": f.message,
+                        "count": cur[fp]})
+    return {"schema": SCHEMA, "findings": entries}
+
+
+def update(path: str, findings: list[Finding], force: bool = False) -> dict:
+    """Write the baseline for the current findings.  The ratchet only
+    turns one way: when `path` already exists, any fingerprint not
+    already frozen is rejected (fix the code instead) unless `force`."""
+    data = render(findings)
+    if os.path.exists(path) and not force:
+        old = baseline_counter(load(path))
+        cur = _counter(findings)
+        grown = sorted(fp for fp in cur if cur[fp] > old.get(fp, 0))
+        if grown:
+            raise ValueError(
+                "baseline may only shrink; refusing to add "
+                f"{len(grown)} new fingerprint(s) (first: {grown[0]!r}). "
+                "Fix the new findings, suppress them with a justified "
+                "'# tpu-lint: ok(rule)' comment, or pass --force for an "
+                "intentional re-freeze.")
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=1, sort_keys=False)
+        f.write("\n")
+    return data

@@ -1,0 +1,187 @@
+"""Jit entry points and reachability — the shared spine of the
+trace-hygiene and retrace checkers.
+
+An **entry point** is a function that jax traces: decorated with
+``@jax.jit`` (or a ``jax.jit(...)`` factory / ``functools.partial``
+thereof), or passed by name to ``jax.jit`` / ``shard_map`` /
+``to_static``.  For each entry we record its static argument names
+(``static_argnums`` / ``static_argnames`` with literal values) — those
+parameters are python values, not tracers.
+
+**Reachability** is a BFS over resolvable calls: bare names through the
+lexical scope chain (nested defs -> module defs -> from-imports into
+scanned modules), ``self.method`` within the enclosing class, and
+``module.func`` through the import map when the target module is in the
+scanned set.  Dynamic dispatch (``opt.update``, callbacks, model calls)
+is out of scope by design — the walker only claims what it can prove.
+"""
+from __future__ import annotations
+
+import ast
+
+from .module import FuncInfo, ModuleInfo, body_nodes
+
+_JIT_FINAL = {"shard_map", "to_static", "pjit"}
+
+
+def is_jit_wrapper(dotted: str | None) -> bool:
+    if not dotted:
+        return False
+    if dotted == "jax.jit" or dotted.endswith(".jax.jit"):
+        return True
+    return dotted.rsplit(".", 1)[-1] in _JIT_FINAL
+
+
+def _literal_static(call: ast.Call) -> tuple[set[int], set[str]]:
+    """static_argnums/static_argnames when given as literals."""
+    nums: set[int] = set()
+    names: set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, int):
+                    nums.add(n.value)
+        elif kw.arg == "static_argnames":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                    names.add(n.value)
+    return nums, names
+
+
+class Entry:
+    __slots__ = ("func", "via", "static_params")
+
+    def __init__(self, func: FuncInfo, via: str, static_params: set[str]):
+        self.func = func
+        self.via = via                   # what made it an entry ("jax.jit")
+        self.static_params = static_params
+
+    def traced_params(self) -> list[str]:
+        return [p for p in self.func.params()
+                if p not in self.static_params and p != "self"]
+
+
+class CallGraph:
+    def __init__(self, project):
+        self.project = project
+        self.entries: list[Entry] = []
+        self.entry_of: dict[FuncInfo, Entry] = {}
+        # FuncInfo -> the entry qualname it is reachable from (first found)
+        self.reachable: dict[FuncInfo, str] = {}
+        for mod in project.modules:
+            self._find_entries(mod)
+        self._propagate()
+
+    # -- entry detection -----------------------------------------------------
+    def _add_entry(self, func: FuncInfo, via: str, nums: set[int],
+                   names: set[str]):
+        if func in self.entry_of:
+            return
+        params = [p for p in func.params() if p != "self"]
+        static = set(names)
+        for i in nums:
+            if 0 <= i < len(params):
+                static.add(params[i])
+        e = Entry(func, via, static)
+        self.entries.append(e)
+        self.entry_of[func] = e
+
+    def _find_entries(self, mod: ModuleInfo):
+        for fi in mod.functions:
+            for dec in fi.node.decorator_list:
+                target, call = dec, None
+                if isinstance(dec, ast.Call):
+                    target, call = dec.func, dec
+                    # functools.partial(jax.jit, static_argnums=...)
+                    d = mod.dotted_name(target)
+                    if d and d.rsplit(".", 1)[-1] == "partial" and dec.args:
+                        inner = mod.dotted_name(dec.args[0])
+                        if is_jit_wrapper(inner):
+                            nums, names = _literal_static(dec)
+                            self._add_entry(fi, inner, nums, names)
+                            continue
+                d = mod.dotted_name(target)
+                if is_jit_wrapper(d):
+                    nums, names = (_literal_static(call) if call is not None
+                                   else (set(), set()))
+                    self._add_entry(fi, d, nums, names)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = mod.dotted_name(node.func)
+            if not is_jit_wrapper(d) or not node.args:
+                continue
+            arg0 = node.args[0]
+            if not isinstance(arg0, ast.Name):
+                continue
+            enclosing = mod.enclosing_function(node)
+            target = self._resolve_bare(mod, enclosing, arg0.id)
+            if isinstance(target, FuncInfo):
+                nums, names = _literal_static(node)
+                self._add_entry(target, d, nums, names)
+
+    # -- call resolution -----------------------------------------------------
+    def _resolve_bare(self, mod: ModuleInfo, scope: FuncInfo | None,
+                      name: str):
+        cur = scope
+        while cur is not None:
+            if name in cur.local_defs:
+                return cur.local_defs[name]
+            cur = cur.parent
+        if name in mod.top_defs:
+            return mod.top_defs[name]
+        dotted = mod.imports.get(name)
+        if dotted:
+            return self._resolve_dotted(dotted)
+        return None
+
+    def _resolve_dotted(self, dotted: str):
+        """paddle_tpu.core.op.apply_op -> FuncInfo when the owning module
+        is in the scanned set."""
+        if "." not in dotted:
+            return None
+        mod_name, func_name = dotted.rsplit(".", 1)
+        target_mod = self.project.by_dotted.get(mod_name)
+        if target_mod is not None:
+            return target_mod.top_defs.get(func_name)
+        return None
+
+    def resolve_call(self, mod: ModuleInfo, scope: FuncInfo | None,
+                     call: ast.Call):
+        """FuncInfo for a call when statically resolvable, else None."""
+        func = call.func
+        if isinstance(func, ast.Name):
+            return self._resolve_bare(mod, scope, func.id)
+        if isinstance(func, ast.Attribute):
+            if isinstance(func.value, ast.Name) and func.value.id == "self" \
+                    and scope is not None and scope.cls is not None:
+                return mod.methods.get(scope.cls.name, {}).get(func.attr)
+            d = mod.dotted_name(func)
+            if d:
+                return self._resolve_dotted(d)
+        return None
+
+    # -- reachability --------------------------------------------------------
+    def _propagate(self):
+        work = []
+        for e in self.entries:
+            if e.func not in self.reachable:
+                self.reachable[e.func] = e.func.qualname
+                work.append(e.func)
+        while work:
+            fi = work.pop()
+            via = self.reachable[fi]
+            for node in body_nodes(fi.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                target = self.resolve_call(fi.module, fi, node)
+                if isinstance(target, FuncInfo) and \
+                        target not in self.reachable:
+                    self.reachable[target] = via
+                    work.append(target)
+
+    def is_reachable(self, fi: FuncInfo) -> bool:
+        return fi in self.reachable
+
+    def entry_for(self, fi: FuncInfo) -> str | None:
+        return self.reachable.get(fi)

@@ -1,0 +1,30 @@
+"""tpu-lint checker registry.  ``default_checkers()`` returns fresh
+instances (checkers may carry per-run state, e.g. the fault-point
+declaration index)."""
+from __future__ import annotations
+
+from .concurrency import ConcurrencyChecker
+from .faultpoints import FaultPointChecker
+from .retrace import RetraceChecker
+from .trace_hygiene import TraceHygieneChecker
+
+__all__ = ["default_checkers", "checker_by_name", "TraceHygieneChecker",
+           "RetraceChecker", "ConcurrencyChecker", "FaultPointChecker"]
+
+_REGISTRY = (TraceHygieneChecker, RetraceChecker, ConcurrencyChecker,
+             FaultPointChecker)
+
+
+def default_checkers():
+    return [cls() for cls in _REGISTRY]
+
+
+def checker_by_name(names):
+    sel = []
+    known = {cls().name: cls for cls in _REGISTRY}
+    for n in names:
+        if n not in known:
+            raise ValueError(
+                f"unknown checker {n!r}; known: {sorted(known)}")
+        sel.append(known[n]())
+    return sel

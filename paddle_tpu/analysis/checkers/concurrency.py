@@ -1,0 +1,361 @@
+"""concurrency — unguarded shared attributes and signal-handler safety.
+
+``concurrency.unguarded-shared-attr``: within a class that runs a
+``threading.Thread`` over one of its own methods, an attribute that is
+*written* on one side (the thread-target call closure vs. every other
+method) and *accessed* on the other, where at least one of those
+accesses is not under a ``with self._lock:``-style guard.  The repo's
+``*_locked`` method-name convention (callers hold the lock) is honored,
+and attributes that are themselves synchronization objects
+(Lock/Event/Queue...) are exempt — their methods are atomic.
+
+``concurrency.signal-unsafe``: a handler registered via
+``signal.signal`` (or anything it calls in the same module) performing
+work that is not async-signal-safe — acquiring locks, logging, file IO,
+allocation-heavy formatting.  A signal can interrupt the holder of the
+very lock the handler then takes: instant deadlock on the shutdown
+path, the hardest hang to reproduce.
+
+Known limits (by design, documented in docs/static_analysis.md): thread
+relationships across classes are resolved by method *name* within one
+module only; container mutation through method calls
+(``self._pool.alloc()``) is not tracked — only attribute stores,
+augmented assigns, and subscript stores on ``self.<attr>``.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Checker, Finding
+from ..module import FuncInfo, ModuleInfo, body_nodes
+
+R_SHARED = "concurrency.unguarded-shared-attr"
+R_SIGNAL = "concurrency.signal-unsafe"
+
+_LOCK_TYPES = {"Lock", "RLock", "Condition"}
+_EXEMPT_TYPES = {"Lock", "RLock", "Condition", "Event", "Semaphore",
+                 "BoundedSemaphore", "Barrier", "Queue", "LifoQueue",
+                 "PriorityQueue", "SimpleQueue", "local"}
+_SKIP_METHODS = {"__init__", "__del__", "__repr__", "__str__"}
+_HINT_SHARED = ("guard both sides with the class lock (`with self._lock:`)"
+                ", move the access into a `*_locked` helper called under "
+                "the lock, or suppress with a rationale if the race is "
+                "benign (e.g. a monotonic monitor flag)")
+_HINT_SIGNAL = ("keep handlers to setting a flag/Event and re-raising; do "
+                "the real work at the next safe point (step boundary), "
+                "like framework/preemption.py's request flag")
+
+# call patterns that are not async-signal-safe
+_UNSAFE_FINAL = {"acquire": "acquires a lock",
+                 "warning": "logs", "info": "logs", "error": "logs",
+                 "debug": "logs", "critical": "logs",
+                 "makedirs": "touches the filesystem",
+                 "dump": "formats/allocates", "dumps": "formats/allocates",
+                 "strftime": "allocates"}
+_UNSAFE_BARE = {"print": "writes stdout", "open": "opens a file"}
+
+
+class _Access:
+    __slots__ = ("attr", "write", "guarded", "method", "line", "col")
+
+    def __init__(self, attr, write, guarded, method, line, col):
+        self.attr = attr
+        self.write = write
+        self.guarded = guarded
+        self.method = method
+        self.line = line
+        self.col = col
+
+
+def _sync_typed_attrs(mod: ModuleInfo, cls: ast.ClassDef
+                      ) -> tuple[set[str], set[str]]:
+    """(lock-ish attrs, exempt sync-object attrs) from __init__ assigns
+    like ``self._lock = threading.Lock()``."""
+    locks, exempt = set(), set()
+    init = mod.methods.get(cls.name, {}).get("__init__")
+    if init is None:
+        return locks, exempt
+    for node in body_nodes(init.node):
+        if not isinstance(node, ast.Assign) or \
+                not isinstance(node.value, ast.Call):
+            continue
+        d = mod.dotted_name(node.value.func)
+        final = d.rsplit(".", 1)[-1] if d else None
+        for t in node.targets:
+            if isinstance(t, ast.Attribute) and \
+                    isinstance(t.value, ast.Name) and t.value.id == "self":
+                if final in _LOCK_TYPES:
+                    locks.add(t.attr)
+                    exempt.add(t.attr)
+                elif final in _EXEMPT_TYPES:
+                    exempt.add(t.attr)
+    return locks, exempt
+
+
+def _guard_ancestor(node, lock_attrs: set[str]) -> bool:
+    """Lexically inside `with self.<lock>:` (or a with over anything whose
+    name smells like a lock)?"""
+    cur = getattr(node, "parent", None)
+    while cur is not None and not isinstance(
+            cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        if isinstance(cur, ast.With):
+            for item in cur.items:
+                ctx = item.context_expr
+                if isinstance(ctx, ast.Attribute) and \
+                        isinstance(ctx.value, ast.Name) and \
+                        ctx.value.id == "self":
+                    name = ctx.attr.lower()
+                    if ctx.attr in lock_attrs or "lock" in name or \
+                            name.endswith(("_cv", "_cond")):
+                        return True
+        cur = getattr(cur, "parent", None)
+    return False
+
+
+def _self_attr_accesses(mod: ModuleInfo, fi: FuncInfo,
+                        lock_attrs: set[str]) -> list[_Access]:
+    out = []
+    locked_method = fi.name.endswith("_locked")
+    for node in body_nodes(fi.node):
+        if not isinstance(node, ast.Attribute) or \
+                not isinstance(node.value, ast.Name) or \
+                node.value.id != "self":
+            continue
+        parent = getattr(node, "parent", None)
+        write = isinstance(node.ctx, (ast.Store, ast.Del))
+        # self.x[i] = v / self.x[i] += v / del self.x[i]: container write
+        if not write and isinstance(parent, ast.Subscript) and \
+                parent.value is node and \
+                isinstance(parent.ctx, (ast.Store, ast.Del)):
+            write = True
+        # method calls (self.x.append(...)) count as reads of x only
+        guarded = locked_method or _guard_ancestor(node, lock_attrs)
+        out.append(_Access(node.attr, write, guarded, fi.name,
+                           node.lineno, node.col_offset))
+    return out
+
+
+class ConcurrencyChecker(Checker):
+    name = "concurrency"
+    rules = (R_SHARED, R_SIGNAL)
+
+    def check_module(self, mod: ModuleInfo, project):
+        out = list(self._shared_attrs(mod))
+        out.extend(self._signal_handlers(mod))
+        return out
+
+    # -- shared attributes ---------------------------------------------------
+    def _thread_targets(self, mod: ModuleInfo) -> list[tuple[str, str]]:
+        """(class, method) pairs passed as Thread(target=self.m)."""
+        out = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = mod.dotted_name(node.func)
+            if not d or d.rsplit(".", 1)[-1] != "Thread":
+                continue
+            target = None
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    target = kw.value
+            if target is None and node.args:
+                target = node.args[0]
+            if isinstance(target, ast.Attribute) and \
+                    isinstance(target.value, ast.Name) and \
+                    target.value.id == "self":
+                fi = mod.enclosing_function(node)
+                if fi is not None and fi.cls is not None:
+                    out.append((fi.cls.name, target.attr))
+        return out
+
+    def _thread_closure(self, mod: ModuleInfo,
+                        roots: list[tuple[str, str]]) -> set[tuple[str, str]]:
+        """BFS from thread targets over self.m() calls (same class) and
+        name-matched <expr>.m() calls into other classes of the module."""
+        method_owners: dict[str, list[str]] = {}
+        for cls_name, meths in mod.methods.items():
+            for m in meths:
+                method_owners.setdefault(m, []).append(cls_name)
+        seen = set()
+        work = [r for r in roots if r[1] in mod.methods.get(r[0], {})]
+        while work:
+            key = work.pop()
+            if key in seen:
+                continue
+            seen.add(key)
+            cls_name, meth = key
+            fi = mod.methods[cls_name][meth]
+            for node in body_nodes(fi.node):
+                if not isinstance(node, ast.Call) or \
+                        not isinstance(node.func, ast.Attribute):
+                    continue
+                callee = node.func.attr
+                base = node.func.value
+                if isinstance(base, ast.Name) and base.id == "self":
+                    if callee in mod.methods.get(cls_name, {}):
+                        work.append((cls_name, callee))
+                    continue
+                # cross-class, name-based: self._owner._note_depth(...)
+                owners = method_owners.get(callee, ())
+                if len(owners) == 1 and owners[0] != cls_name:
+                    work.append((owners[0], callee))
+        return seen
+
+    def _shared_attrs(self, mod: ModuleInfo):
+        roots = self._thread_targets(mod)
+        if not roots:
+            return
+        closure = self._thread_closure(mod, roots)
+        touched_classes = {c for c, _ in closure}
+        for cls in mod.classes:
+            if cls.name not in touched_classes:
+                continue
+            locks, exempt = _sync_typed_attrs(mod, cls)
+            thread_acc: dict[str, list[_Access]] = {}
+            main_acc: dict[str, list[_Access]] = {}
+            for meth, fi in mod.methods.get(cls.name, {}).items():
+                if meth in _SKIP_METHODS:
+                    continue
+                side = thread_acc if (cls.name, meth) in closure else main_acc
+                for a in _self_attr_accesses(mod, fi, locks):
+                    if a.attr in exempt:
+                        continue
+                    side.setdefault(a.attr, []).append(a)
+            for attr in sorted(set(thread_acc) & set(main_acc)):
+                t, m = thread_acc[attr], main_acc[attr]
+                t_writes = [a for a in t if a.write]
+                m_writes = [a for a in m if a.write]
+                # race pair: a write on one side vs any access on the
+                # other, with at least one of the two unguarded; anchor
+                # at the unguarded write when there is one
+                def _pick(writes, others):
+                    if not writes or not others:
+                        return None
+                    uw = [a for a in writes if not a.guarded]
+                    if uw:
+                        return uw[0]
+                    uo = [a for a in others if not a.guarded]
+                    return uo[0] if uo else None
+
+                anchor = _pick(t_writes, m) or _pick(m_writes, t)
+                if anchor is None:
+                    continue
+                t_meths = sorted({a.method for a in t})
+                m_meths = sorted({a.method for a in m})
+                yield Finding(
+                    R_SHARED, mod.rel, anchor.line, anchor.col,
+                    symbol=f"{cls.name}.{anchor.method}",
+                    message=(f"attribute `self.{attr}` of `{cls.name}` is "
+                             f"shared between the thread side "
+                             f"({', '.join(t_meths)}) and callers "
+                             f"({', '.join(m_meths)}) with unguarded "
+                             f"{'write' if anchor.write else 'access'} in "
+                             f"`{anchor.method}`"),
+                    hint=_HINT_SHARED)
+
+    # -- signal handlers -----------------------------------------------------
+    def _module_locks(self, mod: ModuleInfo) -> set[str]:
+        out = set()
+        for node in mod.tree.body:
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call):
+                d = mod.dotted_name(node.value.func)
+                if d and d.rsplit(".", 1)[-1] in _LOCK_TYPES:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            out.add(t.id)
+        return out
+
+    def _resolve_handler(self, mod: ModuleInfo, expr) -> FuncInfo | None:
+        if isinstance(expr, ast.Name):
+            fi = mod.top_defs.get(expr.id)
+            if fi is not None:
+                return fi
+            scope = mod.enclosing_function(expr)
+            while scope is not None:
+                if expr.id in scope.local_defs:
+                    return scope.local_defs[expr.id]
+                scope = scope.parent
+            return None
+        if isinstance(expr, ast.Call) and isinstance(expr.func, ast.Name):
+            # factory: signal.signal(sig, _make_handler(sig)) — follow the
+            # returned nested def
+            factory = mod.top_defs.get(expr.func.id)
+            if factory is not None:
+                for node in body_nodes(factory.node):
+                    if isinstance(node, ast.Return) and \
+                            isinstance(node.value, ast.Name) and \
+                            node.value.id in factory.local_defs:
+                        return factory.local_defs[node.value.id]
+        return None
+
+    def _signal_handlers(self, mod: ModuleInfo):
+        handlers: list[FuncInfo] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = mod.dotted_name(node.func)
+            if not d or not (d == "signal.signal" or
+                             d.endswith(".signal.signal")):
+                continue
+            if len(node.args) < 2:
+                continue
+            h = self._resolve_handler(mod, node.args[1])
+            if h is not None and h not in handlers:
+                handlers.append(h)
+        if not handlers:
+            return
+        locks = self._module_locks(mod)
+        for h in handlers:
+            # handler + everything it calls in this module
+            closure, work = [], [h]
+            while work:
+                fi = work.pop()
+                if fi in closure:
+                    continue
+                closure.append(fi)
+                for node in body_nodes(fi.node):
+                    if isinstance(node, ast.Call) and \
+                            isinstance(node.func, ast.Name):
+                        t = mod.top_defs.get(node.func.id)
+                        if t is not None:
+                            work.append(t)
+            for fi in closure:
+                yield from self._unsafe_calls(mod, fi, h, locks)
+
+    def _unsafe_calls(self, mod: ModuleInfo, fi: FuncInfo, handler: FuncInfo,
+                      locks: set[str]):
+        where = ("" if fi is handler else
+                 f" (reached from handler `{handler.qualname}`)")
+        for node in body_nodes(fi.node):
+            what = None
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    ctx = item.context_expr
+                    if isinstance(ctx, ast.Name) and (
+                            ctx.id in locks or "lock" in ctx.id.lower()):
+                        what = f"`with {ctx.id}:` acquires a lock"
+            elif isinstance(node, ast.Call):
+                f = node.func
+                d = mod.dotted_name(f)
+                final = d.rsplit(".", 1)[-1] if d else None
+                if isinstance(f, ast.Name) and f.id in _UNSAFE_BARE:
+                    what = f"`{f.id}()` {_UNSAFE_BARE[f.id]}"
+                elif d and d.endswith("flight.record"):
+                    what = "`flight.record()` allocates and locks the ring"
+                elif final in _UNSAFE_FINAL and isinstance(f, ast.Attribute):
+                    base = f.value
+                    base_name = (base.id if isinstance(base, ast.Name)
+                                 else None)
+                    if final == "acquire" or (base_name and (
+                            base_name in ("logger", "logging", "log",
+                                          "json", "os", "time"))):
+                        what = f"`{d}()` {_UNSAFE_FINAL[final]}"
+            if what is not None:
+                yield Finding(
+                    R_SIGNAL, mod.rel, node.lineno, node.col_offset,
+                    symbol=fi.qualname,
+                    message=(f"non-async-signal-safe work in signal "
+                             f"handler path: {what} in `{fi.qualname}`"
+                             f"{where}"),
+                    hint=_HINT_SIGNAL)

@@ -1,0 +1,93 @@
+"""fault-point coverage — every declared crash seam must be exercised.
+
+The fault-injection harness (``paddle_tpu/testing/faults.py``) only
+pays off if every ``fault_point("name")`` seam in production code is
+actually crashed in the test matrix; an uncovered seam is a crash path
+that ships untested.  Two rules:
+
+* ``faults.uncovered-seam`` — a seam declared in the package (literal
+  ``fault_point("...")`` call or an entry of ``faults.CATALOGUE``) that
+  never appears as a string literal anywhere under the tests root
+  (``faults.inject(...)``, ``faults.arm(...)``, parametrize lists, and
+  ``PADDLE_TPU_FAULTS`` env specs all count).
+* ``faults.uncatalogued-seam`` — a literal seam not listed in
+  ``CATALOGUE`` in faults.py: the catalogue is the operator-facing index
+  (docs/robustness.md), so a seam missing from it is invisible to chaos
+  tooling.
+
+Dynamic seam names (``fault_point(name)``) are ignored — the catalogue
+is how those stay accounted for.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Checker, Finding
+
+R_UNCOVERED = "faults.uncovered-seam"
+R_UNCATALOGUED = "faults.uncatalogued-seam"
+_HINT_COVER = ("add a crash-matrix case (tests/test_robustness.py style: "
+               "`with faults.inject(<seam>): ...` asserting the "
+               "post-crash state) or a PADDLE_TPU_FAULTS chaos lane")
+_HINT_CATALOGUE = ("add the seam to CATALOGUE in "
+                   "paddle_tpu/testing/faults.py and the docs/"
+                   "robustness.md catalogue")
+
+
+class FaultPointChecker(Checker):
+    name = "faults"
+    rules = (R_UNCOVERED, R_UNCATALOGUED)
+
+    def __init__(self):
+        # seam -> first declaration site (mod.rel, line)
+        self._declared: dict[str, tuple[str, int]] = {}
+        self._catalogue: dict[str, tuple[str, int]] = {}
+
+    def check_module(self, mod, project):
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = mod.dotted_name(node.func)
+            if not d or d.rsplit(".", 1)[-1] != "fault_point":
+                continue
+            if not node.args or not (
+                    isinstance(node.args[0], ast.Constant) and
+                    isinstance(node.args[0].value, str)):
+                continue  # dynamic name: covered via the catalogue
+            name = node.args[0].value
+            self._declared.setdefault(name, (mod.rel, node.lineno))
+        if mod.rel.endswith("testing/faults.py"):
+            for node in mod.tree.body:
+                if isinstance(node, ast.Assign) and any(
+                        isinstance(t, ast.Name) and t.id == "CATALOGUE"
+                        for t in node.targets):
+                    for c in ast.walk(node.value):
+                        if isinstance(c, ast.Constant) and \
+                                isinstance(c.value, str):
+                            self._catalogue.setdefault(
+                                c.value, (mod.rel, c.lineno))
+        return ()
+
+    def finalize(self, project):
+        out = []
+        covered = project.test_string_literals()
+        all_seams = dict(self._catalogue)
+        all_seams.update(self._declared)
+        for name in sorted(all_seams):
+            rel, line = all_seams[name]
+            if name not in covered:
+                out.append(Finding(
+                    R_UNCOVERED, rel, line, symbol=name,
+                    message=(f"fault point `{name}` is declared but never "
+                             "exercised by the crash-matrix tests"),
+                    hint=_HINT_COVER))
+        if self._catalogue:
+            for name in sorted(self._declared):
+                if name not in self._catalogue:
+                    rel, line = self._declared[name]
+                    out.append(Finding(
+                        R_UNCATALOGUED, rel, line, symbol=name,
+                        message=(f"fault point `{name}` is missing from "
+                                 "faults.CATALOGUE"),
+                        hint=_HINT_CATALOGUE))
+        return out

@@ -1,0 +1,164 @@
+"""retrace-hazard — the static complement of observability/retrace.py.
+
+The runtime sentinel counts recompiles after they happen; these rules
+catch the signature mistakes that cause them before a TPU ever spins:
+
+* ``retrace.jit-in-loop`` — ``jax.jit``/``shard_map`` called inside a
+  ``for``/``while`` body: a fresh wrapper per iteration has an empty
+  cache, so every call traces + compiles again (the retrace sentinel's
+  storm case, guaranteed).
+* ``retrace.mutable-default`` — a jit entry with a list/dict/set
+  default: unhashable under the jit cache key when passed static, and a
+  shared mutable across traces otherwise.
+* ``retrace.unhashable-static`` — ``static_argnums``/``static_argnames``
+  pointing at a parameter whose default is unhashable: every call raises
+  or re-keys the cache.
+* ``retrace.traced-dim-shape`` — a traced parameter used directly as a
+  dimension in ``jnp.zeros/ones/full/empty/arange/reshape`` inside a jit
+  entry: the shape becomes data-dependent, so every distinct value is a
+  new signature (per-call recompile).  ``x.shape[i]`` is fine — that is
+  static under trace.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..callgraph import is_jit_wrapper
+from ..core import Checker, Finding
+from ..module import ModuleInfo
+
+R_LOOP = "retrace.jit-in-loop"
+R_MUT = "retrace.mutable-default"
+R_STATIC = "retrace.unhashable-static"
+R_DIM = "retrace.traced-dim-shape"
+
+_SHAPE_FNS = {"zeros", "ones", "full", "empty", "arange", "reshape",
+              "broadcast_to", "tile"}
+_MUTABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+            ast.SetComp)
+
+
+def _in_loop(node) -> bool:
+    cur = getattr(node, "parent", None)
+    while cur is not None and not isinstance(
+            cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Module)):
+        if isinstance(cur, (ast.For, ast.While)):
+            return True
+        cur = getattr(cur, "parent", None)
+    return False
+
+
+def _defaults_by_param(node: ast.FunctionDef) -> dict[str, ast.AST]:
+    args = node.args
+    pos = args.posonlyargs + args.args
+    out = {}
+    for p, d in zip(pos[len(pos) - len(args.defaults):], args.defaults):
+        out[p.arg] = d
+    for p, d in zip(args.kwonlyargs, args.kw_defaults):
+        if d is not None:
+            out[p.arg] = d
+    return out
+
+
+class RetraceChecker(Checker):
+    name = "retrace"
+    rules = (R_LOOP, R_MUT, R_STATIC, R_DIM)
+
+    def check_module(self, mod: ModuleInfo, project):
+        out = []
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) and \
+                    is_jit_wrapper(mod.dotted_name(node.func)) and \
+                    _in_loop(node):
+                fi = mod.enclosing_function(node)
+                out.append(Finding(
+                    R_LOOP, mod.rel, node.lineno, node.col_offset,
+                    symbol=fi.qualname if fi else "<module>",
+                    message=("jit/shard_map wrapper created inside a loop "
+                             "body — a fresh wrapper retraces and "
+                             "recompiles on every iteration"),
+                    hint=("hoist the jitted callable out of the loop (or "
+                          "cache it once, like spmd.py's _unflatten_jit)")))
+        return out
+
+    def finalize(self, project):
+        cg = project.callgraph()
+        out = []
+        for entry in cg.entries:
+            fi = entry.func
+            mod = fi.module
+            defaults = _defaults_by_param(fi.node)
+            for pname, d in defaults.items():
+                if isinstance(d, _MUTABLE):
+                    rule, why = (R_STATIC, "declared static") \
+                        if pname in entry.static_params else \
+                        (R_MUT, "a mutable default")
+                    out.append(Finding(
+                        rule, mod.rel, d.lineno, d.col_offset,
+                        symbol=fi.qualname,
+                        message=(f"jit entry `{fi.qualname}` parameter "
+                                 f"`{pname}` has {why} "
+                                 f"{type(d).__name__.lower()} — unhashable "
+                                 "under the jit cache key"),
+                        hint=("use a tuple / frozen value, or pass it "
+                              "dynamically instead of static")))
+            out.extend(self._traced_dims(entry))
+        return out
+
+    def _traced_dims(self, entry):
+        fi = entry.func
+        mod = fi.module
+        traced = set(entry.traced_params())
+        out = []
+        from ..module import body_nodes
+        for node in body_nodes(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            final = None
+            if isinstance(f, ast.Attribute):
+                final = f.attr
+            elif isinstance(f, ast.Name):
+                final = f.id
+            if final not in _SHAPE_FNS:
+                continue
+            d = mod.dotted_name(f)
+            # only numpy-like constructors (jnp.zeros, np.zeros, bare
+            # from-import) and .reshape methods
+            if d and not (d.startswith("jax.numpy.") or
+                          d.startswith("numpy.") or "." not in d):
+                if final not in ("reshape", "broadcast_to", "tile"):
+                    continue
+            shape_args = list(node.args[:1]) + [
+                kw.value for kw in node.keywords if kw.arg == "shape"]
+            if final in ("reshape", "arange", "tile"):
+                shape_args = list(node.args) + shape_args
+            for arg in shape_args:
+                name = self._bare_traced_name(arg, traced)
+                if name:
+                    out.append(Finding(
+                        R_DIM, mod.rel, node.lineno, node.col_offset,
+                        symbol=fi.qualname,
+                        message=(f"traced parameter `{name}` used as a "
+                                 f"dimension in `{final}` inside jit entry "
+                                 f"`{fi.qualname}` — data-dependent shape, "
+                                 "recompiles per distinct value"),
+                        hint=("derive the size from a static `.shape` or "
+                              "pass it via static_argnums")))
+                    break
+        return out
+
+    @staticmethod
+    def _bare_traced_name(arg, traced: set[str]) -> str | None:
+        """A traced param appearing as a bare dimension (`n` or inside a
+        tuple/arithmetic), NOT through `.shape[i]` (static under trace)."""
+        skip: set[int] = set()
+        for node in ast.walk(arg):
+            if isinstance(node, ast.Attribute):
+                for sub in ast.walk(node):
+                    skip.add(id(sub))
+        for node in ast.walk(arg):
+            if isinstance(node, ast.Name) and node.id in traced and \
+                    id(node) not in skip:
+                return node.id
+        return None

@@ -1,0 +1,252 @@
+"""trace-hygiene — host syncs and python control flow where jax traces.
+
+Three rules:
+
+* ``trace-hygiene.jit-host-sync`` — a host-synchronizing call
+  (``jax.device_get``, ``np.asarray``/``np.array``, ``.item()``,
+  ``.numpy()``, ``.tolist()``, ``float()/int()/bool()`` on a non-literal)
+  inside a function reachable from a ``@jax.jit`` / ``shard_map`` /
+  ``to_static`` entry point.  Inside a trace these either fail on a
+  tracer or, worse, silently force a device round-trip per call.
+* ``trace-hygiene.device-sync`` — the same sync applied to a value the
+  local dataflow proves device-resident (assigned from ``apply_op`` /
+  ``jnp.*`` / ``jax.*``), or ``.item()/.numpy()/.tolist()`` on a function
+  parameter: a blocking transfer in library code that runs per step (the
+  ``DeviceLossList`` class of bug — one ``.item()`` per element turns a
+  dispatch-ahead loop into a host-locked crawl).
+* ``trace-hygiene.traced-control-flow`` — ``if``/``while`` on a traced
+  parameter of a jit entry function: concretization error at best,
+  silent retrace-per-branch at worst.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Checker, Finding
+from ..module import ModuleInfo, body_nodes
+
+R_JIT = "trace-hygiene.jit-host-sync"
+R_DEV = "trace-hygiene.device-sync"
+R_FLOW = "trace-hygiene.traced-control-flow"
+
+_SYNC_METHODS = {"item", "numpy", "tolist"}
+_CASTS = {"float", "int", "bool"}
+_HINT_SYNC = ("keep the value on device (jnp ops / apply_op) or move the "
+              "sync out of the jit-reachable path; see docs/PERF.md on "
+              "per-step host syncs")
+_HINT_FLOW = ("python branching concretizes a tracer; use jnp.where / "
+              "lax.cond, or mark the argument static_argnums if it is "
+              "genuinely a python value")
+
+
+def _bare_name_in(expr, names: set[str]) -> str | None:
+    """First name from `names` used bare in `expr` (not through an
+    attribute like `.shape`, which is static under trace)."""
+    skip: set[int] = set()
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Attribute):
+            for sub in ast.walk(node):
+                skip.add(id(sub))
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name) and node.id in names and \
+                id(node) not in skip:
+            return node.id
+    return None
+
+
+def _is_numpy_coerce(dotted: str | None) -> bool:
+    return dotted in ("numpy.asarray", "numpy.array")
+
+
+def _is_device_get(dotted: str | None) -> bool:
+    return bool(dotted) and (dotted == "jax.device_get" or
+                             dotted.endswith(".device_get"))
+
+
+def _device_producing(mod: ModuleInfo, call: ast.Call) -> bool:
+    d = mod.dotted_name(call.func)
+    if not d:
+        return False
+    if d.startswith("jax.numpy.") or d.startswith("jax.lax.") or \
+            d in ("jax.device_put",):
+        return True
+    return d.rsplit(".", 1)[-1] == "apply_op"
+
+
+class TraceHygieneChecker(Checker):
+    name = "trace-hygiene"
+    rules = (R_JIT, R_DEV, R_FLOW)
+
+    # -- per-module: local dataflow (device-sync) ----------------------------
+    def check_module(self, mod: ModuleInfo, project):
+        out = []
+        for fi in mod.functions:
+            out.extend(self._device_sync_in(mod, fi))
+        return out
+
+    def _device_sync_in(self, mod: ModuleInfo, fi):
+        """Flow-insensitive taint: names ever assigned from a
+        device-producing expression (apply_op / jnp.* / jax.* call, or
+        arithmetic/method chains over tainted names), then flag host
+        syncs applied to them."""
+        params = set(fi.params())
+        tainted: set[str] = set()
+        out = []
+
+        def expr_tainted(e) -> bool:
+            if isinstance(e, ast.Call):
+                if _device_producing(mod, e):
+                    return True
+                # method chained off a tainted value: t.sum(), t.astype()
+                if isinstance(e.func, ast.Attribute) and \
+                        expr_tainted(e.func.value):
+                    return True
+                return False
+            if isinstance(e, ast.Name):
+                return e.id in tainted
+            if isinstance(e, ast.BinOp):
+                return expr_tainted(e.left) or expr_tainted(e.right)
+            if isinstance(e, ast.UnaryOp):
+                return expr_tainted(e.operand)
+            if isinstance(e, (ast.Subscript, ast.Attribute)):
+                return expr_tainted(e.value)
+            return False
+
+        # taint to fixpoint (chains like b = a + 1 after a = jnp.sum(x))
+        changed = True
+        while changed:
+            changed = False
+            for st in body_nodes(fi.node):
+                targets = ()
+                if isinstance(st, ast.Assign):
+                    targets, value = st.targets, st.value
+                elif isinstance(st, ast.AugAssign):
+                    targets, value = (st.target,), st.value
+                for t in targets:
+                    if isinstance(t, ast.Name) and t.id not in tainted \
+                            and expr_tainted(value):
+                        tainted.add(t.id)
+                        changed = True
+
+        def flag(node, what, target):
+            out.append(Finding(
+                R_DEV, mod.rel, node.lineno, node.col_offset,
+                symbol=fi.qualname,
+                message=f"host sync: {what} on device value `{target}`",
+                hint=_HINT_SYNC))
+
+        for node in body_nodes(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr in _SYNC_METHODS:
+                base = f.value
+                if isinstance(base, ast.Name) and (
+                        base.id in tainted or base.id in params):
+                    flag(node, f".{f.attr}()", base.id)
+                elif expr_tainted(base):
+                    flag(node, f".{f.attr}()", "<expr>")
+            elif isinstance(f, ast.Name) and f.id in _CASTS:
+                if node.args and expr_tainted(node.args[0]):
+                    flag(node, f"{f.id}()", ast.unparse(node.args[0]))
+            else:
+                d = mod.dotted_name(f)
+                if (_is_device_get(d) or _is_numpy_coerce(d)) and \
+                        node.args and expr_tainted(node.args[0]):
+                    flag(node, d.rsplit(".", 1)[-1] + "()",
+                         ast.unparse(node.args[0]))
+        return out
+
+    # -- project-wide: jit reachability --------------------------------------
+    def finalize(self, project):
+        cg = project.callgraph()
+        out = []
+        for mod in project.modules:
+            for fi in mod.functions:
+                if not cg.is_reachable(fi):
+                    continue
+                entry = cg.entry_for(fi)
+                out.extend(self._jit_syncs(mod, fi, entry,
+                                           cg.entry_of.get(fi)))
+        for e in cg.entries:
+            out.extend(self._traced_flow(e))
+        return out
+
+    def _jit_syncs(self, mod: ModuleInfo, fi, entry: str, entry_obj=None):
+        out = []
+        where = (f"jit entry `{fi.qualname}`" if fi.qualname == entry else
+                 f"`{fi.qualname}` (reachable from jit entry `{entry}`)")
+        traced = set(entry_obj.traced_params()) if entry_obj else set()
+        for node in body_nodes(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            what = None
+            if isinstance(f, ast.Attribute) and f.attr in _SYNC_METHODS:
+                what = f".{f.attr}()"
+            elif isinstance(f, ast.Name) and f.id in _CASTS:
+                # only flag casts provably applied to a traced parameter
+                # of the entry itself — a cast on an arbitrary local in
+                # reachable code is usually python-scalar plumbing
+                if node.args and traced:
+                    pname = _bare_name_in(node.args[0], traced)
+                    if pname:
+                        what = f"{f.id}() on traced parameter `{pname}`"
+            else:
+                d = mod.dotted_name(f)
+                if _is_device_get(d) or _is_numpy_coerce(d):
+                    what = d + "()"
+            if what is not None:
+                out.append(Finding(
+                    R_JIT, mod.rel, node.lineno, node.col_offset,
+                    symbol=fi.qualname,
+                    message=f"host sync {what} inside {where}",
+                    hint=_HINT_SYNC))
+        return out
+
+    def _traced_flow(self, entry):
+        fi = entry.func
+        mod = fi.module
+        traced = set(entry.traced_params())
+        out = []
+        for node in body_nodes(fi.node):
+            if not isinstance(node, (ast.If, ast.While)):
+                continue
+            name = self._traced_name_in_test(node.test, traced)
+            if name:
+                kind = "if" if isinstance(node, ast.If) else "while"
+                out.append(Finding(
+                    R_FLOW, mod.rel, node.lineno, node.col_offset,
+                    symbol=fi.qualname,
+                    message=(f"python `{kind}` on traced parameter "
+                             f"`{name}` of jit entry `{fi.qualname}`"),
+                    hint=_HINT_FLOW))
+        return out
+
+    @staticmethod
+    def _traced_name_in_test(test, traced: set[str]) -> str | None:
+        """First traced param used as a *value* in the test; usages inside
+        isinstance/hasattr/getattr/len and `is (not) None` checks are
+        python-level and exempt."""
+        exempt_calls = {"isinstance", "hasattr", "getattr", "len", "type"}
+        skip: set[int] = set()
+        for node in ast.walk(test):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Name) and \
+                    node.func.id in exempt_calls:
+                for sub in ast.walk(node):
+                    skip.add(id(sub))
+            if isinstance(node, ast.Compare) and \
+                    all(isinstance(op, (ast.Is, ast.IsNot))
+                        for op in node.ops):
+                for sub in ast.walk(node):
+                    skip.add(id(sub))
+            if isinstance(node, ast.Attribute):
+                # x.shape / x.dtype / x.ndim are static under trace
+                for sub in ast.walk(node):
+                    skip.add(id(sub))
+        for node in ast.walk(test):
+            if isinstance(node, ast.Name) and node.id in traced and \
+                    id(node) not in skip:
+                return node.id
+        return None

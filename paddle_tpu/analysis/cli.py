@@ -1,0 +1,133 @@
+"""tpu-lint command line.
+
+    python tools/tpu_lint.py paddle_tpu/ [--baseline tools/tpu_lint_baseline.json]
+                                         [--format=text|json]
+                                         [--tests tests/]
+                                         [--checkers trace-hygiene,...]
+                                         [--update-baseline [--force]]
+                                         [--show-suppressed]
+
+Exit codes: 0 clean (or all findings frozen in the baseline), 1 new
+findings (or findings with no baseline given), 2 usage/baseline error.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from . import baseline as baseline_mod
+from .checkers import checker_by_name, default_checkers
+from .core import Project, run
+
+
+def _build_parser():
+    p = argparse.ArgumentParser(
+        prog="tpu_lint",
+        description="AST-based TPU-hazard analyzer (trace hygiene, retrace "
+                    "risk, thread/signal safety, fault-point coverage)")
+    p.add_argument("paths", nargs="+",
+                   help="package roots / files to analyze")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--baseline", metavar="FILE",
+                   help="ratchet baseline JSON; only findings NOT frozen "
+                        "there fail")
+    p.add_argument("--tests", metavar="PATH", action="append", default=None,
+                   help="tests root(s)/file(s) scanned as fault-point "
+                        "coverage evidence; repeatable (default: ./tests "
+                        "plus tools/chaos_smoke.py when present)")
+    p.add_argument("--checkers", metavar="NAMES",
+                   help="comma-separated subset (trace-hygiene, retrace, "
+                        "concurrency, faults)")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="rewrite --baseline with the current findings "
+                        "(refuses to grow it)")
+    p.add_argument("--force", action="store_true",
+                   help="with --update-baseline: allow growth (initial "
+                        "freeze / intentional re-baseline)")
+    p.add_argument("--show-suppressed", action="store_true",
+                   help="also list findings silenced by '# tpu-lint: ok' "
+                        "comments")
+    return p
+
+
+def main(argv=None) -> int:
+    args = _build_parser().parse_args(argv)
+    try:
+        checkers = (checker_by_name(
+            [c.strip() for c in args.checkers.split(",") if c.strip()])
+            if args.checkers else default_checkers())
+    except ValueError as e:
+        print(f"tpu-lint: {e}", file=sys.stderr)
+        return 2
+
+    project = Project()
+    for path in args.paths:
+        if not os.path.exists(path):
+            print(f"tpu-lint: no such path: {path}", file=sys.stderr)
+            return 2
+        project.add_root(path)
+    tests = args.tests if args.tests is not None else [
+        t for t in ("tests", os.path.join("tools", "chaos_smoke.py"))
+        if os.path.exists(t)]
+    for t in tests:
+        project.add_tests_root(t)
+
+    findings, suppressed = run(project, checkers)
+
+    if args.update_baseline:
+        if not args.baseline:
+            print("tpu-lint: --update-baseline needs --baseline FILE",
+                  file=sys.stderr)
+            return 2
+        try:
+            baseline_mod.update(args.baseline, findings, force=args.force)
+        except ValueError as e:
+            print(f"tpu-lint: {e}", file=sys.stderr)
+            return 2
+        print(f"tpu-lint: baseline written to {args.baseline} "
+              f"({len(findings)} finding(s))")
+        return 0
+
+    new, fixed = findings, []
+    if args.baseline:
+        try:
+            data = baseline_mod.load(args.baseline)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"tpu-lint: cannot read baseline {args.baseline}: {e}",
+                  file=sys.stderr)
+            return 2
+        new, fixed = baseline_mod.compare(findings, data)
+
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [f.to_dict() for f in findings],
+            "new": [f.to_dict() for f in new],
+            "fixed_fingerprints": fixed,
+            "suppressed": [f.to_dict() for f in suppressed],
+            "counts": {"findings": len(findings), "new": len(new),
+                       "fixed": len(fixed), "suppressed": len(suppressed)},
+        }, indent=1))
+    else:
+        shown = new if args.baseline else findings
+        for f in shown:
+            print(f.render())
+        if args.show_suppressed:
+            for f in suppressed:
+                print(f"suppressed: {f.render()}")
+        frozen = len(findings) - len(new)
+        summary = (f"tpu-lint: {len(findings)} finding(s)"
+                   f" ({len(suppressed)} suppressed in-code)")
+        if args.baseline:
+            summary += (f"; baseline: {frozen} frozen, {len(new)} NEW, "
+                        f"{len(fixed)} fixed")
+            if fixed:
+                summary += ("  — baseline can shrink: re-run with "
+                            "--update-baseline")
+        print(summary, file=sys.stderr)
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,218 @@
+"""tpu-lint core — findings, the checker plugin base, and the project
+(file set) the checkers run over.
+
+A :class:`Finding` is one structured violation: rule id, file:line, the
+enclosing symbol, a message, and a fix hint.  Its :meth:`fingerprint`
+deliberately excludes the line number so the ratchet baseline survives
+unrelated edits above a frozen finding.
+
+A :class:`Checker` sees every module (``check_module``) and then the
+whole project (``finalize``) — per-file rules live in the former,
+cross-file rules (jit reachability, fault-point coverage) in the latter.
+"""
+from __future__ import annotations
+
+import ast
+import os
+
+from .module import ModuleInfo
+
+
+class Finding:
+    __slots__ = ("rule", "path", "line", "col", "symbol", "message", "hint")
+
+    def __init__(self, rule: str, path: str, line: int, col: int = 0,
+                 symbol: str = "", message: str = "", hint: str = ""):
+        self.rule = rule
+        self.path = path
+        self.line = int(line)
+        self.col = int(col)
+        self.symbol = symbol
+        self.message = message
+        self.hint = hint
+
+    def fingerprint(self) -> str:
+        # line-free on purpose: edits elsewhere in the file must not
+        # invalidate baseline entries
+        return f"{self.rule}::{self.path}::{self.symbol}::{self.message}"
+
+    def sort_key(self):
+        return (self.path, self.line, self.col, self.rule, self.message)
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "symbol": self.symbol,
+                "message": self.message, "hint": self.hint}
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}:{self.col}"
+        out = f"{loc}: [{self.rule}] {self.message}"
+        if self.symbol:
+            out += f"  (in {self.symbol})"
+        if self.hint:
+            out += f"\n    hint: {self.hint}"
+        return out
+
+    def __repr__(self):
+        return f"Finding({self.rule}, {self.path}:{self.line})"
+
+
+class Checker:
+    """Plugin base.  Subclasses set ``name`` + ``rules`` and implement
+    either hook; both receive already-parsed :class:`ModuleInfo`s."""
+
+    name: str = ""
+    rules: tuple = ()
+
+    def check_module(self, mod: ModuleInfo, project: "Project"):
+        return ()
+
+    def finalize(self, project: "Project"):
+        return ()
+
+
+class Project:
+    """The analyzed file set: scan roots (package code) plus an optional
+    tests root (coverage evidence for the fault-point rule — test files
+    are scanned for string literals, not linted)."""
+
+    def __init__(self):
+        self.modules: list[ModuleInfo] = []
+        self.by_dotted: dict[str, ModuleInfo] = {}
+        self.parse_errors: list[Finding] = []
+        self.test_files: list[tuple[str, str]] = []  # (rel, source)
+        self._callgraph = None
+
+    # -- loading -------------------------------------------------------------
+    @staticmethod
+    def _rel(path: str) -> str:
+        rel = os.path.relpath(path)
+        if rel.startswith(".."):
+            rel = path
+        return rel.replace(os.sep, "/")
+
+    @staticmethod
+    def _dotted_for(path: str) -> str:
+        """Dotted module name from the path by walking up through package
+        dirs (dirs holding __init__.py)."""
+        path = os.path.abspath(path)
+        parts = [os.path.splitext(os.path.basename(path))[0]]
+        d = os.path.dirname(path)
+        while os.path.isfile(os.path.join(d, "__init__.py")):
+            parts.append(os.path.basename(d))
+            d = os.path.dirname(d)
+        if parts[0] == "__init__":
+            parts = parts[1:] or [""]
+        return ".".join(reversed(parts))
+
+    def add_file(self, path: str):
+        rel = self._rel(path)
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+            mod = ModuleInfo(path, rel, source, self._dotted_for(path))
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            line = getattr(e, "lineno", 0) or 0
+            self.parse_errors.append(Finding(
+                "analysis.parse-error", rel, line,
+                message=f"could not parse: {type(e).__name__}: {e}"))
+            return
+        self.modules.append(mod)
+        if mod.dotted:
+            self.by_dotted[mod.dotted] = mod
+
+    def add_root(self, root: str):
+        if os.path.isfile(root):
+            self.add_file(root)
+            return
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d != "__pycache__" and
+                                 not d.startswith("."))
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    self.add_file(os.path.join(dirpath, fn))
+
+    def add_tests_root(self, root: str):
+        if not root:
+            return
+        if os.path.isfile(root):
+            self.add_test_file(root)
+            return
+        if not os.path.isdir(root):
+            return
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d != "__pycache__" and
+                                 not d.startswith("."))
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    p = os.path.join(dirpath, fn)
+                    try:
+                        with open(p, encoding="utf-8") as f:
+                            self.test_files.append((self._rel(p), f.read()))
+                    except (OSError, UnicodeDecodeError):
+                        continue
+
+    def add_test_file(self, path: str):
+        try:
+            with open(path, encoding="utf-8") as f:
+                self.test_files.append((self._rel(path), f.read()))
+        except (OSError, UnicodeDecodeError):
+            pass
+
+    # -- shared analyses -----------------------------------------------------
+    def callgraph(self):
+        """Jit entry points + reachability, built once and shared by the
+        trace-hygiene and retrace checkers."""
+        if self._callgraph is None:
+            from .callgraph import CallGraph
+            self._callgraph = CallGraph(self)
+        return self._callgraph
+
+    def test_string_literals(self) -> set[str]:
+        """Every string literal in the tests root (plus the contents of
+        PADDLE_TPU_FAULTS-style colon specs) — the coverage evidence the
+        fault-point rule checks seams against."""
+        out: set[str] = set()
+        for _rel, source in self.test_files:
+            try:
+                tree = ast.parse(source)
+            except SyntaxError:
+                continue
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Constant) and \
+                        isinstance(node.value, str):
+                    out.add(node.value)
+                    # "train.step:kill:after=5,fs.upload:raise" env specs
+                    for part in node.value.split(","):
+                        out.add(part.split(":")[0].strip())
+        return out
+
+    def module_by_rel_suffix(self, suffix: str) -> ModuleInfo | None:
+        for mod in self.modules:
+            if mod.rel.endswith(suffix):
+                return mod
+        return None
+
+
+def run(project: Project, checkers) -> tuple[list[Finding], list[Finding]]:
+    """Run checkers over the project; returns (findings, suppressed) both
+    sorted.  Suppression comments are applied here so checkers never need
+    to know about them."""
+    raw: list[Finding] = list(project.parse_errors)
+    for checker in checkers:
+        for mod in project.modules:
+            raw.extend(checker.check_module(mod, project))
+        raw.extend(checker.finalize(project))
+    by_rel = {m.rel: m for m in project.modules}
+    findings, suppressed = [], []
+    for f in raw:
+        mod = by_rel.get(f.path)
+        if mod is not None and mod.is_suppressed(f.rule, f.line):
+            suppressed.append(f)
+        else:
+            findings.append(f)
+    findings.sort(key=Finding.sort_key)
+    suppressed.sort(key=Finding.sort_key)
+    return findings, suppressed

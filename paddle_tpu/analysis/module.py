@@ -1,0 +1,207 @@
+"""Per-file AST model for tpu-lint — parse once, resolve names once.
+
+A :class:`ModuleInfo` wraps one parsed source file with the three
+resolutions every checker needs and none wants to re-implement:
+
+* **imports** — local alias -> dotted origin (``jnp`` -> ``jax.numpy``,
+  ``faults`` -> ``paddle_tpu.testing.faults``), with relative imports
+  resolved against the module's own dotted name;
+* **functions** — every ``def`` (module-level, method, nested) as a
+  :class:`FuncInfo` with qualname, enclosing class, and lexical parent,
+  so call targets can be looked up through the scope chain;
+* **suppressions** — ``# tpu-lint: ok(rule)`` comments by line.
+
+Everything here is stdlib-only on purpose: the CLI runs the analyzer
+without importing paddle_tpu (or jax) at all.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+_SUPPRESS_RE = re.compile(r"#\s*tpu-lint:\s*ok(?:\(([^)]*)\))?")
+
+
+class FuncInfo:
+    """One function/method/nested def with its lexical context."""
+
+    __slots__ = ("node", "module", "qualname", "cls", "parent", "local_defs")
+
+    def __init__(self, node, module, qualname, cls=None, parent=None):
+        self.node = node
+        self.module = module
+        self.qualname = qualname
+        self.cls = cls                  # enclosing ClassDef or None
+        self.parent = parent            # enclosing FuncInfo or None
+        self.local_defs: dict[str, "FuncInfo"] = {}
+
+    @property
+    def name(self):
+        return self.node.name
+
+    def params(self) -> list[str]:
+        a = self.node.args
+        names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+        if a.vararg:
+            names.append(a.vararg.arg)
+        if a.kwarg:
+            names.append(a.kwarg.arg)
+        return names
+
+    def __repr__(self):
+        return f"FuncInfo({self.module.rel}::{self.qualname})"
+
+
+def body_nodes(func_node):
+    """Walk a function body, NOT descending into nested def/class bodies
+    (those are separate FuncInfos / scopes)."""
+    stack = list(func_node.body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            # decorators/defaults evaluate in the enclosing scope
+            stack.extend(getattr(node, "decorator_list", ()))
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class ModuleInfo:
+    def __init__(self, path: str, rel: str, source: str,
+                 dotted: str = ""):
+        self.path = path
+        self.rel = rel                  # display/baseline path (fwd slashes)
+        self.source = source
+        self.dotted = dotted            # e.g. "paddle_tpu.nn.clip"
+        self.tree = ast.parse(source, filename=path)
+        self.imports: dict[str, str] = {}
+        self.functions: list[FuncInfo] = []
+        self.func_of_node: dict[ast.AST, FuncInfo] = {}
+        self.top_defs: dict[str, FuncInfo] = {}
+        self.classes: list[ast.ClassDef] = []
+        self.methods: dict[str, dict[str, FuncInfo]] = {}  # cls -> name -> fi
+        self.suppressions: dict[int, set[str] | None] = {}  # None == all rules
+        self._set_parents()
+        self._collect_imports()
+        self._collect_functions()
+        self._collect_suppressions()
+
+    # -- construction --------------------------------------------------------
+    def _set_parents(self):
+        self.tree.parent = None
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                child.parent = node
+
+    def _collect_imports(self):
+        pkg = self.dotted.rsplit(".", 1)[0] if "." in self.dotted else ""
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    local = alias.asname or alias.name.split(".")[0]
+                    self.imports[local] = (alias.name if alias.asname
+                                           else alias.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                if node.level:
+                    # relative: drop (level-1) trailing components of the
+                    # module's package, then append the stated module
+                    parts = pkg.split(".") if pkg else []
+                    if node.level - 1 <= len(parts):
+                        parts = parts[:len(parts) - (node.level - 1)]
+                        base = ".".join(parts + ([node.module]
+                                                 if node.module else []))
+                    else:
+                        base = node.module or ""
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    local = alias.asname or alias.name
+                    self.imports[local] = (f"{base}.{alias.name}" if base
+                                           else alias.name)
+
+    def _collect_functions(self):
+        def visit(node, cls, parent, prefix):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    qn = f"{prefix}{child.name}"
+                    fi = FuncInfo(child, self, qn, cls=cls, parent=parent)
+                    self.functions.append(fi)
+                    self.func_of_node[child] = fi
+                    if parent is not None:
+                        parent.local_defs[child.name] = fi
+                    elif cls is None:
+                        self.top_defs[child.name] = fi
+                    else:
+                        self.methods.setdefault(cls.name, {})[child.name] = fi
+                    visit(child, cls, fi, qn + ".")
+                elif isinstance(child, ast.ClassDef):
+                    self.classes.append(child)
+                    self.methods.setdefault(child.name, {})
+                    visit(child, child, None, f"{prefix}{child.name}.")
+                else:
+                    visit(child, cls, parent, prefix)
+        visit(self.tree, None, None, "")
+
+    def _collect_suppressions(self):
+        for i, line in enumerate(self.source.splitlines(), start=1):
+            m = _SUPPRESS_RE.search(line)
+            if not m:
+                continue
+            rules = m.group(1)
+            if rules is None or not rules.strip():
+                self.suppressions[i] = None
+            else:
+                self.suppressions[i] = {r.strip() for r in rules.split(",")
+                                        if r.strip()}
+
+    # -- queries -------------------------------------------------------------
+    def enclosing_function(self, node) -> FuncInfo | None:
+        cur = getattr(node, "parent", None)
+        while cur is not None:
+            fi = self.func_of_node.get(cur)
+            if fi is not None:
+                return fi
+            cur = getattr(cur, "parent", None)
+        return None
+
+    def dotted_name(self, node) -> str | None:
+        """Resolve a Name/Attribute chain to a dotted path through the
+        import map (``jnp.zeros`` -> ``jax.numpy.zeros``).  Returns None
+        for anything that is not a plain chain (calls, subscripts...)."""
+        parts = []
+        cur = node
+        while isinstance(cur, ast.Attribute):
+            parts.append(cur.attr)
+            cur = cur.value
+        if not isinstance(cur, ast.Name):
+            return None
+        base = self.imports.get(cur.id, cur.id)
+        parts.append(base)
+        return ".".join(reversed(parts))
+
+    def suppressed_rules(self, line: int):
+        """Union of suppression specs on `line` and the line above;
+        returns (found, rules-or-None)."""
+        found, rules = False, set()
+        for ln in (line, line - 1):
+            if ln in self.suppressions:
+                found = True
+                spec = self.suppressions[ln]
+                if spec is None:
+                    return True, None
+                rules |= spec
+        return found, (rules if found else None)
+
+    def is_suppressed(self, rule: str, line: int) -> bool:
+        found, rules = self.suppressed_rules(line)
+        if not found:
+            return False
+        if rules is None:
+            return True
+        for r in rules:
+            if rule == r or rule.startswith(r + "."):
+                return True
+        return False

@@ -48,9 +48,13 @@ def _scan_nan_inf(name, out):
             # the in-jit counterpart (SURVEY §5.2)
             continue
         if hasattr(v, "dtype") and jnp.issubdtype(v.dtype, jnp.floating):
+            # FLAGS_check_nan_inf debug scan: the sync IS the feature
+            # (materialize to decide whether to crash), tracers skipped
+            # above, and the whole scan is gated off the hot path
             bad = ~jnp.isfinite(v)
-            if bool(bad.any()):
-                n_nan, n_inf = int(jnp.isnan(v).sum()), int(jnp.isinf(v).sum())
+            if bool(bad.any()):  # tpu-lint: ok(trace-hygiene)
+                n_nan = int(jnp.isnan(v).sum())  # tpu-lint: ok(trace-hygiene)
+                n_inf = int(jnp.isinf(v).sum())  # tpu-lint: ok(trace-hygiene)
                 # error path only (never per-op): the crash dump's flight
                 # tail carries the op provenance of the first bad value
                 from ..observability import flight

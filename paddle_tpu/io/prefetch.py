@@ -240,8 +240,12 @@ class DevicePrefetcher:
             it.close()
 
     def stats(self) -> dict:
-        return {"batches": self.batches, "depth": self.depth,
-                "wait_seconds": self.wait_seconds, "stalls": self.stalls}
+        # under the same lock the telemetry sinks take, so a reader
+        # polling from another thread gets a consistent snapshot
+        with self._lock:
+            return {"batches": self.batches, "depth": self.depth,
+                    "wait_seconds": self.wait_seconds,
+                    "stalls": self.stalls}
 
     # -- telemetry sinks (called from both threads) --------------------------
     @staticmethod

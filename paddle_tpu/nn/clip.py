@@ -90,9 +90,16 @@ def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=Fals
         return jnp.sum(jnp.stack(
             [jnp.sum(jnp.abs(g) ** norm_type) for g in gs])) ** (1.0 / norm_type)
     total = apply_op(norm_impl, "grad_norm", tuple(grads), {})
-    scale = max_norm / (float(total.item()) + 1e-6)
-    if scale < 1.0:
-        for p in params:
-            if p.grad is not None:
-                p.grad._replace_(p.grad._value * scale, None)
+
+    # the clip coefficient stays on device (tpu-lint trace-hygiene: the
+    # old float(total.item()) here was a blocking host round-trip per
+    # step); clamping at 1.0 makes the no-clip case an exact *1.0
+    def scale_impl(gv, tv):
+        coef = jnp.minimum(max_norm / (tv + 1e-6), 1.0)
+        return gv * coef.astype(gv.dtype)
+    for p in params:
+        if p.grad is not None:
+            p.grad._replace_(apply_op(
+                scale_impl, "grad_clip_scale", (p.grad, total), {})._value,
+                None)
     return total

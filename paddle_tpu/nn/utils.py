@@ -168,7 +168,9 @@ def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
         total = jnp.sum(jnp.stack(
             [jnp.sum(jnp.abs(p.grad._value.astype(jnp.float64))
                      ** norm_type) for p in params])) ** (1.0 / norm_type)
-    if error_if_nonfinite and not bool(jnp.isfinite(total)):
+    # opt-in error check: materializing the norm is the point (raise on a
+    # host-visible non-finite value before the update applies)
+    if error_if_nonfinite and not bool(jnp.isfinite(total)):  # tpu-lint: ok(trace-hygiene)
         raise RuntimeError(
             "the total norm for gradients is non-finite; disable "
             "error_if_nonfinite to clip anyway")

@@ -297,7 +297,11 @@ class ReduceOnPlateau(LRScheduler):
         if metrics is None:
             return
         from ..core.tensor import Tensor
-        cur = float(metrics.item()) if isinstance(metrics, Tensor) else float(metrics)
+        # epoch-level plateau decision: the comparison chain below needs a
+        # host scalar, one sync per epoch by contract, never per step
+        # tpu-lint: ok(trace-hygiene)
+        cur = float(metrics.item()) \
+            if isinstance(metrics, Tensor) else float(metrics)
         self.last_epoch += 1
         if self.cooldown_counter > 0:
             self.cooldown_counter -= 1

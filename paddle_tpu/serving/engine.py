@@ -171,7 +171,9 @@ class RequestHandle:
     # -- engine internals ----------------------------------------------------
     def _finish(self, error: Optional[BaseException] = None):
         self._state = "done"
-        self._error = error
+        # readers (result/exception) block on the _done Event before
+        # touching _error, so the Event publishes the write
+        self._error = error  # tpu-lint: ok(concurrency)
         self._done.set()
 
     def _emit(self, token: int):
@@ -368,7 +370,9 @@ class Engine:
         EngineClosedError.  Restores the model's train/eval mode."""
         if self._stop:
             return
-        self._stop = True
+        # monitor flag: single False->True transition, polled by the
+        # scheduler loop; a stale read costs one extra 20 ms iteration
+        self._stop = True  # tpu-lint: ok(concurrency)
         self._wake.set()
         if self._thread is not None:
             self._thread.join(timeout=30)
@@ -519,8 +523,10 @@ class Engine:
             except Exception as e:  # noqa: BLE001 — fail loudly, not hang
                 # mark the engine DEAD before failing the in-flight work:
                 # a later submit() must not restart the loop over an
-                # already-failed pool (it raises EngineDeadError instead)
-                self._dead = e
+                # already-failed pool (it raises EngineDeadError instead).
+                # single None->exc transition; racing readers at worst see
+                # the engine alive one sweep late
+                self._dead = e  # tpu-lint: ok(concurrency)
                 flight.record("serving", "scheduler_error",
                               error=f"{type(e).__name__}: {e}")
                 with self._lock:
@@ -642,7 +648,8 @@ class Engine:
                 jnp.asarray(slot_idx), jnp.asarray(plens))
             logits = np.asarray(logits)
         dt = time.perf_counter() - t0
-        self._counts["prefill_batches"] += 1
+        with self._lock:
+            self._counts["prefill_batches"] += 1
         registry().histogram(SERVING_BATCH_SECONDS,
                              "prefill/decode batch wall time").observe(
             dt, labels={"phase": "prefill"})
@@ -660,18 +667,24 @@ class Engine:
     def _decode_step(self) -> bool:
         with self._lock:
             active = self._pool.active()
-        if not active:
-            return False
+            if not active:
+                return False
+            # snapshot the slot-state arrays under the lock: shutdown()
+            # clears _active from the caller thread (tpu-lint
+            # concurrency.unguarded-shared-attr)
+            ids = np.array(self._ids)
+            lengths = np.array(self._lengths)
+            act = np.array(self._active)
         import jax.numpy as jnp
         t0 = time.perf_counter()
         with span("serving.decode", active=len(active)):
             logits, self._kpools, self._vpools, _ = self._decode_fn(
-                self._values, jnp.asarray(self._ids), self._kpools,
-                self._vpools, jnp.asarray(self._lengths),
-                jnp.asarray(self._active))
+                self._values, jnp.asarray(ids), self._kpools,
+                self._vpools, jnp.asarray(lengths), jnp.asarray(act))
             logits = np.asarray(logits)
         dt = time.perf_counter() - t0
-        self._counts["decode_steps"] += 1
+        with self._lock:
+            self._counts["decode_steps"] += 1
         registry().histogram(SERVING_BATCH_SECONDS,
                              "prefill/decode batch wall time").observe(
             dt, labels={"phase": "decode"})
@@ -693,21 +706,22 @@ class Engine:
         decode input or complete + evict the request."""
         token = _sample_row(logits_row, req.temperature, req.top_k, req._rng)
         req._emit(token)
-        self._counts["tokens"] += 1
         registry().counter(SERVING_TOKENS, "tokens generated").inc(1.0)
         finished = (len(req._tokens) >= req.max_new_tokens or
                     (req.eos_token_id is not None and
                      token == req.eos_token_id))
         slot = req.slot
-        if first:
-            self._lengths[slot] = req.prompt.size
-        if finished:
-            with self._lock:
+        with self._lock:
+            self._counts["tokens"] += 1
+            if first:
+                self._lengths[slot] = req.prompt.size
+            if finished:
                 self._evict_locked(req, "completed")
+            else:
+                self._ids[slot, 0] = token
+                self._active[slot] = True
+        if finished:
             req._finish(None)
-        else:
-            self._ids[slot, 0] = token
-            self._active[slot] = True
 
     def _evict_locked(self, req: RequestHandle, outcome: str):
         self._pool.free(req.slot)

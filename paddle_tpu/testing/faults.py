@@ -52,7 +52,22 @@ import threading
 import time
 
 __all__ = ["FaultInjected", "fault_point", "inject", "arm", "disarm",
-           "reset", "hits", "armed"]
+           "reset", "hits", "armed", "CATALOGUE"]
+
+# The operator-facing seam index (docs/robustness.md catalogue).  Every
+# literal ``fault_point("...")`` in the tree must be listed here AND be
+# exercised by the crash-matrix tests — both are enforced statically by
+# tools/tpu_lint.py (rules faults.uncatalogued-seam /
+# faults.uncovered-seam), so a new seam cannot silently ship untested.
+# Dynamic seams (``fault_point(name)`` forwarding fs.upload/fs.download)
+# are accounted for by their entry here.
+CATALOGUE = (
+    "checkpoint.write", "checkpoint.manifest", "checkpoint.commit",
+    "checkpoint.promote", "checkpoint.upload", "checkpoint.upload_commit",
+    "fs.upload", "fs.download",
+    "restore.read", "restore.relayout", "restore.rng",
+    "serving.scheduler", "train.step",
+)
 
 
 class FaultInjected(RuntimeError):

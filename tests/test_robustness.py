@@ -189,6 +189,41 @@ def test_crash_matrix_remote_upload(tmp_path, point):
     np.testing.assert_array_equal(state["w"], _state(1.0)["w"])
 
 
+def test_crash_matrix_remote_download(tmp_path):
+    """The restore-side twin of the upload matrix: a download that keeps
+    failing exhausts the bounded retries and surfaces the original error
+    (no fabricated state), while a transient blip is absorbed and the
+    restored bytes match."""
+    saver = AsyncCheckpointSaver(str(tmp_path / "bucket"), keep_last=3,
+                                 fs=_FakeRemoteFS())
+    saver.save(_state(1.0), step=1, blocking=True)
+    with faults.inject("fs.download", times=None):
+        with pytest.raises(FaultInjected):
+            saver.restore(return_numpy=True)
+    step, state = saver.restore_latest_valid(return_numpy=True)
+    assert step == 1  # hard failure left the remote checkpoint intact
+    with faults.inject("fs.download", exc=OSError("blip"), times=1):
+        state = saver.restore(return_numpy=True)  # retry absorbs it
+    np.testing.assert_array_equal(state["w"], _state(1.0)["w"])
+
+
+def test_crash_matrix_train_step_seam(tmp_path):
+    """A crash injected at the train.step seam (the per-batch fault point
+    inside CheckpointCallback) kills the fit mid-epoch and leaves the
+    last periodic checkpoint restorable."""
+    from paddle_tpu.hapi.callbacks import CheckpointCallback
+    cb = CheckpointCallback(str(tmp_path / "ck"), every_n_steps=2)
+    with faults.inject("train.step", after=3):
+        with pytest.raises(FaultInjected):
+            _hapi_model().fit(_DS(), epochs=2, batch_size=4, verbose=0,
+                              shuffle=False, callbacks=[cb])
+    assert faults.hits("train.step") == 4
+    cb.saver.wait()
+    assert cb.saver.steps() == [2]  # the step-2 periodic save committed
+    step, state = cb.saver.restore_latest_valid(return_numpy=True)
+    assert step == 2 and "train" in state
+
+
 def test_remote_upload_retries_transient_failure(tmp_path):
     saver = AsyncCheckpointSaver(str(tmp_path / "bucket"), keep_last=3,
                                  fs=_FakeRemoteFS())

@@ -1,0 +1,492 @@
+"""tpu-lint (paddle_tpu.analysis) — ISSUE 7: per-rule true-positive and
+should-not-fire fixtures, the suppression-comment path, baseline ratchet
+semantics, and the whole-repo gate (exit 0 at HEAD, non-zero on a seeded
+violation)."""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from paddle_tpu.analysis import (Project, baseline, default_checkers, run)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE = os.path.join(ROOT, "tools", "tpu_lint_baseline.json")
+
+SEEDED_VIOLATION = """\
+import jax
+import jax.numpy as jnp
+
+
+def _helper(y):
+    return jax.device_get(y)
+
+
+@jax.jit
+def seeded_bad_step(x):
+    return _helper(jnp.sum(x))
+"""
+
+
+def _lint(tmp_path, files, tests=None, checkers=None):
+    """Write fixture sources, analyze, return (findings, suppressed)."""
+    root = tmp_path / "pkg"
+    root.mkdir(exist_ok=True)
+    for name, src in files.items():
+        p = root / name
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    project = Project()
+    project.add_root(str(root))
+    troot = tmp_path / "tests"
+    troot.mkdir(exist_ok=True)
+    for name, src in (tests or {}).items():
+        (troot / name).write_text(textwrap.dedent(src))
+    project.add_tests_root(str(troot))
+    return run(project, checkers if checkers is not None
+               else default_checkers())
+
+
+def _rules(findings):
+    return [f.rule for f in findings]
+
+
+# -- trace-hygiene ------------------------------------------------------------
+
+def test_jit_host_sync_through_call_chain(tmp_path):
+    found, _ = _lint(tmp_path, {"m.py": """
+        import jax
+        import numpy as np
+
+        def helper(y):
+            return np.asarray(y)          # sync, reachable from entry
+
+        @jax.jit
+        def step(x):
+            return helper(x)
+
+        def eager_path(x):
+            return np.asarray(x)          # same call, NOT jit-reachable
+    """})
+    hits = [f for f in found if f.rule == "trace-hygiene.jit-host-sync"]
+    assert len(hits) == 1
+    assert hits[0].symbol == "helper"
+    assert "step" in hits[0].message  # names the entry that reaches it
+    assert hits[0].line == 6
+
+
+def test_jit_entry_via_wrapper_call_and_shard_map(tmp_path):
+    found, _ = _lint(tmp_path, {"m.py": """
+        import jax
+
+        def build():
+            def inner(x):
+                return jax.device_get(x)  # nested def passed to jax.jit
+            return jax.jit(inner, donate_argnums=(0,))
+
+        def build_sm(mesh, spec):
+            from jax.experimental.shard_map import shard_map
+            def local(x):
+                return float(x)           # cast on traced param
+            return shard_map(local, mesh=mesh, in_specs=spec,
+                             out_specs=spec)
+    """})
+    rules = _rules(found)
+    assert "trace-hygiene.jit-host-sync" in rules
+    syncs = [f for f in found if f.rule == "trace-hygiene.jit-host-sync"]
+    assert {f.symbol for f in syncs} == {"build.inner", "build_sm.local"}
+
+
+def test_device_sync_taint_dataflow(tmp_path):
+    found, _ = _lint(tmp_path, {"m.py": """
+        import jax.numpy as jnp
+
+        def loss_to_float(x):
+            t = jnp.sum(x * x)
+            u = t / 2 + 1
+            return float(u)               # tainted through arithmetic
+
+        def param_item(metrics):
+            return metrics.item()         # .item() on a parameter
+
+        def fine(learning_rate):
+            lr = float(learning_rate)     # python scalar plumbing: quiet
+            return lr
+    """})
+    dev = [f for f in found if f.rule == "trace-hygiene.device-sync"]
+    assert {f.symbol for f in dev} == {"loss_to_float", "param_item"}
+    assert all(f.symbol != "fine" for f in dev)
+
+
+def test_traced_control_flow_and_static_exemption(tmp_path):
+    found, _ = _lint(tmp_path, {"m.py": """
+        import functools
+        import jax
+
+        @jax.jit
+        def bad(x):
+            if x > 0:                     # branches on a tracer
+                return x
+            return -x
+
+        @functools.partial(jax.jit, static_argnames=("training",))
+        def ok_static(x, training):
+            if training:                  # static: python branch is fine
+                return x * 2
+            return x
+
+        @jax.jit
+        def ok_none(x, mask=None):
+            if mask is None:              # `is None` is python-level
+                return x
+            if x.ndim > 2:                # .shape/.ndim are static
+                return x
+            return x + mask
+    """})
+    flow = [f for f in found
+            if f.rule == "trace-hygiene.traced-control-flow"]
+    assert [f.symbol for f in flow] == ["bad"]
+    assert "`x`" in flow[0].message
+
+
+# -- retrace ------------------------------------------------------------------
+
+def test_retrace_jit_in_loop(tmp_path):
+    found, _ = _lint(tmp_path, {"m.py": """
+        import jax
+
+        def hot(fn, batches):
+            out = []
+            for b in batches:
+                out.append(jax.jit(fn)(b))    # fresh wrapper per iter
+            return out
+
+        def cold(fn, batches):
+            jfn = jax.jit(fn)                 # hoisted: fine
+            return [jfn(b) for b in batches]
+    """})
+    loops = [f for f in found if f.rule == "retrace.jit-in-loop"]
+    assert [f.symbol for f in loops] == ["hot"]
+
+
+def test_retrace_mutable_default_and_unhashable_static(tmp_path):
+    found, _ = _lint(tmp_path, {"m.py": """
+        import functools
+        import jax
+
+        @jax.jit
+        def bad_default(x, opts=[]):
+            return x
+
+        @functools.partial(jax.jit, static_argnames=("cfg",))
+        def bad_static(x, cfg={}):
+            return x
+
+        @jax.jit
+        def ok(x, scale=1.0, axes=(0, 1)):
+            return x * scale
+    """})
+    assert _rules([f for f in found if f.rule.startswith("retrace.")]) == \
+        ["retrace.mutable-default", "retrace.unhashable-static"]
+
+
+def test_retrace_traced_dim_shape(tmp_path):
+    found, _ = _lint(tmp_path, {"m.py": """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def bad(n):
+            return jnp.zeros((n, 4))      # data-dependent shape
+
+        @jax.jit
+        def ok(x):
+            return jnp.zeros((x.shape[0], 4))   # static under trace
+    """})
+    dims = [f for f in found if f.rule == "retrace.traced-dim-shape"]
+    assert [f.symbol for f in dims] == ["bad"]
+
+
+# -- concurrency --------------------------------------------------------------
+
+_WORKER = """
+    import threading
+
+    class Worker:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._stop = threading.Event()   # sync object: exempt
+            self.count = 0
+            self.done = 0
+
+        def start(self):
+            self._t = threading.Thread(target=self._run, daemon=True)
+            self._t.start()
+
+        def _run(self):
+            while not self._stop.is_set():
+                self.count += 1              {count_guard}
+
+        def stats(self):
+            {stats_body}
+"""
+
+
+def test_unguarded_shared_attr_positive(tmp_path):
+    found, _ = _lint(tmp_path, {"m.py": _WORKER.format(
+        count_guard="", stats_body="return self.count")})
+    shared = [f for f in found
+              if f.rule == "concurrency.unguarded-shared-attr"]
+    assert len(shared) == 1
+    assert "`self.count`" in shared[0].message
+    # the Event attr never fires — sync objects are exempt
+    assert all("_stop" not in f.message for f in shared)
+
+
+def test_guarded_both_sides_is_quiet(tmp_path):
+    src = """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.count = 0
+
+            def start(self):
+                self._t = threading.Thread(target=self._run)
+                self._t.start()
+
+            def _run(self):
+                with self._lock:
+                    self.count += 1
+
+            def _bump_locked(self):
+                self.count += 1          # *_locked convention: guarded
+
+            def stats(self):
+                with self._lock:
+                    return self.count
+    """
+    found, _ = _lint(tmp_path, {"m.py": src})
+    assert not [f for f in found
+                if f.rule == "concurrency.unguarded-shared-attr"]
+
+
+def test_suppression_comment_moves_finding_aside(tmp_path):
+    found, suppressed = _lint(tmp_path, {"m.py": _WORKER.format(
+        count_guard="# tpu-lint: ok(concurrency)",
+        stats_body="return self.count")})
+    assert not [f for f in found
+                if f.rule == "concurrency.unguarded-shared-attr"]
+    assert [f.rule for f in suppressed] == \
+        ["concurrency.unguarded-shared-attr"]
+    # a suppression for a DIFFERENT rule family does not silence it
+    found2, _ = _lint(tmp_path, {"m.py": _WORKER.format(
+        count_guard="# tpu-lint: ok(retrace)",
+        stats_body="return self.count")})
+    assert [f.rule for f in found2
+            if f.rule == "concurrency.unguarded-shared-attr"]
+
+
+def test_signal_unsafe_handler(tmp_path):
+    found, _ = _lint(tmp_path, {"m.py": """
+        import logging
+        import signal
+        import threading
+
+        logger = logging.getLogger("x")
+        _flag = threading.Event()
+        _lock = threading.Lock()
+
+        def _chained():
+            with _lock:
+                logger.warning("dying")   # lock + logging in handler path
+
+        def _handler(sig, frame):
+            _chained()
+
+        def _quiet_handler(sig, frame):
+            _flag.set()                   # flag-only: async-signal-safe
+
+        def install():
+            signal.signal(signal.SIGTERM, _handler)
+            signal.signal(signal.SIGINT, _quiet_handler)
+    """})
+    sig = [f for f in found if f.rule == "concurrency.signal-unsafe"]
+    assert len(sig) == 2                  # the with-lock and the logging
+    assert all(f.symbol == "_chained" for f in sig)
+    assert all("_handler" in f.message for f in sig)
+
+
+# -- fault-point coverage -----------------------------------------------------
+
+def test_fault_coverage_and_catalogue(tmp_path):
+    files = {
+        "prod.py": """
+            from .testing import faults
+
+            def save():
+                faults.fault_point("ck.write")
+                faults.fault_point("ck.orphan")
+        """,
+        "testing/__init__.py": "",
+        "testing/faults.py": """
+            CATALOGUE = ("ck.write", "ck.dynamic")
+
+            def fault_point(name, **ctx):
+                pass
+        """,
+    }
+    tests = {"test_crash.py": """
+        def test_matrix():
+            arm("ck.write:kill:after=2")   # env-spec literal counts
+    """}
+    found, _ = _lint(tmp_path, files, tests=tests)
+    uncovered = {f.symbol for f in found
+                 if f.rule == "faults.uncovered-seam"}
+    # ck.orphan (declared, untested) and ck.dynamic (catalogued, untested)
+    assert uncovered == {"ck.orphan", "ck.dynamic"}
+    uncat = [f for f in found if f.rule == "faults.uncatalogued-seam"]
+    assert [f.symbol for f in uncat] == ["ck.orphan"]
+
+
+def test_repo_fault_points_all_covered_and_catalogued():
+    """Acceptance: every declared seam appears in the crash-matrix tests
+    and in faults.CATALOGUE — at HEAD the rule is completely quiet."""
+    project = Project()
+    project.add_root(os.path.join(ROOT, "paddle_tpu"))
+    project.add_tests_root(os.path.join(ROOT, "tests"))
+    project.add_tests_root(os.path.join(ROOT, "tools", "chaos_smoke.py"))
+    found, _ = run(project, default_checkers())
+    faults_findings = [f for f in found if f.rule.startswith("faults.")]
+    assert faults_findings == []
+    from paddle_tpu.testing import faults as faults_mod
+    assert "train.step" in faults_mod.CATALOGUE
+    assert "fs.download" in faults_mod.CATALOGUE
+
+
+# -- baseline ratchet ---------------------------------------------------------
+
+def _fake_findings(*msgs):
+    from paddle_tpu.analysis import Finding
+    return [Finding("r.x", "a.py", i + 1, symbol="s", message=m)
+            for i, m in enumerate(msgs)]
+
+
+def test_baseline_ratchet_semantics(tmp_path):
+    path = str(tmp_path / "base.json")
+    baseline.update(path, _fake_findings("one", "two"))  # initial freeze
+    data = baseline.load(path)
+
+    # unchanged -> nothing new; a line move must not matter
+    moved = _fake_findings("one", "two")
+    for f in moved:
+        f.line += 100
+    new, fixed = baseline.compare(moved, data)
+    assert new == [] and fixed == []
+
+    # a new finding is flagged even with old ones present
+    new, fixed = baseline.compare(_fake_findings("one", "two", "three"),
+                                  data)
+    assert [f.message for f in new] == ["three"] and fixed == []
+
+    # shrink is reported and may be re-frozen
+    new, fixed = baseline.compare(_fake_findings("one"), data)
+    assert new == [] and len(fixed) == 1
+    baseline.update(path, _fake_findings("one"))
+    assert len(baseline.load(path)["findings"]) == 1
+
+    # ...but growth is refused without --force
+    with pytest.raises(ValueError, match="only shrink"):
+        baseline.update(path, _fake_findings("one", "grown"))
+    baseline.update(path, _fake_findings("one", "grown"), force=True)
+    assert len(baseline.load(path)["findings"]) == 2
+
+
+def test_baseline_counts_duplicate_fingerprints(tmp_path):
+    path = str(tmp_path / "base.json")
+    two = _fake_findings("same", "same")
+    for f in two:
+        f.line = 7  # identical fingerprint, two occurrences
+    baseline.update(path, two)
+    data = baseline.load(path)
+    assert data["findings"][0]["count"] == 2
+    new, _ = baseline.compare(two, data)
+    assert new == []
+    three = _fake_findings("same", "same", "same")
+    new, _ = baseline.compare(three, data)
+    assert len(new) == 1  # the third occurrence is NEW
+
+
+# -- the whole-repo gate (tier-1 acceptance) ---------------------------------
+
+def _run_cli(*args, cwd=ROOT):
+    return subprocess.run(
+        [sys.executable, os.path.join("tools", "tpu_lint.py"), *args],
+        cwd=cwd, capture_output=True, text=True)
+
+
+def test_repo_gate_is_green_at_head():
+    res = _run_cli("paddle_tpu", "--baseline",
+                   os.path.join("tools", "tpu_lint_baseline.json"))
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "0 NEW" in res.stderr
+
+
+def test_repo_gate_fails_on_seeded_violation(tmp_path):
+    bad = tmp_path / "seeded_violation.py"
+    bad.write_text(SEEDED_VIOLATION)
+    res = _run_cli("paddle_tpu", str(bad), "--baseline",
+                   os.path.join("tools", "tpu_lint_baseline.json"))
+    assert res.returncode == 1, res.stdout + res.stderr
+    assert "trace-hygiene.jit-host-sync" in res.stdout
+    assert "seeded_violation.py" in res.stdout
+    # and the ratchet refuses to swallow it into the baseline
+    res2 = _run_cli("paddle_tpu", str(bad), "--baseline",
+                    os.path.join("tools", "tpu_lint_baseline.json"),
+                    "--update-baseline")
+    assert res2.returncode == 2
+    assert "only shrink" in res2.stderr
+    # the checked-in baseline file was not touched
+    with open(BASELINE) as f:
+        assert json.load(f)["schema"] == "tpu_lint.baseline.v1"
+
+
+def test_cli_json_format(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(SEEDED_VIOLATION)
+    res = _run_cli(str(bad), "--format", "json")
+    assert res.returncode == 1
+    payload = json.loads(res.stdout)
+    assert payload["counts"]["findings"] >= 1
+    rules = {f["rule"] for f in payload["findings"]}
+    assert "trace-hygiene.jit-host-sync" in rules
+    f0 = payload["findings"][0]
+    assert set(f0) == {"rule", "path", "line", "col", "symbol", "message",
+                       "hint"}
+
+
+def test_cli_checker_subset_and_unknown(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(SEEDED_VIOLATION)
+    res = _run_cli(str(bad), "--checkers", "concurrency")
+    assert res.returncode == 0  # trace-hygiene not selected -> quiet
+    res = _run_cli(str(bad), "--checkers", "nope")
+    assert res.returncode == 2 and "unknown checker" in res.stderr
+
+
+def test_analyzer_runs_without_importing_jax():
+    """The CLI must stay importable/runnable with the runtime broken —
+    prove it never imports paddle_tpu or jax."""
+    res = subprocess.run(
+        [sys.executable, "-c",
+         "import sys\n"
+         "import tools.tpu_lint as t\n"
+         "rc = t.main(['paddle_tpu/analysis'])\n"
+         "assert 'jax' not in sys.modules, 'CLI imported jax'\n"
+         "assert 'paddle_tpu' not in sys.modules, 'CLI imported the pkg'\n"
+         "sys.exit(rc)"],
+        cwd=ROOT, capture_output=True, text=True)
+    assert res.returncode == 0, res.stdout + res.stderr

@@ -1,0 +1,71 @@
+#!/usr/bin/env python
+"""Standalone tpu-lint gate lane (ISSUE 7 satellite).
+
+    python tools/lint_smoke.py            # ratchet gate over paddle_tpu/
+    python tools/lint_smoke.py --self     # + analyzer self-checks
+
+Runs ``tools/tpu_lint.py paddle_tpu/ --baseline tools/tpu_lint_baseline
+.json`` in its own interpreter so the gate fires even when pytest
+subsets have unrelated failures (the same posture as telemetry_smoke /
+chaos_smoke — which also invokes this lane).  ``--self`` additionally
+proves the gate can still *fail*: a seeded host-sync violation in a
+scratch file must flip the exit code, and the ratchet must refuse to
+grow the baseline over it.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import tempfile
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_BASELINE = os.path.join("tools", "tpu_lint_baseline.json")
+
+_SEED = '''\
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def seeded_bad_step(x):
+    y = jnp.sum(x)
+    return jax.device_get(y)
+'''
+
+
+def _lint(*paths, flags=()) -> int:
+    cmd = [sys.executable, os.path.join("tools", "tpu_lint.py"),
+           "paddle_tpu", *paths, "--baseline", _BASELINE, *flags]
+    print("lint smoke:", " ".join(cmd), file=sys.stderr)
+    return subprocess.call(cmd, cwd=_ROOT)
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    rc = _lint()
+    if rc != 0:
+        print("lint smoke: ratchet gate FAILED (new findings above)",
+              file=sys.stderr)
+        return rc
+    if "--self" in argv:
+        with tempfile.TemporaryDirectory() as tmp:
+            bad = os.path.join(tmp, "seeded_violation.py")
+            with open(bad, "w") as f:
+                f.write(_SEED)
+            if _lint(bad) != 1:
+                print("lint smoke: seeded violation NOT caught",
+                      file=sys.stderr)
+                return 1
+            if _lint(bad, flags=("--update-baseline",)) == 0:
+                print("lint smoke: ratchet allowed the baseline to GROW",
+                      file=sys.stderr)
+                return 1
+        print("lint smoke: self-checks ok (seeded violation caught, "
+              "ratchet held)", file=sys.stderr)
+    print("lint smoke: ok", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

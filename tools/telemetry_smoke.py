@@ -105,6 +105,16 @@ def main() -> int:
         if lane_rc != 0:
             print("prefetch lane FAILED", file=sys.stderr)
         rc = rc or lane_rc
+        # tpu-lint ratchet gate (ISSUE 7): runs even when the pytest
+        # subset has unrelated failures, in its own interpreter (the
+        # analyzer is jax-free, so it cannot be broken by runtime drift)
+        print("telemetry smoke: tpu-lint ratchet lane", file=sys.stderr)
+        lint_rc = subprocess.call(
+            [sys.executable, os.path.join("tools", "lint_smoke.py")],
+            env=env, cwd=root)
+        if lint_rc != 0:
+            print("tpu-lint lane FAILED", file=sys.stderr)
+        rc = rc or lint_rc
     return rc
 
 

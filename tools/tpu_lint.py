@@ -1,0 +1,45 @@
+#!/usr/bin/env python
+"""tpu-lint CLI — static TPU-hazard analysis with a ratchet baseline.
+
+    python tools/tpu_lint.py paddle_tpu/ --baseline tools/tpu_lint_baseline.json
+
+Thin wrapper over :mod:`paddle_tpu.analysis` that loads the analysis
+package *standalone* (it is stdlib-only and uses intra-package relative
+imports exclusively), so linting never imports paddle_tpu or jax — the
+gate runs in milliseconds and works even when the runtime deps are
+broken, which is exactly when you want CI signal.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_analysis():
+    """Import paddle_tpu/analysis as the standalone package `_tpu_lint`
+    (dodges paddle_tpu/__init__.py and its jax import)."""
+    if "paddle_tpu" in sys.modules:  # already imported (tests): use it
+        import paddle_tpu.analysis as analysis
+        return analysis
+    pkg_dir = os.path.join(_ROOT, "paddle_tpu", "analysis")
+    spec = importlib.util.spec_from_file_location(
+        "_tpu_lint", os.path.join(pkg_dir, "__init__.py"),
+        submodule_search_locations=[pkg_dir])
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["_tpu_lint"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv=None) -> int:
+    analysis = _load_analysis()
+    cli = __import__(analysis.__name__ + ".cli",
+                     fromlist=["main"])
+    return cli.main(argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
