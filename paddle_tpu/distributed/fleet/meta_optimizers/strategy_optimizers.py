@@ -185,12 +185,17 @@ class AdaptiveLocalSGDOptimizer(LocalSGDOptimizer):
         self._first_norm: Optional[float] = None
 
     def _grad_norm(self) -> float:
-        tot = 0.0
+        """Squared-norm accumulation stays on device; ONE scalar crosses
+        to host per step (was one blocking float() per parameter)."""
+        total = None
         for p in self._inner._parameters:
             if p.grad is not None:
-                tot += float(jnp.sum(jnp.square(
-                    p.grad._value.astype(jnp.float32))))
-        return float(np.sqrt(tot))
+                sq = jnp.sum(jnp.square(p.grad._value.astype(jnp.float32)))
+                total = sq if total is None else total + sq
+        if total is None:
+            return 0.0
+        # the single host transfer: k_steps adaptation is python-side
+        return float(np.sqrt(np.asarray(total)))
 
     @no_grad()
     def step(self):

@@ -92,13 +92,16 @@ class FakeQuanterWithAbsMaxObserver(Layer):
         return quant_dequant(x, self.scale, bits=self.bit_length)
 
     def _observe_value(self, xv):
-        """EMA of batch abs-maxes.  Device-side reduce: only a SCALAR
-        crosses to host per observed forward.  Subclasses that need the
-        full distribution (HistObserver) override this."""
-        cur = float(jnp.max(jnp.abs(xv))) if xv.size else 0.0
-        old = float(np.asarray(self.scale._value))
+        """EMA of batch abs-maxes, kept ENTIRELY on device: the abs-max
+        reduce, the blend and the stored scale are device values, so an
+        observed forward no longer blocks on a host transfer (was two
+        per batch).  Subclasses that need the full distribution
+        (HistObserver) override this."""
+        cur = (jnp.max(jnp.abs(xv)).astype(jnp.float32) if xv.size
+               else jnp.zeros((), jnp.float32))
         new = cur if not self._seen else \
-            self.moving_rate * old + (1 - self.moving_rate) * cur
+            self.moving_rate * self.scale._value + \
+            (1 - self.moving_rate) * cur
         self.scale._replace_(jnp.asarray(new, jnp.float32), None)
         self._seen = True
 

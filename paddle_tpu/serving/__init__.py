@@ -13,6 +13,13 @@ compiled decode program over a fixed slot pool.
     tokens = handle.result(timeout=60)     # or handle.cancel()
     engine.shutdown()
 
+The HTTP traffic layer (OpenAI-compatible completions, per-tenant
+fair-share admission, telemetry-driven load shedding, multi-replica
+routing) lives in :mod:`paddle_tpu.serving.gateway`::
+
+    from paddle_tpu.serving.gateway import start_gateway
+    stack = start_gateway([engine])        # POST /v1/completions
+
 See docs/serving.md for the architecture, tuning and telemetry fields.
 """
 from .engine import (  # noqa: F401

@@ -235,12 +235,21 @@ class Engine:
         eos_token_id: default end-of-sequence id for requests.
         auto_start: start the scheduler thread on first submit (tests set
             False to stage a queue deterministically, then call start()).
+        admission_hook: optional ``hook(request, load)`` called by
+            ``submit`` after validation, BEFORE the request enters the
+            queue, with the would-be :class:`RequestHandle` and a
+            :meth:`load` snapshot.  Raising any exception rejects the
+            request (counted as ``rejected``) and propagates to the
+            caller — the seam an external admission layer (the serving
+            gateway) uses to shed load without reaching into engine
+            internals.
     """
 
     def __init__(self, model, tokenizer=None, max_slots: int = 8,
                  max_len: int = 256, max_queue: Optional[int] = None,
                  prefill_batch: Optional[int] = None, eos_token_id=None,
-                 auto_start: bool = True):
+                 auto_start: bool = True,
+                 admission_hook: Optional[Callable] = None):
         self.model = model
         self.tokenizer = tokenizer
         self.max_slots = int(max_slots)
@@ -260,6 +269,7 @@ class Engine:
                                               self.max_slots)))
         self.eos_token_id = eos_token_id
         self._auto_start = bool(auto_start)
+        self.admission_hook = admission_hook
 
         self._pool = SlotPool(self.max_slots)
         self._queue: deque = deque()
@@ -319,6 +329,19 @@ class Engine:
         eos = self.eos_token_id if eos_token_id is ... else eos_token_id
         req = RequestHandle(self, ids, max_new_tokens, eos, temperature,
                             top_k, seed, deadline_s, stream)
+        hook = self.admission_hook
+        if hook is not None:
+            try:
+                hook(req, self.load())
+            except Exception:
+                with self._lock:
+                    self._counts["rejected"] += 1
+                flight.record("serving", "reject", request=req.request_id,
+                              reason="admission_hook")
+                registry().counter(
+                    SERVING_REQUESTS, "serving requests by outcome").inc(
+                    1.0, labels={"outcome": "rejected"})
+                raise
         with self._lock:
             if len(self._queue) >= self.max_queue:
                 self._counts["rejected"] += 1
@@ -399,6 +422,32 @@ class Engine:
         return False
 
     # -- introspection -------------------------------------------------------
+    def queue_depth(self) -> int:
+        """Unadmitted queued requests right now (O(1), one lock hop)."""
+        with self._lock:
+            return len(self._queue)
+
+    def slots_in_use(self) -> int:
+        """Slots currently owned by in-flight requests (O(1) — the pool
+        keeps the count; no slot-array scan)."""
+        with self._lock:
+            return self._pool.n_active
+
+    def load(self) -> dict:
+        """One-lock-hop load snapshot for external admission/routing
+        (queue depth, slot occupancy, capacity, liveness).  Every field
+        comes from O(1) counters — safe to poll per-request from a
+        gateway without perturbing the scheduler."""
+        with self._lock:
+            return {
+                "queue_depth": len(self._queue),
+                "slots_in_use": self._pool.n_active,
+                "max_slots": self.max_slots,
+                "max_queue": self.max_queue,
+                "max_len": self.max_len,
+                "alive": self._dead is None and not self._stop,
+            }
+
     def stats(self) -> dict:
         with self._lock:
             out = dict(self._counts)

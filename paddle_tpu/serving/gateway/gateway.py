@@ -1,0 +1,446 @@
+"""Gateway core — admission, shedding, dispatch over engine replicas.
+
+The traffic path, socket to slot pool::
+
+    HTTP handler threads (http.py)
+        parse -> tenant -> Gateway.admit()
+                     |         |-- shed check (LoadShedder: est TTFT vs
+                     |         |   deadline) -> 429 + Retry-After
+                     |         `-- FairShareScheduler.enqueue (per-tenant
+                     |             caps -> structured 429)
+                     |  wait/stream on the GatewayRequest
+        dispatcher thread (one per gateway)
+            pop fair-share winner -> EngineRouter.pick (least loaded,
+            skips DEAD replicas) -> Engine.submit(stream=token queue)
+            reap finished handles -> release tenant slot, feed the
+            shedder's EWMAs, per-tenant TTFT histograms
+
+Thread-shape invariants (the tpu-lint concurrency checker runs over this
+package): every handler<->dispatcher handoff crosses on a
+``queue.Queue``/``threading.Event`` or inside the scheduler's lock; the
+dispatcher's outstanding-request list is a local variable of its loop,
+shared with nobody.
+"""
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+import time
+
+import numpy as np
+
+from ...observability import flight, registry
+from ..engine import EngineDeadError, QueueFullError
+from .admission import AdmissionError, FairShareScheduler, TenantConfig
+from .protocol import PRIORITIES, CompletionRequest, ProtocolError
+from .router import EngineRouter, NoEngineAvailableError
+from .shed import LoadShedder
+
+__all__ = ["Gateway", "GatewayClosedError", "GatewayRequest"]
+
+# -- metric names (paddle_tpu.observability registry) -------------------------
+GATEWAY_REQUESTS = "paddle_tpu_gateway_requests_total"
+GATEWAY_QUEUE_DEPTH = "paddle_tpu_gateway_queue_depth"
+GATEWAY_INFLIGHT = "paddle_tpu_gateway_inflight"
+GATEWAY_TTFT = "paddle_tpu_gateway_ttft_seconds"
+GATEWAY_TTFT_EST = "paddle_tpu_gateway_ttft_estimate_seconds"
+GATEWAY_SHED = "paddle_tpu_gateway_shed_total"
+
+_ids = itertools.count(1)
+
+
+class GatewayClosedError(RuntimeError):
+    """The gateway shut down with this request still queued (503)."""
+
+
+class GatewayRequest:
+    """One admitted request crossing the handler/dispatcher boundary.
+
+    The handler thread blocks on :attr:`ready` (dispatch or failure —
+    ``handle``/``error`` are written before the event is set, which
+    publishes them), then on the engine handle; streamed tokens arrive on
+    :attr:`token_q` from the engine's scheduler thread.
+    """
+
+    __slots__ = ("id", "creq", "tenant", "priority", "cost", "prompt",
+                 "t_enqueue", "t_dispatch", "token_q", "ready", "handle",
+                 "error", "engine_name", "deadline")
+
+    def __init__(self, creq: CompletionRequest, tenant: str, priority: str,
+                 prompt: np.ndarray):
+        self.id = f"cmpl-{next(_ids)}"
+        self.creq = creq
+        self.tenant = tenant
+        self.priority = priority
+        self.prompt = prompt
+        self.cost = float(prompt.size + creq.max_tokens)
+        now = time.perf_counter()
+        self.t_enqueue = now
+        self.t_dispatch: float | None = None
+        self.deadline = (None if creq.deadline_s is None
+                         else now + creq.deadline_s)
+        self.token_q: queue.Queue = queue.Queue()
+        self.ready = threading.Event()
+        self.handle = None
+        self.error: BaseException | None = None
+        self.engine_name: str | None = None
+
+    def fail(self, error: BaseException):
+        self.error = error
+        self.ready.set()
+
+    def dispatched(self, handle, engine_name: str):
+        self.handle = handle
+        self.engine_name = engine_name
+        self.t_dispatch = time.perf_counter()
+        self.ready.set()
+
+
+class Gateway:
+    """Multi-tenant front door over one or more serving engines.
+
+    Args:
+        engines: Engine replica(s) — the gateway does NOT own them; shut
+            them down separately (or use ``start_gateway`` from http.py,
+            whose ``close()`` tears the whole stack down).
+        tenants: iterable of :class:`TenantConfig` (unknown tenants get
+            ``default_tenant``'s policy).
+        default_tenant: policy template for unconfigured tenants.
+        api_keys: optional {key: tenant} map; when set, requests without a
+            known key are 401 (strict mode).
+        names: router replica names (default engine0..N-1).
+        shedder: optionally pre-seeded :class:`LoadShedder`.
+        max_queue_total: global queued-request bound across tenants.
+        dispatch_slack: how deep past the slot pool the dispatcher lets an
+            engine's own queue grow (small = ordering stays fair-share).
+        model_name: echoed in completion responses.
+        start: start the dispatcher thread immediately (tests stage
+            queues deterministically with False, then call start()).
+    """
+
+    def __init__(self, engines, tenants=None, *,
+                 default_tenant: TenantConfig | None = None,
+                 api_keys: dict | None = None, names=None,
+                 shedder: LoadShedder | None = None,
+                 max_queue_total: int | None = None, dispatch_slack: int = 1,
+                 model_name: str = "paddle-tpu", start: bool = True):
+        if hasattr(engines, "submit"):
+            engines = [engines]
+        self.router = EngineRouter(engines, names=names)
+        self.scheduler = FairShareScheduler(
+            tenants, default=default_tenant, max_queue_total=max_queue_total)
+        self.shedder = shedder or LoadShedder()
+        self.api_keys = dict(api_keys) if api_keys else None
+        self.model_name = model_name
+        self.dispatch_slack = int(dispatch_slack)
+        self.tokenizer = next(
+            (e.tokenizer for e in self.router.engines
+             if e.tokenizer is not None), None)
+        self._stop_ev = threading.Event()
+        self._thread: threading.Thread | None = None
+        if start:
+            self.start()
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self):
+        if self._stop_ev.is_set():
+            raise GatewayClosedError("gateway is shut down")
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._dispatch_loop, name="paddle-tpu-gateway",
+                daemon=True)
+            self._thread.start()
+
+    def shutdown(self):
+        """Stop dispatching; queued requests fail with
+        :class:`GatewayClosedError` (503 at the wire).  Idempotent; does
+        not shut the engines down."""
+        if self._stop_ev.is_set():
+            return
+        self._stop_ev.set()
+        self.scheduler.close()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        err = GatewayClosedError("gateway shut down")
+        for item in self.scheduler.drain():
+            item.fail(err)
+            self._count(item.tenant, "failed")
+        flight.record("gateway", "shutdown")
+
+    close = shutdown
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+        return False
+
+    # -- admission (handler threads) -----------------------------------------
+    def admit(self, creq: CompletionRequest, tenant: str) -> GatewayRequest:
+        """Validate fit, run the shed check, enqueue under the tenant's
+        fair-share caps.  Raises ProtocolError (4xx), AdmissionError
+        (429, incl. SLO shed) or GatewayClosedError (503)."""
+        if self._stop_ev.is_set():
+            raise GatewayClosedError("gateway is shut down")
+        if not self.router.any_alive():
+            raise NoEngineAvailableError(
+                "no alive engine replica to serve this request")
+        prompt = self._prompt_ids(creq)
+        self.eos_for(creq)               # reject a bad stop field up front
+        max_len = self.router.min_max_len()
+        if prompt.size + creq.max_tokens > max_len:
+            raise ProtocolError(
+                400, f"prompt ({prompt.size}) + max_tokens "
+                f"({creq.max_tokens}) exceeds the serving window "
+                f"({max_len})", param="max_tokens", code="context_window")
+        cfg = self.scheduler.tenant_config(tenant)
+        priority = creq.priority or cfg.priority
+        item = GatewayRequest(creq, tenant, priority, prompt)
+
+        backlog = self.scheduler.backlog_cost(priority) + item.cost
+        slots = self.router.total_slots()
+        decision = self.shedder.decide(creq.deadline_s, backlog, slots)
+        reg = registry()
+        if decision.est_ttft_s is not None:
+            reg.gauge(GATEWAY_TTFT_EST,
+                      "estimated TTFT for a request joining now").set(
+                decision.est_ttft_s)
+        if not decision.admit:
+            self._count(tenant, "shed")
+            reg.counter(GATEWAY_SHED, "requests shed by reason").inc(
+                1.0, labels={"tenant": tenant, "reason": "slo_shed"})
+            flight.record("gateway", "shed", request=item.id, tenant=tenant,
+                          est_ttft_ms=round(decision.est_ttft_s * 1e3, 1),
+                          deadline_ms=round(creq.deadline_s * 1e3, 1),
+                          backlog_tokens=round(backlog, 1))
+            raise AdmissionError(
+                "slo_shed", decision.reason,
+                retry_after_s=decision.retry_after_s, tenant=tenant,
+                est_ttft_s=decision.est_ttft_s)
+        try:
+            self.scheduler.enqueue(item)
+        except AdmissionError as e:
+            self._count(tenant, "rejected")
+            reg.counter(GATEWAY_SHED, "requests shed by reason").inc(
+                1.0, labels={"tenant": tenant, "reason": e.reason})
+            flight.record("gateway", "shed", request=item.id, tenant=tenant,
+                          reason=e.reason)
+            raise
+        self._count(tenant, "accepted")
+        self._depth_gauges()
+        flight.record("gateway", "admit", request=item.id, tenant=tenant,
+                      priority=priority, prompt_len=int(prompt.size),
+                      max_tokens=creq.max_tokens)
+        return item
+
+    def _prompt_ids(self, creq: CompletionRequest) -> np.ndarray:
+        prompt = creq.prompt
+        if isinstance(prompt, str):
+            if self.tokenizer is None:
+                raise ProtocolError(400, "string prompt needs a tokenizer",
+                                    param="prompt", code="no_tokenizer")
+            prompt = self.tokenizer.encode(prompt)
+        ids = np.asarray(prompt, np.int64).reshape(-1)
+        if ids.size < 1:
+            raise ProtocolError(400, "'prompt' is empty", param="prompt",
+                                code="empty_prompt")
+        return ids
+
+    def eos_for(self, creq: CompletionRequest):
+        """Resolve the request's stop field to an eos token id."""
+        stop = creq.stop
+        if stop is None:
+            return ...                   # engine default
+        if isinstance(stop, str):
+            if self.tokenizer is None:
+                raise ProtocolError(400, "string 'stop' needs a tokenizer",
+                                    param="stop", code="no_tokenizer")
+            ids = self.tokenizer.encode(stop)
+            ids = np.asarray(ids, np.int64).reshape(-1)
+            if ids.size != 1:
+                raise ProtocolError(
+                    400, "'stop' must encode to a single token",
+                    param="stop", code="invalid_stop")
+            return int(ids[0])
+        return int(stop)
+
+    # -- result wait (handler threads) ---------------------------------------
+    def result(self, item: GatewayRequest, timeout: float | None = None):
+        """Block for the finished request; returns (token_ids, finish
+        reason).  Engine/gateway failures re-raise for http.py to map."""
+        if not item.ready.wait(timeout):
+            raise TimeoutError(f"request {item.id} was not dispatched "
+                               f"within {timeout}s")
+        if item.error is not None:
+            raise item.error
+        tokens = item.handle.result(timeout=timeout)
+        eos = item.handle.eos_token_id
+        finish = ("stop" if eos is not None and tokens.size and
+                  int(tokens[-1]) == eos else "length")
+        return tokens, finish
+
+    # -- dispatcher thread ---------------------------------------------------
+    def _dispatch_loop(self):
+        outstanding: list = []       # local to this thread — never shared
+        while True:
+            self._reap(outstanding)
+            if self._stop_ev.is_set():
+                break
+            if not self.router.has_headroom(self.dispatch_slack):
+                if not self.router.any_alive():
+                    # every replica died with work queued: fail it loudly
+                    # instead of letting handlers hang to their timeout
+                    item = self.scheduler.pop(timeout=0.02)
+                    if item is not None:
+                        self.scheduler.release(item.tenant, item.cost)
+                        self._count(item.tenant, "failed")
+                        item.fail(NoEngineAvailableError(
+                            "every engine replica is dead"))
+                    continue
+                time.sleep(0.002)
+                continue
+            item = self.scheduler.pop(timeout=0.02)
+            if item is None:
+                continue
+            if item.deadline is not None and \
+                    time.perf_counter() > item.deadline:
+                # expired while queued (shed model was cold or wrong):
+                # fail it NOW, before it burns a slot
+                self.scheduler.release(item.tenant, item.cost)
+                self._count(item.tenant, "expired_queued")
+                item.fail(AdmissionError(
+                    "deadline_queued",
+                    f"request {item.id} deadline passed while queued",
+                    retry_after_s=0.5, tenant=item.tenant))
+                self._depth_gauges()
+                continue
+            if not self._submit(item):
+                continue
+            outstanding.append(item)
+            self._depth_gauges()
+        # drain the reap list so tenants aren't left owing slots
+        deadline = time.perf_counter() + 5.0
+        while outstanding and time.perf_counter() < deadline:
+            self._reap(outstanding)
+            if outstanding:
+                time.sleep(0.01)
+
+    def _submit(self, item: GatewayRequest) -> bool:
+        """Route one popped item to a replica.  True when submitted;
+        False when it was requeued or failed (accounting settled)."""
+        creq = item.creq
+        remaining = (None if item.deadline is None
+                     else max(0.05, item.deadline - time.perf_counter()))
+        tried: list = []
+        while True:
+            try:
+                name, engine = self.router.pick(exclude=tried)
+            except NoEngineAvailableError as e:
+                self.scheduler.release(item.tenant, item.cost)
+                self._count(item.tenant, "failed")
+                item.fail(e)
+                return False
+            try:
+                handle = engine.submit(
+                    item.prompt, max_new_tokens=creq.max_tokens,
+                    eos_token_id=self.eos_for(creq),
+                    temperature=creq.temperature, top_k=creq.top_k,
+                    seed=creq.seed, deadline_s=remaining,
+                    stream=item.token_q.put)
+            except QueueFullError:
+                tried.append(name)
+                if len(tried) >= len(self.router.names):
+                    # every replica is briefly full: put the item back at
+                    # the head of its tenant queue and let headroom gating
+                    # retry — fair-share order is preserved
+                    self.scheduler.requeue(item)
+                    time.sleep(0.002)
+                    return False
+                continue
+            except EngineDeadError:
+                tried.append(name)
+                flight.record("gateway", "failover", request=item.id,
+                              engine=name)
+                continue
+            except Exception as e:  # noqa: BLE001 — surface to the caller
+                self.scheduler.release(item.tenant, item.cost)
+                self._count(item.tenant, "failed")
+                item.fail(e)
+                return False
+            item.dispatched(handle, name)
+            flight.record("gateway", "dispatch", request=item.id,
+                          tenant=item.tenant, engine=name,
+                          queue_wait_ms=round(
+                              1e3 * (item.t_dispatch - item.t_enqueue), 2))
+            return True
+
+    def _reap(self, outstanding: list):
+        """Retire finished engine handles: release the tenant's
+        concurrency unit, feed the shedder, record per-tenant TTFT."""
+        done = [it for it in outstanding if it.handle.done()]
+        if not done:
+            return
+        reg = registry()
+        for item in done:
+            outstanding.remove(item)
+            self.scheduler.release(item.tenant, item.cost)
+            err = item.handle.exception(timeout=0)
+            if err is None:
+                self._count(item.tenant, "completed")
+                self.shedder.observe(item.handle.ttft_s,
+                                     item.handle.token_latencies_s)
+                if item.handle.ttft_s is not None:
+                    gw_ttft = (item.t_dispatch - item.t_enqueue) + \
+                        item.handle.ttft_s
+                    reg.histogram(
+                        GATEWAY_TTFT,
+                        "enqueue -> first token, per tenant").observe(
+                        gw_ttft, labels={"tenant": item.tenant})
+            else:
+                # engine-side failure after dispatch (deadline inside the
+                # engine, cancellation, engine death): the handle carries
+                # it; handler threads see it via result()
+                outcome = type(err).__name__
+                self._count(item.tenant, "expired_engine"
+                            if "Deadline" in outcome else "failed")
+        self._depth_gauges()
+
+    # -- metrics helpers -----------------------------------------------------
+    def _count(self, tenant: str, outcome: str):
+        registry().counter(GATEWAY_REQUESTS,
+                           "gateway requests by tenant and outcome").inc(
+            1.0, labels={"tenant": tenant, "outcome": outcome})
+
+    def _depth_gauges(self):
+        reg = registry()
+        for tenant, d in self.scheduler.depths().items():
+            reg.gauge(GATEWAY_QUEUE_DEPTH,
+                      "queued requests per tenant").set(
+                float(d["queued"]), labels={"tenant": tenant})
+            reg.gauge(GATEWAY_INFLIGHT,
+                      "dispatched, unfinished requests per tenant").set(
+                float(d["in_flight"]), labels={"tenant": tenant})
+
+    # -- introspection -------------------------------------------------------
+    def stats(self) -> dict:
+        return {
+            "tenants": self.scheduler.depths(),
+            "engines": self.router.loads(),
+            "shedder": self.shedder.snapshot(),
+            "closed": self._stop_ev.is_set(),
+        }
+
+    def healthz(self) -> dict:
+        loads = self.router.loads()
+        alive = [n for n, ld in loads.items() if ld["alive"]]
+        return {
+            "alive": bool(alive) and not self._stop_ev.is_set(),
+            "engines": {n: {"alive": ld["alive"],
+                            "slots_in_use": ld["slots_in_use"],
+                            "queue_depth": ld["queue_depth"]}
+                        for n, ld in loads.items()},
+            "queued": self.scheduler.depth(),
+            "priorities": sorted(PRIORITIES),
+        }

@@ -1,0 +1,316 @@
+"""HTTP front door — stdlib ``http.server`` over the Gateway core.
+
+Endpoints:
+
+* ``POST /v1/completions`` — OpenAI-compatible completions; with
+  ``"stream": true`` the response is ``text/event-stream`` carried over
+  chunked transfer encoding, one SSE ``data:`` event per token and a
+  final ``data: [DONE]``.
+* ``GET /healthz`` — liveness JSON (200 while any replica is alive,
+  503 otherwise).
+* ``GET /metrics`` — the process-wide Prometheus exposition (serving +
+  gateway series from the paddle_tpu.observability registry).
+
+One OS thread per in-flight HTTP request (``ThreadingHTTPServer``): the
+handler parses and admits, then *blocks* on the gateway item while the
+single dispatcher thread feeds the engines — a deliberate shape, because
+request concurrency is already bounded by the admission layer's queue +
+concurrency caps, so the thread count is too.
+
+429 responses (queue caps and SLO sheds) carry a ``Retry-After`` header
+and the OpenAI error envelope with a machine-readable ``code``.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from concurrent.futures import CancelledError
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from queue import Empty
+
+from ...observability import registry
+from ..engine import (DeadlineExceededError, EngineClosedError,
+                      EngineDeadError)
+from .admission import AdmissionError
+from .gateway import Gateway, GatewayClosedError
+from .protocol import (SSE_DONE, ProtocolError, chunk_body, completion_body,
+                       error_body, parse_completion_request, sse_event,
+                       tenant_from_headers)
+from .router import NoEngineAvailableError
+
+__all__ = ["GatewayHTTPServer", "start_gateway", "GatewayStack"]
+
+GATEWAY_HTTP = "paddle_tpu_gateway_http_responses_total"
+
+_JSON = "application/json"
+# streamed responses poll the token queue at this period so an engine-side
+# failure/deadline mid-stream is noticed promptly
+_STREAM_POLL_S = 0.05
+
+
+class GatewayHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer bound to a Gateway instance."""
+
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def __init__(self, address, gateway: Gateway,
+                 request_timeout_s: float = 600.0):
+        self.gateway = gateway
+        self.request_timeout_s = float(request_timeout_s)
+        super().__init__(address, _Handler)
+
+    @property
+    def port(self) -> int:
+        return self.server_address[1]
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "paddle-tpu-gateway/1.0"
+
+    # requests land in the metrics/flight layers; stderr stays quiet
+    def log_message(self, format, *args):  # noqa: A002
+        pass
+
+    @property
+    def gateway(self) -> Gateway:
+        return self.server.gateway
+
+    # -- plumbing ------------------------------------------------------------
+    def _send_json(self, status: int, payload: dict, headers=()):
+        body = json.dumps(payload).encode("utf-8")
+        self.send_response(status)
+        self.send_header("Content-Type", _JSON)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in headers:
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+        registry().counter(GATEWAY_HTTP, "gateway HTTP responses by code"
+                           ).inc(1.0, labels={"code": status})
+
+    def _send_error_obj(self, err: Exception):
+        if isinstance(err, ProtocolError):
+            self._send_json(err.status, err.body())
+        elif isinstance(err, AdmissionError):
+            body = error_body(str(err), etype="rate_limit_exceeded",
+                              code=err.reason)
+            if err.est_ttft_s is not None:
+                body["error"]["est_ttft_ms"] = round(err.est_ttft_s * 1e3, 1)
+            self._send_json(
+                err.status, body,
+                headers=[("Retry-After",
+                          str(max(1, round(err.retry_after_s))))])
+        elif isinstance(err, DeadlineExceededError):
+            self._send_json(504, error_body(
+                str(err), etype="timeout_error", code="deadline_exceeded"))
+        elif isinstance(err, (NoEngineAvailableError, GatewayClosedError,
+                              EngineClosedError, EngineDeadError)):
+            self._send_json(503, error_body(
+                str(err), etype="server_error", code="unavailable"))
+        elif isinstance(err, CancelledError):
+            self._send_json(500, error_body(
+                "request was cancelled", etype="server_error",
+                code="cancelled"))
+        elif isinstance(err, TimeoutError):
+            self._send_json(504, error_body(
+                str(err), etype="timeout_error", code="timeout"))
+        else:
+            self._send_json(500, error_body(
+                f"{type(err).__name__}: {err}", etype="server_error",
+                code="internal"))
+
+    # -- GET -----------------------------------------------------------------
+    def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+        try:
+            if self.path == "/healthz":
+                health = self.gateway.healthz()
+                self._send_json(200 if health["alive"] else 503, health)
+            elif self.path == "/metrics":
+                text = registry().to_prometheus_text().encode("utf-8")
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(text)))
+                self.end_headers()
+                self.wfile.write(text)
+                registry().counter(
+                    GATEWAY_HTTP, "gateway HTTP responses by code").inc(
+                    1.0, labels={"code": 200})
+            else:
+                self._send_json(404, error_body(
+                    f"no such endpoint: {self.path}", code="not_found"))
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+    # -- POST ----------------------------------------------------------------
+    def do_POST(self):  # noqa: N802
+        try:
+            if self.path != "/v1/completions":
+                self._send_json(404, error_body(
+                    f"no such endpoint: {self.path}", code="not_found"))
+                return
+            gw = self.gateway
+            try:
+                tenant = tenant_from_headers(self.headers, gw.api_keys)
+                length = int(self.headers.get("Content-Length") or 0)
+                creq = parse_completion_request(
+                    self.rfile.read(length),
+                    has_tokenizer=gw.tokenizer is not None)
+                item = gw.admit(creq, tenant)
+            except (ProtocolError, AdmissionError, GatewayClosedError,
+                    NoEngineAvailableError) as e:
+                self._send_error_obj(e)
+                return
+            if creq.stream:
+                self._stream_completion(gw, item)
+            else:
+                self._blocking_completion(gw, item)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+    def _model_name(self, creq) -> str:
+        return creq.model or self.gateway.model_name
+
+    def _text(self, tokens) -> str:
+        tok = self.gateway.tokenizer
+        if tok is None:
+            return ""
+        return tok.decode([int(t) for t in tokens])
+
+    def _blocking_completion(self, gw: Gateway, item):
+        try:
+            tokens, finish = gw.result(
+                item, timeout=self.server.request_timeout_s)
+        except Exception as e:  # noqa: BLE001 — mapped to wire errors
+            self._send_error_obj(e)
+            return
+        body = completion_body(
+            item.id, self._model_name(item.creq), self._text(tokens),
+            [int(t) for t in tokens], finish, int(item.prompt.size))
+        self._send_json(200, body, headers=[
+            ("X-Paddle-Tpu-Engine", item.engine_name or "")])
+
+    # -- streaming -----------------------------------------------------------
+    def _write_chunk(self, data: bytes):
+        self.wfile.write(f"{len(data):x}\r\n".encode("ascii"))
+        self.wfile.write(data)
+        self.wfile.write(b"\r\n")
+
+    def _end_chunks(self):
+        self.wfile.write(b"0\r\n\r\n")
+
+    def _stream_completion(self, gw: Gateway, item):
+        # wait for dispatch (or early failure) before committing to 200 —
+        # sheds and routing failures still map to clean HTTP errors
+        if not item.ready.wait(self.server.request_timeout_s):
+            self._send_error_obj(TimeoutError(
+                f"request {item.id} was not dispatched in time"))
+            return
+        if item.error is not None:
+            self._send_error_obj(item.error)
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.send_header("X-Paddle-Tpu-Engine", item.engine_name or "")
+        self.end_headers()
+        registry().counter(GATEWAY_HTTP, "gateway HTTP responses by code"
+                           ).inc(1.0, labels={"code": 200})
+        model = self._model_name(item.creq)
+        handle = item.handle
+        sent = 0
+        try:
+            while True:
+                try:
+                    tok = item.token_q.get(timeout=_STREAM_POLL_S)
+                except Empty:
+                    if handle.done():
+                        break
+                    continue
+                sent += 1
+                self._write_chunk(sse_event(chunk_body(
+                    item.id, model, self._text([tok]), [int(tok)], None)))
+            # drain tokens that raced the done() check
+            while not item.token_q.empty():
+                tok = item.token_q.get_nowait()
+                sent += 1
+                self._write_chunk(sse_event(chunk_body(
+                    item.id, model, self._text([tok]), [int(tok)], None)))
+            err = handle.exception(timeout=0)
+            if err is None:
+                eos = handle.eos_token_id
+                toks = handle.tokens
+                finish = ("stop" if eos is not None and toks and
+                          toks[-1] == eos else "length")
+                self._write_chunk(sse_event(chunk_body(
+                    item.id, model, "", [], finish)))
+            else:
+                self._write_chunk(sse_event({
+                    "id": item.id,
+                    "error": error_body(
+                        f"{type(err).__name__}: {err}",
+                        etype="server_error", code="stream_aborted")
+                    ["error"]}))
+            self._write_chunk(SSE_DONE)
+            self._end_chunks()
+        except (BrokenPipeError, ConnectionResetError):
+            # client went away mid-stream: free the slot immediately
+            handle.cancel()
+
+
+# -- convenience stack --------------------------------------------------------
+
+class GatewayStack:
+    """Gateway + HTTP server + serving thread, torn down in order."""
+
+    def __init__(self, gateway: Gateway, server: GatewayHTTPServer,
+                 thread: threading.Thread, own_engines: bool = False):
+        self.gateway = gateway
+        self.server = server
+        self.thread = thread
+        self.own_engines = own_engines
+
+    @property
+    def port(self) -> int:
+        return self.server.port
+
+    @property
+    def address(self) -> str:
+        host, port = self.server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def close(self):
+        """Stop accepting, fail queued work, (optionally) stop engines."""
+        self.server.shutdown()
+        self.server.server_close()
+        self.gateway.shutdown()
+        if self.own_engines:
+            for eng in self.gateway.router.engines:
+                eng.shutdown()
+        self.thread.join(timeout=10)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def start_gateway(engines, host: str = "127.0.0.1", port: int = 0, *,
+                  own_engines: bool = False, request_timeout_s: float = 600.0,
+                  **gateway_kwargs) -> GatewayStack:
+    """Boot the full front door: Gateway core + threaded HTTP server on
+    ``host:port`` (port 0 = ephemeral; read ``stack.port``).  Extra
+    keyword args go to :class:`Gateway`."""
+    gateway = (engines if isinstance(engines, Gateway)
+               else Gateway(engines, **gateway_kwargs))
+    server = GatewayHTTPServer((host, port), gateway,
+                               request_timeout_s=request_timeout_s)
+    thread = threading.Thread(target=server.serve_forever,
+                              name="paddle-tpu-gateway-http", daemon=True)
+    thread.start()
+    return GatewayStack(gateway, server, thread, own_engines=own_engines)

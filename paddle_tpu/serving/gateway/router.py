@@ -1,0 +1,116 @@
+"""Router — spread tenants across N engine replicas, fail over from DEAD.
+
+Replica scale-out for the serving path: the gateway dispatches each
+admitted request to the **least-loaded alive** engine, load being the
+O(1) ``Engine.load()`` snapshot (slot occupancy + engine-side queue
+depth).  An engine whose scheduler crashed reports ``alive: False``
+(PR 5's ``EngineDeadError`` semantics) and is simply never picked again —
+the remaining replicas absorb its traffic; with every replica dead the
+router raises :class:`NoEngineAvailableError` (HTTP 503).
+"""
+from __future__ import annotations
+
+from ...observability import registry
+
+__all__ = ["NoEngineAvailableError", "EngineRouter"]
+
+GATEWAY_ENGINE_SLOTS = "paddle_tpu_gateway_engine_slots_in_use"
+GATEWAY_ENGINES_ALIVE = "paddle_tpu_gateway_engines_alive"
+
+
+class NoEngineAvailableError(RuntimeError):
+    """Every replica is dead or shut down — the gateway answers 503."""
+
+
+class EngineRouter:
+    """Least-loaded routing over a fixed set of engine replicas."""
+
+    def __init__(self, engines, names=None):
+        engines = list(engines)
+        if not engines:
+            raise ValueError("router needs at least one engine")
+        if names is None:
+            names = [f"engine{i}" for i in range(len(engines))]
+        if len(names) != len(engines) or len(set(names)) != len(names):
+            raise ValueError("names must be unique, one per engine")
+        self._engines = list(zip(list(names), engines))
+
+    @property
+    def engines(self) -> list:
+        return [e for _, e in self._engines]
+
+    @property
+    def names(self) -> list:
+        return [n for n, _ in self._engines]
+
+    def loads(self) -> dict:
+        """{name: Engine.load() snapshot} for every replica; also refreshes
+        the per-engine occupancy gauges."""
+        reg = registry()
+        out = {}
+        alive = 0
+        for name, eng in self._engines:
+            ld = eng.load()
+            out[name] = ld
+            alive += bool(ld["alive"])
+            reg.gauge(GATEWAY_ENGINE_SLOTS,
+                      "per-replica slots owned by requests").set(
+                float(ld["slots_in_use"]), labels={"engine": name})
+        reg.gauge(GATEWAY_ENGINES_ALIVE, "replicas able to take work").set(
+            float(alive))
+        return out
+
+    def pick(self, exclude=()) -> tuple:
+        """(name, engine) of the least-loaded alive replica (slot
+        occupancy first, engine queue depth as the tiebreak); raises
+        :class:`NoEngineAvailableError` when none qualifies."""
+        best = None
+        best_key = None
+        for name, eng in self._engines:
+            if name in exclude:
+                continue
+            ld = eng.load()
+            if not ld["alive"]:
+                continue
+            key = (ld["slots_in_use"] + ld["queue_depth"],
+                   ld["queue_depth"], name)
+            if best_key is None or key < best_key:
+                best, best_key = (name, eng), key
+        if best is None:
+            raise NoEngineAvailableError(
+                "no alive engine replica (all dead, excluded, or shut down)")
+        return best
+
+    def any_alive(self) -> bool:
+        return any(eng.load()["alive"] for _, eng in self._engines)
+
+    def has_headroom(self, slack: int = 1) -> bool:
+        """True when some alive replica can take one more request without
+        queuing deeper than `slack` behind its slot pool — the dispatcher
+        gate that keeps ordering decisions IN the gateway's fair-share
+        queues instead of an engine FIFO."""
+        for _, eng in self._engines:
+            ld = eng.load()
+            if ld["alive"] and \
+                    ld["slots_in_use"] + ld["queue_depth"] < \
+                    ld["max_slots"] + slack:
+                return True
+        return False
+
+    def total_slots(self) -> int:
+        """Aggregate decode parallelism of the alive replicas (the shed
+        formula's drain rate denominator)."""
+        total = 0
+        for _, eng in self._engines:
+            ld = eng.load()
+            if ld["alive"]:
+                total += ld["max_slots"]
+        return total or 1
+
+    def min_max_len(self) -> int:
+        """Tightest per-request length bound across alive replicas (admission
+        validates prompt+max_tokens against this)."""
+        lens = [e.max_len for _, e in self._engines
+                if e.load()["alive"]]
+        return min(lens) if lens else min(e.max_len
+                                          for _, e in self._engines)

@@ -1,0 +1,516 @@
+"""Serving gateway tests (paddle_tpu/serving/gateway/).
+
+The contract under test is docs/serving.md's gateway section: the wire
+layer (OpenAI-compatible parsing -> structured 4xx, SSE chunk framing),
+admission (per-tenant caps and weighted fair share), telemetry-driven
+load shedding (429 + Retry-After BEFORE the queue, not a deadline expiry
+inside the engine), the multi-replica router (least-loaded, DEAD-engine
+failover), and the engine-side admission seam.  The acceptance shape: an
+HTTP client streams a completion against a real engine; under a
+saturated queue a high-priority tenant's TTFT stays bounded while the
+greedy tenant is shed with 429s — and decode stays ONE compiled program
+throughout.
+"""
+import http.client
+import json
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import build_gpt, gpt_config
+from paddle_tpu.serving import Engine
+from paddle_tpu.serving.gateway import (
+    AdmissionError,
+    FairShareScheduler,
+    Gateway,
+    GatewayClosedError,
+    LoadShedder,
+    ProtocolError,
+    TenantConfig,
+    parse_completion_request,
+    start_gateway,
+    tenant_from_headers,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny_gpt():
+    cfg = gpt_config("gpt-tiny", max_position_embeddings=128,
+                     hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+    paddle.seed(7)
+    model = build_gpt(cfg)
+    model.eval()
+    return model, cfg
+
+
+def _post(port, payload, headers=None, timeout=300):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        hdrs = {"Content-Type": "application/json"}
+        hdrs.update(headers or {})
+        conn.request("POST", "/v1/completions",
+                     json.dumps(payload).encode(), hdrs)
+        r = conn.getresponse()
+        return r.status, dict(r.getheaders()), r.read()
+    finally:
+        conn.close()
+
+
+def _get(port, path, timeout=60):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        r = conn.getresponse()
+        return r.status, r.read()
+    finally:
+        conn.close()
+
+
+# -- wire layer (no engine) ---------------------------------------------------
+
+def test_parse_completion_request_validation():
+    ok = parse_completion_request(
+        json.dumps({"prompt": [1, 2, 3], "max_tokens": 4,
+                    "temperature": 0.5, "top_k": 8, "seed": 3,
+                    "stream": True, "stop": 7, "deadline_ms": 250,
+                    "priority": "interactive", "model": "m",
+                    "some_future_field": 1}).encode(),
+        has_tokenizer=False)
+    assert ok.prompt == [1, 2, 3] and ok.max_tokens == 4
+    assert ok.stream and ok.stop == 7 and ok.priority == "interactive"
+    assert ok.deadline_s == pytest.approx(0.25)
+
+    def err(payload, raw=False):
+        with pytest.raises(ProtocolError) as ei:
+            parse_completion_request(
+                payload if raw else json.dumps(payload).encode(),
+                has_tokenizer=False)
+        return ei.value
+
+    e = err(b"{not json", raw=True)
+    assert e.status == 400 and e.code == "invalid_json"
+    assert err(b"[1, 2]", raw=True).code == "invalid_json"
+    assert err({}).code == "missing_field"
+    assert err({"prompt": "hi"}).code == "no_tokenizer"
+    assert err({"prompt": []}).code == "invalid_prompt"
+    assert err({"prompt": [1, -2]}).code == "invalid_prompt"
+    assert err({"prompt": [1], "max_tokens": 0}).code == "out_of_range"
+    assert err({"prompt": [1], "max_tokens": "4"}).code == "invalid_type"
+    assert err({"prompt": [1], "temperature": -1}).code == "out_of_range"
+    assert err({"prompt": [1], "priority": "vip"}).code == \
+        "invalid_priority"
+    assert err({"prompt": [1], "stop": "end"}).code == "no_tokenizer"
+    assert err({"prompt": [1], "stop": 1.5}).code == "invalid_type"
+    # error envelope is the OpenAI shape
+    body = e.body()
+    assert set(body["error"]) == {"message", "type", "param", "code"}
+
+
+def test_tenant_from_headers():
+    assert tenant_from_headers({"Authorization": "Bearer alice"}) == "alice"
+    assert tenant_from_headers({"X-Tenant": "bob"}) == "bob"
+    assert tenant_from_headers({"X-Api-Key": "k1"}) == "k1"
+    assert tenant_from_headers({}) == "anonymous"
+    # strict mode: unknown key -> 401
+    keys = {"sk-1": "alice"}
+    assert tenant_from_headers(
+        {"Authorization": "Bearer sk-1"}, keys) == "alice"
+    with pytest.raises(ProtocolError) as ei:
+        tenant_from_headers({"Authorization": "Bearer nope"}, keys)
+    assert ei.value.status == 401
+    with pytest.raises(ProtocolError):
+        tenant_from_headers({}, keys)
+
+
+# -- admission (no engine) ----------------------------------------------------
+
+class _Item:
+    def __init__(self, tenant, cost=10.0, priority="standard", tag=None):
+        self.tenant = tenant
+        self.cost = float(cost)
+        self.priority = priority
+        self.tag = tag
+
+
+def test_fair_share_interleaves_equal_weights():
+    s = FairShareScheduler([TenantConfig("a"), TenantConfig("b")])
+    for i in range(4):
+        s.enqueue(_Item("a", tag=f"a{i}"))
+    for i in range(2):
+        s.enqueue(_Item("b", tag=f"b{i}"))
+    order = [s.pop(timeout=1).tag for _ in range(6)]
+    # equal weights, equal cost: strict alternation while both have work
+    assert order == ["a0", "b0", "a1", "b1", "a2", "a3"]
+
+
+def test_fair_share_weights_and_idle_reset():
+    s = FairShareScheduler([TenantConfig("heavy", weight=3.0),
+                            TenantConfig("light", weight=1.0)])
+    for i in range(6):
+        s.enqueue(_Item("heavy", cost=12, tag=i))
+    for i in range(2):
+        s.enqueue(_Item("light", cost=12, tag=i))
+    first6 = [s.pop(timeout=1).tenant for _ in range(6)]
+    assert first6.count("heavy") >= 4          # ~3:1 share
+    assert first6.count("light") >= 1          # but light is never starved
+    while s.depth():
+        s.pop(timeout=1)
+    # a tenant joining after others ran banks no credit: its clock
+    # fast-forwards to the active minimum instead of starting at 0
+    s.enqueue(_Item("heavy", cost=12))
+    late = _Item("late", cost=12)
+    s.enqueue(late)
+    st = s.depths()
+    assert st["late"]["vtime"] >= 0.0
+    assert {s.pop(timeout=1).tenant for _ in range(2)} == {"heavy", "late"}
+
+
+def test_priority_classes_strictly_preempt():
+    s = FairShareScheduler()
+    s.enqueue(_Item("bulk", priority="batch", tag="b0"))
+    s.enqueue(_Item("bulk2", priority="standard", tag="s0"))
+    s.enqueue(_Item("vip", priority="interactive", tag="i0"))
+    assert [s.pop(timeout=1).tag for _ in range(3)] == ["i0", "s0", "b0"]
+
+
+def test_caps_concurrency_and_requeue():
+    s = FairShareScheduler([TenantConfig("t", max_queue=2,
+                                         max_concurrency=1)])
+    s.enqueue(_Item("t", tag=0))
+    s.enqueue(_Item("t", tag=1))
+    with pytest.raises(AdmissionError) as ei:
+        s.enqueue(_Item("t", tag=2))
+    assert ei.value.reason == "tenant_queue_full"
+    assert ei.value.status == 429 and ei.value.retry_after_s > 0
+    first = s.pop(timeout=1)
+    assert first.tag == 0
+    assert s.pop(timeout=0.05) is None         # concurrency cap holds
+    s.release("t", first.cost)
+    assert s.pop(timeout=1).tag == 1
+    # requeue puts the item back at the FRONT with accounting rolled back
+    s.release("t", 10.0)
+    s.enqueue(_Item("t", tag="x"))
+    s.enqueue(_Item("t", tag="y"))
+    it = s.pop(timeout=1)
+    s.requeue(it)
+    assert s.pop(timeout=1).tag == "x"
+    assert s.backlog_cost("standard") > 0
+
+
+def test_shedder_estimate_and_decide():
+    sh = LoadShedder()
+    # cold start: no data, everything admits
+    d = sh.decide(0.01, backlog_tokens=1e6, total_slots=4)
+    assert d.admit and d.est_ttft_s is None
+    sh.seed(prefill_s=0.1, token_s=0.01)
+    est = sh.estimate_ttft(100, 4)
+    assert est == pytest.approx(0.1 + 0.01 * 100 / 4)
+    assert sh.decide(10.0, 100, 4).admit
+    d = sh.decide(0.2, 100, 4)
+    assert not d.admit and d.retry_after_s >= 0.1
+    assert "deadline" in d.reason
+    # observations blend toward the measured latencies
+    for _ in range(50):
+        sh.observe(0.2, [0.02, 0.02])
+    snap = sh.snapshot()
+    assert snap["prefill_s"] == pytest.approx(0.2, rel=0.05)
+    assert snap["token_s"] == pytest.approx(0.02, rel=0.05)
+
+
+# -- engine admission seam (ISSUE satellite) ----------------------------------
+
+def test_engine_load_snapshot_and_admission_hook(tiny_gpt):
+    model, _ = tiny_gpt
+    rejected = []
+
+    def hook(req, load):
+        if load["queue_depth"] >= 2:
+            rejected.append(req.request_id)
+            raise AdmissionError("custom", "hook says no")
+
+    eng = Engine(model, max_slots=2, max_len=32, auto_start=False,
+                 admission_hook=hook)
+    try:
+        eng.submit([1, 2, 3], max_new_tokens=2)
+        eng.submit([4, 5], max_new_tokens=2)
+        assert eng.queue_depth() == 2 and eng.slots_in_use() == 0
+        ld = eng.load()
+        assert ld == {"queue_depth": 2, "slots_in_use": 0, "max_slots": 2,
+                      "max_queue": 4, "max_len": 32, "alive": True}
+        with pytest.raises(AdmissionError, match="hook says no"):
+            eng.submit([6, 7], max_new_tokens=2)
+        assert rejected and eng.stats()["rejected"] == 1
+    finally:
+        eng.shutdown()
+    assert eng.load()["alive"] is False
+
+
+# -- HTTP end-to-end ----------------------------------------------------------
+
+def test_http_completion_end_to_end(tiny_gpt):
+    model, _ = tiny_gpt
+    eng = Engine(model, max_slots=2, max_len=32, max_queue=16)
+    with start_gateway([eng], own_engines=True) as stack:
+        port = stack.port
+        # direct engine reference for the same prompt
+        want = eng.submit(np.array([5, 17, 3, 8], np.int64),
+                          max_new_tokens=4).result(timeout=300)
+        status, headers, raw = _post(port, {"prompt": [5, 17, 3, 8],
+                                            "max_tokens": 4})
+        assert status == 200
+        body = json.loads(raw)
+        assert body["object"] == "text_completion"
+        assert body["choices"][0]["token_ids"] == [int(t) for t in want]
+        assert body["choices"][0]["finish_reason"] == "length"
+        assert body["usage"] == {"prompt_tokens": 4,
+                                 "completion_tokens": 4, "total_tokens": 8}
+        assert headers.get("X-Paddle-Tpu-Engine") == "engine0"
+
+        # wire-level validation errors -> structured 4xx
+        status, _, raw = _post(port, {"prompt": "text prompt"})
+        err = json.loads(raw)["error"]
+        assert status == 400 and err["code"] == "no_tokenizer"
+        status, _, raw = _post(port, {"prompt": [1, 2],
+                                      "max_tokens": 1000})
+        assert status == 400
+        assert json.loads(raw)["error"]["code"] == "context_window"
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        conn.request("POST", "/v1/completions", b"{bad",
+                     {"Content-Type": "application/json",
+                      "Content-Length": "4"})
+        r = conn.getresponse()
+        assert r.status == 400
+        assert json.loads(r.read())["error"]["code"] == "invalid_json"
+        conn.close()
+        status, _, raw = _post(port, {"prompt": [1, 2]},
+                               headers={"X-Tenant": ""})
+        assert status == 200                    # anonymous tenant works
+
+        # endpoints
+        status, raw = _get(port, "/healthz")
+        health = json.loads(raw)
+        assert status == 200 and health["alive"]
+        assert health["engines"]["engine0"]["alive"]
+        status, raw = _get(port, "/metrics")
+        text = raw.decode()
+        assert status == 200
+        assert "paddle_tpu_gateway_requests_total" in text
+        assert "paddle_tpu_serving_ttft_seconds" in text
+        status, raw = _get(port, "/nope")
+        assert status == 404
+        assert json.loads(raw)["error"]["code"] == "not_found"
+
+        assert eng.compile_stats()["decode_compiles"] == 1
+
+
+def test_http_streaming_chunk_framing(tiny_gpt):
+    """Raw-socket read of a streamed completion: chunked framing parses,
+    every chunk is one SSE `data:` event, the last is [DONE], and the
+    streamed tokens equal the blocking response's."""
+    model, _ = tiny_gpt
+    eng = Engine(model, max_slots=2, max_len=32)
+    with start_gateway([eng], own_engines=True) as stack:
+        _, _, raw = _post(stack.port, {"prompt": [9, 2, 7], "max_tokens": 5})
+        want = json.loads(raw)["choices"][0]["token_ids"]
+
+        payload = json.dumps({"prompt": [9, 2, 7], "max_tokens": 5,
+                              "stream": True}).encode()
+        with socket.create_connection(("127.0.0.1", stack.port),
+                                      timeout=300) as sk:
+            sk.sendall(b"POST /v1/completions HTTP/1.1\r\n"
+                       b"Host: localhost\r\n"
+                       b"Content-Type: application/json\r\n"
+                       b"Content-Length: " +
+                       str(len(payload)).encode() + b"\r\n\r\n" + payload)
+            buf = b""
+            while b"\r\n\r\n" not in buf:
+                chunk = sk.recv(65536)
+                assert chunk, "connection closed before the headers ended"
+                buf += chunk
+            head, _, rest = buf.partition(b"\r\n\r\n")
+            while not rest.endswith(b"0\r\n\r\n"):
+                chunk = sk.recv(65536)
+                assert chunk, "connection closed before the final chunk"
+                rest += chunk
+        assert b"200" in head.split(b"\r\n")[0]
+        assert b"Transfer-Encoding: chunked" in head
+        assert b"text/event-stream" in head
+        # parse the chunked framing by hand
+        events, pos = [], 0
+        while True:
+            eol = rest.index(b"\r\n", pos)
+            size = int(rest[pos:eol], 16)
+            if size == 0:
+                break
+            data = rest[eol + 2:eol + 2 + size]
+            assert data.startswith(b"data: ") and data.endswith(b"\n\n")
+            events.append(data[6:].strip())
+            pos = eol + 2 + size + 2            # skip trailing CRLF
+        assert events[-1] == b"[DONE]"
+        bodies = [json.loads(e) for e in events[:-1]]
+        got = [t for b in bodies for t in b["choices"][0]["token_ids"]]
+        assert got == want
+        assert bodies[-1]["choices"][0]["finish_reason"] == "length"
+        assert all(b["choices"][0]["finish_reason"] is None
+                   for b in bodies[:-1])
+
+
+def test_shed_429_retry_after_and_tenant_caps(tiny_gpt):
+    """Reject-early: with the latency model seeded and a deep backlog, a
+    deadline-carrying request is 429'd with Retry-After at ADMISSION —
+    the engine never sees it.  Per-tenant queue caps 429 the same way."""
+    model, _ = tiny_gpt
+    eng = Engine(model, max_slots=2, max_len=64, auto_start=False)
+    shedder = LoadShedder()
+    shedder.seed(prefill_s=0.05, token_s=0.01)
+    gw = Gateway([eng], tenants=[TenantConfig("bulk", max_queue=10)],
+                 shedder=shedder, start=False)    # dispatcher off: the
+    with start_gateway(gw) as stack:              # backlog stays put
+        creq = parse_completion_request(
+            json.dumps({"prompt": [1] * 4, "max_tokens": 20}).encode(),
+            has_tokenizer=False)
+        for _ in range(10):
+            gw.admit(creq, "bulk")
+        backlog = gw.scheduler.backlog_cost("standard")
+        assert backlog == pytest.approx(240.0)    # 10 * (4 + 20)
+
+        # est ttft = 0.05 + 0.01 * (240 + 24) / 2 = 1.37 s >> 200 ms
+        status, headers, raw = _post(
+            stack.port, {"prompt": [1] * 4, "max_tokens": 20,
+                         "deadline_ms": 200}, headers={"X-Tenant": "vip"})
+        err = json.loads(raw)["error"]
+        assert status == 429 and err["code"] == "slo_shed"
+        assert err["type"] == "rate_limit_exceeded"
+        assert err["est_ttft_ms"] > 200
+        assert int(headers["Retry-After"]) >= 1
+        # no deadline -> no SLO shed, but the bulk tenant's queue is at
+        # its cap -> structured tenant_queue_full
+        status, headers, raw = _post(
+            stack.port, {"prompt": [1] * 4, "max_tokens": 20},
+            headers={"X-Tenant": "bulk"})
+        assert status == 429
+        assert json.loads(raw)["error"]["code"] == "tenant_queue_full"
+        assert "Retry-After" in headers
+        st = eng.stats()
+        assert st["submitted"] == 0, "shed requests must not reach engine"
+    eng.shutdown()
+
+
+def test_fair_share_isolation_under_saturation(tiny_gpt):
+    """The acceptance shape: one greedy tenant saturates the gateway; a
+    high-priority tenant's requests keep completing with bounded TTFT
+    while the greedy overflow is shed with 429s — and the engine decode
+    stays ONE compiled program."""
+    model, _ = tiny_gpt
+    eng = Engine(model, max_slots=2, max_len=48, max_queue=8)
+    tenants = [TenantConfig("greedy", priority="batch", max_queue=6),
+               TenantConfig("vip", priority="interactive", weight=4.0)]
+    with start_gateway([eng], own_engines=True, tenants=tenants) as stack:
+        port = stack.port
+        results = {"greedy": [], "vip": []}
+        lock = threading.Lock()
+
+        def greedy_one(i):
+            status, _, _ = _post(
+                port, {"prompt": [i % 50 + 1] * 6, "max_tokens": 8},
+                headers={"X-Tenant": "greedy"})
+            with lock:
+                results["greedy"].append(status)
+
+        flood = [threading.Thread(target=greedy_one, args=(i,))
+                 for i in range(16)]
+        for t in flood:
+            t.start()
+        time.sleep(0.2)                       # flood is in flight
+
+        vip_ttft = []
+        for i in range(4):
+            t0 = time.perf_counter()
+            status, _, raw = _post(
+                port, {"prompt": [7, 11, i + 1], "max_tokens": 2},
+                headers={"X-Tenant": "vip"})
+            vip_ttft.append(time.perf_counter() - t0)
+            assert status == 200, raw
+        for t in flood:
+            t.join(timeout=600)
+
+        greedy_ok = results["greedy"].count(200)
+        greedy_shed = sum(1 for s in results["greedy"] if s == 429)
+        assert greedy_ok + greedy_shed == 16
+        assert greedy_shed >= 1, \
+            f"greedy overflow must be 429'd: {results['greedy']}"
+        assert greedy_ok >= 1, "greedy must not be starved outright"
+        # vip latency bounded while the system is saturated (generous CI
+        # bound; the interactive class preempts every queued batch item)
+        assert max(vip_ttft) < 60.0
+        assert eng.compile_stats()["decode_compiles"] == 1, \
+            "gateway traffic must not retrace the decode program"
+        depths = stack.gateway.scheduler.depths()
+        assert depths["greedy"]["rejected"] == greedy_shed
+
+
+def test_router_failover_away_from_dead_engine(tiny_gpt):
+    """Two replicas; one's scheduler crashes (serving.scheduler fault
+    seam) and goes DEAD — the router routes every request to the
+    survivor and /healthz still reports overall-alive."""
+    from paddle_tpu.testing import faults
+
+    model, cfg = tiny_gpt
+    paddle.seed(7)
+    model_b = build_gpt(cfg)
+    model_b.eval()
+    eng_a = Engine(model, max_slots=2, max_len=32)
+    eng_b = Engine(model_b, max_slots=2, max_len=32)
+    # kill A exactly once via the PR 5 fault seam, before the gateway
+    faults.arm("serving.scheduler", exc=RuntimeError("pool exploded"),
+               times=None)
+    try:
+        h = eng_a.submit(np.array([1, 2, 3], np.int64), max_new_tokens=2)
+        assert h.exception(timeout=60) is not None
+    finally:
+        faults.reset()
+    assert eng_a.health()["dead"] and not eng_b.health()["dead"]
+
+    with start_gateway([eng_a, eng_b], own_engines=True,
+                       names=["a", "b"]) as stack:
+        for i in range(3):
+            status, headers, raw = _post(
+                stack.port, {"prompt": [4 + i, 9], "max_tokens": 2})
+            assert status == 200, raw
+            assert headers["X-Paddle-Tpu-Engine"] == "b"
+        status, raw = _get(stack.port, "/healthz")
+        health = json.loads(raw)
+        assert status == 200 and health["alive"]
+        assert health["engines"]["a"]["alive"] is False
+        assert health["engines"]["b"]["alive"] is True
+        assert eng_b.compile_stats()["decode_compiles"] == 1
+
+    # with EVERY replica dead the gateway answers 503
+    eng_c = Engine(model_b, max_slots=1, max_len=32, auto_start=False)
+    eng_c.shutdown()
+    with start_gateway([eng_c], names=["c"]) as stack:
+        status, _, raw = _post(stack.port, {"prompt": [1], "max_tokens": 1},
+                               timeout=60)
+        assert status == 503
+        status, raw = _get(stack.port, "/healthz")
+        assert status == 503 and not json.loads(raw)["alive"]
+
+
+def test_gateway_clean_shutdown_fails_queued(tiny_gpt):
+    model, _ = tiny_gpt
+    eng = Engine(model, max_slots=1, max_len=32, auto_start=False)
+    gw = Gateway([eng], start=False)
+    creq = parse_completion_request(
+        json.dumps({"prompt": [1, 2], "max_tokens": 2}).encode(),
+        has_tokenizer=False)
+    item = gw.admit(creq, "t")
+    gw.shutdown()
+    assert isinstance(item.error, GatewayClosedError)
+    with pytest.raises(GatewayClosedError):
+        gw.admit(creq, "t")
+    gw.shutdown()                              # idempotent
+    eng.shutdown()

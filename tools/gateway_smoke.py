@@ -1,0 +1,169 @@
+"""Gateway smoke lane: the multi-tenant HTTP front door end-to-end on the
+CPU backend with telemetry forced ON (ISSUE 8 satellite; tier-1 runs the
+pytest suite telemetry-off, so this lane keeps the gateway's metric and
+flight wiring from silently rotting).
+
+Boots a tiny-model engine + gateway on localhost and drives mixed-tenant
+traffic — one greedy tenant flooding past its queue cap, one light
+interactive tenant sending small sequential requests — then asserts:
+
+* fair-share isolation: every light-tenant request completes with a
+  bounded wall time while the greedy flood is in flight, and the greedy
+  overflow is shed with 429s;
+* telemetry: gateway counters/gauges/histograms are exported through
+  /metrics (Prometheus text) and the flight recorder carries
+  admit/dispatch/shed events;
+* the continuous-batching invariant holds through the gateway (decode
+  stays ONE compiled program);
+* clean shutdown: server, gateway and engine tear down without leaving
+  queued work or live slots.
+
+    python tools/gateway_smoke.py
+
+Exit code 0 on success; any failed invariant raises.
+"""
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import sys
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("PADDLE_TPU_TELEMETRY", "1")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _post(port, payload, tenant, timeout=600):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("POST", "/v1/completions", json.dumps(payload).encode(),
+                     {"Content-Type": "application/json",
+                      "X-Tenant": tenant})
+        r = conn.getresponse()
+        return r.status, r.read()
+    finally:
+        conn.close()
+
+
+def main() -> int:
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import observability as obs
+    from paddle_tpu.models import build_gpt, gpt_config
+    from paddle_tpu.observability import flight
+    from paddle_tpu.serving import Engine
+    from paddle_tpu.serving.gateway import TenantConfig, start_gateway
+    from paddle_tpu.serving.gateway import gateway as gw_mod
+
+    assert obs.enabled(), "telemetry must be ON for this lane"
+    obs.registry().reset()
+
+    cfg = gpt_config("gpt-tiny", max_position_embeddings=128,
+                     hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+    paddle.seed(0)
+    model = build_gpt(cfg)
+    model.eval()
+    engine = Engine(model, max_slots=2, max_len=48, max_queue=8)
+    tenants = [TenantConfig("greedy", priority="batch", max_queue=5),
+               TenantConfig("light", priority="interactive", weight=4.0)]
+    rs = np.random.RandomState(0)
+    stack = start_gateway([engine], own_engines=True, tenants=tenants)
+    try:
+        port = stack.port
+        greedy_status = []
+        lock = threading.Lock()
+
+        def greedy_one(i):
+            st, _ = _post(port, {"prompt": [int(t) for t in
+                                            rs.randint(1, cfg.vocab_size,
+                                                       6)],
+                                 "max_tokens": 10}, "greedy")
+            with lock:
+                greedy_status.append(st)
+
+        flood = [threading.Thread(target=greedy_one, args=(i,))
+                 for i in range(14)]
+        for t in flood:
+            t.start()
+        time.sleep(0.2)
+
+        light_wall = []
+        for i in range(4):
+            t0 = time.perf_counter()
+            st, raw = _post(port, {"prompt": [7, 3, i + 1],
+                                   "max_tokens": 2}, "light")
+            light_wall.append(time.perf_counter() - t0)
+            assert st == 200, (st, raw)
+            body = json.loads(raw)
+            assert len(body["choices"][0]["token_ids"]) == 2, body
+        for t in flood:
+            t.join(timeout=600)
+
+        ok = greedy_status.count(200)
+        shed = sum(1 for s in greedy_status if s == 429)
+        assert ok + shed == 14, greedy_status
+        assert shed >= 1, f"greedy overflow was never shed: {greedy_status}"
+        assert ok >= 1, f"greedy starved outright: {greedy_status}"
+        assert max(light_wall) < 60.0, light_wall
+
+        # -- telemetry through the wire (/metrics) ---------------------------
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        conn.request("GET", "/metrics")
+        text = conn.getresponse().read().decode()
+        conn.close()
+        for series in (gw_mod.GATEWAY_REQUESTS, gw_mod.GATEWAY_QUEUE_DEPTH,
+                       gw_mod.GATEWAY_TTFT, gw_mod.GATEWAY_SHED,
+                       "paddle_tpu_serving_ttft_seconds"):
+            assert series in text, f"{series} missing from /metrics"
+        # the dispatcher's reaper retires handles just after the HTTP
+        # response is written; wait for it to settle before sampling
+        reg = obs.registry()
+        req_c = reg.get(gw_mod.GATEWAY_REQUESTS)
+
+        def _completed():
+            return sum(v for labels, v in req_c.series()
+                       if labels.get("outcome") == "completed")
+
+        deadline = time.perf_counter() + 10
+        while _completed() < ok + 4 and time.perf_counter() < deadline:
+            time.sleep(0.02)
+        assert _completed() == ok + 4, (_completed(), ok, req_c.series())
+        shed_c = reg.get(gw_mod.GATEWAY_SHED)
+        assert shed_c is not None and shed_c.total() == shed, \
+            (shed, shed_c.series() if shed_c else None)
+        kinds = {e["name"] for e in flight.events("gateway")}
+        assert {"admit", "dispatch", "shed"} <= kinds, kinds
+
+        # -- continuous batching held through the gateway --------------------
+        st = engine.stats()
+        assert st["decode_compiles"] == 1, st
+        assert st["active_slots"] == 0 and st["queue_depth"] == 0, st
+        health = stack.gateway.healthz()
+        assert health["alive"] and health["queued"] == 0, health
+        summary = {"gateway_smoke": "ok", "greedy_ok": ok,
+                   "greedy_shed": shed,
+                   "light_wall_max_ms": round(max(light_wall) * 1e3, 1),
+                   "tokens": int(st["tokens"]),
+                   "decode_steps": int(st["decode_steps"])}
+    finally:
+        stack.close()
+
+    # clean shutdown: a post-close request must fail at connect (the
+    # listener is gone), the engine pool must be drained and stopped
+    try:
+        _post(stack.port, {"prompt": [1], "max_tokens": 1}, "x", timeout=2)
+        raise AssertionError("server still accepting after close()")
+    except (ConnectionError, OSError):
+        pass
+    assert not engine.health()["alive"], engine.health()
+    print(json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

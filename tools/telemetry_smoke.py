@@ -11,8 +11,10 @@ layer, and a 60 s step watchdog so the watchdog arm/disarm path in the
 SPMD step executes on every train-step test.  With the default subset it
 additionally runs the prefetch-on training lane (ISSUE 4 satellite): a
 tiny hapi fit through DevicePrefetcher that must complete AND export the
-input-pipeline metrics (host_input_wait counter, buffer-occupancy gauge).
-Exit code is pytest's, or 1 if the prefetch lane fails.
+input-pipeline metrics (host_input_wait counter, buffer-occupancy gauge),
+the tpu-lint ratchet lane (ISSUE 7) and the gateway lane (ISSUE 8:
+mixed-tenant HTTP traffic through tools/gateway_smoke.py).
+Exit code is pytest's, or 1 if any extra lane fails.
 """
 from __future__ import annotations
 
@@ -32,6 +34,7 @@ DEFAULT_SUBSET = [
     "tests/test_checkpoint.py",
     "tests/test_distributed.py",
     "tests/test_serving.py",
+    "tests/test_gateway.py",
     "tests/test_robustness.py",
 ]
 
@@ -115,6 +118,16 @@ def main() -> int:
         if lint_rc != 0:
             print("tpu-lint lane FAILED", file=sys.stderr)
         rc = rc or lint_rc
+        # gateway lane (ISSUE 8 satellite): mixed-tenant HTTP traffic
+        # with telemetry on — fair-share isolation, shed 429s, /metrics
+        # export, clean shutdown
+        print("telemetry smoke: gateway lane", file=sys.stderr)
+        gw_rc = subprocess.call(
+            [sys.executable, os.path.join("tools", "gateway_smoke.py")],
+            env=env, cwd=root)
+        if gw_rc != 0:
+            print("gateway lane FAILED", file=sys.stderr)
+        rc = rc or gw_rc
     return rc
 
 
