@@ -28,8 +28,23 @@ def calculate_density(x) -> float:
 
 def create_mask(tensor, func_name="mask_2d_best", n=2, m=4):
     """2:4 (n-of-m) mask along the last dim: keep the n largest-|w| entries
-    of every m-group."""
-    arr = tensor.numpy() if isinstance(tensor, Tensor) else np.asarray(tensor)
+    of every m-group.
+
+    A Tensor input is masked entirely on device (rank within each m-group
+    via a double argsort) and a device mask comes back — pruning a model
+    no longer downloads every weight to the host and uploads the mask
+    again, and XLA fuses the mask multiply into the consumer matmul.
+    """
+    if isinstance(tensor, Tensor):
+        arr = tensor._value
+        if arr.ndim < 1 or arr.shape[-1] % m:
+            return jnp.ones_like(arr)
+        groups = jnp.abs(arr).reshape(-1, m)
+        order = jnp.argsort(-groups, axis=1)
+        rank = jnp.argsort(order, axis=1)     # rank of each entry by |w|
+        mask = (rank < n).astype(arr.dtype)
+        return mask.reshape(arr.shape)
+    arr = np.asarray(tensor)
     if arr.ndim < 1 or arr.shape[-1] % m:
         return np.ones_like(arr)
     groups = np.abs(arr).reshape(-1, m)

@@ -34,7 +34,16 @@ def ones(shape, dtype=None, name=None) -> Tensor:
 
 def full(shape, fill_value, dtype=None, name=None) -> Tensor:
     if isinstance(fill_value, Tensor):
-        fill_value = fill_value.item()
+        # a device fill stays on device: jnp.full broadcasts the scalar
+        # without the .item() round-trip (which blocked the host per call
+        # and broke the trace under jit)
+        fv = fill_value._value
+        if dtype is None:
+            kind = np.dtype(fv.dtype).kind
+            dtype = (get_default_dtype() if kind == "f"
+                     else ("bool" if kind == "b" else "int64"))
+        return Tensor(jnp.full(_shape(shape), fv.reshape(()), _dt(dtype)),
+                      _internal=True)
     if dtype is None:
         dtype = (get_default_dtype() if isinstance(fill_value, float)
                  else ("int64" if isinstance(fill_value, int)

@@ -313,9 +313,15 @@ def cal_kl_threshold(hist, bin_width, bits=8):
     pick the clip threshold whose 2^(bits-1)-1-level quantized distribution
     has minimum KL divergence from the clipped reference distribution.
     `hist` bins |x| from 0 with width `bin_width`; returns the threshold."""
-    hist = np.asarray(hist, np.float64)
+    hist = np.asarray(hist, np.float64).copy()
     nbins = len(hist)
     levels = 2 ** (bits - 1) - 1
+    # drop the zero bin (TensorRT/MXNet detail): exact zeros — half of any
+    # post-ReLU tensor — quantize losslessly at EVERY threshold, but left
+    # in the histogram their spike dominates the divergence and rewards
+    # clipping away real mass (the spike stays sharp when fewer source
+    # bins merge per level, so small thresholds looked spuriously good)
+    hist[0] = 0.0
     # search from `levels` bins upward (TensorRT's original start): the
     # reference starts at nbins/2, which can never clip below half the
     # histogram range and so fails exactly when outliers inflate the range
@@ -325,6 +331,12 @@ def cal_kl_threshold(hist, bin_width, bits=8):
     best_i, best_kl = nbins, np.inf
     for i in range(levels, nbins + 1):
         tail = total - csum[i]
+        if hist[i - 1] == 0 and tail != 0:
+            # clipped mass would fold onto an EMPTY edge bin: no quantizer
+            # level represents it, so the divergence is infinite (the
+            # masked KL below would instead silently drop the folded mass,
+            # making aggressive clipping look free)
+            continue
         if hist[i - 1] == 0 and tail == 0:
             continue
         p = hist[:i].copy()

@@ -27,10 +27,16 @@ from .engine import (  # noqa: F401
     Engine,
     EngineClosedError,
     EngineDeadError,
+    EngineDrainingError,
+    EngineStalledError,
     QueueFullError,
     RequestHandle,
+    RequestInterruptedError,
 )
 from .slot_pool import SlotPool  # noqa: F401
+from .supervisor import EngineSupervisor  # noqa: F401
 
-__all__ = ["Engine", "RequestHandle", "SlotPool", "QueueFullError",
-           "DeadlineExceededError", "EngineClosedError", "EngineDeadError"]
+__all__ = ["Engine", "EngineSupervisor", "RequestHandle", "SlotPool",
+           "QueueFullError", "DeadlineExceededError", "EngineClosedError",
+           "EngineDeadError", "EngineDrainingError", "EngineStalledError",
+           "RequestInterruptedError"]

@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import atexit
 import itertools
+import os
 import threading
 import time
 import weakref
@@ -51,11 +52,15 @@ import numpy as np
 
 from ..core.tensor import Tensor
 from ..observability import flight, registry, span
+from ..observability import watchdog as _watchdog
 from ..observability.retrace import instrument_jit
+from ..testing import faults
 from .slot_pool import SlotPool
 
 __all__ = ["Engine", "RequestHandle", "QueueFullError",
-           "DeadlineExceededError", "EngineClosedError", "EngineDeadError"]
+           "DeadlineExceededError", "EngineClosedError", "EngineDeadError",
+           "EngineDrainingError", "EngineStalledError",
+           "RequestInterruptedError"]
 
 # -- metric names (paddle_tpu.observability registry) -------------------------
 SERVING_ACTIVE_SLOTS = "paddle_tpu_serving_active_slots"
@@ -65,6 +70,8 @@ SERVING_TOKENS = "paddle_tpu_serving_tokens_total"
 SERVING_TTFT = "paddle_tpu_serving_ttft_seconds"
 SERVING_TOKEN_LATENCY = "paddle_tpu_serving_token_seconds"
 SERVING_BATCH_SECONDS = "paddle_tpu_serving_batch_seconds"
+SERVING_REDISPATCHED = "paddle_tpu_serving_requests_redispatched_total"
+SERVING_INTERRUPTED = "paddle_tpu_serving_requests_interrupted_total"
 
 
 class QueueFullError(RuntimeError):
@@ -79,14 +86,45 @@ class EngineClosedError(RuntimeError):
     """The engine was shut down with this request still in flight."""
 
 
+class EngineDrainingError(EngineClosedError):
+    """The engine is draining: no new admissions, in-flight work finishes
+    (the graceful-shutdown analogue of QueueFullError — retry elsewhere)."""
+
+
 class EngineDeadError(RuntimeError):
     """The scheduler thread crashed: the engine is permanently dead and
     rejects new work, naming the original exception — restarting the loop
-    over an already-failed pool would serve garbage."""
+    over an already-failed pool would serve garbage.  A request that had
+    emitted ZERO tokens when the engine died also fails with this type
+    (unless a supervisor re-dispatches it): the caller knows nothing
+    reached any consumer, so a retry is duplication-safe."""
 
     def __init__(self, cause: BaseException):
         super().__init__(
             f"serving scheduler died: {type(cause).__name__}: {cause}")
+        self.cause = cause
+
+
+class EngineStalledError(RuntimeError):
+    """The scheduler stopped making progress with work pending (decode
+    hang): a supervisor declared the engine dead via :meth:`Engine.abandon`
+    — the stuck thread cannot be killed, but the engine stops accepting
+    work and its requests are classified exactly like a crash."""
+
+
+class RequestInterruptedError(RuntimeError):
+    """The engine died AFTER this request streamed token(s): replaying it
+    elsewhere would duplicate tokens already delivered, so instead of a
+    silent re-run the caller gets this typed error naming how far the
+    stream got and the underlying engine failure."""
+
+    def __init__(self, request_id: int, tokens_streamed: int,
+                 cause: BaseException):
+        super().__init__(
+            f"request {request_id} interrupted after {tokens_streamed} "
+            f"streamed token(s): {type(cause).__name__}: {cause}")
+        self.request_id = request_id
+        self.tokens_streamed = tokens_streamed
         self.cause = cause
 
 
@@ -106,6 +144,7 @@ class RequestHandle:
     def __init__(self, engine, prompt, max_new_tokens, eos_token_id,
                  temperature, top_k, seed, deadline_s, stream):
         self.request_id = next(_ids)
+        self.redispatches = 0        # times re-enqueued after an engine death
         self.prompt = prompt
         self.max_new_tokens = int(max_new_tokens)
         self.eos_token_id = eos_token_id
@@ -115,6 +154,7 @@ class RequestHandle:
         self._stream = stream
         self._engine = engine
         self._state = "queued"            # queued|active|done
+        self._torn = False                # torn off a dead/abandoned engine
         self._cancel_requested = False
         self._done = threading.Event()
         self._error: Optional[BaseException] = None
@@ -177,6 +217,12 @@ class RequestHandle:
         self._done.set()
 
     def _emit(self, token: int):
+        if self._done.is_set() or self._torn:
+            # the request was torn off a dead/abandoned engine while a
+            # stuck dispatch was still in flight: never stream past the
+            # interruption point (a parked zero-token handle must STAY
+            # zero-token or its re-dispatch would duplicate output)
+            return
         self._tokens.append(int(token))
         if self._stream is not None:
             try:
@@ -243,13 +289,31 @@ class Engine:
             caller — the seam an external admission layer (the serving
             gateway) uses to shed load without reaching into engine
             internals.
+        redispatch_hook: optional ``hook(requests, cause) -> taken`` called
+            from the dying scheduler thread when the engine fails, with the
+            zero-tokens-emitted requests (queued or active) and the
+            original exception; it returns the subset it takes ownership
+            of (an :class:`EngineSupervisor` parks them for re-dispatch
+            into the rebuilt engine — SAME handles, so callers never
+            notice).  Requests not taken fail with
+            :class:`EngineDeadError`; requests that already streamed
+            tokens always fail with :class:`RequestInterruptedError` and
+            are never offered to the hook.
+        decode_timeout_s: arm the PR 2 step watchdog around every batched
+            prefill/decode dispatch (default: the
+            ``PADDLE_TPU_DECODE_TIMEOUT_S`` env var): a stalled XLA call
+            produces a crash-dump bundle naming the stuck phase instead
+            of a silent hang, and :meth:`health` exposes the progress age
+            a supervisor uses for stall detection.
     """
 
     def __init__(self, model, tokenizer=None, max_slots: int = 8,
                  max_len: int = 256, max_queue: Optional[int] = None,
                  prefill_batch: Optional[int] = None, eos_token_id=None,
                  auto_start: bool = True,
-                 admission_hook: Optional[Callable] = None):
+                 admission_hook: Optional[Callable] = None,
+                 redispatch_hook: Optional[Callable] = None,
+                 decode_timeout_s: Optional[float] = None):
         self.model = model
         self.tokenizer = tokenizer
         self.max_slots = int(max_slots)
@@ -270,13 +334,25 @@ class Engine:
         self.eos_token_id = eos_token_id
         self._auto_start = bool(auto_start)
         self.admission_hook = admission_hook
+        self.redispatch_hook = redispatch_hook
+        if decode_timeout_s is None:
+            raw = os.environ.get("PADDLE_TPU_DECODE_TIMEOUT_S", "")
+            try:
+                decode_timeout_s = float(raw)
+            except ValueError:
+                decode_timeout_s = None
+        self._decode_timeout_s = (decode_timeout_s
+                                  if decode_timeout_s and
+                                  decode_timeout_s > 0 else None)
 
         self._pool = SlotPool(self.max_slots)
         self._queue: deque = deque()
         self._lock = threading.Lock()
         self._wake = threading.Event()
         self._stop = False
+        self._draining = False
         self._dead: Optional[BaseException] = None
+        self._last_progress = time.perf_counter()
         self._thread: Optional[threading.Thread] = None
         self._built = False
         self._values = None
@@ -288,7 +364,8 @@ class Engine:
         self._counts = {"submitted": 0, "completed": 0, "rejected": 0,
                         "cancelled": 0, "deadline_expired": 0, "failed": 0,
                         "decode_steps": 0, "prefill_batches": 0,
-                        "tokens": 0}
+                        "tokens": 0, "resubmitted": 0, "redispatched": 0,
+                        "interrupted": 0}
         self._was_training = model.training
         model.eval()
         # interpreter exit with a live scheduler thread mid-XLA-call
@@ -307,10 +384,16 @@ class Engine:
         :class:`QueueFullError` when the bounded admission queue is at
         capacity (backpressure: the caller sheds load or retries) and
         ValueError when the request cannot fit a slot."""
-        if self._dead is not None:
+        # lock-free monitor-flag reads: _dead/_stop/_draining make single
+        # benign transitions; at worst a racing submit lands one sweep
+        # late and fails through the death classification instead
+        if self._dead is not None:  # tpu-lint: ok(concurrency)
             raise EngineDeadError(self._dead) from self._dead
         if self._stop:
             raise EngineClosedError("engine is shut down")
+        if self._draining:
+            raise EngineDrainingError(
+                "engine is draining; no new admissions")
         if isinstance(prompt, str):
             if self.tokenizer is None:
                 raise ValueError("string prompt needs a tokenizer")
@@ -365,6 +448,43 @@ class Engine:
         self._wake.set()
         return req
 
+    def resubmit(self, req: RequestHandle) -> RequestHandle:
+        """Re-enqueue a handle taken off a dead engine (the supervisor's
+        re-dispatch path): the SAME handle object rides into this
+        engine's queue, so a caller blocked on ``result()`` never notices
+        the failover.  Only zero-token handles are accepted — re-running
+        a request that already streamed tokens would silently duplicate
+        delivered output.  Bypasses the admission hook and the queue
+        bound (the request was admitted once already)."""
+        if req._tokens:
+            raise ValueError(
+                f"request {req.request_id} already streamed "
+                f"{len(req._tokens)} token(s); re-dispatch would "
+                f"duplicate them")
+        if self._dead is not None:
+            raise EngineDeadError(self._dead) from self._dead
+        if self._stop:
+            raise EngineClosedError("engine is shut down")
+        req._engine = self
+        req._state = "queued"
+        req._torn = False       # live again: this engine may emit for it
+        req.slot = None
+        req.redispatches += 1
+        with self._lock:
+            self._queue.append(req)
+            self._counts["resubmitted"] += 1
+            self._gauges_locked()
+        flight.record("serving", "resubmit", request=req.request_id,
+                      redispatches=req.redispatches)
+        registry().counter(
+            SERVING_REDISPATCHED,
+            "requests re-dispatched after an engine death").inc(
+            1.0, labels={"layer": "supervisor"})
+        if self._auto_start:
+            self.start()
+        self._wake.set()
+        return req
+
     def start(self):
         """Start the scheduler thread (idempotent)."""
         if self._dead is not None:
@@ -388,6 +508,38 @@ class Engine:
                 return False
             time.sleep(0.005)
 
+    def drain(self, deadline_s: float = 30.0) -> bool:
+        """Graceful shutdown, phase one: stop admission (new submits
+        raise :class:`EngineDrainingError` and ``load()`` advertises
+        not-alive so routers stop picking this replica) while the
+        scheduler keeps finishing every queued and in-flight request.
+        Returns True when all of them completed before the deadline —
+        the engine is then idle and a ``shutdown()`` drops nothing."""
+        with self._lock:
+            self._draining = True
+            depth, active = len(self._queue), self._pool.n_active
+        flight.record("serving", "drain_begin", queue_depth=depth,
+                      active_slots=active, deadline_s=float(deadline_s))
+        if (depth or active) and self._dead is None and not self._stop:
+            self.start()        # pending work with no scheduler: run it out
+        ok = self.join(timeout=deadline_s) and self._dead is None
+        flight.record("serving", "drain_done", drained=ok)
+        return ok
+
+    def abandon(self, cause: Optional[BaseException] = None):
+        """A supervisor declares this engine dead from OUTSIDE the
+        scheduler thread (decode stall: the thread is stuck inside an
+        XLA call and cannot be killed).  The engine stops accepting work
+        and its requests are classified exactly as a scheduler crash —
+        zero-token requests are offered to the redispatch hook, streamed
+        ones get :class:`RequestInterruptedError`.  Idempotent; a no-op
+        on an engine that is already dead or shut down."""
+        if self._dead is not None or self._stop:
+            return
+        self._fail_as_dead(cause or EngineStalledError(
+            "engine abandoned by its supervisor"))
+        self._wake.set()        # a parked scheduler wakes up and exits
+
     def shutdown(self):
         """Stop the scheduler; in-flight and queued requests fail with
         EngineClosedError.  Restores the model's train/eval mode."""
@@ -398,7 +550,9 @@ class Engine:
         self._stop = True  # tpu-lint: ok(concurrency)
         self._wake.set()
         if self._thread is not None:
-            self._thread.join(timeout=30)
+            # a DEAD engine's thread is exiting (or, after abandon(),
+            # permanently stuck in an XLA call) — don't wait long for it
+            self._thread.join(timeout=30 if self._dead is None else 2)
         err = EngineClosedError("engine shut down")
         with self._lock:
             pending = list(self._queue) + list(self._pool.active().values())
@@ -445,7 +599,9 @@ class Engine:
                 "max_slots": self.max_slots,
                 "max_queue": self.max_queue,
                 "max_len": self.max_len,
-                "alive": self._dead is None and not self._stop,
+                "alive": (self._dead is None and not self._stop and
+                          not self._draining),
+                "draining": self._draining,
             }
 
     def stats(self) -> dict:
@@ -562,41 +718,86 @@ class Engine:
             jax.jit(prefill, donate_argnums=donate), "serving.prefill")
         self._decode_fn = instrument_jit(
             jax.jit(decode, donate_argnums=donate), "serving.decode")
-        self._built = True
+        with self._lock:
+            self._built = True
 
     # -- scheduler loop ------------------------------------------------------
     def _loop(self):
-        while not self._stop:
+        while not self._stop and self._dead is None:
             try:
                 did = self._step_once()
             except Exception as e:  # noqa: BLE001 — fail loudly, not hang
-                # mark the engine DEAD before failing the in-flight work:
-                # a later submit() must not restart the loop over an
-                # already-failed pool (it raises EngineDeadError instead).
-                # single None->exc transition; racing readers at worst see
-                # the engine alive one sweep late
-                self._dead = e  # tpu-lint: ok(concurrency)
-                flight.record("serving", "scheduler_error",
-                              error=f"{type(e).__name__}: {e}")
-                with self._lock:
-                    pending = (list(self._queue) +
-                               list(self._pool.active().values()))
-                    self._queue.clear()
-                    for slot in list(self._pool.active()):
-                        self._pool.free(slot)
-                    self._active[:] = False
-                    self._counts["failed"] += len(pending)
-                for req in pending:
-                    req._finish(e)
+                self._fail_as_dead(e)
                 raise
+            with self._lock:
+                # progress heartbeat: freezes while a dispatch is stuck
+                # inside XLA (the supervisor's stall detector reads the
+                # age via health())
+                self._last_progress = time.perf_counter()
             if not did:
                 self._wake.wait(0.02)
                 self._wake.clear()
 
+    def _fail_as_dead(self, cause: BaseException):
+        """Death path, from the dying scheduler thread (crash) or a
+        supervisor (:meth:`abandon` on a stall): mark the engine DEAD —
+        a later submit() must not restart the loop over an already-failed
+        pool — then classify the in-flight work by what already reached a
+        consumer: requests with ZERO streamed tokens are duplication-safe
+        and are offered to the redispatch hook (untaken ones fail with
+        EngineDeadError); requests that streamed tokens fail with the
+        typed RequestInterruptedError, never a silent replay."""
+        with self._lock:
+            if self._dead is not None:      # lost the race: already dead
+                return
+            # single None->exc transition; racing lock-free readers at
+            # worst see the engine alive one sweep late
+            self._dead = cause  # tpu-lint: ok(concurrency)
+            queued = list(self._queue)
+            active = list(self._pool.active().values())
+            self._queue.clear()
+            for slot in list(self._pool.active()):
+                self._pool.free(slot)
+            self._active[:] = False
+            for r in queued + active:
+                # freeze the token streams FIRST: after abandon() a
+                # stuck dispatch may still come back and try to emit
+                r._torn = True
+        flight.record("serving", "scheduler_error",
+                      error=f"{type(cause).__name__}: {cause}",
+                      queued=len(queued), active=len(active))
+        fresh = [r for r in queued + active if not r._tokens]
+        streamed = [r for r in active if r._tokens]
+        taken_ids: set = set()
+        hook = self.redispatch_hook
+        if hook is not None and fresh:
+            try:
+                taken_ids = {id(r) for r in hook(list(fresh), cause)}
+            except Exception:  # noqa: BLE001
+                taken_ids = set()   # a broken hook must not mask the death
+        lost = [r for r in fresh if id(r) not in taken_ids]
+        with self._lock:
+            self._counts["failed"] += len(lost) + len(streamed)
+            self._counts["redispatched"] += len(taken_ids)
+            self._counts["interrupted"] += len(streamed)
+            self._gauges_locked()
+        for r in lost:
+            r._finish(EngineDeadError(cause))
+        reg = registry()
+        for r in streamed:
+            flight.record("serving", "interrupted", request=r.request_id,
+                          tokens=len(r._tokens))
+            reg.counter(SERVING_INTERRUPTED,
+                        "requests failed mid-stream by an engine death"
+                        ).inc(1.0)
+            r._finish(RequestInterruptedError(
+                r.request_id, len(r._tokens), cause))
+        if taken_ids:
+            flight.record("serving", "handoff", n=len(taken_ids))
+
     def _step_once(self) -> bool:
         """One scheduler iteration: sweep, admit (batched prefill), one
         batched decode step.  Returns whether any work happened."""
-        from ..testing import faults
         faults.fault_point("serving.scheduler")
         self._sweep()
         did = self._admit()
@@ -605,12 +806,19 @@ class Engine:
 
     def health(self) -> dict:
         """Liveness snapshot: ``alive`` is True only while the engine can
-        still take and make progress on requests."""
+        still take and make progress on requests.  ``progress_age_s`` is
+        the time since the scheduler last completed an iteration — with
+        work pending, a growing age means the thread is stuck inside a
+        dispatch (the supervisor's stall signal)."""
         with self._lock:
             active, depth = self._pool.n_active, len(self._queue)
+            progress_age = time.perf_counter() - self._last_progress
+            built = self._built
         return {
-            "alive": self._dead is None and not self._stop,
+            "alive": (self._dead is None and not self._stop and
+                      not self._draining),
             "dead": self._dead is not None,
+            "draining": self._draining,
             "error": (None if self._dead is None
                       else f"{type(self._dead).__name__}: {self._dead}"),
             "stopped": self._stop,
@@ -618,6 +826,12 @@ class Engine:
                                   self._thread.is_alive()),
             "active_slots": active,
             "queue_depth": depth,
+            "progress_age_s": progress_age,
+            # warm = the decode program exists: dispatches are now
+            # bounded, so a frozen progress age means a genuine stall
+            # (cold engines legitimately sit in multi-second compiles)
+            "warm": built and
+            self.compile_stats()["decode_compiles"] >= 1,
         }
 
     def _sweep(self):
@@ -691,11 +905,18 @@ class Engine:
                           queue_wait_ms=round(
                               1e3 * (req.t_admit - req.t_submit), 3))
         t0 = time.perf_counter()
-        with span("serving.prefill", n=len(batch), bucket=bucket):
-            logits, self._kpools, self._vpools = self._prefill_fn(
-                self._values, jnp.asarray(ids), self._kpools, self._vpools,
-                jnp.asarray(slot_idx), jnp.asarray(plens))
-            logits = np.asarray(logits)
+        faults.fault_point("serving.prefill", n=len(batch))
+        if self._decode_timeout_s is not None:
+            _watchdog.arm("serving.prefill", self._decode_timeout_s)
+        try:
+            with span("serving.prefill", n=len(batch), bucket=bucket):
+                logits, self._kpools, self._vpools = self._prefill_fn(
+                    self._values, jnp.asarray(ids), self._kpools,
+                    self._vpools, jnp.asarray(slot_idx), jnp.asarray(plens))
+                logits = np.asarray(logits)
+        finally:
+            if self._decode_timeout_s is not None:
+                _watchdog.disarm()
         dt = time.perf_counter() - t0
         with self._lock:
             self._counts["prefill_batches"] += 1
@@ -726,11 +947,18 @@ class Engine:
             act = np.array(self._active)
         import jax.numpy as jnp
         t0 = time.perf_counter()
-        with span("serving.decode", active=len(active)):
-            logits, self._kpools, self._vpools, _ = self._decode_fn(
-                self._values, jnp.asarray(ids), self._kpools,
-                self._vpools, jnp.asarray(lengths), jnp.asarray(act))
-            logits = np.asarray(logits)
+        faults.fault_point("serving.decode", active=len(active))
+        if self._decode_timeout_s is not None:
+            _watchdog.arm("serving.decode", self._decode_timeout_s)
+        try:
+            with span("serving.decode", active=len(active)):
+                logits, self._kpools, self._vpools, _ = self._decode_fn(
+                    self._values, jnp.asarray(ids), self._kpools,
+                    self._vpools, jnp.asarray(lengths), jnp.asarray(act))
+                logits = np.asarray(logits)
+        finally:
+            if self._decode_timeout_s is not None:
+                _watchdog.disarm()
         dt = time.perf_counter() - t0
         with self._lock:
             self._counts["decode_steps"] += 1
@@ -753,6 +981,12 @@ class Engine:
     def _emit_token(self, req: RequestHandle, logits_row, first: bool):
         """Sample, stream, and either park the token as the slot's next
         decode input or complete + evict the request."""
+        if req.done() or req._torn or req._engine is not self:
+            # torn away by a supervisor abandon while this batch ran (or
+            # already re-dispatched into a REBUILT engine): its slot here
+            # is freed and its outcome is settled elsewhere
+            return
+        faults.fault_point("serving.stream", request=req.request_id)
         token = _sample_row(logits_row, req.temperature, req.top_k, req._rng)
         req._emit(token)
         registry().counter(SERVING_TOKENS, "tokens generated").inc(1.0)

@@ -31,7 +31,9 @@ import time
 import numpy as np
 
 from ...observability import flight, registry
-from ..engine import EngineDeadError, QueueFullError
+from ...testing import faults
+from ..engine import (SERVING_REDISPATCHED, EngineDeadError, QueueFullError,
+                      RequestInterruptedError)
 from .admission import AdmissionError, FairShareScheduler, TenantConfig
 from .protocol import PRIORITIES, CompletionRequest, ProtocolError
 from .router import EngineRouter, NoEngineAvailableError
@@ -57,15 +59,21 @@ class GatewayClosedError(RuntimeError):
 class GatewayRequest:
     """One admitted request crossing the handler/dispatcher boundary.
 
-    The handler thread blocks on :attr:`ready` (dispatch or failure —
-    ``handle``/``error`` are written before the event is set, which
-    publishes them), then on the engine handle; streamed tokens arrive on
-    :attr:`token_q` from the engine's scheduler thread.
+    The handler thread blocks on :attr:`ready` (first dispatch or early
+    failure — ``handle``/``error`` are written before the event is set,
+    which publishes them) and then on :attr:`done_ev` for the FINAL
+    outcome; streamed tokens arrive on :attr:`token_q` from the engine's
+    scheduler thread.  The dispatcher's reaper is the single authority
+    on the final outcome: an engine death may replace :attr:`handle`
+    with a re-dispatched one (safe only while no token has reached the
+    client), so handlers never treat a handle failure as final — they
+    wait for :meth:`finish`.
     """
 
     __slots__ = ("id", "creq", "tenant", "priority", "cost", "prompt",
                  "t_enqueue", "t_dispatch", "token_q", "ready", "handle",
-                 "error", "engine_name", "deadline")
+                 "error", "engine_name", "deadline", "done_ev",
+                 "final_error", "redispatches")
 
     def __init__(self, creq: CompletionRequest, tenant: str, priority: str,
                  prompt: np.ndarray):
@@ -82,13 +90,24 @@ class GatewayRequest:
                          else now + creq.deadline_s)
         self.token_q: queue.Queue = queue.Queue()
         self.ready = threading.Event()
+        self.done_ev = threading.Event()
         self.handle = None
         self.error: BaseException | None = None
+        self.final_error: BaseException | None = None
         self.engine_name: str | None = None
+        self.redispatches = 0
 
     def fail(self, error: BaseException):
+        """Final failure before (or instead of) a dispatch."""
         self.error = error
+        self.final_error = error
         self.ready.set()
+        self.done_ev.set()
+
+    def finish(self, error: BaseException | None = None):
+        """Final outcome after a dispatch (reaper only)."""
+        self.final_error = error
+        self.done_ev.set()
 
     def dispatched(self, handle, engine_name: str):
         self.handle = handle
@@ -114,6 +133,10 @@ class Gateway:
         max_queue_total: global queued-request bound across tenants.
         dispatch_slack: how deep past the slot pool the dispatcher lets an
             engine's own queue grow (small = ordering stays fair-share).
+        max_redispatch: gateway-side retry budget for requests whose
+            engine died before any token reached the client (engine
+            replacements on ANOTHER replica; a supervisor's same-handle
+            re-dispatches have their own budget).
         model_name: echoed in completion responses.
         start: start the dispatcher thread immediately (tests stage
             queues deterministically with False, then call start()).
@@ -124,6 +147,7 @@ class Gateway:
                  api_keys: dict | None = None, names=None,
                  shedder: LoadShedder | None = None,
                  max_queue_total: int | None = None, dispatch_slack: int = 1,
+                 max_redispatch: int = 2,
                  model_name: str = "paddle-tpu", start: bool = True):
         if hasattr(engines, "submit"):
             engines = [engines]
@@ -134,10 +158,14 @@ class Gateway:
         self.api_keys = dict(api_keys) if api_keys else None
         self.model_name = model_name
         self.dispatch_slack = int(dispatch_slack)
+        self.max_redispatch = int(max_redispatch)
         self.tokenizer = next(
             (e.tokenizer for e in self.router.engines
              if e.tokenizer is not None), None)
         self._stop_ev = threading.Event()
+        self._drain_ev = threading.Event()
+        self._drain_retry_after_s = 5.0
+        self._dispatcher_error: BaseException | None = None
         self._thread: threading.Thread | None = None
         if start:
             self.start()
@@ -170,6 +198,33 @@ class Gateway:
 
     close = shutdown
 
+    def drain(self, deadline_s: float = 30.0) -> bool:
+        """Graceful shutdown, phase one: new admissions are shed with a
+        structured 429 + ``Retry-After`` while queued and in-flight work
+        runs to completion (the dispatcher keeps feeding the engines).
+        Returns True when the gateway went idle before the deadline —
+        a ``shutdown()`` then drops nothing."""
+        self._drain_retry_after_s = max(1.0, float(deadline_s))
+        self._drain_ev.set()
+        flight.record("gateway", "drain_begin",
+                      deadline_s=float(deadline_s),
+                      queued=self.scheduler.depth())
+        deadline = time.perf_counter() + float(deadline_s)
+        ok = False
+        while time.perf_counter() < deadline:
+            d = self.scheduler.depths()
+            if all(v["queued"] == 0 and v["in_flight"] == 0
+                   for v in d.values()):
+                ok = True
+                break
+            time.sleep(0.01)
+        flight.record("gateway", "drain_done", drained=ok)
+        return ok
+
+    @property
+    def draining(self) -> bool:
+        return self._drain_ev.is_set()
+
     def __enter__(self):
         return self
 
@@ -184,6 +239,19 @@ class Gateway:
         (429, incl. SLO shed) or GatewayClosedError (503)."""
         if self._stop_ev.is_set():
             raise GatewayClosedError("gateway is shut down")
+        if self._dispatcher_error is not None:
+            raise GatewayClosedError(
+                f"gateway dispatcher died: "
+                f"{type(self._dispatcher_error).__name__}: "
+                f"{self._dispatcher_error}")
+        if self._drain_ev.is_set():
+            self._count(tenant, "shed")
+            registry().counter(GATEWAY_SHED, "requests shed by reason").inc(
+                1.0, labels={"tenant": tenant, "reason": "draining"})
+            raise AdmissionError(
+                "draining", "gateway is draining for shutdown; retry "
+                "against another replica",
+                retry_after_s=self._drain_retry_after_s, tenant=tenant)
         if not self.router.any_alive():
             raise NoEngineAvailableError(
                 "no alive engine replica to serve this request")
@@ -268,14 +336,15 @@ class Gateway:
 
     # -- result wait (handler threads) ---------------------------------------
     def result(self, item: GatewayRequest, timeout: float | None = None):
-        """Block for the finished request; returns (token_ids, finish
-        reason).  Engine/gateway failures re-raise for http.py to map."""
-        if not item.ready.wait(timeout):
-            raise TimeoutError(f"request {item.id} was not dispatched "
+        """Block for the FINAL outcome (the reaper may transparently
+        re-dispatch an engine death first); returns (token_ids, finish
+        reason).  Failures re-raise for http.py to map."""
+        if not item.done_ev.wait(timeout):
+            raise TimeoutError(f"request {item.id} did not finish "
                                f"within {timeout}s")
-        if item.error is not None:
-            raise item.error
-        tokens = item.handle.result(timeout=timeout)
+        if item.final_error is not None:
+            raise item.final_error
+        tokens = item.handle.result(timeout=0)
         eos = item.handle.eos_token_id
         finish = ("stop" if eos is not None and tokens.size and
                   int(tokens[-1]) == eos else "length")
@@ -283,8 +352,27 @@ class Gateway:
 
     # -- dispatcher thread ---------------------------------------------------
     def _dispatch_loop(self):
+        try:
+            self._dispatch_impl()
+        except Exception as e:  # noqa: BLE001 — die LOUDLY, not silently
+            # dispatcher death must degrade /healthz and fail queued work
+            # instead of hanging every admitted handler to its timeout.
+            # single None->exc transition; admit()/healthz() read it
+            # lock-free like the engine's _dead monitor flag
+            self._dispatcher_error = e  # tpu-lint: ok(concurrency)
+            flight.record("gateway", "dispatcher_died",
+                          error=f"{type(e).__name__}: {e}")
+            err = GatewayClosedError(
+                f"gateway dispatcher died: {type(e).__name__}: {e}")
+            for item in self.scheduler.drain():
+                item.fail(err)
+                self._count(item.tenant, "failed")
+            raise
+
+    def _dispatch_impl(self):
         outstanding: list = []       # local to this thread — never shared
         while True:
+            faults.fault_point("gateway.dispatch")
             self._reap(outstanding)
             if self._stop_ev.is_set():
                 break
@@ -326,6 +414,11 @@ class Gateway:
             self._reap(outstanding)
             if outstanding:
                 time.sleep(0.01)
+        err = GatewayClosedError("gateway shut down mid-request")
+        for item in outstanding:     # still running past the grace window
+            self.scheduler.release(item.tenant, item.cost)
+            self._count(item.tenant, "failed")
+            item.finish(err)
 
     def _submit(self, item: GatewayRequest) -> bool:
         """Route one popped item to a replica.  True when submitted;
@@ -363,6 +456,20 @@ class Gateway:
                 tried.append(name)
                 flight.record("gateway", "failover", request=item.id,
                               engine=name)
+                if len(tried) >= len(self.router.names):
+                    if self.router.any_alive():
+                        # a replica is mid-restart (supervised) or the
+                        # death raced the pick: park the item back at the
+                        # head of its queue and let the headroom gate
+                        # retry once the fleet settles
+                        self.scheduler.requeue(item)
+                        time.sleep(0.002)
+                        return False
+                    self.scheduler.release(item.tenant, item.cost)
+                    self._count(item.tenant, "failed")
+                    item.fail(NoEngineAvailableError(
+                        "every engine replica is dead"))
+                    return False
                 continue
             except Exception as e:  # noqa: BLE001 — surface to the caller
                 self.scheduler.release(item.tenant, item.cost)
@@ -378,15 +485,35 @@ class Gateway:
 
     def _reap(self, outstanding: list):
         """Retire finished engine handles: release the tenant's
-        concurrency unit, feed the shedder, record per-tenant TTFT."""
+        concurrency unit, feed the shedder, record per-tenant TTFT —
+        and re-dispatch handles whose engine died before any token
+        reached the client (bounded by ``max_redispatch``)."""
         done = [it for it in outstanding if it.handle.done()]
         if not done:
             return
         reg = registry()
         for item in done:
             outstanding.remove(item)
-            self.scheduler.release(item.tenant, item.cost)
             err = item.handle.exception(timeout=0)
+            if err is not None and self._redispatchable(item, err):
+                item.redispatches += 1
+                self._flush_tokens(item)
+                reg.counter(
+                    SERVING_REDISPATCHED,
+                    "requests re-dispatched after an engine death").inc(
+                    1.0, labels={"layer": "gateway"})
+                flight.record("gateway", "redispatch", request=item.id,
+                              attempt=item.redispatches,
+                              error=type(err).__name__)
+                if self._submit(item):
+                    # new handle on another replica; tenant accounting is
+                    # still owed — the item stays in flight
+                    outstanding.append(item)
+                # on False the item was either requeued (the main loop
+                # pops and re-submits it) or permanently failed — both
+                # settle the accounting inside _submit
+                continue
+            self.scheduler.release(item.tenant, item.cost)
             if err is None:
                 self._count(item.tenant, "completed")
                 self.shedder.observe(item.handle.ttft_s,
@@ -398,14 +525,42 @@ class Gateway:
                         GATEWAY_TTFT,
                         "enqueue -> first token, per tenant").observe(
                         gw_ttft, labels={"tenant": item.tenant})
+                item.finish(None)
             else:
                 # engine-side failure after dispatch (deadline inside the
-                # engine, cancellation, engine death): the handle carries
-                # it; handler threads see it via result()
+                # engine, cancellation, unrecoverable engine death): the
+                # reaper makes it final; handlers see it via result()
                 outcome = type(err).__name__
                 self._count(item.tenant, "expired_engine"
                             if "Deadline" in outcome else "failed")
+                item.finish(err)
         self._depth_gauges()
+
+    def _redispatchable(self, item: GatewayRequest,
+                        err: BaseException) -> bool:
+        """The retry-safety rule: re-dispatch iff no token can have
+        reached the client.  ``EngineDeadError`` means zero tokens were
+        emitted at all; ``RequestInterruptedError`` means tokens were
+        emitted but — for a NON-streaming request — they only ever
+        reached the gateway's internal queue, which is flushed before
+        the retry."""
+        if item.redispatches >= self.max_redispatch:
+            return False
+        if self._stop_ev.is_set():
+            return False
+        if isinstance(err, EngineDeadError):
+            return not item.handle.tokens    # engine guarantees zero
+        if isinstance(err, RequestInterruptedError):
+            return not item.creq.stream
+        return False
+
+    @staticmethod
+    def _flush_tokens(item: GatewayRequest):
+        while not item.token_q.empty():
+            try:
+                item.token_q.get_nowait()
+            except queue.Empty:              # pragma: no cover - racing reap
+                break
 
     # -- metrics helpers -----------------------------------------------------
     def _count(self, tenant: str, outcome: str):
@@ -430,17 +585,39 @@ class Gateway:
             "engines": self.router.loads(),
             "shedder": self.shedder.snapshot(),
             "closed": self._stop_ev.is_set(),
+            "draining": self._drain_ev.is_set(),
+            "dispatcher_alive": self.dispatcher_alive(),
         }
+
+    def dispatcher_alive(self) -> bool:
+        """False once the dispatcher thread died (or was never started):
+        admitted work would hang, so /healthz degrades instead."""
+        return (self._dispatcher_error is None and
+                self._thread is not None and self._thread.is_alive())
 
     def healthz(self) -> dict:
         loads = self.router.loads()
         alive = [n for n, ld in loads.items() if ld["alive"]]
-        return {
-            "alive": bool(alive) and not self._stop_ev.is_set(),
+        dispatcher_ok = (self.dispatcher_alive() or
+                         # not started yet (start=False tests): not dead
+                         (self._thread is None and
+                          self._dispatcher_error is None and
+                          not self._stop_ev.is_set()))
+        out = {
+            "alive": (bool(alive) and not self._stop_ev.is_set() and
+                      not self._drain_ev.is_set() and dispatcher_ok),
+            "draining": self._drain_ev.is_set(),
+            "dispatcher_alive": dispatcher_ok,
             "engines": {n: {"alive": ld["alive"],
                             "slots_in_use": ld["slots_in_use"],
-                            "queue_depth": ld["queue_depth"]}
+                            "queue_depth": ld["queue_depth"],
+                            "restarting": bool(ld.get("restarting"))}
                         for n, ld in loads.items()},
             "queued": self.scheduler.depth(),
             "priorities": sorted(PRIORITIES),
         }
+        if self._dispatcher_error is not None:
+            out["dispatcher_error"] = (
+                f"{type(self._dispatcher_error).__name__}: "
+                f"{self._dispatcher_error}")
+        return out

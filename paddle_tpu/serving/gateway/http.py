@@ -23,14 +23,16 @@ and the OpenAI error envelope with a machine-readable ``code``.
 from __future__ import annotations
 
 import json
+import signal
 import threading
+import time
 from concurrent.futures import CancelledError
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from queue import Empty
 
-from ...observability import registry
+from ...observability import flight, registry
 from ..engine import (DeadlineExceededError, EngineClosedError,
-                      EngineDeadError)
+                      EngineDeadError, RequestInterruptedError)
 from .admission import AdmissionError
 from .gateway import Gateway, GatewayClosedError
 from .protocol import (SSE_DONE, ProtocolError, chunk_body, completion_body,
@@ -105,6 +107,12 @@ class _Handler(BaseHTTPRequestHandler):
         elif isinstance(err, DeadlineExceededError):
             self._send_json(504, error_body(
                 str(err), etype="timeout_error", code="deadline_exceeded"))
+        elif isinstance(err, RequestInterruptedError):
+            # the engine died mid-generation and the retry budget could
+            # not absorb it; tokens may have been produced, none are
+            # delivered — the client decides whether to re-send
+            self._send_json(503, error_body(
+                str(err), etype="server_error", code="interrupted"))
         elif isinstance(err, (NoEngineAvailableError, GatewayClosedError,
                               EngineClosedError, EngineDeadError)):
             self._send_json(503, error_body(
@@ -220,27 +228,31 @@ class _Handler(BaseHTTPRequestHandler):
         registry().counter(GATEWAY_HTTP, "gateway HTTP responses by code"
                            ).inc(1.0, labels={"code": 200})
         model = self._model_name(item.creq)
-        handle = item.handle
         sent = 0
         try:
+            # final outcome comes from item.done_ev / item.final_error,
+            # never the raw handle: a supervisor or the gateway reaper
+            # may transparently replace the handle while re-dispatching
+            # a zero-token engine death
             while True:
                 try:
                     tok = item.token_q.get(timeout=_STREAM_POLL_S)
                 except Empty:
-                    if handle.done():
+                    if item.done_ev.is_set():
                         break
                     continue
                 sent += 1
                 self._write_chunk(sse_event(chunk_body(
                     item.id, model, self._text([tok]), [int(tok)], None)))
-            # drain tokens that raced the done() check
+            # drain tokens that raced the done check
             while not item.token_q.empty():
                 tok = item.token_q.get_nowait()
                 sent += 1
                 self._write_chunk(sse_event(chunk_body(
                     item.id, model, self._text([tok]), [int(tok)], None)))
-            err = handle.exception(timeout=0)
+            err = item.final_error
             if err is None:
+                handle = item.handle
                 eos = handle.eos_token_id
                 toks = handle.tokens
                 finish = ("stop" if eos is not None and toks and
@@ -248,23 +260,32 @@ class _Handler(BaseHTTPRequestHandler):
                 self._write_chunk(sse_event(chunk_body(
                     item.id, model, "", [], finish)))
             else:
+                code = ("stream_interrupted"
+                        if isinstance(err, RequestInterruptedError)
+                        else "stream_aborted")
                 self._write_chunk(sse_event({
                     "id": item.id,
                     "error": error_body(
                         f"{type(err).__name__}: {err}",
-                        etype="server_error", code="stream_aborted")
-                    ["error"]}))
+                        etype="server_error", code=code)["error"]}))
             self._write_chunk(SSE_DONE)
             self._end_chunks()
         except (BrokenPipeError, ConnectionResetError):
             # client went away mid-stream: free the slot immediately
-            handle.cancel()
+            item.handle.cancel()
 
 
 # -- convenience stack --------------------------------------------------------
 
 class GatewayStack:
-    """Gateway + HTTP server + serving thread, torn down in order."""
+    """Gateway + HTTP server + serving thread, torn down in order.
+
+    Graceful shutdown (the serving analogue of
+    ``framework/preemption.py``): :meth:`install_sigterm_drain` converts
+    SIGTERM into shed-new-traffic-with-``Retry-After`` -> drain -> clean
+    exit — the signal handler only sets an Event; a waiter thread runs
+    the actual drain (flight events, locks and socket teardown are not
+    async-signal-safe)."""
 
     def __init__(self, gateway: Gateway, server: GatewayHTTPServer,
                  thread: threading.Thread, own_engines: bool = False):
@@ -272,6 +293,13 @@ class GatewayStack:
         self.server = server
         self.thread = thread
         self.own_engines = own_engines
+        self._lock = threading.Lock()
+        self._sigterm_ev = threading.Event()
+        self._terminated_ev = threading.Event()
+        self._drain_deadline_s = 30.0
+        self._drain_result: bool | None = None
+        self._waiter: threading.Thread | None = None
+        self._prev_sigterm = None
 
     @property
     def port(self) -> int:
@@ -282,6 +310,65 @@ class GatewayStack:
         host, port = self.server.server_address[:2]
         return f"http://{host}:{port}"
 
+    def drain(self, deadline_s: float = 30.0) -> bool:
+        """Graceful teardown: the HTTP listener keeps answering (new
+        completions get 429 + ``Retry-After``) while the gateway runs its
+        queued and in-flight work dry, then the owned engines drain their
+        decode work, then everything closes.  Returns True when nothing
+        was dropped."""
+        t0 = time.perf_counter()
+        ok = self.gateway.drain(deadline_s)
+        if self.own_engines:
+            for eng in self.gateway.router.engines:
+                remaining = max(
+                    0.5, deadline_s - (time.perf_counter() - t0))
+                ok = eng.drain(remaining) and ok
+        self.close()
+        return ok
+
+    def install_sigterm_drain(self, deadline_s: float = 30.0):
+        """Arm the SIGTERM -> drain -> clean-exit path.  Call from the
+        main thread (signal installation is impossible elsewhere)."""
+        with self._lock:
+            self._drain_deadline_s = float(deadline_s)
+        ev = self._sigterm_ev
+
+        def _handler(sig, frame):
+            # async-signal-safe by construction: ONLY flips the Event;
+            # the waiter thread does the lock/IO-heavy drain
+            ev.set()
+
+        prev = signal.getsignal(signal.SIGTERM)
+        signal.signal(signal.SIGTERM, _handler)
+        with self._lock:
+            self._prev_sigterm = prev
+        self._waiter = threading.Thread(
+            target=self._drain_on_signal, daemon=True,
+            name="paddle-tpu-gateway-drain")
+        self._waiter.start()
+
+    def _drain_on_signal(self):
+        self._sigterm_ev.wait()
+        if self._terminated_ev.is_set():
+            return                    # already closed normally
+        with self._lock:
+            deadline_s = self._drain_deadline_s
+        flight.record("gateway", "sigterm_drain", deadline_s=deadline_s)
+        ok = self.drain(deadline_s)
+        with self._lock:
+            self._drain_result = ok
+
+    @property
+    def drain_result(self) -> bool | None:
+        """Outcome of the signal-triggered drain (None before one ran)."""
+        with self._lock:
+            return self._drain_result
+
+    def wait_terminated(self, timeout: float | None = None) -> bool:
+        """Block until the stack is fully closed (normal close() or the
+        SIGTERM drain path)."""
+        return self._terminated_ev.wait(timeout)
+
     def close(self):
         """Stop accepting, fail queued work, (optionally) stop engines."""
         self.server.shutdown()
@@ -291,6 +378,14 @@ class GatewayStack:
             for eng in self.gateway.router.engines:
                 eng.shutdown()
         self.thread.join(timeout=10)
+        with self._lock:
+            prev, self._prev_sigterm = self._prev_sigterm, None
+        if prev is not None:
+            try:
+                signal.signal(signal.SIGTERM, prev)
+            except (ValueError, OSError):   # not the main thread
+                pass
+        self._terminated_ev.set()
 
     def __enter__(self):
         return self

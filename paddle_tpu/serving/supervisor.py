@@ -1,0 +1,376 @@
+"""EngineSupervisor — detect a dead engine, rebuild it, re-dispatch the
+work that is still duplication-safe.
+
+PR 5 gave the *training* tier preemption safety; this module is the
+serving analogue.  An :class:`~paddle_tpu.serving.engine.Engine` whose
+scheduler crashes (or whose decode stalls inside an XLA call) is
+fail-stop by design — restarting the loop over an already-failed pool
+would serve garbage.  The supervisor therefore restarts *around* it:
+
+* **detect** — a monitor thread polls ``Engine.health()``; ``dead: True``
+  is a crash, and a frozen ``progress_age_s`` with work pending past
+  ``stall_timeout_s`` is a decode stall (the engine is then
+  :meth:`~paddle_tpu.serving.engine.Engine.abandon`-ed, which classifies
+  its requests exactly like a crash).
+* **rebuild** — the old engine is torn down and a FRESH engine + slot
+  pool is built from the same model/config via the caller's ``factory``;
+  each build compiles its own single decode signature (asserted by the
+  chaos lane through the retrace sentinel).
+* **re-dispatch** — the dying engine offers its zero-tokens-emitted
+  requests (queued or active) to the supervisor through the engine's
+  ``redispatch_hook``; the supervisor parks them and re-enqueues the
+  SAME handles into the rebuilt engine, so callers blocked on
+  ``result()`` never notice.  Requests that already streamed tokens are
+  never replayed — they fail with the typed ``RequestInterruptedError``
+  (the retry-safety rule: retryable iff nothing reached a consumer).
+
+Restart attempts are budgeted (``max_restarts`` per
+``restart_window_s``); past the budget the supervisor gives up, fails
+the parked requests with ``EngineDeadError`` and advertises not-alive so
+a router stops picking the replica.
+
+The supervisor is Engine-shaped (``submit/load/health/drain/shutdown``
+proxy to the CURRENT engine), so an ``EngineRouter`` can hold one
+wherever it held an engine::
+
+    sup = EngineSupervisor(lambda: Engine(model, max_slots=8),
+                           name="engine0", stall_timeout_s=30.0)
+    stack = start_gateway([sup], own_engines=True)
+
+During the death->rebuild window ``load()`` advertises the replica as
+alive-with-zero-headroom, so the gateway's dispatcher *waits* for the
+rebuild instead of failing queued work fast (the all-dead 503 path is
+reserved for replicas that are genuinely gone).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional
+
+from ..observability import flight, registry
+from ..testing import faults
+from .engine import (Engine, EngineClosedError, EngineDeadError,
+                     EngineStalledError, QueueFullError)
+
+__all__ = ["EngineSupervisor", "SERVING_RESTARTS"]
+
+SERVING_RESTARTS = "paddle_tpu_serving_engine_restarts_total"
+
+
+class EngineSupervisor:
+    """Self-healing wrapper around one Engine replica (see module doc).
+
+    Args:
+        factory: zero-arg callable returning a fresh :class:`Engine`
+            built from the same model/config — called once at
+            construction and once per restart.
+        name: replica name used in metrics/flight events.
+        max_restarts: restart budget inside ``restart_window_s``; one
+            more death past it makes the supervisor give up.
+        restart_window_s: sliding window for the restart budget.
+        poll_interval_s: monitor thread poll period.
+        stall_timeout_s: declare a stall (and abandon the engine) when
+            the scheduler makes no progress for this long with work
+            pending; None disables stall detection (crashes are still
+            caught).  Only armed once the build is WARM (decode program
+            compiled) — cold engines legitimately sit in multi-second
+            compiles — so the bound only has to exceed a steady-state
+            dispatch.  Read per poll: operators may set/clear it at
+            runtime.
+        max_redispatch: per-request cap on supervisor re-dispatches; a
+            request dying more often than this fails with
+            ``EngineDeadError`` instead of looping forever.
+    """
+
+    def __init__(self, factory: Callable[[], Engine], *,
+                 name: str = "engine", max_restarts: int = 3,
+                 restart_window_s: float = 60.0,
+                 poll_interval_s: float = 0.05,
+                 stall_timeout_s: Optional[float] = None,
+                 max_redispatch: int = 2):
+        self.factory = factory
+        self.name = str(name)
+        self.max_restarts = int(max_restarts)
+        self.restart_window_s = float(restart_window_s)
+        self.poll_interval_s = float(poll_interval_s)
+        self.stall_timeout_s = (None if stall_timeout_s is None
+                                else float(stall_timeout_s))
+        self.max_redispatch = int(max_redispatch)
+        self._lock = threading.Lock()
+        self._stop_ev = threading.Event()
+        self._wake_ev = threading.Event()
+        self._parked: List = []
+        self._restart_times: List[float] = []
+        self._failed: Optional[BaseException] = None
+        self._restarting = False
+        self._restarts = 0
+        self._redispatched = 0
+        self._build_stats: List[dict] = []
+        self._engine = self._attach(factory())
+        self._thread = threading.Thread(
+            target=self._watch, name=f"paddle-tpu-supervisor-{self.name}",
+            daemon=True)
+        self._thread.start()
+
+    def _attach(self, eng: Engine) -> Engine:
+        eng.redispatch_hook = self._take_requests
+        return eng
+
+    # -- redispatch hook (runs on the dying engine's thread) -----------------
+    def _take_requests(self, requests, cause):
+        """Engine death callback: take ownership of the zero-token
+        requests still inside the re-dispatch budget; they are parked
+        until the rebuilt engine exists."""
+        taken = []
+        with self._lock:
+            if self._stop_ev.is_set() or self._failed is not None:
+                return taken
+            for req in requests:
+                if req.redispatches < self.max_redispatch:
+                    taken.append(req)
+            self._parked.extend(taken)
+        if taken:
+            flight.record("supervisor", "park", engine=self.name,
+                          n=len(taken), error=type(cause).__name__)
+        self._wake_ev.set()
+        return taken
+
+    # -- monitor thread ------------------------------------------------------
+    def _watch(self):
+        while not self._stop_ev.is_set():
+            with self._lock:
+                eng = self._engine
+            h = eng.health()
+            if h["dead"]:
+                self._restart(eng)
+            elif (self.stall_timeout_s is not None and h["alive"] and
+                  h["scheduler_running"] and h["warm"] and
+                  (h["active_slots"] or h["queue_depth"]) and
+                  h["progress_age_s"] > self.stall_timeout_s):
+                flight.record("supervisor", "stall", engine=self.name,
+                              progress_age_s=round(h["progress_age_s"], 3))
+                eng.abandon(EngineStalledError(
+                    f"engine {self.name!r}: no scheduler progress for "
+                    f"{h['progress_age_s']:.2f}s with work pending "
+                    f"(stall_timeout_s={self.stall_timeout_s})"))
+                self._restart(eng)
+            self._wake_ev.wait(self.poll_interval_s)
+            self._wake_ev.clear()
+
+    def _restart(self, old: Engine):
+        """Tear down the dead engine, rebuild, re-enqueue parked work."""
+        now = time.monotonic()
+        with self._lock:
+            if self._failed is not None or self._stop_ev.is_set():
+                return
+            self._restart_times = [
+                t for t in self._restart_times
+                if now - t < self.restart_window_s]
+            over_budget = len(self._restart_times) >= self.max_restarts
+            if over_budget:
+                self._failed = RuntimeError(
+                    f"supervisor {self.name!r}: restart budget exhausted "
+                    f"({self.max_restarts} restarts in "
+                    f"{self.restart_window_s:g}s)")
+                parked, self._parked = self._parked, []
+            else:
+                self._restart_times.append(now)
+                self._restarting = True
+                if not getattr(old, "_supervisor_retired", False):
+                    old._supervisor_retired = True
+                    self._build_stats.append(old.compile_stats())
+        if over_budget:
+            cause = old._dead or self._failed
+            flight.record("supervisor", "giveup", engine=self.name,
+                          failed_requests=len(parked),
+                          error=f"{type(cause).__name__}: {cause}")
+            for req in parked:
+                req._finish(EngineDeadError(cause))
+            return
+        flight.record("supervisor", "teardown", engine=self.name,
+                      error=(None if old._dead is None
+                             else f"{type(old._dead).__name__}: "
+                                  f"{old._dead}"))
+        try:
+            old.shutdown()
+        except Exception:  # noqa: BLE001 — the old engine is expendable
+            pass
+        try:
+            faults.fault_point("serving.rebuild", engine=self.name)
+            new = self._attach(self.factory())
+        except Exception as e:  # noqa: BLE001 — retry on the next poll
+            flight.record("supervisor", "rebuild_failed", engine=self.name,
+                          error=f"{type(e).__name__}: {e}")
+            with self._lock:
+                self._restarting = False
+            return          # the monitor sees the engine still dead and
+            #                 tries again; the budget bounds the retries
+        with self._lock:
+            self._engine = new
+            parked, self._parked = self._parked, []
+            self._restarting = False
+            self._restarts += 1
+            restarts = self._restarts
+        requeued = 0
+        for req in parked:
+            try:
+                new.resubmit(req)
+                requeued += 1
+            except Exception as e:  # noqa: BLE001 — never strand a handle
+                req._finish(e if isinstance(e, EngineDeadError)
+                            else EngineDeadError(e))
+        with self._lock:
+            self._redispatched += requeued
+        try:
+            new.start()
+        except Exception:  # noqa: BLE001 — died instantly; next poll retries
+            pass
+        registry().counter(
+            SERVING_RESTARTS,
+            "engine rebuilds performed by a supervisor").inc(
+            1.0, labels={"engine": self.name})
+        flight.record("supervisor", "restart", engine=self.name,
+                      restarts=restarts, redispatched=requeued)
+
+    # -- engine-shaped surface -----------------------------------------------
+    @property
+    def engine(self) -> Engine:
+        """The CURRENT engine build (changes across restarts)."""
+        with self._lock:
+            return self._engine
+
+    @property
+    def tokenizer(self):
+        return self.engine.tokenizer
+
+    @property
+    def max_len(self) -> int:
+        return self.engine.max_len
+
+    @property
+    def max_slots(self) -> int:
+        return self.engine.max_slots
+
+    @property
+    def restarts(self) -> int:
+        with self._lock:
+            return self._restarts
+
+    @property
+    def redispatched(self) -> int:
+        with self._lock:
+            return self._redispatched
+
+    @property
+    def failed(self) -> Optional[BaseException]:
+        with self._lock:
+            return self._failed
+
+    def builds(self) -> List[dict]:
+        """compile_stats() of every RETIRED build plus the current one —
+        the chaos lane asserts each build compiled exactly one decode
+        signature."""
+        with self._lock:
+            eng = self._engine
+            out = list(self._build_stats)
+        out.append(eng.compile_stats())
+        return out
+
+    def submit(self, *args, **kwargs):
+        with self._lock:
+            eng, failed = self._engine, self._failed
+        if failed is not None:
+            raise EngineDeadError(failed)
+        try:
+            return eng.submit(*args, **kwargs)
+        except EngineDeadError:
+            if self.failed is not None:
+                raise
+            # between death and rebuild: this is backpressure, not a
+            # permanent failure — callers retry exactly like a full queue
+            raise QueueFullError(
+                f"engine {self.name!r} is restarting; retry shortly") \
+                from None
+
+    def load(self) -> dict:
+        with self._lock:
+            eng, failed, stopped = (self._engine, self._failed,
+                                    self._stop_ev.is_set())
+        ld = eng.load()
+        if failed is not None or stopped:
+            ld["alive"] = False
+        elif not ld["alive"] and not ld["draining"] and eng.health()["dead"]:
+            # dead-but-supervised: the rebuild is imminent — advertise
+            # alive with zero headroom so routers WAIT instead of
+            # declaring the replica gone
+            ld.update(alive=True, restarting=True,
+                      slots_in_use=ld["max_slots"],
+                      queue_depth=ld["max_queue"])
+        return ld
+
+    def health(self) -> dict:
+        with self._lock:
+            eng, failed, restarting = (self._engine, self._failed,
+                                       self._restarting)
+            restarts, stopped = self._restarts, self._stop_ev.is_set()
+        h = eng.health()
+        h["supervised"] = True
+        h["restarts"] = restarts
+        h["restarting"] = restarting or (
+            h["dead"] and failed is None and not stopped)
+        if failed is not None:
+            h["alive"] = False
+            h["supervisor_failed"] = f"{type(failed).__name__}: {failed}"
+        return h
+
+    def stats(self) -> dict:
+        return self.engine.stats()
+
+    def compile_stats(self) -> dict:
+        return self.engine.compile_stats()
+
+    def queue_depth(self) -> int:
+        return self.engine.queue_depth()
+
+    def slots_in_use(self) -> int:
+        return self.engine.slots_in_use()
+
+    def join(self, timeout: Optional[float] = None) -> bool:
+        return self.engine.join(timeout)
+
+    def drain(self, deadline_s: float = 30.0) -> bool:
+        """Drain the current engine (no restarts happen past this point:
+        drain is the graceful end of the replica's life)."""
+        return self.engine.drain(deadline_s)
+
+    def shutdown(self):
+        """Stop supervising and shut the current engine down; parked
+        requests (mid-rebuild) fail with EngineClosedError."""
+        self._stop_ev.set()
+        self._wake_ev.set()
+        with self._lock:
+            eng = self._engine
+            parked, self._parked = self._parked, []
+        err = EngineClosedError("supervisor shut down")
+        for req in parked:
+            req._finish(err)
+        eng.shutdown()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+    close = shutdown
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+        return False
+
+    def __repr__(self):
+        with self._lock:
+            state = ("failed" if self._failed is not None else
+                     "restarting" if self._restarting else "ok")
+        return (f"EngineSupervisor(name={self.name!r}, state={state}, "
+                f"restarts={self.restarts})")

@@ -36,13 +36,19 @@ Fault points in the tree (see docs/robustness.md for the catalogue):
 ``checkpoint.write``, ``checkpoint.manifest``, ``checkpoint.commit``,
 ``checkpoint.promote``, ``checkpoint.upload``,
 ``checkpoint.upload_commit``, ``fs.upload``, ``fs.download``,
-``serving.scheduler``, ``train.step``, and — the elastic-restore path
+``serving.scheduler``, ``train.step``, the elastic-restore path
 (ISSUE 6) — ``restore.read`` (per-leaf checkpoint read, before CRC),
 ``restore.relayout`` (before a leaf/state is laid out on the target
-mesh), ``restore.rng`` (RNG-key restore).  A fault anywhere along the
-restore path must leave BOTH the checkpoint dir and the running train
-state untouched — asserted by the elastic crash matrix in
-tests/test_elastic.py.
+mesh), ``restore.rng`` (RNG-key restore) — and the self-healing serving
+path (ISSUE 9): ``serving.prefill`` / ``serving.decode`` (before each
+batched dispatch; a crash there loses zero-token vs. streamed requests
+respectively), ``serving.stream`` (per emitted token — ``after=K`` lets
+K tokens through, then the death interrupts a live stream),
+``serving.rebuild`` (the supervisor's engine-rebuild step) and
+``gateway.dispatch`` (the gateway dispatcher loop, whose death must
+degrade /healthz).  A fault anywhere along the restore path must leave
+BOTH the checkpoint dir and the running train state untouched —
+asserted by the elastic crash matrix in tests/test_elastic.py.
 """
 from __future__ import annotations
 
@@ -66,7 +72,9 @@ CATALOGUE = (
     "checkpoint.promote", "checkpoint.upload", "checkpoint.upload_commit",
     "fs.upload", "fs.download",
     "restore.read", "restore.relayout", "restore.rng",
-    "serving.scheduler", "train.step",
+    "serving.scheduler", "serving.prefill", "serving.decode",
+    "serving.stream", "serving.rebuild", "gateway.dispatch",
+    "train.step",
 )
 
 

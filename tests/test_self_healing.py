@@ -1,0 +1,569 @@
+"""Self-healing serving tests (ISSUE 9): engine death classification,
+supervisor restart + same-handle re-dispatch, decode-stall detection,
+gateway-level re-dispatch across replicas, graceful drain, and the
+SIGTERM -> drain -> clean-exit path.
+
+The contract under test is docs/robustness.md's "Serving lifecycle"
+section.  The retry-safety rule everywhere: a request may be re-run iff
+no token has reached a consumer — zero-token deaths re-dispatch
+transparently (same handle via the supervisor, new handle via the
+gateway), streamed deaths fail with the typed RequestInterruptedError
+and are never silently replayed.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import build_gpt, gpt_config
+from paddle_tpu.observability import flight
+from paddle_tpu.serving import (
+    Engine,
+    EngineDeadError,
+    EngineDrainingError,
+    EngineStalledError,
+    EngineSupervisor,
+    QueueFullError,
+    RequestInterruptedError,
+)
+from paddle_tpu.serving.gateway import Gateway, GatewayClosedError
+from paddle_tpu.serving.gateway.protocol import parse_completion_request
+from paddle_tpu.testing import faults
+
+
+@pytest.fixture(scope="module")
+def tiny_gpt():
+    cfg = gpt_config("gpt-tiny", max_position_embeddings=128,
+                     hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+    paddle.seed(11)
+    model = build_gpt(cfg)
+    model.eval()
+    return model, cfg
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _wait(pred, timeout=60.0, period=0.01):
+    deadline = time.perf_counter() + timeout
+    while time.perf_counter() < deadline:
+        if pred():
+            return True
+        time.sleep(period)
+    return False
+
+
+def _creq(max_tokens=3, prompt=(1, 2, 3), **extra):
+    payload = {"prompt": list(prompt), "max_tokens": max_tokens}
+    payload.update(extra)
+    return parse_completion_request(json.dumps(payload).encode(),
+                                    has_tokenizer=False)
+
+
+# -- engine death classification ----------------------------------------------
+
+def test_death_classifies_streamed_vs_zero_token(tiny_gpt):
+    """A scheduler crash splits the pending work by the retry-safety
+    rule: the active request (first token already streamed by prefill)
+    gets RequestInterruptedError naming how far it got; the queued one
+    (nothing emitted) gets the duplication-safe EngineDeadError."""
+    model, _ = tiny_gpt
+    eng = Engine(model, max_slots=1, max_len=32, auto_start=False)
+    try:
+        h_active = eng.submit([1, 2, 3], max_new_tokens=4)
+        h_queued = eng.submit([4, 5], max_new_tokens=4)
+        # the first decode step happens after prefill emitted token 1
+        faults.arm("serving.decode", exc=RuntimeError("chip fell over"),
+                   times=1)
+        eng.start()
+        err_a = h_active.exception(timeout=60)
+        err_q = h_queued.exception(timeout=60)
+        assert isinstance(err_a, RequestInterruptedError)
+        assert err_a.tokens_streamed == len(h_active.tokens) >= 1
+        assert err_a.request_id == h_active.request_id
+        assert isinstance(err_a.cause, RuntimeError)
+        assert isinstance(err_q, EngineDeadError)
+        assert not h_queued.tokens
+        st = eng.stats()
+        assert st["interrupted"] == 1 and st["failed"] == 2
+        assert eng.health()["dead"]
+        with pytest.raises(EngineDeadError):
+            eng.submit([1], max_new_tokens=1)
+    finally:
+        eng.shutdown()
+
+
+@pytest.mark.parametrize("seam,err_type", [
+    ("serving.prefill", EngineDeadError),
+    ("serving.stream", EngineDeadError),      # crashes before the 1st emit
+    ("serving.decode", RequestInterruptedError),
+])
+def test_crash_matrix_serving_seams(tiny_gpt, seam, err_type):
+    """Crash-at-every-seam: each new serving fault point kills the
+    scheduler and the request fails with the classification the seam's
+    position implies (before/after the first streamed token)."""
+    model, _ = tiny_gpt
+    eng = Engine(model, max_slots=2, max_len=32, auto_start=False)
+    try:
+        h = eng.submit([3, 1, 4], max_new_tokens=3)
+        faults.arm(seam, times=1)
+        eng.start()
+        err = h.exception(timeout=60)
+        assert isinstance(err, err_type), (seam, err)
+        if err_type is EngineDeadError:
+            assert not h.tokens, "zero-token classification must hold"
+        assert eng.health()["dead"]
+        assert faults.hits(seam) >= 1
+        names = {e["name"] for e in flight.events("fault")}
+        assert seam in names
+    finally:
+        eng.shutdown()
+
+
+def test_redispatch_hook_takes_zero_token_requests(tiny_gpt):
+    """The dying engine offers zero-token requests to the redispatch
+    hook; taken handles are NOT failed and complete after being
+    resubmitted into a fresh engine — the caller never notices."""
+    model, _ = tiny_gpt
+    parked = []
+    eng = Engine(model, max_slots=2, max_len=32, auto_start=False,
+                 redispatch_hook=lambda reqs, cause: parked.extend(reqs)
+                 or reqs)
+    eng2 = None
+    try:
+        h1 = eng.submit([1, 2, 3], max_new_tokens=3)
+        h2 = eng.submit([4, 5], max_new_tokens=3)
+        faults.arm("serving.prefill", times=1)   # dies before any emit
+        eng.start()
+        assert _wait(lambda: eng.health()["dead"], 60)
+        assert {r.request_id for r in parked} == {h1.request_id,
+                                                 h2.request_id}
+        assert not h1.done() and not h2.done(), \
+            "taken handles must stay live for the re-dispatch"
+        faults.reset()
+        eng2 = Engine(model, max_slots=2, max_len=32)
+        for r in parked:
+            eng2.resubmit(r)
+        a, b = h1.result(timeout=120), h2.result(timeout=120)
+        assert len(a) == 3 and len(b) == 3
+        assert h1.redispatches == 1
+        assert eng2.stats()["resubmitted"] == 2
+        # a handle that already streamed tokens is refused
+        h3 = eng2.submit([7, 8], max_new_tokens=2)
+        h3.result(timeout=120)
+        with pytest.raises(ValueError, match="already streamed"):
+            eng2.resubmit(h3)
+    finally:
+        eng.shutdown()
+        if eng2 is not None:
+            eng2.shutdown()
+
+
+# -- supervisor ---------------------------------------------------------------
+
+def test_supervisor_restart_redispatches_same_handles(tiny_gpt):
+    """Scheduler crash under a supervisor: the engine is rebuilt from
+    the same model/config and the zero-token requests ride the SAME
+    handles into the new build — every submit completes, the rebuilt
+    decode program compiles exactly one signature."""
+    model, _ = tiny_gpt
+    sup = EngineSupervisor(
+        lambda: Engine(model, max_slots=2, max_len=32),
+        name="sup0", poll_interval_s=0.02)
+
+    def sub(prompt):
+        # the submit may land in the death->rebuild window (backpressure)
+        deadline = time.perf_counter() + 120
+        while True:
+            try:
+                return sup.submit(prompt, max_new_tokens=3)
+            except QueueFullError:
+                assert time.perf_counter() < deadline
+                time.sleep(0.02)
+
+    try:
+        faults.arm("serving.prefill", times=1)
+        handles = [sub([i + 1, i + 2]) for i in range(3)]
+        results = [h.result(timeout=180) for h in handles]
+        assert all(len(r) == 3 for r in results)
+        assert sup.restarts == 1
+        assert sup.redispatched >= 1
+        assert any(h.redispatches == 1 for h in handles)
+        # every build that decoded compiled exactly ONE decode signature
+        builds = sup.builds()
+        assert builds[-1]["decode_compiles"] == 1
+        assert all(b["decode_compiles"] <= 1 for b in builds)
+        kinds = {e["name"] for e in flight.events("supervisor")}
+        assert {"park", "teardown", "restart"} <= kinds
+        # the healed engine serves new work
+        assert len(sup.submit([9, 9], max_new_tokens=2
+                              ).result(timeout=120)) == 2
+    finally:
+        sup.shutdown()
+
+
+def test_supervisor_never_replays_streamed_requests(tiny_gpt):
+    """A request whose stream already delivered tokens is NOT
+    re-dispatched: it fails with RequestInterruptedError and the token
+    count in the error matches what the stream consumer saw (no
+    duplicates, no silent re-run)."""
+    model, _ = tiny_gpt
+    seen = []
+    sup = EngineSupervisor(
+        lambda: Engine(model, max_slots=2, max_len=64),
+        name="sup1", poll_interval_s=0.02)
+    try:
+        # let prefill + 3 decode crossings through, then kill: the
+        # request dies with exactly 4 tokens streamed — deterministic
+        faults.arm("serving.decode", times=1, after=3)
+        h = sup.submit([2, 7, 1], max_new_tokens=12, stream=seen.append)
+        err = h.exception(timeout=120)
+        assert isinstance(err, RequestInterruptedError)
+        assert err.tokens_streamed == len(seen) == len(h.tokens) == 4
+        assert h.redispatches == 0
+        # the supervisor still heals the engine for the next request
+        faults.reset()
+
+        def healed():
+            try:
+                return len(sup.submit([5, 5], max_new_tokens=2
+                                      ).result(timeout=120)) == 2
+            except (QueueFullError, EngineDeadError):
+                return False
+        assert _wait(healed, 120, period=0.1)
+        assert sup.restarts == 1
+    finally:
+        sup.shutdown()
+
+
+def test_supervisor_stall_watchdog_abandons_and_rebuilds(tiny_gpt):
+    """Decode stall (the scheduler stuck inside a dispatch): the
+    supervisor sees the frozen progress heartbeat, abandons the engine
+    (EngineStalledError) and rebuilds — the stalled request is
+    interrupted, new work completes on the fresh build."""
+    model, _ = tiny_gpt
+    sup = EngineSupervisor(
+        lambda: Engine(model, max_slots=2, max_len=32),
+        name="sup2", poll_interval_s=0.02)
+    try:
+        # warm up with stall detection OFF: the first-call compiles are
+        # legitimate seconds-long dispatches (stall_timeout_s is read per
+        # poll, so operators can arm it after warmup exactly like this)
+        sup.submit([1, 2], max_new_tokens=2).result(timeout=180)
+        sup.stall_timeout_s = 0.4
+        faults.arm("serving.decode", mode="delay", seconds=2.5, times=1)
+        h = sup.submit([3, 4, 5], max_new_tokens=6)
+        err = h.exception(timeout=60)
+        assert isinstance(err, RequestInterruptedError)
+        assert isinstance(err.cause, EngineStalledError)
+        kinds = {e["name"] for e in flight.events("supervisor")}
+        assert "stall" in kinds
+        assert _wait(lambda: sup.restarts >= 1, 120, period=0.05)
+
+        def healed():
+            try:
+                return len(sup.submit([6, 6], max_new_tokens=2
+                                      ).result(timeout=120)) == 2
+            except (QueueFullError, EngineDeadError):
+                return False
+        assert _wait(healed, 120, period=0.1)
+    finally:
+        sup.shutdown()
+
+
+def test_supervisor_gives_up_past_restart_budget(tiny_gpt):
+    """Engines that keep dying exhaust the restart budget: the
+    supervisor fails parked work with EngineDeadError, advertises
+    not-alive, and rejects new submits."""
+    model, _ = tiny_gpt
+    sup = EngineSupervisor(
+        lambda: Engine(model, max_slots=1, max_len=32),
+        name="sup3", poll_interval_s=0.01, max_restarts=2,
+        restart_window_s=60.0)
+    try:
+        faults.arm("serving.scheduler", times=None)   # every build dies
+        h = sup.submit([1, 2], max_new_tokens=2)
+        err = h.exception(timeout=120)
+        assert isinstance(err, EngineDeadError)
+        assert _wait(lambda: sup.failed is not None, 120)
+        assert sup.restarts <= 2
+        assert sup.load()["alive"] is False
+        faults.reset()
+        with pytest.raises(EngineDeadError):
+            sup.submit([1], max_new_tokens=1)
+        kinds = {e["name"] for e in flight.events("supervisor")}
+        assert "giveup" in kinds
+    finally:
+        sup.shutdown()
+
+
+def test_supervisor_rebuild_fault_is_retried(tiny_gpt):
+    """A crash INSIDE the rebuild (serving.rebuild seam) consumes one
+    restart-budget slot and is retried on the next poll — the replica
+    still heals."""
+    model, _ = tiny_gpt
+    sup = EngineSupervisor(
+        lambda: Engine(model, max_slots=1, max_len=32),
+        name="sup4", poll_interval_s=0.02, max_restarts=3)
+    try:
+        faults.arm("serving.scheduler", times=1)
+        faults.arm("serving.rebuild", times=1)
+        h = sup.submit([1, 2], max_new_tokens=2)
+        assert len(h.result(timeout=180)) == 2
+        assert h.redispatches == 1
+        names = {e["name"] for e in flight.events("supervisor")}
+        assert "rebuild_failed" in names and "restart" in names
+        assert faults.hits("serving.rebuild") >= 1
+    finally:
+        sup.shutdown()
+
+
+# -- graceful drain -----------------------------------------------------------
+
+def test_engine_drain_completes_inflight_then_rejects(tiny_gpt):
+    model, _ = tiny_gpt
+    eng = Engine(model, max_slots=2, max_len=48)
+    try:
+        handles = [eng.submit([i + 1] * 3, max_new_tokens=5)
+                   for i in range(5)]
+        assert eng.drain(deadline_s=180.0) is True
+        for h in handles:
+            assert len(h.result(timeout=1)) == 5   # already finished
+        ld = eng.load()
+        assert ld["alive"] is False and ld["draining"] is True
+        with pytest.raises(EngineDrainingError):
+            eng.submit([1], max_new_tokens=1)
+        assert eng.stats()["completed"] == 5
+    finally:
+        eng.shutdown()
+
+
+def test_gateway_drain_sheds_new_completes_inflight(tiny_gpt):
+    """Gateway drain: queued + in-flight work runs dry while new
+    admissions get a structured 429 'draining' with Retry-After."""
+    from paddle_tpu.serving.gateway import AdmissionError
+
+    model, _ = tiny_gpt
+    eng = Engine(model, max_slots=1, max_len=48)
+    gw = Gateway([eng])
+    try:
+        items = [gw.admit(_creq(max_tokens=4, prompt=(i + 1, 2)), "t")
+                 for i in range(3)]
+        t = threading.Thread(target=gw.drain, args=(180.0,))
+        t.start()
+        time.sleep(0.05)
+        with pytest.raises(AdmissionError) as ei:
+            gw.admit(_creq(), "t")
+        assert ei.value.reason == "draining"
+        assert ei.value.retry_after_s >= 1.0
+        for item in items:
+            tokens, _ = gw.result(item, timeout=180)
+            assert len(tokens) == 4
+        t.join(timeout=180)
+        assert not gw.healthz()["alive"] and gw.healthz()["draining"]
+    finally:
+        gw.shutdown()
+        eng.shutdown()
+
+
+_SIGTERM_SCRIPT = r"""
+import json, os, signal, sys, threading, time
+import http.client
+
+import paddle_tpu as paddle
+from paddle_tpu.models import build_gpt, gpt_config
+from paddle_tpu.serving import Engine
+from paddle_tpu.serving.gateway import start_gateway
+
+cfg = gpt_config("gpt-tiny", max_position_embeddings=128,
+                 hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+paddle.seed(3)
+model = build_gpt(cfg)
+model.eval()
+eng = Engine(model, max_slots=2, max_len=48)
+stack = start_gateway([eng], own_engines=True)
+stack.install_sigterm_drain(deadline_s=120.0)
+
+statuses = []
+lock = threading.Lock()
+
+def one(i):
+    c = http.client.HTTPConnection("127.0.0.1", stack.port, timeout=300)
+    try:
+        c.request("POST", "/v1/completions",
+                  json.dumps({"prompt": [i + 1, 2, 3],
+                              "max_tokens": 6}).encode(),
+                  {"Content-Type": "application/json", "X-Tenant": "t"})
+        r = c.getresponse()
+        body = r.read()
+        with lock:
+            statuses.append((r.status,
+                             len(json.loads(body)["choices"][0]["token_ids"])
+                             if r.status == 200 else 0))
+    finally:
+        c.close()
+
+# warm the engine so the in-flight batch is mid-decode when SIGTERM lands
+one(40)
+threads = [threading.Thread(target=one, args=(i,)) for i in range(4)]
+for t in threads:
+    t.start()
+time.sleep(0.1)                      # requests are in flight
+os.kill(os.getpid(), signal.SIGTERM)
+assert stack.wait_terminated(180), "drain did not finish"
+for t in threads:
+    t.join(timeout=60)
+ok = (len(statuses) == 5 and all(s == 200 and n == 6
+                                 for s, n in statuses))
+print(json.dumps({"statuses": statuses,
+                  "drain_ok": bool(stack.drain_result)}))
+sys.exit(0 if ok and stack.drain_result else 1)
+"""
+
+
+def test_gateway_sigterm_drains_and_exits_zero(tmp_path):
+    """Subprocess acceptance: SIGTERM mid-load -> shed new traffic ->
+    drain -> exit 0 with zero dropped in-flight requests."""
+    script = tmp_path / "sigterm_drain.py"
+    script.write_text(_SIGTERM_SCRIPT)
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=root)
+    proc = subprocess.run(
+        [sys.executable, str(script)], env=env, capture_output=True,
+        text=True, timeout=600,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["drain_ok"] is True
+    assert all(s == 200 for s, _ in out["statuses"]), out
+
+
+# -- gateway-level re-dispatch ------------------------------------------------
+
+def _two_replica_gateway(tiny_gpt, **gw_kwargs):
+    model, cfg = tiny_gpt
+    paddle.seed(11)
+    model_b = build_gpt(cfg)
+    model_b.eval()
+    eng_a = Engine(model, max_slots=2, max_len=48, auto_start=False)
+    eng_b = Engine(model_b, max_slots=2, max_len=48)
+    gw = Gateway([eng_a, eng_b], names=["a", "b"], **gw_kwargs)
+    return eng_a, eng_b, gw
+
+
+def test_gateway_redispatches_zero_token_death(tiny_gpt):
+    """Replica 'a' dies with the request still queued inside it (zero
+    tokens): the reaper re-dispatches the SAME gateway item to 'b' with
+    a fresh engine handle — the client just sees a completion."""
+    eng_a, eng_b, gw = _two_replica_gateway(tiny_gpt)
+    try:
+        # the tie-break dispatches to 'a' (idle, auto_start=False: the
+        # request parks in its queue)
+        item = gw.admit(_creq(max_tokens=4), "t")
+        assert item.ready.wait(60) and item.engine_name == "a"
+        faults.arm("serving.scheduler", times=1)
+        eng_a.start()                         # first iteration crashes
+        tokens, finish = gw.result(item, timeout=180)
+        assert len(tokens) == 4 and finish == "length"
+        assert item.engine_name == "b" and item.redispatches == 1
+        kinds = {e["name"] for e in flight.events("gateway")}
+        assert "redispatch" in kinds
+    finally:
+        gw.shutdown()
+        eng_a.shutdown()
+        eng_b.shutdown()
+
+
+def test_gateway_retries_interrupted_blocking_request(tiny_gpt):
+    """Mid-stream death of a NON-streaming request: the emitted tokens
+    never left the gateway, so the retry-safety rule allows a clean
+    re-run on the survivor — same token sequence, no duplication."""
+    eng_a, eng_b, gw = _two_replica_gateway(tiny_gpt)
+    try:
+        want = eng_b.submit(np.array([1, 2, 3], np.int64),
+                            max_new_tokens=6).result(timeout=180)
+        item = gw.admit(_creq(max_tokens=6), "t")
+        assert item.ready.wait(60) and item.engine_name == "a"
+        # 'a' dies after prefill + 2 decode steps: 3 tokens are emitted
+        # (mid-stream), but none reached the client of a BLOCKING request
+        faults.arm("serving.decode", times=1, after=2)
+        eng_a.start()
+        tokens, _ = gw.result(item, timeout=180)
+        assert item.engine_name == "b" and item.redispatches == 1
+        assert [int(t) for t in tokens] == [int(t) for t in want], \
+            "retried run must equal a clean run (no duplicated prefix)"
+    finally:
+        gw.shutdown()
+        eng_a.shutdown()
+        eng_b.shutdown()
+
+
+def test_gateway_streaming_interruption_is_final(tiny_gpt):
+    """Mid-stream death of a STREAMING request: tokens reached the
+    client, so the gateway must NOT retry — the typed
+    RequestInterruptedError is the final outcome."""
+    eng_a, eng_b, gw = _two_replica_gateway(tiny_gpt)
+    try:
+        item = gw.admit(_creq(max_tokens=8, stream=True), "t")
+        assert item.ready.wait(60) and item.engine_name == "a"
+        faults.arm("serving.decode", times=1, after=2)
+        eng_a.start()
+        with pytest.raises(RequestInterruptedError):
+            gw.result(item, timeout=180)
+        assert item.redispatches == 0
+        assert item.token_q.qsize() >= 1, "tokens DID reach the stream"
+    finally:
+        gw.shutdown()
+        eng_a.shutdown()
+        eng_b.shutdown()
+
+
+# -- dispatcher supervision (satellite) ---------------------------------------
+
+def test_dispatcher_death_degrades_healthz_and_fails_queued(tiny_gpt):
+    """The gateway dispatcher crashing (gateway.dispatch seam) must be
+    VISIBLE: /healthz degrades (alive False, dispatcher_alive False,
+    the error named) and already-admitted requests fail with a 503-class
+    error instead of hanging to their timeout."""
+    model, _ = tiny_gpt
+    eng = Engine(model, max_slots=1, max_len=32, auto_start=False)
+    gw = Gateway([eng], start=False)
+    try:
+        item = gw.admit(_creq(), "t")
+        faults.arm("gateway.dispatch", times=1)
+        gw.start()
+        with pytest.raises(GatewayClosedError, match="dispatcher died"):
+            gw.result(item, timeout=60)
+        health = gw.healthz()
+        assert health["alive"] is False
+        assert health["dispatcher_alive"] is False
+        assert "FaultInjected" in health["dispatcher_error"]
+        with pytest.raises(GatewayClosedError, match="dispatcher died"):
+            gw.admit(_creq(), "t")
+    finally:
+        gw.shutdown()
+        eng.shutdown()
+
+
+def test_healthz_reports_dispatcher_alive_when_running(tiny_gpt):
+    model, _ = tiny_gpt
+    eng = Engine(model, max_slots=1, max_len=32, auto_start=False)
+    gw = Gateway([eng])
+    try:
+        assert _wait(lambda: gw.dispatcher_alive(), 10)
+        h = gw.healthz()
+        assert h["alive"] and h["dispatcher_alive"] and not h["draining"]
+    finally:
+        gw.shutdown()
+        eng.shutdown()

@@ -35,6 +35,7 @@ DEFAULT_SUBSET = [
     "tests/test_distributed.py",
     "tests/test_serving.py",
     "tests/test_gateway.py",
+    "tests/test_self_healing.py",
     "tests/test_robustness.py",
 ]
 
@@ -128,6 +129,16 @@ def main() -> int:
         if gw_rc != 0:
             print("gateway lane FAILED", file=sys.stderr)
         rc = rc or gw_rc
+        # serving chaos lane (ISSUE 9): engine kills under mixed-tenant
+        # load — supervisor restarts, bounded interrupted streams, one
+        # decode signature per rebuild, clean drain
+        print("telemetry smoke: serving chaos lane", file=sys.stderr)
+        chaos_rc = subprocess.call(
+            [sys.executable, os.path.join("tools", "chaos_serving.py")],
+            env=env, cwd=root)
+        if chaos_rc != 0:
+            print("serving chaos lane FAILED", file=sys.stderr)
+        rc = rc or chaos_rc
     return rc
 
 
