@@ -22,7 +22,12 @@ _MASK_REGISTRY: dict = {}
 
 
 def calculate_density(x) -> float:
-    arr = x.numpy() if isinstance(x, Tensor) else np.asarray(x)
+    if isinstance(x, Tensor):
+        # count on device: ONE scalar crosses the host boundary instead
+        # of downloading the whole (possibly huge) parameter
+        frac = (x != 0).astype("float32").mean()
+        return float(frac.item())
+    arr = np.asarray(x)
     return float((arr != 0).sum() / arr.size)
 
 

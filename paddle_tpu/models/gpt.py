@@ -259,21 +259,32 @@ class GPTSelfAttention(Layer):
         else:
             q, k, v = (qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2])
             new_cache = None
-            if cache is not None and len(cache) == 3:
+            if cache is not None and len(cache) in (3, 5):
                 # STATIC cache (k_buf [B,L,nh,hd], v_buf, length): write the
                 # new keys/values in place at `length` and attend over the
                 # fixed-shape buffer under an explicit validity mask — every
                 # decode step is ONE compiled program with donated buffers
                 # (the AnalysisPredictor zero-copy run analog,
                 # analysis_predictor.cc:1618), instead of a concat that
-                # gives each position its own XLA shape
+                # gives each position its own XLA shape.
+                # The 5-tuple form (k_buf, v_buf, length, k_scale, v_scale)
+                # is the int8-quantized pool (serving kv_dtype="int8"):
+                # buffers store int8, scales [B, L] carry one absmax scale
+                # per cached row; writes quantize, the attention read
+                # dequantizes inline (kv_quant helpers).
                 import jax.numpy as jnp
 
                 from ..core.tensor import Tensor as _T
-                k_buf, v_buf, pos0 = cache
+                k_buf, v_buf, pos0 = cache[0], cache[1], cache[2]
+                quantized = len(cache) == 5
                 k_raw = k_buf._value if isinstance(k_buf, _T) else k_buf
                 v_raw = v_buf._value if isinstance(v_buf, _T) else v_buf
                 start = jnp.asarray(pos0, jnp.int32)
+                if quantized and start.ndim != 1:
+                    raise ValueError(
+                        "int8 KV caches (5-tuple) are supported only in "
+                        "the per-slot vector-length form the serving "
+                        "engine uses")
                 if start.ndim == 1:
                     # PER-SLOT lengths (continuous batching, serving.Engine):
                     # `pos0` is a [B] vector — every row owns a slot in a
@@ -282,26 +293,55 @@ class GPTSelfAttention(Layer):
                     # runs under a per-row validity mask.  Rows whose write
                     # would fall off the buffer end (an inactive slot parked
                     # at max_len) are dropped by the scatter, never clipped
-                    # onto a live row.
+                    # onto a live row.  t may be > 1 (speculative
+                    # verification / prefix-tail prefill): position j of a
+                    # row writes at its own offset + j and attends causally
+                    # within the new span.
                     rows = jnp.arange(k_raw.shape[0])[:, None]
                     cols = start[:, None] + jnp.arange(t)[None, :]
-                    k_raw = k_raw.at[rows, cols].set(
-                        k._value.astype(k_raw.dtype), mode="drop")
-                    v_raw = v_raw.at[rows, cols].set(
-                        v._value.astype(v_raw.dtype), mode="drop")
+                    if quantized:
+                        from ..serving.kv_quant import (dequantize_pool,
+                                                        quantize_rows)
+                        ks_raw, vs_raw = cache[3], cache[4]
+                        ks_raw = (ks_raw._value if isinstance(ks_raw, _T)
+                                  else ks_raw)
+                        vs_raw = (vs_raw._value if isinstance(vs_raw, _T)
+                                  else vs_raw)
+                        kq, ksc = quantize_rows(k._value)
+                        vq, vsc = quantize_rows(v._value)
+                        k_raw = k_raw.at[rows, cols].set(kq, mode="drop")
+                        v_raw = v_raw.at[rows, cols].set(vq, mode="drop")
+                        ks_raw = ks_raw.at[rows, cols].set(ksc, mode="drop")
+                        vs_raw = vs_raw.at[rows, cols].set(vsc, mode="drop")
+                        k_att = dequantize_pool(k_raw, ks_raw,
+                                                k._value.dtype)
+                        v_att = dequantize_pool(v_raw, vs_raw,
+                                                v._value.dtype)
+                    else:
+                        k_raw = k_raw.at[rows, cols].set(
+                            k._value.astype(k_raw.dtype), mode="drop")
+                        v_raw = v_raw.at[rows, cols].set(
+                            v._value.astype(v_raw.dtype), mode="drop")
+                        k_att, v_att = k_raw, v_raw
                     max_len = k_raw.shape[1]
                     mask = (jnp.arange(max_len)[None, None, :] <=
                             cols[:, :, None])  # [B, t, L] causal + validity
                     out = F.scaled_dot_product_attention(
-                        q, _T(k_raw, _internal=True),
-                        _T(v_raw, _internal=True),
+                        q, _T(k_att, _internal=True),
+                        _T(v_att, _internal=True),
                         attn_mask=_T(mask[:, None], _internal=True),
                         dropout_p=0.0, is_causal=False, training=False)
                     out = out.reshape([b, t, nh * self.head_dim])
                     out = _constrain(out, P(_U, _U, "mp"))
                     out = self.out_proj(out)
-                    new_cache = (_T(k_raw, _internal=True),
-                                 _T(v_raw, _internal=True), start + t)
+                    if quantized:
+                        new_cache = (_T(k_raw, _internal=True),
+                                     _T(v_raw, _internal=True), start + t,
+                                     _T(ks_raw, _internal=True),
+                                     _T(vs_raw, _internal=True))
+                    else:
+                        new_cache = (_T(k_raw, _internal=True),
+                                     _T(v_raw, _internal=True), start + t)
                     if use_cache:
                         return out, new_cache
                     return out
@@ -504,9 +544,10 @@ class GPTModel(Layer):
         if position_ids is None and use_cache and caches[0] is not None:
             # incremental decode: offset positions by the cached key length
             t = input_ids.shape[1]
-            if len(caches[0]) == 3:
-                # static cache (k_buf, v_buf, length): position base may be
-                # a python int (static prefill) or a traced scalar (step)
+            if len(caches[0]) in (3, 5):
+                # static cache (k_buf, v_buf, length[, k_scale, v_scale]):
+                # position base may be a python int (static prefill) or a
+                # traced scalar (step); the int8 5-tuple keeps length at [2]
                 import jax.numpy as jnp
 
                 from ..core.tensor import Tensor as _T
@@ -658,12 +699,15 @@ class GPTForPretraining(Layer):
     def generate(self, input_ids, max_new_tokens: int = 32,
                  eos_token_id=None, temperature: float = 0.0, top_k: int = 0,
                  seed: int = 0, max_slots: int = 8,
-                 timeout_s: float = 600.0) -> np.ndarray:
+                 timeout_s: float = 600.0, **engine_kwargs) -> np.ndarray:
         """Batch generation built on the continuous-batching serving engine
         (paddle_tpu.serving.Engine): each row becomes one request over a
         shared slot pool, so generation and the serving path are the SAME
         code.  Returns [batch, prompt + longest] ids; rows that stopped at
-        `eos_token_id` are right-padded with it (0 when no eos is set)."""
+        `eos_token_id` are right-padded with it (0 when no eos is set).
+        Extra keyword args reach the Engine — the decode fast-path knobs
+        (``kv_dtype="int8"``, ``speculative_k=``, ``prefix_cache=``,
+        ``sample_on_device=``) apply to offline generation too."""
         from ..serving import Engine
 
         ids = np.asarray(input_ids._value if isinstance(input_ids, Tensor)
@@ -672,7 +716,7 @@ class GPTForPretraining(Layer):
             ids = ids[None]
         b, t = ids.shape
         engine = Engine(self, max_slots=min(int(max_slots), b),
-                        max_len=t + int(max_new_tokens))
+                        max_len=t + int(max_new_tokens), **engine_kwargs)
         try:
             handles = [engine.submit(row, max_new_tokens=max_new_tokens,
                                      eos_token_id=eos_token_id,

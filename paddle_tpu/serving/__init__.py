@@ -13,6 +13,15 @@ compiled decode program over a fixed slot pool.
     tokens = handle.result(timeout=60)     # or handle.cancel()
     engine.shutdown()
 
+The decode fast path (docs/serving.md "Decode fast path") is flag-gated
+on the same engine: ``Engine(prefix_cache=True)`` (content-addressed KV
+reuse across requests sharing a prompt prefix), ``speculative_k=k``
+(draft + verify k tokens per pool read; :class:`NgramDrafter` by
+default, ``drafter=`` seam for a draft model), ``kv_dtype="int8"``
+(quantized pools with per-row scales — 2x slots in the same HBM), and
+``sample_on_device`` (fused on-device sampling; only token ids cross
+the host boundary per step).
+
 The HTTP traffic layer (OpenAI-compatible completions, per-tenant
 fair-share admission, telemetry-driven load shedding, multi-replica
 routing) lives in :mod:`paddle_tpu.serving.gateway`::
@@ -33,10 +42,13 @@ from .engine import (  # noqa: F401
     RequestHandle,
     RequestInterruptedError,
 )
+from .prefix_cache import PrefixEntry, PrefixIndex  # noqa: F401
 from .slot_pool import SlotPool  # noqa: F401
+from .speculative import NgramDrafter  # noqa: F401
 from .supervisor import EngineSupervisor  # noqa: F401
 
 __all__ = ["Engine", "EngineSupervisor", "RequestHandle", "SlotPool",
+           "PrefixIndex", "PrefixEntry", "NgramDrafter",
            "QueueFullError", "DeadlineExceededError", "EngineClosedError",
            "EngineDeadError", "EngineDrainingError", "EngineStalledError",
            "RequestInterruptedError"]

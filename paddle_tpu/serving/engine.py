@@ -27,10 +27,34 @@ production LLM servers (vLLM/Orca-style continuous batching) converged on:
   token and per-token latency — all through the paddle_tpu.observability
   registry, live from request one.
 
+**Decode fast path** (docs/serving.md "Decode fast path"): decode is
+HBM-bandwidth-bound — every step reads the full weights + KV pool to emit
+one token per slot (docs/PERF.md round 5) — so three flag-gated,
+composable attacks on that bound ride the same single-signature loop:
+
+* ``prefix_cache=True`` — completed requests' KV rows are RETAINED in the
+  pool behind a content-addressed index (prefix_cache.PrefixIndex); a
+  request whose prompt starts with a cached row's tokens copies the row
+  and prefills only the tail (shared system prompts skip re-prefill).
+* ``speculative_k=k`` — draft ``k-1`` tokens per step (prompt-lookup
+  n-gram drafter by default, ``drafter=`` seam for a draft model) and
+  verify all of them in ONE ``k``-wide batched forward; the matched
+  prefix is accepted, so each pool read yields up to ``k`` tokens.
+  Greedy output stays token-identical to the plain path by construction.
+* ``kv_dtype="int8"`` — pools stored int8 with per-row scales
+  (kv_quant), dequantized inside the attention read: half the pool bytes,
+  double the slots in the same HBM.
+
+Sampling runs ON DEVICE by default (``sample_on_device=True``):
+temperature / top-k / greedy with per-slot parameters and counter-based
+PRNG keys live in the decode program, so only ``[B(, k)]`` token ids —
+not ``[B, V]`` logits — cross the host boundary per step.
+
 Per-slot cache positions ride the models' static-cache protocol with a
 VECTOR length: ``caches = [(k_buf, v_buf, lengths[B])]`` makes each row
 write its new keys at its own offset and attend under a per-row validity
-mask (models/gpt.py per-slot branch).
+mask (models/gpt.py per-slot branch; the int8 form appends per-row scale
+buffers as a 5-tuple).
 
 Thread-safety: the engine runs the model from its scheduler thread via the
 functional state swap; do not run the same model's eager forward
@@ -55,7 +79,9 @@ from ..observability import flight, registry, span
 from ..observability import watchdog as _watchdog
 from ..observability.retrace import instrument_jit
 from ..testing import faults
+from .prefix_cache import PrefixIndex
 from .slot_pool import SlotPool
+from .speculative import NgramDrafter
 
 __all__ = ["Engine", "RequestHandle", "QueueFullError",
            "DeadlineExceededError", "EngineClosedError", "EngineDeadError",
@@ -72,6 +98,13 @@ SERVING_TOKEN_LATENCY = "paddle_tpu_serving_token_seconds"
 SERVING_BATCH_SECONDS = "paddle_tpu_serving_batch_seconds"
 SERVING_REDISPATCHED = "paddle_tpu_serving_requests_redispatched_total"
 SERVING_INTERRUPTED = "paddle_tpu_serving_requests_interrupted_total"
+SERVING_PREFIX_HITS = "paddle_tpu_serving_prefix_cache_hits_total"
+SERVING_PREFIX_MISSES = "paddle_tpu_serving_prefix_cache_misses_total"
+SERVING_PREFIX_EVICTIONS = "paddle_tpu_serving_prefix_cache_evictions_total"
+SERVING_SPEC_DRAFTED = "paddle_tpu_serving_speculative_tokens_drafted_total"
+SERVING_SPEC_ACCEPTED = \
+    "paddle_tpu_serving_speculative_tokens_accepted_total"
+SERVING_KV_POOL_BYTES = "paddle_tpu_serving_kv_pool_bytes"
 
 
 class QueueFullError(RuntimeError):
@@ -150,6 +183,7 @@ class RequestHandle:
         self.eos_token_id = eos_token_id
         self.temperature = float(temperature)
         self.top_k = int(top_k)
+        self.seed = int(seed)
         self._rng = np.random.RandomState(seed)
         self._stream = stream
         self._engine = engine
@@ -160,11 +194,14 @@ class RequestHandle:
         self._error: Optional[BaseException] = None
         self._tokens: list[int] = []
         self.slot: Optional[int] = None
+        self._prefix_src = None           # PrefixEntry this request copied
+        self._prefix_match = 0            # tokens covered by that copy
         now = time.perf_counter()
         self.t_submit = now
         self.t_admit: Optional[float] = None
         self._t_last_token = now
         self.ttft_s: Optional[float] = None
+        self.prefix_hit = False           # admitted via a prefix-cache copy
         self.token_latencies_s: list[float] = []
         self.deadline = None if deadline_s is None else now + float(deadline_s)
 
@@ -238,7 +275,9 @@ class RequestHandle:
 def _sample_row(logits_row: np.ndarray, temperature: float, top_k: int,
                 rng) -> int:
     """Sample one token from one row of last-position logits (host side —
-    per-request temperature/top_k/rng; greedy at temperature 0)."""
+    per-request temperature/top_k/rng; greedy at temperature 0).  The
+    reference the device sampler's greedy path is parity-tested against
+    (``sample_on_device=False`` escape hatch)."""
     logits = np.asarray(logits_row, np.float32)
     if temperature == 0.0:
         return int(logits.argmax())
@@ -268,7 +307,7 @@ class Engine:
         model: a Layer with the GPT-style cached forward
             ``model(ids, caches=..., use_cache=True) -> (logits, caches)``
             (e.g. ``GPTForPretraining``); when it exposes ``.gpt`` +
-            ``.lm_head`` the head runs only on the last position.
+            ``.lm_head`` the head runs only on the gathered positions.
         tokenizer: optional — lets ``submit`` accept strings (``encode``)
             and handles expose ``text()`` (``decode``).
         max_slots: concurrent requests sharing the batched decode step.
@@ -305,6 +344,31 @@ class Engine:
             produces a crash-dump bundle naming the stuck phase instead
             of a silent hang, and :meth:`health` exposes the progress age
             a supervisor uses for stall detection.
+        prefix_cache: retain completed requests' KV rows behind a
+            content-addressed prefix index; admissions sharing a cached
+            prompt prefix copy the row and prefill only the tail
+            (docs/serving.md "Decode fast path").
+        prefix_block: prefix-match granularity in tokens (the index
+            registers cached rows at block-boundary prefixes — the
+            vLLM-style block hash; smaller blocks match more, hash more).
+        speculative_k: verify ``k`` positions per decode dispatch
+            (``k - 1`` drafted tokens; 0/1 disables).  Greedy requests
+            accept the matched draft prefix — up to ``k`` tokens per pool
+            read; sampled (temperature > 0) requests fall back to one
+            token per step, correctly sampled, in the same program.
+        drafter: ``drafter(context_ids, n) -> n proposed ids`` (default
+            :class:`~paddle_tpu.serving.speculative.NgramDrafter`) — the
+            seam a learned draft model plugs into.
+        kv_dtype: None (model dtype) or ``"int8"`` — store the K/V pools
+            quantized with per-row scales, dequantized inside the
+            attention read (half the pool bytes → 2x slots in the same
+            HBM; see serving/kv_quant.py).
+        sample_on_device: fuse temperature/top-k/greedy sampling into the
+            decode program (per-slot params + counter-based PRNG keys);
+            only ``[B(, k)]`` token ids cross the host boundary per step.
+            False restores the host sampler (``_sample_row``) — the
+            per-request numpy RNG stream, at a ``[B, V]`` logits transfer
+            per step.
     """
 
     def __init__(self, model, tokenizer=None, max_slots: int = 8,
@@ -313,7 +377,13 @@ class Engine:
                  auto_start: bool = True,
                  admission_hook: Optional[Callable] = None,
                  redispatch_hook: Optional[Callable] = None,
-                 decode_timeout_s: Optional[float] = None):
+                 decode_timeout_s: Optional[float] = None,
+                 prefix_cache: bool = False,
+                 prefix_block: int = 16,
+                 speculative_k: int = 0,
+                 drafter: Optional[Callable] = None,
+                 kv_dtype: Optional[str] = None,
+                 sample_on_device: bool = True):
         self.model = model
         self.tokenizer = tokenizer
         self.max_slots = int(max_slots)
@@ -344,6 +414,24 @@ class Engine:
         self._decode_timeout_s = (decode_timeout_s
                                   if decode_timeout_s and
                                   decode_timeout_s > 0 else None)
+        # -- decode fast-path flags (each composable, each keeping the
+        # ONE-compiled-decode-signature invariant per engine config) --------
+        if kv_dtype not in (None, "int8"):
+            raise ValueError(f"kv_dtype must be None or 'int8', "
+                             f"got {kv_dtype!r}")
+        self.kv_dtype = kv_dtype
+        self._kv_quant = kv_dtype == "int8"
+        k = int(speculative_k)
+        if k < 0:
+            raise ValueError(f"speculative_k must be >= 0, got {k}")
+        self.speculative_k = k
+        self._spec_width = max(1, k)          # decode dispatch width
+        self._drafter = (drafter if drafter is not None
+                         else (NgramDrafter() if self._spec_width > 1
+                               else None))
+        self.sample_on_device = bool(sample_on_device)
+        self._prefix = (PrefixIndex(block=prefix_block) if prefix_cache
+                        else None)
 
         self._pool = SlotPool(self.max_slots)
         self._queue: deque = deque()
@@ -356,16 +444,28 @@ class Engine:
         self._thread: Optional[threading.Thread] = None
         self._built = False
         self._values = None
-        self._kpools = self._vpools = None
+        self._pools = None          # (kpools, vpools[, kscales, vscales])
+        self._pool_bytes = 0
         n_rows = self.max_slots + 1           # + scratch row
-        self._ids = np.zeros((n_rows, 1), np.int64)
-        self._lengths = np.zeros(n_rows, np.int32)
-        self._active = np.zeros(n_rows, bool)
+        self._ids = np.zeros((n_rows, self._spec_width), np.int64)
+        # free / cached / scratch rows park at max_len: the decode scatter
+        # DROPS their writes (mode="drop"), so a pool row retained by the
+        # prefix cache is never clobbered by an idle slot's garbage step
+        self._lengths = np.full(n_rows, self.max_len, np.int32)
+        # per-slot sampling params + PRNG base keys, pool-resident mirrors
+        # uploaded with every dispatch (device draws fold the key with the
+        # row's position, so no key state ever crosses back to the host)
+        self._temps = np.zeros(n_rows, np.float32)
+        self._topks = np.zeros(n_rows, np.int32)
+        self._keys = np.zeros((n_rows, 2), np.uint32)
         self._counts = {"submitted": 0, "completed": 0, "rejected": 0,
                         "cancelled": 0, "deadline_expired": 0, "failed": 0,
                         "decode_steps": 0, "prefill_batches": 0,
                         "tokens": 0, "resubmitted": 0, "redispatched": 0,
-                        "interrupted": 0}
+                        "interrupted": 0, "prefix_hits": 0,
+                        "prefix_misses": 0, "prefix_evictions": 0,
+                        "prefix_inserts": 0, "spec_drafted": 0,
+                        "spec_accepted": 0}
         self._was_training = model.training
         model.eval()
         # interpreter exit with a live scheduler thread mid-XLA-call
@@ -469,6 +569,9 @@ class Engine:
         req._state = "queued"
         req._torn = False       # live again: this engine may emit for it
         req.slot = None
+        req._prefix_src = None  # the dead engine's pool (and index) is gone
+        req._prefix_match = 0
+        req.prefix_hit = False
         req.redispatches += 1
         with self._lock:
             self._queue.append(req)
@@ -559,7 +662,11 @@ class Engine:
             self._queue.clear()
             for slot in list(self._pool.active()):
                 self._pool.free(slot)
-            self._active[:] = False
+            if self._prefix is not None:
+                # the pool the cached rows point into is going away
+                self._prefix.drop_all()
+                for slot in list(self._pool.cached()):
+                    self._pool.release_cached(slot)
             self._gauges_locked()
         for req in pending:
             req._finish(err)
@@ -583,7 +690,8 @@ class Engine:
 
     def slots_in_use(self) -> int:
         """Slots currently owned by in-flight requests (O(1) — the pool
-        keeps the count; no slot-array scan)."""
+        keeps the count; no slot-array scan).  Cached (prefix-retained)
+        rows don't count: they are reclaimable on demand."""
         with self._lock:
             return self._pool.n_active
 
@@ -596,6 +704,7 @@ class Engine:
             return {
                 "queue_depth": len(self._queue),
                 "slots_in_use": self._pool.n_active,
+                "cached_slots": self._pool.n_cached,
                 "max_slots": self.max_slots,
                 "max_queue": self.max_queue,
                 "max_len": self.max_len,
@@ -611,18 +720,34 @@ class Engine:
             out["queue_depth"] = len(self._queue)
             out["slot_allocs"] = self._pool.alloc_total
             out["slot_reuses"] = self._pool.reuse_total
+            out["cached_slots"] = self._pool.n_cached
+            out["prefix_entries"] = (0 if self._prefix is None
+                                     else len(self._prefix))
+            out["kv_pool_bytes"] = self._pool_bytes
         out.update(self.compile_stats())
         return out
+
+    def pool_bytes(self) -> int:
+        """Total bytes of the device KV pools (+ int8 scale buffers);
+        0 before the first admission builds them."""
+        with self._lock:
+            return self._pool_bytes
 
     def compile_stats(self) -> dict:
         """Distinct jit signatures per entry point (retrace sentinel
         counters; decode must stay at 1 — THE continuous-batching
-        invariant)."""
+        invariant, with every fast-path flag on)."""
         pf = getattr(self, "_prefill_fn", None)
         dc = getattr(self, "_decode_fn", None)
+        tl = getattr(self, "_tail_fn", None)
+        cp = getattr(self, "_copy_fn", None)
         return {
             "prefill_compiles": len(pf._signatures) if pf is not None else 0,
             "decode_compiles": len(dc._signatures) if dc is not None else 0,
+            "tail_prefill_compiles":
+                len(tl._signatures) if tl is not None else 0,
+            "prefix_copy_compiles":
+                len(cp._signatures) if cp is not None else 0,
         }
 
     # -- jitted pieces -------------------------------------------------------
@@ -631,9 +756,12 @@ class Engine:
         import jax.numpy as jnp
 
         from ..nn.functional_call import _swapped_state, state_values
+        from .kv_quant import quantize_rows
 
         model = self.model
         n_rows, L = self.max_slots + 1, self.max_len
+        quant = self._kv_quant
+        on_device = self.sample_on_device
         self._values = state_values(model)
 
         def _kv_struct():
@@ -646,10 +774,49 @@ class Engine:
                                   jnp.zeros((1, 1), jnp.int64))
 
         kv = _kv_struct()
-        self._kpools = [jnp.zeros((n_rows, L) + tuple(k.shape[2:]), k.dtype)
-                        for k, _ in kv]
-        self._vpools = [jnp.zeros((n_rows, L) + tuple(v.shape[2:]), v.dtype)
-                        for _, v in kv]
+        pool_dtype = jnp.int8 if quant else None
+        kpools = [jnp.zeros((n_rows, L) + tuple(k.shape[2:]),
+                            pool_dtype or k.dtype) for k, _ in kv]
+        vpools = [jnp.zeros((n_rows, L) + tuple(v.shape[2:]),
+                            pool_dtype or v.dtype) for _, v in kv]
+        if quant:
+            kscales = [jnp.zeros((n_rows, L), jnp.float32) for _ in kv]
+            vscales = [jnp.zeros((n_rows, L), jnp.float32) for _ in kv]
+            self._pools = (kpools, vpools, kscales, vscales)
+        else:
+            self._pools = (kpools, vpools)
+        total = sum(int(np.prod(p.shape)) * p.dtype.itemsize
+                    for grp in self._pools for p in grp)
+        with self._lock:
+            self._pool_bytes = total
+        registry().gauge(
+            SERVING_KV_POOL_BYTES,
+            "device bytes of the serving KV pools (incl. int8 scales)"
+        ).set(float(total))
+
+        def _caches_from(pools, lengths):
+            """Pool arrays → the models' per-slot static-cache protocol
+            (3-tuple, or the int8 5-tuple with per-row scale buffers)."""
+            if quant:
+                kps, vps, kss, vss = pools
+                return [(Tensor(kp, _internal=True),
+                         Tensor(vp, _internal=True), lengths,
+                         Tensor(ks, _internal=True),
+                         Tensor(vs, _internal=True))
+                        for kp, vp, ks, vs in zip(kps, vps, kss, vss)]
+            kps, vps = pools
+            return [(Tensor(kp, _internal=True),
+                     Tensor(vp, _internal=True), lengths)
+                    for kp, vp in zip(kps, vps)]
+
+        def _pools_from(new_caches):
+            if quant:
+                return ([c[0]._value for c in new_caches],
+                        [c[1]._value for c in new_caches],
+                        [c[3]._value for c in new_caches],
+                        [c[4]._value for c in new_caches])
+            return ([c[0]._value for c in new_caches],
+                    [c[1]._value for c in new_caches])
 
         def _fwd_last(ids_t, caches_t, gather_idx=None):
             """(per-row logits at the last real position, new caches); when
@@ -672,52 +839,153 @@ class Engine:
                           else lg[jnp.arange(lg.shape[0]), gather_idx])
             return logits, new_caches
 
-        def prefill(values, ids, kpools, vpools, slot_idx, prompt_lens):
+        def _fwd_all(ids_t, caches_t):
+            """Logits at EVERY input position — the speculative verify
+            needs the model's choice after each drafted prefix."""
+            inner = getattr(model, "gpt", None)
+            head = getattr(model, "lm_head", None)
+            if inner is not None and callable(head):
+                x, new_caches = inner(ids_t, caches=caches_t, use_cache=True)
+                logits = head(Tensor(x._value, _internal=True))._value
+            else:
+                lg, new_caches = model(ids_t, caches=caches_t,
+                                       use_cache=True)
+                logits = lg._value
+            return logits, new_caches
+
+        def _sample_rows(lg, temps, topks, keys):
+            """Device sampler, one row each: greedy at temp 0, else
+            temperature + optional top-k via Gumbel-max (categorical
+            sampling without materializing probabilities)."""
+            greedy = jnp.argmax(lg, axis=-1)
+
+            def row(l_row, temp, k, key):
+                l32 = l_row.astype(jnp.float32) / jnp.maximum(temp, 1e-6)
+                v = l_row.shape[-1]
+                srt = jnp.sort(l32)                 # ascending
+                kth = srt[jnp.clip(v - k, 0, v - 1)]
+                keep = (k <= 0) | (l32 >= kth)
+                masked = jnp.where(keep, l32, -1e30)
+                g = jax.random.gumbel(key, masked.shape, jnp.float32)
+                return jnp.argmax(masked + g)
+
+            sampled = jax.vmap(row)(lg, temps, topks, keys)
+            return jnp.where(temps > 0, sampled, greedy)
+
+        def _step_keys(keys, positions):
+            """Counter-based per-draw keys: fold the row's base key with
+            the position its logits sit at — stateless, so no key state
+            ever returns to the host, and the draw for 'token after
+            position p' is identical whichever path (cold prefill, tail
+            prefill, decode) produced it."""
+            return jax.vmap(jax.random.fold_in)(keys, positions)
+
+        def prefill(values, ids, pools, slot_idx, prompt_lens, temps,
+                    topks, keys):
             # the per-request caches are BUILT inside this jit with a
             # python-int length 0 (static prefill: the prompt keeps the
             # causal flash path), then the filled rows scatter into the
             # pool at each request's slot; padding rows target the scratch
-            # slot
+            # slot.  int8 pools quantize at the scatter (the prompt math
+            # itself stays full precision).
             n = ids.shape[0]
             caches_t = [
-                (Tensor(jnp.zeros((n, L) + tuple(kp.shape[2:]), kp.dtype),
+                (Tensor(jnp.zeros((n, L) + tuple(k.shape[2:]), k.dtype),
                         _internal=True),
-                 Tensor(jnp.zeros((n, L) + tuple(vp.shape[2:]), vp.dtype),
+                 Tensor(jnp.zeros((n, L) + tuple(v.shape[2:]), v.dtype),
                         _internal=True), 0)
-                for kp, vp in zip(kpools, vpools)]
+                for k, v in kv]
             with _swapped_state(model, values):
                 logits, new_caches = _fwd_last(
                     Tensor(ids, _internal=True), caches_t,
                     gather_idx=prompt_lens - 1)
-            kpools = [kp.at[slot_idx].set(c[0]._value)
-                      for kp, c in zip(kpools, new_caches)]
-            vpools = [vp.at[slot_idx].set(c[1]._value)
-                      for vp, c in zip(vpools, new_caches)]
-            return logits, kpools, vpools
+            if quant:
+                kpools_, vpools_, kscales_, vscales_ = pools
+                kq = [quantize_rows(c[0]._value) for c in new_caches]
+                vq = [quantize_rows(c[1]._value) for c in new_caches]
+                kpools_ = [kp.at[slot_idx].set(q)
+                           for kp, (q, _) in zip(kpools_, kq)]
+                vpools_ = [vp.at[slot_idx].set(q)
+                           for vp, (q, _) in zip(vpools_, vq)]
+                kscales_ = [ks.at[slot_idx].set(s)
+                            for ks, (_, s) in zip(kscales_, kq)]
+                vscales_ = [vs.at[slot_idx].set(s)
+                            for vs, (_, s) in zip(vscales_, vq)]
+                pools = (kpools_, vpools_, kscales_, vscales_)
+            else:
+                kpools_, vpools_ = pools
+                kpools_ = [kp.at[slot_idx].set(c[0]._value)
+                           for kp, c in zip(kpools_, new_caches)]
+                vpools_ = [vp.at[slot_idx].set(c[1]._value)
+                           for vp, c in zip(vpools_, new_caches)]
+                pools = (kpools_, vpools_)
+            if on_device:
+                toks = _sample_rows(logits, temps, topks,
+                                    _step_keys(keys, prompt_lens - 1))
+                return toks, pools
+            return logits, pools
 
-        def decode(values, ids, kpools, vpools, lengths, active):
+        def decode(values, ids, pools, lengths, temps, topks, keys):
             # ONE batched step over every slot row (+ scratch): vector
-            # lengths route the per-slot static-cache branch; inactive
-            # rows compute garbage that is never read and their lengths
-            # stay put
-            caches_t = [(Tensor(kp, _internal=True),
-                         Tensor(vp, _internal=True), lengths)
-                        for kp, vp in zip(kpools, vpools)]
+            # lengths route the per-slot static-cache branch; idle rows
+            # are parked at max_len so their writes DROP (a prefix-cached
+            # row is never clobbered) and their logits are garbage that
+            # is never read.  ids is [n_rows, W]: W=1 is the plain decode,
+            # W=k the speculative verify — same program shape either way,
+            # ONE signature per engine config.
+            caches_t = _caches_from(pools, lengths)
+            with _swapped_state(model, values):
+                logits, new_caches = _fwd_all(
+                    Tensor(ids, _internal=True), caches_t)
+            pools = _pools_from(new_caches)
+            if on_device:
+                greedy = jnp.argmax(logits, axis=-1)        # [B, W]
+                first = _sample_rows(logits[:, 0], temps, topks,
+                                     _step_keys(keys, lengths))
+                toks = greedy.at[:, 0].set(first)
+                return toks, pools
+            return logits, pools
+
+        def tail_prefill(values, ids, pools, lengths, gather_idx, temps,
+                         topks, keys):
+            # prefix-cache hit path: the prompt HEAD was copied from a
+            # cached row, only the tail runs through the per-slot branch
+            # (rows not in this admit batch park at max_len: writes drop)
+            caches_t = _caches_from(pools, lengths)
             with _swapped_state(model, values):
                 logits, new_caches = _fwd_last(
-                    Tensor(ids, _internal=True), caches_t)
-            kpools = [c[0]._value for c in new_caches]
-            vpools = [c[1]._value for c in new_caches]
-            new_lengths = jnp.where(active, lengths + 1, lengths)
-            return logits, kpools, vpools, new_lengths
+                    Tensor(ids, _internal=True), caches_t,
+                    gather_idx=gather_idx)
+            pools = _pools_from(new_caches)
+            if on_device:
+                toks = _sample_rows(logits, temps, topks,
+                                    _step_keys(keys, lengths + gather_idx))
+                return toks, pools
+            return logits, pools
+
+        def copy_rows(pools, src, dst):
+            # prefix-cache hit: clone the cached rows (K/V + scales) into
+            # the hitting requests' slots — a pure device-side gather/
+            # scatter, bitwise-preserving; padding lanes copy scratch onto
+            # itself
+            return tuple([p.at[dst].set(p[src]) for p in grp]
+                         for grp in pools)
 
         # cache pools are donated: prefill/decode update HBM in place (no
         # donation on CPU — it only warns there)
-        donate = (2, 3) if jax.default_backend() != "cpu" else ()
+        on_cpu = jax.default_backend() == "cpu"
         self._prefill_fn = instrument_jit(
-            jax.jit(prefill, donate_argnums=donate), "serving.prefill")
+            jax.jit(prefill, donate_argnums=() if on_cpu else (2,)),
+            "serving.prefill")
         self._decode_fn = instrument_jit(
-            jax.jit(decode, donate_argnums=donate), "serving.decode")
+            jax.jit(decode, donate_argnums=() if on_cpu else (2,)),
+            "serving.decode")
+        self._tail_fn = instrument_jit(
+            jax.jit(tail_prefill, donate_argnums=() if on_cpu else (2,)),
+            "serving.tail_prefill")
+        self._copy_fn = instrument_jit(
+            jax.jit(copy_rows, donate_argnums=() if on_cpu else (0,)),
+            "serving.prefix_copy")
         with self._lock:
             self._built = True
 
@@ -758,11 +1026,17 @@ class Engine:
             self._queue.clear()
             for slot in list(self._pool.active()):
                 self._pool.free(slot)
-            self._active[:] = False
+            if self._prefix is not None:
+                # dead pool: every cached row dies with it — a rebuilt
+                # engine starts with an EMPTY index (no stale-row reuse)
+                self._prefix.drop_all()
+                for slot in list(self._pool.cached()):
+                    self._pool.release_cached(slot)
             for r in queued + active:
                 # freeze the token streams FIRST: after abandon() a
                 # stuck dispatch may still come back and try to emit
                 r._torn = True
+                r._prefix_src = None
         flight.record("serving", "scheduler_error",
                       error=f"{type(cause).__name__}: {cause}",
                       queued=len(queued), active=len(active))
@@ -875,45 +1149,135 @@ class Engine:
         self._wake.set()                 # active: next sweep evicts
         return True
 
+    # -- admission -----------------------------------------------------------
     def _admit(self) -> bool:
+        import jax
+
+        prefix_metrics = None
+        evicted = 0
         with self._lock:
-            n = min(self._pool.n_free, self.prefill_batch, len(self._queue))
+            want = min(self.prefill_batch, len(self._queue))
+            if self._prefix is not None and want > self._pool.n_free:
+                # reclaim cache capacity: LRU unreferenced entries go back
+                # to the free list.  Referenced rows (copy sources for
+                # in-flight requests) survive the sweep, and so do the
+                # entries the incoming wave itself is about to hit — a
+                # peek pass finds them first, otherwise a fully-cached
+                # pool would evict exactly the rows the queue wants
+                protect = set()
+                for req in itertools.islice(self._queue, want):
+                    hit = self._prefix.lookup(req.prompt, peek=True)
+                    if hit is not None:
+                        protect.add(id(hit[0]))
+                for e in self._prefix.evict_lru(want - self._pool.n_free,
+                                                protect=protect):
+                    self._pool.release_cached(e.slot)
+                    self._counts["prefix_evictions"] += 1
+                    evicted += 1
+                    flight.record("serving", "prefix_evict", slot=e.slot,
+                                  cached_tokens=e.n)
+            n = min(self._pool.n_free, want)
             batch = [self._queue.popleft() for _ in range(n)]
             for req in batch:
                 req.slot = self._pool.alloc(req)
                 req._state = "active"
                 req.t_admit = time.perf_counter()
+            if self._prefix is not None and batch:
+                for req in batch:
+                    hit = self._prefix.lookup(req.prompt)
+                    if hit is not None:
+                        entry, matched = hit
+                        self._prefix.acquire(entry)
+                        req._prefix_src = entry
+                        req._prefix_match = matched
+                        req.prefix_hit = True
+                        self._counts["prefix_hits"] += 1
+                    else:
+                        self._counts["prefix_misses"] += 1
+                prefix_metrics = (sum(1 for r in batch if r.prefix_hit),
+                                  sum(1 for r in batch if not r.prefix_hit))
             self._gauges_locked()
         if not batch:
             return False
         if not self._built:
             with span("serving.build"):
                 self._build()
+        if evicted:
+            registry().counter(
+                SERVING_PREFIX_EVICTIONS,
+                "prefix-cache rows evicted back to the free list").inc(
+                float(evicted))
+        if prefix_metrics is not None:
+            reg = registry()
+            hits, misses = prefix_metrics
+            if hits:
+                reg.counter(SERVING_PREFIX_HITS,
+                            "admissions served from the prefix cache").inc(
+                    float(hits))
+            if misses:
+                reg.counter(SERVING_PREFIX_MISSES,
+                            "admissions with no usable cached prefix").inc(
+                    float(misses))
+        for req in batch:
+            # per-request PRNG base key for the device sampler (one tiny
+            # eager op per ADMISSION, not per token)
+            req._base_key = np.asarray(jax.random.PRNGKey(req.seed),
+                                       np.uint32)
+        cold = [r for r in batch if r._prefix_src is None]
+        hits = [r for r in batch if r._prefix_src is not None]
+        if cold:
+            self._prefill_cold(cold)
+        if hits:
+            self._prefill_hits(hits)
+        with self._lock:
+            self._gauges_locked()
+        return True
 
+    def _set_slot_params_locked(self, req: RequestHandle):
+        slot = req.slot
+        self._temps[slot] = req.temperature
+        self._topks[slot] = req.top_k
+        self._keys[slot] = req._base_key
+
+    def _prefill_cold(self, batch) -> None:
+        """Batched prefill of requests with no cached prefix (the only
+        admission path when the prefix cache is off)."""
         import jax.numpy as jnp
         bucket = _bucket(max(r.prompt.size for r in batch),
                          min(8, self.max_len), self.max_len)
-        ids = np.zeros((self.prefill_batch, bucket), np.int64)
-        slot_idx = np.full(self.prefill_batch, self.max_slots, np.int32)
-        plens = np.ones(self.prefill_batch, np.int32)
-        for i, req in enumerate(batch):
-            ids[i, :req.prompt.size] = req.prompt
-            slot_idx[i] = req.slot
-            plens[i] = req.prompt.size
-            flight.record("serving", "admit", request=req.request_id,
-                          slot=req.slot, prompt_len=int(req.prompt.size),
-                          queue_wait_ms=round(
-                              1e3 * (req.t_admit - req.t_submit), 3))
+        P = self.prefill_batch
+        ids = np.zeros((P, bucket), np.int64)
+        slot_idx = np.full(P, self.max_slots, np.int32)
+        plens = np.ones(P, np.int32)
+        temps = np.zeros(P, np.float32)
+        topks = np.zeros(P, np.int32)
+        keys = np.zeros((P, 2), np.uint32)
+        with self._lock:
+            for i, req in enumerate(batch):
+                ids[i, :req.prompt.size] = req.prompt
+                slot_idx[i] = req.slot
+                plens[i] = req.prompt.size
+                temps[i] = req.temperature
+                topks[i] = req.top_k
+                keys[i] = req._base_key
+                self._set_slot_params_locked(req)
+                flight.record("serving", "admit", request=req.request_id,
+                              slot=req.slot,
+                              prompt_len=int(req.prompt.size),
+                              queue_wait_ms=round(
+                                  1e3 * (req.t_admit - req.t_submit), 3))
         t0 = time.perf_counter()
         faults.fault_point("serving.prefill", n=len(batch))
         if self._decode_timeout_s is not None:
             _watchdog.arm("serving.prefill", self._decode_timeout_s)
         try:
             with span("serving.prefill", n=len(batch), bucket=bucket):
-                logits, self._kpools, self._vpools = self._prefill_fn(
-                    self._values, jnp.asarray(ids), self._kpools,
-                    self._vpools, jnp.asarray(slot_idx), jnp.asarray(plens))
-                logits = np.asarray(logits)
+                out, self._pools = self._prefill_fn(
+                    self._values, jnp.asarray(ids), self._pools,
+                    jnp.asarray(slot_idx), jnp.asarray(plens),
+                    jnp.asarray(temps), jnp.asarray(topks),
+                    jnp.asarray(keys))
+                out = np.asarray(out)
         finally:
             if self._decode_timeout_s is not None:
                 _watchdog.disarm()
@@ -923,28 +1287,127 @@ class Engine:
         registry().histogram(SERVING_BATCH_SECONDS,
                              "prefill/decode batch wall time").observe(
             dt, labels={"phase": "prefill"})
+        self._emit_first_tokens(batch, out, by_slot=False)
+
+    def _prefill_hits(self, hits) -> None:
+        """Prefix-cache hit path: device-copy the cached rows into the
+        new slots, then prefill ONLY the prompt tails through the
+        per-slot branch — admission cost scales with the tail, not the
+        prompt."""
+        import jax.numpy as jnp
+        P = self.prefill_batch
+        scratch = self.max_slots
+        src = np.full(P, scratch, np.int32)
+        dst = np.full(P, scratch, np.int32)
+        n_rows = self.max_slots + 1
+        tails = [r.prompt.size - r._prefix_match for r in hits]
+        tb = _bucket(max(tails), 1, self.max_len)
+        ids = np.zeros((n_rows, tb), np.int64)
+        lens = np.full(n_rows, self.max_len, np.int32)
+        gidx = np.zeros(n_rows, np.int32)
+        with self._lock:
+            for i, req in enumerate(hits):
+                e, m = req._prefix_src, req._prefix_match
+                src[i], dst[i] = e.slot, req.slot
+                tail = req.prompt[m:]
+                ids[req.slot, :tail.size] = tail
+                lens[req.slot] = m
+                gidx[req.slot] = tail.size - 1
+                self._set_slot_params_locked(req)
+                flight.record("serving", "prefix_admit",
+                              request=req.request_id, slot=req.slot,
+                              src_slot=e.slot, cached_tokens=m,
+                              tail=int(tail.size),
+                              queue_wait_ms=round(
+                                  1e3 * (req.t_admit - req.t_submit), 3))
+        t0 = time.perf_counter()
+        faults.fault_point("serving.prefill", n=len(hits))
+        if self._decode_timeout_s is not None:
+            _watchdog.arm("serving.tail_prefill", self._decode_timeout_s)
+        try:
+            with span("serving.prefix_copy", n=len(hits)):
+                self._pools = self._copy_fn(self._pools, jnp.asarray(src),
+                                            jnp.asarray(dst))
+            with span("serving.tail_prefill", n=len(hits), bucket=tb):
+                out, self._pools = self._tail_fn(
+                    self._values, jnp.asarray(ids), self._pools,
+                    jnp.asarray(lens), jnp.asarray(gidx),
+                    jnp.asarray(self._temps), jnp.asarray(self._topks),
+                    jnp.asarray(self._keys))
+                out = np.asarray(out)
+        finally:
+            if self._decode_timeout_s is not None:
+                _watchdog.disarm()
+        dt = time.perf_counter() - t0
+        with self._lock:
+            self._counts["prefill_batches"] += 1
+        registry().histogram(SERVING_BATCH_SECONDS,
+                             "prefill/decode batch wall time").observe(
+            dt, labels={"phase": "tail_prefill"})
+        self._emit_first_tokens(hits, out, by_slot=True)
+
+    def _emit_first_tokens(self, batch, out, by_slot: bool):
+        """Shared tail of both admission paths: record TTFT and emit each
+        request's first token (``out`` is device-sampled token ids, or
+        logits rows when ``sample_on_device=False``)."""
         now = time.perf_counter()
+        finishers = []
         for i, req in enumerate(batch):
+            row = out[req.slot] if by_slot else out[i]
             req.ttft_s = now - req.t_submit
             req._t_last_token = now
             registry().histogram(SERVING_TTFT,
                                  "time to first token").observe(req.ttft_s)
-            self._emit_token(req, logits[i], first=True)
-        with self._lock:
-            self._gauges_locked()
-        return True
+            if req.done() or req._torn or req._engine is not self:
+                continue
+            token = (int(row) if self.sample_on_device else
+                     _sample_row(row, req.temperature, req.top_k, req._rng))
+            finished = self._emit_one(req, token)
+            slot = req.slot
+            with self._lock:
+                self._counts["tokens"] += 1
+                self._lengths[slot] = req.prompt.size
+                if finished:
+                    self._evict_locked(req, "completed")
+                else:
+                    self._ids[slot, 0] = token
+            if finished:
+                finishers.append(req)
+        for req in finishers:
+            req._finish(None)
 
+    # -- decode --------------------------------------------------------------
     def _decode_step(self) -> bool:
         with self._lock:
             active = self._pool.active()
             if not active:
                 return False
+        W = self._spec_width
+        drafts: dict = {}
+        if W > 1:
+            for slot, req in active.items():
+                if req.temperature == 0.0:
+                    # prompt-lookup drafting is greedy-only: an accepted
+                    # draft must equal the token the model WOULD emit,
+                    # which is only well-defined for argmax decoding
+                    ctx = np.concatenate(
+                        [req.prompt, np.asarray(req._tokens, np.int64)])
+                    drafts[slot] = np.asarray(
+                        self._drafter(ctx, W - 1), np.int64)
+        with self._lock:
             # snapshot the slot-state arrays under the lock: shutdown()
-            # clears _active from the caller thread (tpu-lint
+            # mutates slot state from the caller thread (tpu-lint
             # concurrency.unguarded-shared-attr)
+            for slot in active:
+                d = drafts.get(slot)
+                if W > 1:
+                    self._ids[slot, 1:] = (d if d is not None
+                                           else self._ids[slot, 0])
             ids = np.array(self._ids)
             lengths = np.array(self._lengths)
-            act = np.array(self._active)
+            temps = np.array(self._temps)
+            topks = np.array(self._topks)
+            keys = np.array(self._keys)
         import jax.numpy as jnp
         t0 = time.perf_counter()
         faults.fault_point("serving.decode", active=len(active))
@@ -952,10 +1415,11 @@ class Engine:
             _watchdog.arm("serving.decode", self._decode_timeout_s)
         try:
             with span("serving.decode", active=len(active)):
-                logits, self._kpools, self._vpools, _ = self._decode_fn(
-                    self._values, jnp.asarray(ids), self._kpools,
-                    self._vpools, jnp.asarray(lengths), jnp.asarray(act))
-                logits = np.asarray(logits)
+                out, self._pools = self._decode_fn(
+                    self._values, jnp.asarray(ids), self._pools,
+                    jnp.asarray(lengths), jnp.asarray(temps),
+                    jnp.asarray(topks), jnp.asarray(keys))
+                out = np.asarray(out)
         finally:
             if self._decode_timeout_s is not None:
                 _watchdog.disarm()
@@ -966,49 +1430,117 @@ class Engine:
                              "prefill/decode batch wall time").observe(
             dt, labels={"phase": "decode"})
         now = time.perf_counter()
+        tok_hist = registry().histogram(SERVING_TOKEN_LATENCY,
+                                        "per-token decode latency")
+        drafted_total = accepted_total = 0
+        finishers = []
         for slot, req in active.items():
-            self._lengths[slot] += 1
+            if req.done() or req._torn or req._engine is not self:
+                # torn away by a supervisor abandon while this batch ran
+                # (or already re-dispatched into a REBUILT engine): its
+                # outcome is settled elsewhere
+                continue
+            if self.sample_on_device:
+                toks_row = out[slot]                      # [W] token ids
+            else:
+                row_logits = out[slot]                    # [W, V] logits
+                first = _sample_row(row_logits[0], req.temperature,
+                                    req.top_k, req._rng)
+                toks_row = np.concatenate(
+                    [[first], row_logits[1:].argmax(-1)]) \
+                    if W > 1 else np.array([first])
+            # acceptance: the draft at position j (ids[slot, j]) is kept
+            # iff it equals the model's choice at position j-1; the run
+            # t_0..t_m then emits m+1 tokens for this one pool read
+            run = [int(toks_row[0])]
+            d = drafts.get(slot)
+            if d is not None:
+                for j in range(1, W):
+                    if int(d[j - 1]) != int(toks_row[j - 1]):
+                        break
+                    run.append(int(toks_row[j]))
+                drafted_total += W - 1
+                accepted_total += len(run) - 1
+            old_len = int(lengths[slot])
             lat = now - req._t_last_token
             req._t_last_token = now
-            req.token_latencies_s.append(lat)
-            registry().histogram(SERVING_TOKEN_LATENCY,
-                                 "per-token decode latency").observe(lat)
-            self._emit_token(req, logits[slot], first=False)
+            emitted = 0
+            finished = False
+            for token in run:
+                finished = self._emit_one(req, token)
+                emitted += 1
+                if finished:
+                    break
+            # one pool read emitted `emitted` tokens: split the wall time
+            # so the per-token histogram stays sum-preserving
+            for _ in range(emitted):
+                req.token_latencies_s.append(lat / max(emitted, 1))
+                tok_hist.observe(lat / max(emitted, 1))
+            with self._lock:
+                self._counts["tokens"] += emitted
+                self._lengths[slot] = old_len + emitted
+                if finished:
+                    self._evict_locked(req, "completed")
+                else:
+                    self._ids[slot, 0] = run[emitted - 1]
+            if finished:
+                finishers.append(req)
+        if drafted_total:
+            with self._lock:
+                self._counts["spec_drafted"] += drafted_total
+                self._counts["spec_accepted"] += accepted_total
+            reg = registry()
+            reg.counter(SERVING_SPEC_DRAFTED,
+                        "speculative tokens drafted").inc(
+                float(drafted_total))
+            if accepted_total:
+                reg.counter(SERVING_SPEC_ACCEPTED,
+                            "speculative tokens accepted").inc(
+                    float(accepted_total))
+            flight.record("serving", "spec_verify", drafted=drafted_total,
+                          accepted=accepted_total,
+                          rejected=drafted_total - accepted_total)
+        for req in finishers:
+            req._finish(None)
         with self._lock:
             self._gauges_locked()
         return True
 
-    def _emit_token(self, req: RequestHandle, logits_row, first: bool):
-        """Sample, stream, and either park the token as the slot's next
-        decode input or complete + evict the request."""
-        if req.done() or req._torn or req._engine is not self:
-            # torn away by a supervisor abandon while this batch ran (or
-            # already re-dispatched into a REBUILT engine): its slot here
-            # is freed and its outcome is settled elsewhere
-            return
+    def _emit_one(self, req: RequestHandle, token: int) -> bool:
+        """Stream one token to the request; returns whether the request
+        is now finished (budget or EOS)."""
         faults.fault_point("serving.stream", request=req.request_id)
-        token = _sample_row(logits_row, req.temperature, req.top_k, req._rng)
         req._emit(token)
         registry().counter(SERVING_TOKENS, "tokens generated").inc(1.0)
-        finished = (len(req._tokens) >= req.max_new_tokens or
-                    (req.eos_token_id is not None and
-                     token == req.eos_token_id))
-        slot = req.slot
-        with self._lock:
-            self._counts["tokens"] += 1
-            if first:
-                self._lengths[slot] = req.prompt.size
-            if finished:
-                self._evict_locked(req, "completed")
-            else:
-                self._ids[slot, 0] = token
-                self._active[slot] = True
-        if finished:
-            req._finish(None)
+        return (len(req._tokens) >= req.max_new_tokens or
+                (req.eos_token_id is not None and
+                 token == req.eos_token_id))
 
+    # -- eviction / retention ------------------------------------------------
     def _evict_locked(self, req: RequestHandle, outcome: str):
-        self._pool.free(req.slot)
-        self._active[req.slot] = False
+        slot = req.slot
+        if req._prefix_src is not None:
+            self._prefix.release(req._prefix_src)
+            req._prefix_src = None
+        retained = False
+        if self._prefix is not None and outcome == "completed":
+            # the slot row holds the K/V of prompt + generated[:-1]
+            # (exactly `lengths[slot]` rows) — retain it as a reusable
+            # prefix instead of recycling it; duplicates free normally
+            n = int(self._lengths[slot])
+            cached = np.concatenate(
+                [req.prompt, np.asarray(req._tokens, np.int64)])[:n]
+            entry = self._prefix.insert(slot, cached) if n > 0 else None
+            if entry is not None:
+                self._pool.retain(slot, entry)
+                self._counts["prefix_inserts"] += 1
+                flight.record("serving", "prefix_insert", slot=slot,
+                              cached_tokens=n)
+                retained = True
+        if not retained:
+            self._pool.free(slot)
+        # park the row: idle (and cached) rows' pool writes must DROP
+        self._lengths[slot] = self.max_len
         self._evicted_counters_locked(req, outcome)
 
     def _evicted_counters_locked(self, req: RequestHandle, outcome: str):

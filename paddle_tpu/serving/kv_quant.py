@@ -1,0 +1,49 @@
+"""int8 KV-cache quantization helpers (pure jnp; no engine state).
+
+The decode leg is HBM-bandwidth-bound: every token reads the whole KV
+pool (docs/PERF.md round 5), so halving the pool's bytes halves that
+part of the per-token read — and doubles how many slots fit in the same
+HBM.  ``Engine(kv_dtype="int8")`` stores the K/V pools as int8 with one
+float32 scale per *cached row* (one written position of one slot: the
+absmax over that position's ``[heads, head_dim]`` vector), and the
+attention read dequantizes inline.
+
+Per-row scales (rather than per-slot or per-pool) keep the scheme
+strictly incremental: a new token's K/V is quantized against its OWN
+absmax at write time, so nothing already resident ever needs rescaling
+and the pool update stays a pure scatter — the same one-compiled-program
+decode shape as the unquantized path.
+
+Error model: symmetric absmax int8 keeps the worst-case per-element
+error at ``absmax/254`` (~0.4% of the row's dynamic range); the serving
+tests gate generate() parity on the tiny model and bench reports the
+measured quality delta.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["INT8_MAX", "quantize_rows", "dequantize_pool"]
+
+INT8_MAX = 127.0
+# floor for the per-row scale: an all-zero row (unwritten pool padding)
+# quantizes to zeros with a tiny finite scale instead of dividing by 0
+_SCALE_EPS = 1e-8
+
+
+def quantize_rows(x, eps: float = _SCALE_EPS):
+    """``x [..., heads, head_dim]`` float → ``(q int8 same shape,
+    scales [...] float32)``: symmetric absmax over the trailing two dims,
+    one scale per leading index (= per cached row position)."""
+    amax = jnp.max(jnp.abs(x), axis=(-2, -1))
+    scale = jnp.maximum(amax.astype(jnp.float32) / INT8_MAX, eps)
+    q = jnp.clip(jnp.round(x / scale[..., None, None].astype(x.dtype)),
+                 -INT8_MAX, INT8_MAX).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_pool(q, scale, dtype):
+    """Inverse of :func:`quantize_rows`: ``q [..., heads, head_dim]`` int8
+    + ``scale [...]`` → float ``dtype``.  Runs inside the attention read,
+    so XLA fuses it with the QK^T consumer — HBM sees int8 bytes."""
+    return q.astype(dtype) * scale[..., None, None].astype(dtype)

@@ -7,6 +7,14 @@ reused across requests (the continuous-batching property — one compiled
 decode program serves a stream of requests because slots are recycled, not
 reallocated).  Purely host-side and engine-lock-protected by the caller; no
 device arrays live here.
+
+Slots have three states: **free** (on the free list), **active** (owned
+by an in-flight request), and **cached** (retained by the prefix cache:
+the row's K/V is kept resident as a re-usable prefix instead of being
+recycled immediately — see prefix_cache.PrefixIndex).  Cached slots are
+invisible to ``n_active`` (an engine with only cached rows is idle) and
+return to the free list through ``release_cached`` when the index evicts
+them.
 """
 from __future__ import annotations
 
@@ -27,6 +35,7 @@ class SlotPool:
         self.max_slots = int(max_slots)
         self._free: deque = deque(range(self.max_slots))
         self._owner: Dict[int, Any] = {}
+        self._cached: Dict[int, Any] = {}
         self._ever_used: set = set()
         self.alloc_total = 0
         self.reuse_total = 0
@@ -51,6 +60,22 @@ class SlotPool:
         self._free.append(slot)
         return owner
 
+    def retain(self, slot: int, holder: Any) -> Any:
+        """Move an ACTIVE slot to the cached state instead of freeing it:
+        the row stays resident (prefix cache) but stops counting as
+        active.  Returns the previous owner; KeyError on a slot that is
+        not active (same double-free guard as ``free``)."""
+        owner = self._owner.pop(slot)
+        self._cached[slot] = holder
+        return owner
+
+    def release_cached(self, slot: int) -> Any:
+        """Return a cached slot to the free list (prefix-cache eviction);
+        returns the holder.  KeyError when the slot is not cached."""
+        holder = self._cached.pop(slot)
+        self._free.append(slot)
+        return holder
+
     def owner(self, slot: int) -> Any:
         return self._owner[slot]
 
@@ -58,9 +83,17 @@ class SlotPool:
         """{slot: owner} snapshot of the allocated slots."""
         return dict(self._owner)
 
+    def cached(self) -> Dict[int, Any]:
+        """{slot: holder} snapshot of the prefix-cache-retained slots."""
+        return dict(self._cached)
+
     @property
     def n_active(self) -> int:
         return len(self._owner)
+
+    @property
+    def n_cached(self) -> int:
+        return len(self._cached)
 
     @property
     def n_free(self) -> int:
@@ -71,5 +104,5 @@ class SlotPool:
 
     def __repr__(self):
         return (f"SlotPool(max_slots={self.max_slots}, "
-                f"active={self.n_active}, allocs={self.alloc_total}, "
-                f"reuses={self.reuse_total})")
+                f"active={self.n_active}, cached={self.n_cached}, "
+                f"allocs={self.alloc_total}, reuses={self.reuse_total})")

@@ -78,7 +78,10 @@ def ctc_greedy_decode(logits, blank=0):
     """Collapse repeats then drop blanks (PP-OCR greedy decoder)."""
     import numpy as np
 
-    ids = logits.numpy().argmax(-1)  # [N, T]
+    # argmax on device first: the host transfer is the [N, T] int ids,
+    # not the [N, T, C] float logits (a vocab-fold smaller download)
+    pred = logits.argmax(-1)
+    ids = pred.numpy()  # [N, T]
     results = []
     for row in ids:
         out = []

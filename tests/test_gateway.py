@@ -239,7 +239,8 @@ def test_engine_load_snapshot_and_admission_hook(tiny_gpt):
         eng.submit([4, 5], max_new_tokens=2)
         assert eng.queue_depth() == 2 and eng.slots_in_use() == 0
         ld = eng.load()
-        assert ld == {"queue_depth": 2, "slots_in_use": 0, "max_slots": 2,
+        assert ld == {"queue_depth": 2, "slots_in_use": 0,
+                      "cached_slots": 0, "max_slots": 2,
                       "max_queue": 4, "max_len": 32, "alive": True,
                       "draining": False}
         with pytest.raises(AdmissionError, match="hook says no"):

@@ -139,9 +139,15 @@ def main() -> int:
         m.eval()
         models.append(m)
 
+    # decode fast path ON under chaos (ISSUE 10): every rebuild must
+    # drop the prefix cache cleanly (fresh pool + fresh index — no
+    # stale-row reuse) and keep speculative greedy exact, which the
+    # token-count invariant below catches (a stale or replayed prefix
+    # would change the emitted tokens)
     sups = [EngineSupervisor(
         (lambda mm: lambda: Engine(mm, max_slots=SLOTS, max_len=48,
-                                   max_queue=16))(m),
+                                   max_queue=16, prefix_cache=True,
+                                   prefix_block=4, speculative_k=3))(m),
         name=f"engine{i}", poll_interval_s=0.02, max_restarts=6,
         max_redispatch=3)
         for i, m in enumerate(models)]
@@ -228,6 +234,13 @@ def main() -> int:
                 (s.name, builds)
             assert builds[-1]["decode_compiles"] == 1, (s.name, builds)
             assert s.failed is None, s.failed
+            # fast path live under chaos: the current build's prefix
+            # counters only count THIS pool's entries (a rebuild resets
+            # them with the index — stale hits would show up here as
+            # hits exceeding this build's admissions)
+            st = s.stats()
+            assert st["prefix_hits"] + st["prefix_misses"] >= \
+                st["prefix_inserts"], st
 
         # telemetry through the wire
         conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)

@@ -34,10 +34,62 @@ DEFAULT_SUBSET = [
     "tests/test_checkpoint.py",
     "tests/test_distributed.py",
     "tests/test_serving.py",
+    "tests/test_decode_fastpath.py",
     "tests/test_gateway.py",
     "tests/test_self_healing.py",
     "tests/test_robustness.py",
 ]
+
+# decode fast-path lane (ISSUE 10): prefix cache + speculation + int8 KV
+# + device sampling composed on one engine with telemetry live — the new
+# counters/gauges must export, flight must record the fast-path events,
+# and decode must stay at ONE compiled signature.
+FASTPATH_LANE = r"""
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu import observability as obs
+from paddle_tpu.models import build_gpt, gpt_config
+from paddle_tpu.observability import flight
+from paddle_tpu.serving import Engine
+from paddle_tpu.serving.engine import (
+    SERVING_KV_POOL_BYTES, SERVING_PREFIX_HITS, SERVING_PREFIX_MISSES,
+    SERVING_SPEC_ACCEPTED, SERVING_SPEC_DRAFTED)
+
+assert obs.enabled(), "PADDLE_TPU_TELEMETRY=1 must bootstrap telemetry"
+cfg = gpt_config("gpt-tiny", max_position_embeddings=128,
+                 hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+paddle.seed(0)
+model = build_gpt(cfg)
+model.eval()
+rs = np.random.RandomState(0)
+shared = rs.randint(0, cfg.vocab_size, 12).astype(np.int64)
+prompts = [np.concatenate([shared, rs.randint(0, cfg.vocab_size, 3)
+                           .astype(np.int64)]) for _ in range(5)]
+eng = Engine(model, max_slots=2, max_len=64, prefix_cache=True,
+             prefix_block=4, speculative_k=3, kv_dtype="int8",
+             prefill_batch=1)
+outs = [eng.submit(p, max_new_tokens=6).result(timeout=300)
+        for p in prompts]
+st = eng.stats()
+eng.shutdown()
+assert all(o.shape == (6,) for o in outs)
+assert st["decode_compiles"] == 1, st
+assert st["prefix_hits"] > 0 and st["spec_accepted"] > 0, st
+assert st["kv_pool_bytes"] > 0, st
+d = obs.dump()
+for name in (SERVING_PREFIX_HITS, SERVING_PREFIX_MISSES,
+             SERVING_SPEC_DRAFTED, SERVING_SPEC_ACCEPTED):
+    assert name in d["counters"], (name, sorted(d["counters"]))
+assert SERVING_KV_POOL_BYTES in d["gauges"]
+text = obs.to_prometheus_text()
+assert SERVING_PREFIX_HITS in text and SERVING_KV_POOL_BYTES in text
+names = {e["name"] for e in flight.events("serving")}
+assert {"prefix_admit", "prefix_insert", "spec_verify"} <= names, names
+print("fast-path lane ok:", {
+    "prefix_hits": st["prefix_hits"], "spec_accepted": st["spec_accepted"],
+    "kv_pool_bytes": st["kv_pool_bytes"],
+    "decode_compiles": st["decode_compiles"]})
+"""
 
 # prefetch-on training lane: fit a tiny model THROUGH DevicePrefetcher with
 # telemetry live and assert the input-pipeline series were exported.  Runs
@@ -109,6 +161,15 @@ def main() -> int:
         if lane_rc != 0:
             print("prefetch lane FAILED", file=sys.stderr)
         rc = rc or lane_rc
+        # decode fast-path lane (ISSUE 10): prefix cache + speculation +
+        # int8 KV + device sampling with telemetry live — counters,
+        # flight events, one decode signature
+        print("telemetry smoke: decode fast-path lane", file=sys.stderr)
+        fp_rc = subprocess.call([sys.executable, "-c", FASTPATH_LANE],
+                                env=env, cwd=root)
+        if fp_rc != 0:
+            print("fast-path lane FAILED", file=sys.stderr)
+        rc = rc or fp_rc
         # tpu-lint ratchet gate (ISSUE 7): runs even when the pytest
         # subset has unrelated failures, in its own interpreter (the
         # analyzer is jax-free, so it cannot be broken by runtime drift)
