@@ -1,4 +1,16 @@
-"""paddle.metric parity (reference: python/paddle/metric/metrics.py)."""
+"""paddle.metric parity (reference: python/paddle/metric/metrics.py).
+
+Accuracy — the metric the hapi fit/eval loop updates EVERY batch —
+computes on device when fed Tensors: top-k and the correctness compare
+run in jnp, and only ``len(topk)`` scalars cross the host boundary per
+update.  (The original downloaded the full ``[N, C]`` predictions and
+argsorted on host once per batch — a per-step blocking transfer, the
+tpu-lint ``trace-hygiene.device-sync`` class of bug.)  Precision and
+Recall reduce their counts on device the same way.  Auc keeps a host
+histogram by design — like quantization's HistObserver it needs the
+full score distribution, and its inputs are ``[N]`` score vectors, not
+``[N, C]`` logits.
+"""
 from __future__ import annotations
 
 import numpy as np
@@ -6,8 +18,19 @@ import numpy as np
 from ..core.tensor import Tensor
 
 
-def _np(x):
-    return x.numpy() if isinstance(x, Tensor) else np.asarray(x)
+def _host_small(x):
+    """Host view of a SMALL operand (labels, score vectors).  The big
+    per-batch operands — predictions — never come through here: their
+    reductions run on device."""
+    if isinstance(x, Tensor):
+        return np.asarray(x._value)
+    return np.asarray(x)
+
+
+def _device(x):
+    import jax.numpy as jnp
+
+    return x._value if isinstance(x, Tensor) else jnp.asarray(np.asarray(x))
 
 
 class Metric:
@@ -35,8 +58,21 @@ class Accuracy(Metric):
         self.reset()
 
     def compute(self, pred, label, *args):
-        pred_np = _np(pred)
-        label_np = _np(label)
+        if isinstance(pred, Tensor):
+            # top-k + compare stay on device; the [N, C] predictions are
+            # never downloaded — update() syncs len(topk) scalars
+            import jax
+            import jax.numpy as jnp
+
+            p = pred._value
+            lab = _device(label)
+            _, order = jax.lax.top_k(p, self.maxk)
+            if lab.ndim == p.ndim and lab.shape[-1] == 1:
+                lab = lab[..., 0]
+            correct = (order == lab[..., None]).astype(jnp.float32)
+            return Tensor(correct, _internal=True)
+        pred_np = np.asarray(pred)
+        label_np = _host_small(label)
         order = np.argsort(-pred_np, axis=-1)[..., :self.maxk]
         if label_np.ndim == pred_np.ndim and label_np.shape[-1] == 1:
             label_np = label_np.squeeze(-1)
@@ -44,13 +80,15 @@ class Accuracy(Metric):
         return Tensor(correct)
 
     def update(self, correct, *args):
-        c = _np(correct)
+        c = correct._value if isinstance(correct, Tensor) else \
+            np.asarray(correct)
+        n = int(c.shape[0]) if c.ndim else 1
         accs = []
-        for k in self.topk:
-            num = c[..., :k].sum()
-            accs.append(num / max(c.shape[0], 1))
-            self.total[self.topk.index(k)] += num
-            self.count[self.topk.index(k)] += c.shape[0]
+        for i, k in enumerate(self.topk):
+            num = float(c[..., :k].sum())   # one scalar per k on device
+            accs.append(num / max(n, 1))
+            self.total[i] += num
+            self.count[i] += n
         return accs[0] if len(accs) == 1 else accs
 
     def reset(self):
@@ -73,10 +111,13 @@ class Precision(Metric):
         self.reset()
 
     def update(self, preds, labels):
-        p = (_np(preds) > 0.5).astype(np.int32).reshape(-1)
-        l = _np(labels).astype(np.int32).reshape(-1)
-        self.tp += int(((p == 1) & (l == 1)).sum())
-        self.fp += int(((p == 1) & (l == 0)).sum())
+        pb = (_device(preds) > 0.5).reshape(-1)
+        lb = (_device(labels).astype("int32") == 1).reshape(-1)
+        tp = pb & lb
+        fp = pb & ~lb
+        # two scalars cross the host boundary (was two full downloads)
+        self.tp += int(tp.sum())
+        self.fp += int(fp.sum())
 
     def reset(self):
         self.tp = 0
@@ -96,10 +137,12 @@ class Recall(Metric):
         self.reset()
 
     def update(self, preds, labels):
-        p = (_np(preds) > 0.5).astype(np.int32).reshape(-1)
-        l = _np(labels).astype(np.int32).reshape(-1)
-        self.tp += int(((p == 1) & (l == 1)).sum())
-        self.fn += int(((p == 0) & (l == 1)).sum())
+        pb = (_device(preds) > 0.5).reshape(-1)
+        lb = (_device(labels).astype("int32") == 1).reshape(-1)
+        tp = pb & lb
+        fn = ~pb & lb
+        self.tp += int(tp.sum())
+        self.fn += int(fn.sum())
 
     def reset(self):
         self.tp = 0
@@ -115,7 +158,8 @@ class Recall(Metric):
 
 class Auc(Metric):
     """Streaming AUC with histogram buckets (reference: metrics.py Auc +
-    framework/fleet/metrics.cc BasicAucCalculator)."""
+    framework/fleet/metrics.cc BasicAucCalculator).  Host-side by
+    design: the bucketed count update needs the score distribution."""
 
     def __init__(self, curve="ROC", num_thresholds=4095, name="auc"):
         self._name = name
@@ -123,13 +167,13 @@ class Auc(Metric):
         self.reset()
 
     def update(self, preds, labels):
-        p = _np(preds)
+        p = _host_small(preds)
         if p.ndim == 2:
             p = p[:, -1]
-        l = _np(labels).reshape(-1)
+        lab = _host_small(labels).reshape(-1)
         bins = np.clip((p * self.num_thresholds).astype(np.int64), 0,
                        self.num_thresholds)
-        for b, y in zip(bins, l):
+        for b, y in zip(bins, lab):
             if y:
                 self._stat_pos[b] += 1
             else:
@@ -155,8 +199,17 @@ class Auc(Metric):
 
 
 def accuracy(input, label, k=1, correct=None, total=None, name=None):  # noqa: A002
-    pred = _np(input)
-    lab = _np(label).reshape(-1)
+    if isinstance(input, Tensor):
+        import jax
+        import jax.numpy as jnp
+
+        p = input._value
+        lab = _device(label).reshape(-1)
+        _, order = jax.lax.top_k(p, int(k))
+        hit = (order == lab[:, None]).any(axis=1)
+        return Tensor(hit.astype(jnp.float32).mean(), _internal=True)
+    pred = np.asarray(input)
+    lab = _host_small(label).reshape(-1)
     order = np.argsort(-pred, axis=-1)[:, :k]
     correct_np = (order == lab[:, None]).any(axis=1).mean()
     return Tensor(np.float32(correct_np))

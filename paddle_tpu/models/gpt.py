@@ -259,7 +259,7 @@ class GPTSelfAttention(Layer):
         else:
             q, k, v = (qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2])
             new_cache = None
-            if cache is not None and len(cache) in (3, 5):
+            if cache is not None and len(cache) in (3, 4, 5, 6):
                 # STATIC cache (k_buf [B,L,nh,hd], v_buf, length): write the
                 # new keys/values in place at `length` and attend over the
                 # fixed-shape buffer under an explicit validity mask — every
@@ -272,19 +272,32 @@ class GPTSelfAttention(Layer):
                 # buffers store int8, scales [B, L] carry one absmax scale
                 # per cached row; writes quantize, the attention read
                 # dequantizes inline (kv_quant helpers).
+                # The PAGED forms (serving paged_kv=True) add an int32
+                # page table at index 3: 4-tuple (k_pages, v_pages,
+                # lengths, page_table) and 6-tuple (..., k_scale,
+                # v_scale).  K/V live as [num_pages, page_size, heads,
+                # head_dim] pages; position p of row b maps to
+                # pages[page_table[b, p // P], p % P].  Writes scatter
+                # through the table (sentinel/out-of-range entries DROP
+                # — unallocated virtual positions are unwritable), reads
+                # gather the row's pages back into a [B, L_virt, ...]
+                # view under the same validity mask as the dense pool —
+                # the page table is just one more fixed-shape operand,
+                # so decode keeps its ONE compiled signature.
                 import jax.numpy as jnp
 
                 from ..core.tensor import Tensor as _T
                 k_buf, v_buf, pos0 = cache[0], cache[1], cache[2]
-                quantized = len(cache) == 5
+                quantized = len(cache) in (5, 6)
+                paged = len(cache) in (4, 6)
                 k_raw = k_buf._value if isinstance(k_buf, _T) else k_buf
                 v_raw = v_buf._value if isinstance(v_buf, _T) else v_buf
                 start = jnp.asarray(pos0, jnp.int32)
-                if quantized and start.ndim != 1:
+                if (quantized or paged) and start.ndim != 1:
                     raise ValueError(
-                        "int8 KV caches (5-tuple) are supported only in "
-                        "the per-slot vector-length form the serving "
-                        "engine uses")
+                        "int8 (5/6-tuple) and paged (4/6-tuple) KV "
+                        "caches are supported only in the per-slot "
+                        "vector-length form the serving engine uses")
                 if start.ndim == 1:
                     # PER-SLOT lengths (continuous batching, serving.Engine):
                     # `pos0` is a [B] vector — every row owns a slot in a
@@ -297,34 +310,89 @@ class GPTSelfAttention(Layer):
                     # verification / prefix-tail prefill): position j of a
                     # row writes at its own offset + j and attends causally
                     # within the new span.
-                    rows = jnp.arange(k_raw.shape[0])[:, None]
-                    cols = start[:, None] + jnp.arange(t)[None, :]
+                    scale_i = 4 if paged else 3
                     if quantized:
                         from ..serving.kv_quant import (dequantize_pool,
                                                         quantize_rows)
-                        ks_raw, vs_raw = cache[3], cache[4]
+                        ks_raw, vs_raw = cache[scale_i], cache[scale_i + 1]
                         ks_raw = (ks_raw._value if isinstance(ks_raw, _T)
                                   else ks_raw)
                         vs_raw = (vs_raw._value if isinstance(vs_raw, _T)
                                   else vs_raw)
                         kq, ksc = quantize_rows(k._value)
                         vq, vsc = quantize_rows(v._value)
-                        k_raw = k_raw.at[rows, cols].set(kq, mode="drop")
-                        v_raw = v_raw.at[rows, cols].set(vq, mode="drop")
-                        ks_raw = ks_raw.at[rows, cols].set(ksc, mode="drop")
-                        vs_raw = vs_raw.at[rows, cols].set(vsc, mode="drop")
-                        k_att = dequantize_pool(k_raw, ks_raw,
-                                                k._value.dtype)
-                        v_att = dequantize_pool(v_raw, vs_raw,
-                                                v._value.dtype)
+                    if paged:
+                        # gather/scatter through the page table: position
+                        # p of row r lives at pages[table[r, p // P],
+                        # p % P].  Sentinel table entries (>= num_pages)
+                        # make the scatter DROP (an unallocated or
+                        # parked position is unwritable) and gather a
+                        # clamped garbage page that the validity mask
+                        # excludes from attention.
+                        pt = cache[3]
+                        pt = pt._value if isinstance(pt, _T) else pt
+                        pt = jnp.asarray(pt, jnp.int32)
+                        n_pages, psz = k_raw.shape[0], k_raw.shape[1]
+                        n_pt = pt.shape[1]
+                        virt = n_pt * psz
+                        rows = jnp.arange(pt.shape[0])[:, None]
+                        cols = start[:, None] + jnp.arange(t)[None, :]
+                        pslot = jnp.clip(cols // psz, 0, n_pt - 1)
+                        pid = jnp.where(cols < virt, pt[rows, pslot],
+                                        n_pages)
+                        off = cols % psz
+                        pt_safe = jnp.clip(pt, 0, n_pages - 1)
+                        if quantized:
+                            k_raw = k_raw.at[pid, off].set(kq, mode="drop")
+                            v_raw = v_raw.at[pid, off].set(vq, mode="drop")
+                            ks_raw = ks_raw.at[pid, off].set(ksc,
+                                                             mode="drop")
+                            vs_raw = vs_raw.at[pid, off].set(vsc,
+                                                             mode="drop")
+                            k_att = dequantize_pool(
+                                k_raw[pt_safe].reshape(
+                                    (pt.shape[0], virt) + k_raw.shape[2:]),
+                                ks_raw[pt_safe].reshape(pt.shape[0], virt),
+                                k._value.dtype)
+                            v_att = dequantize_pool(
+                                v_raw[pt_safe].reshape(
+                                    (pt.shape[0], virt) + v_raw.shape[2:]),
+                                vs_raw[pt_safe].reshape(pt.shape[0], virt),
+                                v._value.dtype)
+                        else:
+                            k_raw = k_raw.at[pid, off].set(
+                                k._value.astype(k_raw.dtype), mode="drop")
+                            v_raw = v_raw.at[pid, off].set(
+                                v._value.astype(v_raw.dtype), mode="drop")
+                            k_att = k_raw[pt_safe].reshape(
+                                (pt.shape[0], virt) + k_raw.shape[2:])
+                            v_att = v_raw[pt_safe].reshape(
+                                (pt.shape[0], virt) + v_raw.shape[2:])
+                        att_len = virt
                     else:
-                        k_raw = k_raw.at[rows, cols].set(
-                            k._value.astype(k_raw.dtype), mode="drop")
-                        v_raw = v_raw.at[rows, cols].set(
-                            v._value.astype(v_raw.dtype), mode="drop")
-                        k_att, v_att = k_raw, v_raw
-                    max_len = k_raw.shape[1]
-                    mask = (jnp.arange(max_len)[None, None, :] <=
+                        rows = jnp.arange(k_raw.shape[0])[:, None]
+                        cols = start[:, None] + jnp.arange(t)[None, :]
+                        if quantized:
+                            k_raw = k_raw.at[rows, cols].set(kq,
+                                                             mode="drop")
+                            v_raw = v_raw.at[rows, cols].set(vq,
+                                                             mode="drop")
+                            ks_raw = ks_raw.at[rows, cols].set(ksc,
+                                                               mode="drop")
+                            vs_raw = vs_raw.at[rows, cols].set(vsc,
+                                                               mode="drop")
+                            k_att = dequantize_pool(k_raw, ks_raw,
+                                                    k._value.dtype)
+                            v_att = dequantize_pool(v_raw, vs_raw,
+                                                    v._value.dtype)
+                        else:
+                            k_raw = k_raw.at[rows, cols].set(
+                                k._value.astype(k_raw.dtype), mode="drop")
+                            v_raw = v_raw.at[rows, cols].set(
+                                v._value.astype(v_raw.dtype), mode="drop")
+                            k_att, v_att = k_raw, v_raw
+                        att_len = k_raw.shape[1]
+                    mask = (jnp.arange(att_len)[None, None, :] <=
                             cols[:, :, None])  # [B, t, L] causal + validity
                     out = F.scaled_dot_product_attention(
                         q, _T(k_att, _internal=True),
@@ -334,14 +402,14 @@ class GPTSelfAttention(Layer):
                     out = out.reshape([b, t, nh * self.head_dim])
                     out = _constrain(out, P(_U, _U, "mp"))
                     out = self.out_proj(out)
+                    new_cache = (_T(k_raw, _internal=True),
+                                 _T(v_raw, _internal=True), start + t)
+                    if paged:
+                        new_cache = new_cache + (cache[3],)
                     if quantized:
-                        new_cache = (_T(k_raw, _internal=True),
-                                     _T(v_raw, _internal=True), start + t,
-                                     _T(ks_raw, _internal=True),
-                                     _T(vs_raw, _internal=True))
-                    else:
-                        new_cache = (_T(k_raw, _internal=True),
-                                     _T(v_raw, _internal=True), start + t)
+                        new_cache = new_cache + (
+                            _T(ks_raw, _internal=True),
+                            _T(vs_raw, _internal=True))
                     if use_cache:
                         return out, new_cache
                     return out
@@ -544,10 +612,11 @@ class GPTModel(Layer):
         if position_ids is None and use_cache and caches[0] is not None:
             # incremental decode: offset positions by the cached key length
             t = input_ids.shape[1]
-            if len(caches[0]) in (3, 5):
-                # static cache (k_buf, v_buf, length[, k_scale, v_scale]):
-                # position base may be a python int (static prefill) or a
-                # traced scalar (step); the int8 5-tuple keeps length at [2]
+            if len(caches[0]) in (3, 4, 5, 6):
+                # static cache (k_buf, v_buf, length[, page_table]
+                # [, k_scale, v_scale]): position base may be a python int
+                # (static prefill) or a traced scalar (step); every tuple
+                # form keeps length at [2]
                 import jax.numpy as jnp
 
                 from ..core.tensor import Tensor as _T

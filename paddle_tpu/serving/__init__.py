@@ -20,7 +20,11 @@ reuse across requests sharing a prompt prefix), ``speculative_k=k``
 default, ``drafter=`` seam for a draft model), ``kv_dtype="int8"``
 (quantized pools with per-row scales — 2x slots in the same HBM), and
 ``sample_on_device`` (fused on-device sampling; only token ids cross
-the host boundary per step).
+the host boundary per step).  ``Engine(paged_kv=True)`` swaps the dense
+slot rows for block-granular KV pages (docs/serving.md "Paged KV"):
+HBM scales with resident tokens, sequences grow past the compiled
+``max_len``, and prefix-cache hits share pages by reference with
+copy-on-write instead of device row copies.
 
 The HTTP traffic layer (OpenAI-compatible completions, per-tenant
 fair-share admission, telemetry-driven load shedding, multi-replica
@@ -42,13 +46,14 @@ from .engine import (  # noqa: F401
     RequestHandle,
     RequestInterruptedError,
 )
+from .paged_kv import PageAllocator  # noqa: F401
 from .prefix_cache import PrefixEntry, PrefixIndex  # noqa: F401
 from .slot_pool import SlotPool  # noqa: F401
 from .speculative import NgramDrafter  # noqa: F401
 from .supervisor import EngineSupervisor  # noqa: F401
 
 __all__ = ["Engine", "EngineSupervisor", "RequestHandle", "SlotPool",
-           "PrefixIndex", "PrefixEntry", "NgramDrafter",
+           "PageAllocator", "PrefixIndex", "PrefixEntry", "NgramDrafter",
            "QueueFullError", "DeadlineExceededError", "EngineClosedError",
            "EngineDeadError", "EngineDrainingError", "EngineStalledError",
            "RequestInterruptedError"]

@@ -79,6 +79,7 @@ from ..observability import flight, registry, span
 from ..observability import watchdog as _watchdog
 from ..observability.retrace import instrument_jit
 from ..testing import faults
+from .paged_kv import PageAllocator
 from .prefix_cache import PrefixIndex
 from .slot_pool import SlotPool
 from .speculative import NgramDrafter
@@ -105,6 +106,10 @@ SERVING_SPEC_DRAFTED = "paddle_tpu_serving_speculative_tokens_drafted_total"
 SERVING_SPEC_ACCEPTED = \
     "paddle_tpu_serving_speculative_tokens_accepted_total"
 SERVING_KV_POOL_BYTES = "paddle_tpu_serving_kv_pool_bytes"
+SERVING_KV_PAGES_FREE = "paddle_tpu_serving_kv_pages_free"
+SERVING_KV_PAGES_ACTIVE = "paddle_tpu_serving_kv_pages_active"
+SERVING_KV_PAGES_CACHED = "paddle_tpu_serving_kv_pages_cached"
+SERVING_KV_COW_COPIES = "paddle_tpu_serving_kv_page_cow_copies_total"
 
 
 class QueueFullError(RuntimeError):
@@ -196,6 +201,8 @@ class RequestHandle:
         self.slot: Optional[int] = None
         self._prefix_src = None           # PrefixEntry this request copied
         self._prefix_match = 0            # tokens covered by that copy
+        self._pages: Optional[list] = None    # paged mode: backing pages
+        self._cow = None                  # pending (src, dst) page COW copy
         now = time.perf_counter()
         self.t_submit = now
         self.t_admit: Optional[float] = None
@@ -363,6 +370,31 @@ class Engine:
             quantized with per-row scales, dequantized inside the
             attention read (half the pool bytes → 2x slots in the same
             HBM; see serving/kv_quant.py).
+        paged_kv: store K/V in fixed-size **pages** instead of dense
+            per-slot rows (docs/serving.md "Paged KV").  A host-side
+            :class:`~paddle_tpu.serving.paged_kv.PageAllocator` owns the
+            refcounted page pool; each slot carries an int32 page table
+            that is just another decode-program operand, so the decode
+            signature count stays at ONE per config.  HBM scales with
+            the tokens actually resident (admission reserves exactly the
+            pages a request can write and blocks on page exhaustion),
+            sequences may grow past ``max_len`` up to
+            ``max_pages_per_slot * page_size``, and prefix-cache hits
+            share pages by reference with copy-on-write instead of a
+            device row copy.  Greedy output is token-identical to the
+            dense pool; composable with every other flag here.
+        page_size: positions per page (default ``prefix_block``, 16 —
+            the prefix cache's hash granularity is the natural physical
+            allocation unit: block-aligned hits share only whole pages).
+        num_pages: physical pages in the pool (default
+            ``max_slots * ceil(max_len / page_size)`` — dense-equivalent
+            capacity; size it to the traffic, not the worst case, for
+            the HBM win).
+        max_pages_per_slot: page-table width per slot (default
+            ``ceil(max_len / page_size)``); sets the virtual per-slot
+            length ``max_pages_per_slot * page_size``, which may exceed
+            ``max_len`` — long-context past the dense pool's compiled
+            row length.
         sample_on_device: fuse temperature/top-k/greedy sampling into the
             decode program (per-slot params + counter-based PRNG keys);
             only ``[B(, k)]`` token ids cross the host boundary per step.
@@ -383,7 +415,11 @@ class Engine:
                  speculative_k: int = 0,
                  drafter: Optional[Callable] = None,
                  kv_dtype: Optional[str] = None,
-                 sample_on_device: bool = True):
+                 sample_on_device: bool = True,
+                 paged_kv: bool = False,
+                 page_size: Optional[int] = None,
+                 num_pages: Optional[int] = None,
+                 max_pages_per_slot: Optional[int] = None):
         self.model = model
         self.tokenizer = tokenizer
         self.max_slots = int(max_slots)
@@ -432,6 +468,36 @@ class Engine:
         self.sample_on_device = bool(sample_on_device)
         self._prefix = (PrefixIndex(block=prefix_block) if prefix_cache
                         else None)
+        # -- paged KV pool (docs/serving.md "Paged KV") ----------------------
+        self.paged_kv = bool(paged_kv)
+        if not self.paged_kv and (page_size is not None or
+                                  num_pages is not None or
+                                  max_pages_per_slot is not None):
+            raise ValueError("page_size/num_pages/max_pages_per_slot "
+                             "require paged_kv=True")
+        self._page_alloc: Optional[PageAllocator] = None
+        self._page_tables = None
+        if self.paged_kv:
+            P = int(prefix_block if page_size is None else page_size)
+            if P < 1:
+                raise ValueError(f"page_size must be >= 1, got {P}")
+            dense_pages = -(-self.max_len // P)          # ceil
+            n_pt = (dense_pages if max_pages_per_slot is None
+                    else int(max_pages_per_slot))
+            if n_pt < 1:
+                raise ValueError(
+                    f"max_pages_per_slot must be >= 1, got {n_pt}")
+            n_pages = (self.max_slots * dense_pages if num_pages is None
+                       else int(num_pages))
+            self._page_alloc = PageAllocator(n_pages, P)
+            self._max_pages_per_slot = n_pt
+            # virtual per-slot length: how far a slot's page table can
+            # address — may exceed max_len (long context), capped by the
+            # model's position-embedding table
+            virt = n_pt * P
+            self._limit = virt if limit is None else min(virt, int(limit))
+        else:
+            self._limit = self.max_len
 
         self._pool = SlotPool(self.max_slots)
         self._queue: deque = deque()
@@ -442,16 +508,28 @@ class Engine:
         self._dead: Optional[BaseException] = None
         self._last_progress = time.perf_counter()
         self._thread: Optional[threading.Thread] = None
+        self._spawning = False
         self._built = False
         self._values = None
         self._pools = None          # (kpools, vpools[, kscales, vscales])
         self._pool_bytes = 0
         n_rows = self.max_slots + 1           # + scratch row
         self._ids = np.zeros((n_rows, self._spec_width), np.int64)
-        # free / cached / scratch rows park at max_len: the decode scatter
-        # DROPS their writes (mode="drop"), so a pool row retained by the
-        # prefix cache is never clobbered by an idle slot's garbage step
-        self._lengths = np.full(n_rows, self.max_len, np.int32)
+        # free / cached / scratch rows park at the pool's addressable end
+        # (max_len, or the paged virtual length): the decode scatter DROPS
+        # their writes (mode="drop"), so K/V retained by the prefix cache
+        # is never clobbered by an idle slot's garbage step
+        self._park = (self._max_pages_per_slot * self._page_alloc.page_size
+                      if self.paged_kv else self.max_len)
+        self._lengths = np.full(n_rows, self._park, np.int32)
+        if self.paged_kv:
+            # per-slot page tables, sentinel-filled: entry num_pages is
+            # out of range, so a gather clamps it (masked read) and a
+            # scatter at it DROPS the write — unallocated virtual
+            # positions are unwritable by construction
+            self._page_tables = np.full(
+                (n_rows, self._max_pages_per_slot),
+                self._page_alloc.num_pages, np.int32)
         # per-slot sampling params + PRNG base keys, pool-resident mirrors
         # uploaded with every dispatch (device draws fold the key with the
         # row's position, so no key state ever crosses back to the host)
@@ -465,7 +543,11 @@ class Engine:
                         "interrupted": 0, "prefix_hits": 0,
                         "prefix_misses": 0, "prefix_evictions": 0,
                         "prefix_inserts": 0, "spec_drafted": 0,
-                        "spec_accepted": 0}
+                        "spec_accepted": 0, "page_cow_copies": 0,
+                        "page_alloc_stalls": 0}
+        self._active_pages = 0     # pages referenced by in-flight requests
+        self._cached_pages = 0     # pages referenced by prefix entries
+        self._page_stalled = False
         self._was_training = model.training
         model.eval()
         # interpreter exit with a live scheduler thread mid-XLA-call
@@ -505,10 +587,18 @@ class Engine:
             raise ValueError("empty prompt")
         if int(max_new_tokens) < 1:
             raise ValueError("max_new_tokens must be >= 1")
-        if ids.size + int(max_new_tokens) > self.max_len:
+        if ids.size + int(max_new_tokens) > self._limit:
+            what = ("paged limit (max_pages_per_slot * page_size, capped "
+                    "by the model's positions)" if self.paged_kv
+                    else "max_len")
             raise ValueError(
                 f"prompt ({ids.size}) + max_new_tokens ({max_new_tokens}) "
-                f"exceeds max_len={self.max_len}")
+                f"exceeds {what}={self._limit}")
+        if self.paged_kv and self._pages_for(
+                ids.size + int(max_new_tokens)) > self._page_alloc.num_pages:
+            raise ValueError(
+                f"request needs {self._pages_for(ids.size + int(max_new_tokens))} "
+                f"pages but the pool has only {self._page_alloc.num_pages}")
         eos = self.eos_token_id if eos_token_id is ... else eos_token_id
         req = RequestHandle(self, ids, max_new_tokens, eos, temperature,
                             top_k, seed, deadline_s, stream)
@@ -571,6 +661,8 @@ class Engine:
         req.slot = None
         req._prefix_src = None  # the dead engine's pool (and index) is gone
         req._prefix_match = 0
+        req._pages = None
+        req._cow = None
         req.prefix_hit = False
         req.redispatches += 1
         with self._lock:
@@ -589,15 +681,40 @@ class Engine:
         return req
 
     def start(self):
-        """Start the scheduler thread (idempotent)."""
+        """Start the scheduler thread (idempotent).  The check-and-spawn
+        runs under the engine lock: two racing callers (e.g. a gateway
+        handler submitting while a supervisor resubmits parked work)
+        must never BOTH see a missing thread and spawn two schedulers —
+        the second would dispatch against a pool the first is still
+        building."""
         if self._dead is not None:
             raise EngineDeadError(self._dead) from self._dead
         if self._stop:
             raise EngineClosedError("engine is shut down")
+        # double-checked: the common already-running path stays lock-free
+        # (submit calls start() per request); a stale read just falls
+        # through to the locked re-check.  The claim happens under the
+        # lock but Thread.start() runs OUTSIDE it — the new scheduler's
+        # first sweep takes this same lock, and making it queue behind
+        # the spawner costs the admission loop its head start.  The
+        # _spawning flag covers the claimed-but-not-yet-alive window so
+        # two racing callers can never both spawn.
         if self._thread is None or not self._thread.is_alive():
-            self._thread = threading.Thread(
-                target=self._loop, name="paddle-tpu-serving", daemon=True)
-            self._thread.start()
+            t = None
+            with self._lock:
+                if not self._spawning and (self._thread is None or
+                                           not self._thread.is_alive()):
+                    self._spawning = True
+                    t = threading.Thread(
+                        target=self._loop, name="paddle-tpu-serving",
+                        daemon=True)
+                    self._thread = t
+            if t is not None:
+                try:
+                    t.start()
+                finally:
+                    with self._lock:
+                        self._spawning = False
 
     def join(self, timeout: Optional[float] = None) -> bool:
         """Block until queue and slots are empty; False on timeout."""
@@ -661,12 +778,19 @@ class Engine:
             pending = list(self._queue) + list(self._pool.active().values())
             self._queue.clear()
             for slot in list(self._pool.active()):
-                self._pool.free(slot)
+                req = self._pool.free(slot)
+                self._release_pages_locked(req)
             if self._prefix is not None:
-                # the pool the cached rows point into is going away
-                self._prefix.drop_all()
+                # the pool the cached rows/pages point into is going away
+                for e in self._prefix.drop_all():
+                    if self.paged_kv and e.pages:
+                        for p in e.pages:
+                            self._page_alloc.deref(p)
+                        self._cached_pages -= len(e.pages)
                 for slot in list(self._pool.cached()):
                     self._pool.release_cached(slot)
+            if self.paged_kv:
+                self._page_alloc.check()     # zero leaked pages at teardown
             self._gauges_locked()
         for req in pending:
             req._finish(err)
@@ -701,7 +825,7 @@ class Engine:
         comes from O(1) counters — safe to poll per-request from a
         gateway without perturbing the scheduler."""
         with self._lock:
-            return {
+            out = {
                 "queue_depth": len(self._queue),
                 "slots_in_use": self._pool.n_active,
                 "cached_slots": self._pool.n_cached,
@@ -712,6 +836,10 @@ class Engine:
                           not self._draining),
                 "draining": self._draining,
             }
+            if self.paged_kv:
+                out["kv_pages_free"] = self._page_alloc.n_free
+                out["kv_num_pages"] = self._page_alloc.num_pages
+            return out
 
     def stats(self) -> dict:
         with self._lock:
@@ -724,6 +852,13 @@ class Engine:
             out["prefix_entries"] = (0 if self._prefix is None
                                      else len(self._prefix))
             out["kv_pool_bytes"] = self._pool_bytes
+            if self.paged_kv:
+                out["kv_num_pages"] = self._page_alloc.num_pages
+                out["kv_page_size"] = self._page_alloc.page_size
+                out["kv_pages_free"] = self._page_alloc.n_free
+                out["kv_pages_used"] = self._page_alloc.n_used
+                out["kv_pages_active"] = self._active_pages
+                out["kv_pages_cached"] = self._cached_pages
         out.update(self.compile_stats())
         return out
 
@@ -775,16 +910,38 @@ class Engine:
 
         kv = _kv_struct()
         pool_dtype = jnp.int8 if quant else None
-        kpools = [jnp.zeros((n_rows, L) + tuple(k.shape[2:]),
-                            pool_dtype or k.dtype) for k, _ in kv]
-        vpools = [jnp.zeros((n_rows, L) + tuple(v.shape[2:]),
-                            pool_dtype or v.dtype) for _, v in kv]
-        if quant:
-            kscales = [jnp.zeros((n_rows, L), jnp.float32) for _ in kv]
-            vscales = [jnp.zeros((n_rows, L), jnp.float32) for _ in kv]
-            self._pools = (kpools, vpools, kscales, vscales)
+        paged = self.paged_kv
+        if paged:
+            # block-granular pool: [num_pages, page_size, heads, head_dim]
+            # per layer — HBM holds pages, slots address them through
+            # int32 page tables (just another decode operand).  int8
+            # scales ride the page as a [page_size] f32 sidecar: one
+            # absmax per written position, so writes stay strictly
+            # incremental (nothing resident ever rescales).
+            NP_ = self._page_alloc.num_pages
+            P_ = self._page_alloc.page_size
+            n_pt = self._max_pages_per_slot
+            kpools = [jnp.zeros((NP_, P_) + tuple(k.shape[2:]),
+                                pool_dtype or k.dtype) for k, _ in kv]
+            vpools = [jnp.zeros((NP_, P_) + tuple(v.shape[2:]),
+                                pool_dtype or v.dtype) for _, v in kv]
+            if quant:
+                kscales = [jnp.zeros((NP_, P_), jnp.float32) for _ in kv]
+                vscales = [jnp.zeros((NP_, P_), jnp.float32) for _ in kv]
+                self._pools = (kpools, vpools, kscales, vscales)
+            else:
+                self._pools = (kpools, vpools)
         else:
-            self._pools = (kpools, vpools)
+            kpools = [jnp.zeros((n_rows, L) + tuple(k.shape[2:]),
+                                pool_dtype or k.dtype) for k, _ in kv]
+            vpools = [jnp.zeros((n_rows, L) + tuple(v.shape[2:]),
+                                pool_dtype or v.dtype) for _, v in kv]
+            if quant:
+                kscales = [jnp.zeros((n_rows, L), jnp.float32) for _ in kv]
+                vscales = [jnp.zeros((n_rows, L), jnp.float32) for _ in kv]
+                self._pools = (kpools, vpools, kscales, vscales)
+            else:
+                self._pools = (kpools, vpools)
         total = sum(int(np.prod(p.shape)) * p.dtype.itemsize
                     for grp in self._pools for p in grp)
         with self._lock:
@@ -794,9 +951,22 @@ class Engine:
             "device bytes of the serving KV pools (incl. int8 scales)"
         ).set(float(total))
 
-        def _caches_from(pools, lengths):
-            """Pool arrays → the models' per-slot static-cache protocol
-            (3-tuple, or the int8 5-tuple with per-row scale buffers)."""
+        def _caches_from(pools, lengths, tables=None):
+            """Pool arrays → the models' per-slot static-cache protocol:
+            3-tuple dense, 5-tuple dense-int8, or the paged 4/6-tuple
+            forms with the page-table operand at index 3."""
+            if paged:
+                if quant:
+                    kps, vps, kss, vss = pools
+                    return [(Tensor(kp, _internal=True),
+                             Tensor(vp, _internal=True), lengths, tables,
+                             Tensor(ks, _internal=True),
+                             Tensor(vs, _internal=True))
+                            for kp, vp, ks, vs in zip(kps, vps, kss, vss)]
+                kps, vps = pools
+                return [(Tensor(kp, _internal=True),
+                         Tensor(vp, _internal=True), lengths, tables)
+                        for kp, vp in zip(kps, vps)]
             if quant:
                 kps, vps, kss, vss = pools
                 return [(Tensor(kp, _internal=True),
@@ -811,10 +981,11 @@ class Engine:
 
         def _pools_from(new_caches):
             if quant:
+                si = 4 if paged else 3      # scale slots in the cache tuple
                 return ([c[0]._value for c in new_caches],
                         [c[1]._value for c in new_caches],
-                        [c[3]._value for c in new_caches],
-                        [c[4]._value for c in new_caches])
+                        [c[si]._value for c in new_caches],
+                        [c[si + 1]._value for c in new_caches])
             return ([c[0]._value for c in new_caches],
                     [c[1]._value for c in new_caches])
 
@@ -925,6 +1096,100 @@ class Engine:
                 return toks, pools
             return logits, pools
 
+        def prefill_paged(values, ids, pools, tables, prompt_lens, temps,
+                          topks, keys):
+            # paged cold prefill: the per-request caches are built inside
+            # this jit exactly as in the dense path (python-int length 0
+            # keeps the causal flash path — the prompt math is IDENTICAL,
+            # so greedy outputs match the dense pool bitwise), then every
+            # written position scatters into its slot's pages through the
+            # batch page tables.  Padding positions (and padding lanes,
+            # whose tables are all-sentinel) resolve to page id
+            # num_pages, which mode="drop" discards.
+            n, bucket = ids.shape
+            caches_t = [
+                (Tensor(jnp.zeros((n, bucket) + tuple(k.shape[2:]),
+                                  k.dtype), _internal=True),
+                 Tensor(jnp.zeros((n, bucket) + tuple(v.shape[2:]),
+                                  v.dtype), _internal=True), 0)
+                for k, v in kv]
+            with _swapped_state(model, values):
+                logits, new_caches = _fwd_last(
+                    Tensor(ids, _internal=True), caches_t,
+                    gather_idx=prompt_lens - 1)
+            pos = jnp.arange(bucket)
+            valid = pos[None, :] < prompt_lens[:, None]          # [n, bucket]
+            pslot = jnp.clip(pos // P_, 0, n_pt - 1)
+            pid = jnp.where(valid, tables[:, pslot], NP_)
+            off = jnp.broadcast_to((pos % P_)[None, :], pid.shape)
+            if quant:
+                kpools_, vpools_, kscales_, vscales_ = pools
+                kq = [quantize_rows(c[0]._value) for c in new_caches]
+                vq = [quantize_rows(c[1]._value) for c in new_caches]
+                kpools_ = [kp.at[pid, off].set(q, mode="drop")
+                           for kp, (q, _) in zip(kpools_, kq)]
+                vpools_ = [vp.at[pid, off].set(q, mode="drop")
+                           for vp, (q, _) in zip(vpools_, vq)]
+                kscales_ = [ks.at[pid, off].set(s, mode="drop")
+                            for ks, (_, s) in zip(kscales_, kq)]
+                vscales_ = [vs.at[pid, off].set(s, mode="drop")
+                            for vs, (_, s) in zip(vscales_, vq)]
+                pools = (kpools_, vpools_, kscales_, vscales_)
+            else:
+                kpools_, vpools_ = pools
+                kpools_ = [kp.at[pid, off].set(c[0]._value, mode="drop")
+                           for kp, c in zip(kpools_, new_caches)]
+                vpools_ = [vp.at[pid, off].set(c[1]._value, mode="drop")
+                           for vp, c in zip(vpools_, new_caches)]
+                pools = (kpools_, vpools_)
+            if on_device:
+                toks = _sample_rows(logits, temps, topks,
+                                    _step_keys(keys, prompt_lens - 1))
+                return toks, pools
+            return logits, pools
+
+        def decode_paged(values, ids, pools, lengths, tables, temps,
+                         topks, keys):
+            # the paged decode is the dense decode with the page tables
+            # riding along as one more int32 operand — the per-slot
+            # gather/scatter lives in the model's paged cache branch, so
+            # this stays ONE compiled program per engine config
+            caches_t = _caches_from(pools, lengths, tables)
+            with _swapped_state(model, values):
+                logits, new_caches = _fwd_all(
+                    Tensor(ids, _internal=True), caches_t)
+            pools = _pools_from(new_caches)
+            if on_device:
+                greedy = jnp.argmax(logits, axis=-1)
+                first = _sample_rows(logits[:, 0], temps, topks,
+                                     _step_keys(keys, lengths))
+                toks = greedy.at[:, 0].set(first)
+                return toks, pools
+            return logits, pools
+
+        def tail_prefill_paged(values, ids, pools, lengths, tables,
+                               gather_idx, temps, topks, keys):
+            caches_t = _caches_from(pools, lengths, tables)
+            with _swapped_state(model, values):
+                logits, new_caches = _fwd_last(
+                    Tensor(ids, _internal=True), caches_t,
+                    gather_idx=gather_idx)
+            pools = _pools_from(new_caches)
+            if on_device:
+                toks = _sample_rows(logits, temps, topks,
+                                    _step_keys(keys, lengths + gather_idx))
+                return toks, pools
+            return logits, pools
+
+        def copy_pages(pools, src, dst):
+            # copy-on-write: clone whole pages (K/V + scale sidecars)
+            # src->dst — the writer gets a private copy of a shared page,
+            # the readers' bytes are untouched.  Sentinel-padded lanes
+            # gather a clamped page and then DROP the scatter: no-ops.
+            return tuple([p.at[dst].set(p[jnp.clip(src, 0, NP_ - 1)],
+                                        mode="drop") for p in grp]
+                         for grp in pools)
+
         def decode(values, ids, pools, lengths, temps, topks, keys):
             # ONE batched step over every slot row (+ scratch): vector
             # lengths route the per-slot static-cache branch; idle rows
@@ -975,16 +1240,20 @@ class Engine:
         # donation on CPU — it only warns there)
         on_cpu = jax.default_backend() == "cpu"
         self._prefill_fn = instrument_jit(
-            jax.jit(prefill, donate_argnums=() if on_cpu else (2,)),
+            jax.jit(prefill_paged if paged else prefill,
+                    donate_argnums=() if on_cpu else (2,)),
             "serving.prefill")
         self._decode_fn = instrument_jit(
-            jax.jit(decode, donate_argnums=() if on_cpu else (2,)),
+            jax.jit(decode_paged if paged else decode,
+                    donate_argnums=() if on_cpu else (2,)),
             "serving.decode")
         self._tail_fn = instrument_jit(
-            jax.jit(tail_prefill, donate_argnums=() if on_cpu else (2,)),
+            jax.jit(tail_prefill_paged if paged else tail_prefill,
+                    donate_argnums=() if on_cpu else (2,)),
             "serving.tail_prefill")
         self._copy_fn = instrument_jit(
-            jax.jit(copy_rows, donate_argnums=() if on_cpu else (0,)),
+            jax.jit(copy_pages if paged else copy_rows,
+                    donate_argnums=() if on_cpu else (0,)),
             "serving.prefix_copy")
         with self._lock:
             self._built = True
@@ -1025,11 +1294,17 @@ class Engine:
             active = list(self._pool.active().values())
             self._queue.clear()
             for slot in list(self._pool.active()):
-                self._pool.free(slot)
+                req = self._pool.free(slot)
+                self._release_pages_locked(req)
             if self._prefix is not None:
-                # dead pool: every cached row dies with it — a rebuilt
-                # engine starts with an EMPTY index (no stale-row reuse)
-                self._prefix.drop_all()
+                # dead pool: every cached row/page dies with it — a
+                # rebuilt engine starts with an EMPTY index and a fresh
+                # allocator (no stale-row or stale-page reuse)
+                for e in self._prefix.drop_all():
+                    if self.paged_kv and e.pages:
+                        for p in e.pages:
+                            self._page_alloc.deref(p)
+                        self._cached_pages -= len(e.pages)
                 for slot in list(self._pool.cached()):
                     self._pool.release_cached(slot)
             for r in queued + active:
@@ -1150,50 +1425,177 @@ class Engine:
         return True
 
     # -- admission -----------------------------------------------------------
+    def _pages_for(self, n_tokens: int) -> int:
+        """Pages covering positions [0, n_tokens) at the pool page size."""
+        return -(-int(n_tokens) // self._page_alloc.page_size)
+
+    def _admit_dense_locked(self):
+        """Dense-pool admission: pop up to prefill_batch requests into
+        free slots, evicting unreferenced prefix rows under pressure."""
+        evicted = 0
+        want = min(self.prefill_batch, len(self._queue))
+        if self._prefix is not None and want > self._pool.n_free:
+            # reclaim cache capacity: LRU unreferenced entries go back
+            # to the free list.  Referenced rows (copy sources for
+            # in-flight requests) survive the sweep, and so do the
+            # entries the incoming wave itself is about to hit — a
+            # peek pass finds them first, otherwise a fully-cached
+            # pool would evict exactly the rows the queue wants
+            protect = set()
+            for req in itertools.islice(self._queue, want):
+                hit = self._prefix.lookup(req.prompt, peek=True)
+                if hit is not None:
+                    protect.add(id(hit[0]))
+            for e in self._prefix.evict_lru(want - self._pool.n_free,
+                                            protect=protect):
+                self._pool.release_cached(e.slot)
+                self._counts["prefix_evictions"] += 1
+                evicted += 1
+                flight.record("serving", "prefix_evict", slot=e.slot,
+                              cached_tokens=e.n)
+        n = min(self._pool.n_free, want)
+        batch = [self._queue.popleft() for _ in range(n)]
+        for req in batch:
+            req.slot = self._pool.alloc(req)
+            req._state = "active"
+            req.t_admit = time.perf_counter()
+        if self._prefix is not None:
+            for req in batch:
+                hit = self._prefix.lookup(req.prompt)
+                if hit is not None:
+                    entry, matched = hit
+                    self._prefix.acquire(entry)
+                    req._prefix_src = entry
+                    req._prefix_match = matched
+                    req.prefix_hit = True
+                    self._counts["prefix_hits"] += 1
+                else:
+                    self._counts["prefix_misses"] += 1
+        return batch, evicted
+
+    def _admit_paged_locked(self):
+        """Paged-pool admission: head-of-queue requests admit while a
+        slot lane AND their page reservation both fit.  A request
+        reserves every page it can ever write (``ceil((prompt +
+        max_new_tokens) / page_size)``, minus fully-shared prefix
+        pages), so decode can never hit mid-flight page exhaustion —
+        exhaustion is an ADMISSION condition: the request stays queued
+        (backpressure, like slot exhaustion in the dense pool) until
+        retiring work or cache eviction frees pages.  No deadlock:
+        admitted requests never wait on pages, so they always retire."""
+        alloc = self._page_alloc
+        P = alloc.page_size
+        evicted = 0
+        want = min(self.prefill_batch, len(self._queue))
+        if want == 0:
+            # stall episode over (the stalled request retired or was
+            # cancelled): the next exhaustion is a fresh flight event
+            self._page_stalled = False
+            return [], 0
+        protect = set()
+        if self._prefix is not None:
+            for req in itertools.islice(self._queue, want):
+                hit = self._prefix.lookup(req.prompt, peek=True)
+                if hit is not None:
+                    protect.add(id(hit[0]))
+        batch = []
+        while self._queue and len(batch) < want and self._pool.n_free > 0:
+            req = self._queue[0]
+            total = self._pages_for(req.prompt.size + req.max_new_tokens)
+            hit = (self._prefix.lookup(req.prompt, peek=True)
+                   if self._prefix is not None else None)
+            # fully-matched pages are shared by reference; a partial
+            # boundary page (match not page-aligned) is replaced by a
+            # one-page COW copy, so its replacement stays in `need`
+            shared_full = (hit[1] // P) if hit is not None else 0
+            need = total - shared_full
+            while (need > alloc.n_free and self._prefix is not None):
+                # reclaim pages from unreferenced LRU entries, sparing
+                # the ones this wave is about to hit
+                victims = self._prefix.evict_lru(1, protect=protect)
+                if not victims:
+                    break
+                e = victims[0]
+                for p in e.pages:
+                    alloc.deref(p)
+                self._cached_pages -= len(e.pages)
+                self._counts["prefix_evictions"] += 1
+                evicted += 1
+                flight.record("serving", "prefix_evict",
+                              pages=len(e.pages), cached_tokens=e.n)
+            pages = alloc.alloc(need)
+            if pages is None:
+                # page exhaustion: head-of-line request stays queued
+                # (FIFO fairness — no small-request overtake that would
+                # starve the head); flight-record the stall once per
+                # stall episode, not per 20 ms scheduler sweep
+                if not self._page_stalled:
+                    self._page_stalled = True
+                    self._counts["page_alloc_stalls"] += 1
+                    flight.record("serving", "page_alloc_stall",
+                                  request=req.request_id, need=need,
+                                  free=alloc.n_free,
+                                  cached_pages=self._cached_pages)
+                break
+            self._page_stalled = False
+            self._queue.popleft()
+            req.slot = self._pool.alloc(req)
+            req._state = "active"
+            req.t_admit = time.perf_counter()
+            if hit is not None:
+                entry, matched = hit
+                self._prefix.touch(entry)      # count the peeked hit
+                self._prefix.acquire(entry)
+                req._prefix_src = entry
+                req._prefix_match = matched
+                req.prefix_hit = True
+                self._counts["prefix_hits"] += 1
+            elif self._prefix is not None:
+                self._prefix.miss()
+                self._counts["prefix_misses"] += 1
+            self._map_pages_locked(req, pages)
+            batch.append(req)
+        return batch, evicted
+
+    def _map_pages_locked(self, req: RequestHandle, fresh):
+        """Fill the slot's page table: the hit entry's fully-matched
+        pages by reference (refcount++ each), then the fresh pages.
+        When the hit boundary lands inside a shared page, schedule the
+        copy-on-write clone of exactly that page into the first fresh
+        page — the writer diverges on a private copy, the cached
+        entry's bytes are untouched."""
+        alloc = self._page_alloc
+        P = alloc.page_size
+        table = self._page_tables[req.slot]
+        table[:] = alloc.num_pages
+        pages = []
+        m = req._prefix_match
+        shared_full = m // P
+        req._cow = None
+        if req._prefix_src is not None:
+            src_pages = req._prefix_src.pages
+            for i in range(shared_full):
+                alloc.share(src_pages[i])
+                table[i] = src_pages[i]
+                pages.append(src_pages[i])
+            if m % P:
+                req._cow = (src_pages[shared_full], fresh[0])
+        for j, p in enumerate(fresh):
+            table[shared_full + j] = p
+            pages.append(p)
+        req._pages = pages
+        self._active_pages += len(pages)
+
     def _admit(self) -> bool:
         import jax
 
-        prefix_metrics = None
-        evicted = 0
         with self._lock:
-            want = min(self.prefill_batch, len(self._queue))
-            if self._prefix is not None and want > self._pool.n_free:
-                # reclaim cache capacity: LRU unreferenced entries go back
-                # to the free list.  Referenced rows (copy sources for
-                # in-flight requests) survive the sweep, and so do the
-                # entries the incoming wave itself is about to hit — a
-                # peek pass finds them first, otherwise a fully-cached
-                # pool would evict exactly the rows the queue wants
-                protect = set()
-                for req in itertools.islice(self._queue, want):
-                    hit = self._prefix.lookup(req.prompt, peek=True)
-                    if hit is not None:
-                        protect.add(id(hit[0]))
-                for e in self._prefix.evict_lru(want - self._pool.n_free,
-                                                protect=protect):
-                    self._pool.release_cached(e.slot)
-                    self._counts["prefix_evictions"] += 1
-                    evicted += 1
-                    flight.record("serving", "prefix_evict", slot=e.slot,
-                                  cached_tokens=e.n)
-            n = min(self._pool.n_free, want)
-            batch = [self._queue.popleft() for _ in range(n)]
-            for req in batch:
-                req.slot = self._pool.alloc(req)
-                req._state = "active"
-                req.t_admit = time.perf_counter()
+            if self.paged_kv:
+                batch, evicted = self._admit_paged_locked()
+            else:
+                batch, evicted = self._admit_dense_locked()
+            prefix_metrics = None
             if self._prefix is not None and batch:
-                for req in batch:
-                    hit = self._prefix.lookup(req.prompt)
-                    if hit is not None:
-                        entry, matched = hit
-                        self._prefix.acquire(entry)
-                        req._prefix_src = entry
-                        req._prefix_match = matched
-                        req.prefix_hit = True
-                        self._counts["prefix_hits"] += 1
-                    else:
-                        self._counts["prefix_misses"] += 1
                 prefix_metrics = (sum(1 for r in batch if r.prefix_hit),
                                   sum(1 for r in batch if not r.prefix_hit))
             self._gauges_locked()
@@ -1244,7 +1646,7 @@ class Engine:
         admission path when the prefix cache is off)."""
         import jax.numpy as jnp
         bucket = _bucket(max(r.prompt.size for r in batch),
-                         min(8, self.max_len), self.max_len)
+                         min(8, self._limit), self._limit)
         P = self.prefill_batch
         ids = np.zeros((P, bucket), np.int64)
         slot_idx = np.full(P, self.max_slots, np.int32)
@@ -1252,6 +1654,9 @@ class Engine:
         temps = np.zeros(P, np.float32)
         topks = np.zeros(P, np.int32)
         keys = np.zeros((P, 2), np.uint32)
+        tables = (np.full((P, self._max_pages_per_slot),
+                          self._page_alloc.num_pages, np.int32)
+                  if self.paged_kv else None)
         with self._lock:
             for i, req in enumerate(batch):
                 ids[i, :req.prompt.size] = req.prompt
@@ -1260,6 +1665,8 @@ class Engine:
                 temps[i] = req.temperature
                 topks[i] = req.top_k
                 keys[i] = req._base_key
+                if tables is not None:
+                    tables[i] = self._page_tables[req.slot]
                 self._set_slot_params_locked(req)
                 flight.record("serving", "admit", request=req.request_id,
                               slot=req.slot,
@@ -1272,11 +1679,18 @@ class Engine:
             _watchdog.arm("serving.prefill", self._decode_timeout_s)
         try:
             with span("serving.prefill", n=len(batch), bucket=bucket):
-                out, self._pools = self._prefill_fn(
-                    self._values, jnp.asarray(ids), self._pools,
-                    jnp.asarray(slot_idx), jnp.asarray(plens),
-                    jnp.asarray(temps), jnp.asarray(topks),
-                    jnp.asarray(keys))
+                if self.paged_kv:
+                    out, self._pools = self._prefill_fn(
+                        self._values, jnp.asarray(ids), self._pools,
+                        jnp.asarray(tables), jnp.asarray(plens),
+                        jnp.asarray(temps), jnp.asarray(topks),
+                        jnp.asarray(keys))
+                else:
+                    out, self._pools = self._prefill_fn(
+                        self._values, jnp.asarray(ids), self._pools,
+                        jnp.asarray(slot_idx), jnp.asarray(plens),
+                        jnp.asarray(temps), jnp.asarray(topks),
+                        jnp.asarray(keys))
                 out = np.asarray(out)
         finally:
             if self._decode_timeout_s is not None:
@@ -1290,25 +1704,39 @@ class Engine:
         self._emit_first_tokens(batch, out, by_slot=False)
 
     def _prefill_hits(self, hits) -> None:
-        """Prefix-cache hit path: device-copy the cached rows into the
-        new slots, then prefill ONLY the prompt tails through the
-        per-slot branch — admission cost scales with the tail, not the
-        prompt."""
+        """Prefix-cache hit path.  Dense pool: device-copy the cached
+        rows into the new slots, then prefill ONLY the prompt tails
+        through the per-slot branch — admission cost scales with the
+        tail, not the prompt.  Paged pool: ZERO-copy — the hit already
+        shares the cached pages by reference through the page table
+        (host-side int writes); only a partial boundary page needs its
+        one-page COW clone before the tail writes into it."""
         import jax.numpy as jnp
         P = self.prefill_batch
         scratch = self.max_slots
-        src = np.full(P, scratch, np.int32)
-        dst = np.full(P, scratch, np.int32)
+        paged = self.paged_kv
+        sentinel = self._page_alloc.num_pages if paged else scratch
+        src = np.full(P, sentinel, np.int32)
+        dst = np.full(P, sentinel, np.int32)
+        n_copy = 0
         n_rows = self.max_slots + 1
         tails = [r.prompt.size - r._prefix_match for r in hits]
-        tb = _bucket(max(tails), 1, self.max_len)
+        tb = _bucket(max(tails), 1, self._limit)
         ids = np.zeros((n_rows, tb), np.int64)
-        lens = np.full(n_rows, self.max_len, np.int32)
+        lens = np.full(n_rows, self._park, np.int32)
         gidx = np.zeros(n_rows, np.int32)
+        tables = None
         with self._lock:
             for i, req in enumerate(hits):
                 e, m = req._prefix_src, req._prefix_match
-                src[i], dst[i] = e.slot, req.slot
+                if paged:
+                    if req._cow is not None:
+                        src[n_copy], dst[n_copy] = req._cow
+                        n_copy += 1
+                        req._cow = None
+                else:
+                    src[i], dst[i] = e.slot, req.slot
+                    n_copy += 1
                 tail = req.prompt[m:]
                 ids[req.slot, :tail.size] = tail
                 lens[req.slot] = m
@@ -1316,24 +1744,45 @@ class Engine:
                 self._set_slot_params_locked(req)
                 flight.record("serving", "prefix_admit",
                               request=req.request_id, slot=req.slot,
-                              src_slot=e.slot, cached_tokens=m,
-                              tail=int(tail.size),
+                              src_slot=-1 if e.slot is None else e.slot,
+                              cached_tokens=m, tail=int(tail.size),
                               queue_wait_ms=round(
                                   1e3 * (req.t_admit - req.t_submit), 3))
+            if paged:
+                tables = np.array(self._page_tables)
         t0 = time.perf_counter()
         faults.fault_point("serving.prefill", n=len(hits))
         if self._decode_timeout_s is not None:
             _watchdog.arm("serving.tail_prefill", self._decode_timeout_s)
         try:
-            with span("serving.prefix_copy", n=len(hits)):
-                self._pools = self._copy_fn(self._pools, jnp.asarray(src),
-                                            jnp.asarray(dst))
+            if n_copy or not paged:
+                # dense: whole-row clone per hit; paged: only the COW'd
+                # boundary pages (usually zero — block == page size makes
+                # every shared page a full page)
+                with span("serving.prefix_copy", n=n_copy):
+                    self._pools = self._copy_fn(
+                        self._pools, jnp.asarray(src), jnp.asarray(dst))
+                if paged and n_copy:
+                    with self._lock:
+                        self._counts["page_cow_copies"] += n_copy
+                    registry().counter(
+                        SERVING_KV_COW_COPIES,
+                        "shared KV pages cloned for a diverging writer"
+                    ).inc(float(n_copy))
+                    flight.record("serving", "page_cow", copies=n_copy)
             with span("serving.tail_prefill", n=len(hits), bucket=tb):
-                out, self._pools = self._tail_fn(
-                    self._values, jnp.asarray(ids), self._pools,
-                    jnp.asarray(lens), jnp.asarray(gidx),
-                    jnp.asarray(self._temps), jnp.asarray(self._topks),
-                    jnp.asarray(self._keys))
+                if paged:
+                    out, self._pools = self._tail_fn(
+                        self._values, jnp.asarray(ids), self._pools,
+                        jnp.asarray(lens), jnp.asarray(tables),
+                        jnp.asarray(gidx), jnp.asarray(self._temps),
+                        jnp.asarray(self._topks), jnp.asarray(self._keys))
+                else:
+                    out, self._pools = self._tail_fn(
+                        self._values, jnp.asarray(ids), self._pools,
+                        jnp.asarray(lens), jnp.asarray(gidx),
+                        jnp.asarray(self._temps), jnp.asarray(self._topks),
+                        jnp.asarray(self._keys))
                 out = np.asarray(out)
         finally:
             if self._decode_timeout_s is not None:
@@ -1408,6 +1857,8 @@ class Engine:
             temps = np.array(self._temps)
             topks = np.array(self._topks)
             keys = np.array(self._keys)
+            tables = (np.array(self._page_tables) if self.paged_kv
+                      else None)
         import jax.numpy as jnp
         t0 = time.perf_counter()
         faults.fault_point("serving.decode", active=len(active))
@@ -1415,10 +1866,17 @@ class Engine:
             _watchdog.arm("serving.decode", self._decode_timeout_s)
         try:
             with span("serving.decode", active=len(active)):
-                out, self._pools = self._decode_fn(
-                    self._values, jnp.asarray(ids), self._pools,
-                    jnp.asarray(lengths), jnp.asarray(temps),
-                    jnp.asarray(topks), jnp.asarray(keys))
+                if self.paged_kv:
+                    out, self._pools = self._decode_fn(
+                        self._values, jnp.asarray(ids), self._pools,
+                        jnp.asarray(lengths), jnp.asarray(tables),
+                        jnp.asarray(temps), jnp.asarray(topks),
+                        jnp.asarray(keys))
+                else:
+                    out, self._pools = self._decode_fn(
+                        self._values, jnp.asarray(ids), self._pools,
+                        jnp.asarray(lengths), jnp.asarray(temps),
+                        jnp.asarray(topks), jnp.asarray(keys))
                 out = np.asarray(out)
         finally:
             if self._decode_timeout_s is not None:
@@ -1517,6 +1975,19 @@ class Engine:
                  token == req.eos_token_id))
 
     # -- eviction / retention ------------------------------------------------
+    def _release_pages_locked(self, req: RequestHandle):
+        """Drop the request's page references (freed at refcount 0) and
+        sentinel its table row.  No-op outside paged mode."""
+        if not self.paged_kv or req._pages is None:
+            return
+        for p in req._pages:
+            self._page_alloc.deref(p)
+        self._active_pages -= len(req._pages)
+        req._pages = None
+        req._cow = None
+        if req.slot is not None:
+            self._page_tables[req.slot, :] = self._page_alloc.num_pages
+
     def _evict_locked(self, req: RequestHandle, outcome: str):
         slot = req.slot
         if req._prefix_src is not None:
@@ -1524,23 +1995,48 @@ class Engine:
             req._prefix_src = None
         retained = False
         if self._prefix is not None and outcome == "completed":
-            # the slot row holds the K/V of prompt + generated[:-1]
-            # (exactly `lengths[slot]` rows) — retain it as a reusable
+            # the slot holds the K/V of prompt + generated[:-1] (exactly
+            # `lengths[slot]` positions) — retain it as a reusable
             # prefix instead of recycling it; duplicates free normally
             n = int(self._lengths[slot])
             cached = np.concatenate(
                 [req.prompt, np.asarray(req._tokens, np.int64)])[:n]
-            entry = self._prefix.insert(slot, cached) if n > 0 else None
-            if entry is not None:
-                self._pool.retain(slot, entry)
-                self._counts["prefix_inserts"] += 1
-                flight.record("serving", "prefix_insert", slot=slot,
-                              cached_tokens=n)
-                retained = True
-        if not retained:
+            if self.paged_kv:
+                # the ENTRY takes ownership of the pages covering the
+                # cached tokens (refcounts transfer, no device work);
+                # the unused tail of the reservation is released.  The
+                # slot LANE is always recycled — cached prefixes hold
+                # pages, never decode capacity.
+                keep = self._pages_for(n) if n > 0 else 0
+                entry = (self._prefix.insert(
+                    None, cached, pages=req._pages[:keep])
+                    if keep > 0 else None)
+                if entry is not None:
+                    for p in req._pages[keep:]:
+                        self._page_alloc.deref(p)
+                    self._active_pages -= len(req._pages)
+                    self._cached_pages += keep
+                    req._pages = None
+                    self._counts["prefix_inserts"] += 1
+                    flight.record("serving", "prefix_insert", pages=keep,
+                                  cached_tokens=n)
+                    retained = True
+            else:
+                entry = self._prefix.insert(slot, cached) if n > 0 else None
+                if entry is not None:
+                    self._pool.retain(slot, entry)
+                    self._counts["prefix_inserts"] += 1
+                    flight.record("serving", "prefix_insert", slot=slot,
+                                  cached_tokens=n)
+                    retained = True
+        if self.paged_kv:
+            self._release_pages_locked(req)
+            self._page_tables[slot, :] = self._page_alloc.num_pages
+            self._pool.free(slot)
+        elif not retained:
             self._pool.free(slot)
         # park the row: idle (and cached) rows' pool writes must DROP
-        self._lengths[slot] = self.max_len
+        self._lengths[slot] = self._park
         self._evicted_counters_locked(req, outcome)
 
     def _evicted_counters_locked(self, req: RequestHandle, outcome: str):
@@ -1559,3 +2055,15 @@ class Engine:
             float(self._pool.n_active))
         reg.gauge(SERVING_QUEUE_DEPTH, "queued, unadmitted requests").set(
             float(len(self._queue)))
+        if self.paged_kv:
+            reg.gauge(SERVING_KV_PAGES_FREE,
+                      "KV pages on the free list").set(
+                float(self._page_alloc.n_free))
+            reg.gauge(SERVING_KV_PAGES_ACTIVE,
+                      "KV pages referenced by in-flight requests "
+                      "(shared pages count once per reference)").set(
+                float(self._active_pages))
+            reg.gauge(SERVING_KV_PAGES_CACHED,
+                      "KV pages referenced by prefix-cache entries "
+                      "(shared pages count once per reference)").set(
+                float(self._cached_pages))

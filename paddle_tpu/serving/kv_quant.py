@@ -14,6 +14,16 @@ absmax at write time, so nothing already resident ever needs rescaling
 and the pool update stays a pure scatter — the same one-compiled-program
 decode shape as the unquantized path.
 
+The PAGED pool (``Engine(paged_kv=True, kv_dtype="int8")``) keeps the
+identical per-position granularity in a page-shaped layout: scales ride
+each page as a ``[page_size]`` float32 sidecar (``[num_pages,
+page_size]`` buffers per layer per K/V), written by the same scatter
+that writes the int8 page — so sharing a page by reference (prefix COW)
+shares its scales with it, and the quantized paged pool's values are
+bitwise identical to the quantized dense pool's.  Both layouts flow
+through the same two helpers below; they are shape-agnostic over the
+leading dims.
+
 Error model: symmetric absmax int8 keeps the worst-case per-element
 error at ``absmax/254`` (~0.4% of the row's dynamic range); the serving
 tests gate generate() parity on the tiny model and bench reports the

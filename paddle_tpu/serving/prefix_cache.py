@@ -46,16 +46,23 @@ __all__ = ["PrefixEntry", "PrefixIndex"]
 
 
 class PrefixEntry:
-    """One resident KV row: ``slot`` caches the K/V of ``tokens``."""
+    """One resident cached prefix.  Dense pool: ``slot`` is the pool row
+    caching the K/V of ``tokens`` (``pages`` is None).  Paged pool
+    (``Engine(paged_kv=True)``): ``pages`` is the ordered physical page
+    list backing those tokens and ``slot`` is None — a cached prefix
+    holds pages, not a slot lane, so caching never costs decode
+    capacity and a hit shares the pages by reference (COW)."""
 
-    __slots__ = ("slot", "tokens", "refs", "tick", "keys")
+    __slots__ = ("slot", "tokens", "refs", "tick", "keys", "pages")
 
-    def __init__(self, slot: int, tokens: Tuple[int, ...], tick: int):
+    def __init__(self, slot: Optional[int], tokens: Tuple[int, ...],
+                 tick: int, pages: Optional[List[int]] = None):
         self.slot = slot
         self.tokens = tokens
         self.refs = 0
         self.tick = tick          # LRU clock: touched on insert and hit
         self.keys: List[Tuple[int, ...]] = []   # registered prefix keys
+        self.pages = pages        # paged mode: physical pages, in order
 
     @property
     def n(self) -> int:
@@ -124,18 +131,21 @@ class PrefixIndex:
             self.misses += 1
         return None
 
-    def insert(self, slot: int, tokens) -> Optional[PrefixEntry]:
-        """Retain ``slot`` as the resident row for ``tokens``, registering
-        it under every block-boundary prefix.  Returns the new entry, or
-        None when nothing would become addressable (duplicate content,
-        or shorter than one block) — the caller then frees the slot
-        normally instead of retaining a useless row."""
+    def insert(self, slot: Optional[int], tokens,
+               pages: Optional[List[int]] = None) -> Optional[PrefixEntry]:
+        """Retain ``slot`` (dense) or ``pages`` (paged) as the resident
+        K/V for ``tokens``, registering it under every block-boundary
+        prefix.  Returns the new entry, or None when nothing would
+        become addressable (duplicate content, or shorter than one
+        block) — the caller then frees the slot/pages normally instead
+        of retaining a useless row."""
         key = tuple(int(t) for t in tokens)
         if len(key) < self.block or key in self._entries:
             return None
-        entry = PrefixEntry(slot, key, next(self._clock))
+        entry = PrefixEntry(slot, key, next(self._clock), pages=pages)
         self._entries[key] = entry
-        self._by_slot[slot] = entry
+        if slot is not None:
+            self._by_slot[slot] = entry
         for m in self._boundaries(len(key)):
             pk = key[:m]
             # newest entry wins a shared prefix key: recency is the
@@ -143,6 +153,17 @@ class PrefixIndex:
             self._by_prefix[pk] = entry
             entry.keys.append(pk)
         return entry
+
+    def touch(self, entry: PrefixEntry):
+        """Count a hit that was resolved earlier via ``lookup(peek=True)``
+        under the same lock hold (the paged admission loop peeks first to
+        size the page reservation, then commits)."""
+        entry.tick = next(self._clock)
+        self.hits += 1
+
+    def miss(self):
+        """Count a miss resolved via a peek (see :meth:`touch`)."""
+        self.misses += 1
 
     def acquire(self, entry: PrefixEntry):
         entry.refs += 1
@@ -153,7 +174,8 @@ class PrefixIndex:
 
     def _unlink(self, entry: PrefixEntry):
         del self._entries[entry.tokens]
-        del self._by_slot[entry.slot]
+        if entry.slot is not None:
+            del self._by_slot[entry.slot]
         for pk in entry.keys:
             if self._by_prefix.get(pk) is entry:
                 del self._by_prefix[pk]

@@ -15,6 +15,13 @@ recycled immediately — see prefix_cache.PrefixIndex).  Cached slots are
 invisible to ``n_active`` (an engine with only cached rows is idle) and
 return to the free list through ``release_cached`` when the index evicts
 them.
+
+Under the paged pool (``Engine(paged_kv=True)``) this class still owns
+the decode LANES (the batch rows of the single compiled decode
+program), but the K/V bytes behind a lane are tracked by the sibling
+:class:`~paddle_tpu.serving.paged_kv.PageAllocator` — cached prefixes
+then hold pages instead of slots, so the ``cached`` state stays empty
+and caching never costs decode capacity.
 """
 from __future__ import annotations
 

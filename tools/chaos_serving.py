@@ -139,17 +139,26 @@ def main() -> int:
         m.eval()
         models.append(m)
 
-    # decode fast path ON under chaos (ISSUE 10): every rebuild must
-    # drop the prefix cache cleanly (fresh pool + fresh index — no
-    # stale-row reuse) and keep speculative greedy exact, which the
-    # token-count invariant below catches (a stale or replayed prefix
-    # would change the emitted tokens)
+    # decode fast path ON under chaos (ISSUE 10) and the PAGED pool under
+    # it (ISSUE 11): every rebuild must drop the prefix cache AND the
+    # page tables cleanly (fresh pool, fresh index, fresh allocator — no
+    # stale-row or stale-page reuse) and keep speculative greedy exact,
+    # which the token-count invariant below catches (a stale, replayed
+    # or mis-mapped page would change the emitted tokens)
+    engines_built: list = []
+
+    def _factory(mm):
+        def build():
+            e = Engine(mm, max_slots=SLOTS, max_len=48, max_queue=16,
+                       prefix_cache=True, prefix_block=4, speculative_k=3,
+                       paged_kv=True)
+            engines_built.append(e)
+            return e
+        return build
+
     sups = [EngineSupervisor(
-        (lambda mm: lambda: Engine(mm, max_slots=SLOTS, max_len=48,
-                                   max_queue=16, prefix_cache=True,
-                                   prefix_block=4, speculative_k=3))(m),
-        name=f"engine{i}", poll_interval_s=0.02, max_restarts=6,
-        max_redispatch=3)
+        _factory(m), name=f"engine{i}", poll_interval_s=0.02,
+        max_restarts=6, max_redispatch=3)
         for i, m in enumerate(models)]
     tenants = [TenantConfig("vip", priority="interactive", weight=4.0,
                             max_queue=32),
@@ -241,6 +250,11 @@ def main() -> int:
             st = s.stats()
             assert st["prefix_hits"] + st["prefix_misses"] >= \
                 st["prefix_inserts"], st
+            # paged pool live under chaos: the current build's allocator
+            # is internally consistent and conserves pages (a leak shows
+            # up as used pages no active request or cache entry holds)
+            assert st["kv_pages_free"] + st["kv_pages_used"] == \
+                st["kv_num_pages"], st
 
         # telemetry through the wire
         conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
@@ -267,6 +281,16 @@ def main() -> int:
         faults.reset()
         drained = stack.drain(deadline_s=60.0)
     assert drained, "final drain dropped work"
+    # zero leaked pages: every build of every supervisor — the killed
+    # ones (unwound by the death path) and the final drained ones —
+    # ends with an internally-consistent allocator and no page still
+    # referenced (shutdown/death deref every request and cache entry)
+    for e in engines_built:
+        e.shutdown()
+        e._page_alloc.check()
+        assert e._page_alloc.n_used == 0, \
+            f"leaked pages: {e._page_alloc!r}"
+    summary["engine_builds_checked"] = len(engines_built)
     summary["drained"] = True
     print(json.dumps(summary))
     return 0

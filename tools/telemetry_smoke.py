@@ -35,6 +35,7 @@ DEFAULT_SUBSET = [
     "tests/test_distributed.py",
     "tests/test_serving.py",
     "tests/test_decode_fastpath.py",
+    "tests/test_paged_kv.py",
     "tests/test_gateway.py",
     "tests/test_self_healing.py",
     "tests/test_robustness.py",
