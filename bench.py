@@ -743,6 +743,7 @@ def bench_serving():
                 "token_s": float(np.percentile(toks, 50))}
     fast_path_block = _bench_fast_path(model, cfg, on_tpu)
     paged_block = _bench_paged_kv(model, cfg, on_tpu)
+    multi_lora_block = _bench_multi_lora(model, cfg, on_tpu)
     gateway_block = _bench_gateway_curve(cfg, on_tpu, measured)
     tok_p50 = float(np.percentile(toks, 50))
     noise = round(100 * (float(np.percentile(toks, 90)) -
@@ -773,6 +774,7 @@ def bench_serving():
                      "p99": round(float(np.percentile(toks, 99)) * 1e3, 3)},
         "fast_path": fast_path_block,
         "paged_kv": paged_block,
+        "multi_lora": multi_lora_block,
         "gateway": gateway_block,
     }
 
@@ -923,6 +925,117 @@ def _bench_fast_path(model, cfg, on_tpu):
           f"match={int8_block['token_match_vs_float']}", file=sys.stderr)
     return {"prefix_cache": prefix_block_out, "speculative": spec_block,
             "kv_int8": int8_block}
+
+
+def _bench_multi_lora(model, cfg, on_tpu):
+    """Multi-LoRA block (ISSUE 12): many-adapter mixed traffic with a
+    hot/cold skew through one engine, all CPU-gateable.
+
+    * a registry holding MORE adapters than the resident bank, with 70%
+      of traffic on two hot adapters — cold adapters churn through
+      admission-time loads + LRU eviction while the hot ones stay
+      resident; reports tokens/s, the resident-bank hit rate, and the
+      p50 cold-adapter admit stall (bank upload wall time);
+    * ``weight_int8`` — the SAME mixed traffic on
+      ``Engine(weight_dtype="int8")``: stored weight bytes ratio vs f32
+      and a token-match gate (>= 0.9) against the f32 outputs;
+    * decode stays at ONE compiled signature in both configs.
+    """
+    from paddle_tpu.serving import AdapterRegistry, Engine, make_lora
+
+    if on_tpu:
+        slots, max_len, new, n_req = 8, 640, 32, 24
+        n_adapters, resident, rank = 8, 4, 8
+    else:
+        slots, max_len, new, n_req = 4, 64, 8, 16
+        n_adapters, resident, rank = 6, 3, 4
+
+    reg = AdapterRegistry(model, max_resident=resident, max_rank=rank)
+    names = [f"lora{i}" for i in range(n_adapters)]
+    for i, nm in enumerate(names):
+        reg.register(make_lora(cfg, rank=rank, seed=100 + i, name=nm,
+                               std=0.1))
+    rs = np.random.RandomState(21)
+    prompts = [rs.randint(0, cfg.vocab_size, 8).astype(np.int64)
+               for _ in range(n_req)]
+    # hot/cold skew: most traffic on two hot adapters, the rest rotates
+    # through a cold tail wider than the bank (forces load + eviction)
+    picks = [names[i % 2] if rs.rand() < 0.7
+             else names[2 + i % (n_adapters - 2)] for i in range(n_req)]
+
+    def run(engine):
+        engine.submit(prompts[0], max_new_tokens=2).result(
+            timeout=600)                       # warm the compiles
+        t0 = time.perf_counter()
+        handles = [engine.submit(p, max_new_tokens=new, adapter=nm)
+                   for p, nm in zip(prompts, picks)]
+        outs = [h.result(timeout=600) for h in handles]
+        return outs, time.perf_counter() - t0
+
+    eng = Engine(model, max_slots=slots, max_len=max_len,
+                 max_queue=2 * n_req, adapters=reg)
+    outs, wall = run(eng)
+    st = eng.stats()
+    load_ms = [t * 1e3 for t in eng._adapter_load_times]
+    f32_bytes = eng.weight_bytes()
+    eng.shutdown()
+    if st["decode_compiles"] != 1:
+        raise RuntimeError(f"multi_lora: adapters retraced decode: {st}")
+    if st["adapter_evictions"] <= 0 or st["adapter_loads"] <= resident:
+        raise RuntimeError(
+            f"multi_lora: no cold-adapter churn on a {n_adapters}-adapter "
+            f"mix over a {resident}-row bank: {st}")
+    hits, loads = st["adapter_hits"], st["adapter_loads"]
+    tokens = sum(len(o) for o in outs)
+
+    # -- int8 base weights on the same mixed traffic ---------------------
+    q = Engine(model, max_slots=slots, max_len=max_len,
+               max_queue=2 * n_req, adapters=reg, weight_dtype="int8")
+    qouts, _ = run(q)
+    q_st = q.stats()
+    q_bytes = q.weight_bytes()
+    q.shutdown()
+    if q_st["decode_compiles"] != 1:
+        raise RuntimeError(
+            f"multi_lora: int8 weights retraced decode: {q_st}")
+    ratio = q_bytes / max(f32_bytes, 1)
+    if ratio >= 0.5:
+        raise RuntimeError(
+            f"multi_lora: int8 weights did not halve the stored bytes "
+            f"({q_bytes}B vs {f32_bytes}B)")
+    match = float(np.mean([np.mean(b == g) for b, g in zip(outs, qouts)]))
+    if match < 0.9:
+        raise RuntimeError(
+            f"multi_lora: int8 weights token match {match:.3f} < 0.9")
+
+    block = {
+        "requests": n_req,
+        "adapters": n_adapters,
+        "resident_bank": resident,
+        "rank": rank,
+        "tokens_per_sec": round(tokens / wall, 1),
+        "resident_hit_rate": round(hits / max(hits + loads, 1), 3),
+        "cold_loads": int(loads),
+        "evictions": int(st["adapter_evictions"]),
+        "load_stalls": int(st["adapter_load_stalls"]),
+        "cold_admit_stall_ms_p50": round(
+            float(np.percentile(load_ms, 50)), 2) if load_ms else 0.0,
+        "decode_compiles": int(st["decode_compiles"]),
+        "weight_int8": {
+            "weight_bytes": int(q_bytes),
+            "baseline_weight_bytes_f32": int(f32_bytes),
+            "bytes_ratio": round(ratio, 3),
+            "token_match_vs_f32": round(match, 3),
+            "decode_compiles": int(q_st["decode_compiles"]),
+        },
+    }
+    print(f"# multi_lora adapters={n_adapters}/bank={resident} "
+          f"hit_rate={block['resident_hit_rate']} "
+          f"cold stall p50={block['cold_admit_stall_ms_p50']}ms "
+          f"int8 weights ratio={block['weight_int8']['bytes_ratio']} "
+          f"match={block['weight_int8']['token_match_vs_f32']}",
+          file=sys.stderr)
+    return block
 
 
 def _bench_paged_kv(model, cfg, on_tpu):

@@ -240,6 +240,19 @@ class GPTSelfAttention(Layer):
                 self.qkv_proj.bias, eps=pre_norm._epsilon)
         else:
             qkv = self.qkv_proj(x)  # [B, T, 3H/mp-sharded]
+        if use_cache or cache is not None:
+            # batched multi-LoRA serving path (serving/adapters): when the
+            # engine's jit entered an adapter scope, add the per-row
+            # low-rank delta gathered by each row's adapter_id from the
+            # stacked banks — fixed-shape operands, so the decode program
+            # keeps its ONE compiled signature; rows at id 0 gather the
+            # zero adapter (delta exactly 0.0: base rows stay exact)
+            from ..serving.adapters.lora import active as _lora_active
+            _scope = _lora_active()
+            if _scope is not None:
+                from ..core.tensor import Tensor as _T
+                xv = x._value if isinstance(x, Tensor) else x
+                qkv = _T(qkv._value + _scope.delta_qkv(xv), _internal=True)
         # under explicit shard_map (pipeline stage bodies) the mp axis is
         # bound and qkv is the LOCAL column shard: reshape over local heads
         nh = self.num_heads
@@ -640,8 +653,16 @@ class GPTModel(Layer):
                 self.config.moe_num_experts == 0:
             x = self._scan_layers(x)
         else:
+            _scope = None
+            if use_cache:
+                # advance the batched-adapter scope's layer cursor as the
+                # stack walks (each layer gathers ITS bank slice)
+                from ..serving.adapters.lora import active as _lora_active
+                _scope = _lora_active()
             for i, layer in enumerate(self.layers):
                 if use_cache:
+                    if _scope is not None:
+                        _scope.layer = i
                     x, c = layer(x, cache=caches[i], use_cache=True)
                     new_caches.append(c)
                 elif self.config.use_recompute and self.training and \

@@ -35,6 +35,15 @@ routing) lives in :mod:`paddle_tpu.serving.gateway`::
 
 See docs/serving.md for the architecture, tuning and telemetry fields.
 """
+from .adapters import (  # noqa: F401
+    AdapterError,
+    AdapterRankError,
+    AdapterRegistry,
+    AdapterShapeError,
+    LoraAdapter,
+    UnknownAdapterError,
+    make_lora,
+)
 from .engine import (  # noqa: F401
     DeadlineExceededError,
     Engine,
@@ -54,6 +63,8 @@ from .supervisor import EngineSupervisor  # noqa: F401
 
 __all__ = ["Engine", "EngineSupervisor", "RequestHandle", "SlotPool",
            "PageAllocator", "PrefixIndex", "PrefixEntry", "NgramDrafter",
+           "AdapterRegistry", "LoraAdapter", "make_lora", "AdapterError",
+           "AdapterShapeError", "AdapterRankError", "UnknownAdapterError",
            "QueueFullError", "DeadlineExceededError", "EngineClosedError",
            "EngineDeadError", "EngineDrainingError", "EngineStalledError",
            "RequestInterruptedError"]

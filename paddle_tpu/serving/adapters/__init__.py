@@ -1,0 +1,38 @@
+"""paddle_tpu.serving.adapters — multi-LoRA adapter serving.
+
+ONE engine, many fine-tuned variants of the same base model
+(docs/serving.md "Multi-LoRA serving")::
+
+    from paddle_tpu.serving import Engine
+    from paddle_tpu.serving.adapters import AdapterRegistry, make_lora
+
+    reg = AdapterRegistry(model, max_resident=4, max_rank=8)
+    reg.register(make_lora(cfg, rank=4, seed=1, name="tenant-a"))
+    engine = Engine(model, adapters=reg)
+    engine.submit(prompt, adapter="tenant-a")     # LoRA-decoded
+    engine.submit(prompt)                         # base model (id 0)
+
+Per-slot ``adapter_id``s ride the single compiled decode program as one
+more int32 operand; resident adapters live in stacked device banks
+(:mod:`lora`), HBM residency is refcount+LRU (:mod:`registry`), and the
+serving weight operands themselves can go int8
+(``Engine(weight_dtype="int8")``, :mod:`weight_quant`).
+"""
+from .lora import (  # noqa: F401
+    LoraAdapter,
+    adapter_scope,
+    make_lora,
+    merge_into_qkv,
+)
+from .registry import (  # noqa: F401
+    AdapterError,
+    AdapterRankError,
+    AdapterRegistry,
+    AdapterResidency,
+    AdapterShapeError,
+    UnknownAdapterError,
+)
+
+__all__ = ["LoraAdapter", "make_lora", "merge_into_qkv", "adapter_scope",
+           "AdapterRegistry", "AdapterResidency", "AdapterError",
+           "AdapterShapeError", "AdapterRankError", "UnknownAdapterError"]

@@ -1,0 +1,174 @@
+"""Batched LoRA adapters for the serving path (host weights + trace scope).
+
+One engine, many fine-tuned variants: a :class:`LoraAdapter` holds the
+low-rank update of each layer's fused-QKV projection (``W + scale * A @ B``
+with ``A [h, r]``, ``B [r, 3h]`` per layer — the classic LoRA target,
+phrased over this model's head-major fused column layout), and the engine
+stacks every RESIDENT adapter into fixed-shape device **banks**::
+
+    a_bank [max_resident + 1, num_layers, h, r_max]
+    b_bank [max_resident + 1, num_layers, r_max, 3h]
+    scales [max_resident + 1]
+
+Bank row 0 is the reserved **zero adapter**: all-zero factors at scale 0,
+so a base-model request (``adapter_id = 0``) adds an exactly-zero delta
+and its logits match the adapter-free engine bitwise (up to the sign of
+zero) — base rows and adapter rows batch in the SAME decode program.
+
+The model side is a trace-local scope: the engine's jitted prefill /
+tail-prefill / decode functions enter :func:`adapter_scope` with the
+per-row ``adapter_ids`` and the banks as traced operands, and
+``GPTSelfAttention`` adds the gathered per-row delta to its fused QKV
+projection::
+
+    a = a_bank[ids, layer]                    # [B, h, r]  (one gather)
+    b = b_bank[ids, layer]                    # [B, r, 3h]
+    qkv += (x @ a @ b) * scales[ids]          # [B, T, 3h]
+
+Everything is a fixed-shape operand — adapter traffic never changes the
+compiled signature, and rank-``r`` math costs ``O(r * h)`` per token next
+to the base matmul's ``O(3 h^2)`` (r << h).  Smaller-rank adapters are
+zero-padded to ``r_max`` (padding columns multiply to exact zeros).
+
+Host-side weights, validation and HBM residency live in
+:mod:`~paddle_tpu.serving.adapters.registry`.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+__all__ = ["LoraAdapter", "make_lora", "merge_into_qkv", "adapter_scope",
+           "active"]
+
+
+class LoraAdapter:
+    """Host-side LoRA factors for every decoder layer's fused-QKV
+    projection.
+
+    Args:
+        name: registry key (and the gateway's ``model=`` value).
+        a: per-layer down-projections, each ``[hidden, rank]`` float32.
+        b: per-layer up-projections, each ``[rank, 3 * hidden]`` float32.
+        scale: the merged update is ``W + scale * A @ B`` (conventionally
+            ``alpha / rank``).
+    """
+
+    __slots__ = ("name", "a", "b", "scale", "rank")
+
+    def __init__(self, name: str, a: List[np.ndarray], b: List[np.ndarray],
+                 scale: float = 1.0):
+        if not a or len(a) != len(b):
+            raise ValueError(
+                f"adapter {name!r}: need matching per-layer A/B lists, "
+                f"got {len(a)} A / {len(b)} B")
+        self.name = str(name)
+        self.a = [np.asarray(m, np.float32) for m in a]
+        self.b = [np.asarray(m, np.float32) for m in b]
+        self.scale = float(scale)
+        ranks = {m.shape[-1] for m in self.a} | {m.shape[0] for m in self.b}
+        if len(ranks) != 1:
+            raise ValueError(
+                f"adapter {name!r}: inconsistent ranks across layers/"
+                f"factors: {sorted(ranks)}")
+        self.rank = int(next(iter(ranks)))
+        for i, (ma, mb) in enumerate(zip(self.a, self.b)):
+            if ma.ndim != 2 or mb.ndim != 2 or ma.shape[1] != mb.shape[0]:
+                raise ValueError(
+                    f"adapter {name!r} layer {i}: A {ma.shape} / B "
+                    f"{mb.shape} do not compose")
+
+    @property
+    def num_layers(self) -> int:
+        return len(self.a)
+
+    def __repr__(self):
+        return (f"LoraAdapter(name={self.name!r}, rank={self.rank}, "
+                f"layers={self.num_layers}, scale={self.scale})")
+
+
+def make_lora(config, rank: int, seed: int = 0, scale: Optional[float] = None,
+              name: str = "lora", std: float = 0.02) -> LoraAdapter:
+    """Random LoRA factors shaped for ``config`` (tests/bench; real
+    adapters come from fine-tuning).  Both factors are non-zero so the
+    adapter visibly changes outputs; ``scale`` defaults to ``1 / rank``."""
+    rs = np.random.RandomState(seed)
+    h = config.hidden_size
+    a = [rs.normal(0.0, std, (h, rank)).astype(np.float32)
+         for _ in range(config.num_layers)]
+    b = [rs.normal(0.0, std, (rank, 3 * h)).astype(np.float32)
+         for _ in range(config.num_layers)]
+    return LoraAdapter(name, a, b,
+                       scale=(1.0 / rank) if scale is None else scale)
+
+
+def merge_into_qkv(model, adapter: LoraAdapter):
+    """Fold ``scale * A @ B`` into each layer's fused-QKV weight IN PLACE
+    (the offline merged-weights reference the per-adapter parity tests
+    compare the batched path against).  Merge into a throwaway model
+    instance — there is no unmerge."""
+    import jax.numpy as jnp
+
+    gpt = getattr(model, "gpt", model)
+    layers = gpt.layers
+    if len(layers) != adapter.num_layers:
+        raise ValueError(
+            f"adapter {adapter.name!r} has {adapter.num_layers} layers, "
+            f"model has {len(layers)}")
+    for i, layer in enumerate(layers):
+        w = layer.self_attn.qkv_proj.weight
+        delta = adapter.scale * (adapter.a[i] @ adapter.b[i])
+        w._value = w._value + jnp.asarray(delta, w._value.dtype)
+
+
+# -- trace-local adapter scope (the engine's jits enter it) -------------------
+
+_TLS = threading.local()
+
+
+class _AdapterScope:
+    """The traced operands of one batched-adapter forward.  ``layer`` is
+    advanced by ``GPTModel.forward`` as it walks the decoder stack."""
+
+    __slots__ = ("ids", "a_bank", "b_bank", "scales", "layer")
+
+    def __init__(self, ids, a_bank, b_bank, scales):
+        self.ids = ids            # [B] int32 — bank row per batch row
+        self.a_bank = a_bank      # [R+1, L, h, r_max]
+        self.b_bank = b_bank      # [R+1, L, r_max, 3h]
+        self.scales = scales      # [R+1] f32
+        self.layer = 0
+
+    def delta_qkv(self, x):
+        """Per-row LoRA delta for the CURRENT layer's fused QKV: ``x``
+        is the projection's input ``[B, T, h]`` (raw jnp value); returns
+        ``[B, T, 3h]``.  Row ``ids == 0`` gathers the zero adapter, so
+        its delta is exactly 0.0."""
+        import jax.numpy as jnp
+
+        a = self.a_bank[self.ids, self.layer].astype(x.dtype)  # [B, h, r]
+        b = self.b_bank[self.ids, self.layer].astype(x.dtype)  # [B, r, 3h]
+        s = self.scales[self.ids].astype(x.dtype)              # [B]
+        low = jnp.einsum("bth,bhr->btr", x, a)
+        return jnp.einsum("btr,bro->bto", low, b) * s[:, None, None]
+
+
+@contextlib.contextmanager
+def adapter_scope(ids, a_bank, b_bank, scales):
+    """Activate batched-adapter application for model forwards on THIS
+    thread (the engine enters it around the traced model call, inside
+    its jitted prefill/tail/decode functions)."""
+    prev = getattr(_TLS, "scope", None)
+    _TLS.scope = _AdapterScope(ids, a_bank, b_bank, scales)
+    try:
+        yield _TLS.scope
+    finally:
+        _TLS.scope = prev
+
+
+def active() -> Optional[_AdapterScope]:
+    """The thread's live adapter scope, or None outside one."""
+    return getattr(_TLS, "scope", None)

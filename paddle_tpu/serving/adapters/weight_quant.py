@@ -1,0 +1,90 @@
+"""int8 base weights for the serving path (per-channel absmax).
+
+Decode reads every weight byte per step — at the measured 32%-of-roofline
+decode (docs/PERF.md round 5) weight bytes are the half of the HBM bound
+the int8/paged KV work did NOT touch.  ``Engine(weight_dtype="int8")``
+stores the serving weight operands quantized: every 2-D floating
+parameter (QKV/out/MLP projections, embedding tables — the bulk of the
+bytes) becomes an ``int8`` tensor plus one float32 absmax scale **per
+output channel** (axis -1), and the serving jits dequantize at the top of
+the traced step, so what rides HBM between steps — and what every decode
+dispatch reads — is the int8 bytes.
+
+1-D leaves (LayerNorm weights, biases) and non-float buffers stay as-is:
+they are a rounding error of the byte budget and the riskiest to
+quantize.  The transform is host-side and lossy-once (quantize at engine
+build); the engine parity-gates greedy decode against the f32 path and
+bench reports the measured bytes ratio + token-match.
+
+Per-channel (not per-tensor) absmax keeps the worst-case element error at
+``channel_absmax / 254``, which on trained transformer weights is the
+regime weight-only int8 serving runs in production.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["quantize_state", "dequantize_state", "state_bytes"]
+
+_INT8_MAX = 127.0
+_SCALE_EPS = 1e-8
+
+
+def _is_quantizable(v) -> bool:
+    return (hasattr(v, "dtype") and hasattr(v, "ndim") and v.ndim == 2 and
+            jnp.issubdtype(v.dtype, jnp.floating))
+
+
+def quantize_state(values: Dict[str, Any]
+                   ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """``state_values(model)`` dict → ``(packed, dtypes)``: every 2-D
+    float leaf becomes a ``(q int8, scale f32[out_channels])`` pair,
+    everything else passes through.  ``dtypes`` maps the quantized names
+    to their original dtypes — static trace-time info the engine keeps in
+    the closure (strings can't ride a jit pytree)."""
+    packed: Dict[str, Any] = {}
+    dtypes: Dict[str, Any] = {}
+    for k, v in values.items():
+        if _is_quantizable(v):
+            amax = jnp.max(jnp.abs(v), axis=0)
+            scale = jnp.maximum(amax.astype(jnp.float32) / _INT8_MAX,
+                                _SCALE_EPS)
+            q = jnp.clip(jnp.round(v / scale[None, :].astype(v.dtype)),
+                         -_INT8_MAX, _INT8_MAX).astype(jnp.int8)
+            packed[k] = (q, scale)
+            dtypes[k] = v.dtype
+        else:
+            packed[k] = v
+    return packed, dtypes
+
+
+def dequantize_state(packed: Dict[str, Any],
+                     dtypes: Dict[str, Any]) -> Dict[str, Any]:
+    """Inverse of :func:`quantize_state` — runs INSIDE the serving jits,
+    so the stored operands stay int8 and XLA fuses the per-channel
+    multiply toward the consuming matmuls."""
+    out: Dict[str, Any] = {}
+    for k, v in packed.items():
+        if isinstance(v, tuple):
+            q, scale = v
+            dt = dtypes[k]
+            out[k] = q.astype(dt) * scale[None, :].astype(dt)
+        else:
+            out[k] = v
+    return out
+
+
+def state_bytes(packed: Dict[str, Any]) -> int:
+    """Device bytes of the packed state as stored (int8 + scale sidecars
+    for quantized leaves) — the numerator of bench's bytes ratio."""
+    total = 0
+    for v in packed.values():
+        leaves = v if isinstance(v, tuple) else (v,)
+        for leaf in leaves:
+            if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+                total += int(np.prod(leaf.shape)) * \
+                    jnp.dtype(leaf.dtype).itemsize
+    return total

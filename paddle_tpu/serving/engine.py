@@ -45,6 +45,14 @@ composable attacks on that bound ride the same single-signature loop:
   (kv_quant), dequantized inside the attention read: half the pool bytes,
   double the slots in the same HBM.
 
+**Multi-LoRA serving** (docs/serving.md "Multi-LoRA serving"):
+``Engine(adapters=AdapterRegistry(...))`` serves many LoRA-fine-tuned
+variants of the same base weights — per-slot int32 adapter ids gather
+each row's low-rank factors from stacked device banks inside the SAME
+decode program (bank row 0 = the exact base model), with refcount+LRU
+HBM residency and admission-time cold loads; pair with
+``weight_dtype="int8"`` to store the base weights themselves quantized.
+
 Sampling runs ON DEVICE by default (``sample_on_device=True``):
 temperature / top-k / greedy with per-slot parameters and counter-based
 PRNG keys live in the decode program, so only ``[B(, k)]`` token ids —
@@ -110,6 +118,13 @@ SERVING_KV_PAGES_FREE = "paddle_tpu_serving_kv_pages_free"
 SERVING_KV_PAGES_ACTIVE = "paddle_tpu_serving_kv_pages_active"
 SERVING_KV_PAGES_CACHED = "paddle_tpu_serving_kv_pages_cached"
 SERVING_KV_COW_COPIES = "paddle_tpu_serving_kv_page_cow_copies_total"
+SERVING_ADAPTERS_RESIDENT = "paddle_tpu_serving_adapters_resident"
+SERVING_ADAPTER_TOKENS = "paddle_tpu_serving_adapter_tokens_total"
+SERVING_ADAPTER_TTFT = "paddle_tpu_serving_adapter_ttft_seconds"
+SERVING_ADAPTER_LOADS = "paddle_tpu_serving_adapter_loads_total"
+SERVING_ADAPTER_EVICTIONS = "paddle_tpu_serving_adapter_evictions_total"
+SERVING_ADAPTER_STALLS = "paddle_tpu_serving_adapter_load_stalls_total"
+SERVING_WEIGHT_BYTES = "paddle_tpu_serving_weight_bytes"
 
 
 class QueueFullError(RuntimeError):
@@ -180,9 +195,13 @@ class RequestHandle:
     """
 
     def __init__(self, engine, prompt, max_new_tokens, eos_token_id,
-                 temperature, top_k, seed, deadline_s, stream):
+                 temperature, top_k, seed, deadline_s, stream,
+                 adapter=None):
         self.request_id = next(_ids)
         self.redispatches = 0        # times re-enqueued after an engine death
+        self.adapter = adapter       # LoRA adapter name (None = base model)
+        self._adapter_slot = 0       # bank row while active (0 = zero adapter)
+        self._adapter_pinned = False
         self.prompt = prompt
         self.max_new_tokens = int(max_new_tokens)
         self.eos_token_id = eos_token_id
@@ -401,6 +420,20 @@ class Engine:
             False restores the host sampler (``_sample_row``) — the
             per-request numpy RNG stream, at a ``[B, V]`` logits transfer
             per step.
+        adapters: an :class:`~paddle_tpu.serving.adapters.AdapterRegistry`
+            — serve many LoRA-fine-tuned variants of the base model from
+            this one engine (docs/serving.md "Multi-LoRA serving"):
+            ``submit(adapter=name)`` rows gather that adapter's factors
+            from stacked device banks inside the same decode program
+            (bank row 0 = the exact base model).  The registry persists
+            across supervisor rebuilds; bank residency (refcount+LRU,
+            admission-time cold loads, fully-pinned-bank backpressure)
+            is fresh per engine build.
+        weight_dtype: None (model dtype) or ``"int8"`` — store the
+            serving weight operands quantized per output channel
+            (adapters/weight_quant.py), dequantized at the top of each
+            serving jit: HBM between steps holds the int8 bytes (the
+            weight half of the decode HBM bound; parity-gated).
     """
 
     def __init__(self, model, tokenizer=None, max_slots: int = 8,
@@ -419,7 +452,9 @@ class Engine:
                  paged_kv: bool = False,
                  page_size: Optional[int] = None,
                  num_pages: Optional[int] = None,
-                 max_pages_per_slot: Optional[int] = None):
+                 max_pages_per_slot: Optional[int] = None,
+                 adapters=None,
+                 weight_dtype: Optional[str] = None):
         self.model = model
         self.tokenizer = tokenizer
         self.max_slots = int(max_slots)
@@ -468,6 +503,29 @@ class Engine:
         self.sample_on_device = bool(sample_on_device)
         self._prefix = (PrefixIndex(block=prefix_block) if prefix_cache
                         else None)
+        # -- multi-LoRA adapters (docs/serving.md "Multi-LoRA serving"):
+        # the registry is PERSISTENT (shared across supervisor rebuilds);
+        # the residency tracker — bank slots, pins, LRU — is fresh per
+        # engine build, so a rebuilt engine starts with empty banks and
+        # zero pins by construction --------------------------------------
+        self.adapter_registry = adapters
+        self._adapters = None
+        if adapters is not None:
+            if cfg is None:
+                raise ValueError(
+                    "adapters= needs a GPT-style model (config with "
+                    "hidden_size/num_layers) to size the banks")
+            self._adapters = adapters.residency()
+        self._adapter_uploads: dict = {}     # name -> bank slot, pending
+        self._adapter_load_times: list = []  # cold-load wall seconds
+        self._adapter_stalled = False
+        # -- int8 base weights (serving/adapters/weight_quant.py) --------
+        if weight_dtype not in (None, "int8"):
+            raise ValueError(f"weight_dtype must be None or 'int8', "
+                             f"got {weight_dtype!r}")
+        self.weight_dtype = weight_dtype
+        self._weight_quant = weight_dtype == "int8"
+        self._weight_bytes = 0
         # -- paged KV pool (docs/serving.md "Paged KV") ----------------------
         self.paged_kv = bool(paged_kv)
         if not self.paged_kv and (page_size is not None or
@@ -536,6 +594,8 @@ class Engine:
         self._temps = np.zeros(n_rows, np.float32)
         self._topks = np.zeros(n_rows, np.int32)
         self._keys = np.zeros((n_rows, 2), np.uint32)
+        # per-slot adapter bank row (0 = the zero adapter: base model)
+        self._aids = np.zeros(n_rows, np.int32)
         self._counts = {"submitted": 0, "completed": 0, "rejected": 0,
                         "cancelled": 0, "deadline_expired": 0, "failed": 0,
                         "decode_steps": 0, "prefill_batches": 0,
@@ -544,7 +604,9 @@ class Engine:
                         "prefix_misses": 0, "prefix_evictions": 0,
                         "prefix_inserts": 0, "spec_drafted": 0,
                         "spec_accepted": 0, "page_cow_copies": 0,
-                        "page_alloc_stalls": 0}
+                        "page_alloc_stalls": 0, "adapter_hits": 0,
+                        "adapter_loads": 0, "adapter_evictions": 0,
+                        "adapter_load_stalls": 0}
         self._active_pages = 0     # pages referenced by in-flight requests
         self._cached_pages = 0     # pages referenced by prefix entries
         self._page_stalled = False
@@ -560,12 +622,15 @@ class Engine:
     def submit(self, prompt, max_new_tokens: int = 16, eos_token_id=...,
                temperature: float = 0.0, top_k: int = 0, seed: int = 0,
                deadline_s: Optional[float] = None,
-               stream: Optional[Callable[[int], None]] = None
-               ) -> RequestHandle:
+               stream: Optional[Callable[[int], None]] = None,
+               adapter: Optional[str] = None) -> RequestHandle:
         """Queue one request; returns a Future-style handle.  Raises
         :class:`QueueFullError` when the bounded admission queue is at
         capacity (backpressure: the caller sheds load or retries) and
-        ValueError when the request cannot fit a slot."""
+        ValueError when the request cannot fit a slot.  ``adapter``
+        names a registered LoRA adapter (``Engine(adapters=registry)``);
+        unknown names and ranks that can never fit the bank raise the
+        registry's typed errors HERE, not after queueing."""
         # lock-free monitor-flag reads: _dead/_stop/_draining make single
         # benign transitions; at worst a racing submit lands one sweep
         # late and fails through the death classification instead
@@ -599,9 +664,23 @@ class Engine:
             raise ValueError(
                 f"request needs {self._pages_for(ids.size + int(max_new_tokens))} "
                 f"pages but the pool has only {self._page_alloc.num_pages}")
+        if adapter is not None:
+            from .adapters.registry import AdapterRankError
+            if self._adapters is None:
+                raise ValueError(
+                    "this engine has no adapter registry "
+                    "(Engine(adapters=AdapterRegistry(...)))")
+            entry = self.adapter_registry.get(adapter)   # typed: unknown
+            if entry.rank > self.adapter_registry.max_rank:
+                raise AdapterRankError(
+                    f"adapter {adapter!r} rank {entry.rank} exceeds the "
+                    f"bank width max_rank="
+                    f"{self.adapter_registry.max_rank}: it can never "
+                    f"become resident")
         eos = self.eos_token_id if eos_token_id is ... else eos_token_id
         req = RequestHandle(self, ids, max_new_tokens, eos, temperature,
-                            top_k, seed, deadline_s, stream)
+                            top_k, seed, deadline_s, stream,
+                            adapter=adapter)
         hook = self.admission_hook
         if hook is not None:
             try:
@@ -651,6 +730,11 @@ class Engine:
                 f"request {req.request_id} already streamed "
                 f"{len(req._tokens)} token(s); re-dispatch would "
                 f"duplicate them")
+        if req.adapter is not None and self._adapters is None:
+            raise ValueError(
+                f"request {req.request_id} needs adapter "
+                f"{req.adapter!r} but this engine has no adapter "
+                f"registry")
         if self._dead is not None:
             raise EngineDeadError(self._dead) from self._dead
         if self._stop:
@@ -664,6 +748,8 @@ class Engine:
         req._pages = None
         req._cow = None
         req.prefix_hit = False
+        req._adapter_slot = 0    # the dead engine's banks (and pins) died
+        req._adapter_pinned = False
         req.redispatches += 1
         with self._lock:
             self._queue.append(req)
@@ -780,6 +866,8 @@ class Engine:
             for slot in list(self._pool.active()):
                 req = self._pool.free(slot)
                 self._release_pages_locked(req)
+                if self._adapters is not None:
+                    self._unpin_adapter_locked(req)
             if self._prefix is not None:
                 # the pool the cached rows/pages point into is going away
                 for e in self._prefix.drop_all():
@@ -791,6 +879,8 @@ class Engine:
                     self._pool.release_cached(slot)
             if self.paged_kv:
                 self._page_alloc.check()     # zero leaked pages at teardown
+            if self._adapters is not None:
+                self._adapters.check()       # zero leaked adapter pins
             self._gauges_locked()
         for req in pending:
             req._finish(err)
@@ -852,6 +942,11 @@ class Engine:
             out["prefix_entries"] = (0 if self._prefix is None
                                      else len(self._prefix))
             out["kv_pool_bytes"] = self._pool_bytes
+            out["weight_bytes"] = self._weight_bytes
+            if self._adapters is not None:
+                out["adapters_resident"] = self._adapters.n_resident
+                out["adapters_pinned"] = self._adapters.n_pinned
+                out["adapter_bank_capacity"] = self._adapters.capacity
             if self.paged_kv:
                 out["kv_num_pages"] = self._page_alloc.num_pages
                 out["kv_page_size"] = self._page_alloc.page_size
@@ -867,6 +962,13 @@ class Engine:
         0 before the first admission builds them."""
         with self._lock:
             return self._pool_bytes
+
+    def weight_bytes(self) -> int:
+        """Device bytes of the serving weight operands as STORED (int8 +
+        scale sidecars under ``weight_dtype='int8'``); 0 before the
+        first admission builds them."""
+        with self._lock:
+            return self._weight_bytes
 
     def compile_stats(self) -> dict:
         """Distinct jit signatures per entry point (retrace sentinel
@@ -887,6 +989,8 @@ class Engine:
 
     # -- jitted pieces -------------------------------------------------------
     def _build(self):
+        import contextlib
+
         import jax
         import jax.numpy as jnp
 
@@ -909,6 +1013,58 @@ class Engine:
                                   jnp.zeros((1, 1), jnp.int64))
 
         kv = _kv_struct()
+
+        def _leaf_bytes(leaves):
+            return sum(int(np.prod(x.shape)) * jnp.dtype(x.dtype).itemsize
+                       for x in leaves
+                       if hasattr(x, "shape") and hasattr(x, "dtype"))
+
+        if self._weight_quant:
+            # int8 base weights: the STORED serving operands go int8 with
+            # per-channel f32 scales; every jitted entry dequantizes at
+            # the top of its trace, so HBM between steps holds int8 bytes
+            # (docs/serving.md "Multi-LoRA serving").
+            from .adapters.weight_quant import (dequantize_state,
+                                                quantize_state, state_bytes)
+            self._values, _wq_dtypes = quantize_state(self._values)
+            wbytes = state_bytes(self._values)
+
+            def _dq(vals, _d=_wq_dtypes):
+                return dequantize_state(vals, _d)
+        else:
+            wbytes = _leaf_bytes(self._values.values())
+
+            def _dq(vals):
+                return vals
+        with self._lock:
+            self._weight_bytes = wbytes
+        registry().gauge(
+            SERVING_WEIGHT_BYTES,
+            "device bytes of the serving weight operands as stored").set(
+            float(wbytes))
+
+        # -- multi-LoRA adapter banks: fixed-shape device operands every
+        # serving dispatch carries (row 0 = the zero adapter) -------------
+        use_adp = self._adapters is not None
+        if use_adp:
+            from .adapters.lora import adapter_scope as _adapter_scope
+            areg = self.adapter_registry
+            Rcap = self._adapters.capacity
+            r_max, n_layers, h = areg.max_rank, areg.num_layers, areg.hidden
+            self._abank = jnp.zeros((Rcap + 1, n_layers, h, r_max),
+                                    jnp.float32)
+            self._bbank = jnp.zeros((Rcap + 1, n_layers, r_max, 3 * h),
+                                    jnp.float32)
+            self._ascale = jnp.zeros((Rcap + 1,), jnp.float32)
+
+        def _mstate(values, adp):
+            """Swapped model state, plus the batched-adapter scope when
+            the dispatch carries adapter operands."""
+            st = contextlib.ExitStack()
+            st.enter_context(_swapped_state(model, values))
+            if adp is not None:
+                st.enter_context(_adapter_scope(*adp))
+            return st
         pool_dtype = jnp.int8 if quant else None
         paged = self.paged_kv
         if paged:
@@ -1052,7 +1208,7 @@ class Engine:
             return jax.vmap(jax.random.fold_in)(keys, positions)
 
         def prefill(values, ids, pools, slot_idx, prompt_lens, temps,
-                    topks, keys):
+                    topks, keys, adp=None):
             # the per-request caches are BUILT inside this jit with a
             # python-int length 0 (static prefill: the prompt keeps the
             # causal flash path), then the filled rows scatter into the
@@ -1066,7 +1222,7 @@ class Engine:
                  Tensor(jnp.zeros((n, L) + tuple(v.shape[2:]), v.dtype),
                         _internal=True), 0)
                 for k, v in kv]
-            with _swapped_state(model, values):
+            with _mstate(_dq(values), adp):
                 logits, new_caches = _fwd_last(
                     Tensor(ids, _internal=True), caches_t,
                     gather_idx=prompt_lens - 1)
@@ -1097,7 +1253,7 @@ class Engine:
             return logits, pools
 
         def prefill_paged(values, ids, pools, tables, prompt_lens, temps,
-                          topks, keys):
+                          topks, keys, adp=None):
             # paged cold prefill: the per-request caches are built inside
             # this jit exactly as in the dense path (python-int length 0
             # keeps the causal flash path — the prompt math is IDENTICAL,
@@ -1113,7 +1269,7 @@ class Engine:
                  Tensor(jnp.zeros((n, bucket) + tuple(v.shape[2:]),
                                   v.dtype), _internal=True), 0)
                 for k, v in kv]
-            with _swapped_state(model, values):
+            with _mstate(_dq(values), adp):
                 logits, new_caches = _fwd_last(
                     Tensor(ids, _internal=True), caches_t,
                     gather_idx=prompt_lens - 1)
@@ -1149,13 +1305,13 @@ class Engine:
             return logits, pools
 
         def decode_paged(values, ids, pools, lengths, tables, temps,
-                         topks, keys):
+                         topks, keys, adp=None):
             # the paged decode is the dense decode with the page tables
             # riding along as one more int32 operand — the per-slot
             # gather/scatter lives in the model's paged cache branch, so
             # this stays ONE compiled program per engine config
             caches_t = _caches_from(pools, lengths, tables)
-            with _swapped_state(model, values):
+            with _mstate(_dq(values), adp):
                 logits, new_caches = _fwd_all(
                     Tensor(ids, _internal=True), caches_t)
             pools = _pools_from(new_caches)
@@ -1168,9 +1324,9 @@ class Engine:
             return logits, pools
 
         def tail_prefill_paged(values, ids, pools, lengths, tables,
-                               gather_idx, temps, topks, keys):
+                               gather_idx, temps, topks, keys, adp=None):
             caches_t = _caches_from(pools, lengths, tables)
-            with _swapped_state(model, values):
+            with _mstate(_dq(values), adp):
                 logits, new_caches = _fwd_last(
                     Tensor(ids, _internal=True), caches_t,
                     gather_idx=gather_idx)
@@ -1190,7 +1346,8 @@ class Engine:
                                         mode="drop") for p in grp]
                          for grp in pools)
 
-        def decode(values, ids, pools, lengths, temps, topks, keys):
+        def decode(values, ids, pools, lengths, temps, topks, keys,
+                   adp=None):
             # ONE batched step over every slot row (+ scratch): vector
             # lengths route the per-slot static-cache branch; idle rows
             # are parked at max_len so their writes DROP (a prefix-cached
@@ -1199,7 +1356,7 @@ class Engine:
             # W=k the speculative verify — same program shape either way,
             # ONE signature per engine config.
             caches_t = _caches_from(pools, lengths)
-            with _swapped_state(model, values):
+            with _mstate(_dq(values), adp):
                 logits, new_caches = _fwd_all(
                     Tensor(ids, _internal=True), caches_t)
             pools = _pools_from(new_caches)
@@ -1212,12 +1369,12 @@ class Engine:
             return logits, pools
 
         def tail_prefill(values, ids, pools, lengths, gather_idx, temps,
-                         topks, keys):
+                         topks, keys, adp=None):
             # prefix-cache hit path: the prompt HEAD was copied from a
             # cached row, only the tail runs through the per-slot branch
             # (rows not in this admit batch park at max_len: writes drop)
             caches_t = _caches_from(pools, lengths)
-            with _swapped_state(model, values):
+            with _mstate(_dq(values), adp):
                 logits, new_caches = _fwd_last(
                     Tensor(ids, _internal=True), caches_t,
                     gather_idx=gather_idx)
@@ -1296,6 +1453,8 @@ class Engine:
             for slot in list(self._pool.active()):
                 req = self._pool.free(slot)
                 self._release_pages_locked(req)
+                if self._adapters is not None:
+                    self._unpin_adapter_locked(req)
             if self._prefix is not None:
                 # dead pool: every cached row/page dies with it — a
                 # rebuilt engine starts with an EMPTY index and a fresh
@@ -1429,11 +1588,70 @@ class Engine:
         """Pages covering positions [0, n_tokens) at the pool page size."""
         return -(-int(n_tokens) // self._page_alloc.page_size)
 
+    def _pin_adapter_locked(self, req: RequestHandle) -> bool:
+        """Make the request's adapter RESIDENT and pinned before its slot
+        is taken, scheduling a cold bank upload when needed.  False means
+        every bank row is pinned by other in-flight work — the request
+        stays QUEUED (head-of-line backpressure, the same semantics as
+        page exhaustion; admitted work never waits, so the bank always
+        frees up)."""
+        if req.adapter is None or req._adapter_pinned:
+            return True
+        res = self._adapters
+        ev0 = res.evictions
+        got = res.acquire(req.adapter)
+        if got is None:
+            if not self._adapter_stalled:
+                self._adapter_stalled = True
+                self._counts["adapter_load_stalls"] += 1
+                flight.record("serving", "adapter_load_stall",
+                              request=req.request_id, adapter=req.adapter,
+                              resident=res.n_resident)
+                registry().counter(
+                    SERVING_ADAPTER_STALLS,
+                    "admissions stalled on a fully-pinned adapter bank"
+                ).inc(1.0)
+            return False
+        slot, cold = got
+        self._adapter_stalled = False
+        req._adapter_slot = slot
+        req._adapter_pinned = True
+        dev = res.evictions - ev0
+        if dev:
+            self._counts["adapter_evictions"] += dev
+            flight.record("serving", "adapter_evict", n=dev,
+                          for_adapter=req.adapter)
+            registry().counter(
+                SERVING_ADAPTER_EVICTIONS,
+                "refs-0 adapters evicted from the bank (LRU)").inc(
+                float(dev))
+        if cold:
+            if req.adapter not in self._adapter_uploads:
+                self._counts["adapter_loads"] += 1
+                self._adapter_uploads[req.adapter] = slot
+        else:
+            self._counts["adapter_hits"] += 1
+        return True
+
+    def _unpin_adapter_locked(self, req: RequestHandle):
+        """Drop the request's pin (the bank row stays resident at refs 0
+        for the next hit; only LRU pressure reclaims it)."""
+        if req._adapter_pinned:
+            self._adapters.release(req.adapter)
+            req._adapter_pinned = False
+        req._adapter_slot = 0
+
     def _admit_dense_locked(self):
-        """Dense-pool admission: pop up to prefill_batch requests into
-        free slots, evicting unreferenced prefix rows under pressure."""
+        """Dense-pool admission: head-of-queue requests admit while a
+        free slot AND (when they name one) a pinnable adapter bank row
+        are available, evicting unreferenced prefix rows under slot
+        pressure.  An unpinnable adapter is head-of-line backpressure
+        (FIFO fairness, like page exhaustion in the paged pool)."""
         evicted = 0
         want = min(self.prefill_batch, len(self._queue))
+        if want == 0:
+            self._adapter_stalled = False
+            return [], 0
         if self._prefix is not None and want > self._pool.n_free:
             # reclaim cache capacity: LRU unreferenced entries go back
             # to the free list.  Referenced rows (copy sources for
@@ -1443,7 +1661,8 @@ class Engine:
             # pool would evict exactly the rows the queue wants
             protect = set()
             for req in itertools.islice(self._queue, want):
-                hit = self._prefix.lookup(req.prompt, peek=True)
+                hit = self._prefix.lookup(req.prompt, peek=True,
+                                          ns=req.adapter)
                 if hit is not None:
                     protect.add(id(hit[0]))
             for e in self._prefix.evict_lru(want - self._pool.n_free,
@@ -1453,15 +1672,17 @@ class Engine:
                 evicted += 1
                 flight.record("serving", "prefix_evict", slot=e.slot,
                               cached_tokens=e.n)
-        n = min(self._pool.n_free, want)
-        batch = [self._queue.popleft() for _ in range(n)]
-        for req in batch:
+        batch = []
+        while self._queue and len(batch) < want and self._pool.n_free > 0:
+            req = self._queue[0]
+            if not self._pin_adapter_locked(req):
+                break
+            self._queue.popleft()
             req.slot = self._pool.alloc(req)
             req._state = "active"
             req.t_admit = time.perf_counter()
-        if self._prefix is not None:
-            for req in batch:
-                hit = self._prefix.lookup(req.prompt)
+            if self._prefix is not None:
+                hit = self._prefix.lookup(req.prompt, ns=req.adapter)
                 if hit is not None:
                     entry, matched = hit
                     self._prefix.acquire(entry)
@@ -1471,6 +1692,7 @@ class Engine:
                     self._counts["prefix_hits"] += 1
                 else:
                     self._counts["prefix_misses"] += 1
+            batch.append(req)
         return batch, evicted
 
     def _admit_paged_locked(self):
@@ -1491,18 +1713,23 @@ class Engine:
             # stall episode over (the stalled request retired or was
             # cancelled): the next exhaustion is a fresh flight event
             self._page_stalled = False
+            self._adapter_stalled = False
             return [], 0
         protect = set()
         if self._prefix is not None:
             for req in itertools.islice(self._queue, want):
-                hit = self._prefix.lookup(req.prompt, peek=True)
+                hit = self._prefix.lookup(req.prompt, peek=True,
+                                          ns=req.adapter)
                 if hit is not None:
                     protect.add(id(hit[0]))
         batch = []
         while self._queue and len(batch) < want and self._pool.n_free > 0:
             req = self._queue[0]
+            if not self._pin_adapter_locked(req):
+                break                # HOL backpressure: bank fully pinned
             total = self._pages_for(req.prompt.size + req.max_new_tokens)
-            hit = (self._prefix.lookup(req.prompt, peek=True)
+            hit = (self._prefix.lookup(req.prompt, peek=True,
+                                       ns=req.adapter)
                    if self._prefix is not None else None)
             # fully-matched pages are shared by reference; a partial
             # boundary page (match not page-aligned) is replaced by a
@@ -1527,8 +1754,10 @@ class Engine:
             if pages is None:
                 # page exhaustion: head-of-line request stays queued
                 # (FIFO fairness — no small-request overtake that would
-                # starve the head); flight-record the stall once per
-                # stall episode, not per 20 ms scheduler sweep
+                # starve the head); the pin taken above is dropped so a
+                # parked request never holds bank capacity; flight-record
+                # the stall once per stall episode, not per 20 ms sweep
+                self._unpin_adapter_locked(req)
                 if not self._page_stalled:
                     self._page_stalled = True
                     self._counts["page_alloc_stalls"] += 1
@@ -1604,6 +1833,7 @@ class Engine:
         if not self._built:
             with span("serving.build"):
                 self._build()
+        self._flush_adapter_uploads()
         if evicted:
             registry().counter(
                 SERVING_PREFIX_EVICTIONS,
@@ -1640,6 +1870,61 @@ class Engine:
         self._temps[slot] = req.temperature
         self._topks[slot] = req.top_k
         self._keys[slot] = req._base_key
+        self._aids[slot] = req._adapter_slot
+
+    def _flush_adapter_uploads(self):
+        """Admission-time load of cold adapters: upload every scheduled
+        adapter's zero-padded factors into its bank row (eager device
+        writes, once per cold admission — never per token).  Runs on the
+        scheduler thread after ``_build`` so the banks exist; the
+        residency mapping is re-checked under the lock in case a stalled
+        request's row was LRU-reused before its upload ran."""
+        if self._adapters is None:
+            return
+        with self._lock:
+            if not self._adapter_uploads:
+                return
+            ups = [(name, slot) for name, slot in
+                   self._adapter_uploads.items()
+                   if self._adapters.slot_of(name) == slot]
+            self._adapter_uploads.clear()
+        for name, slot in ups:
+            t0 = time.perf_counter()
+            with span("serving.adapter_load", adapter=name, bank_slot=slot):
+                self._load_adapter_bank(slot,
+                                        self.adapter_registry.get(name))
+            dt = time.perf_counter() - t0
+            with self._lock:
+                if self._adapters.slot_of(name) == slot:
+                    self._adapters.mark_loaded(name)
+                self._adapter_load_times.append(dt)
+            registry().counter(
+                SERVING_ADAPTER_LOADS,
+                "cold adapter loads into the device bank").inc(1.0)
+            flight.record("serving", "adapter_load", adapter=name,
+                          bank_slot=slot, load_ms=round(dt * 1e3, 3))
+
+    def _load_adapter_bank(self, slot: int, adapter):
+        """Write one adapter's factors (zero-padded to the bank's
+        ``r_max``) into bank row ``slot``; padding columns contribute
+        exact zeros to the delta."""
+        import jax.numpy as jnp
+        r = adapter.rank
+        a = np.zeros(tuple(self._abank.shape[1:]), np.float32)
+        b = np.zeros(tuple(self._bbank.shape[1:]), np.float32)
+        for i in range(adapter.num_layers):
+            a[i, :, :r] = adapter.a[i]
+            b[i, :r, :] = adapter.b[i]
+        self._abank = self._abank.at[slot].set(jnp.asarray(a))
+        self._bbank = self._bbank.at[slot].set(jnp.asarray(b))
+        self._ascale = self._ascale.at[slot].set(float(adapter.scale))
+
+    def _adp_args(self, aids):
+        """The adapter operand tuple one dispatch carries: per-row bank
+        ids + the stacked banks (fixed shapes — ONE decode signature)."""
+        import jax.numpy as jnp
+        return (jnp.asarray(aids, jnp.int32), self._abank, self._bbank,
+                self._ascale)
 
     def _prefill_cold(self, batch) -> None:
         """Batched prefill of requests with no cached prefix (the only
@@ -1654,6 +1939,7 @@ class Engine:
         temps = np.zeros(P, np.float32)
         topks = np.zeros(P, np.int32)
         keys = np.zeros((P, 2), np.uint32)
+        aid_rows = np.zeros(P, np.int32)
         tables = (np.full((P, self._max_pages_per_slot),
                           self._page_alloc.num_pages, np.int32)
                   if self.paged_kv else None)
@@ -1665,6 +1951,7 @@ class Engine:
                 temps[i] = req.temperature
                 topks[i] = req.top_k
                 keys[i] = req._base_key
+                aid_rows[i] = req._adapter_slot
                 if tables is not None:
                     tables[i] = self._page_tables[req.slot]
                 self._set_slot_params_locked(req)
@@ -1678,19 +1965,21 @@ class Engine:
         if self._decode_timeout_s is not None:
             _watchdog.arm("serving.prefill", self._decode_timeout_s)
         try:
+            extra = ((self._adp_args(aid_rows),)
+                     if self._adapters is not None else ())
             with span("serving.prefill", n=len(batch), bucket=bucket):
                 if self.paged_kv:
                     out, self._pools = self._prefill_fn(
                         self._values, jnp.asarray(ids), self._pools,
                         jnp.asarray(tables), jnp.asarray(plens),
                         jnp.asarray(temps), jnp.asarray(topks),
-                        jnp.asarray(keys))
+                        jnp.asarray(keys), *extra)
                 else:
                     out, self._pools = self._prefill_fn(
                         self._values, jnp.asarray(ids), self._pools,
                         jnp.asarray(slot_idx), jnp.asarray(plens),
                         jnp.asarray(temps), jnp.asarray(topks),
-                        jnp.asarray(keys))
+                        jnp.asarray(keys), *extra)
                 out = np.asarray(out)
         finally:
             if self._decode_timeout_s is not None:
@@ -1750,6 +2039,7 @@ class Engine:
                                   1e3 * (req.t_admit - req.t_submit), 3))
             if paged:
                 tables = np.array(self._page_tables)
+            aids_snap = np.array(self._aids)
         t0 = time.perf_counter()
         faults.fault_point("serving.prefill", n=len(hits))
         if self._decode_timeout_s is not None:
@@ -1770,19 +2060,22 @@ class Engine:
                         "shared KV pages cloned for a diverging writer"
                     ).inc(float(n_copy))
                     flight.record("serving", "page_cow", copies=n_copy)
+            extra = ((self._adp_args(aids_snap),)
+                     if self._adapters is not None else ())
             with span("serving.tail_prefill", n=len(hits), bucket=tb):
                 if paged:
                     out, self._pools = self._tail_fn(
                         self._values, jnp.asarray(ids), self._pools,
                         jnp.asarray(lens), jnp.asarray(tables),
                         jnp.asarray(gidx), jnp.asarray(self._temps),
-                        jnp.asarray(self._topks), jnp.asarray(self._keys))
+                        jnp.asarray(self._topks), jnp.asarray(self._keys),
+                        *extra)
                 else:
                     out, self._pools = self._tail_fn(
                         self._values, jnp.asarray(ids), self._pools,
                         jnp.asarray(lens), jnp.asarray(gidx),
                         jnp.asarray(self._temps), jnp.asarray(self._topks),
-                        jnp.asarray(self._keys))
+                        jnp.asarray(self._keys), *extra)
                 out = np.asarray(out)
         finally:
             if self._decode_timeout_s is not None:
@@ -1807,11 +2100,21 @@ class Engine:
             req._t_last_token = now
             registry().histogram(SERVING_TTFT,
                                  "time to first token").observe(req.ttft_s)
+            if req.adapter is not None:
+                registry().histogram(
+                    SERVING_ADAPTER_TTFT,
+                    "time to first token, per adapter").observe(
+                    req.ttft_s, labels={"adapter": req.adapter})
             if req.done() or req._torn or req._engine is not self:
                 continue
             token = (int(row) if self.sample_on_device else
                      _sample_row(row, req.temperature, req.top_k, req._rng))
             finished = self._emit_one(req, token)
+            if req.adapter is not None:
+                registry().counter(
+                    SERVING_ADAPTER_TOKENS,
+                    "tokens served, per adapter").inc(
+                    1.0, labels={"adapter": req.adapter})
             slot = req.slot
             with self._lock:
                 self._counts["tokens"] += 1
@@ -1857,6 +2160,7 @@ class Engine:
             temps = np.array(self._temps)
             topks = np.array(self._topks)
             keys = np.array(self._keys)
+            aids = np.array(self._aids)
             tables = (np.array(self._page_tables) if self.paged_kv
                       else None)
         import jax.numpy as jnp
@@ -1865,18 +2169,20 @@ class Engine:
         if self._decode_timeout_s is not None:
             _watchdog.arm("serving.decode", self._decode_timeout_s)
         try:
+            extra = ((self._adp_args(aids),)
+                     if self._adapters is not None else ())
             with span("serving.decode", active=len(active)):
                 if self.paged_kv:
                     out, self._pools = self._decode_fn(
                         self._values, jnp.asarray(ids), self._pools,
                         jnp.asarray(lengths), jnp.asarray(tables),
                         jnp.asarray(temps), jnp.asarray(topks),
-                        jnp.asarray(keys))
+                        jnp.asarray(keys), *extra)
                 else:
                     out, self._pools = self._decode_fn(
                         self._values, jnp.asarray(ids), self._pools,
                         jnp.asarray(lengths), jnp.asarray(temps),
-                        jnp.asarray(topks), jnp.asarray(keys))
+                        jnp.asarray(topks), jnp.asarray(keys), *extra)
                 out = np.asarray(out)
         finally:
             if self._decode_timeout_s is not None:
@@ -1934,6 +2240,11 @@ class Engine:
             for _ in range(emitted):
                 req.token_latencies_s.append(lat / max(emitted, 1))
                 tok_hist.observe(lat / max(emitted, 1))
+            if req.adapter is not None and emitted:
+                registry().counter(
+                    SERVING_ADAPTER_TOKENS,
+                    "tokens served, per adapter").inc(
+                    float(emitted), labels={"adapter": req.adapter})
             with self._lock:
                 self._counts["tokens"] += emitted
                 self._lengths[slot] = old_len + emitted
@@ -2009,7 +2320,8 @@ class Engine:
                 # pages, never decode capacity.
                 keep = self._pages_for(n) if n > 0 else 0
                 entry = (self._prefix.insert(
-                    None, cached, pages=req._pages[:keep])
+                    None, cached, pages=req._pages[:keep],
+                    ns=req.adapter)
                     if keep > 0 else None)
                 if entry is not None:
                     for p in req._pages[keep:]:
@@ -2022,7 +2334,8 @@ class Engine:
                                   cached_tokens=n)
                     retained = True
             else:
-                entry = self._prefix.insert(slot, cached) if n > 0 else None
+                entry = (self._prefix.insert(slot, cached, ns=req.adapter)
+                         if n > 0 else None)
                 if entry is not None:
                     self._pool.retain(slot, entry)
                     self._counts["prefix_inserts"] += 1
@@ -2035,6 +2348,9 @@ class Engine:
             self._pool.free(slot)
         elif not retained:
             self._pool.free(slot)
+        if self._adapters is not None:
+            self._unpin_adapter_locked(req)
+            self._aids[slot] = 0
         # park the row: idle (and cached) rows' pool writes must DROP
         self._lengths[slot] = self._park
         self._evicted_counters_locked(req, outcome)
@@ -2055,6 +2371,10 @@ class Engine:
             float(self._pool.n_active))
         reg.gauge(SERVING_QUEUE_DEPTH, "queued, unadmitted requests").set(
             float(len(self._queue)))
+        if self._adapters is not None:
+            reg.gauge(SERVING_ADAPTERS_RESIDENT,
+                      "adapters resident in the device bank").set(
+                float(self._adapters.n_resident))
         if self.paged_kv:
             reg.gauge(SERVING_KV_PAGES_FREE,
                       "KV pages on the free list").set(

@@ -73,15 +73,16 @@ class GatewayRequest:
     __slots__ = ("id", "creq", "tenant", "priority", "cost", "prompt",
                  "t_enqueue", "t_dispatch", "token_q", "ready", "handle",
                  "error", "engine_name", "deadline", "done_ev",
-                 "final_error", "redispatches")
+                 "final_error", "redispatches", "adapter")
 
     def __init__(self, creq: CompletionRequest, tenant: str, priority: str,
-                 prompt: np.ndarray):
+                 prompt: np.ndarray, adapter: str | None = None):
         self.id = f"cmpl-{next(_ids)}"
         self.creq = creq
         self.tenant = tenant
         self.priority = priority
         self.prompt = prompt
+        self.adapter = adapter       # LoRA adapter name (model= resolved)
         self.cost = float(prompt.size + creq.max_tokens)
         now = time.perf_counter()
         self.t_enqueue = now
@@ -162,6 +163,13 @@ class Gateway:
         self.tokenizer = next(
             (e.tokenizer for e in self.router.engines
              if e.tokenizer is not None), None)
+        # multi-LoRA: `model=` names resolve through the replicas' shared
+        # adapter registry (tenant -> adapter is the natural mapping; a
+        # request without model=, or naming the base model, runs id 0)
+        self.adapter_registry = next(
+            (getattr(e, "adapter_registry", None)
+             for e in self.router.engines
+             if getattr(e, "adapter_registry", None) is not None), None)
         self._stop_ev = threading.Event()
         self._drain_ev = threading.Event()
         self._drain_retry_after_s = 5.0
@@ -265,7 +273,8 @@ class Gateway:
                 f"({max_len})", param="max_tokens", code="context_window")
         cfg = self.scheduler.tenant_config(tenant)
         priority = creq.priority or cfg.priority
-        item = GatewayRequest(creq, tenant, priority, prompt)
+        item = GatewayRequest(creq, tenant, priority, prompt,
+                              adapter=self._resolve_adapter(creq))
 
         backlog = self.scheduler.backlog_cost(priority) + item.cost
         slots = self.router.total_slots()
@@ -302,6 +311,28 @@ class Gateway:
                       priority=priority, prompt_len=int(prompt.size),
                       max_tokens=creq.max_tokens)
         return item
+
+    def _resolve_adapter(self, creq: CompletionRequest) -> str | None:
+        """``model=`` → LoRA adapter name through the registry.  Absent
+        or the base model's name → None (adapter id 0); unknown names
+        are a structured 404, a rank the bank can never hold is a 400 —
+        both BEFORE the request queues."""
+        name = creq.model
+        if not name or name == self.model_name:
+            return None
+        reg = self.adapter_registry
+        if reg is None or name not in reg:
+            raise ProtocolError(
+                404, f"model {name!r} is not served here (base model "
+                f"{self.model_name!r}"
+                + (f", adapters: {reg.names()}" if reg is not None else "")
+                + ")", param="model", code="model_not_found")
+        if reg.get(name).rank > reg.max_rank:
+            raise ProtocolError(
+                400, f"adapter {name!r} rank {reg.get(name).rank} exceeds "
+                f"the serving bank width ({reg.max_rank})", param="model",
+                code="adapter_rank")
+        return name
 
     def _prompt_ids(self, creq: CompletionRequest) -> np.ndarray:
         prompt = creq.prompt
@@ -441,7 +472,7 @@ class Gateway:
                     eos_token_id=self.eos_for(creq),
                     temperature=creq.temperature, top_k=creq.top_k,
                     seed=creq.seed, deadline_s=remaining,
-                    stream=item.token_q.put)
+                    stream=item.token_q.put, adapter=item.adapter)
             except QueueFullError:
                 tried.append(name)
                 if len(tried) >= len(self.router.names):

@@ -51,18 +51,22 @@ class PrefixEntry:
     (``Engine(paged_kv=True)``): ``pages`` is the ordered physical page
     list backing those tokens and ``slot`` is None — a cached prefix
     holds pages, not a slot lane, so caching never costs decode
-    capacity and a hit shares the pages by reference (COW)."""
+    capacity and a hit shares the pages by reference (COW).  ``ns`` is
+    the entry's namespace (the serving engine keys entries by
+    ``(adapter, tokens)`` — two adapters' identical prompts produce
+    DIFFERENT K/V, so tenants never share cache rows across adapters)."""
 
-    __slots__ = ("slot", "tokens", "refs", "tick", "keys", "pages")
+    __slots__ = ("slot", "tokens", "refs", "tick", "keys", "pages", "ns")
 
     def __init__(self, slot: Optional[int], tokens: Tuple[int, ...],
-                 tick: int, pages: Optional[List[int]] = None):
+                 tick: int, pages: Optional[List[int]] = None, ns=None):
         self.slot = slot
         self.tokens = tokens
         self.refs = 0
         self.tick = tick          # LRU clock: touched on insert and hit
-        self.keys: List[Tuple[int, ...]] = []   # registered prefix keys
+        self.keys: List[Tuple] = []             # registered prefix keys
         self.pages = pages        # paged mode: physical pages, in order
+        self.ns = ns              # namespace: (ns, tokens) is the identity
 
     @property
     def n(self) -> int:
@@ -85,8 +89,8 @@ class PrefixIndex:
         if int(block) < 1:
             raise ValueError(f"block must be >= 1, got {block}")
         self.block = int(block)
-        self._entries: Dict[Tuple[int, ...], PrefixEntry] = {}
-        self._by_prefix: Dict[Tuple[int, ...], PrefixEntry] = {}
+        self._entries: Dict[Tuple, PrefixEntry] = {}     # (ns, tokens)
+        self._by_prefix: Dict[Tuple, PrefixEntry] = {}   # (ns, prefix)
         self._by_slot: Dict[int, PrefixEntry] = {}
         self._clock = itertools.count(1)
         self.hits = 0
@@ -107,8 +111,8 @@ class PrefixIndex:
             yield b
             b -= self.block
 
-    def lookup(self, prompt,
-               peek: bool = False) -> Optional[Tuple[PrefixEntry, int]]:
+    def lookup(self, prompt, peek: bool = False,
+               ns=None) -> Optional[Tuple[PrefixEntry, int]]:
         """Longest block-aligned cached prefix of ``prompt`` (capped at
         ``len(prompt) - 1``; the last prompt token is always re-prefilled:
         its forward yields the first-token logits).  Returns
@@ -118,10 +122,11 @@ class PrefixIndex:
         :meth:`acquire` the entry if it uses it.  ``peek=True`` probes
         without counting or touching — the engine uses it to find which
         entries an incoming admission wave would hit, so the eviction
-        sweep can spare them."""
+        sweep can spare them.  ``ns`` scopes the probe: only entries
+        inserted under the same namespace can match."""
         toks = tuple(int(t) for t in prompt)
         for m in self._boundaries(len(toks) - 1):
-            entry = self._by_prefix.get(toks[:m])
+            entry = self._by_prefix.get((ns, toks[:m]))
             if entry is not None:
                 if not peek:
                     entry.tick = next(self._clock)
@@ -132,22 +137,25 @@ class PrefixIndex:
         return None
 
     def insert(self, slot: Optional[int], tokens,
-               pages: Optional[List[int]] = None) -> Optional[PrefixEntry]:
+               pages: Optional[List[int]] = None,
+               ns=None) -> Optional[PrefixEntry]:
         """Retain ``slot`` (dense) or ``pages`` (paged) as the resident
-        K/V for ``tokens``, registering it under every block-boundary
-        prefix.  Returns the new entry, or None when nothing would
-        become addressable (duplicate content, or shorter than one
-        block) — the caller then frees the slot/pages normally instead
-        of retaining a useless row."""
+        K/V for ``tokens`` under namespace ``ns``, registering it under
+        every block-boundary prefix.  Returns the new entry, or None
+        when nothing would become addressable (duplicate content in the
+        same namespace, or shorter than one block) — the caller then
+        frees the slot/pages normally instead of retaining a useless
+        row."""
         key = tuple(int(t) for t in tokens)
-        if len(key) < self.block or key in self._entries:
+        if len(key) < self.block or (ns, key) in self._entries:
             return None
-        entry = PrefixEntry(slot, key, next(self._clock), pages=pages)
-        self._entries[key] = entry
+        entry = PrefixEntry(slot, key, next(self._clock), pages=pages,
+                            ns=ns)
+        self._entries[(ns, key)] = entry
         if slot is not None:
             self._by_slot[slot] = entry
         for m in self._boundaries(len(key)):
-            pk = key[:m]
+            pk = (ns, key[:m])
             # newest entry wins a shared prefix key: recency is the
             # better eviction survivor, and any matching row is correct
             self._by_prefix[pk] = entry
@@ -173,7 +181,7 @@ class PrefixIndex:
             entry.refs -= 1
 
     def _unlink(self, entry: PrefixEntry):
-        del self._entries[entry.tokens]
+        del self._entries[(entry.ns, entry.tokens)]
         if entry.slot is not None:
             del self._by_slot[entry.slot]
         for pk in entry.keys:

@@ -245,6 +245,12 @@ class EngineSupervisor:
         return self.engine.tokenizer
 
     @property
+    def adapter_registry(self):
+        """The PERSISTENT adapter registry (same object across rebuilds:
+        the factory hands it to every build; only residency is fresh)."""
+        return self.engine.adapter_registry
+
+    @property
     def max_len(self) -> int:
         return self.engine.max_len
 

@@ -379,13 +379,23 @@ def Print(input, first_n=-1, message=None, summarize=20,
           print_tensor_name=True, print_tensor_type=True,
           print_tensor_shape=True, print_tensor_layout=True,
           print_tensor_lod=True, print_phase="both"):
-    """Debug print op: identity that prints at evaluation time."""
+    """Debug print op: identity that prints at evaluation time.  Traced
+    values route through ``jax.debug.print`` — the contents appear at RUN
+    time from the device-side debug stream, with no host sync (or
+    tracer-concretization error) inside a compiled graph; concrete values
+    print eagerly (same convert_print arrangement as dy2static)."""
     from .graph import Variable as _GV, op_var
 
     def apply(t):
-        v = t.numpy() if hasattr(t, "numpy") else t
-        print(f"{message or ''} {getattr(input, 'name', '')} "
-              f"shape={getattr(v, 'shape', None)}\n{v}")
+        import jax
+
+        v = t._value if hasattr(t, "_value") else t
+        head = (f"{message or ''} {getattr(input, 'name', '')} "
+                f"shape={getattr(v, 'shape', None)}")
+        if isinstance(v, jax.core.Tracer):
+            jax.debug.print(head + "\n{v}", v=v)
+        else:
+            print(f"{head}\n{v}")
         return t
 
     if isinstance(input, _GV):

@@ -61,11 +61,14 @@ def _blocking(port, payload, tenant, out, lock, timeout=600):
                       "X-Tenant": tenant})
         r = conn.getresponse()
         body = r.read()
-        n_tok = (len(json.loads(body)["choices"][0]["token_ids"])
-                 if r.status == 200 else 0)
+        token_ids = (json.loads(body)["choices"][0]["token_ids"]
+                     if r.status == 200 else [])
         with lock:
             out.append({"kind": "blocking", "status": r.status,
-                        "tokens": n_tok,
+                        "tokens": len(token_ids),
+                        "token_ids": token_ids,
+                        "prompt": tuple(payload["prompt"]),
+                        "model": payload.get("model"),
                         "wall_s": time.perf_counter() - t0})
     except Exception as e:  # noqa: BLE001 — a hang/5xx fails the lane
         with lock:
@@ -121,8 +124,10 @@ def main() -> int:
     from paddle_tpu import observability as obs
     from paddle_tpu.models import build_gpt, gpt_config
     from paddle_tpu.observability import flight
-    from paddle_tpu.serving import Engine, EngineSupervisor
-    from paddle_tpu.serving.engine import SERVING_REDISPATCHED
+    from paddle_tpu.serving import AdapterRegistry, Engine, EngineSupervisor
+    from paddle_tpu.serving import make_lora
+    from paddle_tpu.serving.engine import (SERVING_ADAPTER_TOKENS,
+                                           SERVING_REDISPATCHED)
     from paddle_tpu.serving.gateway import TenantConfig, start_gateway
     from paddle_tpu.serving.supervisor import SERVING_RESTARTS
     from paddle_tpu.testing import faults
@@ -139,25 +144,39 @@ def main() -> int:
         m.eval()
         models.append(m)
 
-    # decode fast path ON under chaos (ISSUE 10) and the PAGED pool under
-    # it (ISSUE 11): every rebuild must drop the prefix cache AND the
-    # page tables cleanly (fresh pool, fresh index, fresh allocator — no
-    # stale-row or stale-page reuse) and keep speculative greedy exact,
-    # which the token-count invariant below catches (a stale, replayed
-    # or mis-mapped page would change the emitted tokens)
+    # decode fast path ON under chaos (ISSUE 10), the PAGED pool under it
+    # (ISSUE 11), and MULTI-LORA adapters over both (ISSUE 12): every
+    # rebuild must drop the prefix cache AND the page tables AND the
+    # adapter banks cleanly (fresh pool, fresh index, fresh allocator,
+    # fresh residency with zero pins — no stale rows, pages or bank
+    # slots) and keep speculative greedy exact, which the token-count
+    # and per-adapter-parity invariants below catch (a stale, replayed
+    # or mis-mapped page/bank row would change the emitted tokens).
+    # Each replica gets its OWN registry holding IDENTICAL adapters
+    # (same seeds), so a cross-replica gateway re-dispatch serves the
+    # same variant — the registries persist across that replica's
+    # rebuilds while residency is per-build.
+    ADAPTERS = ["lora-a", "lora-b", "lora-c"]
+    regs = []
+    for _ in models:
+        reg = AdapterRegistry(cfg, max_resident=3, max_rank=8)
+        for j, nm in enumerate(ADAPTERS):
+            reg.register(make_lora(cfg, rank=2 + 2 * j, seed=40 + j,
+                                   name=nm, std=0.2))
+        regs.append(reg)
     engines_built: list = []
 
-    def _factory(mm):
+    def _factory(mm, reg):
         def build():
             e = Engine(mm, max_slots=SLOTS, max_len=48, max_queue=16,
                        prefix_cache=True, prefix_block=4, speculative_k=3,
-                       paged_kv=True)
+                       paged_kv=True, adapters=reg)
             engines_built.append(e)
             return e
         return build
 
     sups = [EngineSupervisor(
-        _factory(m), name=f"engine{i}", poll_interval_s=0.02,
+        _factory(m, regs[i]), name=f"engine{i}", poll_interval_s=0.02,
         max_restarts=6, max_redispatch=3)
         for i, m in enumerate(models)]
     tenants = [TenantConfig("vip", priority="interactive", weight=4.0,
@@ -175,6 +194,21 @@ def main() -> int:
         for i in range(4):
             _blocking(port, {"prompt": [i + 1, 2, 3],
                              "max_tokens": 2}, "vip", [], lock)
+        # per-adapter reference outputs BEFORE any kill: a completed
+        # request for the same (adapter, prompt) pair during/after the
+        # restarts must emit exactly these tokens — a stale or
+        # mis-loaded bank row after a rebuild would break the parity
+        ref_pairs = []
+        for j, nm in enumerate([None] + ADAPTERS):
+            prompt = [j + 2, 5, 9, 3]
+            payload = {"prompt": prompt, "max_tokens": MAX_TOKENS}
+            if nm is not None:
+                payload["model"] = nm
+            o = []
+            _blocking(port, payload, "vip", o, lock)
+            assert o and o[0]["status"] == 200, f"reference failed: {o}"
+            ref_pairs.append((nm, tuple(prompt), o[0]["token_ids"]))
+        reference = {(nm, pr): toks for nm, pr, toks in ref_pairs}
 
         def spawn(target, payload, tenant):
             th = threading.Thread(target=target,
@@ -187,8 +221,16 @@ def main() -> int:
         kills = 0
         sent = 0
         for i in range(total):
-            prompt = [int(t) for t in rs.randint(1, cfg.vocab_size, 4)]
-            payload = {"prompt": prompt, "max_tokens": MAX_TOKENS}
+            if i % 3 == 0:
+                # a known (adapter, prompt) pair: its completion must
+                # match the pre-kill reference bit for bit
+                nm, pr, _ = ref_pairs[(i // 3) % len(ref_pairs)]
+                payload = {"prompt": list(pr), "max_tokens": MAX_TOKENS}
+                if nm is not None:
+                    payload["model"] = nm
+            else:
+                prompt = [int(t) for t in rs.randint(1, cfg.vocab_size, 4)]
+                payload = {"prompt": prompt, "max_tokens": MAX_TOKENS}
             tenant = "vip" if i % 3 else "bulk"
             if i % (total // N_STREAMING) == 1 and tenant == "vip":
                 spawn(_streaming, payload, tenant)
@@ -224,6 +266,17 @@ def main() -> int:
         # no duplicated tokens: completed = exactly MAX_TOKENS each
         wrong = [o for o in completed if o["tokens"] != MAX_TOKENS]
         assert not wrong, f"token-count mismatch (duplication?): {wrong}"
+        # per-adapter token parity across restarts: every completed
+        # known-pair request equals its pre-kill reference
+        checked = 0
+        for o in blocking:
+            key = (o.get("model"), o.get("prompt"))
+            if o["status"] == 200 and key in reference:
+                assert o["token_ids"] == reference[key], \
+                    f"adapter parity broke across a restart: {o} != " \
+                    f"{reference[key]}"
+                checked += 1
+        assert checked > 0, "no known-pair request completed"
         # one decode signature per engine build; every armed kill was
         # absorbed by a restart.  >= not ==: a lane run under external
         # resource pressure can see real (non-injected) engine deaths —
@@ -255,6 +308,10 @@ def main() -> int:
             # up as used pages no active request or cache entry holds)
             assert st["kv_pages_free"] + st["kv_pages_used"] == \
                 st["kv_num_pages"], st
+            # adapter banks live under chaos: residency is per-build,
+            # pins bounded by residents bounded by capacity
+            assert 0 <= st["adapters_pinned"] <= st["adapters_resident"] \
+                <= st["adapter_bank_capacity"], st
 
         # telemetry through the wire
         conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
@@ -262,6 +319,8 @@ def main() -> int:
         text = conn.getresponse().read().decode()
         conn.close()
         assert SERVING_RESTARTS in text, "restart counter missing"
+        assert SERVING_ADAPTER_TOKENS in text, \
+            "per-adapter token counter missing from /metrics"
         restarts_c = obs.registry().get(SERVING_RESTARTS)
         assert restarts_c is not None and restarts_c.total() == restarts
         redis_c = obs.registry().get(SERVING_REDISPATCHED)
@@ -290,6 +349,13 @@ def main() -> int:
         e._page_alloc.check()
         assert e._page_alloc.n_used == 0, \
             f"leaked pages: {e._page_alloc!r}"
+        # zero leaked adapter pins, every build (death + drain paths
+        # both unpin; a leak would keep refs > 0 here)
+        e._adapters.check()
+    # fresh adapter banks per rebuild: every build got its OWN residency
+    # (stale bank reuse across pools is impossible by construction)
+    assert len({id(e._adapters) for e in engines_built}) == \
+        len(engines_built), "a rebuild reused a residency tracker"
     summary["engine_builds_checked"] = len(engines_built)
     summary["drained"] = True
     print(json.dumps(summary))

@@ -39,6 +39,7 @@ DEFAULT_SUBSET = [
     "tests/test_gateway.py",
     "tests/test_self_healing.py",
     "tests/test_robustness.py",
+    "tests/test_multi_lora.py",
 ]
 
 # decode fast-path lane (ISSUE 10): prefix cache + speculation + int8 KV
@@ -89,6 +90,78 @@ assert {"prefix_admit", "prefix_insert", "spec_verify"} <= names, names
 print("fast-path lane ok:", {
     "prefix_hits": st["prefix_hits"], "spec_accepted": st["spec_accepted"],
     "kv_pool_bytes": st["kv_pool_bytes"],
+    "decode_compiles": st["decode_compiles"]})
+"""
+
+# multi-adapter lane (ISSUE 12): two tenants on two LoRA adapters
+# through the HTTP gateway with telemetry live — the per-adapter
+# gauges/counters must export, cold loads hit the flight recorder, and
+# decode stays at ONE compiled signature with the adapter path on.
+MULTI_LORA_LANE = r"""
+import http.client, json
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu import observability as obs
+from paddle_tpu.models import build_gpt, gpt_config
+from paddle_tpu.observability import flight
+from paddle_tpu.serving import AdapterRegistry, Engine, make_lora
+from paddle_tpu.serving.engine import (
+    SERVING_ADAPTER_LOADS, SERVING_ADAPTER_TOKENS, SERVING_ADAPTER_TTFT,
+    SERVING_ADAPTERS_RESIDENT)
+from paddle_tpu.serving.gateway import TenantConfig, start_gateway
+
+assert obs.enabled(), "PADDLE_TPU_TELEMETRY=1 must bootstrap telemetry"
+cfg = gpt_config("gpt-tiny", max_position_embeddings=128,
+                 hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+paddle.seed(0)
+model = build_gpt(cfg)
+model.eval()
+reg = AdapterRegistry(model, max_resident=2, max_rank=8)
+reg.register(make_lora(cfg, rank=4, seed=1, name="tenant-a-model",
+                       std=0.4))
+reg.register(make_lora(cfg, rank=4, seed=2, name="tenant-b-model",
+                       std=0.4))
+eng = Engine(model, max_slots=2, max_len=48, adapters=reg)
+stack = start_gateway(
+    [eng], tenants=[TenantConfig("ta"), TenantConfig("tb")],
+    model_name="base")
+try:
+    outs = {}
+    for tenant, mdl in (("ta", "tenant-a-model"), ("tb", "tenant-b-model"),
+                        ("ta", None)):
+        conn = http.client.HTTPConnection("127.0.0.1", stack.port,
+                                          timeout=300)
+        payload = {"prompt": [3, 5, 7, 9], "max_tokens": 4}
+        if mdl is not None:
+            payload["model"] = mdl
+        conn.request("POST", "/v1/completions",
+                     json.dumps(payload).encode(),
+                     {"Content-Type": "application/json",
+                      "X-Tenant": tenant})
+        r = conn.getresponse()
+        body = json.loads(r.read())
+        conn.close()
+        assert r.status == 200, (r.status, body)
+        outs[(tenant, mdl)] = body["choices"][0]["token_ids"]
+    assert outs[("ta", "tenant-a-model")] != outs[("tb", "tenant-b-model")]
+    st = eng.stats()
+    assert st["decode_compiles"] == 1, st
+    assert st["adapter_loads"] == 2 and st["adapters_resident"] == 2, st
+finally:
+    stack.close()
+    eng.shutdown()
+d = obs.dump()
+for name in (SERVING_ADAPTER_LOADS, SERVING_ADAPTER_TOKENS):
+    assert name in d["counters"], (name, sorted(d["counters"]))
+assert SERVING_ADAPTERS_RESIDENT in d["gauges"]
+assert SERVING_ADAPTER_TTFT in d["histograms"]
+text = obs.to_prometheus_text()
+assert SERVING_ADAPTER_TOKENS in text and SERVING_ADAPTERS_RESIDENT in text
+names = {e["name"] for e in flight.events("serving")}
+assert "adapter_load" in names, names
+print("multi-lora lane ok:", {
+    "adapter_loads": st["adapter_loads"],
+    "resident": st["adapters_resident"],
     "decode_compiles": st["decode_compiles"]})
 """
 
@@ -171,6 +244,15 @@ def main() -> int:
         if fp_rc != 0:
             print("fast-path lane FAILED", file=sys.stderr)
         rc = rc or fp_rc
+        # multi-adapter lane (ISSUE 12): two tenants on two LoRA
+        # adapters through the gateway — per-adapter telemetry exports,
+        # one decode signature with the adapter path live
+        print("telemetry smoke: multi-lora lane", file=sys.stderr)
+        ml_rc = subprocess.call([sys.executable, "-c", MULTI_LORA_LANE],
+                                env=env, cwd=root)
+        if ml_rc != 0:
+            print("multi-lora lane FAILED", file=sys.stderr)
+        rc = rc or ml_rc
         # tpu-lint ratchet gate (ISSUE 7): runs even when the pytest
         # subset has unrelated failures, in its own interpreter (the
         # analyzer is jax-free, so it cannot be broken by runtime drift)
