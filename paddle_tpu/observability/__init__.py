@@ -89,6 +89,9 @@ from . import flight  # noqa: E402,F401
 from . import trace  # noqa: E402,F401
 from . import watchdog  # noqa: E402,F401
 from .trace import span  # noqa: E402,F401
+# request journeys (per-request phase timelines + the windowed feed);
+# imported after registry() exists — journey feeds phase histograms
+from . import journey  # noqa: E402,F401
 
 _bootstrap_from_env()
 watchdog._bootstrap_from_env()

@@ -88,10 +88,13 @@ def arange(start=0, end=None, step=1, dtype=None, name=None) -> Tensor:
 
 
 def linspace(start, stop, num, dtype=None, name=None) -> Tensor:
-    def val(v):
-        return v.item() if isinstance(v, Tensor) else v
-    return Tensor(jnp.linspace(val(start), val(stop), int(val(num)),
-                               dtype=_dt(dtype)), _internal=True)
+    # start/stop ride as 0-d device operands — no host round-trip; only
+    # `num` must be a host int (it sets the output SHAPE, the one thing
+    # jnp.linspace cannot take from the device)
+    s = start._value if isinstance(start, Tensor) else start
+    e = stop._value if isinstance(stop, Tensor) else stop
+    return Tensor(jnp.linspace(s, e, int(num), dtype=_dt(dtype)),
+                  _internal=True)
 
 
 def logspace(start, stop, num, base=10.0, dtype=None, name=None) -> Tensor:

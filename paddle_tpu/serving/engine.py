@@ -196,10 +196,11 @@ class RequestHandle:
 
     def __init__(self, engine, prompt, max_new_tokens, eos_token_id,
                  temperature, top_k, seed, deadline_s, stream,
-                 adapter=None):
+                 adapter=None, journey=None):
         self.request_id = next(_ids)
         self.redispatches = 0        # times re-enqueued after an engine death
         self.adapter = adapter       # LoRA adapter name (None = base model)
+        self.journey = journey       # observability.journey.Journey or None
         self._adapter_slot = 0       # bank row while active (0 = zero adapter)
         self._adapter_pinned = False
         self.prompt = prompt
@@ -224,6 +225,9 @@ class RequestHandle:
         self._cow = None                  # pending (src, dst) page COW copy
         now = time.perf_counter()
         self.t_submit = now
+        self.t_queue = now           # engine-queue entry (reset on resubmit)
+        self._stall_t0: Optional[float] = None   # HOL stall began (journey)
+        self._stall_kind: Optional[str] = None   # adapter_stall | page_stall
         self.t_admit: Optional[float] = None
         self._t_last_token = now
         self.ttft_s: Optional[float] = None
@@ -623,14 +627,20 @@ class Engine:
                temperature: float = 0.0, top_k: int = 0, seed: int = 0,
                deadline_s: Optional[float] = None,
                stream: Optional[Callable[[int], None]] = None,
-               adapter: Optional[str] = None) -> RequestHandle:
+               adapter: Optional[str] = None,
+               journey=None) -> RequestHandle:
         """Queue one request; returns a Future-style handle.  Raises
         :class:`QueueFullError` when the bounded admission queue is at
         capacity (backpressure: the caller sheds load or retries) and
         ValueError when the request cannot fit a slot.  ``adapter``
         names a registered LoRA adapter (``Engine(adapters=registry)``);
         unknown names and ranks that can never fit the bank raise the
-        registry's typed errors HERE, not after queueing."""
+        registry's typed errors HERE, not after queueing.  ``journey``
+        is an optional :class:`~paddle_tpu.observability.journey.Journey`
+        the engine appends its phase records to (engine queue wait,
+        adapter/page stalls, prefill, each decode dispatch) — the
+        request-scoped trace context the gateway threads through the
+        whole serving path (docs/observability.md "Request journeys")."""
         # lock-free monitor-flag reads: _dead/_stop/_draining make single
         # benign transitions; at worst a racing submit lands one sweep
         # late and fails through the death classification instead
@@ -680,7 +690,7 @@ class Engine:
         eos = self.eos_token_id if eos_token_id is ... else eos_token_id
         req = RequestHandle(self, ids, max_new_tokens, eos, temperature,
                             top_k, seed, deadline_s, stream,
-                            adapter=adapter)
+                            adapter=adapter, journey=journey)
         hook = self.admission_hook
         if hook is not None:
             try:
@@ -742,6 +752,9 @@ class Engine:
         req._engine = self
         req._state = "queued"
         req._torn = False       # live again: this engine may emit for it
+        req.t_queue = time.perf_counter()   # journey engine_queue restarts
+        req._stall_t0 = None
+        req._stall_kind = None
         req.slot = None
         req._prefix_src = None  # the dead engine's pool (and index) is gone
         req._prefix_match = 0
@@ -1501,7 +1514,10 @@ class Engine:
             r._finish(RequestInterruptedError(
                 r.request_id, len(r._tokens), cause))
         if taken_ids:
-            flight.record("serving", "handoff", n=len(taken_ids))
+            flight.record("serving", "handoff", n=len(taken_ids),
+                          requests=",".join(
+                              str(r.request_id) for r in fresh
+                              if id(r) in taken_ids))
 
     def _step_once(self) -> bool:
         """One scheduler iteration: sweep, admit (batched prefill), one
@@ -1588,6 +1604,38 @@ class Engine:
         """Pages covering positions [0, n_tokens) at the pool page size."""
         return -(-int(n_tokens) // self._page_alloc.page_size)
 
+    @staticmethod
+    def _journey_admit_locked(req: RequestHandle, **attrs):
+        """Close the request's engine-queue window on its journey: one
+        ``engine_queue`` phase (queue entry -> admit), split at the
+        stall boundary into an explicit ``adapter_stall`` /
+        ``page_stall`` phase when the head-of-line request spent part of
+        that window blocked on bank pins or page exhaustion — the
+        attribution that turns "TTFT was 480 ms" into "300 ms of it was
+        a page stall"."""
+        j = req.journey
+        if j is None:
+            return
+        stall_t0, kind = req._stall_t0, req._stall_kind
+        req._stall_t0 = None
+        req._stall_kind = None
+        if stall_t0 is not None and kind is not None and \
+                stall_t0 > req.t_queue:
+            j.phase("engine_queue", req.t_queue, stall_t0 - req.t_queue,
+                    **attrs)
+            j.phase(kind, stall_t0, req.t_admit - stall_t0)
+        else:
+            j.phase("engine_queue", req.t_queue,
+                    req.t_admit - req.t_queue, **attrs)
+
+    def _mark_stall_locked(self, req: RequestHandle, kind: str):
+        """First time the head-of-line request blocks this episode:
+        remember when, so the admit-time journey phase can attribute the
+        stalled tail of the queue wait to its cause."""
+        if req._stall_t0 is None:
+            req._stall_t0 = time.perf_counter()
+            req._stall_kind = kind
+
     def _pin_adapter_locked(self, req: RequestHandle) -> bool:
         """Make the request's adapter RESIDENT and pinned before its slot
         is taken, scheduling a cold bank upload when needed.  False means
@@ -1601,6 +1649,7 @@ class Engine:
         ev0 = res.evictions
         got = res.acquire(req.adapter)
         if got is None:
+            self._mark_stall_locked(req, "adapter_stall")
             if not self._adapter_stalled:
                 self._adapter_stalled = True
                 self._counts["adapter_load_stalls"] += 1
@@ -1620,6 +1669,7 @@ class Engine:
         if dev:
             self._counts["adapter_evictions"] += dev
             flight.record("serving", "adapter_evict", n=dev,
+                          request=req.request_id,
                           for_adapter=req.adapter)
             registry().counter(
                 SERVING_ADAPTER_EVICTIONS,
@@ -1628,7 +1678,7 @@ class Engine:
         if cold:
             if req.adapter not in self._adapter_uploads:
                 self._counts["adapter_loads"] += 1
-                self._adapter_uploads[req.adapter] = slot
+                self._adapter_uploads[req.adapter] = (slot, req.request_id)
         else:
             self._counts["adapter_hits"] += 1
         return True
@@ -1681,6 +1731,7 @@ class Engine:
             req.slot = self._pool.alloc(req)
             req._state = "active"
             req.t_admit = time.perf_counter()
+            self._journey_admit_locked(req, slot=req.slot)
             if self._prefix is not None:
                 hit = self._prefix.lookup(req.prompt, ns=req.adapter)
                 if hit is not None:
@@ -1758,6 +1809,7 @@ class Engine:
                 # parked request never holds bank capacity; flight-record
                 # the stall once per stall episode, not per 20 ms sweep
                 self._unpin_adapter_locked(req)
+                self._mark_stall_locked(req, "page_stall")
                 if not self._page_stalled:
                     self._page_stalled = True
                     self._counts["page_alloc_stalls"] += 1
@@ -1771,6 +1823,9 @@ class Engine:
             req.slot = self._pool.alloc(req)
             req._state = "active"
             req.t_admit = time.perf_counter()
+            self._journey_admit_locked(req, slot=req.slot,
+                                       pages_reserved=len(pages),
+                                       pages_shared=shared_full)
             if hit is not None:
                 entry, matched = hit
                 self._prefix.touch(entry)      # count the peeked hit
@@ -1831,9 +1886,16 @@ class Engine:
         if not batch:
             return False
         if not self._built:
+            t_b0 = time.perf_counter()
             with span("serving.build"):
                 self._build()
-        self._flush_adapter_uploads()
+            dt_b = time.perf_counter() - t_b0
+            for req in batch:
+                if req.journey is not None:
+                    # cold start: the first admission wave pays the pool
+                    # build — attribute it, don't leave a mystery gap
+                    req.journey.phase("build", t_b0, dt_b)
+        self._flush_adapter_uploads(batch)
         if evicted:
             registry().counter(
                 SERVING_PREFIX_EVICTIONS,
@@ -1872,23 +1934,25 @@ class Engine:
         self._keys[slot] = req._base_key
         self._aids[slot] = req._adapter_slot
 
-    def _flush_adapter_uploads(self):
+    def _flush_adapter_uploads(self, batch=()):
         """Admission-time load of cold adapters: upload every scheduled
         adapter's zero-padded factors into its bank row (eager device
         writes, once per cold admission — never per token).  Runs on the
         scheduler thread after ``_build`` so the banks exist; the
         residency mapping is re-checked under the lock in case a stalled
-        request's row was LRU-reused before its upload ran."""
+        request's row was LRU-reused before its upload ran.  ``batch``
+        is this admission wave — every admitted request waiting on a
+        loaded adapter gets an ``adapter_load`` phase on its journey."""
         if self._adapters is None:
             return
         with self._lock:
             if not self._adapter_uploads:
                 return
-            ups = [(name, slot) for name, slot in
+            ups = [(name, slot, rid) for name, (slot, rid) in
                    self._adapter_uploads.items()
                    if self._adapters.slot_of(name) == slot]
             self._adapter_uploads.clear()
-        for name, slot in ups:
+        for name, slot, rid in ups:
             t0 = time.perf_counter()
             with span("serving.adapter_load", adapter=name, bank_slot=slot):
                 self._load_adapter_bank(slot,
@@ -1902,7 +1966,12 @@ class Engine:
                 SERVING_ADAPTER_LOADS,
                 "cold adapter loads into the device bank").inc(1.0)
             flight.record("serving", "adapter_load", adapter=name,
-                          bank_slot=slot, load_ms=round(dt * 1e3, 3))
+                          bank_slot=slot, request=rid,
+                          load_ms=round(dt * 1e3, 3))
+            for req in batch:
+                if req.adapter == name and req.journey is not None:
+                    req.journey.phase("adapter_load", t0, dt, adapter=name,
+                                      bank_slot=slot)
 
     def _load_adapter_bank(self, slot: int, adapter):
         """Write one adapter's factors (zero-padded to the bank's
@@ -1990,6 +2059,11 @@ class Engine:
         registry().histogram(SERVING_BATCH_SECONDS,
                              "prefill/decode batch wall time").observe(
             dt, labels={"phase": "prefill"})
+        for req in batch:
+            if req.journey is not None:
+                req.journey.phase("prefill", t0, dt, n=len(batch),
+                                  bucket=bucket,
+                                  prompt=int(req.prompt.size))
         self._emit_first_tokens(batch, out, by_slot=False)
 
     def _prefill_hits(self, hits) -> None:
@@ -2008,6 +2082,7 @@ class Engine:
         src = np.full(P, sentinel, np.int32)
         dst = np.full(P, sentinel, np.int32)
         n_copy = 0
+        cow_ids: list[int] = []      # requests whose boundary page COWs
         n_rows = self.max_slots + 1
         tails = [r.prompt.size - r._prefix_match for r in hits]
         tb = _bucket(max(tails), 1, self._limit)
@@ -2022,6 +2097,7 @@ class Engine:
                     if req._cow is not None:
                         src[n_copy], dst[n_copy] = req._cow
                         n_copy += 1
+                        cow_ids.append(req.request_id)
                         req._cow = None
                 else:
                     src[i], dst[i] = e.slot, req.slot
@@ -2059,7 +2135,9 @@ class Engine:
                         SERVING_KV_COW_COPIES,
                         "shared KV pages cloned for a diverging writer"
                     ).inc(float(n_copy))
-                    flight.record("serving", "page_cow", copies=n_copy)
+                    flight.record("serving", "page_cow", copies=n_copy,
+                                  requests=",".join(map(str, cow_ids)))
+            t_copy_end = time.perf_counter()
             extra = ((self._adp_args(aids_snap),)
                      if self._adapters is not None else ())
             with span("serving.tail_prefill", n=len(hits), bucket=tb):
@@ -2080,12 +2158,28 @@ class Engine:
         finally:
             if self._decode_timeout_s is not None:
                 _watchdog.disarm()
-        dt = time.perf_counter() - t0
+        t_end = time.perf_counter()
+        dt = t_end - t0
         with self._lock:
             self._counts["prefill_batches"] += 1
         registry().histogram(SERVING_BATCH_SECONDS,
                              "prefill/decode batch wall time").observe(
             dt, labels={"phase": "tail_prefill"})
+        cow_set = set(cow_ids)
+        for req in hits:
+            if req.journey is None:
+                continue
+            m = req._prefix_match
+            # dense hits device-copy their cached row; paged hits share
+            # pages by reference (zero-copy) unless a boundary page COWed
+            if not paged or req.request_id in cow_set:
+                req.journey.phase("prefix_copy", t0, t_copy_end - t0,
+                                  cached_tokens=m)
+            req.journey.phase("tail_prefill", t_copy_end,
+                              t_end - t_copy_end, cached_tokens=m,
+                              tail=int(req.prompt.size - m),
+                              zero_copy=bool(paged and
+                                             req.request_id not in cow_set))
         self._emit_first_tokens(hits, out, by_slot=True)
 
     def _emit_first_tokens(self, batch, out, by_slot: bool):
@@ -2109,6 +2203,8 @@ class Engine:
                 continue
             token = (int(row) if self.sample_on_device else
                      _sample_row(row, req.temperature, req.top_k, req._rng))
+            if req.journey is not None:
+                req.journey.mark_first_token(now)
             finished = self._emit_one(req, token)
             if req.adapter is not None:
                 registry().counter(
@@ -2245,6 +2341,14 @@ class Engine:
                     SERVING_ADAPTER_TOKENS,
                     "tokens served, per adapter").inc(
                     float(emitted), labels={"adapter": req.adapter})
+            if req.journey is not None:
+                # one phase per batched DISPATCH the request rode (the
+                # existing per-token boundary), never per token
+                attrs = {"emitted": emitted, "active": len(active)}
+                if d is not None:
+                    attrs["drafted"] = W - 1
+                    attrs["accepted"] = len(run) - 1
+                req.journey.phase("decode", t0, dt, **attrs)
             with self._lock:
                 self._counts["tokens"] += emitted
                 self._lengths[slot] = old_len + emitted
@@ -2331,7 +2435,7 @@ class Engine:
                     req._pages = None
                     self._counts["prefix_inserts"] += 1
                     flight.record("serving", "prefix_insert", pages=keep,
-                                  cached_tokens=n)
+                                  request=req.request_id, cached_tokens=n)
                     retained = True
             else:
                 entry = (self._prefix.insert(slot, cached, ns=req.adapter)
@@ -2340,7 +2444,7 @@ class Engine:
                     self._pool.retain(slot, entry)
                     self._counts["prefix_inserts"] += 1
                     flight.record("serving", "prefix_insert", slot=slot,
-                                  cached_tokens=n)
+                                  request=req.request_id, cached_tokens=n)
                     retained = True
         if self.paged_kv:
             self._release_pages_locked(req)

@@ -31,6 +31,7 @@ import time
 import numpy as np
 
 from ...observability import flight, registry
+from ...observability.journey import TelemetryWindow
 from ...testing import faults
 from ..engine import (SERVING_REDISPATCHED, EngineDeadError, QueueFullError,
                       RequestInterruptedError)
@@ -73,19 +74,24 @@ class GatewayRequest:
     __slots__ = ("id", "creq", "tenant", "priority", "cost", "prompt",
                  "t_enqueue", "t_dispatch", "token_q", "ready", "handle",
                  "error", "engine_name", "deadline", "done_ev",
-                 "final_error", "redispatches", "adapter")
+                 "final_error", "redispatches", "adapter", "journey",
+                 "t_queue0", "t_first_token")
 
     def __init__(self, creq: CompletionRequest, tenant: str, priority: str,
-                 prompt: np.ndarray, adapter: str | None = None):
+                 prompt: np.ndarray, adapter: str | None = None,
+                 journey=None):
         self.id = f"cmpl-{next(_ids)}"
         self.creq = creq
         self.tenant = tenant
         self.priority = priority
         self.prompt = prompt
         self.adapter = adapter       # LoRA adapter name (model= resolved)
+        self.journey = journey       # observability Journey (or None)
         self.cost = float(prompt.size + creq.max_tokens)
         now = time.perf_counter()
         self.t_enqueue = now
+        self.t_queue0 = now          # current queue-wait window start
+        self.t_first_token: float | None = None
         self.t_dispatch: float | None = None
         self.deadline = (None if creq.deadline_s is None
                          else now + creq.deadline_s)
@@ -138,6 +144,10 @@ class Gateway:
             engine died before any token reached the client (engine
             replacements on ANOTHER replica; a supervisor's same-handle
             re-dispatches have their own budget).
+        window_s: trailing window of the :class:`TelemetryWindow` feed
+            behind :meth:`window_stats` (queue-wait/TTFT/per-token
+            percentiles, shed rate, per-phase time shares — the
+            closed-loop autoscaler input, ROADMAP item 5).
         model_name: echoed in completion responses.
         start: start the dispatcher thread immediately (tests stage
             queues deterministically with False, then call start()).
@@ -148,7 +158,7 @@ class Gateway:
                  api_keys: dict | None = None, names=None,
                  shedder: LoadShedder | None = None,
                  max_queue_total: int | None = None, dispatch_slack: int = 1,
-                 max_redispatch: int = 2,
+                 max_redispatch: int = 2, window_s: float = 60.0,
                  model_name: str = "paddle-tpu", start: bool = True):
         if hasattr(engines, "submit"):
             engines = [engines]
@@ -156,6 +166,7 @@ class Gateway:
         self.scheduler = FairShareScheduler(
             tenants, default=default_tenant, max_queue_total=max_queue_total)
         self.shedder = shedder or LoadShedder()
+        self.window = TelemetryWindow(window_s=window_s)
         self.api_keys = dict(api_keys) if api_keys else None
         self.model_name = model_name
         self.dispatch_slack = int(dispatch_slack)
@@ -241,10 +252,16 @@ class Gateway:
         return False
 
     # -- admission (handler threads) -----------------------------------------
-    def admit(self, creq: CompletionRequest, tenant: str) -> GatewayRequest:
+    def admit(self, creq: CompletionRequest, tenant: str,
+              journey=None) -> GatewayRequest:
         """Validate fit, run the shed check, enqueue under the tenant's
         fair-share caps.  Raises ProtocolError (4xx), AdmissionError
-        (429, incl. SLO shed) or GatewayClosedError (503)."""
+        (429, incl. SLO shed) or GatewayClosedError (503).  ``journey``
+        (a :mod:`~paddle_tpu.observability.journey` Journey, usually
+        minted by the HTTP handler from ``X-Request-Id``) rides the
+        returned item through dispatch into the engine — every layer
+        appends its phase records to it."""
+        t_admit0 = time.perf_counter()
         if self._stop_ev.is_set():
             raise GatewayClosedError("gateway is shut down")
         if self._dispatcher_error is not None:
@@ -254,6 +271,7 @@ class Gateway:
                 f"{self._dispatcher_error}")
         if self._drain_ev.is_set():
             self._count(tenant, "shed")
+            self.window.observe_shed("draining")
             registry().counter(GATEWAY_SHED, "requests shed by reason").inc(
                 1.0, labels={"tenant": tenant, "reason": "draining"})
             raise AdmissionError(
@@ -274,7 +292,13 @@ class Gateway:
         cfg = self.scheduler.tenant_config(tenant)
         priority = creq.priority or cfg.priority
         item = GatewayRequest(creq, tenant, priority, prompt,
-                              adapter=self._resolve_adapter(creq))
+                              adapter=self._resolve_adapter(creq),
+                              journey=journey)
+        if journey is not None:
+            journey.annotate(tenant=tenant, priority=priority,
+                             completion_id=item.id,
+                             prompt_tokens=int(prompt.size),
+                             max_tokens=creq.max_tokens)
 
         backlog = self.scheduler.backlog_cost(priority) + item.cost
         slots = self.router.total_slots()
@@ -286,9 +310,11 @@ class Gateway:
                 decision.est_ttft_s)
         if not decision.admit:
             self._count(tenant, "shed")
+            self.window.observe_shed("slo_shed")
             reg.counter(GATEWAY_SHED, "requests shed by reason").inc(
                 1.0, labels={"tenant": tenant, "reason": "slo_shed"})
             flight.record("gateway", "shed", request=item.id, tenant=tenant,
+                          journey=journey.id if journey is not None else "",
                           est_ttft_ms=round(decision.est_ttft_s * 1e3, 1),
                           deadline_ms=round(creq.deadline_s * 1e3, 1),
                           backlog_tokens=round(backlog, 1))
@@ -300,11 +326,17 @@ class Gateway:
             self.scheduler.enqueue(item)
         except AdmissionError as e:
             self._count(tenant, "rejected")
+            self.window.observe_shed(e.reason)
             reg.counter(GATEWAY_SHED, "requests shed by reason").inc(
                 1.0, labels={"tenant": tenant, "reason": e.reason})
             flight.record("gateway", "shed", request=item.id, tenant=tenant,
                           reason=e.reason)
             raise
+        now = time.perf_counter()
+        item.t_queue0 = now             # fair-share queue wait starts here
+        if journey is not None:
+            journey.phase("admit", t_admit0, now - t_admit0,
+                          backlog_tokens=round(backlog, 1))
         self._count(tenant, "accepted")
         self._depth_gauges()
         flight.record("gateway", "admit", request=item.id, tenant=tenant,
@@ -455,6 +487,7 @@ class Gateway:
         """Route one popped item to a replica.  True when submitted;
         False when it was requeued or failed (accounting settled)."""
         creq = item.creq
+        t_pick0 = time.perf_counter()
         remaining = (None if item.deadline is None
                      else max(0.05, item.deadline - time.perf_counter()))
         tried: list = []
@@ -472,7 +505,8 @@ class Gateway:
                     eos_token_id=self.eos_for(creq),
                     temperature=creq.temperature, top_k=creq.top_k,
                     seed=creq.seed, deadline_s=remaining,
-                    stream=item.token_q.put, adapter=item.adapter)
+                    stream=self._stream_for(item), adapter=item.adapter,
+                    journey=item.journey)
             except QueueFullError:
                 tried.append(name)
                 if len(tried) >= len(self.router.names):
@@ -508,11 +542,40 @@ class Gateway:
                 item.fail(e)
                 return False
             item.dispatched(handle, name)
+            j = item.journey
+            if j is not None:
+                # queue = fair-share wait (enqueue/requeue -> this pop);
+                # route = router pick + engine handoff.  t_queue0 resets
+                # after each dispatch so a redispatch attributes only its
+                # own wait.
+                j.phase("queue", item.t_queue0, t_pick0 - item.t_queue0,
+                        tenant=item.tenant)
+                j.phase("route", t_pick0, item.t_dispatch - t_pick0,
+                        engine=name)
+                j.annotate(engine=name)
+            item.t_queue0 = item.t_dispatch
             flight.record("gateway", "dispatch", request=item.id,
                           tenant=item.tenant, engine=name,
                           queue_wait_ms=round(
                               1e3 * (item.t_dispatch - item.t_enqueue), 2))
             return True
+
+    def _stream_for(self, item: GatewayRequest):
+        """The engine-side token callback: forwards into the item's
+        token queue, and on the FIRST token feeds the shedder's prefill
+        EWMA — at the prefill-completion journey boundary, not at handle
+        reap.  (Reap-time feeding left ``est_ttft`` stale for the whole
+        lifetime of long-running requests: a burst of them could blow
+        every deadline before the model learned a thing.)"""
+        t_sub = time.perf_counter()     # races dispatched(): close enough
+
+        def _stream(tok, _item=item, _t_sub=t_sub):
+            if _item.t_first_token is None:
+                _item.t_first_token = time.perf_counter()
+                self.shedder.observe_prefill(
+                    _item.t_first_token - (_item.t_dispatch or _t_sub))
+            _item.token_q.put(tok)
+        return _stream
 
     def _reap(self, outstanding: list):
         """Retire finished engine handles: release the tenant's
@@ -529,13 +592,26 @@ class Gateway:
             if err is not None and self._redispatchable(item, err):
                 item.redispatches += 1
                 self._flush_tokens(item)
+                item.t_first_token = None   # zero tokens reached the client
+                from_engine = item.engine_name or ""
+                t_r0 = time.perf_counter()
                 reg.counter(
                     SERVING_REDISPATCHED,
                     "requests re-dispatched after an engine death").inc(
                     1.0, labels={"layer": "gateway"})
                 flight.record("gateway", "redispatch", request=item.id,
                               attempt=item.redispatches,
+                              from_engine=from_engine,
                               error=type(err).__name__)
+                if item.journey is not None:
+                    # the cross-replica hop, on the SAME journey id: the
+                    # phases before it came from from_engine, the ones
+                    # after from the survivor replica
+                    item.journey.phase(
+                        "redispatch", t_r0, time.perf_counter() - t_r0,
+                        attempt=item.redispatches, from_engine=from_engine,
+                        error=type(err).__name__)
+                item.t_queue0 = time.perf_counter()
                 if self._submit(item):
                     # new handle on another replica; tenant accounting is
                     # still owed — the item stays in flight
@@ -547,8 +623,11 @@ class Gateway:
             self.scheduler.release(item.tenant, item.cost)
             if err is None:
                 self._count(item.tenant, "completed")
-                self.shedder.observe(item.handle.ttft_s,
-                                     item.handle.token_latencies_s)
+                # token latencies only: the prefill EWMA was already fed
+                # at prefill completion (first streamed token), so a
+                # burst of long decodes can no longer starve est_ttft
+                self.shedder.observe_tokens(
+                    item.handle.token_latencies_s)
                 if item.handle.ttft_s is not None:
                     gw_ttft = (item.t_dispatch - item.t_enqueue) + \
                         item.handle.ttft_s
@@ -609,12 +688,67 @@ class Gateway:
                       "dispatched, unfinished requests per tenant").set(
                 float(d["in_flight"]), labels={"tenant": tenant})
 
+    # -- journeys / windowed feed --------------------------------------------
+    def finish_journey(self, item: GatewayRequest, outcome: str = "ok"):
+        """Close the item's journey (the HTTP handler calls this once
+        the response — including the streamed tail — is on the wire, so
+        the timeline covers the full client-observed window) and fold it
+        into the rolling :class:`TelemetryWindow`."""
+        j = item.journey
+        if j is None:
+            return
+        handle = item.handle
+        if handle is not None:
+            j.annotate(tokens=len(handle.tokens),
+                       redispatches=item.redispatches)
+        j.finish(outcome)
+        self.window.observe_journey(j)
+
+    def window_stats(self) -> dict:
+        """The trailing-window telemetry feed (queue-wait/TTFT/per-token
+        p50+p99, shed rate, per-phase time shares, redispatch + rebuild
+        counts) plus instantaneous load (queue depth, TTFT estimate) —
+        the exact closed-loop input a trace-driven autoscaler consumes.
+        Also refreshes the ``paddle_tpu_gateway_window_*`` gauges, so a
+        ``/metrics`` scrape exports what this returns."""
+        snap = self.window.snapshot()
+        snap["queue_depth"] = self.scheduler.depth()
+        shed_snap = self.shedder.snapshot()
+        snap["est_ttft_s"] = self.shedder.estimate_ttft(
+            self.scheduler.backlog_cost("batch"),
+            self.router.total_slots())
+        snap["shedder_observations"] = shed_snap["observations"]
+        reg = registry()
+        for key in ("ttft_s", "queue_wait_s", "token_s"):
+            for q in ("p50", "p99"):
+                reg.gauge(f"paddle_tpu_gateway_window_{key[:-2]}_seconds",
+                          f"windowed {key[:-2]} percentiles").set(
+                    snap[key][q], labels={"q": q})
+        reg.gauge("paddle_tpu_gateway_window_shed_rate",
+                  "shed fraction over the trailing window").set(
+            snap["shed_rate"])
+        reg.gauge("paddle_tpu_gateway_window_requests",
+                  "journeys finished in the trailing window").set(
+            float(snap["requests"]))
+        reg.gauge("paddle_tpu_gateway_window_redispatches",
+                  "redispatch phases in the trailing window").set(
+            float(snap["redispatches"]))
+        reg.gauge("paddle_tpu_gateway_window_rebuilds",
+                  "supervisor rebuild phases in the trailing window").set(
+            float(snap["rebuilds"]))
+        for phase, share in snap["phase_share"].items():
+            reg.gauge("paddle_tpu_gateway_window_phase_share",
+                      "per-phase share of attributed request time").set(
+                share, labels={"phase": phase})
+        return snap
+
     # -- introspection -------------------------------------------------------
     def stats(self) -> dict:
         return {
             "tenants": self.scheduler.depths(),
             "engines": self.router.loads(),
             "shedder": self.shedder.snapshot(),
+            "window": self.window.snapshot(),
             "closed": self._stop_ev.is_set(),
             "draining": self._drain_ev.is_set(),
             "dispatcher_alive": self.dispatcher_alive(),

@@ -9,7 +9,23 @@ Endpoints:
 * ``GET /healthz`` — liveness JSON (200 while any replica is alive,
   503 otherwise).
 * ``GET /metrics`` — the process-wide Prometheus exposition (serving +
-  gateway series from the paddle_tpu.observability registry).
+  gateway series from the paddle_tpu.observability registry); scraping
+  it refreshes the ``paddle_tpu_gateway_window_*`` gauges from the
+  rolling :class:`~paddle_tpu.observability.journey.TelemetryWindow`.
+* ``GET /debug/requests?last=N`` — the newest N finished request
+  journeys as JSON timelines (phase-level latency attribution;
+  docs/observability.md "Request journeys").
+* ``GET /debug/requests/<id>`` — one journey by id (live or finished).
+* ``GET /debug/window`` — ``Gateway.window_stats()`` as JSON (the
+  autoscaler feed: windowed TTFT/queue-wait/per-token percentiles,
+  shed rate, phase shares).
+
+Every completion handler mints a request **journey** — adopting the
+client's ``X-Request-Id`` header when present — threads it through
+admission, dispatch and the engine, echoes the id back as an
+``X-Request-Id`` response header (and in the SSE finish event), and
+finishes the journey when the response is fully on the wire, so the
+timeline partitions the client-observed wall time.
 
 One OS thread per in-flight HTTP request (``ThreadingHTTPServer``): the
 handler parses and admits, then *blocks* on the gateway item while the
@@ -31,6 +47,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from queue import Empty
 
 from ...observability import flight, registry
+from ...observability import journey as journey_mod
 from ..engine import (DeadlineExceededError, EngineClosedError,
                       EngineDeadError, RequestInterruptedError)
 from .admission import AdmissionError
@@ -92,50 +109,66 @@ class _Handler(BaseHTTPRequestHandler):
         registry().counter(GATEWAY_HTTP, "gateway HTTP responses by code"
                            ).inc(1.0, labels={"code": status})
 
-    def _send_error_obj(self, err: Exception):
+    @staticmethod
+    def _error_wire(err: Exception):
+        """(status, body, extra_headers, outcome-code) for one mapped
+        error — the journey finishes with the same code the wire
+        carries."""
         if isinstance(err, ProtocolError):
-            self._send_json(err.status, err.body())
-        elif isinstance(err, AdmissionError):
+            return err.status, err.body(), [], (err.code or "protocol")
+        if isinstance(err, AdmissionError):
             body = error_body(str(err), etype="rate_limit_exceeded",
                               code=err.reason)
             if err.est_ttft_s is not None:
                 body["error"]["est_ttft_ms"] = round(err.est_ttft_s * 1e3, 1)
-            self._send_json(
-                err.status, body,
-                headers=[("Retry-After",
-                          str(max(1, round(err.retry_after_s))))])
-        elif isinstance(err, DeadlineExceededError):
-            self._send_json(504, error_body(
-                str(err), etype="timeout_error", code="deadline_exceeded"))
-        elif isinstance(err, RequestInterruptedError):
+            return err.status, body, [
+                ("Retry-After", str(max(1, round(err.retry_after_s))))], \
+                err.reason
+        if isinstance(err, DeadlineExceededError):
+            return 504, error_body(str(err), etype="timeout_error",
+                                   code="deadline_exceeded"), [], \
+                "deadline_exceeded"
+        if isinstance(err, RequestInterruptedError):
             # the engine died mid-generation and the retry budget could
             # not absorb it; tokens may have been produced, none are
             # delivered — the client decides whether to re-send
-            self._send_json(503, error_body(
-                str(err), etype="server_error", code="interrupted"))
-        elif isinstance(err, (NoEngineAvailableError, GatewayClosedError,
-                              EngineClosedError, EngineDeadError)):
-            self._send_json(503, error_body(
-                str(err), etype="server_error", code="unavailable"))
-        elif isinstance(err, CancelledError):
-            self._send_json(500, error_body(
-                "request was cancelled", etype="server_error",
-                code="cancelled"))
-        elif isinstance(err, TimeoutError):
-            self._send_json(504, error_body(
-                str(err), etype="timeout_error", code="timeout"))
-        else:
-            self._send_json(500, error_body(
-                f"{type(err).__name__}: {err}", etype="server_error",
-                code="internal"))
+            return 503, error_body(str(err), etype="server_error",
+                                   code="interrupted"), [], "interrupted"
+        if isinstance(err, (NoEngineAvailableError, GatewayClosedError,
+                            EngineClosedError, EngineDeadError)):
+            return 503, error_body(str(err), etype="server_error",
+                                   code="unavailable"), [], "unavailable"
+        if isinstance(err, CancelledError):
+            return 500, error_body("request was cancelled",
+                                   etype="server_error",
+                                   code="cancelled"), [], "cancelled"
+        if isinstance(err, TimeoutError):
+            return 504, error_body(str(err), etype="timeout_error",
+                                   code="timeout"), [], "timeout"
+        return 500, error_body(f"{type(err).__name__}: {err}",
+                               etype="server_error",
+                               code="internal"), [], "internal"
+
+    def _send_error_obj(self, err: Exception, request_id: str | None = None):
+        status, body, headers, _ = self._error_wire(err)
+        if request_id:
+            headers = list(headers) + [("X-Request-Id", request_id)]
+        self._send_json(status, body, headers=headers)
 
     # -- GET -----------------------------------------------------------------
     def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
         try:
-            if self.path == "/healthz":
+            path, _, query = self.path.partition("?")
+            if path == "/healthz":
                 health = self.gateway.healthz()
                 self._send_json(200 if health["alive"] else 503, health)
-            elif self.path == "/metrics":
+            elif path == "/metrics":
+                # a scrape also refreshes the windowed-feed gauges so
+                # paddle_tpu_gateway_window_* export current values
+                try:
+                    self.gateway.window_stats()
+                except Exception:  # noqa: BLE001 — never break a scrape
+                    pass
                 text = registry().to_prometheus_text().encode("utf-8")
                 self.send_response(200)
                 self.send_header("Content-Type",
@@ -146,6 +179,31 @@ class _Handler(BaseHTTPRequestHandler):
                 registry().counter(
                     GATEWAY_HTTP, "gateway HTTP responses by code").inc(
                     1.0, labels={"code": 200})
+            elif path == "/debug/window":
+                self._send_json(200, self.gateway.window_stats())
+            elif path == "/debug/requests":
+                last = 32
+                for part in query.split("&"):
+                    if part.startswith("last="):
+                        try:
+                            last = max(0, int(part[5:]))
+                        except ValueError:
+                            pass
+                self._send_json(200, {
+                    "requests": [j.timeline()
+                                 for j in journey_mod.recent(last)],
+                    "active": [j.id for j in journey_mod.active()],
+                })
+            elif path.startswith("/debug/requests/"):
+                jid = path[len("/debug/requests/"):]
+                j = journey_mod.get(jid)
+                if j is None:
+                    self._send_json(404, error_body(
+                        f"no journey {jid!r} (ring holds the newest "
+                        f"{len(journey_mod.recent(10 ** 9))})",
+                        code="journey_not_found"))
+                else:
+                    self._send_json(200, j.timeline())
             else:
                 self._send_json(404, error_body(
                     f"no such endpoint: {self.path}", code="not_found"))
@@ -160,21 +218,36 @@ class _Handler(BaseHTTPRequestHandler):
                     f"no such endpoint: {self.path}", code="not_found"))
                 return
             gw = self.gateway
+            # journey start == client-observed request start; the id is
+            # adopted from the client's X-Request-Id when present and
+            # echoed back on every response (header + SSE finish event)
+            j = journey_mod.adopt_or_begin(
+                self.headers.get("X-Request-Id"))
             try:
-                tenant = tenant_from_headers(self.headers, gw.api_keys)
-                length = int(self.headers.get("Content-Length") or 0)
-                creq = parse_completion_request(
-                    self.rfile.read(length),
-                    has_tokenizer=gw.tokenizer is not None)
-                item = gw.admit(creq, tenant)
-            except (ProtocolError, AdmissionError, GatewayClosedError,
-                    NoEngineAvailableError) as e:
-                self._send_error_obj(e)
-                return
-            if creq.stream:
-                self._stream_completion(gw, item)
-            else:
-                self._blocking_completion(gw, item)
+                try:
+                    tenant = tenant_from_headers(self.headers, gw.api_keys)
+                    length = int(self.headers.get("Content-Length") or 0)
+                    raw = self.rfile.read(length)
+                    creq = parse_completion_request(
+                        raw, has_tokenizer=gw.tokenizer is not None)
+                    j.phase("parse", j.t0, time.perf_counter() - j.t0,
+                            body_bytes=len(raw))
+                    item = gw.admit(creq, tenant, journey=j)
+                except (ProtocolError, AdmissionError, GatewayClosedError,
+                        NoEngineAvailableError) as e:
+                    outcome = self._error_wire(e)[3]
+                    self._send_error_obj(e, request_id=j.id)
+                    j.finish(outcome)
+                    return
+                if creq.stream:
+                    self._stream_completion(gw, item)
+                else:
+                    self._blocking_completion(gw, item)
+            finally:
+                # a torn socket (or an unexpected handler error) must
+                # not leak a live journey in the active table
+                if not j.done:
+                    j.finish("aborted")
         except (BrokenPipeError, ConnectionResetError):
             pass
 
@@ -188,17 +261,27 @@ class _Handler(BaseHTTPRequestHandler):
         return tok.decode([int(t) for t in tokens])
 
     def _blocking_completion(self, gw: Gateway, item):
+        j = item.journey
         try:
             tokens, finish = gw.result(
                 item, timeout=self.server.request_timeout_s)
         except Exception as e:  # noqa: BLE001 — mapped to wire errors
-            self._send_error_obj(e)
+            self._send_error_obj(e, request_id=j.id if j else None)
+            if j is not None:
+                gw.finish_journey(item, self._error_wire(e)[3])
             return
+        t_r0 = time.perf_counter()
         body = completion_body(
             item.id, self._model_name(item.creq), self._text(tokens),
-            [int(t) for t in tokens], finish, int(item.prompt.size))
+            [int(t) for t in tokens], finish, int(item.prompt.size),
+            request_id=j.id if j else None)
         self._send_json(200, body, headers=[
-            ("X-Paddle-Tpu-Engine", item.engine_name or "")])
+            ("X-Paddle-Tpu-Engine", item.engine_name or "")]
+            + ([("X-Request-Id", j.id)] if j else []))
+        if j is not None:
+            j.phase("respond", t_r0, time.perf_counter() - t_r0,
+                    tokens=len(tokens))
+            gw.finish_journey(item, "ok")
 
     # -- streaming -----------------------------------------------------------
     def _write_chunk(self, data: bytes):
@@ -210,25 +293,34 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(b"0\r\n\r\n")
 
     def _stream_completion(self, gw: Gateway, item):
+        j = item.journey
         # wait for dispatch (or early failure) before committing to 200 —
         # sheds and routing failures still map to clean HTTP errors
         if not item.ready.wait(self.server.request_timeout_s):
-            self._send_error_obj(TimeoutError(
-                f"request {item.id} was not dispatched in time"))
+            e = TimeoutError(f"request {item.id} was not dispatched in time")
+            self._send_error_obj(e, request_id=j.id if j else None)
+            if j is not None:
+                gw.finish_journey(item, "timeout")
             return
         if item.error is not None:
-            self._send_error_obj(item.error)
+            self._send_error_obj(item.error,
+                                 request_id=j.id if j else None)
+            if j is not None:
+                gw.finish_journey(item, self._error_wire(item.error)[3])
             return
         self.send_response(200)
         self.send_header("Content-Type", "text/event-stream")
         self.send_header("Cache-Control", "no-cache")
         self.send_header("Transfer-Encoding", "chunked")
         self.send_header("X-Paddle-Tpu-Engine", item.engine_name or "")
+        if j is not None:
+            self.send_header("X-Request-Id", j.id)
         self.end_headers()
         registry().counter(GATEWAY_HTTP, "gateway HTTP responses by code"
                            ).inc(1.0, labels={"code": 200})
         model = self._model_name(item.creq)
         sent = 0
+        outcome = "ok"
         try:
             # final outcome comes from item.done_ev / item.final_error,
             # never the raw handle: a supervisor or the gateway reaper
@@ -244,6 +336,7 @@ class _Handler(BaseHTTPRequestHandler):
                 sent += 1
                 self._write_chunk(sse_event(chunk_body(
                     item.id, model, self._text([tok]), [int(tok)], None)))
+            t_done = time.perf_counter()
             # drain tokens that raced the done check
             while not item.token_q.empty():
                 tok = item.token_q.get_nowait()
@@ -258,21 +351,34 @@ class _Handler(BaseHTTPRequestHandler):
                 finish = ("stop" if eos is not None and toks and
                           toks[-1] == eos else "length")
                 self._write_chunk(sse_event(chunk_body(
-                    item.id, model, "", [], finish)))
+                    item.id, model, "", [], finish,
+                    request_id=j.id if j else None)))
             else:
-                code = ("stream_interrupted"
-                        if isinstance(err, RequestInterruptedError)
-                        else "stream_aborted")
-                self._write_chunk(sse_event({
+                outcome = ("stream_interrupted"
+                           if isinstance(err, RequestInterruptedError)
+                           else "stream_aborted")
+                payload = {
                     "id": item.id,
                     "error": error_body(
                         f"{type(err).__name__}: {err}",
-                        etype="server_error", code=code)["error"]}))
+                        etype="server_error", code=outcome)["error"]}
+                if j is not None:
+                    payload["request_id"] = j.id
+                self._write_chunk(sse_event(payload))
             self._write_chunk(SSE_DONE)
             self._end_chunks()
+            if j is not None:
+                # token writes overlap decode (already attributed); the
+                # post-completion flush + finish frames are the stream's
+                # own cost
+                j.phase("stream", t_done, time.perf_counter() - t_done,
+                        tokens_sent=sent)
         except (BrokenPipeError, ConnectionResetError):
             # client went away mid-stream: free the slot immediately
+            outcome = "client_disconnect"
             item.handle.cancel()
+        if j is not None:
+            gw.finish_journey(item, outcome)
 
 
 # -- convenience stack --------------------------------------------------------

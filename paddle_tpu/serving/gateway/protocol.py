@@ -204,9 +204,13 @@ def _choice(text: str, token_ids, finish_reason):
 
 
 def completion_body(req_id: str, model: str, text: str, token_ids,
-                    finish_reason: str, prompt_tokens: int) -> dict:
+                    finish_reason: str, prompt_tokens: int,
+                    request_id: str | None = None) -> dict:
+    """``request_id`` is the journey id (adopted ``X-Request-Id``) —
+    echoed in the body next to the response header so log pipelines can
+    correlate without header access."""
     n = len(token_ids)
-    return {
+    out = {
         "id": req_id, "object": "text_completion",
         "created": int(time.time()), "model": model,
         "choices": [_choice(text, token_ids, finish_reason)],
@@ -214,14 +218,23 @@ def completion_body(req_id: str, model: str, text: str, token_ids,
                   "completion_tokens": n,
                   "total_tokens": int(prompt_tokens) + n},
     }
+    if request_id is not None:
+        out["request_id"] = request_id
+    return out
 
 
 def chunk_body(req_id: str, model: str, text: str, token_ids,
-               finish_reason: str | None) -> dict:
-    """One streamed delta (an SSE ``data:`` payload)."""
-    return {"id": req_id, "object": "text_completion",
-            "created": int(time.time()), "model": model,
-            "choices": [_choice(text, token_ids, finish_reason)]}
+               finish_reason: str | None,
+               request_id: str | None = None) -> dict:
+    """One streamed delta (an SSE ``data:`` payload).  The finish event
+    (``finish_reason`` set) carries ``request_id`` — the journey id a
+    client quotes at ``GET /debug/requests/<id>``."""
+    out = {"id": req_id, "object": "text_completion",
+           "created": int(time.time()), "model": model,
+           "choices": [_choice(text, token_ids, finish_reason)]}
+    if request_id is not None:
+        out["request_id"] = request_id
+    return out
 
 
 def sse_event(payload: dict) -> bytes:

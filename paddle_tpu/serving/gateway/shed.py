@@ -67,7 +67,18 @@ class LoadShedder:
             self._observations += 1
 
     def observe(self, ttft_s: float | None, token_latencies_s):
-        """Fold one finished request's engine-side latency telemetry in."""
+        """Fold one request's engine-side latency telemetry in.
+
+        The two EWMAs have different natural feeding points, and feeding
+        both at handle reap was a real bug: a burst of long-running
+        requests finished nothing for their whole decode, so
+        ``est_ttft`` ran on stale (or cold) numbers exactly when the
+        shed decision mattered most.  The gateway therefore feeds the
+        prefill EWMA at PREFILL COMPLETION (:meth:`observe_prefill`,
+        fired when a request's first token streams — the journey phase
+        boundary) and the token EWMA at reap
+        (:meth:`observe_tokens`, when the per-token series is
+        complete).  This combined form remains for tests/seeding."""
         toks = [t for t in (token_latencies_s or ()) if t > 0]
         with self._lock:
             a = self._alpha
@@ -79,6 +90,16 @@ class LoadShedder:
                 self._token_s = (mean if self._token_s is None else
                                  (1 - a) * self._token_s + a * mean)
             self._observations += 1
+
+    def observe_prefill(self, ttft_s: float | None):
+        """Feed the prefill EWMA the moment a request's first token
+        exists — long-running requests update the model mid-flight
+        instead of only at completion (the stale-estimate fix)."""
+        self.observe(ttft_s, None)
+
+    def observe_tokens(self, token_latencies_s):
+        """Feed the token EWMA a finished request's per-token series."""
+        self.observe(None, token_latencies_s)
 
     # -- estimates -----------------------------------------------------------
     def snapshot(self) -> dict:

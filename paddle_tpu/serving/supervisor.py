@@ -123,16 +123,20 @@ class EngineSupervisor:
         requests still inside the re-dispatch budget; they are parked
         until the rebuilt engine exists."""
         taken = []
+        now = time.perf_counter()
         with self._lock:
             if self._stop_ev.is_set() or self._failed is not None:
                 return taken
             for req in requests:
                 if req.redispatches < self.max_redispatch:
+                    req._park_t0 = now    # journey rebuild-phase start
                     taken.append(req)
             self._parked.extend(taken)
         if taken:
             flight.record("supervisor", "park", engine=self.name,
-                          n=len(taken), error=type(cause).__name__)
+                          n=len(taken), error=type(cause).__name__,
+                          requests=",".join(str(r.request_id)
+                                            for r in taken))
         self._wake_ev.set()
         return taken
 
@@ -184,6 +188,8 @@ class EngineSupervisor:
             cause = old._dead or self._failed
             flight.record("supervisor", "giveup", engine=self.name,
                           failed_requests=len(parked),
+                          requests=",".join(str(r.request_id)
+                                            for r in parked),
                           error=f"{type(cause).__name__}: {cause}")
             for req in parked:
                 req._finish(EngineDeadError(cause))
@@ -213,10 +219,22 @@ class EngineSupervisor:
             self._restarts += 1
             restarts = self._restarts
         requeued = 0
+        requeued_ids = []
         for req in parked:
             try:
                 new.resubmit(req)
                 requeued += 1
+                requeued_ids.append(req.request_id)
+                if req.journey is not None:
+                    # the death -> rebuilt-engine window, attributed: the
+                    # SAME journey id keeps accumulating phases on the
+                    # fresh build (chaos-asserted continuity)
+                    t_park = getattr(req, "_park_t0",
+                                     time.perf_counter())
+                    req.journey.phase(
+                        "rebuild", t_park,
+                        time.perf_counter() - t_park,
+                        engine=self.name, restart=restarts)
             except Exception as e:  # noqa: BLE001 — never strand a handle
                 req._finish(e if isinstance(e, EngineDeadError)
                             else EngineDeadError(e))
@@ -231,7 +249,8 @@ class EngineSupervisor:
             "engine rebuilds performed by a supervisor").inc(
             1.0, labels={"engine": self.name})
         flight.record("supervisor", "restart", engine=self.name,
-                      restarts=restarts, redispatched=requeued)
+                      restarts=restarts, redispatched=requeued,
+                      requests=",".join(map(str, requeued_ids)))
 
     # -- engine-shaped surface -----------------------------------------------
     @property

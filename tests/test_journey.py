@@ -1,0 +1,508 @@
+"""Request-journey tests (ISSUE 13): per-request phase timelines, the
+attribution invariant (phases partition the client-observed wall time,
+gaps surface as an explicit ``unattributed`` phase), journey-id
+continuity across supervisor rebuilds and gateway redispatches, the
+``/debug/requests`` query surfaces, the rolling ``TelemetryWindow``
+feed, and the shedder's prefill-at-prefill-completion regression.
+
+The contract under test is docs/observability.md "Request journeys".
+"""
+import http.client
+import json
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import build_gpt, gpt_config
+from paddle_tpu.observability import flight, journey
+from paddle_tpu.observability.journey import TelemetryWindow
+from paddle_tpu.serving import Engine, EngineSupervisor
+from paddle_tpu.serving.gateway import Gateway, start_gateway
+from paddle_tpu.serving.gateway.protocol import parse_completion_request
+from paddle_tpu.testing import faults
+
+
+@pytest.fixture(scope="module")
+def tiny_gpt():
+    cfg = gpt_config("gpt-tiny", max_position_embeddings=128,
+                     hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+    paddle.seed(13)
+    model = build_gpt(cfg)
+    model.eval()
+    return model, cfg
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faults.reset()
+    journey.clear()
+    yield
+    faults.reset()
+    journey.set_slow_ms(None)
+
+
+def _creq(max_tokens=3, prompt=(1, 2, 3), **extra):
+    payload = {"prompt": list(prompt), "max_tokens": max_tokens}
+    payload.update(extra)
+    return parse_completion_request(json.dumps(payload).encode(),
+                                    has_tokenizer=False)
+
+
+def _assert_partition(tl):
+    """THE invariant: monotone, gap-free, sums to the wall time."""
+    phases = tl["phases"]
+    assert phases, tl
+    total = sum(p["dur_ms"] for p in phases)
+    assert total == pytest.approx(tl["wall_ms"], abs=0.02), \
+        (total, tl["wall_ms"])
+    assert phases[0]["t_ms"] == pytest.approx(0.0, abs=0.01)
+    for a, b in zip(phases, phases[1:]):
+        assert b["t_ms"] >= a["t_ms"]
+        assert a["t_ms"] + a["dur_ms"] == pytest.approx(b["t_ms"],
+                                                        abs=0.01)
+    last = phases[-1]
+    assert last["t_ms"] + last["dur_ms"] == pytest.approx(tl["wall_ms"],
+                                                          abs=0.01)
+
+
+# -- unit: the Journey object -------------------------------------------------
+
+def test_partition_inserts_unattributed_and_clips_overlaps():
+    j = journey.begin("j-unit")
+    t0 = j.t0
+    j.phase("a", t0, 0.010)
+    j.phase("b", t0 + 0.020, 0.010)          # 10 ms gap after a
+    j.phase("c", t0 + 0.025, 0.010)          # overlaps b by 5 ms: clipped
+    j.finish("ok", t_end=t0 + 0.050)
+    tl = j.timeline()
+    _assert_partition(tl)
+    names = [p["phase"] for p in tl["phases"]]
+    assert names == ["a", "unattributed", "b", "c", "unattributed"]
+    by = {p["phase"]: p for p in tl["phases"]}
+    assert by["b"]["dur_ms"] == pytest.approx(10.0, abs=0.01)
+    assert by["c"]["dur_ms"] == pytest.approx(5.0, abs=0.01), \
+        "overlap must be clipped against the cursor, not double-counted"
+    # gaps are explicit, not silent: the a->b gap and the tail to t_end
+    gaps = [p["dur_ms"] for p in tl["phases"]
+            if p["phase"] == "unattributed"]
+    assert gaps == [pytest.approx(10.0, abs=0.01),
+                    pytest.approx(15.0, abs=0.01)]
+    # finished journeys land in the ring and stay addressable
+    assert journey.get("j-unit") is j
+    assert j in journey.recent(10)
+
+
+def test_adopted_ids_and_uniquification():
+    a = journey.begin("client-id")
+    b = journey.begin("client-id")           # same id while a is live
+    assert a.id == "client-id" and b.id != a.id
+    assert b.id.startswith("client-id")
+    minted = journey.begin(None)
+    assert minted.id.startswith("req-")
+    # control characters are stripped from adopted ids
+    weird = journey.begin("x\x00y\nz" + "w" * 200)
+    assert "\x00" not in weird.id and "\n" not in weird.id
+    assert len(weird.id) <= 128
+
+
+def test_bounded_timeline_merges_same_name_records(monkeypatch):
+    monkeypatch.setattr(journey, "_PHASE_CAP", 4)
+    j = journey.begin("j-cap")
+    t = j.t0
+    j.phase("prefill", t, 0.001)
+    t += 0.001
+    for _ in range(20):
+        j.phase("decode", t, 0.002, emitted=1)
+        t += 0.002
+    j.finish("ok", t_end=t)
+    tl = j.timeline()
+    _assert_partition(tl)
+    assert len(tl["phases"]) <= 6, tl["phases"]
+    merged = [p for p in tl["phases"] if p["phase"] == "decode"][-1]
+    assert merged["attrs"]["merged"] > 1
+    # merged records keep counting: all 20 emitted tokens survive
+    assert sum(p["attrs"].get("emitted", 0)
+               for p in tl["phases"] if p["phase"] == "decode") == 20
+    assert tl["merged_phase_records"] > 0
+
+
+def test_slow_request_hook_dumps_timeline(caplog):
+    journey.set_slow_ms(1.0)
+    j = journey.begin("j-slow")
+    j.phase("decode", j.t0, 0.004, emitted=1)
+    with caplog.at_level("WARNING", logger="paddle_tpu.journey"):
+        j.finish("ok", t_end=j.t0 + 0.005)
+    evs = [e for e in flight.events("journey") if e["name"] == "slow"]
+    assert evs and evs[-1]["attrs"]["request"] == "j-slow"
+    assert evs[-1]["attrs"]["wall_ms"] >= 1.0
+    assert "decode" in evs[-1]["attrs"]["phases"]
+    assert any("slow journey j-slow" in r.message for r in caplog.records)
+    # under the threshold: no dump
+    flight.clear()
+    j2 = journey.begin("j-fast")
+    j2.finish("ok", t_end=j2.t0 + 0.0001)
+    assert not [e for e in flight.events("journey")
+                if e["name"] == "slow"]
+
+
+def test_phase_histograms_exported():
+    from paddle_tpu import observability as obs
+    from paddle_tpu.observability.journey import JOURNEY_PHASE_SECONDS
+    j = journey.begin("j-hist")
+    j.phase("prefill", j.t0, 0.002)
+    j.finish("ok", t_end=j.t0 + 0.003)
+    hist = obs.registry().get(JOURNEY_PHASE_SECONDS)
+    assert hist is not None
+    labels = [dict(lbl) for lbl, _ in hist.series()]
+    assert {("phase", "prefill"), ("outcome", "ok")} <= \
+        {pair for lbl in labels for pair in lbl.items()}
+
+
+# -- unit: the windowed feed --------------------------------------------------
+
+def _synthetic_journey(jid, ttft_s, decode_s, tokens, t_end_off=1.0):
+    j = journey.begin(jid)
+    t0 = j.t0
+    j.phase("queue", t0, ttft_s / 2)
+    j.phase("prefill", t0 + ttft_s / 2, ttft_s / 2)
+    j.mark_first_token(t0 + ttft_s)
+    j.phase("decode", t0 + ttft_s, decode_s, emitted=tokens)
+    j.finish("ok", t_end=t0 + t_end_off)
+    return j
+
+
+def test_telemetry_window_percentiles_shares_and_expiry():
+    w = TelemetryWindow(window_s=10.0)
+    now = time.perf_counter()
+    for i, ttft in enumerate((0.010, 0.020, 0.030, 0.040)):
+        w.observe_journey(
+            _synthetic_journey(f"w-{i}", ttft, 0.060, 3), now=now)
+    w.observe_shed("slo_shed", now=now)
+    snap = w.snapshot(now=now)
+    assert snap["requests"] == 4 and snap["shed"] == 1
+    assert snap["shed_rate"] == pytest.approx(0.2)
+    assert snap["ttft_s"]["p50"] == pytest.approx(0.025, abs=1e-3)
+    assert snap["ttft_s"]["p99"] <= 0.040 + 1e-6
+    # per-token = decode time / decode-emitted tokens
+    assert snap["token_s"]["p50"] == pytest.approx(0.020, abs=1e-3)
+    assert snap["queue_wait_s"]["n"] == 4
+    assert snap["phase_share"]  # decode dominates
+    assert max(snap["phase_share"], key=snap["phase_share"].get) in \
+        ("decode", "unattributed")
+    # samples age out of the window
+    later = now + 11.0
+    assert w.snapshot(now=later)["requests"] == 0
+    # unfinished journeys are refused (their partition does not exist)
+    live = journey.begin("w-live")
+    w.observe_journey(live)
+    assert w.snapshot(now=time.perf_counter())["requests"] == 0
+
+
+# -- engine integration -------------------------------------------------------
+
+def test_engine_phases_partition_and_one_signature(tiny_gpt):
+    model, cfg = tiny_gpt
+    eng = Engine(model, max_slots=2, max_len=64)
+    try:
+        j = journey.begin("eng-1")
+        h = eng.submit(np.array([1, 2, 3], np.int64), max_new_tokens=4,
+                       journey=j)
+        h.result(timeout=300)
+        j.finish("ok")
+        tl = j.timeline()
+        _assert_partition(tl)
+        names = [p["phase"] for p in tl["phases"]]
+        for want in ("engine_queue", "build", "prefill", "decode"):
+            assert want in names, (want, names)
+        assert tl["ttft_ms"] is not None and tl["ttft_ms"] > 0
+        decodes = [p for p in tl["phases"] if p["phase"] == "decode"]
+        # 4 tokens: 1 from prefill + 3 decode dispatches, one phase each
+        assert len(decodes) == 3
+        assert all(p["attrs"]["emitted"] == 1 for p in decodes)
+        # journeys add no device work: decode stays ONE compiled program
+        assert eng.compile_stats()["decode_compiles"] == 1
+        # a journey-free submit is untouched (no phases recorded)
+        h2 = eng.submit(np.array([4, 5], np.int64), max_new_tokens=2)
+        h2.result(timeout=300)
+        assert h2.journey is None
+    finally:
+        eng.shutdown()
+
+
+def test_fastpath_journey_phases(tiny_gpt):
+    """Prefix-cache hits attribute their copy + tail-prefill, and the
+    speculative decode dispatch records drafted/accepted counts."""
+    model, cfg = tiny_gpt
+    eng = Engine(model, max_slots=2, max_len=64, prefix_cache=True,
+                 prefix_block=4, speculative_k=3, prefill_batch=1)
+    try:
+        rs = np.random.RandomState(0)
+        shared = rs.randint(0, cfg.vocab_size, 8).astype(np.int64)
+        p1 = np.concatenate([shared, [5, 7]]).astype(np.int64)
+        p2 = np.concatenate([shared, [9, 11]]).astype(np.int64)
+        eng.submit(p1, max_new_tokens=4).result(timeout=300)
+        j = journey.begin("eng-hit")
+        h = eng.submit(p2, max_new_tokens=6, journey=j)
+        h.result(timeout=300)
+        j.finish("ok")
+        tl = j.timeline()
+        _assert_partition(tl)
+        names = [p["phase"] for p in tl["phases"]]
+        assert "tail_prefill" in names and "prefix_copy" in names, names
+        tail = next(p for p in tl["phases"]
+                    if p["phase"] == "tail_prefill")
+        assert tail["attrs"]["cached_tokens"] >= 4
+        decodes = [p for p in tl["phases"] if p["phase"] == "decode"]
+        assert decodes and all("drafted" in p["attrs"] for p in decodes)
+        assert eng.compile_stats()["decode_compiles"] == 1
+    finally:
+        eng.shutdown()
+
+
+# -- HTTP end to end ----------------------------------------------------------
+
+def test_http_journey_end_to_end(tiny_gpt):
+    model, cfg = tiny_gpt
+    eng = Engine(model, max_slots=2, max_len=64)
+    stack = start_gateway([eng])
+    try:
+        port = stack.port
+        t0 = time.perf_counter()
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=300)
+        conn.request("POST", "/v1/completions",
+                     json.dumps({"prompt": [1, 2, 3],
+                                 "max_tokens": 4}).encode(),
+                     {"Content-Type": "application/json", "X-Tenant": "t",
+                      "X-Request-Id": "e2e-blocking"})
+        r = conn.getresponse()
+        body = json.loads(r.read())
+        wall_client_ms = (time.perf_counter() - t0) * 1e3
+        hdrs = dict(r.getheaders())
+        conn.close()
+        assert r.status == 200
+        # the journey id round-trips: header + body
+        assert hdrs.get("X-Request-Id") == "e2e-blocking"
+        assert body["request_id"] == "e2e-blocking"
+
+        # streamed request: the finish SSE event echoes the id
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=300)
+        conn.request("POST", "/v1/completions",
+                     json.dumps({"prompt": [4, 5, 6], "max_tokens": 3,
+                                 "stream": True}).encode(),
+                     {"Content-Type": "application/json", "X-Tenant": "t",
+                      "X-Request-Id": "e2e-stream"})
+        r = conn.getresponse()
+        assert r.status == 200
+        assert dict(r.getheaders()).get("X-Request-Id") == "e2e-stream"
+        finish_ids = []
+        for line in r:
+            if not line.startswith(b"data: ") or b"[DONE]" in line:
+                continue
+            ev = json.loads(line[6:])
+            if ev["choices"][0]["finish_reason"] is not None:
+                finish_ids.append(ev.get("request_id"))
+        conn.close()
+        assert finish_ids == ["e2e-stream"]
+
+        deadline = time.time() + 10
+        while journey.get("e2e-stream") is None or \
+                not journey.get("e2e-stream").done:
+            assert time.time() < deadline
+            time.sleep(0.01)
+
+        # /debug/requests/<id>: the timeline partitions the wall time,
+        # and the wall time matches what the client observed (±5%)
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        conn.request("GET", "/debug/requests/e2e-blocking")
+        r = conn.getresponse()
+        tl = json.loads(r.read())
+        conn.close()
+        assert r.status == 200
+        _assert_partition(tl)
+        assert abs(tl["wall_ms"] - wall_client_ms) <= \
+            0.05 * wall_client_ms + 5.0
+        names = [p["phase"] for p in tl["phases"]]
+        for want in ("parse", "admit", "queue", "route", "engine_queue",
+                     "prefill", "decode", "respond"):
+            assert want in names, (want, names)
+        assert tl["attrs"]["tenant"] == "t"
+        assert tl["outcome"] == "ok"
+
+        # the ring window + 404 for unknown ids
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        conn.request("GET", "/debug/requests?last=50")
+        window = json.loads(conn.getresponse().read())
+        conn.close()
+        ids = {t["id"] for t in window["requests"]}
+        assert {"e2e-blocking", "e2e-stream"} <= ids
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        conn.request("GET", "/debug/requests/nope")
+        r = conn.getresponse()
+        r.read()
+        conn.close()
+        assert r.status == 404
+
+        # window feed agrees with the per-request timelines, and the
+        # gauges export through /metrics
+        stats = stack.gateway.window_stats()
+        assert stats["requests"] >= 2
+        ttfts = sorted(
+            t["ttft_ms"] / 1e3 for t in window["requests"]
+            if t["id"] in ("e2e-blocking", "e2e-stream"))
+        assert stats["ttft_s"]["p50"] <= ttfts[-1] + 1e-6
+        assert stats["ttft_s"]["p99"] >= ttfts[0] - 1e-6
+        assert 0.0 <= stats["shed_rate"] <= 1.0
+        assert stats["phase_share"].get("decode", 0) > 0
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        conn.request("GET", "/metrics")
+        text = conn.getresponse().read().decode()
+        conn.close()
+        assert "paddle_tpu_gateway_window_ttft_seconds" in text
+        assert "paddle_tpu_journey_phase_seconds" in text
+        # /debug/window serves the same feed over the wire
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        conn.request("GET", "/debug/window")
+        wire = json.loads(conn.getresponse().read())
+        conn.close()
+        assert wire["requests"] == stats["requests"]
+    finally:
+        stack.close()
+        eng.shutdown()
+
+
+def test_journey_report_chrome_export(tiny_gpt):
+    from tools.journey_report import (chrome_events_from_timelines,
+                                      summarize)
+    j = journey.begin("chrome-1")
+    j.phase("prefill", j.t0, 0.002)
+    j.phase("decode", j.t0 + 0.002, 0.003, emitted=2)
+    j.finish("ok", t_end=j.t0 + 0.006)
+    tls = [j.timeline()]
+    events = json.loads(json.dumps(chrome_events_from_timelines(tls)))
+    assert len(events) == len(tls[0]["phases"])
+    assert all(e["ph"] == "X" and e["cat"] == "journey" for e in events)
+    # same clock base as the observability span ring (perf_counter µs)
+    assert events[0]["ts"] == pytest.approx(j.t0 * 1e6, rel=1e-9)
+    # in-module chrome export matches the tool's
+    assert len(journey.chrome_events([j])) == len(events)
+    summary = summarize(tls)
+    assert summary["decode"]["ms"] == pytest.approx(3.0, abs=0.01)
+    assert sum(row["share"] for row in summary.values()) == \
+        pytest.approx(1.0, abs=1e-3)
+
+
+# -- continuity across self-healing ------------------------------------------
+
+def test_supervisor_rebuild_keeps_journey_id(tiny_gpt):
+    """Engine kill -> supervisor rebuild -> same-handle resubmit: ONE
+    journey id, a ``rebuild`` phase, serving phases from the new build
+    after it, and a monotone gap-free partition."""
+    model, cfg = tiny_gpt
+
+    def factory():
+        return Engine(model, max_slots=2, max_len=48, auto_start=False)
+
+    sup = EngineSupervisor(factory, name="jrny", poll_interval_s=0.02,
+                           max_restarts=3)
+    try:
+        j = journey.begin("sup-journey")
+        faults.arm("serving.scheduler", times=1)
+        h = sup.submit(np.array([1, 2, 3], np.int64), max_new_tokens=4,
+                       journey=j)
+        sup.engine.start()                 # first iteration crashes
+        tokens = h.result(timeout=300)
+        assert len(tokens) == 4
+        assert sup.restarts == 1
+        assert h.journey is j, "the SAME journey rides the resubmit"
+        j.finish("ok")
+        tl = j.timeline()
+        _assert_partition(tl)
+        names = [p["phase"] for p in tl["phases"]]
+        assert "rebuild" in names, names
+        after = names[names.index("rebuild") + 1:]
+        assert "engine_queue" in after and "prefill" in after and \
+            "decode" in after, \
+            "phases from the rebuilt engine must follow the rebuild"
+        rebuild = next(p for p in tl["phases"] if p["phase"] == "rebuild")
+        assert rebuild["attrs"]["engine"] == "jrny"
+    finally:
+        sup.shutdown()
+
+
+def test_gateway_redispatch_keeps_journey_id(tiny_gpt):
+    """Cross-replica gateway redispatch: one journey id, a
+    ``redispatch`` phase naming the dead replica, and route/engine
+    phases from BOTH replicas on the one timeline."""
+    model, cfg = tiny_gpt
+    paddle.seed(17)
+    model_b = build_gpt(cfg)
+    model_b.eval()
+    eng_a = Engine(model, max_slots=2, max_len=48, auto_start=False)
+    eng_b = Engine(model_b, max_slots=2, max_len=48)
+    gw = Gateway([eng_a, eng_b], names=["a", "b"])
+    try:
+        j = journey.begin("gw-journey")
+        item = gw.admit(_creq(max_tokens=4), "t", journey=j)
+        assert item.ready.wait(60) and item.engine_name == "a"
+        faults.arm("serving.scheduler", times=1)
+        eng_a.start()                      # 'a' dies with zero tokens
+        tokens, _ = gw.result(item, timeout=300)
+        assert len(tokens) == 4 and item.engine_name == "b"
+        gw.finish_journey(item, "ok")
+        tl = j.timeline()
+        _assert_partition(tl)
+        names = [p["phase"] for p in tl["phases"]]
+        assert "redispatch" in names, names
+        red = next(p for p in tl["phases"] if p["phase"] == "redispatch")
+        assert red["attrs"]["from_engine"] == "a"
+        routes = [p["attrs"]["engine"] for p in tl["phases"]
+                  if p["phase"] == "route"]
+        assert routes == ["a", "b"], \
+            "phases from both replicas must be present"
+        after = names[names.index("redispatch") + 1:]
+        assert "engine_queue" in after and "decode" in after
+        # the window feed counts the healed hop
+        gw.window.observe_shed("noise")    # ensure snapshot non-trivial
+        assert gw.window.snapshot()["redispatches"] == 1
+    finally:
+        gw.shutdown()
+        eng_a.shutdown()
+        eng_b.shutdown()
+
+
+# -- shedder regression (satellite) ------------------------------------------
+
+def test_shedder_prefill_fed_at_prefill_completion(tiny_gpt):
+    """Regression for the stale-estimate window: the prefill EWMA used
+    to be fed only from FINISHED handles, so a burst of long-running
+    requests left est_ttft cold/stale for their whole decode.  Now the
+    gateway feeds it when the first token streams (the prefill-complete
+    journey boundary) — while the request is still running."""
+    model, cfg = tiny_gpt
+    eng = Engine(model, max_slots=2, max_len=128)
+    gw = Gateway([eng])
+    try:
+        assert gw.shedder.snapshot()["prefill_s"] is None
+        item = gw.admit(_creq(max_tokens=60, prompt=(1, 2, 3)), "t")
+        assert item.ready.wait(60)
+        # wait for the FIRST token only — the request keeps decoding
+        deadline = time.time() + 120
+        while item.t_first_token is None:
+            assert time.time() < deadline, "no first token"
+            time.sleep(0.005)
+        snap = gw.shedder.snapshot()
+        assert snap["prefill_s"] is not None and snap["prefill_s"] > 0, \
+            "prefill EWMA must update at prefill completion, not reap"
+        assert not item.done_ev.is_set(), \
+            "the request must still be in flight for this to matter"
+        gw.result(item, timeout=300)
+        # token EWMA still arrives at reap
+        deadline = time.time() + 60
+        while gw.shedder.snapshot()["token_s"] is None:
+            assert time.time() < deadline, "token EWMA never fed"
+            time.sleep(0.01)
+    finally:
+        gw.shutdown()
+        eng.shutdown()

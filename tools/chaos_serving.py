@@ -328,6 +328,36 @@ def main() -> int:
         kinds = {e["name"] for e in flight.events("supervisor")}
         assert {"teardown", "restart"} <= kinds, kinds
 
+        # journeys under chaos (ISSUE 13): every finished request
+        # timeline is a monotone, gap-free partition of its wall time —
+        # INCLUDING the ones that crossed a supervisor rebuild or a
+        # gateway redispatch, whose single journey id must keep
+        # accumulating phases on the new build/replica (continuity:
+        # serving phases appear AFTER the rebuild/redispatch phase)
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        conn.request("GET", "/debug/requests?last=1000")
+        tls = json.loads(conn.getresponse().read())["requests"]
+        conn.close()
+        assert len(tls) >= len(completed), (len(tls), len(completed))
+        assert len({t["id"] for t in tls}) == len(tls), "duplicate ids"
+        healed = 0
+        for tl in tls:
+            parts = sum(p["dur_ms"] for p in tl["phases"])
+            assert abs(parts - tl["wall_ms"]) < 0.05, \
+                (tl["id"], parts, tl["wall_ms"])
+            for a, b in zip(tl["phases"], tl["phases"][1:]):
+                assert b["t_ms"] >= a["t_ms"] and \
+                    abs(a["t_ms"] + a["dur_ms"] - b["t_ms"]) < 0.02, \
+                    (tl["id"], "non-monotone or gapped partition")
+            names = [p["phase"] for p in tl["phases"]]
+            for marker in ("rebuild", "redispatch"):
+                if marker in names and tl["outcome"] == "ok":
+                    after = names[names.index(marker) + 1:]
+                    assert {"engine_queue", "prefill", "decode"} & \
+                        set(after), (tl["id"], marker, names)
+                    healed += 1
+        journey_summary = {"journeys": len(tls), "healed_journeys": healed}
+
         summary = {
             "chaos_serving": "ok", "requests": total, "kills": kills,
             "completed": len(completed), "shed": len(shed),
@@ -335,6 +365,7 @@ def main() -> int:
             "supervisor_restarts": restarts,
             "redispatched": redispatched,
             "builds_per_engine": [len(s.builds()) for s in sups],
+            **journey_summary,
         }
     finally:
         faults.reset()

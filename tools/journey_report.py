@@ -1,0 +1,124 @@
+"""Render a window of request journeys as a chrome trace.
+
+Pulls JSON timelines from a running gateway's debug surface (or a saved
+JSON file) and writes a ``chrome://tracing`` / Perfetto-loadable trace
+where every request is one track of phase blocks — the visual answer to
+"where did this request's 480 ms go?".
+
+    python tools/journey_report.py --url http://127.0.0.1:8000 --last 64 \
+        -o /tmp/journeys.trace.json
+    python tools/journey_report.py --json saved_requests.json -o out.json
+
+The events use the SAME format and clock base as the PR 2 observability
+spans (``"ph": "X"``, ``ts`` in perf_counter microseconds, ``"cat":
+"journey"``), so a trace produced IN-PROCESS (``--merge-spans``, or the
+profiler's ``export_chrome_tracing``) interleaves journeys with the
+serving spans and counter tracks on one timeline.  Cross-process (the
+``--url`` mode) the clock base still comes from each timeline's
+``mono0`` field, so journeys from one gateway process stay mutually
+aligned.
+
+Also prints a per-phase attribution summary (total + share per phase
+across the window) — the text form of the
+``paddle_tpu_gateway_window_phase_share`` gauge.
+
+stdlib-only; no jax, no paddle_tpu import needed for the URL/file modes.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+
+__all__ = ["chrome_events_from_timelines", "summarize", "fetch_window"]
+
+
+def chrome_events_from_timelines(timelines, pid: int = 0) -> list[dict]:
+    """JSON journey timelines (the ``/debug/requests`` shape) -> chrome
+    'X' events.  ``ts`` = (mono0 + offset) in microseconds — the
+    perf_counter base the observability span ring also uses, so the two
+    event streams merge onto one timeline in-process."""
+    events = []
+    for tl in timelines:
+        base = float(tl.get("mono0") or 0.0) * 1e6
+        tid = tl.get("id", "?")
+        for seg in tl.get("phases", ()):
+            args = dict(seg.get("attrs") or {})
+            args["journey"] = tid
+            if tl.get("outcome"):
+                args["outcome"] = tl["outcome"]
+            events.append({
+                "name": seg["phase"], "ph": "X",
+                "ts": base + float(seg["t_ms"]) * 1e3,
+                "dur": float(seg["dur_ms"]) * 1e3,
+                "pid": pid, "tid": tid, "cat": "journey", "args": args,
+            })
+    return events
+
+
+def summarize(timelines) -> dict:
+    """Per-phase attribution totals across a window of timelines:
+    {phase: {"ms": total, "share": fraction-of-attributed-time}}."""
+    totals: dict[str, float] = {}
+    for tl in timelines:
+        for seg in tl.get("phases", ()):
+            totals[seg["phase"]] = totals.get(seg["phase"], 0.0) + \
+                float(seg["dur_ms"])
+    grand = sum(totals.values())
+    return {name: {"ms": round(ms, 3),
+                   "share": round(ms / grand, 4) if grand else 0.0}
+            for name, ms in sorted(totals.items(),
+                                   key=lambda kv: -kv[1])}
+
+
+def fetch_window(url: str, last: int = 64, timeout: float = 30.0) -> list:
+    """GET <url>/debug/requests?last=N -> list of JSON timelines."""
+    full = f"{url.rstrip('/')}/debug/requests?last={int(last)}"
+    with urllib.request.urlopen(full, timeout=timeout) as resp:
+        payload = json.loads(resp.read().decode("utf-8"))
+    return payload.get("requests", [])
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument("--url", help="gateway base url, e.g. "
+                     "http://127.0.0.1:8000 (reads /debug/requests)")
+    src.add_argument("--json", dest="json_path",
+                     help="saved /debug/requests payload (or a bare list "
+                     "of timelines)")
+    ap.add_argument("--last", type=int, default=64,
+                    help="window size for --url (default 64)")
+    ap.add_argument("-o", "--out", default="journeys.trace.json",
+                    help="chrome trace output path")
+    ap.add_argument("--merge-spans", action="store_true",
+                    help="also merge the IN-PROCESS observability span "
+                    "ring into the trace (imports paddle_tpu; only "
+                    "meaningful when journeys were recorded in this "
+                    "process)")
+    args = ap.parse_args(argv)
+
+    if args.url:
+        timelines = fetch_window(args.url, args.last)
+    else:
+        with open(args.json_path) as f:
+            payload = json.load(f)
+        timelines = (payload.get("requests", payload)
+                     if isinstance(payload, dict) else payload)
+
+    events = chrome_events_from_timelines(timelines)
+    if args.merge_spans:
+        from paddle_tpu.observability import trace as obs_trace
+        events.extend(obs_trace.chrome_events())
+    with open(args.out, "w") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+    summary = summarize(timelines)
+    print(f"{len(timelines)} journeys, {len(events)} events -> {args.out}")
+    for name, row in summary.items():
+        print(f"  {name:<16} {row['ms']:>10.1f} ms  {row['share']:>6.1%}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -40,6 +40,7 @@ DEFAULT_SUBSET = [
     "tests/test_self_healing.py",
     "tests/test_robustness.py",
     "tests/test_multi_lora.py",
+    "tests/test_journey.py",
 ]
 
 # decode fast-path lane (ISSUE 10): prefix cache + speculation + int8 KV
@@ -165,6 +166,108 @@ print("multi-lora lane ok:", {
     "decode_compiles": st["decode_compiles"]})
 """
 
+# journey lane (ISSUE 13): mixed-tenant HTTP traffic with journeys live —
+# every request's phase partition must sum to its client-observed wall
+# time (the attribution invariant, end to end over a real socket), the
+# journey id round-trips via X-Request-Id, /debug/requests serves the
+# window, window_stats() TTFT percentiles agree with the per-request
+# timelines they aggregate, the chrome-trace export parses, and decode
+# stays at ONE compiled signature with journeys on.
+JOURNEY_LANE = r"""
+import http.client, json, time
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu.models import build_gpt, gpt_config
+from paddle_tpu.serving import Engine
+from paddle_tpu.serving.gateway import TenantConfig, start_gateway
+from tools.journey_report import chrome_events_from_timelines, summarize
+
+cfg = gpt_config("gpt-tiny", max_position_embeddings=128,
+                 hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+paddle.seed(0)
+model = build_gpt(cfg)
+model.eval()
+eng = Engine(model, max_slots=2, max_len=64)
+stack = start_gateway(
+    [eng], tenants=[TenantConfig("ta", priority="interactive"),
+                    TenantConfig("tb", priority="batch")])
+walls = {}
+try:
+    rs = np.random.RandomState(3)
+    for i in range(6):
+        tenant = "ta" if i % 2 == 0 else "tb"
+        rid = f"smoke-{i}"
+        prompt = [int(t) for t in rs.randint(0, cfg.vocab_size, 3 + i)]
+        conn = http.client.HTTPConnection("127.0.0.1", stack.port,
+                                          timeout=300)
+        t0 = time.perf_counter()
+        conn.request("POST", "/v1/completions",
+                     json.dumps({"prompt": prompt, "max_tokens": 4,
+                                 "stream": i % 3 == 0}).encode(),
+                     {"Content-Type": "application/json",
+                      "X-Tenant": tenant, "X-Request-Id": rid})
+        r = conn.getresponse()
+        raw = r.read()
+        walls[rid] = (time.perf_counter() - t0) * 1e3
+        conn.close()
+        assert r.status == 200, (r.status, raw)
+        assert dict(r.getheaders()).get("X-Request-Id") == rid
+        if i % 3 == 0:
+            assert b'"request_id": "%s"' % rid.encode() in raw or \
+                rid in raw.decode(), "SSE finish event must echo the id"
+    time.sleep(0.2)
+    conn = http.client.HTTPConnection("127.0.0.1", stack.port, timeout=60)
+    conn.request("GET", "/debug/requests?last=16")
+    payload = json.loads(conn.getresponse().read())
+    conn.close()
+    tls = payload["requests"]
+    assert len(tls) == 6, [t["id"] for t in tls]
+    ttfts = []
+    for tl in tls:
+        parts = sum(p["dur_ms"] for p in tl["phases"])
+        assert abs(parts - tl["wall_ms"]) < 0.02, (tl["id"], parts,
+                                                   tl["wall_ms"])
+        wall_client = walls[tl["id"]]
+        assert abs(tl["wall_ms"] - wall_client) <= \
+            0.05 * wall_client + 5.0, (tl["id"], tl["wall_ms"], wall_client)
+        starts = [p["t_ms"] for p in tl["phases"]]
+        assert starts == sorted(starts), tl["id"]
+        for a, b in zip(tl["phases"], tl["phases"][1:]):
+            assert abs(a["t_ms"] + a["dur_ms"] - b["t_ms"]) < 0.01, \
+                (tl["id"], "gap")
+        assert tl["outcome"] == "ok" and tl["ttft_ms"] is not None
+        ttfts.append(tl["ttft_ms"] / 1e3)
+    # window feed agrees with the per-request timelines it aggregates
+    w = stack.gateway.window_stats()
+    assert w["requests"] == 6 and w["ttft_s"]["n"] == 6, w
+    ttfts.sort()
+    assert abs(w["ttft_s"]["p50"] -
+               (ttfts[2] + ttfts[3]) / 2) < 1e-3, (w["ttft_s"], ttfts)
+    assert w["ttft_s"]["p99"] <= ttfts[-1] + 1e-6
+    # one id fetch + chrome export parses
+    conn = http.client.HTTPConnection("127.0.0.1", stack.port, timeout=60)
+    conn.request("GET", "/debug/requests/smoke-0")
+    one = json.loads(conn.getresponse().read())
+    conn.close()
+    assert one["id"] == "smoke-0"
+    events = chrome_events_from_timelines(tls)
+    blob = json.dumps({"traceEvents": events})
+    parsed = json.loads(blob)
+    assert len(parsed["traceEvents"]) == sum(len(t["phases"]) for t in tls)
+    assert all(e["ph"] == "X" and e["cat"] == "journey"
+               for e in parsed["traceEvents"])
+    st = eng.stats()
+    assert st["decode_compiles"] == 1, st
+    print("journey lane ok:", {
+        "requests": w["requests"],
+        "ttft_p50_ms": round(w["ttft_s"]["p50"] * 1e3, 1),
+        "phase_share": summarize(tls) and list(summarize(tls))[:3],
+        "decode_compiles": st["decode_compiles"]})
+finally:
+    stack.close()
+    eng.shutdown()
+"""
+
 # prefetch-on training lane: fit a tiny model THROUGH DevicePrefetcher with
 # telemetry live and assert the input-pipeline series were exported.  Runs
 # in its own interpreter so the env-var bootstrap path is what's exercised.
@@ -253,6 +356,15 @@ def main() -> int:
         if ml_rc != 0:
             print("multi-lora lane FAILED", file=sys.stderr)
         rc = rc or ml_rc
+        # journey lane (ISSUE 13): phase partition == client wall time
+        # over a real socket, /debug/requests, window feed agreement,
+        # chrome export, one decode signature with journeys on
+        print("telemetry smoke: journey lane", file=sys.stderr)
+        jn_rc = subprocess.call([sys.executable, "-c", JOURNEY_LANE],
+                                env=env, cwd=root)
+        if jn_rc != 0:
+            print("journey lane FAILED", file=sys.stderr)
+        rc = rc or jn_rc
         # tpu-lint ratchet gate (ISSUE 7): runs even when the pytest
         # subset has unrelated failures, in its own interpreter (the
         # analyzer is jax-free, so it cannot be broken by runtime drift)
