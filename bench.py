@@ -696,6 +696,14 @@ def bench_serving():
     paddle.seed(0)
     model = build_gpt(cfg)
     model.eval()
+    # device perfscope ON for the leg: every Nth decode dispatch is
+    # timed, so the leg reports MFU/BW-per-program for free (the next
+    # hardware round's roofline comes straight from this block)
+    from paddle_tpu.observability import perfscope
+    prev_sample = perfscope.sample_every()
+    perfscope.set_sample_every(
+        int(os.environ.get("PADDLE_TPU_PERFSCOPE_SAMPLE", "4") or 0))
+    perfscope.reset_programs()
     engine = Engine(model, max_slots=slots, max_len=max_len,
                     max_queue=2 * n_req)
     try:
@@ -721,8 +729,10 @@ def bench_serving():
         wall = time.perf_counter() - t0
         st = engine.stats()
         decode_compiles = engine.compile_stats()["decode_compiles"]
+        perf_rep = perfscope.perf_report()
     finally:
         engine.shutdown()
+        perfscope.set_sample_every(prev_sample)
 
     if st["completed"] < n_req:
         raise RuntimeError(f"serving leg: only {st['completed']}/{n_req} "
@@ -734,6 +744,41 @@ def bench_serving():
         raise RuntimeError(
             f"serving leg: decode retraced after warmup "
             f"({warm_decode} -> {decode_compiles} signatures)")
+    # perfscope roofline gate: the decode program must have sampled at
+    # ONE compiled signature, and its reported MFU/BW fraction must match
+    # the cost_analysis-derived expectation (flops / (mean sampled dt x
+    # peak)) — validating the whole attribution chain on every CPU run
+    dec = next((p for p in perf_rep["programs"]
+                if p["program"] == "serving.decode"), None)
+    if dec is None or not dec["sampled"]:
+        raise RuntimeError(
+            f"serving leg: perfscope sampled no decode dispatches: "
+            f"{perf_rep['programs']}")
+    if dec["signatures"] != 1:
+        raise RuntimeError(
+            f"serving leg: decode registered {dec['signatures']} "
+            f"signatures with perfscope sampling on (must stay at 1)")
+    mean_dt = dec["device_s"] / dec["sampled"]
+    for got, flop_or_bytes, peak in (
+            (dec["mfu"], dec["flops"], perf_rep["peak_flops"]),
+            (dec["hbm_bw_frac"], dec["bytes"], perf_rep["peak_hbm_bw"])):
+        if not (flop_or_bytes and peak):
+            continue
+        expect = flop_or_bytes / (mean_dt * peak)
+        if got is None or abs(got - expect) > 0.02 * expect + 1e-9:
+            raise RuntimeError(
+                f"serving leg: perfscope roofline mismatch: got {got}, "
+                f"cost_analysis expectation {expect:.6g}")
+    perfscope_block = {
+        "sample_every": perf_rep["sample_every"],
+        "peak_flops": perf_rep["peak_flops"],
+        "peak_hbm_bw": perf_rep["peak_hbm_bw"],
+        "programs": {p["program"]: {
+            k: p[k] for k in ("dispatches", "sampled", "device_s",
+                              "est_total_s", "share", "mfu",
+                              "hbm_bw_frac")}
+            for p in perf_rep["programs"]},
+    }
     total_tokens = sum(len(h.generated) for h in handles)
     ttfts = np.array([h.ttft_s for h in handles])
     toks = np.array([t for h in handles for t in h.token_latencies_s])
@@ -776,6 +821,7 @@ def bench_serving():
         "paged_kv": paged_block,
         "multi_lora": multi_lora_block,
         "gateway": gateway_block,
+        "perfscope": perfscope_block,
     }
 
 
@@ -1507,6 +1553,18 @@ def _telemetry_block():
     c = reg.get(steps.PIPELINE_STALLS)
     if c is not None:
         block["pipeline_stalls"] = int(c.total())
+    # per-leg perfscope roofline (programs that registered cost and/or
+    # sampled device time this leg; empty when sampling was off)
+    from paddle_tpu.observability import perfscope
+    rep = perfscope.perf_report()
+    if rep["programs"]:
+        block["perfscope"] = {
+            "sample_every": rep["sample_every"],
+            "programs": {p["program"]: {
+                "dispatches": p["dispatches"], "sampled": p["sampled"],
+                "device_s": p["device_s"], "share": p["share"],
+                "mfu": p["mfu"], "hbm_bw_frac": p["hbm_bw_frac"]}
+                for p in rep["programs"]}}
     steps.record_memory_stats()  # refresh the gauges at leg end
     g = reg.get(steps.MEMORY_GAUGE)
     if g is not None:
@@ -1550,7 +1608,9 @@ def main():
             _reset_parallel_state()
             if telemetry:
                 from paddle_tpu import observability as obs
+                from paddle_tpu.observability import perfscope
                 obs.registry().reset()  # per-leg deltas
+                perfscope.reset_programs()
             legs[key] = fn()
         except Exception as e:  # a failing leg must not kill the bench
             traceback.print_exc(file=sys.stderr)

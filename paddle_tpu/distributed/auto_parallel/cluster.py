@@ -18,14 +18,22 @@ from typing import Dict, List, Optional
 
 __all__ = ["Device", "Machine", "Cluster", "LinkSpec"]
 
-# public spec-sheet numbers (bf16 peak per chip, HBM bytes, ICI/DCN GB/s)
+# public spec-sheet numbers (bf16 peak per chip, HBM bytes + GB/s,
+# ICI/DCN GB/s).  The cpu row is SYNTHETIC: it exists so roofline math
+# (perfscope MFU / bandwidth fractions, planner estimates) is exercised
+# and testable on the CPU tier-1 harness, not to describe any real host.
 _KNOWN_CHIPS = {
-    "tpu v4": dict(flops=275e12, memory=32e9, ici_gbps=300.0),
-    "tpu v5 lite": dict(flops=197e12, memory=16e9, ici_gbps=186.0),
-    "tpu v5e": dict(flops=197e12, memory=16e9, ici_gbps=186.0),
-    "tpu v5p": dict(flops=459e12, memory=95e9, ici_gbps=450.0),
-    "tpu v6": dict(flops=918e12, memory=32e9, ici_gbps=448.0),
-    "cpu": dict(flops=1e12, memory=64e9, ici_gbps=25.0),
+    "tpu v4": dict(flops=275e12, memory=32e9, hbm_gbps=1228.0,
+                   ici_gbps=300.0),
+    "tpu v5 lite": dict(flops=197e12, memory=16e9, hbm_gbps=819.0,
+                        ici_gbps=186.0),
+    "tpu v5e": dict(flops=197e12, memory=16e9, hbm_gbps=819.0,
+                    ici_gbps=186.0),
+    "tpu v5p": dict(flops=459e12, memory=95e9, hbm_gbps=2765.0,
+                    ici_gbps=450.0),
+    "tpu v6": dict(flops=918e12, memory=32e9, hbm_gbps=1640.0,
+                   ici_gbps=448.0),
+    "cpu": dict(flops=1e12, memory=64e9, hbm_gbps=100.0, ici_gbps=25.0),
 }
 
 
@@ -37,6 +45,7 @@ class Device:
     kind: str = "tpu v5e"
     flops: float = 197e12          # peak bf16 FLOP/s
     memory: float = 16e9           # HBM bytes
+    hbm_bw: float = 819e9          # HBM bytes/s
 
 
 @dataclass
@@ -80,7 +89,8 @@ class Cluster:
             m.devices.append(Device(
                 global_id=int(d.id), local_id=len(m.devices),
                 machine_id=pid, kind=kind_str,
-                flops=spec["flops"], memory=spec["memory"]))
+                flops=spec["flops"], memory=spec["memory"],
+                hbm_bw=spec["hbm_gbps"] * 1e9))
         spec = cls._chip_spec(kind or "cpu")
         ici = LinkSpec(bandwidth=spec["ici_gbps"] * 1e9, latency=1e-6)
         return cls(list(machines.values()), ici=ici)
@@ -98,7 +108,9 @@ class Cluster:
                     local_id=li, machine_id=mi,
                     kind=dev.get("type", "tpu v5e"),
                     flops=float(dev.get("flops", spec["flops"])),
-                    memory=float(dev.get("memory", spec["memory"]))))
+                    memory=float(dev.get("memory", spec["memory"])),
+                    hbm_bw=float(dev.get("hbm_bandwidth",
+                                         spec["hbm_gbps"] * 1e9))))
             machines.append(mach)
         links = desc.get("links", {})
         ici = LinkSpec(float(links.get("ici_bandwidth", 186e9)),
@@ -138,6 +150,12 @@ class Cluster:
     def device_memory(self) -> float:
         devs = self.devices
         return devs[0].memory if devs else 0.0
+
+    def peak_hbm_bw(self) -> float:
+        """Per-chip HBM bandwidth in bytes/s (the roofline denominator
+        perfscope divides by)."""
+        devs = self.devices
+        return devs[0].hbm_bw if devs else 0.0
 
     def link(self, group_size: int) -> LinkSpec:
         """Link class a collective over `group_size` adjacent devices rides:

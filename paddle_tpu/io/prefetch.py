@@ -70,6 +70,23 @@ def _tree_put(obj, put):
     return obj
 
 
+def _tree_nbytes(obj) -> int:
+    """Device bytes of a batch nest's array leaves (shape/dtype metadata
+    only — never touches data, never syncs)."""
+    if isinstance(obj, Tensor):
+        obj = obj._value
+    if isinstance(obj, (list, tuple)):
+        return sum(_tree_nbytes(v) for v in obj)
+    if isinstance(obj, dict):
+        return sum(_tree_nbytes(v) for v in obj.values())
+    shape = getattr(obj, "shape", None)
+    dtype = getattr(obj, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    itemsize = getattr(dtype, "itemsize", None) or np.dtype(dtype).itemsize
+    return int(np.prod(shape)) * int(itemsize)
+
+
 class _PrefetchIter:
     """One epoch: a producer thread + a bounded queue.  Created fresh per
     ``iter(DevicePrefetcher)`` so epoch loops restart the pipeline."""
@@ -80,6 +97,12 @@ class _PrefetchIter:
         self._stop = threading.Event()
         self._warm = False
         self._done = False
+        # HBM-ledger row: device bytes sitting in this buffer (batches
+        # transferred but not yet consumed) declare their owner, so a
+        # /debug/memory snapshot can name prefetch-held HBM
+        from ..observability import perfscope
+        self._ledger = perfscope.ledger().register(
+            "prefetch", 0, detail=f"DevicePrefetcher buffer ({owner.name})")
         self._thread = threading.Thread(
             target=self._produce, args=(source,), daemon=True,
             name=f"prefetch-{owner.name}")
@@ -107,6 +130,7 @@ class _PrefetchIter:
                 self._q.put(item, timeout=0.05)
             except queue_mod.Full:
                 continue
+            self._ledger.add(_tree_nbytes(item))
             self._owner._note_depth(self._q.qsize())
             return True
         return False
@@ -130,6 +154,7 @@ class _PrefetchIter:
             item = self._blocking_get()
             owner._note_wait(time.perf_counter() - t0, stalled=stalled)
         self._warm = True
+        self._ledger.add(-_tree_nbytes(item))
         owner._note_depth(self._q.qsize())
         if item is _END:
             self.close()
@@ -160,6 +185,7 @@ class _PrefetchIter:
         t = self._thread
         if t is not None and t is not threading.current_thread():
             t.join(timeout=5.0)
+        self._ledger.release()     # buffered batches die with the iterator
 
     def __del__(self):
         try:

@@ -92,6 +92,9 @@ from .trace import span  # noqa: E402,F401
 # request journeys (per-request phase timelines + the windowed feed);
 # imported after registry() exists — journey feeds phase histograms
 from . import journey  # noqa: E402,F401
+# device perfscope: per-program device-time/MFU attribution + the HBM
+# ownership ledger (already pulled in by retrace; re-exported here)
+from . import perfscope  # noqa: E402,F401
 
 _bootstrap_from_env()
 watchdog._bootstrap_from_env()

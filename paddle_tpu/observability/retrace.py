@@ -23,7 +23,7 @@ import logging
 import os
 import time
 
-from . import flight, registry
+from . import flight, perfscope, registry
 from . import trace as trace_mod
 
 logger = logging.getLogger("paddle_tpu.observability")
@@ -130,31 +130,64 @@ class InstrumentedJit:
     so compile begin/end lands in the flight recorder even with telemetry
     off; the metrics registry is only touched when telemetry is on.
     Attribute access (``.lower``, ``.trace``...) delegates to the wrapped
-    function so AOT paths keep working."""
+    function so AOT paths keep working.
+
+    Device perfscope (observability/perfscope.py) rides the same wrapper:
+    each new signature registers its ``cost_analysis`` flops/bytes once
+    at compile, and with ``PADDLE_TPU_PERFSCOPE_SAMPLE=N`` every Nth
+    dispatch is bracketed with a ``block_until_ready`` to measure device
+    seconds — the other ``N-1`` dispatches stay fully async, and the
+    arguments are never touched, so the signature count (ONE compiled
+    decode program per serving config) is unaffected."""
 
     def __init__(self, fn, name: str):
         self._fn = fn
         self._name = name
         self._signatures: set = set()
 
+    def _invoke(self, args, kwargs):
+        try:
+            return self._fn(*args, **kwargs)
+        except Exception as e:  # noqa: BLE001 — OOM forensics, then re-raise
+            perfscope.note_exception(e, program=self._name)
+            raise
+
+    def _timed(self, key, args, kwargs):
+        """One sampled dispatch: block until the result is device-ready
+        and book the wall as device seconds for this program."""
+        t0 = time.perf_counter()
+        out = self._invoke(args, kwargs)
+        # audited sync: runs on 1/N dispatches only (perfscope sampling);
+        # the timer must observe device completion to measure anything
+        perfscope.block_ready(out)  # tpu-lint: ok(trace-hygiene)
+        perfscope.record_sample(self._name, key,
+                                time.perf_counter() - t0)
+        return out
+
     def __call__(self, *args, **kwargs):
         key = _abstract_signature(args, kwargs)
+        sample = (perfscope.poll_sample(self._name)
+                  if perfscope.sampling_active() else False)
         if key in self._signatures:
-            return self._fn(*args, **kwargs)
+            if sample:
+                return self._timed(key, args, kwargs)
+            return self._invoke(args, kwargs)
         # new abstract signature → jax will trace + compile inside this
         # call; the span books compile begin/end (with the signature key)
         # into the flight record — a hang inside XLA leaves an open
-        # "compile" span for the crash dump to show
+        # "compile" span for the crash dump to show.  Compile dispatches
+        # are never timed (the wall is trace+compile, not device time).
         n = len(self._signatures) + 1
         t0 = time.perf_counter()
         with trace_mod.span("compile", fn=self._name, n_compiles=n,
                             signature=str(key)[:256]):
-            out = self._fn(*args, **kwargs)
+            out = self._invoke(args, kwargs)
         dt = time.perf_counter() - t0
         self._signatures.add(key)
         from ..core import op as op_mod
         if op_mod.TELEMETRY:
             record_compile(self._name, key, dt, len(self._signatures))
+        perfscope.register_program(self._name, key, self._fn, args, kwargs)
         return out
 
     def __getattr__(self, item):

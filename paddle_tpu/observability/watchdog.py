@@ -44,6 +44,16 @@ _install_lock = threading.Lock()
 _prev_excepthook = None
 _prev_signal: dict[int, object] = {}
 _last_dump_path: str | None = None
+# extra bundle sections: name -> zero-arg provider returning JSON-safe
+# data (perfscope registers the HBM ledger here so an OOM names owners)
+_sections: dict[str, object] = {}
+
+
+def add_section(name: str, provider):
+    """Attach a named section to every future crash bundle.  ``provider``
+    is a zero-arg callable returning JSON-safe data; a provider that
+    raises is skipped (a crash handler must never raise)."""
+    _sections[str(name)] = provider
 
 
 def dump_dir() -> str:
@@ -78,6 +88,11 @@ def collect(reason: str, exc_info=None) -> dict:
                        for tid, st in trace.open_spans().items()},
         "threads": thread_stacks(),
     }
+    for name, provider in list(_sections.items()):
+        try:
+            bundle[name] = provider()
+        except Exception:  # noqa: BLE001 — a broken section never blocks a dump
+            pass
     if exc_info is not None and exc_info[0] is not None:
         etype, evalue, etb = exc_info
         bundle["exception"] = {

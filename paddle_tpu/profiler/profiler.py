@@ -149,6 +149,17 @@ def _observability_span_events() -> list[dict]:
     return obs_trace.chrome_events()
 
 
+def _perfscope_device_events() -> list[dict]:
+    """Sampled device-program intervals (perfscope ring) as chrome 'X'
+    events (``"cat": "device"``): the per-program device lane lands on
+    the same perf_counter timeline as host spans and journey tracks."""
+    try:
+        from ..observability import perfscope
+    except Exception:  # pragma: no cover
+        return []
+    return perfscope.chrome_events()
+
+
 def _telemetry_counter_events() -> list[dict]:
     """observability counter samples as chrome-trace 'C' events, so metric
     series land on the same timeline as the host RecordEvent spans (and
@@ -286,6 +297,7 @@ class Profiler:
                    "dur": e["dur"], "pid": os.getpid(), "tid": e["tid"],
                    "cat": "host"} for e in self._events]
         events += _observability_span_events()
+        events += _perfscope_device_events()
         events += _telemetry_counter_events()
         with open(path, "w") as f:
             json.dump({"traceEvents": events,

@@ -150,6 +150,15 @@ class AdapterRegistry:
         pins — called once per Engine construction)."""
         return AdapterResidency(self.max_resident)
 
+    def bank_nbytes(self) -> int:
+        """Device bytes of the stacked f32 banks an engine builds over
+        this registry (A + B factors + scales, ``max_resident + 1`` rows
+        incl. the zero adapter) — the ``adapter_bank`` HBM-ledger owner."""
+        rows = self.max_resident + 1
+        a = rows * self.num_layers * self.hidden * self.max_rank
+        b = rows * self.num_layers * self.max_rank * 3 * self.hidden
+        return 4 * (a + b + rows)
+
     def __repr__(self):
         return (f"AdapterRegistry(adapters={len(self)}, "
                 f"max_resident={self.max_resident}, "

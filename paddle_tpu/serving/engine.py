@@ -84,6 +84,8 @@ import numpy as np
 
 from ..core.tensor import Tensor
 from ..observability import flight, registry, span
+from ..observability import perfscope as _perfscope
+from ..observability import steps as _steps
 from ..observability import watchdog as _watchdog
 from ..observability.retrace import instrument_jit
 from ..testing import faults
@@ -614,6 +616,13 @@ class Engine:
         self._active_pages = 0     # pages referenced by in-flight requests
         self._cached_pages = 0     # pages referenced by prefix entries
         self._page_stalled = False
+        # HBM ownership ledger rows (observability/perfscope.py): one per
+        # long-lived device allocation this build owns, registered by
+        # _build and released by shutdown — a rebuilt engine registers
+        # fresh rows, so leaked ledger bytes mean leaked HBM
+        self._ledger_rows: list = []
+        self._ledger_prefix = None     # nested sub-account of kv_pool
+        self._row_bytes = 0            # dense pool: bytes per slot row
         self._was_training = model.training
         model.eval()
         # interpreter exit with a live scheduler thread mid-XLA-call
@@ -895,6 +904,14 @@ class Engine:
             if self._adapters is not None:
                 self._adapters.check()       # zero leaked adapter pins
             self._gauges_locked()
+            ledger_rows, self._ledger_rows = self._ledger_rows, []
+            self._ledger_prefix = None
+        # this build's HBM is going away with its pools/banks: release
+        # the ledger rows (a leaked row here means leaked device bytes —
+        # the chaos lane asserts zero after the kill matrix)
+        for row in ledger_rows:
+            row.release()
+        _steps.record_memory_stats()
         for req in pending:
             req._finish(err)
         if self._was_training:
@@ -1049,8 +1066,13 @@ class Engine:
 
             def _dq(vals):
                 return vals
+        wrow = _perfscope.ledger().register(
+            "weights", wbytes,
+            detail=("serving weight operands, int8 + scales"
+                    if self._weight_quant else "serving weight operands"))
         with self._lock:
             self._weight_bytes = wbytes
+            self._ledger_rows.append(wrow)
         registry().gauge(
             SERVING_WEIGHT_BYTES,
             "device bytes of the serving weight operands as stored").set(
@@ -1069,6 +1091,11 @@ class Engine:
             self._bbank = jnp.zeros((Rcap + 1, n_layers, r_max, 3 * h),
                                     jnp.float32)
             self._ascale = jnp.zeros((Rcap + 1,), jnp.float32)
+            brow = _perfscope.ledger().register(
+                "adapter_bank", areg.bank_nbytes(),
+                detail=f"stacked LoRA banks, {Rcap} rows + zero adapter")
+            with self._lock:
+                self._ledger_rows.append(brow)
 
         def _mstate(values, adp):
             """Swapped model state, plus the batched-adapter scope when
@@ -1113,8 +1140,28 @@ class Engine:
                 self._pools = (kpools, vpools)
         total = sum(int(np.prod(p.shape)) * p.dtype.itemsize
                     for grp in self._pools for p in grp)
+        led = _perfscope.ledger()
+        krow = led.register(
+            "kv_pool", total,
+            detail=(f"paged KV pool, {self._page_alloc.num_pages} pages"
+                    if paged else f"dense KV pool, {n_rows} slot rows"))
+        # prefix-cache sub-account: cached rows/pages live INSIDE the
+        # pool bytes, so the ledger tracks them as a nested owner
+        # (informational, never double-counted)
+        prow = (led.register(
+            "prefix_cache", 0, nested=True,
+            detail="retained KV rows/pages (bytes inside kv_pool)")
+            if self._prefix is not None else None)
         with self._lock:
             self._pool_bytes = total
+            self._ledger_rows.append(krow)
+            if paged:
+                self._page_alloc.bytes_per_page = total // max(NP_, 1)
+            else:
+                self._row_bytes = total // n_rows
+            if prow is not None:
+                self._ledger_prefix = prow
+                self._ledger_rows.append(prow)
         registry().gauge(
             SERVING_KV_POOL_BYTES,
             "device bytes of the serving KV pools (incl. int8 scales)"
@@ -1427,6 +1474,10 @@ class Engine:
             "serving.prefix_copy")
         with self._lock:
             self._built = True
+        # the build just placed the big long-lived allocations: refresh
+        # the backend device-memory gauges so a pure-serving process
+        # exports them without a train loop in sight
+        _steps.record_memory_stats()
 
     # -- scheduler loop ------------------------------------------------------
     def _loop(self):
@@ -2470,6 +2521,13 @@ class Engine:
 
     def _gauges_locked(self):
         reg = registry()
+        if self._ledger_prefix is not None and self._built:
+            # retained-row bytes: cached slot rows (dense) or cached
+            # pages (paged) — a sub-account of the kv_pool owner
+            nb = (self._cached_pages * self._page_alloc.bytes_per_page
+                  if self.paged_kv else
+                  self._pool.n_cached * self._row_bytes)
+            self._ledger_prefix.update(nb)
         reg.gauge(SERVING_ACTIVE_SLOTS,
                   "slots currently owned by requests").set(
             float(self._pool.n_active))

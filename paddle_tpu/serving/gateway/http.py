@@ -11,7 +11,10 @@ Endpoints:
 * ``GET /metrics`` — the process-wide Prometheus exposition (serving +
   gateway series from the paddle_tpu.observability registry); scraping
   it refreshes the ``paddle_tpu_gateway_window_*`` gauges from the
-  rolling :class:`~paddle_tpu.observability.journey.TelemetryWindow`.
+  rolling :class:`~paddle_tpu.observability.journey.TelemetryWindow`
+  AND the ``paddle_tpu_device_memory_bytes`` backend allocator gauges
+  (``steps.record_memory_stats``), so a pure-serving process exports
+  device memory without a train loop.
 * ``GET /debug/requests?last=N`` — the newest N finished request
   journeys as JSON timelines (phase-level latency attribution;
   docs/observability.md "Request journeys").
@@ -19,6 +22,13 @@ Endpoints:
 * ``GET /debug/window`` — ``Gateway.window_stats()`` as JSON (the
   autoscaler feed: windowed TTFT/queue-wait/per-token percentiles,
   shed rate, phase shares).
+* ``GET /debug/perf`` — the perfscope roofline table as JSON: per
+  compiled program, dispatch/sample counts, sampled device time, MFU
+  and HBM-bandwidth fractions (docs/observability.md "Device
+  perfscope").
+* ``GET /debug/memory`` — the HBM ownership ledger as JSON: per-owner
+  device bytes, the backend allocator's ``bytes_in_use``, and the
+  unattributed remainder.
 
 Every completion handler mints a request **journey** — adopting the
 client's ``X-Request-Id`` header when present — threads it through
@@ -164,9 +174,16 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(200 if health["alive"] else 503, health)
             elif path == "/metrics":
                 # a scrape also refreshes the windowed-feed gauges so
-                # paddle_tpu_gateway_window_* export current values
+                # paddle_tpu_gateway_window_* export current values, and
+                # the backend device-memory gauges (pure-serving
+                # processes have no train loop to call this)
                 try:
                     self.gateway.window_stats()
+                except Exception:  # noqa: BLE001 — never break a scrape
+                    pass
+                try:
+                    from ...observability import steps as steps_mod
+                    steps_mod.record_memory_stats()
                 except Exception:  # noqa: BLE001 — never break a scrape
                     pass
                 text = registry().to_prometheus_text().encode("utf-8")
@@ -181,6 +198,12 @@ class _Handler(BaseHTTPRequestHandler):
                     1.0, labels={"code": 200})
             elif path == "/debug/window":
                 self._send_json(200, self.gateway.window_stats())
+            elif path == "/debug/perf":
+                from ...observability import perfscope
+                self._send_json(200, perfscope.perf_report())
+            elif path == "/debug/memory":
+                from ...observability import perfscope
+                self._send_json(200, perfscope.memory_report())
             elif path == "/debug/requests":
                 last = 32
                 for part in query.split("&"):

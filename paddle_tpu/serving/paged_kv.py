@@ -57,6 +57,11 @@ class PageAllocator:
         self.alloc_total = 0
         self.share_total = 0
         self.free_total = 0
+        # device bytes one physical page occupies across every layer's
+        # K/V (+ scale) pool buffers — set by the engine after it builds
+        # the pools; 0 until then.  Pure accounting (the HBM-ledger
+        # prefix_cache sub-owner multiplies cached pages by this).
+        self.bytes_per_page = 0
 
     # -- allocation ----------------------------------------------------------
     def alloc(self, n: int = 1) -> Optional[List[int]]:
@@ -107,6 +112,12 @@ class PageAllocator:
     @property
     def n_used(self) -> int:
         return self.num_pages - len(self._free)
+
+    @property
+    def used_bytes(self) -> int:
+        """Pool bytes backing allocated pages (``bytes_per_page`` must
+        have been set by the pool owner)."""
+        return self.n_used * self.bytes_per_page
 
     def check(self) -> None:
         """Internal-consistency assert (chaos/teardown leak check): every

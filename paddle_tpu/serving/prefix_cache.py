@@ -104,6 +104,12 @@ class PrefixIndex:
     def n_evictable(self) -> int:
         return sum(1 for e in self._entries.values() if e.refs == 0)
 
+    @property
+    def n_cached_tokens(self) -> int:
+        """Tokens whose KV rows the index keeps resident — the content
+        behind the HBM-ledger ``prefix_cache`` sub-owner's bytes."""
+        return sum(e.n for e in self._entries.values())
+
     def _boundaries(self, n: int):
         """Block boundaries <= n, longest first (never 0)."""
         b = (n // self.block) * self.block

@@ -171,15 +171,22 @@ class SparseCsrTensor:
 
 def sparse_coo_tensor(indices, values, shape=None, dtype=None,
                       place=None, stop_gradient=True):
-    idx = jnp.asarray(_val(indices), jnp.int64)
+    # shape inference runs on the HOST copy BEFORE the device transfer:
+    # construction-time indices are host data (lists / numpy) in the
+    # common path, so the np reduction costs nothing — the previous
+    # device-side max forced a transfer + reduce + sync round trip per
+    # construction (and synced even when `shape` was provided)
+    raw = indices._value if isinstance(indices, Tensor) else indices
+    host_idx = np.asarray(raw, dtype=np.int64)
+    if host_idx.ndim != 2:
+        raise ValueError("indices must be [sparse_dim, nnz]")
+    idx = jnp.asarray(host_idx)
     vals = values if isinstance(values, Tensor) else \
         Tensor(jnp.asarray(_val(values)), _internal=True)
     if dtype is not None:
         vals = vals.astype(dtype)
-    if idx.ndim != 2:
-        raise ValueError("indices must be [sparse_dim, nnz]")
     if shape is None:
-        shape = tuple(int(i) for i in np.asarray(idx.max(axis=1)) + 1)
+        shape = tuple(int(i) for i in host_idx.max(axis=1) + 1)
     return SparseCooTensor._make(vals, idx.T, tuple(shape))
 
 

@@ -387,6 +387,20 @@ def main() -> int:
     # (stale bank reuse across pools is impossible by construction)
     assert len({id(e._adapters) for e in engines_built}) == \
         len(engines_built), "a rebuild reused a residency tracker"
+    # HBM-ledger conservation (ISSUE 14; the byte analogue of the
+    # zero-leaked-pages assert): every engine build registered fresh
+    # owner rows (weights + kv_pool + adapter_bank + prefix_cache per
+    # build), every teardown — killed or drained — released them, so
+    # after the kill matrix the ledger holds ZERO serving bytes
+    from paddle_tpu.observability import perfscope
+    led = perfscope.ledger()
+    snap = led.snapshot()
+    assert snap["total"] == 0 and not snap["rows"], \
+        f"leaked ledger bytes after the kill matrix: {snap}"
+    assert led.registered_total >= 4 * len(engines_built), \
+        (led.registered_total, len(engines_built))
+    assert led.released_total == led.registered_total, snap
+    summary["ledger_rows_cycled"] = led.registered_total
     summary["engine_builds_checked"] = len(engines_built)
     summary["drained"] = True
     print(json.dumps(summary))

@@ -1,0 +1,137 @@
+"""Render a running server's device-perfscope state as console tables.
+
+Pulls ``GET /debug/perf`` (the per-program roofline) and
+``GET /debug/memory`` (the HBM ownership ledger) from a live gateway —
+or from saved JSON — and prints the text form: one row per compiled
+program (dispatches, sampled device ms, estimated share of device time,
+MFU, HBM-bandwidth fraction) and one row per HBM owner (bytes, share of
+tracked, nested sub-accounts), with the backend allocator's
+``bytes_in_use`` and the unattributed remainder when the platform
+reports them.  The visual twin of ``tools/journey_report.py`` for the
+device side of the house.
+
+    python tools/perf_report.py --url http://127.0.0.1:8000
+    python tools/perf_report.py --perf-json perf.json --memory-json mem.json
+
+``--trace out.json`` additionally writes the IN-PROCESS perfscope
+device lane (``cat: "device"`` chrome events — only meaningful when
+samples were recorded in this process).
+
+stdlib-only; no jax, no paddle_tpu import needed for the URL/file modes.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+
+__all__ = ["format_perf", "format_memory", "fetch"]
+
+
+def fetch(url: str, path: str, timeout: float = 30.0) -> dict:
+    full = f"{url.rstrip('/')}{path}"
+    with urllib.request.urlopen(full, timeout=timeout) as resp:
+        return json.loads(resp.read().decode("utf-8"))
+
+
+def _fmt_bytes(n) -> str:
+    n = float(n or 0)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:,.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024
+    return f"{n:,.1f} GiB"
+
+
+def _pct(x) -> str:
+    return "-" if x is None else f"{100 * x:6.2f}%"
+
+
+def format_perf(rep: dict) -> list[str]:
+    """``/debug/perf`` JSON -> console roofline table lines."""
+    lines = [
+        f"device perfscope  sample_every={rep.get('sample_every', 0)}  "
+        f"peak={rep.get('peak_flops', 0) / 1e12:.1f} TFLOP/s  "
+        f"hbm={rep.get('peak_hbm_bw', 0) / 1e9:.0f} GB/s",
+        f"  {'program':<24} {'disp':>6} {'sampled':>7} "
+        f"{'device_ms':>10} {'share':>7} {'MFU':>8} {'BW':>8}",
+    ]
+    for p in rep.get("programs", ()):
+        lines.append(
+            f"  {p['program']:<24} {p['dispatches']:>6} {p['sampled']:>7} "
+            f"{1e3 * (p['device_s'] or 0.0):>10.2f} "
+            f"{_pct(p.get('share')):>7} {_pct(p.get('mfu')):>8} "
+            f"{_pct(p.get('hbm_bw_frac')):>8}")
+    if len(lines) == 2:
+        lines.append("  (no programs registered — is sampling on and "
+                     "telemetry live?)")
+    return lines
+
+
+def format_memory(mem: dict) -> list[str]:
+    """``/debug/memory`` JSON -> console ownership table lines."""
+    owners = mem.get("owners", {})
+    total = mem.get("total_tracked", 0) or 0
+    lines = [f"hbm ledger  tracked={_fmt_bytes(total)}",
+             f"  {'owner':<24} {'bytes':>14} {'share':>7}"]
+    for owner, nb in sorted(owners.items(), key=lambda kv: -kv[1]):
+        share = (nb / total) if total else None
+        lines.append(f"  {owner:<24} {_fmt_bytes(nb):>14} "
+                     f"{_pct(share):>7}")
+    for owner, nb in sorted(mem.get("nested", {}).items()):
+        lines.append(f"  {'+ ' + owner:<24} {_fmt_bytes(nb):>14} "
+                     f"{'nested':>7}")
+    backend = mem.get("backend") or {}
+    if "bytes_in_use" in backend:
+        lines.append(f"  {'backend bytes_in_use':<24} "
+                     f"{_fmt_bytes(backend['bytes_in_use']):>14}")
+        lines.append(f"  {'unattributed':<24} "
+                     f"{_fmt_bytes(mem.get('unattributed', 0)):>14}")
+    else:
+        lines.append("  (backend reports no allocator stats on this "
+                     "platform)")
+    return lines
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument("--url", help="gateway base url, e.g. "
+                     "http://127.0.0.1:8000 (reads /debug/perf + "
+                     "/debug/memory)")
+    src.add_argument("--perf-json", help="saved /debug/perf payload")
+    ap.add_argument("--memory-json", help="saved /debug/memory payload "
+                    "(with --perf-json)")
+    ap.add_argument("--trace", help="also write the in-process perfscope "
+                    "device lane as a chrome trace (imports paddle_tpu)")
+    args = ap.parse_args(argv)
+
+    if args.url:
+        perf = fetch(args.url, "/debug/perf")
+        mem = fetch(args.url, "/debug/memory")
+    else:
+        with open(args.perf_json) as f:
+            perf = json.load(f)
+        mem = None
+        if args.memory_json:
+            with open(args.memory_json) as f:
+                mem = json.load(f)
+
+    for line in format_perf(perf):
+        print(line)
+    if mem is not None:
+        print()
+        for line in format_memory(mem):
+            print(line)
+    if args.trace:
+        from paddle_tpu.observability import perfscope
+        events = perfscope.chrome_events()
+        with open(args.trace, "w") as f:
+            json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+        print(f"\n{len(events)} device-lane events -> {args.trace}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

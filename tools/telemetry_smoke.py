@@ -41,6 +41,7 @@ DEFAULT_SUBSET = [
     "tests/test_robustness.py",
     "tests/test_multi_lora.py",
     "tests/test_journey.py",
+    "tests/test_perfscope.py",
 ]
 
 # decode fast-path lane (ISSUE 10): prefix cache + speculation + int8 KV
@@ -268,6 +269,98 @@ finally:
     eng.shutdown()
 """
 
+# perfscope lane (ISSUE 14): serving traffic with device-time sampling ON
+# (every dispatch timed) — the per-program roofline gauges must export,
+# the reported decode MFU/BW fractions must match the cost_analysis
+# expectation, the HBM ledger must reconcile with the pre-existing
+# kv_pool_bytes / weight_bytes exports and drain to zero at shutdown,
+# the chrome device lane must parse, and decode stays at ONE signature.
+PERFSCOPE_LANE = r"""
+import http.client, json
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu import observability as obs
+from paddle_tpu.models import build_gpt, gpt_config
+from paddle_tpu.observability import perfscope
+from paddle_tpu.serving import Engine
+from paddle_tpu.serving.gateway import TenantConfig, start_gateway
+from tools.perf_report import format_memory, format_perf
+
+assert obs.enabled(), "PADDLE_TPU_TELEMETRY=1 must bootstrap telemetry"
+perfscope.set_sample_every(1)
+cfg = gpt_config("gpt-tiny", max_position_embeddings=128,
+                 hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+paddle.seed(0)
+model = build_gpt(cfg)
+model.eval()
+eng = Engine(model, max_slots=2, max_len=64, prefix_cache=True,
+             prefix_block=4)
+stack = start_gateway([eng], tenants=[TenantConfig("ta")])
+try:
+    rs = np.random.RandomState(7)
+    for i in range(4):
+        conn = http.client.HTTPConnection("127.0.0.1", stack.port,
+                                          timeout=300)
+        prompt = [int(t) for t in rs.randint(1, cfg.vocab_size, 5 + i)]
+        conn.request("POST", "/v1/completions",
+                     json.dumps({"prompt": prompt,
+                                 "max_tokens": 4}).encode(),
+                     {"Content-Type": "application/json", "X-Tenant": "ta"})
+        r = conn.getresponse()
+        body = r.read()
+        conn.close()
+        assert r.status == 200, (r.status, body)
+    st = eng.stats()
+    assert st["decode_compiles"] == 1, st
+
+    conn = http.client.HTTPConnection("127.0.0.1", stack.port, timeout=60)
+    conn.request("GET", "/debug/perf")
+    perf = json.loads(conn.getresponse().read())
+    conn.close()
+    dec = next(p for p in perf["programs"]
+               if p["program"] == "serving.decode")
+    assert dec["sampled"] > 0 and dec["signatures"] == 1, dec
+    mean_dt = dec["device_s"] / dec["sampled"]
+    expect = dec["flops"] / (mean_dt * perf["peak_flops"])
+    assert abs(dec["mfu"] - expect) <= 0.02 * expect + 1e-9, (dec, expect)
+
+    conn = http.client.HTTPConnection("127.0.0.1", stack.port, timeout=60)
+    conn.request("GET", "/debug/memory")
+    mem = json.loads(conn.getresponse().read())
+    conn.close()
+    assert mem["owners"]["kv_pool"] == eng.pool_bytes() == \
+        st["kv_pool_bytes"], (mem["owners"], st["kv_pool_bytes"])
+    assert mem["owners"]["weights"] == eng.weight_bytes() == \
+        st["weight_bytes"], (mem["owners"], st["weight_bytes"])
+    assert mem["total_tracked"] == sum(mem["owners"].values()), mem
+
+    conn = http.client.HTTPConnection("127.0.0.1", stack.port, timeout=60)
+    conn.request("GET", "/metrics")
+    text = conn.getresponse().read().decode()
+    conn.close()
+    for name in ("paddle_tpu_device_program_seconds",
+                 "paddle_tpu_device_program_mfu",
+                 "paddle_tpu_device_program_hbm_bw_frac",
+                 "paddle_tpu_hbm_bytes"):
+        assert name in text, name
+
+    events = perfscope.chrome_events()
+    parsed = json.loads(json.dumps({"traceEvents": events}))
+    assert parsed["traceEvents"] and all(
+        e["ph"] == "X" and e["cat"] == "device"
+        for e in parsed["traceEvents"])
+    for line in format_perf(perf) + format_memory(mem):
+        print(line)
+finally:
+    stack.close()
+    eng.shutdown()
+led = perfscope.ledger().owner_bytes()
+assert all(v == 0 for v in led.values()), f"leaked ledger bytes: {led}"
+print("perfscope lane ok:", {
+    "decode_sampled": dec["sampled"], "decode_mfu": dec["mfu"],
+    "owners": list(mem["owners"]), "decode_compiles": st["decode_compiles"]})
+"""
+
 # prefetch-on training lane: fit a tiny model THROUGH DevicePrefetcher with
 # telemetry live and assert the input-pipeline series were exported.  Runs
 # in its own interpreter so the env-var bootstrap path is what's exercised.
@@ -365,6 +458,16 @@ def main() -> int:
         if jn_rc != 0:
             print("journey lane FAILED", file=sys.stderr)
         rc = rc or jn_rc
+        # perfscope lane (ISSUE 14): device-time sampling + HBM ledger —
+        # roofline gauges export, decode MFU matches the cost_analysis
+        # expectation, ledger reconciles with kv_pool/weight bytes and
+        # drains to zero, one decode signature with sampling on
+        print("telemetry smoke: perfscope lane", file=sys.stderr)
+        ps_rc = subprocess.call([sys.executable, "-c", PERFSCOPE_LANE],
+                                env=env, cwd=root)
+        if ps_rc != 0:
+            print("perfscope lane FAILED", file=sys.stderr)
+        rc = rc or ps_rc
         # tpu-lint ratchet gate (ISSUE 7): runs even when the pytest
         # subset has unrelated failures, in its own interpreter (the
         # analyzer is jax-free, so it cannot be broken by runtime drift)
