@@ -62,6 +62,15 @@ class _Metric:
         with self._lock:
             return [(dict(k), v) for k, v in self._children.items()]
 
+    def remove(self, labels: dict | None = None) -> bool:
+        """Delete one labeled series (True if it existed).  For series
+        keyed by a dynamic entity — an engine replica, an adapter — the
+        entity's removal must delete its series, not freeze it at the
+        last value: a dashboard showing a dead replica's stale occupancy
+        is a mis-diagnosis trap."""
+        with self._lock:
+            return self._children.pop(_label_key(labels), None) is not None
+
 
 class Counter(_Metric):
     kind = "counter"

@@ -33,8 +33,16 @@ routing) lives in :mod:`paddle_tpu.serving.gateway`::
     from paddle_tpu.serving.gateway import start_gateway
     stack = start_gateway([engine])        # POST /v1/completions
 
+Replica count is a control loop, not a constant:
+``Autoscaler(stack, factory, min_replicas=1, max_replicas=8)`` watches
+the gateway's windowed telemetry feed and grows/shrinks the fleet
+(docs/serving.md "Autoscaling"; scale-down always drains first —
+docs/robustness.md "Fleet elasticity").  ``FleetSim`` replays the same
+scaling policy against virtual replicas for device-free evaluation.
+
 See docs/serving.md for the architecture, tuning and telemetry fields.
 """
+from .autoscaler import Autoscaler, FleetSim, ScalePolicy  # noqa: F401
 from .adapters import (  # noqa: F401
     AdapterError,
     AdapterRankError,
@@ -61,7 +69,8 @@ from .slot_pool import SlotPool  # noqa: F401
 from .speculative import NgramDrafter  # noqa: F401
 from .supervisor import EngineSupervisor  # noqa: F401
 
-__all__ = ["Engine", "EngineSupervisor", "RequestHandle", "SlotPool",
+__all__ = ["Engine", "EngineSupervisor", "Autoscaler", "ScalePolicy",
+           "FleetSim", "RequestHandle", "SlotPool",
            "PageAllocator", "PrefixIndex", "PrefixEntry", "NgramDrafter",
            "AdapterRegistry", "LoraAdapter", "make_lora", "AdapterError",
            "AdapterShapeError", "AdapterRankError", "UnknownAdapterError",

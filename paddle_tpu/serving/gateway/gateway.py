@@ -186,8 +186,62 @@ class Gateway:
         self._drain_retry_after_s = 5.0
         self._dispatcher_error: BaseException | None = None
         self._thread: threading.Thread | None = None
+        self._fleet_lock = threading.Lock()
+        self._autoscaler = None
         if start:
             self.start()
+
+    # -- fleet elasticity hooks ----------------------------------------------
+    def attach_autoscaler(self, autoscaler):
+        """Register the fleet autoscaler (one per gateway): admission
+        treats a scale-up in flight as capacity-on-the-way (no all-dead
+        503 while the only other replica drains), shed Retry-After is
+        capped at the expected warm-up completion, and ``/debug/fleet``
+        serves its state."""
+        with self._fleet_lock:
+            self._autoscaler = autoscaler
+
+    @property
+    def autoscaler(self):
+        with self._fleet_lock:
+            return self._autoscaler
+
+    def _fleet_pending(self) -> bool:
+        """Capacity is leaving-but-finishing or on the way: some replica
+        is DRAINING (its in-flight work completes; new work must wait,
+        not 503) or the autoscaler has a scale-up building."""
+        a = self.autoscaler
+        if a is not None and a.scale_pending():
+            return True
+        return self.router.any_draining()
+
+    def _scale_eta_s(self) -> float | None:
+        a = self.autoscaler
+        return a.expected_ready_s() if a is not None else None
+
+    def fleet_stats(self) -> dict:
+        """The ``/debug/fleet`` payload: per-replica state from the
+        router plus the autoscaler's view (bounds, desired count,
+        in-flight op, recent scale events) when one is attached."""
+        loads = self.router.loads()
+        out = {
+            "replicas": {
+                name: {"alive": ld["alive"],
+                       "draining": bool(ld.get("draining")),
+                       "restarting": bool(ld.get("restarting")),
+                       "slots_in_use": ld["slots_in_use"],
+                       "queue_depth": ld["queue_depth"],
+                       "max_slots": ld["max_slots"]}
+                for name, ld in loads.items()},
+            "alive": sum(1 for ld in loads.values()
+                         if ld["alive"] and not ld.get("draining")),
+            "draining": sum(1 for ld in loads.values()
+                            if ld.get("draining")),
+            "total_slots": self.router.total_slots(),
+        }
+        a = self.autoscaler
+        out["autoscaler"] = a.fleet_stats() if a is not None else None
+        return out
 
     # -- lifecycle -----------------------------------------------------------
     def start(self):
@@ -278,7 +332,7 @@ class Gateway:
                 "draining", "gateway is draining for shutdown; retry "
                 "against another replica",
                 retry_after_s=self._drain_retry_after_s, tenant=tenant)
-        if not self.router.any_alive():
+        if not self.router.any_alive() and not self._fleet_pending():
             raise NoEngineAvailableError(
                 "no alive engine replica to serve this request")
         prompt = self._prompt_ids(creq)
@@ -309,6 +363,13 @@ class Gateway:
                       "estimated TTFT for a request joining now").set(
                 decision.est_ttft_s)
         if not decision.admit:
+            # scale-aware Retry-After: while a scale-up is building, the
+            # static `est - deadline` horizon is wrong — capacity arrives
+            # at warm-up completion (cold-build EWMA), so shed clients
+            # should return exactly then, not later
+            eta = self._scale_eta_s()
+            if eta is not None and eta < decision.retry_after_s:
+                decision.retry_after_s = max(0.1, round(eta, 2))
             self._count(tenant, "shed")
             self.window.observe_shed("slo_shed")
             reg.counter(GATEWAY_SHED, "requests shed by reason").inc(
@@ -440,9 +501,12 @@ class Gateway:
             if self._stop_ev.is_set():
                 break
             if not self.router.has_headroom(self.dispatch_slack):
-                if not self.router.any_alive():
+                if not self.router.any_alive() and \
+                        not self._fleet_pending():
                     # every replica died with work queued: fail it loudly
                     # instead of letting handlers hang to their timeout
+                    # (a DRAINING replica or an in-flight scale-up means
+                    # capacity is coming — queued work waits instead)
                     item = self.scheduler.pop(timeout=0.02)
                     if item is not None:
                         self.scheduler.release(item.tenant, item.cost)
@@ -495,6 +559,15 @@ class Gateway:
             try:
                 name, engine = self.router.pick(exclude=tried)
             except NoEngineAvailableError as e:
+                if not tried and self._fleet_pending():
+                    # nothing pickable RIGHT NOW but a replica is
+                    # draining out or a scale-up is building: park the
+                    # item at the head of its queue — never redispatch
+                    # onto a replica that is leaving, never 503 work
+                    # that arriving capacity will absorb
+                    self.scheduler.requeue(item)
+                    time.sleep(0.002)
+                    return False
                 self.scheduler.release(item.tenant, item.cost)
                 self._count(item.tenant, "failed")
                 item.fail(e)

@@ -22,6 +22,11 @@ Endpoints:
 * ``GET /debug/window`` — ``Gateway.window_stats()`` as JSON (the
   autoscaler feed: windowed TTFT/queue-wait/per-token percentiles,
   shed rate, phase shares).
+* ``GET /debug/fleet`` — ``Gateway.fleet_stats()`` as JSON: per-replica
+  alive/draining/restarting state and, with an
+  :class:`~paddle_tpu.serving.autoscaler.Autoscaler` attached, the
+  fleet bounds, desired count, in-flight scale op, cold-build EWMA and
+  recent scale events.
 * ``GET /debug/perf`` — the perfscope roofline table as JSON: per
   compiled program, dispatch/sample counts, sampled device time, MFU
   and HBM-bandwidth fractions (docs/observability.md "Device
@@ -198,6 +203,8 @@ class _Handler(BaseHTTPRequestHandler):
                     1.0, labels={"code": 200})
             elif path == "/debug/window":
                 self._send_json(200, self.gateway.window_stats())
+            elif path == "/debug/fleet":
+                self._send_json(200, self.gateway.fleet_stats())
             elif path == "/debug/perf":
                 from ...observability import perfscope
                 self._send_json(200, perfscope.perf_report())

@@ -7,8 +7,22 @@ depth).  An engine whose scheduler crashed reports ``alive: False``
 (PR 5's ``EngineDeadError`` semantics) and is simply never picked again —
 the remaining replicas absorb its traffic; with every replica dead the
 router raises :class:`NoEngineAvailableError` (HTTP 503).
+
+Membership is DYNAMIC (the autoscaler's substrate, ROADMAP item 5):
+:meth:`add_replica` / :meth:`remove_replica` mutate the replica set
+under the router's lock, safe against a dispatcher mid-``pick`` and the
+reaper's cross-replica redispatch — both iterate a snapshot.  A replica
+whose ``load()`` reports ``draining`` is a THIRD state: not pickable
+(no new work, so parked zero-token requests never redispatch onto a
+replica that is leaving) but NOT dead — :meth:`any_draining` lets the
+gateway keep queued work parked instead of 503-ing while the only other
+capacity is mid-cold-build.  Removing a replica deletes its per-engine
+gauge series (``paddle_tpu_gateway_engine_slots_in_use{engine=...}``)
+instead of freezing them at the last value.
 """
 from __future__ import annotations
+
+import threading
 
 from ...observability import registry
 
@@ -23,7 +37,7 @@ class NoEngineAvailableError(RuntimeError):
 
 
 class EngineRouter:
-    """Least-loaded routing over a fixed set of engine replicas."""
+    """Least-loaded routing over a dynamic set of engine replicas."""
 
     def __init__(self, engines, names=None):
         engines = list(engines)
@@ -33,29 +47,71 @@ class EngineRouter:
             names = [f"engine{i}" for i in range(len(engines))]
         if len(names) != len(engines) or len(set(names)) != len(names):
             raise ValueError("names must be unique, one per engine")
+        self._lock = threading.Lock()
         self._engines = list(zip(list(names), engines))
+
+    def _snapshot(self) -> list:
+        with self._lock:
+            return list(self._engines)
+
+    # -- membership (autoscaler control thread vs dispatcher/reaper) ---------
+    def add_replica(self, name: str, engine):
+        """Add one replica under the router's lock; the dispatcher's next
+        ``pick``/``has_headroom`` sees it immediately."""
+        name = str(name)
+        with self._lock:
+            if any(n == name for n, _ in self._engines):
+                raise ValueError(f"replica name {name!r} already routed")
+            self._engines.append((name, engine))
+
+    def remove_replica(self, name: str):
+        """Remove one replica (returns its engine) and DELETE its
+        per-engine gauge series — a removed replica must vanish from the
+        dashboard, not freeze at its last occupancy.  Raises KeyError on
+        an unknown name; refuses to empty the router."""
+        with self._lock:
+            idx = next((i for i, (n, _) in enumerate(self._engines)
+                        if n == name), None)
+            if idx is None:
+                raise KeyError(f"no replica named {name!r}")
+            if len(self._engines) == 1:
+                raise ValueError("refusing to remove the last replica")
+            _, eng = self._engines.pop(idx)
+        registry().gauge(GATEWAY_ENGINE_SLOTS,
+                         "per-replica slots owned by requests").remove(
+            labels={"engine": name})
+        return eng
 
     @property
     def engines(self) -> list:
-        return [e for _, e in self._engines]
+        return [e for _, e in self._snapshot()]
 
     @property
     def names(self) -> list:
-        return [n for n, _ in self._engines]
+        return [n for n, _ in self._snapshot()]
 
     def loads(self) -> dict:
         """{name: Engine.load() snapshot} for every replica; also refreshes
-        the per-engine occupancy gauges."""
+        the per-engine occupancy gauges (and drops series for replicas
+        that left the router since the last refresh)."""
         reg = registry()
         out = {}
         alive = 0
-        for name, eng in self._engines:
+        current = self._snapshot()
+        gauge = reg.gauge(GATEWAY_ENGINE_SLOTS,
+                          "per-replica slots owned by requests")
+        for name, eng in current:
             ld = eng.load()
             out[name] = ld
             alive += bool(ld["alive"])
-            reg.gauge(GATEWAY_ENGINE_SLOTS,
-                      "per-replica slots owned by requests").set(
-                float(ld["slots_in_use"]), labels={"engine": name})
+            gauge.set(float(ld["slots_in_use"]), labels={"engine": name})
+        # sweep series whose engine is no longer routed (a remove_replica
+        # racing this refresh can re-export a stale series for one poll)
+        routed = {name for name, _ in current}
+        for labels, _ in gauge.series():
+            name = labels.get("engine")
+            if name is not None and name not in routed:
+                gauge.remove(labels={"engine": name})
         reg.gauge(GATEWAY_ENGINES_ALIVE, "replicas able to take work").set(
             float(alive))
         return out
@@ -63,14 +119,16 @@ class EngineRouter:
     def pick(self, exclude=()) -> tuple:
         """(name, engine) of the least-loaded alive replica (slot
         occupancy first, engine queue depth as the tiebreak); raises
-        :class:`NoEngineAvailableError` when none qualifies."""
+        :class:`NoEngineAvailableError` when none qualifies.  Draining
+        replicas are never picked — new work (including redispatched
+        parked work) must not land on a replica that is leaving."""
         best = None
         best_key = None
-        for name, eng in self._engines:
+        for name, eng in self._snapshot():
             if name in exclude:
                 continue
             ld = eng.load()
-            if not ld["alive"]:
+            if not ld["alive"] or ld.get("draining"):
                 continue
             key = (ld["slots_in_use"] + ld["queue_depth"],
                    ld["queue_depth"], name)
@@ -82,16 +140,25 @@ class EngineRouter:
         return best
 
     def any_alive(self) -> bool:
-        return any(eng.load()["alive"] for _, eng in self._engines)
+        return any(eng.load()["alive"] for _, eng in self._snapshot())
+
+    def any_draining(self) -> bool:
+        """True while some replica is draining — a third state between
+        alive and dead: it takes no new work but its in-flight work is
+        finishing, so the gateway parks queued work instead of failing
+        it (no spurious 503 while the only other replica is
+        mid-cold-build)."""
+        return any(eng.load().get("draining")
+                   for _, eng in self._snapshot())
 
     def has_headroom(self, slack: int = 1) -> bool:
         """True when some alive replica can take one more request without
         queuing deeper than `slack` behind its slot pool — the dispatcher
         gate that keeps ordering decisions IN the gateway's fair-share
         queues instead of an engine FIFO."""
-        for _, eng in self._engines:
+        for _, eng in self._snapshot():
             ld = eng.load()
-            if ld["alive"] and \
+            if ld["alive"] and not ld.get("draining") and \
                     ld["slots_in_use"] + ld["queue_depth"] < \
                     ld["max_slots"] + slack:
                 return True
@@ -101,16 +168,15 @@ class EngineRouter:
         """Aggregate decode parallelism of the alive replicas (the shed
         formula's drain rate denominator)."""
         total = 0
-        for _, eng in self._engines:
+        for _, eng in self._snapshot():
             ld = eng.load()
-            if ld["alive"]:
+            if ld["alive"] and not ld.get("draining"):
                 total += ld["max_slots"]
         return total or 1
 
     def min_max_len(self) -> int:
         """Tightest per-request length bound across alive replicas (admission
         validates prompt+max_tokens against this)."""
-        lens = [e.max_len for _, e in self._engines
-                if e.load()["alive"]]
-        return min(lens) if lens else min(e.max_len
-                                          for _, e in self._engines)
+        engines = self._snapshot()
+        lens = [e.max_len for _, e in engines if e.load()["alive"]]
+        return min(lens) if lens else min(e.max_len for _, e in engines)

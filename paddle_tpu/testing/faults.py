@@ -44,9 +44,15 @@ path (ISSUE 9): ``serving.prefill`` / ``serving.decode`` (before each
 batched dispatch; a crash there loses zero-token vs. streamed requests
 respectively), ``serving.stream`` (per emitted token — ``after=K`` lets
 K tokens through, then the death interrupts a live stream),
-``serving.rebuild`` (the supervisor's engine-rebuild step) and
+``serving.rebuild`` (the supervisor's engine-rebuild step),
 ``gateway.dispatch`` (the gateway dispatcher loop, whose death must
-degrade /healthz).  A fault anywhere along the restore path must leave
+degrade /healthz), and the fleet-elasticity path (ISSUE 15):
+``scale.up_build`` (before the autoscaler's factory builds a new
+replica — a crash there fails that scale-up, which must be retried),
+``scale.down_drain`` (before a scale-down's drain begins — the replica
+must still leave only after draining empty) and ``autoscaler.tick``
+(the control loop body, whose crash must be absorbed, never ending
+scaling silently).  A fault anywhere along the restore path must leave
 BOTH the checkpoint dir and the running train state untouched —
 asserted by the elastic crash matrix in tests/test_elastic.py.
 """
@@ -74,6 +80,7 @@ CATALOGUE = (
     "restore.read", "restore.relayout", "restore.rng",
     "serving.scheduler", "serving.prefill", "serving.decode",
     "serving.stream", "serving.rebuild", "gateway.dispatch",
+    "scale.up_build", "scale.down_drain", "autoscaler.tick",
     "train.step",
 )
 

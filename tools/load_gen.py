@@ -1,0 +1,217 @@
+"""Trace-driven load generator — diurnal + flash-crowd + heavy-tail.
+
+A Poisson-constant-QPS sweep (the PR 8 bench shape) never exercises the
+autoscaler: real traffic has a daily swing, step-function flash crowds,
+and heavy-tailed prompt/output lengths whose long requests pin slots
+long after the arrival burst has passed.  This module generates exactly
+that, seeded and deterministic:
+
+* **arrivals** — a nonhomogeneous Poisson process (thinning): a
+  sinusoidal diurnal swing (``base_qps * (1 + diurnal_amp * sin)``)
+  with a step-function flash-crowd window pinning the rate to
+  ``flash_mult * base_qps`` for ``flash_duration_s`` starting at
+  ``flash_at`` of the trace — Black Friday in miniature.
+* **lengths** — lognormal prompt and output token counts (heavy tail:
+  p99/p50 of several x), clipped to the serving window.
+
+The SAME trace drives both consumers:
+
+* :class:`paddle_tpu.serving.FleetSim` — virtual-time closed-loop
+  simulation (tier-1-testable policy evaluation, the bench
+  ``autoscale`` block's attainment-vs-replica-seconds curves);
+* this file's CLI — real HTTP load against a gateway::
+
+      python tools/load_gen.py --url http://127.0.0.1:PORT \
+          --duration 30 --qps 4 --flash-mult 6 --seed 0
+
+  replays the trace wall-clock (one thread per in-flight request,
+  bounded), then prints a JSON summary (completed/shed/error counts,
+  client-measured TTFT percentiles, achieved QPS).
+"""
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import math
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+__all__ = ["make_trace", "replay_http"]
+
+
+def make_trace(duration_s: float = 60.0, base_qps: float = 4.0,
+               seed: int = 0, *,
+               diurnal_period_s: float | None = None,
+               diurnal_amp: float = 0.4,
+               flash_at: float = 0.5, flash_mult: float = 6.0,
+               flash_duration_s: float = 8.0,
+               prompt_mean: float = 16.0, prompt_sigma: float = 0.8,
+               out_mean: float = 12.0, out_sigma: float = 0.7,
+               prompt_max: int = 512, out_max: int = 256,
+               deadline_s: float | None = None) -> list:
+    """Seeded trace: [{"t", "prompt_len", "max_tokens"[, "deadline_s"]}].
+
+    ``diurnal_period_s`` defaults to the trace duration (one full day's
+    swing per trace); ``flash_at`` is the flash crowd's start as a
+    fraction of the duration.  Lengths are lognormal around the given
+    means — the p99 request is many times the p50, so a handful of
+    requests dominate slot occupancy exactly like production.
+    """
+    if duration_s <= 0 or base_qps <= 0:
+        raise ValueError("duration_s and base_qps must be positive")
+    rs = np.random.RandomState(seed)
+    period = float(diurnal_period_s or duration_s)
+    flash_t0 = flash_at * duration_s
+    flash_t1 = flash_t0 + flash_duration_s
+
+    def rate(t: float) -> float:
+        # the flash crowd is a STEP to flash_mult x base — it overrides
+        # the diurnal swing rather than compounding with it, so a
+        # caller controls the overload depth exactly
+        if flash_t0 <= t < flash_t1:
+            return max(base_qps * flash_mult, 1e-6)
+        return max(base_qps * (1.0 + diurnal_amp *
+                               math.sin(2.0 * math.pi * t / period)), 1e-6)
+
+    rate_max = base_qps * (1.0 + abs(diurnal_amp)) * max(1.0, flash_mult)
+    trace = []
+    t = 0.0
+    while True:
+        t += float(rs.exponential(1.0 / rate_max))
+        if t >= duration_s:
+            break
+        if rs.uniform() * rate_max > rate(t):
+            continue                     # thinned
+        prompt_len = int(np.clip(
+            rs.lognormal(math.log(prompt_mean), prompt_sigma), 1,
+            prompt_max))
+        max_tokens = int(np.clip(
+            rs.lognormal(math.log(out_mean), out_sigma), 1, out_max))
+        entry = {"t": round(t, 4), "prompt_len": prompt_len,
+                 "max_tokens": max_tokens}
+        if deadline_s is not None:
+            entry["deadline_s"] = float(deadline_s)
+        trace.append(entry)
+    return trace
+
+
+def replay_http(url: str, trace, *, vocab: int = 1000, seed: int = 0,
+                tenant: str = "load", timeout_s: float = 600.0,
+                max_in_flight: int = 256) -> dict:
+    """Replay a trace against a live gateway, wall-clock-faithful: each
+    entry fires at its ``t`` offset (late dispatch is recorded, never
+    skipped).  Returns the client-side summary."""
+    from urllib.parse import urlparse
+    u = urlparse(url)
+    host, port = u.hostname, u.port
+    rs = np.random.RandomState(seed)
+    prompts = [[int(x) for x in rs.randint(1, vocab, e["prompt_len"])]
+               for e in trace]
+    out, lock = [], threading.Lock()
+    gate = threading.Semaphore(max_in_flight)
+
+    def one(entry, prompt):
+        try:
+            payload = {"prompt": prompt, "max_tokens": entry["max_tokens"]}
+            if "deadline_s" in entry:
+                payload["deadline_ms"] = int(entry["deadline_s"] * 1e3)
+            conn = http.client.HTTPConnection(host, port,
+                                              timeout=timeout_s)
+            t0 = time.perf_counter()
+            try:
+                conn.request(
+                    "POST", "/v1/completions", json.dumps(payload).encode(),
+                    {"Content-Type": "application/json",
+                     "X-Tenant": tenant})
+                r = conn.getresponse()
+                body = r.read()
+                ttft = time.perf_counter() - t0   # blocking: full wall
+                n_tok = (len(json.loads(body)["choices"][0]["token_ids"])
+                         if r.status == 200 else 0)
+                with lock:
+                    out.append({"status": r.status, "wall_s": ttft,
+                                "tokens": n_tok})
+            finally:
+                conn.close()
+        except Exception as e:  # noqa: BLE001 — count as a failed sample
+            with lock:
+                out.append({"status": -1, "wall_s": None, "tokens": 0,
+                            "error": f"{type(e).__name__}: {e}"})
+        finally:
+            gate.release()
+
+    threads = []
+    t_start = time.perf_counter()
+    for entry, prompt in zip(trace, prompts):
+        delay = entry["t"] - (time.perf_counter() - t_start)
+        if delay > 0:
+            time.sleep(delay)
+        gate.acquire()
+        th = threading.Thread(target=one, args=(entry, prompt))
+        th.start()
+        threads.append(th)
+    for th in threads:
+        th.join(timeout=timeout_s)
+    wall = time.perf_counter() - t_start
+    walls = sorted(o["wall_s"] for o in out
+                   if o["status"] == 200 and o["wall_s"] is not None)
+    completed = sum(1 for o in out if o["status"] == 200)
+    shed = sum(1 for o in out if o["status"] == 429)
+    errors = [o for o in out if o["status"] not in (200, 429)]
+    pct = (lambda q: round(float(np.percentile(walls, q)) * 1e3, 1)
+           if walls else None)
+    return {
+        "requests": len(trace), "completed": completed, "shed": shed,
+        "errors": len(errors),
+        "achieved_qps": round(completed / wall, 2) if wall else 0.0,
+        "tokens": sum(o["tokens"] for o in out),
+        "wall_ms": {"p50": pct(50), "p99": pct(99)},
+        "duration_s": round(wall, 2),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--url", required=True,
+                    help="gateway base URL (http://host:port)")
+    ap.add_argument("--duration", type=float, default=30.0)
+    ap.add_argument("--qps", type=float, default=4.0)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--diurnal-amp", type=float, default=0.4)
+    ap.add_argument("--flash-at", type=float, default=0.5)
+    ap.add_argument("--flash-mult", type=float, default=6.0)
+    ap.add_argument("--flash-duration", type=float, default=8.0)
+    ap.add_argument("--prompt-mean", type=float, default=16.0)
+    ap.add_argument("--out-mean", type=float, default=12.0)
+    ap.add_argument("--prompt-max", type=int, default=64)
+    ap.add_argument("--out-max", type=int, default=32)
+    ap.add_argument("--deadline-s", type=float, default=None)
+    ap.add_argument("--tenant", default="load")
+    ap.add_argument("--vocab", type=int, default=1000)
+    args = ap.parse_args()
+    trace = make_trace(
+        args.duration, args.qps, args.seed,
+        diurnal_amp=args.diurnal_amp, flash_at=args.flash_at,
+        flash_mult=args.flash_mult, flash_duration_s=args.flash_duration,
+        prompt_mean=args.prompt_mean, out_mean=args.out_mean,
+        prompt_max=args.prompt_max, out_max=args.out_max,
+        deadline_s=args.deadline_s)
+    print(f"# trace: {len(trace)} arrivals over {args.duration}s "
+          f"(flash x{args.flash_mult} at {args.flash_at:.0%})",
+          file=sys.stderr)
+    summary = replay_http(args.url, trace, vocab=args.vocab,
+                          seed=args.seed, tenant=args.tenant)
+    print(json.dumps(summary))
+    return 0 if summary["errors"] == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -42,6 +42,7 @@ DEFAULT_SUBSET = [
     "tests/test_multi_lora.py",
     "tests/test_journey.py",
     "tests/test_perfscope.py",
+    "tests/test_autoscale.py",
 ]
 
 # decode fast-path lane (ISSUE 10): prefix cache + speculation + int8 KV
@@ -361,6 +362,142 @@ print("perfscope lane ok:", {
     "owners": list(mem["owners"]), "decode_compiles": st["decode_compiles"]})
 """
 
+# autoscale lane (ISSUE 15): the closed loop twice over — (a) sim mode:
+# the seeded flash-crowd trace through FleetSim with the live ScalePolicy
+# (SLO attainment >= best static fleet at fewer replica-seconds, zero
+# flaps); (b) real HTTP: a flash burst against a one-replica stack makes
+# the autoscaler build and route a second replica, idle drains it back
+# out (drain-before-remove), fleet metrics and /debug/fleet export, and
+# decode stays at ONE compiled signature per engine build.
+AUTOSCALE_LANE = r"""
+import http.client, json, threading, time
+import paddle_tpu as paddle
+from paddle_tpu import observability as obs
+from paddle_tpu.models import build_gpt, gpt_config
+from paddle_tpu.observability import flight
+from paddle_tpu.serving import Autoscaler, Engine, FleetSim, ScalePolicy
+from paddle_tpu.serving.autoscaler import (FLEET_ALIVE, FLEET_DESIRED,
+                                           FLEET_DRAINING,
+                                           FLEET_SCALE_EVENTS)
+from paddle_tpu.serving.gateway import TenantConfig, start_gateway
+from tools.load_gen import make_trace
+
+assert obs.enabled(), "PADDLE_TPU_TELEMETRY=1 must bootstrap telemetry"
+
+# -- sim-mode closed loop (virtual time, no devices) --------------------
+trace = make_trace(60.0, 4.0, seed=0, flash_mult=8.0, flash_duration_s=10.0,
+                   prompt_mean=12.0, out_mean=10.0, deadline_s=3.0)
+pol = ScalePolicy(slo_ttft_s=1.0, up_ticks=2, idle_ticks=8,
+                  cooldown_up_s=2.0, cooldown_down_s=6.0)
+auto_sim = FleetSim(pol, min_replicas=1, max_replicas=5,
+                    slots_per_replica=4, prefill_s=0.05, token_s=0.01,
+                    build_s=1.5).run(trace)
+statics = [FleetSim(None, min_replicas=n, max_replicas=n, start_replicas=n,
+                    slots_per_replica=4, prefill_s=0.05,
+                    token_s=0.01).run(trace) for n in range(1, 6)]
+best = max(s["slo_attainment"] for s in statics)
+cheapest = min(s["replica_seconds"] for s in statics
+               if s["slo_attainment"] >= best)
+assert auto_sim["slo_attainment"] >= best - 1e-9, (auto_sim, best)
+assert auto_sim["replica_seconds"] < cheapest, (auto_sim, cheapest)
+assert auto_sim["flaps"] == 0, auto_sim["events"]
+
+# -- real HTTP flash burst ----------------------------------------------
+cfg = gpt_config("gpt-tiny", max_position_embeddings=128,
+                 hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+built = []
+
+
+def factory():
+    # one model instance per replica: a scale-up build traces its jit
+    # programs while the loaded replica may be compiling a new prefill
+    # bucket — concurrent tracing over one shared module is unsupported
+    paddle.seed(0)
+    model = build_gpt(cfg)
+    model.eval()
+    e = Engine(model, max_slots=2, max_len=48, max_queue=32)
+    built.append(e)
+    return e
+
+
+stack = start_gateway([factory()], own_engines=True,
+                      tenants=[TenantConfig("t", max_queue=64)],
+                      window_s=2.0)
+auto = Autoscaler(
+    stack, factory, min_replicas=1, max_replicas=2,
+    policy=ScalePolicy(slo_ttft_s=30.0, queue_wait_p99_s=0.05, up_ticks=1,
+                       idle_ticks=3, cooldown_up_s=0.3,
+                       cooldown_down_s=0.8, idle_util=0.99),
+    poll_interval_s=0.05, drain_deadline_s=10.0, build_s_hint=2.0)
+statuses = []
+lock = threading.Lock()
+
+
+def one(i):
+    conn = http.client.HTTPConnection("127.0.0.1", stack.port, timeout=300)
+    conn.request("POST", "/v1/completions",
+                 json.dumps({"prompt": [1 + i % 7, 2, 3],
+                             "max_tokens": 4}).encode(),
+                 {"Content-Type": "application/json", "X-Tenant": "t"})
+    r = conn.getresponse()
+    r.read()
+    conn.close()
+    with lock:
+        statuses.append(r.status)
+
+
+def wait(pred, timeout=120.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+try:
+    one(0)                                     # warm the first replica
+    threads = [threading.Thread(target=one, args=(i,)) for i in range(16)]
+    for th in threads:
+        th.start()
+    assert wait(lambda: len(stack.gateway.router.names) == 2), \
+        "flash burst never triggered a scale-up"
+    for th in threads:
+        th.join(timeout=300)
+    assert statuses and all(s == 200 for s in statuses), statuses
+    assert wait(lambda: len(stack.gateway.router.names) == 1), \
+        "idle never drained the flash replica back out"
+    conn = http.client.HTTPConnection("127.0.0.1", stack.port, timeout=60)
+    conn.request("GET", "/debug/fleet")
+    fleet = json.loads(conn.getresponse().read())
+    conn.close()
+    assert fleet["alive"] == 1 and fleet["autoscaler"]["desired"] == 1
+    assert fleet["autoscaler"]["builds"] == 1
+    conn = http.client.HTTPConnection("127.0.0.1", stack.port, timeout=60)
+    conn.request("GET", "/metrics")
+    text = conn.getresponse().read().decode()
+    conn.close()
+    for name in (FLEET_DESIRED, FLEET_ALIVE, FLEET_DRAINING,
+                 FLEET_SCALE_EVENTS):
+        assert name in text, name
+    ev = {e["name"] for e in flight.events("autoscaler")}
+    assert {"scale_up", "scale_down"} <= ev, ev
+    assert len(built) == 2
+    assert all(e.compile_stats()["decode_compiles"] <= 1 for e in built), \
+        [e.compile_stats() for e in built]
+finally:
+    auto.shutdown()
+    stack.close()
+    for e in built:
+        e.shutdown()
+print("autoscale lane ok:", {
+    "sim_attainment": auto_sim["slo_attainment"],
+    "sim_replica_seconds": auto_sim["replica_seconds"],
+    "sim_vs_best_static": cheapest,
+    "http_requests": len(statuses),
+    "builds": len(built)})
+"""
+
 # prefetch-on training lane: fit a tiny model THROUGH DevicePrefetcher with
 # telemetry live and assert the input-pipeline series were exported.  Runs
 # in its own interpreter so the env-var bootstrap path is what's exercised.
@@ -468,6 +605,14 @@ def main() -> int:
         if ps_rc != 0:
             print("perfscope lane FAILED", file=sys.stderr)
         rc = rc or ps_rc
+        # autoscale lane (ISSUE 15): sim-mode closed loop gates + a real
+        # HTTP flash burst scaling a fleet up and draining it back down
+        print("telemetry smoke: autoscale lane", file=sys.stderr)
+        as_rc = subprocess.call([sys.executable, "-c", AUTOSCALE_LANE],
+                                env=env, cwd=root)
+        if as_rc != 0:
+            print("autoscale lane FAILED", file=sys.stderr)
+        rc = rc or as_rc
         # tpu-lint ratchet gate (ISSUE 7): runs even when the pytest
         # subset has unrelated failures, in its own interpreter (the
         # analyzer is jax-free, so it cannot be broken by runtime drift)
