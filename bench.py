@@ -791,6 +791,7 @@ def bench_serving():
     multi_lora_block = _bench_multi_lora(model, cfg, on_tpu)
     gateway_block = _bench_gateway_curve(cfg, on_tpu, measured)
     autoscale_block = _bench_autoscale_curve(measured)
+    slo_block = _bench_slo_alerting(measured)
     tok_p50 = float(np.percentile(toks, 50))
     noise = round(100 * (float(np.percentile(toks, 90)) -
                          float(np.percentile(toks, 10))) / tok_p50, 2) \
@@ -823,6 +824,7 @@ def bench_serving():
         "multi_lora": multi_lora_block,
         "gateway": gateway_block,
         "autoscale": autoscale_block,
+        "slo": slo_block,
         "perfscope": perfscope_block,
     }
 
@@ -1354,6 +1356,114 @@ def _bench_autoscale_curve(measured):
                   for n, s in sorted(statics.items())],
         "gates": {"attainment_vs_best_static": True,
                   "fewer_replica_seconds": True, "zero_flaps": True},
+    }
+
+
+def _bench_slo_alerting(measured):
+    """Burn-rate alerting block (ISSUE 16): the multi-window SLO
+    evaluator rides the same virtual-time FleetSim as the autoscale
+    curve (measured prefill/token latencies normalized to a 0.15 s mean
+    service time).  Gates: on the flash-crowd trace the fast-burn rule
+    fires BEFORE the slow-window attainment itself crosses below the
+    target (early warning, not postmortem), the alert resolves only
+    after the autoscaler's first scale-up lands (absorption, not
+    flapping), and the steady diurnal trace fires zero alerts (no false
+    positives)."""
+    from paddle_tpu.observability.slo import SloEvaluator, SloObjective
+    from paddle_tpu.serving import FleetSim, ScalePolicy
+    from tools.load_gen import make_trace
+
+    prefill_s = measured["prefill_s"]
+    token_s = max(measured["token_s"], 1e-4)
+    slots, out_mean = 4, 10.0
+    service_meas = prefill_s + out_mean * token_s
+    k = 0.15 / service_meas
+    prefill_v, token_v = prefill_s * k, token_s * k
+    capacity_qps = slots / 0.15
+    # base load leaves the 1-replica fleet comfortable (Poisson bursts
+    # at high utilization would pre-scale the fleet and absorb the
+    # flash before it ever burns); the 5x flash then hits cold
+    base_qps = 0.375 * capacity_qps
+    slo_ttft_s = prefill_v + 1.5
+    target = 0.9
+
+    def objective():
+        # slow window 30 s: long enough that the flash's first seconds
+        # barely move it — the 3 s fast window is what catches the
+        # crowd, which is the whole point of the multi-window split
+        return SloObjective("bench-ttft", "ttft_p99", target,
+                            threshold_s=slo_ttft_s, fast_window_s=3.0,
+                            fast_burn=6.0, slow_window_s=30.0,
+                            slow_burn=2.0, fire_ticks=2, resolve_ticks=6,
+                            min_events=4)
+
+    def run(trace, start_replicas):
+        pol = ScalePolicy(slo_ttft_s=slo_ttft_s, headroom_frac=0.4,
+                          up_ticks=1, idle_ticks=8, cooldown_up_s=4.0,
+                          cooldown_down_s=3.0)
+        return FleetSim(pol, min_replicas=1, max_replicas=6,
+                        start_replicas=start_replicas,
+                        slots_per_replica=slots, prefill_s=prefill_v,
+                        token_s=token_v, build_s=2.0, policy_poll_s=0.25,
+                        window_s=5.0, slo_ttft_s=slo_ttft_s,
+                        slo_evaluator=SloEvaluator([objective()])
+                        ).run(trace)
+
+    # a long pre-flash history makes the period attainment (the curve
+    # the error budget is spent against) move slowly, which is exactly
+    # why burn-rate alerts exist: the fast window reacts in seconds
+    # while the compliance curve takes its time crossing the target
+    flash = run(make_trace(120.0, base_qps, seed=0, flash_mult=5.0,
+                           flash_at=0.75, flash_duration_s=10.0,
+                           prompt_mean=12.0, out_mean=out_mean,
+                           out_max=48), 1)
+    slo = flash["slo"]
+    firings = [t for t in slo["transitions"] if t["to"] == "firing"]
+    resolves = [t for t in slo["transitions"] if t["to"] == "resolved"]
+    if not firings:
+        raise RuntimeError(f"slo gate: flash crowd never fired "
+                           f"(transitions: {slo['transitions']})")
+    breaches = [r["t"] for r in slo["attainment_series"]
+                if r["attainment"] is not None
+                and r["attainment"] < target]
+    first_breach = breaches[0] if breaches else None
+    if first_breach is not None and firings[0]["t"] >= first_breach:
+        raise RuntimeError(
+            f"slo gate: fast-burn fired at {firings[0]['t']} but the "
+            f"period attainment crossed {target} at {first_breach} — "
+            f"the alert must lead the breach")
+    ups = [e for e in flash["events"] if e["direction"] == "up"]
+    if not ups or not resolves or resolves[0]["t"] <= ups[0]["t"]:
+        raise RuntimeError(
+            f"slo gate: no resolve after absorption (ups={ups[:1]}, "
+            f"resolves={resolves[:1]})")
+    steady = run(make_trace(60.0, 0.3 * capacity_qps, seed=1,
+                            flash_mult=1.0, prompt_mean=12.0,
+                            out_mean=out_mean, out_max=48), 2)
+    if steady["slo"]["fired"] != 0:
+        raise RuntimeError(f"slo gate: steady diurnal fired "
+                           f"{steady['slo']['fired']} false positives: "
+                           f"{steady['slo']['transitions']}")
+    lead_s = round(first_breach - firings[0]["t"], 3) \
+        if first_breach is not None else None
+    print(f"# slo fast-burn fired t={firings[0]['t']} "
+          f"(lead {lead_s}s before period-attainment breach at "
+          f"{first_breach}) resolved t={resolves[0]['t']} after up "
+          f"t={ups[0]['t']} steady_false_positives=0", file=sys.stderr)
+    return {
+        "objective": objective().snapshot(),
+        "flash": {"fired": slo["fired"], "resolved": slo["resolved"],
+                  "first_fire_t": round(firings[0]["t"], 3),
+                  "first_attainment_breach_t": first_breach,
+                  "alert_lead_s": lead_s,
+                  "first_up_t": round(ups[0]["t"], 3),
+                  "first_resolve_t": round(resolves[0]["t"], 3),
+                  "rules": sorted({t["rule"] for t in firings})},
+        "steady": {"fired": 0,
+                   "attainment": steady["slo_attainment"]},
+        "gates": {"fires_before_attainment_breach": True,
+                  "resolves_after_absorption": True,
+                  "zero_false_positives": True},
     }
 
 

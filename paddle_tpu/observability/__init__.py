@@ -95,6 +95,9 @@ from . import journey  # noqa: E402,F401
 # device perfscope: per-program device-time/MFU attribution + the HBM
 # ownership ledger (already pulled in by retrace; re-exported here)
 from . import perfscope  # noqa: E402,F401
+# SLO engine: objectives + burn-rate alerts + incident bundles,
+# layered over the keyed journey window and the watchdog seam
+from . import slo  # noqa: E402,F401
 
 _bootstrap_from_env()
 watchdog._bootstrap_from_env()

@@ -431,28 +431,74 @@ class TelemetryWindow:
     shares, redispatch + rebuild counts, all over the trailing
     ``window_s`` seconds.
 
-    Feed it with :meth:`observe_journey` (one call per finished journey)
-    and :meth:`observe_shed` (one call per shed/rejected admission);
-    :meth:`snapshot` prunes and aggregates.  Bounded: at most
-    ``max_samples`` samples are retained, oldest dropped first.
+    Samples are **keyed** by ``(tenant, priority class)`` (ISSUE 16):
+    each key owns its own bounded deque, so a noisy tenant flooding the
+    window can only evict its OWN oldest samples, never another
+    tenant's — per-class SLO attainment stays computable under skewed
+    load.  :meth:`snapshot` aggregates globally (the PR 13 shape) or
+    per key with ``by="tenant"`` / ``by="class"``; :meth:`events` hands
+    the raw in-horizon samples+sheds to the SLO burn-rate evaluator.
+
+    Feed it with :meth:`observe_journey` (one call per finished
+    journey), :meth:`observe_shed` (one call per shed/rejected
+    admission), or the low-level :meth:`observe_sample` (the FleetSim
+    virtual-time bridge).  Bounded: at most ``max_samples_per_key``
+    samples per key, at most ``max_keys`` keys (least-recently-fed key
+    evicted first), oldest-in-key dropped first.
     """
 
     # phases whose attributed time counts as "waiting in a queue" for
     # the queue_wait percentile (gateway fair-share + engine admission)
     QUEUE_PHASES = ("queue", "engine_queue", "adapter_stall", "page_stall")
 
-    def __init__(self, window_s: float = 60.0, max_samples: int = 4096):
+    def __init__(self, window_s: float = 60.0, max_samples: int = 4096,
+                 *, max_samples_per_key: int | None = None,
+                 max_keys: int = 64):
         if window_s <= 0:
             raise ValueError("window_s must be > 0")
         self.window_s = float(window_s)
+        self.max_samples = max(16, int(max_samples))
+        # per-key bound: a key never holds more than this, so the
+        # worst-case retention is max_keys * max_samples_per_key
+        self.max_samples_per_key = (
+            max(16, self.max_samples // 8) if max_samples_per_key is None
+            else max(16, int(max_samples_per_key)))
+        self.max_keys = max(1, int(max_keys))
         self._lock = threading.Lock()
-        self._samples: deque = deque(maxlen=max(16, int(max_samples)))
-        self._sheds: deque = deque(maxlen=max(16, int(max_samples)))
+        # (tenant, priority) -> deque; separate stores for samples and
+        # sheds, one LRU clock across both for key eviction
+        self._samples: dict[tuple, deque] = {}
+        self._sheds: dict[tuple, deque] = {}
+        self._touched: dict[tuple, float] = {}
+
+    @staticmethod
+    def _key(tenant, priority) -> tuple:
+        return (str(tenant or ""), str(priority or ""))
+
+    def _deque_for_locked(self, store: dict, key: tuple,
+                          now: float) -> deque:
+        dq = store.get(key)
+        if dq is None:
+            dq = store[key] = deque(maxlen=self.max_samples_per_key)
+        self._touched[key] = now
+        known = set(self._samples) | set(self._sheds)
+        while len(known) > self.max_keys:
+            victim = min(known - {key},
+                         key=lambda k: self._touched.get(k, 0.0))
+            self._samples.pop(victim, None)
+            self._sheds.pop(victim, None)
+            self._touched.pop(victim, None)
+            known.discard(victim)
+        return dq
 
     # -- feeding -------------------------------------------------------------
-    def observe_journey(self, j: Journey, now: float | None = None):
+    def observe_journey(self, j: Journey, now: float | None = None, *,
+                        tenant: str | None = None,
+                        priority: str | None = None):
         """Fold one FINISHED journey in (unfinished ones are skipped:
-        their partition does not exist yet)."""
+        their partition does not exist yet).  Tenant and priority class
+        default to the journey's own attrs (the gateway annotates both
+        at admission)."""
         if j is None or not j.done:
             return
         totals = j.phase_totals()
@@ -469,46 +515,119 @@ class TelemetryWindow:
                 redispatches += 1
             elif name == "rebuild":
                 rebuilds += 1
-        sample = {
-            "t": time.perf_counter() if now is None else float(now),
-            "wall_s": j.wall_s or 0.0,
-            "ttft_s": j.ttft_s,
-            "queue_wait_s": queue_wait,
+        self.observe_sample(
+            now=now,
+            wall_s=j.wall_s or 0.0,
+            ttft_s=j.ttft_s,
+            queue_wait_s=queue_wait,
             # decode emits the first-of-run token too, but the FIRST
             # token of the request came from prefill — per-token decode
             # latency divides decode time by the decode-emitted count
-            "token_s": (decode_s / tokens) if tokens > 0 else None,
-            "phase_totals": totals,
-            "outcome": j.outcome or "ok",
-            "redispatches": redispatches,
-            "rebuilds": rebuilds,
+            token_s=(decode_s / tokens) if tokens > 0 else None,
+            phase_totals=totals,
+            outcome=j.outcome or "ok",
+            redispatches=redispatches,
+            rebuilds=rebuilds,
+            tenant=tenant if tenant is not None else j.attrs.get("tenant"),
+            priority=(priority if priority is not None
+                      else j.attrs.get("priority")))
+
+    def observe_sample(self, *, now: float | None = None,
+                       wall_s: float = 0.0, ttft_s: float | None = None,
+                       queue_wait_s: float | None = None,
+                       token_s: float | None = None,
+                       phase_totals: dict | None = None,
+                       outcome: str = "ok", redispatches: int = 0,
+                       rebuilds: int = 0, tenant: str | None = None,
+                       priority: str | None = None):
+        """Low-level feed: one finished-request sample without a Journey
+        object — the bridge FleetSim uses to drive the window (and the
+        SLO evaluator on top of it) in virtual time."""
+        t = time.perf_counter() if now is None else float(now)
+        tenant, priority = self._key(tenant, priority)
+        sample = {
+            "t": t, "wall_s": float(wall_s),
+            "ttft_s": None if ttft_s is None else float(ttft_s),
+            "queue_wait_s": (None if queue_wait_s is None
+                             else float(queue_wait_s)),
+            "token_s": None if token_s is None else float(token_s),
+            "phase_totals": dict(phase_totals or {}),
+            "outcome": str(outcome),
+            "redispatches": int(redispatches),
+            "rebuilds": int(rebuilds),
+            "tenant": tenant, "priority": priority,
         }
         with self._lock:
-            self._samples.append(sample)
+            self._deque_for_locked(
+                self._samples, (tenant, priority), t).append(sample)
 
-    def observe_shed(self, reason: str = "", now: float | None = None):
+    def observe_shed(self, reason: str = "", now: float | None = None, *,
+                     tenant: str | None = None,
+                     priority: str | None = None):
+        """One shed/rejected admission, attributed to its tenant and
+        priority class (ISSUE 16: the shed deque used to hold only
+        ``(t, reason)``, making per-tenant shed rate uncomputable)."""
+        t = time.perf_counter() if now is None else float(now)
+        tenant, priority = self._key(tenant, priority)
+        shed = {"t": t, "reason": str(reason),
+                "tenant": tenant, "priority": priority}
         with self._lock:
-            self._sheds.append(
-                (time.perf_counter() if now is None else float(now),
-                 str(reason)))
+            self._deque_for_locked(
+                self._sheds, (tenant, priority), t).append(shed)
 
     # -- reading -------------------------------------------------------------
     def _prune_locked(self, now: float):
         horizon = now - self.window_s
-        while self._samples and self._samples[0]["t"] < horizon:
-            self._samples.popleft()
-        while self._sheds and self._sheds[0][0] < horizon:
-            self._sheds.popleft()
+        for store in (self._samples, self._sheds):
+            for key in list(store):
+                dq = store[key]
+                while dq and dq[0]["t"] < horizon:
+                    dq.popleft()
+                if not dq:
+                    del store[key]
+        for key in list(self._touched):
+            if key not in self._samples and key not in self._sheds:
+                del self._touched[key]
 
-    def snapshot(self, now: float | None = None) -> dict:
-        """The window aggregate, computed fresh (sorting a few thousand
-        floats at poll rate, not request rate)."""
+    def _collect_locked(self, now: float, horizon_s: float | None,
+                        tenant: str | None, priority: str | None):
+        lo = now - min(self.window_s, horizon_s if horizon_s is not None
+                       else self.window_s)
+        out = []
+        for store in (self._samples, self._sheds):
+            rows = []
+            for key, dq in store.items():
+                if tenant is not None and key[0] != str(tenant):
+                    continue
+                if priority is not None and key[1] != str(priority):
+                    continue
+                rows.extend(r for r in dq if lo <= r["t"] <= now)
+            out.append(rows)
+        return out[0], out[1]
+
+    def events(self, now: float | None = None, *,
+               horizon_s: float | None = None, tenant: str | None = None,
+               priority: str | None = None) -> tuple:
+        """``(samples, sheds)`` inside the trailing ``horizon_s``
+        (clamped to ``window_s``), optionally filtered to one tenant
+        and/or priority class — the raw feed the SLO burn-rate
+        evaluator counts good/bad events over."""
         now = time.perf_counter() if now is None else float(now)
         with self._lock:
             self._prune_locked(now)
-            samples = list(self._samples)
-            sheds = list(self._sheds)
+            samples, sheds = self._collect_locked(
+                now, horizon_s, tenant, priority)
+        return ([dict(s) for s in samples], [dict(s) for s in sheds])
 
+    def keys(self, now: float | None = None) -> list:
+        """The ``(tenant, priority)`` keys with in-window data."""
+        now = time.perf_counter() if now is None else float(now)
+        with self._lock:
+            self._prune_locked(now)
+            return sorted(set(self._samples) | set(self._sheds))
+
+    @staticmethod
+    def _aggregate(samples: list, sheds: list) -> dict:
         def _pcts(key):
             vals = sorted(s[key] for s in samples if s[key] is not None)
             return {"p50": round(_percentile(vals, 0.50), 6),
@@ -527,11 +646,11 @@ class TelemetryWindow:
         n_shed = len(sheds)
         denominator = n_requests + n_shed
         return {
-            "window_s": self.window_s,
             "requests": n_requests,
             "shed": n_shed,
             "shed_rate": round(n_shed / denominator, 4) if denominator
             else 0.0,
+            "shed_reasons": _count_by(sheds, "reason"),
             "ttft_s": _pcts("ttft_s"),
             "queue_wait_s": _pcts("queue_wait_s"),
             "token_s": _pcts("token_s"),
@@ -539,6 +658,36 @@ class TelemetryWindow:
             "redispatches": sum(s["redispatches"] for s in samples),
             "rebuilds": sum(s["rebuilds"] for s in samples),
             "outcomes": _count_by(samples, "outcome"),
+        }
+
+    def snapshot(self, now: float | None = None,
+                 by: str | None = None) -> dict:
+        """The window aggregate, computed fresh (sorting a few thousand
+        floats at poll rate, not request rate).  ``by=None`` is the
+        global aggregate; ``by="tenant"`` / ``by="class"`` group by the
+        sample key's tenant / priority-class component — the per-key
+        feed SLO objectives with a ``per=`` selector evaluate over."""
+        if by not in (None, "tenant", "class"):
+            raise ValueError('by must be None, "tenant" or "class"')
+        now = time.perf_counter() if now is None else float(now)
+        with self._lock:
+            self._prune_locked(now)
+            samples, sheds = self._collect_locked(now, None, None, None)
+        if by is None:
+            out = {"window_s": self.window_s}
+            out.update(self._aggregate(samples, sheds))
+            return out
+        field = "tenant" if by == "tenant" else "priority"
+        groups: dict[str, tuple] = {}
+        for s in samples:
+            groups.setdefault(s[field], ([], []))[0].append(s)
+        for s in sheds:
+            groups.setdefault(s[field], ([], []))[1].append(s)
+        return {
+            "window_s": self.window_s,
+            "by": by,
+            "keys": {name: self._aggregate(ss, sh)
+                     for name, (ss, sh) in sorted(groups.items())},
         }
 
 

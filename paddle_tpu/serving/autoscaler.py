@@ -71,6 +71,11 @@ class ScalePolicy:
     * ``queue_wait_p99`` — windowed fair-share queue wait p99 breach.
     * ``shed_rate`` — sustained shedding (the fleet is rejecting work
       it should be absorbing).
+    * ``slo_alert`` — (opt-in, ``scale_on_alerts=True``) the SLO
+      engine's firing set is non-empty: the feed's optional
+      ``firing_alerts`` field carries the burn-rate alerts a
+      :class:`~paddle_tpu.observability.slo.SloEvaluator` is firing —
+      the ROADMAP item-5b seam for SLO-class-aware scaling.
 
     Scale-down trigger (sustained for ``idle_ticks`` polls): queue
     empty, slot utilization at most ``idle_util``, no shedding, and the
@@ -91,7 +96,8 @@ class ScalePolicy:
                  idle_util: float = 0.25, idle_est_frac: float = 0.3,
                  cooldown_up_s: float = 10.0,
                  cooldown_down_s: float = 30.0,
-                 min_window_requests: int = 1):
+                 min_window_requests: int = 1,
+                 scale_on_alerts: bool = False):
         if not 0 < headroom_frac < 1 or not 0 < idle_est_frac < 1:
             raise ValueError("headroom_frac/idle_est_frac must be in (0,1)")
         self.slo_ttft_s = float(slo_ttft_s)
@@ -105,6 +111,7 @@ class ScalePolicy:
         self.cooldown_up_s = float(cooldown_up_s)
         self.cooldown_down_s = float(cooldown_down_s)
         self.min_window_requests = int(min_window_requests)
+        self.scale_on_alerts = bool(scale_on_alerts)
         self._up_streak = 0
         self._idle_streak = 0
         self._last_up = float("-inf")
@@ -113,6 +120,10 @@ class ScalePolicy:
     # -- the decision ---------------------------------------------------------
     def breach_reason(self, feed: dict) -> str:
         """Which scale-up trigger (if any) the feed is breaching."""
+        # a firing burn-rate alert already encodes target + hysteresis;
+        # honouring it first lets per-class SLOs drive scale directly
+        if self.scale_on_alerts and feed.get("firing_alerts"):
+            return "slo_alert"
         est = feed.get("est_ttft_s")
         thresh = (1.0 - self.headroom_frac) * self.slo_ttft_s
         # a breach the fleet can actually fix: replicas drain backlog,
@@ -195,6 +206,7 @@ class ScalePolicy:
             "idle_util": self.idle_util,
             "cooldown_up_s": self.cooldown_up_s,
             "cooldown_down_s": self.cooldown_down_s,
+            "scale_on_alerts": self.scale_on_alerts,
             "up_streak": self._up_streak, "idle_streak": self._idle_streak,
         }
 
@@ -320,6 +332,11 @@ class Autoscaler:
                                    for ld in loads.values())
         feed["total_slots"] = gw.router.total_slots()
         feed["prefill_s"] = gw.shedder.snapshot()["prefill_s"]
+        # the SLO engine's firing set rides the policy feed (optional:
+        # [] when no engine is attached) — ScalePolicy(scale_on_alerts=
+        # True) scales on it, every policy sees it for introspection
+        slo = getattr(gw, "slo_engine", None)
+        feed["firing_alerts"] = slo.firing() if slo is not None else []
         with self._lock:
             op = self._op
             pending, self._pending = self._pending, None
@@ -593,8 +610,19 @@ class FleetSim:
 
     ``run(trace)`` consumes ``tools/load_gen.py`` trace entries
     (dicts with ``t``, ``prompt_len``, ``max_tokens``, optional
-    ``deadline_s``) and reports SLO attainment, replica-seconds, scale
-    events and flap count — the bench's attainment-vs-cost curve.
+    ``deadline_s``, optional ``tenant``/``priority``) and reports SLO
+    attainment, replica-seconds, scale events and flap count — the
+    bench's attainment-vs-cost curve.
+
+    With ``slo_evaluator`` (a :class:`~paddle_tpu.observability.slo.
+    SloEvaluator`), the sim also feeds a keyed
+    :class:`~paddle_tpu.observability.journey.TelemetryWindow` in
+    virtual time — completions at their virtual finish, sheds at shed
+    time — and steps the evaluator at every policy poll: the result
+    grows an ``"slo"`` block (transitions + per-poll series), and the
+    policy feed carries ``firing_alerts`` exactly like the live loop,
+    so burn-rate alerting and alert-driven scaling are benchable
+    deterministically.
     """
 
     def __init__(self, policy: Optional[ScalePolicy] = None, *,
@@ -604,7 +632,7 @@ class FleetSim:
                  prefill_s: float = 0.05, token_s: float = 0.01,
                  build_s: float = 2.0, slo_ttft_s: Optional[float] = None,
                  tick_s: float = 0.02, policy_poll_s: float = 0.25,
-                 window_s: float = 5.0):
+                 window_s: float = 5.0, slo_evaluator=None):
         self.policy = policy
         self.min_replicas = int(min_replicas)
         self.max_replicas = int(max_replicas)
@@ -620,6 +648,7 @@ class FleetSim:
         self.tick_s = float(tick_s)
         self.policy_poll_s = float(policy_poll_s)
         self.window_s = float(window_s)
+        self.slo_evaluator = slo_evaluator
 
     def _est_ttft(self, queue, fleet, now: float) -> float:
         # the shed formula over SERVICE time: a new arrival waits for
@@ -635,6 +664,7 @@ class FleetSim:
         return self.prefill_s + backlog_s / slots
 
     def run(self, trace) -> dict:
+        import heapq
         trace = sorted(trace, key=lambda e: e["t"])
         n_arrivals = len(trace)
         fleet = [_SimReplica(f"sim{i}", "up", 0.0)
@@ -644,6 +674,17 @@ class FleetSim:
         done: list = []                  # {t, ttft, wait} completion log
         sheds: list = []                 # shed timestamps
         events: list = []                # scale events {t, direction, reason}
+        ev = self.slo_evaluator
+        tw = None
+        pending_obs: list = []           # heap: completions by finish time
+        obs_seq = 0                      # heap tiebreak (dicts don't order)
+        slo_transitions: list = []
+        slo_series: list = []
+        slo_att_series: list = []
+        if ev is not None:
+            from ..observability.journey import TelemetryWindow
+            tw = TelemetryWindow(window_s=max(
+                o.slow_window_s for o in ev.objectives))
         t = 0.0
         i = 0                            # trace cursor
         next_poll = self.policy_poll_s
@@ -660,9 +701,15 @@ class FleetSim:
                 if deadline is not None and \
                         self._est_ttft(queue, fleet, t) > deadline:
                     sheds.append(t)
+                    if tw is not None:
+                        tw.observe_shed("slo_shed", now=t,
+                                        tenant=e.get("tenant"),
+                                        priority=e.get("priority"))
                     continue
                 queue.append({"t_arr": e["t"], "service": service,
-                              "tokens": int(e["max_tokens"])})
+                              "tokens": int(e["max_tokens"]),
+                              "tenant": e.get("tenant"),
+                              "priority": e.get("priority")})
             # builds mature
             for rep in fleet:
                 if rep.state == "building" and rep.ready_at <= t:
@@ -690,35 +737,74 @@ class FleetSim:
                 rep.active.append((finish, ttft <= self.slo_ttft_s,
                                    req["service"]))
                 done.append({"t": finish, "ttft": ttft, "wait": wait})
-            # policy poll
-            if self.policy is not None and t >= next_poll:
+                if tw is not None:
+                    # the window sees the completion at its virtual
+                    # FINISH time, not at dispatch — burn rates must
+                    # lag reality exactly like the live loop's do
+                    obs_seq += 1
+                    heapq.heappush(pending_obs, (finish, obs_seq, {
+                        "ttft_s": ttft, "queue_wait_s": wait,
+                        "wall_s": wait + req["service"],
+                        "tenant": req["tenant"],
+                        "priority": req["priority"]}))
+            # policy poll (+ SLO evaluator tick at the same cadence)
+            if (self.policy is not None or ev is not None) \
+                    and t >= next_poll:
                 next_poll += self.policy_poll_s
-                decision, reason = self.policy.decide(
-                    self._feed(t, queue, fleet, done, sheds),
-                    replicas=sum(1 for r in fleet if r.state == "up"),
-                    min_replicas=self.min_replicas,
-                    max_replicas=self.max_replicas, now=t)
-                if decision == "up" and len(fleet) < self.max_replicas:
-                    self.policy.note_event("up", t)
-                    fleet.append(_SimReplica(
-                        f"sim{next_name}", "building", t,
-                        ready_at=t + self.build_s))
-                    next_name += 1
-                    events.append({"t": round(t, 3), "direction": "up",
-                                   "reason": reason})
-                elif decision == "down":
-                    ups = [r for r in fleet if r.state == "up"]
-                    if len(ups) > self.min_replicas:
-                        self.policy.note_event("down", t)
-                        victim = min(ups, key=lambda r: len(r.active))
-                        victim.state = "draining"
+                firing = []
+                if ev is not None:
+                    while pending_obs and pending_obs[0][0] <= t:
+                        finish, _, obs = heapq.heappop(pending_obs)
+                        tw.observe_sample(now=finish, **obs)
+                    slo_transitions.extend(ev.tick(tw, now=t))
+                    firing = ev.firing()
+                    slo_series.extend(
+                        dict(row, t=round(t, 3)) for row in ev.state())
+                    # attainment over the whole SLO period so far (the
+                    # trace IS the compliance window) — the burn-rate
+                    # alert's job is to lead THIS curve's breach
+                    n_done = n_hit = 0
+                    for d in done:
+                        if d["t"] <= t:
+                            n_done += 1
+                            n_hit += d["ttft"] <= self.slo_ttft_s
+                    slo_att_series.append({
+                        "t": round(t, 3),
+                        "attainment": round(n_hit / n_done, 4)
+                        if n_done else None})
+                if self.policy is not None:
+                    feed = self._feed(t, queue, fleet, done, sheds)
+                    feed["firing_alerts"] = firing
+                    decision, reason = self.policy.decide(
+                        feed,
+                        replicas=sum(1 for r in fleet if r.state == "up"),
+                        min_replicas=self.min_replicas,
+                        max_replicas=self.max_replicas, now=t)
+                    if decision == "up" and \
+                            len(fleet) < self.max_replicas:
+                        self.policy.note_event("up", t)
+                        fleet.append(_SimReplica(
+                            f"sim{next_name}", "building", t,
+                            ready_at=t + self.build_s))
+                        next_name += 1
                         events.append({"t": round(t, 3),
-                                       "direction": "down",
+                                       "direction": "up",
                                        "reason": reason})
+                    elif decision == "down":
+                        ups = [r for r in fleet if r.state == "up"]
+                        if len(ups) > self.min_replicas:
+                            self.policy.note_event("down", t)
+                            victim = min(ups,
+                                         key=lambda r: len(r.active))
+                            victim.state = "draining"
+                            events.append({"t": round(t, 3),
+                                           "direction": "down",
+                                           "reason": reason})
             replica_seconds += len(fleet) * self.tick_s
             peak = max(peak, len(fleet))
             if i >= len(trace) and not queue and \
-                    all(not rep.active for rep in fleet):
+                    all(not rep.active for rep in fleet) and \
+                    not pending_obs and (ev is None or not ev.firing()):
                 break
             t += self.tick_s
         # completions recorded at dispatch may nominally finish past the
@@ -726,7 +812,19 @@ class FleetSim:
         hits = sum(1 for d in done if d["ttft"] <= self.slo_ttft_s)
         ttfts = sorted(d["ttft"] for d in done)
         flaps = self._count_flaps(events)
+        slo_block = None
+        if ev is not None:
+            slo_block = {
+                "transitions": slo_transitions,
+                "fired": sum(1 for tr in slo_transitions
+                             if tr["to"] == "firing"),
+                "resolved": sum(1 for tr in slo_transitions
+                                if tr["to"] == "resolved"),
+                "series": slo_series,
+                "attainment_series": slo_att_series,
+            }
         return {
+            "slo": slo_block,
             "arrivals": n_arrivals,
             "completed": len(done),
             "shed": len(sheds),

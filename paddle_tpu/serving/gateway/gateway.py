@@ -188,6 +188,7 @@ class Gateway:
         self._thread: threading.Thread | None = None
         self._fleet_lock = threading.Lock()
         self._autoscaler = None
+        self._slo_engine = None
         if start:
             self.start()
 
@@ -205,6 +206,18 @@ class Gateway:
     def autoscaler(self):
         with self._fleet_lock:
             return self._autoscaler
+
+    def attach_slo_engine(self, engine):
+        """Register the SLO evaluator (one per gateway): its firing set
+        feeds the autoscaler policy input (``firing_alerts``) and
+        ``/debug/slo`` serves its state."""
+        with self._fleet_lock:
+            self._slo_engine = engine
+
+    @property
+    def slo_engine(self):
+        with self._fleet_lock:
+            return self._slo_engine
 
     def _fleet_pending(self) -> bool:
         """Capacity is leaving-but-finishing or on the way: some replica
@@ -323,9 +336,18 @@ class Gateway:
                 f"gateway dispatcher died: "
                 f"{type(self._dispatcher_error).__name__}: "
                 f"{self._dispatcher_error}")
+        # tenant + priority class resolve BEFORE any shed exit so every
+        # shed is attributed to its key in the telemetry window (per-
+        # class SLO attainment is uncomputable otherwise) and the
+        # journey carries both even when the request never enqueues
+        cfg = self.scheduler.tenant_config(tenant)
+        priority = creq.priority or cfg.priority
+        if journey is not None:
+            journey.annotate(tenant=tenant, priority=priority)
         if self._drain_ev.is_set():
             self._count(tenant, "shed")
-            self.window.observe_shed("draining")
+            self.window.observe_shed("draining", tenant=tenant,
+                                     priority=priority)
             registry().counter(GATEWAY_SHED, "requests shed by reason").inc(
                 1.0, labels={"tenant": tenant, "reason": "draining"})
             raise AdmissionError(
@@ -343,14 +365,11 @@ class Gateway:
                 400, f"prompt ({prompt.size}) + max_tokens "
                 f"({creq.max_tokens}) exceeds the serving window "
                 f"({max_len})", param="max_tokens", code="context_window")
-        cfg = self.scheduler.tenant_config(tenant)
-        priority = creq.priority or cfg.priority
         item = GatewayRequest(creq, tenant, priority, prompt,
                               adapter=self._resolve_adapter(creq),
                               journey=journey)
         if journey is not None:
-            journey.annotate(tenant=tenant, priority=priority,
-                             completion_id=item.id,
+            journey.annotate(completion_id=item.id,
                              prompt_tokens=int(prompt.size),
                              max_tokens=creq.max_tokens)
 
@@ -371,7 +390,8 @@ class Gateway:
             if eta is not None and eta < decision.retry_after_s:
                 decision.retry_after_s = max(0.1, round(eta, 2))
             self._count(tenant, "shed")
-            self.window.observe_shed("slo_shed")
+            self.window.observe_shed("slo_shed", tenant=tenant,
+                                     priority=priority)
             reg.counter(GATEWAY_SHED, "requests shed by reason").inc(
                 1.0, labels={"tenant": tenant, "reason": "slo_shed"})
             flight.record("gateway", "shed", request=item.id, tenant=tenant,
@@ -387,7 +407,8 @@ class Gateway:
             self.scheduler.enqueue(item)
         except AdmissionError as e:
             self._count(tenant, "rejected")
-            self.window.observe_shed(e.reason)
+            self.window.observe_shed(e.reason, tenant=tenant,
+                                     priority=priority)
             reg.counter(GATEWAY_SHED, "requests shed by reason").inc(
                 1.0, labels={"tenant": tenant, "reason": e.reason})
             flight.record("gateway", "shed", request=item.id, tenant=tenant,

@@ -211,6 +211,34 @@ class _Handler(BaseHTTPRequestHandler):
             elif path == "/debug/memory":
                 from ...observability import perfscope
                 self._send_json(200, perfscope.memory_report())
+            elif path == "/debug/slo":
+                slo = self.gateway.slo_engine
+                if slo is None:
+                    self._send_json(404, error_body(
+                        "no SLO engine attached to this gateway",
+                        code="no_slo_engine"))
+                else:
+                    self._send_json(200, slo.debug_state())
+            elif path == "/debug/incidents" or \
+                    path.startswith("/debug/incidents/"):
+                slo = self.gateway.slo_engine
+                if slo is None:
+                    self._send_json(404, error_body(
+                        "no SLO engine attached to this gateway",
+                        code="no_slo_engine"))
+                elif path == "/debug/incidents":
+                    self._send_json(200, {
+                        "incidents": slo.store.list()})
+                else:
+                    inc_id = path[len("/debug/incidents/"):]
+                    bundle = slo.store.get(inc_id)
+                    if bundle is None:
+                        self._send_json(404, error_body(
+                            f"no incident {inc_id!r} (ring holds "
+                            f"{len(slo.store.list())})",
+                            code="incident_not_found"))
+                    else:
+                        self._send_json(200, bundle)
             elif path == "/debug/requests":
                 last = 32
                 for part in query.split("&"):
@@ -429,6 +457,7 @@ class GatewayStack:
         self.server = server
         self.thread = thread
         self.own_engines = own_engines
+        self.slo_engine = None          # set by start_gateway(slo_*)
         self._lock = threading.Lock()
         self._sigterm_ev = threading.Event()
         self._terminated_ev = threading.Event()
@@ -507,6 +536,10 @@ class GatewayStack:
 
     def close(self):
         """Stop accepting, fail queued work, (optionally) stop engines."""
+        # the SLO evaluator thread polls gateway window state: stop it
+        # FIRST so no tick races the teardown below
+        if self.slo_engine is not None:
+            self.slo_engine.shutdown()
         self.server.shutdown()
         self.server.server_close()
         self.gateway.shutdown()
@@ -533,10 +566,20 @@ class GatewayStack:
 
 def start_gateway(engines, host: str = "127.0.0.1", port: int = 0, *,
                   own_engines: bool = False, request_timeout_s: float = 600.0,
+                  slo_objectives=None, slo_tick_s: float = 1.0,
+                  slo_incident_dir: str | None = None,
+                  slo_max_incidents: int = 32,
                   **gateway_kwargs) -> GatewayStack:
     """Boot the full front door: Gateway core + threaded HTTP server on
     ``host:port`` (port 0 = ephemeral; read ``stack.port``).  Extra
-    keyword args go to :class:`Gateway`."""
+    keyword args go to :class:`Gateway`.
+
+    ``slo_objectives`` (a list of :class:`~paddle_tpu.observability.slo.
+    SloObjective`) attaches an :class:`~paddle_tpu.observability.slo.
+    SloEngine` evaluating them every ``slo_tick_s`` — burn-rate alerts
+    on ``/debug/slo``, incident bundles (ring-bounded at
+    ``slo_max_incidents`` under ``slo_incident_dir``) on
+    ``/debug/incidents``."""
     gateway = (engines if isinstance(engines, Gateway)
                else Gateway(engines, **gateway_kwargs))
     server = GatewayHTTPServer((host, port), gateway,
@@ -544,4 +587,11 @@ def start_gateway(engines, host: str = "127.0.0.1", port: int = 0, *,
     thread = threading.Thread(target=server.serve_forever,
                               name="paddle-tpu-gateway-http", daemon=True)
     thread.start()
-    return GatewayStack(gateway, server, thread, own_engines=own_engines)
+    stack = GatewayStack(gateway, server, thread, own_engines=own_engines)
+    if slo_objectives:
+        from ...observability.slo import SloEngine
+        stack.slo_engine = SloEngine(
+            gateway, slo_objectives, tick_s=slo_tick_s,
+            incident_dir=slo_incident_dir,
+            max_incidents=slo_max_incidents)
+    return stack

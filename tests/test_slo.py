@@ -1,0 +1,590 @@
+"""SLO engine tests (paddle_tpu/observability/slo.py + keyed window).
+
+The contract under test is docs/observability.md's "SLOs & alerting"
+section: the keyed TelemetryWindow (per-(tenant, class) sample bounds —
+one noisy tenant can't evict another's samples — shed attribution,
+``snapshot(by=)`` grouping), the multi-window burn-rate evaluator
+(Google-SRE fast+slow rules, pending → firing → resolved hysteresis,
+driven in virtual time), incident bundles (schema round-trip, ring
+bound, all three telemetry planes), the HTTP debug surface
+(``/debug/slo``, ``/debug/incidents``), metrics export, the
+``firing_alerts`` autoscaler seam, and — the acceptance shape — a real
+HTTP gateway under a breaching workload fires a fast-burn alert whose
+bundle correlates the planes, while decode stays ONE compiled program.
+"""
+import http.client
+import json
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import build_gpt, gpt_config
+from paddle_tpu.observability import slo as slo_mod
+from paddle_tpu.observability.journey import TelemetryWindow
+from paddle_tpu.observability.slo import (
+    INCIDENT_SCHEMA,
+    IncidentStore,
+    SloEvaluator,
+    SloObjective,
+    build_incident,
+)
+from paddle_tpu.serving import Engine, FleetSim, ScalePolicy
+from paddle_tpu.serving.gateway import (
+    AdmissionError,
+    Gateway,
+    TenantConfig,
+    parse_completion_request,
+    start_gateway,
+)
+from tools.load_gen import make_trace
+
+
+@pytest.fixture(scope="module")
+def tiny_gpt():
+    cfg = gpt_config("gpt-tiny", max_position_embeddings=128,
+                     hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+    paddle.seed(7)
+    model = build_gpt(cfg)
+    model.eval()
+    return model, cfg
+
+
+def _get(port, path, timeout=60):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        r = conn.getresponse()
+        return r.status, r.read()
+    finally:
+        conn.close()
+
+
+def _post(port, payload, headers=None, timeout=300):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        hdrs = {"Content-Type": "application/json"}
+        hdrs.update(headers or {})
+        conn.request("POST", "/v1/completions",
+                     json.dumps(payload).encode(), hdrs)
+        r = conn.getresponse()
+        return r.status, dict(r.getheaders()), r.read()
+    finally:
+        conn.close()
+
+
+# -- keyed TelemetryWindow ----------------------------------------------------
+
+def test_keyed_window_per_key_bounds():
+    """A flooding tenant evicts only its OWN oldest samples."""
+    tw = TelemetryWindow(window_s=1000.0, max_samples_per_key=16)
+    tw.observe_sample(now=1.0, ttft_s=0.5, tenant="quiet")
+    for i in range(200):
+        tw.observe_sample(now=2.0 + i * 0.01, ttft_s=0.1, tenant="noisy")
+    snap = tw.snapshot(now=5.0, by="tenant")
+    assert snap["keys"]["noisy"]["requests"] == 16     # bounded
+    assert snap["keys"]["quiet"]["requests"] == 1      # survived the flood
+    # global aggregate sums the per-key retained samples
+    assert tw.snapshot(now=5.0)["requests"] == 17
+
+
+def test_keyed_window_shed_attribution_and_grouping():
+    tw = TelemetryWindow(window_s=100.0)
+    tw.observe_sample(now=1.0, ttft_s=0.2, tenant="a", priority="batch")
+    tw.observe_sample(now=1.1, ttft_s=0.3, tenant="b",
+                      priority="interactive")
+    tw.observe_shed("slo_shed", now=1.2, tenant="a", priority="batch")
+    tw.observe_shed("tenant_queue_full", now=1.3, tenant="a",
+                    priority="batch")
+    by_t = tw.snapshot(now=2.0, by="tenant")
+    assert by_t["by"] == "tenant"
+    assert by_t["keys"]["a"]["shed"] == 2
+    assert by_t["keys"]["a"]["shed_rate"] == pytest.approx(2 / 3,
+                                                           abs=1e-3)
+    assert by_t["keys"]["a"]["shed_reasons"] == {
+        "slo_shed": 1, "tenant_queue_full": 1}
+    assert by_t["keys"]["b"]["shed"] == 0
+    by_c = tw.snapshot(now=2.0, by="class")
+    assert set(by_c["keys"]) == {"batch", "interactive"}
+    assert by_c["keys"]["batch"]["shed"] == 2
+    # the global shape keeps the PR 13 contract fields
+    g = tw.snapshot(now=2.0)
+    for field in ("requests", "shed", "shed_rate", "ttft_s",
+                  "queue_wait_s", "token_s", "phase_share", "outcomes"):
+        assert field in g
+    with pytest.raises(ValueError):
+        tw.snapshot(by="nope")
+
+
+def test_keyed_window_key_eviction_lru():
+    tw = TelemetryWindow(window_s=1000.0, max_keys=3)
+    for i, name in enumerate(["t0", "t1", "t2"]):
+        tw.observe_sample(now=1.0 + i, tenant=name)
+    tw.observe_sample(now=10.0, tenant="t0")        # refresh t0
+    tw.observe_sample(now=11.0, tenant="t3")        # evicts LRU (t1)
+    keys = {k[0] for k in tw.keys(now=12.0)}
+    assert keys == {"t0", "t2", "t3"}
+
+
+def test_keyed_window_events_horizon_and_filter():
+    tw = TelemetryWindow(window_s=100.0)
+    tw.observe_sample(now=1.0, ttft_s=0.1, tenant="a")
+    tw.observe_sample(now=50.0, ttft_s=0.2, tenant="a")
+    tw.observe_sample(now=50.5, ttft_s=0.3, tenant="b")
+    tw.observe_shed("x", now=50.6, tenant="a")
+    samples, sheds = tw.events(now=51.0, horizon_s=5.0)
+    assert len(samples) == 2 and len(sheds) == 1
+    samples, sheds = tw.events(now=51.0, horizon_s=5.0, tenant="a")
+    assert len(samples) == 1 and samples[0]["ttft_s"] == 0.2
+    assert sheds[0]["reason"] == "x"
+    # horizon clamps to window_s; full-window query sees everything
+    samples, _ = tw.events(now=51.0)
+    assert len(samples) == 3
+
+
+def test_keyed_window_journey_attrs_feed_keys():
+    from paddle_tpu.observability import journey as journey_mod
+    tw = TelemetryWindow(window_s=100.0)
+    j = journey_mod.begin("slo-j1")
+    j.annotate(tenant="acme", priority="interactive")
+    j.phase("prefill", j.t0, 0.01)
+    j.finish("ok")
+    tw.observe_journey(j, now=1.0)
+    snap = tw.snapshot(now=2.0, by="tenant")
+    assert "acme" in snap["keys"]
+    assert tw.snapshot(now=2.0, by="class")["keys"]["interactive"][
+        "requests"] == 1
+
+
+# -- objective validation -----------------------------------------------------
+
+def test_objective_validation():
+    ok = SloObjective("o", "ttft_p99", 0.9, threshold_s=1.0)
+    assert ok.snapshot()["signal"] == "ttft_p99"
+    with pytest.raises(ValueError):
+        SloObjective("o", "nope", 0.9)
+    with pytest.raises(ValueError):
+        SloObjective("o", "shed_rate", 1.0)           # no error budget
+    with pytest.raises(ValueError):
+        SloObjective("o", "ttft_p99", 0.9)            # missing threshold
+    with pytest.raises(ValueError):
+        SloObjective("o", "shed_rate", 0.9, per="tenant", tenant="a")
+    with pytest.raises(ValueError):
+        SloObjective("o", "shed_rate", 0.9, fast_window_s=60.0,
+                     slow_window_s=10.0)
+    with pytest.raises(ValueError):
+        SloEvaluator([])
+    with pytest.raises(ValueError):
+        SloEvaluator([ok, SloObjective("o", "shed_rate", 0.9)])
+
+
+# -- burn-rate matrix ---------------------------------------------------------
+
+def _obj(**kw):
+    base = dict(signal="ttft_p99", target=0.9, threshold_s=1.0,
+                fast_window_s=5.0, fast_burn=8.0, slow_window_s=50.0,
+                slow_burn=2.0, fire_ticks=2, resolve_ticks=3,
+                min_events=4)
+    base.update(kw)
+    return SloObjective(kw.pop("name", "obj"), base.pop("signal"),
+                        base.pop("target"), **base)
+
+
+def test_burn_fast_rule_catches_flash():
+    """A dense burst of bad events trips the FAST rule in a few ticks,
+    long before the slow window degrades."""
+    tw = TelemetryWindow(window_s=100.0)
+    ev = SloEvaluator([_obj()])
+    t = 0.0
+    for _ in range(50):                               # healthy baseline
+        t += 1.0
+        tw.observe_sample(now=t, ttft_s=0.1)
+        assert ev.tick(tw, now=t) == []
+    fired_at = None
+    for i in range(10):                               # flash: all bad
+        t += 1.0
+        for _ in range(3):
+            tw.observe_sample(now=t, ttft_s=5.0)
+        for tr in ev.tick(tw, now=t):
+            if tr["to"] == "firing":
+                fired_at = t
+                assert tr["rule"] == "fast"
+        if fired_at:
+            break
+    # fires within a handful of seconds of the flash start (t=50) —
+    # the slow rule alone would need tens of seconds of degradation
+    assert fired_at is not None and fired_at <= 57.0
+
+
+def test_burn_slow_rule_catches_leak():
+    """A thin trickle of bad events (~25% > threshold, burn 2.5x) never
+    trips the fast rule at 8x but does trip the slow rule."""
+    tw = TelemetryWindow(window_s=100.0)
+    ev = SloEvaluator([_obj()])
+    t = 0.0
+    rules = []
+    for i in range(60):
+        t += 1.0
+        tw.observe_sample(now=t, ttft_s=5.0 if i % 4 == 0 else 0.1)
+        rules += [tr["rule"] for tr in ev.tick(tw, now=t)
+                  if tr["to"] == "firing"]
+    assert rules and set(rules) == {"slow"}
+
+
+def test_burn_under_budget_is_silent():
+    """5% bad against a 90% target is burn 0.5 — inside budget, no
+    alert ever."""
+    tw = TelemetryWindow(window_s=100.0)
+    ev = SloEvaluator([_obj()])
+    t = 0.0
+    trs = []
+    for i in range(100):
+        t += 1.0
+        tw.observe_sample(now=t, ttft_s=5.0 if i % 20 == 10 else 0.1)
+        trs += ev.tick(tw, now=t)
+    assert trs == []
+
+
+def test_burn_min_events_gates_thin_traffic():
+    """One bad sample alone (error rate 1.0, burn 10x) stays silent
+    below min_events."""
+    tw = TelemetryWindow(window_s=100.0)
+    ev = SloEvaluator([_obj(min_events=4)])
+    tw.observe_sample(now=1.0, ttft_s=5.0)
+    assert ev.tick(tw, now=1.0) == []
+    for t in (2.0, 3.0, 4.0):
+        tw.observe_sample(now=t, ttft_s=5.0)
+    trs = ev.tick(tw, now=4.0) + ev.tick(tw, now=5.0)
+    assert any(tr["to"] == "firing" for tr in trs)
+
+
+def test_shed_rate_and_availability_signals():
+    tw = TelemetryWindow(window_s=100.0)
+    ev = SloEvaluator([
+        SloObjective("sheds", "shed_rate", 0.9, fast_window_s=5.0,
+                     fast_burn=5.0, slow_window_s=50.0, fire_ticks=1,
+                     min_events=4),
+        SloObjective("avail", "availability", 0.9, fast_window_s=5.0,
+                     fast_burn=5.0, slow_window_s=50.0, fire_ticks=1,
+                     min_events=4),
+    ])
+    t = 0.0
+    fired = set()
+    for i in range(8):
+        t += 1.0
+        tw.observe_shed("slo_shed", now=t)
+        tw.observe_sample(now=t, outcome="engine_error")
+        for tr in ev.tick(tw, now=t):
+            if tr["to"] == "firing":
+                fired.add(tr["objective"])
+    assert fired == {"sheds", "avail"}
+
+
+# -- lifecycle ----------------------------------------------------------------
+
+def test_alert_lifecycle_holddown_and_resolve():
+    tw = TelemetryWindow(window_s=100.0)
+    ev = SloEvaluator([_obj(fire_ticks=3, resolve_ticks=4)])
+    t = 0.0
+
+    def feed(bad, n=4):
+        nonlocal t
+        t += 1.0
+        for _ in range(n):
+            tw.observe_sample(now=t, ttft_s=5.0 if bad else 0.001)
+        return ev.tick(tw, now=t)
+
+    # a 1-tick blip enters pending, then clears back to inactive
+    # without ever firing (hold-down)
+    trs = feed(bad=True)
+    assert [tr["to"] for tr in trs] == ["pending"]
+    # blip over: fast window still holds the bad burst for a few ticks,
+    # so drown it in good samples until the rule clears
+    for _ in range(8):
+        feed(bad=False, n=40)
+    assert ev.firing() == []
+    st = {(r["objective"], r["key"]): r["state"] for r in ev.state()}
+    assert st[("obj", "all")] == "inactive"
+
+    # sustained breach: pending once the fast window is dominated by
+    # bad events, firing only after fire_ticks consecutive breaches
+    seen = []
+    for _ in range(10):
+        seen += feed(bad=True)
+    kinds = [tr["to"] for tr in seen]
+    assert kinds == ["pending", "firing"]
+    assert ev.firing() and ev.firing()[0]["objective"] == "obj"
+
+    # recovery: resolve only after resolve_ticks consecutive clears
+    seen = []
+    for _ in range(30):
+        seen += feed(bad=False, n=60)
+        if any(tr["to"] == "resolved" for tr in seen):
+            break
+    assert any(tr["to"] == "resolved" for tr in seen)
+    assert ev.firing() == []
+
+
+def test_per_tenant_expansion():
+    tw = TelemetryWindow(window_s=100.0)
+    ev = SloEvaluator([_obj(per="tenant", fire_ticks=1)])
+    t = 0.0
+    fired_keys = set()
+    for _ in range(8):
+        t += 1.0
+        for _ in range(4):
+            tw.observe_sample(now=t, ttft_s=5.0, tenant="noisy")
+            tw.observe_sample(now=t, ttft_s=0.1, tenant="calm")
+        for tr in ev.tick(tw, now=t):
+            if tr["to"] == "firing":
+                fired_keys.add(tr["key"])
+    assert fired_keys == {"noisy"}
+    states = {r["key"]: r["state"] for r in ev.state()}
+    assert states["noisy"] == "firing" and states["calm"] == "inactive"
+
+
+# -- incident bundles ---------------------------------------------------------
+
+def test_incident_store_roundtrip_and_ring(tmp_path):
+    store = IncidentStore(str(tmp_path), max_incidents=3)
+    ids = []
+    for i in range(5):
+        inc_id = store.write({"schema": INCIDENT_SCHEMA,
+                              "incident": {"objective": f"obj{i}",
+                                           "key": "all", "t": float(i)}})
+        ids.append(inc_id)
+    ring = store.list()
+    assert [m["id"] for m in ring] == ids[-3:]        # ring-bounded
+    files = sorted(p.name for p in tmp_path.glob("*.json"))
+    assert len(files) == 3                            # pruned on disk too
+    bundle = store.get(ids[-1])
+    assert bundle["schema"] == INCIDENT_SCHEMA
+    assert bundle["incident"]["objective"] == "obj4"
+    assert bundle["incident"]["id"] == ids[-1]
+    assert store.get(ids[0]) is None                  # evicted
+    assert store.get("nope") is None
+
+
+def test_build_incident_correlates_planes():
+    # live-clock samples: build_incident snapshots at wall perf_counter
+    tw = TelemetryWindow(window_s=100.0)
+    now = time.perf_counter()
+    tw.observe_sample(now=now, ttft_s=2.0, tenant="acme",
+                      priority="interactive")
+    tw.observe_shed("slo_shed", now=now, tenant="acme")
+    bundle = build_incident(
+        {"objective": "o", "key": "acme", "rule": "fast", "t": 2.0,
+         "burn_fast": 9.0, "burn_slow": 2.0, "attainment": 0.7},
+        window=tw)
+    assert bundle["schema"] == INCIDENT_SCHEMA
+    assert bundle["incident"]["objective"] == "o"
+    assert bundle["window"]["global"]["requests"] == 1
+    assert "acme" in bundle["window"]["by_tenant"]["keys"]
+    assert "interactive" in bundle["window"]["by_class"]["keys"]
+    # watchdog base rides along (flight tail, threads) + perf planes
+    for key in ("flight_events", "threads", "perf", "memory",
+                "slowest_journeys"):
+        assert key in bundle
+    json.dumps(bundle, default=str)                   # JSON-serializable
+
+
+# -- autoscaler seam ----------------------------------------------------------
+
+def test_scale_policy_scale_on_alerts():
+    healthy = {"est_ttft_s": 0.1, "queue_wait_s": {"p99": 0.0, "n": 5},
+               "requests": 5, "shed": 0, "shed_rate": 0.0,
+               "queue_depth": 0, "slots_in_use": 0, "total_slots": 4,
+               "prefill_s": 0.05}
+    alerting = dict(healthy, firing_alerts=[
+        {"objective": "ttft", "key": "all", "rule": "fast", "since": 1.0}])
+    default = ScalePolicy()
+    assert default.breach_reason(alerting) == ""      # opt-in only
+    pol = ScalePolicy(scale_on_alerts=True, up_ticks=1)
+    assert pol.breach_reason(dict(healthy, firing_alerts=[])) == ""
+    assert pol.breach_reason(alerting) == "slo_alert"
+    direction, reason = pol.decide(alerting, replicas=1, min_replicas=1,
+                                   max_replicas=4, now=100.0)
+    assert (direction, reason) == ("up", "slo_alert")
+    assert pol.snapshot()["scale_on_alerts"] is True
+
+
+def test_fleetsim_slo_flash_fires_and_resolves():
+    """Virtual-time e2e: the flash trace fires the fast rule, the alert
+    resolves after the autoscaler absorbs the crowd, the steady trace
+    fires nothing."""
+    def objective():
+        return SloObjective("sim-ttft", "ttft_p99", 0.9, threshold_s=1.55,
+                            fast_window_s=3.0, fast_burn=6.0,
+                            slow_window_s=15.0, slow_burn=2.0,
+                            fire_ticks=2, resolve_ticks=6, min_events=4)
+
+    def policy():
+        return ScalePolicy(slo_ttft_s=1.55, headroom_frac=0.4, up_ticks=1,
+                           idle_ticks=8, cooldown_up_s=4.0,
+                           cooldown_down_s=3.0)
+
+    flash = make_trace(60.0, 20.0, seed=0, flash_mult=2.5, flash_at=0.25,
+                       flash_duration_s=10.0, prompt_mean=12.0,
+                       out_mean=10.0, out_max=48)
+    res = FleetSim(policy(), min_replicas=1, max_replicas=6,
+                   start_replicas=1, slots_per_replica=4, prefill_s=0.05,
+                   token_s=0.01, build_s=2.0, policy_poll_s=0.25,
+                   window_s=5.0,
+                   slo_evaluator=SloEvaluator([objective()])).run(flash)
+    slo = res["slo"]
+    assert slo["fired"] >= 1
+    assert slo["resolved"] == slo["fired"]            # nothing stuck
+    firings = [tr for tr in slo["transitions"] if tr["to"] == "firing"]
+    assert all(tr["rule"] == "fast" for tr in firings)
+    # the alert fires DURING the crowd and resolves after a scale-up
+    first_up = min(e["t"] for e in res["events"]
+                   if e["direction"] == "up")
+    resolves = [tr["t"] for tr in slo["transitions"]
+                if tr["to"] == "resolved"]
+    assert min(resolves) > first_up
+
+    steady = make_trace(60.0, 8.0, seed=1, flash_mult=1.0)
+    res2 = FleetSim(policy(), min_replicas=1, max_replicas=6,
+                    start_replicas=2, slots_per_replica=4, prefill_s=0.05,
+                    token_s=0.01, build_s=2.0, policy_poll_s=0.25,
+                    window_s=5.0,
+                    slo_evaluator=SloEvaluator([objective()])).run(steady)
+    assert res2["slo"]["fired"] == 0                  # no false positives
+
+
+# -- gateway shed attribution -------------------------------------------------
+
+def test_gateway_shed_sites_attribute_tenant(tiny_gpt):
+    """Every gateway shed path records (tenant, priority) in the keyed
+    window, and the journey carries both even when the request never
+    enqueues."""
+    from paddle_tpu.observability import journey as journey_mod
+    model, _ = tiny_gpt
+    eng = Engine(model, max_slots=1, max_len=32, auto_start=False)
+    gw = Gateway([eng], tenants=[
+        TenantConfig("acme", priority="interactive", max_queue=1)],
+        start=False)
+    try:
+        creq = parse_completion_request(
+            json.dumps({"prompt": [1, 2, 3], "max_tokens": 2}).encode(),
+            has_tokenizer=False)
+        # site 3: AdmissionError from the fair-share scheduler
+        gw.admit(creq, "acme")                        # fills max_queue=1
+        j = journey_mod.begin("slo-shed-j")
+        with pytest.raises(AdmissionError, match="queue is full"):
+            gw.admit(creq, "acme", journey=j)
+        assert j.attrs["tenant"] == "acme"
+        assert j.attrs["priority"] == "interactive"
+        # site 1: draining
+        gw._drain_ev.set()
+        with pytest.raises(AdmissionError, match="draining"):
+            gw.admit(creq, "acme")
+        gw._drain_ev.clear()
+        snap = gw.window.snapshot(by="tenant")
+        assert snap["keys"]["acme"]["shed"] == 2
+        assert snap["keys"]["acme"]["shed_reasons"] == {
+            "tenant_queue_full": 1, "draining": 1}
+        assert gw.window.snapshot(by="class")["keys"]["interactive"][
+            "shed"] == 2
+    finally:
+        gw.shutdown()
+        eng.shutdown()
+
+
+# -- HTTP end-to-end ----------------------------------------------------------
+
+def test_http_slo_engine_end_to_end(tiny_gpt, tmp_path):
+    """The acceptance shape: a real gateway with the SLO engine on, a
+    breaching workload fires a fast-burn alert, the incident bundle
+    correlates the planes, /debug surfaces serve it, metrics export, and
+    decode stays ONE compiled program."""
+    model, _ = tiny_gpt
+    eng = Engine(model, max_slots=2, max_len=32, max_queue=16)
+    # threshold far below real latency: every completion is a "bad
+    # event", so the fast rule trips deterministically within ticks
+    objectives = [SloObjective(
+        "ttft-tight", "ttft_p99", 0.9, threshold_s=1e-4,
+        fast_window_s=5.0, fast_burn=5.0, slow_window_s=30.0,
+        slow_burn=2.0, fire_ticks=2, resolve_ticks=2, min_events=3)]
+    with start_gateway([eng], own_engines=True,
+                       slo_objectives=objectives, slo_tick_s=0.1,
+                       slo_incident_dir=str(tmp_path)) as stack:
+        port = stack.port
+        assert stack.gateway.slo_engine is stack.slo_engine
+        for _ in range(4):
+            status, _, _ = _post(port, {"prompt": [5, 17, 3],
+                                        "max_tokens": 2},
+                                 headers={"X-Tenant": "acme"})
+            assert status == 200
+        def fired(state):
+            return (any(tr["to"] == "firing"
+                        for tr in state["transitions"])
+                    and state["incidents"])
+
+        deadline = time.time() + 30.0
+        state = None
+        while time.time() < deadline:
+            status, raw = _get(port, "/debug/slo")
+            assert status == 200
+            state = json.loads(raw)
+            if fired(state):
+                break
+            time.sleep(0.1)
+        assert state is not None and fired(state), \
+            "fast-burn alert never fired"
+        assert stack.slo_engine.firing()
+        assert state["objectives"][0]["name"] == "ttft-tight"
+        assert any(a["state"] == "firing" for a in state["alerts"])
+
+        inc_id = state["incidents"][-1]["id"]
+        status, raw = _get(port, "/debug/incidents")
+        assert status == 200
+        assert any(m["id"] == inc_id
+                   for m in json.loads(raw)["incidents"])
+        status, raw = _get(port, f"/debug/incidents/{inc_id}")
+        assert status == 200
+        bundle = json.loads(raw)
+        assert bundle["schema"] == INCIDENT_SCHEMA
+        # all three telemetry planes, correlated in one artifact
+        assert bundle["window"]["global"]["requests"] >= 3
+        assert "acme" in bundle["window"]["by_tenant"]["keys"]
+        assert "perf" in bundle and "memory" in bundle
+        assert bundle["fleet"]["alive"] == 1
+        assert bundle["slowest_journeys"]
+        assert any(e.get("kind") == "alert"
+                   for e in bundle["flight_events"])
+        status, raw = _get(port, "/debug/incidents/inc-nope")
+        assert status == 404
+
+        # the renderer consumes the served bundle as-is
+        from tools.incident_report import render
+        sheet = render(bundle)
+        assert inc_id in sheet and "ttft-tight" in sheet
+
+        # metrics export
+        status, raw = _get(port, "/metrics")
+        text = raw.decode()
+        assert slo_mod.SLO_ATTAINMENT in text
+        assert slo_mod.SLO_BURN_RATE in text
+        assert slo_mod.SLO_BUDGET_REMAINING in text
+        assert slo_mod.SLO_ALERTS in text
+
+        # firing_alerts rides the autoscaler feed seam
+        feed_alerts = stack.gateway.slo_engine.firing()
+        assert feed_alerts[0]["objective"] == "ttft-tight"
+
+        assert eng.compile_stats()["decode_compiles"] == 1
+    # stack close shut the evaluator thread down
+    assert not stack.slo_engine._thread.is_alive()
+
+
+def test_http_slo_404_without_engine(tiny_gpt):
+    model, _ = tiny_gpt
+    eng = Engine(model, max_slots=1, max_len=32, auto_start=False)
+    with start_gateway([eng], own_engines=True) as stack:
+        status, raw = _get(stack.port, "/debug/slo")
+        assert status == 404
+        assert json.loads(raw)["error"]["code"] == "no_slo_engine"
+        status, _ = _get(stack.port, "/debug/incidents")
+        assert status == 404

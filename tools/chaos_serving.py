@@ -33,7 +33,12 @@ round has been absorbed by a supervisor restart, then asserts:
   mid-``scale.down_drain``: zero lost zero-token requests, adapter
   parity across the events, one decode signature per build, zero leaked
   pages/ledger bytes across EVERY build (the scale replicas join the
-  same end-of-lane sweep), final fleet size back within [min, max].
+  same end-of-lane sweep), final fleet size back within [min, max];
+* **SLO alerts heal with the fleet** (ISSUE 16) — an aggressive
+  availability objective rides the whole kill matrix; any alert raised
+  during a rebuild resolves once the fleet is healthy (no stuck-firing
+  state across supervisor rebuilds) and every incident bundle written
+  mid-kill is complete, parseable JSON (atomic tmp+rename writes).
 
     python tools/chaos_serving.py
 
@@ -192,6 +197,23 @@ def main() -> int:
                TenantConfig("bulk", priority="batch", max_queue=8)]
     stack = start_gateway(sups, own_engines=True, tenants=tenants,
                           names=["engine0", "engine1"], max_redispatch=3)
+    # SLO engine riding the kill matrix (ISSUE 16): an availability
+    # objective aggressive enough that the sheds and interrupted
+    # streams the kills cause can burn it.  Whatever fires during a
+    # rebuild must RESOLVE once the fleet heals (no stuck-firing state
+    # across supervisor rebuilds), and every incident bundle written
+    # mid-kill must land as complete, parseable JSON (atomic writes).
+    import tempfile
+    from paddle_tpu.observability.slo import (INCIDENT_SCHEMA, SloEngine,
+                                              SloObjective)
+    slo_eng = SloEngine(
+        stack, [SloObjective("chaos-availability", "availability", 0.99,
+                             fast_window_s=2.0, fast_burn=1.0,
+                             slow_window_s=6.0, slow_burn=1.0,
+                             fire_ticks=1, resolve_ticks=2,
+                             min_events=2)],
+        tick_s=0.1,
+        incident_dir=tempfile.mkdtemp(prefix="chaos_slo_inc_"))
     rs = np.random.RandomState(0)
     out, lock = [], threading.Lock()
     threads = []
@@ -483,6 +505,28 @@ def main() -> int:
             faults.reset()
             auto.shutdown()
 
+        # SLO under chaos (ISSUE 16): the kill matrix is over and the
+        # fleet is healthy — any alert the rebuilds raised must clear
+        # as the window's errors age out (a stuck-firing alert here
+        # would mean evaluator state survived a heal it shouldn't)
+        deadline = time.time() + 60
+        while slo_eng.firing():
+            assert time.time() < deadline, \
+                f"alert stuck firing after the fleet healed: " \
+                f"{slo_eng.firing()}"
+            time.sleep(0.1)
+        incidents = slo_eng.store.list()
+        for m in incidents:
+            b = slo_eng.store.get(m["id"])
+            assert b is not None and b["schema"] == INCIDENT_SCHEMA, m
+            for key in ("incident", "window", "flight_events"):
+                assert key in b, (m["id"], key)
+            assert b["incident"]["objective"] == "chaos-availability", b
+        slo_summary = {
+            "slo_alert_transitions": len(flight.events("alert")),
+            "slo_incidents": len(incidents),
+        }
+
         summary = {
             "chaos_serving": "ok", "requests": total, "kills": kills,
             "completed": len(completed), "shed": len(shed),
@@ -492,9 +536,11 @@ def main() -> int:
             "builds_per_engine": [len(s.builds()) for s in sups],
             **journey_summary,
             **scale_summary,
+            **slo_summary,
         }
     finally:
         faults.reset()
+        slo_eng.shutdown()
         drained = stack.drain(deadline_s=60.0)
     assert drained, "final drain dropped work"
     # zero leaked pages: every build of every supervisor — the killed

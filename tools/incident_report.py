@@ -1,0 +1,159 @@
+"""Render an SLO incident bundle as a human-readable postmortem sheet.
+
+An incident bundle (``paddle_tpu.incident.v1``, written by the SLO
+engine on every transition to firing and served by
+``GET /debug/incidents/<id>``) correlates all three telemetry planes at
+the moment an objective started burning: the keyed window snapshots
+(host wall time), the perfscope roofline + HBM ownership ledger (device
+time + bytes), and the slowest journey timelines + flight tail (what
+each request was doing).  This tool turns one bundle into the text
+summary you'd paste into a postmortem:
+
+    python tools/incident_report.py --url http://127.0.0.1:8000
+    python tools/incident_report.py --url http://127.0.0.1:8000 --id inc-...
+    python tools/incident_report.py --json /tmp/paddle_tpu_incidents/inc-....json
+
+With ``--url`` and no ``--id`` it lists the incident ring; with an id
+(or a saved JSON file) it prints the full sheet.  stdlib-only; no jax,
+no paddle_tpu import — usable against a live gateway from anywhere.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+
+__all__ = ["render", "fetch"]
+
+
+def fetch(url: str, inc_id: str | None = None) -> dict:
+    path = "/debug/incidents" + (f"/{inc_id}" if inc_id else "")
+    with urllib.request.urlopen(url.rstrip("/") + path, timeout=30) as r:
+        return json.loads(r.read().decode("utf-8"))
+
+
+def _fmt_pcts(p: dict | None) -> str:
+    if not p:
+        return "-"
+    p50, p99 = p.get("p50"), p.get("p99")
+    return (f"p50={p50 * 1e3:.1f}ms p99={p99 * 1e3:.1f}ms n={p.get('n')}"
+            if p50 is not None and p99 is not None else "-")
+
+
+def _window_lines(tag: str, snap: dict, out: list):
+    out.append(f"  [{tag}] requests={snap.get('requests')} "
+               f"shed={snap.get('shed')} "
+               f"shed_rate={snap.get('shed_rate')}")
+    out.append(f"      ttft {_fmt_pcts(snap.get('ttft_s'))} | "
+               f"queue_wait {_fmt_pcts(snap.get('queue_wait_s'))} | "
+               f"token {_fmt_pcts(snap.get('token_s'))}")
+    reasons = snap.get("shed_reasons") or {}
+    if reasons:
+        out.append("      shed_reasons: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(reasons.items())))
+
+
+def render(bundle: dict) -> str:
+    out: list[str] = []
+    inc = bundle.get("incident", {})
+    out.append("=" * 72)
+    out.append(f"INCIDENT {inc.get('id', '?')}")
+    out.append(f"  objective={inc.get('objective')} key={inc.get('key')} "
+               f"rule={inc.get('rule')} at {inc.get('time')}")
+    out.append(f"  burn fast={inc.get('burn_fast')} "
+               f"slow={inc.get('burn_slow')} "
+               f"attainment={inc.get('attainment')}")
+    out.append("=" * 72)
+
+    window = bundle.get("window") or {}
+    if window:
+        out.append("\n-- windowed telemetry (host plane) --")
+        if window.get("global"):
+            _window_lines("global", window["global"], out)
+        for by in ("by_tenant", "by_class"):
+            keys = (window.get(by) or {}).get("keys") or {}
+            for name, snap in sorted(keys.items()):
+                _window_lines(f"{by[3:]}:{name or '(default)'}", snap, out)
+
+    perf = bundle.get("perf") or {}
+    programs = perf.get("programs") or []
+    if programs:
+        out.append("\n-- device roofline (device-time plane) --")
+        for p in programs[:8]:
+            out.append(f"  {p.get('name', '?')}: "
+                       f"dispatches={p.get('dispatches')} "
+                       f"device_s={p.get('device_s')} "
+                       f"mfu={p.get('mfu')} "
+                       f"hbm_bw_frac={p.get('hbm_bw_frac')}")
+
+    mem = bundle.get("memory") or {}
+    owners = mem.get("owners") or {}
+    if owners:
+        out.append("\n-- HBM ownership (bytes plane) --")
+        for name, b in sorted(owners.items(),
+                              key=lambda kv: -(kv[1] or 0))[:8]:
+            out.append(f"  {name}: {b}")
+
+    fleet = bundle.get("fleet") or {}
+    if fleet:
+        out.append("\n-- fleet --")
+        out.append(f"  alive={fleet.get('alive')} "
+                   f"draining={fleet.get('draining')} "
+                   f"total_slots={fleet.get('total_slots')}")
+        for name, rep in sorted((fleet.get("replicas") or {}).items()):
+            out.append(f"  {name}: alive={rep.get('alive')} "
+                       f"slots={rep.get('slots_in_use')}/"
+                       f"{rep.get('max_slots')} "
+                       f"queue={rep.get('queue_depth')}")
+
+    slowest = bundle.get("slowest_journeys") or []
+    if slowest:
+        out.append("\n-- slowest journeys in-window --")
+        for tl in slowest:
+            phases = ", ".join(
+                f"{ph.get('phase')}={ph.get('dur_ms', 0):.1f}ms"
+                for ph in (tl.get("phases") or [])[:6])
+            out.append(f"  {tl.get('id')}: wall="
+                       f"{tl.get('wall_ms') or 0:.1f}ms "
+                       f"outcome={tl.get('outcome')} [{phases}]")
+
+    flights = bundle.get("flight_events") or []
+    if flights:
+        out.append(f"\n-- flight tail ({len(flights)} events) --")
+        for evt in flights[-12:]:
+            out.append(f"  {evt.get('kind')}/{evt.get('event')}: "
+                       + ", ".join(f"{k}={v}" for k, v in evt.items()
+                                   if k not in ("kind", "event", "t")))
+    out.append("")
+    return "\n".join(out)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--url", help="gateway base URL (http://host:port)")
+    ap.add_argument("--id", help="incident id to render (with --url)")
+    ap.add_argument("--json", help="render a saved bundle JSON file")
+    args = ap.parse_args()
+    if args.json:
+        with open(args.json) as f:
+            print(render(json.load(f)))
+        return 0
+    if not args.url:
+        ap.error("need --url or --json")
+    if not args.id:
+        ring = fetch(args.url).get("incidents", [])
+        if not ring:
+            print("no incidents recorded")
+            return 0
+        for m in ring:
+            print(f"{m['id']}  objective={m.get('objective')} "
+                  f"key={m.get('key')} rule={m.get('rule')} "
+                  f"time={m.get('time')}")
+        return 0
+    print(render(fetch(args.url, args.id)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

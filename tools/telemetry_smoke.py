@@ -43,6 +43,7 @@ DEFAULT_SUBSET = [
     "tests/test_journey.py",
     "tests/test_perfscope.py",
     "tests/test_autoscale.py",
+    "tests/test_slo.py",
 ]
 
 # decode fast-path lane (ISSUE 10): prefix cache + speculation + int8 KV
@@ -498,6 +499,135 @@ print("autoscale lane ok:", {
     "builds": len(built)})
 """
 
+# SLO lane (ISSUE 16): burn-rate alerting end to end.  Sim mode first — a
+# flash crowd over an undersized fleet must fire the fast-burn rule and
+# resolve after the autoscaler absorbs it, while a steady diurnal trace
+# fires nothing (zero false positives).  Then a real HTTP gateway with an
+# impossible ttft objective: the alert fires, the incident bundle parses
+# with all three telemetry planes correlated, the renderer formats it,
+# the slo gauges export, and decode keeps ONE compiled signature.
+SLO_LANE = r"""
+import http.client, json, time
+import paddle_tpu as paddle
+from paddle_tpu import observability as obs
+from paddle_tpu.models import build_gpt, gpt_config
+from paddle_tpu.observability.slo import (INCIDENT_SCHEMA, SLO_ALERTS,
+                                          SLO_ATTAINMENT,
+                                          SLO_BUDGET_REMAINING,
+                                          SLO_BURN_RATE, SloObjective)
+from paddle_tpu.serving import FleetSim, ScalePolicy
+from paddle_tpu.serving.gateway import TenantConfig, start_gateway
+from tools.incident_report import render
+from tools.load_gen import make_trace
+
+assert obs.enabled(), "PADDLE_TPU_TELEMETRY=1 must bootstrap telemetry"
+
+# -- sim mode: flash crowd fires fast-burn, resolves after absorb -------
+ev_obj = SloObjective("sim-ttft", "ttft_p99", 0.9, threshold_s=1.55,
+                      fast_window_s=3.0, fast_burn=6.0, slow_window_s=15.0,
+                      slow_burn=2.0, fire_ticks=2, resolve_ticks=6,
+                      min_events=4)
+pol = ScalePolicy(slo_ttft_s=1.55, headroom_frac=0.4, up_ticks=1,
+                  idle_ticks=8, cooldown_up_s=4.0, cooldown_down_s=3.0)
+flash = make_trace(60.0, 20.0, seed=0, flash_mult=2.5, flash_at=0.25,
+                   flash_duration_s=10.0, prompt_mean=12.0, out_mean=10.0,
+                   out_max=48)
+
+
+def sim(trace, start_replicas):
+    from paddle_tpu.observability.slo import SloEvaluator
+    return FleetSim(pol, min_replicas=1, max_replicas=6,
+                    start_replicas=start_replicas, slots_per_replica=4,
+                    prefill_s=0.05, token_s=0.01, build_s=2.0,
+                    policy_poll_s=0.25, window_s=5.0,
+                    slo_evaluator=SloEvaluator([ev_obj])).run(trace)
+
+
+hot = sim(flash, 1)
+slo = hot["slo"]
+assert slo["fired"] >= 1, slo
+assert slo["resolved"] == slo["fired"], slo
+firings = [t for t in slo["transitions"] if t["to"] == "firing"]
+assert all(t["rule"] == "fast" for t in firings), firings
+ups = [e for e in hot["events"] if e["direction"] == "up"]
+resolves = [t for t in slo["transitions"] if t["to"] == "resolved"]
+assert ups and resolves and resolves[0]["t"] > ups[0]["t"], \
+    (ups[:1], resolves[:1])
+
+steady = sim(make_trace(60.0, 8.0, seed=1, flash_mult=1.0), 2)
+assert steady["slo"]["fired"] == 0, steady["slo"]
+
+# -- real HTTP gateway: alert -> incident bundle -> renderer ------------
+cfg = gpt_config("gpt-tiny", max_position_embeddings=128,
+                 hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+paddle.seed(0)
+model = build_gpt(cfg)
+model.eval()
+from paddle_tpu.serving import Engine
+eng = Engine(model, max_slots=2, max_len=48, max_queue=32)
+obj = SloObjective("ttft-tight", "ttft_p99", 0.9, threshold_s=1e-4,
+                   fast_window_s=5.0, fast_burn=5.0, slow_window_s=30.0,
+                   slow_burn=2.0, fire_ticks=2, resolve_ticks=2,
+                   min_events=3)
+stack = start_gateway([eng], tenants=[TenantConfig("acme", max_queue=64)],
+                      window_s=30.0, slo_objectives=[obj], slo_tick_s=0.1)
+
+
+def get(path):
+    conn = http.client.HTTPConnection("127.0.0.1", stack.port, timeout=60)
+    conn.request("GET", path)
+    r = conn.getresponse()
+    body = r.read()
+    conn.close()
+    return r.status, body
+
+
+try:
+    for i in range(6):
+        conn = http.client.HTTPConnection("127.0.0.1", stack.port,
+                                          timeout=300)
+        conn.request("POST", "/v1/completions",
+                     json.dumps({"prompt": [1 + i, 2, 3],
+                                 "max_tokens": 4}).encode(),
+                     {"Content-Type": "application/json",
+                      "X-Tenant": "acme"})
+        conn.getresponse().read()
+        conn.close()
+    deadline = time.time() + 60.0
+    state = None
+    while time.time() < deadline:
+        state = json.loads(get("/debug/slo")[1])
+        if (any(t["to"] == "firing" for t in state["transitions"])
+                and state["incidents"]):
+            break
+        time.sleep(0.1)
+    assert state and state["incidents"], state
+    inc_id = state["incidents"][-1]["id"]
+    status, body = get("/debug/incidents/" + inc_id)
+    assert status == 200
+    bundle = json.loads(body)
+    assert bundle["schema"] == INCIDENT_SCHEMA, bundle["schema"]
+    assert bundle["incident"]["objective"] == "ttft-tight"
+    assert bundle["window"]["global"]["requests"] >= 3, bundle["window"]
+    assert "acme" in bundle["window"]["by_tenant"]["keys"]
+    assert bundle["slowest_journeys"], "no journey plane in bundle"
+    assert bundle["fleet"]["alive"] == 1, bundle["fleet"]
+    sheet = render(bundle)
+    assert "ttft-tight" in sheet and "tenant:acme" in sheet, sheet
+    text = get("/metrics")[1].decode()
+    for name in (SLO_ATTAINMENT, SLO_BUDGET_REMAINING, SLO_BURN_RATE,
+                 SLO_ALERTS):
+        assert name in text, name
+    assert eng.compile_stats()["decode_compiles"] == 1, eng.compile_stats()
+finally:
+    stack.close()
+    eng.shutdown()
+print("slo lane ok:", {
+    "sim_fired": slo["fired"], "sim_resolved": slo["resolved"],
+    "steady_fired": steady["slo"]["fired"],
+    "incident": inc_id})
+"""
+
 # prefetch-on training lane: fit a tiny model THROUGH DevicePrefetcher with
 # telemetry live and assert the input-pipeline series were exported.  Runs
 # in its own interpreter so the env-var bootstrap path is what's exercised.
@@ -613,6 +743,15 @@ def main() -> int:
         if as_rc != 0:
             print("autoscale lane FAILED", file=sys.stderr)
         rc = rc or as_rc
+        # slo lane (ISSUE 16): sim-mode burn-rate gates (flash fires fast
+        # rule + resolves post-absorb, steady diurnal fires nothing) plus
+        # a real HTTP alert -> incident bundle -> renderer round trip
+        print("telemetry smoke: slo lane", file=sys.stderr)
+        slo_rc = subprocess.call([sys.executable, "-c", SLO_LANE],
+                                 env=env, cwd=root)
+        if slo_rc != 0:
+            print("slo lane FAILED", file=sys.stderr)
+        rc = rc or slo_rc
         # tpu-lint ratchet gate (ISSUE 7): runs even when the pytest
         # subset has unrelated failures, in its own interpreter (the
         # analyzer is jax-free, so it cannot be broken by runtime drift)
