@@ -98,6 +98,9 @@ from . import perfscope  # noqa: E402,F401
 # SLO engine: objectives + burn-rate alerts + incident bundles,
 # layered over the keyed journey window and the watchdog seam
 from . import slo  # noqa: E402,F401
+# traffic capture: the always-on admission recorder + replay/fit feeds;
+# its process default registers the capture_tail incident section lazily
+from . import capture  # noqa: E402,F401
 
 _bootstrap_from_env()
 watchdog._bootstrap_from_env()

@@ -375,7 +375,10 @@ def build_incident(transition: dict, *, gateway=None, window=None,
     """One incident bundle correlating all three telemetry planes at
     the moment an alert fired: the watchdog base (flight tail, open
     spans, thread stacks, registered sections — perfscope's HBM
-    ownership ledger rides in via its ``add_section`` provider), keyed
+    ownership ledger and the traffic recorder's ``capture_tail`` (the
+    last arrivals before the burn, admitted and shed, each resolvable
+    against ``/debug/requests`` by journey id) ride in via their
+    ``add_section`` providers), keyed
     window snapshots, the N slowest journey timelines in-window, the
     perfscope roofline + memory report, and ``fleet_stats()``.  Every
     plane is individually guarded: a failing provider drops its section

@@ -30,6 +30,7 @@ import time
 
 import numpy as np
 
+from ...observability import capture as capture_mod
 from ...observability import flight, registry
 from ...observability.journey import TelemetryWindow
 from ...testing import faults
@@ -159,7 +160,10 @@ class Gateway:
                  shedder: LoadShedder | None = None,
                  max_queue_total: int | None = None, dispatch_slack: int = 1,
                  max_redispatch: int = 2, window_s: float = 60.0,
-                 model_name: str = "paddle-tpu", start: bool = True):
+                 model_name: str = "paddle-tpu", start: bool = True,
+                 capture=None, capture_mode: str | None = None,
+                 capture_entries: int | None = None,
+                 capture_spill_dir: str | None = None):
         if hasattr(engines, "submit"):
             engines = [engines]
         self.router = EngineRouter(engines, names=names)
@@ -181,6 +185,21 @@ class Gateway:
             (getattr(e, "adapter_registry", None)
              for e in self.router.engines
              if getattr(e, "adapter_registry", None) is not None), None)
+        # traffic capture: an explicit instance or any knob builds a
+        # gateway-local recorder (tests, spill-to-dir deployments);
+        # otherwise every gateway records into the process default.
+        # Either way the recorder feeds the capture_tail bundle section.
+        if capture is not None:
+            self.capture = capture
+            capture_mod.install_incident_section(capture)
+        elif (capture_mode is not None or capture_entries is not None
+              or capture_spill_dir is not None):
+            self.capture = capture_mod.TrafficCapture(
+                max_entries=capture_entries, mode=capture_mode,
+                spill_dir=capture_spill_dir)
+            capture_mod.install_incident_section(self.capture)
+        else:
+            self.capture = capture_mod.get_capture()
         self._stop_ev = threading.Event()
         self._drain_ev = threading.Event()
         self._drain_retry_after_s = 5.0
@@ -318,6 +337,33 @@ class Gateway:
         self.shutdown()
         return False
 
+    def _record_capture(self, creq: CompletionRequest, tenant: str,
+                        priority: str, outcome: str, journey,
+                        prompt=None):
+        """One traffic-capture entry per admission outcome (admitted OR
+        shed) — diagnostics, never control flow, so it must not raise
+        into the handler.  ``prompt`` is the resolved token-id array
+        when admission got that far; earlier exits hash the wire form."""
+        ids = None
+        text = None
+        if prompt is not None:
+            ids = prompt
+        elif isinstance(creq.prompt, (list, tuple)):
+            ids = creq.prompt
+        else:
+            text = creq.prompt
+        try:
+            self.capture.record(
+                tenant=tenant, priority=priority, outcome=outcome,
+                prompt=ids, text=text,
+                prompt_len=len(text) if ids is None and text else None,
+                max_tokens=creq.max_tokens, deadline_s=creq.deadline_s,
+                temperature=creq.temperature, top_k=creq.top_k,
+                seed=creq.seed, model=creq.model,
+                journey_id=journey.id if journey is not None else "")
+        except Exception:
+            pass
+
     # -- admission (handler threads) -----------------------------------------
     def admit(self, creq: CompletionRequest, tenant: str,
               journey=None) -> GatewayRequest:
@@ -350,11 +396,13 @@ class Gateway:
                                      priority=priority)
             registry().counter(GATEWAY_SHED, "requests shed by reason").inc(
                 1.0, labels={"tenant": tenant, "reason": "draining"})
+            self._record_capture(creq, tenant, priority, "draining", journey)
             raise AdmissionError(
                 "draining", "gateway is draining for shutdown; retry "
                 "against another replica",
                 retry_after_s=self._drain_retry_after_s, tenant=tenant)
         if not self.router.any_alive() and not self._fleet_pending():
+            self._record_capture(creq, tenant, priority, "no_engine", journey)
             raise NoEngineAvailableError(
                 "no alive engine replica to serve this request")
         prompt = self._prompt_ids(creq)
@@ -399,6 +447,8 @@ class Gateway:
                           est_ttft_ms=round(decision.est_ttft_s * 1e3, 1),
                           deadline_ms=round(creq.deadline_s * 1e3, 1),
                           backlog_tokens=round(backlog, 1))
+            self._record_capture(creq, tenant, priority, "slo_shed",
+                                 journey, prompt=prompt)
             raise AdmissionError(
                 "slo_shed", decision.reason,
                 retry_after_s=decision.retry_after_s, tenant=tenant,
@@ -413,6 +463,8 @@ class Gateway:
                 1.0, labels={"tenant": tenant, "reason": e.reason})
             flight.record("gateway", "shed", request=item.id, tenant=tenant,
                           reason=e.reason)
+            self._record_capture(creq, tenant, priority, e.reason,
+                                 journey, prompt=prompt)
             raise
         now = time.perf_counter()
         item.t_queue0 = now             # fair-share queue wait starts here
@@ -420,6 +472,8 @@ class Gateway:
             journey.phase("admit", t_admit0, now - t_admit0,
                           backlog_tokens=round(backlog, 1))
         self._count(tenant, "accepted")
+        self._record_capture(creq, tenant, priority, "admitted",
+                             journey, prompt=prompt)
         self._depth_gauges()
         flight.record("gateway", "admit", request=item.id, tenant=tenant,
                       priority=priority, prompt_len=int(prompt.size),

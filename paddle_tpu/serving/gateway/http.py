@@ -15,10 +15,16 @@ Endpoints:
   AND the ``paddle_tpu_device_memory_bytes`` backend allocator gauges
   (``steps.record_memory_stats``), so a pure-serving process exports
   device memory without a train loop.
-* ``GET /debug/requests?last=N`` — the newest N finished request
-  journeys as JSON timelines (phase-level latency attribution;
-  docs/observability.md "Request journeys").
+* ``GET /debug/requests?last=N&tenant=&outcome=`` — the newest N
+  finished request journeys as JSON timelines (phase-level latency
+  attribution; docs/observability.md "Request journeys").  ``tenant=``
+  and ``outcome=`` filter the whole ring before the ``last`` tail, so a
+  busy multi-tenant ring stays navigable.
 * ``GET /debug/requests/<id>`` — one journey by id (live or finished).
+* ``GET /debug/capture?last=N&tenant=&outcome=`` — the traffic-capture
+  ring: one entry per request the gateway saw, admitted or shed, with
+  arrival offset, tenant/priority, lengths, sampling params and the
+  journey id (docs/observability.md "Traffic capture & replay").
 * ``GET /debug/window`` — ``Gateway.window_stats()`` as JSON (the
   autoscaler feed: windowed TTFT/queue-wait/per-token percentiles,
   shed rate, phase shares).
@@ -239,17 +245,48 @@ class _Handler(BaseHTTPRequestHandler):
                             code="incident_not_found"))
                     else:
                         self._send_json(200, bundle)
-            elif path == "/debug/requests":
-                last = 32
+            elif path == "/debug/capture":
+                last = 64
+                tenant = outcome = None
                 for part in query.split("&"):
                     if part.startswith("last="):
                         try:
                             last = max(0, int(part[5:]))
                         except ValueError:
                             pass
+                    elif part.startswith("tenant="):
+                        tenant = part[7:]
+                    elif part.startswith("outcome="):
+                        outcome = part[8:]
+                self._send_json(200, self.gateway.capture.debug_state(
+                    last=last, tenant=tenant, outcome=outcome))
+            elif path == "/debug/requests":
+                last = 32
+                tenant = outcome = None
+                for part in query.split("&"):
+                    if part.startswith("last="):
+                        try:
+                            last = max(0, int(part[5:]))
+                        except ValueError:
+                            pass
+                    elif part.startswith("tenant="):
+                        tenant = part[7:]
+                    elif part.startswith("outcome="):
+                        outcome = part[8:]
+                if tenant is None and outcome is None:
+                    requests = journey_mod.recent(last)
+                else:
+                    # filter over the WHOLE ring, then tail: on a busy
+                    # multi-tenant ring the newest N unfiltered entries
+                    # may hold none of the tenant you're hunting
+                    requests = [
+                        j for j in journey_mod.recent(10 ** 9)
+                        if (tenant is None
+                            or j.attrs.get("tenant") == tenant)
+                        and (outcome is None or j.outcome == outcome)
+                    ][-last:] if last else []
                 self._send_json(200, {
-                    "requests": [j.timeline()
-                                 for j in journey_mod.recent(last)],
+                    "requests": [j.timeline() for j in requests],
                     "active": [j.id for j in journey_mod.active()],
                 })
             elif path.startswith("/debug/requests/"):
@@ -579,7 +616,15 @@ def start_gateway(engines, host: str = "127.0.0.1", port: int = 0, *,
     SloEngine` evaluating them every ``slo_tick_s`` — burn-rate alerts
     on ``/debug/slo``, incident bundles (ring-bounded at
     ``slo_max_incidents`` under ``slo_incident_dir``) on
-    ``/debug/incidents``."""
+    ``/debug/incidents``.
+
+    Traffic capture rides the same passthrough: ``capture_mode=``
+    (``shape``/``full``), ``capture_entries=`` and
+    ``capture_spill_dir=`` build a gateway-local
+    :class:`~paddle_tpu.observability.capture.TrafficCapture` (or pass
+    ``capture=`` an instance); with none set the gateway records into
+    the process default.  Either way ``GET /debug/capture`` serves the
+    ring and incident bundles gain the ``capture_tail`` section."""
     gateway = (engines if isinstance(engines, Gateway)
                else Gateway(engines, **gateway_kwargs))
     server = GatewayHTTPServer((host, port), gateway,

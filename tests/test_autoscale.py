@@ -475,10 +475,16 @@ def test_scale_up_then_drain_down_end_to_end(tiny_gpt):
         assert {"scale_up_begin", "scale_up", "scale_down_begin",
                 "scale_down"} <= ev, ev
         counter = registry().get(FLEET_SCALE_EVENTS)
-        assert counter.value({"direction": "up",
-                              "reason": "queue_wait_p99"}) == 1.0
+        # the flood breaches queue-wait OR ttft-headroom first depending
+        # on scheduling — either way it's exactly one up + one down
+        up = sum(counter.value({"direction": "up", "reason": r})
+                 for r in ("queue_wait_p99", "ttft_headroom", "shed"))
+        assert up == 1.0
         assert counter.value({"direction": "down", "reason": "idle"}) == 1.0
-        assert registry().get(FLEET_DESIRED).value() == 1.0
+        # the router shrinks when the drain completes; the desired
+        # gauge flushes on the autoscaler's next tick — wait for it
+        assert _wait(lambda: registry().get(FLEET_DESIRED).value() == 1.0,
+                     timeout=30)
         assert registry().get(FLEET_ALIVE).value() >= 1.0
         assert registry().get(FLEET_DRAINING) is not None
     finally:

@@ -1,0 +1,394 @@
+"""Traffic capture & deterministic replay tests
+(paddle_tpu/observability/capture.py + the gateway admission hook).
+
+The contract under test is docs/observability.md's "Traffic capture &
+replay" section: the bounded always-on recorder at gateway admission
+(every request captured, admitted OR shed, with tenant/priority
+attribution), the ``shape``/``full`` content modes (shape provably
+retains no token ids), the rotating JSONL spill, ``fit_params``/
+``fit_trace`` recovering a seeded trace's rate curve and length tails,
+the ``capture_tail`` incident-bundle section, the ``/debug/capture``
+and filtered ``/debug/requests`` HTTP surfaces, and — the acceptance
+shape — a mixed-tenant HTTP run captured in full mode and replayed
+through ``tools/replay_capture.to_trace`` + ``load_gen.replay_http``
+reproduces token-identical greedy and seed-exact sampled outputs at ONE
+decode signature.
+"""
+import http.client
+import json
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import build_gpt, gpt_config
+from paddle_tpu.observability import capture as capture_mod
+from paddle_tpu.observability import journey as journey_mod
+from paddle_tpu.observability import watchdog
+from paddle_tpu.observability.capture import (
+    TrafficCapture,
+    fit_params,
+    fit_trace,
+)
+from paddle_tpu.observability.slo import build_incident
+from paddle_tpu.serving import Engine, FleetSim, ScalePolicy
+from paddle_tpu.serving.gateway import (
+    AdmissionError,
+    Gateway,
+    TenantConfig,
+    parse_completion_request,
+    start_gateway,
+)
+from tools.load_gen import make_trace, replay_http
+from tools.replay_capture import load_file, to_trace
+
+
+@pytest.fixture(scope="module")
+def tiny_gpt():
+    cfg = gpt_config("gpt-tiny", max_position_embeddings=128,
+                     hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+    paddle.seed(7)
+    model = build_gpt(cfg)
+    model.eval()
+    return model, cfg
+
+
+def _get(port, path, timeout=60):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        r = conn.getresponse()
+        return r.status, r.read()
+    finally:
+        conn.close()
+
+
+def _post(port, payload, headers=None, timeout=300):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        hdrs = {"Content-Type": "application/json"}
+        hdrs.update(headers or {})
+        conn.request("POST", "/v1/completions",
+                     json.dumps(payload).encode(), hdrs)
+        r = conn.getresponse()
+        return r.status, dict(r.getheaders()), r.read()
+    finally:
+        conn.close()
+
+
+# -- recorder core ------------------------------------------------------------
+
+def test_ring_bound_and_dropped_accounting():
+    """The ring NEVER exceeds its cap; spill-less evictions count as
+    drops instead of blocking the recorder."""
+    cap = TrafficCapture(max_entries=8, mode="shape")
+    for i in range(30):
+        cap.record(tenant="t", priority="standard", outcome="admitted",
+                   prompt_len=4, max_tokens=2, t=float(i))
+    st = cap.stats()
+    assert st["entries"] == 8 and st["max_entries"] == 8
+    assert st["recorded"] == 30 and st["dropped"] == 22
+    # the survivors are the newest, oldest-first
+    ts = [e["t"] for e in cap.entries()]
+    assert ts == sorted(ts) and ts[0] == 22.0 and ts[-1] == 29.0
+    # filters compose with the tail limit
+    assert len(cap.entries(last=3)) == 3
+    assert cap.entries(tenant="nope") == []
+
+
+def test_shape_mode_stores_no_token_ids():
+    """Privacy contract: shape mode retains lengths + a hash, never the
+    ids — not in the ring, not in the tail, not in the JSON dump."""
+    cap = TrafficCapture(max_entries=8, mode="shape")
+    secret = [41, 42, 43, 44, 45]
+    e = cap.record(tenant="a", priority="standard", outcome="admitted",
+                   prompt=secret, max_tokens=2)
+    assert e["prompt_len"] == 5 and e["prompt_hash"]
+    dumped = json.dumps(cap.entries() + [cap.tail()])
+    assert "prompt_hash" in dumped
+    assert '"prompt"' not in dumped
+    # same content -> same hash, different content -> different hash
+    e2 = cap.record(tenant="a", priority="standard", outcome="admitted",
+                    prompt=list(secret), max_tokens=2)
+    e3 = cap.record(tenant="a", priority="standard", outcome="admitted",
+                    prompt=[1, 2, 3], max_tokens=2)
+    assert e2["prompt_hash"] == e["prompt_hash"] != e3["prompt_hash"]
+
+
+def test_full_mode_keeps_ids_but_tail_strips_them():
+    cap = TrafficCapture(max_entries=8, mode="full")
+    cap.record(tenant="a", priority="standard", outcome="admitted",
+               prompt=[7, 8, 9], max_tokens=2)
+    assert cap.entries()[0]["prompt"] == [7, 8, 9]
+    # incident bundles are always shape-view, whatever the mode
+    assert all("prompt" not in e for e in cap.tail()["entries"])
+
+
+def test_spill_rotation_and_round_trip(tmp_path):
+    """Everything recorded lands in the JSONL spill (rotation included)
+    and reads back through tools/replay_capture.load_file."""
+    d = str(tmp_path / "spill")
+    cap = TrafficCapture(max_entries=4, mode="shape", spill_dir=d,
+                         spill_max_bytes=600, spill_files=8)
+    for i in range(40):
+        cap.record(tenant="s", priority="standard", outcome="admitted",
+                   prompt_len=10 + i, max_tokens=3, t=float(i))
+    assert cap.flush(10.0)
+    cap.close()
+    st = cap.stats()
+    assert st["spill"]["spilled"] == 40
+    assert st["spill"]["rotations"] >= 1
+    assert st["dropped"] == 0           # spilled evictions are not drops
+    got = []
+    for p in sorted((tmp_path / "spill").iterdir()):
+        got.extend(load_file(str(p)))
+    assert len(got) == 40
+    assert sorted(e["t"] for e in got) == [float(i) for i in range(40)]
+    assert all(e["prompt_len"] == 10 + int(e["t"]) for e in got)
+
+
+# -- gateway admission hook ---------------------------------------------------
+
+def test_gateway_captures_admitted_and_shed(tiny_gpt):
+    """Every admission outcome lands one attributed entry: accepted,
+    tenant-cap rejections, and draining sheds — tenant + priority
+    resolved on all of them."""
+    model, _ = tiny_gpt
+    eng = Engine(model, max_slots=1, max_len=32, auto_start=False)
+    cap = TrafficCapture(max_entries=64, mode="shape")
+    gw = Gateway([eng], tenants=[
+        TenantConfig("acme", priority="interactive", max_queue=1)],
+        start=False, capture=cap)
+    try:
+        creq = parse_completion_request(
+            json.dumps({"prompt": [1, 2, 3], "max_tokens": 2,
+                        "temperature": 0.5, "top_k": 7, "seed": 11,
+                        "deadline_ms": 60000}).encode(),
+            has_tokenizer=False)
+        j = journey_mod.begin()
+        gw.admit(creq, "acme", journey=j)
+        # the engine never starts: the second enqueue overflows the cap
+        with pytest.raises(AdmissionError) as ei:
+            gw.admit(parse_completion_request(
+                json.dumps({"prompt": [4, 5], "max_tokens": 2}).encode(),
+                has_tokenizer=False), "acme")
+        assert ei.value.reason == "tenant_queue_full"
+        gw._drain_ev.set()
+        with pytest.raises(AdmissionError):
+            gw.admit(parse_completion_request(
+                json.dumps({"prompt": [6], "max_tokens": 1}).encode(),
+                has_tokenizer=False), "acme")
+    finally:
+        gw._drain_ev.clear()
+        gw.shutdown()
+        eng.shutdown()
+    es = cap.entries()
+    assert [e["outcome"] for e in es] == [
+        "admitted", "tenant_queue_full", "draining"]
+    admitted = es[0]
+    assert admitted["tenant"] == "acme"
+    assert admitted["priority"] == "interactive"
+    assert admitted["prompt_len"] == 3
+    assert admitted["temperature"] == 0.5 and admitted["top_k"] == 7 \
+        and admitted["seed"] == 11
+    assert admitted["deadline_s"] == pytest.approx(60.0)
+    assert admitted["journey_id"] == j.id
+    # shed entries carry attribution too (the whole point of capture:
+    # the postmortem sees WHO was shed, not just that sheds happened)
+    assert es[1]["tenant"] == "acme"
+    assert es[1]["priority"] == "interactive"
+    assert es[2]["outcome"] == "draining"
+
+
+def test_capture_tail_rides_incident_bundles(tiny_gpt):
+    """An explicit capture installs the watchdog section; bundles built
+    afterwards carry capture_tail whose journey ids resolve in the ring."""
+    model, _ = tiny_gpt
+    eng = Engine(model, max_slots=1, max_len=32, auto_start=False)
+    cap = TrafficCapture(max_entries=32, mode="full")
+    gw = Gateway([eng], start=False, capture=cap)
+    try:
+        j = journey_mod.begin()
+        gw.admit(parse_completion_request(
+            json.dumps({"prompt": [1, 2, 3], "max_tokens": 2}).encode(),
+            has_tokenizer=False), "acme", journey=j)
+        j.finish("ok")
+        bundle = build_incident(
+            {"objective": "o", "key": "", "rule": "fast", "t": 1.0,
+             "burn_fast": 2.0, "burn_slow": 1.0, "attainment": 0.5},
+            gateway=gw, window=gw.window)
+        tail = bundle["capture_tail"]
+        assert tail["entries"], "capture_tail empty"
+        entry = tail["entries"][-1]
+        assert entry["journey_id"] == j.id
+        assert journey_mod.get(entry["journey_id"]) is not None
+        # full-mode capture, but no prompt ids in the bundle
+        assert "prompt" not in entry
+        assert json.dumps(bundle)       # JSON-safe end to end
+    finally:
+        gw.shutdown()
+        eng.shutdown()
+        watchdog._sections.pop("capture_tail", None)
+
+
+# -- trace fitting ------------------------------------------------------------
+
+def test_fit_recovers_flash_window_and_length_tails():
+    """fit_params over a captured diurnal+flash make_trace run recovers
+    the flash window (within a bin), its depth, and the lognormal
+    sigmas; fit_trace's output reproduces them again (self-consistent)."""
+    src = make_trace(60.0, 4.0, seed=0, flash_at=0.25, flash_mult=6.0,
+                     flash_duration_s=10.0, prompt_sigma=0.8,
+                     out_sigma=0.7, deadline_s=2.0)
+    cap = TrafficCapture(max_entries=10_000, mode="shape")
+    for e in src:
+        cap.record(tenant="bench", priority="standard",
+                   outcome="admitted", prompt_len=e["prompt_len"],
+                   max_tokens=e["max_tokens"],
+                   deadline_s=e["deadline_s"], t=e["t"])
+    p = fit_params(cap.entries())
+    assert p["arrivals"] == len(src)
+    # flash truth: [15s, 25s) at 6x base
+    assert p["flash"] is not None
+    assert p["flash"]["t0"] == pytest.approx(15.0, abs=2 * p["bin_s"])
+    assert p["flash"]["t1"] == pytest.approx(25.0, abs=2 * p["bin_s"])
+    assert 3.0 <= p["flash"]["mult"] <= 12.0
+    assert p["base_qps"] == pytest.approx(4.0, rel=0.35)
+    # heavy-tail shape within tolerance of the seeded sigmas
+    assert p["prompt"]["sigma"] == pytest.approx(0.8, abs=0.15)
+    assert p["out"]["sigma"] == pytest.approx(0.7, abs=0.15)
+    assert p["tenants"] == {"bench": 1.0}
+    assert p["deadline_s"] == pytest.approx(2.0)
+
+    fitted = fit_trace(cap.entries(), seed=1, params=p)
+    assert len(fitted) == pytest.approx(len(src), rel=0.3)
+    assert all(set(e) >= {"t", "prompt_len", "max_tokens", "deadline_s",
+                          "tenant"} for e in fitted)
+    # the fitted trace carries the same flash: re-fitting it finds one
+    # overlapping the first fit's window
+    p2 = fit_params(fitted)
+    assert p2["flash"] is not None
+    assert p2["flash"]["t0"] < p["flash"]["t1"] \
+        and p2["flash"]["t1"] > p["flash"]["t0"]
+
+    # and FleetSim consumes it as-is (the ROADMAP 5a feed)
+    res = FleetSim(ScalePolicy(slo_ttft_s=0.6, up_ticks=1,
+                               cooldown_up_s=4.0),
+                   min_replicas=1, max_replicas=4, start_replicas=1,
+                   slots_per_replica=4, prefill_s=0.05, token_s=0.01,
+                   build_s=2.0, policy_poll_s=0.25,
+                   window_s=5.0).run(fitted)
+    assert res["arrivals"] == len(fitted)
+    assert res["peak_replicas"] >= 1
+
+
+def test_fit_needs_two_arrivals():
+    with pytest.raises(ValueError):
+        fit_params([{"t": 1.0, "prompt_len": 4, "max_tokens": 2,
+                     "tenant": "a"}])
+
+
+# -- HTTP surface + deterministic replay --------------------------------------
+
+def test_http_capture_replay_roundtrip(tiny_gpt):
+    """The acceptance shape: a seeded mixed-tenant HTTP run captured in
+    full mode, pulled from /debug/capture, filtered through
+    replay_capture.to_trace and re-driven by load_gen.replay_http is
+    deterministic — greedy requests token-identical, sampled requests
+    seed-exact — while decode stays ONE compiled program."""
+    model, _ = tiny_gpt
+    eng = Engine(model, max_slots=2, max_len=48, max_queue=16)
+    rs = np.random.RandomState(3)
+    with start_gateway([eng], own_engines=True,
+                       tenants=[TenantConfig("acme",
+                                             priority="interactive"),
+                                TenantConfig("bulk", priority="batch")],
+                       capture_mode="full",
+                       capture_entries=256) as stack:
+        port = stack.port
+        sent = {}
+        for i in range(8):
+            tenant = "acme" if i % 2 else "bulk"
+            payload = {"prompt": [int(x) for x in
+                                  rs.randint(1, 50, 4 + i % 3)],
+                       "max_tokens": 3}
+            if i >= 4:                  # sampled half: seeded
+                payload.update(temperature=0.8, top_k=5, seed=100 + i)
+            status, hdrs, raw = _post(port, payload,
+                                      {"X-Tenant": tenant})
+            assert status == 200, raw
+            jid = hdrs.get("X-Request-Id")
+            sent[jid] = json.loads(raw)["choices"][0]["token_ids"]
+
+        status, raw = _get(port, "/debug/capture?last=100")
+        assert status == 200
+        dump = json.loads(raw)
+        assert dump["mode"] == "full"
+        window = dump["window"]
+        assert len(window) == 8
+        assert all(e["outcome"] == "admitted" for e in window)
+        assert {e["tenant"] for e in window} == {"acme", "bulk"}
+        # full mode: exact ids ride the wire dump
+        assert all(isinstance(e["prompt"], list) for e in window)
+
+        # tenant filter on the capture ring
+        status, raw = _get(port, "/debug/capture?tenant=acme")
+        acme = json.loads(raw)["window"]
+        assert acme and all(e["tenant"] == "acme" for e in acme)
+
+        # single-request replay: one captured id, re-driven exactly
+        one_jid = window[-1]["journey_id"]
+        tr1 = to_trace(window, request_id=one_jid)
+        assert len(tr1) == 1 and tr1[0]["t"] == 0.0
+        s1 = replay_http(f"http://127.0.0.1:{port}", tr1,
+                         collect_tokens=True, speed=100.0)
+        assert s1["completed"] == 1
+        assert s1["results"][0]["token_ids"] == sent[one_jid]
+
+        # whole-window replay at 20x: every request deterministic
+        trace = to_trace(window, admitted_only=True)
+        summary = replay_http(f"http://127.0.0.1:{port}", trace,
+                              collect_tokens=True, speed=20.0)
+        assert summary["completed"] == 8 and summary["errors"] == 0
+        for entry, res in zip(trace, summary["results"]):
+            assert res["token_ids"] == sent[entry["journey_id"]], \
+                (entry["journey_id"], entry["temperature"])
+
+        # journey ring filters (satellite: /debug/requests?tenant=&
+        # outcome=) — the capture's journey ids resolve through them
+        status, raw = _get(port,
+                           "/debug/requests?tenant=acme&last=100")
+        assert status == 200
+        reqs = json.loads(raw)["requests"]
+        assert reqs and all(
+            r["attrs"]["tenant"] == "acme" for r in reqs)
+        status, raw = _get(port, "/debug/requests?outcome=ok&last=4")
+        oks = json.loads(raw)["requests"]
+        assert 0 < len(oks) <= 4
+        assert all(r["outcome"] == "ok" for r in oks)
+        status, raw = _get(port, "/debug/requests?tenant=nobody")
+        assert json.loads(raw)["requests"] == []
+
+        # capture never blocked admission into a second compile
+        assert eng.compile_stats()["decode_compiles"] == 1
+    watchdog._sections.pop("capture_tail", None)
+
+
+def test_metrics_count_entries_and_drops():
+    from paddle_tpu.observability import registry
+    reg = registry()
+    reg.reset()
+    cap = TrafficCapture(max_entries=2, mode="shape")
+    for i in range(5):
+        cap.record(tenant="m", priority="standard", outcome="admitted",
+                   prompt_len=1, max_tokens=1, t=float(i))
+    cap.record(tenant="m", priority="standard", outcome="slo_shed",
+               prompt_len=1, max_tokens=1, t=9.0)
+    counters = reg.dump()["counters"]
+    entries = {tuple(sorted(s["labels"].items())): s["value"]
+               for s in counters[capture_mod.CAPTURE_ENTRIES]}
+    assert entries[(("outcome", "admitted"),)] == 5.0
+    assert entries[(("outcome", "slo_shed"),)] == 1.0
+    dropped = counters[capture_mod.CAPTURE_DROPPED]
+    assert dropped[0]["value"] == 4.0

@@ -344,6 +344,23 @@ def test_http_journey_end_to_end(tiny_gpt):
         conn.close()
         assert r.status == 404
 
+        # ?tenant= / ?outcome= filters run over the WHOLE ring before
+        # the last-N tail (ISSUE 17: a busy multi-tenant ring must stay
+        # navigable), and compose with each other
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        conn.request("GET", "/debug/requests?tenant=t&outcome=ok&last=50")
+        filtered = json.loads(conn.getresponse().read())["requests"]
+        conn.close()
+        assert {t["id"] for t in filtered} >= {"e2e-blocking",
+                                               "e2e-stream"}
+        assert all(t["attrs"]["tenant"] == "t" and t["outcome"] == "ok"
+                   for t in filtered)
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        conn.request("GET", "/debug/requests?tenant=nobody")
+        empty = json.loads(conn.getresponse().read())["requests"]
+        conn.close()
+        assert empty == []
+
         # window feed agrees with the per-request timelines, and the
         # gauges export through /metrics
         stats = stack.gateway.window_stats()

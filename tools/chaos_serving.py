@@ -516,15 +516,42 @@ def main() -> int:
                 f"{slo_eng.firing()}"
             time.sleep(0.1)
         incidents = slo_eng.store.list()
+        from tools.incident_report import render
         for m in incidents:
             b = slo_eng.store.get(m["id"])
             assert b is not None and b["schema"] == INCIDENT_SCHEMA, m
             for key in ("incident", "window", "flight_events"):
                 assert key in b, (m["id"], key)
             assert b["incident"]["objective"] == "chaos-availability", b
+            # traffic capture under chaos (ISSUE 17): every bundle cut
+            # mid-kill carries the capture tail — the arrivals that
+            # caused the burn, admitted AND shed, privacy-safe (no
+            # prompt ids even if a gateway ran full-mode) and each
+            # journey id resolving over the wire
+            tail = b.get("capture_tail")
+            assert tail and isinstance(tail["entries"], list), (m, tail)
+            assert tail["entries"], f"empty capture tail in {m['id']}"
+            assert all("prompt" not in e for e in tail["entries"]), \
+                "prompt ids leaked into an incident bundle"
+            for e in tail["entries"][-3:]:
+                if not e["journey_id"]:
+                    continue
+                conn = http.client.HTTPConnection("127.0.0.1", port,
+                                                  timeout=60)
+                conn.request("GET", f"/debug/requests/{e['journey_id']}")
+                r = conn.getresponse()
+                r.read()
+                conn.close()
+                assert r.status == 200, \
+                    f"capture_tail journey {e['journey_id']} unresolvable"
+            assert "-- capture tail" in render(b), "renderer dropped tail"
+        cap_stats = stack.gateway.capture.stats()
+        assert cap_stats["entries"] <= cap_stats["max_entries"], cap_stats
         slo_summary = {
             "slo_alert_transitions": len(flight.events("alert")),
             "slo_incidents": len(incidents),
+            "captured_arrivals": cap_stats["recorded"],
+            "capture_dropped": cap_stats["dropped"],
         }
 
         summary = {

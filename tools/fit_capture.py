@@ -1,0 +1,66 @@
+"""Fit a synthetic trace to captured gateway traffic.
+
+Reads a traffic capture (a live gateway's ``/debug/capture``, a saved
+dump, or a JSONL spill) and estimates the traffic model behind it —
+windowed arrival-rate curve, flash window, lognormal prompt/output
+length parameters, tenant mix — via ``capture.fit_params``.  With
+``--out`` it also writes the ``capture.fit_trace`` synthetic trace,
+which is ``make_trace``-compatible: feed it to
+``paddle_tpu.serving.FleetSim`` for autoscale policy tuning on measured
+traffic, or back through ``tools/load_gen.py --trace`` for live load.
+
+    # print the fitted parameters of a gateway's recent traffic
+    python tools/fit_capture.py --url http://127.0.0.1:PORT
+
+    # fit a saved capture and emit a replayable synthetic trace
+    python tools/fit_capture.py --file capture.jsonl \
+        --out fitted_trace.json --seed 1
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from paddle_tpu.observability.capture import (  # noqa: E402
+    fit_params, fit_trace)
+from tools.replay_capture import fetch_capture, load_file  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument("--url", default=None,
+                     help="gateway to pull the capture from")
+    src.add_argument("--file", default=None,
+                     help="saved capture dump / entry list / JSONL spill")
+    ap.add_argument("--tenant", default=None,
+                    help="fit only this tenant's entries")
+    ap.add_argument("--bin-s", type=float, default=None,
+                    help="rate-curve bin width (default: span/24)")
+    ap.add_argument("--out", default=None, metavar="FILE",
+                    help="also write a fitted synthetic trace here")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="seed for the fitted trace's arrivals/lengths")
+    args = ap.parse_args()
+    entries = (load_file(args.file) if args.file
+               else fetch_capture(args.url, tenant=args.tenant))
+    if args.tenant:
+        entries = [e for e in entries if e.get("tenant") == args.tenant]
+    params = fit_params(entries, bin_s=args.bin_s)
+    if args.out:
+        trace = fit_trace(entries, seed=args.seed, params=params)
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(trace, f)
+        print(f"# wrote {len(trace)} fitted arrivals to {args.out}",
+              file=sys.stderr)
+    print(json.dumps(params, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -125,6 +125,39 @@ def render(bundle: dict) -> str:
             out.append(f"  {evt.get('kind')}/{evt.get('event')}: "
                        + ", ".join(f"{k}={v}" for k, v in evt.items()
                                    if k not in ("kind", "event", "t")))
+
+    cap = bundle.get("capture_tail") or {}
+    entries = cap.get("entries") or []
+    if entries:
+        span = cap.get("window_s") or 0.0
+        out.append(f"\n-- capture tail ({len(entries)} arrivals over "
+                   f"{span:.1f}s, mode={cap.get('mode')}) --")
+        counts = cap.get("counts") or {}
+        t0 = entries[0].get("t", 0.0)
+        width = max(span, 1e-9)
+        blocks = "▁▂▃▄▅▆▇█"
+        for tenant in sorted(counts):
+            bins = [0] * 24
+            for e in entries:
+                if e.get("tenant") != tenant:
+                    continue
+                i = int((e.get("t", t0) - t0) / width * 24)
+                bins[min(23, max(0, i))] += 1
+            peak = max(bins) or 1
+            spark = "".join(
+                " " if not b else blocks[min(7, b * 8 // (peak + 1))]
+                for b in bins)
+            c = counts[tenant]
+            out.append(f"  {tenant or '(default)'}: |{spark}| "
+                       f"admitted={c.get('admitted', 0)} "
+                       f"shed={c.get('shed', 0)}")
+        sheds = [e for e in entries if e.get("outcome") != "admitted"]
+        for e in sheds[-4:]:
+            out.append(f"    shed {e.get('journey_id') or '?'}: "
+                       f"tenant={e.get('tenant')} "
+                       f"outcome={e.get('outcome')} "
+                       f"prompt_len={e.get('prompt_len')} "
+                       f"max_tokens={e.get('max_tokens')}")
     out.append("")
     return "\n".join(out)
 

@@ -105,24 +105,45 @@ def make_trace(duration_s: float = 60.0, base_qps: float = 4.0,
 
 def replay_http(url: str, trace, *, vocab: int = 1000, seed: int = 0,
                 tenant: str = "load", timeout_s: float = 600.0,
-                max_in_flight: int = 256) -> dict:
+                max_in_flight: int = 256, speed: float = 1.0,
+                collect_tokens: bool = False) -> dict:
     """Replay a trace against a live gateway, wall-clock-faithful: each
     entry fires at its ``t`` offset (late dispatch is recorded, never
-    skipped).  Returns the client-side summary."""
+    skipped).  Returns the client-side summary.
+
+    Accepts both schemas: plain :func:`make_trace` output (synthetic
+    prompts are drawn from ``seed``/``vocab``, one ``tenant`` for the
+    whole run) AND the traffic-capture superset — per-entry ``prompt``
+    (exact token ids, full-mode capture), ``tenant``, ``priority``,
+    ``model``, ``temperature``/``top_k``/``seed``, so a captured window
+    replays with its original attribution and sampling.  ``speed``
+    compresses the inter-arrival clock (2.0 = twice as fast);
+    ``collect_tokens`` adds per-request ``results`` (trace order, with
+    the returned token ids) for determinism checks.
+    """
     from urllib.parse import urlparse
     u = urlparse(url)
     host, port = u.hostname, u.port
+    if speed <= 0:
+        raise ValueError("speed must be positive")
     rs = np.random.RandomState(seed)
-    prompts = [[int(x) for x in rs.randint(1, vocab, e["prompt_len"])]
+    # synthetic prompts draw from ONE stream in trace order, so a legacy
+    # make_trace replay keeps its exact historical prompt sequence; a
+    # captured entry's own ids always win
+    prompts = [e.get("prompt")
+               or [int(x) for x in rs.randint(1, vocab, e["prompt_len"])]
                for e in trace]
     out, lock = [], threading.Lock()
     gate = threading.Semaphore(max_in_flight)
 
-    def one(entry, prompt):
+    def one(i, entry, prompt):
         try:
             payload = {"prompt": prompt, "max_tokens": entry["max_tokens"]}
-            if "deadline_s" in entry:
+            if entry.get("deadline_s") is not None:
                 payload["deadline_ms"] = int(entry["deadline_s"] * 1e3)
+            for k in ("temperature", "top_k", "seed", "model", "priority"):
+                if entry.get(k) is not None:
+                    payload[k] = entry[k]
             conn = http.client.HTTPConnection(host, port,
                                               timeout=timeout_s)
             t0 = time.perf_counter()
@@ -130,32 +151,36 @@ def replay_http(url: str, trace, *, vocab: int = 1000, seed: int = 0,
                 conn.request(
                     "POST", "/v1/completions", json.dumps(payload).encode(),
                     {"Content-Type": "application/json",
-                     "X-Tenant": tenant})
+                     "X-Tenant": entry.get("tenant") or tenant})
                 r = conn.getresponse()
                 body = r.read()
                 ttft = time.perf_counter() - t0   # blocking: full wall
-                n_tok = (len(json.loads(body)["choices"][0]["token_ids"])
-                         if r.status == 200 else 0)
+                toks = (json.loads(body)["choices"][0]["token_ids"]
+                        if r.status == 200 else [])
+                rec = {"i": i, "status": r.status, "wall_s": ttft,
+                       "tokens": len(toks)}
+                if collect_tokens:
+                    rec["token_ids"] = [int(x) for x in toks]
                 with lock:
-                    out.append({"status": r.status, "wall_s": ttft,
-                                "tokens": n_tok})
+                    out.append(rec)
             finally:
                 conn.close()
         except Exception as e:  # noqa: BLE001 — count as a failed sample
             with lock:
-                out.append({"status": -1, "wall_s": None, "tokens": 0,
+                out.append({"i": i, "status": -1, "wall_s": None,
+                            "tokens": 0,
                             "error": f"{type(e).__name__}: {e}"})
         finally:
             gate.release()
 
     threads = []
     t_start = time.perf_counter()
-    for entry, prompt in zip(trace, prompts):
-        delay = entry["t"] - (time.perf_counter() - t_start)
+    for i, (entry, prompt) in enumerate(zip(trace, prompts)):
+        delay = entry["t"] / speed - (time.perf_counter() - t_start)
         if delay > 0:
             time.sleep(delay)
         gate.acquire()
-        th = threading.Thread(target=one, args=(entry, prompt))
+        th = threading.Thread(target=one, args=(i, entry, prompt))
         th.start()
         threads.append(th)
     for th in threads:
@@ -168,14 +193,17 @@ def replay_http(url: str, trace, *, vocab: int = 1000, seed: int = 0,
     errors = [o for o in out if o["status"] not in (200, 429)]
     pct = (lambda q: round(float(np.percentile(walls, q)) * 1e3, 1)
            if walls else None)
-    return {
+    summary = {
         "requests": len(trace), "completed": completed, "shed": shed,
         "errors": len(errors),
         "achieved_qps": round(completed / wall, 2) if wall else 0.0,
         "tokens": sum(o["tokens"] for o in out),
         "wall_ms": {"p50": pct(50), "p99": pct(99)},
-        "duration_s": round(wall, 2),
+        "duration_s": round(wall, 2), "speed": speed,
     }
+    if collect_tokens:
+        summary["results"] = sorted(out, key=lambda o: o["i"])
+    return summary
 
 
 def main() -> int:
@@ -196,19 +224,39 @@ def main() -> int:
     ap.add_argument("--deadline-s", type=float, default=None)
     ap.add_argument("--tenant", default="load")
     ap.add_argument("--vocab", type=int, default=1000)
+    ap.add_argument("--speed", type=float, default=1.0,
+                    help="time-compression factor (2.0 = replay at 2x)")
+    ap.add_argument("--trace", default=None, metavar="FILE",
+                    help="replay a saved trace/capture JSON (a list of "
+                    "entries, or a /debug/capture dump) instead of "
+                    "generating one")
     args = ap.parse_args()
-    trace = make_trace(
-        args.duration, args.qps, args.seed,
-        diurnal_amp=args.diurnal_amp, flash_at=args.flash_at,
-        flash_mult=args.flash_mult, flash_duration_s=args.flash_duration,
-        prompt_mean=args.prompt_mean, out_mean=args.out_mean,
-        prompt_max=args.prompt_max, out_max=args.out_max,
-        deadline_s=args.deadline_s)
-    print(f"# trace: {len(trace)} arrivals over {args.duration}s "
-          f"(flash x{args.flash_mult} at {args.flash_at:.0%})",
-          file=sys.stderr)
+    if args.trace:
+        with open(args.trace, encoding="utf-8") as f:
+            trace = json.load(f)
+        if isinstance(trace, dict):      # a /debug/capture dump
+            trace = trace.get("window", [])
+        if trace:                        # rebase: first arrival fires now
+            t0 = min(e["t"] for e in trace)
+            trace = [dict(e, t=round(e["t"] - t0, 4))
+                     for e in sorted(trace, key=lambda e: e["t"])]
+        print(f"# trace: {len(trace)} arrivals from {args.trace}",
+              file=sys.stderr)
+    else:
+        trace = make_trace(
+            args.duration, args.qps, args.seed,
+            diurnal_amp=args.diurnal_amp, flash_at=args.flash_at,
+            flash_mult=args.flash_mult,
+            flash_duration_s=args.flash_duration,
+            prompt_mean=args.prompt_mean, out_mean=args.out_mean,
+            prompt_max=args.prompt_max, out_max=args.out_max,
+            deadline_s=args.deadline_s)
+        print(f"# trace: {len(trace)} arrivals over {args.duration}s "
+              f"(flash x{args.flash_mult} at {args.flash_at:.0%})",
+              file=sys.stderr)
     summary = replay_http(args.url, trace, vocab=args.vocab,
-                          seed=args.seed, tenant=args.tenant)
+                          seed=args.seed, tenant=args.tenant,
+                          speed=args.speed)
     print(json.dumps(summary))
     return 0 if summary["errors"] == 0 else 1
 

@@ -1,0 +1,143 @@
+"""Deterministic replay of captured gateway traffic.
+
+Pull a window (or one request) out of a traffic capture — a live
+gateway's ``/debug/capture`` ring, a saved dump, or a JSONL spill file —
+and re-drive it against a gateway via ``load_gen.replay_http``,
+preserving inter-arrival times (compressible with ``--speed``),
+tenants, priorities, adapters and sampling seeds.  A full-mode capture
+carries exact prompt token ids, so a greedy request reproduces
+token-identical output and a sampled one is seed-exact (the engine's
+PRNG keys on (seed, position), not batch shape); a shape-mode capture
+replays with synthetic prompts of the captured lengths.
+
+    # replay the target gateway's own recent traffic, 4x compressed
+    python tools/replay_capture.py --url http://127.0.0.1:PORT --speed 4
+
+    # re-drive one captured request (by X-Request-Id / journey id)
+    python tools/replay_capture.py --url http://127.0.0.1:PORT \
+        --file capture.json --request-id 7f3a...
+
+    # replay a window captured on prod against a staging gateway
+    python tools/replay_capture.py --url http://staging:8000 \
+        --from http://prod:8000 --tenant acme --admitted-only
+"""
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from tools.load_gen import replay_http  # noqa: E402
+
+__all__ = ["fetch_capture", "load_file", "to_trace"]
+
+
+def fetch_capture(url: str, last: int = 10 ** 9,
+                  tenant: str | None = None) -> list:
+    """GET ``/debug/capture`` from a live gateway -> entry list."""
+    from urllib.parse import urlparse
+    u = urlparse(url)
+    q = f"/debug/capture?last={last}"
+    if tenant:
+        q += f"&tenant={tenant}"
+    conn = http.client.HTTPConnection(u.hostname, u.port, timeout=30)
+    try:
+        conn.request("GET", q)
+        r = conn.getresponse()
+        body = json.loads(r.read())
+        if r.status != 200:
+            raise RuntimeError(f"GET {q} -> {r.status}: {body}")
+    finally:
+        conn.close()
+    return body["window"]
+
+
+def load_file(path: str) -> list:
+    """Read a capture from disk: a ``/debug/capture`` dump, a bare entry
+    list, or a rotating JSONL spill file."""
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    try:
+        data = json.loads(text)
+    except json.JSONDecodeError:          # JSONL spill: one entry/line
+        return [json.loads(line) for line in text.splitlines()
+                if line.strip()]
+    if isinstance(data, dict):
+        return [data] if "t" in data else data.get("window", [])
+    return data
+
+
+def to_trace(entries, *, request_id: str | None = None,
+             tenant: str | None = None, last: int | None = None,
+             admitted_only: bool = False) -> list:
+    """Filter + order a capture into a replayable trace: sort by
+    arrival, rebase ``t`` so the first entry fires immediately."""
+    out = list(entries)
+    if request_id is not None:
+        out = [e for e in out if e.get("journey_id") == request_id]
+        if not out:
+            raise SystemExit(f"no captured entry with journey id "
+                             f"{request_id!r} ({len(entries)} entries)")
+    if tenant is not None:
+        out = [e for e in out if e.get("tenant") == tenant]
+    if admitted_only:
+        out = [e for e in out if e.get("outcome") == "admitted"]
+    out.sort(key=lambda e: e["t"])
+    if last is not None:
+        out = out[-max(0, int(last)):]
+    if not out:
+        raise SystemExit("capture window is empty after filtering")
+    t0 = out[0]["t"]
+    return [dict(e, t=round(e["t"] - t0, 4)) for e in out]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--url", required=True,
+                    help="target gateway to replay AGAINST")
+    src = ap.add_mutually_exclusive_group()
+    src.add_argument("--from", dest="src_url", default=None,
+                     help="source gateway to pull the capture FROM "
+                     "(default: the target's own ring)")
+    src.add_argument("--file", default=None,
+                     help="saved capture: /debug/capture dump, bare "
+                     "entry list, or JSONL spill")
+    ap.add_argument("--request-id", default=None,
+                    help="replay ONE captured request by journey id")
+    ap.add_argument("--tenant", default=None,
+                    help="replay only this tenant's entries")
+    ap.add_argument("--last", type=int, default=None,
+                    help="replay only the newest N entries (post-filter)")
+    ap.add_argument("--admitted-only", action="store_true",
+                    help="skip entries the source gateway shed")
+    ap.add_argument("--speed", type=float, default=1.0,
+                    help="time-compression factor (4.0 = 4x faster)")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="synthetic-prompt stream for shape-mode entries")
+    ap.add_argument("--vocab", type=int, default=1000)
+    args = ap.parse_args()
+    if args.file:
+        entries = load_file(args.file)
+    else:
+        entries = fetch_capture(args.src_url or args.url,
+                                tenant=args.tenant)
+    trace = to_trace(entries, request_id=args.request_id,
+                     tenant=args.tenant, last=args.last,
+                     admitted_only=args.admitted_only)
+    exact = sum(1 for e in trace if e.get("prompt"))
+    print(f"# replaying {len(trace)} captured arrivals over "
+          f"{trace[-1]['t']:.1f}s at {args.speed}x "
+          f"({exact} with exact prompt ids)", file=sys.stderr)
+    summary = replay_http(args.url, trace, vocab=args.vocab,
+                          seed=args.seed, speed=args.speed)
+    print(json.dumps(summary))
+    return 0 if summary["errors"] == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
