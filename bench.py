@@ -788,6 +788,7 @@ def bench_serving():
                 "token_s": float(np.percentile(toks, 50))}
     fast_path_block = _bench_fast_path(model, cfg, on_tpu)
     paged_block = _bench_paged_kv(model, cfg, on_tpu)
+    kv_tier_block = _bench_kv_tier(model, cfg, on_tpu)
     multi_lora_block = _bench_multi_lora(model, cfg, on_tpu)
     gateway_block = _bench_gateway_curve(cfg, on_tpu, measured)
     autoscale_block = _bench_autoscale_curve(measured)
@@ -822,6 +823,7 @@ def bench_serving():
                      "p99": round(float(np.percentile(toks, 99)) * 1e3, 3)},
         "fast_path": fast_path_block,
         "paged_kv": paged_block,
+        "kv_tier": kv_tier_block,
         "multi_lora": multi_lora_block,
         "gateway": gateway_block,
         "autoscale": autoscale_block,
@@ -977,6 +979,129 @@ def _bench_fast_path(model, cfg, on_tpu):
           f"match={int8_block['token_match_vs_float']}", file=sys.stderr)
     return {"prefix_cache": prefix_block_out, "speculative": spec_block,
             "kv_int8": int8_block}
+
+
+def _bench_kv_tier(model, cfg, on_tpu):
+    """KV tiering block (ISSUE 18): multi-turn conversations whose
+    turn-1 KV pages are EVICTED from the device pool before the warm
+    turn arrives.  The tiered engine (``host_prefix_mb=``) demotes the
+    victims to host DRAM and serves the warm turn via promote —
+    tail-prefill only; the untiered engine pays full re-prefill.
+    Reports warm-vs-cold admit->first-token, the host-tier hit rate and
+    promote p50, and GATES warm < cold (the whole point of the tier).
+    In ROADMAP's standing next-hardware-round list."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models import build_gpt, gpt_config
+    from paddle_tpu.serving import Engine
+
+    if on_tpu:
+        slots, max_len, turn, new, n_conv, num_pages, block = \
+            8, 640, 256, 32, 8, 192, 16
+    else:
+        # gpt-tiny prefill is dispatch-dominated on CPU (a 4-token tail
+        # costs the same as a 64-token prompt), which would make the
+        # warm-vs-cold gate meaningless — this block sizes the model up
+        # until COMPUTE dominates, the regime the tier exists for
+        cfg = gpt_config("gpt-tiny", hidden_size=512, num_layers=6,
+                         num_attention_heads=8,
+                         hidden_dropout_prob=0.0,
+                         attention_dropout_prob=0.0)
+        paddle.seed(0)
+        model = build_gpt(cfg)
+        model.eval()
+        slots, max_len, turn, new, n_conv, num_pages, block = \
+            2, 96, 56, 4, 4, 48, 4
+
+    rs = np.random.RandomState(19)
+    firsts = [rs.randint(0, cfg.vocab_size, turn).astype(np.int64)
+              for _ in range(n_conv)]
+    extras = [rs.randint(0, cfg.vocab_size, block).astype(np.int64)
+              for _ in range(n_conv)]
+
+    def run(tiered):
+        kw = {"host_prefix_mb": 64} if tiered else {}
+        eng = Engine(model, max_slots=slots, max_len=max_len,
+                     max_queue=4 * n_conv, prefix_cache=True,
+                     prefix_block=block, paged_kv=True,
+                     num_pages=num_pages, **kw)
+        try:
+            # warm the prefill buckets + decode compile out of the
+            # measured window
+            eng.submit(firsts[0][:turn // 2],
+                       max_new_tokens=2).result(timeout=600)
+            # turn 1 of every conversation, sequentially: each insert
+            # pressures the fixed pool, so early entries are evicted
+            # (tiered: demoted to host) before their warm turn returns
+            replies = [np.asarray(eng.submit(
+                p, max_new_tokens=new,
+                conversation=f"conv{i}").result(timeout=600))
+                for i, p in enumerate(firsts)]
+            if eng._host_tier is not None:
+                eng._host_tier.flush()
+            handles, outs = [], []
+            for i, (p, r, x) in enumerate(zip(firsts, replies, extras)):
+                warm = np.concatenate([p, r, x]).astype(np.int64)
+                h = eng.submit(warm, max_new_tokens=new,
+                               conversation=f"conv{i}")
+                outs.append(np.asarray(h.result(timeout=600)))
+                handles.append(h)
+            st = eng.stats()
+        finally:
+            eng.shutdown()
+        return handles, outs, st
+
+    h_cold, outs_cold, st_cold = run(tiered=False)
+    h_warm, outs_warm, st_warm = run(tiered=True)
+    for b, o in zip(outs_cold, outs_warm):   # the tier changes nothing
+        np.testing.assert_array_equal(b, o)
+    if st_warm["decode_compiles"] != 1:
+        raise RuntimeError(f"kv tier: promote retraced decode: {st_warm}")
+    promoted = [h for h in h_warm if h.promote_s is not None]
+    if not promoted:
+        raise RuntimeError(
+            f"kv tier: no warm turn was served via a host-tier promote "
+            f"(nothing evicted?): {st_warm}")
+    # cold reference: only TRUE re-prefills (a late conversation whose
+    # entry survived in the device index would pollute the baseline)
+    cold = [h for h in h_cold if not h.prefix_hit]
+    if not cold:
+        raise RuntimeError(
+            "kv tier: the untiered run never re-prefilled — the pool "
+            "never evicted, the comparison is void")
+
+    def admit_to_first(handles):
+        return [h.ttft_s - (h.t_admit - h.t_submit) for h in handles]
+
+    warm_p50 = float(np.percentile(admit_to_first(promoted), 50))
+    cold_p50 = float(np.percentile(admit_to_first(cold), 50))
+    if warm_p50 >= cold_p50:
+        raise RuntimeError(
+            f"kv tier: warm TTFT p50 ({warm_p50 * 1e3:.2f}ms) is not "
+            f"below cold re-prefill p50 ({cold_p50 * 1e3:.2f}ms)")
+    tier_st = st_warm["host_prefix"]
+    hit_rate = tier_st["hits"] / max(tier_st["hits"] +
+                                     tier_st["misses"], 1)
+    promote_p50 = float(np.percentile(
+        [h.promote_s for h in promoted], 50))
+    block_out = {
+        "conversations": n_conv,
+        "turn_tokens": turn,
+        "host_capacity_mb": 64,
+        "demotes": int(tier_st["demotes"]),
+        "host_hit_rate": round(hit_rate, 3),
+        "promotes": int(st_warm["host_prefix_promotes"]),
+        "promote_ms_p50": round(promote_p50 * 1e3, 3),
+        "warm_ttft_ms_p50": round(warm_p50 * 1e3, 2),
+        "cold_ttft_ms_p50": round(cold_p50 * 1e3, 2),
+        "ttft_delta_ms": round((cold_p50 - warm_p50) * 1e3, 2),
+        "decode_compiles": int(st_warm["decode_compiles"]),
+        "parity": "exact",
+    }
+    print(f"# kv-tier warm p50={block_out['warm_ttft_ms_p50']}ms "
+          f"cold p50={block_out['cold_ttft_ms_p50']}ms "
+          f"host hit_rate={block_out['host_hit_rate']} "
+          f"promote p50={block_out['promote_ms_p50']}ms", file=sys.stderr)
+    return block_out
 
 
 def _bench_multi_lora(model, cfg, on_tpu):

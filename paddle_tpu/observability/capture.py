@@ -39,7 +39,8 @@ us, and can we run it again?".  This module closes that loop:
 Entry schema (one JSON-safe dict per request)::
 
     {t, tenant, priority, model, prompt_len, prompt_hash, max_tokens,
-     deadline_s, temperature, top_k, seed, outcome, journey_id
+     deadline_s, temperature, top_k, seed, outcome, journey_id,
+     conversation                     # raw id full mode, hash in shape
      [, prompt]}                      # token ids, full mode only
 
 ``t`` is seconds since the capture epoch (monotonic clock), so a window
@@ -158,12 +159,22 @@ class TrafficCapture:
                max_tokens: int = 0, deadline_s: float | None = None,
                temperature: float = 0.0, top_k: int = 0, seed: int = 0,
                model: str | None = None, journey_id: str = "",
+               conversation: str | None = None,
                t: float | None = None) -> dict:
         """Append one entry; never blocks on disk, never raises into
         admission.  ``prompt`` is the token-id sequence when the caller
         has one (stored only in ``full`` mode); ``t`` overrides the
-        arrival offset for virtual-time feeds (bench/sim)."""
+        arrival offset for virtual-time feeds (bench/sim).
+        ``conversation`` gets the prompt's privacy treatment: the raw id
+        is stored only in ``full`` mode, ``shape`` mode keeps its hash —
+        warm-turn grouping stays analyzable, the identifier does not
+        leak."""
         ids = None if prompt is None else [int(x) for x in prompt]
+        conv = None
+        if conversation is not None:
+            conv = (str(conversation) if self.mode == "full" else
+                    hashlib.blake2b(str(conversation).encode("utf-8"),
+                                    digest_size=8).hexdigest())
         entry = {
             "t": round(time.perf_counter() - self._epoch
                        if t is None else float(t), 4),
@@ -180,6 +191,7 @@ class TrafficCapture:
             "seed": int(seed),
             "outcome": str(outcome),
             "journey_id": str(journey_id),
+            "conversation": conv,
         }
         if self.mode == "full" and ids is not None:
             entry["prompt"] = ids
@@ -299,15 +311,24 @@ class TrafficCapture:
 
     # -- query surfaces ------------------------------------------------------
     def entries(self, last: int | None = None, tenant: str | None = None,
-                outcome: str | None = None) -> list[dict]:
+                outcome: str | None = None,
+                conversation: str | None = None) -> list[dict]:
         """Snapshot of the ring, oldest first, optionally filtered by
-        tenant / outcome and tail-limited to ``last``."""
+        tenant / outcome / conversation and tail-limited to ``last``.
+        The ``conversation`` filter matches what was stored — the raw id
+        in ``full`` mode, its hash in ``shape`` mode — and accepts
+        either form (the raw id is re-hashed for the comparison)."""
         with self._lock:
             out = list(self._ring)
         if tenant is not None:
             out = [e for e in out if e["tenant"] == tenant]
         if outcome is not None:
             out = [e for e in out if e["outcome"] == outcome]
+        if conversation is not None:
+            want = {conversation,
+                    hashlib.blake2b(str(conversation).encode("utf-8"),
+                                    digest_size=8).hexdigest()}
+            out = [e for e in out if e.get("conversation") in want]
         if last is not None:
             out = out[-max(0, int(last)):]
         return [dict(e) for e in out]
@@ -329,13 +350,15 @@ class TrafficCapture:
             }
 
     def debug_state(self, last: int = 64, tenant: str | None = None,
-                    outcome: str | None = None) -> dict:
+                    outcome: str | None = None,
+                    conversation: str | None = None) -> dict:
         """The ``GET /debug/capture`` payload."""
         out = self.stats()
         out["filtered"] = {"last": last, "tenant": tenant,
-                          "outcome": outcome}
+                          "outcome": outcome, "conversation": conversation}
         out["window"] = self.entries(last=last, tenant=tenant,
-                                     outcome=outcome)
+                                     outcome=outcome,
+                                     conversation=conversation)
         return out
 
     def tail(self, n: int | None = None) -> dict:

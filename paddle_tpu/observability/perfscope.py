@@ -65,6 +65,10 @@ DEVICE_PROGRAM_MFU = "paddle_tpu_device_program_mfu"
 DEVICE_PROGRAM_BW_FRAC = "paddle_tpu_device_program_hbm_bw_frac"
 HBM_BYTES = "paddle_tpu_hbm_bytes"
 
+# ledger owners whose bytes live in host DRAM, not on the device: part of
+# the consolidated KV budget, excluded from the bytes_in_use reconciliation
+HOST_OWNERS = frozenset({"host_prefix"})
+
 _lock = threading.Lock()
 
 # sample every Nth dispatch per program; 0 = sampling off (the default:
@@ -457,6 +461,10 @@ def memory_report() -> dict:
     led = ledger()
     owners = led.owner_bytes()
     total = sum(owners.values())
+    # host-plane rows (the host prefix tier) live in the same ledger for
+    # one consolidated budget, but must not count against the device
+    # allocator when reconciling bytes_in_use
+    device_total = total - sum(owners.get(o, 0) for o in HOST_OWNERS)
     backend = {}
     try:
         from ..device.tpu import memory_stats
@@ -468,7 +476,7 @@ def memory_report() -> dict:
            "total_tracked": total, "backend": backend,
            "rows": led.rows()}
     if "bytes_in_use" in backend:
-        out["unattributed"] = int(backend["bytes_in_use"]) - total
+        out["unattributed"] = int(backend["bytes_in_use"]) - device_total
     return out
 
 

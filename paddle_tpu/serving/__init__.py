@@ -63,6 +63,7 @@ from .engine import (  # noqa: F401
     RequestHandle,
     RequestInterruptedError,
 )
+from .kv_tier import HostPrefixTier  # noqa: F401
 from .paged_kv import PageAllocator  # noqa: F401
 from .prefix_cache import PrefixEntry, PrefixIndex  # noqa: F401
 from .slot_pool import SlotPool  # noqa: F401
@@ -70,7 +71,7 @@ from .speculative import NgramDrafter  # noqa: F401
 from .supervisor import EngineSupervisor  # noqa: F401
 
 __all__ = ["Engine", "EngineSupervisor", "Autoscaler", "ScalePolicy",
-           "FleetSim", "RequestHandle", "SlotPool",
+           "FleetSim", "RequestHandle", "SlotPool", "HostPrefixTier",
            "PageAllocator", "PrefixIndex", "PrefixEntry", "NgramDrafter",
            "AdapterRegistry", "LoraAdapter", "make_lora", "AdapterError",
            "AdapterShapeError", "AdapterRankError", "UnknownAdapterError",

@@ -89,8 +89,9 @@ from ..observability import steps as _steps
 from ..observability import watchdog as _watchdog
 from ..observability.retrace import instrument_jit
 from ..testing import faults
+from .kv_tier import HostPrefixTier
 from .paged_kv import PageAllocator
-from .prefix_cache import PrefixIndex
+from .prefix_cache import PrefixEntry, PrefixIndex
 from .slot_pool import SlotPool
 from .speculative import NgramDrafter
 
@@ -127,6 +128,11 @@ SERVING_ADAPTER_LOADS = "paddle_tpu_serving_adapter_loads_total"
 SERVING_ADAPTER_EVICTIONS = "paddle_tpu_serving_adapter_evictions_total"
 SERVING_ADAPTER_STALLS = "paddle_tpu_serving_adapter_load_stalls_total"
 SERVING_WEIGHT_BYTES = "paddle_tpu_serving_weight_bytes"
+SERVING_HOST_PREFIX_HITS = "paddle_tpu_serving_host_prefix_hits_total"
+SERVING_HOST_PREFIX_PROMOTES = \
+    "paddle_tpu_serving_host_prefix_promotes_total"
+SERVING_HOST_PREFIX_PROMOTE_SECONDS = \
+    "paddle_tpu_serving_host_prefix_promote_seconds"
 
 
 class QueueFullError(RuntimeError):
@@ -198,10 +204,11 @@ class RequestHandle:
 
     def __init__(self, engine, prompt, max_new_tokens, eos_token_id,
                  temperature, top_k, seed, deadline_s, stream,
-                 adapter=None, journey=None):
+                 adapter=None, journey=None, conversation=None):
         self.request_id = next(_ids)
         self.redispatches = 0        # times re-enqueued after an engine death
         self.adapter = adapter       # LoRA adapter name (None = base model)
+        self.conversation = conversation  # prefix-index namespace qualifier
         self.journey = journey       # observability.journey.Journey or None
         self._adapter_slot = 0       # bank row while active (0 = zero adapter)
         self._adapter_pinned = False
@@ -225,6 +232,7 @@ class RequestHandle:
         self._prefix_match = 0            # tokens covered by that copy
         self._pages: Optional[list] = None    # paged mode: backing pages
         self._cow = None                  # pending (src, dst) page COW copy
+        self._promote = None              # pending (host entry, match) upload
         now = time.perf_counter()
         self.t_submit = now
         self.t_queue = now           # engine-queue entry (reset on resubmit)
@@ -234,6 +242,7 @@ class RequestHandle:
         self._t_last_token = now
         self.ttft_s: Optional[float] = None
         self.prefix_hit = False           # admitted via a prefix-cache copy
+        self.promote_s: Optional[float] = None  # host-tier promote wall s
         self.token_latencies_s: list[float] = []
         self.deadline = None if deadline_s is None else now + float(deadline_s)
 
@@ -460,7 +469,9 @@ class Engine:
                  num_pages: Optional[int] = None,
                  max_pages_per_slot: Optional[int] = None,
                  adapters=None,
-                 weight_dtype: Optional[str] = None):
+                 weight_dtype: Optional[str] = None,
+                 host_prefix_mb: Optional[float] = None,
+                 host_prefix=None):
         self.model = model
         self.tokenizer = tokenizer
         self.max_slots = int(max_slots)
@@ -563,6 +574,33 @@ class Engine:
         else:
             self._limit = self.max_len
 
+        # -- host-DRAM prefix tier (kv_tier.py; docs/serving.md "KV
+        # tiering & conversations"): strictly opt-in.  host_prefix_mb=
+        # builds an engine-OWNED tier (closed by shutdown);
+        # host_prefix= shares a pre-built tier across supervisor
+        # rebuilds / replicas (never closed by this engine) ---------------
+        self._host_tier = None
+        self._own_host_tier = False
+        if host_prefix is not None and host_prefix_mb is not None:
+            raise ValueError(
+                "pass host_prefix_mb= (engine-owned tier) OR host_prefix= "
+                "(shared tier), not both")
+        if host_prefix is not None or host_prefix_mb is not None:
+            if not (self.paged_kv and self._prefix is not None):
+                raise ValueError("the host prefix tier requires "
+                                 "paged_kv=True and prefix_cache=True")
+            if host_prefix is not None:
+                if host_prefix.block != self._prefix.block:
+                    raise ValueError(
+                        f"host tier block={host_prefix.block} does not "
+                        f"match prefix_block={self._prefix.block}")
+                self._host_tier = host_prefix
+            else:
+                self._host_tier = HostPrefixTier(
+                    capacity_mb=float(host_prefix_mb),
+                    block=self._prefix.block)
+                self._own_host_tier = True
+
         self._pool = SlotPool(self.max_slots)
         self._queue: deque = deque()
         self._lock = threading.Lock()
@@ -612,7 +650,8 @@ class Engine:
                         "spec_accepted": 0, "page_cow_copies": 0,
                         "page_alloc_stalls": 0, "adapter_hits": 0,
                         "adapter_loads": 0, "adapter_evictions": 0,
-                        "adapter_load_stalls": 0}
+                        "adapter_load_stalls": 0, "host_prefix_hits": 0,
+                        "host_prefix_promotes": 0}
         self._active_pages = 0     # pages referenced by in-flight requests
         self._cached_pages = 0     # pages referenced by prefix entries
         self._page_stalled = False
@@ -637,7 +676,8 @@ class Engine:
                deadline_s: Optional[float] = None,
                stream: Optional[Callable[[int], None]] = None,
                adapter: Optional[str] = None,
-               journey=None) -> RequestHandle:
+               journey=None,
+               conversation: Optional[str] = None) -> RequestHandle:
         """Queue one request; returns a Future-style handle.  Raises
         :class:`QueueFullError` when the bounded admission queue is at
         capacity (backpressure: the caller sheds load or retries) and
@@ -649,7 +689,11 @@ class Engine:
         the engine appends its phase records to (engine queue wait,
         adapter/page stalls, prefill, each decode dispatch) — the
         request-scoped trace context the gateway threads through the
-        whole serving path (docs/observability.md "Request journeys")."""
+        whole serving path (docs/observability.md "Request journeys").
+        ``conversation`` qualifies the prefix-cache namespace to
+        ``(adapter, conversation)`` — turn N+1 of the same conversation
+        re-uses turn N's cached KV and pays tail-prefill only
+        (docs/serving.md "KV tiering & conversations")."""
         # lock-free monitor-flag reads: _dead/_stop/_draining make single
         # benign transitions; at worst a racing submit lands one sweep
         # late and fails through the death classification instead
@@ -699,7 +743,8 @@ class Engine:
         eos = self.eos_token_id if eos_token_id is ... else eos_token_id
         req = RequestHandle(self, ids, max_new_tokens, eos, temperature,
                             top_k, seed, deadline_s, stream,
-                            adapter=adapter, journey=journey)
+                            adapter=adapter, journey=journey,
+                            conversation=conversation)
         hook = self.admission_hook
         if hook is not None:
             try:
@@ -769,7 +814,8 @@ class Engine:
         req._prefix_match = 0
         req._pages = None
         req._cow = None
-        req.prefix_hit = False
+        req._promote = None     # promote refs die with the dead engine's
+        req.prefix_hit = False  # admission (_release_pages_locked)
         req._adapter_slot = 0    # the dead engine's banks (and pins) died
         req._adapter_pinned = False
         req.redispatches += 1
@@ -911,6 +957,11 @@ class Engine:
         # the chaos lane asserts zero after the kill matrix)
         for row in ledger_rows:
             row.release()
+        # an engine-OWNED host tier dies with the engine; a SHARED tier
+        # (host_prefix=) outlives it on purpose — that is the rebuild /
+        # replica survival story, and whoever built it closes it
+        if self._own_host_tier and self._host_tier is not None:
+            self._host_tier.close()
         _steps.record_memory_stats()
         for req in pending:
             req._finish(err)
@@ -984,6 +1035,8 @@ class Engine:
                 out["kv_pages_used"] = self._page_alloc.n_used
                 out["kv_pages_active"] = self._active_pages
                 out["kv_pages_cached"] = self._cached_pages
+        if self._host_tier is not None:
+            out["host_prefix"] = self._host_tier.stats()
         out.update(self.compile_stats())
         return out
 
@@ -1742,6 +1795,34 @@ class Engine:
             req._adapter_pinned = False
         req._adapter_slot = 0
 
+    def _req_ns(self, req: RequestHandle):
+        """Prefix-index namespace for one request: the adapter alone, or
+        ``(adapter, conversation)`` when the request carries a
+        conversation id — each conversation owns its cached turns, so a
+        returning user's turn N+1 hits turn N's KV and nobody else's."""
+        return (req.adapter if req.conversation is None
+                else (req.adapter, req.conversation))
+
+    def _demote_locked(self, e):
+        """Hand an evicted prefix entry's page bytes to the host tier.
+
+        The gather (``pool[pages]`` per layer per pool group) is EAGER
+        and runs here, under the lock, BEFORE the pages are deref'd:
+        the engine's jits donate the pools operand on device, so a raw
+        ``self._pools`` snapshot is invalidated by the very next
+        dispatch — fresh gathered arrays are the only thing the spill
+        worker can safely ``device_get`` later, off this hot path."""
+        if self._pools is None or not e.pages:
+            return
+        try:
+            import jax.numpy as jnp
+            idx = jnp.asarray(np.asarray(e.pages, np.int32))
+            gathered = [[pool[idx] for pool in grp]
+                        for grp in self._pools]
+        except Exception:  # noqa: BLE001 — a dying device must not
+            return         # turn an eviction into an engine failure
+        self._host_tier.demote_async(e.ns, e.tokens, gathered)
+
     def _admit_dense_locked(self):
         """Dense-pool admission: head-of-queue requests admit while a
         free slot AND (when they name one) a pinnable adapter bank row
@@ -1763,7 +1844,7 @@ class Engine:
             protect = set()
             for req in itertools.islice(self._queue, want):
                 hit = self._prefix.lookup(req.prompt, peek=True,
-                                          ns=req.adapter)
+                                          ns=self._req_ns(req))
                 if hit is not None:
                     protect.add(id(hit[0]))
             for e in self._prefix.evict_lru(want - self._pool.n_free,
@@ -1784,7 +1865,8 @@ class Engine:
             req.t_admit = time.perf_counter()
             self._journey_admit_locked(req, slot=req.slot)
             if self._prefix is not None:
-                hit = self._prefix.lookup(req.prompt, ns=req.adapter)
+                hit = self._prefix.lookup(req.prompt,
+                                          ns=self._req_ns(req))
                 if hit is not None:
                     entry, matched = hit
                     self._prefix.acquire(entry)
@@ -1821,7 +1903,7 @@ class Engine:
         if self._prefix is not None:
             for req in itertools.islice(self._queue, want):
                 hit = self._prefix.lookup(req.prompt, peek=True,
-                                          ns=req.adapter)
+                                          ns=self._req_ns(req))
                 if hit is not None:
                     protect.add(id(hit[0]))
         batch = []
@@ -1831,8 +1913,17 @@ class Engine:
                 break                # HOL backpressure: bank fully pinned
             total = self._pages_for(req.prompt.size + req.max_new_tokens)
             hit = (self._prefix.lookup(req.prompt, peek=True,
-                                       ns=req.adapter)
+                                       ns=self._req_ns(req))
                    if self._prefix is not None else None)
+            # an HBM miss probes the host tier (kv_tier.py): a host hit
+            # still allocates the FULL reservation — the promoted prefix
+            # uploads into this request's own fresh pages
+            # (_flush_promotes), then shares them back into the device
+            # index, so `need` stays `total` here
+            promote = (self._host_tier.lookup(req.prompt, peek=True,
+                                              ns=self._req_ns(req))
+                       if hit is None and self._host_tier is not None
+                       else None)
             # fully-matched pages are shared by reference; a partial
             # boundary page (match not page-aligned) is replaced by a
             # one-page COW copy, so its replacement stays in `need`
@@ -1840,11 +1931,14 @@ class Engine:
             need = total - shared_full
             while (need > alloc.n_free and self._prefix is not None):
                 # reclaim pages from unreferenced LRU entries, sparing
-                # the ones this wave is about to hit
+                # the ones this wave is about to hit; with a host tier
+                # attached the victim's bytes demote instead of dying
                 victims = self._prefix.evict_lru(1, protect=protect)
                 if not victims:
                     break
                 e = victims[0]
+                if self._host_tier is not None and e.pages:
+                    self._demote_locked(e)
                 for p in e.pages:
                     alloc.deref(p)
                 self._cached_pages -= len(e.pages)
@@ -1885,9 +1979,23 @@ class Engine:
                 req._prefix_match = matched
                 req.prefix_hit = True
                 self._counts["prefix_hits"] += 1
+            elif promote is not None:
+                # HBM miss, host hit: still a device-index miss (both
+                # counters tell the truth), but the upload in
+                # _flush_promotes turns it into a normal zero-copy hit
+                # before prefill — tail-only from there on
+                hentry, matched = promote
+                self._host_tier.touch(hentry)  # count the peeked hit
+                self._host_tier.acquire(hentry)   # un-droppable mid-flight
+                req._promote = (hentry, matched)
+                self._counts["host_prefix_hits"] += 1
+                self._prefix.miss()
+                self._counts["prefix_misses"] += 1
             elif self._prefix is not None:
                 self._prefix.miss()
                 self._counts["prefix_misses"] += 1
+                if self._host_tier is not None:
+                    self._host_tier.miss()     # missed BOTH tiers
             self._map_pages_locked(req, pages)
             batch.append(req)
         return batch, evicted
@@ -1947,6 +2055,7 @@ class Engine:
                     # build — attribute it, don't leave a mystery gap
                     req.journey.phase("build", t_b0, dt_b)
         self._flush_adapter_uploads(batch)
+        self._flush_promotes(batch)
         if evicted:
             registry().counter(
                 SERVING_PREFIX_EVICTIONS,
@@ -2023,6 +2132,104 @@ class Engine:
                 if req.adapter == name and req.journey is not None:
                     req.journey.phase("adapter_load", t0, dt, adapter=name,
                                       bank_slot=slot)
+
+    def _flush_promotes(self, batch=()):
+        """Host-tier promotion: upload each promoted request's cached
+        prefix bytes into the fresh device pages admission reserved for
+        it, then re-insert the prefix into the device index so the NEXT
+        turn hits in HBM directly.
+
+        Runs on the scheduler thread after ``_build`` (the pools exist)
+        and before prefill partitioning — a promoted request leaves here
+        as a normal zero-copy hit (``_prefix_src`` set, tail-prefill
+        only).  The writes are EAGER ``.at[pages].set`` updates per pool
+        per layer, never a jitted entry point, so the decode signature
+        count stays at ONE; the page bytes land verbatim (int8 payload +
+        f32 scales), so greedy output is bitwise-identical to a
+        never-evicted hit.  The upload runs OFF-lock (device work);
+        the mapping is re-checked under the lock first in case the
+        engine shut down while this wave was in flight."""
+        if self._host_tier is None:
+            return
+        import jax.numpy as jnp
+        tier = self._host_tier
+        todo = []
+        with self._lock:
+            for req in batch:
+                if req._promote is None:
+                    continue
+                hentry, m = req._promote
+                if req._pages is None or req.slot is None:
+                    req._promote = None
+                    tier.release(hentry)
+                    continue
+                todo.append((req, hentry, m))
+        P = self._page_alloc.page_size
+        for req, hentry, m in todo:
+            q = -(-m // P)                       # ceil: pages holding m
+            pids = req._pages[:q]
+            t0 = time.perf_counter()
+            try:
+                payload = tier.payload(hentry, q)
+            except KeyError:
+                # the entry vanished under us (tier closed externally):
+                # the request still holds its full reservation — fall
+                # back to a plain cold prefill, never an engine death
+                with self._lock:
+                    req._promote = None
+                    tier.release(hentry)
+                continue
+            idx = jnp.asarray(np.asarray(pids, np.int32))
+            self._pools = tuple(
+                [pool.at[idx].set(jnp.asarray(arr, pool.dtype))
+                 for pool, arr in zip(grp, host_grp)]
+                for grp, host_grp in zip(self._pools, payload))
+            dt = time.perf_counter() - t0
+            nbytes = sum(a.nbytes for g in payload for a in g)
+            with self._lock:
+                req._promote = None
+                entry = self._prefix.insert(None, hentry.tokens[:m],
+                                            pages=list(pids),
+                                            ns=hentry.ns)
+                if entry is not None:
+                    # the index and this request each hold a page ref
+                    for p in pids:
+                        self._page_alloc.share(p)
+                    self._cached_pages += q
+                else:
+                    # pathological duplicate (an unaddressable entry
+                    # already owns (ns, tokens[:m])): ride the hit path
+                    # on a DETACHED entry — not in the index, no page
+                    # sharing; release just decrements its refs
+                    entry = PrefixEntry(None, hentry.tokens[:m], 0,
+                                        pages=None, ns=hentry.ns)
+                self._prefix.acquire(entry)
+                req._prefix_src = entry
+                req._prefix_match = m
+                req._cow = None                  # page-aligned by block
+                req.prefix_hit = True
+                req.promote_s = dt
+                self._counts["host_prefix_promotes"] += 1
+                tier.release(hentry)
+            reg = registry()
+            reg.counter(
+                SERVING_HOST_PREFIX_HITS,
+                "admissions whose prefix was found in the host tier").inc(
+                1.0)
+            reg.counter(
+                SERVING_HOST_PREFIX_PROMOTES,
+                "host-tier prefixes re-uploaded into device pages").inc(1.0)
+            reg.histogram(
+                SERVING_HOST_PREFIX_PROMOTE_SECONDS,
+                "host->device promote wall seconds (upload + re-index)"
+            ).observe(dt)
+            flight.record("serving", "host_prefix_promote",
+                          request=req.request_id, cached_tokens=m,
+                          pages=q, bytes=nbytes,
+                          promote_ms=round(dt * 1e3, 3))
+            if req.journey is not None:
+                req.journey.phase("prefix_promote", t0, dt,
+                                  cached_tokens=m, pages=q, bytes=nbytes)
 
     def _load_adapter_bank(self, slot: int, adapter):
         """Write one adapter's factors (zero-padded to the bank's
@@ -2444,6 +2651,12 @@ class Engine:
     def _release_pages_locked(self, req: RequestHandle):
         """Drop the request's page references (freed at refcount 0) and
         sentinel its table row.  No-op outside paged mode."""
+        if req._promote is not None and self._host_tier is not None:
+            # a pending promote dies with the admission (shutdown /
+            # engine death before _flush_promotes ran): drop the tier
+            # pin so the entry becomes LRU-droppable again
+            self._host_tier.release(req._promote[0])
+            req._promote = None
         if not self.paged_kv or req._pages is None:
             return
         for p in req._pages:
@@ -2476,7 +2689,7 @@ class Engine:
                 keep = self._pages_for(n) if n > 0 else 0
                 entry = (self._prefix.insert(
                     None, cached, pages=req._pages[:keep],
-                    ns=req.adapter)
+                    ns=self._req_ns(req))
                     if keep > 0 else None)
                 if entry is not None:
                     for p in req._pages[keep:]:
@@ -2489,7 +2702,8 @@ class Engine:
                                   request=req.request_id, cached_tokens=n)
                     retained = True
             else:
-                entry = (self._prefix.insert(slot, cached, ns=req.adapter)
+                entry = (self._prefix.insert(slot, cached,
+                                             ns=self._req_ns(req))
                          if n > 0 else None)
                 if entry is not None:
                     self._pool.retain(slot, entry)

@@ -360,6 +360,7 @@ class Gateway:
                 max_tokens=creq.max_tokens, deadline_s=creq.deadline_s,
                 temperature=creq.temperature, top_k=creq.top_k,
                 seed=creq.seed, model=creq.model,
+                conversation=getattr(creq, "conversation", None),
                 journey_id=journey.id if journey is not None else "")
         except Exception:
             pass
@@ -420,6 +421,8 @@ class Gateway:
             journey.annotate(completion_id=item.id,
                              prompt_tokens=int(prompt.size),
                              max_tokens=creq.max_tokens)
+            if getattr(creq, "conversation", None):
+                journey.annotate(conversation=creq.conversation)
 
         backlog = self.scheduler.backlog_cost(priority) + item.cost
         slots = self.router.total_slots()
@@ -654,7 +657,8 @@ class Gateway:
                     temperature=creq.temperature, top_k=creq.top_k,
                     seed=creq.seed, deadline_s=remaining,
                     stream=self._stream_for(item), adapter=item.adapter,
-                    journey=item.journey)
+                    journey=item.journey,
+                    conversation=getattr(creq, "conversation", None))
             except QueueFullError:
                 tried.append(name)
                 if len(tried) >= len(self.router.names):

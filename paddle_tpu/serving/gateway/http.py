@@ -6,6 +6,13 @@ Endpoints:
   ``"stream": true`` the response is ``text/event-stream`` carried over
   chunked transfer encoding, one SSE ``data:`` event per token and a
   final ``data: [DONE]``.
+* ``POST /v1/chat/completions`` — the conversation-first door
+  (docs/serving.md "KV tiering & conversations"): ``messages`` flatten
+  to one prompt, an optional ``conversation`` id namespaces the prefix
+  cache per (adapter, conversation) so a returning user's turn N+1
+  costs tail-prefill only.  Same admission / streaming / journey
+  machinery as completions; responses frame as
+  ``chat.completion[.chunk]``.
 * ``GET /healthz`` — liveness JSON (200 while any replica is alive,
   503 otherwise).
 * ``GET /metrics`` — the process-wide Prometheus exposition (serving +
@@ -21,10 +28,11 @@ Endpoints:
   and ``outcome=`` filter the whole ring before the ``last`` tail, so a
   busy multi-tenant ring stays navigable.
 * ``GET /debug/requests/<id>`` — one journey by id (live or finished).
-* ``GET /debug/capture?last=N&tenant=&outcome=`` — the traffic-capture
-  ring: one entry per request the gateway saw, admitted or shed, with
-  arrival offset, tenant/priority, lengths, sampling params and the
-  journey id (docs/observability.md "Traffic capture & replay").
+* ``GET /debug/capture?last=N&tenant=&outcome=&conversation=`` — the
+  traffic-capture ring: one entry per request the gateway saw, admitted
+  or shed, with arrival offset, tenant/priority, lengths, sampling
+  params, conversation id and the journey id (docs/observability.md
+  "Traffic capture & replay").
 * ``GET /debug/window`` — ``Gateway.window_stats()`` as JSON (the
   autoscaler feed: windowed TTFT/queue-wait/per-token percentiles,
   shed rate, phase shares).
@@ -73,8 +81,10 @@ from ..engine import (DeadlineExceededError, EngineClosedError,
                       EngineDeadError, RequestInterruptedError)
 from .admission import AdmissionError
 from .gateway import Gateway, GatewayClosedError
-from .protocol import (SSE_DONE, ProtocolError, chunk_body, completion_body,
-                       error_body, parse_completion_request, sse_event,
+from .protocol import (SSE_DONE, ProtocolError, chat_chunk_body,
+                       chat_completion_body, chunk_body, completion_body,
+                       error_body, parse_chat_request,
+                       parse_completion_request, sse_event,
                        tenant_from_headers)
 from .router import NoEngineAvailableError
 
@@ -247,7 +257,7 @@ class _Handler(BaseHTTPRequestHandler):
                         self._send_json(200, bundle)
             elif path == "/debug/capture":
                 last = 64
-                tenant = outcome = None
+                tenant = outcome = conversation = None
                 for part in query.split("&"):
                     if part.startswith("last="):
                         try:
@@ -258,8 +268,11 @@ class _Handler(BaseHTTPRequestHandler):
                         tenant = part[7:]
                     elif part.startswith("outcome="):
                         outcome = part[8:]
+                    elif part.startswith("conversation="):
+                        conversation = part[13:]
                 self._send_json(200, self.gateway.capture.debug_state(
-                    last=last, tenant=tenant, outcome=outcome))
+                    last=last, tenant=tenant, outcome=outcome,
+                    conversation=conversation))
             elif path == "/debug/requests":
                 last = 32
                 tenant = outcome = None
@@ -308,10 +321,13 @@ class _Handler(BaseHTTPRequestHandler):
     # -- POST ----------------------------------------------------------------
     def do_POST(self):  # noqa: N802
         try:
-            if self.path != "/v1/completions":
+            if self.path not in ("/v1/completions", "/v1/chat/completions"):
                 self._send_json(404, error_body(
                     f"no such endpoint: {self.path}", code="not_found"))
                 return
+            parse = (parse_chat_request
+                     if self.path == "/v1/chat/completions"
+                     else parse_completion_request)
             gw = self.gateway
             # journey start == client-observed request start; the id is
             # adopted from the client's X-Request-Id when present and
@@ -323,7 +339,7 @@ class _Handler(BaseHTTPRequestHandler):
                     tenant = tenant_from_headers(self.headers, gw.api_keys)
                     length = int(self.headers.get("Content-Length") or 0)
                     raw = self.rfile.read(length)
-                    creq = parse_completion_request(
+                    creq = parse(
                         raw, has_tokenizer=gw.tokenizer is not None)
                     j.phase("parse", j.t0, time.perf_counter() - j.t0,
                             body_bytes=len(raw))
@@ -349,6 +365,32 @@ class _Handler(BaseHTTPRequestHandler):
     def _model_name(self, creq) -> str:
         return creq.model or self.gateway.model_name
 
+    def _body_for(self, item, text, token_ids, finish, prompt_tokens,
+                  request_id=None) -> dict:
+        """Final-response envelope: ``chat.completion`` for the chat
+        door, ``text_completion`` otherwise."""
+        creq = item.creq
+        if getattr(creq, "chat", False):
+            return chat_completion_body(
+                item.id, self._model_name(creq), text, token_ids, finish,
+                prompt_tokens, request_id=request_id,
+                conversation=creq.conversation)
+        return completion_body(
+            item.id, self._model_name(creq), text, token_ids, finish,
+            prompt_tokens, request_id=request_id)
+
+    def _chunk_for(self, item, text, token_ids, finish,
+                   request_id=None) -> dict:
+        """One SSE delta: ``chat.completion.chunk`` or the completions
+        chunk, matching the door the request came through."""
+        creq = item.creq
+        if getattr(creq, "chat", False):
+            return chat_chunk_body(
+                item.id, self._model_name(creq), text, token_ids, finish,
+                request_id=request_id, conversation=creq.conversation)
+        return chunk_body(item.id, self._model_name(creq), text,
+                          token_ids, finish, request_id=request_id)
+
     def _text(self, tokens) -> str:
         tok = self.gateway.tokenizer
         if tok is None:
@@ -366,8 +408,8 @@ class _Handler(BaseHTTPRequestHandler):
                 gw.finish_journey(item, self._error_wire(e)[3])
             return
         t_r0 = time.perf_counter()
-        body = completion_body(
-            item.id, self._model_name(item.creq), self._text(tokens),
+        body = self._body_for(
+            item, self._text(tokens),
             [int(t) for t in tokens], finish, int(item.prompt.size),
             request_id=j.id if j else None)
         self._send_json(200, body, headers=[
@@ -413,7 +455,6 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         registry().counter(GATEWAY_HTTP, "gateway HTTP responses by code"
                            ).inc(1.0, labels={"code": 200})
-        model = self._model_name(item.creq)
         sent = 0
         outcome = "ok"
         try:
@@ -429,15 +470,15 @@ class _Handler(BaseHTTPRequestHandler):
                         break
                     continue
                 sent += 1
-                self._write_chunk(sse_event(chunk_body(
-                    item.id, model, self._text([tok]), [int(tok)], None)))
+                self._write_chunk(sse_event(self._chunk_for(
+                    item, self._text([tok]), [int(tok)], None)))
             t_done = time.perf_counter()
             # drain tokens that raced the done check
             while not item.token_q.empty():
                 tok = item.token_q.get_nowait()
                 sent += 1
-                self._write_chunk(sse_event(chunk_body(
-                    item.id, model, self._text([tok]), [int(tok)], None)))
+                self._write_chunk(sse_event(self._chunk_for(
+                    item, self._text([tok]), [int(tok)], None)))
             err = item.final_error
             if err is None:
                 handle = item.handle
@@ -445,8 +486,8 @@ class _Handler(BaseHTTPRequestHandler):
                 toks = handle.tokens
                 finish = ("stop" if eos is not None and toks and
                           toks[-1] == eos else "length")
-                self._write_chunk(sse_event(chunk_body(
-                    item.id, model, "", [], finish,
+                self._write_chunk(sse_event(self._chunk_for(
+                    item, "", [], finish,
                     request_id=j.id if j else None)))
             else:
                 outcome = ("stream_interrupted"

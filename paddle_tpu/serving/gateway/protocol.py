@@ -21,8 +21,10 @@ import json
 import time
 
 __all__ = ["ProtocolError", "CompletionRequest", "PRIORITIES",
-           "parse_completion_request", "tenant_from_headers",
-           "completion_body", "chunk_body", "sse_event", "SSE_DONE",
+           "parse_completion_request", "parse_chat_request",
+           "tenant_from_headers",
+           "completion_body", "chunk_body", "chat_completion_body",
+           "chat_chunk_body", "sse_event", "SSE_DONE",
            "error_body"]
 
 # priority classes, strictly ordered: a lower value preempts a higher one
@@ -62,10 +64,12 @@ class CompletionRequest:
     string prompts to ids with the engine's tokenizer)."""
 
     __slots__ = ("prompt", "max_tokens", "temperature", "top_k", "seed",
-                 "stream", "stop", "deadline_s", "priority", "model")
+                 "stream", "stop", "deadline_s", "priority", "model",
+                 "conversation", "chat")
 
     def __init__(self, prompt, max_tokens, temperature, top_k, seed,
-                 stream, stop, deadline_s, priority, model):
+                 stream, stop, deadline_s, priority, model,
+                 conversation=None, chat=False):
         self.prompt = prompt              # str | list[int]
         self.max_tokens = max_tokens
         self.temperature = temperature
@@ -76,6 +80,8 @@ class CompletionRequest:
         self.deadline_s = deadline_s
         self.priority = priority          # key of PRIORITIES | None
         self.model = model
+        self.conversation = conversation  # prefix-cache namespace id
+        self.chat = chat                  # respond in chat.completion shape
 
 
 def _field(payload: dict, name: str, types, default, *, validate=None):
@@ -164,13 +170,95 @@ def parse_completion_request(raw: bytes, *, has_tokenizer: bool
         raise ProtocolError(
             400, f"'priority' must be one of {sorted(PRIORITIES)}",
             param="priority", code="invalid_priority")
+    conversation = _field(payload, "conversation", str, None,
+                          validate=lambda v: 0 < len(v) <= 256)
 
     return CompletionRequest(
         prompt=prompt, max_tokens=int(max_tokens),
         temperature=float(temperature), top_k=int(top_k), seed=int(seed),
         stream=bool(stream), stop=stop,
         deadline_s=None if deadline_ms is None else float(deadline_ms) / 1e3,
-        priority=priority, model=model)
+        priority=priority, model=model, conversation=conversation)
+
+
+def parse_chat_request(raw: bytes, *, has_tokenizer: bool
+                       ) -> CompletionRequest:
+    """bytes -> validated /v1/chat/completions request.
+
+    The chat surface is the conversation-first door (docs/serving.md "KV
+    tiering & conversations"): ``messages`` flatten to one prompt and an
+    optional ``conversation`` id namespaces the prefix cache so turn
+    N+1 of the same conversation re-uses turn N's KV.  Flattening is
+    deliberately trivial — role header + content per message — because
+    the engine is tokenizer-optional: string contents need a tokenizer
+    (they flatten to one string), while integer-list contents
+    concatenate tokenizer-free (the load generator / capture-replay
+    form).  Mixing the two in one request is a 400.  Everything else
+    (sampling, deadline, priority, stream) parses exactly like
+    /v1/completions; the returned request carries ``chat=True`` so the
+    HTTP layer frames responses as ``chat.completion[.chunk]``.
+    """
+    if len(raw) > _MAX_BODY_BYTES:
+        raise ProtocolError(413, "request body exceeds 1 MiB",
+                            code="body_too_large")
+    try:
+        payload = json.loads(raw.decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise ProtocolError(400, f"request body is not valid JSON: {e}",
+                            code="invalid_json") from e
+    if not isinstance(payload, dict):
+        raise ProtocolError(400, "request body must be a JSON object",
+                            code="invalid_json")
+    msgs = payload.get("messages")
+    if not isinstance(msgs, list) or not msgs:
+        raise ProtocolError(400, "'messages' must be a non-empty list",
+                            param="messages", code="missing_field")
+    parts, kinds = [], set()
+    for i, m in enumerate(msgs):
+        if not isinstance(m, dict) or not isinstance(m.get("role"), str) \
+                or not m["role"]:
+            raise ProtocolError(
+                400, f"messages[{i}] needs a string 'role'",
+                param="messages", code="invalid_message")
+        content = m.get("content")
+        if isinstance(content, str) and content:
+            kinds.add("str")
+            parts.append((m["role"], content))
+        elif (isinstance(content, list) and content and all(
+                isinstance(t, int) and not isinstance(t, bool) and t >= 0
+                for t in content)):
+            kinds.add("ids")
+            parts.append((m["role"], content))
+        else:
+            raise ProtocolError(
+                400, f"messages[{i}].content must be a non-empty string "
+                "or a non-empty list of non-negative token ids",
+                param="messages", code="invalid_message")
+    if len(kinds) > 1:
+        raise ProtocolError(
+            400, "messages must be all-string or all-token-ids, not "
+            "mixed", param="messages", code="invalid_message")
+    if "str" in kinds:
+        if not has_tokenizer:
+            raise ProtocolError(
+                400, "string message contents need a tokenizer on the "
+                "serving side; send token-id lists", param="messages",
+                code="no_tokenizer")
+        # deterministic flattening: identical histories produce the
+        # IDENTICAL prompt string, byte for byte — that equality is what
+        # the prefix cache keys on, so format drift = cache miss
+        prompt = "".join(f"<|{role}|>{content}\n"
+                         for role, content in parts) + "<|assistant|>"
+    else:
+        prompt = [t for _, content in parts for t in content]
+
+    body = dict(payload)
+    body["prompt"] = prompt
+    body.pop("messages", None)
+    creq = parse_completion_request(
+        json.dumps(body).encode("utf-8"), has_tokenizer=has_tokenizer)
+    creq.chat = True
+    return creq
 
 
 def tenant_from_headers(headers, api_keys: dict | None = None) -> str:
@@ -234,6 +322,51 @@ def chunk_body(req_id: str, model: str, text: str, token_ids,
            "choices": [_choice(text, token_ids, finish_reason)]}
     if request_id is not None:
         out["request_id"] = request_id
+    return out
+
+
+def chat_completion_body(req_id: str, model: str, text: str, token_ids,
+                         finish_reason: str, prompt_tokens: int,
+                         request_id: str | None = None,
+                         conversation: str | None = None) -> dict:
+    """The ``chat.completion`` envelope: the completion payload framed
+    as one assistant message.  ``conversation`` is echoed so a client
+    can confirm which prefix-cache namespace served it."""
+    n = len(token_ids)
+    out = {
+        "id": req_id, "object": "chat.completion",
+        "created": int(time.time()), "model": model,
+        "choices": [{"index": 0, "logprobs": None,
+                     "finish_reason": finish_reason,
+                     "message": {"role": "assistant", "content": text,
+                                 "token_ids": list(token_ids)}}],
+        "usage": {"prompt_tokens": int(prompt_tokens),
+                  "completion_tokens": n,
+                  "total_tokens": int(prompt_tokens) + n},
+    }
+    if request_id is not None:
+        out["request_id"] = request_id
+    if conversation is not None:
+        out["conversation"] = conversation
+    return out
+
+
+def chat_chunk_body(req_id: str, model: str, text: str, token_ids,
+                    finish_reason: str | None,
+                    request_id: str | None = None,
+                    conversation: str | None = None) -> dict:
+    """One streamed ``chat.completion.chunk`` delta."""
+    out = {"id": req_id, "object": "chat.completion.chunk",
+           "created": int(time.time()), "model": model,
+           "choices": [{"index": 0, "finish_reason": finish_reason,
+                        "delta": ({"role": "assistant", "content": text,
+                                   "token_ids": list(token_ids)}
+                                  if finish_reason is None or token_ids
+                                  else {})}]}
+    if request_id is not None:
+        out["request_id"] = request_id
+    if conversation is not None:
+        out["conversation"] = conversation
     return out
 
 

@@ -1,0 +1,374 @@
+"""Host-DRAM prefix tier — evicted KV pages demote instead of dying.
+
+The device prefix index (prefix_cache.py) is HBM-bounded: when the page
+allocator runs dry, ``evict_lru`` frees refs-0 entries and a returning
+conversation pays full re-prefill.  This module adds the second tier:
+
+* **demote** — the engine eagerly gathers a victim entry's pages into
+  fresh (non-donated) device arrays while it still holds the scheduler
+  lock, then hands them to :meth:`HostPrefixTier.demote_async`; a spill
+  worker thread performs the slow ``jax.device_get`` OFF the scheduler's
+  hot path and commits the host copy under the tier lock.  int8 page
+  payloads and f32 scale sidecars are kept verbatim — byte-identical.
+* **promote** — a lookup that misses HBM but hits the host tier
+  re-uploads the pages into freshly alloc'd device pages (engine's
+  ``_flush_promotes``, journey phase ``prefix_promote``) and the request
+  proceeds as a normal zero-copy hit: tail-prefill only, greedy
+  bitwise-identical to a never-evicted hit.
+* **survival** — entries live in host memory keyed by ``(ns, tokens)``
+  exactly like the device index, so they survive engine rebuilds by
+  construction and are replica-portable: the supervisor factory hands
+  the SAME tier object to every build (``Engine(host_prefix=tier)``),
+  or a single engine owns one via ``Engine(host_prefix_mb=N)``.
+
+Accounting mirrors the device side: a ``host_prefix`` owner row in the
+perfscope HBM ledger (``paddle_tpu_hbm_bytes{owner="host_prefix"}`` —
+host bytes, same export so one dashboard shows both tiers), LRU drops
+bounded by ``capacity_mb``, refcounts so an entry mid-promote can never
+be dropped, and demote/drop counters + flight events.
+
+Thread-safety: one lock (``self._lock``) + one condition (``self._cv``)
+guard ALL mutable state; the spill worker drains batches under the cv
+and only the ``jax.device_get`` runs outside it.  The engine always
+takes its own lock BEFORE any tier call, and the tier never calls back
+into the engine — lock order is engine → tier, acyclic.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+
+import numpy as np
+
+from ..observability import flight, registry
+from ..observability import perfscope as _perfscope
+
+__all__ = ["HostPrefixTier", "HostPrefixEntry"]
+
+# -- metric names (paddle_tpu.observability registry) -------------------------
+SERVING_HOST_PREFIX_DEMOTES = \
+    "paddle_tpu_serving_host_prefix_demotes_total"
+SERVING_HOST_PREFIX_DROPS = "paddle_tpu_serving_host_prefix_drops_total"
+SERVING_HOST_PREFIX_ENTRIES = "paddle_tpu_serving_host_prefix_entries"
+
+
+class HostPrefixEntry:
+    """One demoted prefix: the host copy of a device index entry.
+
+    ``payload`` is the page-major host mirror of the engine's pool
+    tuple: one numpy array per pool group per layer, each
+    ``[n_pages, page_size, ...]`` in the entry's own page order (page i
+    of the payload is token block i — physical device page ids are NOT
+    recorded; promotion writes into whatever fresh pages the allocator
+    hands out).
+    """
+
+    __slots__ = ("ns", "tokens", "payload", "nbytes", "refs", "tick",
+                 "keys")
+
+    def __init__(self, ns, tokens, payload, nbytes, tick):
+        self.ns = ns
+        self.tokens = tuple(int(t) for t in tokens)
+        self.payload = payload
+        self.nbytes = int(nbytes)
+        self.refs = 0
+        self.tick = tick
+        self.keys = []                  # (ns, prefix) keys it is under
+
+    @property
+    def n(self) -> int:
+        return len(self.tokens)
+
+    @property
+    def n_pages(self) -> int:
+        return int(self.payload[0][0].shape[0]) if self.payload else 0
+
+
+class HostPrefixTier:
+    """Capacity-bounded, refcounted, LRU host-DRAM tier for KV pages.
+
+    Mirrors the device :class:`PrefixIndex` contract — entries keyed
+    ``(ns, tokens)``, registered under every block-boundary prefix with
+    newest-wins shadowing, LRU over refs-0 entries — but bounds BYTES
+    (``capacity_mb``) instead of entry count, because host payloads are
+    the real cost here.
+    """
+
+    def __init__(self, capacity_mb: float = 256.0, *, block: int = 16,
+                 name: str = "host_prefix"):
+        if capacity_mb <= 0:
+            raise ValueError("capacity_mb must be positive")
+        if block < 1:
+            raise ValueError("block must be >= 1")
+        self.capacity_bytes = int(capacity_mb * (1 << 20))
+        self.block = int(block)
+        self.name = name
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._entries = {}              # (ns, tokens) -> HostPrefixEntry
+        self._by_prefix = {}            # (ns, prefix) -> entry (newest wins)
+        self._clock = itertools.count(1)
+        self._pending = []              # queued demotes awaiting device_get
+        self._busy = 0                  # items drained but not yet committed
+        self._worker = None
+        self._stop = False
+        self._closed = False
+        self._bytes = 0
+        self._counts = {"demotes": 0, "drops": 0, "hits": 0, "misses": 0,
+                        "demote_errors": 0, "dedup_skips": 0}
+        # same export as HBM owners on purpose: one ledger, two tiers —
+        # dashboards already grouping by {owner} pick this row up free
+        self._row = _perfscope.ledger().register(
+            name, 0, detail="host-DRAM KV prefix tier")
+
+    # -- demote side (engine scheduler thread -> spill worker) ----------------
+
+    def demote_async(self, ns, tokens, gathered) -> bool:
+        """Queue one evicted entry for spill to host.
+
+        ``gathered`` holds freshly gathered device arrays (one per pool
+        group per layer, ``[n_pages, page_size, ...]``) that nothing
+        donates — the caller made them with an eager gather precisely so
+        they stay valid after the engine's next donating dispatch.  The
+        slow ``device_get`` happens on the spill worker; on device death
+        the item is dropped and counted, never raised.
+        """
+        if len(tokens) < self.block or not gathered:
+            return False
+        tokens = tuple(int(t) for t in tokens)
+        with self._cv:
+            if self._closed or self._stop:
+                return False
+            if (ns, tokens) in self._entries:
+                self._counts["dedup_skips"] += 1
+                return False
+            self._pending.append((ns, tokens, gathered))
+            if self._worker is None or not self._worker.is_alive():
+                self._worker = threading.Thread(
+                    target=self._spill_loop, name=f"{self.name}-spill",
+                    daemon=True)
+                self._worker.start()
+            self._cv.notify()
+        return True
+
+    def _spill_loop(self):
+        while True:
+            with self._cv:
+                while not self._pending and not self._stop:
+                    self._cv.wait()
+                batch, self._pending = self._pending, []
+                self._busy += len(batch)
+                stop = self._stop
+            fetched = []
+            for ns, tokens, gathered in batch:
+                try:
+                    import jax
+                    payload = [[np.asarray(jax.device_get(a)) for a in grp]
+                               for grp in gathered]
+                    fetched.append((ns, tokens, payload))
+                except Exception:  # noqa: BLE001 — device died mid-spill
+                    fetched.append(None)
+            with self._cv:
+                for item in fetched:
+                    if item is None:
+                        self._counts["demote_errors"] += 1
+                    elif not self._closed:
+                        self._commit_locked(*item)
+                self._busy -= len(batch)
+                self._cv.notify_all()
+                if stop:
+                    return
+
+    def _commit_locked(self, ns, tokens, payload):
+        if (ns, tokens) in self._entries:
+            self._counts["dedup_skips"] += 1
+            return
+        nbytes = sum(a.nbytes for grp in payload for a in grp)
+        e = HostPrefixEntry(ns, tokens, payload, nbytes, next(self._clock))
+        self._entries[(ns, tokens)] = e
+        for b in self._boundaries(e.n):
+            key = (ns, tokens[:b])
+            self._by_prefix[key] = e          # newest wins
+            e.keys.append(key)
+        self._bytes += e.nbytes
+        self._counts["demotes"] += 1
+        registry().counter(
+            SERVING_HOST_PREFIX_DEMOTES,
+            "prefix entries demoted to the host tier on eviction").inc(1.0)
+        flight.record("serving", "host_prefix_demote",
+                      cached_tokens=e.n, pages=e.n_pages, bytes=e.nbytes)
+        self._evict_to_capacity_locked()
+        self._row.update(self._bytes)
+        registry().gauge(
+            SERVING_HOST_PREFIX_ENTRIES,
+            "entries resident in the host prefix tier").set(
+            float(len(self._entries)))
+
+    def _evict_to_capacity_locked(self):
+        while self._bytes > self.capacity_bytes:
+            victim, vkey = None, None
+            for key, e in self._entries.items():
+                if e.refs == 0 and (victim is None or e.tick < victim.tick):
+                    victim, vkey = e, key
+            if victim is None:
+                return                   # everything pinned; over-capacity
+            self._drop_locked(vkey, victim)
+            self._counts["drops"] += 1
+            registry().counter(
+                SERVING_HOST_PREFIX_DROPS,
+                "host-tier entries dropped by the byte-capacity LRU").inc(
+                1.0)
+            flight.record("serving", "host_prefix_drop",
+                          cached_tokens=victim.n, bytes=victim.nbytes)
+
+    def _drop_locked(self, key, e):
+        del self._entries[key]
+        for k in e.keys:
+            if self._by_prefix.get(k) is e:
+                del self._by_prefix[k]
+        e.keys = []
+        e.payload = None
+        self._bytes -= e.nbytes
+
+    # -- lookup / promote side (engine scheduler thread) ----------------------
+
+    def _boundaries(self, n: int):
+        b = (n // self.block) * self.block
+        while b >= self.block:
+            yield b
+            b -= self.block
+
+    def lookup(self, prompt, *, ns=None, peek: bool = False):
+        """Longest-boundary host match for ``prompt`` under ``ns``.
+
+        Returns ``(entry, matched)`` or None.  The match is capped at
+        ``len(prompt) - 1`` so at least one tail token remains to
+        prefill — the same contract as the device index.  ``peek``
+        skips the LRU touch and the hit/miss counters (admission probes
+        repeatedly while waiting on pages; only the commit counts).
+        """
+        toks = tuple(int(t) for t in np.asarray(prompt).reshape(-1))
+        cap = len(toks) - 1
+        for b in self._boundaries(min(len(toks), cap)):
+            with self._lock:
+                e = self._by_prefix.get((ns, toks[:b]))
+                if e is None or e.payload is None:
+                    continue
+                if e.tokens[:b] == toks[:b]:
+                    if not peek:
+                        e.tick = next(self._clock)
+                        self._counts["hits"] += 1
+                    return e, b
+        if not peek:
+            with self._lock:
+                self._counts["misses"] += 1
+        return None
+
+    def miss(self):
+        """Count a miss resolved earlier via ``lookup(peek=True)`` (the
+        paged admission loop peeks first, then commits)."""
+        with self._lock:
+            self._counts["misses"] += 1
+
+    def touch(self, e: HostPrefixEntry):
+        with self._lock:
+            e.tick = next(self._clock)
+            self._counts["hits"] += 1
+
+    def acquire(self, e: HostPrefixEntry):
+        with self._lock:
+            e.refs += 1
+
+    def release(self, e: HostPrefixEntry):
+        with self._lock:
+            if e.refs <= 0:
+                raise KeyError("release of a host-tier entry with no refs")
+            e.refs -= 1
+
+    def payload(self, e: HostPrefixEntry, n_pages: int):
+        """First ``n_pages`` pages of the entry's host payload, per pool
+        group per layer — what ``_flush_promotes`` uploads."""
+        with self._lock:
+            if e.payload is None:
+                raise KeyError("host-tier entry was dropped")
+            return [[a[:n_pages] for a in grp] for grp in e.payload]
+
+    # -- lifecycle / accounting ----------------------------------------------
+
+    def flush(self, timeout: float | None = 5.0) -> bool:
+        """Block until every queued demote has committed (or timed out).
+        Test/bench hook — production never needs to wait on the spill."""
+        deadline = None if timeout is None else \
+            time.monotonic() + timeout
+        with self._cv:
+            while self._pending or self._busy:
+                self._cv.notify()
+                if deadline is None:
+                    self._cv.wait(0.25)
+                else:
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        return False
+                    self._cv.wait(min(left, 0.25))
+        return True
+
+    def drop_all(self) -> int:
+        """Drop every refs-0 entry (capacity-style, not a close)."""
+        dropped = 0
+        with self._lock:
+            for key, e in list(self._entries.items()):
+                if e.refs == 0:
+                    self._drop_locked(key, e)
+                    dropped += 1
+            self._row.update(self._bytes)
+        return dropped
+
+    def close(self, timeout: float | None = 5.0):
+        """Stop the spill worker, drop all entries, release the ledger
+        row.  Idempotent; entries still referenced are dropped too — a
+        closed tier serves nothing."""
+        with self._cv:
+            if self._closed:
+                return
+            self._closed = True
+            self._stop = True
+            self._cv.notify_all()
+            worker = self._worker
+        if worker is not None and worker.is_alive():
+            worker.join(timeout=timeout)
+        with self._lock:
+            for key, e in list(self._entries.items()):
+                self._drop_locked(key, e)
+            self._pending = []
+            self._row.update(0)
+        self._row.release()
+
+    def check(self):
+        """Invariant assert (tests): byte ledger consistent, no negative
+        refs, prefix keys all point at live entries."""
+        with self._lock:
+            total = sum(e.nbytes for e in self._entries.values())
+            assert total == self._bytes, \
+                f"host tier byte leak: sum={total} ledger={self._bytes}"
+            for e in self._entries.values():
+                assert e.refs >= 0
+            for key, e in self._by_prefix.items():
+                assert self._entries.get((e.ns, e.tokens)) is e, \
+                    f"dangling host prefix key {key!r}"
+
+    @property
+    def bytes_used(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"entries": len(self._entries), "bytes": self._bytes,
+                    "capacity_bytes": self.capacity_bytes,
+                    "pending": len(self._pending) + self._busy,
+                    **dict(self._counts)}

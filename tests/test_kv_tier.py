@@ -1,0 +1,315 @@
+"""Host-DRAM KV prefix tier (paddle_tpu/serving/kv_tier.py).
+
+Tier-1 (CPU) coverage for the second cache tier and conversation-keyed
+serving (docs/serving.md "KV tiering & conversations"):
+
+* tier unit contract — demote/lookup roundtrip, block-boundary match
+  capped at ``len(prompt) - 1``, ns isolation, dedup, byte-capacity LRU
+  with refcount pinning, error paths, close idempotence;
+* engine end-to-end — a warm conversation turn whose device entry was
+  EVICTED is served via host-tier promote, greedy token-identical to a
+  never-tiered engine, at ONE compiled decode signature;
+* demotion-disabled regression — an engine without the tier behaves
+  exactly as before the tier existed (full re-prefill, zero host
+  bytes);
+* rebuild survival — a shared tier (``host_prefix=``) outlives
+  ``Engine.shutdown`` and serves the next build's warm turn;
+* conversation namespaces — the same prompt under two conversation ids
+  never shares cache entries.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import build_gpt, gpt_config
+from paddle_tpu.observability import perfscope
+from paddle_tpu.serving import Engine, HostPrefixTier
+
+
+@pytest.fixture(scope="module")
+def tiny_gpt():
+    cfg = gpt_config("gpt-tiny", max_position_embeddings=128,
+                     hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+    paddle.seed(7)
+    model = build_gpt(cfg)
+    model.eval()
+    return model, cfg
+
+
+def _ref_greedy_tokens(model, prompt, n_new):
+    """Full-forward (no cache) greedy continuation of one prompt row."""
+    ids = np.asarray(prompt, np.int64)[None]
+    out = []
+    for _ in range(n_new):
+        logits = model(paddle.to_tensor(ids))
+        nxt = int(np.asarray(logits._value[0, -1]).argmax())
+        out.append(nxt)
+        ids = np.concatenate([ids, [[nxt]]], axis=1).astype(np.int64)
+    return out
+
+
+def _payload(n_pages, fill, page=4):
+    """One pool group of [int8 KV pages, f32 scale sidecar] — 24 bytes
+    per page, the demote_async gather shape."""
+    return [[np.full((n_pages, page, 2), fill, np.int8),
+             np.full((n_pages, page, 1), float(fill), np.float32)]]
+
+
+# -- tier unit contract -------------------------------------------------------
+
+def test_tier_demote_lookup_roundtrip():
+    tier = HostPrefixTier(capacity_mb=1.0, block=4)
+    toks = tuple(range(8))
+    assert tier.demote_async(None, toks, _payload(2, 7))
+    assert tier.flush()
+    assert len(tier) == 1 and tier.bytes_used == 48
+    # longest-boundary match under the right ns; payload byte-identical
+    entry, m = tier.lookup(list(range(12)))
+    assert m == 8 and entry.tokens == toks
+    got = tier.payload(entry, 2)
+    np.testing.assert_array_equal(got[0][0], _payload(2, 7)[0][0])
+    np.testing.assert_array_equal(got[0][1], _payload(2, 7)[0][1])
+    # capped at len(prompt)-1: the exact-prompt lookup steps down a block
+    _, m2 = tier.lookup(list(range(8)))
+    assert m2 == 4
+    # ns isolation + sub-block prompts never match
+    assert tier.lookup(list(range(12)), ns="other") is None
+    assert tier.lookup([0, 1, 2]) is None
+    # short entries skipped, duplicates deduped
+    assert not tier.demote_async(None, (1, 2, 3), _payload(1, 1))
+    assert not tier.demote_async(None, toks, _payload(2, 9))
+    st = tier.stats()
+    assert st["demotes"] == 1 and st["dedup_skips"] == 1
+    assert st["hits"] == 2 and st["misses"] == 2
+    tier.check()
+    tier.close()
+    assert tier.bytes_used == 0 and len(tier) == 0
+    tier.close()                      # idempotent
+    assert not tier.demote_async(None, (9,) * 8, _payload(2, 1))
+
+
+def test_tier_capacity_lru_drops_touched_last():
+    tier = HostPrefixTier(capacity_mb=100 / (1 << 20), block=4)
+    tier.demote_async("a", tuple(range(8)), _payload(2, 1))
+    tier.demote_async("a", tuple(range(100, 108)), _payload(2, 2))
+    assert tier.flush() and len(tier) == 2
+    tier.lookup(list(range(9)), ns="a")          # touch the older entry
+    tier.demote_async("a", tuple(range(200, 208)), _payload(2, 3))
+    assert tier.flush()
+    # 3 * 48B > 100B: the LRU victim is the UNtouched middle entry
+    assert len(tier) == 2 and tier.stats()["drops"] == 1
+    assert tier.lookup(list(range(100, 109)), ns="a", peek=True) is None
+    assert tier.lookup(list(range(9)), ns="a", peek=True) is not None
+    assert tier.lookup(list(range(200, 209)), ns="a", peek=True) is not None
+    tier.check()
+    tier.close()
+
+
+def test_tier_refcount_pins_against_capacity_drop():
+    tier = HostPrefixTier(capacity_mb=60 / (1 << 20), block=4)
+    tier.demote_async(None, tuple(range(8)), _payload(2, 1))
+    assert tier.flush()
+    e, _ = tier.lookup(list(range(9)))
+    tier.acquire(e)                   # mid-promote: may not be dropped
+    tier.demote_async(None, tuple(range(50, 58)), _payload(2, 2))
+    assert tier.flush()
+    # over capacity, but the pinned entry survives — the refs-0
+    # newcomer is the only eligible victim
+    assert tier.lookup(list(range(9)), peek=True) is not None
+    assert tier.stats()["drops"] == 1
+    assert tier.payload(e, 2)[0][0].shape == (2, 4, 2)
+    tier.release(e)
+    with pytest.raises(KeyError):     # refs already back at zero
+        tier.release(e)
+    assert tier.drop_all() == 1
+    with pytest.raises(KeyError):     # dropped entries serve nothing
+        tier.payload(e, 1)
+    tier.check()
+    tier.close()
+
+
+def test_tier_and_engine_knob_validation(tiny_gpt):
+    model, _ = tiny_gpt
+    with pytest.raises(ValueError):
+        HostPrefixTier(capacity_mb=0)
+    with pytest.raises(ValueError):
+        HostPrefixTier(block=0)
+    with pytest.raises(ValueError):   # the tier needs the paged index
+        Engine(model, max_slots=1, max_len=32, host_prefix_mb=8)
+    tier = HostPrefixTier(capacity_mb=8, block=8)
+    with pytest.raises(ValueError):   # both knobs at once
+        Engine(model, max_slots=1, max_len=32, prefix_cache=True,
+               prefix_block=4, paged_kv=True, num_pages=16,
+               host_prefix_mb=8, host_prefix=tier)
+    with pytest.raises(ValueError):   # shared-tier block mismatch
+        Engine(model, max_slots=1, max_len=32, prefix_cache=True,
+               prefix_block=4, paged_kv=True, num_pages=16,
+               host_prefix=tier)
+    tier.close()
+
+
+# -- engine end-to-end --------------------------------------------------------
+
+def _engine(model, **kw):
+    return Engine(model, max_slots=2, max_len=48, prefix_cache=True,
+                  prefix_block=4, paged_kv=True, num_pages=24, **kw)
+
+
+def _conversation_round(eng, p1, fillers, extra):
+    """Turn 1 under one conversation id, filler traffic that forces the
+    turn-1 entry out of the device index, then the warm turn (turn-1
+    prompt + its reply + new user tokens).  Returns (t1, warm_prompt,
+    warm_tokens, warm_handle)."""
+    t1 = np.asarray(
+        eng.submit(p1, max_new_tokens=4, conversation="c1").result(
+            timeout=300))
+    for i, f in enumerate(fillers):
+        eng.submit(f, max_new_tokens=4,
+                   conversation=f"fill{i}").result(timeout=300)
+    if eng._host_tier is not None:
+        assert eng._host_tier.flush()
+    warm = np.concatenate([p1, t1, extra]).astype(np.int64)
+    hw = eng.submit(warm, max_new_tokens=4, conversation="c1")
+    tw = np.asarray(hw.result(timeout=300))
+    return t1, warm, tw, hw
+
+
+@pytest.fixture(scope="module")
+def conv_inputs(tiny_gpt):
+    _, cfg = tiny_gpt
+    rs = np.random.RandomState(11)
+    p1 = rs.randint(0, cfg.vocab_size, 12).astype(np.int64)
+    fillers = [rs.randint(0, cfg.vocab_size, 12).astype(np.int64)
+               for _ in range(6)]
+    extra = rs.randint(0, cfg.vocab_size, 4).astype(np.int64)
+    return p1, fillers, extra
+
+
+def test_warm_turn_after_eviction_promotes_token_identical(
+        tiny_gpt, conv_inputs):
+    """The acceptance shape: turn 1 is demoted to host on eviction; the
+    warm turn misses HBM, hits the host tier, promotes, and its greedy
+    tokens equal the full-forward reference — all at one compiled
+    decode signature."""
+    model, _ = tiny_gpt
+    p1, fillers, extra = conv_inputs
+    before = perfscope.ledger().owner_bytes().get("host_prefix", 0)
+    eng = _engine(model, host_prefix_mb=64)
+    t1, warm, tw, hw = _conversation_round(eng, p1, fillers, extra)
+    st = eng.stats()
+    eng.shutdown()
+    np.testing.assert_array_equal(tw, _ref_greedy_tokens(model, warm, 4))
+    assert hw.prefix_hit, "warm turn must admit as a (promoted) hit"
+    assert st["host_prefix_hits"] == 1
+    assert st["host_prefix_promotes"] == 1
+    assert st["host_prefix"]["demotes"] >= 1
+    assert st["host_prefix"]["hits"] == 1
+    assert st["decode_compiles"] == 1, \
+        "promotion retraced decode — uploads must stay eager"
+    # engine-OWNED tier: shutdown closed it and released its ledger row
+    assert eng._host_tier.bytes_used == 0
+    assert perfscope.ledger().owner_bytes().get("host_prefix", 0) == before
+
+
+def test_demotion_disabled_regression_matches_untired_engine(
+        tiny_gpt, conv_inputs):
+    """Without the tier the engine behaves exactly as at HEAD: the warm
+    turn is a full re-prefill (no hit), zero host bytes anywhere, and
+    the same greedy tokens (the tier changes cost, never content)."""
+    model, _ = tiny_gpt
+    p1, fillers, extra = conv_inputs
+    before = perfscope.ledger().owner_bytes().get("host_prefix", 0)
+    eng = _engine(model)
+    assert eng._host_tier is None
+    t1, warm, tw, hw = _conversation_round(eng, p1, fillers, extra)
+    st = eng.stats()
+    eng.shutdown()
+    np.testing.assert_array_equal(tw, _ref_greedy_tokens(model, warm, 4))
+    assert not hw.prefix_hit, \
+        "filler traffic must evict turn 1 — the warm turn re-prefills"
+    assert "host_prefix" not in st
+    assert st["host_prefix_hits"] == 0 and st["host_prefix_promotes"] == 0
+    assert st["decode_compiles"] == 1
+    assert perfscope.ledger().owner_bytes().get("host_prefix", 0) == before
+
+
+def test_shared_tier_survives_engine_rebuild(tiny_gpt, conv_inputs):
+    """host_prefix= (the supervisor-factory shape): demoted entries live
+    in host memory keyed by (ns, tokens), so a REBUILT engine promotes
+    a conversation demoted by its predecessor."""
+    model, _ = tiny_gpt
+    p1, fillers, extra = conv_inputs
+    before = perfscope.ledger().owner_bytes().get("host_prefix", 0)
+    tier = HostPrefixTier(capacity_mb=64, block=4)
+    eng1 = _engine(model, host_prefix=tier)
+    t1 = np.asarray(
+        eng1.submit(p1, max_new_tokens=4, conversation="c1").result(
+            timeout=300))
+    for i, f in enumerate(fillers):
+        eng1.submit(f, max_new_tokens=4,
+                    conversation=f"fill{i}").result(timeout=300)
+    assert tier.flush()
+    eng1.shutdown()
+    # shared tier is NOT closed by shutdown — entries survived
+    assert len(tier) > 0 and tier.bytes_used > 0
+    tier.check()
+    eng2 = _engine(model, host_prefix=tier)
+    warm = np.concatenate([p1, t1, extra]).astype(np.int64)
+    hw = eng2.submit(warm, max_new_tokens=4, conversation="c1")
+    tw = np.asarray(hw.result(timeout=300))
+    st2 = eng2.stats()
+    eng2.shutdown()
+    np.testing.assert_array_equal(tw, _ref_greedy_tokens(model, warm, 4))
+    assert hw.prefix_hit and st2["host_prefix_promotes"] == 1
+    tier.check()
+    tier.close()
+    assert tier.bytes_used == 0
+    assert perfscope.ledger().owner_bytes().get("host_prefix", 0) == before
+
+
+def test_conversation_namespaces_do_not_share_entries(tiny_gpt):
+    """The same prompt under two conversation ids keys two independent
+    cache namespaces — conversation B never rides on A's KV."""
+    model, cfg = tiny_gpt
+    rs = np.random.RandomState(23)
+    p = rs.randint(0, cfg.vocab_size, 12).astype(np.int64)
+    eng = Engine(model, max_slots=2, max_len=48, prefix_cache=True,
+                 prefix_block=4, paged_kv=True, num_pages=32)
+    ref = eng.submit(p, max_new_tokens=4,
+                     conversation="a").result(timeout=300)
+    hb = eng.submit(p, max_new_tokens=4, conversation="b")
+    out_b = hb.result(timeout=300)
+    ha = eng.submit(p, max_new_tokens=4, conversation="a")
+    out_a = ha.result(timeout=300)
+    st = eng.stats()
+    eng.shutdown()
+    assert not hb.prefix_hit, "cross-conversation reuse is forbidden"
+    assert ha.prefix_hit, "same conversation re-uses its own turns"
+    assert st["prefix_hits"] == 1
+    np.testing.assert_array_equal(out_a, ref)
+    np.testing.assert_array_equal(out_b, ref)
+
+
+def test_conversation_trace_prefix_property_and_determinism():
+    """tools/load_gen.py make_conversation_trace: seeded-deterministic,
+    turn N+1's prompt EXTENDS turn N's (the property that makes warm
+    turns tail-prefill-only), history + output stays within
+    prompt_max, and turns of one conversation never reorder."""
+    from tools.load_gen import make_conversation_trace
+    kw = dict(turns_mean=3.0, prompt_max=96, out_max=16)
+    tr = make_conversation_trace(45.0, 2.0, seed=3, **kw)
+    assert tr == make_conversation_trace(45.0, 2.0, seed=3, **kw)
+    assert tr != make_conversation_trace(45.0, 2.0, seed=4, **kw)
+    assert tr and any(e["turn"] > 0 for e in tr), "no warm turns"
+    by_conv = {}
+    for e in tr:
+        assert e["prompt_len"] == len(e["prompt"])
+        assert e["prompt_len"] + e["max_tokens"] <= 96
+        by_conv.setdefault(e["conversation"], []).append(e)
+    for turns in by_conv.values():
+        assert [e["turn"] for e in turns] == list(range(len(turns)))
+        ts = [e["t"] for e in turns]
+        assert ts == sorted(ts)
+        for prev, nxt in zip(turns, turns[1:]):
+            assert nxt["prompt"][:prev["prompt_len"]] == prev["prompt"]
+            assert nxt["prompt_len"] > prev["prompt_len"]

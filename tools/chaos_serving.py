@@ -505,6 +505,94 @@ def main() -> int:
             faults.reset()
             auto.shutdown()
 
+        # -- KV tiering under chaos (ISSUE 18): a SHARED host-DRAM
+        # prefix tier (Engine(host_prefix=tier), the supervisor-factory
+        # shape) rides a kill.  Turn 1 of a conversation demotes to the
+        # host tier when filler traffic evicts it; a SIGKILL-equivalent
+        # scheduler fault then rebuilds the engine — fresh pool, fresh
+        # device index, EMPTY HBM cache — and the warm turn on the new
+        # build is served from the host tier (promote), token-identical
+        # to a never-tiered dense reference.  End-of-lane: zero leaked
+        # pages on every kv build AND zero leaked host-tier bytes.
+        from paddle_tpu.serving import HostPrefixTier
+        tier = HostPrefixTier(capacity_mb=32, block=4)
+        kv_engines: list = []
+
+        def kv_factory():
+            e = Engine(model3, max_slots=SLOTS, max_len=48, max_queue=16,
+                       prefix_cache=True, prefix_block=4, paged_kv=True,
+                       num_pages=24, host_prefix=tier)
+            kv_engines.append(e)
+            return e
+
+        kv_sup = EngineSupervisor(kv_factory, name="kvtier",
+                                  poll_interval_s=0.02, max_restarts=6,
+                                  max_redispatch=3)
+        try:
+            conv_prompt = [int(t) for t in rs.randint(1, cfg.vocab_size,
+                                                      12)]
+            t1 = [int(t) for t in kv_sup.submit(
+                conv_prompt, max_new_tokens=4,
+                conversation="chaos-conv").result(timeout=300)]
+            warm = conv_prompt + t1 + \
+                [int(t) for t in rs.randint(1, cfg.vocab_size, 4)]
+            # independent reference: a dense, never-killed, never-tiered
+            # engine decoding the warm prompt from scratch
+            ref_eng = Engine(model3, max_slots=1, max_len=48)
+            ref_warm = [int(t) for t in ref_eng.submit(
+                warm, max_new_tokens=4).result(timeout=300)]
+            ref_eng.shutdown()
+            # filler conversations force the turn-1 entry out of the
+            # 24-page pool — eviction demotes it to the host tier
+            for i in range(6):
+                filler = [int(t) for t in rs.randint(1, cfg.vocab_size,
+                                                     12)]
+                kv_sup.submit(filler, max_new_tokens=4,
+                              conversation=f"chaos-fill{i}").result(
+                    timeout=300)
+            assert tier.flush(), "spill worker never drained"
+            assert len(tier) > 0 and tier.stats()["demotes"] > 0, \
+                "nothing demoted to the host tier before the kill"
+            # mid-kill: arm the scheduler fault and poke traffic through
+            # it — the supervisor absorbs the death and rebuilds
+            kv_restarts_before = kv_sup.restarts
+            faults.arm("serving.scheduler", times=1)
+            poke = kv_sup.submit([3, 1, 4, 1, 5], max_new_tokens=2)
+            deadline = time.time() + 120
+            while kv_sup.restarts == kv_restarts_before:
+                assert time.time() < deadline, \
+                    "kv-tier kill never absorbed by a restart"
+                time.sleep(0.02)
+            poke.result(timeout=300)     # redispatched onto the rebuild
+            # the warm turn lands on a rebuilt engine whose device index
+            # is empty — only the host tier can make this a hit
+            hw = kv_sup.submit(warm, max_new_tokens=4,
+                               conversation="chaos-conv")
+            tw = [int(t) for t in hw.result(timeout=300)]
+            kv_st = kv_sup.stats()
+            assert hw.prefix_hit and kv_st["host_prefix_promotes"] >= 1, \
+                f"warm turn was not served from the host tier: {kv_st}"
+            assert tw == ref_warm, \
+                "host-tier promote changed tokens across a rebuild"
+            assert kv_sup.builds()[-1]["decode_compiles"] == 1, \
+                kv_sup.builds()
+            assert kv_sup.failed is None, kv_sup.failed
+            kv_summary = {
+                "kv_tier_demotes": tier.stats()["demotes"],
+                "kv_tier_promotes": int(kv_st["host_prefix_promotes"]),
+                "kv_tier_builds": len(kv_engines),
+                "kv_tier_restarts": kv_sup.restarts,
+            }
+        finally:
+            faults.reset()
+            kv_sup.shutdown()
+        # zero leaked host-tier bytes: shutdown leaves the SHARED tier
+        # open by design (that is the rebuild-survival property); its
+        # invariants hold, and close releases every byte + the ledger row
+        tier.check()
+        tier.close()
+        assert tier.bytes_used == 0 and len(tier) == 0, tier.stats()
+
         # SLO under chaos (ISSUE 16): the kill matrix is over and the
         # fleet is healthy — any alert the rebuilds raised must clear
         # as the window's errors age out (a stuck-firing alert here
@@ -563,6 +651,7 @@ def main() -> int:
             "builds_per_engine": [len(s.builds()) for s in sups],
             **journey_summary,
             **scale_summary,
+            **kv_summary,
             **slo_summary,
         }
     finally:
@@ -582,6 +671,13 @@ def main() -> int:
         # zero leaked adapter pins, every build (death + drain paths
         # both unpin; a leak would keep refs > 0 here)
         e._adapters.check()
+    # the kv-tier builds too: every build — the killed one and the
+    # drained one — ends with zero pages referenced (ISSUE 18)
+    for e in kv_engines:
+        e.shutdown()
+        e._page_alloc.check()
+        assert e._page_alloc.n_used == 0, \
+            f"leaked pages in a kv-tier build: {e._page_alloc!r}"
     # fresh adapter banks per rebuild: every build got its OWN residency
     # (stale bank reuse across pools is impossible by construction)
     assert len({id(e._adapters) for e in engines_built}) == \

@@ -44,7 +44,7 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
-__all__ = ["make_trace", "replay_http"]
+__all__ = ["make_trace", "make_conversation_trace", "replay_http"]
 
 
 def make_trace(duration_s: float = 60.0, base_qps: float = 4.0,
@@ -103,6 +103,83 @@ def make_trace(duration_s: float = 60.0, base_qps: float = 4.0,
     return trace
 
 
+def make_conversation_trace(duration_s: float = 60.0,
+                            base_qps: float = 1.0, seed: int = 0, *,
+                            turns_mean: float = 3.0, turns_max: int = 12,
+                            think_mean_s: float = 2.0,
+                            think_sigma: float = 0.6,
+                            first_turn_mean: float = 24.0,
+                            turn_mean: float = 8.0,
+                            turn_sigma: float = 0.6,
+                            out_mean: float = 8.0, out_sigma: float = 0.5,
+                            prompt_max: int = 512, out_max: int = 128,
+                            vocab: int = 1000,
+                            deadline_s: float | None = None) -> list:
+    """Seeded MULTI-TURN trace: conversations arrive Poisson at
+    ``base_qps``; each runs a geometric number of turns (mean
+    ``turns_mean``, capped ``turns_max``) separated by lognormal think
+    times — the warm-turn shape the KV tier serves (docs/serving.md
+    "KV tiering & conversations").
+
+    Every entry carries explicit ``prompt`` token ids and a
+    ``conversation`` id, and turn N+1's prompt EXTENDS turn N's — the
+    user turn plus a seeded stand-in for the assistant reply are
+    appended to the running history — so the prefix property that makes
+    warm turns cheap holds by construction and the whole trace is
+    reproducible from ``seed``.  Entries are ``replay_http``- and
+    FleetSim-compatible (the superset schema: ``t``, ``prompt``,
+    ``prompt_len``, ``max_tokens``, ``conversation``, ``turn``).  A
+    conversation whose history would outgrow ``prompt_max`` simply
+    ends early (the serving window is the real budget too).
+    """
+    if duration_s <= 0 or base_qps <= 0:
+        raise ValueError("duration_s and base_qps must be positive")
+    if turns_mean < 1.0:
+        raise ValueError("turns_mean must be >= 1")
+    rs = np.random.RandomState(seed)
+    entries = []
+    t = 0.0
+    cidx = 0
+    while True:
+        t += float(rs.exponential(1.0 / base_qps))
+        if t >= duration_s:
+            break
+        cidx += 1
+        cid = f"conv-{seed}-{cidx}"
+        n_turns = int(np.clip(rs.geometric(1.0 / turns_mean),
+                              1, turns_max))
+        first_len = int(np.clip(
+            rs.lognormal(math.log(first_turn_mean), turn_sigma), 1,
+            prompt_max))
+        history = [int(x) for x in rs.randint(1, vocab, first_len)]
+        tt = t
+        for turn in range(n_turns):
+            max_tokens = int(np.clip(
+                rs.lognormal(math.log(out_mean), out_sigma), 1, out_max))
+            if len(history) + max_tokens > prompt_max:
+                break
+            entry = {"t": round(tt, 4), "prompt": list(history),
+                     "prompt_len": len(history),
+                     "max_tokens": max_tokens,
+                     "conversation": cid, "turn": turn}
+            if deadline_s is not None:
+                entry["deadline_s"] = float(deadline_s)
+            entries.append(entry)
+            # the stand-in reply + the next user turn extend the history
+            # (a real client appends the ACTUAL reply; the stand-in
+            # keeps the trace seed-reproducible — the shared prefix is
+            # the previous PROMPT either way)
+            reply = [int(x) for x in rs.randint(1, vocab, max_tokens)]
+            user_len = int(np.clip(
+                rs.lognormal(math.log(turn_mean), turn_sigma), 1,
+                prompt_max))
+            user = [int(x) for x in rs.randint(1, vocab, user_len)]
+            history = history + reply + user
+            tt += float(rs.lognormal(math.log(think_mean_s), think_sigma))
+    entries.sort(key=lambda e: (e["t"], e["conversation"], e["turn"]))
+    return entries
+
+
 def replay_http(url: str, trace, *, vocab: int = 1000, seed: int = 0,
                 tenant: str = "load", timeout_s: float = 600.0,
                 max_in_flight: int = 256, speed: float = 1.0,
@@ -141,7 +218,8 @@ def replay_http(url: str, trace, *, vocab: int = 1000, seed: int = 0,
             payload = {"prompt": prompt, "max_tokens": entry["max_tokens"]}
             if entry.get("deadline_s") is not None:
                 payload["deadline_ms"] = int(entry["deadline_s"] * 1e3)
-            for k in ("temperature", "top_k", "seed", "model", "priority"):
+            for k in ("temperature", "top_k", "seed", "model", "priority",
+                      "conversation"):
                 if entry.get(k) is not None:
                     payload[k] = entry[k]
             conn = http.client.HTTPConnection(host, port,
@@ -230,6 +308,13 @@ def main() -> int:
                     help="replay a saved trace/capture JSON (a list of "
                     "entries, or a /debug/capture dump) instead of "
                     "generating one")
+    ap.add_argument("--conversations", action="store_true",
+                    help="generate a multi-turn conversation trace "
+                    "(make_conversation_trace) instead of independent "
+                    "arrivals — exercises /v1/chat-style prefix reuse "
+                    "via the `conversation` field")
+    ap.add_argument("--turns-mean", type=float, default=3.0,
+                    help="mean turns per conversation (--conversations)")
     args = ap.parse_args()
     if args.trace:
         with open(args.trace, encoding="utf-8") as f:
@@ -242,6 +327,17 @@ def main() -> int:
                      for e in sorted(trace, key=lambda e: e["t"])]
         print(f"# trace: {len(trace)} arrivals from {args.trace}",
               file=sys.stderr)
+    elif args.conversations:
+        trace = make_conversation_trace(
+            args.duration, args.qps, args.seed,
+            turns_mean=args.turns_mean,
+            first_turn_mean=args.prompt_mean, turn_mean=args.out_mean,
+            out_mean=args.out_mean, prompt_max=args.prompt_max,
+            out_max=args.out_max, vocab=args.vocab,
+            deadline_s=args.deadline_s)
+        n_conv = len({e["conversation"] for e in trace})
+        print(f"# trace: {len(trace)} turns across {n_conv} "
+              f"conversations over {args.duration}s", file=sys.stderr)
     else:
         trace = make_trace(
             args.duration, args.qps, args.seed,

@@ -45,6 +45,7 @@ DEFAULT_SUBSET = [
     "tests/test_autoscale.py",
     "tests/test_slo.py",
     "tests/test_capture.py",
+    "tests/test_kv_tier.py",
 ]
 
 # decode fast-path lane (ISSUE 10): prefix cache + speculation + int8 KV
@@ -721,6 +722,141 @@ print("capture lane ok:", {
     "sim_peak_replicas": res["peak_replicas"]})
 """
 
+# conversation lane (ISSUE 18): a two-turn /v1/chat/completions chat
+# through a SUPERVISED replica with a forced eviction between the turns.
+# Turn 1 demotes to the host-DRAM tier when filler traffic evicts it, the
+# warm turn (history + reply + new user message) is served via host-tier
+# promote — one decode signature — and the whole path exports: demote /
+# promote counters through /metrics, the hbm ledger host_prefix owner
+# row, the prefix_promote journey phase, and the capture conversation
+# filter.
+CONVERSATION_LANE = r"""
+import http.client, json
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu import observability as obs
+from paddle_tpu.models import build_gpt, gpt_config
+from paddle_tpu.serving import Engine, EngineSupervisor, HostPrefixTier
+from paddle_tpu.serving.engine import (SERVING_HOST_PREFIX_HITS,
+                                       SERVING_HOST_PREFIX_PROMOTES)
+from paddle_tpu.serving.gateway import TenantConfig, start_gateway
+from paddle_tpu.serving.kv_tier import (SERVING_HOST_PREFIX_DEMOTES,
+                                        SERVING_HOST_PREFIX_ENTRIES)
+
+assert obs.enabled(), "PADDLE_TPU_TELEMETRY=1 must bootstrap telemetry"
+cfg = gpt_config("gpt-tiny", max_position_embeddings=128,
+                 hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+paddle.seed(0)
+model = build_gpt(cfg)
+model.eval()
+tier = HostPrefixTier(capacity_mb=32, block=4)
+
+
+def factory():
+    return Engine(model, max_slots=2, max_len=48, max_queue=32,
+                  prefix_cache=True, prefix_block=4, paged_kv=True,
+                  num_pages=24, host_prefix=tier)
+
+
+sup = EngineSupervisor(factory, name="conv0", poll_interval_s=0.02)
+stack = start_gateway([sup], own_engines=True, names=["conv0"],
+                      tenants=[TenantConfig("acme")], capture_mode="full")
+
+
+def post(path, payload):
+    conn = http.client.HTTPConnection("127.0.0.1", stack.port, timeout=300)
+    conn.request("POST", path, json.dumps(payload).encode(),
+                 {"Content-Type": "application/json", "X-Tenant": "acme"})
+    r = conn.getresponse()
+    body = r.read()
+    conn.close()
+    assert r.status == 200, (r.status, body)
+    return body
+
+
+def get(path):
+    conn = http.client.HTTPConnection("127.0.0.1", stack.port, timeout=60)
+    conn.request("GET", path)
+    body = conn.getresponse().read()
+    conn.close()
+    return body
+
+
+rs = np.random.RandomState(5)
+u1 = [int(x) for x in rs.randint(1, cfg.vocab_size, 12)]
+try:
+    # turn 1: blocking chat
+    b1 = json.loads(post("/v1/chat/completions",
+                         {"messages": [{"role": "user", "content": u1}],
+                          "max_tokens": 4, "conversation": "chat-1"}))
+    assert b1["object"] == "chat.completion", b1
+    assert b1["conversation"] == "chat-1", b1
+    r1 = b1["choices"][0]["message"]["token_ids"]
+    assert len(r1) == 4, b1
+    # forced eviction between the turns: filler conversations overrun
+    # the 24-page pool, so turn 1's entry demotes to the host tier
+    for i in range(6):
+        post("/v1/completions",
+             {"prompt": [int(x) for x in rs.randint(1, cfg.vocab_size, 12)],
+              "max_tokens": 4, "conversation": f"fill{i}"})
+    assert tier.flush(), "spill worker never drained"
+    assert len(tier) > 0, "no entry demoted to the host tier"
+    # warm turn: the full history + the new user message, STREAMED
+    u2 = [int(x) for x in rs.randint(1, cfg.vocab_size, 4)]
+    msgs = [{"role": "user", "content": u1},
+            {"role": "assistant", "content": r1},
+            {"role": "user", "content": u2}]
+    conn = http.client.HTTPConnection("127.0.0.1", stack.port, timeout=300)
+    conn.request("POST", "/v1/chat/completions",
+                 json.dumps({"messages": msgs, "max_tokens": 4,
+                             "conversation": "chat-1",
+                             "stream": True}).encode(),
+                 {"Content-Type": "application/json", "X-Tenant": "acme"})
+    r = conn.getresponse()
+    assert r.status == 200, r.status
+    warm_toks = []
+    for line in r:
+        if not line.startswith(b"data: "):
+            continue
+        data = line[6:].strip()
+        if data == b"[DONE]":
+            break
+        ev = json.loads(data)
+        assert ev["object"] == "chat.completion.chunk", ev
+        warm_toks += ev["choices"][0]["delta"].get("token_ids", [])
+    conn.close()
+    assert len(warm_toks) == 4, warm_toks
+    st = sup.stats()
+    assert st["host_prefix_hits"] >= 1 and \
+        st["host_prefix_promotes"] >= 1, st
+    assert st["decode_compiles"] == 1, st
+    # telemetry through the wire: tier counters + the ledger owner row
+    text = get("/metrics").decode()
+    for name in (SERVING_HOST_PREFIX_DEMOTES, SERVING_HOST_PREFIX_ENTRIES,
+                 SERVING_HOST_PREFIX_HITS, SERVING_HOST_PREFIX_PROMOTES):
+        assert name in text, name
+    assert 'paddle_tpu_hbm_bytes{owner="host_prefix"}' in text
+    # the warm turn's journey carries the prefix_promote phase
+    tls = json.loads(get("/debug/requests?last=50"))["requests"]
+    assert any(p["phase"] == "prefix_promote"
+               for tl in tls for p in tl["phases"]), \
+        [p["phase"] for tl in tls for p in tl["phases"]]
+    # capture attribution: the conversation filter isolates the chat
+    dump = json.loads(get("/debug/capture?conversation=chat-1"))
+    assert len(dump["window"]) == 2, dump
+    assert all(e["conversation"] == "chat-1" for e in dump["window"])
+finally:
+    stack.close()
+tier.check()
+tier.close()
+assert tier.bytes_used == 0, tier.stats()
+print("conversation lane ok:", {
+    "host_prefix_hits": st["host_prefix_hits"],
+    "host_prefix_promotes": st["host_prefix_promotes"],
+    "demotes": tier.stats()["demotes"],
+    "decode_compiles": st["decode_compiles"]})
+"""
+
 # prefetch-on training lane: fit a tiny model THROUGH DevicePrefetcher with
 # telemetry live and assert the input-pipeline series were exported.  Runs
 # in its own interpreter so the env-var bootstrap path is what's exercised.
@@ -854,6 +990,16 @@ def main() -> int:
         if cap_rc != 0:
             print("capture lane FAILED", file=sys.stderr)
         rc = rc or cap_rc
+        # conversation lane (ISSUE 18): two-turn HTTP chat through a
+        # supervised replica with a forced eviction between the turns —
+        # warm turn via host-tier promote, one decode signature, tier
+        # metrics + journey phase + capture filter exported
+        print("telemetry smoke: conversation lane", file=sys.stderr)
+        cv_rc = subprocess.call([sys.executable, "-c", CONVERSATION_LANE],
+                                env=env, cwd=root)
+        if cv_rc != 0:
+            print("conversation lane FAILED", file=sys.stderr)
+        rc = rc or cv_rc
         # tpu-lint ratchet gate (ISSUE 7): runs even when the pytest
         # subset has unrelated failures, in its own interpreter (the
         # analyzer is jax-free, so it cannot be broken by runtime drift)
