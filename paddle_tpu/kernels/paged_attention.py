@@ -1,0 +1,291 @@
+"""Paged decode-attention as a Pallas TPU kernel (ISSUE 19) — the fused
+read for the serving engine's paged KV pool (docs/serving.md "Paged KV").
+
+The XLA paged read gathers every slot's pages into a ``[B, L_virt, heads,
+head_dim]`` temp per layer and (int8 pools) dequantizes as a separate
+pass, so HBM streams f32 gather bytes regardless of what the pool stores.
+This kernel walks the page table directly instead:
+
+* the per-slot int32 page table and lengths ride as **scalar-prefetch**
+  operands (SMEM, available before the body runs), so each grid step can
+  compute which physical page it needs and DMA exactly that
+  ``[page_size, heads, head_dim]`` page from HBM into VMEM — no
+  ``[B, L_virt, ...]`` gather temp exists anywhere;
+* int8 pools dequantize **inside the page read** (``q_i8 * scale`` on the
+  VMEM tile), so HBM streams the int8 pool bytes — the stored-bytes
+  ratio becomes the streamed-bytes ratio;
+* pages past a row's live span (``start + W``) are skipped entirely:
+  bytes scale with the tokens actually resident, not the table width.
+
+Grid ``(B, 2, n_pt)``, phases sequential per row (``arbitrary``):
+
+* phase 0 streams the row's K pages and writes masked scaled scores into
+  a per-row VMEM scores scratch (position ``p`` attends to query ``j``
+  iff ``p <= start + j`` — the causal-within-span + validity mask of
+  models/gpt.py's paged branch, bit for bit);
+* phase 1 softmaxes the **whole** scores row in one shot (same f32
+  exp/sum shape as ``_sdpa_ref``'s ``jax.nn.softmax``, which keeps
+  greedy argmax aligned with the XLA path), then streams the row's V
+  pages and accumulates ``probs @ V`` per page.
+
+Two phases read K then V once each — the same HBM traffic as a one-pass
+online-softmax kernel, without the rescaling carry.  Sentinel table
+entries (``>= num_pages``) clamp to the last physical page exactly like
+the XLA gather's ``pt_safe`` clip; parked rows (``start == L_virt``)
+produce the same never-read garbage either way.
+
+Correctness gates through interpret mode on CPU (auto-detected, or
+``PADDLE_TPU_PALLAS_INTERPRET=1`` / :func:`use_interpret_mode`); the
+serving engine routes decode through here only inside
+:func:`decode_kernel_scope` (``Engine(decode_kernel="pallas")``), the
+same trace-local mechanism the multi-LoRA adapter path uses.
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+import math
+import os
+import threading
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+# this jax exposes the compiler-params dataclass under its older name
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or \
+    getattr(pltpu, "TPUCompilerParams")
+
+# interpret-mode resolution: None = auto (env var, else non-TPU backend);
+# use_interpret_mode() pins it for tests/debugging
+_INTERPRET = None
+
+
+def use_interpret_mode(flag):
+    """Pin interpret mode on/off, or ``None`` to restore auto-detect."""
+    global _INTERPRET
+    _INTERPRET = None if flag is None else bool(flag)
+
+
+def _interpret_now() -> bool:
+    if _INTERPRET is not None:
+        return _INTERPRET
+    env = os.environ.get("PADDLE_TPU_PALLAS_INTERPRET", "")
+    if env:
+        return env not in ("0", "false", "False")
+    return jax.default_backend() != "tpu"
+
+
+# -- trace-local routing scope ------------------------------------------------
+#
+# The engine enters this scope inside its decode jit (and only there), so
+# the model's paged cache branch routes its attention read through the
+# kernel for exactly that program — prefill/tail-prefill keep the XLA
+# read, and the decode signature count stays at ONE per config (the scope
+# is a trace-time routing decision, not an operand).
+
+_TLS = threading.local()
+
+
+@contextlib.contextmanager
+def decode_kernel_scope():
+    prev = getattr(_TLS, "active", False)
+    _TLS.active = True
+    try:
+        yield
+    finally:
+        _TLS.active = prev
+
+
+def active() -> bool:
+    """True while tracing inside :func:`decode_kernel_scope`."""
+    return getattr(_TLS, "active", False)
+
+
+# -- analytic cost registration (observability/perfscope.py) ------------------
+#
+# XLA's cost_analysis books a pallas custom call at zero flops/bytes, so
+# the kernel registers its own analytic numbers once per shape signature
+# — the per-program roofline (PR 14) then attributes kernel dispatches
+# the same way it does the jit programs around them.
+
+_COSTS_BOOKED = set()
+PERFSCOPE_PROGRAM = "kernels.paged_attention"
+
+
+def _book_cost(B, W, H, D, P, n_pt, quant):
+    key = f"B{B}xW{W}xH{H}xD{D}/P{P}x{n_pt}" + ("/int8" if quant else "/f32")
+    if key in _COSTS_BOOKED:
+        return
+    _COSTS_BOOKED.add(key)
+    virt = n_pt * P
+    # QK^T + probs@V: 2 matmuls of [W, virt] x [virt, D] per head per row
+    flops = 4.0 * B * H * W * virt * D
+    esize = 1 if quant else 4
+    pool_bytes = 2.0 * B * virt * H * D * esize      # K + V pages streamed
+    if quant:
+        pool_bytes += 2.0 * B * virt * 4             # f32 scale sidecars
+    io_bytes = 2.0 * B * W * H * D * 4               # q in + out
+    try:
+        from ..observability import perfscope
+        perfscope.register_cost(PERFSCOPE_PROGRAM, key,
+                                {"flops": flops,
+                                 "bytes accessed": pool_bytes + io_bytes})
+    except Exception:  # noqa: BLE001 — observability must never break math
+        pass
+
+
+# -- kernel body --------------------------------------------------------------
+
+def _decode_kernel(pt_ref, len_ref, q_ref, k_hbm, v_hbm, *rest,
+                   P, n_pt, NP, W, H, D, scale, quant):
+    if quant:
+        ks_hbm, vs_hbm, o_ref, s_ref, acc_ref, kv_vmem, sc_vmem, sem, \
+            ssem = rest
+    else:
+        o_ref, s_ref, acc_ref, kv_vmem, sem = rest
+    b, ph, i = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    start = len_ref[b]
+    # page i holds positions [i*P, (i+1)*P): live for this row iff any of
+    # them is attendable by the widest query (start + W - 1)
+    needed = (i * P) < (start + W)
+    # sentinel entries (>= NP) clamp to the last physical page — same
+    # bytes the XLA gather's pt_safe clip reads, masked out below
+    pid = jnp.minimum(pt_ref[b, i], NP - 1)
+
+    def _page(hbm_ref, sc_ref):
+        """DMA one K/V page (+ its scale sidecar) and dequantize."""
+        cp = pltpu.make_async_copy(hbm_ref.at[pid], kv_vmem, sem)
+        cp.start()
+        if quant:
+            cs = pltpu.make_async_copy(sc_ref.at[pid], sc_vmem, ssem)
+            cs.start()
+            cp.wait()
+            cs.wait()
+            return kv_vmem[...].astype(jnp.float32) * \
+                sc_vmem[...][:, None, None]
+        cp.wait()
+        return kv_vmem[...].astype(jnp.float32)
+
+    @pl.when((ph == 0) & needed)
+    def _scores():
+        kh = jnp.transpose(_page(k_hbm, ks_hbm if quant else None),
+                           (1, 0, 2))                        # [H, P, D]
+        qh = jnp.transpose(q_ref[0].astype(jnp.float32), (1, 0, 2))
+        s = jax.lax.dot_general(
+            qh, kh, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * jnp.float32(scale)
+        col = i * P + jax.lax.broadcasted_iota(jnp.int32, (W, P), 1)
+        row = jax.lax.broadcasted_iota(jnp.int32, (W, P), 0)
+        s = jnp.where((col <= start + row)[None], s, jnp.float32(_NEG_INF))
+        s_ref[:, :, pl.ds(i * P, P)] = s
+
+    @pl.when((ph == 0) & jnp.logical_not(needed))
+    def _dead():
+        # no DMA for pages past the live span: their scores are -inf, so
+        # phase 1's probs underflow to exactly 0 and the page is skipped
+        s_ref[:, :, pl.ds(i * P, P)] = jnp.full((H, W, P), _NEG_INF,
+                                                jnp.float32)
+
+    @pl.when((ph == 1) & (i == 0))
+    def _softmax():
+        # whole-row softmax in one shot (the _sdpa_ref f32 exp/sum shape);
+        # probs overwrite the scores scratch in place
+        s = s_ref[...]
+        m = jnp.max(s, axis=-1, keepdims=True)
+        p = jnp.exp(s - m)
+        s_ref[...] = p / jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    @pl.when((ph == 1) & needed)
+    def _weighted():
+        vh = jnp.transpose(_page(v_hbm, vs_hbm if quant else None),
+                           (1, 0, 2))                        # [H, P, D]
+        pr = s_ref[:, :, pl.ds(i * P, P)]                    # [H, W, P]
+        acc_ref[...] += jax.lax.dot_general(
+            pr, vh, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
+
+    @pl.when((ph == 1) & (i == n_pt - 1))
+    def _finish():
+        o_ref[0] = jnp.transpose(acc_ref[...], (1, 0, 2)).astype(o_ref.dtype)
+
+
+# -- public API ---------------------------------------------------------------
+
+def paged_decode_attention(q, k_pages, v_pages, page_table, lengths,
+                           k_scale=None, v_scale=None, scale=None):
+    """Fused paged attention read for per-slot decode.
+
+    Args:
+        q: ``[B, W, heads, head_dim]`` queries (W=1 plain decode, W=k
+            speculative verify), already holding the step's new
+            positions ``start .. start+W-1``.
+        k_pages / v_pages: ``[num_pages, page_size, heads, head_dim]``
+            pools, f32 (model dtype) or int8 — **post-write**: the
+            step's scatter must already have landed so the read attends
+            over the new positions exactly like the XLA path.
+        page_table: ``[B, n_pt]`` int32; entries ``>= num_pages`` are
+            sentinels (parked / unallocated).
+        lengths: ``[B]`` int32 per-row start positions (parked rows sit
+            at ``n_pt * page_size``).
+        k_scale / v_scale: ``[num_pages, page_size]`` f32 absmax scales,
+            required iff the pools are int8 (serving/kv_quant.py).
+
+    Returns:
+        ``[B, W, heads, head_dim]`` attention output in ``q.dtype``.
+    """
+    B, W, H, D = q.shape
+    NP, P = k_pages.shape[0], k_pages.shape[1]
+    n_pt = page_table.shape[1]
+    virt = n_pt * P
+    quant = k_pages.dtype == jnp.int8
+    if quant != (k_scale is not None):
+        raise ValueError("int8 pools need k_scale/v_scale and f32 pools "
+                         f"must not pass them (pool {k_pages.dtype}, "
+                         f"k_scale={'set' if k_scale is not None else None})")
+    if scale is None:
+        scale = 1.0 / math.sqrt(D)
+    _book_cost(B, W, H, D, P, n_pt, quant)
+
+    qmap = lambda b, ph, i, *_: (b, ph * 0, i * 0, ph * 0)   # noqa: E731
+    any_spec = pl.BlockSpec(memory_space=pltpu.ANY)
+    in_specs = [pl.BlockSpec((1, W, H, D), qmap), any_spec, any_spec]
+    operands = [jnp.asarray(page_table, jnp.int32),
+                jnp.asarray(lengths, jnp.int32), q, k_pages, v_pages]
+    scratch = [
+        pltpu.VMEM((H, W, virt), jnp.float32),     # scores, then probs
+        pltpu.VMEM((H, W, D), jnp.float32),        # output accumulator
+        pltpu.VMEM((P, H, D), k_pages.dtype),      # the in-flight page
+        pltpu.SemaphoreType.DMA,
+    ]
+    if quant:
+        in_specs += [any_spec, any_spec]
+        operands += [k_scale, v_scale]
+        scratch.insert(3, pltpu.VMEM((P,), jnp.float32))
+        scratch.append(pltpu.SemaphoreType.DMA)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, 2, n_pt),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, W, H, D), qmap),
+        scratch_shapes=scratch,
+    )
+    kernel = functools.partial(
+        _decode_kernel, P=P, n_pt=n_pt, NP=NP, W=W, H=H, D=D,
+        scale=float(scale), quant=quant)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, W, H, D), q.dtype),
+        compiler_params=_CompilerParams(
+            # rows are independent (parallel); the phase/page dims carry
+            # the scores scratch and must run sequentially per row
+            dimension_semantics=("parallel", "arbitrary", "arbitrary"),
+            vmem_limit_bytes=100 * 1024 * 1024),
+        interpret=_interpret_now(),
+    )(*operands)

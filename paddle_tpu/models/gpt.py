@@ -324,6 +324,7 @@ class GPTSelfAttention(Layer):
                     # row writes at its own offset + j and attends causally
                     # within the new span.
                     scale_i = 4 if paged else 3
+                    att_out = None
                     if quantized:
                         from ..serving.kv_quant import (dequantize_pool,
                                                         quantize_rows)
@@ -354,7 +355,6 @@ class GPTSelfAttention(Layer):
                         pid = jnp.where(cols < virt, pt[rows, pslot],
                                         n_pages)
                         off = cols % psz
-                        pt_safe = jnp.clip(pt, 0, n_pages - 1)
                         if quantized:
                             k_raw = k_raw.at[pid, off].set(kq, mode="drop")
                             v_raw = v_raw.at[pid, off].set(vq, mode="drop")
@@ -362,6 +362,29 @@ class GPTSelfAttention(Layer):
                                                              mode="drop")
                             vs_raw = vs_raw.at[pid, off].set(vsc,
                                                              mode="drop")
+                        else:
+                            k_raw = k_raw.at[pid, off].set(
+                                k._value.astype(k_raw.dtype), mode="drop")
+                            v_raw = v_raw.at[pid, off].set(
+                                v._value.astype(v_raw.dtype), mode="drop")
+                        # serving decode with Engine(decode_kernel=
+                        # "pallas"): the attention READ runs as the fused
+                        # Pallas kernel — page-table walk + (int8) dequant
+                        # + masked softmax in one custom call, no
+                        # [B, virt, ...] gather temp.  The write scatter
+                        # above is unchanged, so the kernel attends over
+                        # the post-write pool exactly like the XLA read.
+                        from ..kernels.paged_attention import (
+                            active as _paged_kernel_active)
+                        if _paged_kernel_active():
+                            from ..kernels.paged_attention import (
+                                paged_decode_attention)
+                            att_out = paged_decode_attention(
+                                q._value, k_raw, v_raw, pt, start,
+                                k_scale=ks_raw if quantized else None,
+                                v_scale=vs_raw if quantized else None)
+                        elif quantized:
+                            pt_safe = jnp.clip(pt, 0, n_pages - 1)
                             k_att = dequantize_pool(
                                 k_raw[pt_safe].reshape(
                                     (pt.shape[0], virt) + k_raw.shape[2:]),
@@ -373,10 +396,7 @@ class GPTSelfAttention(Layer):
                                 vs_raw[pt_safe].reshape(pt.shape[0], virt),
                                 v._value.dtype)
                         else:
-                            k_raw = k_raw.at[pid, off].set(
-                                k._value.astype(k_raw.dtype), mode="drop")
-                            v_raw = v_raw.at[pid, off].set(
-                                v._value.astype(v_raw.dtype), mode="drop")
+                            pt_safe = jnp.clip(pt, 0, n_pages - 1)
                             k_att = k_raw[pt_safe].reshape(
                                 (pt.shape[0], virt) + k_raw.shape[2:])
                             v_att = v_raw[pt_safe].reshape(
@@ -405,13 +425,16 @@ class GPTSelfAttention(Layer):
                                 v._value.astype(v_raw.dtype), mode="drop")
                             k_att, v_att = k_raw, v_raw
                         att_len = k_raw.shape[1]
-                    mask = (jnp.arange(att_len)[None, None, :] <=
-                            cols[:, :, None])  # [B, t, L] causal + validity
-                    out = F.scaled_dot_product_attention(
-                        q, _T(k_att, _internal=True),
-                        _T(v_att, _internal=True),
-                        attn_mask=_T(mask[:, None], _internal=True),
-                        dropout_p=0.0, is_causal=False, training=False)
+                    if att_out is not None:
+                        out = _T(att_out, _internal=True)
+                    else:
+                        mask = (jnp.arange(att_len)[None, None, :] <=
+                                cols[:, :, None])  # [B,t,L] causal+validity
+                        out = F.scaled_dot_product_attention(
+                            q, _T(k_att, _internal=True),
+                            _T(v_att, _internal=True),
+                            attn_mask=_T(mask[:, None], _internal=True),
+                            dropout_p=0.0, is_causal=False, training=False)
                     out = out.reshape([b, t, nh * self.head_dim])
                     out = _constrain(out, P(_U, _U, "mp"))
                     out = self.out_proj(out)
@@ -797,7 +820,8 @@ class GPTForPretraining(Layer):
         `eos_token_id` are right-padded with it (0 when no eos is set).
         Extra keyword args reach the Engine — the decode fast-path knobs
         (``kv_dtype="int8"``, ``speculative_k=``, ``prefix_cache=``,
-        ``sample_on_device=``) apply to offline generation too."""
+        ``sample_on_device=``, ``decode_kernel="pallas"`` with
+        ``paged_kv=True``) apply to offline generation too."""
         from ..serving import Engine
 
         ids = np.asarray(input_ids._value if isinstance(input_ids, Tensor)

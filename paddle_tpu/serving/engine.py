@@ -429,6 +429,18 @@ class Engine:
             length ``max_pages_per_slot * page_size``, which may exceed
             ``max_len`` — long-context past the dense pool's compiled
             row length.
+        decode_kernel: ``"xla"`` (default) or ``"pallas"`` — how the
+            decode step READS the paged pool.  ``"pallas"`` (requires
+            ``paged_kv=True``) routes the per-slot attention read
+            through the fused Pallas kernel
+            (kernels/paged_attention.py): the page-table walk, the int8
+            dequant and the masked softmax run in one custom call that
+            DMAs pages straight from HBM — no ``[B, L_virt, ...]``
+            gather temp, int8 pools stream int8 bytes.  Greedy output
+            is token-identical to the XLA read; decode stays ONE
+            compiled signature and composes with every flag here.  On
+            CPU the kernel runs in Pallas interpret mode (auto-detected;
+            the parity gate tier-1 exercises).
         sample_on_device: fuse temperature/top-k/greedy sampling into the
             decode program (per-slot params + counter-based PRNG keys);
             only ``[B(, k)]`` token ids cross the host boundary per step.
@@ -468,6 +480,7 @@ class Engine:
                  page_size: Optional[int] = None,
                  num_pages: Optional[int] = None,
                  max_pages_per_slot: Optional[int] = None,
+                 decode_kernel: str = "xla",
                  adapters=None,
                  weight_dtype: Optional[str] = None,
                  host_prefix_mb: Optional[float] = None,
@@ -550,6 +563,14 @@ class Engine:
                                   max_pages_per_slot is not None):
             raise ValueError("page_size/num_pages/max_pages_per_slot "
                              "require paged_kv=True")
+        if decode_kernel not in ("xla", "pallas"):
+            raise ValueError(f"decode_kernel must be 'xla' or 'pallas', "
+                             f"got {decode_kernel!r}")
+        if decode_kernel == "pallas" and not paged_kv:
+            raise ValueError(
+                "decode_kernel='pallas' requires paged_kv=True — the "
+                "fused kernel reads the pool through the page table")
+        self.decode_kernel = decode_kernel
         self._page_alloc: Optional[PageAllocator] = None
         self._page_tables = None
         if self.paged_kv:
@@ -1150,13 +1171,26 @@ class Engine:
             with self._lock:
                 self._ledger_rows.append(brow)
 
-        def _mstate(values, adp):
+        # Pallas decode kernel (kernels/paged_attention.py): the scope is
+        # entered inside the DECODE jit only, so that one program's paged
+        # attention read traces through the fused kernel while prefill /
+        # tail-prefill keep the XLA gather — a trace-time routing
+        # decision, not an operand, so the signature count is unchanged
+        use_pallas_decode = self.decode_kernel == "pallas"
+        if use_pallas_decode:
+            from ..kernels.paged_attention import (
+                decode_kernel_scope as _pk_scope)
+
+        def _mstate(values, adp, pk=False):
             """Swapped model state, plus the batched-adapter scope when
-            the dispatch carries adapter operands."""
+            the dispatch carries adapter operands, plus the Pallas
+            decode-kernel scope when this jit is the decode step."""
             st = contextlib.ExitStack()
             st.enter_context(_swapped_state(model, values))
             if adp is not None:
                 st.enter_context(_adapter_scope(*adp))
+            if pk:
+                st.enter_context(_pk_scope())
             return st
         pool_dtype = jnp.int8 if quant else None
         paged = self.paged_kv
@@ -1424,7 +1458,7 @@ class Engine:
             # gather/scatter lives in the model's paged cache branch, so
             # this stays ONE compiled program per engine config
             caches_t = _caches_from(pools, lengths, tables)
-            with _mstate(_dq(values), adp):
+            with _mstate(_dq(values), adp, pk=use_pallas_decode):
                 logits, new_caches = _fwd_all(
                     Tensor(ids, _internal=True), caches_t)
             pools = _pools_from(new_caches)

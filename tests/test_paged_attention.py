@@ -1,0 +1,275 @@
+"""Pallas paged decode-attention kernel (kernels/paged_attention.py).
+
+Covers, all in interpret mode (tier-1 runs on CPU):
+
+* kernel-vs-reference parity matrix: pool dtype {f32, int8} x verify
+  width {1, k} x ragged per-row lengths that sit at page starts, exact
+  page boundaries and mid-page, with sentinel page-table entries.
+* operand validation (int8 pools require scale sidecars, f32 forbid).
+* Engine flag validation (``decode_kernel`` value set, pallas requires
+  ``paged_kv=True``).
+* engine-level greedy token parity vs the XLA paged path at ONE
+  compiled decode signature per config, including the full PR 10/11/12
+  flag composition (prefix_cache + speculative_k + int8 KV).
+* supervisor kill/rebuild: parity across the rebuild, zero leaked
+  pages, one decode signature per build.
+* ``generate(decode_kernel=...)`` passthrough parity.
+* perfscope: the kernel books analytic flops/bytes under its own
+  program (XLA's cost_analysis zeroes custom calls).
+"""
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.kernels import paged_attention as pa
+from paddle_tpu.models import build_gpt, gpt_config
+from paddle_tpu.serving import Engine
+
+
+@pytest.fixture(scope="module")
+def tiny_gpt():
+    cfg = gpt_config("gpt-tiny", max_position_embeddings=128,
+                     hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+    paddle.seed(7)
+    model = build_gpt(cfg)
+    model.eval()
+    return model, cfg
+
+
+def _prompts(cfg, n, seed=0):
+    rs = np.random.RandomState(seed)
+    return [rs.randint(0, cfg.vocab_size, ln).astype(np.int64)
+            for ln, _ in zip((3, 7, 17, 2, 11), range(n))]
+
+
+def _run(engine, prompts, new=6, **kw):
+    return [engine.submit(p, max_new_tokens=new, **kw).result(timeout=300)
+            for p in prompts]
+
+
+# -- unit: kernel vs the XLA paged-read math ---------------------------------
+
+def _ref(q, k_pages, v_pages, pt, lengths, k_scale=None, v_scale=None):
+    """The XLA paged branch, verbatim: clip sentinels, gather to
+    [B, virt, H, D], dequantize, mask cols <= start + row, _sdpa_ref."""
+    NP, P = k_pages.shape[:2]
+    B, W, H, D = q.shape
+    virt = pt.shape[1] * P
+    pt_safe = jnp.clip(pt, 0, NP - 1)
+    if k_scale is not None:
+        k = k_pages.astype(jnp.float32) * k_scale[..., None, None]
+        v = v_pages.astype(jnp.float32) * v_scale[..., None, None]
+    else:
+        k, v = k_pages, v_pages
+    k_att = k[pt_safe].reshape((B, virt, H, D))
+    v_att = v[pt_safe].reshape((B, virt, H, D))
+    cols = lengths[:, None] + jnp.arange(W)[None, :]
+    mask = jnp.arange(virt)[None, None, :] <= cols[:, :, None]
+    qt = jnp.swapaxes(q, 1, 2)                       # [B, H, W, D]
+    kt = jnp.swapaxes(k_att, 1, 2)
+    vt = jnp.swapaxes(v_att, 1, 2)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) / np.sqrt(D)
+    scores = jnp.where(mask[:, None], scores, -1e30)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vt)
+    return np.asarray(jnp.swapaxes(out, 1, 2))
+
+
+def _case(W, quant, seed=0):
+    """5 rows over P=8, n_pt=4 pools: lengths at a page start (0), a
+    page boundary (8), mid-page (5, 13) and one parked row (virt)."""
+    rs = np.random.RandomState(seed)
+    P, n_pt, H, D = 8, 4, 2, 16
+    lengths = np.array([0, 5, 8, 13, n_pt * P], np.int32)
+    B = len(lengths)
+    NP = B * n_pt + 3
+    perm = rs.permutation(NP - 1)            # keep one id purely sentinel
+    pt = np.full((B, n_pt), NP, np.int32)    # sentinel = NP
+    for b, ln in enumerate(lengths[:-1]):    # parked row: all sentinels
+        need = -(-int(ln + W) // P)
+        pt[b, :need] = perm[b * n_pt:b * n_pt + need]
+    q = rs.randn(B, W, H, D).astype(np.float32)
+    if quant:
+        k_pages = rs.randint(-127, 128, (NP, P, H, D)).astype(np.int8)
+        v_pages = rs.randint(-127, 128, (NP, P, H, D)).astype(np.int8)
+        ks = (rs.rand(NP, P).astype(np.float32) + 0.1) / 127.0
+        vs = (rs.rand(NP, P).astype(np.float32) + 0.1) / 127.0
+        return q, k_pages, v_pages, pt, lengths, ks, vs
+    k_pages = rs.randn(NP, P, H, D).astype(np.float32)
+    v_pages = rs.randn(NP, P, H, D).astype(np.float32)
+    return q, k_pages, v_pages, pt, lengths, None, None
+
+
+@pytest.mark.parametrize("quant", [False, True], ids=["f32", "int8"])
+@pytest.mark.parametrize("W", [1, 4])
+def test_kernel_parity_matrix(W, quant):
+    q, kp, vp, pt, lengths, ks, vs = _case(W, quant)
+    got = np.asarray(pa.paged_decode_attention(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(pt), jnp.asarray(lengths),
+        k_scale=None if ks is None else jnp.asarray(ks),
+        v_scale=None if vs is None else jnp.asarray(vs)))
+    want = _ref(jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+                jnp.asarray(pt), jnp.asarray(lengths),
+                None if ks is None else jnp.asarray(ks),
+                None if vs is None else jnp.asarray(vs))
+    assert got.shape == q.shape and np.all(np.isfinite(got))
+    live = lengths < pt.shape[1] * kp.shape[1]
+    np.testing.assert_allclose(got[live], want[live],
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_kernel_scale_validation():
+    q, kp, vp, pt, lengths, ks, vs = _case(1, True)
+    with pytest.raises(ValueError, match="k_scale"):
+        pa.paged_decode_attention(jnp.asarray(q), jnp.asarray(kp),
+                                  jnp.asarray(vp), jnp.asarray(pt),
+                                  jnp.asarray(lengths))
+    q, kp, vp, pt, lengths, _, _ = _case(1, False)
+    with pytest.raises(ValueError, match="k_scale"):
+        pa.paged_decode_attention(jnp.asarray(q), jnp.asarray(kp),
+                                  jnp.asarray(vp), jnp.asarray(pt),
+                                  jnp.asarray(lengths),
+                                  k_scale=jnp.asarray(ks),
+                                  v_scale=jnp.asarray(vs))
+
+
+def test_kernel_books_perfscope_cost():
+    from paddle_tpu.observability import perfscope
+    q, kp, vp, pt, lengths, _, _ = _case(1, False, seed=3)
+    q = q[:, :, :, :8]                       # unique shape => unique key
+    kp, vp = kp[:, :, :, :8], vp[:, :, :, :8]
+    pa.paged_decode_attention(jnp.asarray(q), jnp.asarray(kp),
+                              jnp.asarray(vp), jnp.asarray(pt),
+                              jnp.asarray(lengths))
+    costs = perfscope._programs[pa.PERFSCOPE_PROGRAM].costs
+    key, = [k for k in costs if "D8" in k]
+    assert costs[key]["flops"] > 0 and costs[key]["bytes"] > 0
+
+
+# -- Engine: flag validation + parity at one signature -----------------------
+
+def test_engine_flag_validation(tiny_gpt):
+    model, _ = tiny_gpt
+    with pytest.raises(ValueError, match="decode_kernel"):
+        Engine(model, max_slots=2, max_len=32, paged_kv=True,
+               decode_kernel="mosaic")
+    with pytest.raises(ValueError, match="paged_kv"):
+        Engine(model, max_slots=2, max_len=32, decode_kernel="pallas")
+
+
+@pytest.mark.parametrize("kv_dtype,spec_k", [
+    (None, 0), (None, 3), ("int8", 0), ("int8", 3),
+], ids=["f32-w1", "f32-wk", "int8-w1", "int8-wk"])
+def test_engine_token_parity(tiny_gpt, kv_dtype, spec_k):
+    """Greedy decode through the fused kernel is token-identical to the
+    XLA paged path, at ONE compiled decode signature."""
+    model, cfg = tiny_gpt
+    prompts = _prompts(cfg, 3, seed=11)
+    kw = dict(max_slots=4, max_len=64, paged_kv=True, page_size=8,
+              kv_dtype=kv_dtype)
+    if spec_k:
+        kw["speculative_k"] = spec_k
+    base_eng = Engine(model, decode_kernel="xla", **kw)
+    base = _run(base_eng, prompts)
+    base_eng.shutdown()
+    eng = Engine(model, decode_kernel="pallas", **kw)
+    try:
+        got = _run(eng, prompts)
+        assert eng.stats()["decode_compiles"] == 1
+    finally:
+        eng.shutdown()
+    for b, g in zip(base, got):
+        np.testing.assert_array_equal(g, b)
+
+
+def test_all_flags_one_signature(tiny_gpt):
+    """The full flag composition (prefix_cache + speculative_k + int8 KV
+    + paged_kv) stays token-identical and one-signature under the
+    kernel."""
+    model, cfg = tiny_gpt
+    rs = np.random.RandomState(21)
+    shared = rs.randint(0, cfg.vocab_size, 12).astype(np.int64)
+    prompts = [np.concatenate([shared,
+                               rs.randint(0, cfg.vocab_size, 3)
+                               .astype(np.int64)]) for _ in range(3)]
+    kw = dict(max_slots=3, max_len=64, paged_kv=True, page_size=8,
+              prefix_cache=True, prefix_block=4, speculative_k=3,
+              kv_dtype="int8")
+    base_eng = Engine(model, decode_kernel="xla", **kw)
+    base = _run(base_eng, prompts)
+    base_eng.shutdown()
+    eng = Engine(model, decode_kernel="pallas", **kw)
+    try:
+        got = _run(eng, prompts)
+        st = eng.stats()
+        assert st["decode_compiles"] == 1, st
+        assert st["prefix_hits"] > 0
+    finally:
+        eng.shutdown()
+    for b, g in zip(base, got):
+        np.testing.assert_array_equal(g, b)
+
+
+def test_supervisor_rebuild_pallas(tiny_gpt):
+    """Kill/rebuild with the kernel on: parity across the rebuild, the
+    dead build leaks zero pages, every build has one decode
+    signature."""
+    from paddle_tpu.serving import EngineSupervisor
+    from paddle_tpu.testing import faults
+
+    model, cfg = tiny_gpt
+    prompts = _prompts(cfg, 2, seed=15)
+    cold = Engine(model, max_slots=2, max_len=64, paged_kv=True,
+                  page_size=8)
+    base = _run(cold, prompts)
+    cold.shutdown()
+
+    engines = []
+
+    def factory():
+        e = Engine(model, max_slots=2, max_len=64, paged_kv=True,
+                   page_size=8, decode_kernel="pallas")
+        engines.append(e)
+        return e
+
+    sup = EngineSupervisor(factory, name="pallas", poll_interval_s=0.02,
+                           max_restarts=4)
+    try:
+        np.testing.assert_array_equal(
+            sup.submit(prompts[0], max_new_tokens=6).result(timeout=300),
+            base[0])
+        faults.arm("serving.scheduler", times=1)
+        deadline = time.time() + 120
+        while sup.restarts < 1:
+            assert time.time() < deadline, "kill never absorbed"
+            time.sleep(0.01)
+        dead = engines[0]
+        dead._page_alloc.check()
+        assert dead._page_alloc.n_used == 0
+        np.testing.assert_array_equal(
+            sup.submit(prompts[1], max_new_tokens=6).result(timeout=300),
+            base[1])
+        assert engines[-1] is not engines[0]
+        for b in sup.builds():
+            assert b["decode_compiles"] <= 1, sup.builds()
+    finally:
+        sup.shutdown()
+
+
+def test_generate_passthrough(tiny_gpt):
+    """generate(decode_kernel=...) reaches the Engine (mirror of the
+    kv_dtype passthrough) and preserves greedy outputs."""
+    model, cfg = tiny_gpt
+    rs = np.random.RandomState(33)
+    ids = rs.randint(0, cfg.vocab_size, (2, 6)).astype(np.int64)
+    base = model.generate(ids, max_new_tokens=6, paged_kv=True,
+                          page_size=8)
+    got = model.generate(ids, max_new_tokens=6, paged_kv=True,
+                         page_size=8, decode_kernel="pallas")
+    np.testing.assert_array_equal(got, base)

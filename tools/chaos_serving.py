@@ -593,6 +593,70 @@ def main() -> int:
         tier.close()
         assert tier.bytes_used == 0 and len(tier) == 0, tier.stats()
 
+        # -- Pallas decode kernel under chaos (ISSUE 19): the fused
+        # paged-attention read (Engine(decode_kernel="pallas"), interpret
+        # mode off-TPU) composed with the FULL PR 10/11/12 flag set —
+        # prefix cache + speculative verify + int8 KV + device sampling.
+        # A scheduler kill rebuilds the engine; tokens stay identical to
+        # an XLA-paged-read reference across the rebuild, every build
+        # compiles ONE decode signature, and the dead build leaks zero
+        # pages.
+        pk_flags = dict(max_slots=SLOTS, max_len=48, max_queue=16,
+                        prefix_cache=True, prefix_block=4,
+                        speculative_k=3, kv_dtype="int8", paged_kv=True,
+                        num_pages=24)
+        pk_prompts = [[int(t) for t in rs.randint(1, cfg.vocab_size, n)]
+                      for n in (9, 13)]
+        pk_ref_eng = Engine(model3, decode_kernel="xla", **pk_flags)
+        pk_ref = [[int(t) for t in pk_ref_eng.submit(
+            p, max_new_tokens=4).result(timeout=300)]
+            for p in pk_prompts]
+        pk_ref_eng.shutdown()
+        pk_engines: list = []
+
+        def pk_factory():
+            e = Engine(model3, decode_kernel="pallas", **pk_flags)
+            pk_engines.append(e)
+            return e
+
+        pk_sup = EngineSupervisor(pk_factory, name="pallas",
+                                  poll_interval_s=0.02, max_restarts=6,
+                                  max_redispatch=3)
+        try:
+            t0 = [int(t) for t in pk_sup.submit(
+                pk_prompts[0], max_new_tokens=4).result(timeout=300)]
+            assert t0 == pk_ref[0], \
+                "fused kernel diverged from the XLA paged read"
+            faults.arm("serving.scheduler", times=1)
+            pk_poke = pk_sup.submit([2, 7, 1, 8], max_new_tokens=2)
+            deadline = time.time() + 120
+            while pk_sup.restarts == 0:
+                assert time.time() < deadline, \
+                    "pallas-leg kill never absorbed by a restart"
+                time.sleep(0.02)
+            pk_poke.result(timeout=300)
+            # dead build: host bookkeeping fully unwound
+            pk_engines[0]._page_alloc.check()
+            assert pk_engines[0]._page_alloc.n_used == 0, \
+                f"dead pallas build leaked pages: " \
+                f"{pk_engines[0]._page_alloc!r}"
+            t1p = [int(t) for t in pk_sup.submit(
+                pk_prompts[1], max_new_tokens=4).result(timeout=300)]
+            assert t1p == pk_ref[1], \
+                "fused kernel diverged after the rebuild"
+            assert pk_sup.failed is None, pk_sup.failed
+            for b in pk_sup.builds():
+                assert b["decode_compiles"] <= 1, pk_sup.builds()
+            pk_summary = {
+                "pallas_builds": len(pk_engines),
+                "pallas_restarts": pk_sup.restarts,
+                "pallas_decode_compiles": [b["decode_compiles"]
+                                           for b in pk_sup.builds()],
+            }
+        finally:
+            faults.reset()
+            pk_sup.shutdown()
+
         # SLO under chaos (ISSUE 16): the kill matrix is over and the
         # fleet is healthy — any alert the rebuilds raised must clear
         # as the window's errors age out (a stuck-firing alert here
@@ -652,6 +716,7 @@ def main() -> int:
             **journey_summary,
             **scale_summary,
             **kv_summary,
+            **pk_summary,
             **slo_summary,
         }
     finally:
@@ -678,6 +743,14 @@ def main() -> int:
         e._page_alloc.check()
         assert e._page_alloc.n_used == 0, \
             f"leaked pages in a kv-tier build: {e._page_alloc!r}"
+    # and the pallas-kernel builds (ISSUE 19): the fused read borrows
+    # pages through the same allocator — kernel on/off must not change
+    # the zero-leak invariant
+    for e in pk_engines:
+        e.shutdown()
+        e._page_alloc.check()
+        assert e._page_alloc.n_used == 0, \
+            f"leaked pages in a pallas build: {e._page_alloc!r}"
     # fresh adapter banks per rebuild: every build got its OWN residency
     # (stale bank reuse across pools is impossible by construction)
     assert len({id(e._adapters) for e in engines_built}) == \
