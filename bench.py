@@ -1550,10 +1550,31 @@ def _bench_autoscale_curve(measured):
     if auto["flaps"] != 0:
         raise RuntimeError(f"autoscale gate: {auto['flaps']} scale-flaps "
                            f"(events: {auto['events']})")
+    # warm-pool gate (ISSUE 20): the same policy with one parked spare
+    # must answer the flash with a route-in, not a cold build — the
+    # reaction time of every warm scale-up stays under the build time
+    warm_policy = ScalePolicy(slo_ttft_s=slo_ttft_s, headroom_frac=0.4,
+                              up_ticks=1, idle_ticks=8,
+                              cooldown_up_s=4.0, cooldown_down_s=3.0)
+    warm = FleetSim(warm_policy, min_replicas=1, max_replicas=max_n,
+                    build_s=1.5, warm_pool=1, route_in_s=0.05,
+                    **sim_kw).run(trace)
+    wblock = warm["warm"] or {}
+    if not wblock.get("warm_route_ins"):
+        raise RuntimeError(
+            f"warm-pool gate: no warm route-in fired "
+            f"(events: {warm['events']})")
+    if not wblock.get("max_warm_reaction_s", 1.5) < 1.5:
+        raise RuntimeError(
+            f"warm-pool gate: warm reaction "
+            f"{wblock.get('max_warm_reaction_s')}s not under the 1.5s "
+            f"cold build")
     print(f"# autoscale attainment={auto['slo_attainment']} "
           f"replica_s={auto['replica_seconds']} "
           f"(best static {best_att} @ {cheapest_best}) "
-          f"peak={auto['peak_replicas']} events={len(auto['events'])}",
+          f"peak={auto['peak_replicas']} events={len(auto['events'])} "
+          f"warm_route_ins={wblock['warm_route_ins']} "
+          f"warm_reaction_s={wblock['max_warm_reaction_s']}",
           file=sys.stderr)
     return {
         "trace": {"arrivals": len(trace), "duration_s": 60.0,
@@ -1568,6 +1589,10 @@ def _bench_autoscale_curve(measured):
         "autoscaled": {k: auto[k] for k in (
             "slo_attainment", "replica_seconds", "peak_replicas", "shed",
             "flaps", "ttft_p50_s", "ttft_p99_s")},
+        "warm_pool": dict(
+            wblock,
+            slo_attainment=warm["slo_attainment"],
+            replica_seconds=warm["replica_seconds"]),
         "scale_events": auto["events"],
         "curve": [{"replicas": n,
                    "slo_attainment": s["slo_attainment"],
@@ -1575,7 +1600,8 @@ def _bench_autoscale_curve(measured):
                    "shed": s["shed"]}
                   for n, s in sorted(statics.items())],
         "gates": {"attainment_vs_best_static": True,
-                  "fewer_replica_seconds": True, "zero_flaps": True},
+                  "fewer_replica_seconds": True, "zero_flaps": True,
+                  "warm_pool_reaction": True},
     }
 
 

@@ -37,8 +37,16 @@ Replica count is a control loop, not a constant:
 ``Autoscaler(stack, factory, min_replicas=1, max_replicas=8)`` watches
 the gateway's windowed telemetry feed and grows/shrinks the fleet
 (docs/serving.md "Autoscaling"; scale-down always drains first —
-docs/robustness.md "Fleet elasticity").  ``FleetSim`` replays the same
+docs/robustness.md "Fleet elasticity").  ``Autoscaler(warm_pool=1)``
+keeps a built-and-parked standby replica so a flash scale-up is a
+route-in instead of a cold build.  ``FleetSim`` replays the same
 scaling policy against virtual replicas for device-free evaluation.
+
+The fleet's BUILD is upgradeable in place:
+``RolloutController(stack, factory_for_revision).rollout("r1")``
+replaces every replica with the new revision behind a canary gate —
+zero dropped requests, automatic rollback when the canary misbehaves
+(docs/robustness.md "Fleet upgrades").
 
 See docs/serving.md for the architecture, tuning and telemetry fields.
 """
@@ -66,12 +74,21 @@ from .engine import (  # noqa: F401
 from .kv_tier import HostPrefixTier  # noqa: F401
 from .paged_kv import PageAllocator  # noqa: F401
 from .prefix_cache import PrefixEntry, PrefixIndex  # noqa: F401
+from .rollout import (  # noqa: F401
+    CanaryGate,
+    RolloutController,
+    RolloutError,
+    RolloutResult,
+    RolloutRolledBack,
+)
 from .slot_pool import SlotPool  # noqa: F401
 from .speculative import NgramDrafter  # noqa: F401
 from .supervisor import EngineSupervisor  # noqa: F401
 
 __all__ = ["Engine", "EngineSupervisor", "Autoscaler", "ScalePolicy",
-           "FleetSim", "RequestHandle", "SlotPool", "HostPrefixTier",
+           "FleetSim", "RolloutController", "CanaryGate", "RolloutResult",
+           "RolloutRolledBack", "RolloutError",
+           "RequestHandle", "SlotPool", "HostPrefixTier",
            "PageAllocator", "PrefixIndex", "PrefixEntry", "NgramDrafter",
            "AdapterRegistry", "LoraAdapter", "make_lora", "AdapterError",
            "AdapterShapeError", "AdapterRankError", "UnknownAdapterError",

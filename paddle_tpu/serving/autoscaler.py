@@ -43,6 +43,8 @@ import time
 from collections import deque
 from typing import Callable, Optional
 
+import numpy as np
+
 from ..observability import flight, registry
 from ..testing import faults
 
@@ -241,6 +243,15 @@ class Autoscaler:
         name_prefix: replica names are ``{prefix}-s{N}`` with a
             monotone N (never reused, so per-engine metric series never
             collide across builds).
+        warm_pool: parked standby replicas (ISSUE 20 / ROADMAP 5c).
+            With ``warm_pool=1`` a background worker keeps one replica
+            BUILT, PREWARMED and PARKED-DRAINING (``load()`` advertises
+            not-alive, so it refuses work on the shelf): a flash
+            scale-up routes the spare in instead of cold-building —
+            reaction time is a route-in, not the cold-build EWMA — and
+            a refill build starts in the background.  Spares follow the
+            rollout controller's revision: a rollout upgrades the shelf
+            too (stale-revision spares are torn down, never routed in).
     """
 
     def __init__(self, stack, factory: Callable[[], object], *,
@@ -249,7 +260,8 @@ class Autoscaler:
                  poll_interval_s: float = 1.0,
                  drain_deadline_s: float = 30.0,
                  build_s_hint: float = 10.0,
-                 name_prefix: str = "engine", start: bool = True):
+                 name_prefix: str = "engine", warm_pool: int = 0,
+                 start: bool = True):
         gateway = getattr(stack, "gateway", stack)
         if min_replicas < 1 or max_replicas < min_replicas:
             raise ValueError("need 1 <= min_replicas <= max_replicas")
@@ -261,6 +273,7 @@ class Autoscaler:
         self.poll_interval_s = float(poll_interval_s)
         self.drain_deadline_s = float(drain_deadline_s)
         self.name_prefix = str(name_prefix)
+        self.warm_pool = int(warm_pool)
         self._lock = threading.Lock()
         self._stop_ev = threading.Event()
         self._wake_ev = threading.Event()
@@ -271,6 +284,9 @@ class Autoscaler:
         self._builds = 0
         self._events: deque = deque(maxlen=64)
         self._desired = len(gateway.router.names)
+        self._warm: list = []           # parked (name, engine, revision)
+        self._warm_building = False
+        self._warm_n = 0
         self._thread: Optional[threading.Thread] = None
         gateway.attach_autoscaler(self)
         if start:
@@ -288,14 +304,15 @@ class Autoscaler:
             self._thread.start()
 
     def shutdown(self):
-        """Stop the control loop (replicas stay as they are — the stack
-        owns their teardown)."""
+        """Stop the control loop and tear down parked spares (routed
+        replicas stay as they are — the stack owns their teardown)."""
         self._stop_ev.set()
         self._wake_ev.set()
         with self._lock:
             th = self._thread
         if th is not None:
             th.join(timeout=10)
+        self.drop_warm_pool(reason="shutdown")
 
     close = shutdown
 
@@ -342,6 +359,7 @@ class Autoscaler:
             pending, self._pending = self._pending, None
             desired = self._desired
         self._gauges(desired, alive, draining)
+        self._maybe_refill_warm()
         if op is not None:
             return                       # one scale op at a time
         now = time.monotonic()
@@ -405,6 +423,34 @@ class Autoscaler:
             self._wake_ev.set()
 
     def _scale_up(self, reason: str):
+        spare = self._pop_warm()
+        if spare is not None:
+            name, engine, rev = spare
+            flight.record("autoscaler", "scale_up_warm_begin",
+                          replica=name, reason=reason)
+            t0 = time.monotonic()
+            # route-in, not a build: un-park (reverse the shelf drain)
+            # and add to the router — reaction is milliseconds, so the
+            # cold-build EWMA is NOT fed (it must keep measuring builds)
+            undrain = getattr(engine, "undrain", None)
+            if undrain is not None:
+                undrain()
+            self.gateway.router.add_replica(name, engine, revision=rev)
+            route_s = time.monotonic() - t0
+            with self._lock:
+                self._events.append({
+                    "t": time.time(), "direction": "up", "reason": reason,
+                    "replica": name, "ms": round(route_s * 1e3, 1),
+                    "warm": True})
+            registry().counter(
+                FLEET_SCALE_EVENTS, "scale events by direction/reason").inc(
+                1.0, labels={"direction": "up", "reason": reason})
+            flight.record("autoscaler", "scale_up_warm", replica=name,
+                          reason=reason,
+                          route_in_ms=round(route_s * 1e3, 1))
+            self._wake_ev.set()          # refill the shelf promptly
+            return
+        rev, factory = self._current_factory()
         with self._lock:
             self._replica_n += 1
             name = f"{self.name_prefix}-s{self._replica_n}"
@@ -412,8 +458,8 @@ class Autoscaler:
                       reason=reason)
         t0 = time.monotonic()
         faults.fault_point("scale.up_build", replica=name)
-        engine = self.factory()
-        self.gateway.router.add_replica(name, engine)
+        engine = factory()
+        self.gateway.router.add_replica(name, engine, revision=rev)
         self._await_warm(engine)
         build_s = time.monotonic() - t0
         with self._lock:
@@ -428,6 +474,18 @@ class Autoscaler:
             1.0, labels={"direction": "up", "reason": reason})
         flight.record("autoscaler", "scale_up", replica=name,
                       reason=reason, build_ms=round(build_s * 1e3, 1))
+
+    def _current_factory(self) -> tuple:
+        """(revision, zero-arg factory) for the next cold build.  While
+        a rollout controller is attached, builds follow ITS revision —
+        the mid-rollout target, or the fleet's post-upgrade revision —
+        so elasticity never resurrects a superseded build; without one,
+        the constructor's factory at the fleet's revision."""
+        ctl = getattr(self.gateway, "rollout", None)
+        if ctl is not None:
+            return ctl.revision(), ctl.factory()
+        revs = self.gateway.router.revisions()
+        return next(iter(revs.values()), "r0"), self.factory
 
     def _await_warm(self, engine, timeout_s: float = 120.0):
         """Hold the scale-up op open until the new replica is WARM (its
@@ -455,15 +513,23 @@ class Autoscaler:
 
     def _pick_victim(self):
         """(name, engine) with the least load among removable replicas
-        (alive, not draining, not the last ``min_replicas``)."""
+        (alive, not draining, not the last ``min_replicas``).  While a
+        rollout is active its target-revision replicas — the canary and
+        the surge builds — are PROTECTED: scaling one of them down
+        would unwind the upgrade mid-flight."""
         router = self.gateway.router
         loads = router.loads()
         alive = [n for n, ld in loads.items()
                  if ld["alive"] and not ld.get("draining")]
         if len(alive) <= self.min_replicas:
             return None
-        victim = min(alive, key=lambda n: (loads[n]["slots_in_use"] +
-                                           loads[n]["queue_depth"], n))
+        ctl = getattr(self.gateway, "rollout", None)
+        protected = ctl.protected() if ctl is not None else frozenset()
+        candidates = [n for n in alive if n not in protected]
+        if not candidates:
+            return None
+        victim = min(candidates, key=lambda n: (loads[n]["slots_in_use"] +
+                                                loads[n]["queue_depth"], n))
         engines = dict(zip(router.names, router.engines))
         eng = engines.get(victim)
         return (victim, eng) if eng is not None else None
@@ -490,6 +556,10 @@ class Autoscaler:
                 break
             flight.record("autoscaler", "drain_retry", replica=name,
                           attempt=attempts)
+            # a drain that returns False INSTANTLY (the replica died
+            # and its supervisor is mid-rebuild, or a never-warmed
+            # engine is settling) must not spin this worker hot
+            self._stop_ev.wait(min(0.05 * attempts, 0.5))
         else:
             with self._lock:
                 self._desired += 1
@@ -516,6 +586,118 @@ class Autoscaler:
         flight.record("autoscaler", "scale_down", replica=name,
                       reason=reason, drain_ms=round(drain_s * 1e3, 1),
                       drain_attempts=attempts)
+
+    # -- warm pool (ROADMAP 5c) ----------------------------------------------
+    def _maybe_refill_warm(self):
+        """Kick the background refill when the shelf is short (one
+        refill build at a time; every control-loop tick checks)."""
+        if self.warm_pool <= 0:
+            return
+        with self._lock:
+            if self._warm_building or len(self._warm) >= self.warm_pool:
+                return
+            self._warm_building = True
+        threading.Thread(target=self._warm_build_worker,
+                         name="paddle-tpu-warm-pool", daemon=True).start()
+
+    def _warm_build_worker(self):
+        try:
+            rev, factory = self._current_factory()
+            with self._lock:
+                self._warm_n += 1
+                name = f"{self.name_prefix}-w{self._warm_n}"
+            t0 = time.monotonic()
+            eng = factory()
+            self._prewarm(eng)
+            try:
+                # park: the shelf drain makes load() advertise
+                # not-alive, so the spare refuses work until routed in
+                eng.drain(0.5)
+            except Exception:  # noqa: BLE001 — stubs without drain park as-is
+                pass
+            with self._lock:
+                parked = (not self._stop_ev.is_set() and
+                          len(self._warm) < self.warm_pool)
+                if parked:
+                    self._warm.append((name, eng, rev))
+            if not parked:
+                try:
+                    eng.shutdown()
+                except Exception:  # noqa: BLE001 — never routed
+                    pass
+                return
+            flight.record("autoscaler", "warm_park", replica=name,
+                          revision=rev,
+                          build_ms=round((time.monotonic() - t0) * 1e3, 1))
+        except Exception as e:  # noqa: BLE001 — a failed refill is
+            # absorbed; the next tick retries it
+            flight.record("autoscaler", "warm_build_failed",
+                          error=f"{type(e).__name__}: {e}")
+        finally:
+            with self._lock:
+                self._warm_building = False
+
+    @staticmethod
+    def _prewarm(eng):
+        """Compile the spare's programs BEFORE parking — a spare that
+        still owes its cold compile would make the warm route-in a lie.
+        Best-effort: stub engines park un-warmed."""
+        try:
+            h = eng.submit(np.arange(1, 5, dtype=np.int64),
+                           max_new_tokens=2)
+            h.result(timeout=120)
+        except Exception:  # noqa: BLE001 — warmth is an optimisation
+            pass
+
+    def _pop_warm(self):
+        """The first parked spare at the fleet's CURRENT target
+        revision; stale-revision spares found on the way are torn down
+        (an old build must never route into an upgraded fleet)."""
+        if self.warm_pool <= 0:
+            return None
+        ctl = getattr(self.gateway, "rollout", None)
+        want = ctl.revision() if ctl is not None else None
+        picked = None
+        stale = []
+        with self._lock:
+            keep = []
+            for item in self._warm:
+                if want is not None and item[2] != want:
+                    stale.append(item)
+                elif picked is None:
+                    picked = item
+                else:
+                    keep.append(item)
+            self._warm = keep
+        for name, eng, rev in stale:
+            flight.record("autoscaler", "warm_drop", replica=name,
+                          revision=rev, reason="stale_revision")
+            try:
+                eng.shutdown()
+            except Exception:  # noqa: BLE001 — never routed
+                pass
+        return picked
+
+    def drop_warm_pool(self, keep_revision: Optional[str] = None,
+                       reason: str = "rollout"):
+        """Tear down parked spares NOT at ``keep_revision`` (the
+        rollout controller calls this after an upgrade, so the shelf
+        refills at the new revision; ``None`` drops everything)."""
+        with self._lock:
+            keep, drop = [], []
+            for item in self._warm:
+                (keep if (keep_revision is not None and
+                          item[2] == keep_revision) else drop).append(item)
+            self._warm = keep
+        for name, eng, rev in drop:
+            flight.record("autoscaler", "warm_drop", replica=name,
+                          revision=rev, reason=reason)
+            try:
+                eng.shutdown()
+            except Exception:  # noqa: BLE001 — never routed
+                pass
+        if drop:
+            self._wake_ev.set()          # refill promptly
 
     # -- operator / gateway surface ------------------------------------------
     def trigger(self, direction: str, reason: str = "manual"):
@@ -572,6 +754,11 @@ class Autoscaler:
                 "build_ewma_s": round(self._build_ewma_s, 3),
                 "builds": self._builds,
                 "events": list(self._events),
+                "warm_pool": {
+                    "size": self.warm_pool,
+                    "building": self._warm_building,
+                    "parked": [{"replica": n, "revision": r}
+                               for n, _, r in self._warm]},
             }
         if op is not None:
             op["elapsed_s"] = round(time.monotonic() - op.pop("t0"), 3)
@@ -632,7 +819,8 @@ class FleetSim:
                  prefill_s: float = 0.05, token_s: float = 0.01,
                  build_s: float = 2.0, slo_ttft_s: Optional[float] = None,
                  tick_s: float = 0.02, policy_poll_s: float = 0.25,
-                 window_s: float = 5.0, slo_evaluator=None):
+                 window_s: float = 5.0, slo_evaluator=None,
+                 warm_pool: int = 0, route_in_s: float = 0.05):
         self.policy = policy
         self.min_replicas = int(min_replicas)
         self.max_replicas = int(max_replicas)
@@ -649,6 +837,12 @@ class FleetSim:
         self.policy_poll_s = float(policy_poll_s)
         self.window_s = float(window_s)
         self.slo_evaluator = slo_evaluator
+        # warm pool (ROADMAP 5c): `warm_pool` pre-built spares sit on
+        # the shelf burning replica-seconds; an up decision consumes
+        # one — the new replica matures in `route_in_s` instead of
+        # `build_s` — and a refill build (build_s) restocks the shelf
+        self.warm_pool = int(warm_pool)
+        self.route_in_s = float(route_in_s)
 
     def _est_ttft(self, queue, fleet, now: float) -> float:
         # the shed formula over SERVICE time: a new arrival waits for
@@ -690,8 +884,15 @@ class FleetSim:
         next_poll = self.policy_poll_s
         replica_seconds = 0.0
         peak = len(fleet)
+        spares = self.warm_pool          # parked spares, ready now
+        refills: list = []               # refill builds, by finish time
+        warm_route_ins = 0
         t_end_cap = (trace[-1]["t"] if trace else 0.0) + 300.0
         while t <= t_end_cap:
+            # warm-pool refills mature
+            while refills and refills[0] <= t:
+                refills.pop(0)
+                spares += 1
             # arrivals
             while i < len(trace) and trace[i]["t"] <= t:
                 e = trace[i]
@@ -783,13 +984,25 @@ class FleetSim:
                     if decision == "up" and \
                             len(fleet) < self.max_replicas:
                         self.policy.note_event("up", t)
+                        if spares > 0:
+                            # route the parked spare in: reaction is a
+                            # route-in, and a refill restocks the shelf
+                            spares -= 1
+                            warm_route_ins += 1
+                            reaction = self.route_in_s
+                            refills.append(t + self.build_s)
+                            refills.sort()
+                        else:
+                            reaction = self.build_s
                         fleet.append(_SimReplica(
                             f"sim{next_name}", "building", t,
-                            ready_at=t + self.build_s))
+                            ready_at=t + reaction))
                         next_name += 1
                         events.append({"t": round(t, 3),
                                        "direction": "up",
-                                       "reason": reason})
+                                       "reason": reason,
+                                       "warm": reaction < self.build_s,
+                                       "reaction_s": round(reaction, 4)})
                     elif decision == "down":
                         ups = [r for r in fleet if r.state == "up"]
                         if len(ups) > self.min_replicas:
@@ -800,7 +1013,10 @@ class FleetSim:
                             events.append({"t": round(t, 3),
                                            "direction": "down",
                                            "reason": reason})
-            replica_seconds += len(fleet) * self.tick_s
+            # spares and in-flight refills burn replica-seconds too —
+            # the warm pool's cost side of the bench's attainment curve
+            replica_seconds += (len(fleet) + spares +
+                                len(refills)) * self.tick_s
             peak = max(peak, len(fleet))
             if i >= len(trace) and not queue and \
                     all(not rep.active for rep in fleet) and \
@@ -823,8 +1039,20 @@ class FleetSim:
                 "series": slo_series,
                 "attainment_series": slo_att_series,
             }
+        warm_block = None
+        if self.warm_pool > 0:
+            reactions = [e["reaction_s"] for e in events
+                         if e.get("warm")]
+            warm_block = {
+                "pool": self.warm_pool,
+                "route_in_s": self.route_in_s,
+                "warm_route_ins": warm_route_ins,
+                "max_warm_reaction_s": round(max(reactions), 4)
+                if reactions else None,
+            }
         return {
             "slo": slo_block,
+            "warm": warm_block,
             "arrivals": n_arrivals,
             "completed": len(done),
             "shed": len(sheds),

@@ -921,6 +921,23 @@ class Engine:
         flight.record("serving", "drain_done", drained=ok)
         return ok
 
+    def undrain(self):
+        """Reverse :meth:`drain` on a replica that never finished
+        leaving — the warm-pool route-in (ISSUE 20): a parked spare is
+        built and immediately drained (``load()`` advertises not-alive,
+        so it refuses work while parked) until a flash scale-up routes
+        it back into the fleet.  No-op on a live engine; raises on a
+        dead or shut-down one, which must never re-enter a router."""
+        if self._dead is not None:
+            raise EngineDeadError(self._dead) from self._dead
+        if self._stop:
+            raise EngineClosedError("engine is shut down")
+        with self._lock:
+            was = self._draining
+            self._draining = False
+        if was:
+            flight.record("serving", "undrain")
+
     def abandon(self, cause: Optional[BaseException] = None):
         """A supervisor declares this engine dead from OUTSIDE the
         scheduler thread (decode stall: the thread is stuck inside an
@@ -1010,6 +1027,15 @@ class Engine:
         rows don't count: they are reclaimable on demand."""
         with self._lock:
             return self._pool.n_active
+
+    def adapter_resident(self, name: str) -> bool:
+        """True when the LoRA adapter already occupies a bank row in
+        THIS build (loaded or mid-upload) — the router's locality
+        tiebreak: dispatching onto a resident replica skips the
+        admission-time cold load entirely."""
+        with self._lock:
+            return (self._adapters is not None and
+                    self._adapters.slot_of(name) is not None)
 
     def load(self) -> dict:
         """One-lock-hop load snapshot for external admission/routing

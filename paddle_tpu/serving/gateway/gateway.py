@@ -208,6 +208,7 @@ class Gateway:
         self._fleet_lock = threading.Lock()
         self._autoscaler = None
         self._slo_engine = None
+        self._rollout = None
         if start:
             self.start()
 
@@ -238,24 +239,54 @@ class Gateway:
         with self._fleet_lock:
             return self._slo_engine
 
+    def attach_rollout(self, controller):
+        """Register the rolling-upgrade controller (ISSUE 20, one per
+        gateway): a rollout build in flight counts as
+        capacity-on-the-way (no all-dead 503 mid-upgrade), shed
+        Retry-After is capped at its expected warm-up completion, the
+        reaper feeds it per-engine canary outcomes, and
+        ``/debug/fleet`` serves its state."""
+        with self._fleet_lock:
+            self._rollout = controller
+
+    @property
+    def rollout(self):
+        with self._fleet_lock:
+            return self._rollout
+
     def _fleet_pending(self) -> bool:
         """Capacity is leaving-but-finishing or on the way: some replica
         is DRAINING (its in-flight work completes; new work must wait,
-        not 503) or the autoscaler has a scale-up building."""
+        not 503), the autoscaler has a scale-up building, or the rollout
+        controller is mid-build of a replacement replica."""
         a = self.autoscaler
         if a is not None and a.scale_pending():
+            return True
+        r = self.rollout
+        if r is not None and r.build_pending():
             return True
         return self.router.any_draining()
 
     def _scale_eta_s(self) -> float | None:
+        etas = []
         a = self.autoscaler
-        return a.expected_ready_s() if a is not None else None
+        if a is not None:
+            eta = a.expected_ready_s()
+            if eta is not None:
+                etas.append(eta)
+        r = self.rollout
+        if r is not None:
+            eta = r.expected_ready_s()
+            if eta is not None:
+                etas.append(eta)
+        return min(etas) if etas else None
 
     def fleet_stats(self) -> dict:
         """The ``/debug/fleet`` payload: per-replica state from the
         router plus the autoscaler's view (bounds, desired count,
         in-flight op, recent scale events) when one is attached."""
         loads = self.router.loads()
+        revs = self.router.revisions()
         out = {
             "replicas": {
                 name: {"alive": ld["alive"],
@@ -263,7 +294,8 @@ class Gateway:
                        "restarting": bool(ld.get("restarting")),
                        "slots_in_use": ld["slots_in_use"],
                        "queue_depth": ld["queue_depth"],
-                       "max_slots": ld["max_slots"]}
+                       "max_slots": ld["max_slots"],
+                       "revision": revs.get(name, "r0")}
                 for name, ld in loads.items()},
             "alive": sum(1 for ld in loads.values()
                          if ld["alive"] and not ld.get("draining")),
@@ -273,6 +305,8 @@ class Gateway:
         }
         a = self.autoscaler
         out["autoscaler"] = a.fleet_stats() if a is not None else None
+        r = self.rollout
+        out["rollout"] = r.stats() if r is not None else None
         return out
 
     # -- lifecycle -----------------------------------------------------------
@@ -635,7 +669,8 @@ class Gateway:
         tried: list = []
         while True:
             try:
-                name, engine = self.router.pick(exclude=tried)
+                name, engine = self.router.pick(exclude=tried,
+                                                adapter=item.adapter)
             except NoEngineAvailableError as e:
                 if not tried and self._fleet_pending():
                     # nothing pickable RIGHT NOW but a replica is
@@ -746,6 +781,7 @@ class Gateway:
                 self._flush_tokens(item)
                 item.t_first_token = None   # zero tokens reached the client
                 from_engine = item.engine_name or ""
+                self._note_outcome(from_engine, ok=False)
                 t_r0 = time.perf_counter()
                 reg.counter(
                     SERVING_REDISPATCHED,
@@ -780,6 +816,7 @@ class Gateway:
                 # burst of long decodes can no longer starve est_ttft
                 self.shedder.observe_tokens(
                     item.handle.token_latencies_s)
+                gw_ttft = None
                 if item.handle.ttft_s is not None:
                     gw_ttft = (item.t_dispatch - item.t_enqueue) + \
                         item.handle.ttft_s
@@ -787,6 +824,8 @@ class Gateway:
                         GATEWAY_TTFT,
                         "enqueue -> first token, per tenant").observe(
                         gw_ttft, labels={"tenant": item.tenant})
+                self._note_outcome(item.engine_name, ok=True,
+                                   ttft_s=gw_ttft)
                 item.finish(None)
             else:
                 # engine-side failure after dispatch (deadline inside the
@@ -795,8 +834,21 @@ class Gateway:
                 outcome = type(err).__name__
                 self._count(item.tenant, "expired_engine"
                             if "Deadline" in outcome else "failed")
+                self._note_outcome(item.engine_name, ok=False)
                 item.finish(err)
         self._depth_gauges()
+
+    def _note_outcome(self, engine, ok: bool, ttft_s=None):
+        """Feed the rollout controller's per-engine canary window (the
+        reaper is the only place outcomes carry an engine name) —
+        diagnostics, never control flow, so it must not raise into the
+        dispatcher."""
+        ctl = self.rollout
+        if ctl is not None and engine:
+            try:
+                ctl.note_outcome(engine, ok, ttft_s)
+            except Exception:  # noqa: BLE001 — a hook, not the data path
+                pass
 
     def _redispatchable(self, item: GatewayRequest,
                         err: BaseException) -> bool:

@@ -19,12 +19,24 @@ gateway keep queued work parked instead of 503-ing while the only other
 capacity is mid-cold-build.  Removing a replica deletes its per-engine
 gauge series (``paddle_tpu_gateway_engine_slots_in_use{engine=...}``)
 instead of freezing them at the last value.
+
+Each replica also carries a **revision** label (ISSUE 20): the rollout
+controller tags the replicas it builds with the target revision, so
+``/debug/fleet`` and ``paddle_tpu_fleet_replicas_alive{revision=...}``
+show exactly which builds are serving at any instant of an upgrade —
+and the no-mixed-revision-steady-state invariant is assertable.  When
+two alive replicas both have headroom, :meth:`pick` prefers the one
+whose adapter bank already holds the request's LoRA adapter
+(``adapter=``, the locality tiebreak): residency beats least-loaded
+once cold loads dominate TTFT, and with no adapter (or no resident
+replica with room) the ordering is exactly the pre-locality one.
 """
 from __future__ import annotations
 
 import threading
 
 from ...observability import registry
+from ..autoscaler import FLEET_ALIVE
 
 __all__ = ["NoEngineAvailableError", "EngineRouter"]
 
@@ -39,7 +51,7 @@ class NoEngineAvailableError(RuntimeError):
 class EngineRouter:
     """Least-loaded routing over a dynamic set of engine replicas."""
 
-    def __init__(self, engines, names=None):
+    def __init__(self, engines, names=None, revision: str = "r0"):
         engines = list(engines)
         if not engines:
             raise ValueError("router needs at least one engine")
@@ -49,20 +61,24 @@ class EngineRouter:
             raise ValueError("names must be unique, one per engine")
         self._lock = threading.Lock()
         self._engines = list(zip(list(names), engines))
+        self._revisions = {n: str(revision) for n in names}
 
     def _snapshot(self) -> list:
         with self._lock:
             return list(self._engines)
 
     # -- membership (autoscaler control thread vs dispatcher/reaper) ---------
-    def add_replica(self, name: str, engine):
+    def add_replica(self, name: str, engine, revision: str = "r0"):
         """Add one replica under the router's lock; the dispatcher's next
-        ``pick``/``has_headroom`` sees it immediately."""
+        ``pick``/``has_headroom`` sees it immediately.  ``revision``
+        tags the build (the rollout controller's label; scale-ups tag
+        the fleet's current revision)."""
         name = str(name)
         with self._lock:
             if any(n == name for n, _ in self._engines):
                 raise ValueError(f"replica name {name!r} already routed")
             self._engines.append((name, engine))
+            self._revisions[name] = str(revision)
 
     def remove_replica(self, name: str):
         """Remove one replica (returns its engine) and DELETE its
@@ -77,10 +93,20 @@ class EngineRouter:
             if len(self._engines) == 1:
                 raise ValueError("refusing to remove the last replica")
             _, eng = self._engines.pop(idx)
+            self._revisions.pop(name, None)
         registry().gauge(GATEWAY_ENGINE_SLOTS,
                          "per-replica slots owned by requests").remove(
             labels={"engine": name})
         return eng
+
+    def revisions(self) -> dict:
+        """{replica name: revision label} for the current membership."""
+        with self._lock:
+            return dict(self._revisions)
+
+    def revision_of(self, name: str) -> str:
+        with self._lock:
+            return self._revisions.get(name, "r0")
 
     @property
     def engines(self) -> list:
@@ -98,13 +124,18 @@ class EngineRouter:
         out = {}
         alive = 0
         current = self._snapshot()
+        revs = self.revisions()
         gauge = reg.gauge(GATEWAY_ENGINE_SLOTS,
                           "per-replica slots owned by requests")
+        by_rev: dict = {}
         for name, eng in current:
             ld = eng.load()
             out[name] = ld
             alive += bool(ld["alive"])
             gauge.set(float(ld["slots_in_use"]), labels={"engine": name})
+            if ld["alive"] and not ld.get("draining"):
+                rev = revs.get(name, "r0")
+                by_rev[rev] = by_rev.get(rev, 0) + 1
         # sweep series whose engine is no longer routed (a remove_replica
         # racing this refresh can re-export a stale series for one poll)
         routed = {name for name, _ in current}
@@ -114,14 +145,32 @@ class EngineRouter:
                 gauge.remove(labels={"engine": name})
         reg.gauge(GATEWAY_ENGINES_ALIVE, "replicas able to take work").set(
             float(alive))
+        # the revision-labelled fleet view (ISSUE 20): which builds are
+        # serving right now — mid-rollout both revisions export, at the
+        # steady state exactly one does (stale revisions are swept, the
+        # autoscaler's unlabelled series is left alone)
+        alive_g = reg.gauge(FLEET_ALIVE, "alive, non-draining replicas")
+        for rev, n in by_rev.items():
+            alive_g.set(float(n), labels={"revision": rev})
+        for labels, _ in alive_g.series():
+            rev = labels.get("revision")
+            if rev is not None and rev not in by_rev:
+                alive_g.remove(labels={"revision": rev})
         return out
 
-    def pick(self, exclude=()) -> tuple:
+    def pick(self, exclude=(), adapter: str | None = None) -> tuple:
         """(name, engine) of the least-loaded alive replica (slot
         occupancy first, engine queue depth as the tiebreak); raises
         :class:`NoEngineAvailableError` when none qualifies.  Draining
         replicas are never picked — new work (including redispatched
-        parked work) must not land on a replica that is leaving."""
+        parked work) must not land on a replica that is leaving.
+
+        ``adapter`` is the locality tiebreak (ROADMAP 5d): a replica
+        whose bank already holds the request's LoRA adapter AND has a
+        free slot wins over a colder least-loaded one — the dispatch
+        skips the admission-time cold load.  Residency never overrides
+        backpressure: a resident replica with its slot pool full falls
+        back into the ordinary least-loaded order."""
         best = None
         best_key = None
         for name, eng in self._snapshot():
@@ -130,7 +179,18 @@ class EngineRouter:
             ld = eng.load()
             if not ld["alive"] or ld.get("draining"):
                 continue
-            key = (ld["slots_in_use"] + ld["queue_depth"],
+            local = False
+            if adapter is not None:
+                probe = getattr(eng, "adapter_resident", None)
+                if probe is not None:
+                    try:
+                        local = (bool(probe(adapter)) and
+                                 ld["slots_in_use"] + ld["queue_depth"]
+                                 < ld["max_slots"])
+                    except Exception:  # noqa: BLE001 — locality is a hint
+                        local = False
+            key = (0 if local else 1,
+                   ld["slots_in_use"] + ld["queue_depth"],
                    ld["queue_depth"], name)
             if best_key is None or key < best_key:
                 best, best_key = (name, eng), key

@@ -361,6 +361,9 @@ class EngineSupervisor:
     def slots_in_use(self) -> int:
         return self.engine.slots_in_use()
 
+    def adapter_resident(self, name: str) -> bool:
+        return self.engine.adapter_resident(name)
+
     def join(self, timeout: Optional[float] = None) -> bool:
         return self.engine.join(timeout)
 
@@ -368,6 +371,11 @@ class EngineSupervisor:
         """Drain the current engine (no restarts happen past this point:
         drain is the graceful end of the replica's life)."""
         return self.engine.drain(deadline_s)
+
+    def undrain(self):
+        """Warm-pool route-in: un-park the current engine (see
+        :meth:`Engine.undrain`)."""
+        return self.engine.undrain()
 
     def shutdown(self):
         """Stop supervising and shut the current engine down; parked

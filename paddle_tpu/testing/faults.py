@@ -52,7 +52,15 @@ replica — a crash there fails that scale-up, which must be retried),
 ``scale.down_drain`` (before a scale-down's drain begins — the replica
 must still leave only after draining empty) and ``autoscaler.tick``
 (the control loop body, whose crash must be absorbed, never ending
-scaling silently).  A fault anywhere along the restore path must leave
+scaling silently).  The rolling-upgrade path (ISSUE 20):
+``rollout.build`` (before the rollout controller builds a replica at
+the target revision — a crash fails that build, which is retried, or
+rolls the canary back if the retries run out before anything routed
+in), ``rollout.canary_gate`` (inside the canary-judgment loop — a
+crashed evaluation is absorbed and the gate re-judged, never skipped)
+and ``rollout.drain_old`` (before an incumbent's drain begins — the
+old replica must still leave only once empty, exactly like a
+scale-down).  A fault anywhere along the restore path must leave
 BOTH the checkpoint dir and the running train state untouched —
 asserted by the elastic crash matrix in tests/test_elastic.py.
 """
@@ -81,6 +89,7 @@ CATALOGUE = (
     "serving.scheduler", "serving.prefill", "serving.decode",
     "serving.stream", "serving.rebuild", "gateway.dispatch",
     "scale.up_build", "scale.down_drain", "autoscaler.tick",
+    "rollout.build", "rollout.canary_gate", "rollout.drain_old",
     "train.step",
 )
 

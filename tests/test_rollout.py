@@ -1,0 +1,740 @@
+"""Rolling fleet upgrade tests (ISSUE 20): canary-gate judgment units,
+stub-fleet rollouts (success, canary-bite rollback, build-failure
+rollback, misuse), the crash-at-every-new-seam matrix
+(``rollout.build`` / ``rollout.canary_gate`` / ``rollout.drain_old``),
+warm-pool park/route-in/refill and stale-revision drops, the
+adapter-locality routing tiebreak (unit + cold-load regression on a
+skewed-adapter trace), rollout-aware shed Retry-After, drain promptness
+on a never-warmed engine + ``undrain()``, the FleetSim warm-pool model,
+and a real tiny-GPT revision upgrade over HTTP.
+
+The contract under test is docs/robustness.md's "Fleet upgrades"
+section: zero dropped requests across an upgrade, replica retirement
+only as drain → wait-empty → remove → teardown, automatic rollback
+when the canary gate bites (incumbents never touched), and no mixed
+revision at steady state — all-new on success, all-old after rollback.
+"""
+import json
+import sys
+import threading
+import time
+
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import build_gpt, gpt_config
+from paddle_tpu.observability import flight, registry
+from paddle_tpu.serving import (Autoscaler, CanaryGate, Engine, FleetSim,
+                                RolloutController, RolloutError,
+                                RolloutRolledBack, ScalePolicy)
+from paddle_tpu.serving.autoscaler import FLEET_ALIVE
+from paddle_tpu.serving.gateway import Gateway, TenantConfig
+from paddle_tpu.serving.gateway.protocol import parse_completion_request
+from paddle_tpu.serving.gateway.router import EngineRouter
+from paddle_tpu.serving.rollout import FLEET_ROLLOUTS
+from paddle_tpu.testing import faults
+
+sys.path.insert(0, ".")
+from tools.load_gen import make_trace  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def tiny_gpt():
+    cfg = gpt_config("gpt-tiny", max_position_embeddings=128,
+                     hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+    paddle.seed(21)
+    model = build_gpt(cfg)
+    model.eval()
+    return model, cfg
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _wait(pred, timeout=90.0, period=0.01):
+    deadline = time.perf_counter() + timeout
+    while time.perf_counter() < deadline:
+        if pred():
+            return True
+        time.sleep(period)
+    return False
+
+
+def _creq(max_tokens=3, prompt=(1, 2, 3), **extra):
+    payload = {"prompt": list(prompt), "max_tokens": max_tokens}
+    payload.update(extra)
+    return parse_completion_request(json.dumps(payload).encode(),
+                                    has_tokenizer=False)
+
+
+class StubEngine:
+    """Engine-shaped fake for router/rollout units: O(1) load snapshot,
+    instant drain (counted), parkable via undrain, an adapter-residency
+    surface — no devices, no threads."""
+
+    def __init__(self, max_slots=2, alive=True, resident=()):
+        self.tokenizer = None
+        self.max_len = 64
+        self.max_slots = max_slots
+        self.alive = alive
+        self.draining = False
+        self.slots = 0
+        self.queue = 0
+        self.shut_down = False
+        self.drain_calls = 0
+        self.resident = list(resident)   # parked adapter names (LRU)
+
+    def load(self):
+        return {"queue_depth": self.queue, "slots_in_use": self.slots,
+                "cached_slots": 0, "max_slots": self.max_slots,
+                "max_queue": 16, "max_len": self.max_len,
+                "alive": self.alive and not self.draining,
+                "draining": self.draining}
+
+    def drain(self, deadline_s=30.0):
+        self.drain_calls += 1
+        self.draining = True
+        return True
+
+    def undrain(self):
+        if not self.alive:
+            raise RuntimeError("undrain on a dead stub")
+        self.draining = False
+
+    def adapter_resident(self, name):
+        return name in self.resident
+
+    def shutdown(self):
+        self.shut_down = True
+        self.alive = False
+
+    def health(self):
+        return {"warm": True, "dead": not self.alive}
+
+
+class StubRollout:
+    """Duck-typed rollout controller for gateway/autoscaler coordination
+    units: a fixed revision target with a build reported in flight."""
+
+    def __init__(self, revision="r9", etas=(1.2,), building=True):
+        self.rev = revision
+        self.etas = list(etas)
+        self.building = building
+
+    def revision(self):
+        return self.rev
+
+    def factory(self):
+        return StubEngine
+
+    def protected(self):
+        return frozenset()
+
+    def active(self):
+        return self.building
+
+    def build_pending(self):
+        return self.building
+
+    def expected_ready_s(self):
+        return self.etas.pop(0) if len(self.etas) > 1 else self.etas[0]
+
+    def note_outcome(self, engine, ok, ttft_s=None):
+        pass
+
+    def stats(self):
+        return {"stub": True}
+
+
+def _pol(**kw):
+    base = dict(slo_ttft_s=1.0, headroom_frac=0.25, queue_wait_p99_s=0.5,
+                shed_rate=0.1, up_ticks=2, idle_ticks=3,
+                cooldown_up_s=5.0, cooldown_down_s=10.0)
+    base.update(kw)
+    return ScalePolicy(**base)
+
+
+def _quiet_gate(timeout_s=0.3):
+    """A gate that passes an untrafficked canary fast (stub fleets
+    carry no reaper, so judgment must come from the quiet path)."""
+    return CanaryGate(min_requests=4, timeout_s=timeout_s)
+
+
+# -- canary-gate judgment units -----------------------------------------------
+
+def test_gate_waits_below_min_requests_then_quiet_passes():
+    gate = CanaryGate(min_requests=8, timeout_s=10.0)
+    can = {"n": 3, "errors": 0, "ttft": [0.01] * 3}
+    inc = {"n": 50, "errors": 0, "ttft": [0.01] * 50}
+    assert gate.judge(can, inc, 1, waited_s=1.0) is None
+    ok, name, detail = gate.judge(can, inc, 1, waited_s=10.5)
+    assert ok and name == "quiet", (name, detail)
+    with pytest.raises(ValueError):
+        CanaryGate(min_requests=0)
+
+
+def test_gate_decode_signatures_bites_before_everything():
+    """A canary that re-compiles decode per batch shape fails the gate
+    even with a spotless request window — and before min_requests."""
+    gate = CanaryGate(min_requests=8, max_decode_signatures=1)
+    can = {"n": 0, "errors": 0, "ttft": []}
+    ok, name, _ = gate.judge(can, can, 3, waited_s=0.0)
+    assert not ok and name == "decode_signatures"
+
+
+def test_gate_error_rate_judged_against_incumbent_plus_slack():
+    gate = CanaryGate(min_requests=4, err_rate_slack=0.10)
+    inc = {"n": 40, "errors": 2, "ttft": [0.01] * 40}      # 5% baseline
+    bad = {"n": 10, "errors": 5, "ttft": [0.01] * 10}      # 50%
+    ok, name, _ = gate.judge(bad, inc, 1, waited_s=1.0)
+    assert not ok and name == "error_rate"
+    near = {"n": 10, "errors": 1, "ttft": [0.01] * 10}     # 10% < 5%+10%
+    ok, name, _ = gate.judge(near, inc, 1, waited_s=1.0)
+    assert ok and name == "passed", (name,)
+
+
+def test_gate_ttft_p99_needs_ratio_and_absolute_floor():
+    gate = CanaryGate(min_requests=4, ttft_p99_ratio=2.0,
+                      ttft_p99_floor_s=0.05)
+    inc = {"n": 40, "errors": 0, "ttft": [0.040] * 40}
+    slow = {"n": 10, "errors": 0, "ttft": [0.200] * 10}    # 5x and > floor
+    ok, name, _ = gate.judge(slow, inc, 1, waited_s=1.0)
+    assert not ok and name == "ttft_p99"
+    # 5x the incumbent but under the absolute floor: a 2ms-vs-10ms blip
+    # must not fail an upgrade
+    inc_fast = {"n": 40, "errors": 0, "ttft": [0.002] * 40}
+    blip = {"n": 10, "errors": 0, "ttft": [0.010] * 10}
+    ok, name, _ = gate.judge(blip, inc_fast, 1, waited_s=1.0)
+    assert ok, (name,)
+
+
+# -- stub-fleet rollouts ------------------------------------------------------
+
+def test_rollout_success_replaces_every_replica_with_drain_invariant():
+    """All-new at steady state: every incumbent leaves only after a
+    drain (never a kill), the canary counts as the first replacement
+    (fleet size is conserved), and the outcome counter/flight trail
+    record the upgrade."""
+    registry().reset()
+    olds = [StubEngine(), StubEngine()]
+    gw = Gateway(olds, tenants=[TenantConfig("t")], start=False)
+    news = []
+
+    def factory(revision):
+        e = StubEngine()
+        news.append((revision, e))
+        return e
+
+    ctl = RolloutController(gw, factory, gate=_quiet_gate(),
+                            drain_deadline_s=1.0)
+    try:
+        res = ctl.rollout("r1", timeout=60)
+        assert res is not None and res.ok and not isinstance(
+            res, RolloutRolledBack)
+        assert res.revision == "r1" and res.upgraded == 2
+        assert set(gw.router.revisions().values()) == {"r1"}
+        assert len(gw.router.names) == 2                  # size conserved
+        assert all(rev == "r1" for rev, _ in news)
+        assert all(e.drain_calls >= 1 and e.shut_down for e in olds)
+        assert ctl.revision() == "r1" and not ctl.active()
+        counter = registry().get(FLEET_ROLLOUTS)
+        assert counter.value({"outcome": "upgraded",
+                              "revision": "r1"}) == 1.0
+        ev = {e["name"] for e in flight.events("rollout")}
+        assert {"begin", "build_begin", "routed_in", "canary_passed",
+                "drain_old_begin", "retired", "done"} <= ev, ev
+        # a second rollout to the SAME revision is a typed no-op
+        with pytest.raises(RolloutError):
+            ctl.start_rollout("r1")
+    finally:
+        ctl.shutdown()
+        gw.shutdown()
+
+
+def test_canary_gate_bites_auto_rollback_incumbents_untouched():
+    """The acceptance gate: an injected bad revision (every canary
+    request errors) is rolled back automatically — the result names the
+    failed gate, the canary is drained out, and no incumbent was ever
+    drained or removed."""
+    registry().reset()
+    olds = [StubEngine(), StubEngine()]
+    gw = Gateway(olds, tenants=[TenantConfig("t")], start=False)
+    ctl = RolloutController(
+        gw, lambda rev: StubEngine(),
+        gate=CanaryGate(min_requests=4, timeout_s=30.0),
+        drain_deadline_s=1.0)
+    try:
+        ctl.start_rollout("r1")
+
+        def feed():
+            # outcomes only count once the gate opened its window (the
+            # controller clears observations when judgment starts)
+            if not _wait(lambda: (ctl.stats()["op"] or {}).get("step")
+                         == "canary_gate", timeout=30):
+                return
+            canary = next((n for n, r in gw.router.revisions().items()
+                           if r == "r1"), None)
+            for _ in range(8):
+                ctl.note_outcome(canary, ok=False)
+                ctl.note_outcome("engine0", ok=True, ttft_s=0.01)
+
+        th = threading.Thread(target=feed)
+        th.start()
+        res = ctl.wait(timeout=60)
+        th.join(timeout=30)
+        assert isinstance(res, RolloutRolledBack) and not res.ok
+        assert res.gate == "error_rate", (res.gate, res.detail)
+        assert res.upgraded == 0
+        # all-old: the fleet serves exactly what it served before
+        assert sorted(gw.router.names) == ["engine0", "engine1"]
+        assert set(gw.router.revisions().values()) == {"r0"}
+        assert all(e.drain_calls == 0 and not e.shut_down for e in olds)
+        assert ctl.revision() == "r0"
+        counter = registry().get(FLEET_ROLLOUTS)
+        assert counter.value({"outcome": "rolled_back",
+                              "revision": "r1"}) == 1.0
+        ev = {e["name"] for e in flight.events("rollout")}
+        assert {"rollback_begin", "rolled_back"} <= ev, ev
+    finally:
+        ctl.shutdown()
+        gw.shutdown()
+
+
+def test_canary_build_that_keeps_failing_rolls_back():
+    gw = Gateway([StubEngine()], tenants=[TenantConfig("t")], start=False)
+
+    def bad_factory(revision):
+        raise RuntimeError("revision does not build")
+
+    ctl = RolloutController(gw, bad_factory, gate=_quiet_gate(),
+                            max_step_retries=2)
+    try:
+        res = ctl.rollout("r1", timeout=60)
+        assert isinstance(res, RolloutRolledBack)
+        assert res.gate == "build", (res.gate, res.detail)
+        assert gw.router.names == ["engine0"]
+        assert set(gw.router.revisions().values()) == {"r0"}
+    finally:
+        ctl.shutdown()
+        gw.shutdown()
+
+
+def test_rollout_misuse_is_typed():
+    gw = Gateway([StubEngine()], tenants=[TenantConfig("t")], start=False)
+    ctl = RolloutController(gw, lambda rev: StubEngine(),
+                            gate=CanaryGate(min_requests=4,
+                                            timeout_s=30.0))
+    try:
+        with pytest.raises(RolloutError):
+            ctl.rollout("r0")                 # already at this revision
+        ctl.start_rollout("r1")
+        with pytest.raises(RolloutError):
+            ctl.start_rollout("r2")           # one rollout at a time
+        with pytest.raises(TimeoutError):
+            ctl.wait(timeout=0.05)            # still gating
+    finally:
+        ctl.shutdown()
+        gw.shutdown()
+    with pytest.raises(RolloutError):
+        ctl.start_rollout("r2")               # shut down
+
+
+# -- crash matrix: the new fault seams ----------------------------------------
+
+@pytest.mark.parametrize("seam", ["rollout.build", "rollout.canary_gate",
+                                  "rollout.drain_old"])
+def test_crash_at_rollout_seam_is_absorbed_and_retried(seam):
+    """A raise at any new seam never half-upgrades the fleet: the step
+    is retried and the rollout still lands all-new."""
+    gw = Gateway([StubEngine(), StubEngine()],
+                 tenants=[TenantConfig("t")], start=False)
+    ctl = RolloutController(gw, lambda rev: StubEngine(),
+                            gate=_quiet_gate(), drain_deadline_s=1.0)
+    retry_ev = {"rollout.build": "build_failed",
+                "rollout.canary_gate": "canary_gate_retry",
+                "rollout.drain_old": "drain_old_retry"}[seam]
+    try:
+        faults.arm(seam, times=1)
+        res = ctl.rollout("r2", timeout=60)
+        assert res is not None and res.ok, res
+        assert faults.hits(seam) >= 2          # failed, then retried
+        assert set(gw.router.revisions().values()) == {"r2"}
+        assert len(gw.router.names) == 2
+        ev = {e["name"] for e in flight.events("rollout")}
+        assert retry_ev in ev, (seam, ev)
+    finally:
+        faults.reset()
+        ctl.shutdown()
+        gw.shutdown()
+
+
+# -- autoscaler coordination --------------------------------------------------
+
+def test_scale_down_never_victimises_rollout_replicas():
+    """protected(): with a rollout active, every target-revision
+    replica (canary, surge builds) is exempt from scale-down — the
+    victim is always an incumbent."""
+    registry().reset()
+    incumbent, canary = StubEngine(), StubEngine()
+    gw = Gateway([incumbent], tenants=[TenantConfig("t")], start=False)
+    ctl = RolloutController(gw, lambda rev: StubEngine(),
+                            gate=CanaryGate(min_requests=4,
+                                            timeout_s=30.0))
+    auto = Autoscaler(gw, StubEngine, min_replicas=1, max_replicas=4,
+                      policy=_pol(), poll_interval_s=0.02,
+                      drain_deadline_s=1.0, start=False)
+    try:
+        ctl.start_rollout("r1")
+        assert _wait(lambda: "r1" in gw.router.revisions().values(),
+                     timeout=30)
+        new_name = next(n for n, r in gw.router.revisions().items()
+                        if r == "r1")
+        assert new_name in ctl.protected()
+        assert "engine0" not in ctl.protected()
+        # the autoscaler's victim pick skips the protected replica even
+        # though it is the least loaded
+        incumbent.slots = 2
+        picked = auto._pick_victim()
+        assert picked is not None and picked[0] == "engine0", picked
+    finally:
+        ctl.shutdown()
+        auto.shutdown()
+        gw.shutdown()
+
+
+def test_scale_up_during_rollout_builds_at_target_revision():
+    """A flash crowd mid-upgrade grows the NEW fleet: the autoscaler's
+    cold build follows the rollout's revision and factory."""
+    registry().reset()
+    gw = Gateway([StubEngine()], tenants=[TenantConfig("t")], start=False)
+    gw.attach_rollout(StubRollout(revision="r9"))
+    auto = Autoscaler(gw, StubEngine, min_replicas=1, max_replicas=3,
+                      policy=_pol(), poll_interval_s=0.02,
+                      drain_deadline_s=1.0, name_prefix="as")
+    try:
+        auto.trigger("up")
+        assert _wait(lambda: len(gw.router.names) == 2, timeout=30)
+        revs = gw.router.revisions()
+        built = next(n for n in revs if n != "engine0")
+        assert revs[built] == "r9", revs
+    finally:
+        auto.shutdown()
+        gw.shutdown()
+
+
+def test_shed_retry_after_capped_and_shrinking_during_rollout_build():
+    """While a rollout build is in flight, a 429's Retry-After is the
+    build's expected completion — and successive 429s SHRINK as the
+    build progresses, instead of quoting the static horizon."""
+    from paddle_tpu.serving.gateway.admission import AdmissionError
+    from paddle_tpu.serving.gateway.shed import LoadShedder
+    shedder = LoadShedder()
+    shedder.seed(prefill_s=5.0, token_s=1.0)   # est blows any deadline
+    gw = Gateway([StubEngine()], tenants=[TenantConfig("t")],
+                 shedder=shedder, start=False)
+    with pytest.raises(AdmissionError) as e0:
+        gw.admit(_creq(deadline_ms=100), "t")
+    baseline = e0.value.retry_after_s
+    assert baseline > 2.0, baseline            # the static horizon
+    gw.attach_rollout(StubRollout(etas=[1.2, 0.4]))
+    with pytest.raises(AdmissionError) as e1:
+        gw.admit(_creq(deadline_ms=100), "t")
+    with pytest.raises(AdmissionError) as e2:
+        gw.admit(_creq(deadline_ms=100), "t")
+    assert e1.value.retry_after_s <= 1.2 < baseline
+    assert e2.value.retry_after_s < e1.value.retry_after_s, \
+        (e1.value.retry_after_s, e2.value.retry_after_s)
+    gw.shutdown()
+
+
+# -- warm pool ----------------------------------------------------------------
+
+def test_warm_pool_parks_spare_and_flash_scale_up_routes_it_in():
+    """The shelf: a spare is built and PARKED-DRAINING (refuses work),
+    a scale-up routes it in via undrain (reaction is a route-in, the
+    cold-build EWMA is untouched), and a refill restocks the shelf."""
+    registry().reset()
+    gw = Gateway([StubEngine()], tenants=[TenantConfig("t")], start=False)
+    auto = Autoscaler(gw, StubEngine, min_replicas=1, max_replicas=3,
+                      policy=_pol(), poll_interval_s=0.02,
+                      drain_deadline_s=1.0, warm_pool=1,
+                      build_s_hint=7.5, name_prefix="as")
+    try:
+        assert _wait(lambda: len(
+            auto.fleet_stats()["warm_pool"]["parked"]) == 1, timeout=30)
+        parked = auto.fleet_stats()["warm_pool"]["parked"][0]
+        assert parked["revision"] == "r0"
+        spare_eng = auto._warm[0][1]
+        assert spare_eng.draining                # parked: refuses work
+        assert spare_eng.load()["alive"] is False
+        auto.trigger("up")
+        assert _wait(lambda: len(gw.router.names) == 2, timeout=30)
+        assert parked["replica"] in gw.router.names
+        assert not spare_eng.draining            # undrained on route-in
+        assert spare_eng.load()["alive"]
+        up = [e for e in auto.events() if e["direction"] == "up"]
+        assert up and up[-1].get("warm") is True, up
+        ev = {e["name"] for e in flight.events("autoscaler")}
+        assert {"warm_park", "scale_up_warm"} <= ev, ev
+        # the route-in never feeds the cold-build EWMA
+        assert auto.fleet_stats()["build_ewma_s"] == 7.5
+        # and the shelf refills in the background
+        assert _wait(lambda: len(
+            auto.fleet_stats()["warm_pool"]["parked"]) == 1, timeout=30)
+    finally:
+        auto.shutdown()
+        gw.shutdown()
+
+
+def test_warm_pool_stale_revision_spare_is_dropped_not_routed():
+    """A parked spare at a superseded revision must never route into
+    an upgraded fleet: the pop tears it down and cold-builds at the
+    rollout's target instead."""
+    registry().reset()
+    gw = Gateway([StubEngine()], tenants=[TenantConfig("t")], start=False)
+    auto = Autoscaler(gw, StubEngine, min_replicas=1, max_replicas=3,
+                      policy=_pol(), poll_interval_s=0.02,
+                      drain_deadline_s=1.0, warm_pool=1, start=False)
+    stale = StubEngine()
+    auto._warm.append(("as-w1", stale, "r0"))
+    gw.attach_rollout(StubRollout(revision="r9"))
+    try:
+        assert auto._pop_warm() is None          # stale: dropped
+        assert stale.shut_down
+        ev = [e for e in flight.events("autoscaler")
+              if e["name"] == "warm_drop"]
+        assert ev and ev[-1]["attrs"]["reason"] == "stale_revision", ev
+        # drop_warm_pool keeps matching-revision spares only
+        keep, drop = StubEngine(), StubEngine()
+        auto._warm = [("as-w2", keep, "r9"), ("as-w3", drop, "r0")]
+        auto.drop_warm_pool(keep_revision="r9", reason="rollout")
+        assert not keep.shut_down and drop.shut_down
+        assert [w[0] for w in auto._warm] == ["as-w2"]
+    finally:
+        auto.shutdown()
+        gw.shutdown()
+
+
+def test_fleetsim_warm_pool_reaction_beats_cold_build():
+    """Sim mode: with a parked spare the flash-crowd scale-up matures
+    in route_in_s instead of build_s — and the shelf's replica-seconds
+    are charged, so the bench's cost axis stays honest."""
+    trace = make_trace(30.0, 4.0, seed=0, flash_mult=8.0, flash_at=0.3,
+                       flash_duration_s=8.0, prompt_mean=12.0,
+                       out_mean=10.0, deadline_s=3.0)
+    pol_kw = dict(slo_ttft_s=1.0, up_ticks=1, idle_ticks=8,
+                  cooldown_up_s=2.0, cooldown_down_s=6.0)
+    sim_kw = dict(min_replicas=1, max_replicas=4, slots_per_replica=4,
+                  prefill_s=0.05, token_s=0.01, build_s=1.5)
+    cold = FleetSim(ScalePolicy(**pol_kw), **sim_kw).run(trace)
+    warm = FleetSim(ScalePolicy(**pol_kw), warm_pool=1, route_in_s=0.05,
+                    **sim_kw).run(trace)
+    assert cold["warm"] is None
+    w = warm["warm"]
+    assert w["pool"] == 1 and w["warm_route_ins"] >= 1, w
+    assert w["max_warm_reaction_s"] < 1.5, w     # route-in, not a build
+    assert any(e.get("warm") for e in warm["events"])
+    assert warm["completed"] + warm["shed"] == warm["arrivals"]
+    # the shelf is not free: parked + refilling spares burn seconds
+    assert warm["replica_seconds"] > 0
+
+
+# -- adapter-locality routing -------------------------------------------------
+
+def test_pick_prefers_adapter_resident_replica_with_room():
+    """The locality tiebreak: a resident replica wins over a less
+    loaded cold one, a FULL resident replica falls back to least-loaded
+    (residency never overrides backpressure), and with no adapter the
+    ordering is exactly the pre-locality one."""
+    a, b = StubEngine(), StubEngine(resident=["lora-x"])
+    router = EngineRouter([a, b], names=["a", "b"])
+    b.slots = 1                                  # a is less loaded
+    assert router.pick()[0] == "a"
+    assert router.pick(adapter=None)[0] == "a"
+    assert router.pick(adapter="lora-x")[0] == "b"
+    assert router.pick(adapter="lora-y")[0] == "a"   # resident nowhere
+    b.slots = b.max_slots                        # resident but full
+    assert router.pick(adapter="lora-x")[0] == "a"
+    b.slots = 1
+    assert router.pick(exclude=("b",), adapter="lora-x")[0] == "a"
+
+
+def test_adapter_locality_cuts_cold_loads_on_skewed_trace():
+    """Regression for the satellite: replaying a skewed-adapter trace
+    through pick() with the adapter hint loads adapters across the
+    two-replica fleet strictly fewer times than least-loaded-only
+    routing (each off-replica dispatch of a non-resident adapter is a
+    cold load)."""
+    trace = make_trace(30.0, 6.0, seed=3, adapters=["hot", "a", "b"],
+                       adapter_skew=0.8)
+    assert trace == make_trace(30.0, 6.0, seed=3,
+                               adapters=["hot", "a", "b"],
+                               adapter_skew=0.8)  # deterministic
+    hot_frac = sum(e["model"] == "hot" for e in trace) / len(trace)
+    assert hot_frac > 0.6, hot_frac               # the skew is real
+
+    def replay(use_hint):
+        engines = [StubEngine(max_slots=4), StubEngine(max_slots=4)]
+        router = EngineRouter(engines, names=["e0", "e1"])
+        cold_loads = 0
+        for i, e in enumerate(trace):
+            name, eng = router.pick(
+                adapter=e["model"] if use_hint else None)
+            if e["model"] not in eng.resident:
+                cold_loads += 1
+                eng.resident.append(e["model"])
+                if len(eng.resident) > 2:        # a 2-row adapter bank
+                    eng.resident.pop(0)
+            # a request occupies a slot for a while: alternate a fake
+            # completion so load stays balanced and finite
+            eng.slots = (eng.slots + 1) % eng.max_slots
+        return cold_loads
+
+    with_hint = replay(True)
+    without = replay(False)
+    assert with_hint < without, (with_hint, without)
+
+
+# -- drain promptness + undrain (satellite audit) -----------------------------
+
+def test_drain_on_never_warmed_engine_returns_promptly_and_undrains(
+        tiny_gpt):
+    """Audit regression: drain() on a replica that never compiled or
+    served anything must return True in milliseconds, not sleep toward
+    the deadline — and undrain() reverses a parked drain while a dead
+    or shut-down engine refuses to re-enter a fleet."""
+    from paddle_tpu.serving import EngineClosedError
+    model, _ = tiny_gpt
+    eng = Engine(model, max_slots=2, max_len=48)
+    try:
+        t0 = time.perf_counter()
+        assert eng.drain(deadline_s=30.0) is True
+        assert time.perf_counter() - t0 < 5.0    # prompt, not deadline
+        assert eng.load()["draining"] and not eng.load()["alive"]
+        eng.undrain()
+        assert not eng.load()["draining"] and eng.load()["alive"]
+        ev = {e["name"] for e in flight.events("serving")}
+        assert "undrain" in ev, ev
+    finally:
+        eng.shutdown()
+    with pytest.raises(EngineClosedError):
+        eng.undrain()
+
+
+# -- real engines over HTTP ---------------------------------------------------
+
+def test_rollout_upgrades_real_fleet_over_http_zero_lost(tiny_gpt):
+    """End to end: a live tiny-GPT replica is upgraded to a new
+    revision under HTTP traffic — every request completes with its full
+    token count, the fleet lands all-new, the revision-labelled alive
+    gauge and rollout counter export, /debug/fleet serves the rollout
+    block, and each build keeps the one-signature decode contract."""
+    import http.client
+
+    from paddle_tpu.serving.gateway import start_gateway
+    model, cfg = tiny_gpt
+    registry().reset()
+    built = []
+
+    def factory_for_revision(revision):
+        # one model instance per replica (concurrent tracing over one
+        # shared module is not supported)
+        paddle.seed(21)
+        m = build_gpt(cfg)
+        m.eval()
+        e = Engine(m, max_slots=2, max_len=48, max_queue=32)
+        built.append((revision, e))
+        return e
+
+    stack = start_gateway([factory_for_revision("r0")], own_engines=True,
+                          tenants=[TenantConfig("t", max_queue=64)],
+                          window_s=2.0)
+    gw = stack.gateway
+    ctl = RolloutController(
+        stack, factory_for_revision,
+        gate=CanaryGate(min_requests=2, timeout_s=30.0,
+                        ttft_p99_ratio=50.0, ttft_p99_floor_s=30.0),
+        drain_deadline_s=10.0, build_s_hint=2.0)
+    results = []
+    lock = threading.Lock()
+
+    def one(i):
+        conn = http.client.HTTPConnection("127.0.0.1", stack.port,
+                                          timeout=300)
+        conn.request(
+            "POST", "/v1/completions",
+            json.dumps({"prompt": [1 + i % 7, 2, 3],
+                        "max_tokens": 4}).encode(),
+            {"Content-Type": "application/json", "X-Tenant": "t"})
+        r = conn.getresponse()
+        body = json.loads(r.read())
+        conn.close()
+        with lock:
+            results.append((r.status,
+                            len(body["choices"][0]["token_ids"])
+                            if r.status == 200 else 0))
+
+    try:
+        one(0)                                   # warm the incumbent
+        ctl.start_rollout("r1")
+        stop_feed = threading.Event()
+
+        def feed():
+            i = 1
+            while not stop_feed.is_set():
+                try:
+                    ctl.wait(0.2)
+                    return                       # rollout settled
+                except TimeoutError:
+                    pass
+                one(i)
+                i += 1
+
+        th = threading.Thread(target=feed)
+        th.start()
+        try:
+            res = ctl.wait(timeout=240)
+        finally:
+            stop_feed.set()
+            th.join(timeout=300)
+        assert res is not None and res.ok, res
+        assert res.revision == "r1" and res.upgraded == 1
+        # zero lost requests across the upgrade, full token counts
+        assert results and all(s == 200 and n == 4 for s, n in results), \
+            results
+        # no mixed revision at steady state
+        assert set(gw.router.revisions().values()) == {"r1"}
+        assert built[0][1]._stop                 # old build torn down
+        assert all(e.compile_stats()["decode_compiles"] <= 1
+                   for _, e in built)
+        # the revision-labelled fleet gauge: r1 serving, r0 swept
+        gw.router.loads()
+        series = {dict(lbl).get("revision"): v for lbl, v in
+                  registry().get(FLEET_ALIVE).series()
+                  if dict(lbl).get("revision")}
+        assert series.get("r1", 0) >= 1 and "r0" not in series, series
+        # /debug/fleet: the rollout block + per-replica revision rows
+        conn = http.client.HTTPConnection("127.0.0.1", stack.port,
+                                          timeout=60)
+        conn.request("GET", "/debug/fleet")
+        fleet = json.loads(conn.getresponse().read())
+        conn.close()
+        assert fleet["rollout"]["revision"] == "r1"
+        assert fleet["rollout"]["result"]["ok"] is True
+        assert all(row["revision"] == "r1"
+                   for row in fleet["replicas"].values()), fleet
+        conn = http.client.HTTPConnection("127.0.0.1", stack.port,
+                                          timeout=60)
+        conn.request("GET", "/metrics")
+        text = conn.getresponse().read().decode()
+        conn.close()
+        assert FLEET_ROLLOUTS in text and 'revision="r1"' in text
+    finally:
+        ctl.shutdown()
+        stack.close()
+        for _, e in built:
+            e.shutdown()

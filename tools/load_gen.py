@@ -56,7 +56,9 @@ def make_trace(duration_s: float = 60.0, base_qps: float = 4.0,
                prompt_mean: float = 16.0, prompt_sigma: float = 0.8,
                out_mean: float = 12.0, out_sigma: float = 0.7,
                prompt_max: int = 512, out_max: int = 256,
-               deadline_s: float | None = None) -> list:
+               deadline_s: float | None = None,
+               adapters: list | None = None,
+               adapter_skew: float = 0.8) -> list:
     """Seeded trace: [{"t", "prompt_len", "max_tokens"[, "deadline_s"]}].
 
     ``diurnal_period_s`` defaults to the trace duration (one full day's
@@ -64,6 +66,12 @@ def make_trace(duration_s: float = 60.0, base_qps: float = 4.0,
     fraction of the duration.  Lengths are lognormal around the given
     means — the p99 request is many times the p50, so a handful of
     requests dominate slot occupancy exactly like production.
+
+    With ``adapters`` (LoRA adapter names), each entry carries a
+    ``model`` field: the FIRST adapter gets ``adapter_skew`` of the
+    traffic, the rest split the remainder uniformly — the skewed
+    multi-adapter shape the router's locality tiebreak serves
+    (``replay_http`` forwards ``model`` on the wire).
     """
     if duration_s <= 0 or base_qps <= 0:
         raise ValueError("duration_s and base_qps must be positive")
@@ -99,6 +107,12 @@ def make_trace(duration_s: float = 60.0, base_qps: float = 4.0,
                  "max_tokens": max_tokens}
         if deadline_s is not None:
             entry["deadline_s"] = float(deadline_s)
+        if adapters:
+            if len(adapters) == 1 or rs.uniform() < adapter_skew:
+                entry["model"] = adapters[0]
+            else:
+                entry["model"] = adapters[
+                    1 + int(rs.randint(len(adapters) - 1))]
         trace.append(entry)
     return trace
 
@@ -300,6 +314,11 @@ def main() -> int:
     ap.add_argument("--prompt-max", type=int, default=64)
     ap.add_argument("--out-max", type=int, default=32)
     ap.add_argument("--deadline-s", type=float, default=None)
+    ap.add_argument("--adapters", default=None, metavar="A,B,...",
+                    help="comma-separated LoRA adapter names: entries "
+                    "carry model= with --adapter-skew of the traffic "
+                    "on the first name")
+    ap.add_argument("--adapter-skew", type=float, default=0.8)
     ap.add_argument("--tenant", default="load")
     ap.add_argument("--vocab", type=int, default=1000)
     ap.add_argument("--speed", type=float, default=1.0,
@@ -346,7 +365,10 @@ def main() -> int:
             flash_duration_s=args.flash_duration,
             prompt_mean=args.prompt_mean, out_mean=args.out_mean,
             prompt_max=args.prompt_max, out_max=args.out_max,
-            deadline_s=args.deadline_s)
+            deadline_s=args.deadline_s,
+            adapters=(args.adapters.split(",") if args.adapters
+                      else None),
+            adapter_skew=args.adapter_skew)
         print(f"# trace: {len(trace)} arrivals over {args.duration}s "
               f"(flash x{args.flash_mult} at {args.flash_at:.0%})",
               file=sys.stderr)

@@ -46,6 +46,7 @@ DEFAULT_SUBSET = [
     "tests/test_slo.py",
     "tests/test_capture.py",
     "tests/test_kv_tier.py",
+    "tests/test_rollout.py",
 ]
 
 # decode fast-path lane (ISSUE 10): prefix cache + speculation + int8 KV
@@ -857,6 +858,127 @@ print("conversation lane ok:", {
     "decode_compiles": st["decode_compiles"]})
 """
 
+# rollout lane (ISSUE 20): a real-HTTP fleet of two upgraded in place by
+# RolloutController while traffic is in flight — canary gate passes on
+# live outcomes, every replica lands at the new revision (no mixed
+# steady state), ZERO lost zero-token requests, the revision label
+# exports through /metrics and /debug/fleet, old builds are torn down,
+# and every build keeps ONE compiled decode signature.
+ROLLOUT_LANE = r"""
+import http.client, json, threading
+import paddle_tpu as paddle
+from paddle_tpu import observability as obs
+from paddle_tpu.models import build_gpt, gpt_config
+from paddle_tpu.observability import flight
+from paddle_tpu.serving import CanaryGate, Engine, RolloutController
+from paddle_tpu.serving.autoscaler import FLEET_ALIVE
+from paddle_tpu.serving.gateway import TenantConfig, start_gateway
+from paddle_tpu.serving.rollout import FLEET_ROLLOUTS
+
+assert obs.enabled(), "PADDLE_TPU_TELEMETRY=1 must bootstrap telemetry"
+cfg = gpt_config("gpt-tiny", max_position_embeddings=128,
+                 hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+built = []
+
+
+def factory_for_revision(revision):
+    # one model instance per replica: rollout builds trace their jit
+    # programs while the incumbents are still serving
+    paddle.seed(0)
+    model = build_gpt(cfg)
+    model.eval()
+    e = Engine(model, max_slots=2, max_len=48, max_queue=32)
+    built.append((revision, e))
+    return e
+
+
+stack = start_gateway(
+    [factory_for_revision("r0"), factory_for_revision("r0")],
+    own_engines=True, tenants=[TenantConfig("t", max_queue=64)])
+results = []
+lock = threading.Lock()
+
+
+def one(i):
+    conn = http.client.HTTPConnection("127.0.0.1", stack.port, timeout=300)
+    conn.request("POST", "/v1/completions",
+                 json.dumps({"prompt": [1 + i % 7, 2, 3],
+                             "max_tokens": 4}).encode(),
+                 {"Content-Type": "application/json", "X-Tenant": "t"})
+    r = conn.getresponse()
+    body = r.read()
+    n_tok = (len(json.loads(body)["choices"][0]["token_ids"])
+             if r.status == 200 else 0)
+    conn.close()
+    with lock:
+        results.append((r.status, n_tok))
+
+
+def get(path):
+    conn = http.client.HTTPConnection("127.0.0.1", stack.port, timeout=60)
+    conn.request("GET", path)
+    body = conn.getresponse().read()
+    conn.close()
+    return body
+
+
+ctl = RolloutController(
+    stack, factory_for_revision,
+    gate=CanaryGate(min_requests=2, timeout_s=60.0, ttft_p99_ratio=50.0,
+                    ttft_p99_floor_s=30.0),
+    drain_deadline_s=30.0, build_s_hint=2.0)
+try:
+    one(0)                                # warm an incumbent
+    old_builds = [e for _, e in built]
+    ctl.start_rollout("r1")
+    threads, i = [], 0
+    while i < 60:                         # live load across the upgrade
+        try:
+            ctl.wait(0.05)
+            break
+        except TimeoutError:
+            pass
+        th = threading.Thread(target=one, args=(i,))
+        th.start()
+        threads.append(th)
+        i += 1
+    res = ctl.wait(timeout=600)
+    for th in threads:
+        th.join(timeout=300)
+    assert res.ok and res.upgraded == 2, res
+    # zero lost zero-token requests: everything in flight across the
+    # upgrade completed with its full token budget
+    with lock:
+        snap = list(results)
+    assert snap and all(s == 200 and n == 4 for s, n in snap), snap
+    revs = stack.gateway.router.revisions()
+    assert len(revs) == 2 and set(revs.values()) == {"r1"}, revs
+    # the retired incumbents were torn down, one decode signature per
+    # build — the upgrade never retraced anyone
+    assert all(e._stop for e in old_builds)
+    assert all(e.compile_stats()["decode_compiles"] <= 1
+               for _, e in built), [e.compile_stats() for _, e in built]
+    text = get("/metrics").decode()
+    assert FLEET_ROLLOUTS in text and FLEET_ALIVE in text, text[:400]
+    assert 'revision="r1"' in text, "revision label missing from /metrics"
+    fleet = json.loads(get("/debug/fleet"))
+    assert fleet["rollout"]["revision"] == "r1", fleet["rollout"]
+    assert all(r["revision"] == "r1"
+               for r in fleet["replicas"].values()), fleet["replicas"]
+    names = {e["name"] for e in flight.events("rollout")}
+    assert {"begin", "routed_in", "canary_passed", "retired",
+            "done"} <= names, names
+finally:
+    ctl.shutdown()
+    stack.close()
+    for _, e in built:
+        e.shutdown()
+print("rollout lane ok:", {
+    "requests": len(snap), "upgraded": res.upgraded,
+    "builds": len(built),
+    "revisions": sorted(set(revs.values()))})
+"""
+
 # prefetch-on training lane: fit a tiny model THROUGH DevicePrefetcher with
 # telemetry live and assert the input-pipeline series were exported.  Runs
 # in its own interpreter so the env-var bootstrap path is what's exercised.
@@ -1000,6 +1122,16 @@ def main() -> int:
         if cv_rc != 0:
             print("conversation lane FAILED", file=sys.stderr)
         rc = rc or cv_rc
+        # rollout lane (ISSUE 20): a real-HTTP fleet of two upgraded in
+        # place under live load — canary gate on live outcomes, zero
+        # lost requests, revision-labelled metrics, one decode
+        # signature per build
+        print("telemetry smoke: rollout lane", file=sys.stderr)
+        ro_rc = subprocess.call([sys.executable, "-c", ROLLOUT_LANE],
+                                env=env, cwd=root)
+        if ro_rc != 0:
+            print("rollout lane FAILED", file=sys.stderr)
+        rc = rc or ro_rc
         # tpu-lint ratchet gate (ISSUE 7): runs even when the pytest
         # subset has unrelated failures, in its own interpreter (the
         # analyzer is jax-free, so it cannot be broken by runtime drift)
